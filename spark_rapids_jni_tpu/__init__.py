@@ -1,0 +1,36 @@
+"""spark_rapids_jni_tpu — TPU-native columnar acceleration layer for Apache Spark.
+
+A from-scratch, TPU-first rebuild of the capability surface of
+``com.nvidia:spark-rapids-jni`` (the native layer of the RAPIDS Accelerator
+for Apache Spark): HBM-resident columnar tables, XLA/Pallas kernels for the
+JNI-exposed operators (row<->column transpose, casts, hashing, bloom filters)
+and the cuDF operator substrate (sort, groupby-aggregate, hash-join), a pure
+C++ Parquet footer prune/filter engine, and an ICI all-to-all shuffle
+transport for multi-chip slices.
+
+Layer map (TPU equivalent of reference SURVEY.md section 1):
+  L4' Java API parity sources  -> java/ (build-gated; no JVM in this image)
+  L3' native bridge            -> src/native C API via ctypes (JNI-compatible
+                                  handle model: objects cross as int64 handles)
+  L2' operator layer           -> spark_rapids_jni_tpu.ops
+  L1' columnar substrate       -> spark_rapids_jni_tpu.columnar
+  L0' device/runtime           -> JAX/XLA on TPU (+ runtime/ arena & handles)
+
+The whole package requires 64-bit dtypes (int64 columns, decimal64, xxhash64)
+so jax x64 mode is enabled at import, before any jax array is created.
+Opt out with SPARK_RAPIDS_TPU_NO_X64=1 (not recommended).
+"""
+
+import os as _os
+
+if not _os.environ.get("SPARK_RAPIDS_TPU_NO_X64"):
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+
+from spark_rapids_jni_tpu.types import DType, TypeId  # noqa: E402
+from spark_rapids_jni_tpu.columnar import Column, Table  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = ["DType", "TypeId", "Column", "Table", "__version__"]

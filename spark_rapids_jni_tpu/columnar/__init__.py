@@ -1,0 +1,5 @@
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.columnar.table import Table
+from spark_rapids_jni_tpu.columnar.bitmask import pack_validity, unpack_validity
+
+__all__ = ["Column", "Table", "pack_validity", "unpack_validity"]

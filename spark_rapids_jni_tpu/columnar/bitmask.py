@@ -1,0 +1,48 @@
+"""Validity bitmask pack/unpack.
+
+Arrow and cuDF keep validity as a packed little-endian bitmask (bit i of word
+i//32 set => row i valid; cuDF's ``bitmask_type`` is uint32, see reference
+row_conversion.cu:158-165 where a 32-lane ballot writes one mask word).
+
+On TPU we keep validity *unpacked* on device — one bool per row — because the
+VPU operates on (8,128) vector registers of elements, not bits; select/where
+on a bool vector fuses into adjacent ops for free, while packed bits would
+force serializing shift/or chains. Packed form is used only at the host/Arrow
+boundary and inside the packed-row format, via the helpers here. Both are
+pure XLA (reshape + matmul-free bit ops) so they run on device too.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_BIT_WEIGHTS = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.uint8)
+
+
+def pack_bits_last_axis(bits: jnp.ndarray) -> jnp.ndarray:
+    """Pack bool[..., k] into uint8[..., ceil(k/8)], bit i%8 of byte i//8
+    set <=> bits[..., i]. Trailing pad bits are 0. Shared by the validity
+    bitmask (Arrow/cuDF order) and the packed-row validity tail, which use
+    the same little-endian-within-byte convention."""
+    k = bits.shape[-1]
+    n_bytes = (k + 7) // 8
+    lead = bits.shape[:-1]
+    padded = jnp.zeros((*lead, n_bytes * 8), dtype=jnp.uint8)
+    padded = padded.at[..., :k].set(bits.astype(jnp.uint8))
+    return (padded.reshape(*lead, n_bytes, 8) * _BIT_WEIGHTS).sum(
+        axis=-1, dtype=jnp.uint8
+    )
+
+
+def pack_validity(valid: jnp.ndarray) -> jnp.ndarray:
+    """Pack a bool[n] validity vector into a little-endian uint8 bitmask.
+
+    Output length is ceil(n/8); trailing pad bits are 0.
+    """
+    return pack_bits_last_axis(valid)
+
+
+def unpack_validity(mask: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Unpack a little-endian uint8 bitmask into bool[n]."""
+    bits = (mask[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+    return bits.reshape(-1)[:n].astype(jnp.bool_)

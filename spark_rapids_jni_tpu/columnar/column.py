@@ -1,0 +1,169 @@
+"""Device-resident column — the ``cudf::column`` / ``ai.rapids.cudf.ColumnVector``
+equivalent of the substrate (reference SURVEY.md section 2.2).
+
+A fixed-width column is (data: jnp[n], validity: bool jnp[n] | None).
+A string column is (offsets: int32 jnp[n+1], chars: uint8 jnp[m], validity) —
+Arrow string layout, consumed by ops.cast_strings.
+
+``validity is None`` means "no null mask allocated — all rows valid", the
+same tri-state cuDF uses (null_mask() == nullptr, reference
+row_conversion.cu:263-272 special-cases it in the kernel).
+
+Null slots in ``data`` hold unspecified values (cuDF semantics); comparisons
+and host materialization always consult validity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.types import DType, TypeId
+
+
+@dataclass
+class Column:
+    dtype: DType
+    data: jnp.ndarray
+    validity: Optional[jnp.ndarray] = None  # bool[n], True = valid
+    # String columns only: data is the int32[n+1] offsets, chars the bytes.
+    chars: Optional[jnp.ndarray] = None
+
+    def __post_init__(self) -> None:
+        if self.dtype.is_string:
+            if self.chars is None:
+                raise ValueError("string column requires chars buffer")
+            if self.data.dtype != jnp.int32:
+                raise TypeError("string offsets must be int32")
+        elif self.dtype.is_fixed_width:
+            expect = self.dtype.jnp_dtype
+            if self.data.dtype != expect:
+                raise TypeError(
+                    f"column data dtype {self.data.dtype} != storage dtype "
+                    f"{expect} for {self.dtype}"
+                )
+        if self.validity is not None and self.validity.dtype != jnp.bool_:
+            raise TypeError("validity must be bool")
+
+    @property
+    def size(self) -> int:
+        if self.dtype.is_string:
+            return int(self.data.shape[0]) - 1
+        return int(self.data.shape[0])
+
+    @property
+    def null_count(self) -> int:
+        if self.validity is None:
+            return 0
+        return int(self.size - jnp.sum(self.validity))
+
+    @property
+    def has_nulls(self) -> bool:
+        return self.null_count > 0
+
+    def valid_mask(self) -> jnp.ndarray:
+        """Validity as a concrete bool[n] (materializes all-true if absent)."""
+        if self.validity is not None:
+            return self.validity
+        return jnp.ones((self.size,), dtype=jnp.bool_)
+
+    # ---- host interop -------------------------------------------------
+
+    @classmethod
+    def from_numpy(
+        cls,
+        values: np.ndarray,
+        dtype: Optional[DType] = None,
+        validity: Optional[np.ndarray] = None,
+    ) -> "Column":
+        values = np.asarray(values)
+        if dtype is None:
+            dtype = DType.from_numpy(values.dtype)
+        data = jnp.asarray(values.astype(dtype.storage_dtype, copy=False))
+        vmask = None if validity is None else jnp.asarray(validity, dtype=jnp.bool_)
+        return cls(dtype, data, vmask)
+
+    @classmethod
+    def from_pylist(cls, values: Sequence, dtype: DType) -> "Column":
+        """Build from a python list where ``None`` marks nulls — the shape of
+        the reference's Table.TestBuilder columns (RowConversionTest.java:30-39)."""
+        if dtype.is_string:
+            valid = np.array([v is not None for v in values], dtype=bool)
+            chunks = [(v.encode() if isinstance(v, str) else (v or b"")) for v in values]
+            offsets = np.zeros(len(values) + 1, dtype=np.int32)
+            np.cumsum([len(c) for c in chunks], out=offsets[1:])
+            chars = np.frombuffer(b"".join(chunks), dtype=np.uint8)
+            return cls(
+                dtype,
+                jnp.asarray(offsets),
+                None if valid.all() else jnp.asarray(valid),
+                chars=jnp.asarray(chars.copy()),
+            )
+        valid = np.array([v is not None for v in values], dtype=bool)
+        storage = dtype.storage_dtype
+        filled = np.zeros(len(values), dtype=storage)
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            if dtype.type_id == TypeId.BOOL8:
+                filled[i] = 1 if v else 0
+            else:
+                filled[i] = v
+        vmask = None if valid.all() else jnp.asarray(valid)
+        return cls(dtype, jnp.asarray(filled), vmask)
+
+    def to_numpy(self) -> tuple[np.ndarray, Optional[np.ndarray]]:
+        """Return (data, validity) as host arrays; validity None = all valid."""
+        data = np.asarray(self.data)
+        mask = None if self.validity is None else np.asarray(self.validity)
+        return data, mask
+
+    def to_pylist(self) -> list:
+        if self.dtype.is_string:
+            offsets = np.asarray(self.data)
+            chars = np.asarray(self.chars).tobytes()
+            mask = None if self.validity is None else np.asarray(self.validity)
+            out = []
+            for i in range(self.size):
+                if mask is not None and not mask[i]:
+                    out.append(None)
+                else:
+                    out.append(chars[offsets[i] : offsets[i + 1]].decode())
+            return out
+        data, mask = self.to_numpy()
+        out = []
+        for i in range(self.size):
+            if mask is not None and not mask[i]:
+                out.append(None)
+            elif self.dtype.type_id == TypeId.BOOL8:
+                out.append(bool(data[i]))
+            else:
+                out.append(data[i].item())
+        return out
+
+    # ---- comparison (test oracle) -------------------------------------
+
+    def equals(self, other: "Column") -> bool:
+        """Null-aware equality — the AssertUtils.assertTablesAreEqual oracle
+        (reference RowConversionTest.java:51)."""
+        if self.dtype != other.dtype or self.size != other.size:
+            return False
+        a_valid = np.asarray(self.valid_mask())
+        b_valid = np.asarray(other.valid_mask())
+        if not np.array_equal(a_valid, b_valid):
+            return False
+        if self.dtype.is_string:
+            return self.to_pylist() == other.to_pylist()
+        a, b = np.asarray(self.data), np.asarray(other.data)
+        return bool(np.array_equal(a[a_valid], b[b_valid]))
+
+    def __repr__(self) -> str:
+        return f"Column({self.dtype}, size={self.size}, nulls={self.null_count})"
+
+
+def string_column(values: Sequence[Optional[str]]) -> Column:
+    return Column.from_pylist(values, t.STRING)

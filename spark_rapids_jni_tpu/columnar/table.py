@@ -1,0 +1,54 @@
+"""Device-resident table — the ``cudf::table`` / ``ai.rapids.cudf.Table``
+equivalent: an ordered set of equal-length columns.
+
+Unlike the reference, which passes tables across JNI as raw ``jlong`` native
+views (reference RowConversionJni.cpp:31-36), on the Python side a Table is a
+lightweight pytree of device arrays; the int64-handle model lives in the
+native bridge (runtime/handles) for the Java surface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+from spark_rapids_jni_tpu.columnar.column import Column
+
+
+@dataclass
+class Table:
+    columns: list[Column]
+
+    def __post_init__(self) -> None:
+        if self.columns:
+            n = self.columns[0].size
+            for c in self.columns:
+                if c.size != n:
+                    raise ValueError("all columns in a table must have equal size")
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def num_rows(self) -> int:
+        return self.columns[0].size if self.columns else 0
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def schema(self) -> list:
+        return [c.dtype for c in self.columns]
+
+    @classmethod
+    def from_pylists(cls, columns: Sequence[tuple[Sequence, object]]) -> "Table":
+        """Build from [(values, dtype), ...] — TestBuilder-style."""
+        return cls([Column.from_pylist(v, d) for v, d in columns])
+
+    def equals(self, other: "Table") -> bool:
+        return self.num_columns == other.num_columns and all(
+            a.equals(b) for a, b in zip(self.columns, other.columns)
+        )
+
+    def __repr__(self) -> str:
+        return f"Table(rows={self.num_rows}, columns={[c.dtype for c in self.columns]})"

@@ -1,0 +1,13 @@
+from spark_rapids_jni_tpu.ops.row_conversion import (
+    RowsColumn,
+    compute_fixed_width_layout,
+    convert_from_rows,
+    convert_to_rows,
+)
+
+__all__ = [
+    "RowsColumn",
+    "compute_fixed_width_layout",
+    "convert_from_rows",
+    "convert_to_rows",
+]

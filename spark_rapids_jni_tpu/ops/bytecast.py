@@ -1,0 +1,165 @@
+"""Byte views of fixed-width device arrays, portable across backends.
+
+The packed-row format (ops.row_conversion) and hashing (ops.hash) need the
+little-endian byte image of every fixed-width type. On CPU/GPU that is one
+``lax.bitcast_convert_type``. XLA:TPU's x64-rewriting pass (which emulates
+64-bit types: s64/u64 as u32 pairs, f64 as an f32 pair) does NOT implement
+bitcast-convert for 64-bit element types, so here:
+
+  * <= 4-byte types: direct bitcast (supported everywhere);
+  * 64-bit integers: arithmetic decomposition into (lo, hi) uint32 words —
+    shift/mask/convert are all implemented by the emulation pass;
+  * float64: exact bitcast where supported; elsewhere an arithmetic
+    IEEE-754 encode/decode built on log2/floor/exact-power-of-two scaling
+    (frexp/ldexp/signbit all lower to bitcasts and are unavailable there).
+    TPU's f64 emulation carries ~49 mantissa bits (f32-pair) so the low
+    bits of the emitted mantissa are zero there, and subnormals flush to
+    signed zero — documented deviations; the byte layout is identical.
+
+Byte order is little-endian in all cases (verified: u32 0x01020304 bitcasts
+to [4,3,2,1]), matching the reference row format, which inherits x86/GPU
+native order (reference row_conversion.cu:86-105 reinterprets row bytes as
+int64 words directly).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.types import DType
+
+# Backends whose XLA pipeline implements 64-bit bitcast-convert.
+_BITCAST64_BACKENDS = ("cpu", "cuda", "gpu", "rocm")
+
+
+def _has_bitcast64() -> bool:
+    return jax.default_backend() in _BITCAST64_BACKENDS
+
+
+def _u32_words_to_bytes(words: jnp.ndarray) -> jnp.ndarray:
+    """(n, k) uint32 -> (n, 4k) uint8, little-endian."""
+    n, k = words.shape
+    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(n, 4 * k)
+
+
+def _bytes_to_u32_words(b: jnp.ndarray) -> jnp.ndarray:
+    """(n, 4k) uint8 -> (n, k) uint32, little-endian."""
+    n, nbytes = b.shape
+    return jax.lax.bitcast_convert_type(
+        b.reshape(n, nbytes // 4, 4), jnp.uint32
+    )
+
+
+def _exact_exp2(e: jnp.ndarray) -> jnp.ndarray:
+    """Exactly 2.0**e for integer-valued float e in [-1074, 1023].
+
+    ``jnp.exp2`` is an approximation (off by ulps for large |e|), which is
+    not good enough for mantissa extraction. Binary exponentiation over the
+    exact constants 2**(2**b) / 2**-(2**b) uses only exact multiplies:
+    ascending-order partial products never leave the representable range
+    when the final value is a normal number.
+    """
+    neg = e < 0
+    mag = jnp.abs(e)
+    out = jnp.ones_like(e)
+    for b in range(11):  # 2**11 > 1074
+        if b == 10:
+            # 2**1024 overflows f64; bit 10 only occurs for negative e
+            # (denormal decode, e = -1074), where 2**-1024 is representable.
+            factor = jnp.where(neg, 2.0**-1024, 1.0)
+        else:
+            step = float(2 ** (2**b))
+            factor = jnp.where(neg, 1.0 / step, step)
+        out = out * jnp.where((mag.astype(jnp.int64) >> b) & 1 == 1, factor, 1.0)
+    return out
+
+
+def _f64_to_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """Arithmetic IEEE-754 binary64 encode: f64[n] -> u64[n] bit pattern.
+
+    Uses only primitives the TPU x64-emulation pass implements: abs, log2,
+    floor, exp2, division, comparisons (signbit/frexp bitcast internally and
+    are unavailable there). Exponent from floor(log2) is verified and
+    corrected by one step, so boundary values are safe even though log2 is
+    approximate under the f32-pair emulation.
+    """
+    negative = jnp.where(x != 0.0, x < 0.0, 1.0 / x < 0.0)  # catches -0.0
+    sign = negative.astype(jnp.uint64) << 63
+    ax = jnp.abs(x)
+    safe = jnp.where((ax == 0.0) | ~jnp.isfinite(ax), 1.0, ax)
+    e = jnp.floor(jnp.log2(safe))
+    m = safe / _exact_exp2(e)
+    # one correction step against log2 rounding at power-of-two boundaries
+    e = jnp.where(m >= 2.0, e + 1.0, jnp.where(m < 1.0, e - 1.0, e))
+    m = safe / _exact_exp2(e)
+    frac = jnp.round((m - 1.0) * (2.0**52))
+    # mantissa rounding may carry into the exponent
+    carry = frac >= 2.0**52
+    e = jnp.where(carry, e + 1.0, e)
+    frac = jnp.where(carry, 0.0, frac)
+    biased = jnp.clip(e.astype(jnp.int64) + 1023, 0, 2046).astype(jnp.uint64)
+    bits = sign | (biased << 52) | frac.astype(jnp.uint64)
+    # Subnormals encode as signed zero, by contract: every backend that
+    # needs this path flushes subnormal operands in arithmetic (XLA:CPU is
+    # DAZ; TPU's f32-pair emulation cannot even represent them), so their
+    # significand is unobservable here. The bitcast path is bit-exact.
+    bits = jnp.where(ax < 2.0**-1022, sign, bits)
+    bits = jnp.where(jnp.isinf(ax), sign | (jnp.uint64(2047) << 52), bits)
+    bits = jnp.where(jnp.isnan(x), jnp.uint64(0x7FF8000000000000), bits)
+    return bits
+
+
+def _bits_to_f64(bits: jnp.ndarray) -> jnp.ndarray:
+    """Arithmetic IEEE-754 binary64 decode: u64[n] -> f64[n].
+
+    Exponents outside the emulated range under/overflow to 0/inf on TPU —
+    consistent with that backend's own f64 value range.
+    """
+    sign = jnp.where((bits >> 63) != 0, -1.0, 1.0)
+    biased = ((bits >> 52) & jnp.uint64(2047)).astype(jnp.int64)
+    frac = (bits & jnp.uint64((1 << 52) - 1)).astype(jnp.float64)
+    mant = 1.0 + frac * (2.0**-52)
+    val = sign * mant * _exact_exp2((biased - 1023).astype(jnp.float64))
+    # denormals: value = frac * 2**-1074 (0 on TPU's f32 exponent range)
+    val = jnp.where(
+        biased == 0, sign * frac * _exact_exp2(jnp.float64(-1074)), val
+    )
+    val = jnp.where((biased == 2047) & (frac == 0), sign * jnp.inf, val)
+    val = jnp.where((biased == 2047) & (frac != 0), jnp.nan, val)
+    return val
+
+
+def to_bytes(data: jnp.ndarray, dtype: DType) -> jnp.ndarray:
+    """(n,) fixed-width array -> (n, size) little-endian uint8 bytes."""
+    size = dtype.size_bytes
+    if size == 1:
+        return jax.lax.bitcast_convert_type(data, jnp.uint8).reshape(-1, 1)
+    if size <= 4 or _has_bitcast64():
+        return jax.lax.bitcast_convert_type(data, jnp.uint8)
+    # 64-bit on a backend without 64-bit bitcast: go through u32 words.
+    if dtype.storage_dtype == np.dtype(np.float64):
+        u = _f64_to_bits(data)
+    else:
+        u = data.astype(jnp.uint64)
+    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (u >> 32).astype(jnp.uint32)
+    return _u32_words_to_bytes(jnp.stack([lo, hi], axis=-1))
+
+
+def from_bytes(b: jnp.ndarray, dtype: DType) -> jnp.ndarray:
+    """(n, size) little-endian uint8 bytes -> (n,) of the storage dtype."""
+    target = dtype.jnp_dtype
+    size = dtype.size_bytes
+    if size == 1:
+        return jax.lax.bitcast_convert_type(b.reshape(-1), target)
+    if size <= 4 or _has_bitcast64():
+        return jax.lax.bitcast_convert_type(b, target)
+    words = _bytes_to_u32_words(b)
+    u = words[:, 0].astype(jnp.uint64) | (
+        words[:, 1].astype(jnp.uint64) << 32
+    )
+    if dtype.storage_dtype == np.dtype(np.float64):
+        return _bits_to_f64(u)
+    return u.astype(target)

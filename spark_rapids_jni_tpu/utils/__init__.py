@@ -1,0 +1,4 @@
+from spark_rapids_jni_tpu.utils.tracing import func_range, trace_range
+from spark_rapids_jni_tpu.utils.config import get_option, set_option
+
+__all__ = ["func_range", "trace_range", "get_option", "set_option"]

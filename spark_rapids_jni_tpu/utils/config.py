@@ -1,0 +1,60 @@
+"""Runtime configuration.
+
+The reference configures at three tiers — Maven -D properties -> CMake cache
+vars -> Java system properties (SURVEY.md section 5, "Config / flag system");
+at runtime only system properties matter (e.g. ``ai.rapids.cudf.nvtx.enabled``,
+reference pom.xml:85,437). The TPU equivalent: env vars
+(``SPARK_RAPIDS_TPU_<OPTION>``) overridden by programmatic set_option, with
+documented defaults. No config files.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_ENV_PREFIX = "SPARK_RAPIDS_TPU_"
+
+# option name -> (default, parser)
+_OPTIONS: dict[str, tuple[Any, type]] = {
+    # NVTX-equivalent trace annotations (ai.rapids.cudf.nvtx.enabled parity;
+    # default false like pom.xml:85).
+    "tracing.enabled": (False, bool),
+    # Lift the reference's 1.5KB row-size contract check.
+    "row_conversion.enforce_row_limit": (True, bool),
+    # Log level for the thin runtime logger (slf4j-equivalent).
+    "log.level": ("WARNING", str),
+}
+
+_overrides: dict[str, Any] = {}
+
+
+def _parse(raw: str, typ: type) -> Any:
+    if typ is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+def get_option(name: str) -> Any:
+    if name not in _OPTIONS:
+        raise KeyError(f"unknown option {name!r}")
+    if name in _overrides:
+        return _overrides[name]
+    default, typ = _OPTIONS[name]
+    env = os.environ.get(_ENV_PREFIX + name.upper().replace(".", "_"))
+    if env is not None:
+        return _parse(env, typ)
+    return default
+
+
+def set_option(name: str, value: Any) -> None:
+    if name not in _OPTIONS:
+        raise KeyError(f"unknown option {name!r}")
+    _, typ = _OPTIONS[name]
+    # coerce through the same parser env values get, so
+    # set_option("tracing.enabled", "off") == env ..._ENABLED=off
+    _overrides[name] = _parse(value, typ) if isinstance(value, str) else typ(value)
+
+
+def reset_option(name: str) -> None:
+    _overrides.pop(name, None)

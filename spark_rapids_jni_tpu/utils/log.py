@@ -1,0 +1,29 @@
+"""Thin logging facade — the slf4j-api equivalent (the reference's only
+compile-scope Java dependency, pom.xml:143-153; RMM log level via env,
+pom.xml:82). Level comes from the ``log.level`` option
+(env SPARK_RAPIDS_TPU_LOG_LEVEL)."""
+
+from __future__ import annotations
+
+import logging
+
+from spark_rapids_jni_tpu.utils.config import get_option
+
+_configured = False
+
+
+def get_logger(name: str = "spark_rapids_jni_tpu") -> logging.Logger:
+    global _configured
+    logger = logging.getLogger(name)
+    if not _configured:
+        level = getattr(logging, str(get_option("log.level")).upper(), logging.WARNING)
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        root = logging.getLogger("spark_rapids_jni_tpu")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+        _configured = True
+    return logger

@@ -1,0 +1,46 @@
+"""Profiler trace annotations — the NVTX-range equivalent.
+
+The reference opens an NVTX range (``CUDF_FUNC_RANGE()``) at the top of every
+nontrivial native function (e.g. NativeParquetJni.cpp:191,400,455,508) behind
+the ``ai.rapids.cudf.nvtx.enabled`` toggle (pom.xml:85,437). Here the same
+granularity is provided with ``jax.profiler.TraceAnnotation``, which lands in
+XLA/Perfetto traces captured via ``jax.profiler.trace``. Disabled by default,
+toggled by the ``tracing.enabled`` option (env
+``SPARK_RAPIDS_TPU_TRACING_ENABLED=1``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable, TypeVar
+
+from spark_rapids_jni_tpu.utils.config import get_option
+
+F = TypeVar("F", bound=Callable)
+
+
+@contextlib.contextmanager
+def trace_range(name: str):
+    """Context manager opening a named profiler range when tracing is on."""
+    if not get_option("tracing.enabled"):
+        yield
+        return
+    import jax.profiler
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def func_range(name: str) -> Callable[[F], F]:
+    """Decorator form — CUDF_FUNC_RANGE() parity."""
+
+    def deco(fn: F) -> F:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with trace_range(name):
+                return fn(*args, **kwargs)
+
+        return wrapper  # type: ignore[return-value]
+
+    return deco

@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+Unit tests run on a virtual 8-device CPU mesh (the driver validates the real
+multi-chip path separately via __graft_entry__.dryrun_multichip). Env must be
+set before jax initializes its backends, hence at conftest import time.
+
+Mirrors the reference's test policy (SURVEY.md section 4): round-trip /
+golden-equality against a host oracle; device-conditional features gated by
+markers, not mocks.
+"""
+
+import os
+
+# XLA_FLAGS must be in place before the CPU backend initializes. The axon
+# environment pins JAX_PLATFORMS in a way plain env vars don't override, so
+# the platform itself is forced via jax.config below.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,111 @@
+"""Byte-view helpers: exact against numpy's little-endian byte images.
+
+The u32-word decomposition path (used on TPU, where 64-bit bitcast-convert
+is unimplemented) is covered here on CPU by forcing it, so its arithmetic is
+oracle-checked bit-exactly even though the TPU itself only carries ~49
+mantissa bits for f64.
+"""
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu.ops.bytecast as bc
+from spark_rapids_jni_tpu import types as t
+
+
+ALL_TYPES = [
+    (t.INT8, np.int8),
+    (t.INT16, np.int16),
+    (t.INT32, np.int32),
+    (t.INT64, np.int64),
+    (t.UINT64, np.uint64),
+    (t.FLOAT32, np.float32),
+    (t.FLOAT64, np.float64),
+]
+
+
+def _sample(np_dtype, rng, n=257):
+    if np_dtype == np.float32 or np_dtype == np.float64:
+        vals = rng.standard_normal(n) * 10.0 ** rng.integers(-30, 30, n)
+        vals = np.concatenate([vals, [0.0, -0.0, np.inf, -np.inf, np.nan, 1.0]])
+        return vals.astype(np_dtype)
+    info = np.iinfo(np_dtype)
+    vals = rng.integers(info.min, info.max, n, dtype=np_dtype)
+    return np.concatenate(
+        [vals, np.array([info.min, info.max, 0, 1], dtype=np_dtype)]
+    )
+
+
+@pytest.mark.parametrize("dtype,np_dtype", ALL_TYPES)
+def test_to_bytes_matches_numpy(dtype, np_dtype, rng):
+    import jax.numpy as jnp
+
+    vals = _sample(np_dtype, rng)
+    got = np.asarray(bc.to_bytes(jnp.asarray(vals), dtype))
+    want = vals.view(np.uint8).reshape(len(vals), dtype.size_bytes)
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("dtype,np_dtype", ALL_TYPES)
+def test_from_bytes_round_trip(dtype, np_dtype, rng):
+    import jax.numpy as jnp
+
+    vals = _sample(np_dtype, rng)
+    back = np.asarray(bc.from_bytes(bc.to_bytes(jnp.asarray(vals), dtype), dtype))
+    # nan-aware bit comparison
+    assert np.array_equal(back.view(np.uint8), vals.view(np.uint8))
+
+
+@pytest.mark.parametrize("dtype,np_dtype", [(t.INT64, np.int64), (t.UINT64, np.uint64), (t.FLOAT64, np.float64)])
+def test_decomposition_path_exact(dtype, np_dtype, rng, monkeypatch):
+    """Force the TPU code path (no 64-bit bitcast) on CPU and check it is
+    bit-exact there (full f64 precision exists on CPU)."""
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(bc, "_has_bitcast64", lambda: False)
+    vals = _sample(np_dtype, rng)
+    got = np.asarray(bc.to_bytes(jnp.asarray(vals), dtype))
+    want = vals.view(np.uint8).reshape(len(vals), 8)
+    if np_dtype == np.float64:
+        # NaN encodes to the canonical quiet NaN pattern; compare values
+        back = np.asarray(bc.from_bytes(jnp.asarray(got), dtype))
+        finite = np.isfinite(vals)
+        assert np.array_equal(back[finite], vals[finite])
+        assert np.array_equal(np.isnan(back), np.isnan(vals))
+        assert np.array_equal(np.isinf(back), np.isinf(vals))
+        # sign of -0.0 survives
+        zero = vals == 0
+        assert np.array_equal(np.signbit(back[zero]), np.signbit(vals[zero]))
+    else:
+        assert np.array_equal(got, want)
+        back = np.asarray(bc.from_bytes(jnp.asarray(got), dtype))
+        assert np.array_equal(back, vals)
+
+
+def test_f64_arithmetic_encode_bit_exact_on_cpu(rng, monkeypatch):
+    """On CPU (true doubles) the arithmetic encoder must produce exactly the
+    IEEE bit pattern for finite normals."""
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(bc, "_has_bitcast64", lambda: False)
+    vals = rng.standard_normal(1000) * 10.0 ** rng.integers(-300, 300, 1000)
+    got = np.asarray(bc.to_bytes(jnp.asarray(vals), t.FLOAT64))
+    want = vals.view(np.uint8).reshape(-1, 8)
+    assert np.array_equal(got, want)
+
+
+def test_f64_subnormal_contract(monkeypatch):
+    """Decomposition path: subnormals flush to signed zero (documented —
+    DAZ backends make their significand unobservable to arithmetic);
+    the smallest normals are exact. Bitcast path stays bit-exact."""
+    import jax.numpy as jnp
+
+    vals = np.array([5e-324, -5e-324, 2.0**-1030, 2.0**-1022, -(2.0**-1022)])
+    # bitcast path (real CPU): bit-exact including subnormals
+    got = np.asarray(bc.to_bytes(jnp.asarray(vals), t.FLOAT64))
+    assert np.array_equal(got, vals.view(np.uint8).reshape(-1, 8))
+
+    monkeypatch.setattr(bc, "_has_bitcast64", lambda: False)
+    got = np.asarray(bc.to_bytes(jnp.asarray(vals), t.FLOAT64))
+    flushed = np.array([0.0, -0.0, 0.0, 2.0**-1022, -(2.0**-1022)])
+    assert np.array_equal(got, flushed.view(np.uint8).reshape(-1, 8))

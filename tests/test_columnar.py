@@ -1,0 +1,69 @@
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table, pack_validity, unpack_validity
+
+
+def test_column_from_pylist_nulls():
+    c = Column.from_pylist([3, None, 4], t.INT64)
+    assert c.size == 3
+    assert c.null_count == 1
+    assert c.to_pylist() == [3, None, 4]
+
+
+def test_column_no_mask_when_all_valid():
+    c = Column.from_pylist([1, 2, 3], t.INT32)
+    assert c.validity is None
+    assert c.null_count == 0
+
+
+def test_bool_column_storage():
+    c = Column.from_pylist([True, False, None], t.BOOL8)
+    assert c.data.dtype == jnp.uint8
+    assert c.to_pylist() == [True, False, None]
+
+
+def test_decimal_column():
+    c = Column.from_pylist([5000, 9500, None], t.decimal32(-3))
+    assert c.dtype.scale == -3
+    assert c.data.dtype == jnp.int32
+    assert c.to_pylist() == [5000, 9500, None]
+
+
+def test_string_column_roundtrip():
+    c = Column.from_pylist(["hello", "", None, "wörld"], t.STRING)
+    assert c.size == 4
+    assert c.to_pylist() == ["hello", "", None, "wörld"]
+
+
+def test_table_equality():
+    a = Table.from_pylists([([1, 2, None], t.INT32), ([1.5, None, 2.5], t.FLOAT64)])
+    b = Table.from_pylists([([1, 2, None], t.INT32), ([1.5, None, 2.5], t.FLOAT64)])
+    assert a.equals(b)
+    c = Table.from_pylists([([1, 2, 3], t.INT32), ([1.5, None, 2.5], t.FLOAT64)])
+    assert not a.equals(c)
+
+
+def test_table_unequal_sizes_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        Table.from_pylists([([1, 2], t.INT32), ([1, 2, 3], t.INT32)])
+
+
+def test_validity_pack_unpack_roundtrip(rng):
+    for n in (1, 7, 8, 9, 64, 100):
+        valid = jnp.asarray(rng.random(n) > 0.5)
+        packed = pack_validity(valid)
+        assert packed.shape[0] == (n + 7) // 8
+        back = unpack_validity(packed, n)
+        assert np.array_equal(np.asarray(back), np.asarray(valid))
+
+
+def test_validity_pack_bit_order():
+    # bit i of byte i//8, little-endian within the byte (Arrow/cuDF order)
+    valid = jnp.asarray([True] + [False] * 7 + [False, True])
+    packed = np.asarray(pack_validity(valid))
+    assert packed[0] == 1
+    assert packed[1] == 2

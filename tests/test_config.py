@@ -1,0 +1,69 @@
+import logging
+
+import pytest
+
+from spark_rapids_jni_tpu.utils import config
+from spark_rapids_jni_tpu.utils.log import get_logger
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    for name in list(config._overrides):
+        config.reset_option(name)
+
+
+def test_defaults():
+    assert config.get_option("tracing.enabled") is False
+    assert config.get_option("row_conversion.enforce_row_limit") is True
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_TRACING_ENABLED", "true")
+    assert config.get_option("tracing.enabled") is True
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_TRACING_ENABLED", "off")
+    assert config.get_option("tracing.enabled") is False
+
+
+def test_set_option_coerces_like_env():
+    config.set_option("tracing.enabled", "off")
+    assert config.get_option("tracing.enabled") is False
+    config.set_option("tracing.enabled", "1")
+    assert config.get_option("tracing.enabled") is True
+
+
+def test_unknown_option_rejected():
+    with pytest.raises(KeyError):
+        config.get_option("no.such.option")
+    with pytest.raises(KeyError):
+        config.set_option("no.such.option", 1)
+
+
+def test_row_limit_option_wired():
+    from spark_rapids_jni_tpu import types as t
+    from spark_rapids_jni_tpu.columnar import Table
+    from spark_rapids_jni_tpu.ops import convert_to_rows
+
+    table = Table.from_pylists([([0], t.INT64)] * 200)  # 1600B row
+    with pytest.raises(ValueError):
+        convert_to_rows(table)
+    config.set_option("row_conversion.enforce_row_limit", False)
+    assert convert_to_rows(table)[0].row_size >= 1600
+
+
+def test_logger_level_from_option():
+    config.set_option("log.level", "DEBUG")
+    # fresh configuration path
+    import spark_rapids_jni_tpu.utils.log as log_mod
+
+    log_mod._configured = False
+    logger = get_logger("spark_rapids_jni_tpu.test")
+    assert logging.getLogger("spark_rapids_jni_tpu").level == logging.DEBUG
+
+
+def test_zero_column_table_clear_error():
+    from spark_rapids_jni_tpu.columnar import Table
+    from spark_rapids_jni_tpu.ops import convert_to_rows
+
+    with pytest.raises(ValueError, match="at least one column"):
+        convert_to_rows(Table([]))

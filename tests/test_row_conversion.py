@@ -1,0 +1,161 @@
+"""Row<->column conversion tests.
+
+The round-trip test mirrors the reference's canonical test
+(src/test/java/com/nvidia/spark/rapids/jni/RowConversionTest.java:29-59):
+8 fixed-width columns with nulls incl. decimal32/decimal64, convert to rows,
+assert single batch + row count, convert back, assert table equality.
+Layout unit tests pin the byte-format contract from RowConversion.java:40-99.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Table, Column
+from spark_rapids_jni_tpu.ops import (
+    RowsColumn,
+    compute_fixed_width_layout,
+    convert_from_rows,
+    convert_to_rows,
+)
+
+
+def _reference_test_table() -> Table:
+    # Same shape as Table.TestBuilder in RowConversionTest.java:30-39.
+    return Table.from_pylists(
+        [
+            ([3, 9, 4, 2, 20, None], t.INT64),
+            ([5.0, 9.5, 0.9, 7.23, 2.8, None], t.FLOAT64),
+            ([5, 1, 0, 2, 7, None], t.INT32),
+            ([True, False, False, True, False, None], t.BOOL8),
+            ([1.0, 3.5, 5.9, 7.1, 9.8, None], t.FLOAT32),
+            ([2, 3, 4, 5, 9, None], t.INT8),
+            ([5000, 9500, 900, 7230, 2800, None], t.decimal32(-3)),
+            ([3, 9, 4, 2, 20, None], t.decimal64(-8)),
+        ]
+    )
+
+
+def test_fixed_width_rows_round_trip():
+    table = _reference_test_table()
+    rows = convert_to_rows(table)
+    assert len(rows) == 1  # no batch overflow
+    assert rows[0].num_rows == table.num_rows
+    back = convert_from_rows(rows[0], table.schema())
+    assert table.equals(back)
+
+
+def test_layout_javadoc_example():
+    # | A BOOL8 | B INT16 | C DURATION_DAYS | from RowConversion.java:60-68:
+    # A at 0, pad, B at 2, C at 4, validity byte at 8, row padded to 16.
+    starts, sizes, row_size = compute_fixed_width_layout(
+        [t.BOOL8, t.INT16, t.DURATION_DAYS]
+    )
+    assert starts == [0, 2, 4]
+    assert sizes == [1, 2, 4]
+    assert row_size == 16
+
+
+def test_layout_ordered_descending_is_tight():
+    # C, B, A ordering: |C 4B|B 2B|A 1B|V| = 8 bytes (RowConversion.java:85-89)
+    starts, sizes, row_size = compute_fixed_width_layout(
+        [t.DURATION_DAYS, t.INT16, t.BOOL8]
+    )
+    assert starts == [0, 4, 6]
+    assert row_size == 8
+
+
+def test_row_bytes_exact():
+    # Pin the exact byte image for a tiny table: int32 col + int8 col.
+    table = Table.from_pylists([([0x04030201], t.INT32), ([0x7F], t.INT8)])
+    [rows] = convert_to_rows(table)
+    assert rows.row_size == 8  # 4 + 1 + 1 validity -> pad to 8
+    img = np.asarray(rows.data)
+    assert list(img[:4]) == [0x01, 0x02, 0x03, 0x04]  # little-endian int32
+    assert img[4] == 0x7F
+    assert img[5] == 0b11  # both columns valid
+    assert list(img[6:]) == [0, 0]
+
+
+def test_null_validity_bits():
+    table = Table.from_pylists(
+        [([1, None], t.INT8), ([None, 2], t.INT8), ([3, 4], t.INT8)]
+    )
+    [rows] = convert_to_rows(table)
+    img = np.asarray(rows.data).reshape(2, rows.row_size)
+    # validity byte directly after 3 int8 columns
+    assert img[0][3] == 0b101  # col1 null in row 0
+    assert img[1][3] == 0b110  # col0 null in row 1
+
+
+def test_more_than_8_columns_validity():
+    n_cols = 11
+    cols = [([i, None, i + 1], t.INT32) for i in range(n_cols)]
+    table = Table.from_pylists(cols)
+    [rows] = convert_to_rows(table)
+    # 11 int32 cols = 44 bytes, 2 validity bytes -> 46 -> pad to 48
+    assert rows.row_size == 48
+    back = convert_from_rows(rows[0] if isinstance(rows, list) else rows, table.schema())
+    assert table.equals(back)
+
+
+def test_offsets_sequence():
+    table = Table.from_pylists([([1, 2, 3], t.INT32)])
+    [rows] = convert_to_rows(table)
+    assert list(np.asarray(rows.offsets)) == [0, 8, 16, 24]
+
+
+def test_from_rows_layout_validation():
+    table = Table.from_pylists([([1, 2, 3], t.INT32)])
+    [rows] = convert_to_rows(table)
+    with pytest.raises(ValueError, match="layout"):
+        convert_from_rows(rows, [t.INT64])
+
+
+def test_row_size_limit_enforced():
+    schema = [([0], t.INT64)] * 200  # 200*8 = 1600 > 1536
+    table = Table.from_pylists(schema)
+    with pytest.raises(ValueError, match="too large"):
+        convert_to_rows(table)
+    # and the limit can be lifted on TPU
+    out = convert_to_rows(table, enforce_row_limit=False)
+    assert out[0].row_size >= 1600
+
+
+def test_batching_splits_at_int32_max():
+    # Use a tiny synthetic check of the batching arithmetic by monkeypatching
+    # num_rows handling: directly verify max_rows_per_batch math instead of
+    # allocating 2GB.
+    from spark_rapids_jni_tpu.ops.row_conversion import INT32_MAX
+
+    _, _, row_size = compute_fixed_width_layout([t.INT64, t.INT32])
+    max_rows = (INT32_MAX // row_size) // 32 * 32
+    assert max_rows % 32 == 0
+    assert max_rows * row_size < INT32_MAX
+
+
+def test_round_trip_large_random(rng):
+    n = 10_000
+    table = Table(
+        [
+            Column.from_numpy(rng.integers(-(2**62), 2**62, n).astype(np.int64),
+                              validity=rng.random(n) > 0.1),
+            Column.from_numpy(rng.standard_normal(n).astype(np.float32)),
+            Column.from_numpy(rng.integers(-128, 127, n).astype(np.int8),
+                              validity=rng.random(n) > 0.5),
+            Column.from_numpy((rng.random(n) > 0.5).astype(np.uint8), t.BOOL8,
+                              validity=rng.random(n) > 0.9),
+        ]
+    )
+    [rows] = convert_to_rows(table)
+    back = convert_from_rows(rows, table.schema())
+    assert table.equals(back)
+
+
+def test_empty_table_rows():
+    table = Table.from_pylists([([], t.INT32)])
+    out = convert_to_rows(table)
+    assert len(out) == 1
+    assert out[0].num_rows == 0
+    back = convert_from_rows(out[0], table.schema())
+    assert back.num_rows == 0
