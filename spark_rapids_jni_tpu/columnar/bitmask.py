@@ -15,8 +15,11 @@ pure XLA (reshape + matmul-free bit ops) so they run on device too.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
-_BIT_WEIGHTS = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.uint8)
+# numpy constant: folded into traced computations without forcing device
+# initialization at import time
+_BIT_WEIGHTS = np.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=np.uint8)
 
 
 def pack_bits_last_axis(bits: jnp.ndarray) -> jnp.ndarray:
@@ -44,5 +47,5 @@ def pack_validity(valid: jnp.ndarray) -> jnp.ndarray:
 
 def unpack_validity(mask: jnp.ndarray, n: int) -> jnp.ndarray:
     """Unpack a little-endian uint8 bitmask into bool[n]."""
-    bits = (mask[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+    bits = (mask[:, None] >> np.arange(8, dtype=np.uint8)) & np.uint8(1)
     return bits.reshape(-1)[:n].astype(jnp.bool_)

@@ -159,7 +159,8 @@ class Column:
         if self.dtype.is_string:
             return self.to_pylist() == other.to_pylist()
         a, b = np.asarray(self.data), np.asarray(other.data)
-        return bool(np.array_equal(a[a_valid], b[b_valid]))
+        nan_ok = np.issubdtype(a.dtype, np.floating)
+        return bool(np.array_equal(a[a_valid], b[b_valid], equal_nan=nan_ok))
 
     def __repr__(self) -> str:
         return f"Column({self.dtype}, size={self.size}, nulls={self.null_count})"

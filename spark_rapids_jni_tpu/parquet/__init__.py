@@ -1,0 +1,3 @@
+from spark_rapids_jni_tpu.parquet.footer import ParquetFooter
+
+__all__ = ["ParquetFooter"]

@@ -1,0 +1,122 @@
+"""Parquet footer prune/filter — Python surface over the native engine.
+
+API parity with com.nvidia.spark.rapids.jni.ParquetFooter (reference
+src/main/java/.../ParquetFooter.java:24-114): readAndFilter, getNumRows,
+getNumColumns, serializeThriftFile, AutoCloseable semantics. The heavy
+lifting is C++ (src/native/src/parquet_footer.cpp); objects cross the
+boundary as int64 handles like the reference's jlong handles.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Sequence
+
+from spark_rapids_jni_tpu.runtime import load_native
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+class NativeError(RuntimeError):
+    """Raised when the native core reports a failure — the CudfException
+    equivalent of the reference's CATCH_STD bridge."""
+
+
+class ParquetFooter:
+    def __init__(self, handle: int):
+        if handle == 0:
+            raise ValueError("null footer handle")
+        self._handle = handle
+
+    @classmethod
+    @func_range("ParquetFooter.readAndFilter")
+    def read_and_filter(
+        cls,
+        buffer: bytes,
+        part_offset: int,
+        part_length: int,
+        names: Sequence[str],
+        num_children: Sequence[int],
+        parent_num_children: int,
+        ignore_case: bool = False,
+    ) -> "ParquetFooter":
+        """Parse a raw thrift footer (no PAR1 framing), prune to the
+        requested depth-first column tree, and filter row groups to the
+        partition byte range (negative part_length keeps all groups).
+        Names should be pre-lowercased by the caller when ignore_case is
+        set, as the reference documents (ParquetFooter.java:78-79)."""
+        lib = load_native()
+        if len(names) != len(num_children):
+            raise ValueError("names and num_children must have equal length")
+        c_names = (ctypes.c_char_p * len(names))(
+            *[n.encode() for n in names]
+        )
+        c_children = (ctypes.c_int32 * len(num_children))(*num_children)
+        handle = lib.tpudf_footer_read_and_filter(
+            buffer,
+            len(buffer),
+            part_offset,
+            part_length,
+            c_names,
+            c_children,
+            len(names),
+            parent_num_children,
+            1 if ignore_case else 0,
+        )
+        if handle == 0:
+            raise NativeError(lib.last_error())
+        return cls(handle)
+
+    def _require_open(self) -> int:
+        if self._handle == 0:
+            raise ValueError("footer is closed")
+        return self._handle
+
+    @property
+    def num_rows(self) -> int:
+        lib = load_native()
+        out = lib.tpudf_footer_num_rows(self._require_open())
+        if out < 0:
+            raise NativeError(lib.last_error())
+        return out
+
+    @property
+    def num_columns(self) -> int:
+        lib = load_native()
+        out = lib.tpudf_footer_num_columns(self._require_open())
+        if out < 0:
+            raise NativeError(lib.last_error())
+        return out
+
+    @func_range("ParquetFooter.serializeThriftFile")
+    def serialize_thrift_file(self) -> bytes:
+        """Emit a legal footer file image: PAR1 + thrift + length + PAR1
+        (reference NativeParquetJni.cpp:603-620)."""
+        lib = load_native()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        rc = lib.tpudf_footer_serialize(
+            self._require_open(), ctypes.byref(out), ctypes.byref(out_len)
+        )
+        if rc != 0:
+            raise NativeError(lib.last_error())
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            lib.tpudf_free_buffer(out)
+
+    def close(self) -> None:
+        if self._handle != 0:
+            load_native().tpudf_footer_close(self._handle)
+            self._handle = 0
+
+    def __enter__(self) -> "ParquetFooter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

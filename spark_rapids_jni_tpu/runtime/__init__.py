@@ -1,0 +1,3 @@
+from spark_rapids_jni_tpu.runtime.native import NativeLib, load_native
+
+__all__ = ["NativeLib", "load_native"]

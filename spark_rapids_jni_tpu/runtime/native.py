@@ -1,0 +1,123 @@
+"""Native library loader — the NativeDepsLoader equivalent.
+
+The reference extracts per-platform .so resources from the jar and
+System.load()s them on first API touch (reference RowConversion.java:23-25,
+packaging scheme pom.xml:385-421). Here the equivalent search order is:
+
+  1. ``SPARK_RAPIDS_TPU_NATIVE_LIB`` env var (explicit path);
+  2. a packaged ``_lib/libtpudf.so`` next to this module;
+  3. ``build/native/libtpudf.so`` under the repo root;
+  4. if a toolchain is available, configure+build it with cmake/ninja into
+     ``build/native`` (the dev-workflow path; the reference drives the same
+     step from Maven at the validate phase, pom.xml:306-333).
+
+Loading is lazy and memoized; errors carry the full search trail.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+_LIB_NAME = "libtpudf.so"
+
+_lock = threading.Lock()
+_loaded: Optional["NativeLib"] = None
+
+
+class NativeLib:
+    """ctypes surface of libtpudf with argtypes pinned."""
+
+    def __init__(self, cdll: ctypes.CDLL, path: pathlib.Path):
+        self.path = path
+        self._c = cdll
+        c = cdll
+        c.tpudf_last_error.restype = ctypes.c_char_p
+        c.tpudf_footer_read_and_filter.restype = ctypes.c_int64
+        c.tpudf_footer_read_and_filter.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+        ]
+        c.tpudf_footer_num_rows.restype = ctypes.c_int64
+        c.tpudf_footer_num_rows.argtypes = [ctypes.c_int64]
+        c.tpudf_footer_num_columns.restype = ctypes.c_int32
+        c.tpudf_footer_num_columns.argtypes = [ctypes.c_int64]
+        c.tpudf_footer_serialize.restype = ctypes.c_int32
+        c.tpudf_footer_serialize.argtypes = [
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        c.tpudf_free_buffer.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        c.tpudf_footer_close.restype = ctypes.c_int32
+        c.tpudf_footer_close.argtypes = [ctypes.c_int64]
+        c.tpudf_open_handles.restype = ctypes.c_int64
+
+    def __getattr__(self, name):
+        return getattr(self._c, name)
+
+    def last_error(self) -> str:
+        return self._c.tpudf_last_error().decode(errors="replace")
+
+
+def _candidate_paths() -> list[pathlib.Path]:
+    out = []
+    env = os.environ.get("SPARK_RAPIDS_TPU_NATIVE_LIB")
+    if env:
+        out.append(pathlib.Path(env))
+    out.append(pathlib.Path(__file__).parent / "_lib" / _LIB_NAME)
+    out.append(_REPO_ROOT / "build" / "native" / _LIB_NAME)
+    return out
+
+
+def _build_native() -> Optional[pathlib.Path]:
+    src = _REPO_ROOT / "src" / "native"
+    build = _REPO_ROOT / "build" / "native"
+    if not src.exists():
+        return None
+    try:
+        subprocess.run(
+            ["cmake", "-S", str(src), "-B", str(build), "-G", "Ninja"],
+            check=True,
+            capture_output=True,
+        )
+        subprocess.run(
+            ["ninja", "-C", str(build)], check=True, capture_output=True
+        )
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    lib = build / _LIB_NAME
+    return lib if lib.exists() else None
+
+
+def load_native() -> NativeLib:
+    global _loaded
+    with _lock:
+        if _loaded is not None:
+            return _loaded
+        tried = []
+        for path in _candidate_paths():
+            if path.exists():
+                _loaded = NativeLib(ctypes.CDLL(str(path)), path)
+                return _loaded
+            tried.append(str(path))
+        built = _build_native()
+        if built is not None:
+            _loaded = NativeLib(ctypes.CDLL(str(built)), built)
+            return _loaded
+        raise OSError(
+            f"could not locate or build {_LIB_NAME}; searched: {tried} "
+            "and cmake build of src/native failed"
+        )

@@ -1,0 +1,109 @@
+// Generic Apache Thrift compact-protocol value tree.
+//
+// The reference deserializes Parquet footers into thrift-compiler-generated
+// structs (reference src/main/cpp/src/NativeParquetJni.cpp:452-481 via
+// TCompactProtocol and generated parquet_types.h). This rebuild instead
+// parses the compact wire format (a public, stable spec) into a generic
+// tagged tree: every field — known or unknown — survives a
+// parse -> edit -> serialize round trip byte-compatibly, with no thrift
+// compiler or generated code in the build. Footer-specific logic addresses
+// fields by their parquet.thrift ids (see parquet_footer.cpp).
+//
+// Anti-bomb limits match the reference (NativeParquetJni.cpp:466-471):
+// 100MB max string, 1M max container elements.
+
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace tpudf {
+namespace thrift {
+
+// Compact-protocol wire type ids (field headers and collection elements).
+enum class WireType : uint8_t {
+  STOP = 0,
+  BOOL_TRUE = 1,
+  BOOL_FALSE = 2,
+  I8 = 3,
+  I16 = 4,
+  I32 = 5,
+  I64 = 6,
+  DOUBLE = 7,
+  BINARY = 8,
+  LIST = 9,
+  SET = 10,
+  MAP = 11,
+  STRUCT = 12,
+};
+
+struct Value;
+
+struct Field {
+  int16_t id;
+  std::unique_ptr<Value> value;
+};
+
+// A parsed thrift value. Exactly one of the members is meaningful,
+// discriminated by `type` (BOOL_TRUE doubles as the generic bool kind).
+struct Value {
+  WireType type = WireType::STOP;
+
+  bool b = false;
+  int64_t i = 0;      // I8/I16/I32/I64 (zigzag-decoded)
+  double d = 0.0;
+  std::string bin;    // BINARY (string or bytes)
+
+  // LIST/SET: element wire type + elements.
+  WireType elem_type = WireType::STOP;
+  std::vector<Value> elems;
+
+  // MAP: key/value wire types + pairwise entries.
+  WireType key_type = WireType::STOP;
+  WireType val_type = WireType::STOP;
+  std::vector<Value> keys;
+  std::vector<Value> vals;
+
+  // STRUCT: fields in original wire order.
+  std::vector<Field> fields;
+
+  Value() = default;
+  explicit Value(WireType t) : type(t) {}
+  Value(Value&&) = default;
+  Value& operator=(Value&&) = default;
+  Value(Value const& o) { *this = o; }
+  Value& operator=(Value const& o);
+
+  // Struct helpers: find a field by parquet.thrift id (nullptr if absent).
+  Value* field(int16_t id);
+  Value const* field(int16_t id) const;
+  // Get-or-insert keeping ascending id order (compact protocol deltas
+  // require non-decreasing emit order for maximum compatibility).
+  Value& set_field(int16_t id, WireType t);
+
+  int64_t as_i64() const { return i; }
+  std::string const& as_binary() const { return bin; }
+};
+
+class ParseError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+struct Limits {
+  uint64_t max_string_size = 100ull * 1000 * 1000;  // reference parity
+  uint64_t max_container_size = 1000ull * 1000;
+};
+
+// Parse a single struct (e.g. a Parquet FileMetaData) from `buf[0..len)`.
+// Throws ParseError on malformed input or limit violations.
+Value parse_struct(uint8_t const* buf, uint64_t len, Limits const& limits = {});
+
+// Serialize a struct value to compact-protocol bytes.
+std::string serialize_struct(Value const& v);
+
+}  // namespace thrift
+}  // namespace tpudf

@@ -1,0 +1,168 @@
+// C ABI for the native core — the bridge layer (L3') that plays the role of
+// the reference's JNI files. Objects cross the boundary as opaque int64
+// handles exactly like the reference's jlong pointer-handles
+// (RowConversionJni.cpp:31-36, NativeParquetJni.cpp:547), but routed
+// through a registry so stale handles fail cleanly instead of crashing.
+// Errors follow the reference's CATCH_STD shape (NativeParquetJni.cpp:549):
+// every entry point catches, stores a message, returns a sentinel; callers
+// fetch the message via tpudf_last_error().
+//
+// Consumed by ctypes (spark_rapids_jni_tpu.runtime.native) and by the JNI
+// shim (java/ bridge, built only where a JDK exists).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "tpudf/parquet_footer.hpp"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(std::string msg) { g_last_error = std::move(msg); }
+
+// Generic handle registry: int64 ids -> owned objects. ids start at 1; 0 is
+// the null/error sentinel (matching the reference returning 0 on failure).
+// Lookups hand out shared_ptr so a concurrent close (e.g. Python GC calling
+// __del__ on another thread while ctypes has released the GIL) cannot free
+// an object mid-use — the last owner wins.
+class Registry {
+ public:
+  int64_t put(std::shared_ptr<tpudf::parquet::Footer> obj) {
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t id = next_++;
+    map_[id] = std::move(obj);
+    return id;
+  }
+
+  std::shared_ptr<tpudf::parquet::Footer> get(int64_t id) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = map_.find(id);
+    return it == map_.end() ? nullptr : it->second;
+  }
+
+  bool erase(int64_t id) {
+    std::lock_guard<std::mutex> lock(mu_);
+    return map_.erase(id) > 0;
+  }
+
+  int64_t size() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return static_cast<int64_t>(map_.size());
+  }
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<int64_t, std::shared_ptr<tpudf::parquet::Footer>> map_;
+  int64_t next_ = 1;
+};
+
+Registry& footers() {
+  static Registry r;
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+char const* tpudf_last_error() { return g_last_error.c_str(); }
+
+// Parse + prune + filter in one call, mirroring the readAndFilter JNI entry
+// (reference NativeParquetJni.cpp:499-550). Returns a footer handle, 0 on
+// error.
+int64_t tpudf_footer_read_and_filter(uint8_t const* buf, uint64_t len,
+                                     int64_t part_offset, int64_t part_length,
+                                     char const* const* names,
+                                     int32_t const* num_children,
+                                     int32_t n_names,
+                                     int32_t parent_num_children,
+                                     int32_t ignore_case) {
+  try {
+    auto footer = std::make_shared<tpudf::parquet::Footer>(
+        tpudf::parquet::Footer::parse(buf, len));
+    std::vector<std::string> name_vec;
+    std::vector<int32_t> child_vec;
+    name_vec.reserve(n_names);
+    child_vec.reserve(n_names);
+    for (int32_t k = 0; k < n_names; ++k) {
+      name_vec.emplace_back(names[k]);
+      child_vec.push_back(num_children[k]);
+    }
+    // Order matters: the midpoint filter reads the file's first column, so
+    // row-group filtering runs between schema pruning and chunk gathering
+    // (reference NativeParquetJni.cpp:524-545).
+    footer->prune_columns(name_vec, child_vec, parent_num_children,
+                          ignore_case != 0);
+    if (part_length >= 0) {
+      footer->filter_row_groups(part_offset, part_length);
+    }
+    footer->filter_columns();
+    return footers().put(std::move(footer));
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return 0;
+  }
+}
+
+int64_t tpudf_footer_num_rows(int64_t handle) {
+  try {
+    auto f = footers().get(handle);
+    if (f == nullptr) throw std::invalid_argument("invalid footer handle");
+    return f->num_rows();
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+int32_t tpudf_footer_num_columns(int64_t handle) {
+  try {
+    auto f = footers().get(handle);
+    if (f == nullptr) throw std::invalid_argument("invalid footer handle");
+    return f->num_columns();
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+// Serialize with PAR1 framing into a malloc'd buffer the caller frees with
+// tpudf_free_buffer. Returns 0 on success.
+int32_t tpudf_footer_serialize(int64_t handle, uint8_t** out,
+                               uint64_t* out_len) {
+  try {
+    auto f = footers().get(handle);
+    if (f == nullptr) throw std::invalid_argument("invalid footer handle");
+    std::string framed = f->serialize_framed();
+    *out = static_cast<uint8_t*>(std::malloc(framed.size()));
+    if (*out == nullptr) throw std::bad_alloc();
+    std::memcpy(*out, framed.data(), framed.size());
+    *out_len = framed.size();
+    return 0;
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+void tpudf_free_buffer(uint8_t* buf) { std::free(buf); }
+
+int32_t tpudf_footer_close(int64_t handle) {
+  if (!footers().erase(handle)) {
+    set_error("invalid footer handle");
+    return -1;
+  }
+  return 0;
+}
+
+// Open-handle count — backs leak-check tests, the moral equivalent of the
+// reference's refcount leak-debugging flag (pom.xml:86,436).
+int64_t tpudf_open_handles() { return footers().size(); }
+}

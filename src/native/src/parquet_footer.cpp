@@ -1,0 +1,363 @@
+#include "tpudf/parquet_footer.hpp"
+
+#include <map>
+#include <stdexcept>
+
+namespace tpudf {
+namespace parquet {
+
+using thrift::Value;
+using thrift::WireType;
+
+std::string utf8_to_lower(std::string const& in) {
+  std::string out;
+  out.reserve(in.size());
+  size_t i = 0;
+  while (i < in.size()) {
+    unsigned char c = in[i];
+    if (c < 0x80) {
+      out.push_back(c >= 'A' && c <= 'Z' ? c + 32 : c);
+      ++i;
+      continue;
+    }
+    // Decode one UTF-8 sequence.
+    uint32_t cp = 0;
+    int extra = 0;
+    if ((c & 0xE0) == 0xC0) {
+      cp = c & 0x1F;
+      extra = 1;
+    } else if ((c & 0xF0) == 0xE0) {
+      cp = c & 0x0F;
+      extra = 2;
+    } else if ((c & 0xF8) == 0xF0) {
+      cp = c & 0x07;
+      extra = 3;
+    } else {
+      throw std::invalid_argument("invalid character sequence");
+    }
+    if (i + extra >= in.size()) {
+      throw std::invalid_argument("invalid character sequence");
+    }
+    for (int k = 1; k <= extra; ++k) {
+      unsigned char cc = in[i + k];
+      if ((cc & 0xC0) != 0x80) {
+        throw std::invalid_argument("invalid character sequence");
+      }
+      cp = (cp << 6) | (cc & 0x3F);
+    }
+    i += extra + 1;
+    // Latin-1 supplement upper -> lower (except U+00D7 multiplication sign).
+    if (cp >= 0xC0 && cp <= 0xDE && cp != 0xD7) cp += 0x20;
+    // Re-encode.
+    if (cp < 0x80) {
+      out.push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+  }
+  return out;
+}
+
+Footer Footer::parse(uint8_t const* buf, uint64_t len) {
+  return Footer(thrift::parse_struct(buf, len));
+}
+
+namespace {
+
+// The requested-column tree, built depth-first from the JNI-shaped
+// (names, num_children) request. s_id numbers nodes in request depth-first
+// order (root = 0); c_id numbers leaves only.
+struct RequestNode {
+  std::map<std::string, RequestNode> children;
+  int s_id = 0;
+  int c_id = -1;
+};
+
+RequestNode build_request_tree(std::vector<std::string> const& names,
+                               std::vector<int32_t> const& num_children,
+                               int32_t parent_num_children) {
+  RequestNode root;
+  if (parent_num_children == 0) return root;
+  if (names.size() != num_children.size()) {
+    throw std::invalid_argument("names and num_children length mismatch");
+  }
+  int next_s = 0;
+  int next_c = -1;
+  std::vector<RequestNode*> stack{&root};
+  std::vector<int32_t> remaining{parent_num_children};
+  for (size_t k = 0; k < names.size(); ++k) {
+    if (stack.empty()) {
+      throw std::invalid_argument("request tree: too many entries");
+    }
+    ++next_s;
+    RequestNode node;
+    node.s_id = next_s;
+    if (num_children[k] == 0) node.c_id = ++next_c;
+    auto [it, _] = stack.back()->children.try_emplace(names[k], node);
+    if (num_children[k] > 0) {
+      stack.push_back(&it->second);
+      remaining.push_back(num_children[k]);
+    } else {
+      while (!stack.empty() && --remaining.back() == 0) {
+        stack.pop_back();
+        remaining.pop_back();
+      }
+    }
+  }
+  if (!stack.empty()) {
+    throw std::invalid_argument("request tree: not enough entries");
+  }
+  return root;
+}
+
+struct PruneMaps {
+  std::vector<int> schema_gather;       // output schema pos -> input index
+  std::vector<int> schema_num_children; // new num_children per output pos
+  std::vector<int> chunk_gather;        // output chunk pos -> input leaf idx
+};
+
+// One pass over the flattened file schema, matching against the request
+// tree. Same observable semantics as the reference's column_pruner
+// (NativeParquetJni.cpp:122-303): missing requested columns leave gaps
+// that are compressed out by the ordered maps.
+PruneMaps compute_prune_maps(Value const& schema_list, RequestNode& request,
+                             bool ignore_case) {
+  auto const& elems = schema_list.elems;
+  if (elems.empty()) {
+    throw std::invalid_argument("a root schema element must exist");
+  }
+  std::map<int, int> schema_map;        // s_id -> input schema index
+  std::map<int, int> num_children_map;  // s_id -> new num_children
+  std::map<int, int> chunk_map;         // c_id -> input leaf index
+  schema_map[0] = 0;
+  num_children_map[0] = 0;
+
+  std::vector<RequestNode*> stack{&request};
+  Value const* root_nc = elems[0].field(fid::kSeNumChildren);
+  std::vector<int64_t> remaining{root_nc ? root_nc->i : 0};
+
+  int chunk_index = 0;
+  for (size_t idx = 1; idx < elems.size() && !stack.empty(); ++idx) {
+    Value const& se = elems[idx];
+    Value const* name_f = se.field(fid::kSeName);
+    std::string name = name_f ? name_f->bin : std::string();
+    if (ignore_case) name = utf8_to_lower(name);
+    Value const* nc_f = se.field(fid::kSeNumChildren);
+    int64_t n_children = nc_f ? nc_f->i : 0;
+    bool is_leaf = se.field(fid::kSeType) != nullptr;
+
+    RequestNode* found = nullptr;
+    if (stack.back() != nullptr) {
+      auto it = stack.back()->children.find(name);
+      if (it != stack.back()->children.end()) {
+        found = &it->second;
+        ++num_children_map[stack.back()->s_id];
+        schema_map[found->s_id] = static_cast<int>(idx);
+        num_children_map[found->s_id] = 0;
+      }
+    }
+    if (is_leaf) {
+      if (found != nullptr) chunk_map[found->c_id] = chunk_index;
+      ++chunk_index;
+    }
+    if (n_children > 0) {
+      stack.push_back(found);
+      remaining.push_back(n_children);
+    } else {
+      while (!stack.empty() && --remaining.back() == 0) {
+        stack.pop_back();
+        remaining.pop_back();
+      }
+    }
+  }
+
+  PruneMaps maps;
+  for (auto const& [_, v] : schema_map) maps.schema_gather.push_back(v);
+  for (auto const& [_, v] : num_children_map) {
+    maps.schema_num_children.push_back(v);
+  }
+  for (auto const& [_, v] : chunk_map) maps.chunk_gather.push_back(v);
+  return maps;
+}
+
+int64_t chunk_start_offset(Value const& chunk) {
+  Value const* md = chunk.field(fid::kCcMetaData);
+  if (md == nullptr) return 0;
+  Value const* data_off = md->field(fid::kCmDataPageOffset);
+  int64_t offset = data_off ? data_off->i : 0;
+  Value const* dict_off = md->field(fid::kCmDictionaryPageOffset);
+  if (dict_off != nullptr && offset > dict_off->i) offset = dict_off->i;
+  return offset;
+}
+
+}  // namespace
+
+void Footer::prune_columns(std::vector<std::string> const& names,
+                           std::vector<int32_t> const& num_children,
+                           int32_t parent_num_children, bool ignore_case) {
+  Value* schema = meta_.field(fid::kSchema);
+  if (schema == nullptr || schema->type != WireType::LIST) {
+    throw std::invalid_argument("footer has no schema list");
+  }
+  RequestNode request =
+      build_request_tree(names, num_children, parent_num_children);
+  PruneMaps maps = compute_prune_maps(*schema, request, ignore_case);
+
+  // Gather the schema, rewriting num_children where the element carries it
+  // (leaves without the field stay without it, like the reference, whose
+  // plain member assignment does not flip thrift's __isset flag).
+  std::vector<Value> new_schema;
+  new_schema.reserve(maps.schema_gather.size());
+  for (size_t out = 0; out < maps.schema_gather.size(); ++out) {
+    Value se = schema->elems[maps.schema_gather[out]];
+    if (Value* nc = se.field(fid::kSeNumChildren)) {
+      nc->i = maps.schema_num_children[out];
+    }
+    new_schema.push_back(std::move(se));
+  }
+  schema->elems = std::move(new_schema);
+
+  // Gather column_orders by leaf position.
+  if (Value* orders = meta_.field(fid::kColumnOrders)) {
+    std::vector<Value> new_orders;
+    new_orders.reserve(maps.chunk_gather.size());
+    for (int src : maps.chunk_gather) {
+      if (src < 0 || static_cast<size_t>(src) >= orders->elems.size()) continue;
+      new_orders.push_back(orders->elems[src]);
+    }
+    orders->elems = std::move(new_orders);
+  }
+
+  chunk_gather_ = std::move(maps.chunk_gather);
+  pruned_ = true;
+}
+
+void Footer::filter_columns() {
+  if (!pruned_) {
+    throw std::logic_error("filter_columns requires prune_columns first");
+  }
+  Value* groups = meta_.field(fid::kRowGroups);
+  if (groups == nullptr) return;
+  for (Value& rg : groups->elems) {
+    Value* cols = rg.field(fid::kRgColumns);
+    if (cols == nullptr) continue;
+    std::vector<Value> new_cols;
+    new_cols.reserve(chunk_gather_.size());
+    for (int src : chunk_gather_) {
+      if (src < 0 || static_cast<size_t>(src) >= cols->elems.size()) {
+        throw std::out_of_range("chunk index outside row group columns");
+      }
+      new_cols.push_back(cols->elems[src]);
+    }
+    cols->elems = std::move(new_cols);
+  }
+}
+
+void Footer::filter_row_groups(int64_t part_offset, int64_t part_length) {
+  if (part_length < 0) return;  // reference gate: NativeParquetJni.cpp:542
+  Value* groups = meta_.field(fid::kRowGroups);
+  if (groups == nullptr || groups->elems.empty()) return;
+
+  // PARQUET-2078: only the first row group's file_offset is trustworthy;
+  // if the first chunk carries metadata, use page offsets instead.
+  Value const& first_chunk0 = [&]() -> Value const& {
+    Value const* cols = groups->elems[0].field(fid::kRgColumns);
+    if (cols == nullptr || cols->elems.empty()) {
+      throw std::invalid_argument("row group has no columns");
+    }
+    return cols->elems[0];
+  }();
+  bool use_chunk_meta = first_chunk0.field(fid::kCcMetaData) != nullptr;
+
+  int64_t prev_start = 0;
+  int64_t prev_compressed = 0;
+  std::vector<Value> kept;
+  for (Value& rg : groups->elems) {
+    int64_t start;
+    if (use_chunk_meta) {
+      Value const* cols = rg.field(fid::kRgColumns);
+      if (cols == nullptr || cols->elems.empty()) {
+        throw std::invalid_argument("row group has no columns");
+      }
+      start = chunk_start_offset(cols->elems[0]);
+    } else {
+      Value const* fo = rg.field(fid::kRgFileOffset);
+      start = fo ? fo->i : 0;
+      bool invalid = prev_start == 0
+                         ? start != 4
+                         : start < prev_start + prev_compressed;
+      if (invalid) {
+        // first group always starts at 4 (after the PAR1 magic); later
+        // groups fall back to the previous end (imprecise under padding
+        // but fine for midpoint filtering)
+        start = prev_start == 0 ? 4 : prev_start + prev_compressed;
+      }
+      prev_start = start;
+      Value const* tcs = rg.field(fid::kRgTotalCompressedSize);
+      prev_compressed = tcs ? tcs->i : 0;
+    }
+
+    int64_t total_size = 0;
+    if (Value const* tcs = rg.field(fid::kRgTotalCompressedSize)) {
+      total_size = tcs->i;
+    } else if (Value const* cols = rg.field(fid::kRgColumns)) {
+      for (Value const& cc : cols->elems) {
+        if (Value const* md = cc.field(fid::kCcMetaData)) {
+          if (Value const* sz = md->field(fid::kCmTotalCompressedSize)) {
+            total_size += sz->i;
+          }
+        }
+      }
+    }
+
+    int64_t mid_point = start + total_size / 2;
+    if (mid_point >= part_offset && mid_point < part_offset + part_length) {
+      kept.push_back(std::move(rg));
+    }
+  }
+  groups->elems = std::move(kept);
+}
+
+int64_t Footer::num_rows() const {
+  Value const* groups = meta_.field(fid::kRowGroups);
+  if (groups == nullptr) return 0;
+  int64_t total = 0;
+  for (Value const& rg : groups->elems) {
+    if (Value const* n = rg.field(fid::kRgNumRows)) total += n->i;
+  }
+  return total;
+}
+
+int32_t Footer::num_columns() const {
+  Value const* schema = meta_.field(fid::kSchema);
+  if (schema == nullptr || schema->elems.empty()) return 0;
+  Value const* nc = schema->elems[0].field(fid::kSeNumChildren);
+  return nc ? static_cast<int32_t>(nc->i) : 0;
+}
+
+std::string Footer::serialize_framed() const {
+  std::string body = thrift::serialize_struct(meta_);
+  std::string out;
+  out.reserve(body.size() + 12);
+  out.append("PAR1");
+  out.append(body);
+  uint32_t n = static_cast<uint32_t>(body.size());
+  for (int k = 0; k < 4; ++k) {
+    out.push_back(static_cast<char>((n >> (8 * k)) & 0xFF));
+  }
+  out.append("PAR1");
+  return out;
+}
+
+}  // namespace parquet
+}  // namespace tpudf

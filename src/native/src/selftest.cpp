@@ -1,0 +1,110 @@
+// Minimal native smoke test (run via ctest): build a synthetic footer with
+// the generic thrift writer, then parse -> prune -> filter -> serialize and
+// check invariants. The thorough oracle tests live in tests/ (Python),
+// which cross-check against an independent pure-python compact codec.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+// assert() compiles out under -DNDEBUG (Release); this test must be able to
+// fail in every build type.
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,    \
+                   __LINE__, #cond);                                   \
+      std::exit(1);                                                    \
+    }                                                                  \
+  } while (0)
+
+#include "tpudf/parquet_footer.hpp"
+
+using tpudf::thrift::Value;
+using tpudf::thrift::WireType;
+namespace fid = tpudf::parquet::fid;
+
+namespace {
+
+Value schema_element(char const* name, int64_t num_children, bool leaf) {
+  Value se(WireType::STRUCT);
+  if (leaf) se.set_field(fid::kSeType, WireType::I32).i = 1;  // Type INT32
+  se.set_field(fid::kSeName, WireType::BINARY).bin = name;
+  if (num_children >= 0) {
+    se.set_field(fid::kSeNumChildren, WireType::I32).i = num_children;
+  }
+  return se;
+}
+
+Value column_chunk(int64_t data_page_offset, int64_t total_compressed) {
+  Value cc(WireType::STRUCT);
+  Value& md = cc.set_field(fid::kCcMetaData, WireType::STRUCT);
+  md.set_field(fid::kCmTotalCompressedSize, WireType::I64).i = total_compressed;
+  md.set_field(fid::kCmDataPageOffset, WireType::I64).i = data_page_offset;
+  return cc;
+}
+
+}  // namespace
+
+int main() {
+  // footer: root { a: int32, b: int32, c: int32 }, two row groups
+  Value meta(WireType::STRUCT);
+  Value& schema = meta.set_field(fid::kSchema, WireType::LIST);
+  schema.elem_type = WireType::STRUCT;
+  schema.elems.push_back(schema_element("root", 3, false));
+  schema.elems.push_back(schema_element("a", -1, true));
+  schema.elems.push_back(schema_element("b", -1, true));
+  schema.elems.push_back(schema_element("c", -1, true));
+  meta.set_field(fid::kNumRows, WireType::I64).i = 100;
+  Value& groups = meta.set_field(fid::kRowGroups, WireType::LIST);
+  groups.elem_type = WireType::STRUCT;
+  for (int g = 0; g < 2; ++g) {
+    Value rg(WireType::STRUCT);
+    Value& cols = rg.set_field(fid::kRgColumns, WireType::LIST);
+    cols.elem_type = WireType::STRUCT;
+    for (int c = 0; c < 3; ++c) {
+      cols.elems.push_back(column_chunk(4 + g * 3000 + c * 1000, 1000));
+    }
+    rg.set_field(fid::kRgNumRows, WireType::I64).i = 50;
+    rg.set_field(fid::kRgTotalCompressedSize, WireType::I64).i = 3000;
+    groups.elems.push_back(std::move(rg));
+  }
+
+  std::string bytes = tpudf::thrift::serialize_struct(meta);
+
+  // parse -> prune to {c, a} -> keep only the first row group's byte range
+  auto footer = tpudf::parquet::Footer::parse(
+      reinterpret_cast<uint8_t const*>(bytes.data()), bytes.size());
+  footer.prune_columns({"c", "a"}, {0, 0}, 2, false);
+  footer.filter_row_groups(0, 3000);
+  footer.filter_columns();
+
+  CHECK(footer.num_columns() == 2);
+  CHECK(footer.num_rows() == 50);
+
+  std::string framed = footer.serialize_framed();
+  CHECK(framed.size() > 12);
+  CHECK(std::memcmp(framed.data(), "PAR1", 4) == 0);
+  CHECK(std::memcmp(framed.data() + framed.size() - 4, "PAR1", 4) == 0);
+
+  // the framed body re-parses and retains the pruned shape
+  auto again = tpudf::parquet::Footer::parse(
+      reinterpret_cast<uint8_t const*>(framed.data()) + 4, framed.size() - 12);
+  CHECK(again.num_columns() == 2);
+  CHECK(again.num_rows() == 50);
+
+  // case-insensitive prune matches mixed-case request
+  auto f2 = tpudf::parquet::Footer::parse(
+      reinterpret_cast<uint8_t const*>(bytes.data()), bytes.size());
+  f2.prune_columns({"A"}, {0}, 1, false);
+  f2.filter_columns();
+  CHECK(f2.num_columns() == 0);  // case-sensitive: no match
+  auto f3 = tpudf::parquet::Footer::parse(
+      reinterpret_cast<uint8_t const*>(bytes.data()), bytes.size());
+  f3.prune_columns({"a"}, {0}, 1, true);
+  f3.filter_columns();
+  CHECK(f3.num_columns() == 1);
+
+  std::printf("tpudf selftest OK\n");
+  return 0;
+}

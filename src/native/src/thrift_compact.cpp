@@ -1,0 +1,335 @@
+#include "tpudf/thrift_compact.hpp"
+
+#include <algorithm>
+#include <cstring>
+
+namespace tpudf {
+namespace thrift {
+
+Value& Value::operator=(Value const& o) {
+  if (this == &o) return *this;
+  type = o.type;
+  b = o.b;
+  i = o.i;
+  d = o.d;
+  bin = o.bin;
+  elem_type = o.elem_type;
+  elems = o.elems;
+  key_type = o.key_type;
+  val_type = o.val_type;
+  keys = o.keys;
+  vals = o.vals;
+  fields.clear();
+  fields.reserve(o.fields.size());
+  for (auto const& f : o.fields) {
+    fields.push_back(Field{f.id, std::make_unique<Value>(*f.value)});
+  }
+  return *this;
+}
+
+Value* Value::field(int16_t id) {
+  for (auto& f : fields) {
+    if (f.id == id) return f.value.get();
+  }
+  return nullptr;
+}
+
+Value const* Value::field(int16_t id) const {
+  for (auto const& f : fields) {
+    if (f.id == id) return f.value.get();
+  }
+  return nullptr;
+}
+
+Value& Value::set_field(int16_t id, WireType t) {
+  if (Value* existing = field(id)) {
+    existing->type = t;
+    return *existing;
+  }
+  auto it = std::find_if(fields.begin(), fields.end(),
+                         [id](Field const& f) { return f.id > id; });
+  it = fields.insert(it, Field{id, std::make_unique<Value>(t)});
+  return *it->value;
+}
+
+namespace {
+
+class Reader {
+ public:
+  Reader(uint8_t const* buf, uint64_t len, Limits const& limits)
+      : buf_(buf), len_(len), limits_(limits) {}
+
+  Value read_struct() {
+    Value v(WireType::STRUCT);
+    if (++depth_ > 64) throw ParseError("struct nesting too deep");
+    int16_t last_id = 0;
+    for (;;) {
+      uint8_t header = read_byte();
+      if (header == 0) break;  // STOP
+      auto wire = static_cast<WireType>(header & 0x0F);
+      int16_t delta = static_cast<int16_t>(header >> 4);
+      int16_t id =
+          delta != 0 ? static_cast<int16_t>(last_id + delta) : read_zigzag16();
+      last_id = id;
+      Value fv = read_value(wire);
+      v.fields.push_back(Field{id, std::make_unique<Value>(std::move(fv))});
+    }
+    --depth_;
+    return v;
+  }
+
+  uint64_t pos() const { return pos_; }
+
+ private:
+  Value read_value(WireType wire) {
+    switch (wire) {
+      case WireType::BOOL_TRUE: {
+        Value v(WireType::BOOL_TRUE);
+        v.b = true;
+        return v;
+      }
+      case WireType::BOOL_FALSE: {
+        Value v(WireType::BOOL_FALSE);
+        v.b = false;
+        return v;
+      }
+      case WireType::I8: {
+        Value v(WireType::I8);
+        v.i = static_cast<int8_t>(read_byte());
+        return v;
+      }
+      case WireType::I16:
+      case WireType::I32:
+      case WireType::I64: {
+        Value v(wire);
+        v.i = read_zigzag64();
+        return v;
+      }
+      case WireType::DOUBLE: {
+        Value v(WireType::DOUBLE);
+        uint64_t raw = 0;
+        for (int k = 0; k < 8; ++k) {  // little-endian per compact spec
+          raw |= static_cast<uint64_t>(read_byte()) << (8 * k);
+        }
+        std::memcpy(&v.d, &raw, 8);
+        return v;
+      }
+      case WireType::BINARY: {
+        Value v(WireType::BINARY);
+        uint64_t n = read_varint();
+        if (n > limits_.max_string_size) throw ParseError("string too large");
+        require(n);
+        v.bin.assign(reinterpret_cast<char const*>(buf_ + pos_), n);
+        pos_ += n;
+        return v;
+      }
+      case WireType::LIST:
+      case WireType::SET: {
+        Value v(wire);
+        uint8_t header = read_byte();
+        uint64_t n = header >> 4;
+        v.elem_type = static_cast<WireType>(header & 0x0F);
+        if (n == 0x0F) n = read_varint();
+        if (n > limits_.max_container_size) throw ParseError("container too large");
+        v.elems.reserve(n);
+        for (uint64_t k = 0; k < n; ++k) {
+          v.elems.push_back(read_collection_elem(v.elem_type));
+        }
+        return v;
+      }
+      case WireType::MAP: {
+        Value v(WireType::MAP);
+        uint64_t n = read_varint();
+        if (n > limits_.max_container_size) throw ParseError("container too large");
+        if (n > 0) {
+          uint8_t kv = read_byte();
+          v.key_type = static_cast<WireType>(kv >> 4);
+          v.val_type = static_cast<WireType>(kv & 0x0F);
+          v.keys.reserve(n);
+          v.vals.reserve(n);
+          for (uint64_t k = 0; k < n; ++k) {
+            v.keys.push_back(read_collection_elem(v.key_type));
+            v.vals.push_back(read_collection_elem(v.val_type));
+          }
+        }
+        return v;
+      }
+      case WireType::STRUCT:
+        return read_struct();
+      default:
+        throw ParseError("unknown compact wire type");
+    }
+  }
+
+  // Inside collections, bools are one byte (1=true, 2=false), not encoded
+  // in the element-type nibble.
+  Value read_collection_elem(WireType t) {
+    if (t == WireType::BOOL_TRUE || t == WireType::BOOL_FALSE) {
+      uint8_t raw = read_byte();
+      Value v(raw == 1 ? WireType::BOOL_TRUE : WireType::BOOL_FALSE);
+      v.b = (raw == 1);
+      return v;
+    }
+    return read_value(t);
+  }
+
+  void require(uint64_t n) {
+    if (pos_ + n > len_) throw ParseError("unexpected end of thrift data");
+  }
+
+  uint8_t read_byte() {
+    require(1);
+    return buf_[pos_++];
+  }
+
+  uint64_t read_varint() {
+    uint64_t out = 0;
+    int shift = 0;
+    for (;;) {
+      uint8_t byte = read_byte();
+      out |= static_cast<uint64_t>(byte & 0x7F) << shift;
+      if (!(byte & 0x80)) return out;
+      shift += 7;
+      if (shift > 63) throw ParseError("varint too long");
+    }
+  }
+
+  int64_t read_zigzag64() {
+    uint64_t u = read_varint();
+    return static_cast<int64_t>(u >> 1) ^ -static_cast<int64_t>(u & 1);
+  }
+
+  int16_t read_zigzag16() { return static_cast<int16_t>(read_zigzag64()); }
+
+  uint8_t const* buf_;
+  uint64_t len_;
+  uint64_t pos_ = 0;
+  int depth_ = 0;
+  Limits limits_;
+};
+
+class Writer {
+ public:
+  void write_struct(Value const& v) {
+    int16_t last_id = 0;
+    for (auto const& f : v.fields) {
+      write_field(f.id, last_id, *f.value);
+      last_id = f.id;
+    }
+    out_.push_back('\0');  // STOP
+  }
+
+  std::string take() { return std::move(out_); }
+
+ private:
+  void write_field(int16_t id, int16_t last_id, Value const& v) {
+    int32_t delta = id - last_id;
+    uint8_t wire = static_cast<uint8_t>(v.type);
+    if (delta > 0 && delta <= 15) {
+      out_.push_back(static_cast<char>((delta << 4) | wire));
+    } else {
+      out_.push_back(static_cast<char>(wire));
+      write_zigzag(id);
+    }
+    write_value(v);
+  }
+
+  void write_value(Value const& v) {
+    switch (v.type) {
+      case WireType::BOOL_TRUE:
+      case WireType::BOOL_FALSE:
+        break;  // encoded in the type nibble at field level
+      case WireType::I8:
+        out_.push_back(static_cast<char>(static_cast<int8_t>(v.i)));
+        break;
+      case WireType::I16:
+      case WireType::I32:
+      case WireType::I64:
+        write_zigzag(v.i);
+        break;
+      case WireType::DOUBLE: {
+        uint64_t raw;
+        std::memcpy(&raw, &v.d, 8);
+        for (int k = 0; k < 8; ++k) {
+          out_.push_back(static_cast<char>((raw >> (8 * k)) & 0xFF));
+        }
+        break;
+      }
+      case WireType::BINARY:
+        write_varint(v.bin.size());
+        out_.append(v.bin);
+        break;
+      case WireType::LIST:
+      case WireType::SET: {
+        uint64_t n = v.elems.size();
+        uint8_t et = static_cast<uint8_t>(v.elem_type);
+        if (n < 15) {
+          out_.push_back(static_cast<char>((n << 4) | et));
+        } else {
+          out_.push_back(static_cast<char>(0xF0 | et));
+          write_varint(n);
+        }
+        for (auto const& e : v.elems) write_collection_elem(v.elem_type, e);
+        break;
+      }
+      case WireType::MAP: {
+        uint64_t n = v.keys.size();
+        write_varint(n);
+        if (n > 0) {
+          out_.push_back(static_cast<char>(
+              (static_cast<uint8_t>(v.key_type) << 4) |
+              static_cast<uint8_t>(v.val_type)));
+          for (uint64_t k = 0; k < n; ++k) {
+            write_collection_elem(v.key_type, v.keys[k]);
+            write_collection_elem(v.val_type, v.vals[k]);
+          }
+        }
+        break;
+      }
+      case WireType::STRUCT:
+        write_struct(v);
+        break;
+      default:
+        throw ParseError("cannot serialize unknown wire type");
+    }
+  }
+
+  void write_collection_elem(WireType t, Value const& v) {
+    if (t == WireType::BOOL_TRUE || t == WireType::BOOL_FALSE) {
+      out_.push_back(v.b ? 1 : 2);
+      return;
+    }
+    write_value(v);
+  }
+
+  void write_varint(uint64_t u) {
+    while (u >= 0x80) {
+      out_.push_back(static_cast<char>((u & 0x7F) | 0x80));
+      u >>= 7;
+    }
+    out_.push_back(static_cast<char>(u));
+  }
+
+  void write_zigzag(int64_t s) {
+    write_varint((static_cast<uint64_t>(s) << 1) ^
+                 static_cast<uint64_t>(s >> 63));
+  }
+
+  std::string out_;
+};
+
+}  // namespace
+
+Value parse_struct(uint8_t const* buf, uint64_t len, Limits const& limits) {
+  Reader r(buf, len, limits);
+  return r.read_struct();
+}
+
+std::string serialize_struct(Value const& v) {
+  Writer w;
+  w.write_struct(v);
+  return w.take();
+}
+
+}  // namespace thrift
+}  // namespace tpudf

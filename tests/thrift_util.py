@@ -1,0 +1,254 @@
+"""Independent pure-Python thrift compact-protocol codec, used as the test
+oracle for the native engine: tests synthesize Parquet footers with this
+writer and re-parse the engine's output with this reader. Deliberately a
+separate implementation from src/native/src/thrift_compact.cpp so a shared
+misreading of the wire spec cannot self-validate.
+
+Values are modeled as plain python:
+  struct -> dict {field_id: (wire_type, value)}
+  list   -> (elem_wire_type, [values])
+  i8/i16/i32/i64 -> int, double -> float, binary -> bytes, bool -> bool
+"""
+
+from __future__ import annotations
+
+import struct as _s
+
+STOP, BOOL_T, BOOL_F, I8, I16, I32, I64, DOUBLE, BINARY, LIST, SET, MAP, STRUCT = range(13)
+
+
+# ---- writer ----------------------------------------------------------------
+
+
+def _varint(u: int) -> bytes:
+    out = bytearray()
+    while u >= 0x80:
+        out.append((u & 0x7F) | 0x80)
+        u >>= 7
+    out.append(u)
+    return bytes(out)
+
+
+def _zigzag(s: int) -> bytes:
+    return _varint((s << 1) ^ (s >> 63) if s < 0 else s << 1)
+
+
+def write_struct(fields: dict) -> bytes:
+    out = bytearray()
+    last_id = 0
+    for fid in sorted(fields):
+        wire, value = fields[fid]
+        if wire in (BOOL_T, BOOL_F):
+            wire = BOOL_T if value else BOOL_F
+        delta = fid - last_id
+        if 0 < delta <= 15:
+            out.append((delta << 4) | wire)
+        else:
+            out.append(wire)
+            out += _zigzag(fid)
+        out += _value_bytes(wire, value)
+        last_id = fid
+    out.append(0)
+    return bytes(out)
+
+
+def _value_bytes(wire: int, value) -> bytes:
+    if wire in (BOOL_T, BOOL_F):
+        return b""
+    if wire == I8:
+        return _s.pack("b", value)
+    if wire in (I16, I32, I64):
+        return _zigzag(value)
+    if wire == DOUBLE:
+        return _s.pack("<d", value)
+    if wire == BINARY:
+        raw = value.encode() if isinstance(value, str) else bytes(value)
+        return _varint(len(raw)) + raw
+    if wire in (LIST, SET):
+        elem_wire, elems = value
+        out = bytearray()
+        if len(elems) < 15:
+            out.append((len(elems) << 4) | elem_wire)
+        else:
+            out.append(0xF0 | elem_wire)
+            out += _varint(len(elems))
+        for e in elems:
+            if elem_wire in (BOOL_T, BOOL_F):
+                out.append(1 if e else 2)
+            else:
+                out += _value_bytes(elem_wire, e)
+        return bytes(out)
+    if wire == STRUCT:
+        return write_struct(value)
+    raise ValueError(f"unsupported wire type {wire}")
+
+
+# ---- reader ----------------------------------------------------------------
+
+
+class _Cursor:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def byte(self) -> int:
+        b = self.data[self.pos]
+        self.pos += 1
+        return b
+
+    def varint(self) -> int:
+        out = shift = 0
+        while True:
+            b = self.byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def zigzag(self) -> int:
+        u = self.varint()
+        return (u >> 1) ^ -(u & 1)
+
+
+def read_struct(data: bytes):
+    cur = _Cursor(data)
+    out = _read_struct(cur)
+    return out, cur.pos
+
+
+def _read_struct(cur: _Cursor) -> dict:
+    fields = {}
+    last_id = 0
+    while True:
+        header = cur.byte()
+        if header == 0:
+            return fields
+        wire = header & 0x0F
+        delta = header >> 4
+        fid = last_id + delta if delta else cur.zigzag()
+        last_id = fid
+        fields[fid] = (wire, _read_value(cur, wire))
+
+
+def _read_value(cur: _Cursor, wire: int):
+    if wire == BOOL_T:
+        return True
+    if wire == BOOL_F:
+        return False
+    if wire == I8:
+        return _s.unpack("b", bytes([cur.byte()]))[0]
+    if wire in (I16, I32, I64):
+        return cur.zigzag()
+    if wire == DOUBLE:
+        raw = cur.data[cur.pos : cur.pos + 8]
+        cur.pos += 8
+        return _s.unpack("<d", raw)[0]
+    if wire == BINARY:
+        n = cur.varint()
+        raw = cur.data[cur.pos : cur.pos + n]
+        cur.pos += n
+        return raw
+    if wire in (LIST, SET):
+        header = cur.byte()
+        n = header >> 4
+        elem_wire = header & 0x0F
+        if n == 0x0F:
+            n = cur.varint()
+        elems = []
+        for _ in range(n):
+            if elem_wire in (BOOL_T, BOOL_F):
+                elems.append(cur.byte() == 1)
+            else:
+                elems.append(_read_value(cur, elem_wire))
+        return (elem_wire, elems)
+    if wire == MAP:
+        n = cur.varint()
+        if n == 0:
+            return (STOP, STOP, [])
+        kv = cur.byte()
+        kw, vw = kv >> 4, kv & 0x0F
+        entries = []
+        for _ in range(n):
+            k = _read_value(cur, kw)
+            v = _read_value(cur, vw)
+            entries.append((k, v))
+        return (kw, vw, entries)
+    if wire == STRUCT:
+        return _read_struct(cur)
+    raise ValueError(f"unsupported wire type {wire}")
+
+
+# ---- parquet footer synthesis ----------------------------------------------
+
+# parquet.thrift field ids (public spec)
+FMD_VERSION, FMD_SCHEMA, FMD_NUM_ROWS, FMD_ROW_GROUPS = 1, 2, 3, 4
+FMD_KV, FMD_CREATED_BY, FMD_COLUMN_ORDERS = 5, 6, 7
+SE_TYPE, SE_TYPE_LEN, SE_REP, SE_NAME, SE_NUM_CHILDREN = 1, 2, 3, 4, 5
+SE_CONVERTED, SE_SCALE, SE_PRECISION = 6, 7, 8
+RG_COLUMNS, RG_TOTAL_BYTE_SIZE, RG_NUM_ROWS = 1, 2, 3
+RG_FILE_OFFSET, RG_TOTAL_COMPRESSED = 5, 6
+CC_FILE_OFFSET, CC_META = 2, 3
+CM_TYPE, CM_ENCODINGS, CM_PATH, CM_CODEC, CM_NUM_VALUES = 1, 2, 3, 4, 5
+CM_TOTAL_UNCOMP, CM_TOTAL_COMP, CM_DATA_PAGE_OFF, CM_DICT_PAGE_OFF = 6, 7, 9, 11
+
+
+def schema_element(name, num_children=None, type_=None, extra=None):
+    se = {SE_NAME: (BINARY, name)}
+    if num_children is not None:
+        se[SE_NUM_CHILDREN] = (I32, num_children)
+    if type_ is not None:
+        se[SE_TYPE] = (I32, type_)
+        se[SE_REP] = (I32, 1)  # OPTIONAL
+    if extra:
+        se.update(extra)
+    return se
+
+
+def column_chunk(data_page_offset, total_compressed, path=("c",), dict_page_offset=None):
+    md = {
+        CM_TYPE: (I32, 1),
+        CM_ENCODINGS: (LIST, (I32, [0])),
+        CM_PATH: (LIST, (BINARY, list(path))),
+        CM_CODEC: (I32, 0),
+        CM_NUM_VALUES: (I64, 10),
+        CM_TOTAL_UNCOMP: (I64, total_compressed),
+        CM_TOTAL_COMP: (I64, total_compressed),
+        CM_DATA_PAGE_OFF: (I64, data_page_offset),
+    }
+    if dict_page_offset is not None:
+        md[CM_DICT_PAGE_OFF] = (I64, dict_page_offset)
+    return {CC_FILE_OFFSET: (I64, data_page_offset), CC_META: (STRUCT, md)}
+
+
+def row_group(chunks, num_rows, file_offset=None, total_compressed=None, with_meta=True):
+    rg = {
+        RG_COLUMNS: (LIST, (STRUCT, chunks)),
+        RG_TOTAL_BYTE_SIZE: (I64, sum(1 for _ in chunks) * 1000),
+        RG_NUM_ROWS: (I64, num_rows),
+    }
+    if file_offset is not None:
+        rg[RG_FILE_OFFSET] = (I64, file_offset)
+    if total_compressed is not None:
+        rg[RG_TOTAL_COMPRESSED] = (I64, total_compressed)
+    if not with_meta:
+        rg[RG_COLUMNS] = (
+            LIST,
+            (STRUCT, [{CC_FILE_OFFSET: c[CC_FILE_OFFSET]} for c in chunks]),
+        )
+    return rg
+
+
+def file_metadata(schema_elems, row_groups, num_rows=None, column_orders=None, extra=None):
+    total = sum(rg[RG_NUM_ROWS][1] for rg in row_groups)
+    fmd = {
+        FMD_VERSION: (I32, 1),
+        FMD_SCHEMA: (LIST, (STRUCT, schema_elems)),
+        FMD_NUM_ROWS: (I64, num_rows if num_rows is not None else total),
+        FMD_ROW_GROUPS: (LIST, (STRUCT, row_groups)),
+        FMD_CREATED_BY: (BINARY, "spark_rapids_jni_tpu tests"),
+    }
+    if column_orders is not None:
+        fmd[FMD_COLUMN_ORDERS] = (LIST, (STRUCT, column_orders))
+    if extra:
+        fmd.update(extra)
+    return write_struct(fmd)
