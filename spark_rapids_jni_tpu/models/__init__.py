@@ -1,0 +1,3 @@
+from spark_rapids_jni_tpu.models.tpch import lineitem_table, tpch_q1
+
+__all__ = ["lineitem_table", "tpch_q1"]

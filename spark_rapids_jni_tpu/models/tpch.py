@@ -1,0 +1,181 @@
+"""TPC-H workload pipelines — the "model family" layer of this framework.
+
+The reference's flagship workloads are Spark SQL queries running through the
+RAPIDS accelerator (BASELINE.json configs: RowConversion on the lineitem
+schema; TPC-H q1 groupby-aggregate + sort). Here the same queries are
+expressed directly against the operator substrate, serving three roles:
+benchmark pipelines (bench.py), the driver's compile-check entry
+(__graft_entry__.py), and integration tests of the operator stack.
+
+TPC-H q1 (pricing summary report):
+
+    SELECT l_returnflag, l_linestatus,
+           sum(l_quantity), sum(l_extendedprice),
+           sum(l_extendedprice*(1-l_discount)),
+           sum(l_extendedprice*(1-l_discount)*(1+l_tax)),
+           avg(l_quantity), avg(l_extendedprice), avg(l_discount), count(*)
+    FROM lineitem WHERE l_shipdate <= date '1998-12-01' - 90 days
+    GROUP BY l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus
+
+Money columns use decimal64(-2) (the TPC-H spec's DECIMAL(12,2)) — integer
+backing, which is exactly what the TPU wants (the MXU/VPU have no fast f64;
+int64 arithmetic is emulated but exact).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import GroupByResult, groupby_aggregate
+from spark_rapids_jni_tpu.ops.sort import sort_table
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+# lineitem columns used by q1 (positions in the table below)
+L_QUANTITY = 0
+L_EXTENDEDPRICE = 1
+L_DISCOUNT = 2
+L_TAX = 3
+L_RETURNFLAG = 4
+L_LINESTATUS = 5
+L_SHIPDATE = 6
+
+# 1998-12-01 minus 90 days, in days since epoch (Spark DateType encoding)
+_Q1_CUTOFF_DAYS = 10560
+
+LINEITEM_SCHEMA = [
+    t.decimal64(-2),      # l_quantity  DECIMAL(12,2)
+    t.decimal64(-2),      # l_extendedprice
+    t.decimal64(-2),      # l_discount
+    t.decimal64(-2),      # l_tax
+    t.INT8,               # l_returnflag  ('A','N','R' as bytes)
+    t.INT8,               # l_linestatus  ('F','O')
+    t.TIMESTAMP_DAYS,     # l_shipdate
+]
+
+
+def lineitem_table(num_rows: int, seed: int = 0) -> Table:
+    """Synthetic lineitem batch with TPC-H-like value distributions."""
+    rng = np.random.default_rng(seed)
+    qty = rng.integers(100, 51_00, num_rows).astype(np.int64)       # 1..50 qty
+    price = rng.integers(90_000, 10_500_000, num_rows).astype(np.int64)
+    disc = rng.integers(0, 11, num_rows).astype(np.int64)           # 0.00-0.10
+    tax = rng.integers(0, 9, num_rows).astype(np.int64)             # 0.00-0.08
+    rflag = rng.choice(np.frombuffer(b"ANR", dtype=np.int8), num_rows)
+    lstatus = rng.choice(np.frombuffer(b"FO", dtype=np.int8), num_rows)
+    shipdate = rng.integers(8400, 10957, num_rows).astype(np.int32)
+    return Table(
+        [
+            Column.from_numpy(qty, t.decimal64(-2)),
+            Column.from_numpy(price, t.decimal64(-2)),
+            Column.from_numpy(disc, t.decimal64(-2)),
+            Column.from_numpy(tax, t.decimal64(-2)),
+            Column.from_numpy(rflag, t.INT8),
+            Column.from_numpy(lstatus, t.INT8),
+            Column.from_numpy(shipdate, t.TIMESTAMP_DAYS),
+        ]
+    )
+
+
+class Q1Result(NamedTuple):
+    result: GroupByResult  # grouped aggregates, padded; sorted by flag/status
+
+
+@func_range("tpch_q1")
+def tpch_q1(lineitem: Table) -> Table:
+    """Single-executor q1: filter -> derived columns -> groupby -> sort.
+
+    The WHERE filter keeps static shapes by masking validity instead of
+    compacting rows (masked rows fall out of every null-skipping aggregate),
+    the standard XLA trick for data-dependent filtering.
+    """
+    ship = lineitem.column(L_SHIPDATE)
+    keep = (ship.data <= _Q1_CUTOFF_DAYS) & ship.valid_mask()
+
+    def masked(col: Column) -> Column:
+        return Column(col.dtype, col.data, col.valid_mask() & keep)
+
+    qty = masked(lineitem.column(L_QUANTITY))
+    price = masked(lineitem.column(L_EXTENDEDPRICE))
+    disc = masked(lineitem.column(L_DISCOUNT))
+    tax = masked(lineitem.column(L_TAX))
+
+    # disc_price = price * (1 - disc): decimal multiply at scale -4.
+    # Null in any operand nulls the product (SQL three-valued arithmetic).
+    dp_valid = price.valid_mask() & disc.valid_mask()
+    disc_price = Column(
+        t.decimal64(-4), price.data * (100 - disc.data), dp_valid
+    )
+    # charge = disc_price * (1 + tax): scale -6
+    charge = Column(
+        t.decimal64(-6), disc_price.data * (100 + tax.data),
+        dp_valid & tax.valid_mask(),
+    )
+
+    work = Table(
+        [
+            masked(lineitem.column(L_RETURNFLAG)),
+            masked(lineitem.column(L_LINESTATUS)),
+            qty,
+            price,
+            disc,
+            disc_price,
+            charge,
+        ]
+    )
+    # Masked rows must not create key groups: zero out key bytes for them.
+    rf, ls = work.columns[0], work.columns[1]
+    work.columns[0] = Column(rf.dtype, jnp.where(keep, rf.data, 0), keep)
+    work.columns[1] = Column(ls.dtype, jnp.where(keep, ls.data, 0), keep)
+
+    grouped = groupby_aggregate(
+        work,
+        keys=[0, 1],
+        aggs=[
+            (2, "sum"),   # sum_qty
+            (3, "sum"),   # sum_base_price
+            (5, "sum"),   # sum_disc_price
+            (6, "sum"),   # sum_charge
+            (2, "mean"),  # avg_qty
+            (3, "mean"),  # avg_price
+            (4, "mean"),  # avg_disc
+            (2, "count"),  # count_order
+        ],
+    )
+    # The filtered-out pseudo-group has null keys; q1's ORDER BY puts real
+    # groups first (nulls last) so the compacted head is the answer.
+    return sort_table(grouped.table, [0, 1], nulls_first=[False, False])
+
+
+def tpch_q1_numpy(lineitem: Table) -> dict:
+    """Host oracle: same query in numpy, keyed by (returnflag, linestatus)."""
+    qty = np.asarray(lineitem.column(L_QUANTITY).data)
+    price = np.asarray(lineitem.column(L_EXTENDEDPRICE).data)
+    disc = np.asarray(lineitem.column(L_DISCOUNT).data)
+    tax = np.asarray(lineitem.column(L_TAX).data)
+    rf = np.asarray(lineitem.column(L_RETURNFLAG).data)
+    ls = np.asarray(lineitem.column(L_LINESTATUS).data)
+    ship = np.asarray(lineitem.column(L_SHIPDATE).data)
+    keep = ship <= _Q1_CUTOFF_DAYS
+    out = {}
+    for f in np.unique(rf[keep]):
+        for s in np.unique(ls[keep]):
+            m = keep & (rf == f) & (ls == s)
+            if not m.any():
+                continue
+            dp = price[m] * (100 - disc[m])
+            out[(int(f), int(s))] = {
+                "sum_qty": int(qty[m].sum()),
+                "sum_base_price": int(price[m].sum()),
+                "sum_disc_price": int(dp.sum()),
+                "sum_charge": int((dp * (100 + tax[m])).sum()),
+                "avg_qty": qty[m].mean(),
+                "avg_price": price[m].mean(),
+                "avg_disc": disc[m].mean(),
+                "count": int(m.sum()),
+            }
+    return out

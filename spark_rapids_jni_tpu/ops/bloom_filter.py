@@ -1,0 +1,105 @@
+"""Bloom filter build/probe (north-star component: the reference family
+ships xxhash64-based bloom filters for Spark runtime join pruning;
+BASELINE.json north_star lists "xxhash64/bloom-filter").
+
+TPU-first design: the filter lives on device as ONE BYTE PER BIT (uint8[m])
+rather than a packed bitset. Packed words would force read-modify-write
+bit twiddling through scatters; byte-per-bit makes build a single
+``scatter-max`` (associative, deterministic, duplicate-safe — the role
+CUDA's atomicOr plays in the reference family's kernels) and probe a pure
+gather + AND-reduce. At Spark's default FPP the memory cost (8x) is a few
+MB per filter — noise next to HBM capacity, and worth it for a one-scatter
+build. ``to_packed``/``from_packed`` convert to the little-endian packed
+form for interchange (e.g. with Spark's serialized BloomFilterImpl).
+
+Bit placement is the classic double-hashing scheme Spark's BloomFilterImpl
+uses: bit_i = (h1 + i * h2) mod m off a single xxhash64 evaluation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar.bitmask import pack_validity, unpack_validity
+from spark_rapids_jni_tpu.ops.hash import xxhash64_long
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+@dataclass
+class BloomFilter:
+    bits: jnp.ndarray  # uint8[num_bits], one byte per bit (0/1)
+    num_hashes: int
+
+    @property
+    def num_bits(self) -> int:
+        return int(self.bits.shape[0])
+
+    @classmethod
+    def empty(cls, num_bits: int, num_hashes: int = 3) -> "BloomFilter":
+        if num_bits <= 0:
+            raise ValueError("num_bits must be positive")
+        return cls(jnp.zeros((num_bits,), dtype=jnp.uint8), num_hashes)
+
+    @classmethod
+    def optimal(cls, expected_items: int, fpp: float = 0.03) -> "BloomFilter":
+        """Size like Spark's BloomFilter.create: m = -n ln p / (ln 2)^2,
+        k = max(1, round(m/n * ln 2))."""
+        n = max(expected_items, 1)
+        m = max(int(-n * np.log(fpp) / (np.log(2) ** 2)), 64)
+        k = max(1, int(round(m / n * np.log(2))))
+        return cls.empty(m, k)
+
+    def to_packed(self) -> jnp.ndarray:
+        """Little-endian packed uint8[ceil(m/8)] for interchange."""
+        return pack_validity(self.bits.astype(jnp.bool_))
+
+    @classmethod
+    def from_packed(cls, packed: jnp.ndarray, num_bits: int,
+                    num_hashes: int) -> "BloomFilter":
+        return cls(
+            unpack_validity(packed, num_bits).astype(jnp.uint8), num_hashes
+        )
+
+
+def _bit_positions(values: jnp.ndarray, num_bits: int, num_hashes: int):
+    """(n, k) bit indexes via double hashing off one xxhash64 pass."""
+    seeds = jnp.zeros(values.shape, dtype=jnp.uint64)
+    h = xxhash64_long(values, seeds)
+    h1 = h & jnp.uint64(0xFFFFFFFF)
+    h2 = (h >> jnp.uint64(32)) | jnp.uint64(1)  # odd stride covers the bitset
+    i = jnp.arange(num_hashes, dtype=jnp.uint64)
+    combined = h1[:, None] + i[None, :] * h2[:, None]
+    return (combined % jnp.uint64(num_bits)).astype(jnp.int32)
+
+
+@func_range("bloom_filter_put")
+def bloom_put(
+    bf: BloomFilter,
+    values: jnp.ndarray,
+    valid: jnp.ndarray | None = None,
+) -> BloomFilter:
+    """Insert int64 values (null rows skipped). Functional update — under
+    jit XLA donates/aliases the bitset buffer."""
+    pos = _bit_positions(values.astype(jnp.int64), bf.num_bits, bf.num_hashes)
+    if valid is not None:
+        # route invalid rows' updates out of range; scatter mode="drop"
+        pos = jnp.where(valid[:, None], pos, bf.num_bits)
+    bits = bf.bits.at[pos.reshape(-1)].max(jnp.uint8(1), mode="drop")
+    return BloomFilter(bits, bf.num_hashes)
+
+
+@func_range("bloom_filter_might_contain")
+def bloom_might_contain(bf: BloomFilter, values: jnp.ndarray) -> jnp.ndarray:
+    """bool[n]: definitely-absent rows are False."""
+    pos = _bit_positions(values.astype(jnp.int64), bf.num_bits, bf.num_hashes)
+    return jnp.all(bf.bits[pos] == 1, axis=1)
+
+
+def bloom_merge(a: BloomFilter, b: BloomFilter) -> BloomFilter:
+    """Union — how Spark combines per-task filters."""
+    if a.num_bits != b.num_bits or a.num_hashes != b.num_hashes:
+        raise ValueError("bloom filters must have identical shape to merge")
+    return BloomFilter(jnp.maximum(a.bits, b.bits), a.num_hashes)

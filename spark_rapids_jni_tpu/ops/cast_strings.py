@@ -1,0 +1,266 @@
+"""String -> numeric casts (CastStrings component — BASELINE.json config #1
+"CastStrings float/decimal parse microbench"; part of the reference
+family's Spark-specific kernel set, north_star).
+
+TPU-first design: no per-row character loops. The string column's ragged
+(offsets, chars) buffers are gathered into a dense (n, max_len) character
+matrix once, then every row parses in lockstep with vectorized digit
+arithmetic — a fixed number of elementwise passes over the matrix
+regardless of row count, which is exactly the shape the VPU wants. max_len
+is a static bound (default 32: covers int64/decimal/float literals; longer
+rows are invalid anyway for numeric casts except exotic floats, which
+overflow to inf like Spark's Double.parseDouble on huge exponents).
+
+Spark CAST semantics (non-ANSI): leading/trailing whitespace trimmed,
+optional +/-, invalid input -> null, integer overflow -> null, decimal
+rounds HALF_UP to the target scale and nulls on precision overflow.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+DEFAULT_MAX_LEN = 32
+
+
+def _char_matrix(col: Column, max_len: int):
+    """Gather the ragged chars into (n, max_len) + per-cell presence mask.
+    Cells beyond a row's length are 0x20 (space) so trim logic is uniform."""
+    offsets = col.data
+    chars = col.chars
+    n = col.size
+    starts = offsets[:-1]
+    lengths = offsets[1:] - starts
+    idx = starts[:, None] + jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    present = jnp.arange(max_len, dtype=jnp.int32)[None, :] < lengths[:, None]
+    safe = jnp.clip(idx, 0, max(int(chars.shape[0]) - 1, 0))
+    mat = jnp.where(present, chars[safe], jnp.uint8(0x20))
+    too_long = lengths > max_len
+    del n
+    return mat, present, lengths, too_long
+
+
+def _strip_and_sign(mat, present):
+    """Identify the numeric payload: [start, end) after whitespace trim and
+    optional sign. Returns (is_neg, payload_start, payload_end, had_sign)."""
+    max_len = mat.shape[1]
+    pos = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    is_space = (mat == 0x20) | (mat == 0x09) | (mat == 0x0A) | (mat == 0x0D)
+    nonspace = ~is_space & present
+    big = jnp.int32(max_len)
+    first = jnp.min(jnp.where(nonspace, pos, big), axis=1)
+    last = jnp.max(jnp.where(nonspace, pos, -1), axis=1)
+    end = last + 1
+    first_c = jnp.take_along_axis(
+        mat, jnp.clip(first, 0, max_len - 1)[:, None], axis=1
+    )[:, 0]
+    has_sign = (first_c == ord("-")) | (first_c == ord("+"))
+    is_neg = first_c == ord("-")
+    start = jnp.where(has_sign, first + 1, first)
+    return is_neg, start, end, first
+
+
+@func_range("cast_string_to_integer")
+def string_to_integer(
+    col: Column, dtype: DType, max_len: int = DEFAULT_MAX_LEN
+) -> Column:
+    """Parse to an integral column; invalid/overflow -> null."""
+    if not col.dtype.is_string:
+        raise TypeError("input must be a string column")
+    mat, present, lengths, too_long = _char_matrix(col, max_len)
+    is_neg, start, end, _ = _strip_and_sign(mat, present)
+    pos = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    in_payload = (pos >= start[:, None]) & (pos < end[:, None])
+    digit = mat - jnp.uint8(ord("0"))
+    is_digit = digit <= 9
+    ok = jnp.all(is_digit | ~in_payload, axis=1)
+    ok &= end > start  # at least one digit
+    ok &= ~too_long
+
+    # value = sum digit * 10^(distance from payload end), accumulated in
+    # uint64 — exact for <= 19 digits (10^19 < 2^64), so overflow checks
+    # are precise where float approximations are not (2^63 vs 2^63-1).
+    weight_pos = end[:, None] - 1 - pos  # 0 for last digit
+    d = jnp.where(in_payload, digit.astype(jnp.uint64), jnp.uint64(0))
+    pow10 = jnp.where(
+        (weight_pos >= 0) & (weight_pos < 19),
+        jnp.power(
+            jnp.uint64(10), jnp.clip(weight_pos, 0, 18).astype(jnp.uint64)
+        ),
+        jnp.uint64(0),
+    )
+    value_u = jnp.sum(d * pow10, axis=1)
+    # Count significant digits (leading zeros don't count — "0...001" is a
+    # perfectly good 1). 19 significant digits stay below 10^19 < 2^64:
+    # exact; more would fall outside the pow10 window and silently wrap,
+    # so reject.
+    sig_start = jnp.min(
+        jnp.where(in_payload & (digit != 0) & is_digit, pos, jnp.int32(max_len)),
+        axis=1,
+    )
+    n_sig = jnp.maximum(end - sig_start, 0)
+    ok &= n_sig <= 19
+    np_dt = dtype.storage_dtype
+    info = np.iinfo(np_dt if np_dt.kind in "iu" else np.int64)
+    ok &= jnp.where(
+        is_neg,
+        value_u <= jnp.uint64(-int(info.min)),
+        value_u <= jnp.uint64(info.max),
+    )
+    signed = jnp.where(is_neg, jnp.uint64(0) - value_u, value_u).astype(
+        jnp.int64
+    )
+    return Column(dtype, signed.astype(dtype.jnp_dtype), ok)
+
+
+@func_range("cast_string_to_decimal")
+def string_to_decimal(
+    col: Column, dtype: DType, max_len: int = DEFAULT_MAX_LEN
+) -> Column:
+    """Parse to decimal32/64 at the target scale, HALF_UP rounding;
+    invalid/overflow -> null."""
+    if not dtype.is_decimal:
+        raise TypeError("target must be a decimal type")
+    mat, present, lengths, too_long = _char_matrix(col, max_len)
+    is_neg, start, end, _ = _strip_and_sign(mat, present)
+    pos = jnp.arange(mat.shape[1], dtype=jnp.int32)[None, :]
+    in_payload = (pos >= start[:, None]) & (pos < end[:, None])
+    is_dot = mat == ord(".")
+    digit = mat - jnp.uint8(ord("0"))
+    is_digit = digit <= 9
+    dot_count = jnp.sum(is_dot & in_payload, axis=1)
+    ok = jnp.all(is_digit | is_dot | ~in_payload, axis=1)
+    ok &= dot_count <= 1
+    ok &= (end - start) > dot_count  # at least one digit
+    ok &= ~too_long
+
+    big = jnp.int32(mat.shape[1])
+    dot_pos = jnp.min(jnp.where(is_dot & in_payload, pos, big), axis=1)
+    dot_pos = jnp.where(dot_count == 0, end, dot_pos)
+    # digit weight relative to the decimal point: 10^(int part distance)
+    int_weight = dot_pos[:, None] - 1 - pos           # >=0 left of the dot
+    frac_weight = pos - dot_pos[:, None]              # >=1 right of the dot
+    # target scale: value_unscaled = round(value * 10^-scale), scale <= 0
+    shift = -dtype.scale  # digits of fraction kept
+    # unscaled integer = sum(int digits * 10^(int_weight + shift))
+    #                  + sum(frac digits * 10^(shift - frac_weight)) [+ round]
+    d64 = jnp.where(in_payload & is_digit, digit.astype(jnp.int64), 0)
+    int_exp = int_weight + shift
+    frac_exp = shift - frac_weight
+    exp = jnp.where(pos < dot_pos[:, None], int_exp, frac_exp)
+    contrib = jnp.where(
+        (exp >= 0) & (exp < 19),
+        d64 * jnp.power(jnp.int64(10), jnp.clip(exp, 0, 18).astype(jnp.int64)),
+        0,
+    )
+    value = jnp.sum(contrib, axis=1)
+    # HALF_UP: look at the first dropped fractional digit (exp == -1)
+    round_digit = jnp.sum(jnp.where(exp == -1, d64, 0), axis=1)
+    value = value + (round_digit >= 5).astype(jnp.int64)
+    # Precision overflow, checked on the POST-rounding unscaled magnitude
+    # (9999999.995 rounds up into a 10th digit). Leading zeros don't count:
+    # guard the accumulator window with significant integer digits only.
+    sig_start = jnp.min(
+        jnp.where(in_payload & is_digit & (digit != 0), pos, big), axis=1
+    )
+    sig_int_digits = jnp.maximum(dot_pos - jnp.minimum(sig_start, dot_pos), 0)
+    ok &= (sig_int_digits + shift) <= 18  # accumulator exactness bound
+    max_digits = 18 if dtype.type_id == TypeId.DECIMAL64 else 9
+    max_unscaled = jnp.int64(10 ** max_digits - 1)
+    ok &= value <= max_unscaled
+    signed = jnp.where(is_neg, -value, value)
+    return Column(dtype, signed.astype(dtype.jnp_dtype), ok)
+
+
+@func_range("cast_string_to_float")
+def string_to_float(
+    col: Column, dtype: DType, max_len: int = DEFAULT_MAX_LEN
+) -> Column:
+    """Parse to float32/64; accepts [+-]digits[.digits][eE[+-]digits],
+    plus Infinity/NaN spellings (Spark-compatible); invalid -> null."""
+    mat, present, lengths, too_long = _char_matrix(col, max_len)
+    is_neg, start, end, _ = _strip_and_sign(mat, present)
+    max_len_s = mat.shape[1]
+    pos = jnp.arange(max_len_s, dtype=jnp.int32)[None, :]
+    in_payload = (pos >= start[:, None]) & (pos < end[:, None])
+
+    lower = jnp.where((mat >= ord("A")) & (mat <= ord("Z")), mat + 32, mat)
+
+    def _matches(word: bytes):
+        m = jnp.ones((mat.shape[0],), dtype=jnp.bool_)
+        for i, ch in enumerate(word):
+            at = jnp.clip(start + i, 0, max_len_s - 1)
+            m &= jnp.take_along_axis(lower, at[:, None], axis=1)[:, 0] == ch
+        m &= (end - start) == len(word)
+        return m
+
+    is_inf = _matches(b"infinity") | _matches(b"inf")
+    is_nan = _matches(b"nan")
+
+    is_e = (lower == ord("e")) & in_payload
+    e_count = jnp.sum(is_e, axis=1)
+    big = jnp.int32(max_len_s)
+    e_pos = jnp.min(jnp.where(is_e, pos, big), axis=1)
+    mant_end = jnp.minimum(e_pos, end)
+
+    digit = mat - jnp.uint8(ord("0"))
+    is_digit = digit <= 9
+    is_dot = mat == ord(".")
+    in_mant = (pos >= start[:, None]) & (pos < mant_end[:, None])
+    dot_count = jnp.sum(is_dot & in_mant, axis=1)
+    dot_pos = jnp.min(jnp.where(is_dot & in_mant, pos, big), axis=1)
+    dot_pos = jnp.where(dot_count == 0, mant_end, dot_pos)
+
+    ok = jnp.all(is_digit | is_dot | ~in_mant, axis=1)
+    ok &= dot_count <= 1
+    ok &= (mant_end - start) > dot_count
+
+    # mantissa in f64 + decimal exponent of the last digit
+    d = jnp.where(in_mant & is_digit, digit.astype(jnp.float64), 0.0)
+    int_w = dot_pos[:, None] - 1 - pos
+    frac_w = pos - dot_pos[:, None]
+    expw = jnp.where(pos < dot_pos[:, None], int_w, -frac_w).astype(jnp.float64)
+    mant = jnp.sum(
+        d * jnp.power(10.0, jnp.where(in_mant & is_digit, expw, 0.0))
+        * jnp.where(in_mant & is_digit, 1.0, 0.0),
+        axis=1,
+    )
+
+    # exponent part
+    exp_start = jnp.minimum(e_pos + 1, end)
+    ec = jnp.take_along_axis(
+        mat, jnp.clip(exp_start, 0, max_len_s - 1)[:, None], axis=1
+    )[:, 0]
+    e_sign = jnp.where(ec == ord("-"), -1, 1)
+    e_digits_start = jnp.where(
+        (ec == ord("-")) | (ec == ord("+")), exp_start + 1, exp_start
+    )
+    in_exp = (pos >= e_digits_start[:, None]) & (pos < end[:, None])
+    has_e = e_count == 1
+    ok &= jnp.where(
+        has_e,
+        jnp.all(is_digit | ~in_exp, axis=1) & (end > e_digits_start),
+        e_count == 0,
+    )
+    e_weight = end[:, None] - 1 - pos
+    e_val = jnp.sum(
+        jnp.where(in_exp & is_digit, digit.astype(jnp.int32), 0)
+        * jnp.power(10, jnp.clip(e_weight, 0, 9)).astype(jnp.int32)
+        * (e_weight >= 0),
+        axis=1,
+    )
+    e_val = jnp.clip(e_val * e_sign, -400, 400)
+    scale10 = jnp.power(10.0, e_val.astype(jnp.float64))
+    # 0e400: 0 * inf would be NaN; zero mantissa is zero at any exponent
+    value = jnp.where(mant == 0.0, 0.0, mant * scale10)
+
+    value = jnp.where(is_inf, jnp.inf, value)
+    value = jnp.where(is_nan, jnp.nan, value)
+    ok = (ok & ~too_long) | is_inf | is_nan
+    signed = jnp.where(is_neg, -value, value)
+    return Column(dtype, signed.astype(dtype.jnp_dtype), ok)

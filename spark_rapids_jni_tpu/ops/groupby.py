@@ -1,0 +1,151 @@
+"""Hash-groupby-aggregate equivalent (cuDF groupby is part of the vendored
+capability surface, SURVEY.md section 2.2; TPC-H q1 is the canonical
+workload, BASELINE.json config #3).
+
+TPU-first design: no device hash table (no CUDA-style concurrent hash map
+idiom on the VPU — SURVEY.md section 7 "hard parts" calls this out). Instead
+sort-based grouping: stable-sort rows by the encoded keys, mark segment
+boundaries, turn them into dense group ids with a cumulative sum, and run
+null-aware ``jax.ops.segment_*`` reductions — all static-shape, all fused by
+XLA. Output is padded to the input row count with ``num_groups`` reported
+alongside (static shapes are the price of jit; callers slice on host).
+
+Null semantics are Spark's: null keys form their own group; aggregates skip
+null values; COUNT counts non-null; an all-null group's SUM/MIN/MAX/MEAN is
+null.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.sort import gather, sort_order
+from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+SUPPORTED_AGGS = ("sum", "count", "min", "max", "mean")
+
+
+class GroupByResult(NamedTuple):
+    table: Table          # keys then aggregates, padded to n rows
+    num_groups: jnp.ndarray  # scalar int32
+
+    def compact(self) -> Table:
+        """Host-side trim to the real group count."""
+        k = int(self.num_groups)
+        cols = []
+        for c in self.table.columns:
+            validity = None if c.validity is None else c.validity[:k]
+            cols.append(Column(c.dtype, c.data[:k], validity))
+        return Table(cols)
+
+
+def _rows_equal_prev(table: Table, keys: Sequence[int]) -> jnp.ndarray:
+    """bool[n]: row i has the same key tuple (incl. null-ness) as row i-1."""
+    n = table.num_rows
+    same = jnp.ones((n,), dtype=jnp.bool_)
+    for k in keys:
+        c = table.column(k)
+        v = c.data
+        valid = c.valid_mask()
+        eq_val = v[1:] == v[:-1]
+        if c.dtype.storage_dtype.kind == "f":
+            eq_val = eq_val | (jnp.isnan(v[1:]) & jnp.isnan(v[:-1]))
+        eq_valid = valid[1:] == valid[:-1]
+        both_null = ~valid[1:] & ~valid[:-1]
+        eq = (eq_val & valid[1:] & eq_valid) | both_null
+        same = same.at[1:].set(same[1:] & eq)
+    return same.at[0].set(n == 0)
+
+
+def _sum_dtype(dt: DType) -> DType:
+    """Spark widens SUM: integral -> INT64, decimal keeps scale (wider
+    precision), floats stay floating."""
+    kind = dt.storage_dtype.kind
+    if dt.is_decimal:
+        return DType(TypeId.DECIMAL64, dt.scale)
+    if kind in ("i", "u", "b"):
+        return DType(TypeId.INT64)
+    return dt
+
+
+@func_range("groupby_aggregate")
+def groupby_aggregate(
+    table: Table,
+    keys: Sequence[int],
+    aggs: Sequence[tuple[int, str]],
+) -> GroupByResult:
+    """Group by `keys`; compute [(value_col, op)] aggregates.
+
+    Returns keys + one column per agg, in order, padded to n rows.
+    """
+    for _, op in aggs:
+        if op not in SUPPORTED_AGGS:
+            raise ValueError(f"unsupported aggregation {op!r}")
+    n = table.num_rows
+    order = sort_order(table, keys)
+    sorted_tbl = gather(table, order)
+
+    same = _rows_equal_prev(sorted_tbl, keys)
+    group_id = jnp.cumsum(~same) - 1  # dense ids, 0-based, sorted order
+    num_groups = (group_id[-1] + 1).astype(jnp.int32) if n else jnp.int32(0)
+
+    # Key output columns: first row of each group (scatter-min of row index;
+    # rows are sorted so the first is the group representative).
+    first_idx = jnp.full((n,), n, dtype=jnp.int32).at[group_id].min(
+        jnp.arange(n, dtype=jnp.int32)
+    )
+    out_cols: list[Column] = []
+    for k in keys:
+        c = sorted_tbl.column(k)
+        safe_first = jnp.clip(first_idx, 0, max(n - 1, 0))
+        data = c.data[safe_first]
+        valid = c.valid_mask()[safe_first] & (first_idx < n)
+        out_cols.append(Column(c.dtype, data, valid))
+
+    for col_idx, op in aggs:
+        c = sorted_tbl.column(col_idx)
+        v = c.data
+        valid = c.valid_mask()
+        vcount = jax.ops.segment_sum(
+            valid.astype(jnp.int64), group_id, num_segments=n
+        )
+        if op == "count":
+            out_cols.append(
+                Column(DType(TypeId.INT64), vcount,
+                       jnp.arange(n) < num_groups)
+            )
+            continue
+        if op in ("sum", "mean"):
+            acc_dt = _sum_dtype(c.dtype)
+            vv = jnp.where(valid, v, jnp.zeros_like(v)).astype(acc_dt.jnp_dtype)
+            total = jax.ops.segment_sum(vv, group_id, num_segments=n)
+            has_any = vcount > 0
+            if op == "sum":
+                out_cols.append(Column(acc_dt, total, has_any))
+            else:
+                denom = jnp.maximum(vcount, 1).astype(jnp.float64)
+                mean = total.astype(jnp.float64) / denom
+                out_cols.append(Column(DType(TypeId.FLOAT64), mean, has_any))
+            continue
+        # min / max with null-neutral sentinels
+        np_dt = c.dtype.storage_dtype
+        if np_dt.kind == "f":
+            lo, hi = -jnp.inf, jnp.inf
+        else:
+            info = np.iinfo(np_dt)
+            lo, hi = info.min, info.max
+        if op == "min":
+            vv = jnp.where(valid, v, jnp.asarray(hi, dtype=v.dtype))
+            red = jax.ops.segment_min(vv, group_id, num_segments=n)
+        else:
+            vv = jnp.where(valid, v, jnp.asarray(lo, dtype=v.dtype))
+            red = jax.ops.segment_max(vv, group_id, num_segments=n)
+        out_cols.append(Column(c.dtype, red, vcount > 0))
+
+    return GroupByResult(Table(out_cols), num_groups)

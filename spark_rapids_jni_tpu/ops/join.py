@@ -1,0 +1,138 @@
+"""Equi-join — the cuDF hash-join equivalent (vendored capability surface,
+SURVEY.md section 2.2; exercised by TPC-DS q64/q72, BASELINE.json config #4).
+
+TPU-first design: no device hash table (SURVEY.md section 7: partitioned/
+sort designs instead of chaining hash maps). This is a sort + binary-search
+join: sort the build side once, then for every probe row locate its match
+run with vectorized ``searchsorted`` (lower/upper bound), lay output pairs
+out with a prefix sum, and resolve pair j -> (probe row, match ordinal) with
+one more searchsorted over the offsets. Everything is static-shape; the
+caller supplies ``out_size`` (capacity) and gets back gather maps plus the
+true match count — the bucketed-padding discipline XLA wants. SQL semantics:
+NULL keys never match; left join emits unmatched probe rows with an invalid
+right index.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.sort import gather
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+class JoinMaps(NamedTuple):
+    """Gather maps describing join output rows (padded to out_size)."""
+
+    left_index: jnp.ndarray   # int32[out_size] into the left table
+    right_index: jnp.ndarray  # int32[out_size] into the right table
+    right_valid: jnp.ndarray  # bool: False on left-join unmatched rows
+    row_valid: jnp.ndarray    # bool: False on padding rows
+    total: jnp.ndarray        # scalar int64: true number of output rows
+
+
+def _join_maps_impl(
+    left_key: jnp.ndarray,
+    left_valid: jnp.ndarray,
+    right_key: jnp.ndarray,
+    right_valid: jnp.ndarray,
+    out_size: int,
+    how: str,
+) -> JoinMaps:
+    n_right = right_key.shape[0]
+    # Sort the build side with nulls banished past the valid prefix
+    # (null_rank is the primary lexsort key), then overwrite the tail with
+    # the dtype's max so the array binary-search over it stays sound even
+    # though null rows carry arbitrary key bytes.
+    null_rank = (~right_valid).astype(jnp.uint8)
+    perm = jnp.lexsort((right_key, null_rank)).astype(jnp.int32)
+    n_valid_right = jnp.sum(right_valid.astype(jnp.int64))
+    info = np.iinfo(np.dtype(right_key.dtype.name))
+    sorted_key = jnp.where(
+        jnp.arange(n_right, dtype=jnp.int64) < n_valid_right,
+        right_key[perm],
+        jnp.asarray(info.max, dtype=right_key.dtype),
+    )
+
+    # Match runs per probe row (empty when the probe key is null).
+    lo = jnp.searchsorted(sorted_key, left_key, side="left")
+    hi = jnp.searchsorted(sorted_key, left_key, side="right")
+    hi = jnp.minimum(hi, n_valid_right)  # the sentinel tail never matches
+    lo = jnp.minimum(lo, hi)
+    counts = jnp.where(left_valid, hi - lo, 0)
+    if how == "left":
+        out_per_row = jnp.maximum(counts, 1)  # unmatched probe row emits one
+    else:
+        out_per_row = counts
+    offsets = jnp.cumsum(out_per_row)
+    total = offsets[-1] if left_key.shape[0] else jnp.int64(0)
+
+    j = jnp.arange(out_size, dtype=jnp.int64)
+    row_valid = j < total
+    left_row = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+    left_row = jnp.clip(left_row, 0, max(left_key.shape[0] - 1, 0))
+    base = jnp.where(left_row > 0, offsets[jnp.maximum(left_row - 1, 0)], 0)
+    ordinal = j - base
+    matched = counts[left_row] > 0
+    right_pos = jnp.clip(
+        lo[left_row] + ordinal, 0, max(n_right - 1, 0)
+    ).astype(jnp.int32)
+    right_row = perm[right_pos] if n_right else jnp.zeros_like(right_pos)
+    right_ok = matched & row_valid
+    return JoinMaps(
+        left_index=left_row,
+        right_index=right_row,
+        right_valid=right_ok,
+        row_valid=row_valid,
+        total=total,
+    )
+
+
+@func_range("join")
+def join(
+    left: Table,
+    right: Table,
+    left_on: int,
+    right_on: int,
+    out_size: int,
+    how: str = "inner",
+) -> JoinMaps:
+    """Single-key equi-join returning gather maps. ``out_size`` caps the
+    output (check ``total`` <= out_size on host if exactness matters);
+    multi-key joins compose by pre-hashing keys into one column."""
+    if how not in ("inner", "left"):
+        raise ValueError(f"unsupported join type {how!r}")
+    lc, rc = left.column(left_on), right.column(right_on)
+    if lc.dtype.storage_dtype != rc.dtype.storage_dtype:
+        raise TypeError("join key storage types must match")
+    if lc.dtype.storage_dtype.kind not in ("i", "u"):
+        raise TypeError(
+            "join keys must be integral this round (hash or encode other "
+            "types into an integer column first)"
+        )
+    return _join_maps_impl(
+        lc.data, lc.valid_mask(), rc.data, rc.valid_mask(), out_size, how
+    )
+
+
+def apply_join_maps(
+    left: Table, right: Table, maps: JoinMaps
+) -> Table:
+    """Materialize the joined table: left columns then right columns.
+    Padding rows carry validity False everywhere; unmatched right sides
+    (left join) are null."""
+    cols: list[Column] = []
+    for c in left.columns:
+        validity = c.valid_mask()[maps.left_index] & maps.row_valid
+        cols.append(Column(c.dtype, c.data[maps.left_index], validity))
+    for c in right.columns:
+        validity = (
+            c.valid_mask()[maps.right_index] & maps.right_valid & maps.row_valid
+        )
+        cols.append(Column(c.dtype, c.data[maps.right_index], validity))
+    return Table(cols)

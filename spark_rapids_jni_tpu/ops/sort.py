@@ -1,0 +1,116 @@
+"""Multi-key table sort — the cuDF ``sort``/``order_by`` equivalent of the
+vendored operator substrate (SURVEY.md section 2.2: libcudf sort is part of
+the capability surface; exercised by TPC-H q1's final ORDER BY).
+
+TPU-first design: no comparator kernels. Each key column is *encoded* into
+an order-preserving unsigned integer word (floats via sign-magnitude flip,
+signed ints via sign-bit flip, with a null indicator folded in), and the
+whole thing is one ``jnp.lexsort`` — XLA's native multi-pass radix-friendly
+sort — followed by a gather. Encoded keys also give Spark-compatible total
+float order (NaN sorts greatest, -0.0 == 0.0 is NOT collapsed: -0.0 < 0.0
+bitwise — documented deviation from Java's Double.compare only for -0.0).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.types import DType
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+def _as_unsigned_key(col_data: jnp.ndarray, dtype: DType) -> jnp.ndarray:
+    """Encode one column as an order-preserving uint key (uint32 or uint64)."""
+    np_dt = dtype.storage_dtype
+    if np_dt.kind == "u":
+        return col_data
+    if np_dt.kind == "i":
+        bits = np_dt.itemsize * 8
+        u = col_data.astype(jnp.dtype(f"uint{bits}"))
+        return u ^ jnp.asarray(1 << (bits - 1), dtype=u.dtype)
+    if np_dt == np.float32:
+        u = jax.lax.bitcast_convert_type(col_data, jnp.uint32)
+        sign = (u >> 31).astype(jnp.uint32)
+        # negative: flip all bits; positive: flip sign bit
+        return u ^ jnp.where(sign == 1, jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000))
+    # float64 never reaches here: _key_arrays routes it to the value-level
+    # two-key encoding (no 64-bit bitcast on TPU).
+    raise TypeError(f"unsupported sort key type {dtype}")
+
+
+def _key_arrays(col: Column, ascending: bool, nulls_first: bool):
+    """Return the lexsort key(s) for one column, minor-to-major order."""
+    dtype = col.dtype
+    np_dt = dtype.storage_dtype
+    n = col.size
+    valid = col.valid_mask()
+
+    if np_dt == np.float64:
+        # value-level key: works on all backends, Spark order for NaN
+        v = col.data
+        neg = jnp.where(jnp.isnan(v), jnp.inf, v)
+        key = -neg if not ascending else neg
+        # NaN: +inf surrogate already sorts greatest ascending; descending
+        # -(+inf) = -inf sorts first, matching Spark's NaN-greatest order.
+        nan_rank = jnp.isnan(v)
+        value_keys = [key, (~nan_rank if not ascending else nan_rank)]
+    else:
+        u = _as_unsigned_key(col.data, dtype)
+        if not ascending:
+            u = ~u
+        value_keys = [u]
+
+    null_key = jnp.where(valid, jnp.uint8(1), jnp.uint8(0))
+    if nulls_first:
+        null_rank = null_key  # nulls (0) first
+    else:
+        null_rank = jnp.uint8(1) - null_key  # valids (0) first
+    del n
+    return value_keys + [null_rank]  # null rank is most significant
+
+
+@func_range("sort_order")
+def sort_order(
+    table: Table,
+    keys: Sequence[int],
+    ascending: Sequence[bool] | None = None,
+    nulls_first: Sequence[bool] | None = None,
+) -> jnp.ndarray:
+    """Stable sort permutation (int32) ordering rows by the key columns."""
+    if ascending is None:
+        ascending = [True] * len(keys)
+    if nulls_first is None:
+        nulls_first = [True] * len(keys)
+    lex_keys: list[jnp.ndarray] = []
+    # jnp.lexsort treats the LAST key as primary; build minor -> major.
+    for k, asc, nf in zip(reversed(list(keys)), reversed(list(ascending)),
+                          reversed(list(nulls_first))):
+        lex_keys.extend(_key_arrays(table.column(k), asc, nf))
+    return jnp.lexsort(tuple(lex_keys)).astype(jnp.int32)
+
+
+def gather(table: Table, indices: jnp.ndarray) -> Table:
+    """Row gather — the cuDF gather primitive. Out-of-range indices are
+    clamped by XLA (callers pass valid permutations)."""
+    cols = []
+    for c in table.columns:
+        if c.dtype.is_string:
+            raise NotImplementedError("string gather lands with cast_strings")
+        validity = None if c.validity is None else c.validity[indices]
+        cols.append(Column(c.dtype, c.data[indices], validity))
+    return Table(cols)
+
+
+@func_range("sort_table")
+def sort_table(
+    table: Table,
+    keys: Sequence[int],
+    ascending: Sequence[bool] | None = None,
+    nulls_first: Sequence[bool] | None = None,
+) -> Table:
+    return gather(table, sort_order(table, keys, ascending, nulls_first))

@@ -1,0 +1,151 @@
+"""CastStrings tests — parse semantics vs python int()/float()/Decimal
+oracles, Spark null-on-invalid behavior."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar.column import string_column
+from spark_rapids_jni_tpu.ops.cast_strings import (
+    string_to_decimal,
+    string_to_float,
+    string_to_integer,
+)
+
+
+def test_int_parse_basic():
+    col = string_column(["123", "-45", "+7", "  42  ", "0"])
+    out = string_to_integer(col, t.INT64)
+    assert out.to_pylist() == [123, -45, 7, 42, 0]
+
+
+def test_int_parse_invalid_to_null():
+    col = string_column(["", "abc", "12x", "--4", "4-", "1.5", None, "+"])
+    out = string_to_integer(col, t.INT64)
+    assert out.to_pylist() == [None] * 8
+
+
+def test_int_parse_null_row_passthrough():
+    col = string_column(["5", None])
+    out = string_to_integer(col, t.INT64)
+    assert out.to_pylist() == [5, None]
+
+
+def test_int_overflow_to_null():
+    col = string_column([
+        "9223372036854775807",      # int64 max
+        "9223372036854775808",      # overflow
+        "-9223372036854775808",     # int64 min
+        "99999999999999999999",     # way over
+    ])
+    out = string_to_integer(col, t.INT64)
+    assert out.to_pylist() == [9223372036854775807, None,
+                               -9223372036854775808, None]
+
+
+def test_int32_range_checked():
+    col = string_column(["2147483647", "2147483648", "-2147483648"])
+    out = string_to_integer(col, t.INT32)
+    assert out.to_pylist() == [2147483647, None, -2147483648]
+
+
+def test_int_parse_random_vs_python(rng):
+    vals = [str(int(v)) for v in rng.integers(-(2**62), 2**62, 500)]
+    out = string_to_integer(string_column(vals), t.INT64)
+    assert out.to_pylist() == [int(v) for v in vals]
+
+
+def test_decimal_parse_scale():
+    col = string_column(["1.23", "4.5", "-0.07", "100", "2.999"])
+    out = string_to_decimal(col, t.decimal64(-2))
+    # unscaled at scale -2; 2.999 rounds HALF_UP to 3.00
+    assert out.to_pylist() == [123, 450, -7, 10000, 300]
+
+
+def test_decimal_parse_invalid():
+    col = string_column(["1.2.3", "abc", "", ".", "1..2"])
+    out = string_to_decimal(col, t.decimal64(-2))
+    assert out.to_pylist() == [None] * 5
+
+
+def test_decimal_half_up_rounding():
+    col = string_column(["0.125", "0.124", "-0.125", "0.115"])
+    out = string_to_decimal(col, t.decimal64(-2))
+    # HALF_UP on the magnitude: 0.125 -> 0.13, -0.125 -> -0.13
+    assert out.to_pylist() == [13, 12, -13, 12]
+
+
+def test_decimal32_overflow():
+    col = string_column(["9999999.99", "99999999999.0"])
+    out = string_to_decimal(col, t.decimal32(-2))
+    assert out.to_pylist() == [999999999, None]
+
+
+def test_float_parse_basic():
+    col = string_column(["1.5", "-2.25", "3", "1e3", "2.5e-2", "  7.0  "])
+    out = string_to_float(col, t.FLOAT64)
+    got = out.to_pylist()
+    want = [1.5, -2.25, 3.0, 1000.0, 0.025, 7.0]
+    assert all(
+        g is not None and abs(g - w) < 1e-12 * max(1, abs(w))
+        for g, w in zip(got, want)
+    )
+
+
+def test_float_parse_specials():
+    col = string_column(["Infinity", "-Infinity", "inf", "NaN", "nan"])
+    out = string_to_float(col, t.FLOAT64)
+    got = out.to_pylist()
+    assert got[0] == np.inf
+    assert got[1] == -np.inf
+    assert got[2] == np.inf
+    assert np.isnan(got[3]) and np.isnan(got[4])
+
+
+def test_float_parse_invalid():
+    col = string_column(["1e", "e5", "1.2e3.4", "abc", "", "1 2"])
+    out = string_to_float(col, t.FLOAT64)
+    assert out.to_pylist() == [None] * 6
+
+
+def test_float_parse_random_vs_python(rng):
+    vals = []
+    for _ in range(300):
+        m = rng.uniform(-1e6, 1e6)
+        e = rng.integers(-20, 20)
+        vals.append(f"{m:.6f}e{e}")
+    out = string_to_float(string_column(vals), t.FLOAT64)
+    got = out.to_pylist()
+    for g, v in zip(got, vals):
+        w = float(v)
+        assert g is not None
+        if w == 0:
+            assert abs(g) < 1e-300
+        else:
+            assert abs(g - w) / abs(w) < 1e-9
+
+
+def test_float32_target():
+    out = string_to_float(string_column(["1.5", "bad"]), t.FLOAT32)
+    assert out.data.dtype == np.float32
+    assert out.to_pylist()[0] == 1.5
+    assert out.to_pylist()[1] is None
+
+
+def test_leading_zeros_dont_count_toward_digit_caps():
+    out = string_to_integer(string_column(["00000000000000000000001"]), t.INT64)
+    assert out.to_pylist() == [1]
+    out = string_to_decimal(string_column(["0000000001.0"]), t.decimal32(-2))
+    assert out.to_pylist() == [100]
+
+
+def test_decimal_rounding_into_precision_overflow():
+    out = string_to_decimal(string_column(["9999999.995", "9999999.99"]),
+                            t.decimal32(-2))
+    assert out.to_pylist() == [None, 999999999]
+
+
+def test_float_zero_mantissa_huge_exponent():
+    out = string_to_float(string_column(["0e400", "0.0e999", "-0e999"]),
+                          t.FLOAT64)
+    assert out.to_pylist() == [0.0, 0.0, -0.0]

@@ -1,0 +1,311 @@
+"""Operator substrate tests: sort, groupby-aggregate, join, xxhash64, bloom
+filter — each against an independent host oracle (numpy / pure-python),
+the reference's round-trip/golden-equality test shape (SURVEY.md section 4).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.sort import sort_table, sort_order, gather
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.join import join, apply_join_maps
+from spark_rapids_jni_tpu.ops.hash import (
+    table_xxhash64,
+    partition_hash,
+    xxhash64_int,
+    xxhash64_long,
+)
+from spark_rapids_jni_tpu.ops.bloom_filter import (
+    BloomFilter,
+    bloom_put,
+    bloom_might_contain,
+    bloom_merge,
+)
+from tests.xxh64_ref import xxh64
+
+
+# ---- sort ------------------------------------------------------------------
+
+
+def test_sort_single_int_key(rng):
+    vals = rng.integers(-1000, 1000, 500).astype(np.int64)
+    tbl = Table([Column.from_numpy(vals)])
+    out = sort_table(tbl, [0])
+    assert np.array_equal(np.asarray(out.column(0).data), np.sort(vals))
+
+
+def test_sort_descending(rng):
+    vals = rng.integers(0, 100, 200).astype(np.int32)
+    tbl = Table([Column.from_numpy(vals)])
+    out = sort_table(tbl, [0], ascending=[False])
+    assert np.array_equal(np.asarray(out.column(0).data), np.sort(vals)[::-1])
+
+
+def test_sort_multi_key_stable(rng):
+    a = rng.integers(0, 5, 300).astype(np.int32)
+    b = rng.integers(0, 5, 300).astype(np.int32)
+    payload = np.arange(300, dtype=np.int64)
+    tbl = Table([Column.from_numpy(a), Column.from_numpy(b),
+                 Column.from_numpy(payload)])
+    out = sort_table(tbl, [0, 1])
+    oa = np.asarray(out.column(0).data)
+    ob = np.asarray(out.column(1).data)
+    order = np.lexsort((b, a))  # numpy: last key primary
+    assert np.array_equal(oa, a[order])
+    assert np.array_equal(ob, b[order])
+    assert np.array_equal(np.asarray(out.column(2).data), payload[order])
+
+
+def test_sort_nulls_first_and_last(rng):
+    vals = np.array([5, 1, 3, 2, 4], dtype=np.int32)
+    valid = np.array([True, False, True, False, True])
+    tbl = Table([Column.from_numpy(vals, validity=valid)])
+    first = sort_table(tbl, [0], nulls_first=[True])
+    fv = np.asarray(first.column(0).valid_mask())
+    assert list(fv) == [False, False, True, True, True]
+    assert list(np.asarray(first.column(0).data)[2:]) == [3, 4, 5]
+    last = sort_table(tbl, [0], nulls_first=[False])
+    lv = np.asarray(last.column(0).valid_mask())
+    assert list(lv) == [True, True, True, False, False]
+    assert list(np.asarray(last.column(0).data)[:3]) == [3, 4, 5]
+
+
+def test_sort_float_nan_greatest():
+    vals = np.array([1.5, np.nan, -2.0, np.inf, -np.inf], dtype=np.float32)
+    tbl = Table([Column.from_numpy(vals)])
+    out = np.asarray(sort_table(tbl, [0]).column(0).data)
+    assert np.isnan(out[-1])
+    assert np.array_equal(out[:4], np.array([-np.inf, -2.0, 1.5, np.inf],
+                                            dtype=np.float32))
+    # descending: NaN first
+    out_d = np.asarray(sort_table(tbl, [0], ascending=[False]).column(0).data)
+    assert np.isnan(out_d[0])
+
+
+def test_sort_f64_key():
+    vals = np.array([3.5, -1.25, np.nan, 0.5], dtype=np.float64)
+    tbl = Table([Column.from_numpy(vals)])
+    out = np.asarray(sort_table(tbl, [0]).column(0).data)
+    assert np.array_equal(out[:3], np.array([-1.25, 0.5, 3.5]))
+    assert np.isnan(out[-1])
+
+
+# ---- groupby ---------------------------------------------------------------
+
+
+def test_groupby_sum_count_vs_numpy(rng):
+    keys = rng.integers(0, 37, 2000).astype(np.int32)
+    vals = rng.integers(-100, 100, 2000).astype(np.int64)
+    tbl = Table([Column.from_numpy(keys), Column.from_numpy(vals)])
+    res = groupby_aggregate(tbl, [0], [(1, "sum"), (1, "count"), (1, "min"),
+                                       (1, "max"), (1, "mean")])
+    out = res.compact()
+    assert int(res.num_groups) == len(np.unique(keys))
+    got_keys = np.asarray(out.column(0).data)
+    assert np.array_equal(got_keys, np.unique(keys))
+    for i, k in enumerate(got_keys):
+        sel = vals[keys == k]
+        assert np.asarray(out.column(1).data)[i] == sel.sum()
+        assert np.asarray(out.column(2).data)[i] == len(sel)
+        assert np.asarray(out.column(3).data)[i] == sel.min()
+        assert np.asarray(out.column(4).data)[i] == sel.max()
+        assert np.isclose(np.asarray(out.column(5).data)[i], sel.mean())
+
+
+def test_groupby_null_values_skipped():
+    keys = np.array([1, 1, 2, 2, 2], dtype=np.int32)
+    vals = np.array([10, 20, 30, 40, 50], dtype=np.int64)
+    vvalid = np.array([True, False, False, False, False])
+    tbl = Table([Column.from_numpy(keys),
+                 Column.from_numpy(vals, validity=vvalid)])
+    out = groupby_aggregate(tbl, [0], [(1, "sum"), (1, "count")]).compact()
+    sums = out.column(1)
+    counts = out.column(2)
+    assert np.asarray(sums.data)[0] == 10
+    assert np.asarray(sums.valid_mask())[0]
+    # group 2 all-null: sum is null, count is 0
+    assert not np.asarray(sums.valid_mask())[1]
+    assert list(np.asarray(counts.data)) == [1, 0]
+
+
+def test_groupby_null_keys_form_group():
+    keys = np.array([1, 1, 7], dtype=np.int32)
+    kvalid = np.array([True, False, False])
+    vals = np.array([5, 6, 7], dtype=np.int64)
+    tbl = Table([Column.from_numpy(keys, validity=kvalid),
+                 Column.from_numpy(vals)])
+    res = groupby_aggregate(tbl, [0], [(1, "sum")])
+    assert int(res.num_groups) == 2  # {1} and {null}
+    out = res.compact()
+    kv = np.asarray(out.column(0).valid_mask())
+    sums = np.asarray(out.column(1).data)
+    by_null = {bool(v): s for v, s in zip(kv, sums)}
+    assert by_null[True] == 5
+    assert by_null[False] == 13  # both null-key rows grouped together
+
+
+def test_groupby_decimal_sum_keeps_scale():
+    keys = np.array([1, 1], dtype=np.int32)
+    vals = np.array([150, 250], dtype=np.int64)  # decimal64 scale -2
+    tbl = Table([Column.from_numpy(keys),
+                 Column.from_numpy(vals, t.decimal64(-2))])
+    out = groupby_aggregate(tbl, [0], [(1, "sum")]).compact()
+    assert out.column(1).dtype.scale == -2
+    assert np.asarray(out.column(1).data)[0] == 400
+
+
+# ---- join ------------------------------------------------------------------
+
+
+def test_inner_join_vs_numpy(rng):
+    lk = rng.integers(0, 50, 300).astype(np.int64)
+    rk = rng.integers(0, 50, 200).astype(np.int64)
+    lt = Table([Column.from_numpy(lk),
+                Column.from_numpy(np.arange(300, dtype=np.int64))])
+    rt = Table([Column.from_numpy(rk),
+                Column.from_numpy(np.arange(200, dtype=np.int64) * 10)])
+    expected = sorted(
+        (i, j) for i in range(300) for j in range(200) if lk[i] == rk[j]
+    )
+    maps = join(lt, rt, 0, 0, out_size=len(expected) + 8)
+    assert int(maps.total) == len(expected)
+    got = sorted(
+        (int(li), int(ri))
+        for li, ri, ok in zip(
+            np.asarray(maps.left_index), np.asarray(maps.right_index),
+            np.asarray(maps.row_valid))
+        if ok
+    )
+    assert got == expected
+
+
+def test_left_join_unmatched_rows():
+    lt = Table([Column.from_numpy(np.array([1, 2, 3], dtype=np.int64))])
+    rt = Table([Column.from_numpy(np.array([2, 2], dtype=np.int64)),
+                Column.from_numpy(np.array([20, 21], dtype=np.int64))])
+    maps = join(lt, rt, 0, 0, out_size=8, how="left")
+    assert int(maps.total) == 4  # 1->null, 2->two matches, 3->null
+    out = apply_join_maps(lt, rt, maps)
+    lvals = np.asarray(out.column(0).data)[np.asarray(maps.row_valid)]
+    rvalid = np.asarray(out.column(2).valid_mask())[np.asarray(maps.row_valid)]
+    assert sorted(lvals.tolist()) == [1, 2, 2, 3]
+    assert sorted(rvalid.tolist()) == [False, False, True, True]
+
+
+def test_join_null_keys_never_match():
+    lk = Column.from_numpy(np.array([1, 2], dtype=np.int64),
+                           validity=np.array([True, False]))
+    rk = Column.from_numpy(np.array([1, 2], dtype=np.int64),
+                           validity=np.array([True, False]))
+    maps = join(Table([lk]), Table([rk]), 0, 0, out_size=8)
+    assert int(maps.total) == 1  # only 1==1
+
+
+def test_join_overflow_reports_total():
+    lt = Table([Column.from_numpy(np.zeros(4, dtype=np.int64))])
+    rt = Table([Column.from_numpy(np.zeros(4, dtype=np.int64))])
+    maps = join(lt, rt, 0, 0, out_size=5)
+    assert int(maps.total) == 16  # caller can detect truncation
+    assert int(np.asarray(maps.row_valid).sum()) == 5
+
+
+# ---- xxhash64 --------------------------------------------------------------
+
+
+def test_xxhash64_long_matches_reference(rng):
+    vals = rng.integers(-(2**62), 2**62, 64).astype(np.int64)
+    seeds = rng.integers(0, 2**63, 64).astype(np.uint64)
+    got = np.asarray(xxhash64_long(jnp.asarray(vals), jnp.asarray(seeds)))
+    for v, s, g in zip(vals, seeds, got):
+        want = xxh64(int(np.uint64(v)).to_bytes(8, "little"), int(s))
+        assert int(np.uint64(g)) == want
+
+
+def test_xxhash64_int_matches_reference(rng):
+    vals = rng.integers(-(2**31), 2**31, 64).astype(np.int32)
+    seeds = rng.integers(0, 2**63, 64).astype(np.uint64)
+    got = np.asarray(xxhash64_int(jnp.asarray(vals), jnp.asarray(seeds)))
+    for v, s, g in zip(vals, seeds, got):
+        want = xxh64(int(np.uint32(v)).to_bytes(4, "little"), int(s))
+        assert int(np.uint64(g)) == want
+
+
+def test_table_hash_null_passthrough():
+    c1 = Column.from_numpy(np.array([7, 7], dtype=np.int64),
+                           validity=np.array([True, False]))
+    tbl = Table([c1])
+    h = np.asarray(table_xxhash64(tbl))
+    want0 = xxh64((7).to_bytes(8, "little"), 42)
+    assert int(np.uint64(h[0])) == want0
+    assert int(np.uint64(h[1])) == 42  # null: seed passes through
+
+
+def test_table_hash_chains_columns():
+    tbl = Table([
+        Column.from_numpy(np.array([3], dtype=np.int64)),
+        Column.from_numpy(np.array([9], dtype=np.int32)),
+    ])
+    h = np.asarray(table_xxhash64(tbl))
+    step1 = xxh64((3).to_bytes(8, "little"), 42)
+    step2 = xxh64((9).to_bytes(4, "little"), step1)
+    assert int(np.uint64(h[0])) == step2
+
+
+def test_partition_hash_range(rng):
+    tbl = Table([Column.from_numpy(rng.integers(0, 10**9, 1000))])
+    parts = np.asarray(partition_hash(tbl, [0], 16))
+    assert parts.min() >= 0 and parts.max() < 16
+    # roughly uniform
+    counts = np.bincount(parts, minlength=16)
+    assert counts.min() > 20
+
+
+# ---- bloom filter ----------------------------------------------------------
+
+
+def test_bloom_no_false_negatives(rng):
+    items = rng.integers(0, 2**60, 5000).astype(np.int64)
+    bf = BloomFilter.optimal(len(items), fpp=0.03)
+    bf = bloom_put(bf, jnp.asarray(items))
+    hit = np.asarray(bloom_might_contain(bf, jnp.asarray(items)))
+    assert hit.all()
+
+
+def test_bloom_fpp_reasonable(rng):
+    items = rng.integers(0, 2**60, 5000).astype(np.int64)
+    others = rng.integers(2**61, 2**62, 5000).astype(np.int64)
+    bf = BloomFilter.optimal(len(items), fpp=0.03)
+    bf = bloom_put(bf, jnp.asarray(items))
+    fp = np.asarray(bloom_might_contain(bf, jnp.asarray(others))).mean()
+    assert fp < 0.08
+
+
+def test_bloom_null_values_skipped():
+    bf = BloomFilter.empty(1024, 3)
+    vals = jnp.asarray(np.array([5, 6], dtype=np.int64))
+    bf = bloom_put(bf, vals, valid=jnp.asarray([True, False]))
+    got = np.asarray(bloom_might_contain(bf, vals))
+    assert got[0]
+    assert not got[1]
+
+
+def test_bloom_merge_union(rng):
+    a_items = rng.integers(0, 2**40, 100).astype(np.int64)
+    b_items = rng.integers(2**41, 2**42, 100).astype(np.int64)
+    a = bloom_put(BloomFilter.empty(8192, 3), jnp.asarray(a_items))
+    b = bloom_put(BloomFilter.empty(8192, 3), jnp.asarray(b_items))
+    m = bloom_merge(a, b)
+    assert np.asarray(bloom_might_contain(m, jnp.asarray(a_items))).all()
+    assert np.asarray(bloom_might_contain(m, jnp.asarray(b_items))).all()
+
+
+def test_bloom_packed_round_trip(rng):
+    items = rng.integers(0, 2**40, 50).astype(np.int64)
+    bf = bloom_put(BloomFilter.empty(512, 3), jnp.asarray(items))
+    packed = bf.to_packed()
+    assert packed.shape[0] == 64
+    back = BloomFilter.from_packed(packed, 512, 3)
+    assert np.array_equal(np.asarray(back.bits), np.asarray(bf.bits))

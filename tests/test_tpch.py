@@ -1,0 +1,74 @@
+"""TPC-H q1 integration test: the full pipeline vs the numpy oracle."""
+
+import numpy as np
+
+from spark_rapids_jni_tpu.models.tpch import (
+    lineitem_table,
+    tpch_q1,
+    tpch_q1_numpy,
+)
+
+
+def test_q1_matches_numpy_oracle():
+    li = lineitem_table(20_000, seed=7)
+    got_tbl = tpch_q1(li)
+    want = tpch_q1_numpy(li)
+
+    rf = np.asarray(got_tbl.column(0).data)
+    ls = np.asarray(got_tbl.column(1).data)
+    kvalid = np.asarray(got_tbl.column(0).valid_mask())
+    rows = {}
+    for i in range(len(rf)):
+        if not kvalid[i]:
+            continue
+        rows[(int(rf[i]), int(ls[i]))] = i
+
+    assert set(rows) == set(want)
+    for key, w in want.items():
+        i = rows[key]
+        assert int(np.asarray(got_tbl.column(2).data)[i]) == w["sum_qty"]
+        assert int(np.asarray(got_tbl.column(3).data)[i]) == w["sum_base_price"]
+        assert int(np.asarray(got_tbl.column(4).data)[i]) == w["sum_disc_price"]
+        assert int(np.asarray(got_tbl.column(5).data)[i]) == w["sum_charge"]
+        assert np.isclose(np.asarray(got_tbl.column(6).data)[i], w["avg_qty"])
+        assert np.isclose(np.asarray(got_tbl.column(7).data)[i], w["avg_price"])
+        assert np.isclose(np.asarray(got_tbl.column(8).data)[i], w["avg_disc"])
+        assert int(np.asarray(got_tbl.column(9).data)[i]) == w["count"]
+
+
+def test_q1_groups_sorted_first():
+    li = lineitem_table(5_000, seed=3)
+    out = tpch_q1(li)
+    kvalid = np.asarray(out.column(0).valid_mask())
+    # real groups lead, padding/null-key tail follows
+    n_real = int(kvalid.sum())
+    assert n_real <= 6  # 3 flags x 2 statuses
+    assert kvalid[:n_real].all()
+    rf = np.asarray(out.column(0).data)[:n_real]
+    ls = np.asarray(out.column(1).data)[:n_real]
+    order = np.lexsort((ls, rf))
+    assert np.array_equal(order, np.arange(n_real))
+
+
+def test_q1_null_discount_tax_propagate():
+    import numpy as np
+    from spark_rapids_jni_tpu import types as t
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.models.tpch import tpch_q1
+
+    n = 4
+    cols = [
+        Column.from_numpy(np.full(n, 100, dtype=np.int64), t.decimal64(-2)),
+        Column.from_numpy(np.full(n, 2000, dtype=np.int64), t.decimal64(-2)),
+        Column.from_numpy(np.array([5, 999999, 5, 5], dtype=np.int64),
+                          t.decimal64(-2),
+                          validity=np.array([True, False, True, True])),
+        Column.from_numpy(np.full(n, 3, dtype=np.int64), t.decimal64(-2)),
+        Column.from_numpy(np.full(n, 65, dtype=np.int8)),
+        Column.from_numpy(np.full(n, 70, dtype=np.int8)),
+        Column.from_numpy(np.full(n, 9000, dtype=np.int32), t.TIMESTAMP_DAYS),
+    ]
+    out = tpch_q1(Table(cols))
+    # sum_disc_price must skip the null-discount row: 3 * 2000*(100-5)
+    assert int(np.asarray(out.column(4).data)[0]) == 3 * 2000 * 95
+    assert int(np.asarray(out.column(5).data)[0]) == 3 * 2000 * 95 * 103

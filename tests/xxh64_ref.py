@@ -1,0 +1,65 @@
+"""Independent pure-python XXH64 reference (full algorithm, any length),
+used as the oracle for ops.hash. Implemented from the public xxHash spec;
+deliberately separate from the JAX implementation.
+"""
+
+M = (1 << 64) - 1
+P1 = 0x9E3779B185EBCA87
+P2 = 0xC2B2AE3D4F54DE4F
+P3 = 0x165667B19E3779F9
+P4 = 0x85EBCA77C2B2AE63
+P5 = 0x27D4EB2F165667C5
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (64 - r))) & M
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    n = len(data)
+    pos = 0
+    if n >= 32:
+        v1 = (seed + P1 + P2) & M
+        v2 = (seed + P2) & M
+        v3 = seed & M
+        v4 = (seed - P1) & M
+        while pos + 32 <= n:
+            for i, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[pos + 8 * i : pos + 8 * i + 8], "little")
+                v = _rotl((v + lane * P2) & M, 31) * P1 & M
+                if i == 0:
+                    v1 = v
+                elif i == 1:
+                    v2 = v
+                elif i == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            pos += 32
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12) + _rotl(v4, 18)) & M
+        for v in (v1, v2, v3, v4):
+            h ^= _rotl((v * P2) & M, 31) * P1 & M
+            h = ((h * P1) + P4) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while pos + 8 <= n:
+        lane = int.from_bytes(data[pos : pos + 8], "little")
+        h ^= _rotl((lane * P2) & M, 31) * P1 & M
+        h = (_rotl(h, 27) * P1 + P4) & M
+        pos += 8
+    if pos + 4 <= n:
+        lane = int.from_bytes(data[pos : pos + 4], "little")
+        h ^= (lane * P1) & M
+        h = (_rotl(h, 23) * P2 + P3) & M
+        pos += 4
+    while pos < n:
+        h ^= (data[pos] * P5) & M
+        h = (_rotl(h, 11) * P1) & M
+        pos += 1
+    h ^= h >> 33
+    h = (h * P2) & M
+    h ^= h >> 29
+    h = (h * P3) & M
+    h ^= h >> 32
+    return h
