@@ -1,0 +1,71 @@
+"""Benchmark harness — run the flagship pipeline on the real chip and print
+ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config #3 of BASELINE.json: hash groupby-aggregate + sort (TPC-H q1, single
+executor). The reference publishes no numbers (BASELINE.md), so
+``vs_baseline`` is measured against the earliest recorded bench of this repo
+(BENCH_r*.json) when present, else 1.0.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+
+
+def _prior_baseline(metric: str):
+    """Earliest recorded value of this metric from BENCH_r{N}.json files."""
+    best = None
+    for path in glob.glob(os.path.join(os.path.dirname(__file__) or ".", "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if rec.get("metric") != metric:
+            continue
+        rnd = int(m.group(1))
+        if best is None or rnd < best[0]:
+            best = (rnd, float(rec["value"]))
+    return None if best is None else best[1]
+
+
+def main() -> None:
+    import jax
+
+    from spark_rapids_jni_tpu.models.tpch import lineitem_table, tpch_q1
+
+    n = int(os.environ.get("BENCH_ROWS", 1 << 22))
+    iters = int(os.environ.get("BENCH_ITERS", 5))
+    lineitem = lineitem_table(n)
+    fn = jax.jit(tpch_q1)
+    jax.block_until_ready(fn(lineitem))  # compile + warm cache
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fn(lineitem))
+    per_iter = (time.perf_counter() - t0) / iters
+
+    metric = "tpch_q1_rows_per_s"
+    value = n / per_iter
+    base = _prior_baseline(metric)
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": value,
+                "unit": "rows/s",
+                "vs_baseline": value / base if base else 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,6 @@
 from spark_rapids_jni_tpu.columnar.column import Column
 from spark_rapids_jni_tpu.columnar.table import Table
 from spark_rapids_jni_tpu.columnar.bitmask import pack_validity, unpack_validity
+from spark_rapids_jni_tpu.columnar import pytree as _pytree  # noqa: F401 (registers)
 
 __all__ = ["Column", "Table", "pack_validity", "unpack_validity"]

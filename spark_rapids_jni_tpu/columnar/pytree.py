@@ -1,0 +1,48 @@
+"""Pytree registration for the columnar substrate.
+
+Column and Table become jax pytrees so whole tables flow through ``jit``,
+``shard_map`` and the ICI shuffle as first-class arguments — the TPU-native
+replacement for the reference's raw ``jlong`` native-view handles crossing
+JNI (reference RowConversionJni.cpp:31-36). DType is static aux data (it
+participates in the jit cache key exactly like the reference's
+``(typeId, scale)`` JNI marshaling, RowConversion.java:113-118).
+
+Unflattening bypasses ``__post_init__`` validation: jax substitutes
+non-array placeholders for leaves during tracing/transforms, and the
+equal-length / storage-dtype checks only make sense on real arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.columnar.table import Table
+
+
+def _column_flatten(col: Column):
+    return (col.data, col.validity, col.chars), col.dtype
+
+
+def _column_unflatten(dtype, children) -> Column:
+    data, validity, chars = children
+    col = object.__new__(Column)
+    col.dtype = dtype
+    col.data = data
+    col.validity = validity
+    col.chars = chars
+    return col
+
+
+def _table_flatten(tbl: Table):
+    return tuple(tbl.columns), None
+
+
+def _table_unflatten(_, children) -> Table:
+    tbl = object.__new__(Table)
+    tbl.columns = list(children)
+    return tbl
+
+
+jax.tree_util.register_pytree_node(Column, _column_flatten, _column_unflatten)
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

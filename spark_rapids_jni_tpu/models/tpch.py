@@ -85,13 +85,12 @@ class Q1Result(NamedTuple):
     result: GroupByResult  # grouped aggregates, padded; sorted by flag/status
 
 
-@func_range("tpch_q1")
-def tpch_q1(lineitem: Table) -> Table:
-    """Single-executor q1: filter -> derived columns -> groupby -> sort.
+def _q1_work_table(lineitem: Table) -> Table:
+    """Shared q1 front half: WHERE filter + derived decimal columns.
 
-    The WHERE filter keeps static shapes by masking validity instead of
-    compacting rows (masked rows fall out of every null-skipping aggregate),
-    the standard XLA trick for data-dependent filtering.
+    The filter keeps static shapes by masking validity instead of compacting
+    rows (masked rows fall out of every null-skipping aggregate), the
+    standard XLA trick for data-dependent filtering.
     """
     ship = lineitem.column(L_SHIPDATE)
     keep = (ship.data <= _Q1_CUTOFF_DAYS) & ship.valid_mask()
@@ -131,7 +130,13 @@ def tpch_q1(lineitem: Table) -> Table:
     rf, ls = work.columns[0], work.columns[1]
     work.columns[0] = Column(rf.dtype, jnp.where(keep, rf.data, 0), keep)
     work.columns[1] = Column(ls.dtype, jnp.where(keep, ls.data, 0), keep)
+    return work
 
+
+@func_range("tpch_q1")
+def tpch_q1(lineitem: Table) -> Table:
+    """Single-executor q1: filter -> derived columns -> groupby -> sort."""
+    work = _q1_work_table(lineitem)
     grouped = groupby_aggregate(
         work,
         keys=[0, 1],
@@ -173,9 +178,99 @@ def tpch_q1_numpy(lineitem: Table) -> dict:
                 "sum_base_price": int(price[m].sum()),
                 "sum_disc_price": int(dp.sum()),
                 "sum_charge": int((dp * (100 + tax[m])).sum()),
-                "avg_qty": qty[m].mean(),
-                "avg_price": price[m].mean(),
-                "avg_disc": disc[m].mean(),
+                # true values: unscaled decimal(scale -2) means x 10^-2
+                "avg_qty": qty[m].mean() * 1e-2,
+                "avg_price": price[m].mean() * 1e-2,
+                "avg_disc": disc[m].mean() * 1e-2,
                 "count": int(m.sum()),
             }
     return out
+
+
+# ---- distributed q1 over the executor mesh --------------------------------
+
+# Partial (per-executor) aggregates: SUMs and COUNTs only, because those
+# merge associatively across the shuffle; AVGs are finalized from the merged
+# sums/counts. Indices refer to the work-table layout in _q1_work_table.
+_Q1_PARTIAL_AGGS = [
+    (2, "sum"),    # sum_qty
+    (3, "sum"),    # sum_base_price
+    (5, "sum"),    # sum_disc_price
+    (6, "sum"),    # sum_charge
+    (2, "count"),  # count_qty (also count_order)
+    (3, "count"),  # count_price
+    (4, "sum"),    # sum_disc
+    (4, "count"),  # count_disc
+]
+
+# q1 groups by two one-byte flags: at most 3*2 real groups plus the null-key
+# pseudo-group, so a tiny static budget bounds the shuffle payload.
+_Q1_GROUP_BUDGET = 64
+
+
+def _q1_finalize(merged: Table) -> Table:
+    """Merged sums/counts -> the q1 output schema (avgs = sum/count)."""
+    rf, ls, sq, sp, sdp, sch, cq, cp, sd, cd = merged.columns
+
+    def avg(total: Column, count: Column) -> Column:
+        denom = jnp.maximum(count.data, 1).astype(jnp.float64)
+        # 10^scale rescale so the FLOAT64 avg carries the true value, same
+        # contract as groupby_aggregate's decimal mean.
+        return Column(
+            t.FLOAT64,
+            total.data.astype(jnp.float64) / denom * (10.0 ** total.dtype.scale),
+            count.valid_mask() & (count.data > 0),
+        )
+
+    return Table(
+        [rf, ls, sq, sp, sdp, sch, avg(sq, cq), avg(sp, cp), avg(sd, cd), cq]
+    )
+
+
+def q1_distributed_step(local: Table):
+    """Per-executor q1 step; must run inside shard_map over EXEC_AXIS.
+
+    local partial groupby -> head-truncate to the group budget -> ICI
+    all-to-all shuffle by (returnflag, linestatus) -> merge groupby.
+    Afterward each executor owns a disjoint slice of the key space.
+    """
+    from spark_rapids_jni_tpu.parallel.distributed import head_table
+    from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+    from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
+
+    work = _q1_work_table(local)
+    partial = groupby_aggregate(work, keys=[0, 1], aggs=_Q1_PARTIAL_AGGS)
+    pt = head_table(
+        partial.table, min(_Q1_GROUP_BUDGET, partial.table.num_rows)
+    )
+    sh = hash_shuffle(pt, [0, 1], EXEC_AXIS, capacity=pt.num_rows)
+    merged = groupby_aggregate(
+        sh.table, keys=[0, 1], aggs=[(i, "sum") for i in range(2, 10)]
+    )
+    final = _q1_finalize(merged.table)
+    final = sort_table(final, [0, 1], nulls_first=[False, False])
+    return final, merged.num_groups.reshape(1)
+
+
+def tpch_q1_distributed(lineitem: Table, mesh) -> Table:
+    """Multi-executor q1: shard rows over the mesh, run the shuffle-backed
+    step jitted across it, then collect + globally sort the (tiny) result —
+    the driver-side collect of the Spark job."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_jni_tpu.parallel.distributed import collect, shard_table
+    from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+
+    sharded = shard_table(lineitem, mesh)
+    step = _jax.jit(
+        _jax.shard_map(
+            q1_distributed_step,
+            mesh=mesh,
+            in_specs=(P(EXEC_AXIS),),
+            out_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+        )
+    )
+    per_dev, num_groups = step(sharded)
+    result = collect(per_dev, num_groups, mesh)
+    return sort_table(result, [0, 1], nulls_first=[False, False])

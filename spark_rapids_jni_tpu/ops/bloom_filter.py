@@ -12,8 +12,14 @@ MB per filter — noise next to HBM capacity, and worth it for a one-scatter
 build. ``to_packed``/``from_packed`` convert to the little-endian packed
 form for interchange (e.g. with Spark's serialized BloomFilterImpl).
 
-Bit placement is the classic double-hashing scheme Spark's BloomFilterImpl
-uses: bit_i = (h1 + i * h2) mod m off a single xxhash64 evaluation.
+Bit placement replicates Spark's ``BloomFilterImpl.putLong`` exactly so
+``to_packed``/``from_packed`` interchange with Spark-serialized filters:
+h1 = Murmur3_x86_32.hashLong(item, 0), h2 = Murmur3_x86_32.hashLong(item, h1),
+then for i in 1..k: combined = int32(h1 + i*h2), bitwise-NOT if negative,
+bit = combined % m. Spark's SQL runtime-filter path (BloomFilterAggregate /
+might_contain) additionally pre-hashes the column value with
+xxhash64(seed=42) before putLong — ``spark_prehash`` / the ``*_spark``
+wrappers provide that composition.
 """
 
 from __future__ import annotations
@@ -26,6 +32,35 @@ import numpy as np
 from spark_rapids_jni_tpu.columnar.bitmask import pack_validity, unpack_validity
 from spark_rapids_jni_tpu.ops.hash import xxhash64_long
 from spark_rapids_jni_tpu.utils.tracing import func_range
+
+_MM3_C1 = np.uint32(0xCC9E2D51)
+_MM3_C2 = np.uint32(0x1B873593)
+
+
+def _rotl32(x: jnp.ndarray, r: int) -> jnp.ndarray:
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def murmur3_hash_long(value: jnp.ndarray, seed) -> jnp.ndarray:
+    """Vectorized Murmur3_x86_32.hashLong: two 4-byte little-endian blocks
+    (low word then high word), finalized with length 8. Returns uint32[n]."""
+    v = value.astype(jnp.uint64)
+    low = (v & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    high = (v >> jnp.uint64(32)).astype(jnp.uint32)
+    h1 = jnp.broadcast_to(jnp.asarray(seed, jnp.uint32), low.shape)
+    for word in (low, high):
+        k1 = _rotl32(word * _MM3_C1, 15) * _MM3_C2
+        h1 = _rotl32(h1 ^ k1, 13) * np.uint32(5) + np.uint32(0xE6546B64)
+    h1 = h1 ^ np.uint32(8)  # fmix(h1, length=8)
+    h1 = (h1 ^ (h1 >> np.uint32(16))) * np.uint32(0x85EBCA6B)
+    h1 = (h1 ^ (h1 >> np.uint32(13))) * np.uint32(0xC2B2AE35)
+    return h1 ^ (h1 >> np.uint32(16))
+
+
+def spark_prehash(values: jnp.ndarray) -> jnp.ndarray:
+    """BloomFilterAggregate's value hash: xxhash64(long value, seed=42)."""
+    seeds = jnp.full(values.shape, np.uint64(42), dtype=jnp.uint64)
+    return xxhash64_long(values.astype(jnp.int64), seeds).astype(jnp.int64)
 
 
 @dataclass
@@ -65,14 +100,13 @@ class BloomFilter:
 
 
 def _bit_positions(values: jnp.ndarray, num_bits: int, num_hashes: int):
-    """(n, k) bit indexes via double hashing off one xxhash64 pass."""
-    seeds = jnp.zeros(values.shape, dtype=jnp.uint64)
-    h = xxhash64_long(values, seeds)
-    h1 = h & jnp.uint64(0xFFFFFFFF)
-    h2 = (h >> jnp.uint64(32)) | jnp.uint64(1)  # odd stride covers the bitset
-    i = jnp.arange(num_hashes, dtype=jnp.uint64)
-    combined = h1[:, None] + i[None, :] * h2[:, None]
-    return (combined % jnp.uint64(num_bits)).astype(jnp.int32)
+    """(n, k) bit indexes — BloomFilterImpl.putLong's double hashing."""
+    h1 = murmur3_hash_long(values, np.uint32(0))
+    h2 = murmur3_hash_long(values, h1)
+    i = jnp.arange(1, num_hashes + 1, dtype=jnp.uint32)
+    combined = (h1[:, None] + i[None, :] * h2[:, None]).astype(jnp.int32)
+    combined = jnp.where(combined < 0, ~combined, combined)
+    return combined % jnp.int32(num_bits)
 
 
 @func_range("bloom_filter_put")
@@ -103,3 +137,17 @@ def bloom_merge(a: BloomFilter, b: BloomFilter) -> BloomFilter:
     if a.num_bits != b.num_bits or a.num_hashes != b.num_hashes:
         raise ValueError("bloom filters must have identical shape to merge")
     return BloomFilter(jnp.maximum(a.bits, b.bits), a.num_hashes)
+
+
+def bloom_put_spark(
+    bf: BloomFilter,
+    values: jnp.ndarray,
+    valid: jnp.ndarray | None = None,
+) -> BloomFilter:
+    """BloomFilterAggregate semantics: xxhash64(value, 42) then putLong."""
+    return bloom_put(bf, spark_prehash(values), valid)
+
+
+def bloom_might_contain_spark(bf: BloomFilter, values: jnp.ndarray) -> jnp.ndarray:
+    """Spark SQL might_contain: pre-hash then mightContainLong."""
+    return bloom_might_contain(bf, spark_prehash(values))

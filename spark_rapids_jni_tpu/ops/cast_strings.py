@@ -248,9 +248,13 @@ def string_to_float(
         e_count == 0,
     )
     e_weight = end[:, None] - 1 - pos
+    # int64 accumulation: weights clip at 10^9 per digit, so any nonzero
+    # digit at weight >= 10 still drives the sum past the +-400 saturation
+    # point without int32 wraparound (a 12-digit exponent must saturate to
+    # inf/zero, not wrap to a small finite exponent).
     e_val = jnp.sum(
-        jnp.where(in_exp & is_digit, digit.astype(jnp.int32), 0)
-        * jnp.power(10, jnp.clip(e_weight, 0, 9)).astype(jnp.int32)
+        jnp.where(in_exp & is_digit, digit.astype(jnp.int64), 0)
+        * jnp.power(10, jnp.clip(e_weight, 0, 9)).astype(jnp.int64)
         * (e_weight >= 0),
         axis=1,
     )
@@ -261,6 +265,8 @@ def string_to_float(
 
     value = jnp.where(is_inf, jnp.inf, value)
     value = jnp.where(is_nan, jnp.nan, value)
-    ok = (ok & ~too_long) | is_inf | is_nan
+    # too_long rejects unconditionally: a truncated payload that happens to
+    # trim to an inf/nan spelling is still an overlong string, hence null.
+    ok = (ok | is_inf | is_nan) & ~too_long
     signed = jnp.where(is_neg, -value, value)
     return Column(dtype, signed.astype(dtype.jnp_dtype), ok)

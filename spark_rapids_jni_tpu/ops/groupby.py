@@ -131,6 +131,11 @@ def groupby_aggregate(
             else:
                 denom = jnp.maximum(vcount, 1).astype(jnp.float64)
                 mean = total.astype(jnp.float64) / denom
+                if c.dtype.is_decimal:
+                    # Rescale so the FLOAT64 result carries the true value:
+                    # the unscaled-integer mean alone is off by 10^-scale
+                    # and the float dtype has no scale field to recover it.
+                    mean = mean * (10.0 ** c.dtype.scale)
                 out_cols.append(Column(DType(TypeId.FLOAT64), mean, has_any))
             continue
         # min / max with null-neutral sentinels

@@ -37,7 +37,11 @@ def _as_unsigned_key(col_data: jnp.ndarray, dtype: DType) -> jnp.ndarray:
         u = jax.lax.bitcast_convert_type(col_data, jnp.uint32)
         sign = (u >> 31).astype(jnp.uint32)
         # negative: flip all bits; positive: flip sign bit
-        return u ^ jnp.where(sign == 1, jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000))
+        enc = u ^ jnp.where(sign == 1, jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000))
+        # Canonicalize every NaN (either sign) above +inf: Spark treats NaN
+        # as one greatest value; a negative NaN's payload would otherwise
+        # sort smallest and split NaN groups in groupby.
+        return jnp.where(jnp.isnan(col_data), jnp.uint32(0xFFFFFFFF), enc)
     # float64 never reaches here: _key_arrays routes it to the value-level
     # two-key encoding (no 64-bit bitcast on TPU).
     raise TypeError(f"unsupported sort key type {dtype}")

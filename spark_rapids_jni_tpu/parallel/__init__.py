@@ -1,0 +1,26 @@
+"""Multi-chip parallelism: device mesh, ICI all-to-all shuffle transport,
+and distributed operators.
+
+This is the TPU-native replacement for the RapidsShuffleManager's UCX/NCCL
+block transport (BASELINE.json north_star; absent from the reference repo
+itself, SURVEY.md section 2.3): Spark executors map to mesh devices, a
+repartition-by-key-hash exchange rides XLA's ``all_to_all`` collective over
+ICI, and post-shuffle operators (groupby merge, join) run on the disjoint
+key ranges each chip owns afterward.
+"""
+
+from spark_rapids_jni_tpu.parallel.mesh import executor_mesh, EXEC_AXIS
+from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle, ShuffleResult
+from spark_rapids_jni_tpu.parallel.distributed import (
+    distributed_groupby_aggregate,
+    shard_table,
+)
+
+__all__ = [
+    "EXEC_AXIS",
+    "ShuffleResult",
+    "distributed_groupby_aggregate",
+    "executor_mesh",
+    "hash_shuffle",
+    "shard_table",
+]

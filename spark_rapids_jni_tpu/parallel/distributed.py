@@ -1,0 +1,123 @@
+"""Distributed operators: shard tables over the executor mesh and run
+shuffle-backed relational ops across it.
+
+The execution model mirrors the Spark plugin's (SURVEY.md section 2.3): each
+executor owns a partition of rows and runs the same operator pipeline; the
+only inter-executor step is the repartition-by-key exchange, which here is
+the ICI all_to_all in parallel.shuffle instead of the UCX shuffle manager.
+
+Phantom rows (unoccupied shuffle slots) carry null keys and null values, so
+aggregates skip them by construction; their only observable artifact is a
+possible all-null key group in the padded output, which callers discard the
+same way they discard local groupby padding.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+def head_table(table: Table, k: int) -> Table:
+    """First k rows (static slice) — groupby outputs put real groups first."""
+    cols = []
+    for c in table.columns:
+        validity = None if c.validity is None else c.validity[:k]
+        cols.append(Column(c.dtype, c.data[:k], validity))
+    return Table(cols)
+
+
+def shard_table(table: Table, mesh: Mesh, axis: str = EXEC_AXIS) -> Table:
+    """Distribute a host-built table row-wise across the mesh axis.
+
+    Rows are padded to a multiple of the axis size with null rows (null
+    rows fall out of every aggregate, the framework-wide masking idiom).
+    """
+    d = mesh.shape[axis]
+    n = table.num_rows
+    pad = (-n) % d
+    sharding = NamedSharding(mesh, P(axis))
+    out = []
+    for c in table.columns:
+        if not c.dtype.is_fixed_width:
+            raise NotImplementedError("shard_table: fixed-width columns only")
+        data = jnp.concatenate([c.data, jnp.zeros((pad,), c.data.dtype)]) if pad else c.data
+        valid = c.valid_mask()
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.bool_)]) if pad else valid
+        out.append(
+            Column(
+                c.dtype,
+                jax.device_put(data, sharding),
+                jax.device_put(valid, sharding),
+            )
+        )
+    return Table(out)
+
+
+class DistributedGroupBy(NamedTuple):
+    table: Table             # per-device padded results, sharded over EXEC_AXIS
+    num_groups: jnp.ndarray  # int32[D] groups owned by each device
+    overflowed: jnp.ndarray  # bool[D] shuffle capacity overflow per device
+
+
+@func_range("distributed_groupby_aggregate")
+def distributed_groupby_aggregate(
+    table: Table,
+    keys: Sequence[int],
+    aggs: Sequence[tuple[int, str]],
+    mesh: Mesh,
+    capacity: Optional[int] = None,
+) -> DistributedGroupBy:
+    """Global groupby: shuffle rows by key hash, then one local groupby per
+    device. After the exchange each device owns a disjoint key range, so the
+    per-device results ARE the global answer, partitioned.
+
+    ``table`` must already be sharded row-wise over ``mesh`` (shard_table).
+    """
+    keys = list(keys)
+    aggs = list(aggs)
+
+    def step(local: Table):
+        sh = hash_shuffle(local, keys, EXEC_AXIS, capacity=capacity)
+        res = groupby_aggregate(sh.table, keys, aggs)
+        return res.table, res.num_groups.reshape(1), sh.overflowed.reshape(1)
+
+    out_tbl, num_groups, overflowed = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(EXEC_AXIS),),
+        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+    )(table)
+    return DistributedGroupBy(out_tbl, num_groups, overflowed)
+
+
+def collect(table: Table, num_rows_per_device: jnp.ndarray, mesh: Mesh) -> Table:
+    """Host-side gather of a sharded, per-device-padded result into one
+    compact host table (the driver-side collect of a Spark job)."""
+    d = int(np.prod(list(mesh.shape.values())))
+    per_dev = table.num_rows // d
+    counts = np.asarray(num_rows_per_device).reshape(-1)
+    cols: list[list] = [[] for _ in table.columns]
+    for dev in range(d):
+        k = int(counts[dev])
+        for i, c in enumerate(table.columns):
+            lo = dev * per_dev
+            data = np.asarray(c.data[lo : lo + k])
+            valid = np.asarray(c.valid_mask()[lo : lo + k])
+            cols[i].append((data, valid))
+    out = []
+    for c, parts in zip(table.columns, cols):
+        data = np.concatenate([p[0] for p in parts])
+        valid = np.concatenate([p[1] for p in parts])
+        out.append(Column(c.dtype, jnp.asarray(data), jnp.asarray(valid)))
+    return Table(out)

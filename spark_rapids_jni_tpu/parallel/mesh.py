@@ -1,0 +1,47 @@
+"""Device-mesh construction for the executor model.
+
+The reference's inter-device story is Spark data parallelism: one executor
+task per partition, each issuing independent device work (SURVEY.md
+section 2.3, PER_THREAD_DEFAULT_STREAM at reference pom.xml:80). On TPU the
+executors become positions along one mesh axis; partition exchange between
+them is an XLA collective over ICI instead of UCX peer-to-peer blocks.
+
+One axis is enough for the shuffle transport (all-to-all is a full
+exchange); wider meshes (e.g. a second axis for within-executor model/row
+sharding of a single giant partition) stack on top by reshaping the same
+device list.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+# Axis name for the executor/data-parallel dimension of every mesh this
+# package builds. Collectives in the shuffle bind to this name.
+EXEC_AXIS = "exec"
+
+
+def executor_mesh(
+    num_executors: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 1-D mesh of ``num_executors`` devices along ``EXEC_AXIS``.
+
+    Defaults to every visible device — one executor per chip, the same
+    1 task : 1 device contract Spark's plugin enforces on GPUs.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_executors is None:
+        num_executors = len(devices)
+    if num_executors > len(devices):
+        raise ValueError(
+            f"requested {num_executors} executors but only "
+            f"{len(devices)} devices are visible"
+        )
+    import numpy as np
+
+    return Mesh(np.asarray(devices[:num_executors]), (EXEC_AXIS,))

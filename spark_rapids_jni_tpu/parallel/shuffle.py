@@ -1,0 +1,119 @@
+"""ICI all-to-all shuffle transport — repartition a device-resident table by
+key hash across the executor mesh axis.
+
+This is the RapidsShuffleManager replacement (BASELINE.json north_star;
+SURVEY.md section 2.3 "distributed comm backend — must be built"): where the
+GPU stack serializes partition blocks and moves them over UCX between
+executor processes, here every executor's batch stays in HBM and one XLA
+``all_to_all`` collective performs the full D x D partition exchange over
+ICI in a single fused step.
+
+TPU-first shape discipline: ``all_to_all`` needs a static per-destination
+capacity, so each device packs its rows into a ``(D, capacity)`` send
+buffer (rows sorted by destination partition — one gather, radix-friendly)
+with an occupancy mask; unoccupied receive slots surface as null rows,
+which every downstream operator already skips (the same masked-row trick
+the local operators use for static-shape filtering). The capacity default
+``ceil(n/D) * 2`` covers 2x skew; overflow is detected and reported
+per-call (`ShuffleResult.overflowed`) rather than silently dropped —
+the moral equivalent of the reference's hard 2^31-byte batch bound
+(reference row_conversion.cu:476-479).
+
+Fixed-width columns only, like the reference's row transpose
+(row_conversion.cu:515) — string shuffle lands with the string substrate.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.hash import partition_hash
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+class ShuffleResult(NamedTuple):
+    table: Table            # D*capacity local rows, null-masked where empty
+    row_valid: jnp.ndarray  # bool[D*capacity]: slot holds a real row
+    overflowed: jnp.ndarray  # bool scalar: this device dropped rows
+
+
+def _pack_send(
+    data: jnp.ndarray, order: jnp.ndarray, dst: jnp.ndarray, size: int
+) -> jnp.ndarray:
+    """Gather rows into destination order and scatter into the flat (D*C)
+    send buffer; out-of-capacity rows drop (reported via overflow flag)."""
+    g = data[order]
+    buf = jnp.zeros((size,), dtype=data.dtype)
+    return buf.at[dst].set(g, mode="drop")
+
+
+@func_range("hash_shuffle")
+def hash_shuffle(
+    table: Table,
+    keys: Sequence[int],
+    axis_name: str,
+    capacity: Optional[int] = None,
+) -> ShuffleResult:
+    """Exchange rows so row r lands on device ``hash(keys(r)) % D``.
+
+    Must run inside ``shard_map`` over a mesh with ``axis_name``; ``table``
+    is the caller's local batch. Returns the rows this device owns after
+    the exchange (padded to ``D * capacity`` with null rows).
+    """
+    D = jax.lax.axis_size(axis_name)
+    n = table.num_rows
+    if capacity is None:
+        capacity = max(1, math.ceil(n / D) * 2)
+
+    part = partition_hash(table, list(keys), D)  # int32[n], in [0, D)
+
+    # Sort rows by destination partition; compute each row's slot within
+    # its partition run. Stable sort keeps within-partition input order.
+    order = jnp.argsort(part, stable=True)
+    part_sorted = part[order]
+    counts = jnp.zeros((D,), dtype=jnp.int32).at[part].add(1)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+    )
+    slot = jnp.arange(n, dtype=jnp.int32) - offsets[part_sorted]
+    in_cap = slot < capacity
+    overflowed = jnp.any(~in_cap)
+    size = D * capacity
+    # Flat index into (D, capacity); overflow rows are routed out of range so
+    # the scatters genuinely drop them — p*capacity + slot with slot >= capacity
+    # would land inside partition p+1's region and corrupt it.
+    dst = jnp.where(in_cap, part_sorted * capacity + slot, size)
+
+    occupied = jnp.zeros((size,), dtype=jnp.bool_).at[dst].set(
+        in_cap, mode="drop"
+    )
+
+    def exchange(flat: jnp.ndarray) -> jnp.ndarray:
+        """(D*C,) send layout -> (D*C,) receive layout over ICI."""
+        return jax.lax.all_to_all(
+            flat.reshape(D, capacity), axis_name, 0, 0, tiled=True
+        ).reshape(size)
+
+    recv_occupied = exchange(occupied)
+
+    out_cols = []
+    for col in table.columns:
+        if not col.dtype.is_fixed_width:
+            raise NotImplementedError(
+                "hash_shuffle supports fixed-width columns only (reference "
+                "row_conversion.cu:515 has the same restriction)"
+            )
+        sent = _pack_send(col.data, order, dst, size)
+        recv = exchange(sent)
+        valid_flat = _pack_send(
+            col.valid_mask(), order, dst, size
+        )
+        recv_valid = exchange(valid_flat) & recv_occupied
+        out_cols.append(Column(col.dtype, recv, recv_valid))
+
+    return ShuffleResult(Table(out_cols), recv_occupied, overflowed)

@@ -149,3 +149,28 @@ def test_float_zero_mantissa_huge_exponent():
     out = string_to_float(string_column(["0e400", "0.0e999", "-0e999"]),
                           t.FLOAT64)
     assert out.to_pylist() == [0.0, 0.0, -0.0]
+
+
+def test_float_cast_too_long_inf_rejected():
+    """A >max_len string whose truncation spells 'infinity' is null, not inf."""
+    from spark_rapids_jni_tpu.ops.cast_strings import string_to_float
+    from spark_rapids_jni_tpu.columnar.column import string_column
+
+    s = "infinity" + " " * 24 + "X"  # 33 chars, max_len 32
+    col = string_column([s, "infinity"])
+    out = string_to_float(col, t.FLOAT64)
+    assert out.to_pylist()[0] is None
+    assert out.to_pylist()[1] == np.inf
+
+
+def test_float_cast_huge_exponent_saturates():
+    """11+-digit exponents saturate to inf/0.0 instead of int32-wrapping."""
+    from spark_rapids_jni_tpu.ops.cast_strings import string_to_float
+    from spark_rapids_jni_tpu.columnar.column import string_column
+
+    col = string_column(["1e99999999999", "-1e99999999999", "1e-99999999999"])
+    out = string_to_float(col, t.FLOAT64)
+    vals = out.to_pylist()
+    assert vals[0] == np.inf
+    assert vals[1] == -np.inf
+    assert vals[2] == 0.0

@@ -309,3 +309,98 @@ def test_bloom_packed_round_trip(rng):
     assert packed.shape[0] == 64
     back = BloomFilter.from_packed(packed, 512, 3)
     assert np.array_equal(np.asarray(back.bits), np.asarray(bf.bits))
+
+
+def test_murmur3_hash_long_matches_java_oracle():
+    """Vectorized Murmur3_x86_32.hashLong vs a plain-int transcription of the
+    Java algorithm (Spark util.sketch / Guava hashLong)."""
+    from spark_rapids_jni_tpu.ops.bloom_filter import murmur3_hash_long
+
+    M = 0xFFFFFFFF
+
+    def oracle(v: int, seed: int) -> int:
+        def rotl(x, r):
+            return ((x << r) | (x >> (32 - r))) & M
+
+        low, high = v & M, (v >> 32) & M  # two's-complement uint64 view
+        h1 = seed & M
+        for w in (low, high):
+            k1 = (rotl((w * 0xCC9E2D51) & M, 15) * 0x1B873593) & M
+            h1 = ((rotl(h1 ^ k1, 13) * 5) + 0xE6546B64) & M
+        h1 ^= 8
+        h1 = ((h1 ^ (h1 >> 16)) * 0x85EBCA6B) & M
+        h1 = ((h1 ^ (h1 >> 13)) * 0xC2B2AE35) & M
+        return h1 ^ (h1 >> 16)
+
+    vals = [0, 1, -1, 42, -42, 2**62, -(2**62), 0x123456789ABCDEF]
+    got = np.asarray(
+        murmur3_hash_long(jnp.asarray(np.array(vals, dtype=np.int64)), 0)
+    )
+    for i, v in enumerate(vals):
+        assert int(got[i]) == oracle(v & 0xFFFFFFFFFFFFFFFF, 0), v
+    # seeded variant (h2 = hashLong(item, h1))
+    got_seeded = np.asarray(
+        murmur3_hash_long(
+            jnp.asarray(np.array(vals, dtype=np.int64)), np.uint32(7)
+        )
+    )
+    for i, v in enumerate(vals):
+        assert int(got_seeded[i]) == oracle(v & 0xFFFFFFFFFFFFFFFF, 7), v
+
+
+def test_bloom_bit_positions_match_spark_impl():
+    """Bit indexes replicate BloomFilterImpl.putLong: i=1..k, signed int32
+    combine, bitwise-NOT on negative, mod bitSize."""
+    from spark_rapids_jni_tpu.ops.bloom_filter import (
+        _bit_positions,
+        murmur3_hash_long,
+    )
+
+    vals = np.array([0, 1, -1, 99, 2**50], dtype=np.int64)
+    m, k = 65536, 5
+    got = np.asarray(_bit_positions(jnp.asarray(vals), m, k))
+    h1 = np.asarray(murmur3_hash_long(jnp.asarray(vals), 0)).astype(np.int64)
+    h2 = np.asarray(
+        murmur3_hash_long(jnp.asarray(vals), jnp.asarray(h1, dtype=jnp.uint32))
+    ).astype(np.int64)
+    for r in range(len(vals)):
+        for i in range(1, k + 1):
+            c = (h1[r] + i * h2[r]) & 0xFFFFFFFF
+            if c >= 2**31:  # negative as int32
+                c = (~c) & 0xFFFFFFFF  # Java ~ on int32
+                c &= 0x7FFFFFFF
+            assert got[r, i - 1] == c % m
+
+
+def test_bloom_spark_prehash_wrappers(rng):
+    from spark_rapids_jni_tpu.ops.bloom_filter import (
+        bloom_might_contain_spark,
+        bloom_put_spark,
+        spark_prehash,
+    )
+    from tests.xxh64_ref import xxh64
+
+    items = rng.integers(-(2**60), 2**60, 100).astype(np.int64)
+    # prehash == xxhash64(8-byte LE value, seed 42)
+    ph = np.asarray(spark_prehash(jnp.asarray(items)))
+    for v in items[:5]:
+        want = xxh64(int(np.uint64(np.int64(v))).to_bytes(8, "little"), 42)
+        assert int(np.uint64(ph[list(items).index(v)])) == want
+    bf = BloomFilter.optimal(len(items), fpp=0.03)
+    bf = bloom_put_spark(bf, jnp.asarray(items))
+    assert np.asarray(bloom_might_contain_spark(bf, jnp.asarray(items))).all()
+
+
+def test_sort_float32_negative_nan_greatest():
+    """Both NaN signs sort greatest (Spark order) and form ONE group."""
+    from spark_rapids_jni_tpu.ops.sort import sort_table
+
+    neg_nan = np.frombuffer(np.uint32(0xFFC00000).tobytes(), dtype=np.float32)[0]
+    vals = np.array([1.5, neg_nan, -2.0, np.nan, 7.0], dtype=np.float32)
+    tbl = Table([Column.from_numpy(vals, t.FLOAT32)])
+    out = np.asarray(sort_table(tbl, [0]).column(0).data)
+    assert np.array_equal(out[:3], np.array([-2.0, 1.5, 7.0], dtype=np.float32))
+    assert np.isnan(out[3]) and np.isnan(out[4])
+
+    res = groupby_aggregate(tbl, keys=[0], aggs=[(0, "count")])
+    assert int(res.num_groups) == 4  # -2, 1.5, 7, one unified NaN group
