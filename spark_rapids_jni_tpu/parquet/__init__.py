@@ -1,3 +1,13 @@
 from spark_rapids_jni_tpu.parquet.footer import ParquetFooter
+from spark_rapids_jni_tpu.parquet.reader import (
+    ParquetChunkedReader,
+    read_table,
+    row_group_info,
+)
 
-__all__ = ["ParquetFooter"]
+__all__ = [
+    "ParquetChunkedReader",
+    "ParquetFooter",
+    "read_table",
+    "row_group_info",
+]

@@ -64,6 +64,48 @@ class NativeLib:
         c.tpudf_footer_close.restype = ctypes.c_int32
         c.tpudf_footer_close.argtypes = [ctypes.c_int64]
         c.tpudf_open_handles.restype = ctypes.c_int64
+        # Parquet data reader
+        c.tpudf_parquet_read.restype = ctypes.c_int64
+        c.tpudf_parquet_read.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        c.tpudf_parquet_row_groups.restype = ctypes.c_int32
+        c.tpudf_parquet_row_groups.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+        ]
+        c.tpudf_read_num_rows.restype = ctypes.c_int64
+        c.tpudf_read_num_rows.argtypes = [ctypes.c_int64]
+        c.tpudf_read_num_columns.restype = ctypes.c_int32
+        c.tpudf_read_num_columns.argtypes = [ctypes.c_int64]
+        c.tpudf_read_col_meta.restype = ctypes.c_int32
+        c.tpudf_read_col_meta.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        c.tpudf_read_col_name.restype = ctypes.c_char_p
+        c.tpudf_read_col_name.argtypes = [ctypes.c_int64, ctypes.c_int32]
+        c.tpudf_read_col_copy.restype = ctypes.c_int32
+        c.tpudf_read_col_copy.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        c.tpudf_read_close.restype = ctypes.c_int32
+        c.tpudf_read_close.argtypes = [ctypes.c_int64]
 
     def __getattr__(self, name):
         return getattr(self._c, name)

@@ -102,6 +102,11 @@ struct Limits {
 // Throws ParseError on malformed input or limit violations.
 Value parse_struct(uint8_t const* buf, uint64_t len, Limits const& limits = {});
 
+// Same, reporting how many bytes the struct occupied — needed when structs
+// are embedded mid-stream (Parquet page headers precede page payloads).
+Value parse_struct(uint8_t const* buf, uint64_t len, uint64_t* consumed,
+                   Limits const& limits = {});
+
 // Serialize a struct value to compact-protocol bytes.
 std::string serialize_struct(Value const& v);
 

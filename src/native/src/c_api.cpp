@@ -15,11 +15,13 @@
 #include <cstring>
 #include <memory>
 #include <mutex>
+#include <optional>
 #include <string>
 #include <unordered_map>
 #include <vector>
 
 #include "tpudf/parquet_footer.hpp"
+#include "tpudf/parquet_reader.hpp"
 
 namespace {
 
@@ -32,16 +34,17 @@ void set_error(std::string msg) { g_last_error = std::move(msg); }
 // Lookups hand out shared_ptr so a concurrent close (e.g. Python GC calling
 // __del__ on another thread while ctypes has released the GIL) cannot free
 // an object mid-use — the last owner wins.
+template <class T>
 class Registry {
  public:
-  int64_t put(std::shared_ptr<tpudf::parquet::Footer> obj) {
+  int64_t put(std::shared_ptr<T> obj) {
     std::lock_guard<std::mutex> lock(mu_);
     int64_t id = next_++;
     map_[id] = std::move(obj);
     return id;
   }
 
-  std::shared_ptr<tpudf::parquet::Footer> get(int64_t id) {
+  std::shared_ptr<T> get(int64_t id) {
     std::lock_guard<std::mutex> lock(mu_);
     auto it = map_.find(id);
     return it == map_.end() ? nullptr : it->second;
@@ -59,12 +62,17 @@ class Registry {
 
  private:
   std::mutex mu_;
-  std::unordered_map<int64_t, std::shared_ptr<tpudf::parquet::Footer>> map_;
+  std::unordered_map<int64_t, std::shared_ptr<T>> map_;
   int64_t next_ = 1;
 };
 
-Registry& footers() {
-  static Registry r;
+Registry<tpudf::parquet::Footer>& footers() {
+  static Registry<tpudf::parquet::Footer> r;
+  return r;
+}
+
+Registry<tpudf::parquet::ReadResult>& reads() {
+  static Registry<tpudf::parquet::ReadResult> r;
   return r;
 }
 
@@ -162,7 +170,140 @@ int32_t tpudf_footer_close(int64_t handle) {
   return 0;
 }
 
+// ---- Parquet data reader (chunked at row-group granularity) ---------------
+
+// Decode selected columns / row groups of an in-memory Parquet file into an
+// Arrow-layout host result. A null cols/rgs pointer selects all; a non-null
+// pointer with count 0 selects none. Returns a read handle, 0 on error.
+int64_t tpudf_parquet_read(uint8_t const* buf, uint64_t len,
+                           int32_t const* cols, int32_t n_cols,
+                           int32_t const* rgs, int32_t n_rgs) {
+  try {
+    std::optional<std::vector<int32_t>> col_vec;
+    if (cols != nullptr) col_vec.emplace(cols, cols + n_cols);
+    std::optional<std::vector<int32_t>> rg_vec;
+    if (rgs != nullptr) rg_vec.emplace(rgs, rgs + n_rgs);
+    auto res = std::make_shared<tpudf::parquet::ReadResult>(
+        tpudf::parquet::read_file(buf, len, col_vec, rg_vec));
+    return reads().put(std::move(res));
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return 0;
+  }
+}
+
+// Footer probes for planning chunked reads: fills num_rows/byte_size pairs
+// for up to `cap` row groups; returns the total count, -1 on error.
+int32_t tpudf_parquet_row_groups(uint8_t const* buf, uint64_t len,
+                                 int64_t* num_rows, int64_t* byte_size,
+                                 int32_t cap) {
+  try {
+    auto infos = tpudf::parquet::row_group_infos(buf, len);
+    for (int32_t i = 0; i < cap && i < static_cast<int32_t>(infos.size());
+         ++i) {
+      num_rows[i] = infos[i].num_rows;
+      byte_size[i] = infos[i].total_byte_size;
+    }
+    return static_cast<int32_t>(infos.size());
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+int64_t tpudf_read_num_rows(int64_t handle) {
+  auto r = reads().get(handle);
+  if (r == nullptr) {
+    set_error("invalid read handle");
+    return -1;
+  }
+  return r->num_rows;
+}
+
+int32_t tpudf_read_num_columns(int64_t handle) {
+  auto r = reads().get(handle);
+  if (r == nullptr) {
+    set_error("invalid read handle");
+    return -1;
+  }
+  return static_cast<int32_t>(r->columns.size());
+}
+
+// Column metadata: meta = [physical, converted, scale, precision,
+// type_length, optional, has_validity] (7 int32s); sizes = [data_bytes,
+// chars_bytes, num_rows] (3 int64s). Returns 0 on success.
+int32_t tpudf_read_col_meta(int64_t handle, int32_t i, int32_t* meta,
+                            int64_t* sizes) {
+  auto r = reads().get(handle);
+  if (r == nullptr || i < 0 || i >= static_cast<int32_t>(r->columns.size())) {
+    set_error("invalid read handle or column index");
+    return -1;
+  }
+  auto const& c = r->columns[i];
+  meta[0] = c.physical;
+  meta[1] = c.converted;
+  meta[2] = c.scale;
+  meta[3] = c.precision;
+  meta[4] = c.type_length;
+  meta[5] = c.optional ? 1 : 0;
+  meta[6] = c.validity.empty() ? 0 : 1;
+  sizes[0] = static_cast<int64_t>(c.data.size());
+  sizes[1] = static_cast<int64_t>(c.chars.size());
+  sizes[2] = c.num_rows;
+  return 0;
+}
+
+// Pointer to the column's name (NUL-terminated). The string is copied into
+// thread-local storage so a concurrent tpudf_read_close on another thread
+// cannot free it out from under the caller — valid until this thread's next
+// tpudf_read_col_name call.
+char const* tpudf_read_col_name(int64_t handle, int32_t i) {
+  thread_local std::string name_buf;
+  auto r = reads().get(handle);
+  if (r == nullptr || i < 0 || i >= static_cast<int32_t>(r->columns.size())) {
+    set_error("invalid read handle or column index");
+    return nullptr;
+  }
+  name_buf = r->columns[i].name;
+  return name_buf.c_str();
+}
+
+// Copy out column buffers; any destination may be null to skip it.
+// data: fixed-width payload; offsets: int32[num_rows+1] (BYTE_ARRAY only);
+// chars: string payload; validity: uint8[num_rows]. Returns 0 on success.
+int32_t tpudf_read_col_copy(int64_t handle, int32_t i, uint8_t* data,
+                            int32_t* offsets, uint8_t* chars,
+                            uint8_t* validity) {
+  auto r = reads().get(handle);
+  if (r == nullptr || i < 0 || i >= static_cast<int32_t>(r->columns.size())) {
+    set_error("invalid read handle or column index");
+    return -1;
+  }
+  auto const& c = r->columns[i];
+  if (data != nullptr && !c.data.empty()) {
+    std::memcpy(data, c.data.data(), c.data.size());
+  }
+  if (offsets != nullptr && !c.offsets.empty()) {
+    std::memcpy(offsets, c.offsets.data(), c.offsets.size() * sizeof(int32_t));
+  }
+  if (chars != nullptr && !c.chars.empty()) {
+    std::memcpy(chars, c.chars.data(), c.chars.size());
+  }
+  if (validity != nullptr && !c.validity.empty()) {
+    std::memcpy(validity, c.validity.data(), c.validity.size());
+  }
+  return 0;
+}
+
+int32_t tpudf_read_close(int64_t handle) {
+  if (!reads().erase(handle)) {
+    set_error("invalid read handle");
+    return -1;
+  }
+  return 0;
+}
+
 // Open-handle count — backs leak-check tests, the moral equivalent of the
 // reference's refcount leak-debugging flag (pom.xml:86,436).
-int64_t tpudf_open_handles() { return footers().size(); }
+int64_t tpudf_open_handles() { return footers().size() + reads().size(); }
 }

@@ -1,0 +1,682 @@
+// Parquet data-page decode. See parquet_reader.hpp for the supported
+// subset. All structures are parsed with the generic thrift codec
+// (thrift_compact.hpp) and addressed by parquet.thrift field id.
+
+#include "tpudf/parquet_reader.hpp"
+
+#include <zlib.h>
+
+#include <algorithm>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+#include "tpudf/thrift_compact.hpp"
+
+namespace tpudf {
+namespace parquet {
+
+namespace {
+
+using thrift::Value;
+
+[[noreturn]] void fail(std::string const& msg) {
+  throw std::runtime_error("parquet read: " + msg);
+}
+
+// ---- thrift field ids (parquet.thrift, public spec) ------------------------
+
+// FileMetaData
+constexpr int16_t kFmdSchema = 2;
+constexpr int16_t kFmdRowGroups = 4;
+// SchemaElement
+constexpr int16_t kSeType = 1;
+constexpr int16_t kSeTypeLength = 2;
+constexpr int16_t kSeRepetition = 3;
+constexpr int16_t kSeName = 4;
+constexpr int16_t kSeNumChildren = 5;
+constexpr int16_t kSeConverted = 6;
+constexpr int16_t kSeScale = 7;
+constexpr int16_t kSePrecision = 8;
+// RowGroup
+constexpr int16_t kRgColumns = 1;
+constexpr int16_t kRgTotalByteSize = 2;
+constexpr int16_t kRgNumRows = 3;
+constexpr int16_t kRgTotalCompressed = 6;
+// ColumnChunk / ColumnMetaData
+constexpr int16_t kCcMeta = 3;
+constexpr int16_t kCmType = 1;
+constexpr int16_t kCmCodec = 4;
+constexpr int16_t kCmNumValues = 5;
+constexpr int16_t kCmDataPageOffset = 9;
+constexpr int16_t kCmDictPageOffset = 11;
+// PageHeader
+constexpr int16_t kPhType = 1;
+constexpr int16_t kPhUncompressedSize = 2;
+constexpr int16_t kPhCompressedSize = 3;
+constexpr int16_t kPhDataHeader = 5;
+constexpr int16_t kPhDictHeader = 7;
+constexpr int16_t kPhDataHeaderV2 = 8;
+// DataPageHeader
+constexpr int16_t kDphNumValues = 1;
+constexpr int16_t kDphEncoding = 2;
+constexpr int16_t kDphDefLevelEncoding = 3;
+// DataPageHeaderV2
+constexpr int16_t kDph2NumValues = 1;
+constexpr int16_t kDph2NumNulls = 2;
+constexpr int16_t kDph2Encoding = 4;
+constexpr int16_t kDph2DefLevelsByteLen = 5;
+constexpr int16_t kDph2RepLevelsByteLen = 6;
+constexpr int16_t kDph2IsCompressed = 7;
+
+// enums
+constexpr int32_t kPageData = 0;
+constexpr int32_t kPageDict = 2;
+constexpr int32_t kPageDataV2 = 3;
+constexpr int32_t kEncPlain = 0;
+constexpr int32_t kEncPlainDict = 2;
+constexpr int32_t kEncRle = 3;
+constexpr int32_t kEncRleDict = 8;
+constexpr int32_t kCodecUncompressed = 0;
+constexpr int32_t kCodecSnappy = 1;
+constexpr int32_t kCodecGzip = 2;
+
+int64_t field_i64(Value const& s, int16_t id, char const* what) {
+  auto const* f = s.field(id);
+  if (f == nullptr) fail(std::string("missing field: ") + what);
+  return f->as_i64();
+}
+
+int64_t field_i64_or(Value const& s, int16_t id, int64_t dflt) {
+  auto const* f = s.field(id);
+  return f == nullptr ? dflt : f->as_i64();
+}
+
+// ---- codecs ---------------------------------------------------------------
+
+std::vector<uint8_t> gzip_uncompress(uint8_t const* in, uint64_t n,
+                                     uint64_t expected_out) {
+  std::vector<uint8_t> out(expected_out);
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  // 32 + MAX_WBITS: auto-detect gzip or zlib framing.
+  if (inflateInit2(&zs, 32 + MAX_WBITS) != Z_OK) fail("zlib init failed");
+  zs.next_in = const_cast<Bytef*>(in);
+  zs.avail_in = static_cast<uInt>(n);
+  zs.next_out = out.data();
+  zs.avail_out = static_cast<uInt>(out.size());
+  int rc = inflate(&zs, Z_FINISH);
+  inflateEnd(&zs);
+  if (rc != Z_STREAM_END || zs.total_out != expected_out) {
+    fail("gzip page did not decompress to the declared size");
+  }
+  return out;
+}
+
+uint64_t read_varint(uint8_t const* p, uint64_t len, uint64_t* pos) {
+  uint64_t out = 0;
+  int shift = 0;
+  while (*pos < len) {
+    uint8_t b = p[(*pos)++];
+    out |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if ((b & 0x80) == 0) return out;
+    shift += 7;
+    if (shift > 63) break;
+  }
+  fail("bad varint");
+}
+
+std::vector<uint8_t> do_decompress(int32_t codec, uint8_t const* in,
+                                   uint64_t n, uint64_t expected) {
+  switch (codec) {
+    case kCodecUncompressed: {
+      if (n != expected) fail("uncompressed page size mismatch");
+      return std::vector<uint8_t>(in, in + n);
+    }
+    case kCodecSnappy:
+      return snappy_uncompress(in, n, expected);
+    case kCodecGzip:
+      return gzip_uncompress(in, n, expected);
+    default:
+      fail("unsupported compression codec " + std::to_string(codec) +
+           " (supported: UNCOMPRESSED, SNAPPY, GZIP)");
+  }
+}
+
+// ---- RLE / bit-packed hybrid ----------------------------------------------
+
+// Decode up to `count` values from parquet's RLE/bit-packed hybrid format.
+// Bit-packed groups may carry padding values past `count`; they are decoded
+// and discarded (the spec pads the final group to a multiple of 8).
+void decode_rle_hybrid(uint8_t const* p, uint64_t len, int bit_width,
+                       int64_t count, std::vector<uint32_t>& out) {
+  out.clear();
+  out.reserve(count);
+  if (bit_width == 0) {
+    out.assign(count, 0);
+    return;
+  }
+  if (bit_width > 32) fail("rle bit width > 32");
+  uint64_t pos = 0;
+  int byte_width = (bit_width + 7) / 8;
+  while (static_cast<int64_t>(out.size()) < count) {
+    uint64_t header = read_varint(p, len, &pos);
+    if (header & 1) {
+      // bit-packed run: (header >> 1) groups of 8 values
+      uint64_t groups = header >> 1;
+      uint64_t nbytes = groups * bit_width;  // == groups*8*bw/8
+      if (pos + nbytes > len) fail("bit-packed run past end of level data");
+      uint64_t nvals = groups * 8;
+      for (uint64_t i = 0;
+           i < nvals && static_cast<int64_t>(out.size()) < count; ++i) {
+        uint64_t bit = i * bit_width;
+        uint64_t byte = bit >> 3;
+        int shift = static_cast<int>(bit & 7);
+        // a value spans at most 5 bytes for bw <= 32
+        uint64_t acc = 0;
+        for (int k = 0; k < 5 && byte + k < nbytes; ++k) {
+          acc |= static_cast<uint64_t>(p[pos + byte + k]) << (8 * k);
+        }
+        out.push_back(
+            static_cast<uint32_t>((acc >> shift) &
+                                  ((bit_width == 32)
+                                       ? 0xFFFFFFFFull
+                                       : ((1ull << bit_width) - 1))));
+      }
+      pos += nbytes;
+    } else {
+      uint64_t run = header >> 1;
+      if (pos + byte_width > len) fail("rle run value past end");
+      uint32_t v = 0;
+      for (int k = 0; k < byte_width; ++k) {
+        v |= static_cast<uint32_t>(p[pos + k]) << (8 * k);
+      }
+      pos += byte_width;
+      uint64_t take = std::min<uint64_t>(run, count - out.size());
+      out.insert(out.end(), take, v);
+    }
+  }
+}
+
+// ---- PLAIN decode ---------------------------------------------------------
+
+struct Dict {
+  // fixed-width entries packed at `width` bytes each, or byte-array blobs
+  std::vector<uint8_t> fixed;
+  std::vector<std::string> blobs;
+  int width = 0;
+  int64_t size = 0;
+};
+
+int fixed_width_of(int32_t physical, int32_t type_length) {
+  switch (static_cast<Physical>(physical)) {
+    case Physical::BOOLEAN: return 1;
+    case Physical::INT32:
+    case Physical::FLOAT: return 4;
+    case Physical::INT64:
+    case Physical::DOUBLE: return 8;
+    case Physical::FIXED_LEN_BYTE_ARRAY:
+      if (type_length <= 0) fail("FIXED_LEN_BYTE_ARRAY without type_length");
+      return type_length;
+    case Physical::INT96:
+      fail("INT96 timestamps are not supported (deprecated by the format)");
+    default: return 0;  // BYTE_ARRAY
+  }
+}
+
+// Decode `n` PLAIN values. For fixed-width targets appends n*width bytes to
+// `dst`; for BYTE_ARRAY appends blobs. Booleans are bit-packed LSB-first on
+// the wire and widen to one byte each.
+uint64_t decode_plain(uint8_t const* p, uint64_t len, int64_t n,
+                      int32_t physical, int width, std::vector<uint8_t>* dst,
+                      std::vector<std::string>* blobs) {
+  uint64_t pos = 0;
+  if (static_cast<Physical>(physical) == Physical::BOOLEAN) {
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t byte = pos + (i >> 3);
+      if (byte >= len) fail("boolean data past end of page");
+      dst->push_back((p[byte] >> (i & 7)) & 1);
+    }
+    return pos + ((n + 7) >> 3);
+  }
+  if (static_cast<Physical>(physical) == Physical::BYTE_ARRAY) {
+    for (int64_t i = 0; i < n; ++i) {
+      if (pos + 4 > len) fail("byte_array length past end of page");
+      uint32_t m = static_cast<uint32_t>(p[pos]) |
+                   (static_cast<uint32_t>(p[pos + 1]) << 8) |
+                   (static_cast<uint32_t>(p[pos + 2]) << 16) |
+                   (static_cast<uint32_t>(p[pos + 3]) << 24);
+      pos += 4;
+      if (pos + m > len) fail("byte_array value past end of page");
+      blobs->emplace_back(reinterpret_cast<char const*>(p + pos), m);
+      pos += m;
+    }
+    return pos;
+  }
+  uint64_t nbytes = static_cast<uint64_t>(n) * width;
+  if (pos + nbytes > len) fail("plain values past end of page");
+  dst->insert(dst->end(), p + pos, p + pos + nbytes);
+  return pos + nbytes;
+}
+
+// ---- column chunk decode --------------------------------------------------
+
+struct LeafInfo {
+  std::string name;
+  int32_t physical = 0;
+  int32_t converted = -1;
+  int32_t scale = 0;
+  int32_t precision = 0;
+  int32_t type_length = 0;
+  bool optional = false;
+};
+
+std::vector<LeafInfo> parse_leaves(Value const& fmd) {
+  auto const* schema = fmd.field(kFmdSchema);
+  if (schema == nullptr || schema->elems.empty()) fail("missing schema");
+  auto const& root = schema->elems[0];
+  int64_t n_children = field_i64_or(root, kSeNumChildren, 0);
+  if (static_cast<uint64_t>(n_children) != schema->elems.size() - 1) {
+    fail("nested schemas are not supported yet (flat columns only)");
+  }
+  std::vector<LeafInfo> leaves;
+  for (uint64_t i = 1; i < schema->elems.size(); ++i) {
+    auto const& se = schema->elems[i];
+    if (field_i64_or(se, kSeNumChildren, 0) != 0) {
+      fail("nested schemas are not supported yet (flat columns only)");
+    }
+    LeafInfo li;
+    auto const* nm = se.field(kSeName);
+    li.name = nm ? nm->as_binary() : "";
+    li.physical = static_cast<int32_t>(field_i64(se, kSeType, "schema type"));
+    li.converted = static_cast<int32_t>(field_i64_or(se, kSeConverted, -1));
+    li.scale = static_cast<int32_t>(field_i64_or(se, kSeScale, 0));
+    li.precision = static_cast<int32_t>(field_i64_or(se, kSePrecision, 0));
+    li.type_length = static_cast<int32_t>(field_i64_or(se, kSeTypeLength, 0));
+    // repetition: 0 REQUIRED, 1 OPTIONAL, 2 REPEATED
+    int64_t rep = field_i64_or(se, kSeRepetition, 0);
+    if (rep == 2) fail("REPEATED fields are not supported yet");
+    li.optional = rep == 1;
+    leaves.push_back(std::move(li));
+  }
+  return leaves;
+}
+
+void append_values(ColumnData& col, LeafInfo const& leaf, int width,
+                   std::vector<uint8_t> const& vals,
+                   std::vector<std::string> const& blobs,
+                   std::vector<uint8_t> const& valid_bits, int64_t num_rows) {
+  bool const is_ba =
+      static_cast<Physical>(leaf.physical) == Physical::BYTE_ARRAY;
+  // validity bookkeeping: materialize the byte mask lazily on first null
+  bool has_nulls = false;
+  for (int64_t i = 0; i < num_rows; ++i) {
+    if (!valid_bits[i]) { has_nulls = true; break; }
+  }
+  if (has_nulls || leaf.optional || !col.validity.empty()) {
+    // backfill all-valid prefix for rows appended before the mask existed
+    if (col.validity.size() < static_cast<size_t>(col.num_rows)) {
+      col.validity.resize(col.num_rows, 1);
+    }
+    col.validity.insert(col.validity.end(), valid_bits.begin(),
+                        valid_bits.end());
+  }
+  if (is_ba) {
+    if (col.offsets.empty()) col.offsets.push_back(0);
+    int64_t next = 0;
+    for (int64_t i = 0; i < num_rows; ++i) {
+      int32_t last = col.offsets.back();
+      if (valid_bits[i]) {
+        auto const& b = blobs[next++];
+        if (static_cast<uint64_t>(last) + b.size() > INT32_MAX) {
+          fail("string column exceeds 2^31 chars (reference-parity limit)");
+        }
+        col.chars.insert(col.chars.end(), b.begin(), b.end());
+        col.offsets.push_back(last + static_cast<int32_t>(b.size()));
+      } else {
+        col.offsets.push_back(last);
+      }
+    }
+  } else {
+    int64_t next = 0;
+    for (int64_t i = 0; i < num_rows; ++i) {
+      if (valid_bits[i]) {
+        col.data.insert(col.data.end(), vals.begin() + next * width,
+                        vals.begin() + (next + 1) * width);
+        ++next;
+      } else {
+        col.data.insert(col.data.end(), width, 0);
+      }
+    }
+  }
+  col.num_rows += num_rows;
+}
+
+void decode_chunk(uint8_t const* file, uint64_t file_len, Value const& chunk,
+                  LeafInfo const& leaf, ColumnData& col) {
+  auto const* md = chunk.field(kCcMeta);
+  if (md == nullptr) fail("column chunk without metadata");
+  int32_t codec = static_cast<int32_t>(field_i64(*md, kCmCodec, "codec"));
+  int64_t num_values = field_i64(*md, kCmNumValues, "num_values");
+  int64_t data_off = field_i64(*md, kCmDataPageOffset, "data_page_offset");
+  int64_t dict_off = field_i64_or(*md, kCmDictPageOffset, 0);
+  int64_t pos = data_off;
+  if (dict_off > 0 && dict_off < data_off) pos = dict_off;
+  if (pos < 0 || static_cast<uint64_t>(pos) >= file_len) {
+    fail("column chunk offset out of file bounds");
+  }
+  int const width = fixed_width_of(leaf.physical, leaf.type_length);
+  Dict dict;
+  bool have_dict = false;
+
+  int64_t values_seen = 0;
+  while (values_seen < num_values) {
+    uint64_t consumed = 0;
+    Value ph = thrift::parse_struct(file + pos, file_len - pos, &consumed);
+    int32_t ptype = static_cast<int32_t>(field_i64(ph, kPhType, "page type"));
+    int64_t comp_size = field_i64(ph, kPhCompressedSize, "compressed size");
+    int64_t uncomp_size =
+        field_i64(ph, kPhUncompressedSize, "uncompressed size");
+    // Sign checks before any unsigned arithmetic: a crafted negative size
+    // would wrap the bounds check below and also stall the page cursor
+    // (pos would stop advancing on skipped page types).
+    if (comp_size < 0 || uncomp_size < 0) fail("negative page size");
+    uint64_t body = pos + consumed;
+    if (body + static_cast<uint64_t>(comp_size) > file_len) {
+      fail("page body past end of file");
+    }
+
+    if (ptype == kPageDict) {
+      auto const* dh = ph.field(kPhDictHeader);
+      if (dh == nullptr) fail("dictionary page without header");
+      int64_t n = field_i64(*dh, kDphNumValues, "dict num_values");
+      auto bytes = do_decompress(codec, file + body, comp_size, uncomp_size);
+      dict.width = width;
+      dict.size = n;
+      uint64_t used = decode_plain(bytes.data(), bytes.size(), n,
+                                   leaf.physical, width, &dict.fixed,
+                                   &dict.blobs);
+      (void)used;
+      have_dict = true;
+    } else if (ptype == kPageData || ptype == kPageDataV2) {
+      int64_t page_values;
+      int32_t enc;
+      std::vector<uint32_t> defs;
+      std::vector<uint8_t> bytes;   // decoded values section
+      uint64_t vpos = 0;            // cursor into `bytes`
+
+      if (ptype == kPageData) {
+        auto const* dh = ph.field(kPhDataHeader);
+        if (dh == nullptr) fail("data page without header");
+        page_values = field_i64(*dh, kDphNumValues, "num_values");
+        enc = static_cast<int32_t>(field_i64(*dh, kDphEncoding, "encoding"));
+        bytes = do_decompress(codec, file + body, comp_size, uncomp_size);
+        if (leaf.optional) {
+          int32_t denc = static_cast<int32_t>(
+              field_i64_or(*dh, kDphDefLevelEncoding, kEncRle));
+          if (denc != kEncRle) fail("definition levels must be RLE-encoded");
+          if (bytes.size() < 4) fail("missing def-level length");
+          uint32_t dl = static_cast<uint32_t>(bytes[0]) |
+                        (static_cast<uint32_t>(bytes[1]) << 8) |
+                        (static_cast<uint32_t>(bytes[2]) << 16) |
+                        (static_cast<uint32_t>(bytes[3]) << 24);
+          if (4ull + dl > bytes.size()) fail("def levels past end of page");
+          decode_rle_hybrid(bytes.data() + 4, dl, 1, page_values, defs);
+          vpos = 4ull + dl;
+        }
+      } else {
+        auto const* dh = ph.field(kPhDataHeaderV2);
+        if (dh == nullptr) fail("data page v2 without header");
+        page_values = field_i64(*dh, kDph2NumValues, "num_values");
+        enc = static_cast<int32_t>(field_i64(*dh, kDph2Encoding, "encoding"));
+        int64_t rep_len = field_i64_or(*dh, kDph2RepLevelsByteLen, 0);
+        int64_t def_len = field_i64_or(*dh, kDph2DefLevelsByteLen, 0);
+        if (rep_len != 0) fail("repetition levels unsupported (flat only)");
+        // is_compressed is a thrift BOOL (carried in Value::b, not ::i)
+        auto const* ic = dh->field(kDph2IsCompressed);
+        bool compressed =
+            ic == nullptr || ic->b ||
+            ic->type == thrift::WireType::BOOL_TRUE;
+        // v2: levels are NEVER compressed and sit before the data section
+        if (def_len > comp_size) fail("v2 def levels longer than page");
+        if (leaf.optional && def_len > 0) {
+          decode_rle_hybrid(file + body, def_len, 1, page_values, defs);
+        }
+        uint64_t data_comp = comp_size - def_len;
+        uint64_t data_uncomp = uncomp_size - def_len;
+        if (compressed) {
+          bytes = do_decompress(codec, file + body + def_len, data_comp,
+                                data_uncomp);
+        } else {
+          bytes.assign(file + body + def_len,
+                       file + body + def_len + data_comp);
+        }
+      }
+
+      // validity for this page (flat: def level 1 = present)
+      std::vector<uint8_t> valid(page_values, 1);
+      int64_t n_present = page_values;
+      if (leaf.optional && !defs.empty()) {
+        n_present = 0;
+        for (int64_t i = 0; i < page_values; ++i) {
+          valid[i] = defs[i] != 0;
+          n_present += valid[i];
+        }
+      }
+
+      std::vector<uint8_t> vals;
+      std::vector<std::string> blobs;
+      if (enc == kEncPlain) {
+        decode_plain(bytes.data() + vpos, bytes.size() - vpos, n_present,
+                     leaf.physical, width, &vals, &blobs);
+      } else if (enc == kEncPlainDict || enc == kEncRleDict) {
+        if (!have_dict) fail("dictionary-encoded page before dictionary");
+        if (bytes.size() - vpos < 1) fail("missing dict index bit width");
+        int bw = bytes[vpos];
+        std::vector<uint32_t> idx;
+        decode_rle_hybrid(bytes.data() + vpos + 1, bytes.size() - vpos - 1,
+                          bw, n_present, idx);
+        bool const is_ba =
+            static_cast<Physical>(leaf.physical) == Physical::BYTE_ARRAY;
+        for (uint32_t id : idx) {
+          if (static_cast<int64_t>(id) >= dict.size) {
+            fail("dictionary index out of range");
+          }
+          if (is_ba) {
+            blobs.push_back(dict.blobs[id]);
+          } else {
+            vals.insert(vals.end(), dict.fixed.begin() + id * width,
+                        dict.fixed.begin() + (id + 1) * width);
+          }
+        }
+      } else {
+        fail("unsupported data encoding " + std::to_string(enc) +
+             " (supported: PLAIN, PLAIN_DICTIONARY, RLE_DICTIONARY)");
+      }
+      append_values(col, leaf, width, vals, blobs, valid, page_values);
+      values_seen += page_values;
+    } else {
+      // index pages etc.: skip
+    }
+    pos = body + comp_size;
+  }
+}
+
+Value parse_footer(uint8_t const* file, uint64_t len) {
+  if (len < 12 || std::memcmp(file, "PAR1", 4) != 0 ||
+      std::memcmp(file + len - 4, "PAR1", 4) != 0) {
+    fail("not a Parquet file (missing PAR1 framing)");
+  }
+  uint32_t flen = static_cast<uint32_t>(file[len - 8]) |
+                  (static_cast<uint32_t>(file[len - 7]) << 8) |
+                  (static_cast<uint32_t>(file[len - 6]) << 16) |
+                  (static_cast<uint32_t>(file[len - 5]) << 24);
+  if (8ull + flen > len) fail("footer length larger than file");
+  return thrift::parse_struct(file + len - 8 - flen, flen);
+}
+
+}  // namespace
+
+std::vector<uint8_t> snappy_uncompress(uint8_t const* in, uint64_t n,
+                                       uint64_t expected_out) {
+  uint64_t pos = 0;
+  uint64_t out_len = read_varint(in, n, &pos);
+  if (out_len != expected_out) {
+    fail("snappy stream length != declared page size");
+  }
+  std::vector<uint8_t> out;
+  out.reserve(out_len);
+  while (pos < n) {
+    uint8_t tag = in[pos++];
+    uint32_t kind = tag & 3;
+    if (kind == 0) {  // literal
+      uint64_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        uint32_t extra = static_cast<uint32_t>(len - 60);
+        if (pos + extra > n) fail("snappy literal header past end");
+        uint64_t l = 0;
+        for (uint32_t k = 0; k < extra; ++k) {
+          l |= static_cast<uint64_t>(in[pos + k]) << (8 * k);
+        }
+        pos += extra;
+        len = l + 1;
+      }
+      if (pos + len > n) fail("snappy literal past end");
+      out.insert(out.end(), in + pos, in + pos + len);
+      pos += len;
+    } else {
+      uint64_t len, offset;
+      if (kind == 1) {
+        if (pos >= n) fail("snappy copy1 past end");
+        len = ((tag >> 2) & 7) + 4;
+        offset = (static_cast<uint64_t>(tag >> 5) << 8) | in[pos++];
+      } else if (kind == 2) {
+        if (pos + 2 > n) fail("snappy copy2 past end");
+        len = (tag >> 2) + 1;
+        offset = static_cast<uint64_t>(in[pos]) |
+                 (static_cast<uint64_t>(in[pos + 1]) << 8);
+        pos += 2;
+      } else {
+        if (pos + 4 > n) fail("snappy copy4 past end");
+        len = (tag >> 2) + 1;
+        offset = static_cast<uint64_t>(in[pos]) |
+                 (static_cast<uint64_t>(in[pos + 1]) << 8) |
+                 (static_cast<uint64_t>(in[pos + 2]) << 16) |
+                 (static_cast<uint64_t>(in[pos + 3]) << 24);
+        pos += 4;
+      }
+      if (offset == 0 || offset > out.size()) fail("snappy copy bad offset");
+      // overlapping copies are byte-by-byte by spec
+      uint64_t src = out.size() - offset;
+      for (uint64_t k = 0; k < len; ++k) out.push_back(out[src + k]);
+    }
+  }
+  if (out.size() != out_len) fail("snappy output size mismatch");
+  return out;
+}
+
+std::vector<RowGroupInfo> row_group_infos(uint8_t const* file, uint64_t len) {
+  Value fmd = parse_footer(file, len);
+  std::vector<RowGroupInfo> out;
+  auto const* rgs = fmd.field(kFmdRowGroups);
+  if (rgs == nullptr) return out;
+  for (auto const& rg : rgs->elems) {
+    RowGroupInfo info;
+    info.num_rows = field_i64_or(rg, kRgNumRows, 0);
+    info.total_byte_size = field_i64_or(rg, kRgTotalCompressed,
+                                        field_i64_or(rg, kRgTotalByteSize, 0));
+    out.push_back(info);
+  }
+  return out;
+}
+
+std::vector<std::string> column_names(uint8_t const* file, uint64_t len) {
+  Value fmd = parse_footer(file, len);
+  std::vector<std::string> out;
+  for (auto const& leaf : parse_leaves(fmd)) out.push_back(leaf.name);
+  return out;
+}
+
+ReadResult read_file(uint8_t const* file, uint64_t len,
+                     std::optional<std::vector<int32_t>> const& column_indices,
+                     std::optional<std::vector<int32_t>> const& row_group_indices) {
+  Value fmd = parse_footer(file, len);
+  auto leaves = parse_leaves(fmd);
+  auto const* rgs = fmd.field(kFmdRowGroups);
+  uint64_t n_rgs = rgs == nullptr ? 0 : rgs->elems.size();
+
+  std::vector<int32_t> cols;
+  if (column_indices.has_value()) {
+    cols = *column_indices;
+  } else {
+    for (uint64_t i = 0; i < leaves.size(); ++i) {
+      cols.push_back(static_cast<int32_t>(i));
+    }
+  }
+  std::vector<int32_t> groups;
+  if (row_group_indices.has_value()) {
+    groups = *row_group_indices;
+  } else {
+    for (uint64_t i = 0; i < n_rgs; ++i) {
+      groups.push_back(static_cast<int32_t>(i));
+    }
+  }
+
+  ReadResult res;
+  for (int32_t c : cols) {
+    if (c < 0 || static_cast<uint64_t>(c) >= leaves.size()) {
+      fail("column index out of range");
+    }
+    ColumnData col;
+    auto const& leaf = leaves[c];
+    col.name = leaf.name;
+    col.physical = leaf.physical;
+    col.converted = leaf.converted;
+    col.scale = leaf.scale;
+    col.precision = leaf.precision;
+    col.type_length = leaf.type_length;
+    col.optional = leaf.optional;
+    res.columns.push_back(std::move(col));
+  }
+
+  for (int32_t g : groups) {
+    if (g < 0 || static_cast<uint64_t>(g) >= n_rgs) {
+      fail("row group index out of range");
+    }
+    auto const& rg = rgs->elems[g];
+    auto const* chunks = rg.field(kRgColumns);
+    if (chunks == nullptr || chunks->elems.size() != leaves.size()) {
+      fail("row group chunk count != schema leaf count");
+    }
+    int64_t rg_rows = field_i64_or(rg, kRgNumRows, -1);
+    for (uint64_t k = 0; k < cols.size(); ++k) {
+      auto& col = res.columns[k];
+      int64_t before = col.num_rows;
+      decode_chunk(file, len, chunks->elems[cols[k]], leaves[cols[k]], col);
+      if (rg_rows >= 0 && col.num_rows - before != rg_rows) {
+        fail("column " + col.name + " decoded " +
+             std::to_string(col.num_rows - before) + " rows, row group has " +
+             std::to_string(rg_rows));
+      }
+    }
+    res.num_rows += rg_rows >= 0 ? rg_rows : 0;
+  }
+
+  // Columns with no nulls anywhere may still carry an all-ones validity if
+  // any page allocated one; normalize "all valid" to empty.
+  for (auto& col : res.columns) {
+    bool all = true;
+    for (uint8_t v : col.validity) {
+      if (!v) { all = false; break; }
+    }
+    if (all) col.validity.clear();
+    if (static_cast<Physical>(col.physical) == Physical::BYTE_ARRAY &&
+        col.offsets.empty()) {
+      col.offsets.push_back(0);
+    }
+  }
+  return res;
+}
+
+}  // namespace parquet
+}  // namespace tpudf

@@ -325,6 +325,14 @@ Value parse_struct(uint8_t const* buf, uint64_t len, Limits const& limits) {
   return r.read_struct();
 }
 
+Value parse_struct(uint8_t const* buf, uint64_t len, uint64_t* consumed,
+                   Limits const& limits) {
+  Reader r(buf, len, limits);
+  Value v = r.read_struct();
+  *consumed = r.pos();
+  return v;
+}
+
 std::string serialize_struct(Value const& v) {
   Writer w;
   w.write_struct(v);

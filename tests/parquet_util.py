@@ -1,0 +1,312 @@
+"""Pure-Python Parquet file writer — the test oracle for the native data
+reader. Independent implementation of the write side of the format (PLAIN +
+dictionary encodings, RLE def levels, v1/v2 data pages, UNCOMPRESSED /
+SNAPPY / GZIP codecs) so the C++ decoder can't self-validate against a
+shared misreading of the spec. Flat schemas only, matching the reader's
+supported subset.
+
+Columns are described as ColumnSpec(name, physical, values, ...) where
+values is a list with None marking nulls.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tests import thrift_util as tu
+
+# parquet.thrift enums
+BOOLEAN, INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY, FLBA = 0, 1, 2, 4, 5, 6, 7
+UNCOMPRESSED, SNAPPY, GZIP = 0, 1, 2
+PLAIN, PLAIN_DICT, RLE, RLE_DICT = 0, 2, 3, 8
+PAGE_DATA, PAGE_DICT, PAGE_DATA_V2 = 0, 2, 3
+
+# PageHeader field ids
+PH_TYPE, PH_UNCOMP, PH_COMP, PH_DATA, PH_DICT, PH_DATA_V2 = 1, 2, 3, 5, 7, 8
+DPH_NUM_VALUES, DPH_ENCODING, DPH_DEF_ENC, DPH_REP_ENC = 1, 2, 3, 4
+DICT_NUM_VALUES, DICT_ENCODING = 1, 2
+D2_NUM_VALUES, D2_NUM_NULLS, D2_NUM_ROWS, D2_ENCODING = 1, 2, 3, 4
+D2_DEF_LEN, D2_REP_LEN, D2_IS_COMPRESSED = 5, 6, 7
+
+
+def snappy_compress(raw: bytes) -> bytes:
+    """Valid snappy stream using literal elements only."""
+    out = bytearray()
+    u = len(raw)
+    while u >= 0x80:
+        out.append((u & 0x7F) | 0x80)
+        u >>= 7
+    out.append(u)
+    pos = 0
+    while pos < len(raw):
+        n = min(len(raw) - pos, 65536)
+        if n <= 60:
+            out.append((n - 1) << 2)
+        else:
+            out.append(61 << 2)  # literal with 2-byte little-endian length
+            out += struct.pack("<H", n - 1)
+        out += raw[pos : pos + n]
+        pos += n
+    return bytes(out)
+
+
+def _compress(raw: bytes, codec: int) -> bytes:
+    if codec == UNCOMPRESSED:
+        return raw
+    if codec == SNAPPY:
+        return snappy_compress(raw)
+    if codec == GZIP:
+        return zlib.compress(raw, 6)  # zlib framing; reader auto-detects
+    raise ValueError(f"codec {codec}")
+
+
+def rle_encode_bits(bits: list[int], bit_width: int = 1) -> bytes:
+    """RLE/bit-packed hybrid, RLE runs only (valid for any input)."""
+    out = bytearray()
+    byte_width = (bit_width + 7) // 8
+    i = 0
+    while i < len(bits):
+        j = i
+        while j < len(bits) and bits[j] == bits[i]:
+            j += 1
+        run = j - i
+        header = run << 1
+        while header >= 0x80:
+            out.append((header & 0x7F) | 0x80)
+            header >>= 7
+        out.append(header)
+        out += int(bits[i]).to_bytes(byte_width, "little")
+        i = j
+    return bytes(out)
+
+
+def bitpack_encode(vals: list[int], bit_width: int) -> bytes:
+    """RLE/bit-packed hybrid, one bit-packed run (padded to 8 values)."""
+    n = len(vals)
+    groups = (n + 7) // 8
+    header = (groups << 1) | 1
+    out = bytearray()
+    h = header
+    while h >= 0x80:
+        out.append((h & 0x7F) | 0x80)
+        h >>= 7
+    out.append(h)
+    padded = vals + [0] * (groups * 8 - n)
+    acc = 0
+    nbits = 0
+    for v in padded:
+        acc |= (v & ((1 << bit_width) - 1)) << nbits
+        nbits += bit_width
+        while nbits >= 8:
+            out.append(acc & 0xFF)
+            acc >>= 8
+            nbits -= 8
+    if nbits:
+        out.append(acc & 0xFF)
+    return bytes(out)
+
+
+def plain_encode(physical: int, values: list, type_length: int = 0) -> bytes:
+    out = bytearray()
+    if physical == BOOLEAN:
+        acc = 0
+        for i, v in enumerate(values):
+            if v:
+                acc |= 1 << (i & 7)
+            if (i & 7) == 7:
+                out.append(acc)
+                acc = 0
+        if len(values) & 7:
+            out.append(acc)
+        return bytes(out)
+    fmt = {INT32: "<i", INT64: "<q", FLOAT: "<f", DOUBLE: "<d"}.get(physical)
+    if fmt:
+        for v in values:
+            out += struct.pack(fmt, v)
+        return bytes(out)
+    if physical == BYTE_ARRAY:
+        for v in values:
+            b = v.encode() if isinstance(v, str) else bytes(v)
+            out += struct.pack("<I", len(b)) + b
+        return bytes(out)
+    if physical == FLBA:
+        for v in values:  # int -> big-endian two's complement
+            out += int(v).to_bytes(type_length, "big", signed=True)
+        return bytes(out)
+    raise ValueError(f"physical {physical}")
+
+
+@dataclass
+class ColumnSpec:
+    name: str
+    physical: int
+    values: list  # None = null
+    converted: Optional[int] = None
+    scale: int = 0
+    precision: int = 0
+    type_length: int = 0
+    optional: bool = True
+    use_dictionary: bool = False
+    extra_schema: dict = field(default_factory=dict)
+
+
+def _page_v1(spec: ColumnSpec, values: list, codec: int,
+             encoding: int, payload: bytes) -> bytes:
+    """Assemble one v1 data page: [def levels][payload], compressed whole."""
+    body = bytearray()
+    if spec.optional:
+        defs = rle_encode_bits([0 if v is None else 1 for v in values])
+        body += struct.pack("<I", len(defs)) + defs
+    body += payload
+    comp = _compress(bytes(body), codec)
+    header = tu.write_struct({
+        PH_TYPE: (tu.I32, PAGE_DATA),
+        PH_UNCOMP: (tu.I32, len(body)),
+        PH_COMP: (tu.I32, len(comp)),
+        PH_DATA: (tu.STRUCT, {
+            DPH_NUM_VALUES: (tu.I32, len(values)),
+            DPH_ENCODING: (tu.I32, encoding),
+            DPH_DEF_ENC: (tu.I32, RLE),
+            DPH_REP_ENC: (tu.I32, RLE),
+        }),
+    })
+    return header + comp
+
+
+def _page_v2(spec: ColumnSpec, values: list, codec: int,
+             encoding: int, payload: bytes) -> bytes:
+    """v2 page: levels uncompressed up front, data section compressed."""
+    defs = b""
+    num_nulls = sum(1 for v in values if v is None)
+    if spec.optional:
+        defs = rle_encode_bits([0 if v is None else 1 for v in values])
+    comp = _compress(payload, codec)
+    header = tu.write_struct({
+        PH_TYPE: (tu.I32, PAGE_DATA_V2),
+        PH_UNCOMP: (tu.I32, len(defs) + len(payload)),
+        PH_COMP: (tu.I32, len(defs) + len(comp)),
+        PH_DATA_V2: (tu.STRUCT, {
+            D2_NUM_VALUES: (tu.I32, len(values)),
+            D2_NUM_NULLS: (tu.I32, num_nulls),
+            D2_NUM_ROWS: (tu.I32, len(values)),
+            D2_ENCODING: (tu.I32, encoding),
+            D2_DEF_LEN: (tu.I32, len(defs)),
+            D2_REP_LEN: (tu.I32, 0),
+            D2_IS_COMPRESSED: (tu.BOOL_T, codec != UNCOMPRESSED),
+        }),
+    })
+    return header + defs + comp
+
+
+def write_parquet(
+    columns: list[ColumnSpec],
+    row_group_size: Optional[int] = None,
+    codec: int = UNCOMPRESSED,
+    page_rows: Optional[int] = None,
+    data_page_v2: bool = False,
+) -> bytes:
+    """Serialize a complete flat-schema Parquet file."""
+    num_rows = len(columns[0].values)
+    for c in columns:
+        assert len(c.values) == num_rows
+    rg_size = row_group_size or max(num_rows, 1)
+
+    blob = bytearray(b"PAR1")
+    row_groups = []
+    for rg_start in range(0, max(num_rows, 1), rg_size):
+        rg_vals = {
+            c.name: c.values[rg_start : rg_start + rg_size] for c in columns
+        }
+        n_rg_rows = len(rg_vals[columns[0].name])
+        chunks = []
+        rg_comp_total = 0
+        for c in columns:
+            values = rg_vals[c.name]
+            chunk_start = len(blob)
+            dict_off = None
+            encodings = [PLAIN, RLE]
+            present = [v for v in values if v is not None]
+            if c.use_dictionary:
+                # dictionary page first, then RLE_DICT-encoded data pages
+                uniq = list(dict.fromkeys(present))
+                dict_payload = plain_encode(c.physical, uniq, c.type_length)
+                comp = _compress(dict_payload, codec)
+                dh = tu.write_struct({
+                    PH_TYPE: (tu.I32, PAGE_DICT),
+                    PH_UNCOMP: (tu.I32, len(dict_payload)),
+                    PH_COMP: (tu.I32, len(comp)),
+                    PH_DICT: (tu.STRUCT, {
+                        DICT_NUM_VALUES: (tu.I32, len(uniq)),
+                        DICT_ENCODING: (tu.I32, PLAIN),
+                    }),
+                })
+                dict_off = len(blob)
+                blob += dh + comp
+                encodings = [RLE_DICT, RLE]
+            data_off = len(blob)
+            pr = page_rows or max(n_rg_rows, 1)
+            for p_start in range(0, max(n_rg_rows, 1), pr):
+                pvals = values[p_start : p_start + pr]
+                ppresent = [v for v in pvals if v is not None]
+                if c.use_dictionary:
+                    uniq_index = {v: i for i, v in enumerate(uniq)}
+                    bw = max(1, (len(uniq) - 1).bit_length())
+                    idx = [uniq_index[v] for v in ppresent]
+                    payload = bytes([bw]) + bitpack_encode(idx, bw)
+                    enc = RLE_DICT
+                else:
+                    payload = plain_encode(c.physical, ppresent, c.type_length)
+                    enc = PLAIN
+                page = (_page_v2 if data_page_v2 else _page_v1)(
+                    c, pvals, codec, enc, payload
+                )
+                blob += page
+            chunk_bytes = len(blob) - chunk_start
+            rg_comp_total += chunk_bytes
+            md = {
+                tu.CM_TYPE: (tu.I32, c.physical),
+                tu.CM_ENCODINGS: (tu.LIST, (tu.I32, encodings)),
+                tu.CM_PATH: (tu.LIST, (tu.BINARY, [c.name])),
+                tu.CM_CODEC: (tu.I32, codec),
+                tu.CM_NUM_VALUES: (tu.I64, n_rg_rows),
+                tu.CM_TOTAL_UNCOMP: (tu.I64, chunk_bytes),
+                tu.CM_TOTAL_COMP: (tu.I64, chunk_bytes),
+                tu.CM_DATA_PAGE_OFF: (tu.I64, data_off),
+            }
+            if dict_off is not None:
+                md[tu.CM_DICT_PAGE_OFF] = (tu.I64, dict_off)
+            chunks.append({
+                tu.CC_FILE_OFFSET: (tu.I64, chunk_start),
+                tu.CC_META: (tu.STRUCT, md),
+            })
+        row_groups.append({
+            tu.RG_COLUMNS: (tu.LIST, (tu.STRUCT, chunks)),
+            tu.RG_TOTAL_BYTE_SIZE: (tu.I64, rg_comp_total),
+            tu.RG_NUM_ROWS: (tu.I64, n_rg_rows),
+            tu.RG_TOTAL_COMPRESSED: (tu.I64, rg_comp_total),
+        })
+        if num_rows == 0:
+            break
+
+    schema = [tu.schema_element("root", num_children=len(columns))]
+    for c in columns:
+        extra = dict(c.extra_schema)
+        if c.converted is not None:
+            extra[tu.SE_CONVERTED] = (tu.I32, c.converted)
+        if c.converted == 5:  # DECIMAL
+            extra[tu.SE_SCALE] = (tu.I32, c.scale)
+            extra[tu.SE_PRECISION] = (tu.I32, c.precision)
+        se = {tu.SE_NAME: (tu.BINARY, c.name), tu.SE_TYPE: (tu.I32, c.physical),
+              tu.SE_REP: (tu.I32, 1 if c.optional else 0)}
+        if c.physical == FLBA:
+            se[tu.SE_TYPE_LEN] = (tu.I32, c.type_length)
+        se.update(extra)
+        schema.append(se)
+
+    footer = tu.file_metadata(schema, row_groups, num_rows=num_rows)
+    blob += footer
+    blob += struct.pack("<I", len(footer)) + b"PAR1"
+    return bytes(blob)
