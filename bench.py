@@ -36,31 +36,93 @@ def _prior_baseline(metric: str):
     return None if best is None else best[1]
 
 
-def main() -> None:
+def _bench_tpch_q1(n: int, iters: int):
     import jax
 
     from spark_rapids_jni_tpu.models.tpch import lineitem_table, tpch_q1
 
-    n = int(os.environ.get("BENCH_ROWS", 1 << 22))
-    iters = int(os.environ.get("BENCH_ITERS", 5))
     lineitem = lineitem_table(n)
     fn = jax.jit(tpch_q1)
     jax.block_until_ready(fn(lineitem))  # compile + warm cache
-
     t0 = time.perf_counter()
     for _ in range(iters):
         jax.block_until_ready(fn(lineitem))
     per_iter = (time.perf_counter() - t0) / iters
+    return "tpch_q1_rows_per_s", n / per_iter, "rows/s"
 
-    metric = "tpch_q1_rows_per_s"
-    value = n / per_iter
+
+def _bench_tpcds_q72(n: int, iters: int):
+    import jax
+
+    from spark_rapids_jni_tpu.models import tpcds
+
+    cs = tpcds.catalog_sales_table(n, num_items=1000)
+    dd = tpcds.date_dim_table()
+    it = tpcds.item_table(1000)
+    inv = tpcds.inventory_table(num_items=1000)
+    fn = jax.jit(lambda a, b, c, d: tpcds.tpcds_q72(a, b, c, d).table)
+    jax.block_until_ready(fn(cs, dd, it, inv))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fn(cs, dd, it, inv))
+    per_iter = (time.perf_counter() - t0) / iters
+    return "tpcds_q72_rows_per_s", n / per_iter, "rows/s"
+
+
+def _bench_row_conversion(n: int, iters: int):
+    import jax
+
+    from spark_rapids_jni_tpu.models.tpch import lineitem_table
+    from spark_rapids_jni_tpu.ops.row_conversion import (
+        compute_fixed_width_layout,
+        convert_from_rows,
+        convert_to_rows,
+    )
+
+    lineitem = lineitem_table(n)
+    schema = lineitem.schema()
+
+    def roundtrip(tbl):
+        # convert_to_rows/from_rows jit their cores internally and handle the
+        # 2GB batching on host, like the reference's batch loop
+        out = [convert_from_rows(rc, schema) for rc in convert_to_rows(tbl)]
+        return [c.data for t_ in out for c in t_.columns]
+
+    jax.block_until_ready(roundtrip(lineitem))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(roundtrip(lineitem))
+    per_iter = (time.perf_counter() - t0) / iters
+    # bytes moved: the actual packed row image (incl. alignment padding,
+    # validity bytes, 8-byte row pad) both directions
+    _, _, row_bytes = compute_fixed_width_layout(tuple(schema))
+    gbps = 2 * n * row_bytes / per_iter / 1e9
+    return "row_conversion_gb_per_s", gbps, "GB/s"
+
+
+_CONFIGS = {
+    "tpch_q1": _bench_tpch_q1,
+    "tpcds_q72": _bench_tpcds_q72,
+    "row_conversion": _bench_row_conversion,
+}
+
+
+def main() -> None:
+    config = os.environ.get("BENCH_CONFIG", "tpch_q1")
+    if config not in _CONFIGS:
+        raise SystemExit(
+            f"unknown BENCH_CONFIG {config!r}; valid: {sorted(_CONFIGS)}"
+        )
+    n = int(os.environ.get("BENCH_ROWS", 1 << 22))
+    iters = int(os.environ.get("BENCH_ITERS", 5))
+    metric, value, unit = _CONFIGS[config](n, iters)
     base = _prior_baseline(metric)
     print(
         json.dumps(
             {
                 "metric": metric,
                 "value": value,
-                "unit": "rows/s",
+                "unit": unit,
                 "vs_baseline": value / base if base else 1.0,
             }
         )

@@ -1,0 +1,331 @@
+"""TPC-DS workload pipelines — the join-heavy model family (BASELINE.json
+config #4: "hash-join + Parquet chunked reader (TPC-DS q64/q72)").
+
+These are structurally faithful, predicate-trimmed versions of the two
+headline queries: the join graphs and aggregation shapes match the spec
+queries, while the long tails of scalar predicates (promo windows,
+demographics buckets, address joins) are trimmed so the pipelines stay
+readable. What each exercises:
+
+  q72-style: fact x dimension chain — catalog_sales |x| date_dim (year
+  filter) |x| item |x| inventory on a composite (item, week) key with an
+  inequality post-filter (inv_quantity_on_hand < cs_quantity), then
+  group-count per item. The composite key is packed exactly
+  (item_sk * WEEKS + week) rather than hashed, so no collision handling.
+
+  q64-style: self-join — store_sales(year1) |x| store_sales(year2) on a
+  composite (item, customer) key (customers who bought the same item in
+  two consecutive years), then group-count per item.
+
+All joins use the masking idiom for filters: a WHERE clause before a join
+nulls the join key (null keys never match, ops/join.py); a WHERE after a
+join nulls validity so the row falls out of the aggregate. Shapes stay
+static throughout — the XLA discipline.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import GroupByResult, groupby_aggregate
+from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+from spark_rapids_jni_tpu.ops.sort import sort_table
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+# Composite-key packing bounds (data generators respect these).
+MAX_WEEKS = 512
+MAX_CUSTOMERS = 1 << 20
+
+
+# ---- synthetic data (TPC-DS-flavored distributions) ------------------------
+
+
+def date_dim_table(num_days: int = 730, start_year: int = 2000) -> Table:
+    """d_date_sk, d_week_seq, d_year."""
+    sk = np.arange(1, num_days + 1, dtype=np.int64)
+    week = ((sk - 1) // 7 + 1).astype(np.int64)
+    year = (start_year + (sk - 1) // 365).astype(np.int32)
+    return Table(
+        [
+            Column.from_numpy(sk, t.INT64),
+            Column.from_numpy(week, t.INT64),
+            Column.from_numpy(year, t.INT32),
+        ]
+    )
+
+
+D_DATE_SK, D_WEEK_SEQ, D_YEAR = 0, 1, 2
+
+
+def item_table(num_items: int = 1000, seed: int = 0) -> Table:
+    """i_item_sk, i_brand_id, i_category_id."""
+    rng = np.random.default_rng(seed)
+    sk = np.arange(1, num_items + 1, dtype=np.int64)
+    brand = rng.integers(1, 100, num_items).astype(np.int32)
+    cat = rng.integers(1, 11, num_items).astype(np.int32)
+    return Table(
+        [
+            Column.from_numpy(sk, t.INT64),
+            Column.from_numpy(brand, t.INT32),
+            Column.from_numpy(cat, t.INT32),
+        ]
+    )
+
+
+I_ITEM_SK, I_BRAND_ID, I_CATEGORY_ID = 0, 1, 2
+
+
+def catalog_sales_table(
+    num_rows: int, num_items: int = 1000, num_days: int = 730, seed: int = 1
+) -> Table:
+    """cs_item_sk, cs_sold_date_sk, cs_quantity, cs_order_number."""
+    rng = np.random.default_rng(seed)
+    item = rng.integers(1, num_items + 1, num_rows).astype(np.int64)
+    date = rng.integers(1, num_days + 1, num_rows).astype(np.int64)
+    qty = rng.integers(1, 100, num_rows).astype(np.int64)
+    order = np.arange(num_rows, dtype=np.int64)
+    return Table(
+        [
+            Column.from_numpy(item, t.INT64),
+            Column.from_numpy(date, t.INT64),
+            Column.from_numpy(qty, t.INT64),
+            Column.from_numpy(order, t.INT64),
+        ]
+    )
+
+
+CS_ITEM_SK, CS_SOLD_DATE_SK, CS_QUANTITY, CS_ORDER_NUMBER = 0, 1, 2, 3
+
+
+def inventory_table(
+    num_items: int = 1000, num_weeks: int = 105, seed: int = 2
+) -> Table:
+    """inv_item_sk, inv_week_seq, inv_quantity_on_hand — one row per
+    (item, week), the TPC-DS inventory grain at one warehouse."""
+    rng = np.random.default_rng(seed)
+    item = np.repeat(np.arange(1, num_items + 1, dtype=np.int64), num_weeks)
+    week = np.tile(np.arange(1, num_weeks + 1, dtype=np.int64), num_items)
+    qty = rng.integers(0, 120, num_items * num_weeks).astype(np.int64)
+    return Table(
+        [
+            Column.from_numpy(item, t.INT64),
+            Column.from_numpy(week, t.INT64),
+            Column.from_numpy(qty, t.INT64),
+        ]
+    )
+
+
+INV_ITEM_SK, INV_WEEK_SEQ, INV_QTY = 0, 1, 2
+
+
+def store_sales_table(
+    num_rows: int,
+    num_items: int = 1000,
+    num_customers: int = 5000,
+    num_days: int = 730,
+    seed: int = 3,
+) -> Table:
+    """ss_item_sk, ss_customer_sk, ss_sold_date_sk."""
+    rng = np.random.default_rng(seed)
+    item = rng.integers(1, num_items + 1, num_rows).astype(np.int64)
+    cust = rng.integers(1, num_customers + 1, num_rows).astype(np.int64)
+    date = rng.integers(1, num_days + 1, num_rows).astype(np.int64)
+    return Table(
+        [
+            Column.from_numpy(item, t.INT64),
+            Column.from_numpy(cust, t.INT64),
+            Column.from_numpy(date, t.INT64),
+        ]
+    )
+
+
+SS_ITEM_SK, SS_CUSTOMER_SK, SS_SOLD_DATE_SK = 0, 1, 2
+
+
+def _pack_key(a: Column, b: Column, b_bound: int) -> Column:
+    """Exact composite int64 key a*b_bound + b; null if either side null."""
+    data = a.data * jnp.int64(b_bound) + b.data
+    return Column(t.INT64, data, a.valid_mask() & b.valid_mask())
+
+
+def _null_keys_where(col: Column, drop: jnp.ndarray) -> Column:
+    """WHERE-before-join: null out the join key where `drop` (null keys
+    never match)."""
+    return Column(col.dtype, col.data, col.valid_mask() & ~drop)
+
+
+# ---- q72-style -------------------------------------------------------------
+
+
+@func_range("tpcds_q72")
+def tpcds_q72(
+    catalog_sales: Table,
+    date_dim: Table,
+    item: Table,
+    inventory: Table,
+    year: int = 2000,
+    out_factor: int = 2,
+) -> GroupByResult:
+    """Count, per item, catalog sales in `year` where on-hand inventory in
+    the sale's week was below the ordered quantity (the q72 core: does the
+    warehouse run short). Returns groups (i_item_sk, i_brand_id, count)
+    padded; callers compact() on host."""
+    n_cs = catalog_sales.num_rows
+
+    # catalog_sales |x| date_dim, with WHERE d_year = year pushed into the
+    # build side's key (wrong-year dates get null keys and never match).
+    dd_key = _null_keys_where(
+        date_dim.column(D_DATE_SK),
+        jnp.asarray(np.int32(year)) != date_dim.column(D_YEAR).data,
+    )
+    dd = Table([dd_key, date_dim.column(D_WEEK_SEQ)])
+    m1 = join(catalog_sales, dd, CS_SOLD_DATE_SK, 0, out_size=n_cs)
+    j1 = apply_join_maps(catalog_sales, dd, m1)
+    # j1: [cs_item, cs_date, cs_qty, cs_order, d_date_sk, d_week_seq]
+
+    m2 = join(j1, item, 0, I_ITEM_SK, out_size=n_cs)
+    j2 = apply_join_maps(j1, item, m2)
+    # j2: [...j1..., i_item_sk, i_brand_id, i_category_id]
+
+    # composite (item, week) against the inventory grain
+    probe_key = _pack_key(
+        Column(t.INT64, j2.column(0).data, j2.column(0).valid_mask()),
+        Column(t.INT64, j2.column(5).data, j2.column(5).valid_mask()),
+        MAX_WEEKS,
+    )
+    probe = Table([probe_key] + [j2.column(i) for i in (0, 2, 6, 7)])
+    # probe: [key, cs_item, cs_qty, i_item_sk, i_brand_id]
+    inv_key = _pack_key(
+        inventory.column(INV_ITEM_SK), inventory.column(INV_WEEK_SEQ),
+        MAX_WEEKS,
+    )
+    inv = Table([inv_key, inventory.column(INV_QTY)])
+    m3 = join(probe, inv, 0, 0, out_size=n_cs * out_factor)
+    j3 = apply_join_maps(probe, inv, m3)
+    # j3: [key, cs_item, cs_qty, i_item_sk, i_brand, inv_key, inv_qty]
+
+    # WHERE inv_quantity_on_hand < cs_quantity, after the join
+    short = j3.column(6).data < j3.column(2).data
+    keep = j3.column(6).valid_mask() & j3.column(2).valid_mask() & short
+    keyed = Table(
+        [
+            _null_keys_where(j3.column(3), ~keep),
+            _null_keys_where(j3.column(4), ~keep),
+            Column(t.INT64, j3.column(1).data, keep),
+        ]
+    )
+    grouped = groupby_aggregate(keyed, keys=[0, 1], aggs=[(2, "count")])
+    # ORDER BY count desc, item asc — q72's shape
+    srt = sort_table(
+        grouped.table, [2, 0], ascending=[False, True],
+        nulls_first=[False, False],
+    )
+    return GroupByResult(srt, grouped.num_groups)
+
+
+def tpcds_q72_numpy(
+    catalog_sales: Table, date_dim: Table, item: Table, inventory: Table,
+    year: int = 2000,
+) -> dict:
+    """Host oracle: {(item_sk, brand_id): count}."""
+    cs_item = np.asarray(catalog_sales.column(CS_ITEM_SK).data)
+    cs_date = np.asarray(catalog_sales.column(CS_SOLD_DATE_SK).data)
+    cs_qty = np.asarray(catalog_sales.column(CS_QUANTITY).data)
+    d_sk = np.asarray(date_dim.column(D_DATE_SK).data)
+    d_week = np.asarray(date_dim.column(D_WEEK_SEQ).data)
+    d_year = np.asarray(date_dim.column(D_YEAR).data)
+    i_sk = np.asarray(item.column(I_ITEM_SK).data)
+    i_brand = np.asarray(item.column(I_BRAND_ID).data)
+    inv_item = np.asarray(inventory.column(INV_ITEM_SK).data)
+    inv_week = np.asarray(inventory.column(INV_WEEK_SEQ).data)
+    inv_qty = np.asarray(inventory.column(INV_QTY).data)
+
+    week_of_date = dict(zip(d_sk[d_year == year], d_week[d_year == year]))
+    brand_of_item = dict(zip(i_sk, i_brand))
+    onhand = dict(zip(zip(inv_item, inv_week), inv_qty))
+    out: dict = {}
+    for k in range(len(cs_item)):
+        wk = week_of_date.get(cs_date[k])
+        if wk is None:
+            continue
+        br = brand_of_item.get(cs_item[k])
+        if br is None:
+            continue
+        oh = onhand.get((cs_item[k], wk))
+        if oh is None or not (oh < cs_qty[k]):
+            continue
+        key = (int(cs_item[k]), int(br))
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
+# ---- q64-style -------------------------------------------------------------
+
+
+@func_range("tpcds_q64")
+def tpcds_q64(
+    store_sales: Table,
+    item: Table,
+    year1: int = 2000,
+    year2: int = 2001,
+    num_days_per_year: int = 365,
+    out_factor: int = 4,
+) -> GroupByResult:
+    """Count, per item, customers who bought it in year1 AND again in
+    year2 (q64's cross-year self-join core). Returns groups
+    (i_item_sk, i_brand_id, count) padded."""
+    n = store_sales.num_rows
+    date = store_sales.column(SS_SOLD_DATE_SK).data
+    yr = (date - 1) // jnp.int64(num_days_per_year)
+    in_y1 = yr == (year1 - 2000)
+    in_y2 = yr == (year2 - 2000)
+
+    key = _pack_key(
+        store_sales.column(SS_ITEM_SK), store_sales.column(SS_CUSTOMER_SK),
+        MAX_CUSTOMERS,
+    )
+    left = Table(
+        [_null_keys_where(key, ~in_y1), store_sales.column(SS_ITEM_SK)]
+    )
+    right = Table([_null_keys_where(key, ~in_y2)])
+    maps = join(left, right, 0, 0, out_size=n * out_factor)
+    joined = apply_join_maps(left, right, maps)
+    # joined: [key_y1, ss_item, key_y2]; matched rows = repeat purchases
+    keep = joined.column(2).valid_mask()
+    keyed = Table(
+        [
+            _null_keys_where(joined.column(1), ~keep),
+            Column(t.INT64, joined.column(0).data, keep),
+        ]
+    )
+    grouped = groupby_aggregate(keyed, keys=[0], aggs=[(1, "count")])
+    srt = sort_table(
+        grouped.table, [1, 0], ascending=[False, True],
+        nulls_first=[False, False],
+    )
+    return GroupByResult(srt, grouped.num_groups)
+
+
+def tpcds_q64_numpy(
+    store_sales: Table, year1: int = 2000, year2: int = 2001,
+    num_days_per_year: int = 365,
+) -> dict:
+    """Host oracle: {item_sk: pair count} over (item, customer) pairs."""
+    item = np.asarray(store_sales.column(SS_ITEM_SK).data)
+    cust = np.asarray(store_sales.column(SS_CUSTOMER_SK).data)
+    date = np.asarray(store_sales.column(SS_SOLD_DATE_SK).data)
+    yr = (date - 1) // num_days_per_year + 2000
+    out: dict = {}
+    y2_pairs: dict = {}
+    for k in np.flatnonzero(yr == year2):
+        p = (int(item[k]), int(cust[k]))
+        y2_pairs[p] = y2_pairs.get(p, 0) + 1
+    for k in np.flatnonzero(yr == year1):
+        p = (int(item[k]), int(cust[k]))
+        c2 = y2_pairs.get(p, 0)
+        if c2:
+            out[p[0]] = out.get(p[0], 0) + c2
+    return out

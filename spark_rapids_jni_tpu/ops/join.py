@@ -43,6 +43,7 @@ def _join_maps_impl(
     right_valid: jnp.ndarray,
     out_size: int,
     how: str,
+    left_row_valid: jnp.ndarray | None = None,
 ) -> JoinMaps:
     n_right = right_key.shape[0]
     # Sort the build side with nulls banished past the valid prefix
@@ -67,6 +68,11 @@ def _join_maps_impl(
     counts = jnp.where(left_valid, hi - lo, 0)
     if how == "left":
         out_per_row = jnp.maximum(counts, 1)  # unmatched probe row emits one
+        if left_row_valid is not None:
+            # rows that are not rows at all (padding/phantom shuffle slots)
+            # must emit nothing — only real probe rows get the unmatched-row
+            # treatment (a real row with a NULL key still emits one).
+            out_per_row = jnp.where(left_row_valid, out_per_row, 0)
     else:
         out_per_row = counts
     offsets = jnp.cumsum(out_per_row)
@@ -101,10 +107,13 @@ def join(
     right_on: int,
     out_size: int,
     how: str = "inner",
+    left_row_valid: jnp.ndarray | None = None,
 ) -> JoinMaps:
     """Single-key equi-join returning gather maps. ``out_size`` caps the
     output (check ``total`` <= out_size on host if exactness matters);
-    multi-key joins compose by pre-hashing keys into one column."""
+    multi-key joins compose by pre-hashing keys into one column.
+    ``left_row_valid`` marks which probe rows exist at all (False =
+    padding/shuffle phantom, emits nothing even under a left join)."""
     if how not in ("inner", "left"):
         raise ValueError(f"unsupported join type {how!r}")
     lc, rc = left.column(left_on), right.column(right_on)
@@ -116,7 +125,8 @@ def join(
             "types into an integer column first)"
         )
     return _join_maps_impl(
-        lc.data, lc.valid_mask(), rc.data, rc.valid_mask(), out_size, how
+        lc.data, lc.valid_mask(), rc.data, rc.valid_mask(), out_size, how,
+        left_row_valid,
     )
 
 

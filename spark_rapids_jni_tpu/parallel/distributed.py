@@ -121,3 +121,52 @@ def collect(table: Table, num_rows_per_device: jnp.ndarray, mesh: Mesh) -> Table
         valid = np.concatenate([p[1] for p in parts])
         out.append(Column(c.dtype, jnp.asarray(data), jnp.asarray(valid)))
     return Table(out)
+
+
+class DistributedJoin(NamedTuple):
+    table: Table             # per-device joined rows (padded), sharded
+    total: jnp.ndarray       # int64[D] true match count per device
+    overflowed: jnp.ndarray  # bool[D] shuffle capacity overflow per device
+
+
+@func_range("distributed_join")
+def distributed_join(
+    left: Table,
+    right: Table,
+    left_on: int,
+    right_on: int,
+    mesh: Mesh,
+    out_size_per_device: int,
+    how: str = "inner",
+    left_capacity: Optional[int] = None,
+    right_capacity: Optional[int] = None,
+) -> DistributedJoin:
+    """Repartitioned equi-join — the RapidsShuffleManager join pattern: both
+    sides exchange rows by key hash over ICI, after which equal keys live on
+    the same device and a device-local sort-merge join finishes the work.
+
+    Both inputs must already be sharded row-wise over ``mesh``. Identical
+    routing for both tables is guaranteed because partition_hash depends
+    only on the key value and its storage type (join() rejects mismatched
+    key storage types).
+    """
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+
+    def step(l: Table, r: Table):
+        ls = hash_shuffle(l, [left_on], EXEC_AXIS, capacity=left_capacity)
+        rs = hash_shuffle(r, [right_on], EXEC_AXIS, capacity=right_capacity)
+        # phantom (unoccupied) shuffle slots must not emit left-join rows
+        maps = join(ls.table, rs.table, left_on, right_on,
+                    out_size_per_device, how=how,
+                    left_row_valid=ls.row_valid)
+        joined = apply_join_maps(ls.table, rs.table, maps)
+        overflow = ls.overflowed | rs.overflowed
+        return joined, maps.total.reshape(1), overflow.reshape(1)
+
+    out, total, overflowed = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+    )(left, right)
+    return DistributedJoin(out, total, overflowed)

@@ -1,0 +1,156 @@
+"""TPC-DS q72/q64-style join pipelines vs numpy oracles (BASELINE.json
+config #4 capability), plus the distributed repartitioned join."""
+
+import jax
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.models import tpcds
+from spark_rapids_jni_tpu.parallel import executor_mesh, shard_table
+from spark_rapids_jni_tpu.parallel.distributed import distributed_join
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return executor_mesh(8)
+
+
+def _q72_data(n_cs=2000, n_items=120, n_days=730):
+    return (
+        tpcds.catalog_sales_table(n_cs, num_items=n_items, num_days=n_days),
+        tpcds.date_dim_table(n_days),
+        tpcds.item_table(n_items),
+        tpcds.inventory_table(num_items=n_items, num_weeks=105),
+    )
+
+
+def _groups(result, key_cols, count_col):
+    tbl = result.table
+    cols = [tbl.column(i).to_pylist() for i in key_cols]
+    cnt = tbl.column(count_col).to_pylist()
+    out = {}
+    for i in range(tbl.num_rows):
+        key = tuple(c[i] for c in cols)
+        if any(k is None for k in key):
+            continue
+        out[key if len(key) > 1 else key[0]] = cnt[i]
+    return out
+
+
+def test_q72_matches_oracle():
+    cs, dd, it, inv = _q72_data()
+    res = tpcds.tpcds_q72(cs, dd, it, inv, year=2000)
+    got = _groups(res, [0, 1], 2)
+    want = tpcds.tpcds_q72_numpy(cs, dd, it, inv, year=2000)
+    assert got == want
+    assert len(want) > 10  # non-trivial workload
+
+
+def test_q72_jits():
+    cs, dd, it, inv = _q72_data(n_cs=512, n_items=40)
+    fn = jax.jit(lambda a, b, c, d: tpcds.tpcds_q72(a, b, c, d).table)
+    out = fn(cs, dd, it, inv)
+    want = tpcds.tpcds_q72_numpy(cs, dd, it, inv)
+    got_items = [v for v in out.column(0).to_pylist() if v is not None]
+    assert len(got_items) == len(want)
+
+
+def test_q72_year_filter_changes_result():
+    cs, dd, it, inv = _q72_data(n_cs=1000, n_items=60)
+    y0 = tpcds.tpcds_q72_numpy(cs, dd, it, inv, year=2000)
+    y1 = tpcds.tpcds_q72_numpy(cs, dd, it, inv, year=2001)
+    assert y0 != y1
+    got = _groups(tpcds.tpcds_q72(cs, dd, it, inv, year=2001), [0, 1], 2)
+    assert got == y1
+
+
+def test_q64_matches_oracle():
+    ss = tpcds.store_sales_table(3000, num_items=80, num_customers=400)
+    it = tpcds.item_table(80)
+    res = tpcds.tpcds_q64(ss, it)
+    got = _groups(res, [0], 1)
+    want = tpcds.tpcds_q64_numpy(ss)
+    assert got == want
+    assert len(want) > 10
+
+
+def test_q64_sorted_by_count_desc():
+    ss = tpcds.store_sales_table(2000, num_items=50, num_customers=300)
+    it = tpcds.item_table(50)
+    res = tpcds.tpcds_q64(ss, it)
+    counts = [
+        c for c, k in zip(res.table.column(1).to_pylist(),
+                          res.table.column(0).to_pylist())
+        if k is not None
+    ]
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_distributed_join_matches_local(rng, mesh):
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+
+    n_l, n_r = 512, 256
+    lk = rng.integers(0, 64, n_l).astype(np.int64)
+    lv = rng.integers(0, 1000, n_l).astype(np.int64)
+    rk = rng.integers(0, 64, n_r).astype(np.int64)
+    rv = rng.integers(0, 1000, n_r).astype(np.int64)
+    left = Table([Column.from_numpy(lk), Column.from_numpy(lv)])
+    right = Table([Column.from_numpy(rk), Column.from_numpy(rv)])
+
+    dj = distributed_join(
+        shard_table(left, mesh), shard_table(right, mesh), 0, 0, mesh,
+        out_size_per_device=n_l * 4,
+        left_capacity=n_l // 8, right_capacity=n_r // 8,
+    )
+    assert not np.asarray(dj.overflowed).any()
+
+    # gather real joined pairs from every device
+    got = []
+    tbl = dj.table
+    lkd = np.asarray(tbl.column(0).data)
+    lvd = np.asarray(tbl.column(1).data)
+    rvd = np.asarray(tbl.column(3).data)
+    valid = np.asarray(tbl.column(3).valid_mask())
+    for i in np.flatnonzero(valid):
+        got.append((lkd[i], lvd[i], rvd[i]))
+
+    maps = join(left, right, 0, 0, out_size=n_l * 32)
+    local = apply_join_maps(left, right, maps)
+    lv_ok = np.asarray(local.column(3).valid_mask())
+    want = [
+        (np.asarray(local.column(0).data)[i],
+         np.asarray(local.column(1).data)[i],
+         np.asarray(local.column(3).data)[i])
+        for i in np.flatnonzero(lv_ok)
+    ]
+    assert sorted(got) == sorted(want)
+    assert int(np.asarray(dj.total).sum()) == len(want)
+
+
+def test_distributed_left_join_no_phantom_rows(rng, mesh):
+    """Phantom shuffle slots must not surface as unmatched left-join rows."""
+    n_l, n_r = 256, 64
+    lk = rng.integers(0, 16, n_l).astype(np.int64)
+    rk = rng.integers(8, 24, n_r).astype(np.int64)  # partial overlap
+    left = Table([Column.from_numpy(lk)])
+    right = Table([Column.from_numpy(rk)])
+    dj = distributed_join(
+        shard_table(left, mesh), shard_table(right, mesh), 0, 0, mesh,
+        out_size_per_device=n_l * 8, how="left",
+        left_capacity=n_l // 8, right_capacity=n_r // 8,
+    )
+    assert not np.asarray(dj.overflowed).any()
+    # true left-join row count: sum over left rows of max(matches, 1)
+    match_counts = np.array([(rk == k).sum() for k in lk])
+    want_total = int(np.maximum(match_counts, 1).sum())
+    assert int(np.asarray(dj.total).sum()) == want_total
+    # unmatched left rows appear with null right side
+    tbl = dj.table
+    lkd = np.asarray(tbl.column(0).data)
+    l_ok = np.asarray(tbl.column(0).valid_mask())
+    r_ok = np.asarray(tbl.column(1).valid_mask())
+    got_unmatched = np.sort(lkd[l_ok & ~r_ok])
+    want_unmatched = np.sort(lk[match_counts == 0])
+    np.testing.assert_array_equal(got_unmatched, want_unmatched)
