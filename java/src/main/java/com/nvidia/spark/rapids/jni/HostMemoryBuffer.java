@@ -1,0 +1,69 @@
+/*
+ * Minimal off-heap host buffer — the role ai.rapids.cudf.HostMemoryBuffer
+ * plays in the reference's API signatures (reference ParquetFooter.java:19,
+ * 82-95 takes one as the footer byte source). Address + length + explicit
+ * close, nothing more; allocation is native so the address is stable for
+ * JNI calls.
+ */
+
+package com.nvidia.spark.rapids.jni;
+
+public class HostMemoryBuffer implements AutoCloseable {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private long address;
+  private final long length;
+
+  private HostMemoryBuffer(long address, long length) {
+    this.address = address;
+    this.length = length;
+  }
+
+  public static HostMemoryBuffer allocate(long bytes) {
+    long addr = hostAlloc(bytes);
+    if (addr == 0) {
+      throw new OutOfMemoryError("host allocation of " + bytes + " bytes failed");
+    }
+    return new HostMemoryBuffer(addr, bytes);
+  }
+
+  public long getAddress() {
+    if (address == 0) {
+      throw new IllegalStateException("buffer is closed");
+    }
+    return address;
+  }
+
+  public long getLength() {
+    return length;
+  }
+
+  public void setBytes(long offset, byte[] src) {
+    if (offset < 0 || offset + src.length > length) {
+      throw new IndexOutOfBoundsException();
+    }
+    copyIn(getAddress() + offset, src);
+  }
+
+  public byte[] getBytes(long offset, int count) {
+    if (offset < 0 || offset + count > length) {
+      throw new IndexOutOfBoundsException();
+    }
+    return copyOut(getAddress() + offset, count);
+  }
+
+  @Override
+  public synchronized void close() {
+    if (address != 0) {
+      hostFree(address);
+      address = 0;
+    }
+  }
+
+  private static native long hostAlloc(long bytes);
+  private static native void hostFree(long address);
+  private static native void copyIn(long address, byte[] src);
+  private static native byte[] copyOut(long address, int count);
+}

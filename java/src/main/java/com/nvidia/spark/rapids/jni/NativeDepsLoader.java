@@ -1,0 +1,63 @@
+/*
+ * Native library bootstrap for the TPU build.
+ *
+ * Role parity with the loader the reference classes invoke in their static
+ * initializers (reference RowConversion.java:23-25, ParquetFooter.java:25-27;
+ * per-platform .so packaging scheme at reference pom.xml:385-421): find
+ * libtpudf.so — explicit path, jar resource, or build tree — extract if
+ * needed, System.load once.
+ */
+
+package com.nvidia.spark.rapids.jni;
+
+import java.io.File;
+import java.io.IOException;
+import java.io.InputStream;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.nio.file.StandardCopyOption;
+
+public final class NativeDepsLoader {
+  private static final String LIB_NAME = "tpudf_jni";
+  private static boolean loaded = false;
+
+  private NativeDepsLoader() {}
+
+  public static synchronized void loadNativeDeps() {
+    if (loaded) {
+      return;
+    }
+    String explicit = System.getProperty("spark.rapids.tpu.nativeLib");
+    if (explicit == null) {
+      explicit = System.getenv("SPARK_RAPIDS_TPU_JNI_LIB");
+    }
+    if (explicit != null) {
+      System.load(explicit);
+      loaded = true;
+      return;
+    }
+    String resource = "/" + System.getProperty("os.arch") + "/"
+        + System.getProperty("os.name") + "/lib" + LIB_NAME + ".so";
+    try (InputStream in = NativeDepsLoader.class.getResourceAsStream(resource)) {
+      if (in != null) {
+        Path tmp = Files.createTempFile("lib" + LIB_NAME, ".so");
+        tmp.toFile().deleteOnExit();
+        Files.copy(in, tmp, StandardCopyOption.REPLACE_EXISTING);
+        System.load(tmp.toAbsolutePath().toString());
+        loaded = true;
+        return;
+      }
+    } catch (IOException e) {
+      throw new ExceptionInInitializerError(e);
+    }
+    // dev fallback: repo build tree
+    File dev = new File("build/native/lib" + LIB_NAME + ".so");
+    if (dev.exists()) {
+      System.load(dev.getAbsolutePath());
+      loaded = true;
+      return;
+    }
+    System.loadLibrary(LIB_NAME);
+    loaded = true;
+  }
+}

@@ -1,0 +1,81 @@
+/*
+ * Parquet footer prune/filter — API parity with the reference's
+ * ParquetFooter (reference ParquetFooter.java:40-113): an AutoCloseable
+ * wrapper over a native footer handle with the same depth-first flattened
+ * (names, numChildren) schema-request contract
+ * (reference ParquetFooter.java:66-95). The native side is
+ * src/native/src/parquet_footer.cpp via the tpudf C ABI.
+ */
+
+package com.nvidia.spark.rapids.jni;
+
+public class ParquetFooter implements AutoCloseable {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private long handle;
+
+  private ParquetFooter(long handle) {
+    this.handle = handle;
+  }
+
+  /**
+   * Parse a footer from host memory, prune it to the requested column tree,
+   * and filter row groups to the partition split [partOffset,
+   * partOffset+partLength). Column names and child counts are flattened
+   * depth-first, root excluded — the reference's request encoding
+   * (reference ParquetFooter.java:66-81).
+   */
+  public static ParquetFooter readAndFilter(HostMemoryBuffer buffer,
+      long partOffset, long partLength, String[] names, int[] numChildren,
+      int parentNumChildren, boolean ignoreCase) {
+    long h = readAndFilterNative(buffer.getAddress(), buffer.getLength(),
+        partOffset, partLength, names, numChildren, parentNumChildren,
+        ignoreCase);
+    return new ParquetFooter(h);
+  }
+
+  /** Re-serialize as a PAR1-framed thrift file into a fresh host buffer. */
+  public HostMemoryBuffer serializeThriftFile() {
+    byte[] bytes = serializeNative(checkHandle());
+    HostMemoryBuffer out = HostMemoryBuffer.allocate(bytes.length);
+    out.setBytes(0, bytes);
+    return out;
+  }
+
+  public long getNumRows() {
+    return numRowsNative(checkHandle());
+  }
+
+  public int getNumColumns() {
+    return numColumnsNative(checkHandle());
+  }
+
+  @Override
+  public synchronized void close() {
+    if (handle != 0) {
+      closeNative(handle);
+      handle = 0;
+    }
+  }
+
+  private long checkHandle() {
+    if (handle == 0) {
+      throw new IllegalStateException("footer is closed");
+    }
+    return handle;
+  }
+
+  private static native long readAndFilterNative(long address, long length,
+      long partOffset, long partLength, String[] names, int[] numChildren,
+      int parentNumChildren, boolean ignoreCase);
+
+  private static native byte[] serializeNative(long handle);
+
+  private static native long numRowsNative(long handle);
+
+  private static native int numColumnsNative(long handle);
+
+  private static native void closeNative(long handle);
+}

@@ -25,6 +25,8 @@ static throughout — the XLA discipline.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -265,23 +267,33 @@ def tpcds_q72_numpy(
 # ---- q64-style -------------------------------------------------------------
 
 
+class Q64Result(NamedTuple):
+    result: GroupByResult
+    join_total: jnp.ndarray  # true self-join match count (scalar)
+    out_size: int            # static cap — if join_total > out_size the
+                             # join truncated and counts are unreliable
+
+
 @func_range("tpcds_q64")
 def tpcds_q64(
     store_sales: Table,
-    item: Table,
     year1: int = 2000,
     year2: int = 2001,
     num_days_per_year: int = 365,
+    base_year: int = 2000,
     out_factor: int = 4,
-) -> GroupByResult:
-    """Count, per item, customers who bought it in year1 AND again in
-    year2 (q64's cross-year self-join core). Returns groups
-    (i_item_sk, i_brand_id, count) padded."""
+) -> Q64Result:
+    """Count, per item, (year1 purchase, year2 purchase) pairs by the same
+    customer (q64's cross-year self-join core). Groups are
+    (ss_item_sk, count), padded. ``base_year`` anchors the generator's
+    date_sk=1 (store_sales_table emits days 1..num_days); check
+    ``join_total <= out_size`` on host — duplicate (item, customer) pairs
+    multiply, so the self-join is not structurally bounded."""
     n = store_sales.num_rows
     date = store_sales.column(SS_SOLD_DATE_SK).data
     yr = (date - 1) // jnp.int64(num_days_per_year)
-    in_y1 = yr == (year1 - 2000)
-    in_y2 = yr == (year2 - 2000)
+    in_y1 = yr == (year1 - base_year)
+    in_y2 = yr == (year2 - base_year)
 
     key = _pack_key(
         store_sales.column(SS_ITEM_SK), store_sales.column(SS_CUSTOMER_SK),
@@ -306,7 +318,9 @@ def tpcds_q64(
         grouped.table, [1, 0], ascending=[False, True],
         nulls_first=[False, False],
     )
-    return GroupByResult(srt, grouped.num_groups)
+    return Q64Result(
+        GroupByResult(srt, grouped.num_groups), maps.total, n * out_factor
+    )
 
 
 def tpcds_q64_numpy(

@@ -1,0 +1,79 @@
+"""get_json_object over string columns — the Spark SQL JSONPath extractor
+(north-star JNI kernel; BASELINE.json lists it explicitly).
+
+The extraction engine is native C++ (src/native/src/get_json_object.cpp):
+JSON navigation is a branchy byte-level state machine over variable-length
+strings, which is host work in this design round — the column round-trips
+host<->HBM around the call. Path grammar: ``$``, ``.field``, ``['field']``,
+``[index]``; wildcards raise ValueError (Spark's analyzer behavior for
+paths it cannot compile). String matches come back unquoted with escapes
+decoded; object/array/number/bool matches come back as raw JSON text; JSON
+null and missing paths are SQL NULL.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.parquet.footer import NativeError
+from spark_rapids_jni_tpu.runtime.native import load_native
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+@func_range("get_json_object")
+def get_json_object(col: Column, path: str) -> Column:
+    """Extract ``path`` from every JSON document in a STRING column."""
+    if not col.dtype.is_string:
+        raise TypeError("get_json_object requires a STRING column")
+    lib = load_native()
+    n = col.size
+    offsets = np.ascontiguousarray(np.asarray(col.data), dtype=np.int32)
+    chars = np.ascontiguousarray(np.asarray(col.chars), dtype=np.uint8)
+    if chars.size == 0:
+        chars = np.zeros(1, dtype=np.uint8)
+    valid_in = None
+    if col.validity is not None:
+        valid_in = np.ascontiguousarray(
+            np.asarray(col.validity), dtype=np.uint8
+        )
+
+    out_chars = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_int64()
+    out_offsets = np.empty(n + 1, dtype=np.int32)
+    out_valid = np.empty(n, dtype=np.uint8)
+    rc = lib.tpudf_get_json_object(
+        chars.ctypes.data_as(ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        None if valid_in is None
+        else valid_in.ctypes.data_as(ctypes.c_void_p),
+        n,
+        path.encode(),
+        ctypes.byref(out_chars),
+        ctypes.byref(out_len),
+        out_offsets.ctypes.data_as(ctypes.c_void_p),
+        out_valid.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        msg = lib.last_error()
+        # PathError messages carry a fixed "JSONPath: " prefix (caller bug
+        # -> ValueError); anything else is an engine failure.
+        if msg.startswith("JSONPath:"):
+            raise ValueError(msg)
+        raise NativeError(msg)
+    try:
+        nbytes = out_len.value
+        payload = np.ctypeslib.as_array(out_chars, shape=(max(nbytes, 1),))
+        result_chars = np.array(payload[:nbytes], dtype=np.uint8, copy=True)
+    finally:
+        lib.tpudf_free_buffer(out_chars)
+    return Column(
+        t.STRING,
+        jnp.asarray(out_offsets),
+        jnp.asarray(out_valid.astype(bool)),
+        chars=jnp.asarray(result_chars),
+    )

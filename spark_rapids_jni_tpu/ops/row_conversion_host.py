@@ -1,0 +1,106 @@
+"""Host-side packed-row codec — the native (C++) half of component C1'.
+
+Same byte contract as ops/row_conversion (which runs on device): the JNI
+surface uses this for Spark's host-side UnsafeRow handoff, and the tests
+cross-validate the two implementations byte-for-byte — an independent
+check of the reference layout contract (row_conversion.cu:432-456).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.parquet.footer import NativeError
+from spark_rapids_jni_tpu.runtime.native import load_native
+from spark_rapids_jni_tpu.types import DType
+
+
+def _sizes(schema: list[DType]) -> np.ndarray:
+    return np.array([dt.size_bytes for dt in schema], dtype=np.int32)
+
+
+def host_layout(schema: list[DType]) -> tuple[np.ndarray, int]:
+    """(column_start[n], row_size) from the native layout engine."""
+    lib = load_native()
+    sizes = _sizes(schema)
+    starts = np.empty(len(schema), dtype=np.int32)
+    row_size = lib.tpudf_rows_layout(
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(schema),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if row_size < 0:
+        raise NativeError(lib.last_error())
+    return starts, int(row_size)
+
+
+def host_to_rows(table: Table) -> np.ndarray:
+    """Pack a host copy of the table into uint8[n, row_size]."""
+    lib = load_native()
+    schema = table.schema()
+    sizes = _sizes(schema)
+    n = table.num_rows
+    _, row_size = host_layout(schema)
+
+    datas = []
+    valids = []
+    for c in table.columns:
+        datas.append(np.ascontiguousarray(np.asarray(c.data)))
+        valids.append(
+            None if c.validity is None
+            else np.ascontiguousarray(np.asarray(c.validity), dtype=np.uint8)
+        )
+    data_ptrs = (ctypes.c_void_p * len(datas))(
+        *[d.ctypes.data_as(ctypes.c_void_p).value for d in datas]
+    )
+    valid_ptrs = (ctypes.c_void_p * len(valids))(
+        *[None if v is None else v.ctypes.data_as(ctypes.c_void_p).value
+          for v in valids]
+    )
+    out = np.zeros((n, row_size), dtype=np.uint8)
+    rc = lib.tpudf_to_rows(
+        data_ptrs, valid_ptrs,
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(schema), n, out.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise NativeError(lib.last_error())
+    return out
+
+
+def host_from_rows(rows: np.ndarray, schema: list[DType]) -> Table:
+    """Unpack uint8[n, row_size] into a host-backed Table."""
+    import jax.numpy as jnp
+
+    lib = load_native()
+    sizes = _sizes(schema)
+    _, row_size = host_layout(schema)
+    if rows.ndim != 2 or rows.shape[1] != row_size:
+        raise ValueError("The layout of the data appears to be off")
+    n = rows.shape[0]
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+
+    datas = [np.empty(n, dtype=dt.storage_dtype) for dt in schema]
+    valids = [np.empty(n, dtype=np.uint8) for _ in schema]
+    data_ptrs = (ctypes.c_void_p * len(datas))(
+        *[d.ctypes.data_as(ctypes.c_void_p).value for d in datas]
+    )
+    valid_ptrs = (ctypes.c_void_p * len(valids))(
+        *[v.ctypes.data_as(ctypes.c_void_p).value for v in valids]
+    )
+    rc = lib.tpudf_from_rows(
+        rows.ctypes.data_as(ctypes.c_void_p), n,
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(schema), data_ptrs, valid_ptrs,
+    )
+    if rc != 0:
+        raise NativeError(lib.last_error())
+    return Table(
+        [
+            Column(dt, jnp.asarray(d), jnp.asarray(v.astype(bool)))
+            for dt, d, v in zip(schema, datas, valids)
+        ]
+    )

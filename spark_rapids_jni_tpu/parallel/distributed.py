@@ -37,12 +37,20 @@ def head_table(table: Table, k: int) -> Table:
     return Table(cols)
 
 
-def shard_table(table: Table, mesh: Mesh, axis: str = EXEC_AXIS) -> Table:
+def shard_table(
+    table: Table,
+    mesh: Mesh,
+    axis: str = EXEC_AXIS,
+    return_row_valid: bool = False,
+):
     """Distribute a host-built table row-wise across the mesh axis.
 
     Rows are padded to a multiple of the axis size with null rows (null
     rows fall out of every aggregate, the framework-wide masking idiom).
-    """
+    With ``return_row_valid=True`` also returns the sharded bool[n] mask
+    marking real rows — needed by operators where a padding row is not
+    equivalent to a null-key row (left joins emit unmatched null-key rows
+    but must not emit padding)."""
     d = mesh.shape[axis]
     n = table.num_rows
     pad = (-n) % d
@@ -61,7 +69,13 @@ def shard_table(table: Table, mesh: Mesh, axis: str = EXEC_AXIS) -> Table:
                 jax.device_put(valid, sharding),
             )
         )
-    return Table(out)
+    sharded = Table(out)
+    if not return_row_valid:
+        return sharded
+    row_valid = jnp.concatenate(
+        [jnp.ones((n,), jnp.bool_), jnp.zeros((pad,), jnp.bool_)]
+    )
+    return sharded, jax.device_put(row_valid, sharding)
 
 
 class DistributedGroupBy(NamedTuple):
@@ -140,6 +154,8 @@ def distributed_join(
     how: str = "inner",
     left_capacity: Optional[int] = None,
     right_capacity: Optional[int] = None,
+    left_row_valid: Optional[jnp.ndarray] = None,
+    right_row_valid: Optional[jnp.ndarray] = None,
 ) -> DistributedJoin:
     """Repartitioned equi-join — the RapidsShuffleManager join pattern: both
     sides exchange rows by key hash over ICI, after which equal keys live on
@@ -148,13 +164,18 @@ def distributed_join(
     Both inputs must already be sharded row-wise over ``mesh``. Identical
     routing for both tables is guaranteed because partition_hash depends
     only on the key value and its storage type (join() rejects mismatched
-    key storage types).
+    key storage types). Pass the ``row_valid`` masks from
+    ``shard_table(..., return_row_valid=True)`` so padding rows are dropped
+    before the exchange — under a left join a padding row would otherwise
+    be indistinguishable from a genuine NULL-key row and emit output.
     """
     from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
 
-    def step(l: Table, r: Table):
-        ls = hash_shuffle(l, [left_on], EXEC_AXIS, capacity=left_capacity)
-        rs = hash_shuffle(r, [right_on], EXEC_AXIS, capacity=right_capacity)
+    def step(l: Table, r: Table, lrv, rrv):
+        ls = hash_shuffle(l, [left_on], EXEC_AXIS, capacity=left_capacity,
+                          row_valid=lrv)
+        rs = hash_shuffle(r, [right_on], EXEC_AXIS, capacity=right_capacity,
+                          row_valid=rrv)
         # phantom (unoccupied) shuffle slots must not emit left-join rows
         maps = join(ls.table, rs.table, left_on, right_on,
                     out_size_per_device, how=how,
@@ -163,10 +184,16 @@ def distributed_join(
         overflow = ls.overflowed | rs.overflowed
         return joined, maps.total.reshape(1), overflow.reshape(1)
 
+    d = mesh.shape[EXEC_AXIS]
+    if left_row_valid is None:
+        left_row_valid = jnp.ones((left.num_rows,), jnp.bool_)
+    if right_row_valid is None:
+        right_row_valid = jnp.ones((right.num_rows,), jnp.bool_)
+    del d
     out, total, overflowed = jax.shard_map(
         step,
         mesh=mesh,
-        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
         out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
-    )(left, right)
+    )(left, right, left_row_valid, right_row_valid)
     return DistributedJoin(out, total, overflowed)

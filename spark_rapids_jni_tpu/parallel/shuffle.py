@@ -58,12 +58,18 @@ def hash_shuffle(
     keys: Sequence[int],
     axis_name: str,
     capacity: Optional[int] = None,
+    row_valid: Optional[jnp.ndarray] = None,
 ) -> ShuffleResult:
     """Exchange rows so row r lands on device ``hash(keys(r)) % D``.
 
     Must run inside ``shard_map`` over a mesh with ``axis_name``; ``table``
     is the caller's local batch. Returns the rows this device owns after
     the exchange (padded to ``D * capacity`` with null rows).
+
+    ``row_valid`` marks which local rows exist at all (False = padding from
+    shard_table etc.); non-rows are dropped before the exchange rather than
+    shipped, and never count as overflow. Distinct from column validity — a
+    real row with NULL key still shuffles (to the null-hash partition).
     """
     D = jax.lax.axis_size(axis_name)
     n = table.num_rows
@@ -76,13 +82,25 @@ def hash_shuffle(
     # its partition run. Stable sort keeps within-partition input order.
     order = jnp.argsort(part, stable=True)
     part_sorted = part[order]
-    counts = jnp.zeros((D,), dtype=jnp.int32).at[part].add(1)
+    if row_valid is None:
+        real_sorted = jnp.ones((n,), dtype=jnp.bool_)
+        counts = jnp.zeros((D,), dtype=jnp.int32).at[part].add(1)
+    else:
+        real_sorted = row_valid[order]
+        counts = jnp.zeros((D,), dtype=jnp.int32).at[part].add(
+            row_valid.astype(jnp.int32)
+        )
     offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
     )
-    slot = jnp.arange(n, dtype=jnp.int32) - offsets[part_sorted]
-    in_cap = slot < capacity
-    overflowed = jnp.any(~in_cap)
+    # Slot within partition = count of real rows of the same partition that
+    # precede this row. Rows of a partition are contiguous after the sort,
+    # and offsets[p] counts real rows in earlier partitions, so a real
+    # row's slot is its global real-row rank minus its partition's base.
+    real_rank = jnp.cumsum(real_sorted.astype(jnp.int32)) - 1  # inclusive - 1
+    slot = real_rank - offsets[part_sorted]
+    in_cap = (slot < capacity) & real_sorted
+    overflowed = jnp.any((slot >= capacity) & real_sorted)
     size = D * capacity
     # Flat index into (D, capacity); overflow rows are routed out of range so
     # the scatters genuinely drop them — p*capacity + slot with slot >= capacity

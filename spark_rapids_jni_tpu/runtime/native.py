@@ -106,6 +106,44 @@ class NativeLib:
         ]
         c.tpudf_read_close.restype = ctypes.c_int32
         c.tpudf_read_close.argtypes = [ctypes.c_int64]
+        # host packed-row codec
+        c.tpudf_rows_layout.restype = ctypes.c_int32
+        c.tpudf_rows_layout.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        c.tpudf_to_rows.restype = ctypes.c_int32
+        c.tpudf_to_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        c.tpudf_from_rows.restype = ctypes.c_int32
+        c.tpudf_from_rows.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        # get_json_object
+        c.tpudf_get_json_object.restype = ctypes.c_int32
+        c.tpudf_get_json_object.argtypes = [
+            ctypes.c_void_p,                          # chars
+            ctypes.c_void_p,                          # offsets
+            ctypes.c_void_p,                          # valid (nullable)
+            ctypes.c_int64,                           # n_rows
+            ctypes.c_char_p,                          # path
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p,                          # out offsets
+            ctypes.c_void_p,                          # out valid
+        ]
 
     def __getattr__(self, name):
         return getattr(self._c, name)

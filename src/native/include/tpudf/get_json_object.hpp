@@ -1,0 +1,55 @@
+// get_json_object — Spark SQL's JSONPath extractor (north-star component:
+// BASELINE.json lists "get_json_object" among the JNI-exposed kernels; the
+// reference family ships it as a GPU kernel over string columns).
+//
+// Supported path subset (Spark's own grammar, minus wildcards this round):
+//   $            root
+//   .field       object member (also ['field'])
+//   [index]      array element, 0-based
+// Unsupported ($.* , [*] wildcards) and malformed paths return
+// PathError so callers can fail the whole column like Spark's analyzer
+// would; malformed JSON or a missing match returns nullopt (SQL NULL).
+//
+// Match semantics follow Spark's UDF:
+//   * string results are returned UNQUOTED (raw value, escapes decoded);
+//   * object/array/number/bool results are returned as their literal JSON
+//     text (whitespace preserved as-is from the input);
+//   * a JSON null matches to SQL NULL.
+
+#pragma once
+
+#include <optional>
+#include <stdexcept>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace tpudf {
+namespace json {
+
+class PathError : public std::invalid_argument {
+ public:
+  // All messages carry the "JSONPath: " prefix so bindings can classify
+  // bad-path errors (caller bug -> ValueError) apart from engine errors.
+  explicit PathError(std::string const& msg)
+      : std::invalid_argument("JSONPath: " + msg) {}
+};
+
+struct PathStep {
+  bool is_index = false;
+  std::string field;
+  int64_t index = 0;
+};
+
+// Compile a path once (throws PathError); reuse across a whole column.
+std::vector<PathStep> parse_path(std::string_view path);
+
+std::optional<std::string> get_json_object(std::string_view json,
+                                           std::vector<PathStep> const& steps);
+
+// Convenience single-shot form (parses the path on every call).
+std::optional<std::string> get_json_object(std::string_view json,
+                                           std::string_view path);
+
+}  // namespace json
+}  // namespace tpudf

@@ -1,0 +1,50 @@
+// Host-side packed-row codec (component C1' native half).
+//
+// Byte-contract-identical to the device path
+// (spark_rapids_jni_tpu/ops/row_conversion.py) and to the reference format
+// (reference src/main/cpp/src/row_conversion.cu:432-456 layout;
+// RowConversion.java:40-99 documented contract):
+//   * columns packed in schema order, each aligned to its own size
+//   * validity bytes ((ncols+7)//8) directly after the last column,
+//     bit col%8 of byte col//8 set <=> valid
+//   * rows zero-padded to 8 bytes
+//
+// This is the CPU half of the bridge: the JNI surface packs/unpacks host
+// buffers with it (Spark's UnsafeRow handoff is host-side), while the JAX
+// op does the same transform on-device. The two are cross-validated
+// byte-for-byte in tests.
+
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+namespace tpudf {
+namespace rows {
+
+struct Layout {
+  std::vector<int32_t> start;
+  std::vector<int32_t> size;
+  int32_t row_size = 0;
+};
+
+// Element sizes -> packed layout. Throws std::invalid_argument on
+// non-power-of-two or out-of-range sizes.
+Layout fixed_width_layout(std::vector<int32_t> const& sizes);
+
+// Pack columns into rows. col_data[i] is n_rows*sizes[i] bytes
+// (little-endian values); col_valid[i] is n_rows validity bytes (1=valid)
+// or nullptr for all-valid. out must hold n_rows*layout.row_size bytes;
+// pad bytes are zeroed (same determinism choice as the device path).
+void to_rows(uint8_t const* const* col_data, uint8_t const* const* col_valid,
+             std::vector<int32_t> const& sizes, int64_t n_rows, uint8_t* out);
+
+// Unpack rows into columns. Buffers must be caller-allocated to
+// n_rows*sizes[i] (data) and n_rows (validity; never null — the packed
+// form always carries validity bits, reference row_conversion.cu:551-555).
+void from_rows(uint8_t const* rows, int64_t n_rows,
+               std::vector<int32_t> const& sizes, uint8_t* const* col_data,
+               uint8_t* const* col_valid);
+
+}  // namespace rows
+}  // namespace tpudf

@@ -20,8 +20,10 @@
 #include <unordered_map>
 #include <vector>
 
+#include "tpudf/get_json_object.hpp"
 #include "tpudf/parquet_footer.hpp"
 #include "tpudf/parquet_reader.hpp"
+#include "tpudf/row_conversion.hpp"
 
 namespace {
 
@@ -301,6 +303,95 @@ int32_t tpudf_read_close(int64_t handle) {
     return -1;
   }
   return 0;
+}
+
+// ---- host packed-row codec (C1' native half) ------------------------------
+
+// Layout probe: fills starts[n_cols], returns row_size (or -1 on error).
+int32_t tpudf_rows_layout(int32_t const* sizes, int32_t n_cols,
+                          int32_t* starts) {
+  try {
+    std::vector<int32_t> sz(sizes, sizes + n_cols);
+    auto layout = tpudf::rows::fixed_width_layout(sz);
+    for (int32_t i = 0; i < n_cols; ++i) starts[i] = layout.start[i];
+    return layout.row_size;
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+int32_t tpudf_to_rows(uint8_t const* const* col_data,
+                      uint8_t const* const* col_valid, int32_t const* sizes,
+                      int32_t n_cols, int64_t n_rows, uint8_t* out) {
+  try {
+    std::vector<int32_t> sz(sizes, sizes + n_cols);
+    tpudf::rows::to_rows(col_data, col_valid, sz, n_rows, out);
+    return 0;
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+int32_t tpudf_from_rows(uint8_t const* rows_buf, int64_t n_rows,
+                        int32_t const* sizes, int32_t n_cols,
+                        uint8_t* const* col_data, uint8_t* const* col_valid) {
+  try {
+    std::vector<int32_t> sz(sizes, sizes + n_cols);
+    tpudf::rows::from_rows(rows_buf, n_rows, sz, col_data, col_valid);
+    return 0;
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+// ---- get_json_object ------------------------------------------------------
+
+// Extract `path` from each row of an Arrow string column. out_chars is
+// malloc'd (free with tpudf_free_buffer); out_offsets has n_rows+1 slots,
+// out_valid n_rows. Returns 0, or -1 on error (e.g. unsupported path).
+int32_t tpudf_get_json_object(uint8_t const* chars, int32_t const* offsets,
+                              uint8_t const* valid, int64_t n_rows,
+                              char const* path, uint8_t** out_chars,
+                              int64_t* out_chars_len, int32_t* out_offsets,
+                              uint8_t* out_valid) {
+  try {
+    // Compile the path once for the whole column — also surfaces bad-path
+    // errors even when every row is NULL (Spark's analyzer behavior).
+    auto const steps = tpudf::json::parse_path(path);
+    std::string result;
+    out_offsets[0] = 0;
+    for (int64_t r = 0; r < n_rows; ++r) {
+      std::optional<std::string> match;
+      if (valid == nullptr || valid[r]) {
+        std::string_view row(
+            reinterpret_cast<char const*>(chars) + offsets[r],
+            static_cast<size_t>(offsets[r + 1] - offsets[r]));
+        match = tpudf::json::get_json_object(row, steps);
+      }
+      if (match.has_value()) {
+        result += *match;
+        out_valid[r] = 1;
+      } else {
+        out_valid[r] = 0;
+      }
+      if (result.size() > static_cast<size_t>(INT32_MAX)) {
+        throw std::overflow_error(
+            "get_json_object output exceeds 2^31 chars");
+      }
+      out_offsets[r + 1] = static_cast<int32_t>(result.size());
+    }
+    *out_chars = static_cast<uint8_t*>(std::malloc(result.size() + 1));
+    if (*out_chars == nullptr) throw std::bad_alloc();
+    std::memcpy(*out_chars, result.data(), result.size());
+    *out_chars_len = static_cast<int64_t>(result.size());
+    return 0;
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
 }
 
 // Open-handle count — backs leak-check tests, the moral equivalent of the

@@ -1,0 +1,316 @@
+#include "tpudf/get_json_object.hpp"
+
+#include <cstdint>
+#include <vector>
+
+namespace tpudf {
+namespace json {
+
+namespace {
+
+}  // namespace
+
+// Parse "$.a['b'][3].c" into steps. Throws PathError on anything outside
+// the supported grammar (incl. the wildcards Spark allows but we defer).
+std::vector<PathStep> parse_path(std::string_view path) {
+  if (path.empty() || path[0] != '$') {
+    throw PathError("JSONPath must start with '$'");
+  }
+  std::vector<PathStep> steps;
+  size_t i = 1;
+  while (i < path.size()) {
+    if (path[i] == '.') {
+      ++i;
+      size_t start = i;
+      while (i < path.size() && path[i] != '.' && path[i] != '[') ++i;
+      if (start == i) throw PathError("empty field name in JSONPath");
+      std::string name(path.substr(start, i - start));
+      if (name == "*") throw PathError("wildcard paths are not supported");
+      PathStep s;
+      s.field = std::move(name);
+      steps.push_back(std::move(s));
+    } else if (path[i] == '[') {
+      ++i;
+      if (i < path.size() && (path[i] == '\'' || path[i] == '"')) {
+        char const quote = path[i];
+        ++i;
+        size_t start = i;
+        while (i < path.size() && path[i] != quote) ++i;
+        if (i >= path.size()) throw PathError("unterminated quoted field");
+        PathStep s;
+        s.field = std::string(path.substr(start, i - start));
+        steps.push_back(std::move(s));
+        ++i;
+        if (i >= path.size() || path[i] != ']') {
+          throw PathError("expected ']' in JSONPath");
+        }
+        ++i;
+      } else {
+        size_t start = i;
+        while (i < path.size() && path[i] != ']') ++i;
+        if (i >= path.size()) throw PathError("unterminated '[' in JSONPath");
+        std::string_view idx = path.substr(start, i - start);
+        if (idx == "*") throw PathError("wildcard paths are not supported");
+        if (idx.empty()) throw PathError("empty index in JSONPath");
+        int64_t v = 0;
+        for (char c : idx) {
+          if (c < '0' || c > '9') throw PathError("non-numeric array index");
+          v = v * 10 + (c - '0');
+        }
+        PathStep s;
+        s.is_index = true;
+        s.index = v;
+        steps.push_back(s);
+        ++i;
+      }
+    } else {
+      throw PathError("unexpected character in JSONPath");
+    }
+  }
+  return steps;
+}
+
+namespace {
+
+class Cursor {
+ public:
+  explicit Cursor(std::string_view s) : s_(s) {}
+
+  bool fail() const { return failed_; }
+  size_t pos() const { return i_; }
+  std::string_view text() const { return s_; }
+
+  void ws() {
+    while (i_ < s_.size() &&
+           (s_[i_] == ' ' || s_[i_] == '\t' || s_[i_] == '\n' ||
+            s_[i_] == '\r')) {
+      ++i_;
+    }
+  }
+
+  char peek() {
+    if (i_ >= s_.size()) {
+      failed_ = true;
+      return '\0';
+    }
+    return s_[i_];
+  }
+
+  bool eat(char c) {
+    if (i_ < s_.size() && s_[i_] == c) {
+      ++i_;
+      return true;
+    }
+    failed_ = true;
+    return false;
+  }
+
+  // Skip over one complete value; returns [start,end) of its raw text.
+  std::pair<size_t, size_t> skip_value() {
+    ws();
+    size_t start = i_;
+    char c = peek();
+    if (failed_) return {start, start};
+    if (c == '{') {
+      skip_container('{', '}');
+    } else if (c == '[') {
+      skip_container('[', ']');
+    } else if (c == '"') {
+      skip_string();
+    } else {
+      // literal: number / true / false / null
+      while (i_ < s_.size() && s_[i_] != ',' && s_[i_] != '}' &&
+             s_[i_] != ']' && s_[i_] != ' ' && s_[i_] != '\t' &&
+             s_[i_] != '\n' && s_[i_] != '\r') {
+        ++i_;
+      }
+      if (i_ == start) failed_ = true;
+    }
+    return {start, i_};
+  }
+
+  void skip_string() {
+    if (!eat('"')) return;
+    while (i_ < s_.size()) {
+      char c = s_[i_];
+      if (c == '\\') {
+        i_ += 2;
+        continue;
+      }
+      ++i_;
+      if (c == '"') return;
+    }
+    failed_ = true;  // unterminated
+  }
+
+  void skip_container(char open, char close) {
+    if (!eat(open)) return;
+    int depth = 1;
+    while (i_ < s_.size() && depth > 0) {
+      char c = s_[i_];
+      if (c == '"') {
+        skip_string();
+        continue;
+      }
+      if (c == open) ++depth;
+      if (c == close) --depth;
+      ++i_;
+    }
+    if (depth != 0) failed_ = true;
+  }
+
+  // Decode the string the cursor sits on (must be at '"').
+  std::optional<std::string> decode_string() {
+    if (!eat('"')) return std::nullopt;
+    std::string out;
+    while (i_ < s_.size()) {
+      char c = s_[i_++];
+      if (c == '"') return out;
+      if (c != '\\') {
+        out.push_back(c);
+        continue;
+      }
+      if (i_ >= s_.size()) return std::nullopt;
+      char e = s_[i_++];
+      switch (e) {
+        case '"': out.push_back('"'); break;
+        case '\\': out.push_back('\\'); break;
+        case '/': out.push_back('/'); break;
+        case 'b': out.push_back('\b'); break;
+        case 'f': out.push_back('\f'); break;
+        case 'n': out.push_back('\n'); break;
+        case 'r': out.push_back('\r'); break;
+        case 't': out.push_back('\t'); break;
+        case 'u': {
+          uint32_t cp = 0;
+          if (!hex4(&cp)) return std::nullopt;
+          if (cp >= 0xD800 && cp <= 0xDBFF && i_ + 1 < s_.size() &&
+              s_[i_] == '\\' && s_[i_ + 1] == 'u') {
+            i_ += 2;
+            uint32_t lo = 0;
+            if (!hex4(&lo)) return std::nullopt;
+            if (lo >= 0xDC00 && lo <= 0xDFFF) {
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            } else {
+              return std::nullopt;
+            }
+          }
+          append_utf8(out, cp);
+          break;
+        }
+        default: return std::nullopt;
+      }
+    }
+    return std::nullopt;  // unterminated
+  }
+
+ private:
+  bool hex4(uint32_t* out) {
+    if (i_ + 4 > s_.size()) return false;
+    uint32_t v = 0;
+    for (int k = 0; k < 4; ++k) {
+      char c = s_[i_ + k];
+      uint32_t d;
+      if (c >= '0' && c <= '9') d = c - '0';
+      else if (c >= 'a' && c <= 'f') d = 10 + c - 'a';
+      else if (c >= 'A' && c <= 'F') d = 10 + c - 'A';
+      else return false;
+      v = (v << 4) | d;
+    }
+    i_ += 4;
+    *out = v;
+    return true;
+  }
+
+  static void append_utf8(std::string& out, uint32_t cp) {
+    if (cp < 0x80) {
+      out.push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  std::string_view s_;
+  size_t i_ = 0;
+  bool failed_ = false;
+};
+
+// Position the cursor on the value selected by `steps`; false = no match.
+bool navigate(Cursor& cur, std::vector<PathStep> const& steps) {
+  for (auto const& step : steps) {
+    cur.ws();
+    if (!step.is_index) {
+      if (!cur.eat('{')) return false;
+      bool found = false;
+      while (true) {
+        cur.ws();
+        if (cur.peek() == '}') return false;  // member absent
+        auto key = cur.decode_string();
+        if (!key.has_value()) return false;
+        cur.ws();
+        if (!cur.eat(':')) return false;
+        if (*key == step.field) {
+          found = true;
+          break;  // cursor sits on the member's value
+        }
+        cur.skip_value();
+        if (cur.fail()) return false;
+        cur.ws();
+        if (cur.peek() == ',') {
+          cur.eat(',');
+          continue;
+        }
+        return false;  // '}' or garbage: member absent / malformed
+      }
+      if (!found) return false;
+    } else {
+      if (!cur.eat('[')) return false;
+      cur.ws();
+      if (cur.peek() == ']') return false;  // empty array
+      for (int64_t k = 0; k < step.index; ++k) {
+        cur.skip_value();
+        if (cur.fail()) return false;
+        cur.ws();
+        if (!cur.eat(',')) return false;  // index out of range
+      }
+    }
+  }
+  return !cur.fail();
+}
+
+}  // namespace
+
+std::optional<std::string> get_json_object(
+    std::string_view json, std::vector<PathStep> const& steps) {
+  Cursor cur(json);
+  if (!navigate(cur, steps)) return std::nullopt;
+  cur.ws();
+  char c = cur.peek();
+  if (cur.fail()) return std::nullopt;
+  if (c == '"') {
+    return cur.decode_string();  // strings come back unquoted
+  }
+  auto [start, end] = cur.skip_value();
+  if (cur.fail() || end <= start) return std::nullopt;
+  std::string_view raw = cur.text().substr(start, end - start);
+  if (raw == "null") return std::nullopt;  // JSON null -> SQL NULL
+  return std::string(raw);
+}
+
+std::optional<std::string> get_json_object(std::string_view json,
+                                           std::string_view path) {
+  return get_json_object(json, parse_path(path));
+}
+
+}  // namespace json
+}  // namespace tpudf

@@ -1,0 +1,200 @@
+// JNI shim — the L3' bridge for the Java surface (java/src/main/java/...).
+// Same role as the reference's *Jni.cpp files: marshal handles and arrays,
+// translate C++ exceptions into Java RuntimeExceptions (the reference's
+// CATCH_STD contract, reference RowConversionJni.cpp:40,
+// NativeParquetJni.cpp:549). Compiled only where find_package(JNI)
+// succeeds (no JDK in the primary build image; the ctypes bindings cover
+// the same C++ core in CI).
+
+#include <jni.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tpudf/parquet_footer.hpp"
+#include "tpudf/row_conversion.hpp"
+
+namespace {
+
+void throw_java(JNIEnv* env, char const* msg) {
+  jclass cls = env->FindClass("java/lang/RuntimeException");
+  if (cls != nullptr) env->ThrowNew(cls, msg);
+}
+
+#define TPUDF_JNI_TRY try
+#define TPUDF_JNI_CATCH(env, ret)                \
+  catch (std::exception const& e) {              \
+    throw_java(env, e.what());                   \
+    return ret;                                  \
+  }
+
+std::vector<int32_t> to_int_vec(JNIEnv* env, jintArray arr) {
+  jsize n = env->GetArrayLength(arr);
+  std::vector<int32_t> out(n);
+  env->GetIntArrayRegion(arr, 0, n, reinterpret_cast<jint*>(out.data()));
+  return out;
+}
+
+std::vector<int64_t> to_long_vec(JNIEnv* env, jlongArray arr) {
+  jsize n = env->GetArrayLength(arr);
+  std::vector<int64_t> out(n);
+  env->GetLongArrayRegion(arr, 0, n, reinterpret_cast<jlong*>(out.data()));
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- HostMemoryBuffer -----------------------------------------------------
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_HostMemoryBuffer_hostAlloc(JNIEnv*, jclass,
+                                                            jlong bytes) {
+  return reinterpret_cast<jlong>(std::malloc(static_cast<size_t>(bytes)));
+}
+
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_HostMemoryBuffer_hostFree(JNIEnv*, jclass,
+                                                           jlong addr) {
+  std::free(reinterpret_cast<void*>(addr));
+}
+
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_HostMemoryBuffer_copyIn(JNIEnv* env, jclass,
+                                                         jlong addr,
+                                                         jbyteArray src) {
+  jsize n = env->GetArrayLength(src);
+  env->GetByteArrayRegion(src, 0, n, reinterpret_cast<jbyte*>(addr));
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_com_nvidia_spark_rapids_jni_HostMemoryBuffer_copyOut(JNIEnv* env, jclass,
+                                                          jlong addr,
+                                                          jint count) {
+  jbyteArray out = env->NewByteArray(count);
+  env->SetByteArrayRegion(out, 0, count, reinterpret_cast<jbyte const*>(addr));
+  return out;
+}
+
+// ---- ParquetFooter --------------------------------------------------------
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_ParquetFooter_readAndFilterNative(
+    JNIEnv* env, jclass, jlong addr, jlong len, jlong part_offset,
+    jlong part_length, jobjectArray names, jintArray num_children,
+    jint parent_num_children, jboolean ignore_case) {
+  TPUDF_JNI_TRY {
+    auto footer = tpudf::parquet::Footer::parse(
+        reinterpret_cast<uint8_t const*>(addr), static_cast<uint64_t>(len));
+    std::vector<std::string> name_vec;
+    jsize n = env->GetArrayLength(names);
+    for (jsize i = 0; i < n; ++i) {
+      auto jstr = static_cast<jstring>(env->GetObjectArrayElement(names, i));
+      char const* c = env->GetStringUTFChars(jstr, nullptr);
+      name_vec.emplace_back(c);
+      env->ReleaseStringUTFChars(jstr, c);
+      env->DeleteLocalRef(jstr);
+    }
+    footer.prune_columns(name_vec, to_int_vec(env, num_children),
+                         parent_num_children, ignore_case == JNI_TRUE);
+    if (part_length >= 0) {
+      footer.filter_row_groups(part_offset, part_length);
+    }
+    footer.filter_columns();
+    return reinterpret_cast<jlong>(
+        new tpudf::parquet::Footer(std::move(footer)));
+  }
+  TPUDF_JNI_CATCH(env, 0)
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_com_nvidia_spark_rapids_jni_ParquetFooter_serializeNative(JNIEnv* env,
+                                                               jclass,
+                                                               jlong handle) {
+  TPUDF_JNI_TRY {
+    auto* f = reinterpret_cast<tpudf::parquet::Footer*>(handle);
+    std::string framed = f->serialize_framed();
+    jbyteArray out = env->NewByteArray(static_cast<jsize>(framed.size()));
+    env->SetByteArrayRegion(out, 0, static_cast<jsize>(framed.size()),
+                            reinterpret_cast<jbyte const*>(framed.data()));
+    return out;
+  }
+  TPUDF_JNI_CATCH(env, nullptr)
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_ParquetFooter_numRowsNative(JNIEnv* env,
+                                                             jclass,
+                                                             jlong handle) {
+  TPUDF_JNI_TRY {
+    return reinterpret_cast<tpudf::parquet::Footer*>(handle)->num_rows();
+  }
+  TPUDF_JNI_CATCH(env, -1)
+}
+
+JNIEXPORT jint JNICALL
+Java_com_nvidia_spark_rapids_jni_ParquetFooter_numColumnsNative(JNIEnv* env,
+                                                                jclass,
+                                                                jlong handle) {
+  TPUDF_JNI_TRY {
+    return reinterpret_cast<tpudf::parquet::Footer*>(handle)->num_columns();
+  }
+  TPUDF_JNI_CATCH(env, -1)
+}
+
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_ParquetFooter_closeNative(JNIEnv*, jclass,
+                                                           jlong handle) {
+  delete reinterpret_cast<tpudf::parquet::Footer*>(handle);
+}
+
+// ---- RowConversion --------------------------------------------------------
+
+JNIEXPORT jint JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_rowSizeNative(
+    JNIEnv* env, jclass, jintArray sizes) {
+  TPUDF_JNI_TRY {
+    auto layout = tpudf::rows::fixed_width_layout(to_int_vec(env, sizes));
+    return layout.row_size;
+  }
+  TPUDF_JNI_CATCH(env, -1)
+}
+
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_toRowsNative(
+    JNIEnv* env, jclass, jlongArray data, jlongArray valid, jintArray sizes,
+    jlong num_rows, jlong out_addr) {
+  TPUDF_JNI_TRY {
+    auto d = to_long_vec(env, data);
+    auto v = to_long_vec(env, valid);
+    std::vector<uint8_t const*> dp, vp;
+    for (int64_t a : d) dp.push_back(reinterpret_cast<uint8_t const*>(a));
+    for (int64_t a : v) vp.push_back(reinterpret_cast<uint8_t const*>(a));
+    tpudf::rows::to_rows(dp.data(), vp.data(), to_int_vec(env, sizes),
+                         num_rows, reinterpret_cast<uint8_t*>(out_addr));
+    return;
+  }
+  TPUDF_JNI_CATCH(env, )
+}
+
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_fromRowsNative(
+    JNIEnv* env, jclass, jlong rows_addr, jlong num_rows, jintArray sizes,
+    jlongArray data, jlongArray valid) {
+  TPUDF_JNI_TRY {
+    auto d = to_long_vec(env, data);
+    auto v = to_long_vec(env, valid);
+    std::vector<uint8_t*> dp, vp;
+    for (int64_t a : d) dp.push_back(reinterpret_cast<uint8_t*>(a));
+    for (int64_t a : v) vp.push_back(reinterpret_cast<uint8_t*>(a));
+    tpudf::rows::from_rows(reinterpret_cast<uint8_t const*>(rows_addr),
+                           num_rows, to_int_vec(env, sizes), dp.data(),
+                           vp.data());
+    return;
+  }
+  TPUDF_JNI_CATCH(env, )
+}
+}

@@ -1,0 +1,69 @@
+#include "tpudf/row_conversion.hpp"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace tpudf {
+namespace rows {
+
+namespace {
+int32_t align_to(int32_t offset, int32_t alignment) {
+  return (offset + alignment - 1) & ~(alignment - 1);
+}
+}  // namespace
+
+Layout fixed_width_layout(std::vector<int32_t> const& sizes) {
+  Layout out;
+  int32_t at = 0;
+  for (int32_t s : sizes) {
+    if (s != 1 && s != 2 && s != 4 && s != 8) {
+      throw std::invalid_argument("fixed-width element size must be 1/2/4/8");
+    }
+    at = align_to(at, s);
+    out.start.push_back(at);
+    out.size.push_back(s);
+    at += s;
+  }
+  at += static_cast<int32_t>((sizes.size() + 7) / 8);  // validity bytes
+  out.row_size = align_to(at, 8);
+  return out;
+}
+
+void to_rows(uint8_t const* const* col_data, uint8_t const* const* col_valid,
+             std::vector<int32_t> const& sizes, int64_t n_rows, uint8_t* out) {
+  Layout const layout = fixed_width_layout(sizes);
+  size_t const n_cols = sizes.size();
+  int32_t const vbase =
+      n_cols ? layout.start[n_cols - 1] + layout.size[n_cols - 1] : 0;
+  std::memset(out, 0, static_cast<size_t>(n_rows) * layout.row_size);
+  for (int64_t r = 0; r < n_rows; ++r) {
+    uint8_t* row = out + r * layout.row_size;
+    for (size_t c = 0; c < n_cols; ++c) {
+      int32_t const w = layout.size[c];
+      std::memcpy(row + layout.start[c], col_data[c] + r * w, w);
+      bool const valid =
+          col_valid == nullptr || col_valid[c] == nullptr || col_valid[c][r];
+      if (valid) row[vbase + c / 8] |= static_cast<uint8_t>(1u << (c % 8));
+    }
+  }
+}
+
+void from_rows(uint8_t const* rows, int64_t n_rows,
+               std::vector<int32_t> const& sizes, uint8_t* const* col_data,
+               uint8_t* const* col_valid) {
+  Layout const layout = fixed_width_layout(sizes);
+  size_t const n_cols = sizes.size();
+  int32_t const vbase =
+      n_cols ? layout.start[n_cols - 1] + layout.size[n_cols - 1] : 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    uint8_t const* row = rows + r * layout.row_size;
+    for (size_t c = 0; c < n_cols; ++c) {
+      int32_t const w = layout.size[c];
+      std::memcpy(col_data[c] + r * w, row + layout.start[c], w);
+      col_valid[c][r] = (row[vbase + c / 8] >> (c % 8)) & 1;
+    }
+  }
+}
+
+}  // namespace rows
+}  // namespace tpudf

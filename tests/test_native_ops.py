@@ -1,0 +1,174 @@
+"""Native host-side ops: packed-row codec (cross-validated byte-for-byte
+against the device implementation) and get_json_object."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar.column import string_column
+from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
+from spark_rapids_jni_tpu.ops.row_conversion import (
+    compute_fixed_width_layout,
+    convert_from_rows,
+    convert_to_rows,
+)
+from spark_rapids_jni_tpu.ops.row_conversion_host import (
+    host_from_rows,
+    host_layout,
+    host_to_rows,
+)
+
+
+def _sample_table(rng, n=257):
+    """Mixed widths + nulls + decimals — the RowConversionTest.java shape."""
+    vals = {
+        t.INT8: rng.integers(-100, 100, n).astype(np.int8),
+        t.INT16: rng.integers(-(2**15), 2**15, n).astype(np.int16),
+        t.INT32: rng.integers(-(2**31), 2**31, n).astype(np.int32),
+        t.INT64: rng.integers(-(2**62), 2**62, n).astype(np.int64),
+        t.FLOAT32: rng.normal(size=n).astype(np.float32),
+        t.FLOAT64: rng.normal(size=n).astype(np.float64),
+        t.BOOL8: rng.integers(0, 2, n).astype(np.uint8),
+    }
+    cols = []
+    for dt, data in vals.items():
+        validity = rng.random(n) > 0.15
+        cols.append(Column.from_numpy(data, dt, validity=validity))
+    cols.append(
+        Column.from_numpy(
+            rng.integers(-(10**9), 10**9, n).astype(np.int64), t.decimal64(-2)
+        )
+    )
+    return Table(cols)
+
+
+def test_host_layout_matches_device_layout(rng):
+    tbl = _sample_table(rng, 8)
+    schema = tbl.schema()
+    starts, row_size = host_layout(schema)
+    d_starts, _, d_row_size = compute_fixed_width_layout(tuple(schema))
+    assert list(starts) == d_starts
+    assert row_size == d_row_size
+
+
+def test_host_and_device_row_images_identical(rng):
+    """The C++ codec and the XLA byte-layout transform must produce the
+    exact same bytes — two independent implementations of the reference
+    contract (row_conversion.cu:432-456)."""
+    tbl = _sample_table(rng)
+    host = host_to_rows(tbl)
+    batches = convert_to_rows(tbl)
+    assert len(batches) == 1
+    device = np.asarray(batches[0].data).reshape(tbl.num_rows, -1)
+    np.testing.assert_array_equal(host, device)
+
+
+def test_host_roundtrip(rng):
+    tbl = _sample_table(rng)
+    back = host_from_rows(host_to_rows(tbl), tbl.schema())
+    # null slots may differ in data; compare with null-aware equality
+    for a, b in zip(tbl.columns, back.columns):
+        av = np.asarray(a.valid_mask())
+        bv = np.asarray(b.valid_mask())
+        np.testing.assert_array_equal(av, bv)
+        np.testing.assert_array_equal(
+            np.asarray(a.data)[av], np.asarray(b.data)[bv]
+        )
+
+
+def test_host_unpacks_device_rows(rng):
+    """Cross-decode: C++ unpacks what the device packed, and vice versa."""
+    tbl = _sample_table(rng, 64)
+    device_rows = np.asarray(convert_to_rows(tbl)[0].data).reshape(64, -1)
+    back = host_from_rows(device_rows, tbl.schema())
+    for a, b in zip(tbl.columns, back.columns):
+        np.testing.assert_array_equal(
+            np.asarray(a.valid_mask()), np.asarray(b.valid_mask())
+        )
+    # and the device unpacks what C++ packed
+    from spark_rapids_jni_tpu.ops.row_conversion import RowsColumn
+    import jax.numpy as jnp
+
+    host_rows = host_to_rows(tbl)
+    rc = RowsColumn(64, host_rows.shape[1], jnp.asarray(host_rows.reshape(-1)))
+    back2 = convert_from_rows(rc, tbl.schema())
+    for a, b in zip(tbl.columns, back2.columns):
+        av = np.asarray(a.valid_mask())
+        np.testing.assert_array_equal(av, np.asarray(b.valid_mask()))
+        np.testing.assert_array_equal(
+            np.asarray(a.data)[av], np.asarray(b.data)[av]
+        )
+
+
+# ---- get_json_object -------------------------------------------------------
+
+
+def test_get_json_object_basics():
+    col = string_column(
+        [
+            '{"a": 1, "b": {"c": "hi"}}',
+            '{"b": {"c": "bye"}, "a": 2}',
+            '{"a": [10, 20, {"x": true}]}',
+            'not json',
+            None,
+            '{"other": 5}',
+        ]
+    )
+    assert get_json_object(col, "$.a").to_pylist() == [
+        "1", "2", "[10, 20, {\"x\": true}]", None, None, None,
+    ]
+    assert get_json_object(col, "$.b.c").to_pylist() == [
+        "hi", "bye", None, None, None, None,
+    ]
+    assert get_json_object(col, "$.a[1]").to_pylist() == [
+        None, None, "20", None, None, None,
+    ]
+    assert get_json_object(col, "$.a[2].x").to_pylist() == [
+        None, None, "true", None, None, None,
+    ]
+
+
+def test_get_json_object_spark_semantics():
+    col = string_column(
+        [
+            '{"s": "quoted \\"x\\" \\n tab\\t"}',   # escapes decode
+            '{"s": null}',                            # JSON null -> SQL NULL
+            '{"s": 3.25}',
+            '{"s": {"nested": [1,2]}}',
+            '{"s": "\\u00e9\\ud83d\\ude00"}',         # unicode + surrogate
+        ]
+    )
+    got = get_json_object(col, "$.s").to_pylist()
+    assert got[0] == 'quoted "x" \n tab\t'
+    assert got[1] is None
+    assert got[2] == "3.25"
+    assert got[3] == '{"nested": [1,2]}'
+    assert got[4] == "é\U0001F600"
+
+
+def test_get_json_object_bracket_fields_and_errors():
+    col = string_column(['{"a b": {"c": 7}}'])
+    assert get_json_object(col, "$['a b'].c").to_pylist() == ["7"]
+    with pytest.raises(ValueError):
+        get_json_object(col, "$.*")
+    with pytest.raises(ValueError):
+        get_json_object(col, "a.b")
+    with pytest.raises(ValueError):
+        get_json_object(col, "$.a[*]")
+
+
+def test_get_json_object_missing_and_oob():
+    col = string_column(['{"a": [1]}', '{"a": []}', "{}"])
+    assert get_json_object(col, "$.a[3]").to_pylist() == [None, None, None]
+    assert get_json_object(col, "$.zz").to_pylist() == [None, None, None]
+
+
+def test_get_json_object_bad_path_on_all_null_column():
+    """A bad path must error even when every row is NULL (path compiles
+    once per column, like Spark's analyzer)."""
+    col = string_column([None, None])
+    with pytest.raises(ValueError):
+        get_json_object(col, "$.a[1x]")
+    with pytest.raises(ValueError):
+        get_json_object(col, "$['a")

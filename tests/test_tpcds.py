@@ -68,9 +68,9 @@ def test_q72_year_filter_changes_result():
 
 def test_q64_matches_oracle():
     ss = tpcds.store_sales_table(3000, num_items=80, num_customers=400)
-    it = tpcds.item_table(80)
-    res = tpcds.tpcds_q64(ss, it)
-    got = _groups(res, [0], 1)
+    res = tpcds.tpcds_q64(ss)
+    assert int(res.join_total) <= res.out_size  # no truncation
+    got = _groups(res.result, [0], 1)
     want = tpcds.tpcds_q64_numpy(ss)
     assert got == want
     assert len(want) > 10
@@ -78,14 +78,29 @@ def test_q64_matches_oracle():
 
 def test_q64_sorted_by_count_desc():
     ss = tpcds.store_sales_table(2000, num_items=50, num_customers=300)
-    it = tpcds.item_table(50)
-    res = tpcds.tpcds_q64(ss, it)
+    res = tpcds.tpcds_q64(ss).result
     counts = [
         c for c, k in zip(res.table.column(1).to_pylist(),
                           res.table.column(0).to_pylist())
         if k is not None
     ]
     assert counts == sorted(counts, reverse=True)
+
+
+def test_q64_truncation_is_detectable():
+    """Dense duplicate pairs overflow the static cap; join_total reports it."""
+    ss = tpcds.store_sales_table(2000, num_items=3, num_customers=5)
+    res = tpcds.tpcds_q64(ss, out_factor=1)
+    assert int(res.join_total) > res.out_size
+
+
+def test_q64_base_year_anchors_dates():
+    ss = tpcds.store_sales_table(1500, num_items=40, num_customers=200)
+    # same data interpreted with a different epoch: years 2005/2006
+    res = tpcds.tpcds_q64(ss, year1=2005, year2=2006, base_year=2005)
+    want = tpcds.tpcds_q64_numpy(ss)  # oracle is epoch-2000 on days 1..730
+    got = _groups(res.result, [0], 1)
+    assert got == want
 
 
 def test_distributed_join_matches_local(rng, mesh):
@@ -129,17 +144,22 @@ def test_distributed_join_matches_local(rng, mesh):
     assert int(np.asarray(dj.total).sum()) == len(want)
 
 
-def test_distributed_left_join_no_phantom_rows(rng, mesh):
-    """Phantom shuffle slots must not surface as unmatched left-join rows."""
-    n_l, n_r = 256, 64
+@pytest.mark.parametrize("n_l", [256, 250])  # 250: shard padding on 8 devices
+def test_distributed_left_join_no_phantom_rows(rng, mesh, n_l):
+    """Neither phantom shuffle slots nor shard_table padding rows may
+    surface as unmatched left-join rows."""
+    n_r = 64
     lk = rng.integers(0, 16, n_l).astype(np.int64)
     rk = rng.integers(8, 24, n_r).astype(np.int64)  # partial overlap
     left = Table([Column.from_numpy(lk)])
     right = Table([Column.from_numpy(rk)])
+    l_sh, l_rv = shard_table(left, mesh, return_row_valid=True)
+    r_sh, r_rv = shard_table(right, mesh, return_row_valid=True)
     dj = distributed_join(
-        shard_table(left, mesh), shard_table(right, mesh), 0, 0, mesh,
+        l_sh, r_sh, 0, 0, mesh,
         out_size_per_device=n_l * 8, how="left",
-        left_capacity=n_l // 8, right_capacity=n_r // 8,
+        left_capacity=n_l // 8 + 1, right_capacity=n_r // 8 + 1,
+        left_row_valid=l_rv, right_row_valid=r_rv,
     )
     assert not np.asarray(dj.overflowed).any()
     # true left-join row count: sum over left rows of max(matches, 1)
