@@ -1,0 +1,18 @@
+#!/bin/bash
+# Nightly — role parity with reference ci/nightly-build.sh: clean rebuild,
+# full suite, all bench configs recorded to bench_nightly.jsonl.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+rm -rf build/native
+cmake -S src/native -B build/native -G Ninja
+ninja -C build/native
+./build/native/tpudf_selftest
+python build_scripts/build-info.py
+python -m pytest tests/ -q
+
+: > bench_nightly.jsonl
+for cfg in tpch_q1 tpcds_q72 row_conversion; do
+  BENCH_CONFIG=$cfg python bench.py >> bench_nightly.jsonl
+done
+cat bench_nightly.jsonl

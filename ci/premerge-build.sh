@@ -1,0 +1,23 @@
+#!/bin/bash
+# Premerge gate — role parity with reference ci/premerge-build.sh: build the
+# native core, require a real accelerator (the reference gates on nvidia-smi,
+# ci/premerge-build.sh:21; here the gate is a visible TPU/accelerator jax
+# backend unless PREMERGE_ALLOW_CPU=1), then run the full suite.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+cmake -S src/native -B build/native -G Ninja
+ninja -C build/native
+./build/native/tpudf_selftest
+
+if [[ "${PREMERGE_ALLOW_CPU:-0}" != "1" ]]; then
+  python - << 'PY'
+import jax
+backend = jax.default_backend()
+assert backend not in ("cpu",), f"premerge requires an accelerator, got {backend}"
+print(f"accelerator gate OK: {backend} x{jax.device_count()}")
+PY
+fi
+
+python build_scripts/build-info.py
+python -m pytest tests/ -x -q

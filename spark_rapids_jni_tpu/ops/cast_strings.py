@@ -18,9 +18,11 @@ rounds HALF_UP to the target scale and nulls on precision overflow.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_rapids_jni_tpu import types as t
 from spark_rapids_jni_tpu.columnar import Column
 from spark_rapids_jni_tpu.types import DType, TypeId
 from spark_rapids_jni_tpu.utils.tracing import func_range
@@ -270,3 +272,132 @@ def string_to_float(
     ok = (ok | is_inf | is_nan) & ~too_long
     signed = jnp.where(is_neg, -value, value)
     return Column(dtype, signed.astype(dtype.jnp_dtype), ok)
+
+
+# ---- number -> string (the CastStrings reverse direction) ------------------
+
+_MAX_I64_DIGITS = 20  # 19 digits + sign headroom
+
+
+@jax.jit
+def _digit_matrix_u64(mag: jnp.ndarray) -> jnp.ndarray:
+    """uint64[n] -> uint8[n, 20] decimal digits, most significant first."""
+    powers = jnp.asarray(
+        [np.uint64(10) ** np.uint64(k) for k in range(_MAX_I64_DIGITS - 1, -1, -1)],
+        dtype=jnp.uint64,
+    )
+    return ((mag[:, None] // powers[None, :]) % jnp.uint64(10)).astype(jnp.uint8)
+
+
+def _signed_magnitude(v: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    v = v.astype(jnp.int64)
+    neg = v < 0
+    # INT64_MIN-safe negation: -(v+1) fits, then +1 in uint64
+    mag = jnp.where(
+        neg, (-(v + 1)).astype(jnp.uint64) + jnp.uint64(1), v.astype(jnp.uint64)
+    )
+    return neg, mag
+
+
+@func_range("integer_to_string")
+def integer_to_string(col: Column) -> Column:
+    """Integral column -> STRING, matching Java's Long.toString (no leading
+    zeros, '-' for negatives). Digit extraction runs on device; the
+    variable-length Arrow assembly is host-side. Booleans go through
+    boolean_to_string ('true'/'false', Spark semantics)."""
+    kind = col.dtype.storage_dtype.kind
+    if (
+        kind not in ("i", "u")
+        or col.dtype.is_decimal
+        or col.dtype.type_id == TypeId.BOOL8
+    ):
+        raise TypeError(
+            "integer_to_string requires an integral column (booleans cast "
+            "via boolean_to_string)"
+        )
+    if kind == "u":
+        # unsigned stays in uint64 end to end — casting through int64 would
+        # wrap values >= 2^63 into negatives
+        neg = jnp.zeros(col.data.shape, jnp.bool_)
+        mag = col.data.astype(jnp.uint64)
+    else:
+        neg, mag = _signed_magnitude(col.data.astype(jnp.int64))
+    digits = np.asarray(_digit_matrix_u64(mag))
+    neg = np.asarray(neg)
+    valid = np.asarray(col.valid_mask())
+    return _assemble_decimal_strings(digits, neg, valid, scale=0)
+
+
+@func_range("boolean_to_string")
+def boolean_to_string(col: Column) -> Column:
+    """BOOL8 -> STRING: 'true'/'false' (Spark cast semantics)."""
+    if col.dtype.type_id != TypeId.BOOL8:
+        raise TypeError("boolean_to_string requires a BOOL8 column")
+    vals = np.asarray(col.data) != 0
+    valid = np.asarray(col.valid_mask())
+    pieces = [
+        (b"true" if v else b"false") if ok else b""
+        for v, ok in zip(vals, valid)
+    ]
+    offsets = np.zeros(len(pieces) + 1, dtype=np.int32)
+    np.cumsum([len(p) for p in pieces], out=offsets[1:])
+    chars = np.frombuffer(b"".join(pieces), dtype=np.uint8)
+    return Column(
+        t.STRING,
+        jnp.asarray(offsets),
+        None if valid.all() else jnp.asarray(valid),
+        chars=jnp.asarray(chars.copy() if chars.size else np.zeros(0, np.uint8)),
+    )
+
+
+@func_range("decimal_to_string")
+def decimal_to_string(col: Column) -> Column:
+    """Decimal column -> STRING with Spark's plain representation:
+    scale -2, unscaled 5 -> "0.05"; scale 0 behaves like integers."""
+    if not col.dtype.is_decimal:
+        raise TypeError("decimal_to_string requires a decimal column")
+    if col.dtype.scale > 0:
+        # value = unscaled * 10^scale with scale > 0 needs trailing zeros,
+        # not a fraction — unsupported rather than silently wrong
+        raise NotImplementedError(
+            "positive decimal scales are not supported by decimal_to_string"
+        )
+    neg, mag = _signed_magnitude(col.data)
+    digits = np.asarray(_digit_matrix_u64(mag))
+    neg = np.asarray(neg)
+    valid = np.asarray(col.valid_mask())
+    return _assemble_decimal_strings(digits, neg, valid, scale=-col.dtype.scale)
+
+
+def _assemble_decimal_strings(
+    digits: np.ndarray, neg: np.ndarray, valid: np.ndarray, scale: int
+) -> Column:
+    """Host assembly: digit rows -> Arrow string column. ``scale`` is the
+    number of fractional digits (>= 0)."""
+    n = digits.shape[0]
+    pieces: list[bytes] = []
+    lengths = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        if not valid[i]:
+            pieces.append(b"")
+            continue
+        ds = digits[i]
+        s = bytes(ds + ord("0")).lstrip(b"0")
+        if scale > 0:
+            s = s.rjust(scale + 1, b"0")  # ensure a digit before the dot
+            s = s[:-scale] + b"." + s[-scale:]
+        elif not s:
+            s = b"0"
+        if neg[i]:
+            s = b"-" + s
+        pieces.append(s)
+        lengths[i] = len(s)
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    chars = np.frombuffer(b"".join(pieces), dtype=np.uint8)
+    return Column(
+        t.STRING,
+        jnp.asarray(offsets),
+        None if valid.all() else jnp.asarray(valid),
+        chars=jnp.asarray(chars.copy() if chars.size else np.zeros(0, np.uint8)),
+    )

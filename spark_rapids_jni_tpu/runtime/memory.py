@@ -1,0 +1,173 @@
+"""Device/host memory management — the RMM-equivalent layer.
+
+The reference leans on RMM for device memory pools, per-thread streams and
+an ``RMM_LOGGING_LEVEL`` knob (reference pom.xml:82, CMakeLists.txt:56-63;
+rmm::device_uvector use throughout row_conversion.cu). On TPU the HBM
+allocator itself belongs to XLA — JAX arrays live in XLA's BFC arena, and
+re-implementing that would fight the runtime. What this layer provides is
+the part of RMM's surface a Spark executor actually interacts with:
+
+  * ``device_memory_stats()`` — live/peak/limit HBM numbers per device
+    (RMM's ``mr.get_info`` role) for spill decisions and telemetry;
+  * ``MemoryLimiter`` — a soft budget gate: reserve/release accounting
+    with the same fail-fast contract as a capped RMM pool, used by the
+    chunked reader to size batches;
+  * ``HostStagingPool`` — recycled pinned-style host buffers for the
+    parquet/IO staging path (the role of RMM's pinned-host pool), a size-
+    class freelist so repeated chunked reads stop hammering the allocator;
+  * allocation logging behind ``memory.log_level``
+    (env SPARK_RAPIDS_TPU_MEMORY_LOG_LEVEL) — RMM_LOGGING_LEVEL parity.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_jni_tpu.utils.config import get_option
+from spark_rapids_jni_tpu.utils.log import get_logger
+
+_log = get_logger("spark_rapids_jni_tpu.memory")
+
+
+@dataclass(frozen=True)
+class DeviceMemoryStats:
+    bytes_in_use: int
+    peak_bytes_in_use: int
+    bytes_limit: int
+
+    @property
+    def bytes_free(self) -> int:
+        return max(self.bytes_limit - self.bytes_in_use, 0)
+
+
+def device_memory_stats(device=None) -> DeviceMemoryStats:
+    """Live HBM stats from the XLA allocator (zeros when the backend does
+    not report — e.g. some CPU builds)."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    stats = {}
+    try:
+        stats = device.memory_stats() or {}
+    except (RuntimeError, AttributeError):
+        pass
+    return DeviceMemoryStats(
+        bytes_in_use=int(stats.get("bytes_in_use", 0)),
+        peak_bytes_in_use=int(stats.get("peak_bytes_in_use", 0)),
+        bytes_limit=int(stats.get("bytes_limit", 0)),
+    )
+
+
+class MemoryLimitExceeded(MemoryError):
+    pass
+
+
+class MemoryLimiter:
+    """Soft budget gate with capped-pool semantics: ``reserve`` beyond the
+    budget raises (fail-fast, like a capped RMM pool) instead of letting a
+    giant batch OOM the device mid-kernel."""
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes <= 0:
+            raise ValueError("budget must be positive")
+        self.budget = int(budget_bytes)
+        self._used = 0
+        self._peak = 0
+        self._lock = threading.Lock()
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    @property
+    def peak(self) -> int:
+        return self._peak
+
+    def reserve(self, nbytes: int) -> None:
+        with self._lock:
+            if self._used + nbytes > self.budget:
+                raise MemoryLimitExceeded(
+                    f"reservation of {nbytes} bytes exceeds budget "
+                    f"({self._used}/{self.budget} in use)"
+                )
+            self._used += nbytes
+            self._peak = max(self._peak, self._used)
+            if get_option("memory.log_level") >= 2:
+                _log.info("reserve %d bytes (%d in use)", nbytes, self._used)
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self._used = max(self._used - nbytes, 0)
+            if get_option("memory.log_level") >= 2:
+                _log.info("release %d bytes (%d in use)", nbytes, self._used)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        with self._lock:
+            self._used = 0
+        return False
+
+
+class HostStagingPool:
+    """Freelist of host staging buffers, bucketed by power-of-two size.
+
+    ``take(nbytes)`` returns a uint8 array of at least nbytes (callers
+    slice); ``give(buf)`` recycles it. Thread-safe; bounded per bucket so a
+    burst cannot pin unbounded host memory."""
+
+    def __init__(self, max_buffers_per_class: int = 8):
+        self._free: dict[int, list[np.ndarray]] = {}
+        self._max = max_buffers_per_class
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _size_class(nbytes: int) -> int:
+        return 1 << max(int(nbytes - 1).bit_length(), 6)  # min 64B
+
+    def take(self, nbytes: int) -> np.ndarray:
+        cls = self._size_class(max(nbytes, 1))
+        with self._lock:
+            bucket = self._free.get(cls)
+            if bucket:
+                self.hits += 1
+                return bucket.pop()
+            self.misses += 1
+        if get_option("memory.log_level") >= 1:
+            _log.info("staging alloc %d bytes (class %d)", nbytes, cls)
+        return np.empty(cls, dtype=np.uint8)
+
+    def give(self, buf: np.ndarray) -> None:
+        cls = int(buf.nbytes)
+        # only recycle buffers this pool could have produced: uint8,
+        # power-of-two size, at least the minimum size class
+        if buf.dtype != np.uint8 or cls < 64 or cls & (cls - 1):
+            return
+        with self._lock:
+            bucket = self._free.setdefault(cls, [])
+            if len(bucket) < self._max:
+                bucket.append(buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._free.clear()
+
+
+_default_pool: Optional[HostStagingPool] = None
+_default_pool_lock = threading.Lock()
+
+
+def default_staging_pool() -> HostStagingPool:
+    global _default_pool
+    with _default_pool_lock:
+        if _default_pool is None:
+            _default_pool = HostStagingPool()
+        return _default_pool

@@ -24,6 +24,9 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     "row_conversion.enforce_row_limit": (True, bool),
     # Log level for the thin runtime logger (slf4j-equivalent).
     "log.level": ("WARNING", str),
+    # Memory-layer allocation logging: 0 = off (RMM_LOGGING_LEVEL default
+    # OFF parity, reference pom.xml:82), 1 = staging allocs, 2 = +reserves.
+    "memory.log_level": (0, int),
 }
 
 _overrides: dict[str, Any] = {}

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column
 from spark_rapids_jni_tpu.columnar.column import string_column
 from spark_rapids_jni_tpu.ops.cast_strings import (
     string_to_decimal,
@@ -174,3 +175,80 @@ def test_float_cast_huge_exponent_saturates():
     assert vals[0] == np.inf
     assert vals[1] == -np.inf
     assert vals[2] == 0.0
+
+
+# ---- number -> string ------------------------------------------------------
+
+
+def test_integer_to_string_matches_java():
+    from spark_rapids_jni_tpu.ops.cast_strings import integer_to_string
+
+    vals = [0, 1, -1, 42, -999, 2**62, -(2**63), 2**63 - 1, None, 10**18]
+    col = Column.from_pylist(vals, t.INT64)
+    got = integer_to_string(col).to_pylist()
+    want = [None if v is None else str(v) for v in vals]
+    assert got == want
+
+
+def test_integer_to_string_narrow_types(rng):
+    from spark_rapids_jni_tpu.ops.cast_strings import integer_to_string
+
+    for dt, lo, hi in [(t.INT8, -128, 127), (t.INT16, -(2**15), 2**15 - 1),
+                       (t.INT32, -(2**31), 2**31 - 1)]:
+        vals = [int(x) for x in rng.integers(lo, hi + 1, 50)] + [lo, hi, 0]
+        col = Column.from_pylist(vals, dt)
+        assert integer_to_string(col).to_pylist() == [str(v) for v in vals]
+
+
+def test_decimal_to_string_plain():
+    from spark_rapids_jni_tpu.ops.cast_strings import decimal_to_string
+
+    col = Column.from_pylist([5, -5, 12345, -10001, 0, None, 100],
+                             t.decimal64(-2))
+    got = decimal_to_string(col).to_pylist()
+    assert got == ["0.05", "-0.05", "123.45", "-100.01", "0.00", None, "1.00"]
+
+
+def test_decimal_to_string_scale_zero_and_roundtrip(rng):
+    from spark_rapids_jni_tpu.ops.cast_strings import (
+        decimal_to_string,
+        string_to_decimal,
+    )
+
+    col = Column.from_pylist([7, -3, 0], t.decimal64(0))
+    assert decimal_to_string(col).to_pylist() == ["7", "-3", "0"]
+    # round trip through text at scale -4
+    vals = [int(x) for x in rng.integers(-(10**10), 10**10, 200)]
+    dcol = Column.from_pylist(vals, t.decimal64(-4))
+    text = decimal_to_string(dcol)
+    back = string_to_decimal(text, t.decimal64(-4))
+    assert back.to_pylist() == vals
+
+
+def test_uint64_to_string_above_2_63():
+    from spark_rapids_jni_tpu.ops.cast_strings import integer_to_string
+
+    vals = [2**63, 2**64 - 1, 0, 12345]
+    col = Column.from_pylist(vals, t.UINT64)
+    assert integer_to_string(col).to_pylist() == [str(v) for v in vals]
+
+
+def test_boolean_to_string_spark_semantics():
+    from spark_rapids_jni_tpu.ops.cast_strings import (
+        boolean_to_string,
+        integer_to_string,
+    )
+
+    col = Column.from_pylist([True, False, None], t.BOOL8)
+    assert boolean_to_string(col).to_pylist() == ["true", "false", None]
+    with pytest.raises(TypeError):
+        integer_to_string(col)
+
+
+def test_decimal_to_string_positive_scale_rejected():
+    from spark_rapids_jni_tpu.ops.cast_strings import decimal_to_string
+    from spark_rapids_jni_tpu.types import DType, TypeId
+
+    col = Column.from_pylist([5], DType(TypeId.DECIMAL64, 2))
+    with pytest.raises(NotImplementedError):
+        decimal_to_string(col)

@@ -67,3 +67,47 @@ def test_zero_column_table_clear_error():
 
     with pytest.raises(ValueError, match="at least one column"):
         convert_to_rows(Table([]))
+
+
+# ---- memory layer (RMM-equivalent) -----------------------------------------
+
+
+def test_memory_limiter_caps_and_tracks():
+    from spark_rapids_jni_tpu.runtime.memory import (
+        MemoryLimiter,
+        MemoryLimitExceeded,
+    )
+
+    lim = MemoryLimiter(1000)
+    lim.reserve(600)
+    lim.reserve(300)
+    assert lim.used == 900 and lim.peak == 900
+    try:
+        lim.reserve(200)
+        assert False, "expected MemoryLimitExceeded"
+    except MemoryLimitExceeded:
+        pass
+    lim.release(500)
+    lim.reserve(400)
+    assert lim.used == 800 and lim.peak == 900
+
+
+def test_host_staging_pool_recycles():
+    from spark_rapids_jni_tpu.runtime.memory import HostStagingPool
+
+    pool = HostStagingPool()
+    a = pool.take(1000)
+    assert a.nbytes == 1024  # rounded to size class
+    pool.give(a)
+    b = pool.take(900)
+    assert b is a  # recycled
+    assert pool.hits == 1 and pool.misses == 1
+
+
+def test_device_memory_stats_shape():
+    from spark_rapids_jni_tpu.runtime.memory import device_memory_stats
+
+    s = device_memory_stats()
+    assert s.bytes_in_use >= 0
+    assert s.peak_bytes_in_use >= s.bytes_in_use or s.peak_bytes_in_use == 0
+    assert s.bytes_free >= 0

@@ -241,3 +241,79 @@ def test_hash_shuffle_overflow_drops_not_corrupts(rng, mesh):
     assert len(np.unique(dev_of_slot[rv])) == 1
     # each source kept exactly `capacity` rows for the hot destination
     assert rv.sum() == 8 * 4
+
+
+def test_hash_shuffle_wire_narrowing(rng, mesh):
+    """nvcomp-equivalent transport compression: values that fit the wire
+    type round-trip exactly; too-narrow declarations are detected."""
+    n = 256
+    small = rng.integers(-30000, 30000, n).astype(np.int64)
+    big = rng.integers(2**40, 2**41, n).astype(np.int64)
+    tbl = Table([
+        Column.from_numpy(small, t.INT64),
+        Column.from_numpy(big, t.INT64),
+    ])
+    sharded = shard_table(tbl, mesh)
+
+    def step(local, wire):
+        r = hash_shuffle(local, [0], EXEC_AXIS, capacity=local.num_rows,
+                         wire_dtypes=wire)
+        return r.table, r.row_valid, r.narrowing_overflow.reshape(1)
+
+    from functools import partial
+
+    # int16 wire for the small column: lossless, flag clear
+    out, rv, nov = jax.jit(
+        jax.shard_map(
+            partial(step, wire=[t.INT16, None]),
+            mesh=mesh, in_specs=(P(EXEC_AXIS),),
+            out_specs=(P(EXEC_AXIS),) * 3,
+        )
+    )(sharded)
+    assert not np.asarray(nov).any()
+    rv = np.asarray(rv)
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(out.column(0).data)[rv]), np.sort(small)
+    )
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(out.column(1).data)[rv]), np.sort(big)
+    )
+
+    # int16 wire for the big column: detected
+    _, _, nov2 = jax.jit(
+        jax.shard_map(
+            partial(step, wire=[None, t.INT16]),
+            mesh=mesh, in_specs=(P(EXEC_AXIS),),
+            out_specs=(P(EXEC_AXIS),) * 3,
+        )
+    )(sharded)
+    assert np.asarray(nov2).any()
+
+
+def test_wire_narrowing_ignores_null_garbage(rng, mesh):
+    """Garbage payloads in null slots must not trip narrowing_overflow."""
+    n = 256
+    data = rng.integers(-100, 100, n).astype(np.int64)
+    valid = np.ones(n, dtype=bool)
+    data[::7] = 2**40  # garbage in slots that are null
+    valid[::7] = False
+    tbl = Table([
+        Column.from_numpy(rng.integers(0, 8, n).astype(np.int64), t.INT64),
+        Column.from_numpy(data, t.INT64, validity=valid),
+    ])
+    sharded = shard_table(tbl, mesh)
+
+    def step(local):
+        r = hash_shuffle(local, [0], EXEC_AXIS, capacity=local.num_rows,
+                         wire_dtypes=[None, t.INT16])
+        return r.table, r.row_valid, r.narrowing_overflow.reshape(1)
+
+    out, rv, nov = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
+                      out_specs=(P(EXEC_AXIS),) * 3)
+    )(sharded)
+    assert not np.asarray(nov).any()
+    rv = np.asarray(rv)
+    got = np.asarray(out.column(1).data)[rv]
+    ok = np.asarray(out.column(1).valid_mask())[rv]
+    np.testing.assert_array_equal(np.sort(got[ok]), np.sort(data[valid]))
