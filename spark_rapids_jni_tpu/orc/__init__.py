@@ -1,0 +1,7 @@
+from spark_rapids_jni_tpu.orc.reader import (
+    OrcChunkedReader,
+    read_table,
+    stripe_info,
+)
+
+__all__ = ["OrcChunkedReader", "read_table", "stripe_info"]

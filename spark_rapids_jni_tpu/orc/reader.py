@@ -1,0 +1,191 @@
+"""ORC reader — native stripe decode staged into device tables.
+
+The ORC half of the vendored "Parquet/ORC readers incl. chunked reads"
+capability (SURVEY.md section 2.2; the reference links cuDF's ORC reader
+into libcudf, build-libcudf.xml:34-60). Decode is C++
+(src/native/src/orc_reader.cpp); chunked reads iterate stripes under a
+byte budget — the stripe is ORC's row-group analogue.
+
+Type mapping (ORC kind -> DType):
+  BOOLEAN -> BOOL8        BYTE -> INT8       SHORT -> INT16
+  INT -> INT32            LONG -> INT64      FLOAT/DOUBLE -> FLOAT32/64
+  STRING/VARCHAR/CHAR -> STRING              DATE -> TIMESTAMP_DAYS
+  DECIMAL(p<=18, s) -> decimal64(-s)
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.parquet.footer import NativeError
+from spark_rapids_jni_tpu.runtime.native import load_native
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+_K_BOOLEAN, _K_BYTE, _K_SHORT, _K_INT, _K_LONG = 0, 1, 2, 3, 4
+_K_FLOAT, _K_DOUBLE, _K_STRING = 5, 6, 7
+_K_DECIMAL, _K_DATE, _K_VARCHAR, _K_CHAR = 14, 15, 16, 17
+
+_STRING_KINDS = (_K_STRING, _K_VARCHAR, _K_CHAR)
+
+
+def _map_dtype(kind: int, scale: int):
+    return {
+        _K_BOOLEAN: t.BOOL8,
+        _K_BYTE: t.INT8,
+        _K_SHORT: t.INT16,
+        _K_INT: t.INT32,
+        _K_LONG: t.INT64,
+        _K_FLOAT: t.FLOAT32,
+        _K_DOUBLE: t.FLOAT64,
+        _K_STRING: t.STRING,
+        _K_VARCHAR: t.STRING,
+        _K_CHAR: t.STRING,
+        _K_DATE: t.TIMESTAMP_DAYS,
+        _K_DECIMAL: t.decimal64(-scale),
+    }[kind]
+
+
+def _check(lib, ok: bool, what: str) -> None:
+    if not ok:
+        raise NativeError(f"{what}: {lib.last_error()}")
+
+
+def _i32_array(vals: Optional[Sequence[int]]):
+    if vals is None:
+        return None, 0
+    arr = (ctypes.c_int32 * len(vals))(*vals)
+    return arr, len(vals)
+
+
+def stripe_info(data: bytes) -> list[tuple[int, int]]:
+    """[(num_rows, data_bytes)] per stripe — the chunk-planning probe."""
+    lib = load_native()
+    cap = 4096
+    while True:
+        nr = (ctypes.c_int64 * cap)()
+        bs = (ctypes.c_int64 * cap)()
+        n = lib.tpudf_orc_stripes(data, len(data), nr, bs, cap)
+        _check(lib, n >= 0, "stripe_info")
+        if n <= cap:
+            return [(nr[i], bs[i]) for i in range(n)]
+        cap = n
+
+
+@func_range("orc_read_table")
+def read_table(
+    data: bytes,
+    columns: Optional[Sequence[int]] = None,
+    stripes: Optional[Sequence[int]] = None,
+) -> Table:
+    """Decode a complete in-memory ORC file into a device Table.
+    None selects all columns/stripes; an empty list selects none."""
+    lib = load_native()
+    cols, n_cols = _i32_array(columns)
+    sts, n_sts = _i32_array(stripes)
+    handle = lib.tpudf_orc_read(data, len(data), cols, n_cols, sts, n_sts)
+    _check(lib, handle != 0, "orc read")
+    try:
+        n_columns = lib.tpudf_orc_num_columns(handle)
+        _check(lib, n_columns >= 0, "num_columns")
+        out = []
+        for i in range(n_columns):
+            meta = (ctypes.c_int32 * 4)()
+            sizes = (ctypes.c_int64 * 2)()
+            _check(lib, lib.tpudf_orc_col_meta(handle, i, meta, sizes) == 0,
+                   "col_meta")
+            kind, _prec, scale, has_valid = list(meta)
+            num_rows, chars_bytes = list(sizes)
+            dtype = _map_dtype(kind, scale)
+
+            vbuf = np.empty(num_rows, dtype=np.uint8) if has_valid else None
+            validity = None
+            if kind in _STRING_KINDS:
+                offsets = np.empty(num_rows + 1, dtype=np.int32)
+                chars = np.empty(max(chars_bytes, 1), dtype=np.uint8)
+                _check(
+                    lib,
+                    lib.tpudf_orc_col_copy(
+                        handle, i, None,
+                        offsets.ctypes.data_as(ctypes.c_void_p),
+                        chars.ctypes.data_as(ctypes.c_void_p),
+                        None if vbuf is None
+                        else vbuf.ctypes.data_as(ctypes.c_void_p),
+                    ) == 0,
+                    "col_copy",
+                )
+                if vbuf is not None:
+                    validity = jnp.asarray(vbuf.astype(bool))
+                out.append(
+                    Column(dtype, jnp.asarray(offsets), validity,
+                           chars=jnp.asarray(chars[:chars_bytes]))
+                )
+                continue
+
+            raw = np.empty(max(num_rows, 1), dtype=np.int64)
+            _check(
+                lib,
+                lib.tpudf_orc_col_copy(
+                    handle, i, raw.ctypes.data_as(ctypes.c_void_p), None,
+                    None,
+                    None if vbuf is None
+                    else vbuf.ctypes.data_as(ctypes.c_void_p),
+                ) == 0,
+                "col_copy",
+            )
+            raw = raw[:num_rows]
+            if vbuf is not None:
+                validity = jnp.asarray(vbuf.astype(bool))
+            if kind == _K_FLOAT:
+                values = raw.astype(np.uint32).view(np.float32)
+            elif kind == _K_DOUBLE:
+                values = raw.view(np.uint64).view(np.float64)
+            else:
+                values = raw.astype(dtype.storage_dtype, copy=False)
+            out.append(Column(dtype, jnp.asarray(values), validity))
+        return Table(out)
+    finally:
+        lib.tpudf_orc_close(handle)
+
+
+class OrcChunkedReader:
+    """Iterate an ORC file as Tables bounded by a byte budget — chunk
+    boundaries at stripe granularity, always at least one stripe."""
+
+    def __init__(
+        self,
+        data: bytes,
+        chunk_read_limit: int,
+        columns: Optional[Sequence[int]] = None,
+    ):
+        self._data = data
+        self._columns = list(columns) if columns is not None else None
+        self._limit = max(int(chunk_read_limit), 1)
+        self._infos = stripe_info(data)
+        self._next = 0
+
+    def has_next(self) -> bool:
+        return self._next < len(self._infos)
+
+    def read_chunk(self) -> Table:
+        if not self.has_next():
+            raise StopIteration
+        start = self._next
+        total = 0
+        end = start
+        while end < len(self._infos):
+            total += self._infos[end][1]
+            if end > start and total > self._limit:
+                break
+            end += 1
+        self._next = end
+        return read_table(self._data, self._columns, list(range(start, end)))
+
+    def __iter__(self) -> Iterator[Table]:
+        while self.has_next():
+            yield self.read_chunk()

@@ -106,6 +106,56 @@ class NativeLib:
         ]
         c.tpudf_read_close.restype = ctypes.c_int32
         c.tpudf_read_close.argtypes = [ctypes.c_int64]
+        # ORC reader
+        c.tpudf_orc_read.restype = ctypes.c_int64
+        c.tpudf_orc_read.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        c.tpudf_orc_stripes.restype = ctypes.c_int32
+        c.tpudf_orc_stripes.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+        ]
+        c.tpudf_orc_num_columns.restype = ctypes.c_int32
+        c.tpudf_orc_num_columns.argtypes = [ctypes.c_int64]
+        c.tpudf_orc_num_rows.restype = ctypes.c_int64
+        c.tpudf_orc_num_rows.argtypes = [ctypes.c_int64]
+        c.tpudf_orc_col_meta.restype = ctypes.c_int32
+        c.tpudf_orc_col_meta.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        c.tpudf_orc_col_name.restype = ctypes.c_char_p
+        c.tpudf_orc_col_name.argtypes = [ctypes.c_int64, ctypes.c_int32]
+        c.tpudf_orc_col_copy.restype = ctypes.c_int32
+        c.tpudf_orc_col_copy.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        c.tpudf_orc_close.restype = ctypes.c_int32
+        c.tpudf_orc_close.argtypes = [ctypes.c_int64]
+        c.tpudf_orc_decode_rle2.restype = ctypes.c_int32
+        c.tpudf_orc_decode_rle2.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+        ]
         # host packed-row codec
         c.tpudf_rows_layout.restype = ctypes.c_int32
         c.tpudf_rows_layout.argtypes = [

@@ -1,0 +1,88 @@
+// ORC reader (the ORC half of "Parquet/ORC readers incl. chunked reads" in
+// the vendored capability surface, SURVEY.md section 2.2 — the reference
+// ships cuDF's ORC reader inside libcudf, build-libcudf.xml:34-60).
+//
+// CPU decode -> Arrow-layout host buffers; chunking at stripe granularity
+// (the ORC analogue of row groups). Metadata is protobuf
+// (protobuf_wire.hpp); all field/enum numbers follow the public
+// orc_proto.proto spec.
+//
+// Supported subset (explicit errors otherwise):
+//   * flat struct root of primitive columns: BOOLEAN, BYTE, SHORT, INT,
+//     LONG, FLOAT, DOUBLE, STRING (direct + dictionary), DATE, DECIMAL
+//     (<= 18 digits)
+//   * integer encodings RLEv1 and RLEv2 (short-repeat / direct / delta /
+//     patched-base), byte RLE, boolean RLE
+//   * compression NONE, ZLIB, SNAPPY (ORC 3-byte chunk framing)
+
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace tpudf {
+namespace orc {
+
+// orc_proto.proto Type::Kind values.
+enum class Kind : int32_t {
+  BOOLEAN = 0,
+  BYTE = 1,
+  SHORT = 2,
+  INT = 3,
+  LONG = 4,
+  FLOAT = 5,
+  DOUBLE = 6,
+  STRING = 7,
+  BINARY = 8,
+  TIMESTAMP = 9,
+  LIST = 10,
+  MAP = 11,
+  STRUCT = 12,
+  UNION = 13,
+  DECIMAL = 14,
+  DATE = 15,
+  VARCHAR = 16,
+  CHAR = 17,
+};
+
+struct OrcColumn {
+  std::string name;
+  int32_t kind = 0;          // Kind enum value
+  int32_t precision = 0;     // DECIMAL
+  int32_t scale = 0;         // DECIMAL
+  int64_t num_rows = 0;
+  // numeric/boolean/date/decimal payload: int64 per row (floats bit-stored
+  // as their IEEE pattern in i64 for FLOAT/DOUBLE -- python bitcasts back)
+  std::vector<int64_t> data;
+  // STRING payload
+  std::vector<int32_t> offsets;
+  std::vector<uint8_t> chars;
+  std::vector<uint8_t> validity;  // empty = all valid
+};
+
+struct OrcResult {
+  int64_t num_rows = 0;
+  std::vector<OrcColumn> columns;
+};
+
+struct StripeInfo {
+  int64_t num_rows = 0;
+  int64_t data_bytes = 0;
+};
+
+std::vector<StripeInfo> stripe_infos(uint8_t const* file, uint64_t len);
+
+// Decode selected columns / stripes. nullopt = all, empty list = none
+// (same selection contract as the parquet reader).
+OrcResult read_file(uint8_t const* file, uint64_t len,
+                    std::optional<std::vector<int32_t>> const& columns,
+                    std::optional<std::vector<int32_t>> const& stripes);
+
+// RLEv2 decoder exposed for spec-vector tests.
+std::vector<int64_t> decode_rle_v2(uint8_t const* p, uint64_t len,
+                                   int64_t count, bool is_signed);
+
+}  // namespace orc
+}  // namespace tpudf

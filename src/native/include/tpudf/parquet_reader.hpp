@@ -82,7 +82,11 @@ ReadResult read_file(uint8_t const* file, uint64_t len,
                      std::optional<std::vector<int32_t>> const& column_indices,
                      std::optional<std::vector<int32_t>> const& row_group_indices);
 
-// Raw snappy block-format decompressor (exposed for tests).
+// Raw snappy block-format decompressor (exposed for tests and the ORC
+// reader). Pass kSnappyNoExpectedSize when the container format carries no
+// independent uncompressed size to cross-check (ORC); parquet callers pass
+// the page header's declared size.
+constexpr uint64_t kSnappyNoExpectedSize = ~0ull;
 std::vector<uint8_t> snappy_uncompress(uint8_t const* in, uint64_t n,
                                        uint64_t expected_out);
 

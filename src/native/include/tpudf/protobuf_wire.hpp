@@ -1,0 +1,53 @@
+// Generic protobuf wire-format reader — the ORC metadata counterpart of the
+// generic thrift codec (thrift_compact.hpp): ORC footers are protobuf
+// messages (postscript/footer/stripe footer), parsed here into a tagged
+// field multimap by field number, with no protoc or generated code in the
+// build. Unknown fields are preserved; nested messages are lazily reparsed
+// from their bytes.
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace tpudf {
+namespace pb {
+
+enum class WireType : uint8_t {
+  VARINT = 0,
+  FIXED64 = 1,
+  BYTES = 2,
+  FIXED32 = 5,
+};
+
+struct PbField {
+  uint32_t number = 0;
+  WireType type = WireType::VARINT;
+  uint64_t varint = 0;      // VARINT / FIXED64 / FIXED32 payloads
+  std::string_view bytes;   // BYTES payload (view into the parsed buffer)
+};
+
+// One parsed message: fields in wire order (repeated fields appear once per
+// occurrence). Views point into the caller's buffer — keep it alive.
+class Message {
+ public:
+  static Message parse(uint8_t const* buf, uint64_t len);
+
+  // First field with this number (nullptr if absent).
+  PbField const* field(uint32_t number) const;
+  // All occurrences (for repeated fields).
+  std::vector<PbField const*> fields(uint32_t number) const;
+
+  uint64_t u64(uint32_t number, uint64_t dflt = 0) const;
+  std::string_view bytes(uint32_t number) const;  // empty if absent
+
+  std::vector<PbField> const& all() const { return fields_; }
+
+ private:
+  std::vector<PbField> fields_;
+};
+
+}  // namespace pb
+}  // namespace tpudf

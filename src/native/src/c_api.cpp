@@ -21,6 +21,7 @@
 #include <vector>
 
 #include "tpudf/get_json_object.hpp"
+#include "tpudf/orc_reader.hpp"
 #include "tpudf/parquet_footer.hpp"
 #include "tpudf/parquet_reader.hpp"
 #include "tpudf/row_conversion.hpp"
@@ -75,6 +76,11 @@ Registry<tpudf::parquet::Footer>& footers() {
 
 Registry<tpudf::parquet::ReadResult>& reads() {
   static Registry<tpudf::parquet::ReadResult> r;
+  return r;
+}
+
+Registry<tpudf::orc::OrcResult>& orc_reads() {
+  static Registry<tpudf::orc::OrcResult> r;
   return r;
 }
 
@@ -305,6 +311,136 @@ int32_t tpudf_read_close(int64_t handle) {
   return 0;
 }
 
+// ---- ORC reader (chunked at stripe granularity) ---------------------------
+
+int64_t tpudf_orc_read(uint8_t const* buf, uint64_t len, int32_t const* cols,
+                       int32_t n_cols, int32_t const* stripes,
+                       int32_t n_stripes) {
+  try {
+    std::optional<std::vector<int32_t>> col_vec;
+    if (cols != nullptr) col_vec.emplace(cols, cols + n_cols);
+    std::optional<std::vector<int32_t>> st_vec;
+    if (stripes != nullptr) st_vec.emplace(stripes, stripes + n_stripes);
+    auto res = std::make_shared<tpudf::orc::OrcResult>(
+        tpudf::orc::read_file(buf, len, col_vec, st_vec));
+    return orc_reads().put(std::move(res));
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return 0;
+  }
+}
+
+int32_t tpudf_orc_stripes(uint8_t const* buf, uint64_t len, int64_t* num_rows,
+                          int64_t* byte_size, int32_t cap) {
+  try {
+    auto infos = tpudf::orc::stripe_infos(buf, len);
+    for (int32_t i = 0; i < cap && i < static_cast<int32_t>(infos.size());
+         ++i) {
+      num_rows[i] = infos[i].num_rows;
+      byte_size[i] = infos[i].data_bytes;
+    }
+    return static_cast<int32_t>(infos.size());
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+int32_t tpudf_orc_num_columns(int64_t handle) {
+  auto r = orc_reads().get(handle);
+  if (r == nullptr) {
+    set_error("invalid orc read handle");
+    return -1;
+  }
+  return static_cast<int32_t>(r->columns.size());
+}
+
+int64_t tpudf_orc_num_rows(int64_t handle) {
+  auto r = orc_reads().get(handle);
+  if (r == nullptr) {
+    set_error("invalid orc read handle");
+    return -1;
+  }
+  return r->num_rows;
+}
+
+// meta = [kind, precision, scale, has_validity] (4 int32); sizes =
+// [num_rows, chars_bytes] (2 int64).
+int32_t tpudf_orc_col_meta(int64_t handle, int32_t i, int32_t* meta,
+                           int64_t* sizes) {
+  auto r = orc_reads().get(handle);
+  if (r == nullptr || i < 0 || i >= static_cast<int32_t>(r->columns.size())) {
+    set_error("invalid orc read handle or column index");
+    return -1;
+  }
+  auto const& c = r->columns[i];
+  meta[0] = c.kind;
+  meta[1] = c.precision;
+  meta[2] = c.scale;
+  meta[3] = c.validity.empty() ? 0 : 1;
+  sizes[0] = c.num_rows;
+  sizes[1] = static_cast<int64_t>(c.chars.size());
+  return 0;
+}
+
+char const* tpudf_orc_col_name(int64_t handle, int32_t i) {
+  thread_local std::string name_buf;
+  auto r = orc_reads().get(handle);
+  if (r == nullptr || i < 0 || i >= static_cast<int32_t>(r->columns.size())) {
+    set_error("invalid orc read handle or column index");
+    return nullptr;
+  }
+  name_buf = r->columns[i].name;
+  return name_buf.c_str();
+}
+
+// data: int64[num_rows] (always, incl. float bit patterns); offsets/chars
+// only for string kinds; validity uint8[num_rows]. Null dests skip.
+int32_t tpudf_orc_col_copy(int64_t handle, int32_t i, int64_t* data,
+                           int32_t* offsets, uint8_t* chars,
+                           uint8_t* validity) {
+  auto r = orc_reads().get(handle);
+  if (r == nullptr || i < 0 || i >= static_cast<int32_t>(r->columns.size())) {
+    set_error("invalid orc read handle or column index");
+    return -1;
+  }
+  auto const& c = r->columns[i];
+  if (data != nullptr && !c.data.empty()) {
+    std::memcpy(data, c.data.data(), c.data.size() * sizeof(int64_t));
+  }
+  if (offsets != nullptr && !c.offsets.empty()) {
+    std::memcpy(offsets, c.offsets.data(), c.offsets.size() * sizeof(int32_t));
+  }
+  if (chars != nullptr && !c.chars.empty()) {
+    std::memcpy(chars, c.chars.data(), c.chars.size());
+  }
+  if (validity != nullptr && !c.validity.empty()) {
+    std::memcpy(validity, c.validity.data(), c.validity.size());
+  }
+  return 0;
+}
+
+int32_t tpudf_orc_close(int64_t handle) {
+  if (!orc_reads().erase(handle)) {
+    set_error("invalid orc read handle");
+    return -1;
+  }
+  return 0;
+}
+
+// RLEv2 decode hook for spec-vector tests.
+int32_t tpudf_orc_decode_rle2(uint8_t const* buf, uint64_t len, int64_t count,
+                              int32_t is_signed, int64_t* out) {
+  try {
+    auto vals = tpudf::orc::decode_rle_v2(buf, len, count, is_signed != 0);
+    std::memcpy(out, vals.data(), vals.size() * sizeof(int64_t));
+    return 0;
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
 // ---- host packed-row codec (C1' native half) ------------------------------
 
 // Layout probe: fills starts[n_cols], returns row_size (or -1 on error).
@@ -396,5 +532,7 @@ int32_t tpudf_get_json_object(uint8_t const* chars, int32_t const* offsets,
 
 // Open-handle count — backs leak-check tests, the moral equivalent of the
 // reference's refcount leak-debugging flag (pom.xml:86,436).
-int64_t tpudf_open_handles() { return footers().size() + reads().size(); }
+int64_t tpudf_open_handles() {
+  return footers().size() + reads().size() + orc_reads().size();
+}
 }

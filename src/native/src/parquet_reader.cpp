@@ -521,7 +521,7 @@ std::vector<uint8_t> snappy_uncompress(uint8_t const* in, uint64_t n,
                                        uint64_t expected_out) {
   uint64_t pos = 0;
   uint64_t out_len = read_varint(in, n, &pos);
-  if (out_len != expected_out) {
+  if (expected_out != kSnappyNoExpectedSize && out_len != expected_out) {
     fail("snappy stream length != declared page size");
   }
   std::vector<uint8_t> out;

@@ -1,0 +1,93 @@
+#include "tpudf/protobuf_wire.hpp"
+
+#include <stdexcept>
+
+namespace tpudf {
+namespace pb {
+
+namespace {
+
+uint64_t read_varint(uint8_t const* p, uint64_t len, uint64_t* pos) {
+  uint64_t out = 0;
+  int shift = 0;
+  while (*pos < len && shift <= 63) {
+    uint8_t b = p[(*pos)++];
+    out |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if ((b & 0x80) == 0) return out;
+    shift += 7;
+  }
+  throw std::runtime_error("protobuf: bad varint");
+}
+
+}  // namespace
+
+Message Message::parse(uint8_t const* buf, uint64_t len) {
+  Message m;
+  uint64_t pos = 0;
+  while (pos < len) {
+    uint64_t key = read_varint(buf, len, &pos);
+    PbField f;
+    f.number = static_cast<uint32_t>(key >> 3);
+    f.type = static_cast<WireType>(key & 7);
+    switch (f.type) {
+      case WireType::VARINT:
+        f.varint = read_varint(buf, len, &pos);
+        break;
+      case WireType::FIXED64: {
+        if (pos + 8 > len) throw std::runtime_error("protobuf: short fixed64");
+        uint64_t v = 0;
+        for (int k = 0; k < 8; ++k) v |= static_cast<uint64_t>(buf[pos + k]) << (8 * k);
+        f.varint = v;
+        pos += 8;
+        break;
+      }
+      case WireType::FIXED32: {
+        if (pos + 4 > len) throw std::runtime_error("protobuf: short fixed32");
+        uint64_t v = 0;
+        for (int k = 0; k < 4; ++k) v |= static_cast<uint64_t>(buf[pos + k]) << (8 * k);
+        f.varint = v;
+        pos += 4;
+        break;
+      }
+      case WireType::BYTES: {
+        uint64_t n = read_varint(buf, len, &pos);
+        if (pos + n > len) throw std::runtime_error("protobuf: short bytes");
+        f.bytes = std::string_view(reinterpret_cast<char const*>(buf + pos), n);
+        pos += n;
+        break;
+      }
+      default:
+        throw std::runtime_error("protobuf: unsupported wire type");
+    }
+    m.fields_.push_back(f);
+  }
+  return m;
+}
+
+PbField const* Message::field(uint32_t number) const {
+  for (auto const& f : fields_) {
+    if (f.number == number) return &f;
+  }
+  return nullptr;
+}
+
+std::vector<PbField const*> Message::fields(uint32_t number) const {
+  std::vector<PbField const*> out;
+  for (auto const& f : fields_) {
+    if (f.number == number) out.push_back(&f);
+  }
+  return out;
+}
+
+uint64_t Message::u64(uint32_t number, uint64_t dflt) const {
+  auto const* f = field(number);
+  return f == nullptr ? dflt : f->varint;
+}
+
+std::string_view Message::bytes(uint32_t number) const {
+  auto const* f = field(number);
+  return f == nullptr ? std::string_view() : f->bytes;
+}
+
+}  // namespace pb
+}  // namespace tpudf

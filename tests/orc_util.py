@@ -1,0 +1,251 @@
+"""Pure-Python ORC file writer — independent oracle for the native ORC
+reader. Writes flat-struct files with RLEv1 integer runs (the reader must
+also handle RLEv2, covered by spec vectors elsewhere), byte/boolean RLE,
+direct strings, plain floats, PRESENT streams, and NONE/ZLIB/SNAPPY
+compression with the 3-byte ORC chunk framing. Protobuf metadata is emitted
+with a minimal wire-format writer.
+
+ColumnSpec values are python lists; None marks nulls.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Optional
+
+from tests.parquet_util import snappy_compress
+
+# orc Kind enum
+BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, STRING = 0, 1, 2, 3, 4, 5, 6, 7
+DECIMAL, DATE = 14, 15
+NONE, ZLIB, SNAPPY = 0, 1, 2
+
+
+# ---- protobuf writer -------------------------------------------------------
+
+
+def _varint(u: int) -> bytes:
+    out = bytearray()
+    while u >= 0x80:
+        out.append((u & 0x7F) | 0x80)
+        u >>= 7
+    out.append(u)
+    return bytes(out)
+
+
+def pb_field(number: int, wire: int, payload: bytes) -> bytes:
+    return _varint((number << 3) | wire) + payload
+
+
+def pb_varint(number: int, value: int) -> bytes:
+    return pb_field(number, 0, _varint(value))
+
+
+def pb_bytes(number: int, payload: bytes) -> bytes:
+    return pb_field(number, 2, _varint(len(payload)) + payload)
+
+
+# ---- stream encoders -------------------------------------------------------
+
+
+def zigzag(v: int) -> int:
+    return (v << 1) ^ (v >> 63) if v < 0 else v << 1
+
+
+def rle_v1_literals(values: list[int], signed: bool = True) -> bytes:
+    """RLEv1 literal runs only (always legal)."""
+    out = bytearray()
+    i = 0
+    while i < len(values):
+        chunk = values[i : i + 128]
+        out.append(256 - len(chunk))
+        for v in chunk:
+            out += _varint(zigzag(v) if signed else v)
+        i += len(chunk)
+    return bytes(out)
+
+
+def byte_rle_literals(data: bytes) -> bytes:
+    out = bytearray()
+    i = 0
+    while i < len(data):
+        chunk = data[i : i + 128]
+        out.append(256 - len(chunk))
+        out += chunk
+        i += len(chunk)
+    return bytes(out)
+
+
+def bool_rle(bits: list[bool]) -> bytes:
+    by = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            by[i // 8] |= 1 << (7 - (i % 8))
+    return byte_rle_literals(bytes(by))
+
+
+def frame(raw: bytes, codec: int) -> bytes:
+    """ORC chunked compression framing."""
+    if codec == NONE:
+        return raw
+    if codec == ZLIB:
+        comp = zlib.compressobj(6, zlib.DEFLATED, -15)
+        payload = comp.compress(raw) + comp.flush()
+    elif codec == SNAPPY:
+        payload = snappy_compress(raw)
+    else:
+        raise ValueError(codec)
+    if len(payload) >= len(raw):
+        h = (len(raw) << 1) | 1  # original
+        return struct.pack("<I", h)[:3] + raw
+    h = len(payload) << 1
+    return struct.pack("<I", h)[:3] + payload
+
+
+# ---- file writer -----------------------------------------------------------
+
+
+@dataclass
+class ColumnSpec:
+    name: str
+    kind: int
+    values: list
+    precision: int = 0
+    scale: int = 0
+
+
+def _encode_column(spec: ColumnSpec, values: list, codec: int):
+    """-> list of (stream_kind, framed_bytes) for one stripe."""
+    present_needed = any(v is None for v in values)
+    streams = []
+    if present_needed:
+        streams.append((0, frame(bool_rle([v is not None for v in values]),
+                                 codec)))
+    vals = [v for v in values if v is not None]
+    if spec.kind == BOOLEAN:
+        streams.append((1, frame(bool_rle([bool(v) for v in vals]), codec)))
+    elif spec.kind == BYTE:
+        streams.append(
+            (1, frame(byte_rle_literals(
+                bytes((int(v)) & 0xFF for v in vals)), codec))
+        )
+    elif spec.kind in (SHORT, INT, LONG, DATE):
+        streams.append((1, frame(rle_v1_literals([int(v) for v in vals]),
+                                 codec)))
+    elif spec.kind == FLOAT:
+        raw = b"".join(struct.pack("<f", float(v)) for v in vals)
+        streams.append((1, frame(raw, codec)))
+    elif spec.kind == DOUBLE:
+        raw = b"".join(struct.pack("<d", float(v)) for v in vals)
+        streams.append((1, frame(raw, codec)))
+    elif spec.kind == STRING:
+        chars = b"".join(
+            v.encode() if isinstance(v, str) else bytes(v) for v in vals
+        )
+        lens = [len(v.encode() if isinstance(v, str) else bytes(v))
+                for v in vals]
+        streams.append((1, frame(chars, codec)))
+        streams.append((2, frame(rle_v1_literals(lens, signed=False), codec)))
+    elif spec.kind == DECIMAL:
+        out = bytearray()
+        for v in vals:
+            out += _varint(zigzag(int(v)))
+        streams.append((1, frame(bytes(out), codec)))
+        # SECONDARY scale stream (one entry per value)
+        streams.append(
+            (5, frame(rle_v1_literals([spec.scale] * len(vals),
+                                      signed=False), codec))
+        )
+    else:
+        raise ValueError(f"kind {spec.kind}")
+    return streams
+
+
+def write_orc(
+    columns: list[ColumnSpec],
+    stripe_size: Optional[int] = None,
+    codec: int = NONE,
+    with_row_index: bool = False,
+) -> bytes:
+    """``with_row_index`` emits a dummy ROW_INDEX stream per column at the
+    stripe head (inside indexLength), the layout every real ORC writer
+    produces — readers must skip it when locating data streams."""
+    num_rows = len(columns[0].values)
+    for c in columns:
+        assert len(c.values) == num_rows
+    rows_per_stripe = stripe_size or max(num_rows, 1)
+
+    blob = bytearray(b"ORC")
+    stripe_infos = []
+    for s_start in range(0, max(num_rows, 1), rows_per_stripe):
+        stripe_offset = len(blob)
+        svals = {c.name: c.values[s_start : s_start + rows_per_stripe]
+                 for c in columns}
+        n_stripe = len(svals[columns[0].name])
+        # streams for all columns: index region first, then data region
+        directory = []  # (kind, column_id, length)
+        index = bytearray()
+        if with_row_index:
+            for ci in range(len(columns)):
+                payload = frame(pb_bytes(1, pb_varint(1, 0)), codec)
+                directory.append((6, ci + 1, len(payload)))  # ROW_INDEX
+                index += payload
+        data = bytearray()
+        for ci, c in enumerate(columns):
+            for kind, payload in _encode_column(c, svals[c.name], codec):
+                directory.append((kind, ci + 1, len(payload)))
+                data += payload
+        blob += index
+        blob += data
+        # stripe footer
+        sf = bytearray()
+        for kind, col, length in directory:
+            sf += pb_bytes(1, pb_varint(1, kind) + pb_varint(2, col)
+                           + pb_varint(3, length))
+        sf += pb_bytes(2, pb_varint(1, 0))  # root encoding DIRECT
+        for _ in columns:
+            sf += pb_bytes(2, pb_varint(1, 0))  # DIRECT (RLEv1)
+        sf_framed = frame(bytes(sf), codec)
+        blob += sf_framed
+        stripe_infos.append({
+            "offset": stripe_offset,
+            "indexLength": len(index),
+            "dataLength": len(data),
+            "footerLength": len(sf_framed),
+            "numberOfRows": n_stripe,
+        })
+        if num_rows == 0:
+            break
+
+    # footer
+    footer = bytearray()
+    for si in stripe_infos:
+        footer += pb_bytes(
+            3,
+            pb_varint(1, si["offset"]) + pb_varint(2, si["indexLength"])
+            + pb_varint(3, si["dataLength"]) + pb_varint(4, si["footerLength"])
+            + pb_varint(5, si["numberOfRows"]),
+        )
+    root = pb_varint(1, 12)  # STRUCT
+    for ci in range(len(columns)):
+        root += pb_varint(2, ci + 1)
+    for c in columns:
+        root += pb_bytes(3, c.name.encode())
+    footer += pb_bytes(4, root)
+    for c in columns:
+        ty = pb_varint(1, c.kind)
+        if c.kind == DECIMAL:
+            ty += pb_varint(5, c.precision) + pb_varint(6, c.scale)
+        footer += pb_bytes(4, ty)
+    footer += pb_varint(6, num_rows)
+    footer_framed = frame(bytes(footer), codec)
+    blob += footer_framed
+
+    ps = pb_varint(1, len(footer_framed)) + pb_varint(2, codec)
+    ps += pb_varint(3, 256 * 1024)
+    ps += pb_bytes(8000, b"ORC")
+    blob += ps
+    blob.append(len(ps))
+    return bytes(blob)

@@ -1,0 +1,198 @@
+"""Native ORC reader vs the independent pure-Python writer oracle
+(tests/orc_util.py), plus RLEv2 decoded against the ORC spec's canonical
+example vectors."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.orc import OrcChunkedReader, read_table, stripe_info
+from spark_rapids_jni_tpu.parquet.footer import NativeError
+from spark_rapids_jni_tpu.runtime.native import load_native
+
+from tests import orc_util as ou
+
+
+def _mixed_columns(n=120, with_nulls=True, seed=0):
+    rng = np.random.default_rng(seed)
+
+    def nullify(vals):
+        if not with_nulls:
+            return list(vals)
+        return [None if rng.random() < 0.2 else v for v in vals]
+
+    return [
+        ou.ColumnSpec("b", ou.BOOLEAN, nullify([bool(x) for x in rng.integers(0, 2, n)])),
+        ou.ColumnSpec("i8", ou.BYTE, nullify([int(x) for x in rng.integers(-128, 128, n)])),
+        ou.ColumnSpec("i16", ou.SHORT, nullify([int(x) for x in rng.integers(-(2**15), 2**15, n)])),
+        ou.ColumnSpec("i32", ou.INT, nullify([int(x) for x in rng.integers(-(2**31), 2**31, n)])),
+        ou.ColumnSpec("i64", ou.LONG, nullify([int(x) for x in rng.integers(-(2**62), 2**62, n)])),
+        ou.ColumnSpec("f32", ou.FLOAT, nullify([float(np.float32(x)) for x in rng.normal(size=n)])),
+        ou.ColumnSpec("f64", ou.DOUBLE, nullify([float(x) for x in rng.normal(size=n)])),
+        ou.ColumnSpec("s", ou.STRING, nullify([f"orc-{i}-{'y' * (i % 5)}" for i in range(n)])),
+        ou.ColumnSpec("d", ou.DATE, nullify([int(x) for x in rng.integers(0, 20000, n)])),
+        ou.ColumnSpec("dec", ou.DECIMAL, nullify([int(x) for x in rng.integers(-(10**12), 10**12, n)]),
+                      precision=18, scale=2),
+    ]
+
+
+def _assert_matches(table, specs):
+    assert table.num_columns == len(specs)
+    for col, spec in zip(table.columns, specs):
+        got = col.to_pylist()
+        assert len(got) == len(spec.values), spec.name
+        for g, w in zip(got, spec.values):
+            if w is None:
+                assert g is None, spec.name
+            elif spec.kind == ou.FLOAT:
+                assert g == pytest.approx(w, rel=1e-6), spec.name
+            elif spec.kind == ou.BOOLEAN:
+                assert g == bool(w), spec.name
+            else:
+                assert g == w, spec.name
+
+
+def test_orc_plain_roundtrip():
+    specs = _mixed_columns()
+    table = read_table(ou.write_orc(specs))
+    _assert_matches(table, specs)
+    assert table.column(0).dtype == t.BOOL8
+    assert table.column(4).dtype == t.INT64
+    assert table.column(7).dtype == t.STRING
+    assert table.column(8).dtype == t.TIMESTAMP_DAYS
+    assert table.column(9).dtype == t.decimal64(-2)
+
+
+def test_orc_no_nulls():
+    specs = _mixed_columns(with_nulls=False)
+    table = read_table(ou.write_orc(specs))
+    _assert_matches(table, specs)
+    for c in table.columns:
+        assert c.validity is None
+
+
+@pytest.mark.parametrize("codec", [ou.ZLIB, ou.SNAPPY])
+def test_orc_compressed(codec):
+    specs = _mixed_columns(seed=3)
+    table = read_table(ou.write_orc(specs, codec=codec))
+    _assert_matches(table, specs)
+
+
+def test_orc_multi_stripe_and_selection():
+    specs = _mixed_columns(n=200, seed=5)
+    data = ou.write_orc(specs, stripe_size=64)
+    infos = stripe_info(data)
+    assert [r for r, _ in infos] == [64, 64, 64, 8]
+    _assert_matches(read_table(data), specs)
+    sub = read_table(data, columns=[4, 7], stripes=[1, 2])
+    assert sub.num_columns == 2
+    assert sub.column(0).to_pylist() == specs[4].values[64:192]
+    assert sub.column(1).to_pylist() == specs[7].values[64:192]
+    # empty selection means none
+    assert read_table(data, stripes=[]).num_rows == 0
+    assert read_table(data, columns=[]).num_columns == 0
+
+
+def test_orc_chunked_reader():
+    specs = _mixed_columns(n=300, seed=6)
+    data = ou.write_orc(specs, stripe_size=75, codec=ou.ZLIB)
+    infos = stripe_info(data)
+    budget = max(infos[0][1] + infos[1][1], infos[2][1] + infos[3][1])
+    chunks = list(OrcChunkedReader(data, budget))
+    assert len(chunks) == 2
+    got = []
+    for ch in chunks:
+        got.extend(ch.column(4).to_pylist())
+    assert got == specs[4].values
+
+
+def test_orc_truncated_errors():
+    data = ou.write_orc(_mixed_columns(n=10))
+    with pytest.raises(NativeError):
+        read_table(data[: len(data) // 2])
+
+
+# ---- RLEv2 spec vectors ----------------------------------------------------
+
+
+def _rle2(raw: bytes, count: int, signed=False):
+    lib = load_native()
+    out = np.empty(count, dtype=np.int64)
+    rc = lib.tpudf_orc_decode_rle2(
+        raw, len(raw), count, 1 if signed else 0,
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    assert rc == 0, lib.last_error()
+    return out.tolist()
+
+
+def test_rle2_short_repeat_spec_vector():
+    # ORC spec: [10000, 10000, 10000, 10000, 10000] -> 0x0a 0x27 0x10
+    assert _rle2(bytes([0x0A, 0x27, 0x10]), 5) == [10000] * 5
+
+
+def test_rle2_direct_spec_vector():
+    # ORC spec: [23713, 43806, 57005, 48879] ->
+    # 0x5e 0x03 0x5c 0xa1 0xab 0x1e 0xde 0xad 0xbe 0xef
+    raw = bytes([0x5E, 0x03, 0x5C, 0xA1, 0xAB, 0x1E, 0xDE, 0xAD, 0xBE, 0xEF])
+    assert _rle2(raw, 4) == [23713, 43806, 57005, 48879]
+
+
+def test_rle2_delta_spec_vector():
+    # ORC spec: [2, 3, 5, 7, 11, 13, 17, 19, 23, 29] ->
+    # 0xc6 0x09 0x02 0x02 0x22 0x42 0x42 0x46
+    raw = bytes([0xC6, 0x09, 0x02, 0x02, 0x22, 0x42, 0x42, 0x46])
+    assert _rle2(raw, 10) == [2, 3, 5, 7, 11, 13, 17, 19, 23, 29]
+
+
+def test_rle2_signed_short_repeat():
+    # zigzag(-3) = 5 -> one byte value, repeat 4
+    assert _rle2(bytes([0x01, 0x05]), 4, signed=True) == [-3] * 4
+
+
+def test_orc_handles_balanced():
+    lib = load_native()
+    before = lib.tpudf_open_handles()
+    read_table(ou.write_orc(_mixed_columns(n=8, seed=9)))
+    assert lib.tpudf_open_handles() == before
+
+
+def test_orc_row_index_streams_skipped():
+    """Real writers put ROW_INDEX streams at the stripe head (inside
+    indexLength); data streams must still be located correctly."""
+    specs = _mixed_columns(n=90, seed=11)
+    data = ou.write_orc(specs, stripe_size=40, with_row_index=True,
+                        codec=ou.ZLIB)
+    _assert_matches(read_table(data), specs)
+
+
+def test_rle2_patched_base_rounded_patch_width():
+    """Patch entries pack at closestFixedBits(gap+patch width): build a run
+    with pw=24, pgw=1 (25 -> 26 bits) and check exact decode."""
+    # 10 values at width 8 around base 0, one outlier patched with 24 high
+    # bits. Layout per spec: hdr(2B) third(1B) fourth(1B) base(1B)
+    # data(10B at 8 bits) patches(1 entry at 26 bits -> 4B)
+    vals = list(range(10, 20))
+    outlier_low = 0x37  # low 8 bits of the outlier
+    patch = 0x123456    # 24 high bits
+    real_outlier = (patch << 8) | outlier_low
+    data8 = vals.copy()
+    data8[4] = outlier_low
+    raw = bytearray()
+    raw.append(0x80 | (7 << 1))     # mode 10, width code 7 -> 8 bits
+    raw.append(10 - 1)              # length 10
+    raw.append((0 << 5) | 23)       # base width 1 byte, patch width code 23 -> 24 bits
+    raw.append((2 << 5) | 1)        # gap width 3 bits, 1 patch entry
+    raw.append(0)                   # base = 0
+    raw += bytes(data8)             # 8-bit packed values
+    # one patch entry: gap=4, patch=0x123456; 3+24=27 bits rounds to the
+    # closest fixed width 28; packed MSB-first, zero-padded to 4 bytes
+    entry = (4 << 24) | patch
+    bits = f"{entry:028b}" + "0" * 4
+    raw += bytes(int(bits[i:i + 8], 2) for i in range(0, 32, 8))
+    got = _rle2(bytes(raw), 10)
+    want = vals.copy()
+    want[4] = real_outlier
+    assert got == want
