@@ -1,10 +1,18 @@
-"""Benchmark harness — run the flagship pipeline on the real chip and print
+"""Benchmark harness — run the flagship pipelines on the real chip and print
 ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Config #3 of BASELINE.json: hash groupby-aggregate + sort (TPC-H q1, single
-executor). The reference publishes no numbers (BASELINE.md), so
-``vs_baseline`` is measured against the earliest recorded bench of this repo
-(BENCH_r*.json) when present, else 1.0.
+Configs mirror BASELINE.json (groupby-aggregate+sort = TPC-H q1, hash-join
+pipeline = TPC-DS q72, row⇄column transpose). The reference publishes no
+numbers (BASELINE.md), so ``vs_baseline`` is measured against the earliest
+recorded TPU bench of this repo (BENCH_r*.json) when present, else 1.0.
+
+Robustness contract (VERDICT r1 weak #1): the parent process ALWAYS prints
+exactly one JSON line on stdout and exits 0, even when the TPU backend is
+unavailable or hangs mid-bench. All jax work happens in watchdogged child
+subprocesses (a hang in make_c_api_client — or anywhere later, e.g. a stuck
+compile — only ever kills a child): probe the TPU client, then run the
+measured bench in a child with a hard timeout, falling back to a CPU child
+with a ``platform``/``diagnostic`` field recording the degradation.
 """
 
 from __future__ import annotations
@@ -13,11 +21,18 @@ import glob
 import json
 import os
 import re
+import subprocess
+import sys
 import time
 
-
 def _prior_baseline(metric: str):
-    """Earliest recorded value of this metric from BENCH_r{N}.json files."""
+    """Earliest recorded TPU value of this metric from BENCH_r{N}.json.
+
+    The driver wraps the bench output under a ``parsed`` key
+    (BENCH_r01.json shape: {n, cmd, rc, tail, parsed}); bare records are
+    accepted too. Degraded records (platform cpu, or carrying a diagnostic)
+    are skipped so a fallback run can never become the permanent baseline.
+    """
     best = None
     for path in glob.glob(os.path.join(os.path.dirname(__file__) or ".", "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
@@ -28,12 +43,21 @@ def _prior_baseline(metric: str):
                 rec = json.load(f)
         except (OSError, json.JSONDecodeError):
             continue
-        if rec.get("metric") != metric:
+        if isinstance(rec.get("parsed"), dict):
+            rec = rec["parsed"]
+        if rec.get("metric") != metric or not rec.get("value"):
+            continue
+        if rec.get("platform") == "cpu" or rec.get("diagnostic"):
             continue
         rnd = int(m.group(1))
         if best is None or rnd < best[0]:
             best = (rnd, float(rec["value"]))
     return None if best is None else best[1]
+
+
+# ---------------------------------------------------------------------------
+# Bench bodies (run only in child processes)
+# ---------------------------------------------------------------------------
 
 
 def _bench_tpch_q1(n: int, iters: int):
@@ -48,7 +72,7 @@ def _bench_tpch_q1(n: int, iters: int):
     for _ in range(iters):
         jax.block_until_ready(fn(lineitem))
     per_iter = (time.perf_counter() - t0) / iters
-    return "tpch_q1_rows_per_s", n / per_iter, "rows/s"
+    return n / per_iter
 
 
 def _bench_tpcds_q72(n: int, iters: int):
@@ -66,7 +90,7 @@ def _bench_tpcds_q72(n: int, iters: int):
     for _ in range(iters):
         jax.block_until_ready(fn(cs, dd, it, inv))
     per_iter = (time.perf_counter() - t0) / iters
-    return "tpcds_q72_rows_per_s", n / per_iter, "rows/s"
+    return n / per_iter
 
 
 def _bench_row_conversion(n: int, iters: int):
@@ -96,38 +120,149 @@ def _bench_row_conversion(n: int, iters: int):
     # bytes moved: the actual packed row image (incl. alignment padding,
     # validity bytes, 8-byte row pad) both directions
     _, _, row_bytes = compute_fixed_width_layout(tuple(schema))
-    gbps = 2 * n * row_bytes / per_iter / 1e9
-    return "row_conversion_gb_per_s", gbps, "GB/s"
+    return 2 * n * row_bytes / per_iter / 1e9
 
 
+# config name -> (bench fn, metric, unit); the metric/unit pair is fixed per
+# config so failure records line up with their success history.
 _CONFIGS = {
-    "tpch_q1": _bench_tpch_q1,
-    "tpcds_q72": _bench_tpcds_q72,
-    "row_conversion": _bench_row_conversion,
+    "tpch_q1": (_bench_tpch_q1, "tpch_q1_rows_per_s", "rows/s"),
+    "tpcds_q72": (_bench_tpcds_q72, "tpcds_q72_rows_per_s", "rows/s"),
+    "row_conversion": (_bench_row_conversion, "row_conversion_gb_per_s", "GB/s"),
 }
+
+
+def _child_main(config: str, n: int, iters: int) -> None:
+    """Run one bench body and print its raw value. BENCH_PLATFORM=cpu pins
+    the CPU backend (fallback mode)."""
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        from spark_rapids_jni_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+    value = _CONFIGS[config][0](n, iters)
+    print(json.dumps({"value": value}))
+
+
+# ---------------------------------------------------------------------------
+# Parent watchdog
+# ---------------------------------------------------------------------------
+
+
+def _tail(out: subprocess.CompletedProcess) -> str:
+    lines = (out.stderr or out.stdout or "").strip().splitlines()
+    return lines[-1] if lines else f"rc={out.returncode}"
+
+
+def _probe_tpu(timeout_s: float) -> tuple[bool, str]:
+    """Check TPU client health in a throwaway subprocess (a hang in
+    make_c_api_client — e.g. the chip grant still held by a dead process —
+    must never stall the parent)."""
+    code = (
+        "import jax; ds = jax.devices(); "
+        "assert ds and ds[0].platform != 'cpu', ds; print('TPU_OK')"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"tpu probe timed out after {timeout_s:.0f}s"
+    if out.returncode == 0 and "TPU_OK" in out.stdout:
+        return True, ""
+    return False, f"tpu probe failed: {_tail(out)}"
+
+
+def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float):
+    """Run the bench in a subprocess; returns (value | None, diagnostic)."""
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    env["BENCH_CONFIG"] = config
+    env["BENCH_ROWS"] = str(n)
+    env["BENCH_ITERS"] = str(iters)
+    if platform == "cpu":
+        env["BENCH_PLATFORM"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"{platform} bench timed out after {timeout_s:.0f}s"
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return float(json.loads(line)["value"]), ""
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            continue
+    return None, f"{platform} bench failed: {_tail(out)}"
 
 
 def main() -> None:
     config = os.environ.get("BENCH_CONFIG", "tpch_q1")
-    if config not in _CONFIGS:
-        raise SystemExit(
-            f"unknown BENCH_CONFIG {config!r}; valid: {sorted(_CONFIGS)}"
+    record = {
+        "metric": config,
+        "value": 0.0,
+        "unit": "",
+        "vs_baseline": 0.0,
+        "platform": "none",
+    }
+    diagnostics: list[str] = []
+    try:
+        if config not in _CONFIGS:
+            raise ValueError(
+                f"unknown BENCH_CONFIG {config!r}; valid: {sorted(_CONFIGS)}"
+            )
+        _, metric, unit = _CONFIGS[config]
+        record.update(metric=metric, unit=unit)
+        n = int(os.environ.get("BENCH_ROWS", 1 << 22))
+        iters = int(os.environ.get("BENCH_ITERS", 5))
+        child_timeout = float(os.environ.get("BENCH_TIMEOUT", 900))
+
+        value = None
+        if os.environ.get("BENCH_PLATFORM") == "cpu":
+            diagnostics.append("BENCH_PLATFORM=cpu requested")
+            platform = "cpu"
+        else:
+            ok, why = _probe_tpu(60)
+            if not ok:  # one quick retry: grants linger for a few minutes
+                time.sleep(10)
+                ok, why = _probe_tpu(20)
+            if ok:
+                value, why = _run_child(config, n, iters, "tpu", child_timeout)
+                platform = "tpu"
+            if not ok or value is None:
+                diagnostics.append(why)
+                platform = "cpu"
+        if value is None:
+            value, why = _run_child(config, n, iters, "cpu", child_timeout)
+            if value is None:
+                diagnostics.append(why)
+                platform = "none"
+                value = 0.0
+        base = _prior_baseline(record["metric"]) if platform == "tpu" else None
+        record.update(
+            value=value,
+            vs_baseline=(value / base) if base else (1.0 if value else 0.0),
+            platform=platform,
         )
-    n = int(os.environ.get("BENCH_ROWS", 1 << 22))
-    iters = int(os.environ.get("BENCH_ITERS", 5))
-    metric, value, unit = _CONFIGS[config](n, iters)
-    base = _prior_baseline(metric)
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": value,
-                "unit": unit,
-                "vs_baseline": value / base if base else 1.0,
-            }
-        )
-    )
+    except Exception as exc:  # never a traceback: one JSON line, rc 0
+        diagnostics.append(f"bench harness error: {type(exc).__name__}: {exc}")
+    if diagnostics:
+        record["diagnostic"] = "; ".join(d for d in diagnostics if d)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        _child_main(
+            os.environ["BENCH_CONFIG"],
+            int(os.environ["BENCH_ROWS"]),
+            int(os.environ["BENCH_ITERS"]),
+        )
+    else:
+        main()

@@ -16,3 +16,12 @@ for cfg in tpch_q1 tpcds_q72 row_conversion; do
   BENCH_CONFIG=$cfg python bench.py >> bench_nightly.jsonl
 done
 cat bench_nightly.jsonl
+# bench.py never exits nonzero (driver contract), so the nightly gate is on
+# the records themselves: any degraded/failed line fails the build.
+python - <<'EOF'
+import json, sys
+bad = [r for r in map(json.loads, open("bench_nightly.jsonl"))
+       if r.get("diagnostic") or not r.get("value")]
+if bad:
+    sys.exit("degraded bench records:\n" + "\n".join(map(json.dumps, bad)))
+EOF

@@ -23,6 +23,10 @@ Opt out with SPARK_RAPIDS_TPU_NO_X64=1 (not recommended).
 
 import os as _os
 
+# INVARIANT (tests/test_import_hygiene.py): importing this package must not
+# initialize any jax backend — only config updates. Callers pin the platform
+# (utils.platform.force_cpu_platform) AFTER importing us; a module-level
+# array/device query anywhere in the import graph would break that.
 if not _os.environ.get("SPARK_RAPIDS_TPU_NO_X64"):
     import jax as _jax
 

@@ -1,0 +1,32 @@
+"""Platform selection helpers for the axon-tunnelled TPU environment.
+
+The axon plugin pins JAX's platform list at import time, so ``JAX_PLATFORMS``
+env vars set after process start do NOT switch it off; the only reliable
+switch is ``jax.config.update("jax_platforms", "cpu")`` executed before any
+backend initialization (first ``jax.devices()`` / ``device_put`` / ``jit``).
+This module is the single home of that workaround (used by tests/conftest.py,
+__graft_entry__.dryrun_multichip, and bench.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_platform(n_virtual_devices: int | None = None) -> None:
+    """Pin this process to the CPU backend, optionally with a virtual pool.
+
+    Must be called before jax initializes any backend; the pin is process-
+    wide and sticky (backend init is one-shot in jax), so callers that need
+    the real chip afterwards must use a fresh process.
+    """
+    if n_virtual_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_virtual_devices}"
+            ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -9,20 +9,9 @@ golden-equality against a host oracle; device-conditional features gated by
 markers, not mocks.
 """
 
-import os
+from spark_rapids_jni_tpu.utils.platform import force_cpu_platform
 
-# XLA_FLAGS must be in place before the CPU backend initializes. The axon
-# environment pins JAX_PLATFORMS in a way plain env vars don't override, so
-# the platform itself is forced via jax.config below.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(n_virtual_devices=8)
 
 import numpy as np
 import pytest
