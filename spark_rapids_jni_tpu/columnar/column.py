@@ -38,7 +38,7 @@ class Column:
             if self.chars is None:
                 raise ValueError("string column requires chars buffer")
             if self.data.dtype != jnp.int32:
-                raise TypeError("string offsets must be int32")
+                raise TypeError("string offsets/lengths must be int32")
         elif self.dtype.is_fixed_width:
             expect = self.dtype.jnp_dtype
             if self.data.dtype != expect:
@@ -50,8 +50,18 @@ class Column:
             raise TypeError("validity must be bool")
 
     @property
+    def is_padded_string(self) -> bool:
+        """String column in the padded device layout: data = int32 lengths,
+        chars = uint8 (n, W) matrix (ops.strings converts both ways)."""
+        return (
+            self.dtype.is_string
+            and self.chars is not None
+            and self.chars.ndim == 2
+        )
+
+    @property
     def size(self) -> int:
-        if self.dtype.is_string:
+        if self.dtype.is_string and not self.is_padded_string:
             return int(self.data.shape[0]) - 1
         return int(self.data.shape[0])
 
@@ -123,6 +133,17 @@ class Column:
         return data, mask
 
     def to_pylist(self) -> list:
+        if self.is_padded_string:
+            lengths = np.asarray(self.data)
+            mat = np.asarray(self.chars)
+            mask = None if self.validity is None else np.asarray(self.validity)
+            out = []
+            for i in range(self.size):
+                if mask is not None and not mask[i]:
+                    out.append(None)
+                else:
+                    out.append(mat[i, : lengths[i]].tobytes().decode())
+            return out
         if self.dtype.is_string:
             offsets = np.asarray(self.data)
             chars = np.asarray(self.chars).tobytes()

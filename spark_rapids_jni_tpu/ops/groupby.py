@@ -32,16 +32,29 @@ SUPPORTED_AGGS = ("sum", "count", "min", "max", "mean")
 
 
 class GroupByResult(NamedTuple):
-    table: Table          # keys then aggregates, padded to n rows
+    table: Table          # keys then aggregates, padded to max_groups rows
     num_groups: jnp.ndarray  # scalar int32
+    # True when num_groups exceeded the caller's max_groups bound: groups
+    # past the bound were dropped; the caller re-plans with a larger bound
+    # (grow-and-retry lives in the host wrapper, not here).
+    overflowed: jnp.ndarray | bool = False
 
     def compact(self) -> Table:
         """Host-side trim to the real group count."""
+        if bool(self.overflowed):
+            raise ValueError(
+                "groupby output overflowed max_groups (groups were dropped); "
+                "grow and retry (groupby_aggregate_auto) before compacting"
+            )
         k = int(self.num_groups)
         cols = []
         for c in self.table.columns:
             validity = None if c.validity is None else c.validity[:k]
-            cols.append(Column(c.dtype, c.data[:k], validity))
+            if c.dtype.is_string:
+                cols.append(Column(c.dtype, c.data[:k], validity,
+                                   chars=c.chars[:k]))
+            else:
+                cols.append(Column(c.dtype, c.data[:k], validity))
         return Table(cols)
 
 
@@ -49,13 +62,20 @@ def _rows_equal_prev(table: Table, keys: Sequence[int]) -> jnp.ndarray:
     """bool[n]: row i has the same key tuple (incl. null-ness) as row i-1."""
     n = table.num_rows
     same = jnp.ones((n,), dtype=jnp.bool_)
+    if n == 0:
+        return same
     for k in keys:
         c = table.column(k)
-        v = c.data
         valid = c.valid_mask()
-        eq_val = v[1:] == v[:-1]
-        if c.dtype.storage_dtype.kind == "f":
-            eq_val = eq_val | (jnp.isnan(v[1:]) & jnp.isnan(v[:-1]))
+        if c.dtype.is_string:
+            from spark_rapids_jni_tpu.ops import strings as s
+
+            eq_val = s.strings_equal_prev(c)
+        else:
+            v = c.data
+            eq_val = v[1:] == v[:-1]
+            if c.dtype.storage_dtype.kind == "f":
+                eq_val = eq_val | (jnp.isnan(v[1:]) & jnp.isnan(v[:-1]))
         eq_valid = valid[1:] == valid[:-1]
         both_null = ~valid[1:] & ~valid[:-1]
         eq = (eq_val & valid[1:] & eq_valid) | both_null
@@ -79,52 +99,80 @@ def groupby_aggregate(
     table: Table,
     keys: Sequence[int],
     aggs: Sequence[tuple[int, str]],
+    max_groups: int | None = None,
 ) -> GroupByResult:
     """Group by `keys`; compute [(value_col, op)] aggregates.
 
-    Returns keys + one column per agg, in order, padded to n rows.
+    Returns keys + one column per agg, in order, padded to ``max_groups``
+    rows (default: n, which can never overflow). A smaller ``max_groups``
+    bounds output memory for high-cardinality aggregation; if the true
+    group count exceeds it, rows of the excess groups are dropped and
+    ``overflowed`` is set so the host can grow and retry
+    (``groupby_aggregate_auto``).
     """
     for _, op in aggs:
         if op not in SUPPORTED_AGGS:
             raise ValueError(f"unsupported aggregation {op!r}")
     n = table.num_rows
+    m = n if max_groups is None else int(max_groups)
     order = sort_order(table, keys)
     sorted_tbl = gather(table, order)
 
     same = _rows_equal_prev(sorted_tbl, keys)
     group_id = jnp.cumsum(~same) - 1  # dense ids, 0-based, sorted order
     num_groups = (group_id[-1] + 1).astype(jnp.int32) if n else jnp.int32(0)
+    overflowed = num_groups > m
 
     # Key output columns: first row of each group (scatter-min of row index;
-    # rows are sorted so the first is the group representative).
-    first_idx = jnp.full((n,), n, dtype=jnp.int32).at[group_id].min(
+    # rows are sorted so the first is the group representative). Scatters
+    # with group_id >= m drop (XLA OOB-scatter semantics) — that IS the
+    # cardinality bound.
+    first_idx = jnp.full((m,), n, dtype=jnp.int32).at[group_id].min(
         jnp.arange(n, dtype=jnp.int32)
     )
     out_cols: list[Column] = []
     for k in keys:
         c = sorted_tbl.column(k)
-        safe_first = jnp.clip(first_idx, 0, max(n - 1, 0))
-        data = c.data[safe_first]
+        valid = jnp.zeros((m,), jnp.bool_)
+        if n == 0:
+            # nothing to gather from — emit all-null keys (num_groups = 0)
+            if c.dtype.is_string:
+                out_cols.append(Column(
+                    c.dtype, jnp.zeros((m,), jnp.int32), valid,
+                    chars=jnp.zeros((m, 1), jnp.uint8),
+                ))
+            else:
+                out_cols.append(
+                    Column(c.dtype, jnp.zeros((m,), c.dtype.jnp_dtype), valid)
+                )
+            continue
+        safe_first = jnp.clip(first_idx, 0, n - 1)
         valid = c.valid_mask()[safe_first] & (first_idx < n)
-        out_cols.append(Column(c.dtype, data, valid))
+        if c.dtype.is_string:
+            from spark_rapids_jni_tpu.ops import strings as s
+
+            g = s.gather_strings(c, safe_first)
+            out_cols.append(Column(c.dtype, g.data, valid, chars=g.chars))
+        else:
+            out_cols.append(Column(c.dtype, c.data[safe_first], valid))
 
     for col_idx, op in aggs:
         c = sorted_tbl.column(col_idx)
         v = c.data
         valid = c.valid_mask()
         vcount = jax.ops.segment_sum(
-            valid.astype(jnp.int64), group_id, num_segments=n
+            valid.astype(jnp.int64), group_id, num_segments=m
         )
         if op == "count":
             out_cols.append(
                 Column(DType(TypeId.INT64), vcount,
-                       jnp.arange(n) < num_groups)
+                       jnp.arange(m) < num_groups)
             )
             continue
         if op in ("sum", "mean"):
             acc_dt = _sum_dtype(c.dtype)
             vv = jnp.where(valid, v, jnp.zeros_like(v)).astype(acc_dt.jnp_dtype)
-            total = jax.ops.segment_sum(vv, group_id, num_segments=n)
+            total = jax.ops.segment_sum(vv, group_id, num_segments=m)
             has_any = vcount > 0
             if op == "sum":
                 out_cols.append(Column(acc_dt, total, has_any))
@@ -147,10 +195,31 @@ def groupby_aggregate(
             lo, hi = info.min, info.max
         if op == "min":
             vv = jnp.where(valid, v, jnp.asarray(hi, dtype=v.dtype))
-            red = jax.ops.segment_min(vv, group_id, num_segments=n)
+            red = jax.ops.segment_min(vv, group_id, num_segments=m)
         else:
             vv = jnp.where(valid, v, jnp.asarray(lo, dtype=v.dtype))
-            red = jax.ops.segment_max(vv, group_id, num_segments=n)
+            red = jax.ops.segment_max(vv, group_id, num_segments=m)
         out_cols.append(Column(c.dtype, red, vcount > 0))
 
-    return GroupByResult(Table(out_cols), num_groups)
+    return GroupByResult(Table(out_cols), num_groups, overflowed)
+
+
+def groupby_aggregate_auto(
+    table: Table,
+    keys: Sequence[int],
+    aggs: Sequence[tuple[int, str]],
+    initial_max_groups: int,
+    growth: int = 4,
+) -> GroupByResult:
+    """Host-level grow-and-retry around the cardinality bound: start at
+    ``initial_max_groups`` and multiply by ``growth`` until the result fits
+    (capped at n, which always fits). Each retry recompiles for the new
+    static bound — the bucketed-padding discipline, applied to output
+    cardinality."""
+    n = table.num_rows
+    m = max(1, int(initial_max_groups))
+    while True:
+        res = groupby_aggregate(table, keys, aggs, max_groups=min(m, n))
+        if m >= n or not bool(res.overflowed):
+            return res
+        m *= growth

@@ -77,6 +77,12 @@ def _column_hash(col: Column, seeds: jnp.ndarray) -> jnp.ndarray:
     through unchanged (Spark chaining semantics)."""
     tid = col.dtype.type_id
     v = col.data
+    if tid == TypeId.STRING:
+        from spark_rapids_jni_tpu.ops import strings as s
+
+        # full variable-length XXH64 over the row's UTF-8 bytes — Spark's
+        # hashUnsafeBytes / the reference family's string xxhash64 kernel.
+        return s.hash_string_column(col, seeds)
     if tid in (TypeId.BOOL8, TypeId.INT8, TypeId.UINT8, TypeId.INT16,
                TypeId.UINT16, TypeId.INT32, TypeId.UINT32,
                TypeId.TIMESTAMP_DAYS, TypeId.DURATION_DAYS):

@@ -11,18 +11,26 @@ caller supplies ``out_size`` (capacity) and gets back gather maps plus the
 true match count — the bucketed-padding discipline XLA wants. SQL semantics:
 NULL keys never match; left join emits unmatched probe rows with an invalid
 right index.
+
+Multi-column and string/float keys are **exact**, not hashed: both sides'
+key tuples are dense-rank encoded over their union (one sort of the
+concatenated key columns + boundary scan — the same machinery groupby
+uses), after which the join runs on a single collision-free int32 rank
+column. cuDF's hash join is exact on composite keys; rank encoding is the
+sort-based TPU equivalent (no collision-at-hash wrong answers, unlike the
+round-1 "pre-hash into one column" recipe this replaces).
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
-from spark_rapids_jni_tpu.ops.sort import gather
+from spark_rapids_jni_tpu.ops.sort import gather, sort_order
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 
@@ -99,35 +107,126 @@ def _join_maps_impl(
     )
 
 
+def _concat_key_columns(lc: Column, rc: Column) -> Column:
+    """Stack one key column from both tables into a combined column (left
+    rows first) for union rank encoding."""
+    if lc.dtype.is_string != rc.dtype.is_string:
+        raise TypeError("join key types must match (string vs non-string)")
+    lv, rv = lc.valid_mask(), rc.valid_mask()
+    validity = jnp.concatenate([lv, rv])
+    if lc.dtype.is_decimal or rc.dtype.is_decimal:
+        # unscaled storage comparison is only sound at equal scales
+        if lc.dtype != rc.dtype:
+            raise TypeError(
+                f"decimal join keys must have identical type+scale, got "
+                f"{lc.dtype} vs {rc.dtype} (rescale first)"
+            )
+    if lc.dtype.is_string:
+        from spark_rapids_jni_tpu.ops import strings as s
+
+        lp, rp = s.pad_strings(lc), s.pad_strings(rc)
+        width = max(int(lp.chars.shape[1]), int(rp.chars.shape[1]))
+
+        def widen(p):
+            w = int(p.chars.shape[1])
+            if w == width:
+                return p.chars
+            return jnp.pad(p.chars, ((0, 0), (0, width - w)))
+
+        return Column(
+            lc.dtype,
+            jnp.concatenate([lp.data, rp.data]),
+            validity,
+            chars=jnp.concatenate([widen(lp), widen(rp)]),
+        )
+    if lc.dtype.storage_dtype != rc.dtype.storage_dtype:
+        raise TypeError("join key storage types must match")
+    return Column(lc.dtype, jnp.concatenate([lc.data, rc.data]), validity)
+
+
+@func_range("rank_encode_keys")
+def rank_encode_keys(
+    left: Table, right: Table,
+    left_on: Sequence[int], right_on: Sequence[int],
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact join-key encoding: dense ranks of the key tuples over the union
+    of both tables. ``lkey[i] == rkey[j]`` iff the tuples are equal (nulls
+    compare equal to nulls here; null-match exclusion stays in the join's
+    validity masks). One lexsort of nl+nr rows — collision-free, unlike
+    hashing."""
+    from spark_rapids_jni_tpu.ops.groupby import _rows_equal_prev
+
+    nl = left.num_rows
+    combined = Table([
+        _concat_key_columns(left.column(i), right.column(j))
+        for i, j in zip(left_on, right_on)
+    ])
+    n = combined.num_rows
+    ks = list(range(combined.num_columns))
+    order = sort_order(combined, ks)
+    sorted_tbl = gather(combined, order)
+    same = _rows_equal_prev(sorted_tbl, ks)
+    gid = (jnp.cumsum(~same) - 1).astype(jnp.int32)
+    ranks = jnp.zeros((n,), jnp.int32).at[order].set(gid)
+    return ranks[:nl], ranks[nl:]
+
+
 @func_range("join")
 def join(
     left: Table,
     right: Table,
-    left_on: int,
-    right_on: int,
+    left_on: int | Sequence[int],
+    right_on: int | Sequence[int],
     out_size: int,
     how: str = "inner",
     left_row_valid: jnp.ndarray | None = None,
 ) -> JoinMaps:
-    """Single-key equi-join returning gather maps. ``out_size`` caps the
-    output (check ``total`` <= out_size on host if exactness matters);
-    multi-key joins compose by pre-hashing keys into one column.
-    ``left_row_valid`` marks which probe rows exist at all (False =
-    padding/shuffle phantom, emits nothing even under a left join)."""
+    """Equi-join returning gather maps; single- or multi-column keys of any
+    supported type (integral, float, decimal, string). ``out_size`` caps the
+    output (check ``total`` <= out_size on host if exactness matters, or use
+    ``join_auto``). ``left_row_valid`` marks which probe rows exist at all
+    (False = padding/shuffle phantom, emits nothing even under a left join).
+
+    SQL semantics: a NULL in ANY key column makes the row match nothing."""
     if how not in ("inner", "left"):
         raise ValueError(f"unsupported join type {how!r}")
-    lc, rc = left.column(left_on), right.column(right_on)
-    if lc.dtype.storage_dtype != rc.dtype.storage_dtype:
-        raise TypeError("join key storage types must match")
-    if lc.dtype.storage_dtype.kind not in ("i", "u"):
-        raise TypeError(
-            "join keys must be integral this round (hash or encode other "
-            "types into an integer column first)"
-        )
-    return _join_maps_impl(
-        lc.data, lc.valid_mask(), rc.data, rc.valid_mask(), out_size, how,
-        left_row_valid,
+    left_keys = [left_on] if isinstance(left_on, int) else list(left_on)
+    right_keys = [right_on] if isinstance(right_on, int) else list(right_on)
+    if len(left_keys) != len(right_keys) or not left_keys:
+        raise ValueError("left_on and right_on must be equal-length, non-empty")
+
+    lvalid = left.column(left_keys[0]).valid_mask()
+    for k in left_keys[1:]:
+        lvalid = lvalid & left.column(k).valid_mask()
+    rvalid = right.column(right_keys[0]).valid_mask()
+    for k in right_keys[1:]:
+        rvalid = rvalid & right.column(k).valid_mask()
+
+    lc = left.column(left_keys[0])
+    rc0 = right.column(right_keys[0])
+    single_integral = (
+        len(left_keys) == 1
+        and lc.dtype == rc0.dtype  # incl. decimal scale — unscaled values
+        and not lc.dtype.is_string  # only compare at identical scales
+        and lc.dtype.storage_dtype.kind in ("i", "u")
     )
+    if single_integral:
+        # fast path: integral values are their own exact encoding
+        lkey, rkey = lc.data, rc0.data
+    else:
+        lkey, rkey = rank_encode_keys(left, right, left_keys, right_keys)
+    return _join_maps_impl(
+        lkey, lvalid, rkey, rvalid, out_size, how, left_row_valid,
+    )
+
+
+def _gather_out(c: Column, idx: jnp.ndarray, validity: jnp.ndarray) -> Column:
+    if c.dtype.is_string:
+        from spark_rapids_jni_tpu.ops import strings as s
+
+        g = s.gather_strings(c, idx)
+        return Column(c.dtype, g.data, validity, chars=g.chars)
+    return Column(c.dtype, c.data[idx], validity)
 
 
 def apply_join_maps(
@@ -135,14 +234,39 @@ def apply_join_maps(
 ) -> Table:
     """Materialize the joined table: left columns then right columns.
     Padding rows carry validity False everywhere; unmatched right sides
-    (left join) are null."""
+    (left join) are null. String columns come back in the padded device
+    layout (ops.strings.unpad_strings restores Arrow)."""
     cols: list[Column] = []
     for c in left.columns:
         validity = c.valid_mask()[maps.left_index] & maps.row_valid
-        cols.append(Column(c.dtype, c.data[maps.left_index], validity))
+        cols.append(_gather_out(c, maps.left_index, validity))
     for c in right.columns:
         validity = (
             c.valid_mask()[maps.right_index] & maps.right_valid & maps.row_valid
         )
-        cols.append(Column(c.dtype, c.data[maps.right_index], validity))
+        cols.append(_gather_out(c, maps.right_index, validity))
     return Table(cols)
+
+
+def join_auto(
+    left: Table,
+    right: Table,
+    left_on: int | Sequence[int],
+    right_on: int | Sequence[int],
+    initial_out_size: int | None = None,
+    how: str = "inner",
+    growth: int = 4,
+) -> tuple[JoinMaps, Table]:
+    """Host-level grow-and-retry around the output capacity: run with a
+    guessed ``out_size``, and if ``total`` exceeded it, grow by ``growth``
+    and rerun until exact. Each retry recompiles for the new static bound —
+    output capacity is a planning parameter on TPU, and this wrapper is the
+    planner's feedback loop. Returns (maps, materialized table)."""
+    n = max(left.num_rows, 1)
+    out_size = int(initial_out_size) if initial_out_size else n
+    while True:
+        maps = join(left, right, left_on, right_on, out_size, how=how)
+        total = int(maps.total)
+        if total <= out_size:
+            return maps, apply_join_maps(left, right, maps)
+        out_size = max(total, out_size * growth)

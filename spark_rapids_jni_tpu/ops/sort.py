@@ -50,9 +50,20 @@ def _as_unsigned_key(col_data: jnp.ndarray, dtype: DType) -> jnp.ndarray:
 def _key_arrays(col: Column, ascending: bool, nulls_first: bool):
     """Return the lexsort key(s) for one column, minor-to-major order."""
     dtype = col.dtype
+    valid = col.valid_mask()
+
+    if dtype.is_string:
+        from spark_rapids_jni_tpu.ops import strings as s
+
+        value_keys = s.packed_sort_keys(col)
+        if not ascending:
+            value_keys = [~k for k in value_keys]
+        null_key = jnp.where(valid, jnp.uint8(1), jnp.uint8(0))
+        null_rank = null_key if nulls_first else jnp.uint8(1) - null_key
+        return value_keys + [null_rank]
+
     np_dt = dtype.storage_dtype
     n = col.size
-    valid = col.valid_mask()
 
     if np_dt == np.float64:
         # value-level key: works on all backends, Spark order for NaN
@@ -104,7 +115,10 @@ def gather(table: Table, indices: jnp.ndarray) -> Table:
     cols = []
     for c in table.columns:
         if c.dtype.is_string:
-            raise NotImplementedError("string gather lands with cast_strings")
+            from spark_rapids_jni_tpu.ops import strings as s
+
+            cols.append(s.gather_strings(c, indices))
+            continue
         validity = None if c.validity is None else c.validity[indices]
         cols.append(Column(c.dtype, c.data[indices], validity))
     return Table(cols)

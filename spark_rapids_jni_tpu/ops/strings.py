@@ -1,0 +1,283 @@
+"""String columns in the relational core — sort keys, equality, hashing,
+gather, and the padded device layout.
+
+The reference's relational substrate handles STRING keys everywhere (cuDF
+sort/groupby/join capability surface, built by build-libcudf.xml:34-60).
+cuDF's device layout is Arrow (offsets + chars); its kernels walk the ragged
+buffers with per-thread char loops. That shape is hostile to the TPU: ragged
+gathers serialize on the VPU and defeat XLA tiling.
+
+TPU-first design — two layouts, one conversion boundary:
+
+- **Arrow layout** (offsets int32[n+1], chars uint8[m]) at rest and in IO —
+  what the Parquet/ORC readers produce and `collect` returns.
+- **Padded layout** (lengths int32[n], bytes uint8[n, W]) on device for
+  relational ops. W is a planner-chosen static width (max row length). Every
+  string op becomes a dense, vectorized pass over the matrix: sort keys are
+  big-endian packed uint32 words (memcmp order, length as tiebreak), row
+  equality is one masked compare, and xxhash64 runs the *full* variable-length
+  algorithm with masked lane updates — no per-row loops anywhere.
+
+Conversions are single gathers (static shapes both ways; Arrow->padded pads,
+padded->Arrow compacts into an n*W char buffer with the real total tracked by
+offsets). Width is computed on host where data is host-visible, or passed
+statically by the planner inside jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+STRING = DType(TypeId.STRING)
+
+
+# ---------------------------------------------------------------------------
+# Layout predicates / conversions
+# ---------------------------------------------------------------------------
+
+def is_padded(col: Column) -> bool:
+    """True when a string column carries the padded (n, W) device layout."""
+    return col.is_padded_string
+
+
+def max_string_width(col: Column) -> int:
+    """Host-side max row length (0 for an all-empty column). Only valid
+    outside jit: forces a device->host read of the offsets."""
+    if is_padded(col):
+        return int(col.chars.shape[1])
+    offsets = np.asarray(col.data)
+    if offsets.shape[0] <= 1:
+        return 0
+    return int(np.max(offsets[1:] - offsets[:-1]))
+
+
+def pad_strings(col: Column, width: int | None = None) -> Column:
+    """Arrow -> padded layout. ``width`` must be >= every row length (rows
+    longer than width would corrupt; callers use max_string_width or a
+    planner bound). Cells past a row's length are zero."""
+    if is_padded(col):
+        return col
+    if width is None:
+        try:
+            width = max_string_width(col)
+        except jax.errors.TracerArrayConversionError:
+            raise ValueError(
+                "pad_strings inside jit needs an explicit static width — "
+                "convert string columns to the padded layout (pad_strings) "
+                "on host before entering jit, or pass width="
+            ) from None
+    width = max(int(width), 1)
+    offsets = col.data
+    chars = col.chars
+    n = int(offsets.shape[0]) - 1
+    if n == 0 or int(chars.shape[0]) == 0:
+        return Column(
+            STRING,
+            jnp.zeros((n,), jnp.int32),
+            col.validity,
+            chars=jnp.zeros((n, width), jnp.uint8),
+        )
+    starts = offsets[:-1]
+    lengths = (offsets[1:] - starts).astype(jnp.int32)
+    idx = starts[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+    present = jnp.arange(width, dtype=jnp.int32)[None, :] < lengths[:, None]
+    cap = int(chars.shape[0]) - 1
+    mat = jnp.where(present, chars[jnp.clip(idx, 0, cap)], jnp.uint8(0))
+    return Column(STRING, lengths, col.validity, chars=mat)
+
+
+def unpad_strings(col: Column) -> Column:
+    """Padded -> Arrow layout. The chars buffer is allocated at the static
+    bound n*W; offsets[-1] carries the true total (slack bytes at the end
+    are dead, which the Arrow contract allows)."""
+    if not is_padded(col):
+        return col
+    lengths = col.data
+    mat = col.chars
+    n, width = int(mat.shape[0]), int(mat.shape[1])
+    if n == 0:
+        return Column(
+            STRING,
+            jnp.zeros((1,), jnp.int32),
+            col.validity,
+            chars=jnp.zeros((0,), jnp.uint8),
+        )
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths).astype(jnp.int32)]
+    )
+    # Compact gather: output char position c belongs to the row r with
+    # offsets[r] <= c < offsets[r+1]; its source byte is mat[r, c - offsets[r]].
+    total_cap = max(n * width, 1)
+    c = jnp.arange(total_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets[1:], c, side="right").astype(jnp.int32)
+    row = jnp.clip(row, 0, max(n - 1, 0))
+    delta = c - offsets[row]
+    inside = c < offsets[-1]
+    flat = mat.reshape(-1)
+    src = jnp.clip(row * width + delta, 0, max(n * width - 1, 0))
+    chars = jnp.where(inside, flat[src], jnp.uint8(0))
+    return Column(STRING, offsets, col.validity, chars=chars)
+
+
+def gather_strings(col: Column, indices: jnp.ndarray) -> Column:
+    """Row gather of a padded string column (padded layout makes this the
+    same two-array gather as fixed-width columns)."""
+    col = pad_strings(col)
+    validity = None if col.validity is None else col.validity[indices]
+    return Column(STRING, col.data[indices], validity, chars=col.chars[indices])
+
+
+# ---------------------------------------------------------------------------
+# Sort keys / equality
+# ---------------------------------------------------------------------------
+
+def packed_sort_keys(col: Column) -> list[jnp.ndarray]:
+    """Order-preserving lexsort keys for a padded string column, minor to
+    major: [length, word_k-1, ..., word_0]. Each word packs 4 bytes
+    big-endian into uint32, so uint32 comparison == memcmp on those bytes;
+    zero padding ties equal prefixes and the length key breaks them
+    (shorter first) — exactly memcmp-then-length string order, correct for
+    embedded NUL bytes too."""
+    col = pad_strings(col)
+    mat = col.chars
+    lengths = col.data
+    width = int(mat.shape[1])
+    n_words = (width + 3) // 4
+    pad_w = n_words * 4 - width
+    if pad_w:
+        mat = jnp.pad(mat, ((0, 0), (0, pad_w)))
+    u = mat.astype(jnp.uint32).reshape(mat.shape[0], n_words, 4)
+    words = (
+        (u[:, :, 0] << 24) | (u[:, :, 1] << 16) | (u[:, :, 2] << 8) | u[:, :, 3]
+    )
+    keys = [words[:, i] for i in range(n_words - 1, -1, -1)]
+    return [lengths.astype(jnp.uint32)] + keys
+
+
+def strings_equal_prev(col: Column) -> jnp.ndarray:
+    """bool[n-1]: row i+1's bytes equal row i's (groupby boundary test)."""
+    col = pad_strings(col)
+    mat, lengths = col.chars, col.data
+    eq_len = lengths[1:] == lengths[:-1]
+    eq_bytes = jnp.all(mat[1:] == mat[:-1], axis=1)
+    return eq_len & eq_bytes
+
+
+# ---------------------------------------------------------------------------
+# Variable-length xxhash64 (Spark hashUnsafeBytes parity)
+# ---------------------------------------------------------------------------
+
+from spark_rapids_jni_tpu.ops.hash import (  # noqa: E402 — shared primitives
+    _P1, _P2, _P3, _P4, _P5, _avalanche, _rotl,
+)
+
+
+def _le_words(mat: jnp.ndarray, n_lanes: int, lane_bytes: int) -> jnp.ndarray:
+    """(n, n_lanes) little-endian words of ``lane_bytes`` each from the
+    leading n_lanes*lane_bytes columns of the byte matrix."""
+    u = mat[:, : n_lanes * lane_bytes].astype(jnp.uint64)
+    u = u.reshape(mat.shape[0], n_lanes, lane_bytes)
+    shifts = jnp.asarray(
+        [np.uint64(8 * i) for i in range(lane_bytes)], dtype=jnp.uint64
+    )
+    return jnp.sum(u << shifts[None, None, :], axis=2, dtype=jnp.uint64)
+
+
+@func_range("xxhash64_bytes")
+def xxhash64_bytes(
+    mat: jnp.ndarray, lengths: jnp.ndarray, seeds: jnp.ndarray
+) -> jnp.ndarray:
+    """Full XXH64 of each row's first ``lengths[i]`` bytes, vectorized over
+    rows with per-row seeds — the exact algorithm Spark's hashUnsafeBytes /
+    the reference family's string xxhash64 kernel computes, expressed as a
+    static number of masked elementwise passes (width/8 lane updates), not
+    per-row loops. Rows' bytes past their length MUST be zero-padded (they
+    are masked out, but the packing helpers guarantee it anyway)."""
+    n, width = int(mat.shape[0]), int(mat.shape[1])
+    lengths = lengths.astype(jnp.int64)
+    seeds = seeds.astype(jnp.uint64)
+
+    # Stripe phase: process 32-byte stripes for rows with length >= 32.
+    n_stripes = width // 32
+    n_rows_u64 = (width + 7) // 8
+    padded_w = n_rows_u64 * 8
+    if padded_w != width:
+        mat8 = jnp.pad(mat, ((0, 0), (0, padded_w - width)))
+    else:
+        mat8 = mat
+    lanes = _le_words(mat8, n_rows_u64, 8)  # (n, n_rows_u64) uint64 LE lanes
+
+    full_stripes = jnp.where(lengths >= 32, lengths // 32, 0)
+    v1 = seeds + _P1 + _P2
+    v2 = seeds + _P2
+    v3 = seeds
+    v4 = seeds - _P1
+    for s in range(n_stripes):
+        active = s < full_stripes
+        l0, l1 = lanes[:, 4 * s], lanes[:, 4 * s + 1]
+        l2, l3 = lanes[:, 4 * s + 2], lanes[:, 4 * s + 3]
+        v1 = jnp.where(active, _rotl(v1 + l0 * _P2, 31) * _P1, v1)
+        v2 = jnp.where(active, _rotl(v2 + l1 * _P2, 31) * _P1, v2)
+        v3 = jnp.where(active, _rotl(v3 + l2 * _P2, 31) * _P1, v3)
+        v4 = jnp.where(active, _rotl(v4 + l3 * _P2, 31) * _P1, v4)
+    h_long = (
+        _rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12) + _rotl(v4, 18)
+    )
+    for v in (v1, v2, v3, v4):
+        h_long = (h_long ^ (_rotl(v * _P2, 31) * _P1)) * _P1 + _P4
+    h = jnp.where(lengths >= 32, h_long, seeds + _P5)
+    h = h + lengths.astype(jnp.uint64)
+
+    consumed = full_stripes * 32  # bytes already absorbed per row
+
+    # 8-byte tail lanes: up to width//8 of them, masked per row.
+    full_words = lengths // 8
+    for w in range(n_rows_u64):
+        active = (w >= consumed // 8) & (w < full_words)
+        upd = (h ^ (_rotl(lanes[:, w] * _P2, 31) * _P1))
+        upd = _rotl(upd, 27) * _P1 + _P4
+        h = jnp.where(active, upd, h)
+
+    # One optional 4-byte lane.
+    word4 = _le_words(mat8, n_rows_u64 * 2, 4)  # (n, 2*n_rows_u64) uint32-in-u64
+    pos4 = full_words * 2  # index of the 4-byte word at offset full_words*8
+    has4 = (lengths % 8) >= 4
+    lane4 = jnp.take_along_axis(
+        word4, jnp.clip(pos4, 0, word4.shape[1] - 1)[:, None], axis=1
+    )[:, 0]
+    upd = (h ^ (lane4 * _P1))
+    upd = _rotl(upd, 23) * _P2 + _P3
+    h = jnp.where(has4, upd, h)
+
+    # Up to 7 single-byte tail updates (3 if the 4-byte lane fired).
+    tail_start = full_words * 8 + jnp.where(has4, 4, 0)
+    n_tail_max = min(7, width) if width else 0
+    matu = mat8.astype(jnp.uint64)
+    for b in range(n_tail_max):
+        pos = tail_start + b
+        active = pos < lengths
+        byte = jnp.take_along_axis(
+            matu, jnp.clip(pos, 0, padded_w - 1).astype(jnp.int32)[:, None], axis=1
+        )[:, 0]
+        upd = _rotl(h ^ (byte * _P5), 11) * _P1
+        h = jnp.where(active, upd, h)
+
+    del n
+    return _avalanche(h)
+
+
+def hash_string_column(col: Column, seeds: jnp.ndarray) -> jnp.ndarray:
+    """Chainable per-row hash of a string column: full XXH64 over each row's
+    UTF-8 bytes with the running hash as seed; null rows pass the seed
+    through (Spark HashExpression chaining semantics)."""
+    col = pad_strings(col)
+    hashed = xxhash64_bytes(col.chars, col.data, seeds)
+    if col.validity is None:
+        return hashed
+    return jnp.where(col.validity, hashed, seeds)

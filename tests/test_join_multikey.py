@@ -1,0 +1,190 @@
+"""Exact multi-key and string-key join tests (VERDICT round-2 item 3).
+
+cuDF's hash join is exact on composite keys (north star, BASELINE.json);
+the rank-encoded sort-merge join must return EXACT results on key tuples
+built to defeat weaker encodings: concatenation collisions ("ab","c") vs
+("a","bc"), swapped tuples, and random data checked against a brute-force
+host oracle.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops import strings as s
+from spark_rapids_jni_tpu.ops.join import (
+    apply_join_maps,
+    join,
+    join_auto,
+    rank_encode_keys,
+)
+
+
+def oracle_inner(left_keys, right_keys):
+    """Brute-force inner join pairs; None in any key column never matches."""
+    pairs = []
+    for i, lk in enumerate(zip(*left_keys)):
+        if any(v is None for v in lk):
+            continue
+        for j, rk in enumerate(zip(*right_keys)):
+            if any(v is None for v in rk):
+                continue
+            if lk == rk:
+                pairs.append((i, j))
+    return sorted(pairs)
+
+
+def run_join(ltbl, rtbl, lon, ron, how="inner"):
+    out_size = max(ltbl.num_rows * max(rtbl.num_rows, 1), 1)
+    maps = join(ltbl, rtbl, lon, ron, out_size, how=how)
+    total = int(maps.total)
+    assert total <= out_size
+    li = np.asarray(maps.left_index)[:total]
+    ri = np.asarray(maps.right_index)[:total]
+    rv = np.asarray(maps.right_valid)[:total]
+    return li, ri, rv, maps
+
+
+class TestMultiKeyExact:
+    def test_concatenation_collision(self):
+        # ("ab","c") vs ("a","bc"): equal under naive concatenation,
+        # NOT equal as tuples — must not match.
+        left = Table([
+            Column.from_pylist(["ab", "a"], t.STRING),
+            Column.from_pylist(["c", "bc"], t.STRING),
+        ])
+        right = Table([
+            Column.from_pylist(["a", "ab"], t.STRING),
+            Column.from_pylist(["bc", "x"], t.STRING),
+        ])
+        li, ri, rv, _ = run_join(left, right, [0, 1], [0, 1])
+        assert sorted(zip(li, ri)) == [(1, 0)]
+
+    def test_swapped_tuple_values(self):
+        left = Table([
+            Column.from_pylist([1, 2, 7], t.INT64),
+            Column.from_pylist([2, 1, 7], t.INT64),
+        ])
+        right = Table([
+            Column.from_pylist([2, 7], t.INT64),
+            Column.from_pylist([1, 7], t.INT64),
+        ])
+        li, ri, rv, _ = run_join(left, right, [0, 1], [0, 1])
+        assert sorted(zip(li, ri)) == [(1, 0), (2, 1)]
+
+    def test_mixed_int_string_keys_random_vs_oracle(self, rng):
+        nl, nr = 60, 45
+        lk1 = [int(v) for v in rng.integers(0, 6, nl)]
+        lk2 = [f"s{v}" for v in rng.integers(0, 4, nl)]
+        rk1 = [int(v) for v in rng.integers(0, 6, nr)]
+        rk2 = [f"s{v}" for v in rng.integers(0, 4, nr)]
+        # sprinkle nulls into both key columns
+        lk1[3] = None
+        lk2[11] = None
+        rk2[7] = None
+        left = Table([
+            Column.from_pylist(lk1, t.INT64),
+            Column.from_pylist(lk2, t.STRING),
+            Column.from_pylist(list(range(nl)), t.INT32),
+        ])
+        right = Table([
+            Column.from_pylist(rk1, t.INT64),
+            Column.from_pylist(rk2, t.STRING),
+        ])
+        li, ri, rv, _ = run_join(left, right, [0, 1], [0, 1])
+        assert sorted(zip(li, ri)) == oracle_inner([lk1, lk2], [rk1, rk2])
+
+    def test_three_key_join(self, rng):
+        n = 40
+        cols_l = [[int(v) for v in rng.integers(0, 3, n)] for _ in range(3)]
+        cols_r = [[int(v) for v in rng.integers(0, 3, n)] for _ in range(3)]
+        left = Table([Column.from_pylist(c, t.INT32) for c in cols_l])
+        right = Table([Column.from_pylist(c, t.INT32) for c in cols_r])
+        li, ri, rv, _ = run_join(left, right, [0, 1, 2], [0, 1, 2])
+        assert sorted(zip(li, ri)) == oracle_inner(cols_l, cols_r)
+
+    def test_float_keys_exact(self):
+        # floats route through rank encoding (no bit tricks needed)
+        left = Table([Column.from_pylist([1.5, 2.25, float("nan")], t.FLOAT64)])
+        right = Table([Column.from_pylist([2.25, 1.5, 3.0], t.FLOAT64)])
+        li, ri, rv, _ = run_join(left, right, [0], [0])
+        assert sorted(zip(li, ri)) == [(0, 1), (1, 0)]
+
+
+class TestStringKeyJoin:
+    def test_string_single_key(self, rng):
+        lk = ["apple", "pear", None, "fig", "apple", ""]
+        rk = ["fig", "apple", "", None, "grape"]
+        left = Table([
+            Column.from_pylist(lk, t.STRING),
+            Column.from_pylist(list(range(len(lk))), t.INT64),
+        ])
+        right = Table([
+            Column.from_pylist(rk, t.STRING),
+            Column.from_pylist([10 * i for i in range(len(rk))], t.INT64),
+        ])
+        li, ri, rv, maps = run_join(left, right, 0, 0)
+        assert sorted(zip(li, ri)) == oracle_inner([lk], [rk])
+        out = apply_join_maps(left, right, maps)
+        k = int(maps.total)
+        # left string key survives materialization
+        left_keys_out = s.unpad_strings(
+            Column(t.STRING, out.column(0).data[:k], out.column(0).validity[:k],
+                   chars=out.column(0).chars[:k])
+        ).to_pylist()
+        assert sorted(left_keys_out) == sorted(
+            lk[i] for i, _ in oracle_inner([lk], [rk])
+        )
+
+    def test_string_left_join_nulls(self):
+        lk = ["a", None, "zz"]
+        rk = ["a", "b"]
+        left = Table([Column.from_pylist(lk, t.STRING)])
+        right = Table([Column.from_pylist(rk, t.STRING)])
+        out_size = 8
+        maps = join(left, right, 0, 0, out_size, how="left")
+        total = int(maps.total)
+        assert total == 3  # "a" matches; null row and "zz" emit unmatched
+        rv = np.asarray(maps.right_valid)[:total]
+        li = np.asarray(maps.left_index)[:total]
+        matched = {int(l): bool(v) for l, v in zip(li, rv)}
+        assert matched == {0: True, 1: False, 2: False}
+
+    def test_join_auto_grows(self, rng):
+        # many-to-many: 5x5 matches per key, initial capacity too small
+        lk = ["k"] * 5 + ["other"]
+        rk = ["k"] * 5
+        left = Table([Column.from_pylist(lk, t.STRING)])
+        right = Table([Column.from_pylist(rk, t.STRING)])
+        maps, out = join_auto(left, right, 0, 0, initial_out_size=2)
+        assert int(maps.total) == 25
+
+
+class TestRankEncoding:
+    def test_ranks_agree_iff_tuples_equal(self, rng):
+        lk = ["aa", "ab", "aa", "b"]
+        rk = ["ab", "aa", "c"]
+        left = Table([Column.from_pylist(lk, t.STRING)])
+        right = Table([Column.from_pylist(rk, t.STRING)])
+        lr, rr = rank_encode_keys(left, right, [0], [0])
+        lr, rr = np.asarray(lr), np.asarray(rr)
+        for i, lv in enumerate(lk):
+            for j, rv in enumerate(rk):
+                assert (lr[i] == rr[j]) == (lv == rv)
+
+
+class TestDecimalKeys:
+    def test_scale_mismatch_rejected(self):
+        left = Table([Column.from_pylist([100], t.decimal64(-2))])
+        right = Table([Column.from_pylist([100], t.decimal64(0))])
+        with pytest.raises(TypeError, match="scale"):
+            join(left, right, 0, 0, 4)
+
+    def test_equal_scale_decimal_join(self):
+        left = Table([Column.from_pylist([100, 250], t.decimal64(-2))])
+        right = Table([Column.from_pylist([250, 999], t.decimal64(-2))])
+        li, ri, rv, _ = run_join(left, right, 0, 0)
+        assert sorted(zip(li, ri)) == [(1, 0)]
