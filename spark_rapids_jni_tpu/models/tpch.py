@@ -81,6 +81,24 @@ def lineitem_table(num_rows: int, seed: int = 0) -> Table:
     )
 
 
+def lineitem_table_strings(num_rows: int, seed: int = 0) -> Table:
+    """Lineitem variant with REAL STRING returnflag/linestatus columns —
+    the schema shape Spark actually has before dictionary tricks (flags are
+    CHAR(1) STRINGs in TPC-H). Runs through the same q1 pipeline: string
+    keys sort, group, and shuffle natively."""
+    base = lineitem_table(num_rows, seed)
+    rf = np.asarray(base.column(L_RETURNFLAG).data).astype(np.uint8)
+    ls = np.asarray(base.column(L_LINESTATUS).data).astype(np.uint8)
+    cols = list(base.columns)
+    cols[L_RETURNFLAG] = Column.from_pylist(
+        [chr(b) for b in rf], t.STRING
+    )
+    cols[L_LINESTATUS] = Column.from_pylist(
+        [chr(b) for b in ls], t.STRING
+    )
+    return Table(cols)
+
+
 class Q1Result(NamedTuple):
     result: GroupByResult  # grouped aggregates, padded; sorted by flag/status
 
@@ -115,10 +133,24 @@ def _q1_work_table(lineitem: Table) -> Table:
         dp_valid & tax.valid_mask(),
     )
 
-    work = Table(
+    # Masked rows must not create key groups: zero out key bytes for them.
+    def masked_key(c: Column) -> Column:
+        if c.dtype.is_string:
+            from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+            p = pad_strings(c)
+            return Column(
+                p.dtype,
+                jnp.where(keep, p.data, 0),
+                keep,
+                chars=jnp.where(keep[:, None], p.chars, jnp.uint8(0)),
+            )
+        return Column(c.dtype, jnp.where(keep, c.data, 0), keep)
+
+    return Table(
         [
-            masked(lineitem.column(L_RETURNFLAG)),
-            masked(lineitem.column(L_LINESTATUS)),
+            masked_key(lineitem.column(L_RETURNFLAG)),
+            masked_key(lineitem.column(L_LINESTATUS)),
             qty,
             price,
             disc,
@@ -126,11 +158,6 @@ def _q1_work_table(lineitem: Table) -> Table:
             charge,
         ]
     )
-    # Masked rows must not create key groups: zero out key bytes for them.
-    rf, ls = work.columns[0], work.columns[1]
-    work.columns[0] = Column(rf.dtype, jnp.where(keep, rf.data, 0), keep)
-    work.columns[1] = Column(ls.dtype, jnp.where(keep, ls.data, 0), keep)
-    return work
 
 
 @func_range("tpch_q1")

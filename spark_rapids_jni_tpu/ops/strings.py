@@ -199,7 +199,7 @@ def xxhash64_bytes(
     static number of masked elementwise passes (width/8 lane updates), not
     per-row loops. Rows' bytes past their length MUST be zero-padded (they
     are masked out, but the packing helpers guarantee it anyway)."""
-    n, width = int(mat.shape[0]), int(mat.shape[1])
+    width = int(mat.shape[1])
     lengths = lengths.astype(jnp.int64)
     seeds = seeds.astype(jnp.uint64)
 
@@ -268,7 +268,6 @@ def xxhash64_bytes(
         upd = _rotl(h ^ (byte * _P5), 11) * _P1
         h = jnp.where(active, upd, h)
 
-    del n
     return _avalanche(h)
 
 

@@ -32,8 +32,15 @@ def head_table(table: Table, k: int) -> Table:
     """First k rows (static slice) — groupby outputs put real groups first."""
     cols = []
     for c in table.columns:
+        if c.dtype.is_string and not c.is_padded_string:
+            raise NotImplementedError(
+                "head_table needs string columns in the padded device layout "
+                "(ops.strings.pad_strings); Arrow offsets cannot be sliced "
+                "like row data"
+            )
         validity = None if c.validity is None else c.validity[:k]
-        cols.append(Column(c.dtype, c.data[:k], validity))
+        chars = c.chars[:k] if c.is_padded_string else None
+        cols.append(Column(c.dtype, c.data[:k], validity, chars=chars))
     return Table(cols)
 
 
@@ -57,8 +64,31 @@ def shard_table(
     sharding = NamedSharding(mesh, P(axis))
     out = []
     for c in table.columns:
+        if c.dtype.is_string:
+            # strings shard in the padded device layout: int32 lengths ride
+            # the fixed-width path, the (n, W) char matrix shards by rows
+            from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+            p = pad_strings(c)
+            lengths, mat = p.data, p.chars
+            valid = p.valid_mask()
+            if pad:
+                lengths = jnp.concatenate([lengths, jnp.zeros((pad,), jnp.int32)])
+                mat = jnp.concatenate(
+                    [mat, jnp.zeros((pad, mat.shape[1]), jnp.uint8)]
+                )
+                valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.bool_)])
+            out.append(Column(
+                c.dtype,
+                jax.device_put(lengths, sharding),
+                jax.device_put(valid, sharding),
+                chars=jax.device_put(mat, sharding),
+            ))
+            continue
         if not c.dtype.is_fixed_width:
-            raise NotImplementedError("shard_table: fixed-width columns only")
+            raise NotImplementedError(
+                "shard_table: fixed-width and string columns only"
+            )
         data = jnp.concatenate([c.data, jnp.zeros((pad,), c.data.dtype)]) if pad else c.data
         valid = c.valid_mask()
         valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.bool_)]) if pad else valid
@@ -128,11 +158,32 @@ def collect(table: Table, num_rows_per_device: jnp.ndarray, mesh: Mesh) -> Table
             lo = dev * per_dev
             data = np.asarray(c.data[lo : lo + k])
             valid = np.asarray(c.valid_mask()[lo : lo + k])
-            cols[i].append((data, valid))
+            chars = (
+                np.asarray(c.chars[lo : lo + k])
+                if c.is_padded_string else None
+            )
+            cols[i].append((data, valid, chars))
     out = []
     for c, parts in zip(table.columns, cols):
         data = np.concatenate([p[0] for p in parts])
         valid = np.concatenate([p[1] for p in parts])
+        if c.is_padded_string:
+            # back to the Arrow at-rest layout on host: one boolean-mask
+            # flatten per device chunk (vectorized, no per-row loop)
+            lengths = np.concatenate([p[0] for p in parts])
+            blob = np.concatenate([
+                mat.reshape(-1)[
+                    (np.arange(mat.shape[1])[None, :] < lens[:, None]).reshape(-1)
+                ]
+                for (lens, _, mat) in parts
+            ]) if lengths.size else np.zeros((0,), np.uint8)
+            offsets = np.zeros(lengths.size + 1, dtype=np.int32)
+            np.cumsum(lengths, out=offsets[1:])
+            out.append(Column(
+                c.dtype, jnp.asarray(offsets), jnp.asarray(valid),
+                chars=jnp.asarray(blob.astype(np.uint8)),
+            ))
+            continue
         out.append(Column(c.dtype, jnp.asarray(data), jnp.asarray(valid)))
     return Table(out)
 
@@ -147,8 +198,8 @@ class DistributedJoin(NamedTuple):
 def distributed_join(
     left: Table,
     right: Table,
-    left_on: int,
-    right_on: int,
+    left_on: int | Sequence[int],
+    right_on: int | Sequence[int],
     mesh: Mesh,
     out_size_per_device: int,
     how: str = "inner",
@@ -171,25 +222,28 @@ def distributed_join(
     """
     from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
 
+    left_keys = [left_on] if isinstance(left_on, int) else list(left_on)
+    right_keys = [right_on] if isinstance(right_on, int) else list(right_on)
+
     def step(l: Table, r: Table, lrv, rrv):
-        ls = hash_shuffle(l, [left_on], EXEC_AXIS, capacity=left_capacity,
+        # identical routing for both sides: partition_hash depends only on
+        # key content (string hashing is over actual bytes, padding-blind)
+        ls = hash_shuffle(l, left_keys, EXEC_AXIS, capacity=left_capacity,
                           row_valid=lrv)
-        rs = hash_shuffle(r, [right_on], EXEC_AXIS, capacity=right_capacity,
+        rs = hash_shuffle(r, right_keys, EXEC_AXIS, capacity=right_capacity,
                           row_valid=rrv)
         # phantom (unoccupied) shuffle slots must not emit left-join rows
-        maps = join(ls.table, rs.table, left_on, right_on,
+        maps = join(ls.table, rs.table, left_keys, right_keys,
                     out_size_per_device, how=how,
                     left_row_valid=ls.row_valid)
         joined = apply_join_maps(ls.table, rs.table, maps)
         overflow = ls.overflowed | rs.overflowed
         return joined, maps.total.reshape(1), overflow.reshape(1)
 
-    d = mesh.shape[EXEC_AXIS]
     if left_row_valid is None:
         left_row_valid = jnp.ones((left.num_rows,), jnp.bool_)
     if right_row_valid is None:
         right_row_valid = jnp.ones((right.num_rows,), jnp.bool_)
-    del d
     out, total, overflowed = jax.shard_map(
         step,
         mesh=mesh,

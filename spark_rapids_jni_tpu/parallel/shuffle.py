@@ -19,8 +19,11 @@ per-call (`ShuffleResult.overflowed`) rather than silently dropped —
 the moral equivalent of the reference's hard 2^31-byte batch bound
 (reference row_conversion.cu:476-479).
 
-Fixed-width columns only, like the reference's row transpose
-(row_conversion.cu:515) — string shuffle lands with the string substrate.
+String columns travel in the padded device layout (ops.strings): their
+int32 lengths ride the fixed-width path and the (n, W) char matrix is
+exchanged as W parallel byte lanes of the same all_to_all — variable-length
+data over a static-shape collective. Arrow-layout string columns must be
+padded before entering the mesh program (shard_table does this).
 """
 
 from __future__ import annotations
@@ -49,9 +52,10 @@ def _pack_send(
     data: jnp.ndarray, order: jnp.ndarray, dst: jnp.ndarray, size: int
 ) -> jnp.ndarray:
     """Gather rows into destination order and scatter into the flat (D*C)
-    send buffer; out-of-capacity rows drop (reported via overflow flag)."""
+    send buffer; out-of-capacity rows drop (reported via overflow flag).
+    Works for 1-D columns and 2-D row matrices (padded string chars)."""
     g = data[order]
-    buf = jnp.zeros((size,), dtype=data.dtype)
+    buf = jnp.zeros((size,) + data.shape[1:], dtype=data.dtype)
     return buf.at[dst].set(g, mode="drop")
 
 
@@ -116,10 +120,11 @@ def hash_shuffle(
     )
 
     def exchange(flat: jnp.ndarray) -> jnp.ndarray:
-        """(D*C,) send layout -> (D*C,) receive layout over ICI."""
+        """(D*C, ...) send layout -> (D*C, ...) receive layout over ICI."""
         return jax.lax.all_to_all(
-            flat.reshape(D, capacity), axis_name, 0, 0, tiled=True
-        ).reshape(size)
+            flat.reshape((D, capacity) + flat.shape[1:]),
+            axis_name, 0, 0, tiled=True,
+        ).reshape((size,) + flat.shape[1:])
 
     recv_occupied = exchange(occupied)
 
@@ -129,6 +134,25 @@ def hash_shuffle(
     out_cols = []
     narrowing_overflow = jnp.zeros((), jnp.bool_)
     for i, col in enumerate(table.columns):
+        if col.dtype.is_string:
+            if not col.is_padded_string:
+                raise NotImplementedError(
+                    "hash_shuffle needs string columns in the padded device "
+                    "layout (ops.strings.pad_strings / shard_table do this)"
+                )
+            if wire_dtypes is not None and wire_dtypes[i] is not None:
+                raise ValueError(
+                    "wire narrowing does not apply to string columns "
+                    f"(column {i}); pass None for its wire dtype"
+                )
+            recv_len = exchange(_pack_send(col.data, order, dst, size))
+            recv_mat = exchange(_pack_send(col.chars, order, dst, size))
+            valid_flat = _pack_send(col.valid_mask(), order, dst, size)
+            recv_valid = exchange(valid_flat) & recv_occupied
+            out_cols.append(
+                Column(col.dtype, recv_len, recv_valid, chars=recv_mat)
+            )
+            continue
         if not col.dtype.is_fixed_width:
             raise NotImplementedError(
                 "hash_shuffle supports fixed-width columns only (reference "

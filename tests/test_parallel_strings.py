@@ -1,0 +1,223 @@
+"""Distributed string-key operator tests on the virtual 8-device mesh
+(VERDICT round-2 item 2: string columns through shard_table/hash_shuffle,
+distributed q1 on real STRING flags, and a distributed string-key join).
+
+The string wire format is the padded device layout: int32 lengths over the
+fixed-width all_to_all path, the (n, W) char matrix as W parallel byte
+lanes of the same collective.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.models.tpch import (
+    lineitem_table,
+    lineitem_table_strings,
+    tpch_q1_distributed,
+    tpch_q1_numpy,
+)
+from spark_rapids_jni_tpu.ops import strings as s
+from spark_rapids_jni_tpu.parallel import (
+    EXEC_AXIS,
+    executor_mesh,
+    hash_shuffle,
+    shard_table,
+)
+from spark_rapids_jni_tpu.parallel.distributed import (
+    collect,
+    distributed_groupby_aggregate,
+    distributed_join,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return executor_mesh(8)
+
+
+def test_string_shuffle_preserves_rows_and_targets(rng, mesh):
+    n = 512
+    words = ["alpha", "b", "", "gamma-delta", "ee", "zz9"]
+    vals = [words[i] for i in rng.integers(0, len(words), n)]
+    ints = rng.integers(0, 1000, n).astype(np.int64)
+    tbl = Table([
+        Column.from_pylist(vals, t.STRING),
+        Column.from_numpy(ints),
+    ])
+    sharded = shard_table(tbl, mesh)
+
+    def step(local):
+        # only 6 distinct keys: one partition can receive a sender's whole
+        # local batch, so capacity must cover the local row count
+        sh = hash_shuffle(local, [0], EXEC_AXIS, capacity=n // 8)
+        return sh.table, sh.row_valid, sh.overflowed.reshape(1)
+
+    out, row_valid, overflowed = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
+        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+    ))(sharded)
+    assert not np.asarray(overflowed).any()
+
+    rv = np.asarray(row_valid)
+    got_strings = [
+        v for v, ok in zip(out.column(0).to_pylist(), rv) if ok
+    ]
+    got_ints = [
+        v for v, ok in zip(out.column(1).to_pylist(), rv) if ok
+    ]
+    # row multiset preserved across the exchange
+    assert sorted(got_strings) == sorted(vals)
+    assert sorted(got_ints) == sorted(int(v) for v in ints)
+
+    # co-location: equal strings never land on two different devices
+    d = 8
+    per_dev = out.num_rows // d
+    owner = {}
+    all_strings = out.column(0).to_pylist()
+    for i in range(out.num_rows):
+        if not rv[i]:
+            continue
+        dev = i // per_dev
+        word = all_strings[i]
+        assert owner.setdefault(word, dev) == dev
+
+
+def test_distributed_groupby_string_keys(rng, mesh):
+    n = 1024
+    keys = [f"key_{i}" for i in rng.integers(0, 40, n)]
+    vals = rng.integers(-500, 500, n).astype(np.int64)
+    tbl = Table([
+        Column.from_pylist(keys, t.STRING),
+        Column.from_numpy(vals),
+    ])
+    sharded = shard_table(tbl, mesh)
+    dist = distributed_groupby_aggregate(
+        sharded, keys=[0], aggs=[(1, "sum"), (1, "count")], mesh=mesh,
+        capacity=n // 4,
+    )
+    assert not np.asarray(dist.overflowed).any()
+    got_tbl = collect(dist.table, dist.num_groups, mesh)
+    got = {}
+    ks = got_tbl.column(0).to_pylist()
+    sums = got_tbl.column(1).to_pylist()
+    counts = got_tbl.column(2).to_pylist()
+    for i in range(got_tbl.num_rows):
+        if ks[i] is None and counts[i] == 0:
+            continue  # phantom shuffle-padding group
+        got[ks[i]] = (sums[i], counts[i])
+    want = {}
+    for k, v in zip(keys, vals):
+        tot, cnt = want.get(k, (0, 0))
+        want[k] = (tot + int(v), cnt + 1)
+    assert got == want
+
+
+def test_tpch_q1_distributed_string_flags(mesh):
+    n = 2048
+    strings_li = lineitem_table_strings(n, seed=7)
+    out = tpch_q1_distributed(strings_li, mesh)
+    # oracle runs on the int-flag variant of the same data
+    oracle = tpch_q1_numpy(lineitem_table(n, seed=7))
+    oracle = {(chr(f), chr(st)): v for (f, st), v in oracle.items()}
+
+    rf = out.column(0).to_pylist()
+    ls = out.column(1).to_pylist()
+    got = {}
+    for i in range(out.num_rows):
+        if rf[i] is None or ls[i] is None:
+            continue
+        got[(rf[i], ls[i])] = {
+            "sum_qty": out.column(2).to_pylist()[i],
+            "sum_base_price": out.column(3).to_pylist()[i],
+            "sum_disc_price": out.column(4).to_pylist()[i],
+            "sum_charge": out.column(5).to_pylist()[i],
+            "count": out.column(9).to_pylist()[i],
+        }
+    assert set(got) == set(oracle)
+    for key, want in oracle.items():
+        g = got[key]
+        for field in ("sum_qty", "sum_base_price", "sum_disc_price",
+                      "sum_charge", "count"):
+            assert g[field] == want[field], (key, field)
+
+
+def test_distributed_string_key_join(rng, mesh):
+    nl, nr = 256, 192
+    words = [f"w{i}" for i in range(20)]
+    lk = [words[i] for i in rng.integers(0, 20, nl)]
+    rk = [words[i] for i in rng.integers(0, 20, nr)]
+    lval = rng.integers(0, 10_000, nl).astype(np.int64)
+    rval = rng.integers(0, 10_000, nr).astype(np.int64)
+    left = Table([
+        Column.from_pylist(lk, t.STRING),
+        Column.from_numpy(lval),
+    ])
+    right = Table([
+        Column.from_pylist(rk, t.STRING),
+        Column.from_numpy(rval),
+    ])
+    sl, lrv = shard_table(left, mesh, return_row_valid=True)
+    sr, rrv = shard_table(right, mesh, return_row_valid=True)
+    res = distributed_join(
+        sl, sr, 0, 0, mesh,
+        out_size_per_device=nl * nr // 4,
+        left_capacity=nl // 8, right_capacity=nr // 8,
+        left_row_valid=lrv, right_row_valid=rrv,
+    )
+    assert not np.asarray(res.overflowed).any()
+    got_tbl = collect(res.table, res.total, mesh)
+    # join emits (left key, left val, right key, right val)
+    got = sorted(zip(
+        got_tbl.column(0).to_pylist(),
+        got_tbl.column(1).to_pylist(),
+        got_tbl.column(3).to_pylist(),
+    ))
+    want = sorted(
+        (k, int(a), int(b))
+        for k, a in zip(lk, lval)
+        for k2, b in zip(rk, rval)
+        if k == k2
+    )
+    assert got == want
+
+
+def test_distributed_multikey_join_int_string(rng, mesh):
+    nl, nr = 128, 96
+    lk1 = rng.integers(0, 8, nl).astype(np.int64)
+    lk2 = [f"s{v}" for v in rng.integers(0, 5, nl)]
+    rk1 = rng.integers(0, 8, nr).astype(np.int64)
+    rk2 = [f"s{v}" for v in rng.integers(0, 5, nr)]
+    left = Table([
+        Column.from_numpy(lk1),
+        Column.from_pylist(lk2, t.STRING),
+    ])
+    right = Table([
+        Column.from_numpy(rk1),
+        Column.from_pylist(rk2, t.STRING),
+    ])
+    sl, lrv = shard_table(left, mesh, return_row_valid=True)
+    sr, rrv = shard_table(right, mesh, return_row_valid=True)
+    res = distributed_join(
+        sl, sr, [0, 1], [0, 1], mesh,
+        out_size_per_device=nl * nr // 2,
+        left_capacity=nl // 8, right_capacity=nr // 8,
+        left_row_valid=lrv, right_row_valid=rrv,
+    )
+    assert not np.asarray(res.overflowed).any()
+    got_tbl = collect(res.table, res.total, mesh)
+    got = sorted(zip(
+        got_tbl.column(0).to_pylist(),
+        got_tbl.column(1).to_pylist(),
+    ))
+    want = sorted(
+        (int(a), b)
+        for a, b in zip(lk1, lk2)
+        for c, d in zip(rk1, rk2)
+        if int(a) == int(c) and b == d
+    )
+    assert got == want
