@@ -9,6 +9,10 @@ cd "$(dirname "$0")/.."
 cmake -S src/native -B build/native -G Ninja
 ninja -C build/native
 ./build/native/tpudf_selftest
+if [[ -x build/native/tpudf_rt_selftest ]]; then
+  # device-runtime bridge: C-driven round trip through the embedded runtime
+  TPUDF_PY_PATH="$(pwd)" ./build/native/tpudf_rt_selftest
+fi
 
 if [[ "${PREMERGE_ALLOW_CPU:-0}" != "1" ]]; then
   python - << 'PY'

@@ -1,0 +1,43 @@
+/*
+ * Owning device column — the ai.rapids.cudf.ColumnVector subset the row
+ * conversion path needs: constructed from a native handle released by a
+ * native call (reference RowConversion.java:103-107 wraps the jlong array
+ * returned by convertToRows), AutoCloseable ownership, host round-trip
+ * helpers for tests.
+ */
+
+package ai.rapids.cudf;
+
+public final class ColumnVector extends ColumnView {
+  static {
+    TpuRuntime.ensureInitialized();
+  }
+
+  /** Takes ownership of a handle released by a native call. */
+  public ColumnVector(long nativeHandle) {
+    super(nativeHandle);
+  }
+
+  /**
+   * Build a fixed-width device column from host bytes (little-endian data,
+   * one validity byte per row or null for all-valid) — the TestBuilder-
+   * style entry tests use.
+   */
+  public static ColumnVector fromHost(DType type, long rows, byte[] data,
+      byte[] validity) {
+    long h = fromHostNative(type.getTypeId().getNativeId(), type.getScale(),
+        rows, data, validity);
+    return new ColumnVector(h);
+  }
+
+  /** Copy the column back to host: data bytes and per-row validity bytes. */
+  public void copyToHost(byte[] dataOut, byte[] validityOut) {
+    copyToHostNative(handle, dataOut, validityOut);
+  }
+
+  static native long fromHostNative(int typeId, int scale, long rows,
+      byte[] data, byte[] validity);
+
+  static native void copyToHostNative(long handle, byte[] dataOut,
+      byte[] validityOut);
+}

@@ -1,0 +1,46 @@
+/*
+ * Non-owning view of a device column — the ai.rapids.cudf.ColumnView role:
+ * the handle an API call reads without taking ownership (reference
+ * RowConversion.java:110 takes a ColumnView for convertFromRows). Handles
+ * are int64 keys into the native runtime's registry (libtpudf_rt), the
+ * same jlong-pointer convention as the reference JNI layer
+ * (reference RowConversionJni.cpp:31,36).
+ */
+
+package ai.rapids.cudf;
+
+public class ColumnView implements AutoCloseable {
+  protected long handle;
+
+  ColumnView(long handle) {
+    this.handle = handle;
+  }
+
+  public final long getNativeView() {
+    return handle;
+  }
+
+  public final long getRowCount() {
+    return getRowCountNative(handle);
+  }
+
+  public final DType getType() {
+    return DType.fromNative(getTypeIdNative(handle), getScaleNative(handle));
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      freeNative(handle);
+      handle = 0;
+    }
+  }
+
+  static native long getRowCountNative(long handle);
+
+  static native int getTypeIdNative(long handle);
+
+  static native int getScaleNative(long handle);
+
+  static native void freeNative(long handle);
+}

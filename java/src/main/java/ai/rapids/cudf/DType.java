@@ -1,0 +1,97 @@
+/*
+ * Column data type — the ai.rapids.cudf.DType subset the Spark plugin's
+ * row-conversion path touches (reference RowConversion.java:110-121
+ * marshals each column as (typeId.getNativeId(), getScale()) pairs).
+ * Native ids follow the cuDF type_id enum (branch-22.06 ordering), the
+ * same table as the Python side's types.TypeId.
+ */
+
+package ai.rapids.cudf;
+
+public final class DType {
+  public enum DTypeEnum {
+    EMPTY(0), INT8(1), INT16(2), INT32(3), INT64(4),
+    UINT8(5), UINT16(6), UINT32(7), UINT64(8),
+    FLOAT32(9), FLOAT64(10), BOOL8(11),
+    TIMESTAMP_DAYS(12), TIMESTAMP_SECONDS(13), TIMESTAMP_MILLISECONDS(14),
+    TIMESTAMP_MICROSECONDS(15), TIMESTAMP_NANOSECONDS(16),
+    DURATION_DAYS(17), DURATION_SECONDS(18), DURATION_MILLISECONDS(19),
+    DURATION_MICROSECONDS(20), DURATION_NANOSECONDS(21),
+    DICTIONARY32(22), STRING(23), LIST(24),
+    DECIMAL32(25), DECIMAL64(26), DECIMAL128(27), STRUCT(28);
+
+    private final int nativeId;
+
+    DTypeEnum(int nativeId) {
+      this.nativeId = nativeId;
+    }
+
+    public int getNativeId() {
+      return nativeId;
+    }
+  }
+
+  public static final DType INT8 = new DType(DTypeEnum.INT8, 0);
+  public static final DType INT16 = new DType(DTypeEnum.INT16, 0);
+  public static final DType INT32 = new DType(DTypeEnum.INT32, 0);
+  public static final DType INT64 = new DType(DTypeEnum.INT64, 0);
+  public static final DType FLOAT32 = new DType(DTypeEnum.FLOAT32, 0);
+  public static final DType FLOAT64 = new DType(DTypeEnum.FLOAT64, 0);
+  public static final DType BOOL8 = new DType(DTypeEnum.BOOL8, 0);
+  public static final DType STRING = new DType(DTypeEnum.STRING, 0);
+  public static final DType TIMESTAMP_DAYS =
+      new DType(DTypeEnum.TIMESTAMP_DAYS, 0);
+
+  private final DTypeEnum typeId;
+  private final int scale;
+
+  private DType(DTypeEnum typeId, int scale) {
+    this.typeId = typeId;
+    this.scale = scale;
+  }
+
+  public DTypeEnum getTypeId() {
+    return typeId;
+  }
+
+  /** cuDF convention: value = unscaled * 10^scale (usually negative). */
+  public int getScale() {
+    return scale;
+  }
+
+  public static DType create(DTypeEnum id) {
+    return new DType(id, 0);
+  }
+
+  public static DType create(DTypeEnum id, int scale) {
+    return new DType(id, scale);
+  }
+
+  public static DType fromNative(int nativeId, int scale) {
+    for (DTypeEnum e : DTypeEnum.values()) {
+      if (e.getNativeId() == nativeId) {
+        return new DType(e, scale);
+      }
+    }
+    throw new IllegalArgumentException("unknown native type id " + nativeId);
+  }
+
+  @Override
+  public boolean equals(Object o) {
+    if (!(o instanceof DType)) {
+      return false;
+    }
+    DType d = (DType) o;
+    return d.typeId == typeId && d.scale == scale;
+  }
+
+  @Override
+  public int hashCode() {
+    return typeId.ordinal() * 31 + scale;
+  }
+
+  @Override
+  public String toString() {
+    return typeId + (scale != 0 ? "(scale=" + scale + ")" : "");
+  }
+}

@@ -1,0 +1,71 @@
+/*
+ * Device table — the ai.rapids.cudf.Table subset the Spark plugin's JNI
+ * kernels consume: an ordered set of equal-length device columns behind a
+ * jlong native view (reference RowConversion.java:101-108 passes
+ * table.getNativeView() across JNI; RowConversionJni.cpp:31 reinterprets
+ * it). Constructed from column handles the way the reference builds a
+ * Table from the jlong array a native call returns
+ * (reference RowConversion.java:120 `new Table(handles)`).
+ */
+
+package ai.rapids.cudf;
+
+public final class Table implements AutoCloseable {
+  static {
+    TpuRuntime.ensureInitialized();
+  }
+
+  private long handle;
+  private final ColumnVector[] columns;
+
+  /** Takes ownership of column handles released by a native call. */
+  public Table(long[] columnHandles) {
+    this.columns = new ColumnVector[columnHandles.length];
+    for (int i = 0; i < columnHandles.length; i++) {
+      this.columns[i] = new ColumnVector(columnHandles[i]);
+    }
+    this.handle = createTable(columnHandles);
+  }
+
+  public Table(ColumnVector[] columns) {
+    this.columns = columns.clone();
+    long[] handles = new long[columns.length];
+    for (int i = 0; i < columns.length; i++) {
+      handles[i] = columns[i].getNativeView();
+    }
+    this.handle = createTable(handles);
+  }
+
+  public long getNativeView() {
+    return handle;
+  }
+
+  public long getRowCount() {
+    return getRowCountNative(handle);
+  }
+
+  public int getNumberOfColumns() {
+    return columns.length;
+  }
+
+  public ColumnVector getColumn(int index) {
+    return columns[index];
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      freeNative(handle);
+      handle = 0;
+    }
+    for (ColumnVector c : columns) {
+      c.close();
+    }
+  }
+
+  static native long createTable(long[] columnHandles);
+
+  static native long getRowCountNative(long handle);
+
+  static native void freeNative(long handle);
+}

@@ -1,0 +1,41 @@
+/*
+ * Device-runtime bootstrap — the NativeDepsLoader + CUDA-context-init role
+ * of the reference (reference RowConversion.java:23-25: loadNativeDeps in a
+ * static initializer; first cudf call initializes the CUDA context). Here
+ * the first API touch loads libtpudf_rt.so and initializes the embedded
+ * CPython/JAX runtime that owns the TPU (architecture decision documented
+ * in spark_rapids_jni_tpu/runtime/bridge.py).
+ *
+ * Configuration (system properties, the reference's config idiom,
+ * reference pom.xml:435-438):
+ *   ai.rapids.tpudf.python.path — ':'-separated sys.path entries for the
+ *       runtime package (defaults to TPUDF_PY_PATH env).
+ *   ai.rapids.tpudf.platform    — "" (default: TPU when present) or "cpu".
+ */
+
+package ai.rapids.cudf;
+
+import com.nvidia.spark.rapids.jni.NativeDepsLoader;
+
+public final class TpuRuntime {
+  private static volatile boolean initialized = false;
+
+  private TpuRuntime() {}
+
+  public static void ensureInitialized() {
+    if (!initialized) {
+      synchronized (TpuRuntime.class) {
+        if (!initialized) {
+          NativeDepsLoader.loadNativeDeps();
+          String path = System.getProperty("ai.rapids.tpudf.python.path",
+              System.getenv().getOrDefault("TPUDF_PY_PATH", ""));
+          String platform = System.getProperty("ai.rapids.tpudf.platform", "");
+          initNative(path, platform);
+          initialized = true;
+        }
+      }
+    }
+  }
+
+  static native void initNative(String sysPath, String platform);
+}

@@ -1,0 +1,92 @@
+/*
+ * Row-major <-> column-major conversion, host side — API parity with the
+ * reference's RowConversion (reference RowConversion.java:101-121) over the
+ * same packed-row byte contract (reference RowConversion.java:40-99):
+ * size-aligned columns in schema order, validity bytes (bit col%8 of byte
+ * col//8) after the last column, rows padded to 8 bytes.
+ *
+ * This JVM surface packs/unpacks HOST buffers through the native codec
+ * (src/native/src/row_conversion.cpp) — the Spark-side UnsafeRow handoff.
+ * The device-resident conversion runs in the TPU runtime
+ * (spark_rapids_jni_tpu/ops/row_conversion.py) over the identical layout;
+ * the two are cross-validated byte-for-byte in the Python test suite.
+ */
+
+package com.nvidia.spark.rapids.jni;
+
+public final class HostRowConversion {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private HostRowConversion() {}
+
+  /** One fixed-width column resident in host buffers. */
+  public static final class HostColumn {
+    final HostMemoryBuffer data;
+    final HostMemoryBuffer validity;  // one byte per row, 1 = valid; or null
+    final int elementSize;            // 1, 2, 4 or 8
+
+    public HostColumn(HostMemoryBuffer data, HostMemoryBuffer validity,
+        int elementSize) {
+      this.data = data;
+      this.validity = validity;
+      this.elementSize = elementSize;
+    }
+  }
+
+  /** Row size in bytes for a schema of element sizes (layout probe). */
+  public static int rowSize(int[] elementSizes) {
+    return rowSizeNative(elementSizes);
+  }
+
+  /**
+   * Pack columns into rows. Returns a buffer of numRows * rowSize bytes.
+   * Fixed-width columns only, matching the reference's restriction
+   * (reference row_conversion.cu:515).
+   */
+  public static HostMemoryBuffer convertToRows(HostColumn[] columns,
+      long numRows) {
+    int n = columns.length;
+    long[] data = new long[n];
+    long[] valid = new long[n];
+    int[] sizes = new int[n];
+    for (int i = 0; i < n; i++) {
+      data[i] = columns[i].data.getAddress();
+      valid[i] = columns[i].validity == null ? 0
+          : columns[i].validity.getAddress();
+      sizes[i] = columns[i].elementSize;
+    }
+    long rowSize = rowSizeNative(sizes);
+    HostMemoryBuffer out = HostMemoryBuffer.allocate(numRows * rowSize);
+    toRowsNative(data, valid, sizes, numRows, out.getAddress());
+    return out;
+  }
+
+  /**
+   * Unpack rows into caller-allocated columns (data and validity buffers
+   * must be sized numRows*elementSize and numRows respectively; the packed
+   * form always carries validity, reference row_conversion.cu:551-555).
+   */
+  public static void convertFromRows(HostMemoryBuffer rows, long numRows,
+      HostColumn[] columns) {
+    int n = columns.length;
+    long[] data = new long[n];
+    long[] valid = new long[n];
+    int[] sizes = new int[n];
+    for (int i = 0; i < n; i++) {
+      data[i] = columns[i].data.getAddress();
+      valid[i] = columns[i].validity.getAddress();
+      sizes[i] = columns[i].elementSize;
+    }
+    fromRowsNative(rows.getAddress(), numRows, sizes, data, valid);
+  }
+
+  private static native int rowSizeNative(int[] elementSizes);
+
+  private static native void toRowsNative(long[] data, long[] valid,
+      int[] sizes, long numRows, long outAddress);
+
+  private static native void fromRowsNative(long rowsAddress, long numRows,
+      int[] sizes, long[] data, long[] valid);
+}

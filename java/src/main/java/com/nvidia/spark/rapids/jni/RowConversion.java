@@ -1,92 +1,62 @@
 /*
- * Row-major <-> column-major conversion, host side — API parity with the
- * reference's RowConversion (reference RowConversion.java:101-121) over the
- * same packed-row byte contract (reference RowConversion.java:40-99):
- * size-aligned columns in schema order, validity bytes (bit col%8 of byte
- * col//8) after the last column, rows padded to 8 bytes.
+ * Device row-major <-> column-major table conversion — signature-compatible
+ * with the reference (reference RowConversion.java:101-121) over the same
+ * packed-row byte contract (reference RowConversion.java:40-99): columns
+ * size-aligned in schema order, validity bytes (bit col%8 of byte col//8)
+ * after the last column, rows padded to 8 bytes, output batched under 2^31
+ * bytes with 32-row-multiple batch sizes.
  *
- * This JVM surface packs/unpacks HOST buffers through the native codec
- * (src/native/src/row_conversion.cpp) — the Spark-side UnsafeRow handoff.
- * The device-resident conversion runs in the TPU runtime
- * (spark_rapids_jni_tpu/ops/row_conversion.py) over the identical layout;
- * the two are cross-validated byte-for-byte in the Python test suite.
+ * The conversion runs ON DEVICE through the embedded TPU runtime
+ * (libtpudf_rt -> spark_rapids_jni_tpu.ops.row_conversion), crossing JNI as
+ * jlong handles exactly like the reference's CUDA path (reference
+ * RowConversionJni.cpp:24-41). The host-buffer codec variant lives in
+ * HostRowConversion (the Spark UnsafeRow handoff).
  */
 
 package com.nvidia.spark.rapids.jni;
 
+import ai.rapids.cudf.ColumnVector;
+import ai.rapids.cudf.ColumnView;
+import ai.rapids.cudf.DType;
+import ai.rapids.cudf.Table;
+import ai.rapids.cudf.TpuRuntime;
+
 public final class RowConversion {
   static {
-    NativeDepsLoader.loadNativeDeps();
+    TpuRuntime.ensureInitialized();
   }
 
   private RowConversion() {}
 
-  /** One fixed-width column resident in host buffers. */
-  public static final class HostColumn {
-    final HostMemoryBuffer data;
-    final HostMemoryBuffer validity;  // one byte per row, 1 = valid; or null
-    final int elementSize;            // 1, 2, 4 or 8
-
-    public HostColumn(HostMemoryBuffer data, HostMemoryBuffer validity,
-        int elementSize) {
-      this.data = data;
-      this.validity = validity;
-      this.elementSize = elementSize;
+  /**
+   * Convert a device table to packed rows: one or more LIST<INT8>-shaped
+   * row columns, each under 2GB (reference RowConversion.java:101-108).
+   */
+  public static ColumnVector[] convertToRows(Table table) {
+    long[] ptrs = convertToRows(table.getNativeView());
+    ColumnVector[] ret = new ColumnVector[ptrs.length];
+    for (int i = 0; i < ptrs.length; i++) {
+      ret[i] = new ColumnVector(ptrs[i]);
     }
-  }
-
-  /** Row size in bytes for a schema of element sizes (layout probe). */
-  public static int rowSize(int[] elementSizes) {
-    return rowSizeNative(elementSizes);
+    return ret;
   }
 
   /**
-   * Pack columns into rows. Returns a buffer of numRows * rowSize bytes.
-   * Fixed-width columns only, matching the reference's restriction
-   * (reference row_conversion.cu:515).
+   * Convert packed rows back to a device table with the given column types
+   * (reference RowConversion.java:110-121).
    */
-  public static HostMemoryBuffer convertToRows(HostColumn[] columns,
-      long numRows) {
-    int n = columns.length;
-    long[] data = new long[n];
-    long[] valid = new long[n];
-    int[] sizes = new int[n];
-    for (int i = 0; i < n; i++) {
-      data[i] = columns[i].data.getAddress();
-      valid[i] = columns[i].validity == null ? 0
-          : columns[i].validity.getAddress();
-      sizes[i] = columns[i].elementSize;
+  public static Table convertFromRows(ColumnView vec, DType... schema) {
+    int[] types = new int[schema.length];
+    int[] scale = new int[schema.length];
+    for (int i = 0; i < schema.length; i++) {
+      types[i] = schema[i].getTypeId().getNativeId();
+      scale[i] = schema[i].getScale();
     }
-    long rowSize = rowSizeNative(sizes);
-    HostMemoryBuffer out = HostMemoryBuffer.allocate(numRows * rowSize);
-    toRowsNative(data, valid, sizes, numRows, out.getAddress());
-    return out;
+    return new Table(convertFromRows(vec.getNativeView(), types, scale));
   }
 
-  /**
-   * Unpack rows into caller-allocated columns (data and validity buffers
-   * must be sized numRows*elementSize and numRows respectively; the packed
-   * form always carries validity, reference row_conversion.cu:551-555).
-   */
-  public static void convertFromRows(HostMemoryBuffer rows, long numRows,
-      HostColumn[] columns) {
-    int n = columns.length;
-    long[] data = new long[n];
-    long[] valid = new long[n];
-    int[] sizes = new int[n];
-    for (int i = 0; i < n; i++) {
-      data[i] = columns[i].data.getAddress();
-      valid[i] = columns[i].validity.getAddress();
-      sizes[i] = columns[i].elementSize;
-    }
-    fromRowsNative(rows.getAddress(), numRows, sizes, data, valid);
-  }
+  private static native long[] convertToRows(long nativeHandle);
 
-  private static native int rowSizeNative(int[] elementSizes);
-
-  private static native void toRowsNative(long[] data, long[] valid,
-      int[] sizes, long numRows, long outAddress);
-
-  private static native void fromRowsNative(long rowsAddress, long numRows,
-      int[] sizes, long[] data, long[] valid);
+  private static native long[] convertFromRows(long nativeColumnView,
+      int[] types, int[] scale);
 }

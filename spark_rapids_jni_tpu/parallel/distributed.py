@@ -170,18 +170,24 @@ def collect(table: Table, num_rows_per_device: jnp.ndarray, mesh: Mesh) -> Table
         if c.is_padded_string:
             # back to the Arrow at-rest layout on host: one boolean-mask
             # flatten per device chunk (vectorized, no per-row loop)
-            lengths = np.concatenate([p[0] for p in parts])
+            lengths = data  # string columns carry int32 lengths as data
             blob = np.concatenate([
                 mat.reshape(-1)[
                     (np.arange(mat.shape[1])[None, :] < lens[:, None]).reshape(-1)
                 ]
                 for (lens, _, mat) in parts
             ]) if lengths.size else np.zeros((0,), np.uint8)
+            total = int(lengths.astype(np.int64).sum())
+            if total > np.iinfo(np.int32).max:
+                raise ValueError(
+                    f"collected string column holds {total} bytes, over the "
+                    "int32 Arrow offset bound (2^31-1); collect in batches"
+                )
             offsets = np.zeros(lengths.size + 1, dtype=np.int32)
             np.cumsum(lengths, out=offsets[1:])
             out.append(Column(
                 c.dtype, jnp.asarray(offsets), jnp.asarray(valid),
-                chars=jnp.asarray(blob.astype(np.uint8)),
+                chars=jnp.asarray(blob),
             ))
             continue
         out.append(Column(c.dtype, jnp.asarray(data), jnp.asarray(valid)))

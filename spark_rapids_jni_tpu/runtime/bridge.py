@@ -1,0 +1,108 @@
+"""Device-runtime bridge: the Python entry points `libtpudf_rt.so` calls.
+
+This is the TPU analogue of the reference's JNI->libcudf call path
+(reference RowConversionJni.cpp:24-41: JVM -> JNI -> cudf device code).
+Architecture decision, per SURVEY.md section 7 "hard parts": instead of a
+from-scratch PJRT C-API client, `libtpudf_rt.so` EMBEDS a CPython
+interpreter that owns the JAX runtime — one interpreter per process, the
+single-controller model XLA wants. Every JVM/C thread funnels through the
+GIL into this module, which serializes device work exactly the way the
+reference funnels all Spark task threads into one CUDA context (per-thread
+default streams notwithstanding, pom.xml:80).
+
+Handles held by the C side map to the objects these functions return
+(Column / Table / RowsColumn). All host<->device marshalling crosses as
+raw little-endian bytes, matching the Java side's HostMemoryBuffer
+convention (reference ParquetFooter.java:82-95).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.row_conversion import (
+    RowsColumn,
+    convert_from_rows as _convert_from_rows,
+    convert_to_rows as _convert_to_rows,
+)
+from spark_rapids_jni_tpu.types import DType, TypeId
+
+
+def init_platform(platform: str) -> None:
+    """Pin the backend before first device touch. "" = default (TPU when
+    present); "cpu" = host-only (tests, machines without an accelerator)."""
+    if platform == "cpu":
+        from spark_rapids_jni_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+    import jax
+
+    jax.devices()  # fail fast if the backend cannot initialize
+
+
+def column_from_host(
+    type_id: int, scale: int, n: int, data: bytes, validity: bytes | None
+) -> Column:
+    """Build a device column from little-endian host bytes. ``validity`` is
+    one byte per row (0 = null), or None for all-valid."""
+    dt = DType(TypeId(type_id), scale)
+    arr = np.frombuffer(data, dtype=dt.storage_dtype, count=n)
+    vmask = None
+    if validity is not None:
+        vmask = np.frombuffer(validity, dtype=np.uint8, count=n).astype(bool)
+    return Column.from_numpy(arr.copy(), dt, validity=vmask)
+
+
+def table_create(cols: list[Column]) -> Table:
+    return Table(list(cols))
+
+
+def table_num_columns(table: Table) -> int:
+    return table.num_columns
+
+
+def table_num_rows(table: Table) -> int:
+    return table.num_rows
+
+
+def table_column(table: Table, i: int) -> Column:
+    return table.column(i)
+
+
+def column_info(col: Column) -> tuple[int, int, int]:
+    return int(col.dtype.type_id), col.dtype.scale, col.size
+
+
+def column_to_host(col: Column) -> tuple[bytes, bytes]:
+    """Device column -> (data bytes, one-byte-per-row validity)."""
+    data, mask = col.to_numpy()
+    if mask is None:
+        mask = np.ones(col.size, dtype=bool)
+    return data.tobytes(), mask.astype(np.uint8).tobytes()
+
+
+def convert_to_rows(table: Table) -> list[RowsColumn]:
+    return _convert_to_rows(table)
+
+
+def convert_from_rows(
+    rows: RowsColumn, type_ids: list[int], scales: list[int]
+) -> Table:
+    schema = [DType(TypeId(t), s) for t, s in zip(type_ids, scales)]
+    return _convert_from_rows(rows, schema)
+
+
+def rows_info(rows: RowsColumn) -> tuple[int, int]:
+    return rows.num_rows, rows.row_size
+
+
+def rows_to_host(rows: RowsColumn) -> bytes:
+    return np.asarray(rows.data).tobytes()
+
+
+def rows_from_host(num_rows: int, row_size: int, data: bytes) -> RowsColumn:
+    import jax.numpy as jnp
+
+    arr = np.frombuffer(data, dtype=np.uint8, count=num_rows * row_size)
+    return RowsColumn(num_rows, row_size, jnp.asarray(arr.copy()))

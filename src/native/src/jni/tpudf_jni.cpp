@@ -154,7 +154,7 @@ Java_com_nvidia_spark_rapids_jni_ParquetFooter_closeNative(JNIEnv*, jclass,
 // ---- RowConversion --------------------------------------------------------
 
 JNIEXPORT jint JNICALL
-Java_com_nvidia_spark_rapids_jni_RowConversion_rowSizeNative(
+Java_com_nvidia_spark_rapids_jni_HostRowConversion_rowSizeNative(
     JNIEnv* env, jclass, jintArray sizes) {
   TPUDF_JNI_TRY {
     auto layout = tpudf::rows::fixed_width_layout(to_int_vec(env, sizes));
@@ -164,7 +164,7 @@ Java_com_nvidia_spark_rapids_jni_RowConversion_rowSizeNative(
 }
 
 JNIEXPORT void JNICALL
-Java_com_nvidia_spark_rapids_jni_RowConversion_toRowsNative(
+Java_com_nvidia_spark_rapids_jni_HostRowConversion_toRowsNative(
     JNIEnv* env, jclass, jlongArray data, jlongArray valid, jintArray sizes,
     jlong num_rows, jlong out_addr) {
   TPUDF_JNI_TRY {
@@ -181,7 +181,7 @@ Java_com_nvidia_spark_rapids_jni_RowConversion_toRowsNative(
 }
 
 JNIEXPORT void JNICALL
-Java_com_nvidia_spark_rapids_jni_RowConversion_fromRowsNative(
+Java_com_nvidia_spark_rapids_jni_HostRowConversion_fromRowsNative(
     JNIEnv* env, jclass, jlong rows_addr, jlong num_rows, jintArray sizes,
     jlongArray data, jlongArray valid) {
   TPUDF_JNI_TRY {

@@ -1,0 +1,219 @@
+// JNI bindings for the device-runtime handle model: maps the ai.rapids.cudf
+// Java surface (Table / ColumnVector / ColumnView / TpuRuntime) and the
+// reference-signature RowConversion natives onto the tpudf_rt C ABI, which
+// fronts the embedded CPython/JAX runtime (rt_bridge.cpp).
+//
+// Parity target: reference RowConversionJni.cpp:24-66 — jlong handles in,
+// released jlong handles out, exceptions translated to Java RuntimeException
+// (the CATCH_STD contract). Compiled only when both a JDK and the Python
+// embed library are found.
+
+#include <jni.h>
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+extern "C" {
+int32_t tpudf_rt_init(char const* sys_path, char const* platform);
+char const* tpudf_rt_last_error();
+int64_t tpudf_rt_column_from_host(int32_t type_id, int32_t scale, int64_t n,
+                                  uint8_t const* data, int64_t data_len,
+                                  uint8_t const* validity);
+int64_t tpudf_rt_table_create(int64_t const* cols, int32_t ncols);
+int32_t tpudf_rt_table_num_columns(int64_t tbl);
+int64_t tpudf_rt_table_num_rows(int64_t tbl);
+int64_t tpudf_rt_table_column(int64_t tbl, int32_t i);
+int32_t tpudf_rt_column_info(int64_t col, int32_t* type_id, int32_t* scale,
+                             int64_t* num_rows);
+int32_t tpudf_rt_column_to_host(int64_t col, uint8_t* data_out,
+                                int64_t data_cap, uint8_t* validity_out,
+                                int64_t validity_cap);
+int32_t tpudf_rt_convert_to_rows(int64_t tbl, int64_t* out, int32_t cap,
+                                 int32_t* n_out);
+int64_t tpudf_rt_convert_from_rows(int64_t rows, int32_t const* type_ids,
+                                   int32_t const* scales, int32_t ncols);
+int32_t tpudf_rt_rows_info(int64_t rows, int64_t* num_rows, int64_t* row_size);
+int32_t tpudf_rt_free(int64_t handle);
+}
+
+namespace {
+
+void throw_rt(JNIEnv* env) {
+  jclass cls = env->FindClass("java/lang/RuntimeException");
+  if (cls != nullptr) env->ThrowNew(cls, tpudf_rt_last_error());
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- TpuRuntime -----------------------------------------------------------
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_TpuRuntime_initNative(
+    JNIEnv* env, jclass, jstring sys_path, jstring platform) {
+  char const* p = env->GetStringUTFChars(sys_path, nullptr);
+  char const* plat = env->GetStringUTFChars(platform, nullptr);
+  int32_t rc = tpudf_rt_init(p, plat);
+  env->ReleaseStringUTFChars(sys_path, p);
+  env->ReleaseStringUTFChars(platform, plat);
+  if (rc != 0) throw_rt(env);
+}
+
+// ---- ColumnView / ColumnVector -------------------------------------------
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_ColumnView_getRowCountNative(
+    JNIEnv* env, jclass, jlong handle) {
+  int32_t tid = 0, scale = 0;
+  int64_t n = 0;
+  if (tpudf_rt_column_info(handle, &tid, &scale, &n) != 0) {
+    throw_rt(env);
+    return 0;
+  }
+  return n;
+}
+
+JNIEXPORT jint JNICALL Java_ai_rapids_cudf_ColumnView_getTypeIdNative(
+    JNIEnv* env, jclass, jlong handle) {
+  int32_t tid = 0, scale = 0;
+  int64_t n = 0;
+  if (tpudf_rt_column_info(handle, &tid, &scale, &n) != 0) {
+    throw_rt(env);
+    return 0;
+  }
+  return tid;
+}
+
+JNIEXPORT jint JNICALL Java_ai_rapids_cudf_ColumnView_getScaleNative(
+    JNIEnv* env, jclass, jlong handle) {
+  int32_t tid = 0, scale = 0;
+  int64_t n = 0;
+  if (tpudf_rt_column_info(handle, &tid, &scale, &n) != 0) {
+    throw_rt(env);
+    return 0;
+  }
+  return scale;
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_ColumnView_freeNative(
+    JNIEnv*, jclass, jlong handle) {
+  tpudf_rt_free(handle);
+}
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_ColumnVector_fromHostNative(
+    JNIEnv* env, jclass, jint type_id, jint scale, jlong rows,
+    jbyteArray data, jbyteArray validity) {
+  jsize data_len = env->GetArrayLength(data);
+  std::vector<uint8_t> dbuf(data_len);
+  env->GetByteArrayRegion(data, 0, data_len,
+                          reinterpret_cast<jbyte*>(dbuf.data()));
+  std::vector<uint8_t> vbuf;
+  uint8_t const* vptr = nullptr;
+  if (validity != nullptr) {
+    vbuf.resize(env->GetArrayLength(validity));
+    env->GetByteArrayRegion(validity, 0, static_cast<jsize>(vbuf.size()),
+                            reinterpret_cast<jbyte*>(vbuf.data()));
+    vptr = vbuf.data();
+  }
+  int64_t h = tpudf_rt_column_from_host(type_id, scale, rows, dbuf.data(),
+                                        data_len, vptr);
+  if (h < 0) throw_rt(env);
+  return h;
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_ColumnVector_copyToHostNative(
+    JNIEnv* env, jclass, jlong handle, jbyteArray data_out,
+    jbyteArray validity_out) {
+  jsize data_cap = env->GetArrayLength(data_out);
+  jsize valid_cap =
+      validity_out == nullptr ? 0 : env->GetArrayLength(validity_out);
+  std::vector<uint8_t> dbuf(data_cap);
+  std::vector<uint8_t> vbuf(valid_cap);
+  if (tpudf_rt_column_to_host(handle, dbuf.data(), data_cap,
+                              validity_out == nullptr ? nullptr : vbuf.data(),
+                              valid_cap) != 0) {
+    throw_rt(env);
+    return;
+  }
+  env->SetByteArrayRegion(data_out, 0, data_cap,
+                          reinterpret_cast<jbyte const*>(dbuf.data()));
+  if (validity_out != nullptr) {
+    env->SetByteArrayRegion(validity_out, 0, valid_cap,
+                            reinterpret_cast<jbyte const*>(vbuf.data()));
+  }
+}
+
+// ---- Table ----------------------------------------------------------------
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_Table_createTable(
+    JNIEnv* env, jclass, jlongArray column_handles) {
+  jsize n = env->GetArrayLength(column_handles);
+  std::vector<int64_t> cols(n);
+  env->GetLongArrayRegion(column_handles, 0, n,
+                          reinterpret_cast<jlong*>(cols.data()));
+  int64_t h = tpudf_rt_table_create(cols.data(), n);
+  if (h < 0) throw_rt(env);
+  return h;
+}
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_Table_getRowCountNative(
+    JNIEnv* env, jclass, jlong handle) {
+  int64_t n = tpudf_rt_table_num_rows(handle);
+  if (n < 0) throw_rt(env);
+  return n;
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_Table_freeNative(
+    JNIEnv*, jclass, jlong handle) {
+  tpudf_rt_free(handle);
+}
+
+// ---- RowConversion (reference signatures) ---------------------------------
+
+JNIEXPORT jlongArray JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRows(
+    JNIEnv* env, jclass, jlong table_handle) {
+  int64_t batches[64];
+  int32_t n = 0;
+  if (tpudf_rt_convert_to_rows(table_handle, batches, 64, &n) != 0) {
+    throw_rt(env);
+    return nullptr;
+  }
+  jlongArray out = env->NewLongArray(n);
+  env->SetLongArrayRegion(out, 0, n, reinterpret_cast<jlong*>(batches));
+  return out;
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRows(
+    JNIEnv* env, jclass, jlong rows_handle, jintArray types,
+    jintArray scales) {
+  jsize n = env->GetArrayLength(types);
+  std::vector<int32_t> tvec(n), svec(n);
+  env->GetIntArrayRegion(types, 0, n, reinterpret_cast<jint*>(tvec.data()));
+  env->GetIntArrayRegion(scales, 0, n, reinterpret_cast<jint*>(svec.data()));
+  int64_t tbl = tpudf_rt_convert_from_rows(rows_handle, tvec.data(),
+                                           svec.data(), n);
+  if (tbl < 0) {
+    throw_rt(env);
+    return nullptr;
+  }
+  // release the table's columns to the caller (reference convention: the
+  // Java side wraps the returned handles in `new Table(handles)`)
+  std::vector<int64_t> cols(n);
+  for (jsize i = 0; i < n; ++i) {
+    cols[i] = tpudf_rt_table_column(tbl, i);
+    if (cols[i] < 0) {
+      for (jsize j = 0; j < i; ++j) tpudf_rt_free(cols[j]);
+      tpudf_rt_free(tbl);
+      throw_rt(env);
+      return nullptr;
+    }
+  }
+  tpudf_rt_free(tbl);
+  jlongArray out = env->NewLongArray(n);
+  env->SetLongArrayRegion(out, 0, n, reinterpret_cast<jlong*>(cols.data()));
+  return out;
+}
+
+}  // extern "C"

@@ -1,0 +1,160 @@
+"""Device-runtime bridge tests (VERDICT round-2 item 1): the handle-model
+C ABI (libtpudf_rt) that lets a JVM/native caller drive the device runtime.
+
+Two paths are covered:
+  * embedded-interpreter path: tpudf_rt_selftest (a C executable that owns
+    Py_Initialize) round-trips the reference's 8-column table
+    (RowConversionTest.java:30-39) through the device conversion — the
+    JNI-level proof that works without a JDK in the image;
+  * in-process path: this test process loads libtpudf_rt.so with ctypes and
+    drives the same ABI with Python already initialized (the GILState
+    branch a Python-hosted executor uses).
+"""
+
+import ctypes
+import os
+import pathlib
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+LIB = REPO / "build" / "native" / "libtpudf_rt.so"
+SELFTEST = REPO / "build" / "native" / "tpudf_rt_selftest"
+
+
+def _build_native():
+    subprocess.run(
+        ["cmake", "-S", str(REPO / "src" / "native"), "-B",
+         str(REPO / "build" / "native"), "-G", "Ninja"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", str(REPO / "build" / "native")],
+        check=True, capture_output=True,
+    )
+
+
+@pytest.fixture(scope="module")
+def rt_lib():
+    if not LIB.exists():
+        _build_native()
+    lib = ctypes.CDLL(str(LIB))
+    lib.tpudf_rt_last_error.restype = ctypes.c_char_p
+    lib.tpudf_rt_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.tpudf_rt_column_from_host.restype = ctypes.c_int64
+    lib.tpudf_rt_column_from_host.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+    ]
+    lib.tpudf_rt_table_create.restype = ctypes.c_int64
+    lib.tpudf_rt_table_create.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int32]
+    lib.tpudf_rt_table_num_rows.restype = ctypes.c_int64
+    lib.tpudf_rt_table_num_rows.argtypes = [ctypes.c_int64]
+    lib.tpudf_rt_convert_to_rows.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.tpudf_rt_convert_from_rows.restype = ctypes.c_int64
+    lib.tpudf_rt_convert_from_rows.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+    lib.tpudf_rt_table_column.restype = ctypes.c_int64
+    lib.tpudf_rt_table_column.argtypes = [ctypes.c_int64, ctypes.c_int32]
+    lib.tpudf_rt_column_info.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+    lib.tpudf_rt_column_to_host.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int64]
+    lib.tpudf_rt_rows_info.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.tpudf_rt_free.argtypes = [ctypes.c_int64]
+    # Python is already initialized in this process: init takes the
+    # GILState branch. Platform "cpu" matches the test conftest pin.
+    rc = lib.tpudf_rt_init(str(REPO).encode(), b"cpu")
+    assert rc == 0, lib.tpudf_rt_last_error()
+    return lib
+
+
+def test_rt_selftest_embedded_interpreter():
+    """The C executable owns the interpreter: the no-JDK JNI-level proof."""
+    if not SELFTEST.exists():
+        _build_native()
+    env = dict(os.environ, TPUDF_PY_PATH=str(REPO))
+    out = subprocess.run(
+        [str(SELFTEST)], env=env, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "all checks passed" in out.stdout
+
+
+def test_rt_ctypes_round_trip(rt_lib):
+    lib = rt_lib
+    n = 5
+    data = np.array([10, -3, 7, 0, 99], dtype=np.int64)
+    validity = bytes([1, 1, 0, 1, 1])
+    h_int = lib.tpudf_rt_column_from_host(
+        4, 0, n, data.tobytes(), data.nbytes, validity)  # INT64
+    assert h_int > 0, lib.tpudf_rt_last_error()
+    fdata = np.array([1.5, -2.25, 0.0, 3.75, 9.0], dtype=np.float32)
+    h_f = lib.tpudf_rt_column_from_host(
+        9, 0, n, fdata.tobytes(), fdata.nbytes, None)  # FLOAT32, all valid
+    assert h_f > 0
+
+    cols = (ctypes.c_int64 * 2)(h_int, h_f)
+    tbl = lib.tpudf_rt_table_create(cols, 2)
+    assert tbl > 0
+    assert lib.tpudf_rt_table_num_rows(tbl) == n
+
+    batches = (ctypes.c_int64 * 4)()
+    n_batches = ctypes.c_int32(0)
+    assert lib.tpudf_rt_convert_to_rows(
+        tbl, batches, 4, ctypes.byref(n_batches)) == 0, \
+        lib.tpudf_rt_last_error()
+    assert n_batches.value == 1
+
+    num_rows = ctypes.c_int64(0)
+    row_size = ctypes.c_int64(0)
+    assert lib.tpudf_rt_rows_info(
+        batches[0], ctypes.byref(num_rows), ctypes.byref(row_size)) == 0
+    assert num_rows.value == n
+    # layout: int64 at 0, float32 at 8, 1 validity byte at 12, pad to 16
+    assert row_size.value == 16
+
+    types = (ctypes.c_int32 * 2)(4, 9)
+    scales = (ctypes.c_int32 * 2)(0, 0)
+    back = lib.tpudf_rt_convert_from_rows(batches[0], types, scales, 2)
+    assert back > 0, lib.tpudf_rt_last_error()
+
+    col0 = lib.tpudf_rt_table_column(back, 0)
+    tid = ctypes.c_int32(0)
+    scale = ctypes.c_int32(0)
+    rows = ctypes.c_int64(0)
+    assert lib.tpudf_rt_column_info(
+        col0, ctypes.byref(tid), ctypes.byref(scale), ctypes.byref(rows)) == 0
+    assert (tid.value, scale.value, rows.value) == (4, 0, n)
+    dbuf = ctypes.create_string_buffer(n * 8)
+    vbuf = ctypes.create_string_buffer(n)
+    assert lib.tpudf_rt_column_to_host(col0, dbuf, n * 8, vbuf, n) == 0
+    got = np.frombuffer(dbuf.raw, dtype=np.int64)
+    got_valid = np.frombuffer(vbuf.raw, dtype=np.uint8).astype(bool)
+    np.testing.assert_array_equal(got_valid, [1, 1, 0, 1, 1])
+    np.testing.assert_array_equal(got[got_valid], data[got_valid])
+
+    for h in (col0, back, batches[0], tbl, h_int, h_f):
+        lib.tpudf_rt_free(h)
+
+
+def test_rt_error_reporting(rt_lib):
+    lib = rt_lib
+    # invalid handle -> error code + message, not a crash
+    assert lib.tpudf_rt_table_num_rows(999999) == -1
+    assert b"handle" in lib.tpudf_rt_last_error()
+    # bad type id -> python exception surfaced through last_error
+    h = lib.tpudf_rt_column_from_host(99, 0, 1, b"\x00" * 8, 8, None)
+    assert h == -1
+    assert lib.tpudf_rt_last_error() != b""
