@@ -123,12 +123,120 @@ def _bench_row_conversion(n: int, iters: int):
     return 2 * n * row_bytes / per_iter / 1e9
 
 
+def _bench_parquet_q1(n: int, iters: int):
+    """q1 with a REAL Parquet read in the measured loop (VERDICT r2 item 4):
+    file bytes -> native page decode -> device staging -> q1. Input file is
+    generated once with pyarrow (data generation only — the measured reader
+    is ours)."""
+    import io
+
+    import jax
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu import types as t
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.models.tpch import lineitem_table, tpch_q1
+    from spark_rapids_jni_tpu.parquet.reader import read_table
+
+    li = lineitem_table(n)
+
+    def np_col(i):
+        return np.asarray(li.column(i).data)
+
+    pa_table = pa.table({
+        "l_quantity": pa.array(np_col(0), type=pa.int64()),
+        "l_extendedprice": pa.array(np_col(1), type=pa.int64()),
+        "l_discount": pa.array(np_col(2), type=pa.int64()),
+        "l_tax": pa.array(np_col(3), type=pa.int64()),
+        "l_returnflag": pa.array(np_col(4), type=pa.int8()),
+        "l_linestatus": pa.array(np_col(5), type=pa.int8()),
+        "l_shipdate": pa.array(np_col(6)).cast(pa.date32()),
+    })
+    buf = io.BytesIO()
+    pq.write_table(pa_table, buf, compression="snappy")
+    data = buf.getvalue()
+
+    q1 = jax.jit(tpch_q1)
+    money = t.decimal64(-2)
+
+    def run():
+        tbl = read_table(data)
+        cols = list(tbl.columns)
+        for i in range(4):  # unscaled int64 -> the money decimals q1 wants
+            cols[i] = Column(money, cols[i].data, cols[i].validity)
+        return q1(Table(cols))
+
+    jax.block_until_ready(run())  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(run())
+    per_iter = (time.perf_counter() - t0) / iters
+    return n / per_iter
+
+
+def _bench_shuffle_wire(n: int, iters: int):
+    """Compressed shuffle transport: hash_shuffle with narrowing + BitPack
+    wire specs over the executor mesh (every visible device; 1 on the
+    single-chip bench). Metric = planner-accounted bytes-on-wire per
+    exchange / wall time — the nvcomp-role codec throughput."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_jni_tpu import types as t
+    from spark_rapids_jni_tpu.models.tpch import lineitem_table
+    from spark_rapids_jni_tpu.parallel import (
+        EXEC_AXIS,
+        executor_mesh,
+        hash_shuffle,
+        shard_table,
+    )
+    from spark_rapids_jni_tpu.parallel.wire import BitPack, shuffle_wire_bytes
+
+    mesh = executor_mesh()
+    d = mesh.shape[EXEC_AXIS]
+    li = lineitem_table(n)
+    # quantities fit int16 at scale -2? no — values to 5100; int16 ok.
+    # discounts/taxes 0..10 -> int8; dates span ~12.4 bits -> BitPack(13).
+    wire = [t.INT16, t.INT32, t.INT8, t.INT8, None, None,
+            BitPack(bits=13, reference=8400)]
+    import math
+
+    sharded = shard_table(li, mesh)
+    # one capacity, passed to BOTH the shuffle and the accounting — deriving
+    # it twice risks the metric diverging from the bytes actually moved
+    local_n = math.ceil(li.num_rows / d)
+    capacity = max(1, math.ceil(local_n / d) * 2)
+
+    def step(local):
+        sh = hash_shuffle(local, [6], EXEC_AXIS, capacity=capacity,
+                          wire_dtypes=wire)
+        return sh.table, sh.narrowing_overflow.reshape(1)
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
+        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+    ))
+    out, novf = fn(sharded)
+    jax.block_until_ready(out)
+    assert not bool(novf.any()), "wire spec overflowed — planner bug"
+    acct = shuffle_wire_bytes(li, wire, capacity, d)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fn(sharded))
+    per_iter = (time.perf_counter() - t0) / iters
+    return d * acct["wire_bytes"] / per_iter / 1e9
+
+
 # config name -> (bench fn, metric, unit); the metric/unit pair is fixed per
 # config so failure records line up with their success history.
 _CONFIGS = {
     "tpch_q1": (_bench_tpch_q1, "tpch_q1_rows_per_s", "rows/s"),
     "tpcds_q72": (_bench_tpcds_q72, "tpcds_q72_rows_per_s", "rows/s"),
     "row_conversion": (_bench_row_conversion, "row_conversion_gb_per_s", "GB/s"),
+    "parquet_q1": (_bench_parquet_q1, "parquet_q1_rows_per_s", "rows/s"),
+    "shuffle_wire": (_bench_shuffle_wire, "shuffle_wire_gb_per_s", "GB/s"),
 }
 
 

@@ -17,18 +17,33 @@ public final class Table implements AutoCloseable {
 
   private long handle;
   private final ColumnVector[] columns;
+  private final boolean ownsColumns;
 
   /** Takes ownership of column handles released by a native call. */
   public Table(long[] columnHandles) {
     this.columns = new ColumnVector[columnHandles.length];
+    this.ownsColumns = true;
     for (int i = 0; i < columnHandles.length; i++) {
       this.columns[i] = new ColumnVector(columnHandles[i]);
     }
-    this.handle = createTable(columnHandles);
+    try {
+      this.handle = createTable(columnHandles);
+    } catch (RuntimeException e) {
+      for (ColumnVector c : this.columns) {
+        c.close();
+      }
+      throw e;
+    }
   }
 
+  /**
+   * Build from caller-owned columns. cuDF convention: the caller keeps
+   * ownership of its vectors and closes them itself; this table's close()
+   * only releases the table handle.
+   */
   public Table(ColumnVector[] columns) {
     this.columns = columns.clone();
+    this.ownsColumns = false;
     long[] handles = new long[columns.length];
     for (int i = 0; i < columns.length; i++) {
       handles[i] = columns[i].getNativeView();
@@ -58,8 +73,10 @@ public final class Table implements AutoCloseable {
       freeNative(handle);
       handle = 0;
     }
-    for (ColumnVector c : columns) {
-      c.close();
+    if (ownsColumns) {
+      for (ColumnVector c : columns) {
+        c.close();
+      }
     }
   }
 

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops.hash import partition_hash
+from spark_rapids_jni_tpu.parallel.wire import BitPack, pack_bits, unpack_bits
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 
@@ -159,7 +160,28 @@ def hash_shuffle(
                 "row_conversion.cu:515 has the same restriction)"
             )
         wire = None if wire_dtypes is None else wire_dtypes[i]
-        if wire is not None:
+        if isinstance(wire, BitPack):
+            # nvcomp-equivalent transport compression, stage 2: frame-of-
+            # reference + bit-packing (parallel.wire). Null slots and
+            # unoccupied send slots are cleaned to the reference value so
+            # they always pack; out-of-range real values set
+            # narrowing_overflow — detection, not silent truncation.
+            if col.dtype.storage_dtype.kind not in ("i", "u"):
+                raise TypeError(
+                    f"BitPack wire spec needs integral storage (column {i})"
+                )
+            ref = jnp.asarray(wire.reference, col.data.dtype)
+            clean = jnp.where(col.valid_mask(), col.data, ref)
+            sent = _pack_send(clean, order, dst, size)
+            sent = jnp.where(occupied, sent, ref)
+            packed, ovf = pack_bits(sent.reshape(D, capacity), wire)
+            narrowing_overflow = narrowing_overflow | ovf
+            recv_words = jax.lax.all_to_all(packed, axis_name, 0, 0,
+                                            tiled=True)
+            recv = unpack_bits(
+                recv_words, capacity, wire, col.data.dtype
+            ).reshape(size)
+        elif wire is not None:
             # Null slots hold unspecified data (Column contract) — zero them
             # so garbage payloads can't trip the narrowing check (and the
             # wire bytes become deterministic).
@@ -167,11 +189,11 @@ def hash_shuffle(
                 col.valid_mask(), col.data, jnp.zeros_like(col.data)
             )
             sent = _pack_send(clean, order, dst, size)
-            # nvcomp-equivalent transport compression: the planner declares
-            # a narrower integral wire type (dates in int32, quantities in
-            # int16, ...) and the exchange moves 2-4x fewer bytes over ICI.
-            # A value that does not survive the down/up cast sets
-            # narrowing_overflow — detection, not silent truncation.
+            # nvcomp-equivalent transport compression, stage 1: the planner
+            # declares a narrower integral wire type (dates in int32,
+            # quantities in int16, ...) and the exchange moves 2-4x fewer
+            # bytes over ICI. A value that does not survive the down/up
+            # cast sets narrowing_overflow.
             narrow = sent.astype(wire.jnp_dtype)
             widened = narrow.astype(col.data.dtype)
             # unoccupied slots hold zeros, which always survive narrowing

@@ -71,6 +71,18 @@ struct Gil {
   ~Gil() { PyGILState_Release(state); }
 };
 
+// Pre-GIL guard: PyGILState_Ensure on an uninitialized interpreter is a
+// fatal abort, so every entry point must check this BEFORE taking the GIL
+// (the benign unlocked read of g_bridge is a monotonic pointer set once
+// under tpudf_rt_init's mutex).
+bool rt_ready() {
+  if (!Py_IsInitialized() || g_bridge == nullptr) {
+    g_last_error = "tpudf_rt_init was not called";
+    return false;
+  }
+  return true;
+}
+
 // Call bridge.<fn>(args...) returning a new reference or nullptr (+error).
 PyObject* bridge_call(char const* fn, PyObject* args) {  // steals args
   if (g_bridge == nullptr) {
@@ -164,6 +176,7 @@ int32_t tpudf_rt_init(char const* sys_path, char const* platform) {
 int64_t tpudf_rt_column_from_host(int32_t type_id, int32_t scale, int64_t n,
                                   uint8_t const* data, int64_t data_len,
                                   uint8_t const* validity) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* vbytes;
   if (validity == nullptr) {
@@ -183,6 +196,7 @@ int64_t tpudf_rt_column_from_host(int32_t type_id, int32_t scale, int64_t n,
 }
 
 int64_t tpudf_rt_table_create(int64_t const* cols, int32_t ncols) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* list = PyList_New(ncols);
   for (int32_t i = 0; i < ncols; ++i) {
@@ -202,6 +216,7 @@ int64_t tpudf_rt_table_create(int64_t const* cols, int32_t ncols) {
 }
 
 static int64_t call_int(char const* fn, int64_t handle) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* obj = get_handle(handle);
   if (obj == nullptr) {
@@ -214,6 +229,10 @@ static int64_t call_int(char const* fn, int64_t handle) {
   if (out == nullptr) return -1;
   int64_t v = PyLong_AsLongLong(out);
   Py_DECREF(out);
+  if (v == -1 && PyErr_Occurred()) {
+    set_python_error();  // also clears the pending exception
+    return -1;
+  }
   return v;
 }
 
@@ -226,6 +245,7 @@ int64_t tpudf_rt_table_num_rows(int64_t tbl) {
 }
 
 int64_t tpudf_rt_table_column(int64_t tbl, int32_t i) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* obj = get_handle(tbl);
   if (obj == nullptr) {
@@ -241,6 +261,7 @@ int64_t tpudf_rt_table_column(int64_t tbl, int32_t i) {
 
 int32_t tpudf_rt_column_info(int64_t col, int32_t* type_id, int32_t* scale,
                              int64_t* num_rows) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* obj = get_handle(col);
   if (obj == nullptr) {
@@ -269,6 +290,7 @@ int32_t tpudf_rt_column_info(int64_t col, int32_t* type_id, int32_t* scale,
 int32_t tpudf_rt_column_to_host(int64_t col, uint8_t* data_out,
                                 int64_t data_cap, uint8_t* validity_out,
                                 int64_t validity_cap) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* obj = get_handle(col);
   if (obj == nullptr) {
@@ -311,6 +333,7 @@ int32_t tpudf_rt_column_to_host(int64_t col, uint8_t* data_out,
 // out receives up to cap handles; *n_out the true batch count.
 int32_t tpudf_rt_convert_to_rows(int64_t tbl, int64_t* out, int32_t cap,
                                  int32_t* n_out) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* obj = get_handle(tbl);
   if (obj == nullptr) {
@@ -339,6 +362,7 @@ int32_t tpudf_rt_convert_to_rows(int64_t tbl, int64_t* out, int32_t cap,
 
 int64_t tpudf_rt_convert_from_rows(int64_t rows, int32_t const* type_ids,
                                    int32_t const* scales, int32_t ncols) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* obj = get_handle(rows);
   if (obj == nullptr) {
@@ -360,6 +384,7 @@ int64_t tpudf_rt_convert_from_rows(int64_t rows, int32_t const* type_ids,
 
 int32_t tpudf_rt_rows_info(int64_t rows, int64_t* num_rows,
                            int64_t* row_size) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* obj = get_handle(rows);
   if (obj == nullptr) {
@@ -383,6 +408,7 @@ int32_t tpudf_rt_rows_info(int64_t rows, int64_t* num_rows,
 }
 
 int32_t tpudf_rt_rows_to_host(int64_t rows, uint8_t* out, int64_t cap) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* obj = get_handle(rows);
   if (obj == nullptr) {
@@ -406,6 +432,7 @@ int32_t tpudf_rt_rows_to_host(int64_t rows, uint8_t* out, int64_t cap) {
 
 int64_t tpudf_rt_rows_from_host(int64_t num_rows, int64_t row_size,
                                 uint8_t const* data) {
+  if (!rt_ready()) return -1;
   Gil gil;
   PyObject* args = Py_BuildValue(
       "(LLy#)", static_cast<long long>(num_rows),
@@ -417,6 +444,7 @@ int64_t tpudf_rt_rows_from_host(int64_t num_rows, int64_t row_size,
 }
 
 int32_t tpudf_rt_free(int64_t handle) {
+  if (!rt_ready()) return -1;
   Gil gil;
   std::lock_guard<std::mutex> lock(g_mutex);
   auto it = g_handles.find(handle);
