@@ -39,6 +39,13 @@ class Column:
                 raise ValueError("string column requires chars buffer")
             if self.data.dtype != jnp.int32:
                 raise TypeError("string offsets/lengths must be int32")
+        elif self.dtype.is_decimal128:
+            if self.data.dtype != jnp.int64 or self.data.ndim != 2 \
+                    or self.data.shape[-1] != 2:
+                raise TypeError(
+                    "DECIMAL128 columns store int64[n, 2] limb pairs "
+                    "(lo, hi little-endian)"
+                )
         elif self.dtype.is_fixed_width:
             expect = self.dtype.jnp_dtype
             if self.data.dtype != expect:
@@ -114,6 +121,15 @@ class Column:
                 chars=jnp.asarray(chars.copy()),
             )
         valid = np.array([v is not None for v in values], dtype=bool)
+        if dtype.is_decimal128:
+            limbs = np.zeros((len(values), 2), dtype=np.int64)
+            for i, v in enumerate(values):
+                if v is None:
+                    continue
+                limbs[i, 0] = np.int64(np.uint64(int(v) & 0xFFFFFFFFFFFFFFFF))
+                limbs[i, 1] = int(v) >> 64
+            vmask = None if valid.all() else jnp.asarray(valid)
+            return cls(dtype, jnp.asarray(limbs), vmask)
         storage = dtype.storage_dtype
         filled = np.zeros(len(values), dtype=storage)
         for i, v in enumerate(values):
@@ -162,6 +178,9 @@ class Column:
                 out.append(None)
             elif self.dtype.type_id == TypeId.BOOL8:
                 out.append(bool(data[i]))
+            elif self.dtype.is_decimal128:
+                lo = int(np.uint64(data[i, 0]))
+                out.append((int(data[i, 1]) << 64) | lo)
             else:
                 out.append(data[i].item())
         return out

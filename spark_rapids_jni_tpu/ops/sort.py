@@ -52,6 +52,10 @@ def _key_arrays(col: Column, ascending: bool, nulls_first: bool):
     dtype = col.dtype
     valid = col.valid_mask()
 
+    if dtype.is_decimal128:
+        raise NotImplementedError(
+            "DECIMAL128 sort keys are not supported yet (limb-pair compare)"
+        )
     if dtype.is_string:
         from spark_rapids_jni_tpu.ops import strings as s
 

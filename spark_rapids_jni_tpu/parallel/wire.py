@@ -54,6 +54,12 @@ def pack_bits(values: jnp.ndarray, spec: BitPack) -> tuple[jnp.ndarray, jnp.ndar
 
     Leading axes (e.g. the per-destination blocks of a shuffle send buffer)
     pack independently so the word stream splits cleanly per destination.
+
+    TPU-first formulation: gather-based, not scatter-based. Each output
+    word OR-combines the <= ceil(32/bits)+1 values whose bit fields overlap
+    it — a static unrolled loop of dense gathers the VPU tiles cleanly
+    (the scatter-add formulation measured ~3x slower than CPU on v5e; see
+    BASELINE.md).
     """
     bits = spec.bits
     n = int(values.shape[-1])
@@ -62,25 +68,28 @@ def pack_bits(values: jnp.ndarray, spec: BitPack) -> tuple[jnp.ndarray, jnp.ndar
     overflow = jnp.any((v64 < 0) | (v64 >= (1 << bits)))
     v = v64.astype(jnp.uint32) & jnp.uint32((1 << bits) - 1)
 
-    bit0 = np.arange(n, dtype=np.int64) * bits
-    word = jnp.asarray(bit0 // 32, dtype=jnp.int32)
-    off = jnp.asarray(bit0 % 32, dtype=jnp.uint32)
-
-    low = v << off
-    # bits spilling into the next word; off+bits<=32 -> no spill (shift by
-    # >= 32 is undefined in XLA, so guard with where)
-    spill = off.astype(jnp.int64) + bits > 32
-    high = jnp.where(
-        spill, v >> jnp.where(spill, jnp.uint32(32) - off, jnp.uint32(1)),
-        jnp.uint32(0),
-    )
+    # word w covers bits [32w, 32w+32); contributing values j satisfy
+    # j*bits < 32w+32 and (j+1)*bits > 32w
+    word_bit0 = np.arange(w, dtype=np.int64) * 32
+    j_min = word_bit0 // bits
+    k_max = int(np.max((word_bit0 + 31) // bits - j_min)) if w else 0
 
     shape = values.shape[:-1] + (w,)
     packed = jnp.zeros(shape, jnp.uint32)
-    packed = packed.at[..., word].add(low)
-    packed = packed.at[..., jnp.minimum(word + 1, w - 1)].add(
-        jnp.where(spill, high, jnp.uint32(0))
-    )
+    base = jnp.asarray(word_bit0, dtype=jnp.int64)
+    for k in range(k_max + 1):
+        j = j_min + k
+        valid_j = j < n
+        jc = jnp.asarray(np.minimum(j, max(n - 1, 0)), dtype=jnp.int32)
+        vj = v[..., jc]
+        # shift of value j relative to word start: j*bits - 32w, in
+        # (-32, 32); negative = the value started in an earlier word
+        shift = jnp.asarray(j * bits, dtype=jnp.int64) - base
+        left = jnp.where(shift > 0, shift, 0).astype(jnp.uint32)
+        right = jnp.where(shift < 0, -shift, 0).astype(jnp.uint32)
+        contrib = (vj << left) >> right
+        contrib = jnp.where(jnp.asarray(valid_j), contrib, jnp.uint32(0))
+        packed = packed | contrib
     return packed, overflow
 
 

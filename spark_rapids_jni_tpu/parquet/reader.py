@@ -91,9 +91,11 @@ def _map_dtype(phys: int, conv: int, scale: int, type_length: int) -> DType:
     if phys == _PHYS_FLBA:
         if conv == _CONV_DECIMAL and 0 < type_length <= 8:
             return t.decimal64(-scale)
+        if conv == _CONV_DECIMAL and 8 < type_length <= 16:
+            return t.decimal128(-scale)
         raise NotImplementedError(
             "FIXED_LEN_BYTE_ARRAY is only supported as DECIMAL with "
-            "type_length <= 8"
+            "type_length <= 16"
         )
     raise NotImplementedError(f"unsupported parquet physical type {phys}")
 
@@ -105,6 +107,24 @@ def _flba_to_int64(raw: np.ndarray, width: int) -> np.ndarray:
     for k in range(width):
         out = (out << 8) | m[:, k]
     return out
+
+
+def _flba_to_int128(raw: np.ndarray, width: int) -> np.ndarray:
+    """Big-endian two's-complement unscaled decimal (9..16 bytes) ->
+    int64[n, 2] limb pairs (lo, hi little-endian limb order)."""
+    m = raw.reshape(-1, width).astype(np.uint64)
+    lo = np.zeros(m.shape[0], dtype=np.uint64)
+    hi = np.zeros(m.shape[0], dtype=np.uint64)
+    for k in range(width):  # big-endian: shift the 128-bit value left 8
+        hi = (hi << np.uint64(8)) | (lo >> np.uint64(56))
+        lo = (lo << np.uint64(8)) | m[:, k]
+    # sign-extend bits [8*width, 128) for negative values
+    if width < 16:
+        neg = m[:, 0] >= 128
+        shift = 8 * width - 64  # in (0, 64) for widths 9..15
+        mask = np.uint64((0xFFFFFFFFFFFFFFFF << shift) & 0xFFFFFFFFFFFFFFFF)
+        hi = np.where(neg, hi | mask, hi)
+    return np.stack([lo.view(np.int64), hi.view(np.int64)], axis=1)
 
 
 def _check(lib, ok: bool, what: str) -> None:
@@ -196,6 +216,10 @@ def read_table(
             )
             if vbuf is not None:
                 validity = jnp.asarray(vbuf.astype(bool))
+            if phys == _PHYS_FLBA and dtype.is_decimal128:
+                values = _flba_to_int128(raw[:data_bytes], tlen)
+                out.append(Column(dtype, jnp.asarray(values), validity))
+                continue
             if phys == _PHYS_FLBA:
                 values = _flba_to_int64(raw[:data_bytes], tlen)
             else:

@@ -135,6 +135,13 @@ class DType:
         return self.type_id == TypeId.STRING
 
     @property
+    def is_decimal128(self) -> bool:
+        """128-bit decimal: stored as int64[n, 2] limb pairs (lo unsigned,
+        hi signed, little-endian limb order) — the TPU has no native int128,
+        so the storage IS the pair (cuDF stores __int128_t)."""
+        return self.type_id == TypeId.DECIMAL128
+
+    @property
     def storage_dtype(self) -> np.dtype:
         """Physical element dtype backing this type on device."""
         try:
@@ -189,3 +196,7 @@ def decimal32(scale: int) -> DType:
 
 def decimal64(scale: int) -> DType:
     return DType(TypeId.DECIMAL64, scale)
+
+
+def decimal128(scale: int) -> DType:
+    return DType(TypeId.DECIMAL128, scale)

@@ -5,6 +5,7 @@
 #include "tpudf/parquet_reader.hpp"
 
 #include <zlib.h>
+#include <zstd.h>
 
 #include <algorithm>
 #include <cstring>
@@ -76,10 +77,14 @@ constexpr int32_t kPageDataV2 = 3;
 constexpr int32_t kEncPlain = 0;
 constexpr int32_t kEncPlainDict = 2;
 constexpr int32_t kEncRle = 3;
+constexpr int32_t kEncDeltaBinary = 5;       // DELTA_BINARY_PACKED
+constexpr int32_t kEncDeltaLengthBA = 6;     // DELTA_LENGTH_BYTE_ARRAY
+constexpr int32_t kEncDeltaBA = 7;           // DELTA_BYTE_ARRAY
 constexpr int32_t kEncRleDict = 8;
 constexpr int32_t kCodecUncompressed = 0;
 constexpr int32_t kCodecSnappy = 1;
 constexpr int32_t kCodecGzip = 2;
+constexpr int32_t kCodecZstd = 6;
 
 int64_t field_i64(Value const& s, int16_t id, char const* what) {
   auto const* f = s.field(id);
@@ -137,10 +142,118 @@ std::vector<uint8_t> do_decompress(int32_t codec, uint8_t const* in,
       return snappy_uncompress(in, n, expected);
     case kCodecGzip:
       return gzip_uncompress(in, n, expected);
+    case kCodecZstd: {
+      std::vector<uint8_t> out(expected);
+      size_t rc = ZSTD_decompress(out.data(), out.size(), in, n);
+      if (ZSTD_isError(rc) || rc != expected) {
+        fail("zstd page did not decompress to the declared size");
+      }
+      return out;
+    }
     default:
       fail("unsupported compression codec " + std::to_string(codec) +
-           " (supported: UNCOMPRESSED, SNAPPY, GZIP)");
+           " (supported: UNCOMPRESSED, SNAPPY, GZIP, ZSTD)");
   }
+}
+
+// ---- DELTA_BINARY_PACKED / DELTA_*_BYTE_ARRAY ------------------------------
+
+int64_t zigzag_decode(uint64_t u) {
+  return static_cast<int64_t>(u >> 1) ^ -static_cast<int64_t>(u & 1);
+}
+
+// Decode one DELTA_BINARY_PACKED stream starting at *pos; advances *pos to
+// the first byte after the stream (required: DELTA_LENGTH_BYTE_ARRAY and
+// DELTA_BYTE_ARRAY concatenate further sections behind it).
+std::vector<int64_t> decode_delta_binary(uint8_t const* p, uint64_t len,
+                                         uint64_t* pos) {
+  uint64_t block_size = read_varint(p, len, pos);
+  uint64_t miniblocks = read_varint(p, len, pos);
+  uint64_t total = read_varint(p, len, pos);
+  int64_t value = zigzag_decode(read_varint(p, len, pos));
+  if (miniblocks == 0 || block_size % miniblocks != 0 ||
+      block_size % 128 != 0) {
+    fail("bad DELTA_BINARY_PACKED header");
+  }
+  uint64_t per_mini = block_size / miniblocks;
+  if (per_mini % 32 != 0) fail("miniblock size not a multiple of 32");
+  std::vector<int64_t> out;
+  out.reserve(total);
+  if (total == 0) return out;
+  out.push_back(value);
+  while (out.size() < total) {
+    int64_t min_delta = zigzag_decode(read_varint(p, len, pos));
+    if (*pos + miniblocks > len) fail("delta bit widths past end");
+    uint8_t const* bws = p + *pos;
+    *pos += miniblocks;
+    for (uint64_t m = 0; m < miniblocks; ++m) {
+      int bw = bws[m];
+      if (bw > 64) fail("delta miniblock bit width > 64");
+      if (out.size() >= total) {
+        // fully-padded trailing miniblock: no data bytes were written
+        continue;
+      }
+      uint64_t nbytes = per_mini * bw / 8;
+      if (*pos + nbytes > len) fail("delta miniblock past end of page");
+      for (uint64_t i = 0; i < per_mini && out.size() < total; ++i) {
+        uint64_t bit = i * bw;
+        uint64_t byte = bit >> 3;
+        int shift = static_cast<int>(bit & 7);
+        // a <=64-bit field spans at most 9 bytes
+        unsigned __int128 acc = 0;
+        for (int k = 0; k < 9 && byte + k < nbytes; ++k) {
+          acc |= static_cast<unsigned __int128>(p[*pos + byte + k])
+                 << (8 * k);
+        }
+        uint64_t mask = bw == 64 ? ~0ull : ((1ull << bw) - 1);
+        uint64_t delta = static_cast<uint64_t>(acc >> shift) & mask;
+        value += min_delta + static_cast<int64_t>(delta);
+        out.push_back(value);
+      }
+      *pos += nbytes;
+    }
+  }
+  return out;
+}
+
+// DELTA_LENGTH_BYTE_ARRAY: delta-packed lengths, then concatenated bytes.
+std::vector<std::string> decode_delta_length_ba(uint8_t const* p,
+                                                uint64_t len, uint64_t* pos) {
+  std::vector<int64_t> lengths = decode_delta_binary(p, len, pos);
+  std::vector<std::string> blobs;
+  blobs.reserve(lengths.size());
+  for (int64_t l : lengths) {
+    if (l < 0 || *pos + static_cast<uint64_t>(l) > len) {
+      fail("DELTA_LENGTH_BYTE_ARRAY data past end of page");
+    }
+    blobs.emplace_back(reinterpret_cast<char const*>(p + *pos), l);
+    *pos += static_cast<uint64_t>(l);
+  }
+  return blobs;
+}
+
+// DELTA_BYTE_ARRAY: delta-packed shared-prefix lengths + suffixes as
+// DELTA_LENGTH_BYTE_ARRAY; value i = value[i-1][:prefix[i]] + suffix[i].
+std::vector<std::string> decode_delta_ba(uint8_t const* p, uint64_t len,
+                                         uint64_t* pos) {
+  std::vector<int64_t> prefixes = decode_delta_binary(p, len, pos);
+  std::vector<std::string> suffixes = decode_delta_length_ba(p, len, pos);
+  if (prefixes.size() != suffixes.size()) {
+    fail("DELTA_BYTE_ARRAY prefix/suffix count mismatch");
+  }
+  std::vector<std::string> blobs;
+  blobs.reserve(prefixes.size());
+  std::string prev;
+  for (size_t i = 0; i < prefixes.size(); ++i) {
+    int64_t pre = prefixes[i];
+    if (pre < 0 || static_cast<uint64_t>(pre) > prev.size()) {
+      fail("DELTA_BYTE_ARRAY prefix longer than previous value");
+    }
+    std::string v = prev.substr(0, pre) + suffixes[i];
+    blobs.push_back(v);
+    prev = std::move(v);
+  }
+  return blobs;
 }
 
 // ---- RLE / bit-packed hybrid ----------------------------------------------
@@ -489,9 +602,39 @@ void decode_chunk(uint8_t const* file, uint64_t file_len, Value const& chunk,
                         dict.fixed.begin() + (id + 1) * width);
           }
         }
+      } else if (enc == kEncDeltaBinary) {
+        auto phys = static_cast<Physical>(leaf.physical);
+        if (phys != Physical::INT32 && phys != Physical::INT64) {
+          fail("DELTA_BINARY_PACKED is only valid for INT32/INT64");
+        }
+        uint64_t dpos = vpos;
+        auto dec = decode_delta_binary(bytes.data(), bytes.size(), &dpos);
+        if (static_cast<int64_t>(dec.size()) < n_present) {
+          fail("DELTA_BINARY_PACKED stream shorter than page values");
+        }
+        for (int64_t i = 0; i < n_present; ++i) {
+          int64_t v = dec[i];
+          for (int k = 0; k < width; ++k) {
+            vals.push_back(static_cast<uint8_t>(v >> (8 * k)));
+          }
+        }
+      } else if (enc == kEncDeltaLengthBA || enc == kEncDeltaBA) {
+        if (static_cast<Physical>(leaf.physical) != Physical::BYTE_ARRAY) {
+          fail("DELTA_*_BYTE_ARRAY is only valid for BYTE_ARRAY");
+        }
+        uint64_t dpos = vpos;
+        blobs = enc == kEncDeltaLengthBA
+                    ? decode_delta_length_ba(bytes.data(), bytes.size(), &dpos)
+                    : decode_delta_ba(bytes.data(), bytes.size(), &dpos);
+        if (static_cast<int64_t>(blobs.size()) < n_present) {
+          fail("DELTA byte-array stream shorter than page values");
+        }
+        blobs.resize(n_present);
       } else {
         fail("unsupported data encoding " + std::to_string(enc) +
-             " (supported: PLAIN, PLAIN_DICTIONARY, RLE_DICTIONARY)");
+             " (supported: PLAIN, PLAIN_DICTIONARY, RLE_DICTIONARY, "
+             "DELTA_BINARY_PACKED, DELTA_LENGTH_BYTE_ARRAY, "
+             "DELTA_BYTE_ARRAY)");
       }
       append_values(col, leaf, width, vals, blobs, valid, page_values);
       values_seen += page_values;

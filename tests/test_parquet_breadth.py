@@ -1,0 +1,171 @@
+"""Parquet reader breadth (VERDICT round-2 item 7): ZSTD pages, the DELTA_*
+encodings, and DECIMAL128 storage — each round-tripped against
+pyarrow-written files (pyarrow generates the inputs; the measured decoder
+is ours: src/native/src/parquet_reader.cpp).
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+from spark_rapids_jni_tpu.parquet.reader import read_table  # noqa: E402
+
+
+def write_bytes(table, **kwargs):
+    buf = io.BytesIO()
+    pq.write_table(table, buf, **kwargs)
+    return buf.getvalue()
+
+
+class TestZstd:
+    def test_zstd_pages_round_trip(self, rng):
+        n = 2000
+        ints = rng.integers(-10**9, 10**9, n)
+        strs = [f"row_{i}" if i % 7 else None for i in range(n)]
+        data = write_bytes(
+            pa.table({"a": pa.array(ints), "b": pa.array(strs)}),
+            compression="zstd",
+        )
+        tbl = read_table(data)
+        assert tbl.column(0).to_pylist() == [int(v) for v in ints]
+        assert tbl.column(1).to_pylist() == strs
+
+
+class TestDeltaEncodings:
+    @pytest.mark.parametrize("dtype,lo,hi", [
+        (pa.int32(), -50_000, 50_000),
+        (pa.int64(), -(10**12), 10**12),
+    ])
+    def test_delta_binary_packed(self, rng, dtype, lo, hi):
+        n = 3000
+        vals = rng.integers(lo, hi, n)
+        # sorted-ish data plus jumps: exercises multi-block miniblocks with
+        # varying bit widths
+        vals = np.sort(vals)
+        vals[::97] = rng.integers(lo, hi, len(vals[::97]))
+        data = write_bytes(
+            pa.table({"v": pa.array(vals, type=dtype)}),
+            use_dictionary=False,
+            column_encoding={"v": "DELTA_BINARY_PACKED"},
+        )
+        tbl = read_table(data)
+        assert tbl.column(0).to_pylist() == [int(v) for v in vals]
+
+    def test_delta_binary_packed_single_value(self):
+        data = write_bytes(
+            pa.table({"v": pa.array([42], type=pa.int32())}),
+            use_dictionary=False,
+            column_encoding={"v": "DELTA_BINARY_PACKED"},
+        )
+        assert read_table(data).column(0).to_pylist() == [42]
+
+    def test_delta_binary_packed_with_nulls(self, rng):
+        vals = [int(v) if i % 5 else None
+                for i, v in enumerate(rng.integers(0, 1000, 500))]
+        data = write_bytes(
+            pa.table({"v": pa.array(vals, type=pa.int64())}),
+            use_dictionary=False,
+            column_encoding={"v": "DELTA_BINARY_PACKED"},
+        )
+        assert read_table(data).column(0).to_pylist() == vals
+
+    def test_delta_length_byte_array(self, rng):
+        strs = [("x" * int(k)) + str(i) for i, k in
+                enumerate(rng.integers(0, 40, 800))]
+        strs[13] = None
+        data = write_bytes(
+            pa.table({"s": pa.array(strs)}),
+            use_dictionary=False,
+            column_encoding={"s": "DELTA_LENGTH_BYTE_ARRAY"},
+        )
+        assert read_table(data).column(0).to_pylist() == strs
+
+    def test_delta_byte_array(self, rng):
+        # shared prefixes: the encoding's sweet spot
+        strs = sorted(f"prefix/shared/key_{i:05d}" for i in range(600))
+        data = write_bytes(
+            pa.table({"s": pa.array(strs)}),
+            use_dictionary=False,
+            column_encoding={"s": "DELTA_BYTE_ARRAY"},
+        )
+        assert read_table(data).column(0).to_pylist() == strs
+
+
+class TestDecimal128:
+    def test_wide_decimal_round_trip(self, rng):
+        import decimal
+
+        scale = 4
+        vals = [
+            decimal.Decimal(v) / (10 ** scale)
+            for v in [0, 1, -1, 10**25, -(10**25), 2**64, -(2**64) - 7,
+                      (1 << 100), -(1 << 100)]
+        ]
+        arr = pa.array(vals, type=pa.decimal128(38, scale))
+        data = write_bytes(pa.table({"d": arr}))
+        tbl = read_table(data)
+        col = tbl.column(0)
+        assert col.dtype.is_decimal128
+        assert col.dtype.scale == -scale
+        got = col.to_pylist()
+        want = [int(v.scaleb(scale)) for v in vals]
+        assert got == want
+
+    def test_decimal128_nulls(self):
+        import decimal
+
+        vals = [decimal.Decimal("123456789012345678901234.5"), None,
+                decimal.Decimal("-1.5")]
+        arr = pa.array(vals, type=pa.decimal128(30, 1))
+        data = write_bytes(pa.table({"d": arr}))
+        got = read_table(data).column(0).to_pylist()
+        assert got == [1234567890123456789012345, None, -15]
+
+    def test_nine_byte_decimal(self):
+        # precision 20 -> 9-byte FLBA: exercises the partial-limb sign path
+        import decimal
+
+        vals = [decimal.Decimal(v) for v in
+                [(1 << 66), -(1 << 66), 0, -1, 12345678901234567890]]
+        arr = pa.array(vals, type=pa.decimal128(20, 0))
+        data = write_bytes(pa.table({"d": arr}))
+        got = read_table(data).column(0).to_pylist()
+        assert got == [int(v) for v in vals]
+
+
+class TestDecimal128OpBoundaries:
+    def _col(self):
+        from spark_rapids_jni_tpu import types as t
+        from spark_rapids_jni_tpu.columnar import Column, Table
+
+        d = Column.from_pylist([1 << 70, -(1 << 70), 5], t.decimal128(-2))
+        i = Column.from_pylist([1, 2, 3], t.INT64)
+        return Table([d, i])
+
+    def test_groupby_rejects_cleanly(self):
+        from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+
+        tbl = self._col()
+        with pytest.raises(NotImplementedError, match="DECIMAL128"):
+            groupby_aggregate(tbl, [0], [(1, "sum")])
+        with pytest.raises(NotImplementedError, match="DECIMAL128"):
+            groupby_aggregate(tbl, [1], [(0, "sum")])
+        with pytest.raises(NotImplementedError, match="DECIMAL128"):
+            groupby_aggregate(tbl, [1], [(0, "min")])
+
+    def test_sort_key_rejects_cleanly(self):
+        from spark_rapids_jni_tpu.ops.sort import sort_table
+
+        with pytest.raises(NotImplementedError, match="DECIMAL128"):
+            sort_table(self._col(), [0])
+
+    def test_row_gather_works(self):
+        # non-key usage (gather through sort on another key) is supported
+        from spark_rapids_jni_tpu.ops.sort import sort_table
+
+        out = sort_table(self._col(), [1], ascending=[False])
+        assert out.column(0).to_pylist() == [5, -(1 << 70), 1 << 70]
