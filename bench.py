@@ -25,6 +25,11 @@ import subprocess
 import sys
 import time
 
+# Bumped whenever the timing methodology changes incompatibly; recorded in
+# every line and required of any record used as a comparison baseline.
+_MEASUREMENT_TAG = "digest-sync-v2"
+
+
 def _prior_baseline(metric: str):
     """Earliest recorded TPU value of this metric from BENCH_r{N}.json.
 
@@ -49,6 +54,12 @@ def _prior_baseline(metric: str):
             continue
         if rec.get("platform") == "cpu" or rec.get("diagnostic"):
             continue
+        # Records from before the digest-sync methodology measured the RPC
+        # tunnel's dispatch latency, not device compute (r01 "4.22e9 rows/s"
+        # and r02 "7.36e9 rows/s" q1 are ~1000x off; reconciliation in
+        # BASELINE.md). They are not comparable baselines.
+        if rec.get("measurement") != _MEASUREMENT_TAG:
+            continue
         rnd = int(m.group(1))
         if best is None or rnd < best[0]:
             best = (rnd, float(rec["value"]))
@@ -60,23 +71,49 @@ def _prior_baseline(metric: str):
 # ---------------------------------------------------------------------------
 
 
+def _measure(enqueue, iters: int) -> float:
+    """Seconds per iteration of ``enqueue() -> device scalar``.
+
+    Timing contract (the r01/r02 lesson, BASELINE.md "measurement
+    methodology"): dispatches pipeline asynchronously, then every digest is
+    fetched to host as a float. An 8-byte fetch cannot complete before the
+    compute that produces it, so the clock bounds real device time — unlike
+    ``block_until_ready``, which the tunnelled TPU client acks early
+    (measured: 3.6ms "ready" vs 900ms to produce the data), and unlike
+    per-iteration blocking, which bills one host->device round trip into
+    every sample.
+    """
+    for v in (enqueue() for _ in range(2)):  # warm + settle
+        float(v)
+    t0 = time.perf_counter()
+    vals = [enqueue() for _ in range(iters)]
+    # the device executes enqueued programs in order, so fetching only the
+    # LAST digest bounds every iteration's compute with a single round trip
+    # (fetching each serially would bill iters * RTT back into the number)
+    float(vals[-1])
+    return (time.perf_counter() - t0) / iters
+
+
+def _table_digest(table):
+    """Scalar reachable from EVERY output column — anything not summed into
+    the digest is dead code XLA will prune from the measured program."""
+    import jax.numpy as jnp
+
+    acc = jnp.float64(0)
+    for c in table.columns:
+        acc = acc + jnp.sum(c.data).astype(jnp.float64)
+        acc = acc + jnp.sum(c.valid_mask()).astype(jnp.float64)
+    return acc
+
+
 def _bench_tpch_q1(n: int, iters: int):
     import jax
 
     from spark_rapids_jni_tpu.models.tpch import lineitem_table, tpch_q1
 
     lineitem = lineitem_table(n)
-    fn = jax.jit(tpch_q1)
-    jax.block_until_ready(fn(lineitem))  # compile + warm cache
-    # async enqueue, one final sync: per-iter blocking would fold the
-    # (axon-tunnel) dispatch round trip into every sample and the number
-    # would measure the tunnel, not the chip
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = fn(lineitem)
-    jax.block_until_ready(out)
-    per_iter = (time.perf_counter() - t0) / iters
+    fn = jax.jit(lambda t: _table_digest(tpch_q1(t)))
+    per_iter = _measure(lambda: fn(lineitem), iters)
     return n / per_iter
 
 
@@ -89,14 +126,10 @@ def _bench_tpcds_q72(n: int, iters: int):
     dd = tpcds.date_dim_table()
     it = tpcds.item_table(1000)
     inv = tpcds.inventory_table(num_items=1000)
-    fn = jax.jit(lambda a, b, c, d: tpcds.tpcds_q72(a, b, c, d).table)
-    jax.block_until_ready(fn(cs, dd, it, inv))
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = fn(cs, dd, it, inv)
-    jax.block_until_ready(out)
-    per_iter = (time.perf_counter() - t0) / iters
+    fn = jax.jit(
+        lambda a, b, c, d: _table_digest(tpcds.tpcds_q72(a, b, c, d).table)
+    )
+    per_iter = _measure(lambda: fn(cs, dd, it, inv), iters)
     return n / per_iter
 
 
@@ -110,22 +143,21 @@ def _bench_row_conversion(n: int, iters: int):
         convert_to_rows,
     )
 
+    import jax.numpy as jnp
+
     lineitem = lineitem_table(n)
     schema = lineitem.schema()
 
-    def roundtrip(tbl):
+    def roundtrip_digest(tbl):
         # convert_to_rows/from_rows jit their cores internally and handle the
         # 2GB batching on host, like the reference's batch loop
         out = [convert_from_rows(rc, schema) for rc in convert_to_rows(tbl)]
-        return [c.data for t_ in out for c in t_.columns]
+        acc = jnp.float64(0)
+        for t_ in out:
+            acc = acc + _table_digest(t_)
+        return acc
 
-    jax.block_until_ready(roundtrip(lineitem))  # compile + warm
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = roundtrip(lineitem)
-    jax.block_until_ready(out)
-    per_iter = (time.perf_counter() - t0) / iters
+    per_iter = _measure(lambda: roundtrip_digest(lineitem), iters)
     # bytes moved: the actual packed row image (incl. alignment padding,
     # validity bytes, 8-byte row pad) both directions
     _, _, row_bytes = compute_fixed_width_layout(tuple(schema))
@@ -167,21 +199,17 @@ def _bench_parquet_q1(n: int, iters: int):
     pq.write_table(pa_table, buf, compression="snappy")
     data = buf.getvalue()
 
-    q1 = jax.jit(tpch_q1)
+    q1 = jax.jit(lambda tb: _table_digest(tpch_q1(tb)))
     money = t.decimal64(-2)
 
     def run():
-        tbl = read_table(data)
+        tbl = read_table(data)  # host decode + device staging, in the loop
         cols = list(tbl.columns)
         for i in range(4):  # unscaled int64 -> the money decimals q1 wants
             cols[i] = Column(money, cols[i].data, cols[i].validity)
         return q1(Table(cols))
 
-    jax.block_until_ready(run())  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        jax.block_until_ready(run())
-    per_iter = (time.perf_counter() - t0) / iters
+    per_iter = _measure(run, iters)
     return n / per_iter
 
 
@@ -227,16 +255,17 @@ def _bench_shuffle_wire(n: int, iters: int):
         step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
         out_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
     ))
+
+    import jax.numpy as jnp
+
+    def digest():
+        out, novf = fn(sharded)
+        return _table_digest(out) + novf.astype(jnp.float64).sum()
+
     out, novf = fn(sharded)
-    jax.block_until_ready(out)
     assert not bool(novf.any()), "wire spec overflowed — planner bug"
     acct = shuffle_wire_bytes(li, wire, capacity, d)
-    t0 = time.perf_counter()
-    last = None
-    for _ in range(iters):
-        last = fn(sharded)
-    jax.block_until_ready(last)
-    per_iter = (time.perf_counter() - t0) / iters
+    per_iter = _measure(digest, iters)
     return d * acct["wire_bytes"] / per_iter / 1e9
 
 
@@ -332,6 +361,7 @@ def main() -> None:
         "unit": "",
         "vs_baseline": 0.0,
         "platform": "none",
+        "measurement": _MEASUREMENT_TAG,
     }
     diagnostics: list[str] = []
     try:

@@ -49,15 +49,49 @@ class ShuffleResult(NamedTuple):
     narrowing_overflow: jnp.ndarray
 
 
+class _SendPlan(NamedTuple):
+    """Inverted send-buffer mapping: for output slot s, take sorted row
+    ``src[s]`` when ``hit[s]`` (else the slot is empty). Computed ONCE per
+    shuffle and reused by every column."""
+
+    src: jnp.ndarray  # int32[size] into destination-sorted rows
+    hit: jnp.ndarray  # bool[size]
+
+
+def _plan_send(dst_mono: jnp.ndarray, in_cap: jnp.ndarray,
+               size: int) -> _SendPlan:
+    """Invert the (monotone) row->slot map into a slot->row gather.
+
+    ``dst_mono`` is non-decreasing over the partition-sorted rows (slots
+    increase within a partition, partitions increase across runs; dropped
+    rows are capped at the partition boundary so monotonicity survives
+    overflow). A scatter would serialize on the TPU; searchsorted + gather
+    streams. Ties (capped overflow rows, phantom rows sharing a slot) are
+    broken by taking the LAST row of a tie group — the real in-capacity row
+    always sorts after its capped/phantom shadows — and ``in_cap[src]``
+    rejects groups with no real member.
+    """
+    n = dst_mono.shape[0]
+    slots = jnp.arange(size, dtype=dst_mono.dtype)
+    pos = jnp.searchsorted(dst_mono, slots, side="right").astype(jnp.int32) - 1
+    src = jnp.clip(pos, 0, max(n - 1, 0))
+    hit = (pos >= 0) & (dst_mono[src] == slots) & in_cap[src] if n else (
+        jnp.zeros((size,), jnp.bool_)
+    )
+    return _SendPlan(src, hit)
+
+
 def _pack_send(
-    data: jnp.ndarray, order: jnp.ndarray, dst: jnp.ndarray, size: int
+    data: jnp.ndarray, order: jnp.ndarray, plan: _SendPlan
 ) -> jnp.ndarray:
-    """Gather rows into destination order and scatter into the flat (D*C)
-    send buffer; out-of-capacity rows drop (reported via overflow flag).
-    Works for 1-D columns and 2-D row matrices (padded string chars)."""
-    g = data[order]
-    buf = jnp.zeros((size,) + data.shape[1:], dtype=data.dtype)
-    return buf.at[dst].set(g, mode="drop")
+    """Lay rows out in send-buffer order via the inverted plan (pure
+    gathers, zero scatters). Works for 1-D columns and 2-D row matrices
+    (padded string chars)."""
+    g = data[order][plan.src]
+    zeros = jnp.zeros((), dtype=data.dtype)
+    if g.ndim == 1:
+        return jnp.where(plan.hit, g, zeros)
+    return jnp.where(plan.hit[:, None], g, zeros)
 
 
 @func_range("hash_shuffle")
@@ -93,32 +127,36 @@ def hash_shuffle(
     part_sorted = part[order]
     if row_valid is None:
         real_sorted = jnp.ones((n,), dtype=jnp.bool_)
-        counts = jnp.zeros((D,), dtype=jnp.int32).at[part].add(1)
     else:
         real_sorted = row_valid[order]
-        counts = jnp.zeros((D,), dtype=jnp.int32).at[part].add(
-            row_valid.astype(jnp.int32)
+    real_i32 = real_sorted.astype(jnp.int32)
+    # real rows in earlier partitions (per-partition slot base), scatter-free:
+    # partitions are contiguous after the sort, so the base of partition p is
+    # the exclusive real-row rank at p's first row
+    rank_excl = jnp.cumsum(real_i32) - real_i32  # reals strictly before row
+    if n:
+        part_start = jnp.searchsorted(
+            part_sorted, jnp.arange(D, dtype=part_sorted.dtype), side="left"
         )
-    offsets = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
-    )
-    # Slot within partition = count of real rows of the same partition that
-    # precede this row. Rows of a partition are contiguous after the sort,
-    # and offsets[p] counts real rows in earlier partitions, so a real
-    # row's slot is its global real-row rank minus its partition's base.
-    real_rank = jnp.cumsum(real_sorted.astype(jnp.int32)) - 1  # inclusive - 1
-    slot = real_rank - offsets[part_sorted]
+        base = rank_excl[jnp.clip(part_start, 0, n - 1)]
+        base = jnp.where(part_start < n, base, jnp.cumsum(real_i32)[-1])
+        offsets = base.astype(jnp.int32)
+    else:
+        offsets = jnp.zeros((D,), jnp.int32)
+    # Slot = count of real rows of the same partition preceding this row.
+    # Exclusive rank makes a phantom row tie with the NEXT real row (and
+    # sort BEFORE it) — the send-plan inversion picks the last row of a tie
+    # group, which is then always the real one.
+    slot = rank_excl.astype(jnp.int32) - offsets[part_sorted]
     in_cap = (slot < capacity) & real_sorted
     overflowed = jnp.any((slot >= capacity) & real_sorted)
     size = D * capacity
-    # Flat index into (D, capacity); overflow rows are routed out of range so
-    # the scatters genuinely drop them — p*capacity + slot with slot >= capacity
-    # would land inside partition p+1's region and corrupt it.
-    dst = jnp.where(in_cap, part_sorted * capacity + slot, size)
+    # Monotone destination key over the sorted rows (overflow rows capped at
+    # the partition boundary slot, which is never queried as in-capacity).
+    dst_mono = part_sorted * capacity + jnp.clip(slot, 0, capacity)
+    plan = _plan_send(dst_mono, in_cap, size)
 
-    occupied = jnp.zeros((size,), dtype=jnp.bool_).at[dst].set(
-        in_cap, mode="drop"
-    )
+    occupied = plan.hit
 
     def exchange(flat: jnp.ndarray) -> jnp.ndarray:
         """(D*C, ...) send layout -> (D*C, ...) receive layout over ICI."""
@@ -146,9 +184,9 @@ def hash_shuffle(
                     "wire narrowing does not apply to string columns "
                     f"(column {i}); pass None for its wire dtype"
                 )
-            recv_len = exchange(_pack_send(col.data, order, dst, size))
-            recv_mat = exchange(_pack_send(col.chars, order, dst, size))
-            valid_flat = _pack_send(col.valid_mask(), order, dst, size)
+            recv_len = exchange(_pack_send(col.data, order, plan))
+            recv_mat = exchange(_pack_send(col.chars, order, plan))
+            valid_flat = _pack_send(col.valid_mask(), order, plan)
             recv_valid = exchange(valid_flat) & recv_occupied
             out_cols.append(
                 Column(col.dtype, recv_len, recv_valid, chars=recv_mat)
@@ -172,7 +210,7 @@ def hash_shuffle(
                 )
             ref = jnp.asarray(wire.reference, col.data.dtype)
             clean = jnp.where(col.valid_mask(), col.data, ref)
-            sent = _pack_send(clean, order, dst, size)
+            sent = _pack_send(clean, order, plan)
             sent = jnp.where(occupied, sent, ref)
             packed, ovf = pack_bits(sent.reshape(D, capacity), wire)
             narrowing_overflow = narrowing_overflow | ovf
@@ -188,7 +226,7 @@ def hash_shuffle(
             clean = jnp.where(
                 col.valid_mask(), col.data, jnp.zeros_like(col.data)
             )
-            sent = _pack_send(clean, order, dst, size)
+            sent = _pack_send(clean, order, plan)
             # nvcomp-equivalent transport compression, stage 1: the planner
             # declares a narrower integral wire type (dates in int32,
             # quantities in int16, ...) and the exchange moves 2-4x fewer
@@ -200,10 +238,8 @@ def hash_shuffle(
             narrowing_overflow = narrowing_overflow | jnp.any(widened != sent)
             recv = exchange(narrow).astype(col.data.dtype)
         else:
-            recv = exchange(_pack_send(col.data, order, dst, size))
-        valid_flat = _pack_send(
-            col.valid_mask(), order, dst, size
-        )
+            recv = exchange(_pack_send(col.data, order, plan))
+        valid_flat = _pack_send(col.valid_mask(), order, plan)
         recv_valid = exchange(valid_flat) & recv_occupied
         out_cols.append(Column(col.dtype, recv, recv_valid))
 
