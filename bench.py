@@ -103,6 +103,8 @@ def _table_digest(table):
     for c in table.columns:
         acc = acc + jnp.sum(c.data).astype(jnp.float64)
         acc = acc + jnp.sum(c.valid_mask()).astype(jnp.float64)
+        if c.chars is not None:  # string payloads must stay reachable too
+            acc = acc + jnp.sum(c.chars).astype(jnp.float64)
     return acc
 
 

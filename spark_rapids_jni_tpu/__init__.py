@@ -2,11 +2,17 @@
 
 A from-scratch, TPU-first rebuild of the capability surface of
 ``com.nvidia:spark-rapids-jni`` (the native layer of the RAPIDS Accelerator
-for Apache Spark): HBM-resident columnar tables, XLA/Pallas kernels for the
-JNI-exposed operators (row<->column transpose, casts, hashing, bloom filters)
-and the cuDF operator substrate (sort, groupby-aggregate, hash-join), a pure
-C++ Parquet footer prune/filter engine, and an ICI all-to-all shuffle
-transport for multi-chip slices.
+for Apache Spark): HBM-resident columnar tables, fully vectorized XLA
+programs for the JNI-exposed operators (row<->column transpose, casts,
+hashing, bloom filters) and the cuDF operator substrate (sort,
+groupby-aggregate, hash-join), a pure C++ Parquet footer prune/filter
+engine, and an ICI all-to-all shuffle transport for multi-chip slices.
+No hand-written Pallas kernels ship today: every measured hot spot is a
+layout transform, scan, sort, or gather that XLA already emits well, and
+the two ops where XLA underperformed (scatter-heavy groupby reductions and
+the shuffle pack) were redesigned scatter-free instead (measurements in
+BASELINE.md) — a custom kernel would re-implement what the compiler now
+fuses.
 
 Layer map (TPU equivalent of reference SURVEY.md section 1):
   L4' Java API parity sources  -> java/ (build-gated; no JVM in this image)

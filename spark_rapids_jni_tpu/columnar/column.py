@@ -32,8 +32,22 @@ class Column:
     validity: Optional[jnp.ndarray] = None  # bool[n], True = valid
     # String columns only: data is the int32[n+1] offsets, chars the bytes.
     chars: Optional[jnp.ndarray] = None
+    # Nested columns only (cuDF lists_column_view/structs_column_view roles):
+    # LIST   -> data = int32[n+1] element offsets, children = [element]
+    # STRUCT -> data = uint8[n] placeholder,      children = fields
+    children: Optional[list] = None
 
     def __post_init__(self) -> None:
+        if self.dtype.type_id == TypeId.LIST:
+            if not self.children or len(self.children) != 1:
+                raise ValueError("LIST column requires exactly one child")
+            if self.data.dtype != jnp.int32:
+                raise TypeError("LIST offsets must be int32")
+            return
+        if self.dtype.type_id == TypeId.STRUCT:
+            if not self.children:
+                raise ValueError("STRUCT column requires children")
+            return
         if self.dtype.is_string:
             if self.chars is None:
                 raise ValueError("string column requires chars buffer")
@@ -68,6 +82,8 @@ class Column:
 
     @property
     def size(self) -> int:
+        if self.dtype.type_id == TypeId.LIST:
+            return int(self.data.shape[0]) - 1
         if self.dtype.is_string and not self.is_padded_string:
             return int(self.data.shape[0]) - 1
         return int(self.data.shape[0])
@@ -149,6 +165,27 @@ class Column:
         return data, mask
 
     def to_pylist(self) -> list:
+        if self.dtype.type_id == TypeId.LIST:
+            offsets = np.asarray(self.data)
+            child = self.children[0].to_pylist()
+            mask = None if self.validity is None else np.asarray(self.validity)
+            out = []
+            for i in range(self.size):
+                if mask is not None and not mask[i]:
+                    out.append(None)
+                else:
+                    out.append(child[offsets[i]:offsets[i + 1]])
+            return out
+        if self.dtype.type_id == TypeId.STRUCT:
+            fields = [c.to_pylist() for c in self.children]
+            mask = None if self.validity is None else np.asarray(self.validity)
+            out = []
+            for i in range(self.size):
+                if mask is not None and not mask[i]:
+                    out.append(None)
+                else:
+                    out.append(tuple(f[i] for f in fields))
+            return out
         if self.is_padded_string:
             lengths = np.asarray(self.data)
             mat = np.asarray(self.chars)

@@ -155,6 +155,146 @@ def row_group_info(data: bytes) -> list[tuple[int, int]]:
         cap = n
 
 
+def _read_flat_column(lib, handle: int, i: int) -> Column:
+    """One flat (non-nested) leaf: row-aligned values + optional validity."""
+    meta = (ctypes.c_int32 * 7)()
+    sizes = (ctypes.c_int64 * 3)()
+    _check(lib, lib.tpudf_read_col_meta(handle, i, meta, sizes) == 0,
+           "col_meta")
+    phys, conv, scale, _prec, tlen, _opt, has_valid = list(meta)
+    data_bytes, chars_bytes, num_rows = list(sizes)
+    dtype = _map_dtype(phys, conv, scale, tlen)
+
+    validity = None
+    vbuf = np.empty(num_rows, dtype=np.uint8) if has_valid else None
+    if phys == _PHYS_BYTE_ARRAY:
+        offsets = np.empty(num_rows + 1, dtype=np.int32)
+        chars = np.empty(max(chars_bytes, 1), dtype=np.uint8)
+        _check(
+            lib,
+            lib.tpudf_read_col_copy(
+                handle, i, None,
+                offsets.ctypes.data_as(ctypes.c_void_p),
+                chars.ctypes.data_as(ctypes.c_void_p),
+                None if vbuf is None
+                else vbuf.ctypes.data_as(ctypes.c_void_p),
+            ) == 0,
+            "col_copy",
+        )
+        if vbuf is not None:
+            validity = jnp.asarray(vbuf.astype(bool))
+        return Column(dtype, jnp.asarray(offsets), validity,
+                      chars=jnp.asarray(chars[:chars_bytes]))
+
+    raw = np.empty(max(data_bytes, 1), dtype=np.uint8)
+    _check(
+        lib,
+        lib.tpudf_read_col_copy(
+            handle, i, raw.ctypes.data_as(ctypes.c_void_p), None, None,
+            None if vbuf is None
+            else vbuf.ctypes.data_as(ctypes.c_void_p),
+        ) == 0,
+        "col_copy",
+    )
+    if vbuf is not None:
+        validity = jnp.asarray(vbuf.astype(bool))
+    if phys == _PHYS_FLBA and dtype.is_decimal128:
+        values = _flba_to_int128(raw[:data_bytes], tlen)
+        return Column(dtype, jnp.asarray(values), validity)
+    if phys == _PHYS_FLBA:
+        values = _flba_to_int64(raw[:data_bytes], tlen)
+    else:
+        values = raw[:data_bytes].view(_PHYS_NP[phys])
+    values = values.astype(dtype.storage_dtype, copy=False)
+    return Column(dtype, jnp.asarray(values), validity)
+
+
+def _read_leaf_data(lib, handle: int, leaf_index: int):
+    """Copy one nested leaf's compact values + levels off the native reader."""
+    from spark_rapids_jni_tpu.parquet.nested import LeafData
+
+    meta = (ctypes.c_int32 * 10)()
+    sizes = (ctypes.c_int64 * 5)()
+    _check(lib, lib.tpudf_read_col_meta2(handle, leaf_index, meta, sizes) == 0,
+           "col_meta2")
+    phys, conv, scale, _prec, tlen = meta[0], meta[1], meta[2], meta[3], meta[4]
+    max_rep = meta[8]
+    data_bytes, chars_bytes, _num_rows, n_levels, n_present = list(sizes)
+    dtype = _map_dtype(phys, conv, scale, tlen)
+
+    defs = np.empty(max(n_levels, 1), dtype=np.uint8)
+    reps = np.empty(max(n_levels, 1), dtype=np.uint8) if max_rep else None
+    _check(
+        lib,
+        lib.tpudf_read_col_levels(
+            handle, leaf_index, defs.ctypes.data_as(ctypes.c_void_p),
+            None if reps is None else reps.ctypes.data_as(ctypes.c_void_p),
+        ) == 0,
+        "col_levels",
+    )
+    defs = defs[:n_levels]
+    reps = None if reps is None else reps[:n_levels]
+
+    values = offsets = chars = None
+    if phys == _PHYS_BYTE_ARRAY:
+        offsets = np.empty(n_present + 1, dtype=np.int32)
+        chars = np.empty(max(chars_bytes, 1), dtype=np.uint8)
+        _check(
+            lib,
+            lib.tpudf_read_col_copy(
+                handle, leaf_index, None,
+                offsets.ctypes.data_as(ctypes.c_void_p),
+                chars.ctypes.data_as(ctypes.c_void_p), None,
+            ) == 0,
+            "col_copy",
+        )
+        chars = chars[:chars_bytes]
+    else:
+        raw = np.empty(max(data_bytes, 1), dtype=np.uint8)
+        _check(
+            lib,
+            lib.tpudf_read_col_copy(
+                handle, leaf_index,
+                raw.ctypes.data_as(ctypes.c_void_p), None, None, None,
+            ) == 0,
+            "col_copy",
+        )
+        if phys == _PHYS_FLBA:
+            if dtype.is_decimal128:
+                raise NotImplementedError(
+                    "DECIMAL128 inside nested columns is not supported yet"
+                )
+            values = _flba_to_int64(raw[:data_bytes], tlen)
+        else:
+            values = raw[:data_bytes].view(_PHYS_NP[phys])
+        values = values.astype(dtype.storage_dtype, copy=False)
+    return LeafData(values, offsets, chars, defs, reps, dtype)
+
+
+def _read_nested(lib, handle: int, tree) -> Table:
+    """Assemble a table whose schema contains struct/list columns."""
+    from spark_rapids_jni_tpu.parquet import nested as nst
+
+    leaf_data = {}
+    for nd in tree:
+        if nd.is_leaf:
+            continue  # top-level flat leaves use the row-aligned path
+        for lf in nst.leaves_of(nd):
+            leaf_data[lf.leaf_index] = _read_leaf_data(lib, handle,
+                                                       lf.leaf_index)
+    out = []
+    for nd in tree:
+        if nd.is_leaf:
+            out.append(_read_flat_column(lib, handle, nd.leaf_index))
+        elif nd.converted == nst._CONV_LIST or (
+            len(nd.children) == 1 and nd.children[0].repetition == 2
+        ):
+            out.append(nst.assemble_list(nd, leaf_data))
+        else:
+            out.append(nst.assemble_struct(nd, leaf_data))
+    return Table(out)
+
+
 @func_range("parquet_read_table")
 def read_table(
     data: bytes,
@@ -170,63 +310,29 @@ def read_table(
     try:
         n_columns = lib.tpudf_read_num_columns(handle)
         _check(lib, n_columns >= 0, "num_columns")
-        out = []
-        for i in range(n_columns):
-            meta = (ctypes.c_int32 * 7)()
-            sizes = (ctypes.c_int64 * 3)()
-            _check(lib, lib.tpudf_read_col_meta(handle, i, meta, sizes) == 0,
-                   "col_meta")
-            phys, conv, scale, _prec, tlen, _opt, has_valid = list(meta)
-            data_bytes, chars_bytes, num_rows = list(sizes)
-            dtype = _map_dtype(phys, conv, scale, tlen)
 
-            validity = None
-            vbuf = np.empty(num_rows, dtype=np.uint8) if has_valid else None
-            if phys == _PHYS_BYTE_ARRAY:
-                offsets = np.empty(num_rows + 1, dtype=np.int32)
-                chars = np.empty(max(chars_bytes, 1), dtype=np.uint8)
-                _check(
-                    lib,
-                    lib.tpudf_read_col_copy(
-                        handle, i, None,
-                        offsets.ctypes.data_as(ctypes.c_void_p),
-                        chars.ctypes.data_as(ctypes.c_void_p),
-                        None if vbuf is None
-                        else vbuf.ctypes.data_as(ctypes.c_void_p),
-                    ) == 0,
-                    "col_copy",
-                )
-                if vbuf is not None:
-                    validity = jnp.asarray(vbuf.astype(bool))
-                out.append(
-                    Column(dtype, jnp.asarray(offsets), validity,
-                           chars=jnp.asarray(chars[:chars_bytes]))
-                )
-                continue
+        desc_raw = lib.tpudf_read_schema_desc(handle)
+        _check(lib, desc_raw is not None, "schema_desc")
+        from spark_rapids_jni_tpu.parquet import nested as nst
 
-            raw = np.empty(max(data_bytes, 1), dtype=np.uint8)
-            _check(
-                lib,
-                lib.tpudf_read_col_copy(
-                    handle, i, raw.ctypes.data_as(ctypes.c_void_p), None, None,
-                    None if vbuf is None
-                    else vbuf.ctypes.data_as(ctypes.c_void_p),
-                ) == 0,
-                "col_copy",
-            )
-            if vbuf is not None:
-                validity = jnp.asarray(vbuf.astype(bool))
-            if phys == _PHYS_FLBA and dtype.is_decimal128:
-                values = _flba_to_int128(raw[:data_bytes], tlen)
-                out.append(Column(dtype, jnp.asarray(values), validity))
-                continue
-            if phys == _PHYS_FLBA:
-                values = _flba_to_int64(raw[:data_bytes], tlen)
-            else:
-                values = raw[:data_bytes].view(_PHYS_NP[phys])
-            values = values.astype(dtype.storage_dtype, copy=False)
-            out.append(Column(dtype, jnp.asarray(values), validity))
-        return Table(out)
+        tree = nst.parse_schema_desc(desc_raw.decode())
+        for nd in tree:
+            if nd.is_leaf and nd.repetition == 2:
+                raise NotImplementedError(
+                    f"legacy 1-level repeated field {nd.name!r} is not "
+                    "supported (rewrite as a 3-level LIST)"
+                )
+        if any(not nd.is_leaf for nd in tree):
+            if columns is not None:
+                raise NotImplementedError(
+                    "column selection over nested schemas is not supported "
+                    "yet; read all columns"
+                )
+            return _read_nested(lib, handle, tree)
+
+        return Table(
+            [_read_flat_column(lib, handle, i) for i in range(n_columns)]
+        )
     finally:
         lib.tpudf_read_close(handle)
 

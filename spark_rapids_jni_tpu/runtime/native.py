@@ -93,6 +93,22 @@ class NativeLib:
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int64),
         ]
+        c.tpudf_read_col_meta2.restype = ctypes.c_int32
+        c.tpudf_read_col_meta2.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        c.tpudf_read_col_levels.restype = ctypes.c_int32
+        c.tpudf_read_col_levels.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        c.tpudf_read_schema_desc.restype = ctypes.c_char_p
+        c.tpudf_read_schema_desc.argtypes = [ctypes.c_int64]
         c.tpudf_read_col_name.restype = ctypes.c_char_p
         c.tpudf_read_col_name.argtypes = [ctypes.c_int64, ctypes.c_int32]
         c.tpudf_read_col_copy.restype = ctypes.c_int32

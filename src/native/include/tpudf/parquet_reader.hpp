@@ -9,12 +9,15 @@
 // chunked parquet reader.
 //
 // Supported subset (errors are explicit, never silent):
-//   * flat schemas (no nesting; max def level <= 1, rep level 0)
+//   * flat schemas, nested STRUCTs, and single-level LISTs (repetition
+//     depth 1; nested lists rejected). Nested leaves surface as compact
+//     values + def/rep level streams for Dremel assembly one layer up.
 //   * physical types BOOLEAN, INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY,
-//     FIXED_LEN_BYTE_ARRAY
-//   * encodings PLAIN, PLAIN_DICTIONARY / RLE_DICTIONARY (+ RLE def levels)
+//     FIXED_LEN_BYTE_ARRAY (decimals to 16 bytes)
+//   * encodings PLAIN, PLAIN_DICTIONARY / RLE_DICTIONARY, the DELTA_*
+//     family (+ RLE def/rep levels)
 //   * page types DATA_PAGE (v1), DATA_PAGE_V2, DICTIONARY_PAGE
-//   * codecs UNCOMPRESSED, SNAPPY (built-in decoder), GZIP (zlib)
+//   * codecs UNCOMPRESSED, SNAPPY (built-in decoder), GZIP (zlib), ZSTD
 
 #pragma once
 
@@ -47,21 +50,37 @@ struct ColumnData {
   int32_t type_length = 0;     // FIXED_LEN_BYTE_ARRAY width
   bool optional = false;
 
-  int64_t num_rows = 0;
-  // Fixed-width payload: one value per row, nulls zero-filled.
-  // BOOLEAN = 1 byte/row; INT32/FLOAT = 4; INT64/DOUBLE = 8;
+  int32_t max_def = 0;         // definition-level bound for this leaf
+  int32_t max_rep = 0;         // repetition-level bound (1 = inside a list)
+  bool is_nested = false;      // leaf sits under a group; compact storage
+
+  int64_t num_rows = 0;        // TOP-LEVEL rows (rep==0 entries)
+  int64_t n_levels = 0;        // level entries (== num_rows for non-list)
+  int64_t n_present = 0;       // values actually materialized (nested only)
+  // Flat leaves (max_def <= 1, max_rep == 0): one value per row, nulls
+  // zero-filled. BOOLEAN = 1 byte/row; INT32/FLOAT = 4; INT64/DOUBLE = 8;
   // FIXED_LEN_BYTE_ARRAY = type_length bytes/row (raw big-endian).
+  // Nested leaves: COMPACT present values only (n_present of them);
+  // row structure reconstructs from def/rep levels (Dremel assembly,
+  // done by the Python surface).
   std::vector<uint8_t> data;
-  // BYTE_ARRAY: offsets[num_rows+1] + chars; data stays empty.
+  // BYTE_ARRAY: offsets[n+1] + chars; data stays empty.
   std::vector<int32_t> offsets;
   std::vector<uint8_t> chars;
-  // 1 byte per row, 1 = valid. Empty = all rows valid.
+  // 1 byte per row, 1 = valid. Empty = all rows valid. (flat leaves only)
   std::vector<uint8_t> validity;
+  // Nested leaves only: one entry per level position.
+  std::vector<uint8_t> def_levels;
+  std::vector<uint8_t> rep_levels;  // only when max_rep > 0
 };
 
 struct ReadResult {
   int64_t num_rows = 0;
   std::vector<ColumnData> columns;
+  // preorder schema-tree dump (one "name\tnum_children\trepetition\t
+  // physical\tconverted\tscale\tprecision\ttype_length" line per element)
+  // for nested column assembly
+  std::string schema_desc;
 };
 
 struct RowGroupInfo {

@@ -261,6 +261,70 @@ int32_t tpudf_read_col_meta(int64_t handle, int32_t i, int32_t* meta,
   return 0;
 }
 
+// Extended metadata (nested-aware): meta = [physical, converted, scale,
+// precision, type_length, optional, has_validity, max_def, max_rep,
+// reserved] (10 int32s); sizes = [data_bytes, chars_bytes, num_rows,
+// n_levels, n_present] (5 int64s). num_rows counts TOP-LEVEL rows; nested
+// leaves carry compact values (n_present) plus n_levels def/rep entries.
+int32_t tpudf_read_col_meta2(int64_t handle, int32_t i, int32_t* meta,
+                             int64_t* sizes) {
+  auto r = reads().get(handle);
+  if (r == nullptr || i < 0 || i >= static_cast<int32_t>(r->columns.size())) {
+    set_error("invalid read handle or column index");
+    return -1;
+  }
+  auto const& c = r->columns[i];
+  meta[0] = c.physical;
+  meta[1] = c.converted;
+  meta[2] = c.scale;
+  meta[3] = c.precision;
+  meta[4] = c.type_length;
+  meta[5] = c.optional ? 1 : 0;
+  meta[6] = c.validity.empty() ? 0 : 1;
+  meta[7] = c.max_def;
+  meta[8] = c.max_rep;
+  meta[9] = c.is_nested ? 1 : 0;
+  sizes[0] = static_cast<int64_t>(c.data.size());
+  sizes[1] = static_cast<int64_t>(c.chars.size());
+  sizes[2] = c.num_rows;
+  sizes[3] = c.n_levels;
+  sizes[4] = c.n_present;
+  return 0;
+}
+
+// Copy out a nested leaf's levels: def_out = uint8[n_levels], rep_out =
+// uint8[n_levels] (may be null; required only when max_rep > 0).
+int32_t tpudf_read_col_levels(int64_t handle, int32_t i, uint8_t* def_out,
+                              uint8_t* rep_out) {
+  auto r = reads().get(handle);
+  if (r == nullptr || i < 0 || i >= static_cast<int32_t>(r->columns.size())) {
+    set_error("invalid read handle or column index");
+    return -1;
+  }
+  auto const& c = r->columns[i];
+  if (def_out != nullptr && !c.def_levels.empty()) {
+    std::memcpy(def_out, c.def_levels.data(), c.def_levels.size());
+  }
+  if (rep_out != nullptr && !c.rep_levels.empty()) {
+    std::memcpy(rep_out, c.rep_levels.data(), c.rep_levels.size());
+  }
+  return 0;
+}
+
+// Preorder schema-tree dump for nested assembly (tab-separated lines; see
+// parquet_reader.hpp). Thread-local copy, valid until this thread's next
+// call.
+char const* tpudf_read_schema_desc(int64_t handle) {
+  thread_local std::string desc_buf;
+  auto r = reads().get(handle);
+  if (r == nullptr) {
+    set_error("invalid read handle");
+    return nullptr;
+  }
+  desc_buf = r->schema_desc;
+  return desc_buf.c_str();
+}
+
 // Pointer to the column's name (NUL-terminated). The string is copied into
 // thread-local storage so a concurrent tpudf_read_close on another thread
 // cannot free it out from under the caller — valid until this thread's next

@@ -62,6 +62,7 @@ constexpr int16_t kPhDataHeaderV2 = 8;
 constexpr int16_t kDphNumValues = 1;
 constexpr int16_t kDphEncoding = 2;
 constexpr int16_t kDphDefLevelEncoding = 3;
+constexpr int16_t kDphRepLevelEncoding = 4;
 // DataPageHeaderV2
 constexpr int16_t kDph2NumValues = 1;
 constexpr int16_t kDph2NumNulls = 2;
@@ -256,6 +257,18 @@ std::vector<std::string> decode_delta_ba(uint8_t const* p, uint64_t len,
   return blobs;
 }
 
+int bits_for_level(int32_t max_level) {
+  int bw = 0;
+  while ((1 << bw) <= max_level) ++bw;
+  return bw;
+}
+
+uint32_t read_le32(uint8_t const* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
 // ---- RLE / bit-packed hybrid ----------------------------------------------
 
 // Decode up to `count` values from parquet's RLE/bit-packed hybrid format.
@@ -375,52 +388,136 @@ uint64_t decode_plain(uint8_t const* p, uint64_t len, int64_t n,
 // ---- column chunk decode --------------------------------------------------
 
 struct LeafInfo {
-  std::string name;
+  std::string name;  // dotted path from the root
   int32_t physical = 0;
   int32_t converted = -1;
   int32_t scale = 0;
   int32_t precision = 0;
   int32_t type_length = 0;
   bool optional = false;
+  int32_t max_def = 0;  // definition-level bound (0 = required flat leaf)
+  int32_t max_rep = 0;  // repetition-level bound (0 = no lists on the path)
+  bool nested = false;  // leaf sits under a group (struct/list ancestor)
 };
 
-std::vector<LeafInfo> parse_leaves(Value const& fmd) {
+struct SchemaInfo {
+  std::vector<LeafInfo> leaves;
+  // one line per schema element, preorder:
+  // "name\tnum_children\trepetition\tphysical\tconverted\tscale\t
+  //  precision\ttype_length" — the Python surface rebuilds the tree for
+  // nested column assembly from this
+  std::string desc;
+};
+
+void walk_schema(std::vector<Value> const& elems, uint64_t& idx,
+                 std::string const& prefix, int32_t def, int32_t rep,
+                 SchemaInfo& out) {
+  if (idx >= elems.size()) fail("schema tree shorter than declared");
+  auto const& se = elems[idx++];
+  auto const* nm = se.field(kSeName);
+  std::string name = nm ? nm->as_binary() : "";
+  int64_t n_children = field_i64_or(se, kSeNumChildren, 0);
+  // repetition: 0 REQUIRED, 1 OPTIONAL, 2 REPEATED
+  int64_t repetition = field_i64_or(se, kSeRepetition, 0);
+  if (repetition != 0) def += 1;  // optional and repeated add a def level
+  if (repetition == 2) rep += 1;
+  if (rep > 1) fail("nested lists (repetition depth > 1) are not supported");
+  int32_t physical = static_cast<int32_t>(field_i64_or(se, kSeType, -1));
+  int32_t converted = static_cast<int32_t>(field_i64_or(se, kSeConverted, -1));
+  int32_t scale = static_cast<int32_t>(field_i64_or(se, kSeScale, 0));
+  int32_t precision = static_cast<int32_t>(field_i64_or(se, kSePrecision, 0));
+  int32_t type_length =
+      static_cast<int32_t>(field_i64_or(se, kSeTypeLength, 0));
+  out.desc += name + "\t" + std::to_string(n_children) + "\t" +
+              std::to_string(repetition) + "\t" + std::to_string(physical) +
+              "\t" + std::to_string(converted) + "\t" +
+              std::to_string(scale) + "\t" + std::to_string(precision) +
+              "\t" + std::to_string(type_length) + "\n";
+  std::string path = prefix.empty() ? name : prefix + "." + name;
+  if (n_children == 0) {
+    LeafInfo li;
+    li.name = path;
+    li.physical = static_cast<int32_t>(field_i64(se, kSeType, "schema type"));
+    li.converted = converted;
+    li.scale = scale;
+    li.precision = precision;
+    li.type_length = type_length;
+    li.optional = repetition == 1;
+    li.max_def = def;
+    li.max_rep = rep;
+    // a top-level REPEATED leaf (legacy 1-level list) is nested
+    // too: its level entries are elements, not rows
+    li.nested = !prefix.empty() || rep > 0;
+    out.leaves.push_back(std::move(li));
+    return;
+  }
+  for (int64_t c = 0; c < n_children; ++c) {
+    walk_schema(elems, idx, path, def, rep, out);
+  }
+}
+
+SchemaInfo parse_schema(Value const& fmd) {
   auto const* schema = fmd.field(kFmdSchema);
   if (schema == nullptr || schema->elems.empty()) fail("missing schema");
   auto const& root = schema->elems[0];
   int64_t n_children = field_i64_or(root, kSeNumChildren, 0);
-  if (static_cast<uint64_t>(n_children) != schema->elems.size() - 1) {
-    fail("nested schemas are not supported yet (flat columns only)");
+  SchemaInfo out;
+  uint64_t idx = 1;
+  for (int64_t c = 0; c < n_children; ++c) {
+    walk_schema(schema->elems, idx, "", 0, 0, out);
   }
-  std::vector<LeafInfo> leaves;
-  for (uint64_t i = 1; i < schema->elems.size(); ++i) {
-    auto const& se = schema->elems[i];
-    if (field_i64_or(se, kSeNumChildren, 0) != 0) {
-      fail("nested schemas are not supported yet (flat columns only)");
-    }
-    LeafInfo li;
-    auto const* nm = se.field(kSeName);
-    li.name = nm ? nm->as_binary() : "";
-    li.physical = static_cast<int32_t>(field_i64(se, kSeType, "schema type"));
-    li.converted = static_cast<int32_t>(field_i64_or(se, kSeConverted, -1));
-    li.scale = static_cast<int32_t>(field_i64_or(se, kSeScale, 0));
-    li.precision = static_cast<int32_t>(field_i64_or(se, kSePrecision, 0));
-    li.type_length = static_cast<int32_t>(field_i64_or(se, kSeTypeLength, 0));
-    // repetition: 0 REQUIRED, 1 OPTIONAL, 2 REPEATED
-    int64_t rep = field_i64_or(se, kSeRepetition, 0);
-    if (rep == 2) fail("REPEATED fields are not supported yet");
-    li.optional = rep == 1;
-    leaves.push_back(std::move(li));
+  if (idx != schema->elems.size()) {
+    fail("schema tree longer than declared children");
   }
-  return leaves;
+  return out;
 }
 
 void append_values(ColumnData& col, LeafInfo const& leaf, int width,
                    std::vector<uint8_t> const& vals,
                    std::vector<std::string> const& blobs,
-                   std::vector<uint8_t> const& valid_bits, int64_t num_rows) {
+                   std::vector<uint8_t> const& valid_bits, int64_t num_rows,
+                   std::vector<uint32_t> const& defs,
+                   std::vector<uint32_t> const& reps) {
   bool const is_ba =
       static_cast<Physical>(leaf.physical) == Physical::BYTE_ARRAY;
+  bool const nested = leaf.nested;
+  if (nested) {
+    // Nested leaf: store COMPACT present values + the raw levels; row
+    // structure is reconstructed by Dremel assembly on the Python side.
+    int64_t top_rows = 0;
+    for (int64_t i = 0; i < num_rows; ++i) {
+      uint32_t d = defs.empty() ? static_cast<uint32_t>(leaf.max_def)
+                                : defs[i];
+      col.def_levels.push_back(static_cast<uint8_t>(d));
+      if (leaf.max_rep > 0) {
+        uint32_t r = reps.empty() ? 0 : reps[i];
+        col.rep_levels.push_back(static_cast<uint8_t>(r));
+        top_rows += r == 0;
+      } else {
+        top_rows += 1;
+      }
+    }
+    int64_t n_present = 0;
+    for (int64_t i = 0; i < num_rows; ++i) n_present += valid_bits[i];
+    if (is_ba) {
+      if (col.offsets.empty()) col.offsets.push_back(0);
+      for (auto const& b : blobs) {
+        int32_t last = col.offsets.back();
+        if (static_cast<uint64_t>(last) + b.size() > INT32_MAX) {
+          fail("string column exceeds 2^31 chars (reference-parity limit)");
+        }
+        col.chars.insert(col.chars.end(), b.begin(), b.end());
+        col.offsets.push_back(last + static_cast<int32_t>(b.size()));
+      }
+    } else {
+      col.data.insert(col.data.end(), vals.begin(),
+                      vals.begin() + n_present * width);
+    }
+    col.num_rows += top_rows;
+    col.n_levels += num_rows;
+    col.n_present += n_present;
+    return;
+  }
   // validity bookkeeping: materialize the byte mask lazily on first null
   bool has_nulls = false;
   for (int64_t i = 0; i < num_rows; ++i) {
@@ -463,6 +560,8 @@ void append_values(ColumnData& col, LeafInfo const& leaf, int width,
     }
   }
   col.num_rows += num_rows;
+  col.n_levels += num_rows;
+  col.n_present += num_rows;  // flat: every row materializes a value slot
 }
 
 void decode_chunk(uint8_t const* file, uint64_t file_len, Value const& chunk,
@@ -518,24 +617,42 @@ void decode_chunk(uint8_t const* file, uint64_t file_len, Value const& chunk,
       std::vector<uint8_t> bytes;   // decoded values section
       uint64_t vpos = 0;            // cursor into `bytes`
 
+      std::vector<uint32_t> reps;
+      int const def_bw = bits_for_level(leaf.max_def);
+      int const rep_bw = bits_for_level(leaf.max_rep);
       if (ptype == kPageData) {
         auto const* dh = ph.field(kPhDataHeader);
         if (dh == nullptr) fail("data page without header");
         page_values = field_i64(*dh, kDphNumValues, "num_values");
         enc = static_cast<int32_t>(field_i64(*dh, kDphEncoding, "encoding"));
         bytes = do_decompress(codec, file + body, comp_size, uncomp_size);
-        if (leaf.optional) {
+        // v1 layout: [rep levels][def levels][values], each level run
+        // length-prefixed (4 bytes LE) and RLE/bit-packed
+        if (leaf.max_rep > 0) {
+          int32_t renc = static_cast<int32_t>(
+              field_i64_or(*dh, kDphRepLevelEncoding, kEncRle));
+          if (renc != kEncRle) fail("repetition levels must be RLE-encoded");
+          if (bytes.size() < vpos + 4) fail("missing rep-level length");
+          uint32_t rl = read_le32(bytes.data() + vpos);
+          if (vpos + 4ull + rl > bytes.size()) {
+            fail("rep levels past end of page");
+          }
+          decode_rle_hybrid(bytes.data() + vpos + 4, rl, rep_bw,
+                            page_values, reps);
+          vpos += 4ull + rl;
+        }
+        if (leaf.max_def > 0) {
           int32_t denc = static_cast<int32_t>(
               field_i64_or(*dh, kDphDefLevelEncoding, kEncRle));
           if (denc != kEncRle) fail("definition levels must be RLE-encoded");
-          if (bytes.size() < 4) fail("missing def-level length");
-          uint32_t dl = static_cast<uint32_t>(bytes[0]) |
-                        (static_cast<uint32_t>(bytes[1]) << 8) |
-                        (static_cast<uint32_t>(bytes[2]) << 16) |
-                        (static_cast<uint32_t>(bytes[3]) << 24);
-          if (4ull + dl > bytes.size()) fail("def levels past end of page");
-          decode_rle_hybrid(bytes.data() + 4, dl, 1, page_values, defs);
-          vpos = 4ull + dl;
+          if (bytes.size() < vpos + 4) fail("missing def-level length");
+          uint32_t dl = read_le32(bytes.data() + vpos);
+          if (vpos + 4ull + dl > bytes.size()) {
+            fail("def levels past end of page");
+          }
+          decode_rle_hybrid(bytes.data() + vpos + 4, dl, def_bw,
+                            page_values, defs);
+          vpos += 4ull + dl;
         }
       } else {
         auto const* dh = ph.field(kPhDataHeaderV2);
@@ -544,35 +661,43 @@ void decode_chunk(uint8_t const* file, uint64_t file_len, Value const& chunk,
         enc = static_cast<int32_t>(field_i64(*dh, kDph2Encoding, "encoding"));
         int64_t rep_len = field_i64_or(*dh, kDph2RepLevelsByteLen, 0);
         int64_t def_len = field_i64_or(*dh, kDph2DefLevelsByteLen, 0);
-        if (rep_len != 0) fail("repetition levels unsupported (flat only)");
         // is_compressed is a thrift BOOL (carried in Value::b, not ::i)
         auto const* ic = dh->field(kDph2IsCompressed);
         bool compressed =
             ic == nullptr || ic->b ||
             ic->type == thrift::WireType::BOOL_TRUE;
-        // v2: levels are NEVER compressed and sit before the data section
-        if (def_len > comp_size) fail("v2 def levels longer than page");
-        if (leaf.optional && def_len > 0) {
-          decode_rle_hybrid(file + body, def_len, 1, page_values, defs);
+        // v2: levels are NEVER compressed, sit before the data section
+        // (rep first, then def), and carry no length prefix
+        if (rep_len + def_len > comp_size) {
+          fail("v2 level sections longer than page");
         }
-        uint64_t data_comp = comp_size - def_len;
-        uint64_t data_uncomp = uncomp_size - def_len;
+        if (leaf.max_rep > 0 && rep_len > 0) {
+          decode_rle_hybrid(file + body, rep_len, rep_bw, page_values, reps);
+        }
+        if (leaf.max_def > 0 && def_len > 0) {
+          decode_rle_hybrid(file + body + rep_len, def_len, def_bw,
+                            page_values, defs);
+        }
+        uint64_t lvl = static_cast<uint64_t>(rep_len + def_len);
+        uint64_t data_comp = comp_size - lvl;
+        uint64_t data_uncomp = uncomp_size - lvl;
         if (compressed) {
-          bytes = do_decompress(codec, file + body + def_len, data_comp,
+          bytes = do_decompress(codec, file + body + lvl, data_comp,
                                 data_uncomp);
         } else {
-          bytes.assign(file + body + def_len,
-                       file + body + def_len + data_comp);
+          bytes.assign(file + body + lvl, file + body + lvl + data_comp);
         }
+        vpos = 0;
       }
 
-      // validity for this page (flat: def level 1 = present)
+      // present values: def level == max_def (flat optional: def != 0)
       std::vector<uint8_t> valid(page_values, 1);
       int64_t n_present = page_values;
-      if (leaf.optional && !defs.empty()) {
+      if (leaf.max_def > 0 && !defs.empty()) {
         n_present = 0;
         for (int64_t i = 0; i < page_values; ++i) {
-          valid[i] = defs[i] != 0;
+          valid[i] =
+              defs[i] == static_cast<uint32_t>(leaf.max_def) ? 1 : 0;
           n_present += valid[i];
         }
       }
@@ -636,7 +761,8 @@ void decode_chunk(uint8_t const* file, uint64_t file_len, Value const& chunk,
              "DELTA_BINARY_PACKED, DELTA_LENGTH_BYTE_ARRAY, "
              "DELTA_BYTE_ARRAY)");
       }
-      append_values(col, leaf, width, vals, blobs, valid, page_values);
+      append_values(col, leaf, width, vals, blobs, valid, page_values,
+                    defs, reps);
       values_seen += page_values;
     } else {
       // index pages etc.: skip
@@ -736,7 +862,7 @@ std::vector<RowGroupInfo> row_group_infos(uint8_t const* file, uint64_t len) {
 std::vector<std::string> column_names(uint8_t const* file, uint64_t len) {
   Value fmd = parse_footer(file, len);
   std::vector<std::string> out;
-  for (auto const& leaf : parse_leaves(fmd)) out.push_back(leaf.name);
+  for (auto const& leaf : parse_schema(fmd).leaves) out.push_back(leaf.name);
   return out;
 }
 
@@ -744,7 +870,8 @@ ReadResult read_file(uint8_t const* file, uint64_t len,
                      std::optional<std::vector<int32_t>> const& column_indices,
                      std::optional<std::vector<int32_t>> const& row_group_indices) {
   Value fmd = parse_footer(file, len);
-  auto leaves = parse_leaves(fmd);
+  auto schema = parse_schema(fmd);
+  auto& leaves = schema.leaves;
   auto const* rgs = fmd.field(kFmdRowGroups);
   uint64_t n_rgs = rgs == nullptr ? 0 : rgs->elems.size();
 
@@ -766,6 +893,7 @@ ReadResult read_file(uint8_t const* file, uint64_t len,
   }
 
   ReadResult res;
+  res.schema_desc = schema.desc;
   for (int32_t c : cols) {
     if (c < 0 || static_cast<uint64_t>(c) >= leaves.size()) {
       fail("column index out of range");
@@ -779,6 +907,9 @@ ReadResult read_file(uint8_t const* file, uint64_t len,
     col.precision = leaf.precision;
     col.type_length = leaf.type_length;
     col.optional = leaf.optional;
+    col.max_def = leaf.max_def;
+    col.max_rep = leaf.max_rep;
+    col.is_nested = leaf.nested;
     res.columns.push_back(std::move(col));
   }
 

@@ -169,3 +169,72 @@ class TestDecimal128OpBoundaries:
 
         out = sort_table(self._col(), [1], ascending=[False])
         assert out.column(0).to_pylist() == [5, -(1 << 70), 1 << 70]
+
+
+class TestNested:
+    def test_struct_of_primitives(self, rng):
+        n = 500
+        a = [int(v) if i % 6 else None
+             for i, v in enumerate(rng.integers(0, 1000, n))]
+        b = [f"s{i}" for i in range(n)]
+        structs = [
+            None if i % 11 == 0 else {"a": a[i], "b": b[i]}
+            for i in range(n)
+        ]
+        arr = pa.array(structs, type=pa.struct(
+            [("a", pa.int64()), ("b", pa.string())]))
+        data = write_bytes(pa.table({"s": arr, "flat": pa.array(range(n))}))
+        tbl = read_table(data)
+        got = tbl.column(0).to_pylist()
+        want = [
+            None if s is None else (s["a"], s["b"]) for s in structs
+        ]
+        assert got == want
+        assert tbl.column(1).to_pylist() == list(range(n))
+
+    def test_nested_struct_of_struct(self):
+        vals = [
+            {"inner": {"x": 1}, "y": 10},
+            {"inner": None, "y": 20},
+            None,
+            {"inner": {"x": None}, "y": None},
+        ]
+        typ = pa.struct([
+            ("inner", pa.struct([("x", pa.int32())])),
+            ("y", pa.int64()),
+        ])
+        data = write_bytes(pa.table({"s": pa.array(vals, type=typ)}))
+        got = read_table(data).column(0).to_pylist()
+        assert got == [((1,), 10), (None, 20), None, ((None,), None)]
+
+    def test_list_of_ints(self, rng):
+        lists = [[1, 2, 3], [], None, [4], [None, 5], list(range(50))]
+        arr = pa.array(lists, type=pa.list_(pa.int64()))
+        data = write_bytes(pa.table({"l": arr}))
+        got = read_table(data).column(0).to_pylist()
+        assert got == lists
+
+    def test_list_of_strings(self):
+        lists = [["a", "bb"], None, [], ["", None, "xyz"]]
+        arr = pa.array(lists, type=pa.list_(pa.string()))
+        data = write_bytes(pa.table({"l": arr}))
+        got = read_table(data).column(0).to_pylist()
+        assert got == lists
+
+    def test_list_multi_row_group(self, rng):
+        lists = [
+            None if i % 17 == 0 else
+            [int(v) for v in rng.integers(0, 100, int(rng.integers(0, 6)))]
+            for i in range(3000)
+        ]
+        arr = pa.array(lists, type=pa.list_(pa.int32()))
+        data = write_bytes(pa.table({"l": arr}), row_group_size=512)
+        got = read_table(data).column(0).to_pylist()
+        assert got == lists
+
+    def test_list_of_struct_rejected_cleanly(self):
+        arr = pa.array([[{"x": 1}], None],
+                       type=pa.list_(pa.struct([("x", pa.int32())])))
+        data = write_bytes(pa.table({"l": arr}))
+        with pytest.raises(NotImplementedError, match="struct elements"):
+            read_table(data)
