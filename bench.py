@@ -105,6 +105,10 @@ def _table_digest(table):
         acc = acc + jnp.sum(c.valid_mask()).astype(jnp.float64)
         if c.chars is not None:  # string payloads must stay reachable too
             acc = acc + jnp.sum(c.chars).astype(jnp.float64)
+        if c.children:  # nested payloads (LIST/STRUCT) likewise
+            class _T:  # minimal table shim for recursion
+                columns = c.children
+            acc = acc + _table_digest(_T)
     return acc
 
 

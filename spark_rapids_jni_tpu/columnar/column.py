@@ -38,6 +38,8 @@ class Column:
     children: Optional[list] = None
 
     def __post_init__(self) -> None:
+        if self.validity is not None and self.validity.dtype != jnp.bool_:
+            raise TypeError("validity must be bool")
         if self.dtype.type_id == TypeId.LIST:
             if not self.children or len(self.children) != 1:
                 raise ValueError("LIST column requires exactly one child")
@@ -67,8 +69,6 @@ class Column:
                     f"column data dtype {self.data.dtype} != storage dtype "
                     f"{expect} for {self.dtype}"
                 )
-        if self.validity is not None and self.validity.dtype != jnp.bool_:
-            raise TypeError("validity must be bool")
 
     @property
     def is_padded_string(self) -> bool:

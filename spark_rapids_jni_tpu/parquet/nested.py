@@ -52,14 +52,29 @@ class SchemaNode:
         return self.num_children == 0
 
 
+def _unescape_name(s: str) -> str:
+    """Inverse of the reader's dump escaping (\\t, \\n, \\\\ in field names)."""
+    out = []
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append({"t": "\t", "n": "\n", "\\": "\\"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
 def parse_schema_desc(desc: str) -> list[SchemaNode]:
     """Rebuild the top-level fields from the reader's preorder dump."""
     lines = [ln for ln in desc.split("\n") if ln]
     nodes = []
     for ln in lines:
-        parts = ln.split("\t")
+        parts = ln.rsplit("\t", 7)  # name is escaped; split from the right
         nodes.append(SchemaNode(
-            name=parts[0], num_children=int(parts[1]),
+            name=_unescape_name(parts[0]), num_children=int(parts[1]),
             repetition=int(parts[2]), physical=int(parts[3]),
             converted=int(parts[4]), scale=int(parts[5]),
             precision=int(parts[6]), type_length=int(parts[7]),

@@ -1,0 +1,7 @@
+// Intentionally empty translation unit. Role parity with the reference's
+// emptyfile.cpp (reference src/main/cpp/src/emptyfile.cpp, used at
+// CMakeLists.txt:189-195): stub shared libraries built from this file do
+// nothing except dynamically link the real engine, so consumers that load
+// the old library names keep working (the reference ships a fat lib
+// deliberately NAMED libcudf.so plus libcudfjni.so stubs for drop-in
+// compatibility with the cudf Java bindings).

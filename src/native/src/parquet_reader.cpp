@@ -409,9 +409,14 @@ struct SchemaInfo {
   std::string desc;
 };
 
+constexpr int32_t kMaxSchemaDepth = 64;  // anti-bomb cap (cf. the thrift
+                                         // string/container caps); also
+                                         // keeps def levels inside uint8
+
 void walk_schema(std::vector<Value> const& elems, uint64_t& idx,
                  std::string const& prefix, int32_t def, int32_t rep,
-                 SchemaInfo& out) {
+                 int32_t depth, SchemaInfo& out) {
+  if (depth > kMaxSchemaDepth) fail("schema nesting deeper than 64 levels");
   if (idx >= elems.size()) fail("schema tree shorter than declared");
   auto const& se = elems[idx++];
   auto const* nm = se.field(kSeName);
@@ -428,7 +433,14 @@ void walk_schema(std::vector<Value> const& elems, uint64_t& idx,
   int32_t precision = static_cast<int32_t>(field_i64_or(se, kSePrecision, 0));
   int32_t type_length =
       static_cast<int32_t>(field_i64_or(se, kSeTypeLength, 0));
-  out.desc += name + "\t" + std::to_string(n_children) + "\t" +
+  std::string esc_name;
+  for (char ch : name) {  // tab/newline are legal in parquet field names
+    if (ch == '\\') esc_name += "\\\\";
+    else if (ch == '\t') esc_name += "\\t";
+    else if (ch == '\n') esc_name += "\\n";
+    else esc_name += ch;
+  }
+  out.desc += esc_name + "\t" + std::to_string(n_children) + "\t" +
               std::to_string(repetition) + "\t" + std::to_string(physical) +
               "\t" + std::to_string(converted) + "\t" +
               std::to_string(scale) + "\t" + std::to_string(precision) +
@@ -452,7 +464,7 @@ void walk_schema(std::vector<Value> const& elems, uint64_t& idx,
     return;
   }
   for (int64_t c = 0; c < n_children; ++c) {
-    walk_schema(elems, idx, path, def, rep, out);
+    walk_schema(elems, idx, path, def, rep, depth + 1, out);
   }
 }
 
@@ -464,7 +476,7 @@ SchemaInfo parse_schema(Value const& fmd) {
   SchemaInfo out;
   uint64_t idx = 1;
   for (int64_t c = 0; c < n_children; ++c) {
-    walk_schema(schema->elems, idx, "", 0, 0, out);
+    walk_schema(schema->elems, idx, "", 0, 0, 1, out);
   }
   if (idx != schema->elems.size()) {
     fail("schema tree longer than declared children");
@@ -661,6 +673,9 @@ void decode_chunk(uint8_t const* file, uint64_t file_len, Value const& chunk,
         enc = static_cast<int32_t>(field_i64(*dh, kDph2Encoding, "encoding"));
         int64_t rep_len = field_i64_or(*dh, kDph2RepLevelsByteLen, 0);
         int64_t def_len = field_i64_or(*dh, kDph2DefLevelsByteLen, 0);
+        // signed thrift i32s: a crafted negative length would pass the sum
+        // bound below and wrap the unsigned cursor arithmetic
+        if (rep_len < 0 || def_len < 0) fail("negative v2 level length");
         // is_compressed is a thrift BOOL (carried in Value::b, not ::i)
         auto const* ic = dh->field(kDph2IsCompressed);
         bool compressed =
