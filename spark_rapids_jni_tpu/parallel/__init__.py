@@ -10,17 +10,29 @@ key ranges each chip owns afterward.
 """
 
 from spark_rapids_jni_tpu.parallel.mesh import executor_mesh, EXEC_AXIS
-from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle, ShuffleResult
+from spark_rapids_jni_tpu.parallel.shuffle import (
+    ShuffleResult,
+    hash_shuffle,
+    shuffle_by_partition,
+)
 from spark_rapids_jni_tpu.parallel.distributed import (
     distributed_groupby_aggregate,
+    distributed_join,
     shard_table,
 )
+from spark_rapids_jni_tpu.parallel.sort import distributed_sort
+from spark_rapids_jni_tpu.parallel.wire import BitPack, shuffle_wire_bytes
 
 __all__ = [
+    "BitPack",
     "EXEC_AXIS",
     "ShuffleResult",
     "distributed_groupby_aggregate",
+    "distributed_join",
+    "distributed_sort",
     "executor_mesh",
     "hash_shuffle",
     "shard_table",
+    "shuffle_by_partition",
+    "shuffle_wire_bytes",
 ]

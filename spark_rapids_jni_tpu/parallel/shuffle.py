@@ -114,12 +114,27 @@ def hash_shuffle(
     shipped, and never count as overflow. Distinct from column validity — a
     real row with NULL key still shuffles (to the null-hash partition).
     """
+    part = partition_hash(table, list(keys), jax.lax.axis_size(axis_name))
+    return shuffle_by_partition(table, part, axis_name, capacity=capacity,
+                                row_valid=row_valid, wire_dtypes=wire_dtypes)
+
+
+@func_range("shuffle_by_partition")
+def shuffle_by_partition(
+    table: Table,
+    part: jnp.ndarray,
+    axis_name: str,
+    capacity: Optional[int] = None,
+    row_valid: Optional[jnp.ndarray] = None,
+    wire_dtypes: Optional[Sequence] = None,
+) -> ShuffleResult:
+    """Exchange rows by a caller-computed partition id (int32[n] in [0, D)).
+    ``hash_shuffle`` routes by key hash; range shuffles (distributed sort)
+    route by splitter bucket — same transport, different ``part``."""
     D = jax.lax.axis_size(axis_name)
     n = table.num_rows
     if capacity is None:
         capacity = max(1, math.ceil(n / D) * 2)
-
-    part = partition_hash(table, list(keys), D)  # int32[n], in [0, D)
 
     # Sort rows by destination partition; compute each row's slot within
     # its partition run. Stable sort keeps within-partition input order.

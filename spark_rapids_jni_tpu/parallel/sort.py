@@ -1,0 +1,150 @@
+"""Distributed ORDER BY — range-partitioned global sort over the executor
+mesh, the Spark `RangePartitioner` + per-partition sort pattern (the engine
+half belongs to this layer; cuDF provides the per-partition sort the same
+way, capability surface SURVEY.md section 2.2).
+
+TPU-first shape: splitters are planned on HOST from the key sample (range
+boundaries are planning metadata, like shuffle capacities), then the mesh
+program is fully static — every row's destination is one ``searchsorted``
+over the splitter vector, the exchange is the same all_to_all transport as
+the hash shuffle (``shuffle_by_partition``), and each device finishes with
+a local ``sort_table``. Concatenating device partitions in mesh order IS
+the global order; ties on the primary key stay co-located (searchsorted
+buckets equal values together), so secondary keys order exactly.
+
+Fixed-width primary keys this round; a string primary key needs multi-word
+splitter comparison and raises NotImplementedError.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.sort import _as_unsigned_key, sort_table
+from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+from spark_rapids_jni_tpu.parallel.shuffle import shuffle_by_partition
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+def _encode_primary(col: Column) -> jnp.ndarray:
+    """Order-preserving unsigned encoding of the primary sort key; nulls
+    encode below every valid value (nulls-first order)."""
+    if col.dtype.is_string:
+        raise NotImplementedError(
+            "distributed_sort on a STRING primary key is not supported yet"
+        )
+    if col.dtype.storage_dtype == np.float64:
+        # route on the float32 truncation: order-preserving bucketing only
+        # (exact order is restored by the local sort's full-precision keys)
+        enc32 = _as_unsigned_key(
+            col.data.astype(jnp.float32), _F32
+        ).astype(jnp.uint64)
+        enc = enc32 << jnp.uint64(32)
+    else:
+        enc = _as_unsigned_key(col.data, col.dtype).astype(jnp.uint64)
+        bits = col.dtype.storage_dtype.itemsize * 8
+        if bits < 64:
+            enc = enc << jnp.uint64(64 - bits)
+    # shift into [1, 2^64): 0 is reserved for nulls
+    enc = jnp.maximum(enc >> jnp.uint64(1), jnp.uint64(1))
+    return jnp.where(col.valid_mask(), enc, jnp.uint64(0))
+
+
+class _F32:  # minimal DType stand-in for the float32 encoding path
+    storage_dtype = np.dtype(np.float32)
+
+
+def plan_splitters(table: Table, key: int, num_partitions: int,
+                   sample_size: int = 65536) -> np.ndarray:
+    """Host-side range planning: quantiles of a BOUNDED strided sample of
+    the encoded primary key -> ``num_partitions - 1`` ascending splitters
+    (uint64). Sampling caps the device->host transfer the way Spark's
+    RangePartitioner bounds its per-partition sample — quantiles of a 64k
+    sample match full-column quantiles to well under one partition width."""
+    col = table.column(key)
+    n = col.size
+    if n == 0:
+        return np.zeros(max(num_partitions - 1, 0), dtype=np.uint64)
+    if n > sample_size:
+        idx = jnp.asarray(
+            np.linspace(0, n - 1, sample_size).astype(np.int64)
+        )
+        col = Column(col.dtype, col.data[idx],
+                     None if col.validity is None else col.validity[idx])
+    enc = np.asarray(_encode_primary(col))
+    qs = np.linspace(0, 1, num_partitions + 1)[1:-1]
+    return np.quantile(enc, qs, method="nearest").astype(np.uint64)
+
+
+class DistributedSort(NamedTuple):
+    table: Table             # per-device sorted partitions, mesh order
+    num_rows: jnp.ndarray    # int64[D] real rows per device
+    overflowed: jnp.ndarray  # bool[D] range-shuffle capacity overflow
+
+
+@func_range("distributed_sort")
+def distributed_sort(
+    table: Table,
+    keys: Sequence[int],
+    mesh,
+    ascending: Sequence[bool] | None = None,
+    capacity: Optional[int] = None,
+    row_valid: Optional[jnp.ndarray] = None,
+    splitters: Optional[np.ndarray] = None,
+) -> DistributedSort:
+    """Global multi-key sort: range-shuffle by the primary key, then local
+    sort per device. ``table`` must already be sharded over ``mesh``
+    (shard_table); pass its ``row_valid`` so padding rows drop before the
+    exchange. Device d's partition holds the d-th ascending key range, so
+    ``collect(...)`` concatenation is globally ordered.
+
+    ``ascending[0]`` False is handled by reversing the device ranges at
+    collect time being insufficient — this round requires ascending primary
+    order (descending composes by reversing the collected result when all
+    keys descend)."""
+    keys = list(keys)
+    if ascending is not None and not all(ascending):
+        raise NotImplementedError(
+            "distributed_sort is ascending-only this round; reverse the "
+            "collected result for all-descending orders"
+        )
+    d = mesh.shape[EXEC_AXIS]
+    if splitters is None:
+        splitters = plan_splitters(table, keys[0], d)
+    spl = jnp.asarray(np.asarray(splitters, dtype=np.uint64))
+    if row_valid is None:
+        row_valid = jnp.ones((table.num_rows,), jnp.bool_)
+
+    def step(local: Table, rv):
+        enc = _encode_primary(local.column(keys[0]))
+        part = jnp.searchsorted(spl, enc, side="right").astype(jnp.int32)
+        sh = shuffle_by_partition(local, part, EXEC_AXIS, capacity=capacity,
+                                  row_valid=rv)
+        # local sort with the occupancy mask as the MOST significant key
+        # (descending: real rows first) so phantom slots can never
+        # interleave with real null-key rows; the user keys keep Spark's
+        # default nulls-first order among real rows
+        from spark_rapids_jni_tpu import types as t
+
+        mask_col = Column(t.UINT8, sh.row_valid.astype(jnp.uint8))
+        aug = Table([mask_col] + list(sh.table.columns))
+        ordered = sort_table(
+            aug, [0] + [k + 1 for k in keys],
+            ascending=[False] + [True] * len(keys),
+        )
+        ordered = Table(ordered.columns[1:])
+        n_real = jnp.sum(sh.row_valid.astype(jnp.int64))
+        return ordered, n_real.reshape(1), sh.overflowed.reshape(1)
+
+    out, n_real, ovf = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+    )(table, row_valid)
+    return DistributedSort(out, n_real, ovf)

@@ -25,6 +25,7 @@ Parquet vectorized reader:
 from __future__ import annotations
 
 import ctypes
+import os
 from typing import Iterator, Optional, Sequence
 
 import jax.numpy as jnp
@@ -141,14 +142,19 @@ def _i32_array(vals: Optional[Sequence[int]]):
     return arr, len(vals)
 
 
-def row_group_info(data: bytes) -> list[tuple[int, int]]:
-    """[(num_rows, byte_size)] per row group — the chunk-planning probe."""
+def row_group_info(data: "bytes | str | os.PathLike") -> list[tuple[int, int]]:
+    """[(num_rows, byte_size)] per row group — the chunk-planning probe.
+    Accepts in-memory bytes or a path (mmap; only footer pages fault in)."""
     lib = load_native()
     cap = 4096
     while True:
         nr = (ctypes.c_int64 * cap)()
         bs = (ctypes.c_int64 * cap)()
-        n = lib.tpudf_parquet_row_groups(data, len(data), nr, bs, cap)
+        if isinstance(data, (str, os.PathLike)):
+            n = lib.tpudf_parquet_row_groups_path(
+                os.fsencode(data), nr, bs, cap)
+        else:
+            n = lib.tpudf_parquet_row_groups(data, len(data), nr, bs, cap)
         _check(lib, n >= 0, "row_group_info")
         if n <= cap:
             return [(nr[i], bs[i]) for i in range(n)]
@@ -297,15 +303,28 @@ def _read_nested(lib, handle: int, tree) -> Table:
 
 @func_range("parquet_read_table")
 def read_table(
-    data: bytes,
+    data: "bytes | str | os.PathLike",
     columns: Optional[Sequence[int]] = None,
     row_groups: Optional[Sequence[int]] = None,
 ) -> Table:
-    """Decode a complete in-memory Parquet file into a device Table."""
+    """Decode a Parquet file into a device Table.
+
+    ``data`` may be in-memory bytes OR a filesystem path: paths decode
+    through a native mmap (the cuFile/GDS-role storage path, reference
+    CMakeLists.txt:200-222) — only the byte ranges of the selected row
+    groups are ever faulted in, so chunked reads of large files never
+    materialize the file through Python."""
     lib = load_native()
     cols, n_cols = _i32_array(columns)
     rgs, n_rgs = _i32_array(row_groups)
-    handle = lib.tpudf_parquet_read(data, len(data), cols, n_cols, rgs, n_rgs)
+    if isinstance(data, (str, os.PathLike)):
+        handle = lib.tpudf_parquet_read_path(
+            os.fsencode(data), cols, n_cols, rgs, n_rgs
+        )
+    else:
+        handle = lib.tpudf_parquet_read(
+            data, len(data), cols, n_cols, rgs, n_rgs
+        )
     _check(lib, handle != 0, "parquet read")
     try:
         n_columns = lib.tpudf_read_num_columns(handle)

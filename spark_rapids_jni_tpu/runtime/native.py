@@ -93,6 +93,21 @@ class NativeLib:
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int64),
         ]
+        c.tpudf_parquet_read_path.restype = ctypes.c_int64
+        c.tpudf_parquet_read_path.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        c.tpudf_parquet_row_groups_path.restype = ctypes.c_int32
+        c.tpudf_parquet_row_groups_path.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+        ]
         c.tpudf_read_col_meta2.restype = ctypes.c_int32
         c.tpudf_read_col_meta2.argtypes = [
             ctypes.c_int64,

@@ -1,0 +1,67 @@
+// Read-only mmap wrapper — the storage->decode half of the cuFile/GDS role
+// (reference CMakeLists.txt:200-222): the decoder reads pages directly out
+// of the page cache instead of a caller-materialized buffer, so chunked
+// reads of large files touch only the byte ranges they decode.
+
+#pragma once
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+namespace tpudf {
+
+class MappedFile {
+ public:
+  explicit MappedFile(char const* path) {
+    int fd = ::open(path, O_RDONLY | O_CLOEXEC);
+    if (fd < 0) {
+      throw std::runtime_error(std::string("open ") + path + ": " +
+                               std::strerror(errno));
+    }
+    struct stat st;
+    if (::fstat(fd, &st) != 0) {
+      int e = errno;
+      ::close(fd);
+      throw std::runtime_error(std::string("fstat ") + path + ": " +
+                               std::strerror(e));
+    }
+    size_ = static_cast<uint64_t>(st.st_size);
+    if (size_ > 0) {
+      void* p = ::mmap(nullptr, size_, PROT_READ, MAP_PRIVATE, fd, 0);
+      if (p == MAP_FAILED) {
+        int e = errno;
+        ::close(fd);
+        throw std::runtime_error(std::string("mmap ") + path + ": " +
+                                 std::strerror(e));
+      }
+      data_ = static_cast<uint8_t const*>(p);
+    }
+    ::close(fd);  // the mapping outlives the descriptor
+  }
+
+  MappedFile(MappedFile const&) = delete;
+  MappedFile& operator=(MappedFile const&) = delete;
+
+  ~MappedFile() {
+    if (data_ != nullptr) {
+      ::munmap(const_cast<uint8_t*>(data_), size_);
+    }
+  }
+
+  uint8_t const* data() const { return data_; }
+  uint64_t size() const { return size_; }
+
+ private:
+  uint8_t const* data_ = nullptr;
+  uint64_t size_ = 0;
+};
+
+}  // namespace tpudf

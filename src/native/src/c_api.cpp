@@ -21,6 +21,7 @@
 #include <vector>
 
 #include "tpudf/get_json_object.hpp"
+#include "tpudf/mapped_file.hpp"
 #include "tpudf/orc_reader.hpp"
 #include "tpudf/parquet_footer.hpp"
 #include "tpudf/parquet_reader.hpp"
@@ -197,6 +198,48 @@ int64_t tpudf_parquet_read(uint8_t const* buf, uint64_t len,
   } catch (std::exception const& e) {
     set_error(e.what());
     return 0;
+  }
+}
+
+// Storage->decode path without host-visible materialization: mmap the file
+// read-only and decode selected columns/row groups straight out of the
+// mapping — the cuFile/GDS role (reference CMakeLists.txt:200-222: a direct
+// storage->device staging path that bypasses caller-managed buffers). The
+// page cursor touches only the byte ranges of the requested chunks, so a
+// chunked read of a large file never faults in the rest.
+int64_t tpudf_parquet_read_path(char const* path, int32_t const* cols,
+                                int32_t n_cols, int32_t const* rgs,
+                                int32_t n_rgs) {
+  try {
+    tpudf::MappedFile map(path);  // RAII mmap; throws with errno detail
+    std::optional<std::vector<int32_t>> col_vec;
+    if (cols != nullptr) col_vec.emplace(cols, cols + n_cols);
+    std::optional<std::vector<int32_t>> rg_vec;
+    if (rgs != nullptr) rg_vec.emplace(rgs, rgs + n_rgs);
+    auto res = std::make_shared<tpudf::parquet::ReadResult>(
+        tpudf::parquet::read_file(map.data(), map.size(), col_vec, rg_vec));
+    return reads().put(std::move(res));
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return 0;
+  }
+}
+
+// Row-group probe over a file path (mmap; footer pages only are touched).
+int32_t tpudf_parquet_row_groups_path(char const* path, int64_t* num_rows,
+                                      int64_t* byte_size, int32_t cap) {
+  try {
+    tpudf::MappedFile map(path);
+    auto infos = tpudf::parquet::row_group_infos(map.data(), map.size());
+    for (int32_t i = 0; i < cap && i < static_cast<int32_t>(infos.size());
+         ++i) {
+      num_rows[i] = infos[i].num_rows;
+      byte_size[i] = infos[i].total_byte_size;
+    }
+    return static_cast<int32_t>(infos.size());
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
   }
 }
 

@@ -238,3 +238,42 @@ class TestNested:
         data = write_bytes(pa.table({"l": arr}))
         with pytest.raises(NotImplementedError, match="struct elements"):
             read_table(data)
+
+
+class TestPathReads:
+    def test_read_table_from_path(self, rng, tmp_path):
+        n = 5000
+        ints = rng.integers(-(10**9), 10**9, n)
+        strs = [f"p{i}" if i % 5 else None for i in range(n)]
+        f = tmp_path / "data.parquet"
+        pq.write_table(
+            pa.table({"a": pa.array(ints), "s": pa.array(strs)}),
+            f, compression="zstd",
+        )
+        tbl = read_table(str(f))
+        assert tbl.column(0).to_pylist() == [int(v) for v in ints]
+        assert tbl.column(1).to_pylist() == strs
+
+    def test_chunked_reader_from_path(self, rng, tmp_path):
+        from spark_rapids_jni_tpu.parquet.reader import ParquetChunkedReader
+
+        n = 4000
+        f = tmp_path / "chunked.parquet"
+        pq.write_table(
+            pa.table({"v": pa.array(rng.integers(0, 100, n))}),
+            f, row_group_size=512,
+        )
+        rdr = ParquetChunkedReader(str(f), chunk_read_limit=1)
+        total, chunks = 0, 0
+        while rdr.has_next():
+            t_ = rdr.read_chunk()
+            total += t_.num_rows
+            chunks += 1
+        assert total == n
+        assert chunks == (n + 511) // 512  # one row group per chunk
+
+    def test_missing_path_errors_cleanly(self):
+        from spark_rapids_jni_tpu.parquet.footer import NativeError
+
+        with pytest.raises(NativeError, match="open"):
+            read_table("/nonexistent/file.parquet")
