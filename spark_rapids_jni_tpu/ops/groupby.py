@@ -202,6 +202,41 @@ def groupby_aggregate(
                 )
             plan.append((op, c, None, None, count_lane))
 
+    _string_order_cache: dict = {}  # value-sort order per column, shared
+                                    # between a column's min and max aggs
+
+    def _string_minmax(c: Column, op: str, vcount: jnp.ndarray) -> Column:
+        """MIN/MAX of a string column: rank rows by string order (one sort
+        of the value column), segment-reduce the int ranks, gather the
+        winning row's string — order statistics via ranks instead of
+        per-group byte comparisons."""
+        if n == 0:
+            return Column(c.dtype, jnp.zeros((m,), jnp.int32),
+                          jnp.zeros((m,), jnp.bool_),
+                          chars=jnp.zeros((m, 1), jnp.uint8))
+        cache_key = id(c)
+        if cache_key not in _string_order_cache:
+            _string_order_cache[cache_key] = sort_order(
+                Table([c]), [0], nulls_first=[False]  # nulls last
+            )
+        order_v = _string_order_cache[cache_key]
+        rank = jnp.zeros((n,), jnp.int32).at[order_v].set(
+            jnp.arange(n, dtype=jnp.int32)
+        )
+        # null values never win: give them the worst rank for the op
+        sentinel = jnp.int32(n if op == "min" else -1)
+        rank = jnp.where(c.valid_mask(), rank, sentinel)
+        if op == "min":
+            best = jnp.full((m,), n, jnp.int32).at[group_id].min(rank)
+        else:
+            best = jnp.full((m,), -1, jnp.int32).at[group_id].max(rank)
+        has_any = vcount > 0
+        winner_row = order_v[jnp.clip(best, 0, max(n - 1, 0))]
+        from spark_rapids_jni_tpu.ops import strings as s
+
+        g = s.gather_strings(c, winner_row)
+        return Column(c.dtype, g.data, has_any, chars=g.chars)
+
     if int_lanes and n:
         stack = jnp.stack(int_lanes, axis=1)  # (n, k)
         cs = jnp.cumsum(stack, axis=0)
@@ -244,6 +279,9 @@ def groupby_aggregate(
                 out_cols.append(Column(DType(TypeId.FLOAT64), mean, has_any))
             continue
         # min / max with null-neutral sentinels
+        if c.dtype.is_string:
+            out_cols.append(_string_minmax(c, op, vcount))
+            continue
         np_dt = c.dtype.storage_dtype
         if np_dt.kind == "f":
             lo, hi = -jnp.inf, jnp.inf

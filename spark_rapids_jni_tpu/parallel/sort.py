@@ -12,8 +12,8 @@ a local ``sort_table``. Concatenating device partitions in mesh order IS
 the global order; ties on the primary key stay co-located (searchsorted
 buckets equal values together), so secondary keys order exactly.
 
-Fixed-width primary keys this round; a string primary key needs multi-word
-splitter comparison and raises NotImplementedError.
+Primary keys may be fixed-width or STRING (strings bucket on an 8-byte
+big-endian prefix; equal prefixes co-locate so exactness holds).
 """
 
 from __future__ import annotations
@@ -34,12 +34,24 @@ from spark_rapids_jni_tpu.utils.tracing import func_range
 
 def _encode_primary(col: Column) -> jnp.ndarray:
     """Order-preserving unsigned encoding of the primary sort key; nulls
-    encode below every valid value (nulls-first order)."""
+    encode below every valid value (nulls-first order).
+
+    Strings bucket on their first 8 bytes (big-endian packed): a prefix is
+    the major component of memcmp order, and equal prefixes collapse to
+    one bucket, so ties stay co-located and the local sort's full-width
+    keys keep global order exact."""
     if col.dtype.is_string:
-        raise NotImplementedError(
-            "distributed_sort on a STRING primary key is not supported yet"
-        )
-    if col.dtype.storage_dtype == np.float64:
+        from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+        p = pad_strings(col)
+        mat, lengths = p.chars, p.data
+        width = int(mat.shape[1])
+        col = p  # valid_mask read from the padded layout below
+        enc = jnp.zeros((p.size,), jnp.uint64)
+        for b in range(min(8, width)):
+            byte = jnp.where(b < lengths, mat[:, b], jnp.uint8(0))
+            enc = enc | (byte.astype(jnp.uint64) << jnp.uint64(8 * (7 - b)))
+    elif col.dtype.storage_dtype == np.float64:
         # route on the float32 truncation: order-preserving bucketing only
         # (exact order is restored by the local sort's full-precision keys)
         enc32 = _as_unsigned_key(
@@ -75,8 +87,13 @@ def plan_splitters(table: Table, key: int, num_partitions: int,
         idx = jnp.asarray(
             np.linspace(0, n - 1, sample_size).astype(np.int64)
         )
-        col = Column(col.dtype, col.data[idx],
-                     None if col.validity is None else col.validity[idx])
+        if col.dtype.is_string:
+            from spark_rapids_jni_tpu.ops.strings import gather_strings
+
+            col = gather_strings(col, idx)
+        else:
+            col = Column(col.dtype, col.data[idx],
+                         None if col.validity is None else col.validity[idx])
     enc = np.asarray(_encode_primary(col))
     qs = np.linspace(0, 1, num_partitions + 1)[1:-1]
     return np.quantile(enc, qs, method="nearest").astype(np.uint64)

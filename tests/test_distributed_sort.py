@@ -99,3 +99,30 @@ def test_overflow_detected(rng, mesh):
     sharded, rv = shard_table(tbl, mesh, return_row_valid=True)
     res = distributed_sort(sharded, [0], mesh, capacity=2, row_valid=rv)
     assert np.asarray(res.overflowed).any()
+
+
+def test_string_primary_key(rng, mesh):
+    n = 512
+    words = [f"{c}{v:04d}" for c, v in
+             zip(rng.choice(list("abcdefgh"), n), rng.integers(0, 50, n))]
+    payload = np.arange(n, dtype=np.int64)
+    tbl = Table([
+        Column.from_pylist(words, t.STRING),
+        Column.from_numpy(payload),
+    ])
+    out = run_sorted(tbl, [0], mesh, n)
+    assert out.column(0).to_pylist() == sorted(words)
+
+
+def test_string_key_with_shared_prefixes(rng, mesh):
+    # prefixes longer than the 8-byte bucket key: ties must co-locate and
+    # the local sort's full-width keys restore exact order
+    n = 256
+    words = [f"shared/prefix/longer/than/8/{v:05d}"
+             for v in rng.integers(0, 200, n)]
+    words[7] = None
+    tbl = Table([Column.from_pylist(words, t.STRING)])
+    out = run_sorted(tbl, [0], mesh, n)
+    got = out.column(0).to_pylist()
+    assert got[0] is None
+    assert got[1:] == sorted(w for w in words if w is not None)

@@ -240,3 +240,40 @@ class TestReviewRegressions:
 
         with pytest.raises(ValueError, match="static width"):
             run(col)
+
+
+class TestStringMinMax:
+    def test_min_max_matches_oracle(self, rng):
+        n = 400
+        keys = [int(v) for v in rng.integers(0, 12, n)]
+        words = [f"w{v:03d}" for v in rng.integers(0, 500, n)]
+        for i in range(0, n, 23):
+            words[i] = None
+        tbl = Table([
+            Column.from_pylist(keys, t.INT32),
+            Column.from_pylist(words, t.STRING),
+        ])
+        res = groupby_aggregate(tbl, [0], [(1, "min"), (1, "max")])
+        out = res.compact()
+        got = {
+            out.column(0).to_pylist()[i]: (
+                out.column(1).to_pylist()[i], out.column(2).to_pylist()[i])
+            for i in range(int(res.num_groups))
+        }
+        want = {}
+        for k, w in zip(keys, words):
+            lo, hi = want.get(k, (None, None))
+            if w is not None:
+                lo = w if lo is None else min(lo, w)
+                hi = w if hi is None else max(hi, w)
+            want[k] = (lo, hi)
+        assert got == want
+
+    def test_all_null_group_is_null(self):
+        tbl = Table([
+            Column.from_pylist([1, 1, 2], t.INT32),
+            Column.from_pylist([None, None, "z"], t.STRING),
+        ])
+        res = groupby_aggregate(tbl, [0], [(1, "min")])
+        out = res.compact()
+        assert out.column(1).to_pylist() == [None, "z"]
