@@ -264,6 +264,83 @@ def tpcds_q72_numpy(
     return out
 
 
+# ---- distributed q72 (broadcast-join plan) ---------------------------------
+
+# padded groupby outputs shuffle under a static per-device group budget;
+# the item dimension bounds distinct (item, brand) groups
+_Q72_GROUP_BUDGET = 4096
+
+
+def tpcds_q72_distributed(
+    catalog_sales: Table,
+    date_dim: Table,
+    item: Table,
+    inventory: Table,
+    mesh,
+    year: int = 2000,
+    out_factor: int = 2,
+    group_budget: int = _Q72_GROUP_BUDGET,
+) -> Table:
+    """Multi-executor q72 with Spark's broadcast-join plan: the fact table
+    shards row-wise over the mesh, the three dimension tables replicate to
+    every device (they are small — the broadcast side of a broadcast hash
+    join), each executor runs the whole join chain + partial group-count
+    locally, and the partial counts merge through the ICI shuffle exactly
+    like distributed q1. Returns the compacted global (item, brand, count)
+    table, count-desc/item-asc ordered."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        collect,
+        head_table,
+        shard_table,
+    )
+    from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+    from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
+
+    sharded = shard_table(catalog_sales, mesh)
+
+    def step(local_cs: Table, dd: Table, it: Table, inv: Table):
+        # padding rows carry null join keys (shard_table nulls validity),
+        # so they fall out of the first join and never reach the count
+        partial = tpcds_q72(local_cs, dd, it, inv, year=year,
+                            out_factor=out_factor)
+        pt = head_table(
+            partial.table, min(group_budget, partial.table.num_rows)
+        )
+        sh = hash_shuffle(pt, [0, 1], EXEC_AXIS, capacity=pt.num_rows)
+        merged = groupby_aggregate(sh.table, keys=[0, 1], aggs=[(2, "sum")])
+        return (merged.table, merged.num_groups.reshape(1),
+                partial.num_groups.reshape(1))
+
+    out, num_groups, partial_groups = _jax.jit(_jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(EXEC_AXIS), P(), P(), P()),
+        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+    ))(sharded, date_dim, item, inventory)
+    if int(np.max(np.asarray(partial_groups))) > group_budget:
+        raise ValueError(
+            "per-device q72 group count exceeded the shuffle budget "
+            f"({group_budget}); pass a larger group_budget"
+        )
+    result = collect(out, num_groups, mesh)
+    # drop the phantom null-key group the shuffle padding creates
+    keys_valid = np.asarray(result.column(0).valid_mask()) & np.asarray(
+        result.column(1).valid_mask()
+    )
+    cols = []
+    for c in result.columns:
+        cols.append(Column(
+            c.dtype,
+            jnp.asarray(np.asarray(c.data)[keys_valid]),
+            jnp.asarray(np.asarray(c.valid_mask())[keys_valid]),
+        ))
+    final = Table(cols)
+    return sort_table(final, [2, 0], ascending=[False, True],
+                      nulls_first=[False, False])
+
+
 # ---- q64-style -------------------------------------------------------------
 
 

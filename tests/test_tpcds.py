@@ -174,3 +174,34 @@ def test_distributed_left_join_no_phantom_rows(rng, mesh, n_l):
     got_unmatched = np.sort(lkd[l_ok & ~r_ok])
     want_unmatched = np.sort(lk[match_counts == 0])
     np.testing.assert_array_equal(got_unmatched, want_unmatched)
+
+
+def test_q72_distributed_matches_oracle():
+    from spark_rapids_jni_tpu.models.tpcds import (
+        catalog_sales_table,
+        date_dim_table,
+        inventory_table,
+        item_table,
+        tpcds_q72_distributed,
+        tpcds_q72_numpy,
+    )
+    from spark_rapids_jni_tpu.parallel import executor_mesh
+
+    mesh = executor_mesh(8)
+    cs = catalog_sales_table(2048, num_items=200, seed=5)
+    dd = date_dim_table()
+    it = item_table(200)
+    inv = inventory_table(num_items=200)
+    out = tpcds_q72_distributed(cs, dd, it, inv, mesh)
+    got = {
+        (out.column(0).to_pylist()[i], out.column(1).to_pylist()[i]):
+            out.column(2).to_pylist()[i]
+        for i in range(out.num_rows)
+    }
+    want = tpcds_q72_numpy(cs, dd, it, inv)
+    assert got == want
+    # ORDER BY count desc, item asc holds
+    counts = out.column(2).to_pylist()
+    items = out.column(0).to_pylist()
+    order_keys = list(zip((-c for c in counts), items))
+    assert order_keys == sorted(order_keys)
