@@ -205,7 +205,6 @@ def _bench_parquet_q1(n: int, iters: int):
     # direct storage->decode route), not a Python-materialized buffer
     tmp = tempfile.NamedTemporaryFile(suffix=".parquet", delete=False)
     tmp.close()
-    pq.write_table(pa_table, tmp.name, compression="snappy")
     data = tmp.name
 
     q1 = jax.jit(lambda tb: _table_digest(tpch_q1(tb)))
@@ -219,6 +218,7 @@ def _bench_parquet_q1(n: int, iters: int):
         return q1(Table(cols))
 
     try:
+        pq.write_table(pa_table, data, compression="snappy")
         per_iter = _measure(run, iters)
     finally:
         os.unlink(tmp.name)

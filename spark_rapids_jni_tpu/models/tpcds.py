@@ -264,6 +264,23 @@ def tpcds_q72_numpy(
     return out
 
 
+def _compact_valid_keys(result: Table, num_key_cols: int,
+                        order_keys, ascending) -> Table:
+    """Drop the shuffle's phantom null-key group(s) from a collected
+    result and apply the final ORDER BY — shared tail of the distributed
+    q72/q64 plans."""
+    keys_valid = np.asarray(result.column(0).valid_mask()).copy()
+    for k in range(1, num_key_cols):
+        keys_valid &= np.asarray(result.column(k).valid_mask())
+    cols = [
+        Column(c.dtype, jnp.asarray(np.asarray(c.data)[keys_valid]),
+               jnp.asarray(np.asarray(c.valid_mask())[keys_valid]))
+        for c in result.columns
+    ]
+    return sort_table(Table(cols), order_keys, ascending=ascending,
+                      nulls_first=[False] * len(order_keys))
+
+
 # ---- distributed q72 (broadcast-join plan) ---------------------------------
 
 # padded groupby outputs shuffle under a static per-device group budget;
@@ -325,20 +342,7 @@ def tpcds_q72_distributed(
             f"({group_budget}); pass a larger group_budget"
         )
     result = collect(out, num_groups, mesh)
-    # drop the phantom null-key group the shuffle padding creates
-    keys_valid = np.asarray(result.column(0).valid_mask()) & np.asarray(
-        result.column(1).valid_mask()
-    )
-    cols = []
-    for c in result.columns:
-        cols.append(Column(
-            c.dtype,
-            jnp.asarray(np.asarray(c.data)[keys_valid]),
-            jnp.asarray(np.asarray(c.valid_mask())[keys_valid]),
-        ))
-    final = Table(cols)
-    return sort_table(final, [2, 0], ascending=[False, True],
-                      nulls_first=[False, False])
+    return _compact_valid_keys(result, 2, [2, 0], [False, True])
 
 
 # ---- q64-style -------------------------------------------------------------
@@ -398,6 +402,96 @@ def tpcds_q64(
     return Q64Result(
         GroupByResult(srt, grouped.num_groups), maps.total, n * out_factor
     )
+
+
+def tpcds_q64_distributed(
+    store_sales: Table,
+    mesh,
+    year1: int = 2000,
+    year2: int = 2001,
+    num_days_per_year: int = 365,
+    base_year: int = 2000,
+    out_factor: int = 4,
+    group_budget: int = _Q72_GROUP_BUDGET,
+) -> Table:
+    """Multi-executor q64: the cross-year self-join is big x big, so it
+    takes the REPARTITIONED plan (unlike q72's broadcast): both year-slices
+    exchange rows by composite-key hash over ICI (distributed_join), equal
+    keys co-locate, each device joins and partial-counts locally, and
+    partial counts merge through a second shuffle. Returns the compacted
+    global (item, count) table, count-desc/item-asc."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        collect,
+        distributed_join,
+        head_table,
+        shard_table,
+    )
+    from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+    from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
+
+    n = store_sales.num_rows
+    date = np.asarray(store_sales.column(SS_SOLD_DATE_SK).data)
+    yr = (date - 1) // num_days_per_year
+
+    key = _pack_key(
+        store_sales.column(SS_ITEM_SK), store_sales.column(SS_CUSTOMER_SK),
+        MAX_CUSTOMERS,
+    )
+    left = Table([
+        _null_keys_where(key, jnp.asarray(yr != (year1 - base_year))),
+        store_sales.column(SS_ITEM_SK),
+    ])
+    right = Table([
+        _null_keys_where(key, jnp.asarray(yr != (year2 - base_year))),
+    ])
+    sl, lrv = shard_table(left, mesh, return_row_valid=True)
+    sr, rrv = shard_table(right, mesh, return_row_valid=True)
+    d = mesh.shape[EXEC_AXIS]
+    res = distributed_join(
+        sl, sr, 0, 0, mesh,
+        out_size_per_device=max(1, n * out_factor // max(d // 2, 1)),
+        left_capacity=max(1, n // d * 2), right_capacity=max(1, n // d * 2),
+        left_row_valid=lrv, right_row_valid=rrv,
+    )
+    if np.asarray(res.overflowed).any():
+        raise ValueError("q64 join shuffle overflowed; raise capacities")
+    out_cap = max(1, n * out_factor // max(d // 2, 1))
+    if int(np.max(np.asarray(res.total))) > out_cap:
+        raise ValueError(
+            "q64 device-local join output exceeded out_size_per_device "
+            f"({out_cap}); raise out_factor (counts would silently truncate)"
+        )
+
+    def count_step(joined: Table):
+        # joined: [key_y1, ss_item, key_y2]; matched rows = repeat buys
+        keep = joined.column(2).valid_mask()
+        keyed = Table([
+            _null_keys_where(joined.column(1), ~keep),
+            Column(t.INT64, joined.column(0).data, keep),
+        ])
+        partial = groupby_aggregate(keyed, keys=[0], aggs=[(1, "count")])
+        pt = head_table(
+            partial.table, min(group_budget, partial.table.num_rows)
+        )
+        sh = hash_shuffle(pt, [0], EXEC_AXIS, capacity=pt.num_rows)
+        merged = groupby_aggregate(sh.table, keys=[0], aggs=[(1, "sum")])
+        return (merged.table, merged.num_groups.reshape(1),
+                partial.num_groups.reshape(1))
+
+    out, num_groups, partial_groups = _jax.jit(_jax.shard_map(
+        count_step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
+        out_specs=(P(EXEC_AXIS),) * 3,
+    ))(res.table)
+    if int(np.max(np.asarray(partial_groups))) > group_budget:
+        raise ValueError(
+            "per-device q64 group count exceeded the shuffle budget "
+            f"({group_budget}); pass a larger group_budget"
+        )
+    result = collect(out, num_groups, mesh)
+    return _compact_valid_keys(result, 1, [1, 0], [False, True])
 
 
 def tpcds_q64_numpy(

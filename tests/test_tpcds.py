@@ -205,3 +205,47 @@ def test_q72_distributed_matches_oracle():
     items = out.column(0).to_pylist()
     order_keys = list(zip((-c for c in counts), items))
     assert order_keys == sorted(order_keys)
+
+
+def test_q64_distributed_matches_oracle():
+    from spark_rapids_jni_tpu.models.tpcds import (
+        store_sales_table,
+        tpcds_q64_distributed,
+        tpcds_q64_numpy,
+    )
+    from spark_rapids_jni_tpu.parallel import executor_mesh
+
+    mesh = executor_mesh(8)
+    ss = store_sales_table(2048, num_items=100, num_customers=400, seed=9)
+    out = tpcds_q64_distributed(ss, mesh)
+    got = {
+        out.column(0).to_pylist()[i]: out.column(1).to_pylist()[i]
+        for i in range(out.num_rows)
+    }
+    want = tpcds_q64_numpy(ss)
+    assert got == want
+
+
+def test_q64_distributed_detects_join_truncation():
+    import numpy as np
+    import pytest as _pytest
+
+    from spark_rapids_jni_tpu import types as t
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.models.tpcds import tpcds_q64_distributed
+    from spark_rapids_jni_tpu.parallel import executor_mesh
+
+    # one (item, customer) pair bought 300x in each year: 90000 join pairs
+    # co-locate on one device, far beyond out_size_per_device
+    n = 640
+    item = np.full(n, 7, dtype=np.int64)
+    cust = np.full(n, 11, dtype=np.int64)
+    date = np.where(np.arange(n) % 2 == 0, 10, 400).astype(np.int64)
+    ss = Table([
+        Column.from_numpy(item, t.INT64),
+        Column.from_numpy(cust, t.INT64),
+        Column.from_numpy(date, t.INT64),
+    ])
+    mesh = executor_mesh(8)
+    with _pytest.raises(ValueError, match="out_size_per_device"):
+        tpcds_q64_distributed(ss, mesh, out_factor=4)
