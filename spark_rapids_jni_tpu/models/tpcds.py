@@ -450,15 +450,15 @@ def tpcds_q64_distributed(
     sl, lrv = shard_table(left, mesh, return_row_valid=True)
     sr, rrv = shard_table(right, mesh, return_row_valid=True)
     d = mesh.shape[EXEC_AXIS]
+    out_cap = max(1, n * out_factor // max(d // 2, 1))
     res = distributed_join(
         sl, sr, 0, 0, mesh,
-        out_size_per_device=max(1, n * out_factor // max(d // 2, 1)),
+        out_size_per_device=out_cap,
         left_capacity=max(1, n // d * 2), right_capacity=max(1, n // d * 2),
         left_row_valid=lrv, right_row_valid=rrv,
     )
     if np.asarray(res.overflowed).any():
         raise ValueError("q64 join shuffle overflowed; raise capacities")
-    out_cap = max(1, n * out_factor // max(d // 2, 1))
     if int(np.max(np.asarray(res.total))) > out_cap:
         raise ValueError(
             "q64 device-local join output exceeded out_size_per_device "

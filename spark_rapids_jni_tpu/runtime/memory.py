@@ -171,3 +171,156 @@ def default_staging_pool() -> HostStagingPool:
         if _default_pool is None:
             _default_pool = HostStagingPool()
         return _default_pool
+
+
+# ---- spill store (the RMM arena's overflow valve) --------------------------
+
+
+def _col_nbytes(c) -> int:
+    total = int(np.prod(c.data.shape)) * c.data.dtype.itemsize
+    if c.validity is not None:
+        total += int(c.validity.shape[0])
+    if c.chars is not None:
+        total += int(np.prod(c.chars.shape))
+    for child in (c.children or ()):
+        total += _col_nbytes(child)
+    return total
+
+
+def _table_nbytes(table) -> int:
+    return sum(_col_nbytes(c) for c in table.columns)
+
+
+def _col_to_host(c) -> tuple:
+    """Recursive host snapshot of a column (incl. LIST/STRUCT children)."""
+    return (
+        c.dtype,
+        np.asarray(c.data),
+        None if c.validity is None else np.asarray(c.validity),
+        None if c.chars is None else np.asarray(c.chars),
+        None if not c.children else [_col_to_host(ch) for ch in c.children],
+    )
+
+
+def _col_from_host(snap):
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.columnar import Column
+
+    dtype, data, validity, chars, children = snap
+    return Column(
+        dtype, jnp.asarray(data),
+        None if validity is None else jnp.asarray(validity),
+        chars=None if chars is None else jnp.asarray(chars),
+        children=None if children is None
+        else [_col_from_host(ch) for ch in children],
+    )
+
+
+class SpillStore:
+    """HBM pressure valve — the role RMM's spillable pool plays for the
+    Spark plugin: registered tables count against a device budget; when a
+    new registration would exceed it, least-recently-used tables SPILL to
+    host numpy copies (freeing their HBM the moment the JAX arrays drop),
+    and touching a spilled table stages it back, spilling others if needed.
+
+    Deliberate scope: inter-OPERATOR working sets (shuffle partitions,
+    chunked-read batches, cached build sides) — not intra-kernel memory,
+    which belongs to XLA's own arena. Thread-safe; spill/unspill events log
+    under ``memory.log_level`` >= 1.
+    """
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes <= 0:
+            raise ValueError("budget must be positive")
+        self.budget = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._next_id = 1
+        # id -> dict(state="device"|"host", table|host_cols, nbytes, tick)
+        self._entries: dict[int, dict] = {}
+        self._tick = 0
+        self.spill_count = 0
+        self.unspill_count = 0
+
+    def _device_bytes_locked(self) -> int:
+        return sum(e["nbytes"] for e in self._entries.values()
+                   if e["state"] == "device")
+
+    @property
+    def device_bytes(self) -> int:
+        with self._lock:
+            return self._device_bytes_locked()
+
+    def _spill_lru_locked(self, need: int) -> None:
+        """Spill least-recently-used device entries until ``need`` fits."""
+        while self._device_bytes_locked() + need > self.budget:
+            candidates = [
+                (e["tick"], eid) for eid, e in self._entries.items()
+                if e["state"] == "device"
+            ]
+            if not candidates:
+                raise MemoryLimitExceeded(
+                    f"table of {need} bytes exceeds the spill budget "
+                    f"({self.budget}) even with everything spilled"
+                )
+            _, eid = min(candidates)
+            e = self._entries[eid]
+            e["host_cols"] = [_col_to_host(c) for c in e["table"].columns]
+            e["table"] = None  # drop the device arrays -> XLA frees HBM
+            e["state"] = "host"
+            self.spill_count += 1
+            if get_option("memory.log_level") >= 1:
+                _log.info("spill table %d (%d bytes) to host", eid,
+                          e["nbytes"])
+
+    def put(self, table) -> int:
+        """Register a device table; returns its handle. May spill others."""
+        nbytes = _table_nbytes(table)
+        with self._lock:
+            self._spill_lru_locked(nbytes)
+            self._tick += 1
+            eid = self._next_id
+            self._next_id += 1
+            self._entries[eid] = {
+                "state": "device", "table": table, "host_cols": None,
+                "nbytes": nbytes, "tick": self._tick,
+            }
+            return eid
+
+    def get(self, handle: int):
+        """Fetch a table, staging it back to device if it was spilled."""
+        from spark_rapids_jni_tpu.columnar import Table
+
+        with self._lock:
+            e = self._entries.get(handle)
+            if e is None:
+                raise KeyError(f"unknown spill-store handle {handle}")
+            self._tick += 1
+            e["tick"] = self._tick
+            if e["state"] == "device":
+                return e["table"]
+            self._spill_lru_locked(e["nbytes"])
+            cols = [_col_from_host(snap) for snap in e["host_cols"]]
+            e["table"] = Table(cols)
+            e["host_cols"] = None
+            e["state"] = "device"
+            self.unspill_count += 1
+            if get_option("memory.log_level") >= 1:
+                _log.info("unspill table %d (%d bytes)", handle, e["nbytes"])
+            return e["table"]
+
+    def drop(self, handle: int) -> None:
+        with self._lock:
+            self._entries.pop(handle, None)
+
+    def stats(self) -> dict:
+        with self._lock:
+            device = self._device_bytes_locked()
+            host = sum(e["nbytes"] for e in self._entries.values()
+                       if e["state"] == "host")
+            return {
+                "device_bytes": device, "host_bytes": host,
+                "budget_bytes": self.budget,
+                "spills": self.spill_count, "unspills": self.unspill_count,
+                "tables": len(self._entries),
+            }

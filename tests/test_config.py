@@ -111,3 +111,107 @@ def test_device_memory_stats_shape():
     assert s.bytes_in_use >= 0
     assert s.peak_bytes_in_use >= s.bytes_in_use or s.peak_bytes_in_use == 0
     assert s.bytes_free >= 0
+
+
+class TestSpillStore:
+    def _table(self, n, seed=0):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.columnar import Column, Table
+
+        rng = np.random.default_rng(seed)
+        return Table([Column.from_numpy(
+            rng.integers(0, 1000, n).astype(np.int64))])
+
+    def test_spills_lru_and_restores_exact(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.runtime.memory import SpillStore
+
+        store = SpillStore(budget_bytes=3000)  # fits two 128-row int64 tables
+        t1, t2, t3 = (self._table(128, s) for s in (1, 2, 3))
+        want1 = np.asarray(t1.column(0).data).copy()
+        h1 = store.put(t1)
+        h2 = store.put(t2)
+        h3 = store.put(t3)  # t1 is LRU -> spills
+        assert store.spill_count == 1
+        s = store.stats()
+        assert s["host_bytes"] > 0 and s["device_bytes"] <= 3000
+        got1 = store.get(h1)  # unspill; t2 becomes the spill victim
+        np.testing.assert_array_equal(np.asarray(got1.column(0).data), want1)
+        assert store.unspill_count == 1
+        assert store.spill_count == 2
+        # all three still retrievable and exact
+        for h, t in ((h2, t2), (h3, t3)):
+            got = store.get(h)
+            np.testing.assert_array_equal(
+                np.asarray(got.column(0).data), np.asarray(t.column(0).data))
+
+    def test_oversized_table_raises(self):
+        import pytest as _pytest
+
+        from spark_rapids_jni_tpu.runtime.memory import (
+            MemoryLimitExceeded,
+            SpillStore,
+        )
+
+        store = SpillStore(budget_bytes=100)
+        with _pytest.raises(MemoryLimitExceeded):
+            store.put(self._table(1024))
+
+    def test_drop_frees_budget(self):
+        from spark_rapids_jni_tpu.runtime.memory import SpillStore
+
+        store = SpillStore(budget_bytes=2100)
+        h1 = store.put(self._table(128))
+        store.drop(h1)
+        store.put(self._table(128))  # fits again without spilling
+        assert store.spill_count == 0
+
+    def test_string_table_spills(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu import types as t
+        from spark_rapids_jni_tpu.columnar import Column, Table
+        from spark_rapids_jni_tpu.runtime.memory import (
+            SpillStore,
+            _table_nbytes,
+        )
+
+        tbl = Table([Column.from_pylist(["alpha", None, "omega"], t.STRING)])
+        # budget fits exactly the string table: the next put must evict it
+        store = SpillStore(budget_bytes=_table_nbytes(tbl))
+        h = store.put(tbl)
+        store.put(Table([Column.from_numpy(np.zeros(1, dtype=np.int8))]))
+        assert store.spill_count == 1
+        got = store.get(h)
+        assert got.column(0).to_pylist() == ["alpha", None, "omega"]
+
+    def test_multi_eviction_and_nested_columns(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.types import DType, TypeId
+        from spark_rapids_jni_tpu.columnar import Column, Table
+        from spark_rapids_jni_tpu.runtime.memory import (
+            SpillStore,
+            _table_nbytes,
+        )
+
+        small = [self._table(64, s) for s in (1, 2, 3)]
+        big = self._table(160, 4)
+        store = SpillStore(budget_bytes=_table_nbytes(small[0]) * 3)
+        hs = [store.put(t) for t in small]
+        store.put(big)  # 1280B into 1536B budget: evicts all three smalls
+        assert store.spill_count == 3
+
+        # LIST column round-trips a spill with its child intact
+        child = Column.from_numpy(np.arange(5, dtype=np.int64))
+        lst = Column(DType(TypeId.LIST), jnp.asarray([0, 2, 5], jnp.int32),
+                     children=[child])
+        ltbl = Table([lst])
+        store2 = SpillStore(budget_bytes=_table_nbytes(ltbl))
+        h = store2.put(ltbl)
+        store2.put(self._table(4, 9))  # evicts the list table
+        got = store2.get(h)
+        assert got.column(0).to_pylist() == [[0, 1], [2, 3, 4]]
