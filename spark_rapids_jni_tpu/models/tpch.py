@@ -47,6 +47,26 @@ L_SHIPDATE = 6
 # 1998-12-01 minus 90 days, in days since epoch (Spark DateType encoding)
 _Q1_CUTOFF_DAYS = 10560
 
+# q1 groups by two one-byte flags: at most 3*2 real groups plus the null-key
+# pseudo-group. A tiny static group budget keeps every downstream shape
+# (groupby output, final ORDER BY, shuffle payload) at m rows instead of n —
+# and switches groupby_aggregate onto its small-m boundary path (no
+# full-length scans).
+_Q1_GROUP_BUDGET = 64
+
+# The q1 aggregate plan over _q1_work_table's column layout, shared by the
+# jitted pipeline and the checked host wrapper so they cannot diverge.
+_Q1_AGGS = [
+    (2, "sum"),    # sum_qty
+    (3, "sum"),    # sum_base_price
+    (5, "sum"),    # sum_disc_price
+    (6, "sum"),    # sum_charge
+    (2, "mean"),   # avg_qty
+    (3, "mean"),   # avg_price
+    (4, "mean"),   # avg_disc
+    (2, "count"),  # count_order
+]
+
 LINEITEM_SCHEMA = [
     t.decimal64(-2),      # l_quantity  DECIMAL(12,2)
     t.decimal64(-2),      # l_extendedprice
@@ -162,24 +182,37 @@ def _q1_work_table(lineitem: Table) -> Table:
 
 @func_range("tpch_q1")
 def tpch_q1(lineitem: Table) -> Table:
-    """Single-executor q1: filter -> derived columns -> groupby -> sort."""
+    """Single-executor q1: filter -> derived columns -> groupby -> sort.
+
+    The group budget is part of the query plan, the way Spark's planner
+    carries a cardinality estimate: q1 groups by two CHAR(1) flags, <= 7
+    groups including the null-key pseudo-group, so 64 is a 9x margin. On
+    data outside that contract (>=64 distinct byte pairs) the excess
+    groups are dropped — jitted code cannot raise on a device predicate;
+    use ``tpch_q1_checked`` from host code to turn overflow into an error.
+    """
     work = _q1_work_table(lineitem)
     grouped = groupby_aggregate(
-        work,
-        keys=[0, 1],
-        aggs=[
-            (2, "sum"),   # sum_qty
-            (3, "sum"),   # sum_base_price
-            (5, "sum"),   # sum_disc_price
-            (6, "sum"),   # sum_charge
-            (2, "mean"),  # avg_qty
-            (3, "mean"),  # avg_price
-            (4, "mean"),  # avg_disc
-            (2, "count"),  # count_order
-        ],
+        work, keys=[0, 1], aggs=_Q1_AGGS, max_groups=_Q1_GROUP_BUDGET
     )
     # The filtered-out pseudo-group has null keys; q1's ORDER BY puts real
     # groups first (nulls last) so the compacted head is the answer.
+    return sort_table(grouped.table, [0, 1], nulls_first=[False, False])
+
+
+def tpch_q1_checked(lineitem: Table) -> Table:
+    """Host-side q1 wrapper that enforces the plan's group-budget contract
+    (raises instead of silently dropping groups on out-of-contract data)."""
+    work = _q1_work_table(lineitem)
+    grouped = groupby_aggregate(
+        work, keys=[0, 1], aggs=_Q1_AGGS, max_groups=_Q1_GROUP_BUDGET
+    )
+    if bool(grouped.overflowed):
+        raise ValueError(
+            f"q1 key domain exceeded the plan's group budget "
+            f"({int(grouped.num_groups)} > {_Q1_GROUP_BUDGET}): the "
+            "returnflag/linestatus bytes are outside the TPC-H contract"
+        )
     return sort_table(grouped.table, [0, 1], nulls_first=[False, False])
 
 
@@ -230,9 +263,6 @@ _Q1_PARTIAL_AGGS = [
     (4, "count"),  # count_disc
 ]
 
-# q1 groups by two one-byte flags: at most 3*2 real groups plus the null-key
-# pseudo-group, so a tiny static budget bounds the shuffle payload.
-_Q1_GROUP_BUDGET = 64
 
 
 def _q1_finalize(merged: Table) -> Table:
@@ -261,16 +291,24 @@ def q1_distributed_step(local: Table):
     all-to-all shuffle by (returnflag, linestatus) -> merge groupby.
     Afterward each executor owns a disjoint slice of the key space.
     """
-    from spark_rapids_jni_tpu.parallel.distributed import head_table
     from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
     from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
 
     work = _q1_work_table(local)
-    partial = groupby_aggregate(work, keys=[0, 1], aggs=_Q1_PARTIAL_AGGS)
-    pt = head_table(
-        partial.table, min(_Q1_GROUP_BUDGET, partial.table.num_rows)
-    )
-    sh = hash_shuffle(pt, [0, 1], EXEC_AXIS, capacity=pt.num_rows)
+    budget = min(_Q1_GROUP_BUDGET, work.num_rows)
+    # the budget-bounded partial IS the head truncation: its output is
+    # padded to exactly `budget` rows, real groups first
+    partial = groupby_aggregate(work, keys=[0, 1], aggs=_Q1_PARTIAL_AGGS,
+                                max_groups=budget)
+    # only the real groups cross the wire: the budget-padding rows (null
+    # keys, zero aggregates) would all hash to one partition and waste the
+    # null-key receiver's capacity on ~90% phantom payload
+    real = jnp.arange(budget, dtype=jnp.int32) < partial.num_groups
+    sh = hash_shuffle(partial.table, [0, 1], EXEC_AXIS, capacity=budget,
+                      row_valid=real)
+    # merge with max_groups=None: m = the shuffle buffer size (every sender
+    # contributed <= budget rows), which can never overflow — the receiving
+    # device may own up to sender_count * budget distinct partial groups
     merged = groupby_aggregate(
         sh.table, keys=[0, 1], aggs=[(i, "sum") for i in range(2, 10)]
     )

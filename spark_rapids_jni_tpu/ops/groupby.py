@@ -71,6 +71,9 @@ def _rows_equal_prev(table: Table, keys: Sequence[int]) -> jnp.ndarray:
             from spark_rapids_jni_tpu.ops import strings as s
 
             eq_val = s.strings_equal_prev(c)
+        elif c.dtype.is_decimal128:
+            v = c.data
+            eq_val = jnp.all(v[1:] == v[:-1], axis=-1)
         else:
             v = c.data
             eq_val = v[1:] == v[:-1]
@@ -81,6 +84,79 @@ def _rows_equal_prev(table: Table, keys: Sequence[int]) -> jnp.ndarray:
         eq = (eq_val & valid[1:] & eq_valid) | both_null
         same = same.at[1:].set(same[1:] & eq)
     return same.at[0].set(n == 0)
+
+
+# Below this group-count bound (and when the boundary work is actually
+# smaller than the scan it replaces — see the gate in groupby_aggregate)
+# the boundary machinery switches from full-length scans to block-level
+# reductions (see _group_starts / _boundary_prefix): a cumsum over n rows
+# is latency-bound on the TPU (measured 68ms for 4M int64 lanes, ~0.9 GB/s
+# effective — BASELINE.md), while a block-sum pass is bandwidth-bound and
+# the per-boundary partials are O(m * block).
+_SMALL_M = 1024
+_MIN_BLOCK = 32
+_MAX_BLOCK = 512
+
+
+def _pick_block(n: int, m: int) -> int:
+    """Block size balancing the two costs of the boundary path: the block-sum
+    pass reads n rows; the per-boundary partials read ~2*m*block rows. Cap
+    block so boundary work stays under the streaming pass."""
+    b = _MIN_BLOCK
+    while b < _MAX_BLOCK and 2 * m * (b * 2) <= n:
+        b *= 2
+    return b
+
+
+def _group_starts(same: jnp.ndarray, q: int,
+                  block: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Positions of the first ``q`` group starts over sorted keys, plus the
+    exact total group count — without materializing per-row group ids.
+
+    ``same[i]`` is True when sorted row i has the same key as row i-1, so
+    group starts are the set bits of ``~same``. The g-th start is located
+    with per-block popcounts: a tiny cumsum over block counts finds the
+    block containing it, then a (q, BLOCK) within-block scan finds the bit.
+    Absent groups (g >= total) report position n.
+    """
+    n = same.shape[0]
+    flags = (~same).astype(jnp.int32)
+    nb = -(-n // block)
+    pad = nb * block - n
+    fb = jnp.pad(flags, (0, pad)).reshape(nb, block)
+    bpre = jnp.cumsum(fb.sum(axis=1))            # (nb,) inclusive
+    total = bpre[-1].astype(jnp.int32)
+    g = jnp.arange(q, dtype=jnp.int32)
+    ib = jnp.clip(jnp.searchsorted(bpre, g, side="right"), 0, nb - 1)
+    prev = jnp.where(ib > 0, bpre[jnp.maximum(ib - 1, 0)], 0)
+    rank = g - prev                              # g-th start's rank in block
+    rows = fb[ib]                                # (q, BLOCK) gather
+    within = jnp.cumsum(rows, axis=1)
+    hit = (within == (rank + 1)[:, None]) & (rows > 0)
+    idx_in = jnp.argmax(hit, axis=1).astype(jnp.int32)
+    starts = ib.astype(jnp.int32) * block + idx_in
+    return jnp.where(g < total, starts, n).astype(jnp.int32), total
+
+
+def _boundary_prefix(stack: jnp.ndarray, idx: jnp.ndarray,
+                     block: int) -> jnp.ndarray:
+    """Exact int64 prefix sums of ``stack`` (n, k) evaluated only at the
+    ``idx`` (q,) boundaries: per-block sums (one bandwidth pass) + a tiny
+    block-level cumsum + a (q, BLOCK, k) masked partial for each boundary's
+    own block. Replaces the full-length (n, k) cumsum when boundaries are
+    few; tree reductions of int64 are exact, so this matches the scan path
+    bit-for-bit."""
+    n, k = stack.shape
+    nb = -(-n // block)
+    pad = nb * block - n
+    sp = jnp.pad(stack, ((0, pad), (0, 0))).reshape(nb, block, k)
+    bpre = jnp.cumsum(sp.sum(axis=1), axis=0)    # (nb, k) inclusive
+    ib = jnp.clip(idx // block, 0, nb - 1)
+    r = idx - ib * block                         # may equal block at idx == n
+    base = jnp.where((ib > 0)[:, None], bpre[jnp.maximum(ib - 1, 0)], 0)
+    rows = sp[ib]                                # (q, block, k)
+    mask = jnp.arange(block, dtype=jnp.int32)[None, :, None] < r[:, None, None]
+    return base + jnp.sum(jnp.where(mask, rows, 0), axis=1)
 
 
 def _sum_dtype(dt: DType) -> DType:
@@ -118,32 +194,44 @@ def groupby_aggregate(
     for _, op in aggs:
         if op not in SUPPORTED_AGGS:
             raise ValueError(f"unsupported aggregation {op!r}")
-    for k in keys:
-        if table.column(k).dtype.is_decimal128:
-            raise NotImplementedError(
-                "DECIMAL128 groupby keys are not supported yet"
-            )
     n = table.num_rows
     m = n if max_groups is None else int(max_groups)
     order = sort_order(table, keys)
     sorted_tbl = gather(table, order)
 
     same = _rows_equal_prev(sorted_tbl, keys)
-    group_id = (jnp.cumsum(~same) - 1).astype(jnp.int32)
-    num_groups = (group_id[-1] + 1).astype(jnp.int32) if n else jnp.int32(0)
-    overflowed = num_groups > m
+    # small-m boundary path: locate group starts with block popcounts and
+    # defer (often skip entirely) the full-length group-id scan. Gated on
+    # the boundary work (2*m*block rows) actually undercutting the scan.
+    small = n > 0 and m <= _SMALL_M and 2 * m * _MIN_BLOCK <= n
+    block = _pick_block(n, m) if small else 0
+    _gid_cache: list = []
 
-    # group_id is sorted (dense ids over sorted rows), so every per-group
-    # boundary is a binary search, not a scatter — scatters serialize on
-    # the TPU (measured 4x slower than the scan/searchsorted formulation
-    # at 4M rows on v5e; BASELINE.md).
+    def _gid() -> jnp.ndarray:
+        """Per-row dense group id — materialized only for aggregates with
+        no boundary-difference form (float sums, min/max, string ranks)."""
+        if not _gid_cache:
+            _gid_cache.append((jnp.cumsum(~same) - 1).astype(jnp.int32))
+        return _gid_cache[0]
+
     garange = jnp.arange(m, dtype=jnp.int32)
-    if n:
+    if small:
+        starts, num_groups = _group_starts(same, m + 1, block)
+        g_lo, g_hi = starts[:m], starts[1:]
+    elif n:
+        group_id = _gid()
+        num_groups = (group_id[-1] + 1).astype(jnp.int32)
+        # group_id is sorted (dense ids over sorted rows), so every
+        # per-group boundary is a binary search, not a scatter — scatters
+        # serialize on the TPU (measured 4x slower than the scan/
+        # searchsorted formulation at 4M rows on v5e; BASELINE.md).
         g_lo = jnp.searchsorted(group_id, garange, side="left").astype(jnp.int32)
         g_hi = jnp.searchsorted(group_id, garange, side="right").astype(jnp.int32)
     else:
+        num_groups = jnp.int32(0)
         g_lo = jnp.zeros((m,), jnp.int32)
         g_hi = jnp.zeros((m,), jnp.int32)
+    overflowed = num_groups > m
     # first row of each group (n = absent, matching the old scatter-min)
     first_idx = jnp.where(g_hi > g_lo, g_lo, n)
     out_cols: list[Column] = []
@@ -157,6 +245,10 @@ def groupby_aggregate(
                     c.dtype, jnp.zeros((m,), jnp.int32), valid,
                     chars=jnp.zeros((m, 1), jnp.uint8),
                 ))
+            elif c.dtype.is_decimal128:
+                out_cols.append(
+                    Column(c.dtype, jnp.zeros((m, 2), jnp.int64), valid)
+                )
             else:
                 out_cols.append(
                     Column(c.dtype, jnp.zeros((m,), c.dtype.jnp_dtype), valid)
@@ -183,11 +275,32 @@ def groupby_aggregate(
         int_lanes.append(arr.astype(jnp.int64))
         return len(int_lanes) - 1
 
+    _M32 = jnp.int64(0xFFFFFFFF)
+
     plan = []  # (op, column, acc_dt, lane ids / None)
     for col_idx, op in aggs:
         c = sorted_tbl.column(col_idx)
         valid = c.valid_mask()
         count_lane = lane(valid)
+        if op in ("sum", "mean") and c.dtype.is_decimal128:
+            if op == "mean":
+                raise NotImplementedError(
+                    "DECIMAL128 mean is not supported (f64 on TPU is "
+                    "f32-pair emulated, ~49-bit mantissa — a lossy mean "
+                    "would be silent corruption); sum/count instead"
+                )
+            # exact 128-bit sum: split (lo, hi) into four 32-bit limb
+            # lanes so no int64 lane can overflow (sums bounded by
+            # 2^32 * n), recombined with carry propagation below; totals
+            # beyond 128 bits wrap two's-complement (the int64 SUM posture)
+            lo = jnp.where(valid, c.data[:, 0], jnp.int64(0))
+            hi = jnp.where(valid, c.data[:, 1], jnp.int64(0))
+            lanes128 = (
+                lane(lo & _M32), lane((lo >> 32) & _M32),
+                lane(hi & _M32), lane(hi >> 32),
+            )
+            plan.append(("sum128", c, c.dtype, lanes128, count_lane))
+            continue
         if op in ("sum", "mean"):
             acc_dt = _sum_dtype(c.dtype)
             vv = jnp.where(valid, c.data, jnp.zeros_like(c.data))
@@ -196,30 +309,30 @@ def groupby_aggregate(
             else:
                 plan.append((op, c, acc_dt, None, count_lane))
         else:
-            if c.dtype.is_decimal128:
-                raise NotImplementedError(
-                    "DECIMAL128 min/max is not supported yet"
-                )
             plan.append((op, c, None, None, count_lane))
 
-    _string_order_cache: dict = {}  # value-sort order per column, shared
-                                    # between a column's min and max aggs
+    _rank_order_cache: dict = {}  # value-sort order per column, shared
+                                  # between a column's min and max aggs
 
-    def _string_minmax(c: Column, op: str, vcount: jnp.ndarray) -> Column:
-        """MIN/MAX of a string column: rank rows by string order (one sort
-        of the value column), segment-reduce the int ranks, gather the
-        winning row's string — order statistics via ranks instead of
-        per-group byte comparisons."""
+    def _rank_minmax(c: Column, op: str, vcount: jnp.ndarray) -> Column:
+        """MIN/MAX of a column with no elementwise-reducible storage
+        (strings, DECIMAL128 limb pairs): rank rows by value order (one
+        sort of the value column), segment-reduce the int ranks, gather
+        the winning row — order statistics via ranks instead of per-group
+        comparator loops."""
         if n == 0:
-            return Column(c.dtype, jnp.zeros((m,), jnp.int32),
-                          jnp.zeros((m,), jnp.bool_),
-                          chars=jnp.zeros((m, 1), jnp.uint8))
+            if c.dtype.is_string:
+                return Column(c.dtype, jnp.zeros((m,), jnp.int32),
+                              jnp.zeros((m,), jnp.bool_),
+                              chars=jnp.zeros((m, 1), jnp.uint8))
+            return Column(c.dtype, jnp.zeros((m, 2), jnp.int64),
+                          jnp.zeros((m,), jnp.bool_))
         cache_key = id(c)
-        if cache_key not in _string_order_cache:
-            _string_order_cache[cache_key] = sort_order(
+        if cache_key not in _rank_order_cache:
+            _rank_order_cache[cache_key] = sort_order(
                 Table([c]), [0], nulls_first=[False]  # nulls last
             )
-        order_v = _string_order_cache[cache_key]
+        order_v = _rank_order_cache[cache_key]
         rank = jnp.zeros((n,), jnp.int32).at[order_v].set(
             jnp.arange(n, dtype=jnp.int32)
         )
@@ -227,17 +340,25 @@ def groupby_aggregate(
         sentinel = jnp.int32(n if op == "min" else -1)
         rank = jnp.where(c.valid_mask(), rank, sentinel)
         if op == "min":
-            best = jnp.full((m,), n, jnp.int32).at[group_id].min(rank)
+            best = jnp.full((m,), n, jnp.int32).at[_gid()].min(rank)
         else:
-            best = jnp.full((m,), -1, jnp.int32).at[group_id].max(rank)
+            best = jnp.full((m,), -1, jnp.int32).at[_gid()].max(rank)
         has_any = vcount > 0
         winner_row = order_v[jnp.clip(best, 0, max(n - 1, 0))]
-        from spark_rapids_jni_tpu.ops import strings as s
+        if c.dtype.is_string:
+            from spark_rapids_jni_tpu.ops import strings as s
 
-        g = s.gather_strings(c, winner_row)
-        return Column(c.dtype, g.data, has_any, chars=g.chars)
+            g = s.gather_strings(c, winner_row)
+            return Column(c.dtype, g.data, has_any, chars=g.chars)
+        return Column(c.dtype, c.data[winner_row], has_any)
 
-    if int_lanes and n:
+    if int_lanes and n and small:
+        # one bandwidth pass over the lanes + O(m * block) boundary work;
+        # empty groups have g_lo == g_hi == n so their difference is 0
+        stack = jnp.stack(int_lanes, axis=1)  # (n, k)
+        pref = _boundary_prefix(stack, jnp.concatenate([g_hi, g_lo]), block)
+        seg = pref[:m] - pref[m:]
+    elif int_lanes and n:
         stack = jnp.stack(int_lanes, axis=1)  # (n, k)
         cs = jnp.cumsum(stack, axis=0)
         lo_c = jnp.clip(g_lo, 0, n - 1)
@@ -251,6 +372,17 @@ def groupby_aggregate(
     for op, c, acc_dt, val_lane, count_lane in plan:
         valid = c.valid_mask()
         vcount = seg[:, count_lane]
+        if op == "sum128":
+            s0, s1, s2, s3 = (seg[:, i] for i in val_lane)
+            c0 = s0 & _M32
+            t = s1 + (s0 >> 32)
+            lo = c0 | ((t & _M32) << 32)
+            u = s2 + (t >> 32)
+            hi = (u & _M32) + ((s3 + (u >> 32)) << 32)
+            out_cols.append(Column(
+                acc_dt, jnp.stack([lo, hi], axis=-1), vcount > 0
+            ))
+            continue
         if op == "count":
             out_cols.append(
                 Column(DType(TypeId.INT64), vcount,
@@ -265,7 +397,7 @@ def groupby_aggregate(
                 vv = jnp.where(valid, c.data, jnp.zeros_like(c.data)).astype(
                     acc_dt.jnp_dtype
                 )
-                total = jax.ops.segment_sum(vv, group_id, num_segments=m)
+                total = jax.ops.segment_sum(vv, _gid(), num_segments=m)
             if op == "sum":
                 out_cols.append(Column(acc_dt, total, has_any))
             else:
@@ -279,8 +411,8 @@ def groupby_aggregate(
                 out_cols.append(Column(DType(TypeId.FLOAT64), mean, has_any))
             continue
         # min / max with null-neutral sentinels
-        if c.dtype.is_string:
-            out_cols.append(_string_minmax(c, op, vcount))
+        if c.dtype.is_string or c.dtype.is_decimal128:
+            out_cols.append(_rank_minmax(c, op, vcount))
             continue
         np_dt = c.dtype.storage_dtype
         if np_dt.kind == "f":
@@ -290,10 +422,10 @@ def groupby_aggregate(
             lo, hi = info.min, info.max
         if op == "min":
             vv = jnp.where(valid, c.data, jnp.asarray(hi, dtype=c.data.dtype))
-            red = jax.ops.segment_min(vv, group_id, num_segments=m)
+            red = jax.ops.segment_min(vv, _gid(), num_segments=m)
         else:
             vv = jnp.where(valid, c.data, jnp.asarray(lo, dtype=c.data.dtype))
-            red = jax.ops.segment_max(vv, group_id, num_segments=m)
+            red = jax.ops.segment_max(vv, _gid(), num_segments=m)
         out_cols.append(Column(c.dtype, red, vcount > 0))
 
     return GroupByResult(Table(out_cols), num_groups, overflowed)

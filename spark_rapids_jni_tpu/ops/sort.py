@@ -53,9 +53,16 @@ def _key_arrays(col: Column, ascending: bool, nulls_first: bool):
     valid = col.valid_mask()
 
     if dtype.is_decimal128:
-        raise NotImplementedError(
-            "DECIMAL128 sort keys are not supported yet (limb-pair compare)"
-        )
+        # limb-pair compare: unsigned low limb minor, sign-flipped high limb
+        # major — uint ordering on the pair == 128-bit integer ordering
+        lo_u = col.data[:, 0].astype(jnp.uint64)
+        hi_u = col.data[:, 1].astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+        value_keys = [lo_u, hi_u]
+        if not ascending:
+            value_keys = [~k for k in value_keys]
+        null_key = jnp.where(valid, jnp.uint8(1), jnp.uint8(0))
+        null_rank = null_key if nulls_first else jnp.uint8(1) - null_key
+        return value_keys + [null_rank]
     if dtype.is_string:
         from spark_rapids_jni_tpu.ops import strings as s
 
@@ -93,6 +100,58 @@ def _key_arrays(col: Column, ascending: bool, nulls_first: bool):
     return value_keys + [null_rank]  # null rank is most significant
 
 
+def _key_bits(arr: jnp.ndarray) -> int | None:
+    """Bit width of a lexsort key array, or None if not a packable uint."""
+    return {
+        jnp.dtype(jnp.bool_): 1,
+        jnp.dtype(jnp.uint8): 8,
+        jnp.dtype(jnp.uint16): 16,
+        jnp.dtype(jnp.uint32): 32,
+    }.get(jnp.dtype(arr.dtype))
+
+
+def _pack_lex_keys(lex_keys: list[jnp.ndarray]) -> list[jnp.ndarray]:
+    """Fuse minor->major unsigned lex keys into as few words as possible.
+
+    A variadic lexsort pays a multi-operand comparator per sort pass; when
+    the combined key fits one machine word (the common relational case:
+    a couple of flag/dictionary/date keys plus null ranks), packing them
+    into a single uint32 collapses the whole thing to one single-key
+    argsort, which XLA sorts substantially faster. 64-bit packs use a
+    (hi, lo) uint32 pair rather than uint64 — int64 is emulated on the
+    TPU VPU, and two 32-bit keys lexsort faster than one emulated 64-bit.
+    """
+    widths = [_key_bits(a) for a in lex_keys]
+    if any(w is None for w in widths) or sum(widths) > 64:
+        return lex_keys
+    total = sum(widths)
+
+    def fold(keys: list[jnp.ndarray]) -> jnp.ndarray:
+        # keys are minor -> major: the LAST is the most significant field
+        acc = None
+        for a in reversed(keys):
+            w = _key_bits(a)
+            a32 = a.astype(jnp.uint32)
+            acc = a32 if acc is None else (acc << w) | a32
+        return acc
+
+    if total <= 32:
+        return [fold(lex_keys)]
+    # split the minor->major run into a low word and a high word, each
+    # <=32 bits; if the high run cannot fit its own word (e.g. a 32-bit
+    # value key + null rank landing together), packing is not possible
+    lo_run, bits = [], 0
+    for i, a in enumerate(lex_keys):
+        w = _key_bits(a)
+        if bits + w > 32:
+            if sum(widths[i:]) > 32:
+                return lex_keys
+            return [fold(lo_run), fold(lex_keys[i:])]
+        lo_run.append(a)
+        bits += w
+    raise AssertionError("unreachable: total > 32 must split")
+
+
 @func_range("sort_order")
 def sort_order(
     table: Table,
@@ -110,6 +169,9 @@ def sort_order(
     for k, asc, nf in zip(reversed(list(keys)), reversed(list(ascending)),
                           reversed(list(nulls_first))):
         lex_keys.extend(_key_arrays(table.column(k), asc, nf))
+    lex_keys = _pack_lex_keys(lex_keys)
+    if len(lex_keys) == 1:
+        return jnp.argsort(lex_keys[0], stable=True).astype(jnp.int32)
     return jnp.lexsort(tuple(lex_keys)).astype(jnp.int32)
 
 

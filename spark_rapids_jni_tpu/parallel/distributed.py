@@ -145,52 +145,72 @@ def distributed_groupby_aggregate(
     return DistributedGroupBy(out_tbl, num_groups, overflowed)
 
 
+@jax.jit
+def _compact_to_front(table: Table, counts: jnp.ndarray) -> Table:
+    """Device-side compaction of a per-device-padded sharded result: gather
+    every device's first counts[i] rows into a contiguous prefix. One
+    searchsorted-driven gather (the framework's scatter-free routing idiom);
+    XLA/GSPMD inserts the cross-shard collective. Rows past the real total
+    are clamped repeats of row 0 — the caller slices them off."""
+    d = counts.shape[0]
+    n = table.num_rows
+    per_dev = n // d
+    off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)]
+    )  # (d+1,) exclusive prefix
+    j = jnp.arange(n, dtype=jnp.int32)
+    dev = jnp.clip(
+        jnp.searchsorted(off[1:], j, side="right").astype(jnp.int32), 0, d - 1
+    )
+    src = dev * per_dev + (j - off[dev])
+    src = jnp.where(j < off[-1], src, 0)
+    from spark_rapids_jni_tpu.ops.sort import gather
+
+    return gather(table, src)
+
+
 def collect(table: Table, num_rows_per_device: jnp.ndarray, mesh: Mesh) -> Table:
-    """Host-side gather of a sharded, per-device-padded result into one
-    compact host table (the driver-side collect of a Spark job)."""
+    """Driver-side collect of a sharded, per-device-padded result into one
+    compact host table. The compaction runs on-device in one jitted gather
+    (not a per-device host loop), so exactly ``total`` rows cross to the
+    host — one bounded transfer per buffer, O(result), not O(padded)."""
+    counts = jnp.asarray(num_rows_per_device).reshape(-1).astype(jnp.int32)
     d = int(np.prod(list(mesh.shape.values())))
-    per_dev = table.num_rows // d
-    counts = np.asarray(num_rows_per_device).reshape(-1)
-    cols: list[list] = [[] for _ in table.columns]
-    for dev in range(d):
-        k = int(counts[dev])
-        for i, c in enumerate(table.columns):
-            lo = dev * per_dev
-            data = np.asarray(c.data[lo : lo + k])
-            valid = np.asarray(c.valid_mask()[lo : lo + k])
-            chars = (
-                np.asarray(c.chars[lo : lo + k])
-                if c.is_padded_string else None
-            )
-            cols[i].append((data, valid, chars))
+    if counts.shape[0] != d:
+        raise ValueError(
+            f"collect: {counts.shape[0]} per-device counts for a "
+            f"{d}-device mesh"
+        )
+    compacted = _compact_to_front(table, counts)
+    total = int(np.asarray(counts).astype(np.int64).sum())
     out = []
-    for c, parts in zip(table.columns, cols):
-        data = np.concatenate([p[0] for p in parts])
-        valid = np.concatenate([p[1] for p in parts])
+    for c in compacted.columns:
+        valid = np.asarray(c.valid_mask()[:total])
         if c.is_padded_string:
-            # back to the Arrow at-rest layout on host: one boolean-mask
-            # flatten per device chunk (vectorized, no per-row loop)
-            lengths = data  # string columns carry int32 lengths as data
-            blob = np.concatenate([
+            # back to the Arrow at-rest layout on host: lengths ride the
+            # data buffer; flatten the fetched (total, W) char matrix
+            lens = np.asarray(c.data[:total])
+            mat = np.asarray(c.chars[:total])
+            blob = (
                 mat.reshape(-1)[
                     (np.arange(mat.shape[1])[None, :] < lens[:, None]).reshape(-1)
                 ]
-                for (lens, _, mat) in parts
-            ]) if lengths.size else np.zeros((0,), np.uint8)
-            total = int(lengths.astype(np.int64).sum())
-            if total > np.iinfo(np.int32).max:
+                if lens.size else np.zeros((0,), np.uint8)
+            )
+            nbytes = int(lens.astype(np.int64).sum())
+            if nbytes > np.iinfo(np.int32).max:
                 raise ValueError(
-                    f"collected string column holds {total} bytes, over the "
+                    f"collected string column holds {nbytes} bytes, over the "
                     "int32 Arrow offset bound (2^31-1); collect in batches"
                 )
-            offsets = np.zeros(lengths.size + 1, dtype=np.int32)
-            np.cumsum(lengths, out=offsets[1:])
+            offsets = np.zeros(lens.size + 1, dtype=np.int32)
+            np.cumsum(lens, out=offsets[1:])
             out.append(Column(
                 c.dtype, jnp.asarray(offsets), jnp.asarray(valid),
                 chars=jnp.asarray(blob),
             ))
             continue
-        out.append(Column(c.dtype, jnp.asarray(data), jnp.asarray(valid)))
+        out.append(Column(c.dtype, jnp.asarray(c.data[:total]), jnp.asarray(valid)))
     return Table(out)
 
 

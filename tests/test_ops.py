@@ -404,3 +404,123 @@ def test_sort_float32_negative_nan_greatest():
 
     res = groupby_aggregate(tbl, keys=[0], aggs=[(0, "count")])
     assert int(res.num_groups) == 4  # -2, 1.5, 7, one unified NaN group
+
+
+# ---- small-m boundary path (blocked group starts + boundary prefix) --------
+
+
+def _groupby_tables_equal(a, b):
+    assert a.num_columns == b.num_columns
+    for i in range(a.num_columns):
+        ca, cb = a.column(i), b.column(i)
+        va, vb = np.asarray(ca.valid_mask()), np.asarray(cb.valid_mask())
+        assert np.array_equal(va, vb), f"col {i} validity"
+        da, db = np.asarray(ca.data), np.asarray(cb.data)
+        assert np.array_equal(da[va], db[vb]), f"col {i} data"
+
+
+def test_groupby_small_m_matches_default_path(rng):
+    # n deliberately not a multiple of the block size; spans >1 block
+    n = 4000
+    k1 = rng.integers(0, 5, n).astype(np.int8)
+    k2 = rng.integers(0, 3, n).astype(np.int8)
+    kvalid = rng.random(n) > 0.05
+    vals = rng.integers(-1000, 1000, n).astype(np.int64)
+    vvalid = rng.random(n) > 0.2
+    fvals = rng.normal(size=n)
+    tbl = Table([
+        Column.from_numpy(k1, validity=kvalid),
+        Column.from_numpy(k2),
+        Column.from_numpy(vals, validity=vvalid),
+        Column.from_numpy(fvals),
+    ])
+    aggs = [(2, "sum"), (2, "count"), (2, "mean"), (2, "min"), (2, "max"),
+            (3, "sum")]
+    # max_groups=32 passes the blocked-boundary gate (2*32*32 <= 4000);
+    # max_groups=None (m=n=4000 > _SMALL_M) takes the scan path
+    fast = groupby_aggregate(tbl, [0, 1], aggs, max_groups=32)
+    slow = groupby_aggregate(tbl, [0, 1], aggs)
+    assert int(fast.num_groups) == int(slow.num_groups)
+    assert not bool(fast.overflowed)
+    _groupby_tables_equal(fast.compact(), slow.compact())
+
+
+def test_groupby_small_m_exact_fit_and_overflow(rng):
+    n = 700  # > one block, < two
+    keys = rng.integers(0, 10, n).astype(np.int32)
+    vals = rng.integers(0, 100, n).astype(np.int64)
+    tbl = Table([Column.from_numpy(keys), Column.from_numpy(vals)])
+    true_k = len(np.unique(keys))
+    exact = groupby_aggregate(tbl, [0], [(1, "sum")], max_groups=true_k)
+    assert not bool(exact.overflowed)
+    assert int(exact.num_groups) == true_k
+    over = groupby_aggregate(tbl, [0], [(1, "sum")], max_groups=true_k - 1)
+    assert bool(over.overflowed)
+    # overflow still computes the exact total and the first m groups exactly
+    assert int(over.num_groups) == true_k
+    uniq = np.unique(keys)
+    got = np.asarray(over.table.column(0).data)[: true_k - 1]
+    assert np.array_equal(got, uniq[: true_k - 1])
+    want = [vals[keys == u].sum() for u in uniq[: true_k - 1]]
+    assert np.array_equal(
+        np.asarray(over.table.column(1).data)[: true_k - 1], want
+    )
+
+
+def test_groupby_small_m_group_spanning_blocks():
+    # one giant group crossing many blocks + a tiny one at the end: the
+    # boundary-prefix path must sum across full blocks + a partial block
+    from spark_rapids_jni_tpu.ops.groupby import _MAX_BLOCK
+
+    n = 3 * _MAX_BLOCK + 17
+    keys = np.zeros(n, dtype=np.int32)
+    keys[-5:] = 9
+    vals = np.arange(n, dtype=np.int64)
+    tbl = Table([Column.from_numpy(keys), Column.from_numpy(vals)])
+    res = groupby_aggregate(tbl, [0], [(1, "sum"), (1, "count")],
+                            max_groups=4)
+    out = res.compact()
+    assert int(res.num_groups) == 2
+    assert list(np.asarray(out.column(1).data)) == [
+        int(vals[:-5].sum()), int(vals[-5:].sum())
+    ]
+    assert list(np.asarray(out.column(2).data)) == [n - 5, 5]
+
+
+def test_sort_packed_key_matches_multikey(rng):
+    # two int8 keys + null ranks pack into one uint32 argsort; verify the
+    # permutation matches numpy's stable lexsort on the same keys
+    n = 513
+    k1 = rng.integers(-3, 3, n).astype(np.int8)
+    k2 = rng.integers(0, 4, n).astype(np.int8)
+    valid = rng.random(n) > 0.1
+    tbl = Table([Column.from_numpy(k1, validity=valid),
+                 Column.from_numpy(k2)])
+    order = np.asarray(sort_order(tbl, [0, 1]))
+    # numpy oracle mirroring the key encoding: null rank most significant
+    # (nulls first), then the k1 value key (null rows keep their stored
+    # value as tie-break, same as the unpacked lexsort), then k2; stable
+    oracle = np.lexsort((k2, k1, valid.astype(np.int8)))
+    assert np.array_equal(order, oracle)
+
+
+def test_sort_packed_key_32bit_primary_with_nulls(rng):
+    # regression: [int32 key, int8 key] produces a 40-bit high run (uint32
+    # value + uint8 null rank) that must NOT be folded into one uint32 —
+    # doing so drops the primary null rank and interleaves null rows by
+    # their stored garbage values
+    n = 400
+    k1 = rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32)
+    k2 = rng.integers(0, 5, n).astype(np.int8)
+    valid = rng.random(n) > 0.3
+    tbl = Table([Column.from_numpy(k1, validity=valid),
+                 Column.from_numpy(k2)])
+    order = np.asarray(sort_order(tbl, [0, 1]))
+    sv = valid[order]
+    # nulls first (default): all null rows precede all valid rows
+    assert not np.any(np.diff(sv.astype(np.int8)) < 0) or sv[0] == False  # noqa: E712
+    nnull = int((~valid).sum())
+    assert not sv[:nnull].any() and sv[nnull:].all()
+    # valid rows ordered by k1 then k2
+    vk1 = k1[order][nnull:]
+    assert np.all(np.diff(vk1.astype(np.int64)) >= 0)

@@ -200,7 +200,10 @@ def test_graft_entry_dryrun():
 
     fn, args = ge.entry()
     out = jax.jit(fn)(*args)
-    assert out.num_rows == args[0].num_rows
+    # q1 output is padded to its static group budget, not to n
+    from spark_rapids_jni_tpu.models.tpch import _Q1_GROUP_BUDGET
+
+    assert out.num_rows == _Q1_GROUP_BUDGET
     ge.dryrun_multichip(8)
 
 
@@ -317,3 +320,40 @@ def test_wire_narrowing_ignores_null_garbage(rng, mesh):
     got = np.asarray(out.column(1).data)[rv]
     ok = np.asarray(out.column(1).valid_mask())[rv]
     np.testing.assert_array_equal(np.sort(got[ok]), np.sort(data[valid]))
+
+
+def test_distributed_groupby_high_cardinality(rng, mesh):
+    """VERDICT r2 item 8: >=1e5 distinct groups through the distributed
+    groupby within a bounded shuffle capacity — the scaling-discipline
+    proof that output cardinality is not silently capped."""
+    n = 1 << 18
+    n_keys = 100_001
+    keys = rng.integers(0, n_keys, n).astype(np.int64)
+    vals = rng.integers(0, 1000, n).astype(np.int64)
+    tbl = Table([Column.from_numpy(keys), Column.from_numpy(vals)])
+    sharded = shard_table(tbl, mesh)
+    d = mesh.shape[EXEC_AXIS]
+    # hash partitioning is near-uniform: 2x headroom over the mean load
+    capacity = (n // d) * 2
+    res = distributed_groupby_aggregate(
+        sharded, [0], [(1, "sum"), (1, "count")], mesh, capacity=capacity
+    )
+    assert not np.asarray(res.overflowed).any()
+    total_groups = int(np.asarray(res.num_groups).sum())
+    # padding rows form one null-key pseudo-group per device
+    import collections
+
+    want = collections.Counter(keys.tolist())
+    assert total_groups >= len(want)
+    out = collect(res.table, res.num_groups, mesh)
+    kv = np.asarray(out.column(0).valid_mask())
+    got_keys = np.asarray(out.column(0).data)[kv]
+    got_sums = np.asarray(out.column(1).data)[kv]
+    got_counts = np.asarray(out.column(2).data)[kv]
+    assert len(got_keys) == len(want)
+    want_sums = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        want_sums[k] = want_sums.get(k, 0) + v
+    sums_by_key = dict(zip(got_keys.tolist(), got_sums.tolist()))
+    assert sums_by_key == want_sums
+    assert dict(zip(got_keys.tolist(), got_counts.tolist())) == dict(want)

@@ -1,6 +1,10 @@
 """TPC-H q1 integration test: the full pipeline vs the numpy oracle."""
 
 import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
 
 from spark_rapids_jni_tpu.models.tpch import (
     lineitem_table,
@@ -72,3 +76,38 @@ def test_q1_null_discount_tax_propagate():
     # sum_disc_price must skip the null-discount row: 3 * 2000*(100-5)
     assert int(np.asarray(out.column(4).data)[0]) == 3 * 2000 * 95
     assert int(np.asarray(out.column(5).data)[0]) == 3 * 2000 * 95 * 103
+
+
+def test_tpch_q1_checked_rejects_out_of_contract_key_domain(rng):
+    # >64 distinct (returnflag, linestatus) byte pairs violate the plan's
+    # group-budget contract; the host wrapper must raise, not drop groups
+    from spark_rapids_jni_tpu.models.tpch import lineitem_table, tpch_q1_checked
+
+    li = lineitem_table(4096)
+    cols = list(li.columns)
+    rf = rng.integers(0, 16, 4096).astype(np.int8)
+    ls = rng.integers(0, 8, 4096).astype(np.int8)
+    cols[4] = Column.from_numpy(rf, t.INT8)
+    cols[5] = Column.from_numpy(ls, t.INT8)
+    with pytest.raises(ValueError, match="group budget"):
+        tpch_q1_checked(Table(cols))
+
+
+def test_tpch_q1_checked_matches_oracle(rng):
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_table, tpch_q1_checked, tpch_q1_numpy)
+
+    li = lineitem_table(3000)
+    out = tpch_q1_checked(li)
+    oracle = tpch_q1_numpy(li)
+    vm = (np.asarray(out.column(0).valid_mask())
+          & np.asarray(out.column(1).valid_mask()))
+    got = {}
+    for i in np.nonzero(vm)[0]:
+        got[(int(np.asarray(out.column(0).data)[i]),
+             int(np.asarray(out.column(1).data)[i]))] = (
+            int(np.asarray(out.column(2).data)[i]),
+            int(np.asarray(out.column(9).data)[i]),
+        )
+    want = {k: (v["sum_qty"], v["count"]) for k, v in oracle.items()}
+    assert got == want
