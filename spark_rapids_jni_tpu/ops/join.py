@@ -139,6 +139,9 @@ def _concat_key_columns(lc: Column, rc: Column) -> Column:
             validity,
             chars=jnp.concatenate([widen(lp), widen(rp)]),
         )
+    if lc.dtype.is_decimal128:
+        # limb-pair storage concatenates along the row axis like any other
+        return Column(lc.dtype, jnp.concatenate([lc.data, rc.data]), validity)
     if lc.dtype.storage_dtype != rc.dtype.storage_dtype:
         raise TypeError("join key storage types must match")
     return Column(lc.dtype, jnp.concatenate([lc.data, rc.data]), validity)
@@ -208,6 +211,7 @@ def join(
         len(left_keys) == 1
         and lc.dtype == rc0.dtype  # incl. decimal scale — unscaled values
         and not lc.dtype.is_string  # only compare at identical scales
+        and not lc.dtype.is_decimal128  # limb pairs go via rank encoding
         and lc.dtype.storage_dtype.kind in ("i", "u")
     )
     if single_integral:

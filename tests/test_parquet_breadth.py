@@ -146,22 +146,24 @@ class TestDecimal128OpBoundaries:
         i = Column.from_pylist([1, 2, 3], t.INT64)
         return Table([d, i])
 
-    def test_groupby_rejects_cleanly(self):
+    def test_groupby_supported_and_mean_rejects(self):
+        # relational support landed in round 3 (tests/test_decimal128_ops.py
+        # is the full oracle suite); only the lossy mean stays rejected
         from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
 
         tbl = self._col()
+        out = groupby_aggregate(tbl, [0], [(1, "sum")]).compact()
+        assert out.column(0).to_pylist() == [-(1 << 70), 5, 1 << 70]
+        out2 = groupby_aggregate(tbl, [1], [(0, "sum"), (0, "min")]).compact()
+        assert out2.column(1).to_pylist() == [1 << 70, -(1 << 70), 5]
         with pytest.raises(NotImplementedError, match="DECIMAL128"):
-            groupby_aggregate(tbl, [0], [(1, "sum")])
-        with pytest.raises(NotImplementedError, match="DECIMAL128"):
-            groupby_aggregate(tbl, [1], [(0, "sum")])
-        with pytest.raises(NotImplementedError, match="DECIMAL128"):
-            groupby_aggregate(tbl, [1], [(0, "min")])
+            groupby_aggregate(tbl, [1], [(0, "mean")])
 
-    def test_sort_key_rejects_cleanly(self):
+    def test_sort_key_supported(self):
         from spark_rapids_jni_tpu.ops.sort import sort_table
 
-        with pytest.raises(NotImplementedError, match="DECIMAL128"):
-            sort_table(self._col(), [0])
+        out = sort_table(self._col(), [0])
+        assert out.column(0).to_pylist() == [-(1 << 70), 5, 1 << 70]
 
     def test_row_gather_works(self):
         # non-key usage (gather through sort on another key) is supported
