@@ -225,6 +225,44 @@ def _bench_parquet_q1(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_json_extract(n: int, iters: int):
+    """Device JSONPath engine ($.field over generated flat-ish documents):
+    the get_json_object fast path, measured fully on-device (the host
+    engine's round trip is exactly what this path removes)."""
+    import jax
+    import numpy as np
+
+    from spark_rapids_jni_tpu import types as t
+    from spark_rapids_jni_tpu.columnar import Column
+    from spark_rapids_jni_tpu.ops import json_device as jd
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    rng = np.random.default_rng(0)
+    docs = []
+    for i in range(min(n, 4096)):  # template pool; tiled to n below
+        price = int(rng.integers(1, 10_000))
+        qty = int(rng.integers(1, 100))
+        docs.append(
+            '{"sku":"s%d","price":%d,"qty":%d,"meta":{"w":%d}}'
+            % (i, price, qty, qty * 2)
+        )
+    docs = (docs * (n // len(docs) + 1))[:n]
+    col = pad_strings(Column.from_pylist(docs, t.STRING))
+    assert bool(jd.device_eligible(col))
+
+    def digest(c):
+        out = jd.get_json_object_device(c, "$.meta.w")
+        import jax.numpy as jnp
+
+        return (jnp.sum(out.data).astype(jnp.float64)
+                + jnp.sum(out.chars).astype(jnp.float64)
+                + jnp.sum(out.valid_mask()).astype(jnp.float64))
+
+    fn = jax.jit(digest)
+    per_iter = _measure(lambda: fn(col), iters)
+    return n / per_iter
+
+
 def _bench_shuffle_wire(n: int, iters: int):
     """Compressed shuffle transport: hash_shuffle with narrowing + BitPack
     wire specs over the executor mesh (every visible device; 1 on the
@@ -289,6 +327,7 @@ _CONFIGS = {
     "row_conversion": (_bench_row_conversion, "row_conversion_gb_per_s", "GB/s"),
     "parquet_q1": (_bench_parquet_q1, "parquet_q1_rows_per_s", "rows/s"),
     "shuffle_wire": (_bench_shuffle_wire, "shuffle_wire_gb_per_s", "GB/s"),
+    "json_extract": (_bench_json_extract, "json_extract_rows_per_s", "rows/s"),
 }
 
 

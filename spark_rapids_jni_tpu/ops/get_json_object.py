@@ -1,14 +1,23 @@
 """get_json_object over string columns — the Spark SQL JSONPath extractor
 (north-star JNI kernel; BASELINE.json lists it explicitly).
 
-The extraction engine is native C++ (src/native/src/get_json_object.cpp):
-JSON navigation is a branchy byte-level state machine over variable-length
-strings, which is host work in this design round — the column round-trips
-host<->HBM around the call. Path grammar: ``$``, ``.field``, ``['field']``,
+Two engines behind one dispatcher:
+
+* **Device fast path** (``ops/json_device.py``): fully vectorized XLA
+  program over the padded (n, W) layout — structural classification via
+  quote-parity/bracket-depth scans, span narrowing per path component. No
+  host round trip. Taken when every row is escape-free and structurally
+  sane (one scalar eligibility fetch decides).
+* **Native host engine** (src/native/src/get_json_object.cpp): the branchy
+  byte-level state machine, used for escaped/malformed inputs — the cases
+  where a data-dependent parse genuinely beats a vectorized one.
+
+Path grammar (both engines): ``$``, ``.field``, ``['field']``,
 ``[index]``; wildcards raise ValueError (Spark's analyzer behavior for
-paths it cannot compile). String matches come back unquoted with escapes
-decoded; object/array/number/bool matches come back as raw JSON text; JSON
-null and missing paths are SQL NULL.
+paths it cannot compile). String matches come back unquoted (escapes
+decoded on the host path; the device path never sees escapes);
+object/array/number/bool matches come back as raw JSON text; JSON null and
+missing paths are SQL NULL.
 """
 
 from __future__ import annotations
@@ -27,9 +36,32 @@ from spark_rapids_jni_tpu.utils.tracing import func_range
 
 @func_range("get_json_object")
 def get_json_object(col: Column, path: str) -> Column:
-    """Extract ``path`` from every JSON document in a STRING column."""
+    """Extract ``path`` from every JSON document in a STRING column.
+    Dispatches to the device engine when the column is eligible; the
+    native host engine otherwise."""
     if not col.dtype.is_string:
         raise TypeError("get_json_object requires a STRING column")
+    from spark_rapids_jni_tpu.ops import json_device as jd
+
+    # one jitted device pass computes the extraction AND the eligibility
+    # verdict from a shared structural classification; only the 1-byte
+    # verdict crosses to the host
+    result, eligible = jd.extract_with_eligibility(col, path)
+    if bool(eligible):
+        return result
+    return get_json_object_host(col, path)
+
+
+@func_range("get_json_object_host")
+def get_json_object_host(col: Column, path: str) -> Column:
+    """Native-engine path (host round trip) — escape decoding and full
+    grammar validation live here."""
+    if not col.dtype.is_string:
+        raise TypeError("get_json_object requires a STRING column")
+    if col.is_padded_string:
+        from spark_rapids_jni_tpu.ops.strings import unpad_strings
+
+        col = unpad_strings(col)
     lib = load_native()
     n = col.size
     offsets = np.ascontiguousarray(np.asarray(col.data), dtype=np.int32)

@@ -1,0 +1,131 @@
+"""Device JSONPath engine vs the native host engine (the semantic oracle):
+randomized well-formed documents plus adversarial structural cases, same
+column through both engines, exact equality required (SURVEY.md section 4
+round-trip/golden-equality shape)."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops import json_device as jd
+from spark_rapids_jni_tpu.ops.get_json_object import (
+    get_json_object,
+    get_json_object_host,
+)
+
+
+def string_column(values):
+    return Column.from_pylist(values, t.STRING)
+
+
+def _rand_value(rng, depth):
+    r = rng.random()
+    if depth >= 3 or r < 0.35:
+        return rng.choice([
+            17, -3, 2.5, 1e3, True, False, None, "plain", "", "x y",
+            "été",  # utf-8 multibyte, no escapes
+        ])
+    if r < 0.6:
+        return {k: _rand_value(rng, depth + 1)
+                for k in rng.sample(["a", "b", "field", "nm", "z9"],
+                                    rng.randint(0, 4))}
+    return [_rand_value(rng, depth + 1) for _ in range(rng.randint(0, 4))]
+
+
+def _dumps(rng, obj):
+    # vary whitespace: compact, spaced, or sprinkled newlines
+    style = rng.random()
+    if style < 0.4:
+        return json.dumps(obj, separators=(",", ":"), ensure_ascii=False)
+    if style < 0.8:
+        return json.dumps(obj, ensure_ascii=False)
+    return json.dumps(obj, indent=1, ensure_ascii=False)
+
+
+PATHS = ["$", "$.a", "$.field", "$.nm.a", "$.a.b", "$.a[0]", "$.a[1]",
+         "$['field']", "$.a[2].b", "$.b.field", "$.z9"]
+
+
+def test_device_engine_matches_native_randomized():
+    rng = random.Random(1234)
+    docs = []
+    for _ in range(300):
+        docs.append(_dumps(rng, {
+            k: _rand_value(rng, 1)
+            for k in rng.sample(["a", "b", "field", "nm", "z9"],
+                                rng.randint(0, 5))
+        }))
+    docs += [None, "", "   "]
+    col = string_column(docs)
+    assert bool(jd.device_eligible(col))
+    for path in PATHS:
+        dev = jd.get_json_object_device(col, path).to_pylist()
+        host = get_json_object_host(col, path).to_pylist()
+        assert dev == host, f"path {path}: {dev[:8]} != {host[:8]}"
+
+
+def test_device_engine_adversarial_structurals():
+    docs = [
+        '{"x":"field","field":1}',          # value string shadows a key
+        '{"x":"field"}',                    # only the shadow, no real key
+        '{"a":{"field":0},"field":2}',      # deeper same-name key first
+        '{"field":{"field":3}}',            # same name chained
+        '{"a":[{"field":1},{"field":2}]}',  # keys inside array elements
+        '{"field":[]}',                     # empty array
+        '{"field":{}}',                     # empty object
+        '{"field":""}',                     # empty string value
+        '{"field":null}',                   # JSON null -> SQL NULL
+        '{ "field" : 42 }',                 # spaced
+        '{"field":[1,[2,3],{"a":4}]}',      # nested array mix
+        '{"fiel":1,"fielded":2,"field":3}', # prefix/suffix name confusion
+        '[1,2,3]',                          # root array
+        '"rootstr"',                        # root string
+        '17',                               # root scalar
+        'null',                             # root null
+        '{}',                               # empty root
+    ]
+    col = string_column(docs)
+    assert bool(jd.device_eligible(col))
+    for path in ["$", "$.field", "$.field[1]", "$.a[1]", "$.a[1].field",
+                 "$.field.field", "$.a[2].a", "$[1]"]:
+        dev = jd.get_json_object_device(col, path).to_pylist()
+        host = get_json_object_host(col, path).to_pylist()
+        assert dev == host, f"path {path}: {dev} != {host}"
+
+
+def test_trailing_garbage_routes_to_host():
+    # balanced-but-invalid grammar (content past the root value) is exactly
+    # what the device sanity check must exclude; the dispatcher then gives
+    # the host engine's answer
+    docs = ['{"a":1}garbage', '17 garbage', '"s" x', '{"a":2}']
+    col = string_column(docs)
+    assert not bool(jd.device_eligible(col))
+    assert (get_json_object(col, "$.a").to_pylist()
+            == get_json_object_host(col, "$.a").to_pylist())
+
+
+def test_dispatcher_routes_escapes_to_host():
+    col = string_column(['{"s": "es\\"caped"}', '{"s": 1}'])
+    assert not bool(jd.device_eligible(col))
+    assert get_json_object(col, "$.s").to_pylist() == ['es"caped', "1"]
+
+
+def test_dispatcher_rejects_bad_paths_before_engine_choice():
+    col = string_column(['{"a": 1}'])
+    with pytest.raises(ValueError):
+        get_json_object(col, "$.a[*]")
+    with pytest.raises(ValueError):
+        get_json_object(col, "no-dollar")
+
+
+def test_device_engine_width_edge():
+    # rows whose key window sits at the very end of the char matrix
+    docs = ['{"k":1}', '{"kk":22}', '{"a":1,"k":9}']
+    col = string_column(docs)
+    dev = jd.get_json_object_device(col, "$.k").to_pylist()
+    host = get_json_object_host(col, "$.k").to_pylist()
+    assert dev == host == ["1", None, "9"]
