@@ -24,4 +24,5 @@ PY
 fi
 
 python build_scripts/build-info.py
+bash ci/java-build.sh   # self-gating: skips (exit 0) where no JDK exists
 python -m pytest tests/ -x -q

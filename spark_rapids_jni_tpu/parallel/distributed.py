@@ -85,11 +85,16 @@ def shard_table(
                 chars=jax.device_put(mat, sharding),
             ))
             continue
-        if not c.dtype.is_fixed_width:
+        if not (c.dtype.is_fixed_width or c.dtype.is_decimal128):
             raise NotImplementedError(
                 "shard_table: fixed-width and string columns only"
             )
-        data = jnp.concatenate([c.data, jnp.zeros((pad,), c.data.dtype)]) if pad else c.data
+        if pad:
+            data = jnp.concatenate(
+                [c.data, jnp.zeros((pad,) + c.data.shape[1:], c.data.dtype)]
+            )
+        else:
+            data = c.data
         valid = c.valid_mask()
         valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.bool_)]) if pad else valid
         out.append(

@@ -207,12 +207,17 @@ def shuffle_by_partition(
                 Column(col.dtype, recv_len, recv_valid, chars=recv_mat)
             )
             continue
-        if not col.dtype.is_fixed_width:
+        if not (col.dtype.is_fixed_width or col.dtype.is_decimal128):
             raise NotImplementedError(
                 "hash_shuffle supports fixed-width columns only (reference "
                 "row_conversion.cu:515 has the same restriction)"
             )
         wire = None if wire_dtypes is None else wire_dtypes[i]
+        if wire is not None and col.dtype.is_decimal128:
+            raise ValueError(
+                f"wire narrowing does not apply to DECIMAL128 (column {i}); "
+                "pass None for its wire dtype"
+            )
         if isinstance(wire, BitPack):
             # nvcomp-equivalent transport compression, stage 2: frame-of-
             # reference + bit-packing (parallel.wire). Null slots and

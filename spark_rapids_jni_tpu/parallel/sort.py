@@ -51,6 +51,12 @@ def _encode_primary(col: Column) -> jnp.ndarray:
         for b in range(min(8, width)):
             byte = jnp.where(b < lengths, mat[:, b], jnp.uint8(0))
             enc = enc | (byte.astype(jnp.uint64) << jnp.uint64(8 * (7 - b)))
+    elif col.dtype.is_decimal128:
+        # bucket on the sign-flipped high limb: the major component of
+        # 128-bit order; equal-hi values collapse to one bucket, and the
+        # local sort's full limb-pair keys keep global order exact (the
+        # same tie-collapse argument as the string 8-byte prefix)
+        enc = col.data[:, 1].astype(jnp.uint64) ^ jnp.uint64(1 << 63)
     elif col.dtype.storage_dtype == np.float64:
         # route on the float32 truncation: order-preserving bucketing only
         # (exact order is restored by the local sort's full-precision keys)

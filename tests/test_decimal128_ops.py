@@ -141,3 +141,62 @@ def test_decimal128_minmax_vs_python(rng):
     got_k = out.column(0).to_pylist()
     assert out.column(1).to_pylist() == [want_min.get(k) for k in got_k]
     assert out.column(2).to_pylist() == [want_max.get(k) for k in got_k]
+
+
+# ---- distributed layer -----------------------------------------------------
+
+
+def test_decimal128_distributed_groupby(rng):
+    from spark_rapids_jni_tpu.parallel import (
+        distributed_groupby_aggregate, executor_mesh, shard_table)
+    from spark_rapids_jni_tpu.parallel.distributed import collect
+
+    mesh = executor_mesh(8)
+    n = 512
+    pool = [
+        (int(rng.integers(-(2**30), 2**30)) << 64)
+        | int(rng.integers(0, 2**62)) for _ in range(5)
+    ]
+    keys = [pool[i] for i in rng.integers(0, 5, n)]
+    vals = _vals(rng, n)
+    tbl = Table([_col(keys), _col(vals)])
+    sharded = shard_table(tbl, mesh)
+    res = distributed_groupby_aggregate(
+        sharded, [0], [(1, "sum"), (1, "count")], mesh, capacity=n // 8
+    )
+    assert not np.asarray(res.overflowed).any()
+    out = collect(res.table, res.num_groups, mesh)
+    want = {}
+    for k, v in zip(keys, vals):
+        want[k] = want.get(k, 0) + v
+    got = {}
+    kv = out.column(0).to_pylist()
+    sv = out.column(1).to_pylist()
+    for k, v in zip(kv, sv):
+        if k is not None:
+            got[k] = v
+    assert got == want
+
+
+def test_decimal128_distributed_sort(rng):
+    from spark_rapids_jni_tpu.parallel import executor_mesh, shard_table
+    from spark_rapids_jni_tpu.parallel.distributed import collect
+    from spark_rapids_jni_tpu.parallel.sort import distributed_sort
+
+    mesh = executor_mesh(8)
+    n = 256
+    vals = _vals(rng, n)
+    tbl = Table([_col(vals)])
+    sharded, rv = shard_table(tbl, mesh, return_row_valid=True)
+    res = distributed_sort(sharded, [0], mesh, capacity=n, row_valid=rv)
+    assert not np.asarray(res.overflowed).any()
+    out = collect(res.table, res.num_rows, mesh)
+    assert out.column(0).to_pylist() == sorted(vals)
+
+
+def test_decimal128_spark_hash_guarded():
+    from spark_rapids_jni_tpu.ops.hash import table_xxhash64
+
+    tbl = Table([_col([1, 2])])
+    with pytest.raises(NotImplementedError, match="DECIMAL128"):
+        table_xxhash64(tbl)
