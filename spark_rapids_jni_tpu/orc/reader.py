@@ -9,8 +9,11 @@ byte budget — the stripe is ORC's row-group analogue.
 Type mapping (ORC kind -> DType):
   BOOLEAN -> BOOL8        BYTE -> INT8       SHORT -> INT16
   INT -> INT32            LONG -> INT64      FLOAT/DOUBLE -> FLOAT32/64
-  STRING/VARCHAR/CHAR -> STRING              DATE -> TIMESTAMP_DAYS
-  DECIMAL(p<=18, s) -> decimal64(-s)
+  STRING/VARCHAR/CHAR/BINARY -> STRING       DATE -> TIMESTAMP_DAYS
+  TIMESTAMP -> TIMESTAMP_MICROS (unix epoch; ORC 2015-epoch + nano
+  trailing-zero encoding decoded natively)
+  DECIMAL(p<=18, s) -> decimal64(-s)         DECIMAL(p>18, s) ->
+  decimal128(-s) (int64 limb pairs)
 """
 
 from __future__ import annotations
@@ -28,13 +31,15 @@ from spark_rapids_jni_tpu.runtime.native import load_native
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 _K_BOOLEAN, _K_BYTE, _K_SHORT, _K_INT, _K_LONG = 0, 1, 2, 3, 4
-_K_FLOAT, _K_DOUBLE, _K_STRING = 5, 6, 7
+_K_FLOAT, _K_DOUBLE, _K_STRING, _K_BINARY, _K_TIMESTAMP = 5, 6, 7, 8, 9
 _K_DECIMAL, _K_DATE, _K_VARCHAR, _K_CHAR = 14, 15, 16, 17
 
-_STRING_KINDS = (_K_STRING, _K_VARCHAR, _K_CHAR)
+_STRING_KINDS = (_K_STRING, _K_VARCHAR, _K_CHAR, _K_BINARY)
 
 
-def _map_dtype(kind: int, scale: int):
+def _map_dtype(kind: int, scale: int, precision: int = 0):
+    if kind == _K_DECIMAL and precision > 18:
+        return t.decimal128(-scale)
     return {
         _K_BOOLEAN: t.BOOL8,
         _K_BYTE: t.INT8,
@@ -44,8 +49,10 @@ def _map_dtype(kind: int, scale: int):
         _K_FLOAT: t.FLOAT32,
         _K_DOUBLE: t.FLOAT64,
         _K_STRING: t.STRING,
+        _K_BINARY: t.STRING,   # raw bytes ride the string layout
         _K_VARCHAR: t.STRING,
         _K_CHAR: t.STRING,
+        _K_TIMESTAMP: t.TIMESTAMP_MICROSECONDS,
         _K_DATE: t.TIMESTAMP_DAYS,
         _K_DECIMAL: t.decimal64(-scale),
     }[kind]
@@ -99,9 +106,9 @@ def read_table(
             sizes = (ctypes.c_int64 * 2)()
             _check(lib, lib.tpudf_orc_col_meta(handle, i, meta, sizes) == 0,
                    "col_meta")
-            kind, _prec, scale, has_valid = list(meta)
+            kind, prec, scale, has_valid = list(meta)
             num_rows, chars_bytes = list(sizes)
-            dtype = _map_dtype(kind, scale)
+            dtype = _map_dtype(kind, scale, prec)
 
             vbuf = np.empty(num_rows, dtype=np.uint8) if has_valid else None
             validity = None
@@ -127,7 +134,8 @@ def read_table(
                 )
                 continue
 
-            raw = np.empty(max(num_rows, 1), dtype=np.int64)
+            n_vals = 2 * num_rows if dtype.is_decimal128 else num_rows
+            raw = np.empty(max(n_vals, 1), dtype=np.int64)
             _check(
                 lib,
                 lib.tpudf_orc_col_copy(
@@ -138,9 +146,13 @@ def read_table(
                 ) == 0,
                 "col_copy",
             )
-            raw = raw[:num_rows]
             if vbuf is not None:
                 validity = jnp.asarray(vbuf.astype(bool))
+            if dtype.is_decimal128:
+                limbs = raw[: 2 * num_rows].reshape(num_rows, 2)
+                out.append(Column(dtype, jnp.asarray(limbs), validity))
+                continue
+            raw = raw[:num_rows]
             if kind == _K_FLOAT:
                 values = raw.astype(np.uint32).view(np.float32)
             elif kind == _K_DOUBLE:

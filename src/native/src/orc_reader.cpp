@@ -46,6 +46,7 @@ constexpr uint32_t kTyScale = 6;
 // StripeFooter
 constexpr uint32_t kSfStreams = 1;
 constexpr uint32_t kSfColumns = 2;
+constexpr uint32_t kSfWriterTimezone = 3;
 // Stream
 constexpr uint32_t kStKind = 1;
 constexpr uint32_t kStColumn = 2;
@@ -163,6 +164,40 @@ struct Cursor {
   int64_t varint_s() {  // zigzag
     uint64_t u = varint_u();
     return static_cast<int64_t>((u >> 1) ^ (~(u & 1) + 1));
+  }
+
+  // 128-bit zigzag varint (ORC DECIMAL with precision > 18): returns
+  // (lo unsigned, hi signed) little-endian limbs of the two's-complement
+  // value — the framework's DECIMAL128 storage layout.
+  std::pair<uint64_t, int64_t> varint_s128() {
+    uint64_t lo = 0, hi = 0;
+    int shift = 0;
+    while (shift <= 127) {
+      uint8_t b = byte();
+      uint64_t g = b & 0x7F;
+      if (shift < 64) {
+        lo |= g << shift;
+        if (shift + 7 > 64) hi |= g >> (64 - shift);
+      } else {
+        hi |= g << (shift - 64);
+      }
+      if (!(b & 0x80)) {
+        // the 19th byte contributes only 2 bits (shift 126): payload above
+        // them means a corrupt stream, not a silently-truncated value
+        if (shift == 126 && (g >> 2) != 0) fail("varint128 high-bit garbage");
+        break;
+      }
+      shift += 7;
+      if (shift > 127) fail("varint128 overruns 128 bits");
+    }
+    uint64_t sign = lo & 1;
+    uint64_t rlo = (lo >> 1) | (hi << 63);
+    uint64_t rhi = hi >> 1;
+    if (sign) {
+      rlo = ~rlo;
+      rhi = ~rhi;
+    }
+    return {rlo, static_cast<int64_t>(rhi)};
   }
 };
 
@@ -479,6 +514,7 @@ struct StripeDirectory {
   std::vector<StreamEntry> streams;
   std::vector<uint64_t> encodings;   // ColumnEncoding.kind per column id
   std::vector<uint64_t> dict_sizes;  // ColumnEncoding.dictionarySize
+  std::string writer_timezone;       // StripeFooter.writerTimezone
 };
 
 // Parse the stripe footer's stream directory ONCE per stripe. The streams
@@ -510,6 +546,7 @@ StripeDirectory parse_directory(uint64_t file_len, Message const& stripe,
     dir.encodings.push_back(enc.u64(kCeKind));
     dir.dict_sizes.push_back(enc.u64(kCeDictSize));
   }
+  dir.writer_timezone = std::string(sf.bytes(kSfWriterTimezone));
   return dir;
 }
 
@@ -628,16 +665,70 @@ void decode_stripe_column(uint8_t const* file, FileMeta const& meta,
       break;
     }
     case Kind::DECIMAL: {
-      if (ty.precision > 18) fail("DECIMAL precision > 18 unsupported");
       // unbounded base-128 zigzag varints + scale stream (ignored: the
       // footer scale is authoritative for modern writers)
+      Cursor c{s.data.data(), s.data.size()};
+      if (ty.precision > 18) {
+        // precision 19-38 -> DECIMAL128 limb pairs, two i64 per row
+        std::vector<std::pair<uint64_t, int64_t>> vals;
+        vals.reserve(n_present);
+        for (int64_t k = 0; k < n_present; ++k) {
+          vals.push_back(c.varint_s128());
+        }
+        int64_t next = 0;
+        for (int64_t r = 0; r < stripe_rows; ++r) {
+          if (valid[r]) {
+            out.data.push_back(static_cast<int64_t>(vals[next].first));
+            out.data.push_back(vals[next].second);
+            ++next;
+          } else {
+            out.data.push_back(0);
+            out.data.push_back(0);
+          }
+        }
+        break;
+      }
       std::vector<int64_t> vals;
       vals.reserve(n_present);
-      Cursor c{s.data.data(), s.data.size()};
       for (int64_t k = 0; k < n_present; ++k) vals.push_back(c.varint_s());
       scatter_i64(vals);
       break;
     }
+    case Kind::TIMESTAMP: {
+      // data = signed seconds from 2015-01-01 in the WRITER's timezone
+      // (stripe footer writerTimezone); nanos always non-negative (floor
+      // convention — modern orc-java uses floorDiv too; files from legacy
+      // toward-zero writers would read 1s high on pre-1970 fractional
+      // values). Wall-clock conversion needs a tz database, so non-UTC
+      // writers fail loudly rather than shift silently; secondary = nanos
+      // with the removed-trailing-zero count in the low 3 bits (z > 0
+      // means value * 10^(z+1)). Result: int64 unix-epoch microseconds.
+      auto const& tz = dir.writer_timezone;
+      if (!tz.empty() && tz != "UTC" && tz != "GMT" && tz != "Etc/UTC" &&
+          tz != "Etc/GMT") {
+        fail("TIMESTAMP written in timezone '" + tz +
+             "'; only UTC/GMT-written files are supported (wall-clock "
+             "conversion needs a tz database)");
+      }
+      constexpr int64_t kOrcEpochSeconds = 1420070400;
+      auto secs = decode_int_stream(s.data, n_present, true, v2);
+      auto nenc = decode_int_stream(s.secondary, n_present, false, v2);
+      std::vector<int64_t> vals;
+      vals.reserve(n_present);
+      for (int64_t k = 0; k < n_present; ++k) {
+        int64_t v = nenc[k];
+        int64_t nanos = v >> 3;
+        int z = static_cast<int>(v & 7);
+        if (z != 0) {
+          for (int q = 0; q < z + 1; ++q) nanos *= 10;
+        }
+        vals.push_back(
+            (secs[k] + kOrcEpochSeconds) * 1000000 + nanos / 1000);
+      }
+      scatter_i64(vals);
+      break;
+    }
+    case Kind::BINARY:
     case Kind::STRING:
     case Kind::VARCHAR:
     case Kind::CHAR: {
