@@ -339,3 +339,277 @@ def tpch_q1_distributed(lineitem: Table, mesh) -> Table:
     per_dev, num_groups = step(sharded)
     result = collect(per_dev, num_groups, mesh)
     return sort_table(result, [0, 1], nulls_first=[False, False])
+
+
+# ---- TPC-H q3 (shipping priority): join + groupby + order-by ---------------
+#
+#   SELECT l_orderkey, sum(l_extendedprice*(1-l_discount)) AS revenue,
+#          o_orderdate, o_shippriority
+#   FROM customer, orders, lineitem
+#   WHERE c_mktsegment = :seg AND c_custkey = o_custkey
+#     AND l_orderkey = o_orderkey
+#     AND o_orderdate < :cutoff AND l_shipdate > :cutoff
+#   GROUP BY l_orderkey, o_orderdate, o_shippriority
+#   ORDER BY revenue DESC, o_orderdate LIMIT 10
+
+_Q3_CUTOFF_DAYS = 9204  # 1995-03-15
+N_SEGMENTS = 5          # TPC-H market segments
+
+# orders columns
+O_ORDERKEY, O_CUSTKEY, O_ORDERDATE, O_SHIPPRIORITY = 0, 1, 2, 3
+# customer columns
+C_CUSTKEY, C_MKTSEGMENT = 0, 1
+# q3 lineitem columns
+L3_ORDERKEY, L3_EXTENDEDPRICE, L3_DISCOUNT, L3_SHIPDATE = 0, 1, 2, 3
+
+
+def customer_table(num_rows: int, seed: int = 0) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(np.arange(1, num_rows + 1, dtype=np.int64)),
+        Column.from_numpy(
+            rng.integers(0, N_SEGMENTS, num_rows).astype(np.int8), t.INT8
+        ),
+    ])
+
+
+def orders_table(num_rows: int, num_customers: int, seed: int = 1) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(np.arange(1, num_rows + 1, dtype=np.int64)),
+        Column.from_numpy(
+            rng.integers(1, num_customers + 1, num_rows).astype(np.int64)
+        ),
+        Column.from_numpy(
+            rng.integers(8400, 10957, num_rows).astype(np.int32),
+            t.TIMESTAMP_DAYS,
+        ),
+        Column.from_numpy(rng.integers(0, 2, num_rows).astype(np.int32)),
+    ])
+
+
+def lineitem_q3_table(num_rows: int, num_orders: int, seed: int = 2) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(
+            rng.integers(1, num_orders + 1, num_rows).astype(np.int64)
+        ),
+        Column.from_numpy(
+            rng.integers(90_000, 10_500_000, num_rows).astype(np.int64),
+            t.decimal64(-2),
+        ),
+        Column.from_numpy(
+            rng.integers(0, 11, num_rows).astype(np.int64), t.decimal64(-2)
+        ),
+        Column.from_numpy(
+            rng.integers(8400, 10957, num_rows).astype(np.int32),
+            t.TIMESTAMP_DAYS,
+        ),
+    ])
+
+
+def _null_where(c: Column, drop: jnp.ndarray) -> Column:
+    return Column(c.dtype, c.data, c.valid_mask() & ~drop)
+
+
+def _q3_inputs(customer: Table, orders: Table, lineitem: Table,
+               segment: int, cutoff: int):
+    """Shared q3 filtered inputs for BOTH plans (single change point for
+    predicates/scales): segment-filtered customer keys, date-filtered
+    orders, and the shipdate-filtered lineitem probe with its revenue
+    lane. Returns (cust, ord_t, probe)."""
+    cust = Table([_null_where(
+        customer.column(C_CUSTKEY),
+        customer.column(C_MKTSEGMENT).data != jnp.int8(segment),
+    )])
+    okey = _null_where(
+        orders.column(O_CUSTKEY),
+        orders.column(O_ORDERDATE).data >= jnp.int32(cutoff),
+    )
+    ord_t = Table([okey, orders.column(O_ORDERKEY),
+                   orders.column(O_ORDERDATE),
+                   orders.column(O_SHIPPRIORITY)])
+    lkey = _null_where(
+        lineitem.column(L3_ORDERKEY),
+        lineitem.column(L3_SHIPDATE).data <= jnp.int32(cutoff),
+    )
+    price = lineitem.column(L3_EXTENDEDPRICE)
+    disc = lineitem.column(L3_DISCOUNT)
+    revenue = Column(
+        t.decimal64(-4), price.data * (100 - disc.data),
+        price.valid_mask() & disc.valid_mask(),
+    )
+    probe = Table([lkey, revenue])
+    return cust, ord_t, probe
+
+
+def _q3_joined(customer: Table, orders: Table, lineitem: Table,
+               segment: int, cutoff: int, out_factor: int):
+    """Single-executor q3 front: both joins. Returns
+    (joined lineitem x orders table, join maps total, out cap)."""
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+
+    cust, ord_t, probe = _q3_inputs(customer, orders, lineitem, segment,
+                                    cutoff)
+    m1 = join(ord_t, cust, 0, 0, out_size=orders.num_rows)
+    oc = apply_join_maps(ord_t, cust, m1)
+    # oc: [o_custkey, o_orderkey, o_orderdate, o_shippriority, c_custkey]
+    matched = oc.column(4).valid_mask()
+    oc_key = _null_where(oc.column(1), ~matched)
+    build = Table([oc_key, oc.column(2), oc.column(3)])
+
+    out_cap = lineitem.num_rows * out_factor
+    m2 = join(probe, build, 0, 0, out_size=out_cap)
+    j = apply_join_maps(probe, build, m2)
+    # j: [l_orderkey, revenue, o_orderkey, o_orderdate, o_shippriority]
+    return j, m2.total, out_cap
+
+
+class Q3Result(NamedTuple):
+    result: GroupByResult  # [l_orderkey, o_orderdate, o_shippriority, rev]
+    join_total: jnp.ndarray  # true lineitem-x-orders match count
+    out_cap: int             # static join output bound (check total <= cap)
+
+
+@func_range("tpch_q3")
+def tpch_q3(customer: Table, orders: Table, lineitem: Table,
+            segment: int = 0, cutoff: int = _Q3_CUTOFF_DAYS,
+            out_factor: int = 2) -> Q3Result:
+    """Single-executor q3. Grouped rows
+    [l_orderkey, o_orderdate, o_shippriority, revenue] padded; callers
+    compact + head for the LIMIT, and check ``join_total <= out_cap`` on
+    host (join_auto pattern) — exceeding it means matches were dropped."""
+    j, total, cap = _q3_joined(customer, orders, lineitem, segment,
+                               cutoff, out_factor)
+    matched = j.column(2).valid_mask()
+    keyed = Table([
+        _null_where(j.column(0), ~matched),
+        _null_where(j.column(3), ~matched),
+        _null_where(j.column(4), ~matched),
+        Column(j.column(1).dtype, j.column(1).data,
+               j.column(1).valid_mask() & matched),
+    ])
+    grouped = groupby_aggregate(keyed, keys=[0, 1, 2], aggs=[(3, "sum")])
+    srt = sort_table(
+        grouped.table, [3, 1], ascending=[False, True],
+        nulls_first=[False, False],
+    )
+    return Q3Result(GroupByResult(srt, grouped.num_groups), total, cap)
+
+
+def tpch_q3_numpy(customer: Table, orders: Table, lineitem: Table,
+                  segment: int = 0, cutoff: int = _Q3_CUTOFF_DAYS) -> dict:
+    """Host oracle: {orderkey: (revenue, orderdate, shippriority)}."""
+    seg = np.asarray(customer.column(C_MKTSEGMENT).data)
+    ckey = np.asarray(customer.column(C_CUSTKEY).data)
+    good_cust = set(ckey[seg == segment].tolist())
+    okey = np.asarray(orders.column(O_ORDERKEY).data)
+    ocust = np.asarray(orders.column(O_CUSTKEY).data)
+    odate = np.asarray(orders.column(O_ORDERDATE).data)
+    oprio = np.asarray(orders.column(O_SHIPPRIORITY).data)
+    good_orders = {}
+    for k, c, d, p in zip(okey, ocust, odate, oprio):
+        if d < cutoff and int(c) in good_cust:
+            good_orders[int(k)] = (int(d), int(p))
+    lkey = np.asarray(lineitem.column(L3_ORDERKEY).data)
+    price = np.asarray(lineitem.column(L3_EXTENDEDPRICE).data)
+    disc = np.asarray(lineitem.column(L3_DISCOUNT).data)
+    ldate = np.asarray(lineitem.column(L3_SHIPDATE).data)
+    out = {}
+    for k, p, dc, d in zip(lkey, price, disc, ldate):
+        k = int(k)
+        if d > cutoff and k in good_orders:
+            rev = int(p) * (100 - int(dc))
+            date, prio = good_orders[k]
+            if k in out:
+                out[k] = (out[k][0] + rev, date, prio)
+            else:
+                out[k] = (rev, date, prio)
+    return out
+
+
+def tpch_q3_distributed(customer: Table, orders: Table, lineitem: Table,
+                        mesh, segment: int = 0,
+                        cutoff: int = _Q3_CUTOFF_DAYS,
+                        out_factor: int = 4) -> Table:
+    """Multi-executor q3: the REPARTITIONED two-exchange plan. Exchange 1
+    co-locates orders and customers by custkey hash; exchange 2 co-locates
+    the qualifying orders with lineitem by orderkey hash. After exchange 2
+    every orderkey lives on exactly one device, so the per-device groupby
+    partitions the global answer; collect + one tiny host sort finishes."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        collect,
+        distributed_join,
+        shard_table,
+    )
+    from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+
+    d = int(np.prod(list(mesh.shape.values())))
+    n_ord, n_li = orders.num_rows, lineitem.num_rows
+
+    cust, ord_t, probe = _q3_inputs(customer, orders, lineitem, segment,
+                                    cutoff)
+
+    so, orv = shard_table(ord_t, mesh, return_row_valid=True)
+    sc, crv = shard_table(cust, mesh, return_row_valid=True)
+    res1 = distributed_join(
+        so, sc, 0, 0, mesh,
+        out_size_per_device=max(1, n_ord // max(d // 2, 1)),
+        left_capacity=max(1, n_ord // d * 2),
+        right_capacity=max(1, customer.num_rows // d * 2),
+        left_row_valid=orv, right_row_valid=crv,
+    )
+    if np.asarray(res1.overflowed).any():
+        raise ValueError("q3 exchange 1 overflowed; raise capacities")
+    oc = res1.table  # sharded: [o_custkey, o_orderkey, o_date, o_prio, c_custkey]
+    matched = oc.column(4).valid_mask()
+    build = Table([
+        Column(oc.column(1).dtype, oc.column(1).data,
+               oc.column(1).valid_mask() & matched),
+        oc.column(2), oc.column(3),
+    ])
+
+    sp, prv = shard_table(probe, mesh, return_row_valid=True)
+    # inner join: null-key build rows never match, so key validity doubles
+    # as the row mask (saves shuffle capacity on exchange-1 padding)
+    res2 = distributed_join(
+        sp, build, 0, 0, mesh,
+        out_size_per_device=max(1, n_li * out_factor // max(d // 2, 1)),
+        left_capacity=max(1, n_li // d * 2),
+        right_capacity=max(1, build.num_rows // d * 2),
+        left_row_valid=prv, right_row_valid=build.column(0).valid_mask(),
+    )
+    if np.asarray(res2.overflowed).any():
+        raise ValueError("q3 exchange 2 overflowed; raise capacities")
+
+    def group_step(j: Table):
+        # j: [l_orderkey, revenue, o_orderkey, o_date, o_prio]
+        matched = j.column(2).valid_mask()
+        keyed = Table([
+            _null_where(j.column(0), ~matched),
+            _null_where(j.column(3), ~matched),
+            _null_where(j.column(4), ~matched),
+            Column(j.column(1).dtype, j.column(1).data,
+                   j.column(1).valid_mask() & matched),
+        ])
+        g = groupby_aggregate(keyed, keys=[0, 1, 2], aggs=[(3, "sum")])
+        return g.table, g.num_groups.reshape(1)
+
+    out, num_groups = _jax.jit(_jax.shard_map(
+        group_step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
+        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+    ))(res2.table)
+    result = collect(out, num_groups, mesh)
+    srt = sort_table(result, [3, 1], ascending=[False, True],
+                     nulls_first=[False, False])
+    # drop the null-key pseudo-groups (unmatched/padding)
+    kv = np.asarray(srt.column(0).valid_mask())
+    k = int(kv.sum())
+    return Table([
+        Column(c.dtype, c.data[:k],
+               None if c.validity is None else c.validity[:k])
+        for c in srt.columns
+    ])

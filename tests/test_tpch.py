@@ -111,3 +111,62 @@ def test_tpch_q1_checked_matches_oracle(rng):
         )
     want = {k: (v["sum_qty"], v["count"]) for k, v in oracle.items()}
     assert got == want
+
+
+# ---- q3 --------------------------------------------------------------------
+
+
+def _q3_tables(n_cust=64, n_ord=512, n_li=2048):
+    from spark_rapids_jni_tpu.models.tpch import (
+        customer_table, lineitem_q3_table, orders_table)
+
+    c = customer_table(n_cust)
+    o = orders_table(n_ord, n_cust)
+    li = lineitem_q3_table(n_li, n_ord)
+    return c, o, li
+
+
+def test_tpch_q3_matches_oracle():
+    import jax
+
+    from spark_rapids_jni_tpu.models.tpch import tpch_q3, tpch_q3_numpy
+
+    c, o, li = _q3_tables()
+    res = jax.jit(lambda a, b, d: tpch_q3(a, b, d))(c, o, li)
+    assert int(res.join_total) <= res.out_cap
+    out = res.result.table
+    want = tpch_q3_numpy(c, o, li)
+    kv = np.asarray(out.column(0).valid_mask())
+    got = {}
+    for i in np.nonzero(kv)[0]:
+        got[int(np.asarray(out.column(0).data)[i])] = (
+            int(np.asarray(out.column(3).data)[i]),
+            int(np.asarray(out.column(1).data)[i]),
+            int(np.asarray(out.column(2).data)[i]),
+        )
+    assert got == want
+    # ORDER BY revenue desc among real groups (sorted nulls-last, so the
+    # real groups are the head)
+    revs = np.asarray(out.column(3).data)[: int(kv.sum())]
+    assert np.all(np.diff(revs.astype(np.int64)) <= 0)
+
+
+def test_tpch_q3_distributed_matches_oracle():
+    from spark_rapids_jni_tpu.models.tpch import (
+        tpch_q3_distributed, tpch_q3_numpy)
+    from spark_rapids_jni_tpu.parallel import executor_mesh
+
+    c, o, li = _q3_tables(n_cust=48, n_ord=256, n_li=1024)
+    mesh = executor_mesh(8)
+    out = tpch_q3_distributed(c, o, li, mesh)
+    want = tpch_q3_numpy(c, o, li)
+    got = {}
+    for i in range(out.num_rows):
+        got[int(np.asarray(out.column(0).data)[i])] = (
+            int(np.asarray(out.column(3).data)[i]),
+            int(np.asarray(out.column(1).data)[i]),
+            int(np.asarray(out.column(2).data)[i]),
+        )
+    assert got == want
+    revs = np.asarray(out.column(3).data)
+    assert np.all(np.diff(revs.astype(np.int64)) <= 0)
