@@ -401,3 +401,148 @@ def _assemble_decimal_strings(
         None if valid.all() else jnp.asarray(valid),
         chars=jnp.asarray(chars.copy() if chars.size else np.zeros(0, np.uint8)),
     )
+
+
+# ---- date casts ------------------------------------------------------------
+
+
+def _days_from_civil(y: jnp.ndarray, m: jnp.ndarray,
+                     d: jnp.ndarray) -> jnp.ndarray:
+    """(year, month, day) -> days since 1970-01-01 (proleptic Gregorian).
+    Pure integer arithmetic (the era/day-of-era formulation), so the whole
+    column converts in one vectorized pass."""
+    y = y.astype(jnp.int64)
+    m = m.astype(jnp.int64)
+    d = d.astype(jnp.int64)
+    y = jnp.where(m <= 2, y - 1, y)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400                                     # [0, 399]
+    mp = (m + 9) % 12                                       # Mar=0..Feb=11
+    doy = (153 * mp + 2) // 5 + d - 1                       # [0, 365]
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy           # [0, 146096]
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def _civil_from_days(z: jnp.ndarray):
+    """days since 1970-01-01 -> (year, month, day), inverse of the above."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097                                  # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    return jnp.where(m <= 2, y + 1, y), m, d
+
+
+_DAYS_IN_MONTH = jnp.asarray(
+    [0, 31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31], dtype=jnp.int32
+)
+
+
+@func_range("string_to_date")
+def string_to_date(col: Column) -> Column:
+    """STRING 'yyyy-[M]M-[d]d' -> TIMESTAMP_DAYS (Spark date cast):
+    leading/trailing whitespace trimmed (Spark's UTF8String.trim), then
+    exactly a 4-digit year and 1-2 digit month/day with real calendar
+    validation (month range, day-in-month, leap years). Anything else is
+    NULL, the non-ANSI Spark cast posture. (Spark's shorter forms —
+    'yyyy', 'yyyy-[M]M', trailing 'T...' — are not accepted yet.)"""
+    if not col.dtype.is_string:
+        raise TypeError("string_to_date requires a STRING column")
+    raw, rpresent, rlengths, over = _char_matrix(col, max_len=16)
+    w = raw.shape[1]
+    jdx = jnp.arange(w, dtype=jnp.int32)
+    # trim: whitespace = bytes <= 0x20 (UTF8String.trim's rule)
+    ws = rpresent & (raw <= 0x20)
+    content = rpresent & ~ws
+    lead = jnp.where(
+        jnp.any(content, axis=1), jnp.argmax(content, axis=1), 0
+    ).astype(jnp.int32)
+    last = jnp.max(jnp.where(content, jdx[None, :], -1), axis=1)
+    lengths = (last - lead + 1).astype(jnp.int32)
+    lengths = jnp.maximum(lengths, 0)
+    # shift each row left by its leading-whitespace count
+    src = jnp.clip(jdx[None, :] + lead[:, None], 0, w - 1)
+    mat = jnp.take_along_axis(raw, src, axis=1)
+    present = jdx[None, :] < lengths[:, None]
+    mat = jnp.where(present, mat, jnp.uint8(0x20))
+    # interior whitespace is a parse error; only a fully-out-of-window row
+    # is unjudgeable (trimmed content can never exceed 10 parseable bytes)
+    valid = col.valid_mask() & ~over & (lengths <= 10)
+    is_digit = present & (mat >= ord("0")) & (mat <= ord("9"))
+    is_dash = present & (mat == ord("-"))
+    digit = jnp.where(is_digit, mat - ord("0"), 0).astype(jnp.int32)
+
+    # dash positions: first at index 4; second at 6 or 7
+    n_dash = jnp.sum(is_dash, axis=1)
+    dash2 = jnp.argmax(is_dash & (jdx[None, :] > 4), axis=1).astype(jnp.int32)
+
+    def field(lo, hi):  # digits in [lo, hi) -> int, plus all-digit flag
+        sel = (jdx[None, :] >= lo[:, None]) & (jdx[None, :] < hi[:, None])
+        ok = jnp.all(~sel | is_digit, axis=1)
+        # fold left: value = sum digit * 10^(hi-1-j)
+        p = jnp.where(sel, hi[:, None] - 1 - jdx[None, :], 0)
+        val = jnp.sum(
+            jnp.where(sel, digit * (10 ** p.astype(jnp.int64)), 0), axis=1
+        )
+        return val.astype(jnp.int32), ok & jnp.any(sel, axis=1)
+
+    lo0 = jnp.zeros_like(lengths)
+    year, y_ok = field(lo0, jnp.full_like(lengths, 4))
+    month, m_ok = field(jnp.full_like(lengths, 5), dash2)
+    day, d_ok = field(dash2 + 1, lengths)
+    dash_ok = (
+        (n_dash == 2)
+        & is_dash[:, 4]
+        & (dash2 > 5) & (dash2 <= 7)
+        & (lengths - dash2 >= 2) & (lengths - dash2 <= 3)
+        & (lengths >= 8) & (lengths <= 10)
+    )
+    month_ok = (month >= 1) & (month <= 12)
+    leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
+    dim = _DAYS_IN_MONTH[jnp.clip(month, 0, 12)]
+    dim = jnp.where((month == 2) & leap, 29, dim)
+    day_ok = (day >= 1) & (day <= dim)
+    ok = valid & dash_ok & y_ok & m_ok & d_ok & month_ok & day_ok
+    days = _days_from_civil(year, month, day)
+    return Column(
+        t.TIMESTAMP_DAYS, jnp.where(ok, days, 0).astype(jnp.int32), ok
+    )
+
+
+@func_range("date_to_string")
+def date_to_string(col: Column) -> Column:
+    """TIMESTAMP_DAYS -> STRING 'yyyy-MM-dd' (zero-padded). Years outside
+    [0, 9999] render with a sign ('-0044-03-15', '+10000-01-01') rather
+    than nulling a valid row — a non-null date always formats."""
+    if col.dtype.type_id != TypeId.TIMESTAMP_DAYS:
+        raise TypeError("date_to_string requires a TIMESTAMP_DAYS column")
+    y, m, d = _civil_from_days(col.data)
+    ok = np.asarray(col.valid_mask())
+    y = np.asarray(y)
+    m = np.asarray(m)
+    d = np.asarray(d)
+
+    def fmt(yy, mm, dd):
+        if yy < 0:
+            return ("-%04d-%02d-%02d" % (-yy, mm, dd)).encode()
+        if yy > 9999:
+            return ("+%d-%02d-%02d" % (yy, mm, dd)).encode()
+        return ("%04d-%02d-%02d" % (yy, mm, dd)).encode()
+
+    pieces = [
+        fmt(yy, mm, dd) if v else b""
+        for yy, mm, dd, v in zip(y, m, d, ok)
+    ]
+    offsets = np.zeros(len(pieces) + 1, dtype=np.int32)
+    np.cumsum([len(p) for p in pieces], out=offsets[1:])
+    chars = np.frombuffer(b"".join(pieces), dtype=np.uint8)
+    return Column(
+        t.STRING,
+        jnp.asarray(offsets),
+        None if ok.all() else jnp.asarray(ok),
+        chars=jnp.asarray(chars.copy() if chars.size else np.zeros(0, np.uint8)),
+    )

@@ -252,3 +252,90 @@ def test_decimal_to_string_positive_scale_rejected():
     col = Column.from_pylist([5], DType(TypeId.DECIMAL64, 2))
     with pytest.raises(NotImplementedError):
         decimal_to_string(col)
+
+
+# ---- date casts ------------------------------------------------------------
+
+
+def test_string_to_date_vs_python_oracle(rng):
+    import datetime
+
+    from spark_rapids_jni_tpu.ops.cast_strings import string_to_date
+
+    dates = []
+    for _ in range(400):
+        y = int(rng.integers(1, 9999))
+        m = int(rng.integers(1, 13))
+        d = int(rng.integers(1, 29))
+        style = rng.random()
+        if style < 0.5:
+            dates.append(f"{y:04d}-{m:02d}-{d:02d}")
+        else:
+            dates.append(f"{y:04d}-{m}-{d}")  # 1-digit month/day forms
+    bad = ["", "2020-13-01", "2020-02-30", "2019-02-29", "20-01-01",
+           "2020/01/01", "2020-1-", "x020-01-01", "2020-01-01x",
+           "2020--1-01", "2021-00-10", "2021-04-31", None, "2020-011-1"]
+    col = Column.from_pylist(dates + bad, t.STRING)
+    out = string_to_date(col)
+    got_valid = np.asarray(out.valid_mask())
+    got_days = np.asarray(out.data)
+    epoch = datetime.date(1970, 1, 1)
+    for i, s in enumerate(dates):
+        y, m, d = (int(x) for x in s.split("-"))
+        want = (datetime.date(y, m, d) - epoch).days
+        assert got_valid[i], s
+        assert got_days[i] == want, s
+    # 2020-02-29 IS valid (leap year); every `bad` entry is null
+    for j in range(len(bad)):
+        assert not got_valid[len(dates) + j], bad[j]
+    leap = string_to_date(Column.from_pylist(["2020-02-29"], t.STRING))
+    assert bool(np.asarray(leap.valid_mask())[0])
+    assert int(np.asarray(leap.data)[0]) == (
+        datetime.date(2020, 2, 29) - epoch).days
+
+
+def test_date_roundtrip_through_strings(rng):
+    from spark_rapids_jni_tpu.ops.cast_strings import (
+        date_to_string, string_to_date)
+
+    days = rng.integers(-700000, 2900000, 500).astype(np.int32)
+    col = Column.from_numpy(days, t.TIMESTAMP_DAYS)
+    as_str = date_to_string(col)
+    back = string_to_date(as_str)
+    assert np.asarray(back.valid_mask()).all()
+    assert np.array_equal(np.asarray(back.data), days)
+
+
+def test_date_to_string_format():
+    import datetime
+
+    from spark_rapids_jni_tpu.ops.cast_strings import date_to_string
+
+    epoch = datetime.date(1970, 1, 1)
+    samples = [datetime.date(2024, 2, 29), datetime.date(1, 1, 1),
+               datetime.date(9999, 12, 31), datetime.date(1969, 12, 31)]
+    days = np.array([(s - epoch).days for s in samples], dtype=np.int32)
+    out = date_to_string(Column.from_numpy(days, t.TIMESTAMP_DAYS))
+    assert out.to_pylist() == [s.isoformat() for s in samples]
+
+
+def test_string_to_date_trims_whitespace():
+    from spark_rapids_jni_tpu.ops.cast_strings import string_to_date
+
+    col = Column.from_pylist(
+        [" 2020-01-02", "2020-01-02 ", "\t2020-1-2 \n", "20 20-01-02",
+         "   "], t.STRING)
+    out = string_to_date(col)
+    v = np.asarray(out.valid_mask())
+    assert list(v) == [True, True, True, False, False]
+    assert len(set(np.asarray(out.data)[:3].tolist())) == 1
+
+
+def test_date_to_string_extreme_years_format_not_null():
+    from spark_rapids_jni_tpu.ops.cast_strings import date_to_string
+
+    days = np.array([-720000, 3000000], dtype=np.int32)
+    out = date_to_string(Column.from_numpy(days, t.TIMESTAMP_DAYS))
+    vals = out.to_pylist()
+    assert vals[0].startswith("-0") and vals[1].startswith("+1")
+    assert np.asarray(out.valid_mask()).all()
