@@ -225,6 +225,30 @@ def _bench_parquet_q1(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_tpch_q3(n: int, iters: int):
+    """q3 join+groupby pipeline: n lineitem rows against n/8 orders and
+    n/64 customers (TPC-H-ish fanout)."""
+    import jax
+
+    from spark_rapids_jni_tpu.models.tpch import (
+        customer_table,
+        lineitem_q3_table,
+        orders_table,
+        tpch_q3,
+    )
+
+    n_cust = max(n // 64, 4)
+    n_ord = max(n // 8, 8)
+    c = customer_table(n_cust)
+    o = orders_table(n_ord, n_cust)
+    li = lineitem_q3_table(n, n_ord)
+    fn = jax.jit(
+        lambda a, b, d: _table_digest(tpch_q3(a, b, d).result.table)
+    )
+    per_iter = _measure(lambda: fn(c, o, li), iters)
+    return n / per_iter
+
+
 def _bench_json_extract(n: int, iters: int):
     """Device JSONPath engine ($.field over generated flat-ish documents):
     the get_json_object fast path, measured fully on-device (the host
@@ -328,6 +352,7 @@ _CONFIGS = {
     "parquet_q1": (_bench_parquet_q1, "parquet_q1_rows_per_s", "rows/s"),
     "shuffle_wire": (_bench_shuffle_wire, "shuffle_wire_gb_per_s", "GB/s"),
     "json_extract": (_bench_json_extract, "json_extract_rows_per_s", "rows/s"),
+    "tpch_q3": (_bench_tpch_q3, "tpch_q3_rows_per_s", "rows/s"),
 }
 
 

@@ -328,6 +328,19 @@ def integer_to_string(col: Column) -> Column:
     return _assemble_decimal_strings(digits, neg, valid, scale=0)
 
 
+def _column_from_pieces(pieces: list, valid) -> Column:
+    """Host-side Arrow assembly shared by every X->string cast."""
+    offsets = np.zeros(len(pieces) + 1, dtype=np.int32)
+    np.cumsum([len(p) for p in pieces], out=offsets[1:])
+    chars = np.frombuffer(b"".join(pieces), dtype=np.uint8)
+    return Column(
+        t.STRING,
+        jnp.asarray(offsets),
+        None if valid.all() else jnp.asarray(valid),
+        chars=jnp.asarray(chars.copy() if chars.size else np.zeros(0, np.uint8)),
+    )
+
+
 @func_range("boolean_to_string")
 def boolean_to_string(col: Column) -> Column:
     """BOOL8 -> STRING: 'true'/'false' (Spark cast semantics)."""
@@ -339,15 +352,7 @@ def boolean_to_string(col: Column) -> Column:
         (b"true" if v else b"false") if ok else b""
         for v, ok in zip(vals, valid)
     ]
-    offsets = np.zeros(len(pieces) + 1, dtype=np.int32)
-    np.cumsum([len(p) for p in pieces], out=offsets[1:])
-    chars = np.frombuffer(b"".join(pieces), dtype=np.uint8)
-    return Column(
-        t.STRING,
-        jnp.asarray(offsets),
-        None if valid.all() else jnp.asarray(valid),
-        chars=jnp.asarray(chars.copy() if chars.size else np.zeros(0, np.uint8)),
-    )
+    return _column_from_pieces(pieces, valid)
 
 
 @func_range("decimal_to_string")
@@ -376,7 +381,6 @@ def _assemble_decimal_strings(
     number of fractional digits (>= 0)."""
     n = digits.shape[0]
     pieces: list[bytes] = []
-    lengths = np.zeros(n, dtype=np.int32)
     for i in range(n):
         if not valid[i]:
             pieces.append(b"")
@@ -391,16 +395,7 @@ def _assemble_decimal_strings(
         if neg[i]:
             s = b"-" + s
         pieces.append(s)
-        lengths[i] = len(s)
-    offsets = np.zeros(n + 1, dtype=np.int32)
-    np.cumsum(lengths, out=offsets[1:])
-    chars = np.frombuffer(b"".join(pieces), dtype=np.uint8)
-    return Column(
-        t.STRING,
-        jnp.asarray(offsets),
-        None if valid.all() else jnp.asarray(valid),
-        chars=jnp.asarray(chars.copy() if chars.size else np.zeros(0, np.uint8)),
-    )
+    return _column_from_pieces(pieces, valid)
 
 
 # ---- date casts ------------------------------------------------------------
@@ -442,6 +437,95 @@ _DAYS_IN_MONTH = jnp.asarray(
 )
 
 
+def _trimmed_matrix(col: Column, max_len: int):
+    """(mat, present, lengths, judgeable): the char matrix gathered from
+    each row's first non-whitespace byte, lengths excluding trailing
+    whitespace (Spark's UTF8String.trim: bytes <= 0x20). The trim runs on
+    the flat chars buffer BEFORE the window gather (next/prev-non-ws via
+    cumulative min/max scans), so arbitrarily long whitespace padding
+    cannot push a short value out of the window; ``judgeable`` is False
+    only when the TRIMMED content overruns ``max_len``."""
+    offsets = col.data
+    chars = col.chars
+    n = col.size
+    total = int(chars.shape[0])
+    starts = offsets[:-1].astype(jnp.int32)
+    ends = offsets[1:].astype(jnp.int32)
+    if total == 0:
+        lengths = jnp.zeros((n,), jnp.int32)
+        mat = jnp.full((n, max_len), jnp.uint8(0x20))
+        present = jnp.zeros((n, max_len), jnp.bool_)
+        return mat, present, lengths, jnp.ones((n,), jnp.bool_)
+    idxs = jnp.arange(total, dtype=jnp.int32)
+    nonws = chars > 0x20
+    # first non-ws index >= i / last non-ws index <= i, whole-buffer scans
+    nxt = jax.lax.associative_scan(
+        jnp.minimum, jnp.where(nonws, idxs, jnp.int32(total)),
+        reverse=True)
+    prv = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(nonws, idxs, jnp.int32(-1)))
+    s_eff = jnp.minimum(nxt[jnp.clip(starts, 0, total - 1)], ends)
+    e_eff = prv[jnp.clip(ends - 1, 0, total - 1)] + 1
+    e_eff = jnp.where(ends > starts, jnp.minimum(e_eff, ends), starts)
+    lengths = jnp.maximum(e_eff - s_eff, 0).astype(jnp.int32)
+    jdx = jnp.arange(max_len, dtype=jnp.int32)
+    idx = s_eff[:, None] + jdx[None, :]
+    present = jdx[None, :] < lengths[:, None]
+    mat = jnp.where(
+        present, chars[jnp.clip(idx, 0, total - 1)], jnp.uint8(0x20)
+    )
+    return mat, present, lengths, lengths <= max_len
+
+
+def _digit_field(mat: jnp.ndarray, present: jnp.ndarray):
+    """Per-row digit classification + a [lo, hi)-window integer parser."""
+    w = mat.shape[1]
+    jdx = jnp.arange(w, dtype=jnp.int32)
+    is_digit = present & (mat >= ord("0")) & (mat <= ord("9"))
+    digit = jnp.where(is_digit, mat - ord("0"), 0).astype(jnp.int32)
+
+    def field(lo, hi):  # digits in [lo, hi) -> int, plus all-digit flag
+        sel = (jdx[None, :] >= lo[:, None]) & (jdx[None, :] < hi[:, None])
+        ok = jnp.all(~sel | is_digit, axis=1)
+        p = jnp.where(sel, hi[:, None] - 1 - jdx[None, :], 0)
+        val = jnp.sum(
+            jnp.where(sel, digit * (10 ** p.astype(jnp.int64)), 0), axis=1
+        )
+        return val.astype(jnp.int32), ok & jnp.any(sel, axis=1)
+
+    return is_digit, field
+
+
+def _parse_civil_date(mat, present, date_len):
+    """Parse 'yyyy-[M]M-[d]d' occupying [0, date_len) of each row ->
+    (days, ok): 4-digit year, 1-2 digit month/day, calendar-validated."""
+    w = mat.shape[1]
+    jdx = jnp.arange(w, dtype=jnp.int32)
+    in_date = present & (jdx[None, :] < date_len[:, None])
+    is_digit, field = _digit_field(mat, in_date)
+    is_dash = in_date & (mat == ord("-"))
+    n_dash = jnp.sum(is_dash, axis=1)
+    dash2 = jnp.argmax(is_dash & (jdx[None, :] > 4), axis=1).astype(jnp.int32)
+    year, y_ok = field(jnp.zeros_like(date_len),
+                       jnp.full_like(date_len, 4))
+    month, m_ok = field(jnp.full_like(date_len, 5), dash2)
+    day, d_ok = field(dash2 + 1, date_len)
+    dash_ok = (
+        (n_dash == 2)
+        & is_dash[:, 4]
+        & (dash2 > 5) & (dash2 <= 7)
+        & (date_len - dash2 >= 2) & (date_len - dash2 <= 3)
+        & (date_len >= 8) & (date_len <= 10)
+    )
+    month_ok = (month >= 1) & (month <= 12)
+    leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
+    dim = _DAYS_IN_MONTH[jnp.clip(month, 0, 12)]
+    dim = jnp.where((month == 2) & leap, 29, dim)
+    day_ok = (day >= 1) & (day <= dim)
+    ok = dash_ok & y_ok & m_ok & d_ok & month_ok & day_ok
+    return _days_from_civil(year, month, day), ok
+
+
 @func_range("string_to_date")
 def string_to_date(col: Column) -> Column:
     """STRING 'yyyy-[M]M-[d]d' -> TIMESTAMP_DAYS (Spark date cast):
@@ -452,64 +536,83 @@ def string_to_date(col: Column) -> Column:
     'yyyy', 'yyyy-[M]M', trailing 'T...' — are not accepted yet.)"""
     if not col.dtype.is_string:
         raise TypeError("string_to_date requires a STRING column")
-    raw, rpresent, rlengths, over = _char_matrix(col, max_len=16)
-    w = raw.shape[1]
-    jdx = jnp.arange(w, dtype=jnp.int32)
-    # trim: whitespace = bytes <= 0x20 (UTF8String.trim's rule)
-    ws = rpresent & (raw <= 0x20)
-    content = rpresent & ~ws
-    lead = jnp.where(
-        jnp.any(content, axis=1), jnp.argmax(content, axis=1), 0
-    ).astype(jnp.int32)
-    last = jnp.max(jnp.where(content, jdx[None, :], -1), axis=1)
-    lengths = (last - lead + 1).astype(jnp.int32)
-    lengths = jnp.maximum(lengths, 0)
-    # shift each row left by its leading-whitespace count
-    src = jnp.clip(jdx[None, :] + lead[:, None], 0, w - 1)
-    mat = jnp.take_along_axis(raw, src, axis=1)
-    present = jdx[None, :] < lengths[:, None]
-    mat = jnp.where(present, mat, jnp.uint8(0x20))
-    # interior whitespace is a parse error; only a fully-out-of-window row
-    # is unjudgeable (trimmed content can never exceed 10 parseable bytes)
-    valid = col.valid_mask() & ~over & (lengths <= 10)
-    is_digit = present & (mat >= ord("0")) & (mat <= ord("9"))
-    is_dash = present & (mat == ord("-"))
-    digit = jnp.where(is_digit, mat - ord("0"), 0).astype(jnp.int32)
-
-    # dash positions: first at index 4; second at 6 or 7
-    n_dash = jnp.sum(is_dash, axis=1)
-    dash2 = jnp.argmax(is_dash & (jdx[None, :] > 4), axis=1).astype(jnp.int32)
-
-    def field(lo, hi):  # digits in [lo, hi) -> int, plus all-digit flag
-        sel = (jdx[None, :] >= lo[:, None]) & (jdx[None, :] < hi[:, None])
-        ok = jnp.all(~sel | is_digit, axis=1)
-        # fold left: value = sum digit * 10^(hi-1-j)
-        p = jnp.where(sel, hi[:, None] - 1 - jdx[None, :], 0)
-        val = jnp.sum(
-            jnp.where(sel, digit * (10 ** p.astype(jnp.int64)), 0), axis=1
-        )
-        return val.astype(jnp.int32), ok & jnp.any(sel, axis=1)
-
-    lo0 = jnp.zeros_like(lengths)
-    year, y_ok = field(lo0, jnp.full_like(lengths, 4))
-    month, m_ok = field(jnp.full_like(lengths, 5), dash2)
-    day, d_ok = field(dash2 + 1, lengths)
-    dash_ok = (
-        (n_dash == 2)
-        & is_dash[:, 4]
-        & (dash2 > 5) & (dash2 <= 7)
-        & (lengths - dash2 >= 2) & (lengths - dash2 <= 3)
-        & (lengths >= 8) & (lengths <= 10)
-    )
-    month_ok = (month >= 1) & (month <= 12)
-    leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
-    dim = _DAYS_IN_MONTH[jnp.clip(month, 0, 12)]
-    dim = jnp.where((month == 2) & leap, 29, dim)
-    day_ok = (day >= 1) & (day <= dim)
-    ok = valid & dash_ok & y_ok & m_ok & d_ok & month_ok & day_ok
-    days = _days_from_civil(year, month, day)
+    mat, present, lengths, judgeable = _trimmed_matrix(col, max_len=16)
+    days, ok = _parse_civil_date(mat, present, lengths)
+    ok = ok & col.valid_mask() & judgeable & (lengths <= 10)
     return Column(
         t.TIMESTAMP_DAYS, jnp.where(ok, days, 0).astype(jnp.int32), ok
+    )
+
+
+@func_range("string_to_timestamp")
+def string_to_timestamp(col: Column) -> Column:
+    """STRING 'yyyy-[M]M-[d]d[ |T][H]H:[m]m:[s]s[.fraction]' ->
+    TIMESTAMP_MICROSECONDS (UTC; Spark cast without zone suffixes). A bare
+    date reads as midnight; fractions carry up to 6 digits (micros —
+    longer fractions are NULL rather than silently truncated)."""
+    if not col.dtype.is_string:
+        raise TypeError("string_to_timestamp requires a STRING column")
+    mat, present, lengths, judgeable = _trimmed_matrix(col, max_len=32)
+    w = mat.shape[1]
+    jdx = jnp.arange(w, dtype=jnp.int32)
+    # the date/time separator: first ' ' or 'T' within the trimmed row
+    sep_mask = present & ((mat == ord(" ")) | (mat == ord("T")))
+    has_sep = jnp.any(sep_mask, axis=1)
+    sep = jnp.where(
+        has_sep, jnp.argmax(sep_mask, axis=1), lengths
+    ).astype(jnp.int32)
+    days, date_ok = _parse_civil_date(mat, present, sep)
+
+    in_time = present & (jdx[None, :] > sep[:, None])
+    _is_digit, field = _digit_field(mat, in_time)
+    is_colon = in_time & (mat == ord(":"))
+    n_colon = jnp.sum(is_colon, axis=1)
+    c1 = jnp.where(jnp.any(is_colon, axis=1),
+                   jnp.argmax(is_colon, axis=1), w).astype(jnp.int32)
+    after_c1 = is_colon & (jdx[None, :] > c1[:, None])
+    c2 = jnp.where(jnp.any(after_c1, axis=1),
+                   jnp.argmax(after_c1, axis=1), w).astype(jnp.int32)
+    dot_mask = in_time & (mat == ord("."))
+    has_dot = jnp.any(dot_mask, axis=1)
+    dot = jnp.where(has_dot, jnp.argmax(dot_mask, axis=1),
+                    lengths).astype(jnp.int32)
+
+    hh, h_ok = field(sep + 1, c1)
+    mm, mi_ok = field(c1 + 1, c2)
+    ss, s_ok = field(c2 + 1, jnp.minimum(dot, lengths))
+    frac_digits = lengths - dot - 1
+    fr, f_ok = field(dot + 1, lengths)
+    # scale the fraction to microseconds by its digit count
+    fscale = 10 ** jnp.clip(6 - frac_digits, 0, 6).astype(jnp.int64)
+    micros_frac = jnp.where(has_dot, fr.astype(jnp.int64) * fscale, 0)
+    f_ok = jnp.where(
+        has_dot, f_ok & (frac_digits >= 1) & (frac_digits <= 6), True
+    )
+
+    def width_ok(lo, hi, wmin, wmax):
+        width = hi - lo
+        return (width >= wmin) & (width <= wmax)
+
+    time_shape_ok = (
+        (n_colon == 2)
+        & width_ok(sep + 1, c1, 1, 2)
+        & width_ok(c1 + 1, c2, 1, 2)
+        & width_ok(c2 + 1, jnp.minimum(dot, lengths), 1, 2)
+        & h_ok & mi_ok & s_ok & f_ok
+        & (hh >= 0) & (hh <= 23) & (mm >= 0) & (mm <= 59)
+        & (ss >= 0) & (ss <= 59)
+    )
+    time_micros = (
+        (hh.astype(jnp.int64) * 3600 + mm.astype(jnp.int64) * 60
+         + ss.astype(jnp.int64)) * 1_000_000 + micros_frac
+    )
+    time_value = jnp.where(has_sep, time_micros, 0)
+    time_valid = jnp.where(has_sep, time_shape_ok, True)
+
+    ok = col.valid_mask() & judgeable & date_ok & time_valid
+    micros = days.astype(jnp.int64) * 86_400_000_000 + time_value
+    return Column(
+        t.TIMESTAMP_MICROSECONDS, jnp.where(ok, micros, 0), ok
     )
 
 
@@ -537,12 +640,4 @@ def date_to_string(col: Column) -> Column:
         fmt(yy, mm, dd) if v else b""
         for yy, mm, dd, v in zip(y, m, d, ok)
     ]
-    offsets = np.zeros(len(pieces) + 1, dtype=np.int32)
-    np.cumsum([len(p) for p in pieces], out=offsets[1:])
-    chars = np.frombuffer(b"".join(pieces), dtype=np.uint8)
-    return Column(
-        t.STRING,
-        jnp.asarray(offsets),
-        None if ok.all() else jnp.asarray(ok),
-        chars=jnp.asarray(chars.copy() if chars.size else np.zeros(0, np.uint8)),
-    )
+    return _column_from_pieces(pieces, ok)

@@ -323,8 +323,8 @@ def test_string_to_date_trims_whitespace():
     from spark_rapids_jni_tpu.ops.cast_strings import string_to_date
 
     col = Column.from_pylist(
-        [" 2020-01-02", "2020-01-02 ", "\t2020-1-2 \n", "20 20-01-02",
-         "   "], t.STRING)
+        [" " * 40 + "2020-01-02", "2020-01-02" + " " * 40,
+         "\t2020-1-2 \n", "20 20-01-02", "   "], t.STRING)
     out = string_to_date(col)
     v = np.asarray(out.valid_mask())
     assert list(v) == [True, True, True, False, False]
@@ -339,3 +339,65 @@ def test_date_to_string_extreme_years_format_not_null():
     vals = out.to_pylist()
     assert vals[0].startswith("-0") and vals[1].startswith("+1")
     assert np.asarray(out.valid_mask()).all()
+
+
+def test_string_to_timestamp_vs_python_oracle(rng):
+    import datetime
+
+    from spark_rapids_jni_tpu.ops.cast_strings import string_to_timestamp
+
+    epoch = datetime.datetime(1970, 1, 1)
+    rows, want = [], []
+    for _ in range(300):
+        y = int(rng.integers(1900, 2100))
+        mo = int(rng.integers(1, 13))
+        d = int(rng.integers(1, 29))
+        h = int(rng.integers(0, 24))
+        mi = int(rng.integers(0, 60))
+        sec = int(rng.integers(0, 60))
+        us = int(rng.integers(0, 1_000_000))
+        dt = datetime.datetime(y, mo, d, h, mi, sec, us)
+        style = rng.random()
+        if style < 0.3:
+            rows.append(dt.strftime("%Y-%m-%d %H:%M:%S.%f"))
+        elif style < 0.5:
+            rows.append(dt.strftime("%Y-%m-%dT%H:%M:%S.%f"))
+        elif style < 0.7:
+            dt = dt.replace(microsecond=0)
+            rows.append(dt.strftime("%Y-%m-%d %H:%M:%S"))
+        elif style < 0.85:
+            dt = dt.replace(microsecond=(us // 1000) * 1000)
+            rows.append(dt.strftime("%Y-%m-%d %H:%M:%S.") + f"{us // 1000:03d}")
+        else:
+            dt = dt.replace(hour=0, minute=0, second=0, microsecond=0)
+            rows.append(dt.strftime("%Y-%m-%d"))
+        want.append((dt - epoch) // datetime.timedelta(microseconds=1))
+    bad = ["2020-01-01 25:00:00", "2020-01-01 10:61:00", "2020-01-01 10:00",
+           "2020-01-01 10:00:00.", "2020-01-01 10:00:00.1234567",
+           "2020-01-01X10:00:00", "2020-13-01 00:00:00", None,
+           "2020-01-01 1:2:3:4"]
+    col = Column.from_pylist(rows + bad, t.STRING)
+    out = string_to_timestamp(col)
+    got_valid = np.asarray(out.valid_mask())
+    got = np.asarray(out.data)
+    for i, s in enumerate(rows):
+        assert got_valid[i], s
+        assert got[i] == want[i], (s, int(got[i]), want[i])
+    for j, s in enumerate(bad):
+        assert not got_valid[len(rows) + j], s
+
+
+def test_string_to_timestamp_trim_and_single_digit_fields():
+    import datetime
+
+    from spark_rapids_jni_tpu.ops.cast_strings import string_to_timestamp
+
+    epoch = datetime.datetime(1970, 1, 1)
+    col = Column.from_pylist(
+        ["  2020-1-2 3:4:5  ", "2020-01-02T03:04:05.5"], t.STRING)
+    out = string_to_timestamp(col)
+    assert np.asarray(out.valid_mask()).all()
+    dt = datetime.datetime(2020, 1, 2, 3, 4, 5)
+    us = (dt - epoch) // datetime.timedelta(microseconds=1)
+    assert int(np.asarray(out.data)[0]) == us
+    assert int(np.asarray(out.data)[1]) == us + 500_000
