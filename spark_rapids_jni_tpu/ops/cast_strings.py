@@ -641,3 +641,28 @@ def date_to_string(col: Column) -> Column:
         for yy, mm, dd, v in zip(y, m, d, ok)
     ]
     return _column_from_pieces(pieces, ok)
+
+
+@func_range("string_to_boolean")
+def string_to_boolean(col: Column) -> Column:
+    """STRING -> BOOL8 (Spark cast): case-insensitive t/true/y/yes/1 and
+    f/false/n/no/0, whitespace-trimmed; anything else is NULL."""
+    if not col.dtype.is_string:
+        raise TypeError("string_to_boolean requires a STRING column")
+    mat, present, lengths, judgeable = _trimmed_matrix(col, max_len=8)
+    lower = jnp.where(
+        present & (mat >= ord("A")) & (mat <= ord("Z")), mat + 32, mat
+    )
+
+    def is_word(word: bytes) -> jnp.ndarray:
+        ok = lengths == len(word)
+        for i, b in enumerate(word):
+            ok = ok & (lower[:, i] == b)
+        return ok
+
+    truthy = (is_word(b"t") | is_word(b"true") | is_word(b"y")
+              | is_word(b"yes") | is_word(b"1"))
+    falsy = (is_word(b"f") | is_word(b"false") | is_word(b"n")
+             | is_word(b"no") | is_word(b"0"))
+    ok = col.valid_mask() & judgeable & (truthy | falsy)
+    return Column(t.BOOL8, truthy.astype(jnp.uint8), ok)

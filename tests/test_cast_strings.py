@@ -401,3 +401,15 @@ def test_string_to_timestamp_trim_and_single_digit_fields():
     us = (dt - epoch) // datetime.timedelta(microseconds=1)
     assert int(np.asarray(out.data)[0]) == us
     assert int(np.asarray(out.data)[1]) == us + 500_000
+
+
+def test_string_to_boolean_spark_words():
+    from spark_rapids_jni_tpu.ops.cast_strings import string_to_boolean
+
+    vals = ["true", "TRUE", " t ", "y", "Yes", "1", "false", "F", "no",
+            "N", "0", "truthy", "", "2", None, "tru"]
+    out = string_to_boolean(Column.from_pylist(vals, t.STRING))
+    assert out.to_pylist() == [
+        True, True, True, True, True, True, False, False, False,
+        False, False, None, None, None, None, None,
+    ]
