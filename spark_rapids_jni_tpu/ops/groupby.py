@@ -46,16 +46,9 @@ class GroupByResult(NamedTuple):
                 "groupby output overflowed max_groups (groups were dropped); "
                 "grow and retry (groupby_aggregate_auto) before compacting"
             )
-        k = int(self.num_groups)
-        cols = []
-        for c in self.table.columns:
-            validity = None if c.validity is None else c.validity[:k]
-            if c.dtype.is_string:
-                cols.append(Column(c.dtype, c.data[:k], validity,
-                                   chars=c.chars[:k]))
-            else:
-                cols.append(Column(c.dtype, c.data[:k], validity))
-        return Table(cols)
+        from spark_rapids_jni_tpu.ops.table_ops import trim_table
+
+        return trim_table(self.table, int(self.num_groups))
 
 
 def _rows_equal_prev(table: Table, keys: Sequence[int]) -> jnp.ndarray:

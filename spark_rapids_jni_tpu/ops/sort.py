@@ -48,9 +48,21 @@ def _as_unsigned_key(col_data: jnp.ndarray, dtype: DType) -> jnp.ndarray:
 
 
 def _key_arrays(col: Column, ascending: bool, nulls_first: bool):
-    """Return the lexsort key(s) for one column, minor-to-major order."""
+    """Return the lexsort key(s) for one column, minor-to-major order.
+
+    Null rows' VALUE keys are forced to a constant: a null cell's stored
+    bytes are unspecified (Column contract), and letting them order the
+    null run would split the null group across clusters once later sort
+    keys reset between them — adjacent-equality consumers (groupby,
+    distinct, rank encoding) would then see several "null groups" where
+    SQL semantics require one. With the constant, null rows tie on this
+    column and order by the remaining keys, like any other equal run.
+    """
     dtype = col.dtype
     valid = col.valid_mask()
+
+    def null_const(keys):
+        return [jnp.where(valid, k, jnp.zeros((), k.dtype)) for k in keys]
 
     if dtype.is_decimal128:
         # limb-pair compare: unsigned low limb minor, sign-flipped high limb
@@ -62,7 +74,7 @@ def _key_arrays(col: Column, ascending: bool, nulls_first: bool):
             value_keys = [~k for k in value_keys]
         null_key = jnp.where(valid, jnp.uint8(1), jnp.uint8(0))
         null_rank = null_key if nulls_first else jnp.uint8(1) - null_key
-        return value_keys + [null_rank]
+        return null_const(value_keys) + [null_rank]
     if dtype.is_string:
         from spark_rapids_jni_tpu.ops import strings as s
 
@@ -71,7 +83,7 @@ def _key_arrays(col: Column, ascending: bool, nulls_first: bool):
             value_keys = [~k for k in value_keys]
         null_key = jnp.where(valid, jnp.uint8(1), jnp.uint8(0))
         null_rank = null_key if nulls_first else jnp.uint8(1) - null_key
-        return value_keys + [null_rank]
+        return null_const(value_keys) + [null_rank]
 
     np_dt = dtype.storage_dtype
     n = col.size
@@ -97,7 +109,7 @@ def _key_arrays(col: Column, ascending: bool, nulls_first: bool):
     else:
         null_rank = jnp.uint8(1) - null_key  # valids (0) first
     del n
-    return value_keys + [null_rank]  # null rank is most significant
+    return null_const(value_keys) + [null_rank]  # null rank most significant
 
 
 def _key_bits(arr: jnp.ndarray) -> int | None:

@@ -1,0 +1,163 @@
+"""Table-level cuDF operator parity: concatenate, boolean-mask stream
+compaction, and distinct (cuDF ``concatenate`` / ``apply_boolean_mask`` /
+``distinct`` — vendored capability surface, SURVEY.md section 2.2).
+
+TPU-first shape discipline throughout: compaction-style ops cannot return
+data-dependent shapes under jit, so they follow the framework-wide
+padded-plus-count contract (rows compacted to the front, ``num_rows``
+reported; callers slice on host) — the same contract groupby and the
+shuffle use. No scatters: compaction is a stable argsort on the keep flag
+(kept rows first, input order preserved), which XLA sorts as one pass.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.sort import gather, sort_order
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+def trim_table(table: Table, k: int) -> Table:
+    """Host-side trim of a padded result to its first ``k`` real rows —
+    the shared tail of every padded-plus-count contract (groupby,
+    compaction). Handles fixed-width, limb-pair, padded-string, and
+    Arrow-string columns (whose offsets need k+1 entries)."""
+    cols = []
+    for c in table.columns:
+        validity = None if c.validity is None else c.validity[:k]
+        if c.dtype.is_string and c.is_padded_string:
+            cols.append(Column(c.dtype, c.data[:k], validity,
+                               chars=c.chars[:k]))
+        elif c.dtype.is_string:
+            nchars = int(c.data[k])
+            cols.append(Column(c.dtype, c.data[: k + 1], validity,
+                               chars=c.chars[:nchars]))
+        else:
+            cols.append(Column(c.dtype, c.data[:k], validity))
+    return Table(cols)
+
+
+class CompactResult(NamedTuple):
+    table: Table             # kept rows first, padded to the input size
+    num_rows: jnp.ndarray    # scalar int32: real row count
+
+    def compact(self) -> Table:
+        """Host-side trim to the real row count."""
+        return trim_table(self.table, int(self.num_rows))
+
+
+def _concat_columns(cols: Sequence[Column]) -> Column:
+    dtype = cols[0].dtype
+    for c in cols[1:]:
+        if c.dtype != dtype:
+            raise TypeError(
+                f"concatenate: column dtypes differ ({c.dtype} vs {dtype})"
+            )
+    if all(c.validity is None for c in cols):
+        validity = None  # keep the no-null-mask fast path alive
+    else:
+        validity = jnp.concatenate([c.valid_mask() for c in cols])
+    if dtype.is_string:
+        if any(c.is_padded_string for c in cols):
+            # normalize to the padded device layout at the widest width
+            from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+            padded = [pad_strings(c) for c in cols]
+            width = max(int(p.chars.shape[1]) for p in padded)
+            mats = [
+                jnp.pad(p.chars, ((0, 0), (0, width - int(p.chars.shape[1]))))
+                for p in padded
+            ]
+            return Column(
+                dtype,
+                jnp.concatenate([p.data for p in padded]),
+                validity,
+                chars=jnp.concatenate(mats),
+            )
+        # Arrow layout: shift each table's offsets by the chars written so far
+        parts, offs, base = [], [], 0
+        for c in cols:
+            offs.append(c.data[:-1] + base if c.size else c.data[:0])
+            parts.append(c.chars)
+            base = base + c.data[-1] if c.size else base
+        offs.append(jnp.asarray([base], jnp.int32).reshape(1))
+        return Column(
+            dtype,
+            jnp.concatenate(offs).astype(jnp.int32),
+            validity,
+            chars=jnp.concatenate(parts) if parts else jnp.zeros(0, jnp.uint8),
+        )
+    return Column(dtype, jnp.concatenate([c.data for c in cols]), validity)
+
+
+@func_range("concatenate")
+def concatenate(tables: Sequence[Table]) -> Table:
+    """Row-wise concatenation (cuDF ``concatenate``): schemas must match;
+    string columns concat in either layout (Arrow offsets re-based on
+    device; padded layouts widened to the max width)."""
+    tables = list(tables)
+    if not tables:
+        raise ValueError("concatenate needs at least one table")
+    ncols = tables[0].num_columns
+    for tb in tables[1:]:
+        if tb.num_columns != ncols:
+            raise TypeError("concatenate: column counts differ")
+    return Table([
+        _concat_columns([tb.column(i) for tb in tables])
+        for i in range(ncols)
+    ])
+
+
+@func_range("apply_boolean_mask")
+def apply_boolean_mask(table: Table, mask: jnp.ndarray) -> CompactResult:
+    """Stream compaction (cuDF ``apply_boolean_mask``): keep rows where
+    ``mask`` is True, preserving input order. Output is padded to the
+    input size with ``num_rows`` alongside (slice on host)."""
+    n = table.num_rows
+    if mask.shape != (n,):
+        raise ValueError(f"mask shape {mask.shape} != ({n},)")
+    keep = mask.astype(jnp.bool_)
+    # stable argsort on the drop flag: kept rows first, original order kept
+    order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    num = jnp.sum(keep).astype(jnp.int32)
+    return CompactResult(_gather_mask_tail(table, order, num), num)
+
+
+def _gather_mask_tail(table: Table, order: jnp.ndarray,
+                      num: jnp.ndarray) -> Table:
+    """One gather by ``order`` with rows past ``num`` forced null (padding
+    must not read as stale duplicates)."""
+    out = gather(table, order)
+    j = jnp.arange(table.num_rows, dtype=jnp.int32)
+    cols = []
+    for c in out.columns:
+        validity = c.valid_mask() & (j < num)
+        if c.dtype.is_string:
+            cols.append(Column(c.dtype, c.data, validity, chars=c.chars))
+        else:
+            cols.append(Column(c.dtype, c.data, validity))
+    return Table(cols)
+
+
+@func_range("distinct")
+def distinct(table: Table, keys: Optional[Sequence[int]] = None) -> CompactResult:
+    """Distinct key tuples (cuDF ``distinct`` / Spark dropDuplicates):
+    keeps one row per distinct tuple over ``keys`` (default: all columns);
+    null tuples count as equal (one null group). Output rows arrive in
+    key-sorted order, padded, with the distinct count alongside."""
+    ks = list(range(table.num_columns)) if keys is None else list(keys)
+    from spark_rapids_jni_tpu.ops.groupby import _rows_equal_prev
+
+    order = sort_order(table, ks)
+    # adjacency only needs the KEY columns sorted; the full table is
+    # gathered once, through the composed permutation
+    key_sorted = gather(Table([table.column(k) for k in ks]), order)
+    same = _rows_equal_prev(key_sorted, list(range(len(ks))))
+    keep = ~same
+    perm = jnp.argsort(same, stable=True).astype(jnp.int32)
+    num = jnp.sum(keep).astype(jnp.int32)
+    return CompactResult(_gather_mask_tail(table, order[perm], num), num)

@@ -498,9 +498,10 @@ def test_sort_packed_key_matches_multikey(rng):
                  Column.from_numpy(k2)])
     order = np.asarray(sort_order(tbl, [0, 1]))
     # numpy oracle mirroring the key encoding: null rank most significant
-    # (nulls first), then the k1 value key (null rows keep their stored
-    # value as tie-break, same as the unpacked lexsort), then k2; stable
-    oracle = np.lexsort((k2, k1, valid.astype(np.int8)))
+    # (nulls first), then the k1 value key with null rows forced to one
+    # constant (they tie and fall through to k2), then k2; stable
+    k1_masked = np.where(valid, k1, np.int8(0))
+    oracle = np.lexsort((k2, k1_masked, valid.astype(np.int8)))
     assert np.array_equal(order, oracle)
 
 
@@ -524,3 +525,30 @@ def test_sort_packed_key_32bit_primary_with_nulls(rng):
     # valid rows ordered by k1 then k2
     vk1 = k1[order][nnull:]
     assert np.all(np.diff(vk1.astype(np.int64)) >= 0)
+
+
+def test_groupby_null_keys_with_garbage_storage_form_one_group(rng):
+    # regression: null cells carry unspecified stored bytes; rows with
+    # DIFFERENT garbage under null keys must still form ONE null group
+    # (the sort masks null value keys to a constant — without that, later
+    # sort keys reset between garbage clusters and the null group splits)
+    n = 200
+    keys = rng.integers(-(10**9), 10**9, n).astype(np.int64)  # garbage
+    valid = rng.random(n) > 0.5
+    sub = rng.integers(0, 3, n).astype(np.int8)  # secondary key
+    vals = rng.integers(0, 100, n).astype(np.int64)
+    tbl = Table([Column.from_numpy(keys, validity=valid),
+                 Column.from_numpy(sub),
+                 Column.from_numpy(vals)])
+    res = groupby_aggregate(tbl, [0, 1], [(2, "sum"), (2, "count")])
+    want = {}
+    for k, ok, sb, v in zip(keys, valid, sub, vals):
+        kk = (int(k) if ok else None, int(sb))
+        want[kk] = want.get(kk, 0) + int(v)
+    assert int(res.num_groups) == len(want)
+    out = res.compact()
+    got = {}
+    for i in range(out.num_rows):
+        kv = out.column(0).to_pylist()[i]
+        got[(kv, out.column(1).to_pylist()[i])] = out.column(2).to_pylist()[i]
+    assert got == want

@@ -1,0 +1,108 @@
+"""concatenate / apply_boolean_mask / distinct vs numpy oracles."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.table_ops import (
+    apply_boolean_mask,
+    concatenate,
+    distinct,
+)
+
+
+def test_concatenate_fixed_and_decimal128(rng):
+    a = rng.integers(-100, 100, 50).astype(np.int64)
+    b = rng.integers(-100, 100, 30).astype(np.int64)
+    va = rng.random(50) > 0.2
+    d1 = Column.from_pylist([1 << 70, None, -5], t.decimal128(-2))
+    d2 = Column.from_pylist([7, 1 << 80], t.decimal128(-2))
+    t1 = Table([Column.from_numpy(a, validity=va),
+                Column.from_numpy(np.arange(50, dtype=np.int32))])
+    t2 = Table([Column.from_numpy(b),
+                Column.from_numpy(np.arange(30, dtype=np.int32))])
+    out = concatenate([t1, t2])
+    assert out.num_rows == 80
+    got = np.asarray(out.column(0).data)
+    assert np.array_equal(got[:50], a) and np.array_equal(got[50:], b)
+    assert np.array_equal(
+        np.asarray(out.column(0).valid_mask())[:50], va)
+    dcat = concatenate([Table([d1]), Table([d2])]).column(0)
+    assert dcat.to_pylist() == [1 << 70, None, -5, 7, 1 << 80]
+
+
+def test_concatenate_arrow_strings():
+    s1 = Column.from_pylist(["ab", None, "xyz"], t.STRING)
+    s2 = Column.from_pylist(["", "qq"], t.STRING)
+    out = concatenate([Table([s1]), Table([s2])]).column(0)
+    assert out.to_pylist() == ["ab", None, "xyz", "", "qq"]
+
+
+def test_concatenate_padded_strings():
+    from spark_rapids_jni_tpu.ops.strings import pad_strings, unpad_strings
+
+    s1 = pad_strings(Column.from_pylist(["a", "bbbb"], t.STRING))
+    s2 = Column.from_pylist(["cc", None], t.STRING)
+    out = concatenate([Table([s1]), Table([s2])]).column(0)
+    assert unpad_strings(out).to_pylist() == ["a", "bbbb", "cc", None]
+
+
+def test_concatenate_type_mismatch_raises():
+    t1 = Table([Column.from_numpy(np.zeros(2, np.int64))])
+    t2 = Table([Column.from_numpy(np.zeros(2, np.int32))])
+    with pytest.raises(TypeError):
+        concatenate([t1, t2])
+
+
+def test_apply_boolean_mask_order_and_padding(rng):
+    import jax
+
+    n = 300
+    vals = rng.integers(0, 1000, n).astype(np.int64)
+    valid = rng.random(n) > 0.1
+    mask = rng.random(n) > 0.5
+    tbl = Table([Column.from_numpy(vals, validity=valid)])
+    res = jax.jit(apply_boolean_mask)(tbl, np.asarray(mask))
+    k = int(res.num_rows)
+    assert k == int(mask.sum())
+    out = res.compact()
+    assert np.array_equal(np.asarray(out.column(0).data), vals[mask])
+    assert np.array_equal(
+        np.asarray(out.column(0).valid_mask()), valid[mask])
+    # padding tail reads as null
+    tail_valid = np.asarray(res.table.column(0).valid_mask())[k:]
+    assert not tail_valid.any()
+
+
+def test_apply_boolean_mask_strings():
+    s = Column.from_pylist(["a", "bb", None, "dddd", "e"], t.STRING)
+    res = apply_boolean_mask(
+        Table([s]), np.array([True, False, True, True, False]))
+    from spark_rapids_jni_tpu.ops.strings import unpad_strings
+
+    out = unpad_strings(res.compact().column(0))
+    assert out.to_pylist() == ["a", None, "dddd"]
+
+
+def test_distinct_vs_numpy(rng):
+    n = 500
+    a = rng.integers(0, 12, n).astype(np.int64)
+    b = rng.integers(0, 4, n).astype(np.int8)
+    valid = rng.random(n) > 0.15
+    tbl = Table([Column.from_numpy(a, validity=valid),
+                 Column.from_numpy(b)])
+    res = distinct(tbl, [0, 1])
+    out = res.compact()
+    want = set()
+    for x, y, ok in zip(a, b, valid):
+        want.add((int(x) if ok else None, int(y)))
+    got = set(zip(out.column(0).to_pylist(), out.column(1).to_pylist()))
+    assert got == want
+    assert int(res.num_rows) == len(want)
+
+
+def test_distinct_all_columns_default():
+    tbl = Table([Column.from_numpy(np.array([3, 1, 3, 1, 2], np.int64))])
+    res = distinct(tbl)
+    assert res.compact().column(0).to_pylist() == [1, 2, 3]
