@@ -1,0 +1,106 @@
+"""Column-level scalar reductions — cuDF ``reduce`` parity (SUM/MIN/MAX/
+MEAN/COUNT with SQL null semantics: nulls skipped; an all-null column's
+SUM/MIN/MAX/MEAN is null). Fully jittable; each op returns
+(value, valid) device scalars so callers compose without host syncs."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+def _masked(col: Column, neutral):
+    valid = col.valid_mask()
+    return jnp.where(valid, col.data, jnp.asarray(neutral, col.data.dtype)), valid
+
+
+@func_range("reduce_count")
+def count(col: Column) -> jnp.ndarray:
+    """Non-null count (always valid)."""
+    return jnp.sum(col.valid_mask()).astype(jnp.int64)
+
+
+@func_range("reduce_sum")
+def sum_(col: Column):
+    """(sum, valid): int/decimal accumulate in int64 (exact); floats in
+    their own dtype. DECIMAL128 sums limb-exactly (carry recombination)."""
+    valid = col.valid_mask()
+    has_any = jnp.any(valid)
+    if col.dtype.is_decimal128:
+        m32 = jnp.int64(0xFFFFFFFF)
+        lo = jnp.where(valid, col.data[:, 0], jnp.int64(0))
+        hi = jnp.where(valid, col.data[:, 1], jnp.int64(0))
+        s0 = jnp.sum(lo & m32)
+        s1 = jnp.sum((lo >> 32) & m32)
+        s2 = jnp.sum(hi & m32)
+        s3 = jnp.sum(hi >> 32)
+        c0 = s0 & m32
+        tq = s1 + (s0 >> 32)
+        lo_t = c0 | ((tq & m32) << 32)
+        u = s2 + (tq >> 32)
+        hi_t = (u & m32) + ((s3 + (u >> 32)) << 32)
+        return jnp.stack([lo_t, hi_t]), has_any
+    vals, _ = _masked(col, 0)
+    kind = col.dtype.storage_dtype.kind
+    if kind == "u":
+        # unsigned accumulates unsigned: values >= 2^63 must not wrap
+        return jnp.sum(vals.astype(jnp.uint64)), has_any
+    if kind in ("i", "b"):
+        return jnp.sum(vals.astype(jnp.int64)), has_any
+    return jnp.sum(vals), has_any
+
+
+def _minmax(col: Column, op: str):
+    if col.dtype.is_string or col.dtype.is_decimal128:
+        # order statistics via one sort: the winner is row 0 / row n-1 of
+        # the nulls-last order (rank trick without the groupby machinery)
+        from spark_rapids_jni_tpu.columnar import Table
+        from spark_rapids_jni_tpu.ops.sort import gather, sort_order
+
+        order = sort_order(Table([col]), [0], nulls_first=[False])
+        valid = col.valid_mask()
+        has_any = jnp.any(valid)
+        n = col.size
+        pos = jnp.where(
+            jnp.asarray(op == "min"), 0,
+            jnp.maximum(jnp.sum(valid).astype(jnp.int32) - 1, 0),
+        )
+        winner = gather(Table([col]), order[pos][None])
+        return winner.column(0), has_any
+    np_dt = col.dtype.storage_dtype
+    if np_dt.kind == "f":
+        neutral = np.inf if op == "min" else -np.inf
+    else:
+        info = np.iinfo(np_dt)
+        neutral = info.max if op == "min" else info.min
+    vals, valid = _masked(col, neutral)
+    red = jnp.min(vals) if op == "min" else jnp.max(vals)
+    return red, jnp.any(valid)
+
+
+@func_range("reduce_min")
+def min_(col: Column):
+    return _minmax(col, "min")
+
+
+@func_range("reduce_max")
+def max_(col: Column):
+    return _minmax(col, "max")
+
+
+@func_range("reduce_mean")
+def mean(col: Column):
+    """(mean as FLOAT64, valid); decimals rescale so the float carries the
+    true value (the groupby mean contract). DECIMAL128 unsupported (lossy
+    f64-emulated mean would be silent corruption)."""
+    if col.dtype.is_decimal128:
+        raise NotImplementedError("DECIMAL128 mean (see groupby rationale)")
+    total, has_any = sum_(col)
+    denom = jnp.maximum(count(col), 1).astype(jnp.float64)
+    m = total.astype(jnp.float64) / denom
+    if col.dtype.is_decimal:
+        m = m * (10.0 ** col.dtype.scale)
+    return m, has_any

@@ -21,24 +21,35 @@ from spark_rapids_jni_tpu.ops.sort import gather, sort_order
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 
+def _slice_rows(table: Table, lo: int, hi: int) -> Table:
+    """Host-level row slice [lo, hi) handling every column layout
+    (fixed-width, limb-pair, padded string, Arrow string — whose offsets
+    need hi-lo+1 entries re-based to the slice's first char)."""
+    cols = []
+    for c in table.columns:
+        validity = None if c.validity is None else c.validity[lo:hi]
+        if c.dtype.is_string and c.is_padded_string:
+            cols.append(Column(c.dtype, c.data[lo:hi], validity,
+                               chars=c.chars[lo:hi]))
+        elif c.dtype.is_string:
+            base_lo = int(c.data[lo])
+            base_hi = int(c.data[hi])
+            cols.append(Column(
+                c.dtype,
+                (c.data[lo:hi + 1] - base_lo).astype(jnp.int32),
+                validity,
+                chars=c.chars[base_lo:base_hi],
+            ))
+        else:
+            cols.append(Column(c.dtype, c.data[lo:hi], validity))
+    return Table(cols)
+
+
 def trim_table(table: Table, k: int) -> Table:
     """Host-side trim of a padded result to its first ``k`` real rows —
     the shared tail of every padded-plus-count contract (groupby,
-    compaction). Handles fixed-width, limb-pair, padded-string, and
-    Arrow-string columns (whose offsets need k+1 entries)."""
-    cols = []
-    for c in table.columns:
-        validity = None if c.validity is None else c.validity[:k]
-        if c.dtype.is_string and c.is_padded_string:
-            cols.append(Column(c.dtype, c.data[:k], validity,
-                               chars=c.chars[:k]))
-        elif c.dtype.is_string:
-            nchars = int(c.data[k])
-            cols.append(Column(c.dtype, c.data[: k + 1], validity,
-                               chars=c.chars[:nchars]))
-        else:
-            cols.append(Column(c.dtype, c.data[:k], validity))
-    return Table(cols)
+    compaction)."""
+    return _slice_rows(table, 0, k)
 
 
 class CompactResult(NamedTuple):
@@ -161,3 +172,18 @@ def distinct(table: Table, keys: Optional[Sequence[int]] = None) -> CompactResul
     perm = jnp.argsort(same, stable=True).astype(jnp.int32)
     num = jnp.sum(keep).astype(jnp.int32)
     return CompactResult(_gather_mask_tail(table, order[perm], num), num)
+
+
+@func_range("contiguous_split")
+def contiguous_split(table: Table, splits: Sequence[int]) -> list[Table]:
+    """Split rows at the given indices (cuDF ``contiguous_split``, the
+    primitive the Spark plugin uses to carve shuffle partitions):
+    ``splits=[a, b]`` -> three tables covering [0,a), [a,b), [b,n).
+    Host-level API (static row counts per piece); each piece's buffers
+    are device slices of the parent."""
+    n = table.num_rows
+    bounds = [0] + [int(x) for x in splits] + [n]
+    for lo, hi in zip(bounds, bounds[1:]):
+        if lo > hi or lo < 0 or hi > n:
+            raise ValueError(f"bad split bounds {splits} for {n} rows")
+    return [_slice_rows(table, lo, hi) for lo, hi in zip(bounds, bounds[1:])]

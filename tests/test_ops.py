@@ -547,8 +547,6 @@ def test_groupby_null_keys_with_garbage_storage_form_one_group(rng):
         want[kk] = want.get(kk, 0) + int(v)
     assert int(res.num_groups) == len(want)
     out = res.compact()
-    got = {}
-    for i in range(out.num_rows):
-        kv = out.column(0).to_pylist()[i]
-        got[(kv, out.column(1).to_pylist()[i])] = out.column(2).to_pylist()[i]
+    c0, c1, c2 = (out.column(i).to_pylist() for i in range(3))
+    got = {(c0[i], c1[i]): c2[i] for i in range(out.num_rows)}
     assert got == want

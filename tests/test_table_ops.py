@@ -106,3 +106,72 @@ def test_distinct_all_columns_default():
     tbl = Table([Column.from_numpy(np.array([3, 1, 3, 1, 2], np.int64))])
     res = distinct(tbl)
     assert res.compact().column(0).to_pylist() == [1, 2, 3]
+
+
+def test_contiguous_split_arrow_strings_and_fixed(rng):
+    from spark_rapids_jni_tpu.ops.table_ops import contiguous_split
+
+    vals = rng.integers(0, 100, 10).astype(np.int64)
+    strs = [f"s{i}" * (i % 3) for i in range(10)]
+    tbl = Table([Column.from_numpy(vals),
+                 Column.from_pylist(strs, t.STRING)])
+    parts = contiguous_split(tbl, [3, 7])
+    assert [p.num_rows for p in parts] == [3, 4, 3]
+    got = []
+    for p in parts:
+        got.extend(p.column(1).to_pylist())
+    assert got == strs
+    back = np.concatenate([np.asarray(p.column(0).data) for p in parts])
+    assert np.array_equal(back, vals)
+
+
+def test_reduce_vs_numpy(rng):
+    from spark_rapids_jni_tpu.ops import reduce as r
+
+    n = 300
+    vals = rng.integers(-1000, 1000, n).astype(np.int64)
+    valid = rng.random(n) > 0.2
+    col = Column.from_numpy(vals, validity=valid)
+    s, ok = r.sum_(col)
+    assert bool(ok) and int(s) == vals[valid].sum()
+    assert int(r.count(col)) == valid.sum()
+    mn, ok1 = r.min_(col)
+    mx, ok2 = r.max_(col)
+    assert int(mn) == vals[valid].min() and int(mx) == vals[valid].max()
+    m, ok3 = r.mean(col)
+    assert np.isclose(float(m), vals[valid].mean())
+    # all-null: value invalid
+    empty = Column.from_numpy(vals, validity=np.zeros(n, bool))
+    _, ok4 = r.sum_(empty)
+    assert not bool(ok4)
+
+
+def test_reduce_decimal128_and_strings(rng):
+    from spark_rapids_jni_tpu.ops import reduce as r
+
+    vals = [1 << 70, -(1 << 90), 5, None]
+    col = Column.from_pylist(vals, t.decimal128(-2))
+    s, ok = r.sum_(col)
+    limbs = np.asarray(s)
+    got = (int(limbs[1]) << 64) | int(np.uint64(limbs[0]))
+    assert got == (1 << 70) - (1 << 90) + 5
+    mn, _ = r.min_(col)
+    mx, _ = r.max_(col)
+    assert mn.to_pylist() == [-(1 << 90)]
+    assert mx.to_pylist() == [1 << 70]
+    sc = Column.from_pylist(["pear", "apple", None, "zq"], t.STRING)
+    smin, ok1 = r.min_(sc)
+    smax, ok2 = r.max_(sc)
+    assert bool(ok1) and bool(ok2)
+    from spark_rapids_jni_tpu.ops.strings import unpad_strings
+
+    assert unpad_strings(smin).to_pylist() == ["apple"]
+    assert unpad_strings(smax).to_pylist() == ["zq"]
+
+
+def test_reduce_uint64_sum_does_not_wrap():
+    from spark_rapids_jni_tpu.ops import reduce as r
+
+    col = Column.from_numpy(np.array([2**63, 5], np.uint64), t.UINT64)
+    s, ok = r.sum_(col)
+    assert bool(ok) and int(s) == 2**63 + 5
