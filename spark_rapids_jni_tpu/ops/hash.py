@@ -100,12 +100,37 @@ def _column_hash(col: Column, seeds: jnp.ndarray) -> jnp.ndarray:
             bits = _f64_to_bits(norm)
         hashed = xxhash64_long(bits, seeds)
     elif col.dtype.is_decimal128:
-        # limb-chained routing hash: equal 128-bit values hash equally.
-        # (Spark hashes Decimal(>18) by its unscaled byte array — byte-level
-        # parity for wide decimals is deferred; this hash is used for
-        # framework-internal partitioning, where any value-identity hash
-        # routes correctly.)
-        hashed = xxhash64_long(v[:, 1], xxhash64_long(v[:, 0], seeds))
+        # Spark hashes Decimal(precision > 18) as XXH64 over the MINIMAL
+        # big-endian two's-complement byte array of the unscaled value
+        # (java BigDecimal.unscaledValue().toByteArray()): build the
+        # 16-byte big-endian image, strip redundant sign-filler bytes
+        # (keeping one when the next byte's sign bit would flip the
+        # value), left-align, and run the variable-length byte hash.
+        from spark_rapids_jni_tpu.ops.strings import xxhash64_bytes
+
+        lo = v[:, 0]
+        hi = v[:, 1]
+        shifts = jnp.arange(56, -1, -8, dtype=jnp.int64)
+        be = jnp.concatenate(
+            [((hi[:, None] >> shifts[None, :]) & 0xFF),
+             ((lo[:, None] >> shifts[None, :]) & 0xFF)], axis=1
+        ).astype(jnp.uint8)                         # (n, 16) big-endian
+        filler = jnp.where(hi < 0, jnp.uint8(0xFF), jnp.uint8(0))
+        is_filler = be == filler[:, None]
+        # first non-filler byte index (16 when all filler: value 0 / -1)
+        nf = jnp.argmin(is_filler.astype(jnp.int8), axis=1).astype(jnp.int32)
+        all_filler = jnp.all(is_filler, axis=1)
+        first = jnp.where(all_filler, 15, nf)
+        # sign bit of the first kept byte must match the filler's, else
+        # one filler byte stays (0x80 <-> sign flip)
+        fb = jnp.take_along_axis(be, first[:, None], axis=1)[:, 0]
+        sign_mismatch = (fb >= 0x80) != (hi < 0)
+        start = jnp.where(all_filler, 15,
+                          jnp.where(sign_mismatch, first - 1, first))
+        lengths = (16 - start).astype(jnp.int32)
+        src = jnp.clip(start[:, None] + jnp.arange(16, dtype=jnp.int32), 0, 15)
+        shifted = jnp.take_along_axis(be, src, axis=1)
+        hashed = xxhash64_bytes(shifted, lengths, seeds)
     else:
         hashed = xxhash64_long(v.astype(jnp.int64), seeds)
     if col.validity is None:
@@ -118,27 +143,16 @@ def table_xxhash64(
     table: Table,
     columns: Sequence[int] | None = None,
     seed: int = SPARK_DEFAULT_SEED,
-    _internal_routing: bool = False,
 ) -> jnp.ndarray:
     """Row hash: per-column xxhash64 chained left-to-right with the running
-    hash as seed (Spark HashExpression). Returns int64[n].
-
-    Spark-exact for every supported type EXCEPT DECIMAL128, whose Spark
-    hash runs over the unscaled byte array — not yet implemented. A
-    decimal128 column therefore raises unless ``_internal_routing`` is set
-    (partition_hash sets it: any value-identity hash routes correctly)."""
+    hash as seed (Spark HashExpression). Returns int64[n]. Spark-exact for
+    every supported type, including DECIMAL128 (minimal two's-complement
+    byte-array hash, the Decimal(precision > 18) rule)."""
     cols = range(table.num_columns) if columns is None else columns
     n = table.num_rows
     h = jnp.full((n,), np.uint64(seed), dtype=jnp.uint64)
     for c in cols:
-        col = table.column(c)
-        if col.dtype.is_decimal128 and not _internal_routing:
-            raise NotImplementedError(
-                "Spark-exact xxhash64 of DECIMAL128 (unscaled byte array) "
-                "is not implemented; the limb-chained hash is available "
-                "for internal partitioning only"
-            )
-        h = _column_hash(col, h)
+        h = _column_hash(table.column(c), h)
     return h.astype(jnp.int64)
 
 
@@ -146,5 +160,5 @@ def partition_hash(table: Table, columns: Sequence[int], num_partitions: int) ->
     """Spark-style hash partitioning: pmod(hash, n). Returns int32[n].
     jnp's % follows Python semantics (result carries the divisor's sign),
     which IS pmod."""
-    h = table_xxhash64(table, columns, _internal_routing=True)
+    h = table_xxhash64(table, columns)
     return (h % jnp.int64(num_partitions)).astype(jnp.int32)

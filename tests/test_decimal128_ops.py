@@ -194,9 +194,28 @@ def test_decimal128_distributed_sort(rng):
     assert out.column(0).to_pylist() == sorted(vals)
 
 
-def test_decimal128_spark_hash_guarded():
-    from spark_rapids_jni_tpu.ops.hash import table_xxhash64
+def test_decimal128_spark_hash_vs_reference():
+    # Spark Decimal(p>18) hash: XXH64 over the minimal big-endian
+    # two's-complement bytes of the unscaled value (java
+    # BigDecimal.unscaledValue().toByteArray())
+    from spark_rapids_jni_tpu.ops.hash import SPARK_DEFAULT_SEED, table_xxhash64
+    from tests.xxh64_ref import xxh64
 
-    tbl = Table([_col([1, 2])])
-    with pytest.raises(NotImplementedError, match="DECIMAL128"):
-        table_xxhash64(tbl)
+    vals = [0, -1, 1, 127, 128, -128, -129, 255, 256,
+            2**63 - 1, 2**63, -(2**63), -(2**63) - 1,
+            2**64, -(2**64), 2**120 + 12345, -(2**120) - 7, None]
+    tbl = Table([_col(vals)])
+    got = np.asarray(table_xxhash64(tbl))
+
+    def java_bytes(v):
+        ln = 1
+        while not (-(1 << (8 * ln - 1)) <= v <= (1 << (8 * ln - 1)) - 1):
+            ln += 1
+        return v.to_bytes(ln, "big", signed=True)
+
+    for i, v in enumerate(vals):
+        if v is None:
+            assert got[i] == np.int64(np.uint64(SPARK_DEFAULT_SEED))
+        else:
+            want = xxh64(java_bytes(v), SPARK_DEFAULT_SEED)
+            assert np.uint64(got[i]) == np.uint64(want), v
