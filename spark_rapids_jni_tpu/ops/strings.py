@@ -280,3 +280,156 @@ def hash_string_column(col: Column, seeds: jnp.ndarray) -> jnp.ndarray:
     if col.validity is None:
         return hashed
     return jnp.where(col.validity, hashed, seeds)
+
+
+# ---- search predicates (cuDF strings::contains/find, Spark LIKE) -----------
+
+
+def _needle_windows(col: Column, needle: bytes) -> jnp.ndarray:
+    """bool (n, W): position j starts a full match of ``needle`` (callers
+    special-case empty needles; f >= 1 here)."""
+    assert needle, "empty needles are the caller's fast path"
+    p = pad_strings(col)
+    mat, lengths = p.chars, p.data
+    w = int(mat.shape[1])
+    f = len(needle)
+    if f > w:
+        return jnp.zeros((p.size, w), jnp.bool_)
+    jdx = jnp.arange(w, dtype=jnp.int32)
+    win = jnp.ones((p.size, w), jnp.bool_)
+    for off, byte in enumerate(needle):
+        win = win & (jnp.roll(mat, -off, axis=1) == byte)
+    return win & (jdx[None, :] + f <= lengths[:, None])
+
+
+def _bool8_result(hit: jnp.ndarray, col: Column) -> Column:
+    """BOOL8 predicate result; validity passes through untouched (None
+    stays None — the no-null-mask fast path)."""
+    return Column(DType(TypeId.BOOL8), hit.astype(jnp.uint8), col.validity)
+
+
+@func_range("string_contains")
+def contains(col: Column, needle: str) -> Column:
+    """BOOL8: row contains ``needle`` (empty needle matches everything,
+    Java String.contains). Null rows stay null."""
+    nb = needle.encode("utf-8")
+    if not nb:
+        hit = jnp.ones((col.size,), jnp.bool_)
+    else:
+        hit = jnp.any(_needle_windows(col, nb), axis=1)
+    return _bool8_result(hit, col)
+
+
+@func_range("string_starts_with")
+def starts_with(col: Column, prefix: str) -> Column:
+    nb = prefix.encode("utf-8")
+    if not nb:
+        hit = jnp.ones((col.size,), jnp.bool_)
+    else:
+        hit = _needle_windows(col, nb)[:, 0]
+    return _bool8_result(hit, col)
+
+
+@func_range("string_ends_with")
+def ends_with(col: Column, suffix: str) -> Column:
+    nb = suffix.encode("utf-8")
+    p = pad_strings(col)
+    if not nb:
+        hit = jnp.ones((col.size,), jnp.bool_)
+    else:
+        win = _needle_windows(p, nb)
+        pos = jnp.clip(p.data - len(nb), 0, max(int(p.chars.shape[1]) - 1, 0))
+        hit = jnp.take_along_axis(win, pos[:, None], axis=1)[:, 0]
+        hit = hit & (p.data >= len(nb))
+    return _bool8_result(hit, col)
+
+
+@func_range("string_like")
+def like(col: Column, pattern: str, escape: str = "\\") -> Column:
+    """SQL LIKE: '%' any run, '_' any single CHARACTER, escape char
+    literal-izes the next char. Compiled to a literal-segment plan
+    evaluated with vectorized window matches + a per-gap reachability
+    scan — no regex engine, no per-row host work.
+
+    '_' advances one BYTE in this engine; on ASCII data that equals
+    Spark's one-character semantics. Patterns containing '_' against a
+    column with multi-byte UTF-8 rows raise (fail loudly, never silently
+    filter differently than Spark); '%' and literals are byte-exact for
+    any UTF-8 data."""
+    esc = escape.encode("utf-8")
+    if len(esc) != 1:
+        raise ValueError("LIKE escape must be one byte")
+    # compile: list of (literal bytes, min_gap, floating) segments
+    segs: list[bytes] = []
+    gaps: list[tuple[int, bool]] = []  # (min single-char count, saw %)
+    cur = bytearray()
+    pend_gap = [0, False]
+    i = 0
+    pb = pattern.encode("utf-8")
+    while i < len(pb):
+        c = pb[i:i + 1]
+        if c == esc and i + 1 < len(pb):
+            cur += pb[i + 1:i + 2]
+            i += 2
+            continue
+        if c in (b"%", b"_"):
+            if cur:
+                segs.append(bytes(cur))
+                gaps.append(tuple(pend_gap))
+                cur = bytearray()
+                pend_gap = [0, False]
+            if c == b"%":
+                pend_gap[1] = True
+            else:
+                pend_gap[0] += 1
+            i += 1
+            continue
+        cur += c
+        i += 1
+    segs.append(bytes(cur))
+    gaps.append(tuple(pend_gap))
+    tail_gap = (0, False)
+    if not segs[-1] and len(segs) > 1:
+        tail_gap = gaps.pop()
+        segs.pop()
+
+    p = pad_strings(col)
+    if (any(g[0] for g in gaps) or tail_gap[0]) and bool(
+        jnp.any(p.chars >= 0x80)
+    ):
+        raise NotImplementedError(
+            "LIKE '_' advances one byte in this engine; the pattern uses "
+            "'_' and the column holds multi-byte UTF-8, where Spark's "
+            "one-character semantics would diverge — failing loudly "
+            "instead of filtering differently"
+        )
+    n = p.size
+    w = int(p.chars.shape[1])
+    jdx = jnp.arange(w + 1, dtype=jnp.int32)
+    # reach[j] True: pattern consumed so far can end exactly at byte j
+    reach = jnp.zeros((n, w + 1), jnp.bool_).at[:, 0].set(True)
+    for seg, (mincnt, floating) in zip(segs, gaps):
+        # gap: advance exactly mincnt (then any amount if floating)
+        if mincnt:
+            reach = jnp.roll(reach, mincnt, axis=1)
+            reach = reach & (jdx[None, :] >= mincnt)
+        reach = reach & (jdx[None, :] <= p.data[:, None])
+        if floating:
+            reach = jax.lax.associative_scan(jnp.logical_or, reach, axis=1)
+        if seg:
+            win = _needle_windows(p, seg)  # (n, w): match starting at j
+            ok_start = jnp.concatenate(
+                [win, jnp.zeros((n, 1), jnp.bool_)], axis=1)
+            moved = jnp.roll(reach & ok_start, len(seg), axis=1)
+            reach = moved & (jdx[None, :] >= len(seg))
+    mincnt, floating = tail_gap
+    if mincnt:
+        reach = jnp.roll(reach, mincnt, axis=1)
+        reach = reach & (jdx[None, :] >= mincnt)
+    reach = reach & (jdx[None, :] <= p.data[:, None])
+    if floating:
+        hit = jnp.any(reach, axis=1)
+    else:
+        hit = jnp.take_along_axis(
+            reach, jnp.clip(p.data, 0, w)[:, None], axis=1)[:, 0]
+    return _bool8_result(hit, col)

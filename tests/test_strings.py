@@ -277,3 +277,90 @@ class TestStringMinMax:
         res = groupby_aggregate(tbl, [0], [(1, "min")])
         out = res.compact()
         assert out.column(1).to_pylist() == [None, "z"]
+
+
+# ---- search predicates -----------------------------------------------------
+
+
+def _rand_strings(rng, n, alphabet="abc%_x", maxlen=12):
+    out = []
+    for _ in range(n):
+        ln = int(rng.integers(0, maxlen))
+        out.append("".join(rng.choice(list(alphabet)) for _ in range(ln)))
+    return out
+
+
+def test_contains_starts_ends_vs_python(rng):
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    vals = _rand_strings(rng, 300) + [None, "", "abc"]
+    col = Column.from_pylist(vals, t.STRING)
+    for needle in ["a", "ab", "abc", "", "bca", "xxxxxxxxxxxxxxxxx"]:
+        got_c = s.contains(col, needle).to_pylist()
+        got_s = s.starts_with(col, needle).to_pylist()
+        got_e = s.ends_with(col, needle).to_pylist()
+        for i, v in enumerate(vals):
+            if v is None:
+                assert got_c[i] is None and got_s[i] is None
+                continue
+            assert got_c[i] == (needle in v), (v, needle)
+            assert got_s[i] == v.startswith(needle), (v, needle)
+            assert got_e[i] == v.endswith(needle), (v, needle)
+
+
+def test_like_vs_regex_oracle(rng):
+    import re
+
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    def like_re(pat):
+        out = []
+        i = 0
+        while i < len(pat):
+            c = pat[i]
+            if c == "\\" and i + 1 < len(pat):
+                out.append(re.escape(pat[i + 1]))
+                i += 2
+                continue
+            if c == "%":
+                out.append(".*")
+            elif c == "_":
+                out.append(".")
+            else:
+                out.append(re.escape(c))
+            i += 1
+        return re.compile("".join(out), re.DOTALL)
+
+    vals = _rand_strings(rng, 250) + ["", "abc", "a%b", "axxb", None]
+    col = Column.from_pylist(vals, t.STRING)
+    patterns = ["%", "", "a%", "%a", "%ab%", "a_c", "_", "__", "a%b%c",
+                "abc", "%abc", "abc%", "a\\%b", "%a_c%", "a%%b", "_%_"]
+    for pat in patterns:
+        rx = like_re(pat)
+        got = s.like(col, pat).to_pylist()
+        for i, v in enumerate(vals):
+            if v is None:
+                assert got[i] is None
+                continue
+            want = rx.fullmatch(v) is not None
+            assert got[i] == want, (v, pat, got[i], want)
+
+
+def test_like_underscore_rejects_multibyte_utf8():
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    col = Column.from_pylist(["aéc", "abc"], t.STRING)
+    with pytest.raises(NotImplementedError, match="multi-byte"):
+        s.like(col, "a_c")
+    # '%' and literal patterns stay byte-exact on the same data
+    assert s.like(col, "a%c").to_pylist() == [True, True]
+    assert s.contains(col, "é").to_pylist() == [True, False]
+
+
+def test_predicates_keep_validity_none_fast_path():
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    col = Column.from_pylist(["ab", "cd"], t.STRING)
+    assert col.validity is None
+    assert s.contains(col, "a").validity is None
+    assert s.like(col, "%a%").validity is None
