@@ -1,0 +1,17 @@
+#!/bin/bash
+# Dependency-advance canary — role parity with the reference's
+# ci/submodule-sync.sh (bot advances the cuDF pin and runs mvn verify,
+# merging only if green). This framework's "vendored dependency" is the
+# JAX/XLA stack: the canary records the stack's versions, runs the full
+# suite against whatever is installed, and exits nonzero on breakage so an
+# upgrade bot (or a human bumping the image) gets the same green/red gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python - <<'PY'
+import jax, jaxlib, numpy
+print(f"jax={jax.__version__} jaxlib={jaxlib.__version__} "
+      f"numpy={numpy.__version__}")
+PY
+python -m pytest tests/ -x -q
+echo "dependency canary green"

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Artifact packaging/publishing stage — role parity with the reference's
+# ci/deploy.sh (multi-classifier artifact publishing). Produces a versioned
+# tarball bundling the Python package, the native libraries (libtpudf,
+# libcudf/libcudfjni drop-in shims, libtpudf_rt when built), and build
+# provenance; DEPLOY_DIR selects the destination ("repository").
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DEPLOY_DIR="${DEPLOY_DIR:-dist}"
+cmake -S src/native -B build/native -G Ninja >/dev/null
+ninja -C build/native >/dev/null
+./build/native/tpudf_selftest >/dev/null
+
+# build-info.py emits python assignments (VERSION = '0.1.0'); generate the
+# provenance FIRST so the staged package ships it, then parse the version
+info=$(python build_scripts/build-info.py)
+ver=$(printf '%s\n' "$info" | sed -n "s/^VERSION = '\(.*\)'/\1/p")
+rev=$(git rev-parse --short HEAD)
+name="spark_rapids_jni_tpu-${ver:-0.0}-${rev}"
+stage=$(mktemp -d)
+trap 'rm -rf "$stage"' EXIT
+mkdir -p "$stage/$name/native"
+cp -r spark_rapids_jni_tpu "$stage/$name/"
+find "$stage/$name" -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+cp build/native/*.so "$stage/$name/native/"
+# key=value properties (the reference's build-info.properties shape)
+printf '%s\n' "$info" | sed -n "s/^\([A-Z_]*\) = '\(.*\)'/\L\1\E=\2/p" \
+  > "$stage/$name/build-info.properties"
+mkdir -p "$DEPLOY_DIR"
+tar -C "$stage" -czf "$DEPLOY_DIR/$name.tar.gz" "$name"
+echo "deployed $DEPLOY_DIR/$name.tar.gz"

@@ -433,3 +433,68 @@ def like(col: Column, pattern: str, escape: str = "\\") -> Column:
         hit = jnp.take_along_axis(
             reach, jnp.clip(p.data, 0, w)[:, None], axis=1)[:, 0]
     return _bool8_result(hit, col)
+
+
+# ---- transforms ------------------------------------------------------------
+
+
+@func_range("substring")
+def substring(col: Column, start: int, length: int | None = None) -> Column:
+    """Byte-range substring (cuDF strings::slice_strings with fixed
+    bounds): 0-based ``start``, optional ``length`` (None = to end).
+    Negative ``start`` counts from the row end, Spark substr semantics.
+    Byte-based: callers ensure boundaries are character-aligned for
+    multi-byte UTF-8 (the cuDF kernel's posture)."""
+    p = pad_strings(col)
+    mat, lengths = p.chars, p.data
+    w = int(mat.shape[1])
+    if start < 0:
+        # Spark substringSQL: the end is computed from the UNCLAMPED
+        # position, so substr('abc', -5, 2) is '' (end = -2+2 = 0), not 'ab'
+        raw = lengths + start
+        begin = jnp.clip(raw, 0, lengths)
+        if length is None:
+            out_len = lengths - begin
+        else:
+            end = jnp.clip(raw + length, 0, lengths)
+            out_len = jnp.maximum(end - begin, 0)
+    else:
+        begin = jnp.minimum(jnp.full_like(lengths, start), lengths)
+        if length is None:
+            out_len = lengths - begin
+        else:
+            out_len = jnp.clip(jnp.full_like(lengths, length), 0,
+                               lengths - begin)
+    src = begin[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    keep = jnp.arange(w, dtype=jnp.int32)[None, :] < out_len[:, None]
+    out = jnp.where(keep, jnp.take_along_axis(
+        mat, jnp.clip(src, 0, w - 1), axis=1), jnp.uint8(0))
+    return Column(STRING, out_len.astype(jnp.int32), col.validity, chars=out)
+
+
+def _ascii_case(col: Column, to_upper: bool) -> Column:
+    p = pad_strings(col)
+    mat = p.chars
+    if bool(jnp.any(mat >= 0x80)):
+        raise NotImplementedError(
+            "upper/lower are ASCII-vectorized; this column holds multi-byte "
+            "UTF-8, where Java's full Unicode case mapping would diverge — "
+            "failing loudly instead of corrupting non-ASCII text"
+        )
+    if to_upper:
+        out = jnp.where((mat >= ord("a")) & (mat <= ord("z")), mat - 32, mat)
+    else:
+        out = jnp.where((mat >= ord("A")) & (mat <= ord("Z")), mat + 32, mat)
+    return Column(STRING, p.data, col.validity, chars=out)
+
+
+@func_range("string_upper")
+def upper(col: Column) -> Column:
+    """ASCII uppercase (Spark upper; non-ASCII input fails loudly)."""
+    return _ascii_case(col, True)
+
+
+@func_range("string_lower")
+def lower(col: Column) -> Column:
+    """ASCII lowercase (Spark lower; non-ASCII input fails loudly)."""
+    return _ascii_case(col, False)

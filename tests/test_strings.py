@@ -364,3 +364,42 @@ def test_predicates_keep_validity_none_fast_path():
     assert col.validity is None
     assert s.contains(col, "a").validity is None
     assert s.like(col, "%a%").validity is None
+
+
+def test_substring_vs_python(rng):
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    vals = _rand_strings(rng, 200, alphabet="abcdef", maxlen=10) + ["", None]
+    col = Column.from_pylist(vals, t.STRING)
+    for start, ln in [(0, 3), (2, None), (5, 2), (-3, 2), (-1, None),
+                      (0, 0), (9, 5), (-20, 3), (-20, None)]:
+        got = unpad(s.substring(col, start, ln))
+        for i, v in enumerate(vals):
+            if v is None:
+                assert got[i] is None
+                continue
+            if start < 0:
+                # Spark substringSQL: end from the UNCLAMPED position
+                raw = len(v) + start
+                b = max(raw, 0)
+                e = len(v) if ln is None else min(max(raw + ln, 0), len(v))
+                want = v[b:e] if e > b else ""
+            else:
+                want = v[start:] if ln is None else v[start:start + ln]
+            assert got[i] == want, (v, start, ln, got[i], want)
+
+
+def unpad(col):
+    from spark_rapids_jni_tpu.ops.strings import unpad_strings
+
+    return unpad_strings(col).to_pylist()
+
+
+def test_upper_lower_ascii_and_guard():
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    col = Column.from_pylist(["aBc9!", "", None, "XYZ"], t.STRING)
+    assert unpad(s.upper(col)) == ["ABC9!", "", None, "XYZ"]
+    assert unpad(s.lower(col)) == ["abc9!", "", None, "xyz"]
+    with pytest.raises(NotImplementedError, match="ASCII"):
+        s.upper(Column.from_pylist(["é"], t.STRING))
