@@ -28,7 +28,8 @@ from spark_rapids_jni_tpu.ops.sort import gather, sort_order
 from spark_rapids_jni_tpu.types import DType, TypeId
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
-SUPPORTED_AGGS = ("sum", "count", "min", "max", "mean")
+SUPPORTED_AGGS = ("sum", "count", "min", "max", "mean", "var", "std",
+                  "nunique")
 
 
 class GroupByResult(NamedTuple):
@@ -51,6 +52,21 @@ class GroupByResult(NamedTuple):
         return trim_table(self.table, int(self.num_groups))
 
 
+def _col_values_equal_prev(c: Column) -> jnp.ndarray:
+    """bool[n-1]: row i+1's VALUE equals row i's (validity ignored here;
+    NaNs compare equal — the grouping convention)."""
+    if c.dtype.is_string:
+        from spark_rapids_jni_tpu.ops import strings as s
+
+        return s.strings_equal_prev(c)
+    if c.dtype.is_decimal128:
+        return jnp.all(c.data[1:] == c.data[:-1], axis=-1)
+    eq_val = c.data[1:] == c.data[:-1]
+    if c.dtype.storage_dtype.kind == "f":
+        eq_val = eq_val | (jnp.isnan(c.data[1:]) & jnp.isnan(c.data[:-1]))
+    return eq_val
+
+
 def _rows_equal_prev(table: Table, keys: Sequence[int]) -> jnp.ndarray:
     """bool[n]: row i has the same key tuple (incl. null-ness) as row i-1."""
     n = table.num_rows
@@ -60,18 +76,7 @@ def _rows_equal_prev(table: Table, keys: Sequence[int]) -> jnp.ndarray:
     for k in keys:
         c = table.column(k)
         valid = c.valid_mask()
-        if c.dtype.is_string:
-            from spark_rapids_jni_tpu.ops import strings as s
-
-            eq_val = s.strings_equal_prev(c)
-        elif c.dtype.is_decimal128:
-            v = c.data
-            eq_val = jnp.all(v[1:] == v[:-1], axis=-1)
-        else:
-            v = c.data
-            eq_val = v[1:] == v[:-1]
-            if c.dtype.storage_dtype.kind == "f":
-                eq_val = eq_val | (jnp.isnan(v[1:]) & jnp.isnan(v[:-1]))
+        eq_val = _col_values_equal_prev(c)
         eq_valid = valid[1:] == valid[:-1]
         both_null = ~valid[1:] & ~valid[:-1]
         eq = (eq_val & valid[1:] & eq_valid) | both_null
@@ -294,6 +299,20 @@ def groupby_aggregate(
             )
             plan.append(("sum128", c, c.dtype, lanes128, count_lane))
             continue
+        if op in ("var", "std"):
+            if c.dtype.is_decimal128:
+                raise NotImplementedError(
+                    "DECIMAL128 variance needs exact wide arithmetic"
+                )
+            if c.dtype.is_string or                     c.dtype.storage_dtype.kind not in ("i", "u", "f"):
+                raise TypeError(
+                    f"var/std need a numeric column, got {c.dtype}"
+                )
+            plan.append((op, c, None, None, count_lane))
+            continue
+        if op == "nunique":
+            plan.append((op, c, DType(TypeId.INT64), col_idx, count_lane))
+            continue
         if op in ("sum", "mean"):
             acc_dt = _sum_dtype(c.dtype)
             vv = jnp.where(valid, c.data, jnp.zeros_like(c.data))
@@ -306,6 +325,7 @@ def groupby_aggregate(
 
     _rank_order_cache: dict = {}  # value-sort order per column, shared
                                   # between a column's min and max aggs
+    _var_cache: dict = {}         # per-column variance, shared var<->std
 
     def _rank_minmax(c: Column, op: str, vcount: jnp.ndarray) -> Column:
         """MIN/MAX of a column with no elementwise-reducible storage
@@ -402,6 +422,58 @@ def groupby_aggregate(
                     # and the float dtype has no scale field to recover it.
                     mean = mean * (10.0 ** c.dtype.scale)
                 out_cols.append(Column(DType(TypeId.FLOAT64), mean, has_any))
+            continue
+        if op in ("var", "std"):
+            # sample variance (Spark var_samp/stddev_samp): two-pass
+            # centered form in float64 for numerical robustness, computed
+            # once per column and shared between sibling var/std aggs
+            # (the _rank_order_cache pattern). NB: TPU f64 is f32-pair
+            # emulated (~49-bit mantissa) — documented precision posture,
+            # matching the mean contract.
+            cache_key = id(c)
+            if cache_key not in _var_cache:
+                scale_f = (10.0 ** c.dtype.scale) if c.dtype.is_decimal                     else 1.0
+                x = jnp.where(valid, c.data, jnp.zeros_like(c.data)).astype(
+                    jnp.float64) * scale_f
+                s1 = jax.ops.segment_sum(x, _gid(), num_segments=m)
+                denom = jnp.maximum(vcount, 1).astype(jnp.float64)
+                mean_g = s1 / denom
+                centered = jnp.where(valid, x - mean_g[_gid()], 0.0)
+                m2 = jax.ops.segment_sum(centered * centered, _gid(),
+                                         num_segments=m)
+                _var_cache[cache_key] = m2 / jnp.maximum(
+                    vcount - 1, 1).astype(jnp.float64)
+            var = _var_cache[cache_key]
+            out_val = jnp.sqrt(var) if op == "std" else var
+            out_cols.append(
+                Column(DType(TypeId.FLOAT64), out_val, vcount > 1)
+            )
+            continue
+        if op == "nunique":
+            # distinct non-null values per group: secondary sort by
+            # (keys, value) with value nulls last; count positions that
+            # start a new valid value run within their group
+            col_idx2 = val_lane  # original column index stashed in plan
+            nf = [True] * len(keys) + [False]
+            order2 = sort_order(table, list(keys) + [col_idx2],
+                                nulls_first=nf)
+            sub = gather(
+                Table([table.column(k) for k in keys]
+                      + [table.column(col_idx2)]), order2)
+            kix = list(range(len(keys)))
+            same_k = _rows_equal_prev(sub, kix)
+            vcol = sub.column(len(keys))
+            vvalid2 = vcol.valid_mask()
+            eqv = _col_values_equal_prev(vcol)
+            prev_same_valid = jnp.concatenate(
+                [jnp.zeros((1,), jnp.bool_), eqv & vvalid2[:-1]])
+            flag = vvalid2 & (~same_k | ~prev_same_valid)
+            gid2 = (jnp.cumsum(~same_k) - 1).astype(jnp.int32)
+            cnt = jax.ops.segment_sum(
+                flag.astype(jnp.int64), gid2, num_segments=m)
+            out_cols.append(
+                Column(acc_dt, cnt, garange < num_groups)
+            )
             continue
         # min / max with null-neutral sentinels
         if c.dtype.is_string or c.dtype.is_decimal128:

@@ -550,3 +550,70 @@ def test_groupby_null_keys_with_garbage_storage_form_one_group(rng):
     c0, c1, c2 = (out.column(i).to_pylist() for i in range(3))
     got = {(c0[i], c1[i]): c2[i] for i in range(out.num_rows)}
     assert got == want
+
+
+def test_groupby_var_std_vs_numpy(rng):
+    keys = rng.integers(0, 9, 1500).astype(np.int32)
+    vals = rng.normal(scale=50, size=1500)
+    vvalid = rng.random(1500) > 0.2
+    tbl = Table([Column.from_numpy(keys),
+                 Column.from_numpy(vals, validity=vvalid)])
+    out = groupby_aggregate(
+        tbl, [0], [(1, "var"), (1, "std"), (1, "count")]).compact()
+    got_k = np.asarray(out.column(0).data)
+    for i, k in enumerate(got_k):
+        sel = vals[(keys == k) & vvalid]
+        if len(sel) >= 2:
+            assert np.isclose(np.asarray(out.column(1).data)[i],
+                              sel.var(ddof=1), rtol=1e-5)
+            assert np.isclose(np.asarray(out.column(2).data)[i],
+                              sel.std(ddof=1), rtol=1e-5)
+        else:
+            assert not np.asarray(out.column(1).valid_mask())[i]
+
+
+def test_groupby_var_decimal_rescales():
+    keys = np.zeros(4, np.int32)
+    vals = np.array([100, 200, 300, 400], np.int64)  # 1.00..4.00 @ scale -2
+    tbl = Table([Column.from_numpy(keys),
+                 Column.from_numpy(vals, t.decimal64(-2))])
+    out = groupby_aggregate(tbl, [0], [(1, "var")]).compact()
+    want = np.array([1.0, 2.0, 3.0, 4.0]).var(ddof=1)
+    assert np.isclose(np.asarray(out.column(1).data)[0], want, rtol=1e-6)
+
+
+def test_groupby_nunique_vs_python(rng):
+    n = 1200
+    keys = rng.integers(0, 7, n).astype(np.int64)
+    vals = rng.integers(0, 15, n).astype(np.int32)
+    vvalid = rng.random(n) > 0.25
+    tbl = Table([Column.from_numpy(keys),
+                 Column.from_numpy(vals, validity=vvalid)])
+    out = groupby_aggregate(tbl, [0], [(1, "nunique")]).compact()
+    got = dict(zip(out.column(0).to_pylist(), out.column(1).to_pylist()))
+    want = {}
+    for k, v, ok in zip(keys.tolist(), vals.tolist(), vvalid):
+        want.setdefault(k, set())
+        if ok:
+            want[k].add(v)
+    assert got == {k: len(s) for k, s in want.items()}
+
+
+def test_groupby_nunique_strings(rng):
+    keys = np.array([1, 1, 1, 2, 2, 2, 2], np.int32)
+    svals = ["a", "bb", "a", None, "x", "x", "y"]
+    tbl = Table([Column.from_numpy(keys),
+                 Column.from_pylist(svals, t.STRING)])
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    cols = list(tbl.columns)
+    cols[1] = pad_strings(cols[1])
+    out = groupby_aggregate(Table(cols), [0], [(1, "nunique")]).compact()
+    assert out.column(1).to_pylist() == [2, 2]
+
+
+def test_groupby_var_rejects_strings():
+    tbl = Table([Column.from_numpy(np.zeros(3, np.int32)),
+                 Column.from_pylist(["a", "b", "c"], t.STRING)])
+    with pytest.raises(TypeError, match="numeric"):
+        groupby_aggregate(tbl, [0], [(1, "var")])
