@@ -1,0 +1,125 @@
+"""Arrow interop — the cuDF ``to_arrow``/``from_arrow`` surface (vendored
+capability, SURVEY.md section 2.2: cuDF builds against Arrow and converts
+both ways). Host-boundary API: pyarrow tables are host data, so these run
+outside jit; device columns round-trip through numpy views.
+
+Type mapping is the Spark/cuDF one: Arrow decimal128(p<=18) lands in
+DECIMAL64 storage, wider in limb-pair DECIMAL128; date32 ->
+TIMESTAMP_DAYS; timestamp(us) -> TIMESTAMP_MICROSECONDS; strings/binary
+keep their bytes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.columnar.table import Table
+
+def from_arrow(table) -> Table:
+    """pyarrow.Table -> device Table (one host->device copy per buffer)."""
+    import pyarrow as pa
+
+    cols = []
+    for name in table.column_names:
+        arr = table.column(name).combine_chunks()
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.chunk(0) if arr.num_chunks else pa.array(
+                [], type=arr.type)
+        ty = arr.type
+        mask = None if arr.null_count == 0 else np.asarray(
+            arr.is_valid())
+        if pa.types.is_string(ty) or pa.types.is_large_string(ty) or \
+                pa.types.is_binary(ty):
+            cols.append(Column.from_pylist(
+                [None if v is None else (
+                    v.decode("utf-8", "surrogateescape")
+                    if isinstance(v, bytes) else v)
+                 for v in arr.to_pylist()],
+                t.STRING))
+            continue
+        if pa.types.is_decimal(ty):
+            import decimal as _d
+
+            with _d.localcontext(_d.Context(prec=60)):
+                vals = [None if v is None else int(v.scaleb(ty.scale))
+                        for v in arr.to_pylist()]
+            dt = (t.decimal128(-ty.scale) if ty.precision > 18
+                  else t.decimal64(-ty.scale))
+            cols.append(Column.from_pylist(vals, dt))
+            continue
+        # Nulls must be filled IN ARROW before the numpy conversion:
+        # np.asarray of a null-bearing integer array goes through float64
+        # (NaN for nulls), silently corrupting values beyond 2^53. The
+        # validity mask was captured above; filled cells are don't-care.
+        def _np_exact(a, pa_type):
+            import pyarrow.compute as pc
+
+            if a.null_count:
+                a = pc.fill_null(a, 0)
+            return np.ascontiguousarray(np.asarray(a.cast(pa_type)))
+
+        if pa.types.is_date32(ty):
+            cols.append(Column.from_numpy(
+                _np_exact(arr, pa.int32()), t.TIMESTAMP_DAYS,
+                validity=mask))
+            continue
+        if pa.types.is_timestamp(ty):
+            if ty.unit != "us":
+                arr = arr.cast(pa.timestamp("us"))
+            cols.append(Column.from_numpy(
+                _np_exact(arr, pa.int64()), t.TIMESTAMP_MICROSECONDS,
+                validity=mask))
+            continue
+        cols.append(Column.from_numpy(_np_exact(arr, ty), validity=mask))
+    return Table(cols)
+
+
+def to_arrow(table: Table, names: list[str] | None = None):
+    """device Table -> pyarrow.Table (one device->host copy per buffer)."""
+    import pyarrow as pa
+
+    arrays, out_names = [], []
+    for i, c in enumerate(table.columns):
+        name = names[i] if names else f"c{i}"
+        out_names.append(name)
+        valid = np.asarray(c.valid_mask())
+        mask = None if valid.all() else ~valid
+        if c.dtype.is_string:
+            vals = c.to_pylist()
+            arrays.append(pa.array(vals, type=pa.string()))
+            continue
+        if c.dtype.is_decimal128:
+            import decimal as _d
+
+            vals = c.to_pylist()
+            with _d.localcontext(_d.Context(prec=60)):
+                arrays.append(pa.array(
+                    [None if v is None
+                     else _d.Decimal(v).scaleb(c.dtype.scale)
+                     for v in vals],
+                    type=pa.decimal128(38, -c.dtype.scale)))
+            continue
+        if c.dtype.is_decimal:
+            import decimal as _d
+
+            vals = c.to_pylist()
+            arrays.append(pa.array(
+                [None if v is None else _d.Decimal(v).scaleb(c.dtype.scale)
+                 for v in vals],
+                type=pa.decimal128(18, -c.dtype.scale)))
+            continue
+        data = np.asarray(c.data)
+        if c.dtype.type_id == t.TypeId.TIMESTAMP_DAYS:
+            arrays.append(pa.array(data, type=pa.date32(), from_pandas=False)
+                          if mask is None else
+                          pa.array(data.astype("datetime64[D]"),
+                                   mask=mask))
+            continue
+        if c.dtype.type_id == t.TypeId.TIMESTAMP_MICROSECONDS:
+            arrays.append(pa.array(data.view("datetime64[us]"), mask=mask))
+            continue
+        arrays.append(pa.array(data, mask=mask))
+    # positional form: duplicate caller-supplied names must not drop columns
+    return pa.table(arrays, names=out_names)

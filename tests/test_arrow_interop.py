@@ -1,0 +1,96 @@
+"""to_arrow/from_arrow round trips vs pyarrow as the oracle."""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar.arrow import from_arrow, to_arrow
+
+
+def test_arrow_roundtrip_primitives(rng):
+    n = 200
+    pt = pa.table({
+        "i64": pa.array([None if i % 7 == 0 else int(v) for i, v in
+                         enumerate(rng.integers(-(10**12), 10**12, n))]),
+        "i32": pa.array(rng.integers(-100, 100, n).astype(np.int32)),
+        "f64": pa.array(rng.normal(size=n)),
+        "b": pa.array([bool(v) for v in rng.integers(0, 2, n)]),
+        "s": pa.array([None, "", "héllo"] + [f"s{i}" for i in range(n - 3)]),
+    })
+    tbl = from_arrow(pt)
+    back = to_arrow(tbl, names=pt.column_names)
+    assert back.column("i64").to_pylist() == pt.column("i64").to_pylist()
+    assert back.column("i32").to_pylist() == pt.column("i32").to_pylist()
+    assert back.column("b").to_pylist() == pt.column("b").to_pylist()
+    assert back.column("s").to_pylist() == pt.column("s").to_pylist()
+    got_f = back.column("f64").to_pylist()
+    want_f = pt.column("f64").to_pylist()
+    assert np.allclose(got_f, want_f)
+
+
+def test_arrow_roundtrip_decimals_dates_timestamps():
+    pt = pa.table({
+        "d64": pa.array([decimal.Decimal("12.34"), None,
+                         decimal.Decimal("-0.01")],
+                        type=pa.decimal128(10, 2)),
+        "d128": pa.array([decimal.Decimal("123456789012345678901.55"),
+                          None, decimal.Decimal("-7.00")],
+                         type=pa.decimal128(30, 2)),
+        "dt": pa.array([0, None, 19000], type=pa.date32()),
+        "ts": pa.array([0, 1_234_567, None], type=pa.timestamp("us")),
+    })
+    tbl = from_arrow(pt)
+    assert tbl.column(0).dtype.is_decimal and not tbl.column(0).dtype.is_decimal128
+    assert tbl.column(1).dtype.is_decimal128
+    assert tbl.column(2).dtype == t.TIMESTAMP_DAYS
+    assert tbl.column(3).dtype == t.TIMESTAMP_MICROSECONDS
+    back = to_arrow(tbl, names=pt.column_names)
+    for name in pt.column_names:
+        assert back.column(name).to_pylist() == pt.column(name).to_pylist(), name
+
+
+def test_from_arrow_feeds_relational_ops(rng):
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+
+    pt = pa.table({
+        "k": pa.array((rng.integers(0, 5, 100)).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 50, 100).astype(np.int64)),
+    })
+    tbl = from_arrow(pt)
+    out = groupby_aggregate(tbl, [0], [(1, "sum")]).compact()
+    import collections
+
+    want = collections.defaultdict(int)
+    for k, v in zip(pt.column("k").to_pylist(), pt.column("v").to_pylist()):
+        want[k] += v
+    got = dict(zip(out.column(0).to_pylist(), out.column(1).to_pylist()))
+    assert got == dict(want)
+
+
+def test_from_arrow_nullable_bigints_exact():
+    big = 2**60 + 12345
+    pt = pa.table({
+        "x": pa.array([big, None, -(2**59) - 7]),
+        "ts": pa.array([big, None, 17], type=pa.timestamp("us")),
+    })
+    tbl = from_arrow(pt)
+    assert tbl.column(0).to_pylist() == [big, None, -(2**59) - 7]
+    assert tbl.column(1).to_pylist() == [big, None, 17]
+
+
+def test_from_arrow_wide_decimal_exact():
+    v = decimal.Decimal("12345678901234567890123456789012345.67")
+    pt = pa.table({"d": pa.array([v], type=pa.decimal128(38, 2))})
+    tbl = from_arrow(pt)
+    assert tbl.column(0).to_pylist() == [int(v.scaleb(2, decimal.Context(prec=60)))]
+
+
+def test_to_arrow_duplicate_names_kept():
+    tbl = Table([Column.from_numpy(np.arange(3, dtype=np.int64)),
+                 Column.from_numpy(np.arange(3, dtype=np.int32))])
+    out = to_arrow(tbl, names=["x", "x"])
+    assert out.num_columns == 2
