@@ -4,9 +4,11 @@ A from-scratch, TPU-first rebuild of the capability surface of
 ``com.nvidia:spark-rapids-jni`` (the native layer of the RAPIDS Accelerator
 for Apache Spark): HBM-resident columnar tables, fully vectorized XLA
 programs for the JNI-exposed operators (row<->column transpose, casts,
-hashing, bloom filters) and the cuDF operator substrate (sort,
-groupby-aggregate, hash-join), a pure C++ Parquet footer prune/filter
-engine, and an ICI all-to-all shuffle transport for multi-chip slices.
+hashing, bloom filters, a vectorized device JSONPath engine) and the cuDF
+operator substrate (sort, groupby-aggregate, exact multi-key join,
+concatenate/distinct/compaction, reductions, string predicates — all
+incl. STRING and DECIMAL128 columns), pure C++ Parquet/ORC read engines,
+and an ICI all-to-all shuffle transport for multi-chip slices.
 No hand-written Pallas kernels ship today: every measured hot spot is a
 layout transform, scan, sort, or gather that XLA already emits well, and
 the two ops where XLA underperformed (scatter-heavy groupby reductions and
