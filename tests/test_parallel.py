@@ -357,3 +357,31 @@ def test_distributed_groupby_high_cardinality(rng, mesh):
     sums_by_key = dict(zip(got_keys.tolist(), got_sums.tolist()))
     assert sums_by_key == want_sums
     assert dict(zip(got_keys.tolist(), got_counts.tolist())) == dict(want)
+
+
+def test_distributed_groupby_var_and_nunique(rng, mesh):
+    """var/std/nunique are not merge-decomposable, but the repartitioned
+    plan shuffles WHOLE key groups onto one device before the local
+    groupby — so they are exact in the distributed path too."""
+    n = 1024
+    keys = rng.integers(0, 11, n).astype(np.int64)
+    vals = rng.integers(0, 9, n).astype(np.int64)
+    tbl = Table([Column.from_numpy(keys), Column.from_numpy(vals)])
+    sharded = shard_table(tbl, mesh)
+    res = distributed_groupby_aggregate(
+        sharded, [0], [(1, "var"), (1, "nunique"), (1, "count")],
+        mesh, capacity=n,
+    )
+    assert not np.asarray(res.overflowed).any()
+    out = collect(res.table, res.num_groups, mesh)
+    kv = out.column(0).to_pylist()
+    col_var = out.column(1).to_pylist()
+    col_nu = out.column(2).to_pylist()
+    got_var = {kv[i]: col_var[i] for i in range(out.num_rows)
+               if kv[i] is not None}
+    got_nu = {kv[i]: col_nu[i] for i in range(out.num_rows)
+              if kv[i] is not None}
+    for k in np.unique(keys):
+        sel = vals[keys == k]
+        assert np.isclose(got_var[int(k)], sel.var(ddof=1), rtol=1e-5)
+        assert got_nu[int(k)] == len(set(sel.tolist()))
