@@ -22,8 +22,11 @@ def from_arrow(table) -> Table:
     import pyarrow as pa
 
     cols = []
-    for name in table.column_names:
-        arr = table.column(name).combine_chunks()
+    # positional iteration: duplicate column names (which to_arrow's
+    # positional pa.table form deliberately supports) must round-trip —
+    # fetching by name would raise or pick the wrong column
+    for col_idx in range(table.num_columns):
+        arr = table.column(col_idx).combine_chunks()
         if isinstance(arr, pa.ChunkedArray):
             arr = arr.chunk(0) if arr.num_chunks else pa.array(
                 [], type=arr.type)

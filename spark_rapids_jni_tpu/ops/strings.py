@@ -368,8 +368,19 @@ def like(col: Column, pattern: str, escape: str = "\\") -> Column:
     pb = pattern.encode("utf-8")
     while i < len(pb):
         c = pb[i:i + 1]
-        if c == esc and i + 1 < len(pb):
-            cur += pb[i + 1:i + 2]
+        if c == esc:
+            # Spark's checkLikePattern posture: the escape char must be
+            # followed by %, _, or the escape char itself; a trailing
+            # escape (or escaping an ordinary char) is an invalid pattern,
+            # not a silent literal.
+            nxt = pb[i + 1:i + 2]
+            if not nxt or nxt not in (b"%", b"_", esc):
+                raise ValueError(
+                    f"invalid LIKE pattern {pattern!r}: the escape "
+                    f"character must be followed by '%', '_', or the "
+                    f"escape character itself"
+                )
+            cur += nxt
             i += 2
             continue
         if c in (b"%", b"_"):

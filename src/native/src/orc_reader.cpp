@@ -696,13 +696,22 @@ void decode_stripe_column(uint8_t const* file, FileMeta const& meta,
     }
     case Kind::TIMESTAMP: {
       // data = signed seconds from 2015-01-01 in the WRITER's timezone
-      // (stripe footer writerTimezone); nanos always non-negative (floor
-      // convention — modern orc-java uses floorDiv too; files from legacy
-      // toward-zero writers would read 1s high on pre-1970 fractional
-      // values). Wall-clock conversion needs a tz database, so non-UTC
-      // writers fail loudly rather than shift silently; secondary = nanos
-      // with the removed-trailing-zero count in the low 3 bits (z > 0
-      // means value * 10^(z+1)). Result: int64 unix-epoch microseconds.
+      // (stripe footer writerTimezone). Two wire conventions exist for
+      // pre-1970 fractional values, both truncating seconds toward zero:
+      //   * ORC C++ / pyarrow emit SIGNED nanos with the same sign as the
+      //     value (observed on the wire: -1.5s -> secs=-1, nanos=-5e8) —
+      //     plain signed addition reconstructs exactly;
+      //   * orc-java's TimestampTreeReader convention keeps nanos
+      //     POSITIVE and the reader subtracts one second when the total is
+      //     negative with nonzero nanos (cuDF's ORC decoder matches).
+      // The two are distinguishable per value: negative total seconds with
+      // POSITIVE nanos can only come from a java-convention writer, so
+      // that exact case gets the -1s adjustment and everything else is
+      // signed addition. Wall-clock conversion needs a tz database, so
+      // non-UTC writers fail loudly rather than shift silently; secondary
+      // = nanos with the removed-trailing-zero count in the low 3 bits
+      // (z > 0 means value * 10^(z+1)). Result: int64 unix-epoch
+      // microseconds.
       auto const& tz = dir.writer_timezone;
       if (!tz.empty() && tz != "UTC" && tz != "GMT" && tz != "Etc/UTC" &&
           tz != "Etc/GMT") {
@@ -722,8 +731,9 @@ void decode_stripe_column(uint8_t const* file, FileMeta const& meta,
         if (z != 0) {
           for (int q = 0; q < z + 1; ++q) nanos *= 10;
         }
-        vals.push_back(
-            (secs[k] + kOrcEpochSeconds) * 1000000 + nanos / 1000);
+        int64_t total_secs = secs[k] + kOrcEpochSeconds;
+        if (total_secs < 0 && nanos > 0) total_secs -= 1;
+        vals.push_back(total_secs * 1000000 + nanos / 1000);
       }
       scatter_i64(vals);
       break;

@@ -19,7 +19,11 @@ from tests.parquet_util import snappy_compress
 
 # orc Kind enum
 BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, STRING = 0, 1, 2, 3, 4, 5, 6, 7
+TIMESTAMP = 9
 DECIMAL, DATE = 14, 15
+
+# ORC timestamp epoch: seconds from unix epoch to 2015-01-01T00:00:00Z
+ORC_EPOCH_SECONDS = 1420070400
 NONE, ZLIB, SNAPPY = 0, 1, 2
 
 
@@ -148,6 +152,36 @@ def _encode_column(spec: ColumnSpec, values: list, codec: int):
                 for v in vals]
         streams.append((1, frame(chars, codec)))
         streams.append((2, frame(rle_v1_literals(lens, signed=False), codec)))
+    elif spec.kind == TIMESTAMP:
+        # orc-java TimestampTreeWriter convention: values are unix-epoch
+        # MICROSECONDS; wire = (seconds truncated toward zero relative to
+        # the 2015 epoch, POSITIVE nanos with the trailing-zero count in
+        # the low 3 bits). The reader must apply the java-side -1s
+        # adjustment for negative totals with nonzero nanos.
+        secs_out, nanos_out = [], []
+        for v in vals:
+            us = int(v)
+            s = us // 1_000_000  # floor
+            frac_us = us - s * 1_000_000  # in [0, 1e6)
+            if us < 0 and frac_us != 0:
+                s += 1  # truncate toward zero (java wire convention)
+            nanos = frac_us * 1000
+            z = 0
+            if nanos != 0:
+                while nanos % 10 == 0 and z < 7:
+                    nanos //= 10
+                    z += 1
+                if z == 1:  # encoding cannot express exactly one zero
+                    nanos *= 10
+                    z = 0
+                else:
+                    z = max(z - 1, 0)
+            secs_out.append(s - ORC_EPOCH_SECONDS)
+            nanos_out.append((nanos << 3) | z)
+        streams.append((1, frame(rle_v1_literals(secs_out), codec)))
+        streams.append(
+            (5, frame(rle_v1_literals(nanos_out, signed=False), codec))
+        )
     elif spec.kind == DECIMAL:
         out = bytearray()
         for v in vals:

@@ -94,3 +94,14 @@ def test_to_arrow_duplicate_names_kept():
                  Column.from_numpy(np.arange(3, dtype=np.int32))])
     out = to_arrow(tbl, names=["x", "x"])
     assert out.num_columns == 2
+
+
+def test_from_arrow_duplicate_names_roundtrip():
+    """from_arrow must iterate positionally so duplicate column names
+    (which to_arrow deliberately supports) round-trip (ADVICE r3)."""
+    tbl = Table([Column.from_numpy(np.arange(3, dtype=np.int64)),
+                 Column.from_numpy(np.arange(10, 13, dtype=np.int64))])
+    back = from_arrow(to_arrow(tbl, names=["x", "x"]))
+    assert back.num_columns == 2
+    assert back.column(0).to_pylist() == [0, 1, 2]
+    assert back.column(1).to_pylist() == [10, 11, 12]

@@ -228,6 +228,39 @@ def test_orc_timestamp_micros_vs_pyarrow():
     assert col.to_pylist() == us
 
 
+def test_orc_timestamp_pre_epoch_java_convention():
+    """orc-java wire convention: seconds truncated toward zero, POSITIVE
+    nanos — the reader must subtract one second on negative totals with
+    nonzero nanos (the orc-java TimestampTreeReader / cuDF adjustment).
+    pyarrow's ORC C++ writer instead emits signed nanos (covered by
+    test_orc_timestamp_micros_vs_pyarrow); values in (-1s, 0) are
+    unrepresentable in the java convention and excluded here."""
+    from spark_rapids_jni_tpu.orc.reader import read_table
+    from spark_rapids_jni_tpu import types as t
+    from tests.orc_util import TIMESTAMP, ColumnSpec, write_orc
+
+    us = [0, 1, -1_500_000, -777_000_001, 1_234_567_890_123_456,
+          -2_000_000, None, 1420070400_000_000]
+    data = write_orc([ColumnSpec("ts", TIMESTAMP, us)])
+    col = read_table(data).column(0)
+    assert col.dtype == t.TIMESTAMP_MICROSECONDS
+    assert col.to_pylist() == us
+
+
+def test_orc_timestamp_pre_epoch_fractional_vs_pyarrow():
+    """Pre-epoch fractional seconds through the pyarrow writer (signed
+    nanos on the wire) — the ADVICE r3 scenario, pinned both ways."""
+    import pyarrow as pa
+
+    from spark_rapids_jni_tpu.orc.reader import read_table
+
+    us = [-1_500_000, -1, -999_999, -2_000_001, -1_000_000]
+    data = _arrow_orc_bytes(pa.table({
+        "ts": pa.array(us, type=pa.timestamp("us")),
+    }))
+    assert read_table(data).column(0).to_pylist() == us
+
+
 def test_orc_binary_vs_pyarrow():
     import pyarrow as pa
 

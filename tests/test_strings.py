@@ -357,6 +357,22 @@ def test_like_underscore_rejects_multibyte_utf8():
     assert s.contains(col, "é").to_pylist() == [True, False]
 
 
+def test_like_invalid_escape_patterns_raise():
+    """Spark's checkLikePattern posture: the escape char must precede
+    '%', '_', or itself; a trailing escape or escape of an ordinary char
+    is an invalid pattern, not a silent literal (ADVICE r3)."""
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    col = Column.from_pylist(["abc\\", "abc"], t.STRING)
+    for bad in ["abc\\", "\\", "a\\bc", "%\\x"]:
+        with pytest.raises(ValueError, match="escape"):
+            s.like(col, bad)
+    # the three legal escape targets still work
+    assert s.like(col, "abc\\\\").to_pylist() == [True, False]
+    assert s.like(col, "ab\\%").to_pylist() == [False, False]
+    assert s.like(col, "ab\\_").to_pylist() == [False, False]
+
+
 def test_predicates_keep_validity_none_fast_path():
     from spark_rapids_jni_tpu.ops import strings as s
 
