@@ -42,6 +42,28 @@ class JoinMaps(NamedTuple):
     right_valid: jnp.ndarray  # bool: False on left-join unmatched rows
     row_valid: jnp.ndarray    # bool: False on padding rows
     total: jnp.ndarray        # scalar int64: true number of output rows
+    # bool: False on right/full-join rows with no left match (null left)
+    left_valid: jnp.ndarray
+
+
+def _sorted_valid_keys(
+    key: jnp.ndarray, valid: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort one side with nulls banished past the valid prefix (null_rank
+    is the primary lexsort key), then overwrite the tail with the dtype's
+    max so a binary search over it stays sound even though null rows carry
+    arbitrary key bytes. Returns (sorted_key, n_valid, perm)."""
+    n = key.shape[0]
+    null_rank = (~valid).astype(jnp.uint8)
+    perm = jnp.lexsort((key, null_rank)).astype(jnp.int32)
+    n_valid = jnp.sum(valid.astype(jnp.int64))
+    info = np.iinfo(np.dtype(key.dtype.name))
+    sorted_key = jnp.where(
+        jnp.arange(n, dtype=jnp.int64) < n_valid,
+        key[perm],
+        jnp.asarray(info.max, dtype=key.dtype),
+    )
+    return sorted_key, n_valid, perm
 
 
 def _join_maps_impl(
@@ -52,21 +74,12 @@ def _join_maps_impl(
     out_size: int,
     how: str,
     left_row_valid: jnp.ndarray | None = None,
+    right_row_valid: jnp.ndarray | None = None,
 ) -> JoinMaps:
+    n_left = left_key.shape[0]
     n_right = right_key.shape[0]
-    # Sort the build side with nulls banished past the valid prefix
-    # (null_rank is the primary lexsort key), then overwrite the tail with
-    # the dtype's max so the array binary-search over it stays sound even
-    # though null rows carry arbitrary key bytes.
-    null_rank = (~right_valid).astype(jnp.uint8)
-    perm = jnp.lexsort((right_key, null_rank)).astype(jnp.int32)
-    n_valid_right = jnp.sum(right_valid.astype(jnp.int64))
-    info = np.iinfo(np.dtype(right_key.dtype.name))
-    sorted_key = jnp.where(
-        jnp.arange(n_right, dtype=jnp.int64) < n_valid_right,
-        right_key[perm],
-        jnp.asarray(info.max, dtype=right_key.dtype),
-    )
+    sorted_key, n_valid_right, perm = _sorted_valid_keys(
+        right_key, right_valid)
 
     # Match runs per probe row (empty when the probe key is null).
     lo = jnp.searchsorted(sorted_key, left_key, side="left")
@@ -74,22 +87,29 @@ def _join_maps_impl(
     hi = jnp.minimum(hi, n_valid_right)  # the sentinel tail never matches
     lo = jnp.minimum(lo, hi)
     counts = jnp.where(left_valid, hi - lo, 0)
-    if how == "left":
+    if how in ("left", "full"):
         out_per_row = jnp.maximum(counts, 1)  # unmatched probe row emits one
-        if left_row_valid is not None:
-            # rows that are not rows at all (padding/phantom shuffle slots)
-            # must emit nothing — only real probe rows get the unmatched-row
-            # treatment (a real row with a NULL key still emits one).
-            out_per_row = jnp.where(left_row_valid, out_per_row, 0)
-    else:
+    elif how == "left_semi":
+        out_per_row = (counts > 0).astype(counts.dtype)
+    elif how == "left_anti":
+        # no match at all — a NULL probe key matches nothing, so it
+        # qualifies (Spark NOT EXISTS / cuDF left_anti semantics)
+        out_per_row = (counts == 0).astype(counts.dtype)
+    else:  # inner, right
         out_per_row = counts
+    if left_row_valid is not None and how != "inner" and how != "right":
+        # rows that are not rows at all (padding/phantom shuffle slots)
+        # must emit nothing — only real probe rows get the unmatched-row /
+        # semi / anti treatment (a real row with a NULL key still counts).
+        # inner/right emission is already 0 for phantom rows: their keys
+        # are null (counts == 0).
+        out_per_row = jnp.where(left_row_valid, out_per_row, 0)
     offsets = jnp.cumsum(out_per_row)
-    total = offsets[-1] if left_key.shape[0] else jnp.int64(0)
+    probe_total = offsets[-1] if n_left else jnp.int64(0)
 
     j = jnp.arange(out_size, dtype=jnp.int64)
-    row_valid = j < total
     left_row = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
-    left_row = jnp.clip(left_row, 0, max(left_key.shape[0] - 1, 0))
+    left_row = jnp.clip(left_row, 0, max(n_left - 1, 0))
     base = jnp.where(left_row > 0, offsets[jnp.maximum(left_row - 1, 0)], 0)
     ordinal = j - base
     matched = counts[left_row] > 0
@@ -97,13 +117,50 @@ def _join_maps_impl(
         lo[left_row] + ordinal, 0, max(n_right - 1, 0)
     ).astype(jnp.int32)
     right_row = perm[right_pos] if n_right else jnp.zeros_like(right_pos)
-    right_ok = matched & row_valid
+
+    if how not in ("right", "full"):
+        row_valid = j < probe_total
+        right_ok = matched & row_valid & (how != "left_anti")
+        return JoinMaps(
+            left_index=left_row,
+            right_index=right_row,
+            right_valid=right_ok,
+            row_valid=row_valid,
+            total=probe_total,
+            left_valid=row_valid,
+        )
+
+    # right/full outer: append build rows no valid probe row matched, with
+    # a null left side. A build row is matched iff its key is valid and
+    # appears among the valid probe keys — one more sort + binary search,
+    # the mirror of the probe phase (scatter-free).
+    lvalid_eff = left_valid
+    if left_row_valid is not None:
+        lvalid_eff = lvalid_eff & left_row_valid
+    sorted_left, n_valid_left, _ = _sorted_valid_keys(left_key, lvalid_eff)
+    l_lo = jnp.searchsorted(sorted_left, right_key, side="left")
+    l_hi = jnp.minimum(
+        jnp.searchsorted(sorted_left, right_key, side="right"), n_valid_left)
+    exists_in_left = jnp.minimum(l_lo, l_hi) < l_hi
+    unmatched = ~(right_valid & exists_in_left)
+    if right_row_valid is not None:
+        unmatched = unmatched & right_row_valid  # phantom slots emit nothing
+    r_off = jnp.cumsum(unmatched.astype(jnp.int64))
+    extra_total = r_off[-1] if n_right else jnp.int64(0)
+    total = probe_total + extra_total
+
+    is_extra = (j >= probe_total) & (j < total)
+    k = jnp.clip(j - probe_total, 0, None)
+    extra_right = jnp.searchsorted(r_off, k, side="right").astype(jnp.int32)
+    extra_right = jnp.clip(extra_right, 0, max(n_right - 1, 0))
+    row_valid = j < total
     return JoinMaps(
         left_index=left_row,
-        right_index=right_row,
-        right_valid=right_ok,
+        right_index=jnp.where(is_extra, extra_right, right_row),
+        right_valid=(matched | is_extra) & row_valid,
         row_valid=row_valid,
         total=total,
+        left_valid=row_valid & ~is_extra,
     )
 
 
@@ -174,6 +231,9 @@ def rank_encode_keys(
     return ranks[:nl], ranks[nl:]
 
 
+_JOIN_TYPES = ("inner", "left", "left_semi", "left_anti", "right", "full")
+
+
 @func_range("join")
 def join(
     left: Table,
@@ -183,16 +243,26 @@ def join(
     out_size: int,
     how: str = "inner",
     left_row_valid: jnp.ndarray | None = None,
+    right_row_valid: jnp.ndarray | None = None,
 ) -> JoinMaps:
     """Equi-join returning gather maps; single- or multi-column keys of any
     supported type (integral, float, decimal, string). ``out_size`` caps the
     output (check ``total`` <= out_size on host if exactness matters, or use
-    ``join_auto``). ``left_row_valid`` marks which probe rows exist at all
-    (False = padding/shuffle phantom, emits nothing even under a left join).
+    ``join_auto``). ``left_row_valid`` / ``right_row_valid`` mark which rows
+    exist at all (False = padding/shuffle phantom, emits nothing even under
+    an outer join).
+
+    Join types (the cuDF surface, reference build-libcudf.xml:34-60
+    capability): ``inner``, ``left``, ``left_semi`` (one row per probe row
+    with >=1 match; right side = first match), ``left_anti`` (one row per
+    probe row with NO match — null keys qualify; right side null),
+    ``right`` (inner + unmatched build rows with null left), ``full``
+    (left + unmatched build rows with null left).
 
     SQL semantics: a NULL in ANY key column makes the row match nothing."""
-    if how not in ("inner", "left"):
-        raise ValueError(f"unsupported join type {how!r}")
+    if how not in _JOIN_TYPES:
+        raise ValueError(
+            f"unsupported join type {how!r}; valid: {_JOIN_TYPES}")
     left_keys = [left_on] if isinstance(left_on, int) else list(left_on)
     right_keys = [right_on] if isinstance(right_on, int) else list(right_on)
     if len(left_keys) != len(right_keys) or not left_keys:
@@ -221,6 +291,7 @@ def join(
         lkey, rkey = rank_encode_keys(left, right, left_keys, right_keys)
     return _join_maps_impl(
         lkey, lvalid, rkey, rvalid, out_size, how, left_row_valid,
+        right_row_valid,
     )
 
 
@@ -238,11 +309,14 @@ def apply_join_maps(
 ) -> Table:
     """Materialize the joined table: left columns then right columns.
     Padding rows carry validity False everywhere; unmatched right sides
-    (left join) are null. String columns come back in the padded device
-    layout (ops.strings.unpad_strings restores Arrow)."""
+    (left/full join) and unmatched left sides (right/full join) are null.
+    String columns come back in the padded device layout
+    (ops.strings.unpad_strings restores Arrow)."""
     cols: list[Column] = []
     for c in left.columns:
-        validity = c.valid_mask()[maps.left_index] & maps.row_valid
+        validity = (
+            c.valid_mask()[maps.left_index] & maps.left_valid & maps.row_valid
+        )
         cols.append(_gather_out(c, maps.left_index, validity))
     for c in right.columns:
         validity = (

@@ -263,10 +263,12 @@ def distributed_join(
                           row_valid=lrv)
         rs = hash_shuffle(r, right_keys, EXEC_AXIS, capacity=right_capacity,
                           row_valid=rrv)
-        # phantom (unoccupied) shuffle slots must not emit left-join rows
+        # phantom (unoccupied) shuffle slots must not emit outer-join rows
+        # on either side
         maps = join(ls.table, rs.table, left_keys, right_keys,
                     out_size_per_device, how=how,
-                    left_row_valid=ls.row_valid)
+                    left_row_valid=ls.row_valid,
+                    right_row_valid=rs.row_valid)
         joined = apply_join_maps(ls.table, rs.table, maps)
         overflow = ls.overflowed | rs.overflowed
         return joined, maps.total.reshape(1), overflow.reshape(1)

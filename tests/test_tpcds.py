@@ -249,3 +249,48 @@ def test_q64_distributed_detects_join_truncation():
     mesh = executor_mesh(8)
     with _pytest.raises(ValueError, match="out_size_per_device"):
         tpcds_q64_distributed(ss, mesh, out_factor=4)
+
+
+@pytest.mark.parametrize("how", ["left_semi", "left_anti", "full"])
+def test_distributed_join_types_match_oracle(rng, mesh, how):
+    """Semi/anti/full compose under hash partitioning (equal keys are
+    co-located after the exchange), including with shard padding and
+    phantom shuffle slots on both sides (VERDICT r3 item 5)."""
+    n_l, n_r = 250, 90  # 250: shard padding on 8 devices
+    lk = rng.integers(0, 32, n_l).astype(np.int64)
+    rk = rng.integers(16, 48, n_r).astype(np.int64)  # partial overlap
+    left = Table([Column.from_numpy(lk)])
+    right = Table([Column.from_numpy(rk)])
+    l_sh, l_rv = shard_table(left, mesh, return_row_valid=True)
+    r_sh, r_rv = shard_table(right, mesh, return_row_valid=True)
+    dj = distributed_join(
+        l_sh, r_sh, 0, 0, mesh,
+        out_size_per_device=n_l * 8, how=how,
+        left_capacity=n_l // 8 + 8, right_capacity=n_r // 8 + 8,
+        left_row_valid=l_rv, right_row_valid=r_rv,
+    )
+    assert not np.asarray(dj.overflowed).any()
+
+    matches = np.array([(rk == k).sum() for k in lk])
+    if how == "left_semi":
+        want = sorted(lk[matches > 0])
+    elif how == "left_anti":
+        want = sorted(lk[matches == 0])
+    else:  # full: every pair + unmatched both sides
+        want_total = int(
+            np.maximum(matches, 1).sum()
+            + (~np.isin(rk, lk)).sum()
+        )
+        assert int(np.asarray(dj.total).sum()) == want_total
+        tbl = dj.table
+        l_ok = np.asarray(tbl.column(0).valid_mask())
+        r_ok = np.asarray(tbl.column(1).valid_mask())
+        rkd = np.asarray(tbl.column(1).data)
+        got_right_only = sorted(rkd[r_ok & ~l_ok])
+        assert got_right_only == sorted(rk[~np.isin(rk, lk)])
+        return
+    tbl = dj.table
+    lkd = np.asarray(tbl.column(0).data)
+    l_ok = np.asarray(tbl.column(0).valid_mask())
+    assert sorted(lkd[l_ok]) == want
+    assert int(np.asarray(dj.total).sum()) == len(want)
