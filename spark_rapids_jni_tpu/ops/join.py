@@ -78,6 +78,13 @@ def _join_maps_impl(
 ) -> JoinMaps:
     n_left = left_key.shape[0]
     n_right = right_key.shape[0]
+    # Rows that are not rows at all (padding/phantom shuffle slots) must
+    # never match, regardless of what their key bytes and key validity
+    # happen to hold — fold row existence into key validity up front.
+    if left_row_valid is not None:
+        left_valid = left_valid & left_row_valid
+    if right_row_valid is not None:
+        right_valid = right_valid & right_row_valid
     sorted_key, n_valid_right, perm = _sorted_valid_keys(
         right_key, right_valid)
 
@@ -98,11 +105,10 @@ def _join_maps_impl(
     else:  # inner, right
         out_per_row = counts
     if left_row_valid is not None and how != "inner" and how != "right":
-        # rows that are not rows at all (padding/phantom shuffle slots)
-        # must emit nothing — only real probe rows get the unmatched-row /
-        # semi / anti treatment (a real row with a NULL key still counts).
-        # inner/right emission is already 0 for phantom rows: their keys
-        # are null (counts == 0).
+        # phantom probe rows must emit nothing — only real probe rows get
+        # the unmatched-row / semi / anti treatment (a real row with a
+        # NULL key still counts). inner/right emission is already 0 for
+        # phantom rows: left_valid was masked above, so counts == 0.
         out_per_row = jnp.where(left_row_valid, out_per_row, 0)
     offsets = jnp.cumsum(out_per_row)
     probe_total = offsets[-1] if n_left else jnp.int64(0)
@@ -134,10 +140,7 @@ def _join_maps_impl(
     # a null left side. A build row is matched iff its key is valid and
     # appears among the valid probe keys — one more sort + binary search,
     # the mirror of the probe phase (scatter-free).
-    lvalid_eff = left_valid
-    if left_row_valid is not None:
-        lvalid_eff = lvalid_eff & left_row_valid
-    sorted_left, n_valid_left, _ = _sorted_valid_keys(left_key, lvalid_eff)
+    sorted_left, n_valid_left, _ = _sorted_valid_keys(left_key, left_valid)
     l_lo = jnp.searchsorted(sorted_left, right_key, side="left")
     l_hi = jnp.minimum(
         jnp.searchsorted(sorted_left, right_key, side="right"), n_valid_left)

@@ -308,13 +308,16 @@ class TestJoinTypes:
         """Build rows marked not-a-row (shuffle phantoms) must not surface
         as unmatched right rows."""
         lk = [1]
-        rk = [1, 5, 6]
+        rk = [1, 5, 1]  # phantom row 2 carries key bytes that WOULD match
         left = Table([Column.from_pylist(lk, t.INT64)])
         right = Table([Column.from_pylist(rk, t.INT64)])
         rrv = jnp.asarray([True, True, False])  # row 2 is a phantom
         maps = join(left, right, 0, 0, 8, how=how,
                     right_row_valid=rrv)
         got = _pairs(maps)
+        # the phantom neither matches (despite matching key bytes) nor
+        # surfaces as an unmatched right row
+        assert (0, 2) not in got
         assert (None, 2) not in got
         assert (None, 1) in got
         assert (0, 0) in got
