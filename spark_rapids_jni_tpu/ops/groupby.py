@@ -138,12 +138,13 @@ def _group_starts(same: jnp.ndarray, q: int,
 
 def _boundary_prefix(stack: jnp.ndarray, idx: jnp.ndarray,
                      block: int) -> jnp.ndarray:
-    """Exact int64 prefix sums of ``stack`` (n, k) evaluated only at the
-    ``idx`` (q,) boundaries: per-block sums (one bandwidth pass) + a tiny
-    block-level cumsum + a (q, BLOCK, k) masked partial for each boundary's
-    own block. Replaces the full-length (n, k) cumsum when boundaries are
-    few; tree reductions of int64 are exact, so this matches the scan path
-    bit-for-bit."""
+    """Prefix sums of ``stack`` (n, k) evaluated only at the ``idx`` (q,)
+    boundaries: per-block sums (one bandwidth pass) + a tiny block-level
+    cumsum + a (q, BLOCK, k) masked partial for each boundary's own block.
+    Replaces the full-length (n, k) cumsum when boundaries are few.
+    int64-only: tree reductions of int64 are exact, so this matches the
+    scan path bit-for-bit (float lanes take _segmented_sum_scan instead —
+    prefix differencing would cancel catastrophically across groups)."""
     n, k = stack.shape
     nb = -(-n // block)
     pad = nb * block - n
@@ -155,6 +156,48 @@ def _boundary_prefix(stack: jnp.ndarray, idx: jnp.ndarray,
     rows = sp[ib]                                # (q, block, k)
     mask = jnp.arange(block, dtype=jnp.int32)[None, :, None] < r[:, None, None]
     return base + jnp.sum(jnp.where(mask, rows, 0), axis=1)
+
+
+def _segmented_sum_scan(stack: jnp.ndarray,
+                        seg_start: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive segmented running sum along sorted rows: the accumulator
+    resets wherever ``seg_start`` is True, so each group's sum only ever
+    adds that group's own values — the error of a group's float sum scales
+    with the group's magnitude, like ``segment_sum``, NOT with the global
+    prefix (prefix differencing cancels the running total and loses small
+    groups that follow large ones entirely). The (sum, flag) combine is
+    the segmented-sum monoid (associative) -> log-depth scan, no scatter.
+    ``stack`` is (n, k); read per-group results at each group's last row."""
+
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av + bv), af | bf
+
+    v, _ = jax.lax.associative_scan(
+        combine, (stack, seg_start[:, None] | jnp.zeros(
+            stack.shape, jnp.bool_)))
+    return v
+
+
+def _segmented_extremum(vv: jnp.ndarray, seg_start: jnp.ndarray,
+                        op: str) -> jnp.ndarray:
+    """Inclusive segmented running min/max along sorted rows: the value
+    resets wherever ``seg_start`` is True. The (value, start-flag) combine
+    is the segmented-reduce monoid (associative), so
+    ``lax.associative_scan`` compiles it to a log-depth scan — replacing
+    ``jax.ops.segment_min/max``, whose scatter formulation serializes on
+    the TPU (BASELINE.md measured 1.6-4x against scan forms). Read the
+    per-group result at each group's last row."""
+    pick = jnp.minimum if op == "min" else jnp.maximum
+
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, pick(av, bv)), af | bf
+
+    v, _ = jax.lax.associative_scan(combine, (vv, seg_start))
+    return v
 
 
 def _sum_dtype(dt: DType) -> DType:
@@ -262,16 +305,63 @@ def groupby_aggregate(
         else:
             out_cols.append(Column(c.dtype, c.data[safe_first], valid))
 
-    # Integer-accumulated reductions (sums of ints/decimals, all counts)
-    # batch into ONE (n, k) int64 cumsum + per-group boundary differences:
-    # exact arithmetic, one streaming pass, zero scatters. Float sums and
-    # min/max stay on segment_* (cumsum differencing would change float
-    # rounding; order statistics have no prefix-sum form).
-    int_lanes: list[jnp.ndarray] = []  # (n,) int64 each
+    # Sum-form reductions (sums of ints/decimals/floats, all counts) batch
+    # into ONE (n, k) prefix pass per accumulator dtype + per-group
+    # boundary differences: one streaming pass, zero scatters. int64 lanes
+    # are exact; float lanes carry parallel-reduction rounding (summation
+    # order is unspecified, like any parallel float sum — Spark makes the
+    # same non-guarantee). Min/max ride a segmented log-depth scan
+    # (_segmented_extremum) instead of segment_* scatters.
+    int_lanes: list[jnp.ndarray] = []    # (n,) int64 each
+    float_lanes: list[jnp.ndarray] = []  # (n,) float64 each
+    # sibling aggs on one column (sum+mean+var, every agg's count) must
+    # share lanes, not stack identical copies into the streaming pass
+    _lane_memo: dict = {}
 
-    def lane(arr: jnp.ndarray) -> int:
+    def lane(arr: jnp.ndarray, memo_key=None) -> tuple[str, int]:
+        if memo_key is not None and memo_key in _lane_memo:
+            return _lane_memo[memo_key]
         int_lanes.append(arr.astype(jnp.int64))
-        return len(int_lanes) - 1
+        spec = ("i", len(int_lanes) - 1)
+        if memo_key is not None:
+            _lane_memo[memo_key] = spec
+        return spec
+
+    def flane(arr: jnp.ndarray, memo_key=None) -> tuple[str, int]:
+        if memo_key is not None and memo_key in _lane_memo:
+            return _lane_memo[memo_key]
+        float_lanes.append(arr.astype(jnp.float64))
+        spec = ("f", len(float_lanes) - 1)
+        if memo_key is not None:
+            _lane_memo[memo_key] = spec
+        return spec
+
+    def _seg_sums(stack: jnp.ndarray) -> jnp.ndarray:
+        """(n, k) lane stack -> (m, k) per-group sums. int64 lanes ride
+        prefix differencing (exact, so cancellation is a non-issue): block
+        prefixes when small, full cumsum + searchsorted differences
+        otherwise. Float lanes instead ride a segmented scan that resets
+        at group boundaries — prefix differencing would cancel the global
+        running total and absorb small groups that follow large ones
+        (catastrophic cancellation, worse under TPU's f32-pair f64)."""
+        if n == 0:
+            return jnp.zeros((m, stack.shape[1]), stack.dtype)
+        if stack.dtype.kind == "f":
+            run = _segmented_sum_scan(stack, ~same)
+            out = run[jnp.clip(g_hi - 1, 0, n - 1)]
+            return jnp.where((g_hi > g_lo)[:, None], out, 0)
+        if small:
+            # empty groups have g_lo == g_hi == n so their difference is 0
+            pref = _boundary_prefix(
+                stack, jnp.concatenate([g_hi, g_lo]), block)
+            return pref[:m] - pref[m:]
+        cs = jnp.cumsum(stack, axis=0)
+        lo_c = jnp.clip(g_lo, 0, n - 1)
+        hi_c = jnp.clip(g_hi - 1, 0, n - 1)
+        upper = cs[hi_c]  # (m, k)
+        lower = jnp.where(
+            (g_lo > 0)[:, None], cs[jnp.maximum(lo_c - 1, 0)], 0)
+        return jnp.where((g_hi > g_lo)[:, None], upper - lower, 0)
 
     _M32 = jnp.int64(0xFFFFFFFF)
 
@@ -279,7 +369,7 @@ def groupby_aggregate(
     for col_idx, op in aggs:
         c = sorted_tbl.column(col_idx)
         valid = c.valid_mask()
-        count_lane = lane(valid)
+        count_lane = lane(valid, memo_key=(id(c), "count"))
         if op in ("sum", "mean") and c.dtype.is_decimal128:
             if op == "mean":
                 raise NotImplementedError(
@@ -294,8 +384,10 @@ def groupby_aggregate(
             lo = jnp.where(valid, c.data[:, 0], jnp.int64(0))
             hi = jnp.where(valid, c.data[:, 1], jnp.int64(0))
             lanes128 = (
-                lane(lo & _M32), lane((lo >> 32) & _M32),
-                lane(hi & _M32), lane(hi >> 32),
+                lane(lo & _M32, memo_key=(id(c), "s128", 0)),
+                lane((lo >> 32) & _M32, memo_key=(id(c), "s128", 1)),
+                lane(hi & _M32, memo_key=(id(c), "s128", 2)),
+                lane(hi >> 32, memo_key=(id(c), "s128", 3)),
             )
             plan.append(("sum128", c, c.dtype, lanes128, count_lane))
             continue
@@ -308,7 +400,16 @@ def groupby_aggregate(
                 raise TypeError(
                     f"var/std need a numeric column, got {c.dtype}"
                 )
-            plan.append((op, c, None, None, count_lane))
+            # first pass (the per-group sum for the mean) rides the lane
+            # machinery: exact int64 for integral/decimal storage, a float
+            # lane otherwise; the centered second pass is a _seg_sums call
+            # in the consume loop (no scatter either way)
+            vv = jnp.where(valid, c.data, jnp.zeros_like(c.data))
+            if c.dtype.storage_dtype.kind in ("i", "u"):
+                sum_spec = lane(vv, memo_key=(id(c), "sum_i"))
+            else:
+                sum_spec = flane(vv, memo_key=(id(c), "sum_f"))
+            plan.append((op, c, None, sum_spec, count_lane))
             continue
         if op == "nunique":
             plan.append((op, c, DType(TypeId.INT64), col_idx, count_lane))
@@ -317,9 +418,11 @@ def groupby_aggregate(
             acc_dt = _sum_dtype(c.dtype)
             vv = jnp.where(valid, c.data, jnp.zeros_like(c.data))
             if acc_dt.storage_dtype.kind in ("i", "u"):
-                plan.append((op, c, acc_dt, lane(vv), count_lane))
-            else:
-                plan.append((op, c, acc_dt, None, count_lane))
+                plan.append((op, c, acc_dt,
+                             lane(vv, memo_key=(id(c), "sum_i")), count_lane))
+            else:  # float accumulation rides a float lane — no scatter
+                plan.append((op, c, acc_dt,
+                             flane(vv, memo_key=(id(c), "sum_f")), count_lane))
         else:
             plan.append((op, c, None, None, count_lane))
 
@@ -346,16 +449,16 @@ def groupby_aggregate(
                 Table([c]), [0], nulls_first=[False]  # nulls last
             )
         order_v = _rank_order_cache[cache_key]
-        rank = jnp.zeros((n,), jnp.int32).at[order_v].set(
-            jnp.arange(n, dtype=jnp.int32)
-        )
+        # inverse permutation via argsort (a sort, not a scatter — scatters
+        # serialize on TPU)
+        rank = jnp.argsort(order_v).astype(jnp.int32)
         # null values never win: give them the worst rank for the op
         sentinel = jnp.int32(n if op == "min" else -1)
         rank = jnp.where(c.valid_mask(), rank, sentinel)
-        if op == "min":
-            best = jnp.full((m,), n, jnp.int32).at[_gid()].min(rank)
-        else:
-            best = jnp.full((m,), -1, jnp.int32).at[_gid()].max(rank)
+        # segmented log-depth scan over the key-sorted rows, read at each
+        # group's last row — replaces the .at[gid].min/max scatter
+        run = _segmented_extremum(rank, ~same, op)
+        best = run[jnp.clip(g_hi - 1, 0, n - 1)]
         has_any = vcount > 0
         winner_row = order_v[jnp.clip(best, 0, max(n - 1, 0))]
         if c.dtype.is_string:
@@ -365,28 +468,30 @@ def groupby_aggregate(
             return Column(c.dtype, g.data, has_any, chars=g.chars)
         return Column(c.dtype, c.data[winner_row], has_any)
 
-    if int_lanes and n and small:
-        # one bandwidth pass over the lanes + O(m * block) boundary work;
-        # empty groups have g_lo == g_hi == n so their difference is 0
-        stack = jnp.stack(int_lanes, axis=1)  # (n, k)
-        pref = _boundary_prefix(stack, jnp.concatenate([g_hi, g_lo]), block)
-        seg = pref[:m] - pref[m:]
-    elif int_lanes and n:
-        stack = jnp.stack(int_lanes, axis=1)  # (n, k)
-        cs = jnp.cumsum(stack, axis=0)
-        lo_c = jnp.clip(g_lo, 0, n - 1)
-        hi_c = jnp.clip(g_hi - 1, 0, n - 1)
-        upper = cs[hi_c]  # (m, k)
-        lower = jnp.where((g_lo > 0)[:, None], cs[jnp.maximum(lo_c - 1, 0)], 0)
-        seg = jnp.where((g_hi > g_lo)[:, None], upper - lower, 0)  # (m, k)
-    else:
-        seg = jnp.zeros((m, max(len(int_lanes), 1)), jnp.int64)
+    seg_i = (_seg_sums(jnp.stack(int_lanes, axis=1)) if int_lanes
+             else jnp.zeros((m, 1), jnp.int64))
+    seg_f = (_seg_sums(jnp.stack(float_lanes, axis=1)) if float_lanes
+             else jnp.zeros((m, 1), jnp.float64))
+
+    def seg_col(spec: tuple[str, int]) -> jnp.ndarray:
+        kind, idx = spec
+        return seg_i[:, idx] if kind == "i" else seg_f[:, idx]
+
+    def _row_gid() -> jnp.ndarray:
+        """Per-row dense group id for the centered variance pass. In the
+        small-m path group starts are already known, so a searchsorted
+        replaces the full-length cumsum scan."""
+        if small:
+            return (jnp.searchsorted(
+                g_lo, jnp.arange(n, dtype=jnp.int32), side="right"
+            ) - 1).astype(jnp.int32)
+        return _gid()
 
     for op, c, acc_dt, val_lane, count_lane in plan:
         valid = c.valid_mask()
-        vcount = seg[:, count_lane]
+        vcount = seg_col(count_lane)
         if op == "sum128":
-            s0, s1, s2, s3 = (seg[:, i] for i in val_lane)
+            s0, s1, s2, s3 = (seg_col(i) for i in val_lane)
             c0 = s0 & _M32
             t = s1 + (s0 >> 32)
             lo = c0 | ((t & _M32) << 32)
@@ -404,13 +509,7 @@ def groupby_aggregate(
             continue
         if op in ("sum", "mean"):
             has_any = vcount > 0
-            if val_lane is not None:
-                total = seg[:, val_lane].astype(acc_dt.jnp_dtype)
-            else:  # float accumulation: keep segment_sum rounding behavior
-                vv = jnp.where(valid, c.data, jnp.zeros_like(c.data)).astype(
-                    acc_dt.jnp_dtype
-                )
-                total = jax.ops.segment_sum(vv, _gid(), num_segments=m)
+            total = seg_col(val_lane).astype(acc_dt.jnp_dtype)
             if op == "sum":
                 out_cols.append(Column(acc_dt, total, has_any))
             else:
@@ -427,20 +526,23 @@ def groupby_aggregate(
             # sample variance (Spark var_samp/stddev_samp): two-pass
             # centered form in float64 for numerical robustness, computed
             # once per column and shared between sibling var/std aggs
-            # (the _rank_order_cache pattern). NB: TPU f64 is f32-pair
-            # emulated (~49-bit mantissa) — documented precision posture,
-            # matching the mean contract.
+            # (the _rank_order_cache pattern). The group sum came from the
+            # lane machinery (exact int64 for integral/decimal storage);
+            # the centered second pass is one more _seg_sums lane — zero
+            # scatters end to end. NB: TPU f64 is f32-pair emulated
+            # (~49-bit mantissa) — documented precision posture, matching
+            # the mean contract.
             cache_key = id(c)
             if cache_key not in _var_cache:
                 scale_f = (10.0 ** c.dtype.scale) if c.dtype.is_decimal                     else 1.0
-                x = jnp.where(valid, c.data, jnp.zeros_like(c.data)).astype(
-                    jnp.float64) * scale_f
-                s1 = jax.ops.segment_sum(x, _gid(), num_segments=m)
                 denom = jnp.maximum(vcount, 1).astype(jnp.float64)
-                mean_g = s1 / denom
-                centered = jnp.where(valid, x - mean_g[_gid()], 0.0)
-                m2 = jax.ops.segment_sum(centered * centered, _gid(),
-                                         num_segments=m)
+                mean_g = seg_col(val_lane).astype(jnp.float64) * scale_f                     / denom
+                if n:
+                    x = c.data.astype(jnp.float64) * scale_f
+                    centered = jnp.where(valid, x - mean_g[_row_gid()], 0.0)
+                    m2 = _seg_sums((centered * centered)[:, None])[:, 0]
+                else:
+                    m2 = jnp.zeros((m,), jnp.float64)
                 _var_cache[cache_key] = m2 / jnp.maximum(
                     vcount - 1, 1).astype(jnp.float64)
             var = _var_cache[cache_key]
@@ -468,9 +570,20 @@ def groupby_aggregate(
             prev_same_valid = jnp.concatenate(
                 [jnp.zeros((1,), jnp.bool_), eqv & vvalid2[:-1]])
             flag = vvalid2 & (~same_k | ~prev_same_valid)
-            gid2 = (jnp.cumsum(~same_k) - 1).astype(jnp.int32)
-            cnt = jax.ops.segment_sum(
-                flag.astype(jnp.int64), gid2, num_segments=m)
+            # gid2 is monotone over its own sort, so per-group flag counts
+            # are cumsum boundary differences — same idiom as the lanes,
+            # no scatter
+            if n:
+                gid2 = (jnp.cumsum(~same_k) - 1).astype(jnp.int32)
+                cs2 = jnp.cumsum(flag.astype(jnp.int64))
+                lo2 = jnp.searchsorted(gid2, garange, side="left")
+                hi2 = jnp.searchsorted(gid2, garange, side="right")
+                upper2 = cs2[jnp.clip(hi2 - 1, 0, n - 1)]
+                lower2 = jnp.where(
+                    lo2 > 0, cs2[jnp.clip(lo2 - 1, 0, n - 1)], 0)
+                cnt = jnp.where(hi2 > lo2, upper2 - lower2, 0)
+            else:
+                cnt = jnp.zeros((m,), jnp.int64)
             out_cols.append(
                 Column(acc_dt, cnt, garange < num_groups)
             )
@@ -485,12 +598,13 @@ def groupby_aggregate(
         else:
             info = np.iinfo(np_dt)
             lo, hi = info.min, info.max
-        if op == "min":
-            vv = jnp.where(valid, c.data, jnp.asarray(hi, dtype=c.data.dtype))
-            red = jax.ops.segment_min(vv, _gid(), num_segments=m)
+        sentinel = hi if op == "min" else lo
+        vv = jnp.where(valid, c.data, jnp.asarray(sentinel, c.data.dtype))
+        if n:
+            run = _segmented_extremum(vv, ~same, op)
+            red = run[jnp.clip(g_hi - 1, 0, n - 1)]
         else:
-            vv = jnp.where(valid, c.data, jnp.asarray(lo, dtype=c.data.dtype))
-            red = jax.ops.segment_max(vv, _gid(), num_segments=m)
+            red = jnp.zeros((m,), c.data.dtype)
         out_cols.append(Column(c.dtype, red, vcount > 0))
 
     return GroupByResult(Table(out_cols), num_groups, overflowed)

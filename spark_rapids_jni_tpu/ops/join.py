@@ -230,7 +230,10 @@ def rank_encode_keys(
     sorted_tbl = gather(combined, order)
     same = _rows_equal_prev(sorted_tbl, ks)
     gid = (jnp.cumsum(~same) - 1).astype(jnp.int32)
-    ranks = jnp.zeros((n,), jnp.int32).at[order].set(gid)
+    # scatter-free permutation inverse: ranks[order[i]] = gid[i] is the
+    # gather ranks = gid[argsort(order)] (argsort of a permutation is its
+    # inverse; scatters serialize on TPU)
+    ranks = gid[jnp.argsort(order)]
     return ranks[:nl], ranks[nl:]
 
 

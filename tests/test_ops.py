@@ -416,7 +416,13 @@ def _groupby_tables_equal(a, b):
         va, vb = np.asarray(ca.valid_mask()), np.asarray(cb.valid_mask())
         assert np.array_equal(va, vb), f"col {i} validity"
         da, db = np.asarray(ca.data), np.asarray(cb.data)
-        assert np.array_equal(da[va], db[vb]), f"col {i} data"
+        if da.dtype.kind == "f":
+            # float lanes sum in an unspecified parallel order, which
+            # differs between the blocked-boundary and scan paths (int
+            # lanes stay bit-exact in both)
+            assert np.allclose(da[va], db[vb], rtol=1e-9), f"col {i} data"
+        else:
+            assert np.array_equal(da[va], db[vb]), f"col {i} data"
 
 
 def test_groupby_small_m_matches_default_path(rng):
@@ -617,3 +623,93 @@ def test_groupby_var_rejects_strings():
                  Column.from_pylist(["a", "b", "c"], t.STRING)])
     with pytest.raises(TypeError, match="numeric"):
         groupby_aggregate(tbl, [0], [(1, "var")])
+
+
+def test_groupby_and_q1_compile_scatter_free():
+    """VERDICT r3 item 9: every aggregate (incl. var/std, float mean,
+    nunique, numeric and string min/max) and the full q1 plan must lower
+    with ZERO scatter instructions — scatters serialize on the TPU
+    (BASELINE.md measured 1.6-4x vs scan forms). `.at[static_slice].set`
+    lowers to pad/dynamic-update-slice, which is fine; this counts real
+    scatter HLO ops."""
+    import re
+
+    import jax
+
+    from spark_rapids_jni_tpu.models.tpch import lineitem_table, tpch_q1
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    def real_scatters(hlo):
+        # ' scatter(' also catches variadic scatters whose result type is
+        # a spaced tuple, which '\\S+' would miss
+        return [ln for ln in hlo.splitlines() if " scatter(" in ln]
+
+    tbl = Table([
+        Column.from_pylist([1, 2, 1, 3] * 64, t.INT64),
+        Column.from_pylist([1.5, 2.5, 3.5, 4.5] * 64, t.FLOAT64),
+        Column.from_pylist([10, 20, 30, 40] * 64, t.INT32),
+        pad_strings(Column.from_pylist(["a", "bb", "a", "c"] * 64, t.STRING)),
+    ])
+
+    def g(tb):
+        r = groupby_aggregate(
+            tb, [0],
+            [(1, "sum"), (1, "mean"), (1, "var"), (1, "std"), (2, "min"),
+             (2, "max"), (2, "nunique"), (1, "count"), (3, "min"),
+             (3, "max")])
+        out = jnp.float64(0)
+        for c in r.table.columns:
+            out = out + jnp.sum(c.data).astype(jnp.float64)
+            if c.chars is not None:
+                out = out + jnp.sum(c.chars)
+        return out + r.num_groups
+
+    hlo = jax.jit(g).lower(tbl).compile().as_text()
+    assert real_scatters(hlo) == []
+
+    li = lineitem_table(2048)
+
+    def q1_digest(tb):
+        out = tpch_q1(tb)
+        return sum(jnp.sum(c.data).astype(jnp.float64)
+                   + jnp.sum(c.valid_mask()) for c in out.columns)
+
+    hlo_q1 = jax.jit(q1_digest).lower(li).compile().as_text()
+    assert real_scatters(hlo_q1) == []
+
+
+def test_groupby_float_small_group_after_large_group():
+    """Float group sums must be accurate to each group's OWN magnitude: a
+    tiny group following a huge one would vanish entirely under global
+    prefix differencing (the segmented-scan path prevents that)."""
+    keys = np.array([1] * 1000 + [2] * 4, dtype=np.int32)
+    vals = np.concatenate([
+        np.full(1000, 1e12), np.full(4, 1e-3)]).astype(np.float64)
+    tbl = Table([Column.from_numpy(keys), Column.from_numpy(vals)])
+    out = groupby_aggregate(
+        tbl, [0], [(1, "sum"), (1, "mean"), (1, "var")]).compact()
+    sums = np.asarray(out.column(1).data)
+    means = np.asarray(out.column(2).data)
+    assert np.isclose(sums[0], 1e15, rtol=1e-12)
+    assert np.isclose(sums[1], 4e-3, rtol=1e-12), sums[1]
+    assert np.isclose(means[1], 1e-3, rtol=1e-12)
+    # variance of a constant group is 0 (to the group's own magnitude)
+    var = np.asarray(out.column(3).data)
+    assert abs(var[1]) < 1e-18
+
+
+def test_empty_table_groupby_every_agg():
+    """n == 0 must trace and run for EVERY aggregate (the scatter-free
+    nunique path once crashed here)."""
+    tbl = Table([
+        Column.from_numpy(np.zeros(0, dtype=np.int64)),
+        Column.from_numpy(np.zeros(0, dtype=np.float64)),
+    ])
+    res = groupby_aggregate(
+        tbl, [0],
+        [(1, "sum"), (1, "count"), (1, "mean"), (1, "min"), (1, "max"),
+         (1, "var"), (1, "std"), (1, "nunique")],
+        max_groups=4)
+    assert int(res.num_groups) == 0
+    for c in res.table.columns:
+        assert not np.asarray(c.valid_mask()).any()
