@@ -39,6 +39,11 @@ class GroupByResult(NamedTuple):
     # past the bound were dropped; the caller re-plans with a larger bound
     # (grow-and-retry lives in the host wrapper, not here).
     overflowed: jnp.ndarray | bool = False
+    # True when a DECIMAL128 SUM exceeded 128 bits in some group: the
+    # affected group's sum is null, never a silently wrapped value (the
+    # Spark ANSI overflow posture, surfaced like the shuffle codec's
+    # narrowing_overflow rather than corrupting data).
+    sum_overflow: jnp.ndarray | bool = False
 
     def compact(self) -> Table:
         """Host-side trim to the real group count."""
@@ -156,6 +161,21 @@ def _boundary_prefix(stack: jnp.ndarray, idx: jnp.ndarray,
     rows = sp[ib]                                # (q, block, k)
     mask = jnp.arange(block, dtype=jnp.int32)[None, :, None] < r[:, None, None]
     return base + jnp.sum(jnp.where(mask, rows, 0), axis=1)
+
+
+def _range_sums_from_cumsum(cs: jnp.ndarray, lo: jnp.ndarray,
+                            hi: jnp.ndarray) -> jnp.ndarray:
+    """Per-range sums over rows [lo, hi) from an inclusive cumsum ``cs``
+    of shape (n,) or (n, k); empty ranges (hi <= lo) give 0. The shared
+    boundary-difference idiom of the int lane path and nunique."""
+    n = cs.shape[0]
+    upper = cs[jnp.clip(hi - 1, 0, n - 1)]
+    lower_raw = cs[jnp.clip(lo - 1, 0, n - 1)]
+    if cs.ndim == 2:
+        lower = jnp.where((lo > 0)[:, None], lower_raw, 0)
+        return jnp.where((hi > lo)[:, None], upper - lower, 0)
+    lower = jnp.where(lo > 0, lower_raw, 0)
+    return jnp.where(hi > lo, upper - lower, 0)
 
 
 def _segmented_sum_scan(stack: jnp.ndarray,
@@ -355,13 +375,8 @@ def groupby_aggregate(
             pref = _boundary_prefix(
                 stack, jnp.concatenate([g_hi, g_lo]), block)
             return pref[:m] - pref[m:]
-        cs = jnp.cumsum(stack, axis=0)
-        lo_c = jnp.clip(g_lo, 0, n - 1)
-        hi_c = jnp.clip(g_hi - 1, 0, n - 1)
-        upper = cs[hi_c]  # (m, k)
-        lower = jnp.where(
-            (g_lo > 0)[:, None], cs[jnp.maximum(lo_c - 1, 0)], 0)
-        return jnp.where((g_hi > g_lo)[:, None], upper - lower, 0)
+        return _range_sums_from_cumsum(
+            jnp.cumsum(stack, axis=0), g_lo, g_hi)
 
     _M32 = jnp.int64(0xFFFFFFFF)
 
@@ -380,7 +395,7 @@ def groupby_aggregate(
             # exact 128-bit sum: split (lo, hi) into four 32-bit limb
             # lanes so no int64 lane can overflow (sums bounded by
             # 2^32 * n), recombined with carry propagation below; totals
-            # beyond 128 bits wrap two's-complement (the int64 SUM posture)
+            # beyond 128 bits null the group and set sum_overflow
             lo = jnp.where(valid, c.data[:, 0], jnp.int64(0))
             hi = jnp.where(valid, c.data[:, 1], jnp.int64(0))
             lanes128 = (
@@ -445,13 +460,15 @@ def groupby_aggregate(
                           jnp.zeros((m,), jnp.bool_))
         cache_key = id(c)
         if cache_key not in _rank_order_cache:
-            _rank_order_cache[cache_key] = sort_order(
+            order_c = sort_order(
                 Table([c]), [0], nulls_first=[False]  # nulls last
             )
-        order_v = _rank_order_cache[cache_key]
-        # inverse permutation via argsort (a sort, not a scatter — scatters
-        # serialize on TPU)
-        rank = jnp.argsort(order_v).astype(jnp.int32)
+            # inverse permutation via argsort (a sort, not a scatter —
+            # scatters serialize on TPU); cached so a column's min and max
+            # share both sorts
+            _rank_order_cache[cache_key] = (
+                order_c, jnp.argsort(order_c).astype(jnp.int32))
+        order_v, rank = _rank_order_cache[cache_key]
         # null values never win: give them the worst rank for the op
         sentinel = jnp.int32(n if op == "min" else -1)
         rank = jnp.where(c.valid_mask(), rank, sentinel)
@@ -487,6 +504,7 @@ def groupby_aggregate(
             ) - 1).astype(jnp.int32)
         return _gid()
 
+    sum128_overflow = jnp.bool_(False)
     for op, c, acc_dt, val_lane, count_lane in plan:
         valid = c.valid_mask()
         vcount = seg_col(count_lane)
@@ -496,9 +514,17 @@ def groupby_aggregate(
             t = s1 + (s0 >> 32)
             lo = c0 | ((t & _M32) << 32)
             u = s2 + (t >> 32)
-            hi = (u & _M32) + ((s3 + (u >> 32)) << 32)
+            top = s3 + (u >> 32)  # exact signed bits >= 96 of the total
+            hi = (u & _M32) + (top << 32)
+            # the true total fits signed 128 bits iff `top` is the sign
+            # extension of its own low 32 bits; otherwise packing would
+            # wrap two's-complement — null the group and raise the flag
+            # instead (Spark ANSI decimal overflow posture)
+            ovf_g = (top != ((top << 32) >> 32)) & (vcount > 0)
+            sum128_overflow = sum128_overflow | jnp.any(
+                ovf_g & (garange < num_groups))
             out_cols.append(Column(
-                acc_dt, jnp.stack([lo, hi], axis=-1), vcount > 0
+                acc_dt, jnp.stack([lo, hi], axis=-1), (vcount > 0) & ~ovf_g
             ))
             continue
         if op == "count":
@@ -575,13 +601,10 @@ def groupby_aggregate(
             # no scatter
             if n:
                 gid2 = (jnp.cumsum(~same_k) - 1).astype(jnp.int32)
-                cs2 = jnp.cumsum(flag.astype(jnp.int64))
                 lo2 = jnp.searchsorted(gid2, garange, side="left")
                 hi2 = jnp.searchsorted(gid2, garange, side="right")
-                upper2 = cs2[jnp.clip(hi2 - 1, 0, n - 1)]
-                lower2 = jnp.where(
-                    lo2 > 0, cs2[jnp.clip(lo2 - 1, 0, n - 1)], 0)
-                cnt = jnp.where(hi2 > lo2, upper2 - lower2, 0)
+                cnt = _range_sums_from_cumsum(
+                    jnp.cumsum(flag.astype(jnp.int64)), lo2, hi2)
             else:
                 cnt = jnp.zeros((m,), jnp.int64)
             out_cols.append(
@@ -607,7 +630,8 @@ def groupby_aggregate(
             red = jnp.zeros((m,), c.data.dtype)
         out_cols.append(Column(c.dtype, red, vcount > 0))
 
-    return GroupByResult(Table(out_cols), num_groups, overflowed)
+    return GroupByResult(Table(out_cols), num_groups, overflowed,
+                         sum128_overflow)
 
 
 def groupby_aggregate_auto(

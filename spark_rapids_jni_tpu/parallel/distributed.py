@@ -117,6 +117,10 @@ class DistributedGroupBy(NamedTuple):
     table: Table             # per-device padded results, sharded over EXEC_AXIS
     num_groups: jnp.ndarray  # int32[D] groups owned by each device
     overflowed: jnp.ndarray  # bool[D] shuffle capacity overflow per device
+    # bool[D] per-device DECIMAL128 SUM 128-bit overflow (the group is
+    # nulled locally; this flag is how the caller tells an overflowed
+    # group from an all-null-input group — Spark ANSI posture)
+    sum_overflow: jnp.ndarray | bool = False
 
 
 @func_range("distributed_groupby_aggregate")
@@ -139,15 +143,17 @@ def distributed_groupby_aggregate(
     def step(local: Table):
         sh = hash_shuffle(local, keys, EXEC_AXIS, capacity=capacity)
         res = groupby_aggregate(sh.table, keys, aggs)
-        return res.table, res.num_groups.reshape(1), sh.overflowed.reshape(1)
+        return (res.table, res.num_groups.reshape(1),
+                sh.overflowed.reshape(1),
+                jnp.asarray(res.sum_overflow).reshape(1))
 
-    out_tbl, num_groups, overflowed = jax.shard_map(
+    out_tbl, num_groups, overflowed, sum_overflow = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=(P(EXEC_AXIS),),
-        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
     )(table)
-    return DistributedGroupBy(out_tbl, num_groups, overflowed)
+    return DistributedGroupBy(out_tbl, num_groups, overflowed, sum_overflow)
 
 
 @jax.jit

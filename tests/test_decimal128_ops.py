@@ -219,3 +219,43 @@ def test_decimal128_spark_hash_vs_reference():
         else:
             want = xxh64(java_bytes(v), SPARK_DEFAULT_SEED)
             assert np.uint64(got[i]) == np.uint64(want), v
+
+
+def test_decimal128_sum_overflow_flagged_not_wrapped():
+    """A 128-bit SUM that exceeds the signed 128-bit range must null the
+    group and set sum_overflow — never return a two's-complement-wrapped
+    value (VERDICT r3 item 10; Spark ANSI decimal overflow posture)."""
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+
+    big = (1 << 127) - 1  # signed 128-bit max
+    keys = [1, 1, 2]
+    vals = [big, big, 7]  # group 1 overflows; group 2 is fine
+    tbl = Table([
+        Column.from_pylist(keys, t.INT64),
+        Column.from_pylist(vals, t.decimal128(0)),
+    ])
+    res = groupby_aggregate(tbl, [0], [(1, "sum")])
+    assert bool(np.asarray(res.sum_overflow))
+    out = res.compact()
+    sums = out.column(1)
+    ok = np.asarray(sums.valid_mask())
+    assert list(ok) == [False, True]  # overflowed group nulled
+    assert sums.to_pylist()[1] == 7
+
+    # negative-direction overflow too
+    small = -(1 << 127)
+    tbl2 = Table([
+        Column.from_pylist([1, 1], t.INT64),
+        Column.from_pylist([small, small], t.decimal128(0)),
+    ])
+    res2 = groupby_aggregate(tbl2, [0], [(1, "sum")])
+    assert bool(np.asarray(res2.sum_overflow))
+
+    # a sum that lands exactly on the boundary must NOT flag
+    tbl3 = Table([
+        Column.from_pylist([1, 1], t.INT64),
+        Column.from_pylist([big, -big], t.decimal128(0)),
+    ])
+    res3 = groupby_aggregate(tbl3, [0], [(1, "sum")])
+    assert not bool(np.asarray(res3.sum_overflow))
+    assert res3.compact().column(1).to_pylist() == [0]

@@ -420,7 +420,8 @@ def _groupby_tables_equal(a, b):
             # float lanes sum in an unspecified parallel order, which
             # differs between the blocked-boundary and scan paths (int
             # lanes stay bit-exact in both)
-            assert np.allclose(da[va], db[vb], rtol=1e-9), f"col {i} data"
+            assert np.allclose(
+                da[va], db[vb], rtol=1e-9, atol=0), f"col {i} data"
         else:
             assert np.array_equal(da[va], db[vb]), f"col {i} data"
 

@@ -385,3 +385,22 @@ def test_distributed_groupby_var_and_nunique(rng, mesh):
         sel = vals[keys == k]
         assert np.isclose(got_var[int(k)], sel.var(ddof=1), rtol=1e-5)
         assert got_nu[int(k)] == len(set(sel.tolist()))
+
+
+def test_distributed_groupby_sum_overflow_surfaces(mesh):
+    """A DECIMAL128 SUM that exceeds 128 bits on one device must surface
+    through DistributedGroupBy.sum_overflow, distinguishable from an
+    all-null-input group."""
+    big = (1 << 127) - 1
+    n = 16
+    keys = [1] * n  # one group -> lands on one device after the shuffle
+    vals = [big] * n
+    tbl = Table([
+        Column.from_pylist(keys, t.INT64),
+        Column.from_pylist(vals, t.decimal128(0)),
+    ])
+    sharded = shard_table(tbl, mesh)
+    res = distributed_groupby_aggregate(
+        sharded, [0], [(1, "sum")], mesh, capacity=n)
+    assert not np.asarray(res.overflowed).any()
+    assert np.asarray(res.sum_overflow).any()
