@@ -666,3 +666,60 @@ def string_to_boolean(col: Column) -> Column:
              | is_word(b"no") | is_word(b"0"))
     ok = col.valid_mask() & judgeable & (truthy | falsy)
     return Column(t.BOOL8, truthy.astype(jnp.uint8), ok)
+
+
+# ---- float -> string -------------------------------------------------------
+
+
+def _java_float_repr(v, float32: bool) -> bytes:
+    """One float as Java Double.toString/Float.toString renders it — the
+    Spark ``cast(double as string)`` surface: shortest digits that
+    round-trip at the column's width, plain decimal for 1e-3 <= |v| < 1e7
+    (always one fractional digit), otherwise d.dddE[-]ee scientific."""
+    if np.isnan(v):
+        return b"NaN"
+    if np.isinf(v):
+        return b"Infinity" if v > 0 else b"-Infinity"
+    # dtype-aware shortest digits: numpy's unique repr is computed at the
+    # value's own width, so pin the declared width here rather than trust
+    # the caller's scalar type (a bare Python float would silently format
+    # at float64 width)
+    v = np.float32(v) if float32 else np.float64(v)
+    s = np.format_float_scientific(v, unique=True)
+    sign = b""
+    if s.startswith("-"):
+        sign = b"-"
+        s = s[1:]
+    mant, exp = s.split("e")
+    digits = mant.replace(".", "").rstrip("0")
+    if not digits:  # +/- zero
+        return sign + b"0.0"
+    p = int(exp) + 1  # value = 0.<digits> * 10**p
+    if -2 <= p <= 7:  # 1e-3 <= |v| < 1e7: plain decimal
+        if p <= 0:
+            out = "0." + "0" * (-p) + digits
+        elif p >= len(digits):
+            out = digits + "0" * (p - len(digits)) + ".0"
+        else:
+            out = digits[:p] + "." + digits[p:]
+    else:
+        frac = digits[1:] or "0"
+        out = digits[0] + "." + frac + "E" + str(p - 1)
+    return sign + out.encode()
+
+
+@func_range("float_to_string")
+def float_to_string(col: Column) -> Column:
+    """FLOAT32/FLOAT64 -> STRING with Java Double.toString semantics (the
+    Spark cast surface; closes the COVERAGE.md float->string gap). Host
+    assembly like every X->string cast."""
+    if col.dtype.storage_dtype.kind != "f":
+        raise TypeError("float_to_string requires a float column")
+    float32 = col.dtype.type_id == TypeId.FLOAT32
+    vals = np.asarray(col.data)
+    valid = np.asarray(col.valid_mask())
+    pieces = [
+        _java_float_repr(v, float32) if ok else b""
+        for v, ok in zip(vals, valid)
+    ]
+    return _column_from_pieces(pieces, valid)

@@ -483,15 +483,25 @@ def substring(col: Column, start: int, length: int | None = None) -> Column:
     return Column(STRING, out_len.astype(jnp.int32), col.validity, chars=out)
 
 
+def _host_case(col: Column, to_upper: bool) -> Column:
+    """Full Unicode case mapping on host (Python's str.upper/lower applies
+    the same Unicode full case mapping Java uses under Locale.ROOT, incl.
+    one-to-many expansions like ß -> SS). The price is a device->host
+    round trip — only taken when the column actually holds non-ASCII."""
+    vals = col.to_pylist()
+    out = [None if v is None else (v.upper() if to_upper else v.lower())
+           for v in vals]
+    return pad_strings(Column.from_pylist(out, STRING))
+
+
 def _ascii_case(col: Column, to_upper: bool) -> Column:
     p = pad_strings(col)
     mat = p.chars
     if bool(jnp.any(mat >= 0x80)):
-        raise NotImplementedError(
-            "upper/lower are ASCII-vectorized; this column holds multi-byte "
-            "UTF-8, where Java's full Unicode case mapping would diverge — "
-            "failing loudly instead of corrupting non-ASCII text"
-        )
+        # non-ASCII: the vectorized byte path would corrupt multi-byte
+        # UTF-8, so route through the host engine (correct, slower) —
+        # the two-engine pattern get_json_object uses
+        return _host_case(col, to_upper)
     if to_upper:
         out = jnp.where((mat >= ord("a")) & (mat <= ord("z")), mat - 32, mat)
     else:
@@ -501,11 +511,124 @@ def _ascii_case(col: Column, to_upper: bool) -> Column:
 
 @func_range("string_upper")
 def upper(col: Column) -> Column:
-    """ASCII uppercase (Spark upper; non-ASCII input fails loudly)."""
+    """Spark upper: ASCII rides the vectorized device path; non-ASCII
+    falls back to the host Unicode engine."""
     return _ascii_case(col, True)
 
 
 @func_range("string_lower")
 def lower(col: Column) -> Column:
-    """ASCII lowercase (Spark lower; non-ASCII input fails loudly)."""
+    """Spark lower: ASCII rides the vectorized device path; non-ASCII
+    falls back to the host Unicode engine."""
     return _ascii_case(col, False)
+
+
+# ---- regexp (host engine) --------------------------------------------------
+#
+# Spark's regexp functions compile java.util.regex patterns per-row on the
+# GPU in cuDF; a device regex VM is out of scope here, so these run the
+# HOST engine (Python `re`) — the documented two-engine posture
+# (get_json_object precedent): correct results, device->host round trip.
+# Java-compat measures: patterns compile with re.ASCII so \d/\w/\s/\b are
+# the ASCII classes java.util.regex defaults to; possessive quantifiers
+# (a*+) work natively on Python 3.11+; \p{...} classes are rejected by
+# compile (fail loudly, never silently different).
+
+
+def _java_replacement_to_python(rep: str, n_groups: int) -> str:
+    """Java Matcher.appendReplacement syntax -> Python sub template.
+    ``\\x`` in Java means LITERAL x (so ``\\n`` is the letter n, not a
+    newline); ``$digits`` binds greedily to the longest prefix that is a
+    valid group number <= ``n_groups`` (Java's rule — '$10' with two
+    groups is group 1 then literal '0')."""
+    out = []
+    i = 0
+    while i < len(rep):
+        c = rep[i]
+        if c == "\\":
+            if i + 1 >= len(rep):
+                raise ValueError(
+                    "invalid regexp replacement: trailing backslash")
+            nxt = rep[i + 1]
+            out.append("\\\\" if nxt == "\\" else nxt)
+            i += 2
+            continue
+        if c == "$":
+            j = i + 1
+            if j >= len(rep) or not rep[j].isdigit():
+                raise ValueError(
+                    f"invalid regexp replacement {rep!r}: '$' must be "
+                    f"followed by a group number (escape literal '$' "
+                    f"with a backslash)")
+            # greedy: extend while the accumulated number stays a valid
+            # group reference
+            g = int(rep[j])
+            j += 1
+            while j < len(rep) and rep[j].isdigit()                     and g * 10 + int(rep[j]) <= n_groups:
+                g = g * 10 + int(rep[j])
+                j += 1
+            if g > n_groups:
+                raise ValueError(
+                    f"invalid regexp replacement {rep!r}: group {g} "
+                    f"exceeds the pattern's {n_groups} group(s)")
+            out.append(f"\\g<{g}>")
+            i = j
+            continue
+        out.append("\\\\" if c == "\\" else c)
+        i += 1
+    return "".join(out)
+
+
+def _compile_java_regex(pattern: str):
+    """Compile with re.ASCII so \\d/\\w/\\s/\\b mean what java.util.regex
+    means by default ([0-9] etc.) — Python's Unicode-aware classes would
+    silently match differently than Spark."""
+    import re as _re
+
+    return _re.compile(pattern, _re.ASCII)
+
+
+def _host_regexp(col: Column, rx, fn):
+    vals = col.to_pylist()
+    return [None if v is None else fn(rx, v) for v in vals]
+
+
+@func_range("regexp_contains")
+def regexp_contains(col: Column, pattern: str) -> Column:
+    """RLIKE / regexp-find (cuDF contains_re): True when the pattern
+    matches anywhere in the string. Host engine."""
+    rx = _compile_java_regex(pattern)
+    out = _host_regexp(col, rx, lambda r, v: r.search(v) is not None)
+    flags = jnp.asarray([bool(v) for v in out], jnp.uint8)
+    from spark_rapids_jni_tpu.types import BOOL8
+
+    return Column(BOOL8, flags, col.valid_mask()
+                  if col.validity is not None else None)
+
+
+@func_range("regexp_extract")
+def regexp_extract(col: Column, pattern: str, group: int = 1) -> Column:
+    """Spark regexp_extract: the group'th capture of the first match,
+    '' when the pattern does not match (Spark returns empty string, not
+    null). Host engine."""
+    rx = _compile_java_regex(pattern)
+
+    def ext(r, v):
+        m = r.search(v)
+        if m is None:
+            return ""
+        g = m.group(group)
+        return "" if g is None else g
+
+    out = _host_regexp(col, rx, ext)
+    return pad_strings(Column.from_pylist(out, STRING))
+
+
+@func_range("regexp_replace")
+def regexp_replace(col: Column, pattern: str, replacement: str) -> Column:
+    """Spark regexp_replace: every match replaced; Java $N group refs
+    (greedy multi-digit) and \\x literal escapes supported. Host engine."""
+    rx = _compile_java_regex(pattern)
+    rep = _java_replacement_to_python(replacement, rx.groups)
+    out = _host_regexp(col, rx, lambda r, v: r.sub(rep, v))
+    return pad_strings(Column.from_pylist(out, STRING))

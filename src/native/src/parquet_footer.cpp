@@ -1,5 +1,9 @@
 #include "tpudf/parquet_footer.hpp"
 
+#include <clocale>
+#include <cwctype>
+#include <locale.h>
+
 #include <map>
 #include <stdexcept>
 
@@ -8,6 +12,30 @@ namespace parquet {
 
 using thrift::Value;
 using thrift::WireType;
+
+namespace {
+
+// Full-range code-point lowering via towlower_l pinned to a UTF-8 locale
+// (deterministic regardless of the process LC_CTYPE, unlike the
+// reference's bare towlower after mbstowcs — same mapping table, no
+// locale surprise). Falls back to identity above ASCII only if the image
+// has no UTF-8 locale at all.
+wint_t lower_code_point(wint_t cp) {
+  static locale_t loc = [] {
+    locale_t l = newlocale(LC_CTYPE_MASK, "C.UTF-8", (locale_t)0);
+    if (!l) l = newlocale(LC_CTYPE_MASK, "en_US.UTF-8", (locale_t)0);
+    return l;
+  }();
+  if (loc) return towlower_l(cp, loc);
+  // no UTF-8 locale in the image: keep at least the ASCII + Latin-1
+  // floor the pre-locale implementation guaranteed (U+00D7 is the
+  // multiplication sign, not a letter)
+  if (cp < 0x80) return towlower(cp);
+  if (cp >= 0xC0 && cp <= 0xDE && cp != 0xD7) return cp + 0x20;
+  return cp;
+}
+
+}  // namespace
 
 std::string utf8_to_lower(std::string const& in) {
   std::string out;
@@ -46,8 +74,11 @@ std::string utf8_to_lower(std::string const& in) {
       cp = (cp << 6) | (cc & 0x3F);
     }
     i += extra + 1;
-    // Latin-1 supplement upper -> lower (except U+00D7 multiplication sign).
-    if (cp >= 0xC0 && cp <= 0xDE && cp != 0xD7) cp += 0x20;
+    // Full wide-char-range simple lowering — the reference's
+    // unicode_to_lower goes through towlower for every code point
+    // (NativeParquetJni.cpp:45-77), so Greek/Cyrillic/etc column names
+    // case-fold identically under case-insensitive matching.
+    cp = static_cast<uint32_t>(lower_code_point(static_cast<wint_t>(cp)));
     // Re-encode.
     if (cp < 0x80) {
       out.push_back(static_cast<char>(cp));

