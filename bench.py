@@ -225,6 +225,61 @@ def _bench_parquet_q1(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_cast_strings(n: int, iters: int):
+    """BASELINE.json config #1: CastStrings float/decimal parse
+    throughput. Generates n numeric strings (template pool tiled to n),
+    measures one jitted pass that parses the SAME padded column to
+    FLOAT64 and DECIMAL64(-2) (both engines of the microbench)."""
+    import jax
+    import numpy as np
+
+    from spark_rapids_jni_tpu import types as t
+    from spark_rapids_jni_tpu.columnar import Column
+    from spark_rapids_jni_tpu.ops.cast_strings import (
+        string_to_decimal,
+        string_to_float,
+    )
+
+    rng = np.random.default_rng(0)
+    pool = []
+    for _ in range(min(n, 4096)):
+        mant = rng.integers(-10_000_000, 10_000_000)
+        frac = rng.integers(0, 100)
+        pool.append(f"{mant}.{frac:02d}")
+    vals = (pool * (n // len(pool) + 1))[:n]
+    # Arrow layout: the parse engines build their own char matrix
+    col = Column.from_pylist(vals, t.STRING)
+
+    import jax.numpy as jnp
+
+    def digest(c):
+        f = string_to_float(c, t.FLOAT64)
+        d = string_to_decimal(c, t.decimal64(-2))
+        return (jnp.sum(f.data).astype(jnp.float64)
+                + jnp.sum(f.valid_mask())
+                + jnp.sum(d.data).astype(jnp.float64)
+                + jnp.sum(d.valid_mask()))
+
+    fn = jax.jit(digest)
+    per_iter = _measure(lambda: fn(col), iters)
+    return n / per_iter
+
+
+def _bench_tpcds_q64(n: int, iters: int):
+    """BASELINE.json config #4's q64 half: the cross-year self-join core
+    over n store_sales rows."""
+    import jax
+
+    from spark_rapids_jni_tpu.models import tpcds
+
+    ss = tpcds.store_sales_table(n)
+    fn = jax.jit(
+        lambda a: _table_digest(tpcds.tpcds_q64(a).result.table)
+    )
+    per_iter = _measure(lambda: fn(ss), iters)
+    return n / per_iter
+
+
 def _bench_tpch_q3(n: int, iters: int):
     """q3 join+groupby pipeline: n lineitem rows against n/8 orders and
     n/64 customers (TPC-H-ish fanout)."""
@@ -353,6 +408,8 @@ _CONFIGS = {
     "shuffle_wire": (_bench_shuffle_wire, "shuffle_wire_gb_per_s", "GB/s"),
     "json_extract": (_bench_json_extract, "json_extract_rows_per_s", "rows/s"),
     "tpch_q3": (_bench_tpch_q3, "tpch_q3_rows_per_s", "rows/s"),
+    "cast_strings": (_bench_cast_strings, "cast_strings_rows_per_s", "rows/s"),
+    "tpcds_q64": (_bench_tpcds_q64, "tpcds_q64_rows_per_s", "rows/s"),
 }
 
 

@@ -574,7 +574,7 @@ def _java_replacement_to_python(rep: str, n_groups: int) -> str:
             out.append(f"\\g<{g}>")
             i = j
             continue
-        out.append("\\\\" if c == "\\" else c)
+        out.append(c)  # backslashes were consumed by the branch above
         i += 1
     return "".join(out)
 
@@ -582,9 +582,35 @@ def _java_replacement_to_python(rep: str, n_groups: int) -> str:
 def _compile_java_regex(pattern: str):
     """Compile with re.ASCII so \\d/\\w/\\s/\\b mean what java.util.regex
     means by default ([0-9] etc.) — Python's Unicode-aware classes would
-    silently match differently than Spark."""
+    silently match differently than Spark. Java-only character-class
+    syntax Python would silently mis-parse (``[a-z&&[b]]`` intersection,
+    nested classes) is rejected up front."""
     import re as _re
 
+    # scan for class intersection / nesting inside [...] — Python re
+    # compiles both without error but with different semantics
+    depth = 0
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == "[":
+            if depth > 0:
+                raise ValueError(
+                    f"unsupported java.util.regex syntax in {pattern!r}: "
+                    f"nested character class (Python re would silently "
+                    f"parse it differently)")
+            depth = 1
+        elif c == "]" and depth:
+            depth = 0
+        elif depth and pattern.startswith("&&", i):
+            raise ValueError(
+                f"unsupported java.util.regex syntax in {pattern!r}: "
+                f"character-class intersection '&&' (Python re would "
+                f"silently parse it differently)")
+        i += 1
     return _re.compile(pattern, _re.ASCII)
 
 
@@ -612,6 +638,12 @@ def regexp_extract(col: Column, pattern: str, group: int = 1) -> Column:
     '' when the pattern does not match (Spark returns empty string, not
     null). Host engine."""
     rx = _compile_java_regex(pattern)
+    if not 0 <= group <= rx.groups:
+        # validate up front like regexp_replace — otherwise an invalid
+        # index only crashes on rows that happen to match (Spark raises)
+        raise ValueError(
+            f"regexp_extract group {group} out of range: pattern has "
+            f"{rx.groups} group(s)")
 
     def ext(r, v):
         m = r.search(v)

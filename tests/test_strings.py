@@ -475,3 +475,18 @@ def test_regexp_java_semantics_edges():
     assert s.regexp_contains(
         Column.from_pylist(["aaab", "aaa"], t.STRING), r"a*+b"
     ).to_pylist() == [True, False]
+
+
+def test_regexp_rejects_java_class_syntax_and_bad_groups():
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    col = Column.from_pylist(["ab"], t.STRING)
+    with pytest.raises(ValueError, match="intersection"):
+        s.regexp_contains(col, r"[a-c&&[b]]")
+    with pytest.raises(ValueError, match="nested"):
+        s.regexp_contains(col, r"[a[b]]")
+    # escaped brackets and class-internal literals stay fine
+    assert s.regexp_contains(col, r"[ab]\[?").to_pylist() == [True]
+    assert s.regexp_contains(col, r"a&&?b").to_pylist() == [False]
+    with pytest.raises(ValueError, match="out of range"):
+        s.regexp_extract(col, r"(\w)", 2)
