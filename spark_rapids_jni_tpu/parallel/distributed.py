@@ -113,6 +113,80 @@ def shard_table(
     return sharded, jax.device_put(row_valid, sharding)
 
 
+def shard_table_multiprocess(
+    local: Table,
+    mesh: Mesh,
+    axis: str = EXEC_AXIS,
+) -> Table:
+    """Multi-process variant of ``shard_table``: every participating
+    process contributes its own local row chunk and gets back a GLOBAL
+    sharded Table spanning all processes' devices (the
+    one-PJRT-client-per-executor-JVM model, SURVEY.md section 7's
+    riskiest piece).
+
+    Requires ``jax.distributed.initialize`` to have run; ``mesh`` must
+    span the global device list. Every process must call this
+    collectively with the SAME number of local rows, a multiple of its
+    local device count (pad with null rows first if needed — static
+    shapes make uniform partitions a hard requirement, the same
+    bucketed-padding discipline as everywhere else; verified here with
+    an allgather so a mismatch fails loudly instead of hanging in the
+    next collective). String columns are padded to the GLOBAL max char
+    width (also allgathered) so every process builds the same program.
+
+    What changes for Spark executor JVMs: each executor's embedded
+    runtime calls ``jax.distributed.initialize(coordinator, n_execs,
+    exec_id)`` once at startup (the coordinator address comes from the
+    driver, like the UCX shuffle manager's handshake), builds the same
+    global mesh from ``jax.devices()``, and builds global arrays from
+    its local partitions exactly like this function. The jitted shuffle
+    step is then identical to the single-process path — XLA's CPU/TPU
+    collectives carry cross-process traffic (ICI on a slice, DCN across
+    slices) without any operator-level change."""
+    from jax.experimental import multihost_utils
+
+    sharding = NamedSharding(mesh, P(axis))
+    n_procs = jax.process_count()
+    counts = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([local.num_rows], jnp.int64), tiled=True))
+    if not (counts == local.num_rows).all():
+        raise ValueError(
+            f"shard_table_multiprocess needs the SAME row count in every "
+            f"process (static shapes); got per-process counts "
+            f"{counts.tolist()} — pad with null rows to a common size "
+            f"first")
+    global_rows = local.num_rows * n_procs
+
+    def make_global(arr):
+        np_arr = np.asarray(arr)
+        return jax.make_array_from_process_local_data(
+            sharding, np_arr, (global_rows,) + np_arr.shape[1:])
+
+    out = []
+    for c in local.columns:
+        if c.dtype.is_string:
+            from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+            # pad to the GLOBAL max width: a process-local width would
+            # compile a different program per process and wedge the
+            # collectives on a shape mismatch
+            if not c.is_padded_string:
+                c = pad_strings(c)
+            local_w = int(c.chars.shape[1])
+            widths = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray([local_w], jnp.int64), tiled=True))
+            target_w = int(widths.max())
+            if local_w < target_w:  # pad_strings no-ops on padded input
+                c = Column(c.dtype, c.data, c.validity, chars=jnp.pad(
+                    c.chars, ((0, 0), (0, target_w - local_w))))
+        chars = make_global(c.chars) if c.is_padded_string else None
+        out.append(Column(
+            c.dtype, make_global(c.data), make_global(c.valid_mask()),
+            chars=chars,
+        ))
+    return Table(out)
+
+
 class DistributedGroupBy(NamedTuple):
     table: Table             # per-device padded results, sharded over EXEC_AXIS
     num_groups: jnp.ndarray  # int32[D] groups owned by each device

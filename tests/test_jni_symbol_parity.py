@@ -1,0 +1,77 @@
+"""Java <-> C++ JNI symbol parity, checked at the SOURCE level.
+
+This image has no JDK (ci/java-build.sh self-gates; the full compile +
+jar + surefire run happens inside ci/Dockerfile via build/build-in-docker
+on a JDK-bearing runner), so the realistic drift risk is a silent rename
+or typo between a Java ``native`` declaration and its ``Java_*``
+definition in src/native/src/jni/ — which would otherwise only surface as
+an UnsatisfiedLinkError at run time on the Java runner. This test parses
+both sides and requires an exact bijection, using the JNI name-mangling
+rules (reference layering: RowConversionJni.cpp exports must line up
+with RowConversion.java natives, SURVEY.md section 1 L3/L4).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+JAVA_ROOT = REPO / "java" / "src" / "main" / "java"
+JNI_SRC = REPO / "src" / "native" / "src" / "jni"
+
+_NATIVE_DECL = re.compile(
+    r"\bnative\s+[\w.\[\]<>]+\s+(\w+)\s*\(", re.MULTILINE
+)
+_PACKAGE = re.compile(r"^\s*package\s+([\w.]+)\s*;", re.MULTILINE)
+_CPP_DEF = re.compile(r"\b(Java_\w+)\s*\(")
+
+
+def _mangle(component: str) -> str:
+    """JNI name mangling for one dot-separated component: underscores
+    become _1 (the other escapes — unicode, semicolons — cannot appear
+    in Java identifiers)."""
+    return component.replace("_", "_1")
+
+
+def expected_symbols() -> set[str]:
+    syms = set()
+    for path in sorted(JAVA_ROOT.rglob("*.java")):
+        text = path.read_text()
+        pkg_m = _PACKAGE.search(text)
+        assert pkg_m, f"{path} has no package declaration"
+        parts = pkg_m.group(1).split(".") + [path.stem]
+        prefix = "Java_" + "_".join(_mangle(p) for p in parts)
+        for m in _NATIVE_DECL.finditer(text):
+            syms.add(prefix + "_" + _mangle(m.group(1)))
+    return syms
+
+
+def defined_symbols() -> set[str]:
+    syms = set()
+    for path in sorted(JNI_SRC.glob("*.cpp")):
+        for m in _CPP_DEF.finditer(path.read_text()):
+            syms.add(m.group(1))
+    return syms
+
+
+def test_every_java_native_has_a_cpp_definition():
+    java = expected_symbols()
+    cpp = defined_symbols()
+    assert java, "no Java native declarations found — parser broke?"
+    missing = sorted(java - cpp)
+    assert not missing, (
+        "Java native methods with no Java_* definition in "
+        f"src/native/src/jni/ (UnsatisfiedLinkError at runtime): {missing}"
+    )
+
+
+def test_every_cpp_export_has_a_java_declaration():
+    java = expected_symbols()
+    cpp = defined_symbols()
+    assert cpp, "no Java_* definitions found — parser broke?"
+    orphans = sorted(cpp - java)
+    assert not orphans, (
+        f"Java_* definitions with no matching Java native declaration "
+        f"(dead export or renamed Java side): {orphans}"
+    )

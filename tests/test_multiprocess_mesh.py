@@ -1,0 +1,55 @@
+"""Multi-process executor mesh prototype (VERDICT r3 item 4; SURVEY.md
+section 7 names coordinating collectives across independently-launched
+executor processes — one PJRT client each — the riskiest novel piece).
+
+Two OS processes x 4 virtual CPU devices each form one 8-device global
+mesh via jax.distributed; the UNCHANGED q1 distributed step runs jitted
+across it, its hash_shuffle all_to_all crossing the process boundary.
+Each worker verifies the globally-gathered result against the numpy
+oracle (tests/multiproc_q1_worker.py)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_q1_shuffle_crosses_process_boundaries():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    n_procs, rows_per_proc = 2, 512
+    env = dict(os.environ)
+    # the workers pin their own platform/devices; drop the parent's pins
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tests.multiproc_q1_worker",
+             str(pid), str(n_procs), str(port), str(rows_per_proc)],
+            cwd=repo, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for pid in range(n_procs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        tail = "\n".join(out.strip().splitlines()[-15:])
+        assert p.returncode == 0, f"worker {pid} failed:\n{tail}"
+        assert "Q1_MULTIPROC_MATCH" in out, f"worker {pid}:\n{tail}"
