@@ -225,6 +225,39 @@ def _bench_parquet_q1(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_tpch_q1_planned(n: int, iters: int):
+    """q1 with planner-declared flag domains (groupby_aggregate_bounded):
+    no sort, no gather, no scan — the bounded-domain fast path."""
+    import jax
+
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_table,
+        tpch_q1_planned,
+    )
+
+    lineitem = lineitem_table(n)
+    fn = jax.jit(lambda t: _table_digest(tpch_q1_planned(t)))
+    per_iter = _measure(lambda: fn(lineitem), iters)
+    return n / per_iter
+
+
+def _bench_tpch_q1_pallas(n: int, iters: int):
+    """q1 through the experimental fused Pallas kernel (ops/pallas_q1.py)
+    — the single-pass, zero-int64 formulation. Interpret mode on non-TPU
+    backends (the kernel itself is TPU-only)."""
+    import jax
+
+    from spark_rapids_jni_tpu.models.tpch import lineitem_table
+    from spark_rapids_jni_tpu.ops.pallas_q1 import tpch_q1_pallas
+
+    interpret = jax.default_backend() != "tpu"
+    lineitem = lineitem_table(n)
+    fn = jax.jit(
+        lambda t: _table_digest(tpch_q1_pallas(t, interpret=interpret)))
+    per_iter = _measure(lambda: fn(lineitem), iters)
+    return n / per_iter
+
+
 def _bench_cast_strings(n: int, iters: int):
     """BASELINE.json config #1: CastStrings float/decimal parse
     throughput. Generates n numeric strings (template pool tiled to n),
@@ -410,6 +443,10 @@ _CONFIGS = {
     "tpch_q3": (_bench_tpch_q3, "tpch_q3_rows_per_s", "rows/s"),
     "cast_strings": (_bench_cast_strings, "cast_strings_rows_per_s", "rows/s"),
     "tpcds_q64": (_bench_tpcds_q64, "tpcds_q64_rows_per_s", "rows/s"),
+    "tpch_q1_planned": (
+        _bench_tpch_q1_planned, "tpch_q1_planned_rows_per_s", "rows/s"),
+    "tpch_q1_pallas": (
+        _bench_tpch_q1_pallas, "tpch_q1_pallas_rows_per_s", "rows/s"),
 }
 
 

@@ -200,6 +200,49 @@ def tpch_q1(lineitem: Table) -> Table:
     return sort_table(grouped.table, [0, 1], nulls_first=[False, False])
 
 
+# TPC-H DDL domains for the q1 flags (the spec fixes returnflag to
+# 'A'/'N'/'R' and linestatus to 'F'/'O'); a real planner gets the same
+# facts from dictionary/column statistics.
+_Q1_RF_DOMAIN = (ord("A"), ord("N"), ord("R"))
+_Q1_LS_DOMAIN = (ord("F"), ord("O"))
+
+
+@func_range("tpch_q1_planned_result")
+def tpch_q1_planned_result(lineitem: Table):
+    """q1 with PLANNER-DECLARED key domains: the flag domains come from
+    the TPC-H DDL (CHAR(1) check constraints / dictionary stats), so
+    grouping needs no sort, no gather, no scan — one streaming masked-
+    reduction pass (groupby_aggregate_bounded), and the output order is
+    static (real groups lexicographic, null groups last), so the final
+    ORDER BY costs nothing. Returns the full BoundedGroupByResult so
+    jitted callers can observe ``domain_miss``; the single shared call
+    path for the checked and unchecked wrappers below."""
+    work = _q1_work_table(lineitem)
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate_bounded
+
+    return groupby_aggregate_bounded(
+        work, keys=[0, 1], aggs=_Q1_AGGS,
+        key_domains=[_Q1_RF_DOMAIN, _Q1_LS_DOMAIN],
+    )
+
+
+def tpch_q1_planned(lineitem: Table) -> Table:
+    """Planned q1, table only — same output schema as ``tpch_q1``.
+    Out-of-domain key bytes fold into the null-key group WITHOUT signal
+    here (jitted code cannot raise); callers that must detect that use
+    ``tpch_q1_planned_result().domain_miss`` or the checked wrapper."""
+    return tpch_q1_planned_result(lineitem).table
+
+
+def tpch_q1_planned_checked(lineitem: Table) -> Table:
+    """Host wrapper for the planned q1: domain misses re-plan onto the
+    general sort-based pipeline instead of dropping rows."""
+    res = tpch_q1_planned_result(lineitem)
+    if bool(res.domain_miss):
+        return tpch_q1_checked(lineitem)
+    return res.table
+
+
 def tpch_q1_checked(lineitem: Table) -> Table:
     """Host-side q1 wrapper that enforces the plan's group-budget contract
     (raises instead of silently dropping groups on out-of-contract data)."""
