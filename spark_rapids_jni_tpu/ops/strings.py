@@ -351,11 +351,14 @@ def like(col: Column, pattern: str, escape: str = "\\") -> Column:
     evaluated with vectorized window matches + a per-gap reachability
     scan — no regex engine, no per-row host work.
 
-    '_' advances one BYTE in this engine; on ASCII data that equals
-    Spark's one-character semantics. Patterns containing '_' against a
-    column with multi-byte UTF-8 rows raise (fail loudly, never silently
-    filter differently than Spark); '%' and literals are byte-exact for
-    any UTF-8 data."""
+    '_' advances one UTF-8 CHARACTER (Spark semantics) via character-
+    boundary tracking; '%' and literals are byte-exact for any UTF-8
+    data (a valid-UTF-8 literal cannot match at a continuation byte, so
+    byte- and char-anchoring agree). On INVALID UTF-8, continuation
+    bytes (0x80-0xBF) always extend the preceding character — e.g. a
+    lone b"\\x80\\x80" row counts as one character — matching how a
+    byte-oriented UTF-8 scanner segments garbage; behavior on such data
+    is unspecified in Spark."""
     esc = escape.encode("utf-8")
     if len(esc) != 1:
         raise ValueError("LIKE escape must be one byte")
@@ -405,25 +408,41 @@ def like(col: Column, pattern: str, escape: str = "\\") -> Column:
         segs.pop()
 
     p = pad_strings(col)
-    if (any(g[0] for g in gaps) or tail_gap[0]) and bool(
-        jnp.any(p.chars >= 0x80)
-    ):
-        raise NotImplementedError(
-            "LIKE '_' advances one byte in this engine; the pattern uses "
-            "'_' and the column holds multi-byte UTF-8, where Spark's "
-            "one-character semantics would diverge — failing loudly "
-            "instead of filtering differently"
-        )
     n = p.size
     w = int(p.chars.shape[1])
     jdx = jnp.arange(w + 1, dtype=jnp.int32)
+    # '_' advances one CHARACTER (Spark semantics): position j in [0, w]
+    # is a character boundary iff j == 0 or the byte at j is not a UTF-8
+    # continuation byte (0x80-0xBF); one-char advance moves each boundary
+    # to the NEXT boundary via a prev-boundary gather. On pure-ASCII data
+    # every position is a boundary and this degenerates to the one-byte
+    # shift. '%' gaps stay byte-based: a valid-UTF-8 literal can never
+    # match starting at a continuation byte (lead bytes are < 0x80 or
+    # >= 0xC0), so byte-anchoring and char-anchoring agree.
+    # boundary at position j <=> the byte AT j starts a character (j = 0
+    # and j = w are always boundaries; chars past a row's length are
+    # zero-padded, i.e. non-continuation, so the row end works out too)
+    cont = (p.chars & 0xC0) == 0x80                      # (n, w)
+    is_b = jnp.concatenate(
+        [jnp.ones((n, 1), jnp.bool_), ~cont[:, 1:],
+         jnp.ones((n, 1), jnp.bool_)], axis=1)           # (n, w+1)
+    pos_if_b = jnp.where(is_b, jdx[None, :], -1)
+    pb_incl = jax.lax.associative_scan(jnp.maximum, pos_if_b, axis=1)
+    prev_b = jnp.concatenate(
+        [jnp.full((n, 1), -1, jdx.dtype), pb_incl[:, :-1]], axis=1)
+
+    def advance_chars(r, k):
+        for _ in range(k):
+            r = (is_b & (prev_b >= 0)
+                 & jnp.take_along_axis(r, jnp.clip(prev_b, 0, w), axis=1))
+        return r
+
     # reach[j] True: pattern consumed so far can end exactly at byte j
     reach = jnp.zeros((n, w + 1), jnp.bool_).at[:, 0].set(True)
     for seg, (mincnt, floating) in zip(segs, gaps):
-        # gap: advance exactly mincnt (then any amount if floating)
+        # gap: advance exactly mincnt chars (then any amount if floating)
         if mincnt:
-            reach = jnp.roll(reach, mincnt, axis=1)
-            reach = reach & (jdx[None, :] >= mincnt)
+            reach = advance_chars(reach, mincnt)
         reach = reach & (jdx[None, :] <= p.data[:, None])
         if floating:
             reach = jax.lax.associative_scan(jnp.logical_or, reach, axis=1)
@@ -435,8 +454,7 @@ def like(col: Column, pattern: str, escape: str = "\\") -> Column:
             reach = moved & (jdx[None, :] >= len(seg))
     mincnt, floating = tail_gap
     if mincnt:
-        reach = jnp.roll(reach, mincnt, axis=1)
-        reach = reach & (jdx[None, :] >= mincnt)
+        reach = advance_chars(reach, mincnt)
     reach = reach & (jdx[None, :] <= p.data[:, None])
     if floating:
         hit = jnp.any(reach, axis=1)

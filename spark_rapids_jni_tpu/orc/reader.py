@@ -11,7 +11,10 @@ Type mapping (ORC kind -> DType):
   INT -> INT32            LONG -> INT64      FLOAT/DOUBLE -> FLOAT32/64
   STRING/VARCHAR/CHAR/BINARY -> STRING       DATE -> TIMESTAMP_DAYS
   TIMESTAMP -> TIMESTAMP_MICROS (unix epoch; ORC 2015-epoch + nano
-  trailing-zero encoding decoded natively)
+  trailing-zero encoding decoded natively; non-UTC writer timezones
+  converted wall-clock -> UTC here via the tz database — pyarrow's
+  assume_timezone, ambiguous/nonexistent local times resolve to the
+  EARLIEST candidate, a documented choice where implementations differ)
   DECIMAL(p<=18, s) -> decimal64(-s)         DECIMAL(p>18, s) ->
   decimal128(-s) (int64 limb pairs)
 """
@@ -63,6 +66,24 @@ def _check(lib, ok: bool, what: str) -> None:
         raise NativeError(f"{what}: {lib.last_error()}")
 
 
+_UTC_NAMES = ("", "UTC", "GMT", "Etc/UTC", "Etc/GMT")
+
+
+def _wall_to_utc_micros(raw: np.ndarray, valid, tz: str) -> np.ndarray:
+    """Wall-clock micros in the writer's zone -> unix-epoch UTC micros,
+    via the tz database (the dependency the native layer deliberately
+    does not own). Ambiguous/nonexistent wall times (DST transitions)
+    resolve to the earliest valid instant."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    mask = None if valid is None else ~np.asarray(valid, dtype=bool)
+    arr = pa.array(raw.view("datetime64[us]"), mask=mask)
+    out = pc.assume_timezone(
+        arr, tz, ambiguous="earliest", nonexistent="earliest")
+    return np.asarray(out.cast(pa.int64()).fill_null(0))
+
+
 def _i32_array(vals: Optional[Sequence[int]]):
     if vals is None:
         return None, 0
@@ -98,6 +119,9 @@ def read_table(
     handle = lib.tpudf_orc_read(data, len(data), cols, n_cols, sts, n_sts)
     _check(lib, handle != 0, "orc read")
     try:
+        tz_raw = lib.tpudf_orc_writer_timezone(handle)
+        _check(lib, tz_raw is not None, "writer_timezone")
+        writer_tz = tz_raw.decode("utf-8")
         n_columns = lib.tpudf_orc_num_columns(handle)
         _check(lib, n_columns >= 0, "num_columns")
         out = []
@@ -157,6 +181,8 @@ def read_table(
                 values = raw.astype(np.uint32).view(np.float32)
             elif kind == _K_DOUBLE:
                 values = raw.view(np.uint64).view(np.float64)
+            elif kind == _K_TIMESTAMP and writer_tz not in _UTC_NAMES:
+                values = _wall_to_utc_micros(raw, vbuf, writer_tz)
             else:
                 values = raw.astype(dtype.storage_dtype, copy=False)
             out.append(Column(dtype, jnp.asarray(values), validity))

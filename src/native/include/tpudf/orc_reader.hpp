@@ -65,6 +65,11 @@ struct OrcColumn {
 struct OrcResult {
   int64_t num_rows = 0;
   std::vector<OrcColumn> columns;
+  // unique StripeFooter.writerTimezone across the decoded stripes
+  // (empty/UTC-family means no conversion is needed). TIMESTAMP payloads
+  // are WALL-CLOCK micros in this zone; the Python layer applies the tz
+  // database (stripes with conflicting zones fail the decode).
+  std::string writer_timezone;
 };
 
 struct StripeInfo {

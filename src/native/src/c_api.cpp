@@ -490,6 +490,20 @@ int32_t tpudf_orc_col_meta(int64_t handle, int32_t i, int32_t* meta,
   return 0;
 }
 
+// the unique StripeFooter.writerTimezone of the decoded stripes ("" =
+// none recorded / UTC-family): TIMESTAMP payloads are wall-clock micros
+// in this zone and the caller owns the tz-database conversion.
+char const* tpudf_orc_writer_timezone(int64_t handle) {
+  thread_local std::string tz_buf;
+  auto r = orc_reads().get(handle);
+  if (r == nullptr) {
+    set_error("invalid orc read handle");
+    return nullptr;
+  }
+  tz_buf = r->writer_timezone;
+  return tz_buf.c_str();
+}
+
 char const* tpudf_orc_col_name(int64_t handle, int32_t i) {
   thread_local std::string name_buf;
   auto r = orc_reads().get(handle);

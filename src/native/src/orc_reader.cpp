@@ -712,13 +712,10 @@ void decode_stripe_column(uint8_t const* file, FileMeta const& meta,
       // = nanos with the removed-trailing-zero count in the low 3 bits
       // (z > 0 means value * 10^(z+1)). Result: int64 unix-epoch
       // microseconds.
-      auto const& tz = dir.writer_timezone;
-      if (!tz.empty() && tz != "UTC" && tz != "GMT" && tz != "Etc/UTC" &&
-          tz != "Etc/GMT") {
-        fail("TIMESTAMP written in timezone '" + tz +
-             "'; only UTC/GMT-written files are supported (wall-clock "
-             "conversion needs a tz database)");
-      }
+      // non-UTC writer zones no longer fail here: the decode emits
+      // WALL-CLOCK micros and read_file records the zone; the Python
+      // layer owns the tz database (zoneinfo via pyarrow) and converts
+      // wall -> UTC there.
       constexpr int64_t kOrcEpochSeconds = 1420070400;
       auto secs = decode_int_stream(s.data, n_present, true, v2);
       auto nenc = decode_int_stream(s.secondary, n_present, false, v2);
@@ -838,6 +835,7 @@ OrcResult read_file(uint8_t const* file, uint64_t len,
   }
 
   OrcResult res;
+  bool first_stripe = true;
   for (int32_t cidx : cols) {
     if (cidx < 0 || static_cast<uint64_t>(cidx) >= meta.leaves.size()) {
       fail("column index out of range");
@@ -871,6 +869,16 @@ OrcResult read_file(uint8_t const* file, uint64_t len,
     auto sf_bytes = decode_stream(file + sf_off, sf_len, meta.compression);
     Message sf = Message::parse(sf_bytes.data(), sf_bytes.size());
     StripeDirectory dir = parse_directory(len, stripe, sf);
+    if (first_stripe) {
+      res.writer_timezone = dir.writer_timezone;
+      first_stripe = false;
+    } else if (res.writer_timezone != dir.writer_timezone) {
+      // includes empty-vs-named mixes: an unrecorded zone reads as UTC
+      // here, so silently adopting a sibling stripe's named zone would
+      // shift that stripe's values — fail loudly instead
+      fail("stripes disagree on writerTimezone ('" +
+           res.writer_timezone + "' vs '" + dir.writer_timezone + "')");
+    }
     for (uint64_t k = 0; k < cols.size(); ++k) {
       decode_stripe_column(file, meta, dir, cols[k], stripe_rows,
                            res.columns[k]);

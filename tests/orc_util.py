@@ -202,6 +202,7 @@ def write_orc(
     stripe_size: Optional[int] = None,
     codec: int = NONE,
     with_row_index: bool = False,
+    writer_timezone=None,  # str for all stripes, or list per stripe
 ) -> bytes:
     """``with_row_index`` emits a dummy ROW_INDEX stream per column at the
     stripe head (inside indexLength), the layout every real ORC writer
@@ -241,6 +242,10 @@ def write_orc(
         sf += pb_bytes(2, pb_varint(1, 0))  # root encoding DIRECT
         for _ in columns:
             sf += pb_bytes(2, pb_varint(1, 0))  # DIRECT (RLEv1)
+        tz = (writer_timezone[len(stripe_infos)]
+              if isinstance(writer_timezone, list) else writer_timezone)
+        if tz is not None:
+            sf += pb_bytes(3, tz.encode())
         sf_framed = frame(bytes(sf), codec)
         blob += sf_framed
         stripe_infos.append({

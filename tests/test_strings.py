@@ -346,15 +346,43 @@ def test_like_vs_regex_oracle(rng):
             assert got[i] == want, (v, pat, got[i], want)
 
 
-def test_like_underscore_rejects_multibyte_utf8():
+def test_like_underscore_multibyte_utf8_char_semantics():
+    """'_' matches one CHARACTER, not one byte (Spark semantics) —
+    multi-byte UTF-8 no longer fails loudly, it works."""
     from spark_rapids_jni_tpu.ops import strings as s
 
-    col = Column.from_pylist(["aéc", "abc"], t.STRING)
-    with pytest.raises(NotImplementedError, match="multi-byte"):
-        s.like(col, "a_c")
+    col = Column.from_pylist(["aéc", "abc", "axyc", "日本語"], t.STRING)
+    assert s.like(col, "a_c").to_pylist() == [True, True, False, False]
+    assert s.like(col, "___").to_pylist() == [True, True, False, True]
+    assert s.like(col, "_本_").to_pylist() == [False, False, False, True]
+    assert s.like(col, "__").to_pylist() == [False, False, False, False]
+    assert s.like(col, "_%").to_pylist() == [True, True, True, True]
     # '%' and literal patterns stay byte-exact on the same data
-    assert s.like(col, "a%c").to_pylist() == [True, True]
-    assert s.contains(col, "é").to_pylist() == [True, False]
+    assert s.like(col, "a%c").to_pylist() == [True, True, True, False]
+    assert s.contains(col, "é").to_pylist() == [True, False, False, False]
+
+
+def test_like_multibyte_vs_regex_oracle(rng):
+    """Random UTF-8 strings x '_'-bearing patterns against Python's
+    character-level regex engine."""
+    import re
+
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    alphabet = list("abéλ日x")
+    vals = ["".join(rng.choice(alphabet,
+                               size=int(rng.integers(0, 7))))
+            for _ in range(200)]
+    col = Column.from_pylist(vals, t.STRING)
+    for pat in ["_", "__", "a_", "_é", "%_", "_%_", "a_%", "%日_",
+                "___%", "_b_"]:
+        rx = re.compile(
+            "".join(".*" if c == "%" else "." if c == "_"
+                    else re.escape(c) for c in pat), re.DOTALL)
+        got = s.like(col, pat).to_pylist()
+        for v, g in zip(vals, got):
+            want = rx.fullmatch(v) is not None
+            assert g == want, (v, pat, g, want)
 
 
 def test_like_invalid_escape_patterns_raise():
