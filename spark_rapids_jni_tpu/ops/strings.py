@@ -422,20 +422,25 @@ def like(col: Column, pattern: str, escape: str = "\\") -> Column:
     # boundary at position j <=> the byte AT j starts a character (j = 0
     # and j = w are always boundaries; chars past a row's length are
     # zero-padded, i.e. non-continuation, so the row end works out too)
-    cont = (p.chars & 0xC0) == 0x80                      # (n, w)
-    is_b = jnp.concatenate(
-        [jnp.ones((n, 1), jnp.bool_), ~cont[:, 1:],
-         jnp.ones((n, 1), jnp.bool_)], axis=1)           # (n, w+1)
-    pos_if_b = jnp.where(is_b, jdx[None, :], -1)
-    pb_incl = jax.lax.associative_scan(jnp.maximum, pos_if_b, axis=1)
-    prev_b = jnp.concatenate(
-        [jnp.full((n, 1), -1, jdx.dtype), pb_incl[:, :-1]], axis=1)
+    if any(g[0] for g in gaps) or tail_gap[0]:
+        # only '_'-bearing patterns pay for the boundary machinery
+        cont = (p.chars & 0xC0) == 0x80                  # (n, w)
+        is_b = jnp.concatenate(
+            [jnp.ones((n, 1), jnp.bool_), ~cont[:, 1:],
+             jnp.ones((n, 1), jnp.bool_)], axis=1)       # (n, w+1)
+        pos_if_b = jnp.where(is_b, jdx[None, :], -1)
+        pb_incl = jax.lax.associative_scan(jnp.maximum, pos_if_b, axis=1)
+        prev_b = jnp.concatenate(
+            [jnp.full((n, 1), -1, jdx.dtype), pb_incl[:, :-1]], axis=1)
 
-    def advance_chars(r, k):
-        for _ in range(k):
-            r = (is_b & (prev_b >= 0)
-                 & jnp.take_along_axis(r, jnp.clip(prev_b, 0, w), axis=1))
-        return r
+        def advance_chars(r, k):
+            for _ in range(k):
+                r = (is_b & (prev_b >= 0) & jnp.take_along_axis(
+                    r, jnp.clip(prev_b, 0, w), axis=1))
+            return r
+    else:
+        def advance_chars(r, k):  # pragma: no cover - zero-count gaps
+            return r
 
     # reach[j] True: pattern consumed so far can end exactly at byte j
     reach = jnp.zeros((n, w + 1), jnp.bool_).at[:, 0].set(True)

@@ -206,6 +206,11 @@ class OrcChunkedReader:
         self._limit = max(int(chunk_read_limit), 1)
         self._infos = stripe_info(data)
         self._next = 0
+        # cross-stripe invariants (e.g. agreeing writerTimezone) are
+        # checked per read_file call, so per-chunk reads would silently
+        # miss a conflict between stripes of DIFFERENT chunks — walk all
+        # stripe footers once up front (no column decode: columns=[])
+        read_table(data, columns=[])
 
     def has_next(self) -> bool:
         return self._next < len(self._infos)

@@ -146,6 +146,7 @@ def test_decimal128_minmax_vs_python(rng):
 # ---- distributed layer -----------------------------------------------------
 
 
+@pytest.mark.slow
 def test_decimal128_distributed_groupby(rng):
     from spark_rapids_jni_tpu.parallel import (
         distributed_groupby_aggregate, executor_mesh, shard_table)
@@ -178,6 +179,7 @@ def test_decimal128_distributed_groupby(rng):
     assert got == want
 
 
+@pytest.mark.slow
 def test_decimal128_distributed_sort(rng):
     from spark_rapids_jni_tpu.parallel import executor_mesh, shard_table
     from spark_rapids_jni_tpu.parallel.distributed import collect

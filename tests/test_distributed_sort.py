@@ -7,6 +7,9 @@ capacity overflow detection.
 import numpy as np
 import pytest
 
+# every test here drives the 8-device distributed sort (>=45 s each)
+pytestmark = pytest.mark.slow
+
 from spark_rapids_jni_tpu import types as t
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.parallel import executor_mesh, shard_table

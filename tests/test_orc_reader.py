@@ -394,3 +394,17 @@ def test_orc_timestamp_unknown_zone_fails_loudly():
                      writer_timezone="Not/A_Zone")
     with pytest.raises(Exception, match="Not/A_Zone"):
         read_table(data)
+
+
+def test_orc_chunked_reader_rejects_cross_chunk_tz_conflict():
+    """The conflicting-stripe check must fire even when the disagreeing
+    stripes would land in different chunks."""
+    from spark_rapids_jni_tpu.orc.reader import OrcChunkedReader
+    from tests.orc_util import TIMESTAMP, ColumnSpec, write_orc
+
+    vals = [0, 1_000_000, 2_000_000, 3_000_000]
+    data = write_orc(
+        [ColumnSpec("ts", TIMESTAMP, vals)], stripe_size=2,
+        writer_timezone=[None, "Europe/Berlin"])
+    with pytest.raises(NativeError, match="disagree"):
+        OrcChunkedReader(data, chunk_read_limit=1)

@@ -102,6 +102,7 @@ def test_hash_shuffle_preserves_rows_and_targets(rng, mesh):
     )
 
 
+@pytest.mark.slow
 def test_distributed_groupby_matches_local(rng, mesh):
     n = 512
     tbl = _random_table(rng, n)
@@ -195,6 +196,7 @@ def test_tpch_q1_distributed_matches_single_device(mesh):
         ), f"column {col} mismatch"
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     import __graft_entry__ as ge
 
@@ -322,6 +324,7 @@ def test_wire_narrowing_ignores_null_garbage(rng, mesh):
     np.testing.assert_array_equal(np.sort(got[ok]), np.sort(data[valid]))
 
 
+@pytest.mark.slow
 def test_distributed_groupby_high_cardinality(rng, mesh):
     """VERDICT r2 item 8: >=1e5 distinct groups through the distributed
     groupby within a bounded shuffle capacity — the scaling-discipline
@@ -359,6 +362,7 @@ def test_distributed_groupby_high_cardinality(rng, mesh):
     assert dict(zip(got_keys.tolist(), got_counts.tolist())) == dict(want)
 
 
+@pytest.mark.slow
 def test_distributed_groupby_var_and_nunique(rng, mesh):
     """var/std/nunique are not merge-decomposable, but the repartitioned
     plan shuffles WHOLE key groups onto one device before the local
@@ -387,6 +391,7 @@ def test_distributed_groupby_var_and_nunique(rng, mesh):
         assert got_nu[int(k)] == len(set(sel.tolist()))
 
 
+@pytest.mark.slow
 def test_distributed_groupby_sum_overflow_surfaces(mesh):
     """A DECIMAL128 SUM that exceeds 128 bits on one device must surface
     through DistributedGroupBy.sum_overflow, distinguishable from an

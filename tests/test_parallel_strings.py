@@ -87,6 +87,7 @@ def test_string_shuffle_preserves_rows_and_targets(rng, mesh):
         assert owner.setdefault(word, dev) == dev
 
 
+@pytest.mark.slow
 def test_distributed_groupby_string_keys(rng, mesh):
     n = 1024
     keys = [f"key_{i}" for i in rng.integers(0, 40, n)]
@@ -146,6 +147,7 @@ def test_tpch_q1_distributed_string_flags(mesh):
             assert g[field] == want[field], (key, field)
 
 
+@pytest.mark.slow
 def test_distributed_string_key_join(rng, mesh):
     nl, nr = 256, 192
     words = [f"w{i}" for i in range(20)]
@@ -186,6 +188,7 @@ def test_distributed_string_key_join(rng, mesh):
     assert got == want
 
 
+@pytest.mark.slow
 def test_distributed_multikey_join_int_string(rng, mesh):
     nl, nr = 128, 96
     lk1 = rng.integers(0, 8, nl).astype(np.int64)

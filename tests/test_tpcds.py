@@ -103,6 +103,7 @@ def test_q64_base_year_anchors_dates():
     assert got == want
 
 
+@pytest.mark.slow
 def test_distributed_join_matches_local(rng, mesh):
     from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
 
@@ -145,6 +146,7 @@ def test_distributed_join_matches_local(rng, mesh):
 
 
 @pytest.mark.parametrize("n_l", [256, 250])  # 250: shard padding on 8 devices
+@pytest.mark.slow
 def test_distributed_left_join_no_phantom_rows(rng, mesh, n_l):
     """Neither phantom shuffle slots nor shard_table padding rows may
     surface as unmatched left-join rows."""
@@ -207,6 +209,7 @@ def test_q72_distributed_matches_oracle():
     assert order_keys == sorted(order_keys)
 
 
+@pytest.mark.slow
 def test_q64_distributed_matches_oracle():
     from spark_rapids_jni_tpu.models.tpcds import (
         store_sales_table,
@@ -226,6 +229,7 @@ def test_q64_distributed_matches_oracle():
     assert got == want
 
 
+@pytest.mark.slow
 def test_q64_distributed_detects_join_truncation():
     import numpy as np
     import pytest as _pytest
@@ -252,6 +256,7 @@ def test_q64_distributed_detects_join_truncation():
 
 
 @pytest.mark.parametrize("how", ["left_semi", "left_anti", "full"])
+@pytest.mark.slow
 def test_distributed_join_types_match_oracle(rng, mesh, how):
     """Semi/anti/full compose under hash partitioning (equal keys are
     co-located after the exchange), including with shard padding and

@@ -153,6 +153,7 @@ def test_tpch_q3_matches_oracle():
     assert np.all(np.diff(revs.astype(np.int64)) <= 0)
 
 
+@pytest.mark.slow
 def test_tpch_q3_distributed_matches_oracle():
     from spark_rapids_jni_tpu.models.tpch import (
         tpch_q3_distributed, tpch_q3_numpy)
