@@ -91,14 +91,22 @@ def _i32_array(vals: Optional[Sequence[int]]):
     return arr, len(vals)
 
 
-def stripe_info(data: bytes) -> list[tuple[int, int]]:
-    """[(num_rows, data_bytes)] per stripe — the chunk-planning probe."""
+def stripe_info(data) -> list[tuple[int, int]]:
+    """[(num_rows, data_bytes)] per stripe — the chunk-planning probe.
+    ``data`` may be bytes or a filesystem path (mmap; only tail pages
+    fault in)."""
+    from spark_rapids_jni_tpu.utils.fspath import as_fs_path
+
     lib = load_native()
     cap = 4096
     while True:
         nr = (ctypes.c_int64 * cap)()
         bs = (ctypes.c_int64 * cap)()
-        n = lib.tpudf_orc_stripes(data, len(data), nr, bs, cap)
+        path = as_fs_path(data)
+        if path is not None:
+            n = lib.tpudf_orc_stripes_path(path, nr, bs, cap)
+        else:
+            n = lib.tpudf_orc_stripes(data, len(data), nr, bs, cap)
         _check(lib, n >= 0, "stripe_info")
         if n <= cap:
             return [(nr[i], bs[i]) for i in range(n)]
@@ -107,16 +115,26 @@ def stripe_info(data: bytes) -> list[tuple[int, int]]:
 
 @func_range("orc_read_table")
 def read_table(
-    data: bytes,
+    data,
     columns: Optional[Sequence[int]] = None,
     stripes: Optional[Sequence[int]] = None,
 ) -> Table:
-    """Decode a complete in-memory ORC file into a device Table.
-    None selects all columns/stripes; an empty list selects none."""
+    """Decode an ORC file into a device Table. ``data`` may be in-memory
+    bytes OR a filesystem path: paths decode through a native mmap (the
+    cuFile/GDS-role storage path, like the Parquet reader) — stripe-
+    selective reads fault in only the selected byte ranges. None selects
+    all columns/stripes; an empty list selects none."""
+    from spark_rapids_jni_tpu.utils.fspath import as_fs_path
+
     lib = load_native()
     cols, n_cols = _i32_array(columns)
     sts, n_sts = _i32_array(stripes)
-    handle = lib.tpudf_orc_read(data, len(data), cols, n_cols, sts, n_sts)
+    path = as_fs_path(data)
+    if path is not None:
+        handle = lib.tpudf_orc_read_path(path, cols, n_cols, sts, n_sts)
+    else:
+        handle = lib.tpudf_orc_read(
+            data, len(data), cols, n_cols, sts, n_sts)
     _check(lib, handle != 0, "orc read")
     try:
         tz_raw = lib.tpudf_orc_writer_timezone(handle)
@@ -193,11 +211,13 @@ def read_table(
 
 class OrcChunkedReader:
     """Iterate an ORC file as Tables bounded by a byte budget — chunk
-    boundaries at stripe granularity, always at least one stripe."""
+    boundaries at stripe granularity, always at least one stripe.
+    ``data`` may be bytes or a filesystem path (mmap route: each chunk
+    faults in only its stripes' byte ranges)."""
 
     def __init__(
         self,
-        data: bytes,
+        data,
         chunk_read_limit: int,
         columns: Optional[Sequence[int]] = None,
     ):

@@ -35,6 +35,7 @@ from spark_rapids_jni_tpu import types as t
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.parquet.footer import NativeError
 from spark_rapids_jni_tpu.runtime.native import load_native
+from spark_rapids_jni_tpu.utils.fspath import as_fs_path
 from spark_rapids_jni_tpu.types import DType, TypeId
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
@@ -150,9 +151,9 @@ def row_group_info(data: "bytes | str | os.PathLike") -> list[tuple[int, int]]:
     while True:
         nr = (ctypes.c_int64 * cap)()
         bs = (ctypes.c_int64 * cap)()
-        if isinstance(data, (str, os.PathLike)):
-            n = lib.tpudf_parquet_row_groups_path(
-                os.fsencode(data), nr, bs, cap)
+        path = as_fs_path(data)
+        if path is not None:
+            n = lib.tpudf_parquet_row_groups_path(path, nr, bs, cap)
         else:
             n = lib.tpudf_parquet_row_groups(data, len(data), nr, bs, cap)
         _check(lib, n >= 0, "row_group_info")
@@ -317,10 +318,9 @@ def read_table(
     lib = load_native()
     cols, n_cols = _i32_array(columns)
     rgs, n_rgs = _i32_array(row_groups)
-    if isinstance(data, (str, os.PathLike)):
-        handle = lib.tpudf_parquet_read_path(
-            os.fsencode(data), cols, n_cols, rgs, n_rgs
-        )
+    path = as_fs_path(data)
+    if path is not None:
+        handle = lib.tpudf_parquet_read_path(path, cols, n_cols, rgs, n_rgs)
     else:
         handle = lib.tpudf_parquet_read(
             data, len(data), cols, n_cols, rgs, n_rgs

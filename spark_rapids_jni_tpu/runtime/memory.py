@@ -191,30 +191,72 @@ def _table_nbytes(table) -> int:
     return sum(_col_nbytes(c) for c in table.columns)
 
 
-def _col_to_host(c) -> tuple:
+def _pack_array(arr, cctx):
+    """Optionally zstd-compress one host buffer (the nvcomp role for the
+    HOST path: spilled working sets, future DCN exchange). Returns the
+    plain array when compression is off."""
+    if arr is None:
+        return None
+    a = np.ascontiguousarray(arr)
+    if cctx is None:
+        return a
+    # compress() takes buffer-protocol objects — no tobytes() copy
+    return ("zstd", a.dtype.str, a.shape, cctx.compress(a))
+
+
+def _unpack_array(obj, dctx):
+    if obj is None or not isinstance(obj, tuple):
+        return obj
+    _, dtype_str, shape, blob = obj
+    return np.frombuffer(
+        dctx.decompress(blob), dtype=np.dtype(dtype_str)).reshape(shape)
+
+
+def _packed_nbytes(obj) -> int:
+    if obj is None:
+        return 0
+    if isinstance(obj, tuple):
+        return len(obj[3])
+    return obj.nbytes
+
+
+def _col_to_host(c, cctx=None) -> tuple:
     """Recursive host snapshot of a column (incl. LIST/STRUCT children)."""
     return (
         c.dtype,
-        np.asarray(c.data),
-        None if c.validity is None else np.asarray(c.validity),
-        None if c.chars is None else np.asarray(c.chars),
-        None if not c.children else [_col_to_host(ch) for ch in c.children],
+        _pack_array(np.asarray(c.data), cctx),
+        None if c.validity is None
+        else _pack_array(np.asarray(c.validity), cctx),
+        None if c.chars is None else _pack_array(np.asarray(c.chars), cctx),
+        None if not c.children
+        else [_col_to_host(ch, cctx) for ch in c.children],
     )
 
 
-def _col_from_host(snap):
+def _col_from_host(snap, dctx=None):
     import jax.numpy as jnp
 
     from spark_rapids_jni_tpu.columnar import Column
 
     dtype, data, validity, chars, children = snap
     return Column(
-        dtype, jnp.asarray(data),
-        None if validity is None else jnp.asarray(validity),
-        chars=None if chars is None else jnp.asarray(chars),
+        dtype, jnp.asarray(_unpack_array(data, dctx)),
+        None if validity is None
+        else jnp.asarray(_unpack_array(validity, dctx)),
+        chars=None if chars is None
+        else jnp.asarray(_unpack_array(chars, dctx)),
         children=None if children is None
-        else [_col_from_host(ch) for ch in children],
+        else [_col_from_host(ch, dctx) for ch in children],
     )
+
+
+def _host_snap_nbytes(snap) -> int:
+    _, data, validity, chars, children = snap
+    n = (_packed_nbytes(data) + _packed_nbytes(validity)
+         + _packed_nbytes(chars))
+    for ch in (children or []):
+        n += _host_snap_nbytes(ch)
+    return n
 
 
 class SpillStore:
@@ -230,7 +272,12 @@ class SpillStore:
     under ``memory.log_level`` >= 1.
     """
 
-    def __init__(self, budget_bytes: int):
+    def __init__(self, budget_bytes: int, compress_spill: bool = False,
+                 compress_level: int = 3):
+        """``compress_spill`` zstd-compresses spilled host buffers (the
+        nvcomp general-codec role on the host path): logical HBM bytes
+        stay the accounting unit; ``stats()['host_stored_bytes']``
+        reports the actual compressed footprint."""
         if budget_bytes <= 0:
             raise ValueError("budget must be positive")
         self.budget = int(budget_bytes)
@@ -241,6 +288,13 @@ class SpillStore:
         self._tick = 0
         self.spill_count = 0
         self.unspill_count = 0
+        self._cctx = None
+        self._dctx = None
+        if compress_spill:
+            import zstandard as zstd
+
+            self._cctx = zstd.ZstdCompressor(level=compress_level)
+            self._dctx = zstd.ZstdDecompressor()
 
     def _device_bytes_locked(self) -> int:
         return sum(e["nbytes"] for e in self._entries.values()
@@ -265,7 +319,8 @@ class SpillStore:
                 )
             _, eid = min(candidates)
             e = self._entries[eid]
-            e["host_cols"] = [_col_to_host(c) for c in e["table"].columns]
+            e["host_cols"] = [
+                _col_to_host(c, self._cctx) for c in e["table"].columns]
             e["table"] = None  # drop the device arrays -> XLA frees HBM
             e["state"] = "host"
             self.spill_count += 1
@@ -300,7 +355,8 @@ class SpillStore:
             if e["state"] == "device":
                 return e["table"]
             self._spill_lru_locked(e["nbytes"])
-            cols = [_col_from_host(snap) for snap in e["host_cols"]]
+            cols = [
+                _col_from_host(snap, self._dctx) for snap in e["host_cols"]]
             e["table"] = Table(cols)
             e["host_cols"] = None
             e["state"] = "device"
@@ -318,8 +374,13 @@ class SpillStore:
             device = self._device_bytes_locked()
             host = sum(e["nbytes"] for e in self._entries.values()
                        if e["state"] == "host")
+            stored = sum(
+                sum(_host_snap_nbytes(s) for s in e["host_cols"])
+                for e in self._entries.values() if e["state"] == "host"
+            )
             return {
                 "device_bytes": device, "host_bytes": host,
+                "host_stored_bytes": stored,  # compressed footprint
                 "budget_bytes": self.budget,
                 "spills": self.spill_count, "unspills": self.unspill_count,
                 "tables": len(self._entries),

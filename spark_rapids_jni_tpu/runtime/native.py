@@ -170,6 +170,18 @@ class NativeLib:
         c.tpudf_orc_col_name.argtypes = [ctypes.c_int64, ctypes.c_int32]
         c.tpudf_orc_writer_timezone.restype = ctypes.c_char_p
         c.tpudf_orc_writer_timezone.argtypes = [ctypes.c_int64]
+        c.tpudf_orc_read_path.restype = ctypes.c_int64
+        c.tpudf_orc_read_path.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ]
+        c.tpudf_orc_stripes_path.restype = ctypes.c_int32
+        c.tpudf_orc_stripes_path.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+        ]
         c.tpudf_orc_col_copy.restype = ctypes.c_int32
         c.tpudf_orc_col_copy.argtypes = [
             ctypes.c_int64,

@@ -1,0 +1,16 @@
+"""Shared bytes-vs-path dispatch for the file readers (Parquet + ORC):
+both accept in-memory bytes or a filesystem path, where paths route to
+the native mmap storage path. One helper so the readers cannot diverge
+on path handling."""
+
+from __future__ import annotations
+
+import os
+
+
+def as_fs_path(data) -> bytes | None:
+    """fsencode'd path when ``data`` names a file, else None (in-memory
+    bytes)."""
+    if isinstance(data, (str, os.PathLike)):
+        return os.fsencode(data)
+    return None

@@ -437,6 +437,45 @@ int64_t tpudf_orc_read(uint8_t const* buf, uint64_t len, int32_t const* cols,
   }
 }
 
+// ORC half of the mmap storage route (cuFile/GDS role, mirroring
+// tpudf_parquet_read_path): decode straight out of a read-only mapping —
+// stripe-selective chunked reads fault in only the selected byte ranges.
+int64_t tpudf_orc_read_path(char const* path, int32_t const* cols,
+                            int32_t n_cols, int32_t const* stripes,
+                            int32_t n_stripes) {
+  try {
+    tpudf::MappedFile map(path);
+    std::optional<std::vector<int32_t>> col_vec;
+    if (cols != nullptr) col_vec.emplace(cols, cols + n_cols);
+    std::optional<std::vector<int32_t>> st_vec;
+    if (stripes != nullptr) st_vec.emplace(stripes, stripes + n_stripes);
+    auto res = std::make_shared<tpudf::orc::OrcResult>(
+        tpudf::orc::read_file(map.data(), map.size(), col_vec, st_vec));
+    return orc_reads().put(std::move(res));
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return 0;
+  }
+}
+
+// Stripe probe over a file path (mmap; tail pages only are touched).
+int32_t tpudf_orc_stripes_path(char const* path, int64_t* num_rows,
+                               int64_t* byte_size, int32_t cap) {
+  try {
+    tpudf::MappedFile map(path);
+    auto infos = tpudf::orc::stripe_infos(map.data(), map.size());
+    for (int32_t i = 0; i < cap && i < static_cast<int32_t>(infos.size());
+         ++i) {
+      num_rows[i] = infos[i].num_rows;
+      byte_size[i] = infos[i].data_bytes;
+    }
+    return static_cast<int32_t>(infos.size());
+  } catch (std::exception const& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
 int32_t tpudf_orc_stripes(uint8_t const* buf, uint64_t len, int64_t* num_rows,
                           int64_t* byte_size, int32_t cap) {
   try {

@@ -67,3 +67,4 @@ def test_validity_pack_bit_order():
     packed = np.asarray(pack_validity(valid))
     assert packed[0] == 1
     assert packed[1] == 2
+

@@ -408,3 +408,24 @@ def test_orc_chunked_reader_rejects_cross_chunk_tz_conflict():
         writer_timezone=[None, "Europe/Berlin"])
     with pytest.raises(NativeError, match="disagree"):
         OrcChunkedReader(data, chunk_read_limit=1)
+
+
+def test_orc_path_based_mmap_read(tmp_path):
+    """The cuFile/GDS-role storage route: decode from a filesystem path
+    through the native mmap, bytes-identical to the in-memory path,
+    including chunked reads."""
+    specs = _mixed_columns(n=150, seed=13)
+    data = ou.write_orc(specs, stripe_size=50, codec=ou.ZLIB)
+    f = tmp_path / "t.orc"
+    f.write_bytes(data)
+
+    assert stripe_info(str(f)) == stripe_info(data)
+    _assert_matches(read_table(str(f)), specs)
+    sub = read_table(str(f), columns=[4], stripes=[1])
+    assert sub.column(0).to_pylist() == specs[4].values[50:100]
+
+    budget = stripe_info(data)[0][1] + 1
+    got = []
+    for ch in OrcChunkedReader(str(f), budget):
+        got.extend(ch.column(4).to_pylist())
+    assert got == specs[4].values
