@@ -361,24 +361,25 @@ def decimal_to_string(col: Column) -> Column:
     scale -2, unscaled 5 -> "0.05"; scale 0 behaves like integers."""
     if not col.dtype.is_decimal:
         raise TypeError("decimal_to_string requires a decimal column")
-    if col.dtype.scale > 0:
-        # value = unscaled * 10^scale with scale > 0 needs trailing zeros,
-        # not a fraction — unsupported rather than silently wrong
-        raise NotImplementedError(
-            "positive decimal scales are not supported by decimal_to_string"
-        )
     neg, mag = _signed_magnitude(col.data)
     digits = np.asarray(_digit_matrix_u64(mag))
     neg = np.asarray(neg)
     valid = np.asarray(col.valid_mask())
+    if col.dtype.scale > 0:
+        # value = unscaled * 10^scale: integral with trailing zeros
+        # (Spark renders DECIMAL(p, negative-s) as a plain integer)
+        return _assemble_decimal_strings(
+            digits, neg, valid, scale=0, trailing_zeros=col.dtype.scale)
     return _assemble_decimal_strings(digits, neg, valid, scale=-col.dtype.scale)
 
 
 def _assemble_decimal_strings(
-    digits: np.ndarray, neg: np.ndarray, valid: np.ndarray, scale: int
+    digits: np.ndarray, neg: np.ndarray, valid: np.ndarray, scale: int,
+    trailing_zeros: int = 0,
 ) -> Column:
     """Host assembly: digit rows -> Arrow string column. ``scale`` is the
-    number of fractional digits (>= 0)."""
+    number of fractional digits (>= 0); ``trailing_zeros`` appends fixed
+    zeros (positive decimal scales — integral values)."""
     n = digits.shape[0]
     pieces: list[bytes] = []
     for i in range(n):
@@ -392,6 +393,8 @@ def _assemble_decimal_strings(
             s = s[:-scale] + b"." + s[-scale:]
         elif not s:
             s = b"0"
+        elif trailing_zeros:
+            s = s + b"0" * trailing_zeros
         if neg[i]:
             s = b"-" + s
         pieces.append(s)

@@ -245,13 +245,15 @@ def test_boolean_to_string_spark_semantics():
         integer_to_string(col)
 
 
-def test_decimal_to_string_positive_scale_rejected():
+def test_decimal_to_string_positive_scale_trailing_zeros():
+    """Positive decimal scales render as integers with trailing zeros
+    (value = unscaled * 10^scale), zero stays '0'."""
     from spark_rapids_jni_tpu.ops.cast_strings import decimal_to_string
     from spark_rapids_jni_tpu.types import DType, TypeId
 
-    col = Column.from_pylist([5], DType(TypeId.DECIMAL64, 2))
-    with pytest.raises(NotImplementedError):
-        decimal_to_string(col)
+    col = Column.from_pylist([5, -12, 0, None], DType(TypeId.DECIMAL64, 2))
+    assert decimal_to_string(col).to_pylist() == [
+        "500", "-1200", "0", None]
 
 
 # ---- date casts ------------------------------------------------------------
