@@ -1,0 +1,62 @@
+"""Deterministic fuzz of the native parsers (footer/thrift, Parquet
+pages, ORC/protobuf): garbage, bit-flipped valid files, and truncations
+must always surface as Python exceptions — never a native crash. This is
+the runtime half of the reference's sanitizer posture (SURVEY.md
+section 5: thrift anti-bomb caps, `CUDF_EXPECTS` bounds checks); the
+compile-time half is -Werror."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.orc.reader import read_table as orc_read
+from spark_rapids_jni_tpu.parquet.footer import ParquetFooter
+from spark_rapids_jni_tpu.parquet.reader import read_table as pq_read
+from tests import orc_util as ou
+from tests import parquet_util as pu
+
+
+def _swallow(fn, *args):
+    try:
+        fn(*args)
+    except Exception:
+        pass  # any Python exception is fine; a crash would kill pytest
+
+
+def test_random_garbage_never_crashes():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        blob = bytes(rng.integers(0, 256, int(rng.integers(8, 400)),
+                                  dtype=np.uint8))
+        _swallow(orc_read, blob)
+        _swallow(pq_read, blob)
+        _swallow(ParquetFooter.read_and_filter, blob, 0, -1, ["a"], [0], 1)
+
+
+def test_bitflipped_orc_never_crashes():
+    specs = [ou.ColumnSpec("i", ou.LONG, list(range(50))),
+             ou.ColumnSpec("s", ou.STRING, [f"x{i}" for i in range(50)])]
+    good = bytearray(ou.write_orc(specs, codec=ou.ZLIB))
+    rng = np.random.default_rng(1)
+    for _ in range(200):
+        b = bytearray(good)
+        for _ in range(int(rng.integers(1, 8))):
+            b[int(rng.integers(0, len(b)))] = int(rng.integers(0, 256))
+        _swallow(orc_read, bytes(b))
+    for cut in range(1, len(good), 5):
+        _swallow(orc_read, bytes(good[:cut]))
+
+
+def test_bitflipped_parquet_never_crashes():
+    good = bytearray(pu.write_parquet([
+        pu.ColumnSpec("a", physical=2, values=list(range(64))),
+        pu.ColumnSpec("s", physical=6,
+                      values=[f"v{i}" for i in range(64)]),
+    ]))
+    rng = np.random.default_rng(2)
+    for _ in range(200):
+        b = bytearray(good)
+        for _ in range(int(rng.integers(1, 8))):
+            b[int(rng.integers(0, len(b)))] = int(rng.integers(0, 256))
+        _swallow(pq_read, bytes(b))
+    for cut in range(1, len(good), 5):
+        _swallow(pq_read, bytes(good[:cut]))
