@@ -25,7 +25,7 @@ import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops.sort import gather, sort_order
-from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.types import DType, TypeId, decimal128
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 SUPPORTED_AGGS = ("sum", "count", "min", "max", "mean", "var", "std",
@@ -220,6 +220,74 @@ def _segmented_extremum(vv: jnp.ndarray, seg_start: jnp.ndarray,
     return v
 
 
+_U32 = jnp.uint64(0xFFFFFFFF)
+
+
+def _mean128_exact(lo: jnp.ndarray, hi: jnp.ndarray,
+                   count: jnp.ndarray):
+    """Exact DECIMAL128 mean: (S * 10^4) / count with HALF_UP rounding,
+    computed entirely in integer limb arithmetic (TPU f64 is f32-pair
+    emulated, so a float mean would silently lose precision — this path
+    never touches floats). ``lo``/``hi`` are the exact 128-bit group sums
+    (two's complement int64 pair), ``count`` the per-group non-null
+    counts. Works because counts fit 32 bits: limb-wise long division
+    with 32-bit limbs keeps every intermediate inside uint64.
+
+    Returns (limbs (m, 2) int64, overflow bool[m]) — overflow when the
+    widened value exceeds signed 128 bits (Spark ANSI: null + flag)."""
+    ulo = lo.astype(jnp.uint64)
+    uhi = hi.astype(jnp.uint64)
+    neg = hi < 0
+    # |S|: two's-complement negate the 128-bit pair where negative
+    nlo = (~ulo) + jnp.uint64(1)
+    nhi = (~uhi) + jnp.where(ulo == 0, jnp.uint64(1), jnp.uint64(0))
+    mlo = jnp.where(neg, nlo, ulo)
+    mhi = jnp.where(neg, nhi, uhi)
+    m = [mlo & _U32, mlo >> 32, mhi & _U32, mhi >> 32]
+
+    # |S| * 10^4 with carry propagation (limb * 1e4 < 2^46, in-range)
+    ten4 = jnp.uint64(10_000)
+    t, carry = [], jnp.zeros_like(mlo)
+    for limb in m:
+        cur = limb * ten4 + carry
+        t.append(cur & _U32)
+        carry = cur >> 32
+    t.append(carry)  # 5th limb
+
+    c = count.astype(jnp.uint64)
+    count_too_big = c > _U32
+    c_safe = jnp.maximum(jnp.where(count_too_big, jnp.uint64(1), c),
+                         jnp.uint64(1))
+    # + c//2: HALF_UP (away from zero on the magnitude)
+    add = c_safe >> 1
+    for i in range(5):
+        cur = t[i] + add
+        t[i] = cur & _U32
+        add = cur >> 32
+        if i == 4:
+            break
+
+    # long division top -> bottom; r < c <= 2^32 keeps cur inside uint64
+    q = [None] * 5
+    r = jnp.zeros_like(mlo)
+    for i in range(4, -1, -1):
+        cur = (r << 32) | t[i]
+        q[i] = cur // c_safe
+        r = cur - q[i] * c_safe
+    overflow = (q[4] != 0) | (q[3] >> 31 != 0) | count_too_big
+
+    qlo = q[0] | (q[1] << 32)
+    qhi = q[2] | (q[3] << 32)
+    # negate back where the sum was negative
+    rlo = jnp.where(neg, (~qlo) + jnp.uint64(1), qlo)
+    rhi = jnp.where(
+        neg, (~qhi) + jnp.where(qlo == 0, jnp.uint64(1), jnp.uint64(0)),
+        qhi)
+    limbs = jnp.stack(
+        [rlo.astype(jnp.int64), rhi.astype(jnp.int64)], axis=-1)
+    return limbs, overflow
+
+
 def _sum_dtype(dt: DType) -> DType:
     """Spark widens SUM: integral -> INT64, decimal keeps scale (wider
     precision), floats stay floating."""
@@ -386,16 +454,12 @@ def groupby_aggregate(
         valid = c.valid_mask()
         count_lane = lane(valid, memo_key=(id(c), "count"))
         if op in ("sum", "mean") and c.dtype.is_decimal128:
-            if op == "mean":
-                raise NotImplementedError(
-                    "DECIMAL128 mean is not supported (f64 on TPU is "
-                    "f32-pair emulated, ~49-bit mantissa — a lossy mean "
-                    "would be silent corruption); sum/count instead"
-                )
             # exact 128-bit sum: split (lo, hi) into four 32-bit limb
             # lanes so no int64 lane can overflow (sums bounded by
             # 2^32 * n), recombined with carry propagation below; totals
-            # beyond 128 bits null the group and set sum_overflow
+            # beyond 128 bits null the group and set sum_overflow.
+            # mean128 divides the exact sum by the count with limb-wise
+            # long division (exact, no f64) — see the consume branch.
             lo = jnp.where(valid, c.data[:, 0], jnp.int64(0))
             hi = jnp.where(valid, c.data[:, 1], jnp.int64(0))
             lanes128 = (
@@ -404,7 +468,12 @@ def groupby_aggregate(
                 lane(hi & _M32, memo_key=(id(c), "s128", 2)),
                 lane(hi >> 32, memo_key=(id(c), "s128", 3)),
             )
-            plan.append(("sum128", c, c.dtype, lanes128, count_lane))
+            if op == "mean":
+                # Spark avg(decimal) carries 4 extra fractional digits
+                plan.append(("mean128", c, decimal128(c.dtype.scale - 4),
+                             lanes128, count_lane))
+            else:
+                plan.append(("sum128", c, c.dtype, lanes128, count_lane))
             continue
         if op in ("var", "std"):
             if c.dtype.is_decimal128:
@@ -508,7 +577,7 @@ def groupby_aggregate(
     for op, c, acc_dt, val_lane, count_lane in plan:
         valid = c.valid_mask()
         vcount = seg_col(count_lane)
-        if op == "sum128":
+        if op in ("sum128", "mean128"):
             s0, s1, s2, s3 = (seg_col(i) for i in val_lane)
             c0 = s0 & _M32
             t = s1 + (s0 >> 32)
@@ -521,10 +590,16 @@ def groupby_aggregate(
             # wrap two's-complement — null the group and raise the flag
             # instead (Spark ANSI decimal overflow posture)
             ovf_g = (top != ((top << 32) >> 32)) & (vcount > 0)
+            if op == "mean128":
+                limbs, div_ovf = _mean128_exact(lo, hi, vcount)
+                ovf_g = ovf_g | (div_ovf & (vcount > 0))
+                out = limbs
+            else:
+                out = jnp.stack([lo, hi], axis=-1)
             sum128_overflow = sum128_overflow | jnp.any(
                 ovf_g & (garange < num_groups))
             out_cols.append(Column(
-                acc_dt, jnp.stack([lo, hi], axis=-1), (vcount > 0) & ~ovf_g
+                acc_dt, out, (vcount > 0) & ~ovf_g
             ))
             continue
         if op == "count":

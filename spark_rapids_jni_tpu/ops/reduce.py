@@ -93,11 +93,18 @@ def max_(col: Column):
 
 @func_range("reduce_mean")
 def mean(col: Column):
-    """(mean as FLOAT64, valid); decimals rescale so the float carries the
-    true value (the groupby mean contract). DECIMAL128 unsupported (lossy
-    f64-emulated mean would be silent corruption)."""
+    """(mean, valid). Floats/ints/decimal64 return FLOAT64 rescaled to the
+    true value (the groupby mean contract); DECIMAL128 returns EXACT
+    (2,)-limb unscaled value at 4 extra fractional digits via the same
+    integer long-division path the groupby uses — no f64 anywhere."""
     if col.dtype.is_decimal128:
-        raise NotImplementedError("DECIMAL128 mean (see groupby rationale)")
+        from spark_rapids_jni_tpu.ops.groupby import _mean128_exact
+
+        total, has_any = sum_(col)  # (2,) int64 limbs, exact
+        cnt = count(col)
+        limbs, overflow = _mean128_exact(
+            total[0:1], total[1:2], cnt.reshape(1))
+        return limbs[0], has_any & ~overflow[0]
     total, has_any = sum_(col)
     denom = jnp.maximum(count(col), 1).astype(jnp.float64)
     m = total.astype(jnp.float64) / denom

@@ -117,11 +117,16 @@ def test_decimal128_join_keys(rng):
     assert got == want
 
 
-def test_decimal128_mean_rejected():
+def test_decimal128_mean_now_supported_smoke():
+    """mean on DECIMAL128 no longer raises — it computes exactly (full
+    oracle coverage in test_decimal128_mean_exact_vs_bigint_oracle)."""
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+
     tbl = Table([Column.from_numpy(np.zeros(4, np.int32)),
                  _col([1, 2, 3, 4])])
-    with pytest.raises(NotImplementedError):
-        groupby_aggregate(tbl, [0], [(1, "mean")])
+    out = groupby_aggregate(tbl, [0], [(1, "mean")]).compact()
+    # (1+2+3+4)/4 = 2.5 -> unscaled 25000 at 4 extra fractional digits
+    assert out.column(1).to_pylist() == [25000]
 
 
 def test_decimal128_minmax_vs_python(rng):
@@ -261,3 +266,53 @@ def test_decimal128_sum_overflow_flagged_not_wrapped():
     res3 = groupby_aggregate(tbl3, [0], [(1, "sum")])
     assert not bool(np.asarray(res3.sum_overflow))
     assert res3.compact().column(1).to_pylist() == [0]
+
+
+def test_decimal128_mean_exact_vs_bigint_oracle():
+    """DECIMAL128 mean is EXACT integer arithmetic: (sum * 10^4) / count
+    with HALF_UP rounding via limb-wise long division — no f64 anywhere
+    (TPU f64 is f32-pair emulated). Output scale widens by 4 fractional
+    digits (Spark avg(decimal) semantics)."""
+    import random
+
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+
+    random.seed(3)
+    n = 300
+    keys = [random.randrange(7) for _ in range(n)]
+    vals = [((-1) ** i) * random.getrandbits(100) for i in range(n)]
+    vals[5] = None
+    tbl = Table([
+        Column.from_pylist(keys, t.INT64),
+        Column.from_pylist(vals, t.decimal128(-2)),
+    ])
+    out = groupby_aggregate(tbl, [0], [(1, "mean")]).compact()
+    assert out.column(1).dtype == t.decimal128(-6)
+
+    def half_up_div(a, b):
+        sign = -1 if a < 0 else 1
+        q, r = divmod(abs(a), b)
+        return sign * (q + (1 if 2 * r >= b else 0))
+
+    for k, m in zip(out.column(0).to_pylist(), out.column(1).to_pylist()):
+        sel = [v for kk, v in zip(keys, vals)
+               if kk == k and v is not None]
+        assert m == half_up_div(sum(sel) * 10_000, len(sel)), k
+
+    # rounding edge: exactly .5 goes away from zero (HALF_UP)
+    tbl2 = Table([
+        Column.from_pylist([1, 1, 2, 2], t.INT64),
+        Column.from_pylist([1, 0, -1, 0], t.decimal128(-4)),
+    ])
+    out2 = groupby_aggregate(tbl2, [0], [(1, "mean")]).compact()
+    assert out2.column(1).to_pylist() == [5000, -5000]
+
+    # widening overflow (sum fits 128 bits, * 10^4 does not): null + flag
+    big = 1 << 126
+    tbl3 = Table([
+        Column.from_pylist([1, 1], t.INT64),
+        Column.from_pylist([big, big - 1], t.decimal128(0)),
+    ])
+    res3 = groupby_aggregate(tbl3, [0], [(1, "mean")])
+    assert bool(np.asarray(res3.sum_overflow))
+    assert not np.asarray(res3.compact().column(1).valid_mask())[0]

@@ -146,9 +146,10 @@ class TestDecimal128OpBoundaries:
         i = Column.from_pylist([1, 2, 3], t.INT64)
         return Table([d, i])
 
-    def test_groupby_supported_and_mean_rejects(self):
+    def test_groupby_supported_including_exact_mean(self):
         # relational support landed in round 3 (tests/test_decimal128_ops.py
-        # is the full oracle suite); only the lossy mean stays rejected
+        # is the full oracle suite); mean became exact integer arithmetic
+        # in round 4
         from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
 
         tbl = self._col()
@@ -156,8 +157,9 @@ class TestDecimal128OpBoundaries:
         assert out.column(0).to_pylist() == [-(1 << 70), 5, 1 << 70]
         out2 = groupby_aggregate(tbl, [1], [(0, "sum"), (0, "min")]).compact()
         assert out2.column(1).to_pylist() == [1 << 70, -(1 << 70), 5]
-        with pytest.raises(NotImplementedError, match="DECIMAL128"):
-            groupby_aggregate(tbl, [1], [(0, "mean")])
+        out3 = groupby_aggregate(tbl, [1], [(0, "mean")]).compact()
+        assert out3.column(1).to_pylist() == [
+            (1 << 70) * 10_000, -(1 << 70) * 10_000, 5 * 10_000]
 
     def test_sort_key_supported(self):
         from spark_rapids_jni_tpu.ops.sort import sort_table

@@ -175,3 +175,24 @@ def test_reduce_uint64_sum_does_not_wrap():
     col = Column.from_numpy(np.array([2**63, 5], np.uint64), t.UINT64)
     s, ok = r.sum_(col)
     assert bool(ok) and int(s) == 2**63 + 5
+
+
+def test_reduce_mean_decimal128_exact():
+    """Reduction-level DECIMAL128 mean rides the same exact integer
+    long-division path as the groupby (4 extra fractional digits)."""
+    import random
+
+    from spark_rapids_jni_tpu.ops import reduce as r
+
+    random.seed(9)
+    vals = [((-1) ** i) * random.getrandbits(100) for i in range(37)]
+    col = Column.from_pylist(vals, t.decimal128(-2))
+    m, ok = r.mean(col)
+    sign = -1 if sum(vals) < 0 else 1
+    q, rem = divmod(abs(sum(vals)) * 10_000, len(vals))
+    want = sign * (q + (1 if 2 * rem >= len(vals) else 0))
+    got = (int(np.asarray(m)[1]) << 64) | (
+        int(np.asarray(m)[0]) & ((1 << 64) - 1))
+    got = got - (1 << 128) if got >= (1 << 127) else got
+    assert got == want
+    assert bool(ok)
