@@ -264,8 +264,6 @@ def _mean128_exact(lo: jnp.ndarray, hi: jnp.ndarray,
         cur = t[i] + add
         t[i] = cur & _U32
         add = cur >> 32
-        if i == 4:
-            break
 
     # long division top -> bottom; r < c <= 2^32 keeps cur inside uint64
     q = [None] * 5

@@ -41,8 +41,12 @@ def sum_(col: Column):
         tq = s1 + (s0 >> 32)
         lo_t = c0 | ((tq & m32) << 32)
         u = s2 + (tq >> 32)
-        hi_t = (u & m32) + ((s3 + (u >> 32)) << 32)
-        return jnp.stack([lo_t, hi_t]), has_any
+        top = s3 + (u >> 32)
+        hi_t = (u & m32) + (top << 32)
+        # totals past signed 128 bits null the result instead of wrapping
+        # (the groupby sum_overflow posture, reference: Spark ANSI)
+        ovf = top != ((top << 32) >> 32)
+        return jnp.stack([lo_t, hi_t]), has_any & ~ovf
     vals, _ = _masked(col, 0)
     kind = col.dtype.storage_dtype.kind
     if kind == "u":

@@ -1,0 +1,178 @@
+"""Window functions over sorted partitions — the cuDF rolling/window
+surface Spark's window expressions lower to (vendored capability family,
+SURVEY.md section 2.2).
+
+TPU-first design: one sort by (partition keys, order keys), per-row
+results computed with the groupby module's scatter-free segmented
+machinery (log-depth segmented scans, cummax boundary tracking — no
+segment_* scatters, which serialize on TPU), then one gather through the
+sort's inverse permutation so every result column aligns with the INPUT
+row order (Spark window semantics: results join back to their rows).
+
+Null order keys sort by the sort module's null rules and otherwise
+behave as values; null partition keys form their own partition (Spark).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import (
+    _rows_equal_prev,
+    _segmented_extremum,
+    _segmented_sum_scan,
+)
+from spark_rapids_jni_tpu.ops.sort import gather, sort_order
+from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+class Window:
+    """Shared precompute for one PARTITION BY / ORDER BY spec: the sort,
+    its inverse, and the partition/peer boundary flags. Build once, call
+    any number of window functions against it."""
+
+    def __init__(
+        self,
+        table: Table,
+        partition_by: Sequence[int],
+        order_by: Sequence[int],
+        ascending: Sequence[bool] | None = None,
+        nulls_first: Sequence[bool] | None = None,
+    ):
+        self._table = table
+        n = table.num_rows
+        self._n = n
+        keys = list(partition_by) + list(order_by)
+        asc = ([True] * len(partition_by) + list(ascending)
+               if ascending is not None else None)
+        nf = ([True] * len(partition_by) + list(nulls_first)
+              if nulls_first is not None else None)
+        self._order = sort_order(table, keys, ascending=asc, nulls_first=nf)
+        self._sorted = gather(table, self._order)
+        # inverse permutation via argsort — a sort, never a scatter
+        self._inv = jnp.argsort(self._order).astype(jnp.int32)
+        # same_p[i]: sorted row i continues row i-1's partition;
+        # same_peer[i]: ... AND has an equal order-key tuple (rank peers)
+        self._same_p = _rows_equal_prev(self._sorted, list(
+            range(len(partition_by))))
+        self._same_peer = _rows_equal_prev(
+            self._sorted, list(range(len(keys))))
+        self._idx = jnp.arange(n, dtype=jnp.int64)
+        # position of each sorted row's partition start (cummax of starts)
+        self._p_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(~self._same_p, self._idx, -1))
+
+    def _unsort(self, sorted_vals: jnp.ndarray) -> jnp.ndarray:
+        return sorted_vals[self._inv]
+
+    def _int_col(self, sorted_vals: jnp.ndarray) -> Column:
+        return Column(DType(TypeId.INT64),
+                      self._unsort(sorted_vals.astype(jnp.int64)), None)
+
+    @func_range("window_row_number")
+    def row_number(self) -> Column:
+        """1-based position within the partition (ROW_NUMBER)."""
+        return self._int_col(self._idx - self._p_start + 1)
+
+    @func_range("window_rank")
+    def rank(self) -> Column:
+        """RANK: 1 + rows strictly before the first peer (gaps on ties)."""
+        first_peer = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(~self._same_peer, self._idx, -1))
+        return self._int_col(first_peer - self._p_start + 1)
+
+    @func_range("window_dense_rank")
+    def dense_rank(self) -> Column:
+        """DENSE_RANK: distinct order-key values seen so far (no gaps)."""
+        new_val = (~self._same_peer).astype(jnp.int64)
+        dr = _segmented_sum_scan(new_val[:, None], ~self._same_p)[:, 0]
+        return self._int_col(dr)
+
+    def _shifted(self, col_idx: int, k: int) -> Column:
+        c = self._sorted.column(col_idx)
+        if c.dtype.is_string:
+            from spark_rapids_jni_tpu.ops import strings as s
+
+            c = s.pad_strings(c)
+        src = jnp.clip(self._idx - k, 0, max(self._n - 1, 0)).astype(
+            jnp.int32)
+        in_bounds = (self._idx - k >= 0) & (self._idx - k < self._n)
+        # same partition iff the partition start did not change
+        same_part = self._p_start[src] == self._p_start
+        ok = in_bounds & same_part
+        validity = c.valid_mask()[src] & ok
+        chars = c.chars[src] if c.is_padded_string else None
+        data = c.data[src]
+        out = Column(c.dtype, self._unsort(data),
+                     self._unsort(validity),
+                     chars=None if chars is None else self._unsort(chars))
+        return out
+
+    @func_range("window_lag")
+    def lag(self, col_idx: int, k: int = 1) -> Column:
+        """Value k rows earlier in the partition, null past the edge."""
+        if k < 0:
+            raise ValueError("lag offset must be >= 0 (use lead)")
+        return self._shifted(col_idx, k)
+
+    @func_range("window_lead")
+    def lead(self, col_idx: int, k: int = 1) -> Column:
+        """Value k rows later in the partition, null past the edge."""
+        if k < 0:
+            raise ValueError("lead offset must be >= 0 (use lag)")
+        return self._shifted(col_idx, -k)
+
+    def _running(self, col_idx: int, op: str) -> Column:
+        c = self._sorted.column(col_idx)
+        if c.dtype.is_string or c.dtype.is_decimal128:
+            raise NotImplementedError(
+                f"running {op} needs fixed-width numeric columns"
+            )
+        valid = c.valid_mask()
+        if op == "sum":
+            from spark_rapids_jni_tpu.ops.groupby import _sum_dtype
+
+            acc_dt = _sum_dtype(c.dtype)
+            zero = jnp.zeros_like(c.data)
+            vv = jnp.where(valid, c.data, zero)
+            if acc_dt.storage_dtype.kind in ("i", "u"):
+                vv = vv.astype(jnp.int64)
+            else:
+                vv = vv.astype(jnp.float64)
+            run = _segmented_sum_scan(vv[:, None], ~self._same_p)[:, 0]
+            # running count of valid values: all-null-so-far stays null
+            cnt = _segmented_sum_scan(
+                valid.astype(jnp.int64)[:, None], ~self._same_p)[:, 0]
+            return Column(acc_dt,
+                          self._unsort(run.astype(acc_dt.jnp_dtype)),
+                          self._unsort(cnt > 0))
+        np_dt = c.dtype.storage_dtype
+        if np_dt.kind == "f":
+            sentinel = jnp.inf if op == "min" else -jnp.inf
+        else:
+            info = np.iinfo(np_dt)
+            sentinel = info.max if op == "min" else info.min
+        vv = jnp.where(valid, c.data, jnp.asarray(sentinel, c.data.dtype))
+        run = _segmented_extremum(vv, ~self._same_p, op)
+        cnt = _segmented_sum_scan(
+            valid.astype(jnp.int64)[:, None], ~self._same_p)[:, 0]
+        return Column(c.dtype, self._unsort(run), self._unsort(cnt > 0))
+
+    @func_range("window_running_sum")
+    def running_sum(self, col_idx: int) -> Column:
+        """SUM over ROWS UNBOUNDED PRECEDING .. CURRENT ROW."""
+        return self._running(col_idx, "sum")
+
+    @func_range("window_running_min")
+    def running_min(self, col_idx: int) -> Column:
+        return self._running(col_idx, "min")
+
+    @func_range("window_running_max")
+    def running_max(self, col_idx: int) -> Column:
+        return self._running(col_idx, "max")

@@ -196,3 +196,19 @@ def test_reduce_mean_decimal128_exact():
     got = got - (1 << 128) if got >= (1 << 127) else got
     assert got == want
     assert bool(ok)
+
+
+def test_reduce_decimal128_sum_overflow_nulls():
+    """Reduction-level DECIMAL128 totals past 128 bits null the result
+    (and its mean) instead of silently wrapping — the groupby posture."""
+    from spark_rapids_jni_tpu.ops import reduce as r
+
+    col = Column.from_pylist([1 << 126] * 3, t.decimal128(0))
+    s, ok = r.sum_(col)
+    assert not bool(ok)
+    m, ok2 = r.mean(col)
+    assert not bool(ok2)
+    # in-range totals stay valid and exact
+    col2 = Column.from_pylist([1 << 100, -(1 << 99)], t.decimal128(0))
+    s2, ok_s = r.sum_(col2)
+    assert bool(ok_s)

@@ -1,0 +1,119 @@
+"""Window functions vs a hand-rolled host oracle (the cuDF rolling/
+window surface Spark window expressions lower to)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.window import Window
+
+
+def _oracle(part, order, vals, vvalid):
+    n = len(part)
+    rows = sorted(range(n), key=lambda i: (part[i], order[i], i))
+    out = {k: {} for k in ("rn", "rk", "dr", "sum", "mn", "mx", "lag",
+                           "lead")}
+    state = {}
+    for i in rows:
+        st = state.setdefault(part[i], dict(
+            cnt=0, last=None, rank=0, dense=0, sum=0, any=False,
+            mn=None, mx=None, seq=[]))
+        st["cnt"] += 1
+        if st["last"] != order[i]:
+            st["rank"], st["dense"] = st["cnt"], st["dense"] + 1
+            st["last"] = order[i]
+        out["rn"][i], out["rk"][i], out["dr"][i] = (
+            st["cnt"], st["rank"], st["dense"])
+        if vvalid[i]:
+            st["sum"] += int(vals[i]); st["any"] = True
+            st["mn"] = (int(vals[i]) if st["mn"] is None
+                        else min(st["mn"], int(vals[i])))
+            st["mx"] = (int(vals[i]) if st["mx"] is None
+                        else max(st["mx"], int(vals[i])))
+        out["sum"][i] = st["sum"] if st["any"] else None
+        out["mn"][i], out["mx"][i] = st["mn"], st["mx"]
+        st["seq"].append(i)
+    for p, st in state.items():
+        seq = st["seq"]
+        for j, i in enumerate(seq):
+            pv = seq[j - 1] if j else None
+            nx = seq[j + 1] if j + 1 < len(seq) else None
+            out["lag"][i] = (int(vals[pv]) if pv is not None
+                             and vvalid[pv] else None)
+            out["lead"][i] = (int(vals[nx]) if nx is not None
+                              and vvalid[nx] else None)
+    return out
+
+
+def test_window_functions_vs_oracle(rng):
+    n = 257
+    part = rng.integers(0, 9, n).astype(np.int64)
+    order = rng.integers(0, 12, n).astype(np.int32)
+    vals = rng.integers(-50, 50, n).astype(np.int64)
+    vvalid = rng.random(n) > 0.15
+    tbl = Table([
+        Column.from_numpy(part),
+        Column.from_numpy(order),
+        Column.from_numpy(vals, validity=vvalid),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    want = _oracle(part, order, vals, vvalid)
+    got = {
+        "rn": w.row_number().to_pylist(),
+        "rk": w.rank().to_pylist(),
+        "dr": w.dense_rank().to_pylist(),
+        "sum": w.running_sum(2).to_pylist(),
+        "mn": w.running_min(2).to_pylist(),
+        "mx": w.running_max(2).to_pylist(),
+        "lag": w.lag(2, 1).to_pylist(),
+        "lead": w.lead(2, 1).to_pylist(),
+    }
+    for k, col in got.items():
+        for i in range(n):
+            assert col[i] == want[k][i], (k, i, col[i], want[k][i])
+
+
+def test_window_string_lag_and_float_running_sum(rng):
+    part = [1, 1, 1, 2, 2]
+    order = [1, 2, 3, 1, 2]
+    names = ["a", None, "ccc", "dd", "e"]
+    f = [0.5, 1.25, None, 2.0, 3.0]
+    tbl = Table([
+        Column.from_pylist(part, t.INT64),
+        Column.from_pylist(order, t.INT32),
+        Column.from_pylist(names, t.STRING),
+        Column.from_pylist(f, t.FLOAT64),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    assert w.lag(2, 1).to_pylist() == [None, "a", None, None, "dd"]
+    assert w.lead(2, 1).to_pylist() == [None, "ccc", None, "e", None]
+    assert w.running_sum(3).to_pylist() == [0.5, 1.75, 1.75, 2.0, 5.0]
+
+
+def test_window_desc_order_and_lag2():
+    part = [1] * 4
+    order = [10, 20, 30, 40]
+    v = [1, 2, 3, 4]
+    tbl = Table([
+        Column.from_pylist(part, t.INT64),
+        Column.from_pylist(order, t.INT32),
+        Column.from_pylist(v, t.INT64),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1], ascending=[False])
+    # descending order: row_number 1 belongs to order=40
+    assert w.row_number().to_pylist() == [4, 3, 2, 1]
+    assert w.lag(2, 2).to_pylist() == [3, 4, None, None]
+
+
+def test_window_null_partition_forms_own_group():
+    part = [1, None, 1, None]
+    order = [1, 1, 2, 2]
+    v = [10, 20, 30, 40]
+    tbl = Table([
+        Column.from_pylist(part, t.INT64),
+        Column.from_pylist(order, t.INT32),
+        Column.from_pylist(v, t.INT64),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    assert w.running_sum(2).to_pylist() == [10, 20, 40, 60]
