@@ -29,7 +29,7 @@ from spark_rapids_jni_tpu.types import DType, TypeId, decimal128
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 SUPPORTED_AGGS = ("sum", "count", "min", "max", "mean", "var", "std",
-                  "nunique")
+                  "nunique", "first", "last")
 
 
 class GroupByResult(NamedTuple):
@@ -683,6 +683,54 @@ def groupby_aggregate(
             out_cols.append(
                 Column(acc_dt, cnt, garange < num_groups)
             )
+            continue
+        if op in ("first", "last"):
+            # index of the first/last VALID row per group via a segmented
+            # first-valid scan over row indices (one mechanism for every
+            # dtype — the winning row is gathered afterwards). Rows are
+            # key-sorted STABLY, so "first" is first in input order
+            # within the group (Spark first/last with ignoreNulls=True).
+            if n:
+                row_idx = jnp.arange(n, dtype=jnp.int64)
+                cand = jnp.where(valid, row_idx, jnp.int64(-1))
+
+                if op == "first":
+                    def combine(a, b):
+                        av, af = a
+                        bv, bf = b
+                        return jnp.where(
+                            bf, bv, jnp.where(av >= 0, av, bv)), af | bf
+                else:
+                    def combine(a, b):
+                        av, af = a
+                        bv, bf = b
+                        return jnp.where(
+                            bf, bv, jnp.where(bv >= 0, bv, av)), af | bf
+
+                run, _ = jax.lax.associative_scan(combine, (cand, ~same))
+                win = run[jnp.clip(g_hi - 1, 0, n - 1)]
+                has = (win >= 0) & (g_hi > g_lo)
+                row = jnp.clip(win, 0, n - 1).astype(jnp.int32)
+            else:
+                has = jnp.zeros((m,), jnp.bool_)
+                row = jnp.zeros((m,), jnp.int32)
+            if c.dtype.is_string:
+                from spark_rapids_jni_tpu.ops import strings as s
+
+                if n:
+                    g = s.gather_strings(c, row)
+                    out_cols.append(Column(c.dtype, g.data, has,
+                                           chars=g.chars))
+                else:
+                    out_cols.append(Column(
+                        c.dtype, jnp.zeros((m,), jnp.int32), has,
+                        chars=jnp.zeros((m, 1), jnp.uint8)))
+            elif n:
+                out_cols.append(Column(c.dtype, c.data[row], has))
+            else:
+                shape = (m, 2) if c.dtype.is_decimal128 else (m,)
+                out_cols.append(Column(
+                    c.dtype, jnp.zeros(shape, c.data.dtype), has))
             continue
         # min / max with null-neutral sentinels
         if c.dtype.is_string or c.dtype.is_decimal128:

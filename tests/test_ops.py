@@ -657,7 +657,7 @@ def test_groupby_and_q1_compile_scatter_free():
             tb, [0],
             [(1, "sum"), (1, "mean"), (1, "var"), (1, "std"), (2, "min"),
              (2, "max"), (2, "nunique"), (1, "count"), (3, "min"),
-             (3, "max")])
+             (3, "max"), (1, "first"), (3, "last")])
         out = jnp.float64(0)
         for c in r.table.columns:
             out = out + jnp.sum(c.data).astype(jnp.float64)
@@ -714,3 +714,40 @@ def test_empty_table_groupby_every_agg():
     assert int(res.num_groups) == 0
     for c in res.table.columns:
         assert not np.asarray(c.valid_mask()).any()
+
+
+def test_groupby_first_last_vs_oracle(rng):
+    """first/last (ignoreNulls semantics) across int, string, and
+    DECIMAL128 columns — input order within each group is preserved by
+    the stable key sort."""
+    n = 500
+    keys = [int(v) for v in rng.integers(0, 11, n)]
+    ints = [int(v) if rng.random() > 0.3 else None
+            for v in rng.integers(-99, 99, n)]
+    strs = [f"s{v}" if rng.random() > 0.3 else None
+            for v in rng.integers(0, 50, n)]
+    wide = [((1 << 80) + int(v)) if rng.random() > 0.3 else None
+            for v in rng.integers(0, 1000, n)]
+    tbl = Table([
+        Column.from_pylist(keys, t.INT64),
+        Column.from_pylist(ints, t.INT32),
+        Column.from_pylist(strs, t.STRING),
+        Column.from_pylist(wide, t.decimal128(0)),
+    ])
+    res = groupby_aggregate(
+        tbl, [0],
+        [(1, "first"), (1, "last"), (2, "first"), (2, "last"),
+         (3, "first"), (3, "last")])
+    out = res.compact()
+    gk = out.column(0).to_pylist()
+    for i, k in enumerate(gk):
+        for col_idx, vals, out_first, out_last in (
+                (1, ints, 1, 2), (2, strs, 3, 4), (3, wide, 5, 6)):
+            seq = [v for kk, v in zip(keys, vals)
+                   if kk == k and v is not None]
+            want_first = seq[0] if seq else None
+            want_last = seq[-1] if seq else None
+            assert out.column(out_first).to_pylist()[i] == want_first, (
+                k, col_idx, "first")
+            assert out.column(out_last).to_pylist()[i] == want_last, (
+                k, col_idx, "last")
