@@ -29,7 +29,8 @@ from spark_rapids_jni_tpu.types import DType, TypeId, decimal128
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 SUPPORTED_AGGS = ("sum", "count", "min", "max", "mean", "var", "std",
-                  "nunique", "first", "last")
+                  "nunique", "first", "last", "first_include_nulls",
+                  "last_include_nulls")
 
 
 class GroupByResult(NamedTuple):
@@ -684,13 +685,31 @@ def groupby_aggregate(
                 Column(acc_dt, cnt, garange < num_groups)
             )
             continue
-        if op in ("first", "last"):
-            # index of the first/last VALID row per group via a segmented
-            # first-valid scan over row indices (one mechanism for every
-            # dtype — the winning row is gathered afterwards). Rows are
-            # key-sorted STABLY, so "first" is first in input order
-            # within the group (Spark first/last with ignoreNulls=True).
-            if n:
+        if op in ("first", "last", "first_include_nulls",
+                  "last_include_nulls"):
+            # "first"/"last" skip nulls (Spark First/Last with
+            # ignoreNulls=true): a segmented first-valid scan over row
+            # indices finds the winning row — one mechanism for every
+            # dtype, gathered afterwards. The *_include_nulls variants
+            # (Spark's DEFAULT ignoreNulls=false) are simply the group's
+            # first/last ROW: g_lo / g_hi - 1, no scan at all. Rows are
+            # key-sorted STABLY, so order within a group is input order.
+            if op.endswith("_include_nulls"):
+                if op.startswith("first"):
+                    win = jnp.where(g_hi > g_lo, g_lo.astype(jnp.int64),
+                                    jnp.int64(-1))
+                else:
+                    win = jnp.where(g_hi > g_lo,
+                                    (g_hi - 1).astype(jnp.int64),
+                                    jnp.int64(-1))
+                has = (win >= 0)
+                if n:
+                    row = jnp.clip(win, 0, n - 1).astype(jnp.int32)
+                    has = has & valid[row]
+                else:
+                    row = jnp.zeros((m,), jnp.int32)
+                    has = jnp.zeros((m,), jnp.bool_)
+            elif n:
                 row_idx = jnp.arange(n, dtype=jnp.int64)
                 cand = jnp.where(valid, row_idx, jnp.int64(-1))
 

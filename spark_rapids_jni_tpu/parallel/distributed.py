@@ -299,6 +299,85 @@ def collect(table: Table, num_rows_per_device: jnp.ndarray, mesh: Mesh) -> Table
     return Table(out)
 
 
+class DistributedWindow(NamedTuple):
+    table: Table             # shuffled input rows (padded), sharded
+    results: Table           # one column per requested window spec,
+                             # aligned row-for-row with ``table``
+    row_valid: jnp.ndarray   # bool[D*capacity]: slot holds a real row
+    overflowed: jnp.ndarray  # bool[D] shuffle capacity overflow
+
+
+@func_range("distributed_window")
+def distributed_window(
+    table: Table,
+    partition_by: Sequence[int],
+    order_by: Sequence[int],
+    specs: Sequence,
+    mesh: Mesh,
+    row_valid: jnp.ndarray,
+    capacity: Optional[int] = None,
+) -> DistributedWindow:
+    """Global window functions: shuffle rows by partition-key hash so each
+    device owns whole partitions, then evaluate partition-local windows —
+    window functions are partition-local once partitions are co-located,
+    exactly the distributed groupby argument.
+
+    ``specs``: window requests as static tuples —
+    ``("row_number",)``, ``("rank",)``, ``("dense_rank",)``,
+    ``("lag", col_idx, k)``, ``("lead", col_idx, k)``,
+    ``("running_sum", col_idx)``, ``("running_min", col_idx)``,
+    ``("running_max", col_idx)``. Results come back sharded, aligned to
+    the shuffled rows; filter output by the returned ``row_valid``.
+
+    ``row_valid`` is REQUIRED (use ``shard_table(...,
+    return_row_valid=True)``): unlike aggregates, window functions give
+    null-key rows real results, so a padding row mistaken for a real row
+    would pollute the genuine null-key partition — an all-ones default
+    would hide exactly that hazard. Phantom shuffle slots are kept out of
+    every real partition by an occupancy pseudo-key."""
+    from spark_rapids_jni_tpu.ops.window import Window
+
+    pkeys = list(partition_by)
+    okeys = list(order_by)
+    specs = [tuple(s) for s in specs]
+
+    def step(local: Table, lrv):
+        sh = hash_shuffle(local, pkeys, EXEC_AXIS, capacity=capacity,
+                          row_valid=lrv)
+        from spark_rapids_jni_tpu import types as t_
+
+        # phantom slots must not join the (real) null-key partition:
+        # a leading occupancy pseudo-key banishes them to their own
+        # trailing partition
+        occ = Column(t_.INT8,
+                     jnp.where(sh.row_valid, jnp.int8(0), jnp.int8(1)),
+                     None)
+        wtbl = Table([occ] + list(sh.table.columns))
+        w = Window(wtbl, partition_by=[0] + [k + 1 for k in pkeys],
+                   order_by=[k + 1 for k in okeys])
+        out_cols = []
+        for spec in specs:
+            kind = spec[0]
+            if kind in ("row_number", "rank", "dense_rank"):
+                out_cols.append(getattr(w, kind)())
+            elif kind in ("lag", "lead"):
+                out_cols.append(getattr(w, kind)(spec[1] + 1, spec[2]))
+            elif kind in ("running_sum", "running_min", "running_max"):
+                out_cols.append(getattr(w, kind)(spec[1] + 1))
+            else:
+                raise ValueError(f"unknown window spec {spec!r}")
+        return (sh.table, Table(out_cols), sh.row_valid,
+                sh.overflowed.reshape(1))
+
+    out_tbl, results, rv, ovf = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+        out_specs=(P(EXEC_AXIS),) * 4,
+    )(table, row_valid)
+    return DistributedWindow(out_tbl, results, rv, ovf)
+
+
 class DistributedJoin(NamedTuple):
     table: Table             # per-device joined rows (padded), sharded
     total: jnp.ndarray       # int64[D] true match count per device

@@ -751,3 +751,23 @@ def test_groupby_first_last_vs_oracle(rng):
                 k, col_idx, "first")
             assert out.column(out_last).to_pylist()[i] == want_last, (
                 k, col_idx, "last")
+
+
+def test_groupby_first_last_include_nulls():
+    """*_include_nulls = Spark's DEFAULT First/Last (ignoreNulls=false):
+    the group's first/last ROW, null result when that row's value is
+    null."""
+    keys = [1, 1, 2, 2]
+    vals = [None, 5, 7, None]
+    tbl = Table([
+        Column.from_pylist(keys, t.INT64),
+        Column.from_pylist(vals, t.INT64),
+    ])
+    out = groupby_aggregate(
+        tbl, [0],
+        [(1, "first_include_nulls"), (1, "last_include_nulls"),
+         (1, "first"), (1, "last")]).compact()
+    assert out.column(1).to_pylist() == [None, 7]   # first row as-is
+    assert out.column(2).to_pylist() == [5, None]   # last row as-is
+    assert out.column(3).to_pylist() == [5, 7]      # first non-null
+    assert out.column(4).to_pylist() == [5, 7]      # last non-null

@@ -117,3 +117,55 @@ def test_window_null_partition_forms_own_group():
     ])
     w = Window(tbl, partition_by=[0], order_by=[1])
     assert w.running_sum(2).to_pylist() == [10, 20, 40, 60]
+
+
+@pytest.mark.slow
+def test_distributed_window_matches_local(rng):
+    """Window results over the 8-device mesh (whole partitions
+    co-located by the shuffle) match the single-device Window."""
+    from spark_rapids_jni_tpu.parallel import executor_mesh, shard_table
+    from spark_rapids_jni_tpu.parallel.distributed import distributed_window
+
+    mesh = executor_mesh(8)
+    n = 250  # forces shard padding
+    part = rng.integers(0, 13, n).astype(np.int64)
+    order = rng.integers(0, 9, n).astype(np.int32)
+    vals = rng.integers(-50, 50, n).astype(np.int64)
+    tbl = Table([
+        Column.from_numpy(part),
+        Column.from_numpy(order),
+        Column.from_numpy(vals),
+    ])
+    sharded, rv = shard_table(tbl, mesh, return_row_valid=True)
+    specs = [("row_number",), ("rank",), ("running_sum", 2),
+             ("lag", 2, 1)]
+    dw = distributed_window(sharded, [0], [1], specs, mesh, rv,
+                            capacity=n)
+    assert not np.asarray(dw.overflowed).any()
+
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    local = {
+        ("row_number",): w.row_number().to_pylist(),
+        ("rank",): w.rank().to_pylist(),
+        ("running_sum", 2): w.running_sum(2).to_pylist(),
+        ("lag", 2, 1): w.lag(2, 1).to_pylist(),
+    }
+    # identify rows by (part, order, val) — make rows unique first
+    rv_np = np.asarray(dw.row_valid)
+    keys_got = list(zip(
+        np.asarray(dw.table.column(0).data)[rv_np],
+        np.asarray(dw.table.column(1).data)[rv_np],
+        np.asarray(dw.table.column(2).data)[rv_np],
+    ))
+    # multiset comparison per window spec: bucket by full row identity
+    import collections
+
+    for si, spec in enumerate(specs):
+        got_col = dw.results.column(si).to_pylist()
+        got = collections.Counter(
+            (k, got_col[i])
+            for k, i in zip(keys_got, np.flatnonzero(rv_np)))
+        want = collections.Counter(
+            ((part[i], order[i], vals[i]), local[spec][i])
+            for i in range(n))
+        assert got == want, spec
