@@ -67,6 +67,15 @@ class Window:
         # position of each sorted row's partition start (cummax of starts)
         self._p_start = jax.lax.associative_scan(
             jnp.maximum, jnp.where(~self._same_p, self._idx, -1))
+        # ...and its partition end: the next start minus one (reverse
+        # cummin of start positions, exclusive)
+        start_pos = jnp.where(~self._same_p, self._idx, n)
+        nxt = jnp.flip(jax.lax.associative_scan(
+            jnp.minimum, jnp.flip(start_pos)))
+        # nxt[i] = earliest start at or after i; shift to get "after i"
+        nxt_after = jnp.concatenate(
+            [nxt[1:], jnp.full((1,), n, dtype=nxt.dtype)]) if n else nxt
+        self._p_end = nxt_after - 1
 
     def _unsort(self, sorted_vals: jnp.ndarray) -> jnp.ndarray:
         return sorted_vals[self._inv]
@@ -163,6 +172,74 @@ class Window:
         cnt = _segmented_sum_scan(
             valid.astype(jnp.int64)[:, None], ~self._same_p)[:, 0]
         return Column(c.dtype, self._unsort(run), self._unsort(cnt > 0))
+
+    def _rolling_parts(self, col_idx: int, preceding: int, following: int):
+        """Shared rolling-frame machinery: per-row frame sums and counts
+        over ROWS BETWEEN preceding PRECEDING AND following FOLLOWING,
+        clamped to the partition — prefix differences of the SEGMENTED
+        running sum (resets each partition, so int lanes are exact and
+        float error stays partition-local)."""
+        if preceding < 0 or following < 0:
+            raise ValueError("rolling bounds must be >= 0")
+        c = self._sorted.column(col_idx)
+        if c.dtype.is_string or c.dtype.is_decimal128:
+            raise NotImplementedError(
+                "rolling aggregates need fixed-width numeric columns")
+        valid = c.valid_mask()
+        vv = jnp.where(valid, c.data, jnp.zeros_like(c.data))
+        if c.dtype.storage_dtype.kind in ("i", "u", "b"):
+            vv = vv.astype(jnp.int64)
+        else:
+            vv = vv.astype(jnp.float64)
+        n = self._n
+        run = _segmented_sum_scan(vv[:, None], ~self._same_p)[:, 0]
+        cnt = _segmented_sum_scan(
+            valid.astype(jnp.int64)[:, None], ~self._same_p)[:, 0]
+        lo = jnp.clip(self._idx - preceding, self._p_start, self._p_end)
+        hi = jnp.clip(self._idx + following, self._p_start, self._p_end)
+        safe = lambda a, i: a[jnp.clip(i, 0, max(n - 1, 0))]
+
+        def frame(arr):
+            upper = safe(arr, hi)
+            base = jnp.where(lo > self._p_start, safe(arr, lo - 1), 0)
+            return upper - base
+
+        return c, frame(run), frame(cnt)
+
+    @func_range("window_rolling_sum")
+    def rolling_sum(self, col_idx: int, preceding: int,
+                    following: int = 0) -> Column:
+        """SUM over ROWS BETWEEN preceding PRECEDING AND following
+        FOLLOWING (the cuDF rolling-window op). Exact for int/decimal
+        lanes; float frames difference partition-local running sums
+        (documented float-rounding posture)."""
+        from spark_rapids_jni_tpu.ops.groupby import _sum_dtype
+
+        c, wsum, wcnt = self._rolling_parts(col_idx, preceding, following)
+        acc_dt = _sum_dtype(c.dtype)
+        return Column(acc_dt,
+                      self._unsort(wsum.astype(acc_dt.jnp_dtype)),
+                      self._unsort(wcnt > 0))
+
+    @func_range("window_rolling_count")
+    def rolling_count(self, col_idx: int, preceding: int,
+                      following: int = 0) -> Column:
+        """COUNT of non-null values in the rolling frame."""
+        _, _, wcnt = self._rolling_parts(col_idx, preceding, following)
+        return Column(DType(TypeId.INT64), self._unsort(wcnt), None)
+
+    @func_range("window_rolling_mean")
+    def rolling_mean(self, col_idx: int, preceding: int,
+                     following: int = 0) -> Column:
+        """AVG over the rolling frame (FLOAT64, decimal-rescaled like the
+        groupby mean contract)."""
+        c, wsum, wcnt = self._rolling_parts(col_idx, preceding, following)
+        denom = jnp.maximum(wcnt, 1).astype(jnp.float64)
+        m = wsum.astype(jnp.float64) / denom
+        if c.dtype.is_decimal:
+            m = m * (10.0 ** c.dtype.scale)
+        return Column(DType(TypeId.FLOAT64), self._unsort(m),
+                      self._unsort(wcnt > 0))
 
     @func_range("window_running_sum")
     def running_sum(self, col_idx: int) -> Column:

@@ -169,3 +169,41 @@ def test_distributed_window_matches_local(rng):
             ((part[i], order[i], vals[i]), local[spec][i])
             for i in range(n))
         assert got == want, spec
+
+
+def test_rolling_frames_vs_oracle(rng):
+    """ROWS BETWEEN p PRECEDING AND f FOLLOWING, clamped to the
+    partition, vs a brute-force oracle (incl. nulls)."""
+    n = 200
+    part = rng.integers(0, 7, n).astype(np.int64)
+    order = rng.integers(0, 50, n).astype(np.int32)
+    vals = rng.integers(-30, 30, n).astype(np.int64)
+    vvalid = rng.random(n) > 0.2
+    tbl = Table([
+        Column.from_numpy(part),
+        Column.from_numpy(order),
+        Column.from_numpy(vals, validity=vvalid),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    for p, f in ((2, 0), (0, 2), (3, 1), (0, 0)):
+        got_sum = w.rolling_sum(2, p, f).to_pylist()
+        got_cnt = w.rolling_count(2, p, f).to_pylist()
+        got_mean = w.rolling_mean(2, p, f).to_pylist()
+        # oracle: per partition in (order, input) order
+        rows = sorted(range(n), key=lambda i: (part[i], order[i], i))
+        pos_in = {i: j for j, i in enumerate(rows)}
+        by_part = {}
+        for i in rows:
+            by_part.setdefault(part[i], []).append(i)
+        for pid, seq in by_part.items():
+            for j, i in enumerate(seq):
+                frame = seq[max(j - p, 0): j + f + 1]
+                sel = [int(vals[r]) for r in frame if vvalid[r]]
+                assert got_cnt[i] == len(sel), (p, f, i)
+                if sel:
+                    assert got_sum[i] == sum(sel), (p, f, i)
+                    assert got_mean[i] == pytest.approx(
+                        sum(sel) / len(sel)), (p, f, i)
+                else:
+                    assert got_sum[i] is None
+                    assert got_mean[i] is None
