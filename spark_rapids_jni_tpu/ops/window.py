@@ -67,15 +67,30 @@ class Window:
         # position of each sorted row's partition start (cummax of starts)
         self._p_start = jax.lax.associative_scan(
             jnp.maximum, jnp.where(~self._same_p, self._idx, -1))
-        # ...and its partition end: the next start minus one (reverse
-        # cummin of start positions, exclusive)
-        start_pos = jnp.where(~self._same_p, self._idx, n)
+        self._p_end = self._segment_end(self._same_p)
+        self._peer_end_cache: jnp.ndarray | None = None
+
+    def _segment_end(self, same_prev: jnp.ndarray) -> jnp.ndarray:
+        """Sorted position of the last row of each row's segment, where a
+        segment starts wherever ``same_prev`` is False: reverse cummin of
+        start positions, shifted to 'earliest start strictly after i',
+        minus one."""
+        n = self._n
+        start_pos = jnp.where(~same_prev, self._idx, n)
         nxt = jnp.flip(jax.lax.associative_scan(
             jnp.minimum, jnp.flip(start_pos)))
-        # nxt[i] = earliest start at or after i; shift to get "after i"
         nxt_after = jnp.concatenate(
             [nxt[1:], jnp.full((1,), n, dtype=nxt.dtype)]) if n else nxt
-        self._p_end = nxt_after - 1
+        return nxt_after - 1
+
+    @property
+    def _peer_end(self) -> jnp.ndarray:
+        """Sorted position of the last row in each row's peer group (same
+        partition AND equal order keys) — the frame end of Spark's default
+        RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW window."""
+        if self._peer_end_cache is None:
+            self._peer_end_cache = self._segment_end(self._same_peer)
+        return self._peer_end_cache
 
     def _unsort(self, sorted_vals: jnp.ndarray) -> jnp.ndarray:
         return sorted_vals[self._inv]
@@ -89,12 +104,16 @@ class Window:
         """1-based position within the partition (ROW_NUMBER)."""
         return self._int_col(self._idx - self._p_start + 1)
 
+    def _first_peer(self) -> jnp.ndarray:
+        """Sorted position of the first row of each row's peer group
+        (cummax of peer-group starts)."""
+        return jax.lax.associative_scan(
+            jnp.maximum, jnp.where(~self._same_peer, self._idx, -1))
+
     @func_range("window_rank")
     def rank(self) -> Column:
         """RANK: 1 + rows strictly before the first peer (gaps on ties)."""
-        first_peer = jax.lax.associative_scan(
-            jnp.maximum, jnp.where(~self._same_peer, self._idx, -1))
-        return self._int_col(first_peer - self._p_start + 1)
+        return self._int_col(self._first_peer() - self._p_start + 1)
 
     @func_range("window_dense_rank")
     def dense_rank(self) -> Column:
@@ -104,24 +123,13 @@ class Window:
         return self._int_col(dr)
 
     def _shifted(self, col_idx: int, k: int) -> Column:
-        c = self._sorted.column(col_idx)
-        if c.dtype.is_string:
-            from spark_rapids_jni_tpu.ops import strings as s
-
-            c = s.pad_strings(c)
-        src = jnp.clip(self._idx - k, 0, max(self._n - 1, 0)).astype(
-            jnp.int32)
-        in_bounds = (self._idx - k >= 0) & (self._idx - k < self._n)
+        pos = self._idx - k
+        src = jnp.clip(pos, 0, max(self._n - 1, 0)).astype(jnp.int32)
+        in_bounds = (pos >= 0) & (pos < self._n)
         # same partition iff the partition start did not change
         same_part = self._p_start[src] == self._p_start
-        ok = in_bounds & same_part
-        validity = c.valid_mask()[src] & ok
-        chars = c.chars[src] if c.is_padded_string else None
-        data = c.data[src]
-        out = Column(c.dtype, self._unsort(data),
-                     self._unsort(validity),
-                     chars=None if chars is None else self._unsort(chars))
-        return out
+        return self._gather_at(self._sorted.column(col_idx), pos,
+                               in_bounds & same_part)
 
     @func_range("window_lag")
     def lag(self, col_idx: int, k: int = 1) -> Column:
@@ -136,6 +144,14 @@ class Window:
         if k < 0:
             raise ValueError("lead offset must be >= 0 (use lag)")
         return self._shifted(col_idx, -k)
+
+    @staticmethod
+    def _sentinel(np_dt, op: str):
+        """Neutral element for min/max over possibly-null values."""
+        if np_dt.kind == "f":
+            return jnp.inf if op == "min" else -jnp.inf
+        info = np.iinfo(np_dt)
+        return info.max if op == "min" else info.min
 
     def _running(self, col_idx: int, op: str) -> Column:
         c = self._sorted.column(col_idx)
@@ -161,17 +177,38 @@ class Window:
             return Column(acc_dt,
                           self._unsort(run.astype(acc_dt.jnp_dtype)),
                           self._unsort(cnt > 0))
-        np_dt = c.dtype.storage_dtype
-        if np_dt.kind == "f":
-            sentinel = jnp.inf if op == "min" else -jnp.inf
-        else:
-            info = np.iinfo(np_dt)
-            sentinel = info.max if op == "min" else info.min
+        sentinel = self._sentinel(c.dtype.storage_dtype, op)
         vv = jnp.where(valid, c.data, jnp.asarray(sentinel, c.data.dtype))
         run = _segmented_extremum(vv, ~self._same_p, op)
         cnt = _segmented_sum_scan(
             valid.astype(jnp.int64)[:, None], ~self._same_p)[:, 0]
         return Column(c.dtype, self._unsort(run), self._unsort(cnt > 0))
+
+    def _frame_bounds(self, preceding: int, following: int):
+        """Sorted-position [lo, hi] of each row's ROWS frame, clamped to
+        its partition."""
+        if preceding < 0 or following < 0:
+            raise ValueError("rolling bounds must be >= 0")
+        lo = jnp.clip(self._idx - preceding, self._p_start, self._p_end)
+        hi = jnp.clip(self._idx + following, self._p_start, self._p_end)
+        return lo, hi
+
+    def _frame_diff(self, running: jnp.ndarray, lo: jnp.ndarray,
+                    hi: jnp.ndarray) -> jnp.ndarray:
+        """Per-frame total of a segmented running sum via prefix
+        differences (the base at lo-1 is zero at a partition start, so
+        cross-partition terms never enter)."""
+        n = self._n
+        safe = lambda a, i: a[jnp.clip(i, 0, max(n - 1, 0))]
+        upper = safe(running, hi)
+        base = jnp.where(lo > self._p_start, safe(running, lo - 1), 0)
+        return upper - base
+
+    def _frame_valid_count(self, valid: jnp.ndarray, lo: jnp.ndarray,
+                           hi: jnp.ndarray) -> jnp.ndarray:
+        cnt = _segmented_sum_scan(
+            valid.astype(jnp.int64)[:, None], ~self._same_p)[:, 0]
+        return self._frame_diff(cnt, lo, hi)
 
     def _rolling_parts(self, col_idx: int, preceding: int, following: int):
         """Shared rolling-frame machinery: per-row frame sums and counts
@@ -179,8 +216,7 @@ class Window:
         clamped to the partition — prefix differences of the SEGMENTED
         running sum (resets each partition, so int lanes are exact and
         float error stays partition-local)."""
-        if preceding < 0 or following < 0:
-            raise ValueError("rolling bounds must be >= 0")
+        lo, hi = self._frame_bounds(preceding, following)
         c = self._sorted.column(col_idx)
         if c.dtype.is_string or c.dtype.is_decimal128:
             raise NotImplementedError(
@@ -191,20 +227,9 @@ class Window:
             vv = vv.astype(jnp.int64)
         else:
             vv = vv.astype(jnp.float64)
-        n = self._n
         run = _segmented_sum_scan(vv[:, None], ~self._same_p)[:, 0]
-        cnt = _segmented_sum_scan(
-            valid.astype(jnp.int64)[:, None], ~self._same_p)[:, 0]
-        lo = jnp.clip(self._idx - preceding, self._p_start, self._p_end)
-        hi = jnp.clip(self._idx + following, self._p_start, self._p_end)
-        safe = lambda a, i: a[jnp.clip(i, 0, max(n - 1, 0))]
-
-        def frame(arr):
-            upper = safe(arr, hi)
-            base = jnp.where(lo > self._p_start, safe(arr, lo - 1), 0)
-            return upper - base
-
-        return c, frame(run), frame(cnt)
+        return (c, self._frame_diff(run, lo, hi),
+                self._frame_valid_count(valid, lo, hi))
 
     @func_range("window_rolling_sum")
     def rolling_sum(self, col_idx: int, preceding: int,
@@ -224,8 +249,11 @@ class Window:
     @func_range("window_rolling_count")
     def rolling_count(self, col_idx: int, preceding: int,
                       following: int = 0) -> Column:
-        """COUNT of non-null values in the rolling frame."""
-        _, _, wcnt = self._rolling_parts(col_idx, preceding, following)
+        """COUNT of non-null values in the rolling frame — needs only the
+        validity mask, so every dtype (strings, DECIMAL128) is counted."""
+        lo, hi = self._frame_bounds(preceding, following)
+        valid = self._sorted.column(col_idx).valid_mask()
+        wcnt = self._frame_valid_count(valid, lo, hi)
         return Column(DType(TypeId.INT64), self._unsort(wcnt), None)
 
     @func_range("window_rolling_mean")
@@ -240,6 +268,145 @@ class Window:
             m = m * (10.0 ** c.dtype.scale)
         return Column(DType(TypeId.FLOAT64), self._unsort(m),
                       self._unsort(wcnt > 0))
+
+    @func_range("window_rolling_min")
+    def rolling_min(self, col_idx: int, preceding: int,
+                    following: int = 0) -> Column:
+        """MIN over the ROWS frame — sparse-table range-minimum (doubling
+        levels at power-of-two strides, two overlapping block gathers per
+        row), O(n log w) with zero scatters; a sliding extremum has no
+        prefix-difference form the way sums do."""
+        return self._rolling_extremum(col_idx, preceding, following, "min")
+
+    @func_range("window_rolling_max")
+    def rolling_max(self, col_idx: int, preceding: int,
+                    following: int = 0) -> Column:
+        """MAX over the ROWS frame (see rolling_min for the design)."""
+        return self._rolling_extremum(col_idx, preceding, following, "max")
+
+    def _rolling_extremum(self, col_idx: int, preceding: int,
+                          following: int, op: str) -> Column:
+        lo, hi = self._frame_bounds(preceding, following)
+        c = self._sorted.column(col_idx)
+        if c.dtype.is_string or c.dtype.is_decimal128:
+            raise NotImplementedError(
+                "rolling min/max needs fixed-width numeric columns")
+        n = self._n
+        valid = c.valid_mask()
+        sentinel = self._sentinel(c.dtype.storage_dtype, op)
+        vv = jnp.where(valid, c.data, jnp.asarray(sentinel, c.data.dtype))
+        pick = jnp.minimum if op == "min" else jnp.maximum
+        # levels[l][i] = extremum of vv[i : i + 2^l], enough levels to
+        # cover the widest possible frame (static bound w)
+        w = preceding + following + 1
+        nlev = max(1, min(w, max(n, 1)).bit_length())
+        levels = [vv]
+        for lev in range(nlev - 1):
+            off = 1 << lev
+            shifted = levels[-1][jnp.clip(self._idx + off, 0,
+                                          max(n - 1, 0)).astype(jnp.int32)]
+            levels.append(pick(levels[-1], shifted))
+        stacked = jnp.stack(levels)  # (nlev, n)
+        length = hi - lo + 1
+        # k = floor(log2(length)) via static comparisons (exact, no fp)
+        k = jnp.zeros((n,), dtype=jnp.int64)
+        for lev in range(1, nlev):
+            k = k + (length >= (1 << lev))
+        span = jnp.left_shift(jnp.int64(1), k)
+        # two overlapping 2^k blocks cover [lo, hi]; gather each level at
+        # the block start, then select level k per row (take_along_axis
+        # keeps indices within one axis — no nlev*n flat index to
+        # overflow int32)
+        idx32 = lambda i: jnp.clip(i, 0, max(n - 1, 0)).astype(jnp.int32)
+        at_lo = stacked[:, idx32(lo)]
+        at_hi = stacked[:, idx32(hi - span + 1)]
+        k32 = k.astype(jnp.int32)[None, :]
+        a = jnp.take_along_axis(at_lo, k32, axis=0)[0]
+        b = jnp.take_along_axis(at_hi, k32, axis=0)[0]
+        out = pick(a, b)
+        wcnt = self._frame_valid_count(valid, lo, hi)
+        return Column(c.dtype, self._unsort(out), self._unsort(wcnt > 0))
+
+    @func_range("window_ntile")
+    def ntile(self, buckets: int) -> Column:
+        """NTILE(k): partition rows into k buckets whose sizes differ by
+        at most one, larger buckets first (SQL/Spark semantics)."""
+        if buckets <= 0:
+            raise ValueError("ntile bucket count must be positive")
+        size = self._p_end - self._p_start + 1
+        pos = self._idx - self._p_start
+        q = size // buckets
+        r = size - q * buckets
+        big = r * (q + 1)  # rows covered by the (q+1)-sized buckets
+        in_big = pos < big
+        # q == 0 only when size < buckets, where every row is its own
+        # bucket and pos < big always holds — the q-branch is never taken
+        tile = jnp.where(
+            in_big,
+            pos // jnp.maximum(q + 1, 1),
+            r + (pos - big) // jnp.maximum(q, 1),
+        )
+        return self._int_col(tile + 1)
+
+    @func_range("window_percent_rank")
+    def percent_rank(self) -> Column:
+        """PERCENT_RANK: (rank - 1) / (partition rows - 1); 0.0 for
+        single-row partitions."""
+        rank = self._first_peer() - self._p_start
+        size = self._p_end - self._p_start + 1
+        pr = rank.astype(jnp.float64) / jnp.maximum(
+            size - 1, 1).astype(jnp.float64)
+        return Column(DType(TypeId.FLOAT64), self._unsort(pr), None)
+
+    @func_range("window_cume_dist")
+    def cume_dist(self) -> Column:
+        """CUME_DIST: rows up to and including the current row's peers,
+        over the partition row count."""
+        size = self._p_end - self._p_start + 1
+        upto = self._peer_end - self._p_start + 1
+        cd = upto.astype(jnp.float64) / size.astype(jnp.float64)
+        return Column(DType(TypeId.FLOAT64), self._unsort(cd), None)
+
+    def _gather_at(self, c: Column, pos: jnp.ndarray,
+                   in_frame: jnp.ndarray) -> Column:
+        """Gather column values at sorted positions ``pos``, null outside
+        ``in_frame``, unsorted back to input row order."""
+        if c.dtype.is_string:
+            from spark_rapids_jni_tpu.ops import strings as s
+
+            c = s.pad_strings(c)
+        src = jnp.clip(pos, 0, max(self._n - 1, 0)).astype(jnp.int32)
+        validity = c.valid_mask()[src] & in_frame
+        chars = c.chars[src] if c.is_padded_string else None
+        return Column(c.dtype, self._unsort(c.data[src]),
+                      self._unsort(validity),
+                      chars=None if chars is None else self._unsort(chars))
+
+    @func_range("window_first_value")
+    def first_value(self, col_idx: int) -> Column:
+        """FIRST_VALUE under Spark's default frame (RANGE UNBOUNDED
+        PRECEDING .. CURRENT ROW): the partition's first row."""
+        c = self._sorted.column(col_idx)
+        return self._gather_at(c, self._p_start,
+                               jnp.ones((self._n,), jnp.bool_))
+
+    @func_range("window_last_value")
+    def last_value(self, col_idx: int) -> Column:
+        """LAST_VALUE under Spark's default frame: the last row of the
+        current row's peer group (RANGE frames include peers)."""
+        c = self._sorted.column(col_idx)
+        return self._gather_at(c, self._peer_end,
+                               jnp.ones((self._n,), jnp.bool_))
+
+    @func_range("window_nth_value")
+    def nth_value(self, col_idx: int, k: int) -> Column:
+        """NTH_VALUE(col, k), 1-based from the frame start; null when the
+        default frame (partition start .. peer end) has fewer than k rows."""
+        if k <= 0:
+            raise ValueError("nth_value offset is 1-based and positive")
+        c = self._sorted.column(col_idx)
+        pos = self._p_start + (k - 1)
+        return self._gather_at(c, pos, pos <= self._peer_end)
 
     @func_range("window_running_sum")
     def running_sum(self, col_idx: int) -> Column:

@@ -191,7 +191,6 @@ def test_rolling_frames_vs_oracle(rng):
         got_mean = w.rolling_mean(2, p, f).to_pylist()
         # oracle: per partition in (order, input) order
         rows = sorted(range(n), key=lambda i: (part[i], order[i], i))
-        pos_in = {i: j for j, i in enumerate(rows)}
         by_part = {}
         for i in rows:
             by_part.setdefault(part[i], []).append(i)
@@ -207,3 +206,102 @@ def test_rolling_frames_vs_oracle(rng):
                 else:
                     assert got_sum[i] is None
                     assert got_mean[i] is None
+
+
+def test_rolling_min_max_vs_oracle(rng):
+    """Sparse-table rolling MIN/MAX vs brute force (nulls, ties,
+    partition clamping, several frame shapes)."""
+    n = 231
+    part = rng.integers(0, 6, n).astype(np.int64)
+    order = rng.integers(0, 40, n).astype(np.int32)
+    vals = rng.integers(-99, 99, n).astype(np.int64)
+    vvalid = rng.random(n) > 0.25
+    tbl = Table([
+        Column.from_numpy(part),
+        Column.from_numpy(order),
+        Column.from_numpy(vals, validity=vvalid),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    rows = sorted(range(n), key=lambda i: (part[i], order[i], i))
+    by_part = {}
+    for i in rows:
+        by_part.setdefault(part[i], []).append(i)
+    for p, f in ((0, 0), (1, 0), (4, 2), (0, 5), (7, 7)):
+        got_mn = w.rolling_min(2, p, f).to_pylist()
+        got_mx = w.rolling_max(2, p, f).to_pylist()
+        for pid, seq in by_part.items():
+            for j, i in enumerate(seq):
+                frame = seq[max(j - p, 0): j + f + 1]
+                sel = [int(vals[r]) for r in frame if vvalid[r]]
+                if sel:
+                    assert got_mn[i] == min(sel), (p, f, i)
+                    assert got_mx[i] == max(sel), (p, f, i)
+                else:
+                    assert got_mn[i] is None, (p, f, i)
+                    assert got_mx[i] is None, (p, f, i)
+
+
+def test_rolling_min_float_and_decimal():
+    part = [1] * 5
+    order = [1, 2, 3, 4, 5]
+    f = [3.5, None, -1.25, 8.0, 0.5]
+    d = [150, -275, 300, None, 125]  # DECIMAL64 scale -2
+    tbl = Table([
+        Column.from_pylist(part, t.INT64),
+        Column.from_pylist(order, t.INT32),
+        Column.from_pylist(f, t.FLOAT64),
+        Column.from_pylist(d, t.DType(t.TypeId.DECIMAL64, scale=-2)),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    assert w.rolling_min(2, 1, 0).to_pylist() == [3.5, 3.5, -1.25, -1.25,
+                                                  0.5]
+    assert w.rolling_max(2, 1, 1).to_pylist() == [3.5, 3.5, 8.0, 8.0, 8.0]
+    got = w.rolling_min(3, 1, 0)
+    assert got.dtype.scale == -2
+    assert got.to_pylist() == [d[0], -275, -275, 300, 125]
+
+
+def test_ntile_percent_rank_cume_dist():
+    # one 7-row partition (ntile(3) -> 3,2,2) and one 1-row partition
+    part = [1] * 7 + [2]
+    order = [10, 20, 20, 30, 40, 50, 60, 5]
+    tbl = Table([
+        Column.from_pylist(part, t.INT64),
+        Column.from_pylist(order, t.INT32),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    assert w.ntile(3).to_pylist() == [1, 1, 1, 2, 2, 3, 3, 1]
+    # ntile with more buckets than rows: each row its own bucket
+    assert w.ntile(10).to_pylist() == [1, 2, 3, 4, 5, 6, 7, 1]
+    pr = w.percent_rank().to_pylist()
+    assert pr[0] == 0.0 and pr[7] == 0.0
+    assert pr[1] == pr[2] == pytest.approx(1 / 6)
+    assert pr[6] == pytest.approx(1.0)
+    cd = w.cume_dist().to_pylist()
+    assert cd[0] == pytest.approx(1 / 7)
+    assert cd[1] == cd[2] == pytest.approx(3 / 7)
+    assert cd[6] == pytest.approx(1.0) and cd[7] == pytest.approx(1.0)
+
+
+def test_first_last_nth_value():
+    part = [1, 1, 1, 1, 2, 2]
+    order = [1, 2, 2, 3, 1, 2]
+    v = ["a", None, "cc", "d", "e", "ff"]
+    tbl = Table([
+        Column.from_pylist(part, t.INT64),
+        Column.from_pylist(order, t.INT32),
+        Column.from_pylist(v, t.STRING),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    assert w.first_value(2).to_pylist() == ["a", "a", "a", "a", "e", "e"]
+    # default RANGE frame: last_value reaches the end of the peer group
+    assert w.last_value(2).to_pylist() == ["a", "cc", "cc", "d", "e",
+                                           "ff"]
+    # the 2nd row of partition 1 is the NULL string (stable tie order),
+    # so every frame that reaches it yields null — nth_value does not
+    # skip nulls
+    assert w.nth_value(2, 2).to_pylist() == [None, None, None, None,
+                                             None, "ff"]
+    assert w.nth_value(2, 4).to_pylist() == [None] * 3 + ["d"] + [None] * 2
+    with pytest.raises(ValueError):
+        w.nth_value(2, 0)
