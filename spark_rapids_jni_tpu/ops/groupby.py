@@ -303,6 +303,60 @@ def _sum_dtype(dt: DType) -> DType:
     return dt
 
 
+def _dense_group_bounds(group_id: jnp.ndarray | None, n: int,
+                        m: int) -> tuple:
+    """(num_groups, g_lo, g_hi) from sorted dense group ids: every
+    per-group boundary is a binary search, not a scatter — scatters
+    serialize on the TPU (measured 4x slower than the scan/searchsorted
+    formulation at 4M rows on v5e; BASELINE.md). ``group_id`` is None
+    only when n == 0."""
+    garange = jnp.arange(m, dtype=jnp.int32)
+    if group_id is None or n == 0:
+        return (jnp.int32(0), jnp.zeros((m,), jnp.int32),
+                jnp.zeros((m,), jnp.int32))
+    num_groups = (group_id[-1] + 1).astype(jnp.int32)
+    g_lo = jnp.searchsorted(group_id, garange, side="left").astype(jnp.int32)
+    g_hi = jnp.searchsorted(group_id, garange, side="right").astype(jnp.int32)
+    return num_groups, g_lo, g_hi
+
+
+def _gather_group_keys(sorted_tbl: Table, keys: Sequence[int],
+                       first_idx: jnp.ndarray, m: int,
+                       n: int) -> list[Column]:
+    """One output row per group: each key column gathered at its group's
+    first sorted row (absent groups carry first_idx == n -> null)."""
+    out_cols: list[Column] = []
+    for k in keys:
+        c = sorted_tbl.column(k)
+        valid = jnp.zeros((m,), jnp.bool_)
+        if n == 0:
+            # nothing to gather from — emit all-null keys (num_groups = 0)
+            if c.dtype.is_string:
+                out_cols.append(Column(
+                    c.dtype, jnp.zeros((m,), jnp.int32), valid,
+                    chars=jnp.zeros((m, 1), jnp.uint8),
+                ))
+            elif c.dtype.is_decimal128:
+                out_cols.append(
+                    Column(c.dtype, jnp.zeros((m, 2), jnp.int64), valid)
+                )
+            else:
+                out_cols.append(
+                    Column(c.dtype, jnp.zeros((m,), c.dtype.jnp_dtype), valid)
+                )
+            continue
+        safe_first = jnp.clip(first_idx, 0, n - 1)
+        valid = c.valid_mask()[safe_first] & (first_idx < n)
+        if c.dtype.is_string:
+            from spark_rapids_jni_tpu.ops import strings as s
+
+            g = s.gather_strings(c, safe_first)
+            out_cols.append(Column(c.dtype, g.data, valid, chars=g.chars))
+        else:
+            out_cols.append(Column(c.dtype, c.data[safe_first], valid))
+    return out_cols
+
+
 @func_range("groupby_aggregate")
 def groupby_aggregate(
     table: Table,
@@ -346,51 +400,13 @@ def groupby_aggregate(
     if small:
         starts, num_groups = _group_starts(same, m + 1, block)
         g_lo, g_hi = starts[:m], starts[1:]
-    elif n:
-        group_id = _gid()
-        num_groups = (group_id[-1] + 1).astype(jnp.int32)
-        # group_id is sorted (dense ids over sorted rows), so every
-        # per-group boundary is a binary search, not a scatter — scatters
-        # serialize on the TPU (measured 4x slower than the scan/
-        # searchsorted formulation at 4M rows on v5e; BASELINE.md).
-        g_lo = jnp.searchsorted(group_id, garange, side="left").astype(jnp.int32)
-        g_hi = jnp.searchsorted(group_id, garange, side="right").astype(jnp.int32)
     else:
-        num_groups = jnp.int32(0)
-        g_lo = jnp.zeros((m,), jnp.int32)
-        g_hi = jnp.zeros((m,), jnp.int32)
+        num_groups, g_lo, g_hi = _dense_group_bounds(
+            _gid() if n else None, n, m)
     overflowed = num_groups > m
     # first row of each group (n = absent, matching the old scatter-min)
     first_idx = jnp.where(g_hi > g_lo, g_lo, n)
-    out_cols: list[Column] = []
-    for k in keys:
-        c = sorted_tbl.column(k)
-        valid = jnp.zeros((m,), jnp.bool_)
-        if n == 0:
-            # nothing to gather from — emit all-null keys (num_groups = 0)
-            if c.dtype.is_string:
-                out_cols.append(Column(
-                    c.dtype, jnp.zeros((m,), jnp.int32), valid,
-                    chars=jnp.zeros((m, 1), jnp.uint8),
-                ))
-            elif c.dtype.is_decimal128:
-                out_cols.append(
-                    Column(c.dtype, jnp.zeros((m, 2), jnp.int64), valid)
-                )
-            else:
-                out_cols.append(
-                    Column(c.dtype, jnp.zeros((m,), c.dtype.jnp_dtype), valid)
-                )
-            continue
-        safe_first = jnp.clip(first_idx, 0, n - 1)
-        valid = c.valid_mask()[safe_first] & (first_idx < n)
-        if c.dtype.is_string:
-            from spark_rapids_jni_tpu.ops import strings as s
-
-            g = s.gather_strings(c, safe_first)
-            out_cols.append(Column(c.dtype, g.data, valid, chars=g.chars))
-        else:
-            out_cols.append(Column(c.dtype, c.data[safe_first], valid))
+    out_cols = _gather_group_keys(sorted_tbl, keys, first_idx, m, n)
 
     # Sum-form reductions (sums of ints/decimals/floats, all counts) batch
     # into ONE (n, k) prefix pass per accumulator dtype + per-group
@@ -793,6 +809,75 @@ def groupby_aggregate_auto(
         if m >= n or not bool(res.overflowed):
             return res
         m *= growth
+
+
+@func_range("groupby_percentile")
+def groupby_percentile(
+    table: Table,
+    keys: Sequence[int],
+    value_col: int,
+    qs: Sequence[float],
+    max_groups: int | None = None,
+) -> GroupByResult:
+    """Exact per-group percentiles (Spark `percentile` semantics: linear
+    interpolation between closest ranks over non-null values; median is
+    qs=[0.5]). Output: keys + one FLOAT64 column per q.
+
+    Sort-based order statistics: ONE sort by (keys..., value) with value
+    nulls last, so each group's valid values occupy a contiguous run
+    [g_lo, g_lo + cnt); every percentile is then two gathers at computed
+    offsets — no scatters, no per-group loops. Exact, unlike HLL-style
+    sketches; the reference's capability family is cuDF's
+    quantile/median groupby (vendored surface, SURVEY.md section 2.2).
+    """
+    qs = [float(q) for q in qs]
+    if not qs or any(q < 0.0 or q > 1.0 for q in qs):
+        raise ValueError("percentile fractions must be in [0, 1]")
+    c_in = table.column(value_col)
+    if c_in.dtype.is_string or c_in.dtype.is_decimal128:
+        raise NotImplementedError(
+            "groupby_percentile needs fixed-width numeric values")
+    n = table.num_rows
+    m = n if max_groups is None else int(max_groups)
+    sort_keys = list(keys) + [value_col]
+    order = sort_order(
+        table, sort_keys,
+        nulls_first=[True] * len(keys) + [False])
+    sorted_tbl = gather(table, order)
+    same = _rows_equal_prev(sorted_tbl, keys)
+    group_id = (jnp.cumsum(~same) - 1).astype(jnp.int32) if n else None
+    num_groups, g_lo, g_hi = _dense_group_bounds(group_id, n, m)
+    overflowed = num_groups > m
+    first_idx = jnp.where(g_hi > g_lo, g_lo, n)
+    out_cols = _gather_group_keys(sorted_tbl, keys, first_idx, m, n)
+
+    c = sorted_tbl.column(value_col)
+    if n:
+        vcum = jnp.cumsum(c.valid_mask().astype(jnp.int64))
+        upper = vcum[jnp.clip(g_hi - 1, 0, n - 1)]
+        base = jnp.where(g_lo > 0, vcum[jnp.clip(g_lo - 1, 0, n - 1)], 0)
+        cnt = jnp.where(g_hi > g_lo, upper - base, 0)
+    else:
+        cnt = jnp.zeros((m,), jnp.int64)
+    vals = c.data.astype(jnp.float64)
+    if c.dtype.is_decimal:
+        vals = vals * (10.0 ** c.dtype.scale)
+    group_ok = cnt > 0
+    for q in qs:
+        p = q * (cnt - 1).astype(jnp.float64)
+        lo_off = jnp.floor(p).astype(jnp.int64)
+        frac = p - lo_off.astype(jnp.float64)
+        i0 = g_lo.astype(jnp.int64) + lo_off
+        i1 = g_lo.astype(jnp.int64) + jnp.minimum(lo_off + 1, cnt - 1)
+        safe = lambda i: jnp.clip(i, 0, max(n - 1, 0)).astype(jnp.int32)
+        if n:
+            v0 = vals[safe(i0)]
+            v1 = vals[safe(i1)]
+            out = v0 * (1.0 - frac) + v1 * frac
+        else:
+            out = jnp.zeros((m,), jnp.float64)
+        out_cols.append(Column(DType(TypeId.FLOAT64), out, group_ok))
+    return GroupByResult(Table(out_cols), num_groups, overflowed)
 
 
 class BoundedGroupByResult(NamedTuple):

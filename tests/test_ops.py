@@ -771,3 +771,62 @@ def test_groupby_first_last_include_nulls():
     assert out.column(2).to_pylist() == [5, None]   # last row as-is
     assert out.column(3).to_pylist() == [5, 7]      # first non-null
     assert out.column(4).to_pylist() == [5, 7]      # last non-null
+
+
+def test_groupby_percentile_vs_numpy(rng):
+    """Exact percentiles (linear interpolation) vs numpy.percentile per
+    group, with null keys and null values."""
+    from spark_rapids_jni_tpu.ops.groupby import groupby_percentile
+
+    n = 400
+    keys = rng.integers(0, 11, n).astype(np.int64)
+    kvalid = rng.random(n) > 0.1
+    vals = rng.integers(-500, 500, n).astype(np.int64)
+    vvalid = rng.random(n) > 0.2
+    tbl = Table([
+        Column.from_numpy(keys, validity=kvalid),
+        Column.from_numpy(vals, validity=vvalid),
+    ])
+    qs = [0.0, 0.25, 0.5, 0.9, 1.0]
+    res = groupby_percentile(tbl, [0], 1, qs)
+    out = res.compact()
+    got_keys = out.column(0).to_pylist()
+    groups = {}
+    for i in range(n):
+        k = int(keys[i]) if kvalid[i] else None
+        if vvalid[i]:
+            groups.setdefault(k, []).append(int(vals[i]))
+        else:
+            groups.setdefault(k, [])
+    assert sorted(got_keys, key=lambda x: (x is None, x)) == sorted(
+        groups, key=lambda x: (x is None, x))
+    for r, k in enumerate(got_keys):
+        sel = groups[k]
+        for qi, q in enumerate(qs):
+            got = out.column(1 + qi).to_pylist()[r]
+            if not sel:
+                assert got is None, (k, q)
+            else:
+                assert got == pytest.approx(
+                    float(np.percentile(sel, q * 100))), (k, q)
+
+
+def test_groupby_percentile_median_decimal_and_errors():
+    from spark_rapids_jni_tpu.ops.groupby import groupby_percentile
+
+    # DECIMAL64 scale -2: 1.50, 3.00, 2.25 -> median 2.25
+    d = [150, 300, 225]
+    tbl = Table([
+        Column.from_pylist([1, 1, 1], t.INT64),
+        Column.from_pylist(d, t.DType(t.TypeId.DECIMAL64, scale=-2)),
+    ])
+    res = groupby_percentile(tbl, [0], 1, [0.5])
+    assert res.compact().column(1).to_pylist() == [pytest.approx(2.25)]
+    with pytest.raises(ValueError):
+        groupby_percentile(tbl, [0], 1, [1.5])
+    with pytest.raises(ValueError):
+        groupby_percentile(tbl, [0], 1, [])
+    s = Table([Column.from_pylist([1], t.INT64),
+               Column.from_pylist(["x"], t.STRING)])
+    with pytest.raises(NotImplementedError):
+        groupby_percentile(s, [0], 1, [0.5])
