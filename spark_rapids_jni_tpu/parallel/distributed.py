@@ -211,12 +211,20 @@ def distributed_groupby_aggregate(
 
     ``table`` must already be sharded row-wise over ``mesh`` (shard_table).
     """
-    keys = list(keys)
     aggs = list(aggs)
+    return _distributed_groupby(
+        table, list(keys), mesh, capacity,
+        lambda sh_tbl, ks: groupby_aggregate(sh_tbl, ks, aggs))
+
+
+def _distributed_groupby(table, keys, mesh, capacity, local_groupby):
+    """Shared shuffle-then-local-groupby scaffold: hash-exchange rows so
+    each device owns whole key groups, run ``local_groupby(shuffled_table,
+    keys)`` per device, and pack the sharded GroupByResult."""
 
     def step(local: Table):
         sh = hash_shuffle(local, keys, EXEC_AXIS, capacity=capacity)
-        res = groupby_aggregate(sh.table, keys, aggs)
+        res = local_groupby(sh.table, keys)
         return (res.table, res.num_groups.reshape(1),
                 sh.overflowed.reshape(1),
                 jnp.asarray(res.sum_overflow).reshape(1))
@@ -228,6 +236,26 @@ def distributed_groupby_aggregate(
         out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
     )(table)
     return DistributedGroupBy(out_tbl, num_groups, overflowed, sum_overflow)
+
+
+def distributed_groupby_percentile(
+    table: Table,
+    keys: Sequence[int],
+    value_col: int,
+    qs: Sequence[float],
+    mesh: Mesh,
+    capacity: Optional[int] = None,
+) -> DistributedGroupBy:
+    """Global exact percentiles: shuffle rows by key hash (whole groups
+    co-locate), then one local sort-based groupby_percentile per device —
+    order statistics are group-local, so co-location makes the per-device
+    answers globally exact (no sketch merging, unlike t-digest designs)."""
+    from spark_rapids_jni_tpu.ops.groupby import groupby_percentile
+
+    qs = [float(q) for q in qs]
+    return _distributed_groupby(
+        table, list(keys), mesh, capacity,
+        lambda sh_tbl, ks: groupby_percentile(sh_tbl, ks, value_col, qs))
 
 
 @jax.jit
@@ -326,7 +354,12 @@ def distributed_window(
     ``("row_number",)``, ``("rank",)``, ``("dense_rank",)``,
     ``("lag", col_idx, k)``, ``("lead", col_idx, k)``,
     ``("running_sum", col_idx)``, ``("running_min", col_idx)``,
-    ``("running_max", col_idx)``. Results come back sharded, aligned to
+    ``("running_max", col_idx)``, ``("ntile", buckets)``,
+    ``("percent_rank",)``, ``("cume_dist",)``,
+    ``("first_value", col_idx)``, ``("last_value", col_idx)``,
+    ``("nth_value", col_idx, k)``, and
+    ``("rolling_<sum|count|mean|min|max>", col_idx, preceding,
+    following)``. Results come back sharded, aligned to
     the shuffled rows; filter output by the returned ``row_valid``.
 
     ``row_valid`` is REQUIRED (use ``shard_table(...,
@@ -358,12 +391,22 @@ def distributed_window(
         out_cols = []
         for spec in specs:
             kind = spec[0]
-            if kind in ("row_number", "rank", "dense_rank"):
+            if kind in ("row_number", "rank", "dense_rank",
+                        "percent_rank", "cume_dist"):
                 out_cols.append(getattr(w, kind)())
             elif kind in ("lag", "lead"):
                 out_cols.append(getattr(w, kind)(spec[1] + 1, spec[2]))
-            elif kind in ("running_sum", "running_min", "running_max"):
+            elif kind in ("running_sum", "running_min", "running_max",
+                          "first_value", "last_value"):
                 out_cols.append(getattr(w, kind)(spec[1] + 1))
+            elif kind == "nth_value":
+                out_cols.append(w.nth_value(spec[1] + 1, spec[2]))
+            elif kind == "ntile":
+                out_cols.append(w.ntile(spec[1]))
+            elif kind in ("rolling_sum", "rolling_count", "rolling_mean",
+                          "rolling_min", "rolling_max"):
+                out_cols.append(getattr(w, kind)(
+                    spec[1] + 1, spec[2], spec[3]))
             else:
                 raise ValueError(f"unknown window spec {spec!r}")
         return (sh.table, Table(out_cols), sh.row_valid,

@@ -409,3 +409,46 @@ def test_distributed_groupby_sum_overflow_surfaces(mesh):
         sharded, [0], [(1, "sum")], mesh, capacity=n)
     assert not np.asarray(res.overflowed).any()
     assert np.asarray(res.sum_overflow).any()
+
+
+@pytest.mark.slow
+def test_distributed_groupby_percentile_matches_local(rng, mesh):
+    from spark_rapids_jni_tpu.ops.groupby import groupby_percentile
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        distributed_groupby_percentile,
+    )
+
+    n = 512
+    keys = rng.integers(0, 17, n).astype(np.int64)
+    vals = rng.integers(-90, 90, n).astype(np.int64)
+    vvalid = rng.random(n) > 0.15
+    tbl = Table([
+        Column.from_numpy(keys),
+        Column.from_numpy(vals, validity=vvalid),
+    ])
+    sharded = shard_table(tbl, mesh)
+    qs = [0.25, 0.5, 0.75]
+    dist = distributed_groupby_percentile(
+        sharded, [0], 1, qs, mesh, capacity=n // 2)
+    assert not np.asarray(dist.overflowed).any()
+    got = collect(dist.table, dist.num_groups, mesh)
+    local = groupby_percentile(tbl, [0], 1, qs).compact()
+
+    def rows(tb, limit):
+        cols = [tb.column(i).to_pylist()[:limit]
+                for i in range(tb.num_columns)]
+        return {cols[0][i]: tuple(c[i] for c in cols[1:])
+                for i in range(limit)}
+
+    want = rows(local, local.num_rows)
+    got_rows = rows(got, got.num_rows)
+    # drop phantom all-null groups from shuffle padding
+    got_rows = {k: v for k, v in got_rows.items()
+                if not (k is None and all(x is None for x in v)
+                        and k not in want)}
+    assert set(got_rows) == set(want)
+    for k in want:
+        for a, b in zip(got_rows[k], want[k]):
+            assert (a is None) == (b is None), k
+            if a is not None:
+                assert a == pytest.approx(b), k

@@ -305,3 +305,59 @@ def test_first_last_nth_value():
     assert w.nth_value(2, 4).to_pylist() == [None] * 3 + ["d"] + [None] * 2
     with pytest.raises(ValueError):
         w.nth_value(2, 0)
+
+
+@pytest.mark.slow
+def test_distributed_window_new_specs_match_local(rng):
+    from spark_rapids_jni_tpu.parallel import executor_mesh, shard_table
+    from spark_rapids_jni_tpu.parallel.distributed import distributed_window
+
+    mesh = executor_mesh(8)
+    n = 250
+    part = rng.integers(0, 13, n).astype(np.int64)
+    order = rng.integers(0, 9, n).astype(np.int32)
+    vals = rng.integers(-50, 50, n).astype(np.int64)
+    tbl = Table([
+        Column.from_numpy(part),
+        Column.from_numpy(order),
+        Column.from_numpy(vals),
+    ])
+    sharded, rv = shard_table(tbl, mesh, return_row_valid=True)
+    specs = [("ntile", 3), ("percent_rank",), ("cume_dist",),
+             ("first_value", 2), ("last_value", 2), ("nth_value", 2, 2),
+             ("rolling_sum", 2, 2, 1), ("rolling_min", 2, 2, 1),
+             ("rolling_max", 2, 1, 0)]
+    dw = distributed_window(sharded, [0], [1], specs, mesh, rv,
+                            capacity=n)
+    assert not np.asarray(dw.overflowed).any()
+
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    local = {
+        ("ntile", 3): w.ntile(3).to_pylist(),
+        ("percent_rank",): w.percent_rank().to_pylist(),
+        ("cume_dist",): w.cume_dist().to_pylist(),
+        ("first_value", 2): w.first_value(2).to_pylist(),
+        ("last_value", 2): w.last_value(2).to_pylist(),
+        ("nth_value", 2, 2): w.nth_value(2, 2).to_pylist(),
+        ("rolling_sum", 2, 2, 1): w.rolling_sum(2, 2, 1).to_pylist(),
+        ("rolling_min", 2, 2, 1): w.rolling_min(2, 2, 1).to_pylist(),
+        ("rolling_max", 2, 1, 0): w.rolling_max(2, 1, 0).to_pylist(),
+    }
+    import collections
+
+    rv_np = np.asarray(dw.row_valid)
+    keys_got = list(zip(
+        np.asarray(dw.table.column(0).data)[rv_np],
+        np.asarray(dw.table.column(1).data)[rv_np],
+        np.asarray(dw.table.column(2).data)[rv_np],
+    ))
+    for si, spec in enumerate(specs):
+        got_col = dw.results.column(si).to_pylist()
+        round6 = lambda v: round(v, 6) if isinstance(v, float) else v
+        got = collections.Counter(
+            (k, round6(got_col[i]))
+            for k, i in zip(keys_got, np.flatnonzero(rv_np)))
+        want = collections.Counter(
+            ((part[i], order[i], vals[i]), round6(local[spec][i]))
+            for i in range(n))
+        assert got == want, spec
