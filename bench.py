@@ -123,6 +123,21 @@ def _bench_tpch_q1(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_tpch_q6(n: int, iters: int):
+    """The pure-streaming query: one masked multiply-accumulate, no sort/
+    groupby/join — measures how close the engine gets to raw HBM
+    bandwidth (~38 B/row of predicate+value traffic)."""
+    import jax
+
+    from spark_rapids_jni_tpu.columnar import Table
+    from spark_rapids_jni_tpu.models.tpch import lineitem_table, tpch_q6
+
+    lineitem = lineitem_table(n)
+    fn = jax.jit(lambda t: _table_digest(Table([tpch_q6(t)])))
+    per_iter = _measure(lambda: fn(lineitem), iters)
+    return n / per_iter
+
+
 def _bench_tpcds_q72(n: int, iters: int):
     import jax
 
@@ -435,6 +450,7 @@ def _bench_shuffle_wire(n: int, iters: int):
 # config so failure records line up with their success history.
 _CONFIGS = {
     "tpch_q1": (_bench_tpch_q1, "tpch_q1_rows_per_s", "rows/s"),
+    "tpch_q6": (_bench_tpch_q6, "tpch_q6_rows_per_s", "rows/s"),
     "tpcds_q72": (_bench_tpcds_q72, "tpcds_q72_rows_per_s", "rows/s"),
     "row_conversion": (_bench_row_conversion, "row_conversion_gb_per_s", "GB/s"),
     "parquet_q1": (_bench_parquet_q1, "parquet_q1_rows_per_s", "rows/s"),

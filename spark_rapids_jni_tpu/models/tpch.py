@@ -259,6 +259,64 @@ def tpch_q1_checked(lineitem: Table) -> Table:
     return sort_table(grouped.table, [0, 1], nulls_first=[False, False])
 
 
+# TPC-H q6 predicate constants: shipdate in [1994-01-01, 1995-01-01) as
+# days since epoch (8766 = 24*365 + 6 leap days; 9131 = 8766 + 365),
+# discount in [0.05, 0.07] at scale -2, quantity < 24 at scale -2.
+_Q6_DATE_LO = 8766
+_Q6_DATE_HI = 9131
+_Q6_DISC_LO = 5
+_Q6_DISC_HI = 7
+_Q6_QTY_HI = 2400
+
+
+@func_range("tpch_q6")
+def tpch_q6(lineitem: Table) -> Column:
+    """TPC-H q6: SELECT sum(l_extendedprice * l_discount) WHERE shipdate
+    in a year AND discount BETWEEN 0.05 AND 0.07 AND quantity < 24.
+
+    The pure streaming query: no groupby, no sort, no join — ONE masked
+    multiply-accumulate over three predicate columns, the shape that
+    exposes raw HBM bandwidth (the cuDF/libcudf capability family's
+    filter+reduce fast path, SURVEY.md section 2.2). The product of two
+    scale -2 decimals is scale -4; the int64 accumulator is exact up to
+    ~9e18, i.e. ~8.7e10 matched rows at TPC-H value ranges — far beyond
+    any single-chip batch, so no 128-bit lanes are needed (unlike the
+    general DECIMAL128 SUM path, which this plan deliberately avoids).
+
+    Returns a 1-row DECIMAL64(scale -4) column (null iff no row matched).
+    """
+    qty = lineitem.column(L_QUANTITY)
+    price = lineitem.column(L_EXTENDEDPRICE)
+    disc = lineitem.column(L_DISCOUNT)
+    ship = lineitem.column(L_SHIPDATE)
+    sel = (
+        qty.valid_mask() & price.valid_mask() & disc.valid_mask()
+        & ship.valid_mask()
+        & (ship.data >= _Q6_DATE_LO) & (ship.data < _Q6_DATE_HI)
+        & (disc.data >= _Q6_DISC_LO) & (disc.data <= _Q6_DISC_HI)
+        & (qty.data < _Q6_QTY_HI)
+    )
+    prod = jnp.where(sel, price.data * disc.data, jnp.int64(0))
+    total = jnp.sum(prod).reshape(1)
+    any_row = jnp.any(sel).reshape(1)
+    return Column(t.decimal64(-4), total, any_row)
+
+
+def tpch_q6_numpy(lineitem: Table) -> int:
+    """Host oracle for q6 (exact int arithmetic, scale -4 result)."""
+    qty = np.asarray(lineitem.column(L_QUANTITY).data)
+    price = np.asarray(lineitem.column(L_EXTENDEDPRICE).data)
+    disc = np.asarray(lineitem.column(L_DISCOUNT).data)
+    ship = np.asarray(lineitem.column(L_SHIPDATE).data)
+    valid = np.ones(lineitem.num_rows, dtype=bool)
+    for c in (L_QUANTITY, L_EXTENDEDPRICE, L_DISCOUNT, L_SHIPDATE):
+        valid &= np.asarray(lineitem.column(c).valid_mask())
+    sel = (valid & (ship >= _Q6_DATE_LO) & (ship < _Q6_DATE_HI)
+           & (disc >= _Q6_DISC_LO) & (disc <= _Q6_DISC_HI)
+           & (qty < _Q6_QTY_HI))
+    return int((price[sel].astype(object) * disc[sel].astype(object)).sum())
+
+
 def tpch_q1_numpy(lineitem: Table) -> dict:
     """Host oracle: same query in numpy, keyed by (returnflag, linestatus)."""
     qty = np.asarray(lineitem.column(L_QUANTITY).data)

@@ -332,3 +332,40 @@ def test_bounded_groupby_float32_sum_dtype():
     out = res.table.column(1)
     assert out.dtype == t.FLOAT32
     assert out.to_pylist()[0] == 4.5
+
+
+def test_tpch_q6_matches_numpy_oracle():
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_table, tpch_q6, tpch_q6_numpy)
+
+    li = lineitem_table(5000, seed=9)
+    out = tpch_q6(li)
+    assert out.dtype.scale == -4
+    # decimal to_pylist yields the raw scaled integer representation
+    got = out.to_pylist()[0]
+    want = tpch_q6_numpy(li)
+    assert want != 0 and got == want
+
+
+def test_tpch_q6_nulls_and_empty_match():
+    from spark_rapids_jni_tpu.models.tpch import (
+        _Q6_DATE_LO, lineitem_table, tpch_q6, tpch_q6_numpy)
+
+    li = lineitem_table(64, seed=1)
+    # null out some discount values: those rows must not contribute
+    cols = list(li.columns)
+    disc = cols[2]
+    valid = np.ones(64, dtype=bool)
+    valid[::3] = False
+    cols[2] = Column(disc.dtype, disc.data, jnp.asarray(valid))
+    li2 = Table(cols)
+    want2 = tpch_q6_numpy(li2)
+    got2 = tpch_q6(li2).to_pylist()[0]
+    # SQL SUM over zero rows is NULL
+    assert got2 == (want2 if want2 != 0 else None)
+    # no matching rows -> null result
+    cols[6] = Column(
+        cols[6].dtype,
+        jnp.zeros((64,), cols[6].data.dtype) + (_Q6_DATE_LO - 100),
+        None)
+    assert tpch_q6(Table(cols)).to_pylist() == [None]
