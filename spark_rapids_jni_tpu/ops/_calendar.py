@@ -1,0 +1,52 @@
+"""Shared proleptic-Gregorian civil-calendar arithmetic (days since
+1970-01-01 <-> year/month/day) — used by the string date casts and the
+datetime op family, so the two can never disagree on a date.
+
+The era decomposition: shift to 0000-03-01 so leap days land at the end
+of each 400-year cycle, split into eras / years-of-era with the leap
+corrections as integer divisions, and read month/day off the 5-month
+cycle polynomial (153m+2)/5. Everything is int64 elementwise
+``floor_divide`` — jnp's ``//`` is already floor division, so no
+truncation compensation is needed (or wanted: compensating on top of
+floor division would shift exact negative multiples by one era).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def civil_from_days(z: jnp.ndarray):
+    """days since 1970-01-01 -> (year, month, day), int64 each."""
+    z = z.astype(jnp.int64) + 719_468  # days since 0000-03-01
+    era = jnp.floor_divide(z, 146_097)
+    doe = z - era * 146_097  # [0, 146096]
+    yoe = jnp.floor_divide(
+        doe - jnp.floor_divide(doe, 1460) + jnp.floor_divide(doe, 36_524)
+        - jnp.floor_divide(doe, 146_096),
+        365,
+    )  # [0, 399]
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4)
+                 - jnp.floor_divide(yoe, 100))  # [0, 365]
+    mp = jnp.floor_divide(5 * doy + 2, 153)  # March-based month [0, 11]
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1  # [1, 31]
+    m = mp + jnp.where(mp < 10, 3, -9)  # civil month [1, 12]
+    return y + (mp >= 10), m, d
+
+
+def days_from_civil(y: jnp.ndarray, m: jnp.ndarray,
+                    d: jnp.ndarray) -> jnp.ndarray:
+    """(year, month, day) -> int64 days since 1970-01-01; inverse of
+    civil_from_days."""
+    y = y.astype(jnp.int64)
+    m = m.astype(jnp.int64)
+    d = d.astype(jnp.int64)
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = m + jnp.where(m > 2, -3, 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = 365 * yoe + jnp.floor_divide(yoe, 4) - jnp.floor_divide(
+        yoe, 100) + doy
+    return era * 146_097 + doe - 719_468

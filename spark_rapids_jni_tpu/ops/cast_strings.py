@@ -24,6 +24,7 @@ import numpy as np
 
 from spark_rapids_jni_tpu import types as t
 from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops._calendar import civil_from_days, days_from_civil
 from spark_rapids_jni_tpu.types import DType, TypeId
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
@@ -406,33 +407,15 @@ def _assemble_decimal_strings(
 
 def _days_from_civil(y: jnp.ndarray, m: jnp.ndarray,
                      d: jnp.ndarray) -> jnp.ndarray:
-    """(year, month, day) -> days since 1970-01-01 (proleptic Gregorian).
-    Pure integer arithmetic (the era/day-of-era formulation), so the whole
-    column converts in one vectorized pass."""
-    y = y.astype(jnp.int64)
-    m = m.astype(jnp.int64)
-    d = d.astype(jnp.int64)
-    y = jnp.where(m <= 2, y - 1, y)
-    era = jnp.where(y >= 0, y, y - 399) // 400
-    yoe = y - era * 400                                     # [0, 399]
-    mp = (m + 9) % 12                                       # Mar=0..Feb=11
-    doy = (153 * mp + 2) // 5 + d - 1                       # [0, 365]
-    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy           # [0, 146096]
-    return (era * 146097 + doe - 719468).astype(jnp.int32)
+    """(year, month, day) -> int32 days since 1970-01-01 (shared civil-
+    calendar arithmetic, ops/_calendar.py)."""
+    return days_from_civil(y, m, d).astype(jnp.int32)
 
 
 def _civil_from_days(z: jnp.ndarray):
-    """days since 1970-01-01 -> (year, month, day), inverse of the above."""
-    z = z.astype(jnp.int64) + 719468
-    era = jnp.where(z >= 0, z, z - 146096) // 146097
-    doe = z - era * 146097                                  # [0, 146096]
-    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
-    y = yoe + era * 400
-    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
-    mp = (5 * doy + 2) // 153
-    d = doy - (153 * mp + 2) // 5 + 1
-    m = jnp.where(mp < 10, mp + 3, mp - 9)
-    return jnp.where(m <= 2, y + 1, y), m, d
+    """days since 1970-01-01 -> (year, month, day) (shared civil-calendar
+    arithmetic, ops/_calendar.py)."""
+    return civil_from_days(z)
 
 
 _DAYS_IN_MONTH = jnp.asarray(

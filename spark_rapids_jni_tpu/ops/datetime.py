@@ -1,0 +1,170 @@
+"""Datetime extraction and arithmetic over DATE/TIMESTAMP columns — the
+cuDF datetime op family (vendored capability surface, SURVEY.md section
+2.2) Spark lowers year()/month()/dayofmonth()/date_add()/datediff()/
+last_day()/trunc() and friends to.
+
+TPU-first design: the civil-calendar conversion (days since epoch ->
+year/month/day) is pure branch-free integer arithmetic on the era/
+day-of-era decomposition — elementwise VPU code with no lookup tables,
+no data-dependent control flow, fully fusable by XLA. Timestamps reduce
+to days + intra-day remainder with floor-division semantics correct for
+negative (pre-1970) values.
+
+Null semantics: every function is null-in -> null-out per row (Spark).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops._calendar import civil_from_days, days_from_civil
+from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+_DAY_US = 86_400_000_000
+
+_TS_TO_DAY_DIV = {
+    TypeId.TIMESTAMP_DAYS: 1,
+    TypeId.TIMESTAMP_SECONDS: 86_400,
+    TypeId.TIMESTAMP_MILLISECONDS: 86_400_000,
+    TypeId.TIMESTAMP_MICROSECONDS: _DAY_US,
+    TypeId.TIMESTAMP_NANOSECONDS: 86_400_000_000_000,
+}
+
+
+def _days_since_epoch(col: Column) -> jnp.ndarray:
+    """int64 civil days since 1970-01-01, floor division (pre-epoch
+    instants land on the correct earlier day)."""
+    div = _TS_TO_DAY_DIV.get(col.dtype.type_id)
+    if div is None:
+        raise NotImplementedError(
+            f"datetime op needs a DATE/TIMESTAMP column, got {col.dtype}")
+    d = col.data.astype(jnp.int64)
+    return d if div == 1 else jnp.floor_divide(d, div)
+
+
+def _int_out(col: Column, vals: jnp.ndarray, dtype=None) -> Column:
+    dt = dtype or DType(TypeId.INT32)
+    return Column(dt, vals.astype(dt.jnp_dtype), col.valid_mask())
+
+
+@func_range("dt_year")
+def year(col: Column) -> Column:
+    """Civil year (Spark year())."""
+    y, _, _ = civil_from_days(_days_since_epoch(col))
+    return _int_out(col, y)
+
+
+@func_range("dt_month")
+def month(col: Column) -> Column:
+    """Civil month 1-12 (Spark month())."""
+    _, m, _ = civil_from_days(_days_since_epoch(col))
+    return _int_out(col, m)
+
+
+@func_range("dt_day")
+def day(col: Column) -> Column:
+    """Day of month 1-31 (Spark dayofmonth())."""
+    _, _, d = civil_from_days(_days_since_epoch(col))
+    return _int_out(col, d)
+
+
+@func_range("dt_day_of_week")
+def day_of_week(col: Column) -> Column:
+    """ISO day of week, Monday=1..Sunday=7 (1970-01-01 was a Thursday)."""
+    z = _days_since_epoch(col)
+    return _int_out(col, jnp.mod(z + 3, 7) + 1)
+
+
+@func_range("dt_day_of_week_spark")
+def day_of_week_spark(col: Column) -> Column:
+    """Spark dayofweek(): Sunday=1..Saturday=7."""
+    z = _days_since_epoch(col)
+    return _int_out(col, jnp.mod(z + 4, 7) + 1)
+
+
+@func_range("dt_day_of_year")
+def day_of_year(col: Column) -> Column:
+    """1-based ordinal day within the year (Spark dayofyear())."""
+    z = _days_since_epoch(col)
+    y, _, _ = civil_from_days(z)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return _int_out(col, z - jan1 + 1)
+
+
+@func_range("dt_quarter")
+def quarter(col: Column) -> Column:
+    _, m, _ = civil_from_days(_days_since_epoch(col))
+    return _int_out(col, jnp.floor_divide(m - 1, 3) + 1)
+
+
+@func_range("dt_last_day")
+def last_day(col: Column) -> Column:
+    """Last day of the instant's month, as TIMESTAMP_DAYS (Spark
+    last_day())."""
+    y, m, _ = civil_from_days(_days_since_epoch(col))
+    ny = y + (m == 12)
+    nm = jnp.where(m == 12, 1, m + 1)
+    first_next = days_from_civil(ny, nm, jnp.ones_like(nm))
+    return _int_out(col, first_next - 1, DType(TypeId.TIMESTAMP_DAYS))
+
+
+@func_range("dt_date_add")
+def date_add(col: Column, days: int | jnp.ndarray) -> Column:
+    """DATE +/- integer days (Spark date_add / date_sub via negative)."""
+    if col.dtype.type_id != TypeId.TIMESTAMP_DAYS:
+        raise NotImplementedError("date_add needs a TIMESTAMP_DAYS column")
+    return _int_out(col, col.data.astype(jnp.int64) + days,
+                    DType(TypeId.TIMESTAMP_DAYS))
+
+
+@func_range("dt_datediff")
+def datediff(end: Column, start: Column) -> Column:
+    """end - start in whole civil days (Spark datediff)."""
+    d = _days_since_epoch(end) - _days_since_epoch(start)
+    return Column(DType(TypeId.INT32), d.astype(jnp.int32),
+                  end.valid_mask() & start.valid_mask())
+
+
+@func_range("dt_add_months")
+def add_months(col: Column, n: int) -> Column:
+    """Calendar-aware month shift: day-of-month clamps to the target
+    month's length (Spark add_months: Jan 31 + 1 month = Feb 28/29)."""
+    if col.dtype.type_id != TypeId.TIMESTAMP_DAYS:
+        raise NotImplementedError(
+            "add_months needs a TIMESTAMP_DAYS column")
+    y, m, d = civil_from_days(_days_since_epoch(col))
+    tot = y * 12 + (m - 1) + n
+    ny = jnp.floor_divide(tot, 12)
+    nm = tot - ny * 12 + 1
+    # clamp to the target month's last day
+    ny2 = ny + (nm == 12)
+    nm2 = jnp.where(nm == 12, 1, nm + 1)
+    month_len = (days_from_civil(ny2, nm2, jnp.ones_like(nm))
+                 - days_from_civil(ny, nm, jnp.ones_like(nm)))
+    out = days_from_civil(ny, nm, jnp.minimum(d, month_len))
+    return _int_out(col, out, DType(TypeId.TIMESTAMP_DAYS))
+
+
+_TRUNC_UNITS = ("year", "quarter", "month", "week")
+
+
+@func_range("dt_trunc")
+def trunc(col: Column, unit: str) -> Column:
+    """Truncate to the start of year/quarter/month/ISO week (Spark
+    trunc())."""
+    unit = unit.lower()
+    if unit not in _TRUNC_UNITS:
+        raise ValueError(f"trunc unit must be one of {_TRUNC_UNITS}")
+    z = _days_since_epoch(col)
+    if unit == "week":  # back to Monday
+        out = z - jnp.mod(z + 3, 7)
+    else:
+        y, m, _ = civil_from_days(z)
+        if unit == "year":
+            m = jnp.ones_like(m)
+        elif unit == "quarter":
+            m = (jnp.floor_divide(m - 1, 3) * 3) + 1
+        out = days_from_civil(y, m, jnp.ones_like(m))
+    return _int_out(col, out, DType(TypeId.TIMESTAMP_DAYS))

@@ -1,0 +1,108 @@
+"""Datetime ops vs Python's datetime module as the host oracle, over a
+range that crosses leap years, century rules, and the pre-1970 era."""
+
+import datetime as pydt
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops import datetime as dt
+
+_EPOCH = pydt.date(1970, 1, 1)
+
+
+def _dates_col(days, validity=None):
+    return Column.from_numpy(np.asarray(days, np.int32),
+                             t.TIMESTAMP_DAYS, validity=validity)
+
+
+def _sample_days(rng):
+    # 1890..2120: leap centuries (2000), non-leap centuries (1900, 2100),
+    # pre-epoch negatives
+    return rng.integers(-29220, 54787, 500).astype(np.int64)
+
+
+def test_extraction_vs_python(rng):
+    days = _sample_days(rng)
+    col = _dates_col(days)
+    got = {
+        "year": dt.year(col).to_pylist(),
+        "month": dt.month(col).to_pylist(),
+        "day": dt.day(col).to_pylist(),
+        "doy": dt.day_of_year(col).to_pylist(),
+        "dow": dt.day_of_week(col).to_pylist(),
+        "quarter": dt.quarter(col).to_pylist(),
+    }
+    for i, z in enumerate(days):
+        d = _EPOCH + pydt.timedelta(days=int(z))
+        assert got["year"][i] == d.year, d
+        assert got["month"][i] == d.month, d
+        assert got["day"][i] == d.day, d
+        assert got["doy"][i] == d.timetuple().tm_yday, d
+        assert got["dow"][i] == d.isoweekday(), d
+        assert got["quarter"][i] == (d.month - 1) // 3 + 1, d
+
+
+def test_last_day_add_months_trunc_vs_python(rng):
+    days = _sample_days(rng)
+    col = _dates_col(days)
+    last = dt.last_day(col).to_pylist()
+    plus7 = dt.add_months(col, 7).to_pylist()
+    minus13 = dt.add_months(col, -13).to_pylist()
+    ty = dt.trunc(col, "year").to_pylist()
+    tq = dt.trunc(col, "quarter").to_pylist()
+    tm = dt.trunc(col, "month").to_pylist()
+    tw = dt.trunc(col, "week").to_pylist()
+
+    def shift_months(d, n):
+        tot = d.year * 12 + (d.month - 1) + n
+        y, m = divmod(tot, 12)
+        m += 1
+        import calendar
+
+        return pydt.date(y, m, min(d.day, calendar.monthrange(y, m)[1]))
+
+    for i, z in enumerate(days):
+        d = _EPOCH + pydt.timedelta(days=int(z))
+        import calendar
+
+        want_last = pydt.date(
+            d.year, d.month, calendar.monthrange(d.year, d.month)[1])
+        assert last[i] == (want_last - _EPOCH).days, d
+        assert plus7[i] == (shift_months(d, 7) - _EPOCH).days, d
+        assert minus13[i] == (shift_months(d, -13) - _EPOCH).days, d
+        assert ty[i] == (pydt.date(d.year, 1, 1) - _EPOCH).days, d
+        qm = (d.month - 1) // 3 * 3 + 1
+        assert tq[i] == (pydt.date(d.year, qm, 1) - _EPOCH).days, d
+        assert tm[i] == (pydt.date(d.year, d.month, 1) - _EPOCH).days, d
+        assert tw[i] == (d - pydt.timedelta(days=d.isoweekday() - 1)
+                         - _EPOCH).days, d
+
+
+def test_timestamp_micros_and_nulls():
+    # 1969-12-31 23:59:59.999999 is civil day -1; 1970-01-01 00:00:00 is 0
+    us = [-1, 0, 86_400_000_000, None]
+    col = Column.from_pylist(us, t.TIMESTAMP_MICROSECONDS)
+    assert dt.year(col).to_pylist() == [1969, 1970, 1970, None]
+    assert dt.day(col).to_pylist() == [31, 1, 2, None]
+    assert dt.month(col).to_pylist() == [12, 1, 1, None]
+
+
+def test_date_add_datediff():
+    a = _dates_col([0, 100, -50], validity=np.array([True, True, False]))
+    b = _dates_col([10, 90, 1])
+    assert dt.date_add(a, 5).to_pylist() == [5, 105, None]
+    assert dt.datediff(b, a).to_pylist() == [10, -10, None]
+    with pytest.raises(NotImplementedError):
+        dt.date_add(Column.from_pylist([1], t.INT64), 1)
+    with pytest.raises(ValueError):
+        dt.trunc(a, "hour")
+
+
+def test_day_of_week_spark_convention():
+    # 1970-01-01 (day 0) was a Thursday: ISO 4, Spark 5
+    col = _dates_col([0, 3, 4])  # Thu, Sun, Mon
+    assert dt.day_of_week(col).to_pylist() == [4, 7, 1]
+    assert dt.day_of_week_spark(col).to_pylist() == [5, 1, 2]
