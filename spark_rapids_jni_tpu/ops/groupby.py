@@ -17,6 +17,7 @@ null.
 
 from __future__ import annotations
 
+import numbers
 from typing import NamedTuple, Sequence
 
 import jax
@@ -29,8 +30,10 @@ from spark_rapids_jni_tpu.types import DType, TypeId, decimal128
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 SUPPORTED_AGGS = ("sum", "count", "min", "max", "mean", "var", "std",
-                  "nunique", "first", "last", "first_include_nulls",
-                  "last_include_nulls")
+                  "var_pop", "std_pop", "nunique", "first", "last",
+                  "first_include_nulls", "last_include_nulls")
+# two-column aggregates: the agg spec is (col_x, (op, col_y))
+SUPPORTED_BINARY_AGGS = ("covar_samp", "covar_pop", "corr")
 
 
 class GroupByResult(NamedTuple):
@@ -287,6 +290,104 @@ def _mean128_exact(lo: jnp.ndarray, hi: jnp.ndarray,
     return limbs, overflow
 
 
+# ---------------------------------------------------------------------------
+# Exact DECIMAL128 variance: base-2^16 limb arithmetic.
+#
+# var_samp over unscaled 128-bit integers U is
+#     (n * ΣU² − (ΣU)²) / (n(n−1)) * 10^(2·scale) (scale here follows the columnar convention value = unscaled·10^scale)
+# The numerator is computed EXACTLY in 16-bit limbs (up to 2^316 — both
+# terms are ≤ n²·2^254) and rounded to float64 once at the end, so the
+# result carries none of the cancellation the two-pass float form suffers
+# under TPU's f32-pair float64 (~49-bit mantissa, documented posture).
+# 16-bit limbs keep every intermediate inside int64: per-row squared limbs
+# are < 2^16, so per-group lane sums are < 2^16·n ≤ 2^47; convolution
+# partial sums are < 24·2^32 < 2^37; limb×count products are < 2^47.
+# ---------------------------------------------------------------------------
+
+_M16 = jnp.int64(0xFFFF)
+
+
+def _i128_mag_limbs16(lo: jnp.ndarray, hi: jnp.ndarray):
+    """(8 magnitude limbs base 2^16, int64 each in [0, 2^16)) plus the
+    negative mask of a two's-complement (lo, hi) int64 pair."""
+    ulo = lo.astype(jnp.uint64)
+    uhi = hi.astype(jnp.uint64)
+    neg = hi < 0
+    nlo = (~ulo) + jnp.uint64(1)
+    nhi = (~uhi) + jnp.where(ulo == 0, jnp.uint64(1), jnp.uint64(0))
+    mlo = jnp.where(neg, nlo, ulo)
+    mhi = jnp.where(neg, nhi, uhi)
+    u16 = jnp.uint64(0xFFFF)
+    limbs = [((mlo >> (16 * k)) & u16).astype(jnp.int64) for k in range(4)]
+    limbs += [((mhi >> (16 * k)) & u16).astype(jnp.int64) for k in range(4)]
+    return limbs, neg
+
+
+def _carry_norm16(vals: list, width: int):
+    """Carry-normalize base-2^16 limbs (possibly signed / un-normalized
+    int64) into ``width`` limbs in [0, 2^16) + the final arithmetic carry
+    (0 when the value is non-negative and fits; -1 when negative)."""
+    carry = jnp.int64(0)
+    out = []
+    for k in range(width):
+        v = (vals[k] + carry) if k < len(vals) else (
+            carry if k else jnp.int64(0))
+        out.append(v & _M16)
+        carry = v >> 16  # arithmetic shift == floor division: signed-safe
+    return out, carry
+
+
+def _negate_limbs16_if(limbs: list, neg: jnp.ndarray) -> list:
+    """Two's-complement negate a normalized limb vector where ``neg``."""
+    out = []
+    carry = jnp.int64(1)
+    for l in limbs:
+        v = (_M16 - l) + carry
+        out.append(jnp.where(neg, v & _M16, l))
+        carry = v >> 16
+    return out
+
+
+def _conv_limbs16(a: list, b: list) -> list:
+    """Un-normalized convolution c_p = Σ_{i+j=p} a_i·b_j (schoolbook
+    multiply of two normalized limb vectors)."""
+    c = [None] * (len(a) + len(b) - 1)
+    for i, ai in enumerate(a):
+        for j, bj in enumerate(b):
+            t = ai * bj
+            c[i + j] = t if c[i + j] is None else c[i + j] + t
+    return c
+
+
+def _sub_limbs16(a: list, b: list) -> list:
+    """Exact a − b over normalized limb vectors, a ≥ b elementwise-wide."""
+    out = []
+    borrow = jnp.int64(0)
+    for x, y in zip(a, b):
+        v = x - y - borrow
+        out.append(v & _M16)
+        borrow = jnp.where(v < 0, jnp.int64(1), jnp.int64(0))
+    return out
+
+
+def _limbs16_to_f64(limbs: list) -> jnp.ndarray:
+    """Round a normalized limb vector to float64 (top-down fold: one
+    rounding per limb, ~len ulps total — vastly tighter than squaring in
+    floats)."""
+    acc = jnp.zeros_like(limbs[-1], dtype=jnp.float64)
+    for l in reversed(limbs):
+        acc = acc * 65536.0 + l.astype(jnp.float64)
+    return acc
+
+
+def _sq_limbs16_rows(lo: jnp.ndarray, hi: jnp.ndarray) -> list:
+    """Per-row U² as 16 normalized base-2^16 limbs (U² < 2^254 always
+    fits). These become int64 lanes for the streaming group-sum pass."""
+    mag, _ = _i128_mag_limbs16(lo, hi)  # sign squares away
+    sq, _carry = _carry_norm16(_conv_limbs16(mag, mag), 16)
+    return sq
+
+
 def _sum_dtype(dt: DType) -> DType:
     """Spark widens SUM: integral -> INT64, decimal keeps scale (wider
     precision), floats stay floating."""
@@ -374,7 +475,13 @@ def groupby_aggregate(
     (``groupby_aggregate_auto``).
     """
     for _, op in aggs:
-        if op not in SUPPORTED_AGGS:
+        if isinstance(op, tuple):
+            if (len(op) != 2 or op[0] not in SUPPORTED_BINARY_AGGS
+                    or not isinstance(op[1], numbers.Integral)):
+                raise ValueError(
+                    f"unsupported binary aggregation {op!r}; expected "
+                    f"(op, col_y) with op in {SUPPORTED_BINARY_AGGS}")
+        elif op not in SUPPORTED_AGGS:
             raise ValueError(f"unsupported aggregation {op!r}")
     n = table.num_rows
     m = n if max_groups is None else int(max_groups)
@@ -463,10 +570,37 @@ def groupby_aggregate(
 
     _M32 = jnp.int64(0xFFFFFFFF)
 
-    plan = []  # (op, column, acc_dt, lane ids / None)
+    plan = []  # (op, column, acc_dt / other column, lane ids / None)
     for col_idx, op in aggs:
         c = sorted_tbl.column(col_idx)
         valid = c.valid_mask()
+        if isinstance(op, tuple):
+            # binary aggregates (covar_samp/covar_pop/corr): Spark counts
+            # only rows where BOTH operands are non-null, so these ride
+            # dedicated pairwise-masked sum + count lanes (memoized per
+            # column pair — corr shares them with sibling covar aggs).
+            kind, oidx = op
+            cy = sorted_tbl.column(oidx)
+            for cc in (c, cy):
+                if (cc.dtype.is_string or cc.dtype.is_decimal128
+                        or cc.dtype.storage_dtype.kind not in
+                        ("i", "u", "f")):
+                    raise TypeError(
+                        f"{kind} needs numeric (non-DECIMAL128) columns, "
+                        f"got {cc.dtype}")
+            both = valid & cy.valid_mask()
+            pair = (id(c), id(cy))
+            both_lane = lane(both, memo_key=(pair, "count2"))
+            specs = []
+            for cc, tag in ((c, "sx"), (cy, "sy")):
+                vv = jnp.where(both, cc.data, jnp.zeros_like(cc.data))
+                mk = (pair, tag)
+                specs.append(
+                    lane(vv, memo_key=mk)
+                    if cc.dtype.storage_dtype.kind in ("i", "u")
+                    else flane(vv, memo_key=mk))
+            plan.append((kind, c, cy, tuple(specs), both_lane))
+            continue
         count_lane = lane(valid, memo_key=(id(c), "count"))
         if op in ("sum", "mean") and c.dtype.is_decimal128:
             # exact 128-bit sum: split (lo, hi) into four 32-bit limb
@@ -490,11 +624,28 @@ def groupby_aggregate(
             else:
                 plan.append(("sum128", c, c.dtype, lanes128, count_lane))
             continue
-        if op in ("var", "std"):
+        if op in ("var", "std", "var_pop", "std_pop"):
             if c.dtype.is_decimal128:
-                raise NotImplementedError(
-                    "DECIMAL128 variance needs exact wide arithmetic"
-                )
+                # exact wide second moments: 8 signed ±|U| limb lanes for
+                # ΣU plus 16 per-row U² limb lanes for ΣU² — every lane
+                # sum is exact int64; the variance numerator is combined
+                # in wide limb arithmetic in the consume loop and rounded
+                # to float64 once.
+                lo = jnp.where(valid, c.data[:, 0], jnp.int64(0))
+                hi = jnp.where(valid, c.data[:, 1], jnp.int64(0))
+                mag, negr = _i128_mag_limbs16(lo, hi)
+                key128 = id(c)
+                sum_specs = tuple(
+                    lane(jnp.where(negr, -mag[k], mag[k]),
+                         memo_key=(key128, "v128s", k))
+                    for k in range(8))
+                sq = _sq_limbs16_rows(lo, hi)
+                sq_specs = tuple(
+                    lane(sq[k], memo_key=(key128, "v128q", k))
+                    for k in range(16))
+                plan.append((op + "128", c, None, (sum_specs, sq_specs),
+                             count_lane))
+                continue
             if c.dtype.is_string or                     c.dtype.storage_dtype.kind not in ("i", "u", "f"):
                 raise TypeError(
                     f"var/std need a numeric column, got {c.dtype}"
@@ -528,6 +679,8 @@ def groupby_aggregate(
     _rank_order_cache: dict = {}  # value-sort order per column, shared
                                   # between a column's min and max aggs
     _var_cache: dict = {}         # per-column variance, shared var<->std
+    _covar_cache: dict = {}       # per-pair centered moments, shared
+                                  # between covar_samp/covar_pop/corr
 
     def _rank_minmax(c: Column, op: str, vcount: jnp.ndarray) -> Column:
         """MIN/MAX of a column with no elementwise-reducible storage
@@ -638,34 +791,123 @@ def groupby_aggregate(
                     mean = mean * (10.0 ** c.dtype.scale)
                 out_cols.append(Column(DType(TypeId.FLOAT64), mean, has_any))
             continue
-        if op in ("var", "std"):
-            # sample variance (Spark var_samp/stddev_samp): two-pass
-            # centered form in float64 for numerical robustness, computed
-            # once per column and shared between sibling var/std aggs
-            # (the _rank_order_cache pattern). The group sum came from the
-            # lane machinery (exact int64 for integral/decimal storage);
-            # the centered second pass is one more _seg_sums lane — zero
-            # scatters end to end. NB: TPU f64 is f32-pair emulated
-            # (~49-bit mantissa) — documented precision posture, matching
-            # the mean contract.
+        if op in ("var128", "std128", "var_pop128", "std_pop128"):
+            # exact DECIMAL128 variance: combine the 8+16 exact lane sums
+            # into n·ΣU² − (ΣU)² with base-2^16 limb arithmetic (≤ 2^316,
+            # every intermediate in int64), round to float64 once, then
+            # divide by n(n−1) (sample) or n² (population) and apply
+            # 10^(2·scale). The exact numerator is cached per column and
+            # shared by all four variants.
             cache_key = id(c)
             if cache_key not in _var_cache:
-                scale_f = (10.0 ** c.dtype.scale) if c.dtype.is_decimal                     else 1.0
+                sum_specs, sq_specs = val_lane
+                s_lanes = [seg_col(i) for i in sum_specs]
+                q_lanes = [seg_col(i) for i in sq_specs]
+                # exact ΣU: signed lane sums → 12 normalized limbs + sign
+                # (|ΣU| < 2^16·2^31·2^112 = 2^159 < 2^192); the final
+                # carry is the sign (-1 ⟺ negative)
+                sl, s_carry = _carry_norm16(s_lanes, 12)
+                sl = _negate_limbs16_if(sl, s_carry < 0)
+                # (ΣU)²: 12×12 convolution → 24 normalized limbs
+                bsq, _ = _carry_norm16(_conv_limbs16(sl, sl), 24)
+                # n·ΣU²: lane sums (< 2^47) → 20 limbs, × count (< 2^31
+                # keeps limb·n < 2^47), renormalized to 24
+                ql, _ = _carry_norm16(q_lanes, 20)
+                nq, _ = _carry_norm16([q * vcount for q in ql], 24)
+                # numerator is ≥ 0 by Cauchy–Schwarz — exact subtraction
+                num = _limbs16_to_f64(_sub_limbs16(nq, bsq))
+                _var_cache[cache_key] = num * (10.0 ** (2 * c.dtype.scale))
+            pop = "pop" in op
+            denom = (vcount * vcount if pop
+                     else vcount * (vcount - 1))
+            var = _var_cache[cache_key] / jnp.maximum(
+                denom, 1).astype(jnp.float64)
+            out_val = jnp.sqrt(var) if op.startswith("std") else var
+            out_cols.append(Column(
+                DType(TypeId.FLOAT64), out_val,
+                vcount > (0 if pop else 1)
+            ))
+            continue
+        if op in ("var", "std", "var_pop", "std_pop"):
+            # variance (Spark var_samp/stddev_samp/var_pop/stddev_pop):
+            # two-pass centered form in float64 for numerical robustness;
+            # the centered second moment M2 is computed once per column
+            # and shared by all four variants (the _rank_order_cache
+            # pattern). The group sum came from the lane machinery (exact
+            # int64 for integral/decimal storage); the centered second
+            # pass is one more _seg_sums lane — zero scatters end to end.
+            # NB: TPU f64 is f32-pair emulated (~49-bit mantissa) —
+            # documented precision posture, matching the mean contract.
+            cache_key = id(c)
+            if cache_key not in _var_cache:
+                scale_f = (10.0 ** c.dtype.scale) if c.dtype.is_decimal \
+                    else 1.0
                 denom = jnp.maximum(vcount, 1).astype(jnp.float64)
-                mean_g = seg_col(val_lane).astype(jnp.float64) * scale_f                     / denom
+                mean_g = seg_col(val_lane).astype(jnp.float64) * scale_f \
+                    / denom
                 if n:
                     x = c.data.astype(jnp.float64) * scale_f
                     centered = jnp.where(valid, x - mean_g[_row_gid()], 0.0)
                     m2 = _seg_sums((centered * centered)[:, None])[:, 0]
                 else:
                     m2 = jnp.zeros((m,), jnp.float64)
-                _var_cache[cache_key] = m2 / jnp.maximum(
+                _var_cache[cache_key] = m2
+            pop = op.endswith("_pop")
+            var = _var_cache[cache_key] / jnp.maximum(
+                vcount - (0 if pop else 1), 1).astype(jnp.float64)
+            out_val = jnp.sqrt(var) if op.startswith("std") else var
+            out_cols.append(Column(
+                DType(TypeId.FLOAT64), out_val,
+                vcount > (0 if pop else 1)
+            ))
+            continue
+        if op in ("covar_samp", "covar_pop", "corr"):
+            # pairwise centered moments Σcx·cy, Σcx², Σcy² in one
+            # _seg_sums pass (float64 two-pass form, the var posture),
+            # cached per column pair so corr + sibling covar aggs share
+            # it. vcount here is the BOTH-non-null count (Spark's
+            # Covariance/Corr row semantics).
+            cy = acc_dt
+            spec_x, spec_y = val_lane
+            cache_key = (id(c), id(cy))
+            if cache_key not in _covar_cache:
+                sfx = (10.0 ** c.dtype.scale) if c.dtype.is_decimal else 1.0
+                sfy = (10.0 ** cy.dtype.scale) if cy.dtype.is_decimal \
+                    else 1.0
+                denom = jnp.maximum(vcount, 1).astype(jnp.float64)
+                mean_x = seg_col(spec_x).astype(jnp.float64) * sfx / denom
+                mean_y = seg_col(spec_y).astype(jnp.float64) * sfy / denom
+                if n:
+                    both = valid & cy.valid_mask()
+                    gid = _row_gid()
+                    cxv = jnp.where(
+                        both,
+                        c.data.astype(jnp.float64) * sfx - mean_x[gid], 0.0)
+                    cyv = jnp.where(
+                        both,
+                        cy.data.astype(jnp.float64) * sfy - mean_y[gid],
+                        0.0)
+                    moments = _seg_sums(jnp.stack(
+                        [cxv * cyv, cxv * cxv, cyv * cyv], axis=1))
+                else:
+                    moments = jnp.zeros((m, 3), jnp.float64)
+                _covar_cache[cache_key] = moments
+            sxy, sxx, syy = (
+                _covar_cache[cache_key][:, i] for i in range(3))
+            if op == "corr":
+                # constant series / singleton groups give 0/0 → NaN, the
+                # Spark Corr value posture; only empty groups are null
+                out_val = sxy / jnp.sqrt(sxx * syy)
+                validity = vcount > 0
+            elif op == "covar_pop":
+                out_val = sxy / jnp.maximum(vcount, 1).astype(jnp.float64)
+                validity = vcount > 0
+            else:  # covar_samp: n ≤ 1 is null (the var_samp posture)
+                out_val = sxy / jnp.maximum(
                     vcount - 1, 1).astype(jnp.float64)
-            var = _var_cache[cache_key]
-            out_val = jnp.sqrt(var) if op == "std" else var
+                validity = vcount > 1
             out_cols.append(
-                Column(DType(TypeId.FLOAT64), out_val, vcount > 1)
-            )
+                Column(DType(TypeId.FLOAT64), out_val, validity))
             continue
         if op == "nunique":
             # distinct non-null values per group: secondary sort by

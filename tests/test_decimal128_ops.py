@@ -316,3 +316,97 @@ def test_decimal128_mean_exact_vs_bigint_oracle():
     res3 = groupby_aggregate(tbl3, [0], [(1, "mean")])
     assert bool(np.asarray(res3.sum_overflow))
     assert not np.asarray(res3.compact().column(1).valid_mask())[0]
+
+
+def test_decimal128_var_std_exact_vs_fraction_oracle():
+    """var/std on DECIMAL128: the numerator n*ΣU² − (ΣU)² is computed in
+    exact base-2^16 limb arithmetic and rounded to float64 once — compare
+    against a Python Fraction oracle on values spanning both limbs
+    (groupby.py var128 consume branch)."""
+    import random
+    from fractions import Fraction
+
+    random.seed(11)
+    n = 400
+    keys = [random.randrange(6) for _ in range(n)]
+    vals = [((-1) ** i) * random.getrandbits(110) for i in range(n)]
+    vals[3] = None
+    vals[7] = None
+    scale = -2
+    tbl = Table([
+        Column.from_pylist(keys, t.INT64),
+        Column.from_pylist(vals, t.decimal128(scale)),
+    ])
+    out = groupby_aggregate(
+        tbl, [0], [(1, "var"), (1, "std")]).compact()
+    got_var = out.column(1).to_pylist()
+    got_std = out.column(2).to_pylist()
+    for k, gv, gs in zip(out.column(0).to_pylist(), got_var, got_std):
+        sel = [v for kk, v in zip(keys, vals)
+               if kk == k and v is not None]
+        cnt = len(sel)
+        s1, s2 = sum(sel), sum(v * v for v in sel)
+        want = Fraction(cnt * s2 - s1 * s1,
+                        cnt * (cnt - 1)) * Fraction(10) ** (2 * scale)
+        assert abs(gv - float(want)) <= 1e-12 * float(want), k
+        assert abs(gs - float(want) ** 0.5) <= 1e-12 * float(want) ** 0.5
+
+
+def test_decimal128_var_null_and_singleton_groups():
+    """count<=1 groups are null (Spark var_samp posture shared with the
+    float path); all-null groups too; a constant group has variance 0."""
+    keys = [1, 2, 2, 3, 3, 4, 4, 4]
+    vals = [7, None, None, 5, 5, 1, 2, 3]
+    tbl = Table([
+        Column.from_pylist(keys, t.INT64),
+        Column.from_pylist(vals, t.decimal128(0)),
+    ])
+    out = groupby_aggregate(tbl, [0], [(1, "var")]).compact()
+    got = out.column(1).to_pylist()
+    assert got[0] is None        # singleton
+    assert got[1] is None        # all-null
+    assert got[2] == 0.0         # constant group
+    assert got[3] == 1.0         # var_samp({1,2,3}) == 1
+
+
+def test_decimal128_var_extreme_magnitudes():
+    """Values near ±2^127: U² ≈ 2^254 exercises every limb position; the
+    exact-numerator path must not overflow or lose the small spread."""
+    big = (1 << 126) + 12345
+    vals = [big, big + 100, big - 100, -big, -(big + 100), -(big - 100)]
+    keys = [1, 1, 1, 2, 2, 2]
+    tbl = Table([
+        Column.from_pylist(keys, t.INT64),
+        Column.from_pylist(vals, t.decimal128(0)),
+    ])
+    out = groupby_aggregate(tbl, [0], [(1, "var")]).compact()
+    # exact sample variance of {b-100, b, b+100} is 10000 regardless of b
+    assert out.column(1).to_pylist() == [10000.0, 10000.0]
+
+
+def test_decimal128_var_pop_exact():
+    """var_pop on DECIMAL128 shares the exact numerator with var_samp:
+    denominator n², singleton groups valid 0.0."""
+    from fractions import Fraction
+
+    vals = [(1 << 100) + 7, (1 << 100) - 13, 5, 6]
+    keys = [1, 1, 1, 2]
+    tbl = Table([
+        Column.from_pylist(keys, t.INT64),
+        Column.from_pylist(vals, t.decimal128(-1)),
+    ])
+    out = groupby_aggregate(
+        tbl, [0], [(1, "var_pop"), (1, "std_pop")]).compact()
+    for k, gv in zip(out.column(0).to_pylist(),
+                     out.column(1).to_pylist()):
+        sel = [v for kk, v in zip(keys, vals) if kk == k]
+        cnt = len(sel)
+        s1, s2 = sum(sel), sum(v * v for v in sel)
+        want = float(Fraction(cnt * s2 - s1 * s1, cnt * cnt)
+                     * Fraction(1, 100))
+        assert abs(gv - want) <= 1e-12 * max(want, 1.0), k
+    # std_pop is the sqrt of var_pop, and singleton groups are valid 0.0
+    got_var = out.column(1).to_pylist()
+    got_std = out.column(2).to_pylist()
+    assert got_std == [v ** 0.5 for v in got_var]
+    assert got_var[1] == 0.0 and got_std[1] == 0.0

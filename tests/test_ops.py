@@ -830,3 +830,87 @@ def test_groupby_percentile_median_decimal_and_errors():
                Column.from_pylist(["x"], t.STRING)])
     with pytest.raises(NotImplementedError):
         groupby_percentile(s, [0], 1, [0.5])
+
+
+def test_groupby_var_pop_std_pop_vs_numpy(rng):
+    """Population variants (Spark var_pop/stddev_pop): denominator n, and
+    singleton groups are 0.0 (valid), not null — only empty/all-null
+    groups are null."""
+    keys = rng.integers(0, 8, 900).astype(np.int32)
+    keys[0] = 99  # guaranteed singleton group
+    vals = rng.normal(scale=12, size=900)
+    vvalid = rng.random(900) > 0.2
+    vvalid[0] = True
+    tbl = Table([Column.from_numpy(keys),
+                 Column.from_numpy(vals, validity=vvalid)])
+    out = groupby_aggregate(
+        tbl, [0], [(1, "var_pop"), (1, "std_pop")]).compact()
+    got_k = np.asarray(out.column(0).data)
+    for i, k in enumerate(got_k):
+        sel = vals[(keys == k) & vvalid]
+        if len(sel) >= 1:
+            assert np.isclose(np.asarray(out.column(1).data)[i],
+                              sel.var(ddof=0), rtol=1e-5, atol=1e-12), k
+            assert np.isclose(np.asarray(out.column(2).data)[i],
+                              sel.std(ddof=0), rtol=1e-5, atol=1e-12), k
+            assert bool(np.asarray(out.column(1).valid_mask())[i])
+        else:
+            assert not np.asarray(out.column(1).valid_mask())[i]
+
+
+def test_groupby_covar_corr_vs_numpy(rng):
+    """covar_samp/covar_pop/corr two-column aggregates: Spark counts only
+    rows where BOTH operands are non-null; corr of a constant series is
+    NaN (0/0), empty groups null."""
+    n = 1100
+    keys = rng.integers(0, 7, n).astype(np.int64)
+    x = rng.normal(size=n) * 3.0
+    y = 0.6 * x + rng.normal(size=n)
+    xv = rng.random(n) > 0.15
+    yv = rng.random(n) > 0.15
+    tbl = Table([Column.from_numpy(keys),
+                 Column.from_numpy(x, validity=xv),
+                 Column.from_numpy(y, validity=yv)])
+    out = groupby_aggregate(tbl, [0], [
+        (1, ("covar_samp", 2)), (1, ("covar_pop", 2)), (1, ("corr", 2)),
+    ]).compact()
+    got_k = np.asarray(out.column(0).data)
+    for i, k in enumerate(got_k):
+        sel = (keys == k) & xv & yv
+        xs, ys = x[sel], y[sel]
+        m = len(xs)
+        cpop = float(np.mean((xs - xs.mean()) * (ys - ys.mean()))) if m \
+            else None
+        if m > 1:
+            assert np.isclose(np.asarray(out.column(1).data)[i],
+                              float(np.cov(xs, ys, ddof=1)[0, 1]),
+                              rtol=1e-5), k
+            assert np.isclose(np.asarray(out.column(3).data)[i],
+                              float(np.corrcoef(xs, ys)[0, 1]),
+                              rtol=1e-5), k
+        else:
+            assert not np.asarray(out.column(1).valid_mask())[i]
+        if m >= 1:
+            assert np.isclose(np.asarray(out.column(2).data)[i], cpop,
+                              rtol=1e-5, atol=1e-12), k
+        else:
+            assert not np.asarray(out.column(2).valid_mask())[i]
+
+
+def test_groupby_corr_constant_series_nan():
+    tbl = Table([Column.from_numpy(np.zeros(3, np.int32)),
+                 Column.from_numpy(np.array([5.0, 5.0, 5.0])),
+                 Column.from_numpy(np.array([1.0, 2.0, 3.0]))])
+    out = groupby_aggregate(tbl, [0], [(1, ("corr", 2))]).compact()
+    assert bool(np.asarray(out.column(1).valid_mask())[0])
+    assert np.isnan(np.asarray(out.column(1).data)[0])
+
+
+def test_groupby_binary_agg_validation():
+    tbl = Table([Column.from_numpy(np.zeros(2, np.int32)),
+                 Column.from_numpy(np.ones(2, np.int64)),
+                 Column.from_pylist(["a", "b"], t.STRING)])
+    with pytest.raises(ValueError, match="binary"):
+        groupby_aggregate(tbl, [0], [(1, ("cov", 1))])
+    with pytest.raises(TypeError, match="numeric"):
+        groupby_aggregate(tbl, [0], [(1, ("corr", 2))])

@@ -452,3 +452,31 @@ def test_distributed_groupby_percentile_matches_local(rng, mesh):
             assert (a is None) == (b is None), k
             if a is not None:
                 assert a == pytest.approx(b), k
+
+
+def test_distributed_groupby_covar_corr(rng, mesh):
+    """Binary aggregates ride the same whole-group-shuffle plan, so
+    covar/corr are exact over the mesh too."""
+    n = 512
+    keys = rng.integers(0, 9, n).astype(np.int64)
+    x = rng.normal(size=n)
+    y = 0.3 * x + rng.normal(size=n)
+    tbl = Table([Column.from_numpy(keys), Column.from_numpy(x),
+                 Column.from_numpy(y)])
+    sharded = shard_table(tbl, mesh)
+    res = distributed_groupby_aggregate(
+        sharded, [0], [(1, ("covar_samp", 2)), (1, ("corr", 2))],
+        mesh, capacity=n,
+    )
+    out = collect(res.table, res.num_groups, mesh)
+    kv = out.column(0).to_pylist()
+    got_cov = {kv[i]: out.column(1).to_pylist()[i]
+               for i in range(out.num_rows) if kv[i] is not None}
+    got_corr = {kv[i]: out.column(2).to_pylist()[i]
+                for i in range(out.num_rows) if kv[i] is not None}
+    for k in np.unique(keys):
+        xs, ys = x[keys == k], y[keys == k]
+        assert np.isclose(got_cov[int(k)],
+                          float(np.cov(xs, ys, ddof=1)[0, 1]), rtol=1e-5)
+        assert np.isclose(got_corr[int(k)],
+                          float(np.corrcoef(xs, ys)[0, 1]), rtol=1e-5)
