@@ -477,10 +477,12 @@ def groupby_aggregate(
     for _, op in aggs:
         if isinstance(op, tuple):
             if (len(op) != 2 or op[0] not in SUPPORTED_BINARY_AGGS
-                    or not isinstance(op[1], numbers.Integral)):
+                    or not isinstance(op[1], numbers.Integral)
+                    or not 0 <= op[1] < table.num_columns):
                 raise ValueError(
                     f"unsupported binary aggregation {op!r}; expected "
-                    f"(op, col_y) with op in {SUPPORTED_BINARY_AGGS}")
+                    f"(op, col_y) with op in {SUPPORTED_BINARY_AGGS} and "
+                    f"col_y a column index of the input table")
         elif op not in SUPPORTED_AGGS:
             raise ValueError(f"unsupported aggregation {op!r}")
     n = table.num_rows
@@ -646,7 +648,8 @@ def groupby_aggregate(
                 plan.append((op + "128", c, None, (sum_specs, sq_specs),
                              count_lane))
                 continue
-            if c.dtype.is_string or                     c.dtype.storage_dtype.kind not in ("i", "u", "f"):
+            if c.dtype.is_string or \
+                    c.dtype.storage_dtype.kind not in ("i", "u", "f"):
                 raise TypeError(
                     f"var/std need a numeric column, got {c.dtype}"
                 )

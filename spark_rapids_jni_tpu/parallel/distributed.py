@@ -359,7 +359,8 @@ def distributed_window(
     ``("first_value", col_idx)``, ``("last_value", col_idx)``,
     ``("nth_value", col_idx, k)``, and
     ``("rolling_<sum|count|mean|min|max>", col_idx, preceding,
-    following)``. Results come back sharded, aligned to
+    following)``, and ``("rolling_<var|std>", col_idx, preceding,
+    following[, ddof])``. Results come back sharded, aligned to
     the shuffled rows; filter output by the returned ``row_valid``.
 
     ``row_valid`` is REQUIRED (use ``shard_table(...,
@@ -407,6 +408,11 @@ def distributed_window(
                           "rolling_min", "rolling_max"):
                 out_cols.append(getattr(w, kind)(
                     spec[1] + 1, spec[2], spec[3]))
+            elif kind in ("rolling_var", "rolling_std"):
+                # optional trailing ddof (default 1 = sample)
+                out_cols.append(getattr(w, kind)(
+                    spec[1] + 1, spec[2], spec[3],
+                    spec[4] if len(spec) > 4 else 1))
             else:
                 raise ValueError(f"unknown window spec {spec!r}")
         return (sh.table, Table(out_cols), sh.row_valid,

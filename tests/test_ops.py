@@ -912,5 +912,9 @@ def test_groupby_binary_agg_validation():
                  Column.from_pylist(["a", "b"], t.STRING)])
     with pytest.raises(ValueError, match="binary"):
         groupby_aggregate(tbl, [0], [(1, ("cov", 1))])
+    with pytest.raises(ValueError, match="binary"):
+        groupby_aggregate(tbl, [0], [(1, ("corr", -1))])  # no wraparound
+    with pytest.raises(ValueError, match="binary"):
+        groupby_aggregate(tbl, [0], [(1, ("corr", 3))])   # out of range
     with pytest.raises(TypeError, match="numeric"):
         groupby_aggregate(tbl, [0], [(1, ("corr", 2))])

@@ -326,7 +326,8 @@ def test_distributed_window_new_specs_match_local(rng):
     specs = [("ntile", 3), ("percent_rank",), ("cume_dist",),
              ("first_value", 2), ("last_value", 2), ("nth_value", 2, 2),
              ("rolling_sum", 2, 2, 1), ("rolling_min", 2, 2, 1),
-             ("rolling_max", 2, 1, 0)]
+             ("rolling_max", 2, 1, 0), ("rolling_var", 2, 2, 1),
+             ("rolling_std", 2, 3, 1, 0)]
     dw = distributed_window(sharded, [0], [1], specs, mesh, rv,
                             capacity=n)
     assert not np.asarray(dw.overflowed).any()
@@ -342,6 +343,9 @@ def test_distributed_window_new_specs_match_local(rng):
         ("rolling_sum", 2, 2, 1): w.rolling_sum(2, 2, 1).to_pylist(),
         ("rolling_min", 2, 2, 1): w.rolling_min(2, 2, 1).to_pylist(),
         ("rolling_max", 2, 1, 0): w.rolling_max(2, 1, 0).to_pylist(),
+        ("rolling_var", 2, 2, 1): w.rolling_var(2, 2, 1).to_pylist(),
+        ("rolling_std", 2, 3, 1, 0): w.rolling_std(
+            2, 3, 1, 0).to_pylist(),
     }
     import collections
 
@@ -361,3 +365,77 @@ def test_distributed_window_new_specs_match_local(rng):
             ((part[i], order[i], vals[i]), round6(local[spec][i]))
             for i in range(n))
         assert got == want, spec
+
+
+def test_rolling_var_std_vs_oracle(rng):
+    """Rolling VAR/STD (ddof 1 and 0) vs numpy per-frame brute force:
+    partition-mean centering must reproduce the plain two-pass result;
+    frames with count <= ddof are null."""
+    n = 180
+    part = rng.integers(0, 5, n).astype(np.int64)
+    order = rng.integers(0, 40, n).astype(np.int32)
+    vals = (rng.normal(scale=1e6, size=n) + 3e8)  # large offset stresses
+    vvalid = rng.random(n) > 0.2                  # the centering
+    tbl = Table([
+        Column.from_numpy(part),
+        Column.from_numpy(order),
+        Column.from_numpy(vals, validity=vvalid),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    rows = sorted(range(n), key=lambda i: (part[i], order[i], i))
+    by_part = {}
+    for i in rows:
+        by_part.setdefault(part[i], []).append(i)
+    for p, f, ddof in ((3, 0, 1), (2, 2, 1), (4, 1, 0), (0, 0, 0)):
+        got_v = w.rolling_var(2, p, f, ddof).to_pylist()
+        got_s = w.rolling_std(2, p, f, ddof).to_pylist()
+        for pid, seq in by_part.items():
+            for j, i in enumerate(seq):
+                frame = seq[max(j - p, 0): j + f + 1]
+                sel = np.array([vals[r] for r in frame if vvalid[r]])
+                if len(sel) > ddof:
+                    want = float(sel.var(ddof=ddof))
+                    # noise floor of the prefix-difference form is
+                    # ~eps * partition-accumulated cx^2: cx ~ 1e6 over
+                    # ~40-row partitions gives ~4e13 * 2.2e-16 ~ 1e-2
+                    # absolute (5e-15 relative to the ~1e12 variances);
+                    # std's floor is its square root
+                    assert got_v[i] == pytest.approx(
+                        want, rel=1e-6, abs=0.05), (p, f, ddof, i)
+                    assert got_s[i] == pytest.approx(
+                        want ** 0.5, rel=1e-6, abs=0.25), (p, f, ddof, i)
+                else:
+                    assert got_v[i] is None, (p, f, ddof, i)
+                    assert got_s[i] is None, (p, f, ddof, i)
+
+
+def test_rolling_var_decimal_rescales():
+    tbl = Table([
+        Column.from_numpy(np.zeros(4, np.int64)),
+        Column.from_numpy(np.arange(4, dtype=np.int32)),
+        Column.from_numpy(np.array([100, 300, 500, 900], np.int64),
+                          t.decimal64(-2)),  # 1.0, 3.0, 5.0, 9.0
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    got = w.rolling_var(2, 3, 0, 1).to_pylist()
+    assert got[3] == pytest.approx(
+        float(np.array([1.0, 3.0, 5.0, 9.0]).var(ddof=1)))
+
+
+def test_rolling_var_rejects_bad_inputs():
+    tbl = Table([
+        Column.from_numpy(np.zeros(2, np.int64)),
+        Column.from_numpy(np.arange(2, dtype=np.int32)),
+        Column.from_pylist(["a", "b"], t.STRING),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    with pytest.raises(TypeError, match="numeric"):
+        w.rolling_var(2, 1, 0)
+    tbl2 = Table([
+        Column.from_numpy(np.zeros(2, np.int64)),
+        Column.from_numpy(np.arange(2, dtype=np.int32)),
+        Column.from_numpy(np.ones(2, np.int64)),
+    ])
+    with pytest.raises(ValueError, match="ddof"):
+        Window(tbl2, partition_by=[0], order_by=[1]).rolling_var(
+            2, 1, 0, ddof=2)
