@@ -1,0 +1,214 @@
+"""LIST-column operators: explode/posexplode and collect_list/collect_set.
+
+cuDF ships ``explode``/``explode_position`` and the ``collect_list``/
+``collect_set`` groupby aggregations as part of the vendored capability
+surface (SURVEY.md section 2.2 — libcudf columnar engine; Spark lowers
+``explode()``, ``posexplode()``, ``collect_list()``, ``collect_set()``
+straight onto them). The TPU designs here are scatter-free:
+
+- ``explode``: each output slot finds its parent row with ONE searchsorted
+  against the per-row start positions, then gathers. Inner and outer
+  explode share the mechanism — outer adds one slot for every empty/null
+  list (start = offsets + running empty count), which reproduces Spark's
+  exact interleaved row order with static shapes (output padded to the
+  worst case, ``row_valid`` reports the live slots).
+- ``groupby_collect``: stable key sort + one boolean argsort compacts each
+  group's kept values into a dense child in input order; list offsets are
+  a cumsum of per-group keep counts. ``distinct=True`` re-sorts by
+  (keys, value) and keeps first occurrences — set semantics with
+  value-ordered output (Spark's collect_set leaves order unspecified).
+
+Null semantics are Spark's: collect_list/collect_set SKIP null values and
+return EMPTY lists (never null) for groups with no kept values; explode
+drops null/empty lists, explode_outer emits one all-null row for them.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import (
+    _dense_group_bounds,
+    _gather_group_keys,
+    _rows_equal_prev,
+    _col_values_equal_prev,
+)
+from spark_rapids_jni_tpu.ops.sort import gather, sort_order
+from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+def make_list_column(values: Sequence, element_dtype: DType) -> Column:
+    """Host-side LIST builder from ``[[...], None, [...]]`` pylists (the
+    test/ingest convenience mirroring ``Column.from_pylist``)."""
+    import numpy as np
+
+    offsets = np.zeros(len(values) + 1, dtype=np.int32)
+    flat: list = []
+    valid = np.ones(len(values), dtype=bool)
+    for i, v in enumerate(values):
+        if v is None:
+            valid[i] = False
+            offsets[i + 1] = offsets[i]
+        else:
+            flat.extend(v)
+            offsets[i + 1] = offsets[i] + len(v)
+    child = Column.from_pylist(flat, element_dtype)
+    return Column(
+        DType(TypeId.LIST), jnp.asarray(offsets),
+        None if valid.all() else jnp.asarray(valid),
+        children=[child],
+    )
+
+
+class ExplodeResult(NamedTuple):
+    table: Table              # exploded rows, padded to the static bound
+    row_valid: jnp.ndarray    # bool[out_n]: live output slots
+    num_rows: jnp.ndarray     # scalar int64 true output row count
+
+
+def _gather_any(c: Column, idx: jnp.ndarray, extra_valid) -> Column:
+    """Gather a non-LIST column at ``idx`` with extra invalidation."""
+    valid = c.valid_mask()[idx] & extra_valid
+    if c.dtype.is_string:
+        from spark_rapids_jni_tpu.ops import strings as s
+
+        g = s.gather_strings(c, idx)
+        return Column(c.dtype, g.data, valid, chars=g.chars)
+    return Column(c.dtype, c.data[idx], valid)
+
+
+@func_range("explode")
+def explode(table: Table, col_idx: int, *, outer: bool = False,
+            position: bool = False) -> ExplodeResult:
+    """Explode the LIST column ``col_idx``: one output row per element,
+    the other columns repeated, in Spark's exact interleaved order.
+
+    ``outer=True`` (Spark ``explode_outer``) keeps rows whose list is
+    empty or null as a single row with a null element. ``position=True``
+    (Spark ``posexplode``) inserts an INT32 0-based position column just
+    before the element column. Output is padded to the static worst case
+    (child length, + row count when outer); ``row_valid`` marks live
+    slots and ``num_rows`` is the true count.
+    """
+    lc = table.column(col_idx)
+    if lc.dtype.type_id != TypeId.LIST:
+        raise TypeError(f"explode needs a LIST column, got {lc.dtype}")
+    child = lc.children[0]
+    if child.dtype.type_id == TypeId.LIST:
+        raise NotImplementedError("explode of nested LIST-of-LIST")
+    n = lc.size
+    offsets = lc.data.astype(jnp.int64)
+    list_valid = lc.valid_mask()
+    # treat null lists as length 0 (they contribute rows only under outer)
+    lens = jnp.where(list_valid, offsets[1:] - offsets[:-1], 0)
+    starts_inner = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64), jnp.cumsum(lens)])
+    if outer:
+        empty = (lens == 0).astype(jnp.int64)
+        starts = starts_inner + jnp.concatenate(
+            [jnp.zeros((1,), jnp.int64), jnp.cumsum(empty)])
+    else:
+        starts = starts_inner
+    total = starts[-1]
+    out_n = int(child.size) + (n if outer else 0)
+    k = jnp.arange(out_n, dtype=jnp.int64)
+    parent = jnp.clip(
+        jnp.searchsorted(starts, k, side="right") - 1, 0, max(n - 1, 0)
+    ).astype(jnp.int32)
+    j = k - starts[parent]
+    live = k < total
+    has_elem = live & (j < lens[parent])
+    # element index into the ORIGINAL child buffer (null lists have
+    # lens == 0, so has_elem is False and the clipped index is unused)
+    eidx = jnp.clip(offsets[parent] + j, 0,
+                    max(int(child.size) - 1, 0)).astype(jnp.int32)
+    out_cols: list[Column] = []
+    for ci in range(table.num_columns):
+        if ci == col_idx:
+            if position:
+                out_cols.append(Column(
+                    DType(TypeId.INT32), j.astype(jnp.int32), has_elem))
+            out_cols.append(_gather_any(child, eidx, has_elem))
+        else:
+            c = table.column(ci)
+            if c.dtype.type_id in (TypeId.LIST, TypeId.STRUCT):
+                raise NotImplementedError(
+                    "explode alongside other nested columns")
+            out_cols.append(_gather_any(c, parent, live))
+    return ExplodeResult(Table(out_cols), live, total)
+
+
+class CollectResult(NamedTuple):
+    table: Table              # keys then ONE LIST column, padded to m rows
+    num_groups: jnp.ndarray   # scalar int32
+
+
+@func_range("groupby_collect")
+def groupby_collect(table: Table, keys: Sequence[int], value_col: int,
+                    *, distinct: bool = False) -> CollectResult:
+    """collect_list (``distinct=False``) / collect_set (``distinct=True``)
+    of ``value_col`` grouped by ``keys``.
+
+    The LIST child holds every kept value, groups concatenated in key
+    order; offsets are the cumsum of per-group keep counts. Groups with
+    no kept values get EMPTY lists (Spark returns [] here, not null).
+    Output is padded to n rows like groupby_aggregate; callers trim with
+    ``num_groups`` (the child is likewise padded — ``to_pylist`` only
+    reads below each list's offsets).
+    """
+    c_check = table.column(value_col)
+    if c_check.dtype.type_id in (TypeId.LIST, TypeId.STRUCT):
+        raise NotImplementedError("collect of nested columns")
+    n = table.num_rows
+    m = n
+    sub = Table([table.column(k) for k in keys] + [table.column(value_col)])
+    kix = list(range(len(keys)))
+    vix = len(keys)
+    if distinct:
+        order = sort_order(sub, kix + [vix],
+                           nulls_first=[True] * len(keys) + [False])
+    else:
+        order = sort_order(sub, kix)
+    ssub = gather(sub, order)
+    same = _rows_equal_prev(ssub, kix)
+    if n:
+        gid = (jnp.cumsum(~same) - 1).astype(jnp.int32)
+    else:
+        gid = None
+    num_groups, g_lo, g_hi = _dense_group_bounds(gid, n, m)
+    first_idx = jnp.where(g_hi > g_lo, g_lo, n)
+    out_cols = _gather_group_keys(ssub, kix, first_idx, m, n)
+
+    vc = ssub.column(vix)
+    keep = vc.valid_mask()
+    if distinct and n:
+        # drop repeats of the same value within a group (values are
+        # adjacent after the secondary sort — the nunique flag idiom)
+        eqv = _col_values_equal_prev(vc)
+        prev_same_valid = jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), eqv & keep[:-1]])
+        keep = keep & (~same | ~prev_same_valid)
+    if n:
+        pref = jnp.cumsum(keep.astype(jnp.int64))
+        pref0 = jnp.concatenate([jnp.zeros((1,), jnp.int64), pref])
+        counts = pref0[g_hi] - pref0[g_lo]
+        # kept rows first (stable) — their sorted order IS group order,
+        # so the compacted prefix is exactly the dense child
+        comp = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+        child = _gather_any(vc, comp, jnp.bool_(True))
+    else:
+        counts = jnp.zeros((m,), jnp.int64)
+        child = vc
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64), jnp.cumsum(counts)]
+    ).astype(jnp.int32)
+    garange = jnp.arange(m, dtype=jnp.int32)
+    out_cols.append(Column(
+        DType(TypeId.LIST), offsets, garange < num_groups,
+        children=[child],
+    ))
+    return CollectResult(Table(out_cols), num_groups)

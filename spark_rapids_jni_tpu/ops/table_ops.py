@@ -18,7 +18,13 @@ import jax.numpy as jnp
 
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops.sort import gather, sort_order
+from spark_rapids_jni_tpu.types import TypeId
 from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+def _slice_child(c: Column, lo: int, hi: int) -> Column:
+    """Row slice of a LIST child (any non-nested layout)."""
+    return _slice_rows(Table([c]), lo, hi).column(0)
 
 
 def _slice_rows(table: Table, lo: int, hi: int) -> Table:
@@ -28,7 +34,18 @@ def _slice_rows(table: Table, lo: int, hi: int) -> Table:
     cols = []
     for c in table.columns:
         validity = None if c.validity is None else c.validity[lo:hi]
-        if c.dtype.is_string and c.is_padded_string:
+        if c.dtype.type_id == TypeId.LIST:
+            # slice-and-rebase: cut the child to this window's element
+            # range [offsets[lo], offsets[hi]) and shift the offsets so
+            # they index the cut child from 0
+            base = c.data[lo]
+            cols.append(Column(
+                c.dtype, (c.data[lo:hi + 1] - base).astype(jnp.int32),
+                validity,
+                children=[_slice_child(c.children[0], int(base),
+                                       int(c.data[hi]))],
+            ))
+        elif c.dtype.is_string and c.is_padded_string:
             cols.append(Column(c.dtype, c.data[lo:hi], validity,
                                chars=c.chars[lo:hi]))
         elif c.dtype.is_string:

@@ -308,7 +308,9 @@ class Window:
             jnp.stack([cx, cx * cx], axis=1), ~self._same_p)
         s1 = self._frame_diff(runs2[:, 0], lo, hi)
         s2 = self._frame_diff(runs2[:, 1], lo, hi)
-        cnt = self._frame_valid_count(valid, lo, hi)
+        # runs[:, 1] is already the segmented running count of valids —
+        # reuse it rather than paying _frame_valid_count's extra scan
+        cnt = self._frame_diff(runs[:, 1], lo, hi).astype(jnp.int64)
         m = cnt.astype(jnp.float64)
         num = jnp.maximum(s2 - s1 * s1 / jnp.maximum(m, 1.0), 0.0)
         var = num / jnp.maximum(m - ddof, 1.0)
