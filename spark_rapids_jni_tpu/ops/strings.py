@@ -645,14 +645,59 @@ def _host_regexp(col: Column, rx, fn):
 @func_range("regexp_contains")
 def regexp_contains(col: Column, pattern: str) -> Column:
     """RLIKE / regexp-find (cuDF contains_re): True when the pattern
-    matches anywhere in the string. Host engine."""
+    matches anywhere in the string.
+
+    Two engines (the get_json_object posture): patterns inside the
+    DFA-compilable subset run ON DEVICE — a host-compiled byte DFA
+    executed as one int32 gather per char column over the padded layout
+    (``ops/regex_device.py``); everything else (backrefs, lookaround,
+    class intersection, …) falls back to the host java.util.regex
+    emulation. Rows with embedded NUL bytes would alias the device
+    engine's end-of-row sentinel, so such columns are detected with one
+    device reduction and routed to the host engine whole.
+
+    Config ``regex.force_engine`` pins "device" (raises on unsupported
+    patterns) or "host" for testing."""
+    from spark_rapids_jni_tpu.types import BOOL8
+    from spark_rapids_jni_tpu.utils.config import get_option
+
+    validity = col.valid_mask() if col.validity is not None else None
+    force = get_option("regex.force_engine")
+    if force != "host":
+        from spark_rapids_jni_tpu.ops import regex_device as rd
+
+        try:
+            comp = rd.compile_pattern(pattern)
+        except rd.RegexUnsupported:
+            if force == "device":
+                raise
+            comp = None
+        if comp is not None:
+            pc = pad_strings(col)
+            # eligibility: zero count per row must equal the pad tail,
+            # i.e. no NUL inside the content bytes
+            w = pc.chars.shape[1]
+            nzeros = jnp.sum((pc.chars == 0).astype(jnp.int32), axis=1)
+            clean = bool(jnp.all(nzeros == (w - pc.data)))
+            if clean:
+                # the NUL check already synced lengths; reuse them to
+                # skip run_dfa's defensive extra zero column when the
+                # widest row leaves padding slack
+                n_rows = pc.chars.shape[0]
+                needs_pad = bool(
+                    n_rows and int(jnp.max(pc.data)) >= w)
+                flags = rd.run_dfa(
+                    pc.chars, comp,
+                    ensure_sentinel=needs_pad).astype(jnp.uint8)
+                return Column(BOOL8, flags, validity)
+            if force == "device":
+                raise ValueError(
+                    "regex.force_engine=device but the column has "
+                    "embedded NUL bytes (sentinel alias)")
     rx = _compile_java_regex(pattern)
     out = _host_regexp(col, rx, lambda r, v: r.search(v) is not None)
     flags = jnp.asarray([bool(v) for v in out], jnp.uint8)
-    from spark_rapids_jni_tpu.types import BOOL8
-
-    return Column(BOOL8, flags, col.valid_mask()
-                  if col.validity is not None else None)
+    return Column(BOOL8, flags, validity)
 
 
 @func_range("regexp_extract")

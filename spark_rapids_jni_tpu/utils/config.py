@@ -27,6 +27,10 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # Memory-layer allocation logging: 0 = off (RMM_LOGGING_LEVEL default
     # OFF parity, reference pom.xml:82), 1 = staging allocs, 2 = +reserves.
     "memory.log_level": (0, int),
+    # regexp engine pin: "" = auto (device when compilable, else host),
+    # "device" = require the DFA engine, "host" = force java.util.regex
+    # emulation (testing / behavior comparison).
+    "regex.force_engine": ("", str),
 }
 
 _overrides: dict[str, Any] = {}

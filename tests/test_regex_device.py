@@ -1,0 +1,162 @@
+"""Device regex engine (host-compiled byte DFA, ops/regex_device.py) vs
+the host java.util.regex emulation — engine-vs-engine oracle, the
+test pattern the two-engine get_json_object dispatcher uses."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops import strings as s
+from spark_rapids_jni_tpu.ops.regex_device import (
+    RegexUnsupported,
+    compile_pattern,
+)
+from spark_rapids_jni_tpu.utils import config
+
+DEVICE_PATTERNS = [
+    r"abc", r"a.c", r"^abc", r"abc$", r"^abc$", r"a*b", r"a+b", r"ab?c",
+    r"[abc]+", r"[^abc]", r"[a-f0-9]{2}", r"a{2,4}", r"a{3}", r"a{2,}",
+    r"(ab|cd)+e", r"\d+", r"\w+@\w+", r"\s", r"\S+", r"[A-Z][a-z]*",
+    r"foo|bar|baz", r"^$", r"(?:ab)*c", r"a\.b", r"[.*+]", r"colou?r",
+    r"\d{1,3}\.\d{1,3}", r"a.*z", r"^\w+$", r".", r"x{0,2}y", r"[\w-]+",
+]
+STRINGS = [
+    "", "a", "abc", "xabcx", "aab", "aaab", "b", "ABC", "a.c", "axc",
+    "a\nc", "123", "ab12", "foo", "barbaz", "colour", "color", "aaaa",
+    "192.168.1.1", "hello world", "Hello", "abababe", "user@host", " ",
+    "azzz", "é", "aéc", "日本語", "naïve", "xxy", "xy", "y", "a-b",
+    None, "zzz",
+]
+
+
+def _col():
+    return Column.from_pylist(STRINGS, t.STRING)
+
+
+@pytest.mark.parametrize("pattern", DEVICE_PATTERNS)
+def test_device_engine_matches_host_engine(pattern):
+    col = _col()
+    # force each engine explicitly; the verdicts must agree
+    config.set_option("regex.force_engine", "device")
+    try:
+        got_dev = s.regexp_contains(col, pattern).to_pylist()
+    finally:
+        config.set_option("regex.force_engine", "host")
+    try:
+        got_host = s.regexp_contains(col, pattern).to_pylist()
+    finally:
+        config.set_option("regex.force_engine", "")
+    assert got_dev == got_host, pattern
+
+
+def test_unsupported_patterns_fall_back_to_host():
+    col = _col()
+    # backreference: not DFA-compilable — auto mode must still answer
+    for pat in (r"(a)\1", r"a(?=b)", r"\bword\b", r"a|b$", r"a*?b"):
+        with pytest.raises(RegexUnsupported):
+            compile_pattern(pat)
+        config.set_option("regex.force_engine", "device")
+        try:
+            with pytest.raises((RegexUnsupported, ValueError)):
+                s.regexp_contains(col, pat)
+        finally:
+            config.set_option("regex.force_engine", "")
+    out = s.regexp_contains(
+        Column.from_pylist(["aa", "ab", None], t.STRING), r"(a)\1"
+    ).to_pylist()
+    assert out == [True, False, None]
+
+
+def test_device_utf8_dot_counts_characters():
+    """`.` must match ONE character (not byte) — `^.$` on multi-byte."""
+    col = Column.from_pylist(["é", "ab", "日", "x", ""], t.STRING)
+    config.set_option("regex.force_engine", "device")
+    try:
+        got = s.regexp_contains(col, r"^.$").to_pylist()
+    finally:
+        config.set_option("regex.force_engine", "")
+    assert got == [True, False, True, True, False]
+
+
+def test_device_negated_class_matches_multibyte():
+    col = Column.from_pylist(["é", "a", "\n"], t.STRING)
+    config.set_option("regex.force_engine", "device")
+    try:
+        got = s.regexp_contains(col, r"[^a]").to_pylist()
+    finally:
+        config.set_option("regex.force_engine", "")
+    assert got == [True, False, True]
+
+
+def test_embedded_nul_routes_to_host():
+    """A NUL inside content aliases the device sentinel; auto mode must
+    give the (correct) host answer, device-pinned mode must refuse."""
+    col = Column.from_pylist(["a\x00b", "ab"], t.STRING)
+    got = s.regexp_contains(col, r"b").to_pylist()
+    assert got == [True, True]
+    config.set_option("regex.force_engine", "device")
+    try:
+        with pytest.raises(ValueError, match="NUL"):
+            s.regexp_contains(col, r"b")
+    finally:
+        config.set_option("regex.force_engine", "")
+
+
+def test_dfa_state_cap_guards_blowup():
+    # classic subset-construction bomb: (a|b)*a(a|b){N}
+    with pytest.raises(RegexUnsupported, match="DFA exceeds"):
+        compile_pattern(r"(a|b)*a(a|b){14}")
+
+
+def test_padded_input_stays_padded():
+    col = s.pad_strings(Column.from_pylist(["foo", "bar"], t.STRING))
+    config.set_option("regex.force_engine", "device")
+    try:
+        got = s.regexp_contains(col, r"^f").to_pylist()
+    finally:
+        config.set_option("regex.force_engine", "")
+    assert got == [True, False]
+
+
+def test_anchor_on_one_alternation_branch_falls_back():
+    """`^a|b` / `a|b$` anchor only one branch in Java — the device
+    engine must refuse (host fallback gives the right answer)."""
+    for pat in (r"^a|b", r"a|b$"):
+        with pytest.raises(RegexUnsupported):
+            compile_pattern(pat)
+    col = Column.from_pylist(["xb", "a", "z"], t.STRING)
+    assert s.regexp_contains(col, r"^a|b").to_pylist() == \
+        [True, True, False]
+
+
+def test_dollar_matches_before_trailing_newline():
+    """Java/Python '$' matches just before a single final line
+    terminator — device and host must agree on newline-ended rows."""
+    col = Column.from_pylist(
+        ["abc", "abc\n", "abc\nx", "abc\n\n", "ab"], t.STRING)
+    config.set_option("regex.force_engine", "device")
+    try:
+        got_dev = s.regexp_contains(col, r"abc$").to_pylist()
+    finally:
+        config.set_option("regex.force_engine", "host")
+    try:
+        got_host = s.regexp_contains(col, r"abc$").to_pylist()
+    finally:
+        config.set_option("regex.force_engine", "")
+    assert got_dev == got_host == [True, True, False, False, False]
+
+
+def test_stacked_quantifiers_fall_back():
+    """a{2}{3} is rejected by java.util.regex ('multiple repeat') — the
+    device compiler must not silently accept a different language."""
+    for pat in (r"a{2}{3}", r"a**", r"a?*"):
+        with pytest.raises(RegexUnsupported):
+            compile_pattern(pat)
+
+
+def test_nul_in_pattern_falls_back():
+    with pytest.raises(RegexUnsupported, match="NUL"):
+        compile_pattern("a\x00")
+    with pytest.raises(RegexUnsupported, match="NUL"):
+        compile_pattern("[\x00a]")
