@@ -138,6 +138,37 @@ def _bench_tpch_q6(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_tpch_q14(n: int, iters: int):
+    """q14 join+LIKE pipeline: n lineitem rows against n/16 parts; the
+    CASE WHEN p_type LIKE 'PROMO%%' lane runs on join-gathered strings."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q14_table,
+        part_table,
+        tpch_q14,
+    )
+
+    from spark_rapids_jni_tpu.columnar import Table
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    part = part_table(max(n // 16, 64))
+    pcols = list(part.columns)
+    pcols[1] = pad_strings(pcols[1])  # jit needs static string widths
+    part = Table(pcols)
+    lineitem = lineitem_q14_table(n, max(n // 16, 64))
+
+    def run(p_, l_):
+        r = tpch_q14(p_, l_)
+        return (r.promo_revenue + r.total_revenue * 3
+                + r.join_total.astype(jnp.int64) * 7)
+
+    fn = jax.jit(run)
+    per_iter = _measure(lambda: fn(part, lineitem), iters)
+    return n / per_iter
+
+
 def _bench_tpcds_q72(n: int, iters: int):
     import jax
 
@@ -457,6 +488,7 @@ _CONFIGS = {
     "shuffle_wire": (_bench_shuffle_wire, "shuffle_wire_gb_per_s", "GB/s"),
     "json_extract": (_bench_json_extract, "json_extract_rows_per_s", "rows/s"),
     "tpch_q3": (_bench_tpch_q3, "tpch_q3_rows_per_s", "rows/s"),
+    "tpch_q14": (_bench_tpch_q14, "tpch_q14_rows_per_s", "rows/s"),
     "cast_strings": (_bench_cast_strings, "cast_strings_rows_per_s", "rows/s"),
     "tpcds_q64": (_bench_tpcds_q64, "tpcds_q64_rows_per_s", "rows/s"),
     "tpch_q1_planned": (

@@ -510,7 +510,8 @@ def lineitem_q3_table(num_rows: int, num_orders: int, seed: int = 2) -> Table:
 
 
 def _null_where(c: Column, drop: jnp.ndarray) -> Column:
-    return Column(c.dtype, c.data, c.valid_mask() & ~drop)
+    return Column(c.dtype, c.data, c.valid_mask() & ~drop,
+                  chars=c.chars, children=c.children)
 
 
 def _q3_inputs(customer: Table, orders: Table, lineitem: Table,
@@ -714,3 +715,411 @@ def tpch_q3_distributed(customer: Table, orders: Table, lineitem: Table,
                None if c.validity is None else c.validity[:k])
         for c in srt.columns
     ])
+
+
+# ---------------------------------------------------------------------------
+# q12 — shipping modes and order priority (join + string-key groupby with
+# conditional counts). Reference workload family: BASELINE.json config #4's
+# "hash-join + reader" shape; predicates are Spark CASE WHEN lowering onto
+# masked integer lanes.
+# ---------------------------------------------------------------------------
+
+# q12 lineitem columns
+L12_ORDERKEY, L12_SHIPMODE, L12_COMMITDATE = 0, 1, 2
+L12_RECEIPTDATE, L12_SHIPDATE = 3, 4
+# q12 orders columns
+O12_ORDERKEY, O12_ORDERPRIORITY = 0, 1
+
+_Q12_MODES = ("MAIL", "SHIP", "AIR", "RAIL", "TRUCK", "FOB", "REG AIR")
+_Q12_PRIORITIES = ("1-URGENT", "2-HIGH", "3-MEDIUM",
+                   "4-NOT SPECIFIED", "5-LOW")
+_Q12_YEAR_START = 8766   # 1994-01-01 in days
+_Q12_YEAR_END = 9131     # 1995-01-01
+
+
+def lineitem_q12_table(num_rows: int, num_orders: int,
+                       seed: int = 3) -> Table:
+    rng = np.random.default_rng(seed)
+    ship = rng.integers(8400, 10957, num_rows).astype(np.int32)
+    commit = ship + rng.integers(-30, 60, num_rows).astype(np.int32)
+    receipt = commit + rng.integers(-20, 40, num_rows).astype(np.int32)
+    return Table([
+        Column.from_numpy(
+            rng.integers(1, num_orders + 1, num_rows).astype(np.int64)),
+        Column.from_pylist(
+            [_Q12_MODES[i] for i in rng.integers(0, len(_Q12_MODES),
+                                                 num_rows)], t.STRING),
+        Column.from_numpy(commit, t.TIMESTAMP_DAYS),
+        Column.from_numpy(receipt, t.TIMESTAMP_DAYS),
+        Column.from_numpy(ship, t.TIMESTAMP_DAYS),
+    ])
+
+
+def orders_q12_table(num_rows: int, seed: int = 4) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(np.arange(1, num_rows + 1, dtype=np.int64)),
+        Column.from_pylist(
+            [_Q12_PRIORITIES[i]
+             for i in rng.integers(0, len(_Q12_PRIORITIES), num_rows)],
+            t.STRING),
+    ])
+
+
+class Q12Result(NamedTuple):
+    result: GroupByResult    # [l_shipmode, high_line_count, low_line_count]
+    join_total: jnp.ndarray
+
+
+@func_range("tpch_q12")
+def tpch_q12(orders: Table, lineitem: Table,
+             modes: tuple = ("MAIL", "SHIP"),
+             year_start: int = _Q12_YEAR_START,
+             year_end: int = _Q12_YEAR_END) -> Q12Result:
+    """q12: lineitem filtered on mode/date sanity predicates, joined to
+    orders on orderkey, grouped by shipmode with CASE-WHEN priority
+    counts. Static shapes: the WHERE lowers to a nulled join key (the
+    q3 idiom), CASE WHEN to masked int lanes."""
+    from spark_rapids_jni_tpu.ops import strings as s
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+
+    mode_c = lineitem.column(L12_SHIPMODE)
+    in_modes = jnp.zeros((lineitem.num_rows,), jnp.bool_)
+    for mname in modes:
+        in_modes = in_modes | (s.like(mode_c, mname).data != 0)
+    commit_c = lineitem.column(L12_COMMITDATE)
+    receipt_c = lineitem.column(L12_RECEIPTDATE)
+    ship_c = lineitem.column(L12_SHIPDATE)
+    commit, receipt, ship = commit_c.data, receipt_c.data, ship_c.data
+    # null predicate operands are not-TRUE (SQL): AND every valid_mask
+    keep = (in_modes & mode_c.valid_mask() & commit_c.valid_mask()
+            & receipt_c.valid_mask() & ship_c.valid_mask()
+            & (commit < receipt) & (ship < commit)
+            & (receipt >= jnp.int32(year_start))
+            & (receipt < jnp.int32(year_end)))
+    probe = Table([
+        _null_where(lineitem.column(L12_ORDERKEY), ~keep),
+        mode_c,
+    ])
+    maps = join(probe, orders, 0, 0, out_size=lineitem.num_rows)
+    j = apply_join_maps(probe, orders, maps)
+    # j: [l_orderkey, l_shipmode, o_orderkey, o_orderpriority]
+    matched = j.column(2).valid_mask()
+    prio = j.column(3)
+    urgent = ((s.like(prio, "1-URGENT").data != 0)
+              | (s.like(prio, "2-HIGH").data != 0))
+    high = Column(t.INT64,
+                  jnp.where(matched & urgent, jnp.int64(1), jnp.int64(0)),
+                  matched)
+    low = Column(t.INT64,
+                 jnp.where(matched & ~urgent, jnp.int64(1), jnp.int64(0)),
+                 matched)
+    keyed = Table([
+        _null_where(j.column(1), ~matched), high, low,
+    ])
+    g = groupby_aggregate(keyed, keys=[0], aggs=[(1, "sum"), (2, "sum")])
+    srt = sort_table(g.table, [0], nulls_first=[False])
+    return Q12Result(GroupByResult(srt, g.num_groups), maps.total)
+
+
+def tpch_q12_numpy(orders: Table, lineitem: Table,
+                   modes: tuple = ("MAIL", "SHIP"),
+                   year_start: int = _Q12_YEAR_START,
+                   year_end: int = _Q12_YEAR_END) -> dict:
+    prio = {int(k): p for k, p in zip(
+        np.asarray(orders.column(O12_ORDERKEY).data).tolist(),
+        orders.column(O12_ORDERPRIORITY).to_pylist())}
+    out: dict = {}
+    lmode = lineitem.column(L12_SHIPMODE).to_pylist()
+    lkey = np.asarray(lineitem.column(L12_ORDERKEY).data).tolist()
+    commit = np.asarray(lineitem.column(L12_COMMITDATE).data).tolist()
+    receipt = np.asarray(lineitem.column(L12_RECEIPTDATE).data).tolist()
+    ship = np.asarray(lineitem.column(L12_SHIPDATE).data).tolist()
+    for i in range(lineitem.num_rows):
+        if lmode[i] not in modes:
+            continue
+        if not (commit[i] < receipt[i] and ship[i] < commit[i]
+                and year_start <= receipt[i] < year_end):
+            continue
+        p = prio.get(lkey[i])
+        if p is None:
+            continue
+        hi, lo = out.setdefault(lmode[i], [0, 0])
+        if p in ("1-URGENT", "2-HIGH"):
+            out[lmode[i]][0] += 1
+        else:
+            out[lmode[i]][1] += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# q14 — promotion effect (join + LIKE + global conditional ratio)
+# ---------------------------------------------------------------------------
+
+P_PARTKEY, P_TYPE, P_BRAND, P_CONTAINER, P_SIZE = 0, 1, 2, 3, 4
+
+_P_TYPES = ("PROMO BURNISHED COPPER", "PROMO PLATED BRASS",
+            "STANDARD POLISHED TIN", "MEDIUM BRUSHED NICKEL",
+            "ECONOMY ANODIZED STEEL", "SMALL PLATED COPPER")
+_P_BRANDS = ("Brand#11", "Brand#12", "Brand#23", "Brand#34", "Brand#55")
+_P_CONTAINERS = ("SM CASE", "SM BOX", "SM PACK", "SM PKG",
+                 "MED BAG", "MED BOX", "MED PKG", "MED PACK",
+                 "LG CASE", "LG BOX", "LG PACK", "LG PKG")
+
+
+def part_table(num_rows: int, seed: int = 5) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(np.arange(1, num_rows + 1, dtype=np.int64)),
+        Column.from_pylist(
+            [_P_TYPES[i] for i in rng.integers(0, len(_P_TYPES),
+                                               num_rows)], t.STRING),
+        Column.from_pylist(
+            [_P_BRANDS[i] for i in rng.integers(0, len(_P_BRANDS),
+                                                num_rows)], t.STRING),
+        Column.from_pylist(
+            [_P_CONTAINERS[i]
+             for i in rng.integers(0, len(_P_CONTAINERS), num_rows)],
+            t.STRING),
+        Column.from_numpy(rng.integers(1, 51, num_rows).astype(np.int32)),
+    ])
+
+
+# q14/q19 lineitem columns
+L14_PARTKEY, L14_EXTENDEDPRICE, L14_DISCOUNT, L14_SHIPDATE = 0, 1, 2, 3
+
+
+def lineitem_q14_table(num_rows: int, num_parts: int,
+                       seed: int = 6) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(
+            rng.integers(1, num_parts + 1, num_rows).astype(np.int64)),
+        Column.from_numpy(
+            rng.integers(90_000, 10_500_000, num_rows).astype(np.int64),
+            t.decimal64(-2)),
+        Column.from_numpy(
+            rng.integers(0, 11, num_rows).astype(np.int64),
+            t.decimal64(-2)),
+        Column.from_numpy(
+            rng.integers(8400, 10957, num_rows).astype(np.int32),
+            t.TIMESTAMP_DAYS),
+    ])
+
+
+_Q14_MONTH_START = 9374  # 1995-09-01
+_Q14_MONTH_END = 9404    # 1995-10-01
+
+
+class Q14Result(NamedTuple):
+    promo_revenue: jnp.ndarray   # int64 unscaled decimal(-4)
+    total_revenue: jnp.ndarray   # int64 unscaled decimal(-4)
+    join_total: jnp.ndarray
+
+    def ratio(self) -> float:
+        """100 * promo/total (the published q14 metric), host-side."""
+        tot = int(self.total_revenue)
+        return 100.0 * int(self.promo_revenue) / tot if tot else 0.0
+
+
+@func_range("tpch_q14")
+def tpch_q14(part: Table, lineitem: Table,
+             month_start: int = _Q14_MONTH_START,
+             month_end: int = _Q14_MONTH_END) -> Q14Result:
+    """q14: shipdate-month lineitem joined to part; promo share of
+    revenue. The CASE WHEN p_type LIKE 'PROMO%' lane runs the device
+    LIKE engine on the join-gathered strings; revenue stays exact
+    int64 decimal(-4) to the end (the q6 posture)."""
+    from spark_rapids_jni_tpu.ops import strings as s
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+
+    ship_c = lineitem.column(L14_SHIPDATE)
+    ship = ship_c.data
+    keep = (ship_c.valid_mask()
+            & (ship >= jnp.int32(month_start))
+            & (ship < jnp.int32(month_end)))
+    price = lineitem.column(L14_EXTENDEDPRICE)
+    disc = lineitem.column(L14_DISCOUNT)
+    revenue = price.data * (100 - disc.data)   # decimal(-4), exact
+    rev_ok = price.valid_mask() & disc.valid_mask() & keep
+    probe = Table([
+        _null_where(lineitem.column(L14_PARTKEY), ~keep),
+    ])
+    build = Table([part.column(P_PARTKEY), part.column(P_TYPE)])
+    maps = join(probe, build, 0, 0, out_size=lineitem.num_rows)
+    # gather the probe-side revenue lanes by the join's left map instead
+    # of materializing them as table columns (they are derived, not data)
+    li = jnp.clip(maps.left_index, 0, max(lineitem.num_rows - 1, 0))
+    j = apply_join_maps(probe, build, maps)
+    matched = j.column(1).valid_mask() & maps.row_valid
+    rev_j = jnp.where(matched & rev_ok[li], revenue[li], 0)
+    promo = s.like(j.column(2), "PROMO%").data != 0
+    return Q14Result(
+        jnp.sum(jnp.where(promo, rev_j, 0)),
+        jnp.sum(rev_j),
+        maps.total,
+    )
+
+
+def tpch_q14_numpy(part: Table, lineitem: Table,
+                   month_start: int = _Q14_MONTH_START,
+                   month_end: int = _Q14_MONTH_END) -> tuple:
+    ptype = {int(k): v for k, v in zip(
+        np.asarray(part.column(P_PARTKEY).data).tolist(),
+        part.column(P_TYPE).to_pylist())}
+    lkey = np.asarray(lineitem.column(L14_PARTKEY).data).tolist()
+    price = np.asarray(lineitem.column(L14_EXTENDEDPRICE).data).tolist()
+    disc = np.asarray(lineitem.column(L14_DISCOUNT).data).tolist()
+    ship = np.asarray(lineitem.column(L14_SHIPDATE).data).tolist()
+    promo = total = 0
+    for i in range(lineitem.num_rows):
+        if not month_start <= ship[i] < month_end:
+            continue
+        tp = ptype.get(lkey[i])
+        if tp is None:
+            continue
+        rev = price[i] * (100 - disc[i])
+        total += rev
+        if tp.startswith("PROMO"):
+            promo += rev
+    return promo, total
+
+
+# ---------------------------------------------------------------------------
+# q19 — discounted revenue (join + OR-of-ANDs compound predicate)
+# ---------------------------------------------------------------------------
+
+L19_PARTKEY, L19_QUANTITY, L19_EXTENDEDPRICE = 0, 1, 2
+L19_DISCOUNT, L19_SHIPMODE, L19_SHIPINSTRUCT = 3, 4, 5
+
+_Q19_INSTRUCTS = ("DELIVER IN PERSON", "COLLECT COD", "NONE",
+                  "TAKE BACK RETURN")
+
+
+def lineitem_q19_table(num_rows: int, num_parts: int,
+                       seed: int = 7) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(
+            rng.integers(1, num_parts + 1, num_rows).astype(np.int64)),
+        Column.from_numpy(
+            rng.integers(100, 51_00, num_rows).astype(np.int64),
+            t.decimal64(-2)),
+        Column.from_numpy(
+            rng.integers(90_000, 10_500_000, num_rows).astype(np.int64),
+            t.decimal64(-2)),
+        Column.from_numpy(
+            rng.integers(0, 11, num_rows).astype(np.int64),
+            t.decimal64(-2)),
+        Column.from_pylist(
+            ["AIR" if i == 0 else ("AIR REG" if i == 1 else "TRUCK")
+             for i in rng.integers(0, 3, num_rows)], t.STRING),
+        Column.from_pylist(
+            [_Q19_INSTRUCTS[i]
+             for i in rng.integers(0, len(_Q19_INSTRUCTS), num_rows)],
+            t.STRING),
+    ])
+
+
+# (brand, container prefix, qty_lo in whole units, size_hi)
+_Q19_BRANCHES = (
+    ("Brand#12", "SM", 1, 5),
+    ("Brand#23", "MED", 10, 10),
+    ("Brand#34", "LG", 20, 15),
+)
+
+
+class Q19Result(NamedTuple):
+    revenue: jnp.ndarray     # int64 unscaled decimal(-4)
+    join_total: jnp.ndarray
+
+
+@func_range("tpch_q19")
+def tpch_q19(part: Table, lineitem: Table,
+             branches: tuple = _Q19_BRANCHES) -> Q19Result:
+    """q19: the OR-of-ANDs predicate over joined lineitem x part —
+    every branch is a vectorized mask over join-gathered part columns
+    and probe-side lanes; revenue is the exact int64 masked sum."""
+    from spark_rapids_jni_tpu.ops import strings as s
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+
+    n = lineitem.num_rows
+    probe = Table([lineitem.column(L19_PARTKEY)])
+    build = Table([part.column(P_PARTKEY), part.column(P_BRAND),
+                   part.column(P_CONTAINER), part.column(P_SIZE)])
+    maps = join(probe, build, 0, 0, out_size=n)
+    li = jnp.clip(maps.left_index, 0, max(n - 1, 0))
+    j = apply_join_maps(probe, build, maps)
+    # j: [l_partkey, p_partkey, p_brand, p_container, p_size]
+    matched = j.column(1).valid_mask() & maps.row_valid
+
+    qty_c = lineitem.column(L19_QUANTITY)
+    price_c = lineitem.column(L19_EXTENDEDPRICE)
+    disc_c = lineitem.column(L19_DISCOUNT)
+    qty = qty_c.data[li]                              # decimal(-2)
+    price = price_c.data[li]
+    disc = disc_c.data[li]
+    lane_ok = (qty_c.valid_mask() & price_c.valid_mask()
+               & disc_c.valid_mask()
+               & lineitem.column(L19_SHIPMODE).valid_mask()
+               & lineitem.column(L19_SHIPINSTRUCT).valid_mask())[li]
+    mode = s.gather_strings(
+        s.pad_strings(lineitem.column(L19_SHIPMODE)), li)
+    instr = s.gather_strings(
+        s.pad_strings(lineitem.column(L19_SHIPINSTRUCT)), li)
+    mode_c = Column(t.STRING, mode.data, None, chars=mode.chars)
+    instr_c = Column(t.STRING, instr.data, None, chars=instr.chars)
+
+    air = ((s.like(mode_c, "AIR").data != 0)
+           | (s.like(mode_c, "AIR REG").data != 0))
+    person = s.like(instr_c, "DELIVER IN PERSON").data != 0
+    brand_c, cont_c, size = j.column(2), j.column(3), j.column(4).data
+
+    pred = jnp.zeros((j.num_rows,), jnp.bool_)
+    for brand, cont_prefix, qty_lo, size_hi in branches:
+        b = (s.like(brand_c, brand).data != 0)
+        cont = s.like(cont_c, cont_prefix + "%").data != 0
+        qlo = jnp.int64(qty_lo * 100)
+        qhi = jnp.int64((qty_lo + 10) * 100)
+        qok = (qty >= qlo) & (qty <= qhi)
+        sok = (size >= 1) & (size <= jnp.int32(size_hi))
+        pred = pred | (b & cont & qok & sok)
+    pred = pred & air & person & matched & lane_ok
+    revenue = jnp.where(pred, price * (100 - disc), 0)
+    return Q19Result(jnp.sum(revenue), maps.total)
+
+
+def tpch_q19_numpy(part: Table, lineitem: Table,
+                   branches: tuple = _Q19_BRANCHES) -> int:
+    pinfo = {}
+    pk = np.asarray(part.column(P_PARTKEY).data).tolist()
+    pb = part.column(P_BRAND).to_pylist()
+    pc = part.column(P_CONTAINER).to_pylist()
+    ps = np.asarray(part.column(P_SIZE).data).tolist()
+    for i in range(part.num_rows):
+        pinfo[pk[i]] = (pb[i], pc[i], ps[i])
+    lkey = np.asarray(lineitem.column(L19_PARTKEY).data).tolist()
+    qty = np.asarray(lineitem.column(L19_QUANTITY).data).tolist()
+    price = np.asarray(lineitem.column(L19_EXTENDEDPRICE).data).tolist()
+    disc = np.asarray(lineitem.column(L19_DISCOUNT).data).tolist()
+    mode = lineitem.column(L19_SHIPMODE).to_pylist()
+    instr = lineitem.column(L19_SHIPINSTRUCT).to_pylist()
+    total = 0
+    for i in range(lineitem.num_rows):
+        info = pinfo.get(lkey[i])
+        if info is None:
+            continue
+        if mode[i] not in ("AIR", "AIR REG"):
+            continue
+        if instr[i] != "DELIVER IN PERSON":
+            continue
+        ok = False
+        for brand, cont_prefix, qty_lo, size_hi in branches:
+            if (info[0] == brand and info[1].startswith(cont_prefix)
+                    and qty_lo * 100 <= qty[i] <= (qty_lo + 10) * 100
+                    and 1 <= info[2] <= size_hi):
+                ok = True
+                break
+        if ok:
+            total += price[i] * (100 - disc[i])
+    return total

@@ -276,13 +276,18 @@ class _Parser:
             raise RegexUnsupported("unterminated {")
         body = self.p[self.i + 1: j]
         self.i = j + 1
-        parts = body.split(",")
-        try:
-            lo = int(parts[0])
-            hi = (lo if len(parts) == 1
-                  else (int(parts[1]) if parts[1] else None))
-        except ValueError:
+        # strict ASCII-digit grammar: {m} {m,} {m,n} and nothing else —
+        # int()'s permissive parsing (whitespace, signs, fullwidth
+        # digits, extra fields) would silently compile a language the
+        # host engine treats as literal text
+        import re as _re
+
+        if not _re.fullmatch(r"[0-9]+(,[0-9]*)?", body):
             raise RegexUnsupported(f"bad repetition {{{body}}}")
+        parts = body.split(",")
+        lo = int(parts[0])
+        hi = (lo if len(parts) == 1
+              else (int(parts[1]) if parts[1] else None))
         if hi is not None and (hi < lo or lo < 0):
             raise RegexUnsupported(f"bad repetition {{{body}}}")
         if (hi or lo) > MAX_EXPANSION:
@@ -445,9 +450,15 @@ def _closure(nfa: _Nfa, states: frozenset) -> frozenset:
     return frozenset(seen)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=256)
 def compile_pattern(pattern: str) -> CompiledRegex:
     """Host compile: pattern -> byte DFA recognizing
-    ``search(P) and end-of-row`` over zero-terminated padded rows."""
+    ``search(P) and end-of-row`` over zero-terminated padded rows.
+    LRU-cached per pattern (immutable result) — repeated per-batch
+    calls skip the subset construction."""
     nfa = _Nfa()
     parser = _Parser(pattern, nfa)
     frag = parser.parse()

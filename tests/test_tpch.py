@@ -369,3 +369,52 @@ def test_tpch_q6_nulls_and_empty_match():
         jnp.zeros((64,), cols[6].data.dtype) + (_Q6_DATE_LO - 100),
         None)
     assert tpch_q6(Table(cols)).to_pylist() == [None]
+
+
+def test_tpch_q12_vs_numpy():
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q12_table, orders_q12_table, tpch_q12, tpch_q12_numpy)
+
+    orders = orders_q12_table(300)
+    lineitem = lineitem_q12_table(1500, 400)  # some orderkeys unmatched
+    res = tpch_q12(orders, lineitem)
+    want = tpch_q12_numpy(orders, lineitem)
+    m = int(res.result.num_groups)
+    tbl = res.result.table
+    got = {}
+    for i in range(m):
+        k = tbl.column(0).to_pylist()[i]
+        if k is None:
+            continue
+        got[k] = [tbl.column(1).to_pylist()[i],
+                  tbl.column(2).to_pylist()[i]]
+    assert got == want
+    # output is shipmode-sorted (the ORDER BY)
+    ks = [k for k in tbl.column(0).to_pylist()[:m] if k is not None]
+    assert ks == sorted(ks)
+
+
+def test_tpch_q14_vs_numpy():
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q14_table, part_table, tpch_q14, tpch_q14_numpy)
+
+    part = part_table(200)
+    lineitem = lineitem_q14_table(2000, 250)
+    res = tpch_q14(part, lineitem)
+    promo, total = tpch_q14_numpy(part, lineitem)
+    assert int(res.promo_revenue) == promo
+    assert int(res.total_revenue) == total
+    if total:
+        assert res.ratio() == 100.0 * promo / total
+
+
+def test_tpch_q19_vs_numpy():
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q19_table, part_table, tpch_q19, tpch_q19_numpy)
+
+    part = part_table(150)
+    lineitem = lineitem_q19_table(2500, 180)
+    res = tpch_q19(part, lineitem)
+    want = tpch_q19_numpy(part, lineitem)
+    assert int(res.revenue) == want
+    assert want > 0  # the synthetic distributions must actually hit
