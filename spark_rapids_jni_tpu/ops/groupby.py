@@ -388,6 +388,54 @@ def _sq_limbs16_rows(lo: jnp.ndarray, hi: jnp.ndarray) -> list:
     return sq
 
 
+def _cmp_limbs16(a: list, b: list) -> jnp.ndarray:
+    """int32 sign of (a - b) over equal-length normalized limb vectors:
+    lexicographic from the top limb, vectorized."""
+    cmp = jnp.zeros_like(a[0], dtype=jnp.int32)
+    for x, y in zip(reversed(a), reversed(b)):
+        here = jnp.sign(x - y).astype(jnp.int32)
+        cmp = jnp.where(cmp != 0, cmp, here)
+    return cmp
+
+
+def _add_limbs16(a: list, b: list) -> list:
+    """Exact a + b over normalized limb vectors (same length; the caller
+    sizes the vectors so the sum cannot carry out of the top limb)."""
+    out = []
+    carry = jnp.int64(0)
+    for x, y in zip(a, b):
+        v = x + y + carry
+        out.append(v & _M16)
+        carry = v >> 16
+    return out
+
+
+def _signed_sub_limbs16(a_mag: list, a_neg: jnp.ndarray,
+                        b_mag: list, b_neg: jnp.ndarray):
+    """Sign-magnitude a − b over normalized limb vectors: returns
+    (magnitude limbs, negative mask). Same-sign operands subtract the
+    smaller magnitude from the larger; opposite signs add magnitudes."""
+    same_sign = a_neg == b_neg
+    cmp = _cmp_limbs16(a_mag, b_mag)      # sign of |a| - |b|
+    a_ge = cmp >= 0
+    hi_ = [jnp.where(a_ge, x, y) for x, y in zip(a_mag, b_mag)]
+    lo_ = [jnp.where(a_ge, y, x) for x, y in zip(a_mag, b_mag)]
+    diff = _sub_limbs16(hi_, lo_)
+    added = _add_limbs16(a_mag, b_mag)
+    mag = [jnp.where(same_sign, d, s) for d, s in zip(diff, added)]
+    # same sign: result sign follows the dominant operand (a if |a|>=|b|
+    # else flipped); opposite signs: a - (-|b|-ish) keeps a's sign when
+    # a is the positive one... spelled out: a + (-b) where b_neg
+    # flipped — the sum's sign is a's sign (magnitudes add).
+    neg_same = jnp.where(a_ge, a_neg, ~b_neg)
+    neg = jnp.where(same_sign, neg_same, a_neg)
+    # canonical zero: non-negative
+    is_zero = jnp.ones_like(a_neg)
+    for l in mag:
+        is_zero = is_zero & (l == 0)
+    return mag, neg & ~is_zero
+
+
 def _sum_dtype(dt: DType) -> DType:
     """Spark widens SUM: integral -> INT64, decimal keeps scale (wider
     precision), floats stay floating."""
@@ -584,15 +632,74 @@ def groupby_aggregate(
             kind, oidx = op
             cy = sorted_tbl.column(oidx)
             for cc in (c, cy):
-                if (cc.dtype.is_string or cc.dtype.is_decimal128
-                        or cc.dtype.storage_dtype.kind not in
+                if cc.dtype.is_string or (
+                        not cc.dtype.is_decimal128
+                        and cc.dtype.storage_dtype.kind not in
                         ("i", "u", "f")):
                     raise TypeError(
-                        f"{kind} needs numeric (non-DECIMAL128) columns, "
-                        f"got {cc.dtype}")
+                        f"{kind} needs numeric columns, got {cc.dtype}")
             both = valid & cy.valid_mask()
             pair = (id(c), id(cy))
             both_lane = lane(both, memo_key=(pair, "count2"))
+            if c.dtype.is_decimal128 or cy.dtype.is_decimal128:
+                # exact wide path: both operands must have integral
+                # storage (a float partner has no exact form — cast it
+                # to a decimal first)
+                for cc in (c, cy):
+                    if (not cc.dtype.is_decimal128
+                            and cc.dtype.storage_dtype.kind not in
+                            ("i", "u")):
+                        raise TypeError(
+                            f"{kind} with a DECIMAL128 operand needs an "
+                            f"integral-storage partner, got {cc.dtype}")
+
+                def _as_i128(cc):
+                    if cc.dtype.is_decimal128:
+                        lo_ = jnp.where(both, cc.data[:, 0], jnp.int64(0))
+                        hi_ = jnp.where(both, cc.data[:, 1], jnp.int64(0))
+                    else:
+                        v = jnp.where(
+                            both, cc.data.astype(jnp.int64), jnp.int64(0))
+                        if cc.dtype.storage_dtype.kind == "u":
+                            # unsigned: the int64 cast keeps the BITS;
+                            # zero-extend (v >> 63 would sign-wrap
+                            # values >= 2^63)
+                            hi_ = jnp.zeros_like(v)
+                        else:
+                            hi_ = v >> 63       # sign extension
+                        lo_ = v
+                    return lo_, hi_
+
+                lox, hix = _as_i128(c)
+                loy, hiy = _as_i128(cy)
+                magx, negx = _i128_mag_limbs16(lox, hix)
+                magy, negy = _i128_mag_limbs16(loy, hiy)
+                sx_specs = tuple(
+                    lane(jnp.where(negx, -magx[k], magx[k]),
+                         memo_key=(pair, "cx128", k)) for k in range(8))
+                sy_specs = tuple(
+                    lane(jnp.where(negy, -magy[k], magy[k]),
+                         memo_key=(pair, "cy128", k)) for k in range(8))
+                xy, _ = _carry_norm16(_conv_limbs16(magx, magy), 16)
+                neg_xy = negx != negy
+                sxy_specs = tuple(
+                    lane(jnp.where(neg_xy, -xy[k], xy[k]),
+                         memo_key=(pair, "cxy128", k)) for k in range(16))
+                if kind == "corr":
+                    sqx = _sq_limbs16_rows(lox, hix)
+                    sqy = _sq_limbs16_rows(loy, hiy)
+                    sq_specs = (
+                        tuple(lane(sqx[k], memo_key=(pair, "cqx128", k))
+                              for k in range(16)),
+                        tuple(lane(sqy[k], memo_key=(pair, "cqy128", k))
+                              for k in range(16)),
+                    )
+                else:
+                    sq_specs = None
+                plan.append((kind + "128pair", c, cy,
+                             (sx_specs, sy_specs, sxy_specs, sq_specs),
+                             both_lane))
+                continue
             specs = []
             for cc, tag in ((c, "sx"), (cy, "sy")):
                 vv = jnp.where(both, cc.data, jnp.zeros_like(cc.data))
@@ -863,6 +970,87 @@ def groupby_aggregate(
                 DType(TypeId.FLOAT64), out_val,
                 vcount > (0 if pop else 1)
             ))
+            continue
+        if op in ("covar_samp128pair", "covar_pop128pair",
+                  "corr128pair"):
+            # exact DECIMAL128(-compatible) covariance/correlation: the
+            # numerator n·ΣXY − ΣX·ΣY is assembled in sign-magnitude
+            # base-2^16 limb arithmetic (|terms| ≤ n²·2^254 < 2^317,
+            # 25-limb vectors) and rounded to float64 once. corr divides
+            # by the exact variance numerators, so the decimal scales
+            # cancel identically.
+            cy = acc_dt
+            sx_specs, sy_specs, sxy_specs, sq_specs = val_lane
+            WIDTH = 25
+            pair_key = (id(c), id(cy), "128pair")
+
+            def _norm_sums():
+                # normalized sign-magnitude ΣX / ΣY (shared by the
+                # numerator and corr's variance terms)
+                if (pair_key, "sums") not in _covar_cache:
+                    sxl, cxc = _carry_norm16(
+                        [seg_col(i) for i in sx_specs], 12)
+                    sx_neg = cxc < 0
+                    sxl = _negate_limbs16_if(sxl, sx_neg)
+                    syl, cyc = _carry_norm16(
+                        [seg_col(i) for i in sy_specs], 12)
+                    sy_neg = cyc < 0
+                    syl = _negate_limbs16_if(syl, sy_neg)
+                    _covar_cache[(pair_key, "sums")] = (
+                        sxl, sx_neg, syl, sy_neg)
+                return _covar_cache[(pair_key, "sums")]
+
+            if (pair_key, "num") not in _covar_cache:
+                sxl, sx_neg, syl, sy_neg = _norm_sums()
+                sxyl, cxyc = _carry_norm16(
+                    [seg_col(i) for i in sxy_specs], 20)
+                sxy_neg = cxyc < 0
+                sxyl = _negate_limbs16_if(sxyl, sxy_neg)
+                # A = n·|ΣXY| (sign sxy_neg), B = |ΣX|·|ΣY| (sign xor)
+                a_mag, _ = _carry_norm16(
+                    [l * vcount for l in sxyl], WIDTH)
+                b_mag, _ = _carry_norm16(_conv_limbs16(sxl, syl), WIDTH)
+                n_mag, n_neg = _signed_sub_limbs16(
+                    a_mag, sxy_neg, b_mag, sx_neg != sy_neg)
+                _covar_cache[(pair_key, "num")] = (
+                    jnp.where(n_neg, -1.0, 1.0) * _limbs16_to_f64(n_mag))
+            num = _covar_cache[(pair_key, "num")]
+            var_nums = None
+            if sq_specs is not None:
+                if (pair_key, "varnums") not in _covar_cache:
+                    sxl, _sxn, syl, _syn = _norm_sums()
+                    vn = []
+                    for sq, sl in ((sq_specs[0], sxl),
+                                   (sq_specs[1], syl)):
+                        ql, _ = _carry_norm16(
+                            [seg_col(i) for i in sq], 20)
+                        nq, _ = _carry_norm16(
+                            [q * vcount for q in ql], WIDTH)
+                        bsq, _ = _carry_norm16(
+                            _conv_limbs16(sl, sl), WIDTH)
+                        vn.append(
+                            _limbs16_to_f64(_sub_limbs16(nq, bsq)))
+                    _covar_cache[(pair_key, "varnums")] = vn
+                var_nums = _covar_cache[(pair_key, "varnums")]
+            scale = sum((cc.dtype.scale if cc.dtype.is_decimal else 0)
+                        for cc in (c, cy))
+            if op == "corr128pair":
+                # scales cancel between numerator and the sqrt of the
+                # variance-numerator product
+                out_val = num / jnp.sqrt(var_nums[0] * var_nums[1])
+                validity = vcount > 0
+            elif op == "covar_pop128pair":
+                out_val = num / jnp.maximum(
+                    vcount * vcount, 1).astype(jnp.float64) \
+                    * (10.0 ** scale)
+                validity = vcount > 0
+            else:
+                out_val = num / jnp.maximum(
+                    vcount * (vcount - 1), 1).astype(jnp.float64) \
+                    * (10.0 ** scale)
+                validity = vcount > 1
+            out_cols.append(
+                Column(DType(TypeId.FLOAT64), out_val, validity))
             continue
         if op in ("covar_samp", "covar_pop", "corr"):
             # pairwise centered moments Σcx·cy, Σcx², Σcy² in one

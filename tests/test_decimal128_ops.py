@@ -410,3 +410,99 @@ def test_decimal128_var_pop_exact():
     got_std = out.column(2).to_pylist()
     assert got_std == [v ** 0.5 for v in got_var]
     assert got_var[1] == 0.0 and got_std[1] == 0.0
+
+
+def test_decimal128_covar_corr_exact_vs_fraction_oracle():
+    """covar_samp/covar_pop/corr with DECIMAL128 operands: the numerator
+    n·ΣXY − ΣX·ΣY is assembled in sign-magnitude limb arithmetic and
+    rounded to float64 once; corr's decimal scales cancel against the
+    exact variance numerators (groupby.py covar128pair branch)."""
+    import random
+    from fractions import Fraction
+
+    random.seed(21)
+    n = 200
+    keys = [random.randrange(5) for _ in range(n)]
+    xs = [((-1) ** i) * random.getrandbits(100) for i in range(n)]
+    ys = [((-1) ** (i // 3)) * random.getrandbits(90) for i in range(n)]
+    xs[4] = None
+    ys[9] = None
+    tbl = Table([
+        Column.from_pylist(keys, t.INT64),
+        Column.from_pylist(xs, t.decimal128(-2)),
+        Column.from_pylist(ys, t.decimal128(-1)),
+    ])
+    out = groupby_aggregate(tbl, [0], [
+        (1, ("covar_samp", 2)), (1, ("covar_pop", 2)), (1, ("corr", 2)),
+    ]).compact()
+    for i, k in enumerate(out.column(0).to_pylist()):
+        sel = [(x, y) for kk, x, y in zip(keys, xs, ys)
+               if kk == k and x is not None and y is not None]
+        cnt = len(sel)
+        sx = sum(x for x, _ in sel)
+        sy = sum(y for _, y in sel)
+        big_n = cnt * sum(x * y for x, y in sel) - sx * sy
+        scale = Fraction(10) ** (-2 + -1)
+        vx = cnt * sum(x * x for x, _ in sel) - sx * sx
+        vy = cnt * sum(y * y for _, y in sel) - sy * sy
+        want = {
+            1: float(Fraction(big_n, cnt * (cnt - 1)) * scale),
+            2: float(Fraction(big_n, cnt * cnt) * scale),
+            3: big_n / (vx * vy) ** 0.5,
+        }
+        for col, w in want.items():
+            got = out.column(col).to_pylist()[i]
+            assert abs(got - w) <= 1e-12 * max(abs(w), 1e-300), (k, col)
+
+
+def test_decimal128_covar_mixed_int_partner_and_postures():
+    """DECIMAL128 x INT64 rides the exact path (sign-extended limbs);
+    float partners are rejected; singleton/empty groups follow the
+    covar validity postures."""
+    tbl = Table([
+        Column.from_pylist([1, 1, 1, 1, 2], t.INT64),
+        Column.from_pylist(
+            [10 ** 30, -(10 ** 30), 5, 7, 9], t.decimal128(0)),
+        Column.from_pylist([3, -2, 8, 1, 4], t.INT64),
+    ])
+    out = groupby_aggregate(
+        tbl, [0], [(1, ("covar_pop", 2)), (1, ("covar_samp", 2))]
+    ).compact()
+    from fractions import Fraction
+
+    sel = [(10 ** 30, 3), (-(10 ** 30), -2), (5, 8), (7, 1)]
+    sx = sum(x for x, _ in sel)
+    sy = sum(y for _, y in sel)
+    big_n = 4 * sum(x * y for x, y in sel) - sx * sy
+    want_pop = float(Fraction(big_n, 16))
+    got = out.column(1).to_pylist()
+    assert abs(got[0] - want_pop) <= 1e-12 * abs(want_pop)
+    assert got[1] == 0.0                      # singleton covar_pop = 0
+    assert out.column(2).to_pylist()[1] is None   # singleton samp null
+
+    fcol = Column.from_numpy(np.ones(5))
+    with pytest.raises(TypeError, match="integral-storage"):
+        groupby_aggregate(
+            Table([tbl.column(0), tbl.column(1), fcol]),
+            [0], [(1, ("corr", 2))])
+
+
+def test_decimal128_covar_uint64_partner_zero_extends():
+    """UINT64 partners >= 2^63 must zero-extend, not sign-wrap (a wrap
+    flips the covariance sign silently)."""
+    from fractions import Fraction
+
+    ys = [2 ** 63 + 10, 5, 7]
+    tbl = Table([
+        Column.from_pylist([1, 1, 1], t.INT64),
+        Column.from_pylist([100, 200, 300], t.decimal128(0)),
+        Column.from_numpy(np.array(ys, dtype=np.uint64)),
+    ])
+    out = groupby_aggregate(tbl, [0], [(1, ("covar_pop", 2))]).compact()
+    sel = list(zip([100, 200, 300], ys))
+    sx = sum(x for x, _ in sel)
+    sy = sum(y for _, y in sel)
+    big_n = 3 * sum(x * y for x, y in sel) - sx * sy
+    want = float(Fraction(big_n, 9))
+    got = out.column(1).to_pylist()[0]
+    assert abs(got - want) <= 1e-12 * abs(want), (got, want)
