@@ -1,0 +1,510 @@
+"""String transform/function breadth over the padded device layout.
+
+The cuDF strings API (vendored capability surface, SURVEY.md section 2.2)
+carries the full Spark string-function family; this module adds the
+widely-used transforms missing from ``ops.strings``: length, trim
+variants, pad variants, concat/concat_ws, instr, repeat, reverse,
+translate, and split (producing LIST<STRING> for the split+explode
+pattern).
+
+Design: everything is index arithmetic + ``take_along_axis`` gathers
+over the (n, W) padded char matrix — no scatters, no per-row host work.
+Char-level semantics (Spark counts CHARACTERS) are handled either
+exactly on device (length, reverse, instr — continuation-byte masks) or
+by an ASCII-device/host-Unicode split (lpad/rpad/initcap — the
+upper/lower posture).
+
+Null semantics are Spark's: unary transforms propagate nulls; concat is
+null-if-any-null; concat_ws SKIPS nulls; split of null is null.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops.strings import (
+    is_padded,
+    pad_strings,
+)
+from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+_CONT_MASK = jnp.uint8(0xC0)
+_CONT_BITS = jnp.uint8(0x80)
+
+
+def _padded(col: Column) -> Column:
+    if not col.dtype.is_string:
+        raise TypeError(f"string op needs a STRING column, got {col.dtype}")
+    return col if is_padded(col) else pad_strings(col)
+
+
+def _in_row(lens: jnp.ndarray, w: int) -> jnp.ndarray:
+    return jnp.arange(w, dtype=jnp.int32)[None, :] < lens[:, None]
+
+
+def _is_cont(chars: jnp.ndarray) -> jnp.ndarray:
+    return (chars & _CONT_MASK) == _CONT_BITS
+
+
+def _validity(col: Column):
+    return col.valid_mask() if col.validity is not None else None
+
+
+def _string_col(lens: jnp.ndarray, chars: jnp.ndarray, validity):
+    return Column(DType(TypeId.STRING), lens.astype(jnp.int32), validity,
+                  chars=chars)
+
+
+@func_range("string_length")
+def length(col: Column) -> Column:
+    """Spark ``length``: CHARACTER count (UTF-8 aware)."""
+    p = _padded(col)
+    w = p.chars.shape[1]
+    nch = jnp.sum(
+        (_in_row(p.data, w) & ~_is_cont(p.chars)).astype(jnp.int32), axis=1)
+    return Column(DType(TypeId.INT32), nch.astype(jnp.int32),
+                  _validity(col))
+
+
+def _trim_bounds(p: Column, charset: bytes, left: bool, right: bool):
+    w = p.chars.shape[1]
+    member = jnp.zeros_like(p.chars, dtype=jnp.bool_)
+    for b in charset:
+        member = member | (p.chars == jnp.uint8(b))
+    in_row = _in_row(p.data, w)
+    keep = ~member & in_row
+    any_keep = jnp.any(keep, axis=1)
+    if left:
+        first = jnp.argmax(keep, axis=1).astype(jnp.int32)
+        start = jnp.where(any_keep, first, p.data)
+    else:
+        start = jnp.zeros_like(p.data)
+    if right:
+        last = (w - 1 - jnp.argmax(keep[:, ::-1], axis=1)).astype(jnp.int32)
+        end = jnp.where(any_keep, last + 1, start)
+    else:
+        end = p.data
+    return start, jnp.maximum(end, start)
+
+
+def _shift_rows(chars: jnp.ndarray, start: jnp.ndarray,
+                new_len: jnp.ndarray) -> jnp.ndarray:
+    w = chars.shape[1]
+    idx = jnp.arange(w, dtype=jnp.int32)[None, :] + start[:, None]
+    out = jnp.take_along_axis(chars, jnp.clip(idx, 0, w - 1), axis=1)
+    return jnp.where(_in_row(new_len, w), out, jnp.uint8(0))
+
+
+def _trim_impl(col: Column, charset: str, left: bool,
+               right: bool) -> Column:
+    cs = charset.encode()
+    if any(b >= 0x80 for b in cs):
+        raise NotImplementedError(
+            "trim charset must be ASCII (multi-byte trim chars need the "
+            "host path)")
+    p = _padded(col)
+    start, end = _trim_bounds(p, cs, left, right)
+    new_len = end - start
+    return _string_col(new_len, _shift_rows(p.chars, start, new_len),
+                       _validity(col))
+
+
+@func_range("string_trim")
+def trim(col: Column, charset: str = " ") -> Column:
+    """Spark ``trim``/``btrim``: strip leading+trailing charset chars."""
+    return _trim_impl(col, charset, True, True)
+
+
+@func_range("string_ltrim")
+def ltrim(col: Column, charset: str = " ") -> Column:
+    return _trim_impl(col, charset, True, False)
+
+
+@func_range("string_rtrim")
+def rtrim(col: Column, charset: str = " ") -> Column:
+    return _trim_impl(col, charset, False, True)
+
+
+def _ascii_only(p: Column) -> bool:
+    """Host-synced check: every content byte < 0x80."""
+    w = p.chars.shape[1]
+    return bool(jnp.all(~_in_row(p.data, w) | (p.chars < 0x80)))
+
+
+def _pad_impl(col: Column, width: int, pad: str, left: bool) -> Column:
+    """lpad/rpad, CHARACTER-counted. ASCII data + ASCII pad rides the
+    device path; anything else falls back to the host (the upper/lower
+    posture)."""
+    pb = pad.encode()
+    p = _padded(col)
+    if width <= 0:
+        # Spark UTF8String.lpad/rpad with len <= 0 is always ''
+        n = p.chars.shape[0]
+        return _string_col(jnp.zeros((n,), jnp.int32),
+                           jnp.zeros((n, 1), jnp.uint8), _validity(col))
+    if not pb:
+        # Spark with an empty pad string truncates but never extends
+        pb = b"\x00"  # placeholder, never used when npad clamps to 0
+        can_pad = False
+    else:
+        can_pad = True
+    if any(b >= 0x80 for b in pb) or not _ascii_only(p):
+        vals = col.to_pylist()  # handles both string layouts directly
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+            elif len(v) >= width:
+                out.append(v[:width])
+            elif not pad:
+                out.append(v)
+            else:
+                need = width - len(v)
+                fill = (pad * (need // len(pad) + 1))[:need]
+                out.append(fill + v if left else v + fill)
+        return pad_strings(Column.from_pylist(out, t.STRING))
+    # ASCII device path: chars == bytes
+    w = p.chars.shape[1]
+    out_w = max(width, 1)
+    lens = p.data
+    trunc = jnp.minimum(lens, width)
+    if can_pad:
+        npad = jnp.maximum(width - lens, 0)
+    else:
+        npad = jnp.zeros_like(lens)
+    out_len = jnp.where(lens >= width, trunc, trunc + npad)
+    j = jnp.arange(out_w, dtype=jnp.int32)[None, :]
+    pad_arr = jnp.asarray(np.frombuffer(pb, dtype=np.uint8))
+    plen = len(pb)
+    if left:
+        in_pad = j < npad[:, None]
+        src = jnp.clip(j - npad[:, None], 0, w - 1)
+    else:
+        in_pad = (j >= trunc[:, None]) & (j < out_len[:, None])
+        src = jnp.clip(j, 0, w - 1)
+    data = jnp.take_along_axis(
+        p.chars[:, :w], src, axis=1) if w else jnp.zeros(
+        (p.chars.shape[0], out_w), jnp.uint8)
+    padj = (j % plen) if left else ((j - trunc[:, None]) % plen)
+    pad_bytes = pad_arr[padj.astype(jnp.int32).reshape(-1)].reshape(
+        padj.shape) if plen > 1 else jnp.broadcast_to(
+        pad_arr[0], padj.shape)
+    out = jnp.where(in_pad, pad_bytes, data)
+    out = jnp.where(_in_row(out_len, out_w), out, jnp.uint8(0))
+    return _string_col(out_len, out, _validity(col))
+
+
+@func_range("string_lpad")
+def lpad(col: Column, width: int, pad: str = " ") -> Column:
+    return _pad_impl(col, width, pad, left=True)
+
+
+@func_range("string_rpad")
+def rpad(col: Column, width: int, pad: str = " ") -> Column:
+    return _pad_impl(col, width, pad, left=False)
+
+
+@func_range("string_concat")
+def concat(a: Column, b: Column) -> Column:
+    """Spark ``concat(a, b)``: null if EITHER side is null."""
+    pa, pb = _padded(a), _padded(b)
+    wa, wb = pa.chars.shape[1], pb.chars.shape[1]
+    out_w = wa + wb
+    la, lb = pa.data, pb.data
+    out_len = la + lb
+    j = jnp.arange(out_w, dtype=jnp.int32)[None, :]
+    from_a = j < la[:, None]
+    a_src = jnp.clip(j, 0, wa - 1)
+    b_src = jnp.clip(j - la[:, None], 0, wb - 1)
+    av = jnp.take_along_axis(pa.chars, a_src, axis=1)
+    bv = jnp.take_along_axis(pb.chars, b_src, axis=1)
+    out = jnp.where(from_a, av, bv)
+    out = jnp.where(_in_row(out_len, out_w), out, jnp.uint8(0))
+    validity = pa.valid_mask() & pb.valid_mask()
+    if a.validity is None and b.validity is None:
+        validity = None
+    return _string_col(out_len, out, validity)
+
+
+@func_range("string_concat_ws")
+def concat_ws(sep: str, cols: Sequence[Column]) -> Column:
+    """Spark ``concat_ws``: join NON-NULL operands with ``sep`` (null
+    operands are skipped; the result is null only when... never — Spark
+    returns '' when all operands are null)."""
+    sb = sep.encode()
+    slen = len(sb)
+    if not cols:
+        raise ValueError(
+            "concat_ws needs at least one column (a zero-operand "
+            "concat_ws is a planner constant, not a columnar kernel)")
+    ps = [_padded(c) for c in cols]
+    n = ps[0].chars.shape[0]
+    out_w = sum(p.chars.shape[1] for p in ps) + slen * max(len(ps) - 1, 0)
+    sep_arr = jnp.asarray(np.frombuffer(sb, dtype=np.uint8)) if slen \
+        else None
+    out = jnp.zeros((n, max(out_w, 1)), jnp.uint8)
+    cur_len = jnp.zeros((n,), jnp.int32)
+    j = jnp.arange(max(out_w, 1), dtype=jnp.int32)[None, :]
+    started = jnp.zeros((n,), jnp.bool_)
+    for p in ps:
+        ok = p.valid_mask()
+        piece_len = jnp.where(ok, p.data, 0)
+        sep_here = jnp.where(started & ok, slen, 0).astype(jnp.int32)
+        # separator bytes
+        if slen:
+            rel = j - cur_len[:, None]
+            in_sep = (rel >= 0) & (rel < sep_here[:, None])
+            sep_b = sep_arr[jnp.clip(rel, 0, slen - 1).reshape(-1)].reshape(
+                rel.shape)
+            out = jnp.where(in_sep, sep_b, out)
+            cur_len = cur_len + sep_here
+        rel = j - cur_len[:, None]
+        wp = p.chars.shape[1]
+        in_piece = (rel >= 0) & (rel < piece_len[:, None])
+        src = jnp.clip(rel, 0, max(wp - 1, 0))
+        pv = jnp.take_along_axis(p.chars, src, axis=1)
+        out = jnp.where(in_piece, pv, out)
+        cur_len = cur_len + piece_len
+        started = started | ok
+    out = jnp.where(_in_row(cur_len, max(out_w, 1)), out, jnp.uint8(0))
+    return _string_col(cur_len, out, None)
+
+
+@func_range("string_instr")
+def instr(col: Column, sub: str) -> Column:
+    """Spark ``instr``: 1-based CHARACTER position of the first
+    occurrence, 0 when absent, null for null input. Empty needle -> 1
+    (Java indexOf convention)."""
+    from spark_rapids_jni_tpu.ops.strings import _needle_windows
+
+    p = _padded(col)
+    w = p.chars.shape[1]
+    nb = sub.encode()
+    if not nb:
+        one = jnp.ones((p.chars.shape[0],), jnp.int32)
+        return Column(DType(TypeId.INT32), one, _validity(col))
+    hit = _needle_windows(p, nb)   # (n, w) byte-position hits
+    in_row = jnp.arange(w, dtype=jnp.int32)[None, :] + len(nb) \
+        <= p.data[:, None]
+    hit = hit & in_row
+    any_hit = jnp.any(hit, axis=1)
+    first_byte = jnp.argmax(hit, axis=1).astype(jnp.int32)
+    # char index of that byte = count of non-continuation bytes before it
+    notcont = (~_is_cont(p.chars)).astype(jnp.int32)
+    pre = jnp.cumsum(notcont, axis=1)
+    idx = jnp.take_along_axis(
+        pre, jnp.clip(first_byte - 1, 0, w - 1)[:, None], axis=1)[:, 0]
+    charpos = jnp.where(first_byte > 0, idx, 0) + 1
+    return Column(DType(TypeId.INT32),
+                  jnp.where(any_hit, charpos, 0).astype(jnp.int32),
+                  _validity(col))
+
+
+@func_range("string_repeat")
+def repeat(col: Column, k: int) -> Column:
+    """Spark ``repeat(str, k)``; k <= 0 gives ''."""
+    p = _padded(col)
+    w = p.chars.shape[1]
+    if k <= 0:
+        n = p.chars.shape[0]
+        return _string_col(jnp.zeros((n,), jnp.int32),
+                           jnp.zeros((n, 1), jnp.uint8), _validity(col))
+    out_w = w * k
+    lens = p.data
+    out_len = lens * k
+    j = jnp.arange(out_w, dtype=jnp.int32)[None, :]
+    safe = jnp.maximum(lens, 1)[:, None]
+    src = jnp.clip(j % safe, 0, w - 1)
+    out = jnp.take_along_axis(p.chars, src, axis=1)
+    out = jnp.where(_in_row(out_len, out_w), out, jnp.uint8(0))
+    return _string_col(out_len, out, _validity(col))
+
+
+@func_range("string_reverse")
+def reverse(col: Column) -> Column:
+    """Spark ``reverse``: CHARACTER-level reversal (multi-byte UTF-8
+    sequences keep their byte order). For output byte j, mirror to
+    e = len-1-j, find e's character [start s, final f], and read byte
+    s + (f - e) — two masked scans, one gather, no host work."""
+    p = _padded(col)
+    n, w = p.chars.shape
+    lens = p.data
+    idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    starts = ~_is_cont(p.chars)  # zero padding is a start too
+    # char start position per byte: running max of start indices
+    import jax
+
+    s_per = jax.lax.cummax(jnp.where(starts, idx, -1), axis=1)
+    # char final position per byte: a byte is final iff the NEXT byte
+    # starts a char (the zero pad after the last byte is a start)
+    nxt = jnp.concatenate(
+        [starts[:, 1:], jnp.ones((n, 1), jnp.bool_)], axis=1)
+    f_per = jax.lax.cummin(jnp.where(nxt, idx, w), axis=1, reverse=True)
+    e = jnp.clip(lens[:, None] - 1 - idx, 0, w - 1)
+    s_e = jnp.take_along_axis(s_per, e, axis=1)
+    f_e = jnp.take_along_axis(f_per, e, axis=1)
+    src = jnp.clip(s_e + (f_e - e), 0, w - 1)
+    out = jnp.take_along_axis(p.chars, src, axis=1)
+    out = jnp.where(_in_row(lens, w), out, jnp.uint8(0))
+    return _string_col(lens, out, _validity(col))
+
+
+@func_range("string_translate")
+def translate(col: Column, from_str: str, to_str: str) -> Column:
+    """Spark ``translate``: per-character substitution; chars in
+    ``from_str`` beyond ``to_str``'s length are DELETED. Single-byte
+    (ASCII) mappings ride the device 256-entry table; any multi-byte
+    character in the mapping or the data falls back to the host."""
+    fb, tb = from_str.encode(), to_str.encode()
+    p = _padded(col)
+    if (any(b >= 0x80 for b in fb) or any(b >= 0x80 for b in tb)
+            or not _ascii_only(p)):
+        table = {}
+        for i, ch in enumerate(from_str):
+            if ch not in table:
+                table[ch] = to_str[i] if i < len(to_str) else None
+        vals = col.to_pylist()  # handles both string layouts directly
+        out = [None if v is None else
+               "".join((table[ch] if table[ch] is not None else "")
+                       if ch in table else ch for ch in v) for v in vals]
+        return pad_strings(Column.from_pylist(out, t.STRING))
+    # device path: map[256] with a delete marker, then compact kept bytes
+    m = np.arange(256, dtype=np.int16)
+    seen = set()
+    for i, b in enumerate(fb):
+        if b in seen:
+            continue
+        seen.add(b)
+        m[b] = tb[i] if i < len(tb) else -1
+    tbl = jnp.asarray(m)
+    w = p.chars.shape[1]
+    mapped = tbl[p.chars.astype(jnp.int32)]
+    keep = (mapped >= 0) & _in_row(p.data, w)
+    new_len = jnp.sum(keep.astype(jnp.int32), axis=1)
+    # compact kept bytes to the front: position among kept = exclusive
+    # prefix; dense gather via argsort of ~keep (stable)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    gathered = jnp.take_along_axis(
+        jnp.where(keep, mapped, 0).astype(jnp.uint8), order, axis=1)
+    out = jnp.where(_in_row(new_len, w), gathered, jnp.uint8(0))
+    return _string_col(new_len, out, _validity(col))
+
+
+class SplitResult(NamedTuple):
+    column: Column            # LIST<STRING>, one list per input row
+    overflowed: jnp.ndarray   # True when a row had more pieces than cap
+
+
+@func_range("string_split")
+def split(col: Column, sep: str, limit: int = -1,
+          max_pieces: int | None = None) -> SplitResult:
+    """Spark ``split(str, sep[, limit])`` for LITERAL separators (regex
+    separators go through the host engine upstream): LIST<STRING> with
+    the split+explode contract.
+
+    ``limit > 0``: at most ``limit`` pieces, the last keeps the rest
+    (Java semantics) — the static piece budget is ``limit``.
+    ``limit <= 0``: unbounded; the caller must pass ``max_pieces`` as
+    the static budget, and rows exceeding it set ``overflowed`` (the
+    shuffle-capacity posture) with their excess pieces dropped.
+    """
+    import jax
+
+    sb = sep.encode()
+    if not sb:
+        raise ValueError("split separator must be non-empty")
+    cap = limit if limit > 0 else max_pieces
+    if cap is None:
+        raise ValueError(
+            "split with limit <= 0 needs max_pieces (static piece budget)")
+    if cap < 1:
+        raise ValueError("split piece budget must be >= 1")
+    from spark_rapids_jni_tpu.ops.strings import _needle_windows
+
+    p = _padded(col)
+    n, w = p.chars.shape
+    lens = p.data
+    raw = _needle_windows(p, sb)
+    in_row = jnp.arange(w, dtype=jnp.int32)[None, :] + len(sb) \
+        <= lens[:, None]
+    raw = raw & in_row
+    if len(sb) > 1:
+        # leftmost non-overlapping matches: a scan over byte columns
+        # kills hits that start inside an earlier match
+        def step(allowed, col_hits):
+            jcol, hits = col_hits
+            ok = hits & (jcol >= allowed)
+            allowed = jnp.where(ok, jcol + len(sb), allowed)
+            return allowed, ok
+
+        cols_idx = jnp.arange(w, dtype=jnp.int32)
+        _, kept = jax.lax.scan(
+            step, jnp.zeros((n,), jnp.int32),
+            (cols_idx, raw.T))
+        hits = kept.T
+    else:
+        hits = raw
+    ndelim = jnp.sum(hits.astype(jnp.int32), axis=1)
+    use_delim = jnp.minimum(ndelim, cap - 1)
+    # null input rows contribute no pieces at all — the dense child and
+    # the offsets must agree row-for-row
+    npieces = jnp.where(p.valid_mask(), use_delim + 1, 0)
+    overflowed = jnp.any((ndelim > cap - 1) if limit <= 0
+                         else jnp.zeros((n,), jnp.bool_))
+    # k-th delimiter byte position per row via searchsorted over the
+    # inclusive hit prefix (the _group_starts idiom)
+    incl = jnp.cumsum(hits.astype(jnp.int32), axis=1)
+    ks = jnp.arange(1, cap + 1, dtype=jnp.int32)  # delim ranks 1..cap
+    dpos = jax.vmap(
+        lambda pr: jnp.searchsorted(pr, ks, side="left"))(incl)
+    dpos = dpos.astype(jnp.int32)              # (n, cap); absent rank -> w
+    # piece p: [start_p, end_p) where end_p is delim rank p+1 (the
+    # natural/extended last piece is overridden below)
+    zero = jnp.zeros((n, 1), jnp.int32)
+    starts = jnp.concatenate(
+        [zero, dpos[:, :cap - 1] + len(sb)], axis=1)   # (n, cap)
+    ends = dpos
+    pidx = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    live = pidx < npieces[:, None]
+    if limit > 0:
+        # Java limit semantics: the last kept piece keeps the REST
+        # (separators included)
+        extend = pidx == (npieces - 1)[:, None]
+    else:
+        # cap mode: only a row's NATURAL last piece runs to end-of-row;
+        # overflowing rows get their excess pieces dropped cleanly
+        extend = pidx == ndelim[:, None]
+    p_start = jnp.where(live, starts, 0)
+    p_end = jnp.where(extend, lens[:, None], jnp.where(live, ends, 0))
+    p_len = jnp.maximum(p_end - p_start, 0)
+    # child: (n*cap, w) padded strings, row-major (row, piece)
+    flat_start = p_start.reshape(-1)
+    flat_len = p_len.reshape(-1)
+    src_rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), cap)
+    j = jnp.arange(w, dtype=jnp.int32)[None, :]
+    src = jnp.clip(flat_start[:, None] + j, 0, w - 1)
+    child_chars = jnp.take_along_axis(p.chars[src_rows], src, axis=1)
+    child_chars = jnp.where(_in_row(flat_len, w), child_chars,
+                            jnp.uint8(0))
+    # compact live pieces to the front of the child (argsort idiom) so
+    # offsets index a dense child
+    live_flat = live.reshape(-1)
+    order = jnp.argsort(~live_flat, stable=True).astype(jnp.int32)
+    child = Column(
+        DType(TypeId.STRING),
+        flat_len[order].astype(jnp.int32),
+        None,
+        chars=child_chars[order],
+    )
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64),
+         jnp.cumsum(npieces.astype(jnp.int64))]).astype(jnp.int32)
+    lc = Column(DType(TypeId.LIST), offsets, _validity(col),
+                children=[child])
+    return SplitResult(lc, overflowed)

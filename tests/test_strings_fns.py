@@ -1,0 +1,170 @@
+"""String function breadth (ops/strings_fns.py) vs Python oracles —
+length/trim/pad/concat/concat_ws/instr/repeat/reverse/translate/split."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops import strings_fns as f
+
+MIX = ["hello", "", "  padded  ", "a", None, "日本語", "naïve", "aXbXc",
+       " x ", "tail   ", "   lead", "ab"]
+
+
+def _col(vals=MIX):
+    return Column.from_pylist(vals, t.STRING)
+
+
+def test_length_counts_characters():
+    got = f.length(_col()).to_pylist()
+    assert got == [None if v is None else len(v) for v in MIX]
+
+
+def test_trim_variants_vs_python():
+    col = _col()
+    assert f.trim(col).to_pylist() == \
+        [None if v is None else v.strip(" ") for v in MIX]
+    assert f.ltrim(col).to_pylist() == \
+        [None if v is None else v.lstrip(" ") for v in MIX]
+    assert f.rtrim(col).to_pylist() == \
+        [None if v is None else v.rstrip(" ") for v in MIX]
+    # custom charset
+    c2 = Column.from_pylist(["xxhixx", "xhx", "hh"], t.STRING)
+    assert f.trim(c2, "x").to_pylist() == ["hi", "h", "hh"]
+
+
+def test_pad_variants_vs_python():
+    col = _col()
+
+    def lp(v, w, p):
+        if v is None:
+            return None
+        if len(v) >= w:
+            return v[:w]
+        need = w - len(v)
+        return (p * (need // len(p) + 1))[:need] + v
+
+    def rp(v, w, p):
+        if v is None:
+            return None
+        if len(v) >= w:
+            return v[:w]
+        need = w - len(v)
+        return v + (p * (need // len(p) + 1))[:need]
+
+    assert f.lpad(col, 6, "*").to_pylist() == [lp(v, 6, "*") for v in MIX]
+    assert f.rpad(col, 6, "ab").to_pylist() == [rp(v, 6, "ab") for v in MIX]
+    # multi-byte data rides the host path with the same semantics
+    assert f.lpad(_col(["é", "abc"]), 4, "-").to_pylist() == ["---é", "-abc"]
+    assert f.lpad(_col(["ab"]), 4, "é").to_pylist() == ["ééab"]
+
+
+def test_concat_and_concat_ws():
+    a = Column.from_pylist(["x", None, "ab", ""], t.STRING)
+    b = Column.from_pylist(["1", "2", None, "z"], t.STRING)
+    assert f.concat(a, b).to_pylist() == ["x1", None, None, "z"]
+    c = Column.from_pylist(["q", "r", "s", None], t.STRING)
+    # concat_ws skips nulls, never returns null
+    assert f.concat_ws("-", [a, b, c]).to_pylist() == \
+        ["x-1-q", "2-r", "ab-s", "-z"]  # empty strings are KEPT (Spark)
+    assert f.concat_ws("", [a, b]).to_pylist() == ["x1", "2", "ab", "z"]
+
+
+def test_instr_char_positions():
+    col = _col(["hello", "héllo", "abcabc", "", None, "日本語"])
+    assert f.instr(col, "l").to_pylist() == [3, 3, 0, 0, None, 0]
+    assert f.instr(col, "abc").to_pylist() == [0, 0, 1, 0, None, 0]
+    assert f.instr(col, "本").to_pylist() == [0, 0, 0, 0, None, 2]
+    assert f.instr(col, "").to_pylist() == [1, 1, 1, 1, None, 1]
+
+
+def test_repeat():
+    col = _col(["ab", "", None, "xyz"])
+    assert f.repeat(col, 3).to_pylist() == ["ababab", "", None, "xyzxyzxyz"]
+    assert f.repeat(col, 0).to_pylist() == ["", "", None, ""]
+
+
+def test_reverse_utf8_characters():
+    col = _col(["abc", "", None, "日本語", "aé日b", "x"])
+    assert f.reverse(col).to_pylist() == \
+        [None if v is None else v[::-1] for v in
+         ["abc", "", None, "日本語", "aé日b", "x"]]
+
+
+def test_translate_device_and_host():
+    col = _col(["abcba", "xyz", None])
+    # b->1, c deleted (from longer than to)
+    assert f.translate(col, "bc", "1").to_pylist() == ["a11a", "xyz", None]
+    # swap via table (simultaneous, not sequential)
+    assert f.translate(col, "ab", "ba").to_pylist() == \
+        ["bacab", "xyz", None]
+    # multi-byte mapping rides the host path
+    col2 = _col(["café", "ee"])
+    assert f.translate(col2, "é", "e").to_pylist() == ["cafe", "ee"]
+
+
+def test_split_literal_vs_python():
+    col = _col(["a,b,c", "", ",lead", "trail,", ",,", "solo", None])
+    res = f.split(col, ",", max_pieces=8)
+    assert not bool(res.overflowed)
+    got = res.column.to_pylist()
+    want = [None if v is None else v.split(",") for v in
+            ["a,b,c", "", ",lead", "trail,", ",,", "solo", None]]
+    assert got == want
+
+
+def test_split_limit_keeps_rest():
+    col = _col(["a,b,c,d", "x"])
+    got = f.split(col, ",", limit=2).column.to_pylist()
+    assert got == [["a", "b,c,d"], ["x"]]
+
+
+def test_split_multibyte_sep_non_overlapping():
+    col = _col(["aaa", "aabaab", "xx"])
+    got = f.split(col, "aa", max_pieces=6).column.to_pylist()
+    # Java "aaa".split("aa", -1) -> ["", "a"]; "aabaab" -> ["", "b", "b"]
+    assert got == [["", "a"], ["", "b", "b"], ["xx"]]
+
+
+def test_split_overflow_flag():
+    col = _col(["a,b,c,d,e"])
+    res = f.split(col, ",", max_pieces=3)
+    assert bool(res.overflowed)
+    # cap mode drops excess pieces cleanly — no separators leak into
+    # the kept pieces (limit mode is the one that keeps the rest)
+    assert res.column.to_pylist()[0] == ["a", "b", "c"]
+
+
+def test_split_then_explode():
+    from spark_rapids_jni_tpu.ops.lists import explode
+
+    col = _col(["a,b", "c", None])
+    ids = Column.from_pylist([1, 2, 3], t.INT64)
+    res = f.split(col, ",", max_pieces=4)
+    ex = explode(Table([ids, res.column]), 1)
+    rv = np.asarray(ex.row_valid)
+    rows = [(ex.table.column(0).to_pylist()[i],
+             ex.table.column(1).to_pylist()[i])
+            for i in np.flatnonzero(rv)]
+    assert rows == [(1, "a"), (1, "b"), (2, "c")]
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="non-empty"):
+        f.split(_col(["a"]), "")
+    with pytest.raises(ValueError, match="max_pieces"):
+        f.split(_col(["a"]), ",")
+    with pytest.raises(TypeError, match="STRING"):
+        f.length(Column.from_numpy(np.ones(2, np.int64)))
+
+
+def test_pad_nonpositive_width_is_empty():
+    col = _col(["abc", "é", None])
+    assert f.lpad(col, 0).to_pylist() == ["", "", None]
+    assert f.rpad(col, -1, "x").to_pylist() == ["", "", None]
+
+
+def test_concat_ws_empty_column_list_rejected():
+    with pytest.raises(ValueError, match="at least one column"):
+        f.concat_ws("-", [])
