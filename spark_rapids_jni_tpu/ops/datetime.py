@@ -168,3 +168,118 @@ def trunc(col: Column, unit: str) -> Column:
             m = (jnp.floor_divide(m - 1, 3) * 3) + 1
         out = days_from_civil(y, m, jnp.ones_like(m))
     return _int_out(col, out, DType(TypeId.TIMESTAMP_DAYS))
+
+
+def _intraday(col: Column, unit_per_day: int) -> jnp.ndarray:
+    """Units into the civil day, floor semantics (pre-epoch instants get
+    the positive intra-day remainder)."""
+    div = _TS_TO_DAY_DIV.get(col.dtype.type_id)
+    if div is None or div == 1:
+        raise NotImplementedError(
+            f"time-of-day op needs a sub-day TIMESTAMP column, got "
+            f"{col.dtype}")
+    d = col.data.astype(jnp.int64)
+    rem = d - jnp.floor_divide(d, div) * div     # [0, div)
+    return jnp.floor_divide(rem * unit_per_day, div)
+
+
+@func_range("dt_hour")
+def hour(col: Column) -> Column:
+    """Spark hour(): 0-23 within the instant's civil day."""
+    return _int_out(col, _intraday(col, 24))
+
+
+@func_range("dt_minute")
+def minute(col: Column) -> Column:
+    return _int_out(col, jnp.mod(_intraday(col, 24 * 60), 60))
+
+
+@func_range("dt_second")
+def second(col: Column) -> Column:
+    return _int_out(col, jnp.mod(_intraday(col, 86_400), 60))
+
+
+@func_range("dt_weekofyear")
+def weekofyear(col: Column) -> Column:
+    """Spark weekofyear(): ISO-8601 week number (1-53), branch-free.
+
+    w = (doy - isodow + 10) / 7; w == 0 rolls into the previous year's
+    last week, w == 53 rolls into week 1 when the year doesn't have 53
+    ISO weeks (i.e. Jan 1 is not Thu and it's not a leap year starting
+    Wed)."""
+    z = _days_since_epoch(col)
+    y, m, d = civil_from_days(z)
+    jan1 = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+    doy = (z - jan1 + 1).astype(jnp.int64)       # 1-based
+    isodow = jnp.mod(z + 3, 7) + 1               # 1=Mon..7=Sun
+    w = jnp.floor_divide(doy - isodow + 10, 7)
+    # w == 0: belongs to the previous ISO year's last week
+    prev_jan1 = days_from_civil(y - 1, jnp.ones_like(m), jnp.ones_like(d))
+    prev_len = jan1 - prev_jan1
+    prev_doy = doy + prev_len
+    w_prev = jnp.floor_divide(prev_doy - isodow + 10, 7)
+    # w == 53: valid only when Dec 28 of y is still in week 53 (ISO long
+    # year); otherwise it's week 1 of y+1
+    dec28 = days_from_civil(y, jnp.full_like(m, 12), jnp.full_like(d, 28))
+    dec28_dow = jnp.mod(dec28 + 3, 7) + 1
+    dec28_doy = (dec28 - jan1 + 1).astype(jnp.int64)
+    w_dec28 = jnp.floor_divide(dec28_doy - dec28_dow + 10, 7)
+    out = jnp.where(w < 1, w_prev, jnp.where(w > w_dec28, 1, w))
+    return _int_out(col, out)
+
+
+@func_range("dt_months_between")
+def months_between(end: Column, start: Column,
+                   round_off: bool = True) -> Column:
+    """Spark months_between(date1, date2): whole months plus a 31-day
+    fractional remainder; exact integer when the days-of-month match or
+    both are month-ends; rounded to 8 digits when ``round_off``.
+    FLOAT64 output. DATE-precision operands only: Spark counts
+    time-of-day in the 31-day fraction, so silently flooring a sub-day
+    timestamp would give wrong-vs-Spark answers — raise instead (the
+    date_add/add_months posture)."""
+    for c in (end, start):
+        if c.dtype.type_id != TypeId.TIMESTAMP_DAYS:
+            raise NotImplementedError(
+                "months_between needs TIMESTAMP_DAYS columns (sub-day "
+                "precision contributes to Spark's fraction)")
+    z1, z2 = _days_since_epoch(end), _days_since_epoch(start)
+    y1, m1, d1 = civil_from_days(z1)
+    y2, m2, d2 = civil_from_days(z2)
+    months = ((y1 - y2) * 12 + (m1 - m2)).astype(jnp.float64)
+
+    def _is_month_end(y, m, d, z):
+        nxt = days_from_civil(
+            y + jnp.floor_divide(m, 12),
+            jnp.mod(m, 12) + 1, jnp.ones_like(d))
+        return z == nxt - 1
+
+    both_end = _is_month_end(y1, m1, d1, z1) & _is_month_end(y2, m2, d2, z2)
+    same_dom = d1 == d2
+    frac = (d1 - d2).astype(jnp.float64) / 31.0
+    out = jnp.where(same_dom | both_end, months, months + frac)
+    if round_off:
+        out = jnp.round(out * 1e8) / 1e8
+    validity = end.valid_mask() & start.valid_mask()
+    return Column(DType(TypeId.FLOAT64), out, validity)
+
+
+_NEXT_DAY_NAMES = {
+    "mon": 1, "monday": 1, "tue": 2, "tuesday": 2, "wed": 3,
+    "wednesday": 3, "thu": 4, "thursday": 4, "fri": 5, "friday": 5,
+    "sat": 6, "saturday": 6, "sun": 7, "sunday": 7,
+}
+
+
+@func_range("dt_next_day")
+def next_day(col: Column, day_name: str) -> Column:
+    """Spark next_day(date, dayOfWeek): the first date LATER than the
+    input that falls on the given weekday."""
+    key = day_name.strip().lower()
+    if key not in _NEXT_DAY_NAMES:
+        raise ValueError(f"unknown day-of-week name {day_name!r}")
+    target = _NEXT_DAY_NAMES[key]                # 1=Mon..7=Sun
+    z = _days_since_epoch(col)
+    isodow = jnp.mod(z + 3, 7) + 1
+    ahead = jnp.mod(target - isodow + 6, 7) + 1  # 1..7 strictly ahead
+    return _int_out(col, z + ahead, DType(TypeId.TIMESTAMP_DAYS))

@@ -289,10 +289,8 @@ def instr(col: Column, sub: str) -> Column:
     if not nb:
         one = jnp.ones((p.chars.shape[0],), jnp.int32)
         return Column(DType(TypeId.INT32), one, _validity(col))
+    # _needle_windows already masks hits to needle-fits-in-row
     hit = _needle_windows(p, nb)   # (n, w) byte-position hits
-    in_row = jnp.arange(w, dtype=jnp.int32)[None, :] + len(nb) \
-        <= p.data[:, None]
-    hit = hit & in_row
     any_hit = jnp.any(hit, axis=1)
     first_byte = jnp.argmax(hit, axis=1).astype(jnp.int32)
     # char index of that byte = count of non-continuation bytes before it
@@ -430,10 +428,7 @@ def split(col: Column, sep: str, limit: int = -1,
     p = _padded(col)
     n, w = p.chars.shape
     lens = p.data
-    raw = _needle_windows(p, sb)
-    in_row = jnp.arange(w, dtype=jnp.int32)[None, :] + len(sb) \
-        <= lens[:, None]
-    raw = raw & in_row
+    raw = _needle_windows(p, sb)   # already masked to fits-in-row
     if len(sb) > 1:
         # leftmost non-overlapping matches: a scan over byte columns
         # kills hits that start inside an earlier match
@@ -508,3 +503,42 @@ def split(col: Column, sep: str, limit: int = -1,
     lc = Column(DType(TypeId.LIST), offsets, _validity(col),
                 children=[child])
     return SplitResult(lc, overflowed)
+
+
+@func_range("string_initcap")
+def initcap(col: Column) -> Column:
+    """Spark ``initcap``: first letter of each SPACE-delimited word
+    uppercased, every other letter lowercased — Spark's
+    UTF8String.toTitleCase treats only ' ' (0x20) as a delimiter, so
+    tabs/newlines do NOT start words. ASCII rides the device path;
+    non-ASCII data falls back to the host (the upper/lower posture)."""
+    p = _padded(col)
+    if not _ascii_only(p):
+        vals = col.to_pylist()
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+                continue
+            chars = []
+            prev_sp = True
+            for ch in v:
+                if ch == " ":
+                    chars.append(ch)
+                    prev_sp = True
+                else:
+                    chars.append(ch.upper() if prev_sp else ch.lower())
+                    prev_sp = False
+            out.append("".join(chars))
+        return pad_strings(Column.from_pylist(out, t.STRING))
+    n, w = p.chars.shape
+    ws = p.chars == jnp.uint8(0x20)
+    prev_ws = jnp.concatenate(
+        [jnp.ones((n, 1), jnp.bool_), ws[:, :-1]], axis=1)
+    is_lower = (p.chars >= 0x61) & (p.chars <= 0x7A)
+    is_upper = (p.chars >= 0x41) & (p.chars <= 0x5A)
+    up = jnp.where(is_lower, p.chars - 0x20, p.chars)
+    low = jnp.where(is_upper, p.chars + 0x20, p.chars)
+    out = jnp.where(prev_ws, up, low)
+    out = jnp.where(_in_row(p.data, w), out, jnp.uint8(0))
+    return _string_col(p.data, out, _validity(col))

@@ -106,3 +106,89 @@ def test_day_of_week_spark_convention():
     col = _dates_col([0, 3, 4])  # Thu, Sun, Mon
     assert dt.day_of_week(col).to_pylist() == [4, 7, 1]
     assert dt.day_of_week_spark(col).to_pylist() == [5, 1, 2]
+
+
+def test_hour_minute_second_vs_python():
+    import datetime as dtm
+
+    rng = np.random.default_rng(17)
+    us = rng.integers(-4 * 10**15, 4 * 10**15, 400)
+    col = Column.from_numpy(us, t.DType(t.TypeId.TIMESTAMP_MICROSECONDS))
+    from spark_rapids_jni_tpu.ops import datetime as d
+
+    hh = d.hour(col).to_pylist()
+    mm = d.minute(col).to_pylist()
+    ss = d.second(col).to_pylist()
+    epoch = dtm.datetime(1970, 1, 1)
+    for i, u in enumerate(us.tolist()):
+        w = epoch + dtm.timedelta(microseconds=int(u))
+        assert (hh[i], mm[i], ss[i]) == (w.hour, w.minute, w.second), u
+
+
+def test_weekofyear_vs_python_isocalendar():
+    import datetime as dtm
+
+    days = list(range(-1100, 1100, 7)) + list(range(10950, 11330))
+    col = Column.from_pylist(days, t.DType(t.TypeId.TIMESTAMP_DAYS))
+    from spark_rapids_jni_tpu.ops import datetime as d
+
+    got = d.weekofyear(col).to_pylist()
+    epoch = dtm.date(1970, 1, 1)
+    for i, z in enumerate(days):
+        want = (epoch + dtm.timedelta(days=z)).isocalendar()[1]
+        assert got[i] == want, (z, epoch + dtm.timedelta(days=z))
+
+
+def test_months_between_spark_rules():
+    import datetime as dtm
+
+    from spark_rapids_jni_tpu.ops import datetime as d
+
+    epoch = dtm.date(1970, 1, 1)
+
+    def day(y, m, dd):
+        return (dtm.date(y, m, dd) - epoch).days
+
+    pairs = [
+        ((1997, 2, 28), (1996, 10, 30)),   # Spark doc example: 3.9354...
+        ((2015, 3, 31), (2015, 2, 28)),    # both month-ends -> 1.0
+        ((2020, 5, 15), (2020, 3, 15)),    # same dom -> 2.0
+        ((2020, 1, 1), (2020, 1, 31)),     # negative fraction
+    ]
+    c1 = Column.from_pylist([day(*a) for a, _ in pairs],
+                            t.DType(t.TypeId.TIMESTAMP_DAYS))
+    c2 = Column.from_pylist([day(*b) for _, b in pairs],
+                            t.DType(t.TypeId.TIMESTAMP_DAYS))
+    got = d.months_between(c1, c2).to_pylist()
+    assert got[0] == pytest.approx(3.93548387)   # Spark's documented value
+    assert got[1] == 1.0
+    assert got[2] == 2.0
+    assert got[3] == pytest.approx(-(30 / 31), abs=1e-8)
+
+
+def test_next_day_vs_python():
+    import datetime as dtm
+
+    from spark_rapids_jni_tpu.ops import datetime as d
+
+    epoch = dtm.date(1970, 1, 1)
+    days = list(range(10950, 10990))
+    col = Column.from_pylist(days, t.DType(t.TypeId.TIMESTAMP_DAYS))
+    for name, iso in (("monday", 1), ("Fri", 5), ("SUN", 7)):
+        got = d.next_day(col, name).to_pylist()
+        for z, g in zip(days, got):
+            cur = epoch + dtm.timedelta(days=z)
+            want = cur + dtm.timedelta(days=1)
+            while want.isoweekday() != iso:
+                want += dtm.timedelta(days=1)
+            assert g == (want - epoch).days, (z, name)
+
+
+def test_months_between_rejects_subday_precision():
+    from spark_rapids_jni_tpu.ops import datetime as d
+
+    c = Column.from_numpy(np.zeros(2, np.int64),
+                          t.DType(t.TypeId.TIMESTAMP_MICROSECONDS))
+    cd = Column.from_pylist([0, 1], t.DType(t.TypeId.TIMESTAMP_DAYS))
+    with pytest.raises(NotImplementedError, match="TIMESTAMP_DAYS"):
+        d.months_between(c, cd)

@@ -168,3 +168,15 @@ def test_pad_nonpositive_width_is_empty():
 def test_concat_ws_empty_column_list_rejected():
     with pytest.raises(ValueError, match="at least one column"):
         f.concat_ws("-", [])
+
+
+def test_initcap_device_and_host():
+    col = _col(["hello world", "a  b", "XYZ abc", "", None, "  x"])
+    assert f.initcap(col).to_pylist() == \
+        ["Hello World", "A  B", "Xyz Abc", "", None, "  X"]
+    # Spark delimits on SPACE only: a tab does not start a new word
+    assert f.initcap(_col(["foo\tbar baz"])).to_pylist() == \
+        ["Foo\tbar Baz"]
+    # non-ASCII routes to host with identical word logic
+    col2 = _col(["héllo wörld", "日本 test"])
+    assert f.initcap(col2).to_pylist() == ["Héllo Wörld", "日本 Test"]
