@@ -265,9 +265,12 @@ def months_between(end: Column, start: Column,
 
 
 _NEXT_DAY_NAMES = {
-    "mon": 1, "monday": 1, "tue": 2, "tuesday": 2, "wed": 3,
-    "wednesday": 3, "thu": 4, "thursday": 4, "fri": 5, "friday": 5,
-    "sat": 6, "saturday": 6, "sun": 7, "sunday": 7,
+    # Spark's DateTimeUtils.getDayOfWeekFromString accepts 2-letter,
+    # 3-letter, and full names
+    "mo": 1, "mon": 1, "monday": 1, "tu": 2, "tue": 2, "tuesday": 2,
+    "we": 3, "wed": 3, "wednesday": 3, "th": 4, "thu": 4, "thursday": 4,
+    "fr": 5, "fri": 5, "friday": 5, "sa": 6, "sat": 6, "saturday": 6,
+    "su": 7, "sun": 7, "sunday": 7,
 }
 
 

@@ -212,3 +212,115 @@ def groupby_collect(table: Table, keys: Sequence[int], value_col: int,
         children=[child],
     ))
     return CollectResult(Table(out_cols), num_groups)
+
+
+@func_range("array_size")
+def array_size(col: Column) -> Column:
+    """Spark ``size``/``cardinality``: element count per list; null
+    lists give null (ANSI) — the caller can map null->-1 for legacy."""
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(f"array_size needs a LIST column, got {col.dtype}")
+    lens = (col.data[1:] - col.data[:-1]).astype(jnp.int32)
+    return Column(DType(TypeId.INT32), lens,
+                  col.valid_mask() if col.validity is not None else None)
+
+
+@func_range("array_contains")
+def array_contains(col: Column, value) -> Column:
+    """Spark ``array_contains(list, value)``: per-row ANY over the
+    child — a prefix-difference count over the flat child matches, no
+    per-row loops. Three-valued logic matches Spark's ArrayContains:
+    TRUE when found; NULL when not found but the list has a null
+    element (the null might have been the value); FALSE otherwise; a
+    null list is null."""
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(
+            f"array_contains needs a LIST column, got {col.dtype}")
+    child = col.children[0]
+    if child.dtype.is_decimal128:
+        raise NotImplementedError(
+            "array_contains on DECIMAL128 children")
+    if child.dtype.is_string:
+        from spark_rapids_jni_tpu.ops import strings as s
+
+        p = s.pad_strings(child)
+        vb = str(value).encode()
+        w = p.chars.shape[1]
+        if len(vb) > w:
+            hit = jnp.zeros((p.chars.shape[0],), jnp.bool_)
+        else:
+            target = jnp.zeros((w,), jnp.uint8).at[:len(vb)].set(
+                jnp.asarray(bytearray(vb), dtype=jnp.uint8))
+            hit = (p.data == len(vb)) & jnp.all(
+                p.chars == target[None, :], axis=1)
+        hit = hit & p.valid_mask()
+    else:
+        hit = (child.data == value) & child.valid_mask()
+
+    def _range_any(flags):
+        pref = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int64),
+             jnp.cumsum(flags.astype(jnp.int64))])
+        off = col.data.astype(jnp.int32)
+        return (pref[off[1:]] - pref[off[:-1]]) > 0
+
+    found = _range_any(hit)
+    has_null_elem = _range_any(~child.valid_mask())
+    from spark_rapids_jni_tpu.types import BOOL8
+
+    validity = col.valid_mask() & (found | ~has_null_elem)
+    return Column(BOOL8, found.astype(jnp.uint8), validity)
+
+
+@func_range("element_at")
+def element_at(col: Column, k: int) -> Column:
+    """Spark ``element_at(list, k)``: 1-based; negative k counts from
+    the end; out-of-bounds gives null (non-ANSI posture)."""
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(f"element_at needs a LIST column, got {col.dtype}")
+    if k == 0:
+        raise ValueError("element_at index is 1-based (k != 0)")
+    child = col.children[0]
+    off = col.data.astype(jnp.int32)
+    lens = off[1:] - off[:-1]
+    if k > 0:
+        pos = off[:-1] + (k - 1)
+        in_b = k <= lens
+    else:
+        pos = off[1:] + k
+        in_b = -k <= lens
+    valid = in_b & col.valid_mask()
+    src = jnp.clip(pos, 0, max(int(child.size) - 1, 0))
+    return _gather_any(child, src, valid)
+
+
+@func_range("array_join")
+def array_join(col: Column, sep: str,
+               null_replacement: str | None = None) -> Column:
+    """Spark ``array_join``: concatenate STRING list elements with
+    ``sep``; null elements are skipped unless ``null_replacement``."""
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(f"array_join needs a LIST column, got {col.dtype}")
+    child = col.children[0]
+    if not child.dtype.is_string:
+        raise TypeError("array_join needs LIST<STRING>")
+    # host-assembled (ragged concatenation has no fixed-width form that
+    # beats the explode->concat_ws chain; columns needing device joins
+    # should explode + groupby_collect instead)
+    vals = col.to_pylist()
+    out = []
+    for lst in vals:
+        if lst is None:
+            out.append(None)
+            continue
+        parts = []
+        for v in lst:
+            if v is None:
+                if null_replacement is not None:
+                    parts.append(null_replacement)
+            else:
+                parts.append(v)
+        out.append(sep.join(parts))
+    from spark_rapids_jni_tpu import types as t
+
+    return Column.from_pylist(out, t.STRING)

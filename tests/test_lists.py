@@ -129,3 +129,43 @@ def test_explode_roundtrips_collect():
     ex = explode(trimmed, 1)
     got = _exploded_rows(ex, 2)
     assert got == [(1, 11), (2, 14), (3, 10), (3, 12)]
+
+
+def test_array_size_contains_element_at():
+    lists = [[1, 2, 3], [], None, [5], [2, None, 2]]
+    tbl_col = make_list_column(lists, t.INT64)
+    from spark_rapids_jni_tpu.ops.lists import (
+        array_contains,
+        array_size,
+        element_at,
+    )
+
+    assert array_size(tbl_col).to_pylist() == [3, 0, None, 1, 3]
+    assert array_contains(tbl_col, 2).to_pylist() == \
+        [True, False, None, False, True]
+    # Spark three-valued logic: value absent but list has a null
+    # element -> NULL (row [2, None, 2] searched for 9)
+    assert array_contains(tbl_col, 9).to_pylist() == \
+        [False, False, None, False, None]
+    assert element_at(tbl_col, 1).to_pylist() == [1, None, None, 5, 2]
+    assert element_at(tbl_col, -1).to_pylist() == [3, None, None, 5, 2]
+    assert element_at(tbl_col, 3).to_pylist() == [3, None, None, None, 2]
+    # null element position -> null value but in-bounds
+    assert element_at(tbl_col, 2).to_pylist() == [2, None, None, None, None]
+    with pytest.raises(ValueError, match="1-based"):
+        element_at(tbl_col, 0)
+
+
+def test_array_contains_strings_and_join():
+    lists = [["a", "bb", None], [], ["bb"], None]
+    lc = make_list_column(lists, t.STRING)
+    from spark_rapids_jni_tpu.ops.lists import array_contains, array_join
+
+    assert array_contains(lc, "bb").to_pylist() == \
+        [True, False, True, None]
+    # row 0 has a null element: absent value -> NULL (Spark 3VL)
+    assert array_contains(lc, "zz").to_pylist() == \
+        [None, False, False, None]
+    assert array_join(lc, ",").to_pylist() == ["a,bb", "", "bb", None]
+    assert array_join(lc, "-", null_replacement="?").to_pylist() == \
+        ["a-bb-?", "", "bb", None]
