@@ -766,6 +766,42 @@ def orders_q12_table(num_rows: int, seed: int = 4) -> Table:
     ])
 
 
+def _q12_keep(lineitem: Table, mode_c: Column, modes: tuple,
+              year_start: int, year_end: int) -> jnp.ndarray:
+    """Shared q12 WHERE (single change point for single-device and
+    distributed plans, the _q3_inputs convention): mode IN list + date
+    sanity predicates, null operands not-TRUE (every valid_mask ANDed)."""
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    in_modes = jnp.zeros((lineitem.num_rows,), jnp.bool_)
+    for mname in modes:
+        in_modes = in_modes | (s.like(mode_c, mname).data != 0)
+    commit_c = lineitem.column(L12_COMMITDATE)
+    receipt_c = lineitem.column(L12_RECEIPTDATE)
+    ship_c = lineitem.column(L12_SHIPDATE)
+    return (in_modes & mode_c.valid_mask() & commit_c.valid_mask()
+            & receipt_c.valid_mask() & ship_c.valid_mask()
+            & (commit_c.data < receipt_c.data)
+            & (ship_c.data < commit_c.data)
+            & (receipt_c.data >= jnp.int32(year_start))
+            & (receipt_c.data < jnp.int32(year_end)))
+
+
+def _q12_priority_lanes(prio: Column, matched: jnp.ndarray):
+    """Shared CASE WHEN o_orderpriority IN ('1-URGENT','2-HIGH') lanes."""
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    urgent = ((s.like(prio, "1-URGENT").data != 0)
+              | (s.like(prio, "2-HIGH").data != 0))
+    high = Column(t.INT64,
+                  jnp.where(matched & urgent, jnp.int64(1), jnp.int64(0)),
+                  matched)
+    low = Column(t.INT64,
+                 jnp.where(matched & ~urgent, jnp.int64(1), jnp.int64(0)),
+                 matched)
+    return high, low
+
+
 class Q12Result(NamedTuple):
     result: GroupByResult    # [l_shipmode, high_line_count, low_line_count]
     join_total: jnp.ndarray
@@ -784,19 +820,7 @@ def tpch_q12(orders: Table, lineitem: Table,
     from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
 
     mode_c = lineitem.column(L12_SHIPMODE)
-    in_modes = jnp.zeros((lineitem.num_rows,), jnp.bool_)
-    for mname in modes:
-        in_modes = in_modes | (s.like(mode_c, mname).data != 0)
-    commit_c = lineitem.column(L12_COMMITDATE)
-    receipt_c = lineitem.column(L12_RECEIPTDATE)
-    ship_c = lineitem.column(L12_SHIPDATE)
-    commit, receipt, ship = commit_c.data, receipt_c.data, ship_c.data
-    # null predicate operands are not-TRUE (SQL): AND every valid_mask
-    keep = (in_modes & mode_c.valid_mask() & commit_c.valid_mask()
-            & receipt_c.valid_mask() & ship_c.valid_mask()
-            & (commit < receipt) & (ship < commit)
-            & (receipt >= jnp.int32(year_start))
-            & (receipt < jnp.int32(year_end)))
+    keep = _q12_keep(lineitem, mode_c, modes, year_start, year_end)
     probe = Table([
         _null_where(lineitem.column(L12_ORDERKEY), ~keep),
         mode_c,
@@ -805,15 +829,7 @@ def tpch_q12(orders: Table, lineitem: Table,
     j = apply_join_maps(probe, orders, maps)
     # j: [l_orderkey, l_shipmode, o_orderkey, o_orderpriority]
     matched = j.column(2).valid_mask()
-    prio = j.column(3)
-    urgent = ((s.like(prio, "1-URGENT").data != 0)
-              | (s.like(prio, "2-HIGH").data != 0))
-    high = Column(t.INT64,
-                  jnp.where(matched & urgent, jnp.int64(1), jnp.int64(0)),
-                  matched)
-    low = Column(t.INT64,
-                 jnp.where(matched & ~urgent, jnp.int64(1), jnp.int64(0)),
-                 matched)
+    high, low = _q12_priority_lanes(j.column(3), matched)
     keyed = Table([
         _null_where(j.column(1), ~matched), high, low,
     ])
@@ -1123,3 +1139,99 @@ def tpch_q19_numpy(part: Table, lineitem: Table,
         if ok:
             total += price[i] * (100 - disc[i])
     return total
+
+
+_Q12_GROUP_BUDGET = 16  # |shipmode domain| = 7 plus the null pseudo-group
+
+
+def tpch_q12_distributed(orders: Table, lineitem: Table, mesh,
+                         modes: tuple = ("MAIL", "SHIP"),
+                         year_start: int = _Q12_YEAR_START,
+                         year_end: int = _Q12_YEAR_END) -> Table:
+    """Multi-executor q12: repartitioned orderkey join, then the classic
+    two-phase aggregation — per-device partial groupby on the (tiny)
+    shipmode domain, partial rows shuffled by key hash, merged, collected
+    and shipmode-sorted on the driver. The partial->shuffle->merge shape
+    is the q1 distributed plan; the join is the q3 repartition exchange —
+    q12 composes both."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_jni_tpu.ops import strings as s
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        collect,
+        distributed_join,
+        shard_table,
+    )
+    from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+    from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
+
+    if len(modes) + 1 > _Q12_GROUP_BUDGET:
+        raise ValueError(
+            f"q12 mode domain {len(modes)} exceeds the partial-groupby "
+            f"budget {_Q12_GROUP_BUDGET}")
+    # WHERE -> nulled join key (shared predicate helper, single change
+    # point with the single-device plan)
+    mode_c = s.pad_strings(lineitem.column(L12_SHIPMODE))
+    keep = _q12_keep(lineitem, mode_c, modes, year_start, year_end)
+    probe = Table([
+        _null_where(lineitem.column(L12_ORDERKEY), ~keep),
+        mode_c,
+    ])
+    build = Table([
+        orders.column(O12_ORDERKEY),
+        s.pad_strings(orders.column(O12_ORDERPRIORITY)),
+    ])
+    sl, lrv = shard_table(probe, mesh, return_row_valid=True)
+    sr, rrv = shard_table(build, mesh, return_row_valid=True)
+    nl = probe.num_rows
+    d = mesh.devices.size
+    # per-device capacities (the q3 sizing): 2x skew headroom; overflow
+    # is checked below and is the caller's retry signal
+    res = distributed_join(
+        sl, sr, [0], [0], mesh,
+        out_size_per_device=max(1, nl // d * 2),
+        left_capacity=max(1, nl // d * 2),
+        right_capacity=max(1, orders.num_rows // d * 2),
+        left_row_valid=lrv, right_row_valid=rrv,
+    )
+    if bool(np.asarray(res.overflowed).any()):
+        raise ValueError(
+            "q12 join exchange overflowed its per-device capacity "
+            "(key skew); retry with a larger capacity factor")
+
+    def agg_step(j: Table):
+        # j: [l_orderkey, l_shipmode, o_orderkey, o_orderpriority]
+        matched = j.column(2).valid_mask()
+        high, low = _q12_priority_lanes(j.column(3), matched)
+        mode_j = j.column(1)
+        keyed = Table([
+            Column(mode_j.dtype,
+                   jnp.where(matched, mode_j.data, 0),
+                   matched,
+                   chars=jnp.where(matched[:, None], mode_j.chars,
+                                   jnp.uint8(0))),
+            high, low,
+        ])
+        budget = min(_Q12_GROUP_BUDGET, keyed.num_rows)
+        partial = groupby_aggregate(
+            keyed, keys=[0], aggs=[(1, "sum"), (2, "sum")],
+            max_groups=budget)
+        real = jnp.arange(budget, dtype=jnp.int32) < partial.num_groups
+        sh = hash_shuffle(partial.table, [0], EXEC_AXIS, capacity=budget,
+                          row_valid=real)
+        merged = groupby_aggregate(
+            sh.table, keys=[0], aggs=[(1, "sum"), (2, "sum")])
+        return merged.table, merged.num_groups.reshape(1)
+
+    per_dev, num_groups = _jax.jit(_jax.shard_map(
+        agg_step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
+        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+    ))(res.table)
+    result = collect(per_dev, num_groups, mesh)
+    srt = sort_table(result, [0], nulls_first=[False])
+    kv = np.asarray(srt.column(0).valid_mask())
+    k = int(kv.sum())
+    from spark_rapids_jni_tpu.ops.table_ops import trim_table
+
+    return trim_table(srt, k)

@@ -418,3 +418,27 @@ def test_tpch_q19_vs_numpy():
     want = tpch_q19_numpy(part, lineitem)
     assert int(res.revenue) == want
     assert want > 0  # the synthetic distributions must actually hit
+
+
+@pytest.mark.slow
+def test_tpch_q12_distributed_matches_numpy():
+    from spark_rapids_jni_tpu.parallel import executor_mesh
+
+    mesh = executor_mesh(8)
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q12_table,
+        orders_q12_table,
+        tpch_q12_distributed,
+        tpch_q12_numpy,
+    )
+
+    orders = orders_q12_table(160)
+    lineitem = lineitem_q12_table(800, 200)
+    out = tpch_q12_distributed(orders, lineitem, mesh)
+    want = tpch_q12_numpy(orders, lineitem)
+    kcol = out.column(0).to_pylist()
+    hcol = out.column(1).to_pylist()
+    lcol = out.column(2).to_pylist()
+    got = {k: [h, lo] for k, h, lo in zip(kcol, hcol, lcol)
+           if k is not None}
+    assert got == want
