@@ -27,6 +27,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Sequence
 
+import jax
 import jax.numpy as jnp
 
 from spark_rapids_jni_tpu.columnar import Column, Table
@@ -257,15 +258,8 @@ def array_contains(col: Column, value) -> Column:
     else:
         hit = (child.data == value) & child.valid_mask()
 
-    def _range_any(flags):
-        pref = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int64),
-             jnp.cumsum(flags.astype(jnp.int64))])
-        off = col.data.astype(jnp.int32)
-        return (pref[off[1:]] - pref[off[:-1]]) > 0
-
-    found = _range_any(hit)
-    has_null_elem = _range_any(~child.valid_mask())
+    found = _range_any(hit, col.data)
+    has_null_elem = _range_any(~child.valid_mask(), col.data)
     from spark_rapids_jni_tpu.types import BOOL8
 
     validity = col.valid_mask() & (found | ~has_null_elem)
@@ -324,3 +318,215 @@ def array_join(col: Column, sep: str,
     from spark_rapids_jni_tpu import types as t
 
     return Column.from_pylist(out, t.STRING)
+
+
+def _range_any(flags: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
+    """bool[n]: ANY of ``flags`` within each [offsets[i], offsets[i+1])
+    — one cumsum + prefix difference, the shared list-predicate idiom."""
+    pref = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64),
+         jnp.cumsum(flags.astype(jnp.int64))])
+    off = offsets.astype(jnp.int32)
+    return (pref[off[1:]] - pref[off[:-1]]) > 0
+
+
+def _parent_ids(col: Column) -> jnp.ndarray:
+    """int32 parent row per child element (searchsorted over offsets —
+    the explode idiom). Child slots BEYOND offsets[-1] (the padded tail
+    array_distinct/groupby_collect leave behind) get the sentinel parent
+    ``n`` so they sort after every real row and match no range query —
+    clipping them into the last row would corrupt it."""
+    child_n = int(col.children[0].size)
+    n = col.size
+    off = col.data.astype(jnp.int64)
+    k = jnp.arange(child_n, dtype=jnp.int64)
+    real = jnp.clip(
+        jnp.searchsorted(off, k, side="right") - 1, 0,
+        max(n - 1, 0)).astype(jnp.int32)
+    return jnp.where(k < off[-1], real, jnp.int32(n))
+
+
+@func_range("sort_array")
+def sort_array(col: Column, ascending: bool = True) -> Column:
+    """Spark ``sort_array``: elements sorted within each list (offsets
+    unchanged — one segmented sort of (parent, value)). Null elements
+    first when ascending, last when descending (Spark's rule)."""
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(f"sort_array needs a LIST column, got {col.dtype}")
+    child = col.children[0]
+    parent = _parent_ids(col)
+    from spark_rapids_jni_tpu.types import DType as _D, TypeId as _T
+
+    ptbl = Table([
+        Column(_D(_T.INT32), parent, None),
+        child,
+    ])
+    order = sort_order(ptbl, [0, 1], ascending=[True, ascending],
+                       nulls_first=[True, ascending])
+    schild = gather(Table([child]), order).column(0)
+    return Column(col.dtype, col.data, col.validity, children=[schild])
+
+
+@func_range("array_position")
+def array_position(col: Column, value) -> Column:
+    """Spark ``array_position``: 1-based index of the first element equal
+    to ``value``, 0 when absent, null for null lists. Null elements never
+    match (no 3VL here — Spark's ArrayPosition returns a position, and
+    absent-with-nulls is still 0... matching Spark's non-ANSI behavior:
+    it returns null only for null inputs)."""
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(
+            f"array_position needs a LIST column, got {col.dtype}")
+    child = col.children[0]
+    if child.dtype.is_decimal128:
+        raise NotImplementedError("array_position on DECIMAL128 children")
+    if child.dtype.is_string:
+        from spark_rapids_jni_tpu.ops import strings as s
+
+        p = s.pad_strings(child)
+        vb = str(value).encode()
+        w = p.chars.shape[1]
+        if len(vb) > w:
+            hit = jnp.zeros((int(child.size),), jnp.bool_)
+        else:
+            target = jnp.zeros((w,), jnp.uint8).at[:len(vb)].set(
+                jnp.asarray(bytearray(vb), dtype=jnp.uint8))
+            hit = (p.data == len(vb)) & jnp.all(
+                p.chars == target[None, :], axis=1) & p.valid_mask()
+    else:
+        hit = (child.data == value) & child.valid_mask()
+    child_n = int(child.size)
+    k = jnp.arange(child_n, dtype=jnp.int64)
+    first_global = jnp.where(hit, k, child_n)
+    # per-list min of the hit positions via a cummin prefix difference:
+    # positions are globally increasing, so the first hit in [lo, hi) is
+    # the min over that range — use a suffix-min then gather at lo
+    if child_n:
+        suffix_min = jax.lax.cummin(first_global[::-1])[::-1]
+        off = col.data.astype(jnp.int32)
+        lo = jnp.clip(off[:-1], 0, child_n - 1)
+        first_in = jnp.minimum(
+            suffix_min[lo],
+            jnp.int64(child_n))
+        # clamp to the row's own range: a hit belonging to a LATER row
+        # must not leak backwards
+        in_range = first_in < off[1:]
+        pos = jnp.where(in_range & (first_in >= off[:-1]),
+                        first_in - off[:-1] + 1, 0)
+    else:
+        pos = jnp.zeros((col.size,), jnp.int64)
+    return Column(DType(TypeId.INT64), pos.astype(jnp.int64),
+                  col.valid_mask() if col.validity is not None else None)
+
+
+@func_range("array_distinct")
+def array_distinct(col: Column) -> Column:
+    """Spark ``array_distinct``: duplicates removed, FIRST occurrences
+    kept in order. Two sorts: (parent, value) marks first occurrences,
+    (parent, position) restores order; the kept elements compact into a
+    dense child with prefix-sum offsets."""
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(
+            f"array_distinct needs a LIST column, got {col.dtype}")
+    child = col.children[0]
+    n = col.size
+    child_n = int(child.size)
+    if child_n == 0:
+        return col
+    parent = _parent_ids(col)
+    from spark_rapids_jni_tpu.types import DType as _D, TypeId as _T
+
+    pcol = Column(_D(_T.INT32), parent, None)
+    ptbl = Table([pcol, child])
+    order = sort_order(ptbl, [0, 1], nulls_first=[True, True])
+    svals = gather(ptbl, order)
+    same_parent = svals.column(0).data[1:] == svals.column(0).data[:-1]
+    sc = svals.column(1)
+    eqv = _col_values_equal_prev(sc)
+    v1 = sc.valid_mask()
+    both_null = ~v1[1:] & ~v1[:-1]
+    same_val = (eqv & v1[1:] & v1[:-1]) | both_null
+    dup = jnp.concatenate(
+        [jnp.zeros((1,), jnp.bool_), same_parent & same_val])
+    # keep flag back in ORIGINAL child positions: keep[order[i]] = ~dup[i]
+    # (a gather-free formulation: sort (order) is a permutation, use
+    # argsort to invert — one more sort, no scatter)
+    inv = jnp.argsort(order).astype(jnp.int32)
+    keep = (~dup)[inv]
+    counts_pref = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64), jnp.cumsum(keep.astype(jnp.int64))])
+    off = col.data.astype(jnp.int32)
+    new_off = (counts_pref[off] ).astype(jnp.int32)
+    comp = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    new_child = _gather_any(child, comp, jnp.bool_(True))
+    return Column(col.dtype, new_off, col.validity, children=[new_child])
+
+
+@func_range("arrays_overlap")
+def arrays_overlap(a: Column, b: Column) -> Column:
+    """Spark ``arrays_overlap``: TRUE when the rows' lists share a
+    non-null element; NULL when they don't but either side has a null
+    element (3VL); FALSE otherwise; null lists give null."""
+    for c in (a, b):
+        if c.dtype.type_id != TypeId.LIST:
+            raise TypeError(
+                f"arrays_overlap needs LIST columns, got {c.dtype}")
+    ca, cb = a.children[0], b.children[0]
+    if ca.dtype != cb.dtype:
+        raise TypeError("arrays_overlap needs matching element dtypes")
+    if ca.dtype.is_decimal128:
+        raise NotImplementedError("arrays_overlap on DECIMAL128 children")
+    n = a.size
+    pa, pb = _parent_ids(a), _parent_ids(b)
+    from spark_rapids_jni_tpu.ops.table_ops import concatenate
+    from spark_rapids_jni_tpu.types import DType as _D, TypeId as _T
+
+    side_a = Column(_D(_T.INT8),
+                    jnp.zeros((int(ca.size),), jnp.int8), None)
+    side_b = Column(_D(_T.INT8),
+                    jnp.ones((int(cb.size),), jnp.int8), None)
+    ta = Table([Column(_D(_T.INT32), pa, None), ca, side_a])
+    tb = Table([Column(_D(_T.INT32), pb, None), cb, side_b])
+    allt = concatenate([ta, tb])
+    order = sort_order(allt, [0, 1, 2], nulls_first=[True, False, True])
+    sv = gather(allt, order)
+    same_parent = sv.column(0).data[1:] == sv.column(0).data[:-1]
+    sc = sv.column(1)
+    v1 = sc.valid_mask()
+    eqv = _col_values_equal_prev(sc)
+    same_valid_val = eqv & v1[1:] & v1[:-1]
+    diff_side = sv.column(2).data[1:] != sv.column(2).data[:-1]
+    pairhit = same_parent & same_valid_val & diff_side
+    # per-parent ANY over adjacent pair hits (prefix-difference count
+    # indexed by the sorted parent runs)
+    hit_parent = sv.column(0).data[1:]
+    total = int(ca.size) + int(cb.size)
+    cnt = jnp.zeros((n,), jnp.int64)
+    if total > 1:
+        pref = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int64),
+             jnp.cumsum(pairhit.astype(jnp.int64))])
+        pr = jnp.arange(n, dtype=jnp.int32)
+        lo = jnp.searchsorted(hit_parent, pr, side="left")
+        hi = jnp.searchsorted(hit_parent, pr, side="right")
+        cnt = pref[hi] - pref[lo]
+    overlap = cnt > 0
+
+    # 3VL per Spark's ArraysOverlap: NULL only when there is no common
+    # element, BOTH arrays are non-empty, and either contains a null
+    def _range_any_nulls(col_l):
+        c = col_l.children[0]
+        if c.validity is None:
+            return jnp.zeros((n,), jnp.bool_)
+        return _range_any(~c.valid_mask(), col_l.data)
+
+    def _nonempty(col_l):
+        off_ = col_l.data.astype(jnp.int32)
+        return off_[1:] > off_[:-1]
+
+    has_null = ((_range_any_nulls(a) | _range_any_nulls(b))
+                & _nonempty(a) & _nonempty(b))
+    from spark_rapids_jni_tpu.types import BOOL8
+
+    validity = a.valid_mask() & b.valid_mask() & (overlap | ~has_null)
+    return Column(BOOL8, overlap.astype(jnp.uint8), validity)

@@ -169,3 +169,76 @@ def test_array_contains_strings_and_join():
     assert array_join(lc, ",").to_pylist() == ["a,bb", "", "bb", None]
     assert array_join(lc, "-", null_replacement="?").to_pylist() == \
         ["a-bb-?", "", "bb", None]
+
+
+def test_sort_array_vs_python():
+    from spark_rapids_jni_tpu.ops.lists import sort_array
+
+    lists = [[3, 1, 2], [], None, [5, None, 4], [9]]
+    lc = make_list_column(lists, t.INT64)
+    asc = sort_array(lc).to_pylist()
+    # Spark: nulls FIRST ascending
+    assert asc == [[1, 2, 3], [], None, [None, 4, 5], [9]]
+    desc = sort_array(lc, ascending=False).to_pylist()
+    assert desc == [[3, 2, 1], [], None, [5, 4, None], [9]]
+    # strings sort too
+    sl = make_list_column([["b", "a"], ["z"]], t.STRING)
+    assert sort_array(sl).to_pylist() == [["a", "b"], ["z"]]
+
+
+def test_array_position_vs_python():
+    from spark_rapids_jni_tpu.ops.lists import array_position
+
+    lists = [[7, 2, 2], [], None, [None, 2], [1, 3]]
+    lc = make_list_column(lists, t.INT64)
+    assert array_position(lc, 2).to_pylist() == [2, 0, None, 2, 0]
+    sl = make_list_column([["a", "bb"], ["c"], None], t.STRING)
+    assert array_position(sl, "bb").to_pylist() == [2, 0, None]
+
+
+def test_array_distinct_keeps_first_occurrences():
+    from spark_rapids_jni_tpu.ops.lists import array_distinct
+
+    lists = [[3, 1, 3, 2, 1], [], None, [None, 5, None], [4, 4]]
+    lc = make_list_column(lists, t.INT64)
+    got = array_distinct(lc).to_pylist()
+    assert got == [[3, 1, 2], [], None, [None, 5], [4]]
+
+
+def test_arrays_overlap_3vl():
+    from spark_rapids_jni_tpu.ops.lists import arrays_overlap
+
+    a = make_list_column([[1, 2], [1], [None, 1], [7], None], t.INT64)
+    b = make_list_column([[2, 9], [3], [4], [None], [1]], t.INT64)
+    got = arrays_overlap(a, b).to_pylist()
+    # row0 shares 2 -> True; row1 disjoint no nulls -> False;
+    # row2 disjoint with a null -> None; row3 disjoint with null -> None;
+    # row4 null list -> None
+    assert got == [True, False, None, None, None]
+
+
+def test_list_ops_on_padded_child_tails():
+    """array_distinct leaves a padded child tail; downstream sort_array
+    and arrays_overlap must not let tail slots corrupt the last row
+    (review regression)."""
+    from spark_rapids_jni_tpu.ops.lists import (
+        array_distinct,
+        arrays_overlap,
+        sort_array,
+    )
+
+    dd = array_distinct(make_list_column([[1, 1], [5, 7]], t.INT64))
+    assert sort_array(dd).to_pylist() == [[1], [5, 7]]
+    a2 = array_distinct(make_list_column([[9, 9], [2]], t.INT64))
+    b2 = make_list_column([[7], [9]], t.INT64)
+    assert arrays_overlap(a2, b2).to_pylist() == [False, False]
+
+
+def test_arrays_overlap_empty_side_is_false():
+    """Spark: NULL only when BOTH arrays are non-empty — an empty side
+    gives FALSE even when the other has nulls."""
+    from spark_rapids_jni_tpu.ops.lists import arrays_overlap
+
+    a = make_list_column([[]], t.INT64)
+    b = make_list_column([[None]], t.INT64)
+    assert arrays_overlap(a, b).to_pylist() == [False]
