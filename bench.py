@@ -169,6 +169,37 @@ def _bench_tpch_q14(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_regexp(n: int, iters: int):
+    """Device regex engine: RLIKE over synthetic log lines (host-compiled
+    byte DFA, one gather per char column). rows/s."""
+    import jax
+    import numpy as np
+
+    from spark_rapids_jni_tpu.ops import regex_device as rd
+
+    rng = np.random.default_rng(0)
+    words = [b"GET", b"POST", b"/api/v2/items", b"status=200",
+             b"status=404", b"id=", b"1970-01-01", b"ERROR", b"ok"]
+    rows = []
+    for i in range(n):
+        k = rng.integers(2, 6)
+        rows.append(b" ".join(
+            words[j] + (str(int(i)).encode() if j == 5 else b"")
+            for j in rng.integers(0, len(words), k)))
+    w = max(len(r) for r in rows) + 1
+    mat = np.zeros((n, w), dtype=np.uint8)
+    for i, r in enumerate(rows):
+        mat[i, :len(r)] = np.frombuffer(r, dtype=np.uint8)
+    comp = rd.compile_pattern(r"status=[45]\d\d")
+    import jax.numpy as jnp
+
+    chars = jnp.asarray(mat)
+    fn = jax.jit(lambda c: jnp.sum(
+        rd.run_dfa(c, comp, ensure_sentinel=False).astype(jnp.int32)))
+    per_iter = _measure(lambda: fn(chars), iters)
+    return n / per_iter
+
+
 def _bench_tpcds_q72(n: int, iters: int):
     import jax
 
@@ -489,6 +520,7 @@ _CONFIGS = {
     "json_extract": (_bench_json_extract, "json_extract_rows_per_s", "rows/s"),
     "tpch_q3": (_bench_tpch_q3, "tpch_q3_rows_per_s", "rows/s"),
     "tpch_q14": (_bench_tpch_q14, "tpch_q14_rows_per_s", "rows/s"),
+    "regexp": (_bench_regexp, "regexp_rows_per_s", "rows/s"),
     "cast_strings": (_bench_cast_strings, "cast_strings_rows_per_s", "rows/s"),
     "tpcds_q64": (_bench_tpcds_q64, "tpcds_q64_rows_per_s", "rows/s"),
     "tpch_q1_planned": (

@@ -242,19 +242,7 @@ def array_contains(col: Column, value) -> Column:
         raise NotImplementedError(
             "array_contains on DECIMAL128 children")
     if child.dtype.is_string:
-        from spark_rapids_jni_tpu.ops import strings as s
-
-        p = s.pad_strings(child)
-        vb = str(value).encode()
-        w = p.chars.shape[1]
-        if len(vb) > w:
-            hit = jnp.zeros((p.chars.shape[0],), jnp.bool_)
-        else:
-            target = jnp.zeros((w,), jnp.uint8).at[:len(vb)].set(
-                jnp.asarray(bytearray(vb), dtype=jnp.uint8))
-            hit = (p.data == len(vb)) & jnp.all(
-                p.chars == target[None, :], axis=1)
-        hit = hit & p.valid_mask()
+        hit = _scalar_string_hit(child, value)
     else:
         hit = (child.data == value) & child.valid_mask()
 
@@ -320,6 +308,23 @@ def array_join(col: Column, sep: str,
     return Column.from_pylist(out, t.STRING)
 
 
+def _scalar_string_hit(child: Column, value) -> jnp.ndarray:
+    """bool[child_n]: child string elements equal to the scalar value
+    (padded compare; absent when longer than the padded width)."""
+    from spark_rapids_jni_tpu.ops import strings as s
+
+    p = s.pad_strings(child)
+    vb = str(value).encode()
+    w = p.chars.shape[1]
+    if len(vb) > w:
+        return jnp.zeros((int(child.size),), jnp.bool_)
+    target = jnp.zeros((w,), jnp.uint8).at[:len(vb)].set(
+        jnp.asarray(bytearray(vb), dtype=jnp.uint8))
+    return ((p.data == len(vb))
+            & jnp.all(p.chars == target[None, :], axis=1)
+            & p.valid_mask())
+
+
 def _range_any(flags: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
     """bool[n]: ANY of ``flags`` within each [offsets[i], offsets[i+1])
     — one cumsum + prefix difference, the shared list-predicate idiom."""
@@ -381,18 +386,7 @@ def array_position(col: Column, value) -> Column:
     if child.dtype.is_decimal128:
         raise NotImplementedError("array_position on DECIMAL128 children")
     if child.dtype.is_string:
-        from spark_rapids_jni_tpu.ops import strings as s
-
-        p = s.pad_strings(child)
-        vb = str(value).encode()
-        w = p.chars.shape[1]
-        if len(vb) > w:
-            hit = jnp.zeros((int(child.size),), jnp.bool_)
-        else:
-            target = jnp.zeros((w,), jnp.uint8).at[:len(vb)].set(
-                jnp.asarray(bytearray(vb), dtype=jnp.uint8))
-            hit = (p.data == len(vb)) & jnp.all(
-                p.chars == target[None, :], axis=1) & p.valid_mask()
+        hit = _scalar_string_hit(child, value)
     else:
         hit = (child.data == value) & child.valid_mask()
     child_n = int(child.size)
@@ -474,6 +468,10 @@ def arrays_overlap(a: Column, b: Column) -> Column:
     ca, cb = a.children[0], b.children[0]
     if ca.dtype != cb.dtype:
         raise TypeError("arrays_overlap needs matching element dtypes")
+    if a.size != b.size:
+        raise ValueError(
+            f"arrays_overlap needs equal row counts, got {a.size} vs "
+            f"{b.size}")
     if ca.dtype.is_decimal128:
         raise NotImplementedError("arrays_overlap on DECIMAL128 children")
     n = a.size

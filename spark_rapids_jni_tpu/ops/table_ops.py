@@ -204,3 +204,67 @@ def contiguous_split(table: Table, splits: Sequence[int]) -> list[Table]:
         if lo > hi or lo < 0 or hi > n:
             raise ValueError(f"bad split bounds {splits} for {n} rows")
     return [_slice_rows(table, lo, hi) for lo, hi in zip(bounds, bounds[1:])]
+
+
+def _set_op(left: Table, right: Table, keep_matched: bool) -> CompactResult:
+    """Shared EXCEPT/INTERSECT scaffold: distinct left tuples, marked and
+    concatenated with right rows, one sort over all columns, then a
+    per-tuple-group ANY over the side flag — SQL set-op null semantics
+    (NULL tuples compare equal) come from _rows_equal_prev's both-null
+    rule, unlike an equi-join which would drop them."""
+    from spark_rapids_jni_tpu.ops.groupby import _rows_equal_prev
+    from spark_rapids_jni_tpu.types import DType as _D, TypeId as _T
+
+    if left.num_columns != right.num_columns:
+        raise ValueError("set ops need matching column counts")
+    for i in range(left.num_columns):
+        if left.column(i).dtype != right.column(i).dtype:
+            raise TypeError(
+                f"set ops need matching dtypes at column {i}: "
+                f"{left.column(i).dtype} vs {right.column(i).dtype}")
+    l0 = distinct(left).compact()
+
+    def _with_side(tbl: Table, side: int) -> Table:
+        flag = Column(_D(_T.INT8),
+                      jnp.full((tbl.num_rows,), side, jnp.int8), None)
+        return Table(list(tbl.columns) + [flag])
+
+    allt = concatenate([_with_side(l0, 0), _with_side(right, 1)])
+    nk = left.num_columns
+    ks = list(range(nk))
+    order = sort_order(allt, ks)
+    sall = gather(allt, order)
+    same = _rows_equal_prev(sall, ks)
+    n_all = sall.num_rows
+    gid = (jnp.cumsum(~same) - 1).astype(jnp.int32)
+    side_sorted = sall.column(nk).data
+    pref = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64),
+         jnp.cumsum((side_sorted == 1).astype(jnp.int64))])
+    garange = jnp.arange(n_all, dtype=jnp.int32)
+    lo = jnp.searchsorted(gid, garange, side="left")
+    hi = jnp.searchsorted(gid, garange, side="right")
+    grp_has_right = (pref[hi] - pref[lo]) > 0
+    matched = grp_has_right[gid]
+    mask = (side_sorted == 0) & (matched == keep_matched)
+    perm = jnp.argsort(~mask, stable=True).astype(jnp.int32)
+    num = jnp.sum(mask).astype(jnp.int32)
+    # _gather_mask_tail nulls the padding rows — the module's contract
+    # (padding must not read as stale duplicates)
+    return CompactResult(
+        _gather_mask_tail(Table([sall.column(i) for i in ks]), perm, num),
+        num)
+
+
+@func_range("except_rows")
+def except_rows(left: Table, right: Table) -> CompactResult:
+    """SQL EXCEPT (DISTINCT): distinct left tuples with no equal tuple
+    in right; NULLs compare equal (set semantics)."""
+    return _set_op(left, right, keep_matched=False)
+
+
+@func_range("intersect_rows")
+def intersect_rows(left: Table, right: Table) -> CompactResult:
+    """SQL INTERSECT (DISTINCT): distinct left tuples that also appear
+    in right; NULLs compare equal."""
+    return _set_op(left, right, keep_matched=True)

@@ -212,3 +212,56 @@ def test_reduce_decimal128_sum_overflow_nulls():
     col2 = Column.from_pylist([1 << 100, -(1 << 99)], t.decimal128(0))
     s2, ok_s = r.sum_(col2)
     assert bool(ok_s)
+
+
+def test_except_intersect_vs_python(rng):
+    from spark_rapids_jni_tpu.ops.table_ops import (
+        except_rows,
+        intersect_rows,
+    )
+
+    n = 300
+    lk = rng.integers(0, 9, n)
+    lv = rng.integers(0, 4, n).astype(np.float64)
+    lnull = rng.random(n) < 0.1
+    rk = rng.integers(0, 9, 200)
+    rv = rng.integers(0, 4, 200).astype(np.float64)
+    rnull = rng.random(200) < 0.1
+    left = Table([Column.from_numpy(lk),
+                  Column.from_numpy(lv, validity=~lnull)])
+    right = Table([Column.from_numpy(rk),
+                   Column.from_numpy(rv, validity=~rnull)])
+
+    def tuples(ks, vs, nulls):
+        return {(int(k), None if nu else float(v))
+                for k, v, nu in zip(ks, vs, nulls)}
+
+    lt, rt = tuples(lk, lv, lnull), tuples(rk, rv, rnull)
+    exc = except_rows(left, right).compact()
+    got_exc = set(zip(exc.column(0).to_pylist(),
+                      exc.column(1).to_pylist()))
+    assert got_exc == lt - rt
+    ints = intersect_rows(left, right).compact()
+    got_int = set(zip(ints.column(0).to_pylist(),
+                      ints.column(1).to_pylist()))
+    assert got_int == lt & rt
+
+
+def test_set_ops_null_tuples_and_validation(rng):
+    from spark_rapids_jni_tpu.ops.table_ops import (
+        except_rows,
+        intersect_rows,
+    )
+
+    left = Table([Column.from_pylist([1, None, 2, None], t.INT64)])
+    right = Table([Column.from_pylist([None, 3], t.INT64)])
+    # NULL compares equal in set ops: the null tuple is IN right
+    assert except_rows(left, right).compact().column(0).to_pylist() == \
+        [1, 2]
+    assert intersect_rows(left, right).compact().column(0).to_pylist() == \
+        [None]
+    with pytest.raises(ValueError, match="column counts"):
+        except_rows(left, Table([left.column(0), left.column(0)]))
+    with pytest.raises(TypeError, match="matching dtypes"):
+        except_rows(left, Table([Column.from_numpy(
+            np.ones(2, np.float64))]))
