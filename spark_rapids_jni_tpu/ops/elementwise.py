@@ -1,0 +1,228 @@
+"""Elementwise/scalar SQL function family (cuDF unary/binary ops +
+Spark conditional expressions — vendored capability surface, SURVEY.md
+section 2.2): coalesce, nullif, greatest/least, abs, ceil/floor, round
+(decimal-exact HALF_UP), and pmod.
+
+All pure ``jnp.where`` lattices — XLA fuses them into whatever consumer
+follows, so there is no standalone kernel cost. Decimal ``round`` stays
+in integer arithmetic end to end (the package's exactness posture: TPU
+f64 is f32-pair emulated, so float round-tripping a DECIMAL would
+silently lose digits).
+
+Null semantics are Spark's per function: coalesce takes the first
+non-null; nullif(a, b) nulls where equal; greatest/least SKIP nulls
+(null only when every operand is null); unary math propagates nulls;
+pmod is null when the divisor is 0 (non-ANSI posture) or either side
+is null.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+def _check_numeric(c: Column, op: str) -> None:
+    if c.dtype.is_string or c.dtype.is_decimal128 or \
+            c.dtype.type_id in (TypeId.LIST, TypeId.STRUCT):
+        raise TypeError(f"{op} needs a fixed-width numeric column, "
+                        f"got {c.dtype}")
+
+
+def _same_dtypes(cols: Sequence[Column], op: str) -> None:
+    for c in cols[1:]:
+        if c.dtype != cols[0].dtype:
+            raise TypeError(
+                f"{op} needs matching dtypes, got {c.dtype} vs "
+                f"{cols[0].dtype}")
+
+
+@func_range("coalesce")
+def coalesce(cols: Sequence[Column]) -> Column:
+    """Spark ``coalesce``: per row, the first non-null operand."""
+    if not cols:
+        raise ValueError("coalesce needs at least one column")
+    _same_dtypes(cols, "coalesce")
+    first = cols[0]
+    if first.dtype.is_string:
+        from spark_rapids_jni_tpu.ops.strings import pad_to_common_width
+
+        ps = pad_to_common_width(cols)
+        data = ps[0].data
+        chars = ps[0].chars
+        taken = ps[0].valid_mask()
+        for p in ps[1:]:
+            use = ~taken & p.valid_mask()
+            data = jnp.where(use, p.data, data)
+            chars = jnp.where(use[:, None], p.chars, chars)
+            taken = taken | p.valid_mask()
+        return Column(first.dtype, data, taken, chars=chars)
+    data = cols[0].data
+    taken = cols[0].valid_mask()
+    for c in cols[1:]:
+        use = ~taken & c.valid_mask()
+        if first.dtype.is_decimal128:
+            data = jnp.where(use[:, None], c.data, data)
+        else:
+            data = jnp.where(use, c.data, data)
+        taken = taken | c.valid_mask()
+    return Column(first.dtype, data, taken)
+
+
+@func_range("nullif")
+def nullif(a: Column, b: Column) -> Column:
+    """Spark ``nullif(a, b)``: a, nulled where a == b (null-safe: a null
+    pair does NOT null — Spark's NullIf uses EqualTo, null == null is
+    unknown, so a stays null anyway)."""
+    _same_dtypes([a, b], "nullif")
+    if a.dtype.is_string or a.dtype.is_decimal128:
+        raise NotImplementedError("nullif on string/DECIMAL128 columns")
+    eq = (a.data == b.data) & a.valid_mask() & b.valid_mask()
+    return Column(a.dtype, a.data, a.valid_mask() & ~eq)
+
+
+def _nary_extremum(cols: Sequence[Column], op: str) -> Column:
+    if len(cols) < 2:
+        raise ValueError(f"{op} needs at least two columns")
+    _same_dtypes(cols, op)
+    for c in cols:
+        _check_numeric(c, op)
+    pick_max = op == "greatest"
+    is_float = cols[0].dtype.storage_dtype.kind == "f"
+
+    def key(x):
+        # Spark orders NaN ABOVE every value for greatest/least
+        if not is_float:
+            return x
+        return jnp.where(jnp.isnan(x), jnp.inf, x)
+
+    acc = cols[0].data
+    have = cols[0].valid_mask()
+    for c in cols[1:]:
+        v = c.valid_mask()
+        better = jnp.where(pick_max, key(c.data) > key(acc),
+                           key(c.data) < key(acc))
+        use = v & (~have | better)
+        acc = jnp.where(use, c.data, acc)
+        have = have | v
+    return Column(cols[0].dtype, acc, have)
+
+
+@func_range("greatest")
+def greatest(cols: Sequence[Column]) -> Column:
+    """Spark ``greatest``: row-wise max, SKIPPING nulls (null only when
+    all operands are null)."""
+    return _nary_extremum(cols, "greatest")
+
+
+@func_range("least")
+def least(cols: Sequence[Column]) -> Column:
+    return _nary_extremum(cols, "least")
+
+
+@func_range("abs_")
+def abs_(col: Column) -> Column:
+    _check_numeric(col, "abs")
+    return Column(col.dtype, jnp.abs(col.data), col.validity)
+
+
+@func_range("ceil")
+def ceil(col: Column) -> Column:
+    """Spark ``ceil``: BIGINT for floats; decimals round toward +inf in
+    integer arithmetic (result scale 0, kept in the same storage)."""
+    return _round_directed(col, up=True)
+
+
+@func_range("floor")
+def floor(col: Column) -> Column:
+    return _round_directed(col, up=False)
+
+
+def _round_directed(col: Column, up: bool) -> Column:
+    _check_numeric(col, "ceil/floor")
+    dt = col.dtype
+    if dt.is_decimal:
+        s = -dt.scale
+        if s <= 0:
+            # scale >= 0: already integral; BIGINT value is
+            # unscaled * 10^scale
+            mul = 10 ** dt.scale
+            return Column(DType(TypeId.INT64),
+                          col.data.astype(jnp.int64) * mul, col.validity)
+        pow10 = 10 ** s
+        q = jnp.floor_divide(col.data, pow10)
+        if up:
+            q = q + (jnp.remainder(col.data, pow10) != 0).astype(q.dtype)
+        return Column(DType(TypeId.INT64), q.astype(jnp.int64),
+                      col.validity)
+    if dt.storage_dtype.kind == "f":
+        v = jnp.ceil(col.data) if up else jnp.floor(col.data)
+        return Column(DType(TypeId.INT64), v.astype(jnp.int64),
+                      col.validity)
+    return Column(DType(TypeId.INT64), col.data.astype(jnp.int64),
+                  col.validity)
+
+
+@func_range("round_decimal")
+def round_decimal(col: Column, d: int = 0) -> Column:
+    """Spark ``round(decimal, d)`` with HALF_UP, EXACT integer
+    arithmetic: the unscaled value is divided by 10^(frac-d) with
+    away-from-zero tie rounding; the result keeps scale -d (Spark
+    narrows the scale). Non-decimal inputs are rejected — float round
+    belongs to jnp directly."""
+    dt = col.dtype
+    if not dt.is_decimal or dt.is_decimal128:
+        raise TypeError(
+            f"round_decimal needs a DECIMAL32/64 column, got {dt}")
+    frac = -dt.scale
+    if d >= frac:
+        return col  # nothing to drop
+    pow10 = 10 ** (frac - d)
+    v = col.data
+    q = jnp.floor_divide(v, pow10)
+    r = v - q * pow10                     # in [0, pow10)
+    # HALF_UP is away from zero: for negative values the floor division
+    # already moved down, so a remainder STRICTLY ABOVE half rounds the
+    # magnitude... spelled out via the sign-split:
+    neg = v < 0
+    round_up_pos = (~neg) & (r * 2 >= pow10)
+    round_up_neg = neg & (r * 2 > pow10)
+    q = q + (round_up_pos | round_up_neg).astype(q.dtype)
+    from spark_rapids_jni_tpu.types import decimal32, decimal64
+
+    out_dt = decimal64(-d) if dt.type_id == TypeId.DECIMAL64 \
+        else decimal32(-d)
+    return Column(out_dt, q.astype(dt.jnp_dtype), col.validity)
+
+
+@func_range("pmod")
+def pmod(a: Column, b: Column) -> Column:
+    """Spark ``pmod(a, b)``, bit-exact to its Java formula
+    ``r = a % n; if (r < 0) (r + n) % n else r`` with JAVA's
+    truncated-% (dividend sign) — for positive divisors that is the
+    usual [0, b) modulus; for negative divisors Spark's result keeps
+    the dividend-sign quirk, reproduced here rather than idealized.
+    Division by zero gives null (non-ANSI posture)."""
+    _same_dtypes([a, b], "pmod")
+    _check_numeric(a, "pmod")
+    zero = b.data == 0
+    safe_b = jnp.where(zero, jnp.ones_like(b.data), b.data)
+
+    def _trunc_mod(x, nn):
+        # Java truncated % from floor %: t = m - n when m != 0 and the
+        # operand signs differ — no abs() anywhere, so INT64_MIN is safe
+        fm = jnp.remainder(x, nn)
+        flip = (fm != 0) & ((x < 0) != (nn < 0))
+        return fm - jnp.where(flip, nn, jnp.zeros_like(nn))
+
+    jt = _trunc_mod(a.data, safe_b)
+    srn = jt + safe_b          # |jt| < |n| so this cannot overflow
+    adj = _trunc_mod(srn, safe_b)
+    m = jnp.where(jt < 0, adj, jt)
+    validity = a.valid_mask() & b.valid_mask() & ~zero
+    return Column(a.dtype, m.astype(a.dtype.jnp_dtype), validity)

@@ -125,6 +125,19 @@ def unpad_strings(col: Column) -> Column:
     return Column(STRING, offsets, col.validity, chars=chars)
 
 
+def pad_to_common_width(cols):
+    """Pad several string columns to one shared (max) padded width —
+    the normalization concatenate/coalesce need before mixing rows."""
+    ps = [pad_strings(c) for c in cols]
+    w = max(int(p.chars.shape[1]) for p in ps)
+    return [
+        p if int(p.chars.shape[1]) == w else Column(
+            p.dtype, p.data, p.validity,
+            chars=jnp.pad(p.chars, ((0, 0), (0, w - p.chars.shape[1]))))
+        for p in ps
+    ]
+
+
 def gather_strings(col: Column, indices: jnp.ndarray) -> Column:
     """Row gather of a padded string column (padded layout makes this the
     same two-array gather as fixed-width columns)."""

@@ -92,19 +92,14 @@ def _concat_columns(cols: Sequence[Column]) -> Column:
     if dtype.is_string:
         if any(c.is_padded_string for c in cols):
             # normalize to the padded device layout at the widest width
-            from spark_rapids_jni_tpu.ops.strings import pad_strings
+            from spark_rapids_jni_tpu.ops.strings import pad_to_common_width
 
-            padded = [pad_strings(c) for c in cols]
-            width = max(int(p.chars.shape[1]) for p in padded)
-            mats = [
-                jnp.pad(p.chars, ((0, 0), (0, width - int(p.chars.shape[1]))))
-                for p in padded
-            ]
+            padded = pad_to_common_width(cols)
             return Column(
                 dtype,
                 jnp.concatenate([p.data for p in padded]),
                 validity,
-                chars=jnp.concatenate(mats),
+                chars=jnp.concatenate([p.chars for p in padded]),
             )
         # Arrow layout: shift each table's offsets by the chars written so far
         parts, offs, base = [], [], 0
@@ -232,20 +227,16 @@ def _set_op(left: Table, right: Table, keep_matched: bool) -> CompactResult:
     allt = concatenate([_with_side(l0, 0), _with_side(right, 1)])
     nk = left.num_columns
     ks = list(range(nk))
-    order = sort_order(allt, ks)
+    # side as the trailing sort key: left tuples are DISTINCT, so each
+    # group's single side-0 row sorts immediately before its side-1
+    # rows — membership is one neighbor compare, no group-id machinery
+    order = sort_order(allt, ks + [nk])
     sall = gather(allt, order)
     same = _rows_equal_prev(sall, ks)
-    n_all = sall.num_rows
-    gid = (jnp.cumsum(~same) - 1).astype(jnp.int32)
     side_sorted = sall.column(nk).data
-    pref = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int64),
-         jnp.cumsum((side_sorted == 1).astype(jnp.int64))])
-    garange = jnp.arange(n_all, dtype=jnp.int32)
-    lo = jnp.searchsorted(gid, garange, side="left")
-    hi = jnp.searchsorted(gid, garange, side="right")
-    grp_has_right = (pref[hi] - pref[lo]) > 0
-    matched = grp_has_right[gid]
+    next_same = jnp.concatenate(
+        [same[1:], jnp.zeros((1,), jnp.bool_)])
+    matched = next_same  # the only same-key follower can be side 1
     mask = (side_sorted == 0) & (matched == keep_matched)
     perm = jnp.argsort(~mask, stable=True).astype(jnp.int32)
     num = jnp.sum(mask).astype(jnp.int32)

@@ -1,0 +1,96 @@
+"""Elementwise SQL functions vs Python oracles (coalesce/nullif/
+greatest/least/abs/ceil/floor/round/pmod)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops import elementwise as e
+
+
+def test_coalesce_numeric_and_strings():
+    a = Column.from_pylist([1, None, None, 4], t.INT64)
+    b = Column.from_pylist([None, 2, None, 40], t.INT64)
+    c = Column.from_pylist([9, 9, None, 9], t.INT64)
+    assert e.coalesce([a, b, c]).to_pylist() == [1, 2, None, 4]
+    sa = Column.from_pylist(["x", None, None], t.STRING)
+    sb = Column.from_pylist([None, "longer", None], t.STRING)
+    assert e.coalesce([sa, sb]).to_pylist() == ["x", "longer", None]
+    with pytest.raises(ValueError, match="at least one"):
+        e.coalesce([])
+
+
+def test_nullif_and_extremums():
+    a = Column.from_pylist([1, 2, None, 5], t.INT64)
+    b = Column.from_pylist([1, 3, 7, None], t.INT64)
+    assert e.nullif(a, b).to_pylist() == [None, 2, None, 5]
+    c = Column.from_pylist([0, 9, 1, None], t.INT64)
+    # greatest/least SKIP nulls (null only when all null)
+    assert e.greatest([a, b, c]).to_pylist() == [1, 9, 7, 5]
+    assert e.least([a, b, c]).to_pylist() == [0, 2, 1, 5]
+    alln = Column.from_pylist([None, None], t.INT64)
+    assert e.greatest([alln, alln]).to_pylist() == [None, None]
+
+
+def test_abs_ceil_floor():
+    f = Column.from_numpy(np.array([1.5, -1.5, 2.0, -0.1]))
+    assert e.abs_(f).to_pylist() == [1.5, 1.5, 2.0, 0.1]
+    assert e.ceil(f).to_pylist() == [2, -1, 2, 0]
+    assert e.floor(f).to_pylist() == [1, -2, 2, -1]
+    d = Column.from_numpy(np.array([150, -150, 199, -101], np.int64),
+                          t.decimal64(-2))  # 1.50 -1.50 1.99 -1.01
+    assert e.ceil(d).to_pylist() == [2, -1, 2, -1]
+    assert e.floor(d).to_pylist() == [1, -2, 1, -2]
+
+
+def test_round_decimal_half_up_exact():
+    d = Column.from_numpy(
+        np.array([12345, 12350, 12344, -12345, -12350, -12344], np.int64),
+        t.decimal64(-3))  # 12.345 12.350 12.344 ...
+    out = e.round_decimal(d, 2)
+    assert out.dtype == t.decimal64(-2)
+    # HALF_UP away from zero: 12.345 -> 12.35; -12.345 -> -12.35
+    assert out.to_pylist() == [1235, 1235, 1234, -1235, -1235, -1234]
+    # d >= frac digits: unchanged
+    assert e.round_decimal(d, 3).to_pylist() == d.to_pylist()
+
+
+def test_pmod_matches_spark_java_formula():
+    def spark_pmod(a, n):
+        r = int(np.sign(a)) * (abs(a) % abs(n))
+        if r < 0:
+            s = r + n
+            return int(np.sign(s)) * (abs(s) % abs(n))
+        return r
+
+    vals = [(7, 3), (-7, 3), (2, -3), (-2, -3), (0, 5), (9, 9),
+            (-9, 2), (5, 0)]
+    a = Column.from_pylist([v[0] for v in vals], t.INT64)
+    b = Column.from_pylist([v[1] for v in vals], t.INT64)
+    got = e.pmod(a, b).to_pylist()
+    for (x, n), g in zip(vals, got):
+        if n == 0:
+            assert g is None
+        else:
+            assert g == spark_pmod(x, n), (x, n, g)
+
+
+def test_greatest_least_nan_is_largest_any_order():
+    nan = float("nan")
+    x = Column.from_numpy(np.array([1.0, nan]))
+    y = Column.from_numpy(np.array([nan, 1.0]))
+    import math
+
+    for order in ([x, y], [y, x]):
+        g = e.greatest(order).to_pylist()
+        l_ = e.least(order).to_pylist()
+        assert all(math.isnan(v) for v in g)
+        assert l_ == [1.0, 1.0]
+
+
+def test_pmod_int64_min_exact():
+    a = Column.from_pylist([-(2 ** 63)], t.INT64)
+    b = Column.from_pylist([3], t.INT64)
+    # Java: (-2^63) % 3 == -2 -> pmod == 1
+    assert e.pmod(a, b).to_pylist() == [1]
