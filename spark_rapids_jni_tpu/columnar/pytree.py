@@ -21,16 +21,21 @@ from spark_rapids_jni_tpu.columnar.table import Table
 
 
 def _column_flatten(col: Column):
-    return (col.data, col.validity, col.chars), col.dtype
+    # nested children (LIST/STRUCT) are pytrees themselves — they MUST
+    # ride the leaves tuple or jit/shard_map would silently drop a LIST
+    # column's child buffer (the dataclass default would resurface as
+    # children=None after unflattening)
+    return (col.data, col.validity, col.chars, col.children), col.dtype
 
 
 def _column_unflatten(dtype, children) -> Column:
-    data, validity, chars = children
+    data, validity, chars, nested = children
     col = object.__new__(Column)
     col.dtype = dtype
     col.data = data
     col.validity = validity
     col.chars = chars
+    col.children = nested
     return col
 
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import NamedTuple, Optional, Sequence
 
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops.sort import gather, sort_order
@@ -89,6 +90,29 @@ def _concat_columns(cols: Sequence[Column]) -> Column:
         validity = None  # keep the no-null-mask fast path alive
     else:
         validity = jnp.concatenate([c.valid_mask() for c in cols])
+    if dtype.type_id == TypeId.LIST:
+        # host-level: trim each child to its live element range (padded
+        # tails would corrupt the offset re-base), shift offsets by the
+        # running child total, concat children recursively
+        offs, base = [], 0
+        kids = []
+        for c in cols:
+            live = int(c.data[-1]) if c.size else 0
+            offs.append(c.data[:-1].astype(jnp.int64) + base)
+            kids.append(_slice_child(c.children[0], 0, live))
+            base += live
+        if base > np.iinfo(np.int32).max:
+            raise ValueError(
+                f"concatenated LIST child holds {base} elements, over the "
+                "int32 Arrow offset bound (2^31-1); concatenate in batches")
+        offs.append(jnp.asarray([base], jnp.int64))
+        child = _concat_columns(kids) if kids else cols[0].children[0]
+        return Column(
+            dtype,
+            jnp.concatenate(offs).astype(jnp.int32),
+            validity,
+            children=[child],
+        )
     if dtype.is_string:
         if any(c.is_padded_string for c in cols):
             # normalize to the padded device layout at the widest width

@@ -492,3 +492,78 @@ def distributed_join(
         out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
     )(left, right, left_row_valid, right_row_valid)
     return DistributedJoin(out, total, overflowed)
+
+
+class DistributedCollectList(NamedTuple):
+    table: Table             # keys then one LIST column, host-assembled
+    overflowed: jnp.ndarray  # bool[D] shuffle capacity overflow
+
+
+@func_range("distributed_groupby_collect")
+def distributed_groupby_collect(
+    table: Table,
+    keys: Sequence[int],
+    value_col: int,
+    mesh: Mesh,
+    capacity: int,
+    distinct: bool = False,
+) -> DistributedCollectList:
+    """Global collect_list/collect_set: hash-shuffle rows so whole key
+    groups co-locate (the shared ``_distributed_groupby`` scaffold), run
+    one local ``groupby_collect`` per device, then assemble the
+    per-device LIST results on the driver (trim + LIST-aware
+    concatenate — the nested-offset analogue of ``collect``). Row order
+    across devices is unspecified (sort on the keys afterwards if
+    needed).
+
+    Shard padding rows follow the module's phantom-row posture: they
+    surface as one all-null-key group (with an empty list) that callers
+    discard like local groupby padding."""
+    from spark_rapids_jni_tpu.ops.lists import CollectResult, groupby_collect
+    from spark_rapids_jni_tpu.ops.groupby import GroupByResult
+    from spark_rapids_jni_tpu.ops.table_ops import concatenate, trim_table
+
+    ks = list(keys)
+
+    def local_collect(sh_tbl: Table, kss):
+        res = groupby_collect(sh_tbl, kss, value_col, distinct=distinct)
+        # adapt to the scaffold's GroupByResult packing (the default
+        # overflow flags are static False — collect has no max_groups)
+        return GroupByResult(res.table, res.num_groups)
+
+    dist = _distributed_groupby(table, ks, mesh, capacity, local_collect)
+    out_tbl, ngs, ovf = dist.table, dist.num_groups, dist.overflowed
+    d = int(np.prod(list(mesh.shape.values())))
+    counts = np.asarray(ngs).reshape(-1)
+
+    def _host_chunks(c: Column) -> list[Column]:
+        """ONE device->host fetch per buffer, then numpy slicing — no
+        per-device sync loop (each leaf is evenly divided across the
+        mesh by shard_map)."""
+        bufs = {}
+        for name in ("data", "validity", "chars"):
+            arr = getattr(c, name)
+            bufs[name] = None if arr is None else np.asarray(arr)
+        kid_chunks = (None if c.children is None
+                      else [_host_chunks(k) for k in c.children])
+        out = []
+        for di in range(d):
+            def seg(arr):
+                if arr is None:
+                    return None
+                chunk = arr.shape[0] // d
+                return jnp.asarray(arr[di * chunk:(di + 1) * chunk])
+
+            kids = (None if kid_chunks is None
+                    else [kc[di] for kc in kid_chunks])
+            out.append(Column(c.dtype, seg(bufs["data"]),
+                              seg(bufs["validity"]),
+                              chars=seg(bufs["chars"]), children=kids))
+        return out
+
+    col_chunks = [_host_chunks(c) for c in out_tbl.columns]
+    per_dev = []
+    for di in range(d):
+        tbl_d = Table([cc[di] for cc in col_chunks])
+        per_dev.append(trim_table(tbl_d, int(counts[di])))
+    return DistributedCollectList(concatenate(per_dev), ovf)

@@ -242,3 +242,65 @@ def test_arrays_overlap_empty_side_is_false():
     a = make_list_column([[]], t.INT64)
     b = make_list_column([[None]], t.INT64)
     assert arrays_overlap(a, b).to_pylist() == [False]
+
+
+def test_list_column_survives_jit():
+    """Pytree regression: LIST children must ride jit/shard_map leaves
+    (the old registration silently dropped them)."""
+    import jax
+
+    lc = make_list_column([[1, 2], None, [3]], t.INT64)
+    out = jax.jit(lambda c: c)(lc)
+    assert out.children is not None
+    assert out.to_pylist() == [[1, 2], None, [3]]
+
+    # a jitted explode end to end
+    tbl = Table([Column.from_pylist([7, 8, 9], t.INT64), lc])
+
+    def f(tb):
+        r = explode(tb, 1)
+        return r.table, r.row_valid, r.num_rows
+
+    ot, rv, num = jax.jit(f)(tbl)
+    assert int(num) == 3
+    rows = [(ot.column(0).to_pylist()[i], ot.column(1).to_pylist()[i])
+            for i in np.flatnonzero(np.asarray(rv))]
+    assert rows == [(7, 1), (7, 2), (9, 3)]
+
+
+@pytest.mark.slow
+def test_distributed_groupby_collect(rng):
+    from spark_rapids_jni_tpu.parallel import executor_mesh, shard_table
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        distributed_groupby_collect,
+    )
+
+    mesh = executor_mesh(8)
+    n = 512
+    keys = rng.integers(0, 9, n).astype(np.int64)
+    vals = rng.integers(-30, 30, n).astype(np.int64)
+    vvalid = rng.random(n) > 0.2
+    tbl = Table([Column.from_numpy(keys),
+                 Column.from_numpy(vals, validity=vvalid)])
+    sharded = shard_table(tbl, mesh)
+    res = distributed_groupby_collect(sharded, [0], 1, mesh, capacity=n)
+    assert not np.asarray(res.overflowed).any()
+    got = {}
+    for k, lst in zip(res.table.column(0).to_pylist(),
+                      res.table.column(1).to_pylist()):
+        if k is not None:
+            got[k] = sorted(lst)
+    want = {}
+    for k, v, ok in zip(keys.tolist(), vals.tolist(), vvalid):
+        want.setdefault(k, [])
+        if ok:
+            want[k].append(v)
+    assert got == {k: sorted(v) for k, v in want.items()}
+
+    # collect_set over the mesh
+    res2 = distributed_groupby_collect(
+        sharded, [0], 1, mesh, capacity=n, distinct=True)
+    got2 = {k: lst for k, lst in
+            zip(res2.table.column(0).to_pylist(),
+                res2.table.column(1).to_pylist()) if k is not None}
+    assert got2 == {k: sorted(set(v)) for k, v in want.items()}

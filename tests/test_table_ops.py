@@ -265,3 +265,13 @@ def test_set_ops_null_tuples_and_validation(rng):
     with pytest.raises(TypeError, match="matching dtypes"):
         except_rows(left, Table([Column.from_numpy(
             np.ones(2, np.float64))]))
+
+
+def test_concatenate_list_columns():
+    from spark_rapids_jni_tpu.ops.lists import make_list_column
+    from spark_rapids_jni_tpu.ops.table_ops import concatenate
+
+    a = Table([make_list_column([[1, 2], None], t.INT64)])
+    b = Table([make_list_column([[], [3]], t.INT64)])
+    out = concatenate([a, b])
+    assert out.column(0).to_pylist() == [[1, 2], None, [], [3]]
