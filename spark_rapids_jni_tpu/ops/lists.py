@@ -528,3 +528,59 @@ def arrays_overlap(a: Column, b: Column) -> Column:
 
     validity = a.valid_mask() & b.valid_mask() & (overlap | ~has_null)
     return Column(BOOL8, overlap.astype(jnp.uint8), validity)
+
+
+@func_range("sequence")
+def sequence(start: Column, stop: Column, step: Column | int = 1,
+             max_length: int = 1024) -> Column:
+    """Spark ``sequence(start, stop, step)``: one inclusive arithmetic
+    range per row as LIST<INT64>.
+
+    HOST-LEVEL generator (not jit-composable: the static child budget
+    and Spark's error semantics both need host checks). A row whose
+    range exceeds ``max_length`` raises; a step moving AWAY from stop
+    raises like Spark's ILLEGAL_SEQUENCE_BOUNDARIES; step 0 is rejected
+    up front; null operands give a null row (Spark null propagation)."""
+    if isinstance(step, int):
+        if step == 0:
+            raise ValueError("sequence step must be non-zero")
+        step_data = jnp.full((start.size,), step, jnp.int64)
+        step_valid = jnp.ones((start.size,), jnp.bool_)
+    else:
+        step_data = step.data.astype(jnp.int64)
+        step_valid = step.valid_mask() & (step_data != 0)
+    a = start.data.astype(jnp.int64)
+    b = stop.data.astype(jnp.int64)
+    ok = start.valid_mask() & stop.valid_mask() & step_valid
+    right_dir = jnp.where(step_data > 0, b >= a, b <= a)
+    if bool(jnp.any(ok & ~right_dir)):
+        raise ValueError(
+            "sequence step moves away from stop (Spark "
+            "ILLEGAL_SEQUENCE_BOUNDARIES)")
+    safe_step = jnp.where(step_data == 0, jnp.int64(1), step_data)
+    lens = jnp.where(ok & right_dir,
+                     jnp.floor_divide(b - a, safe_step) + 1,
+                     jnp.int64(0))
+    too_long = bool(jnp.any(lens > max_length))
+    if too_long:
+        raise ValueError(
+            f"sequence row exceeds max_length={max_length} elements; "
+            "raise max_length (static child budget)")
+    n = start.size
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64), jnp.cumsum(lens)]).astype(jnp.int32)
+    child_n = n * max_length
+    k = jnp.arange(child_n, dtype=jnp.int64)
+    parent = jnp.clip(
+        jnp.searchsorted(offsets.astype(jnp.int64), k, side="right") - 1,
+        0, max(n - 1, 0)).astype(jnp.int32)
+    j = k - offsets[parent]
+    live = k < offsets[-1]
+    vals = a[parent] + j * step_data[parent]
+    child = Column(DType(TypeId.INT64),
+                   jnp.where(live, vals, 0).astype(jnp.int64),
+                   live)
+    validity = None if (start.validity is None
+                        and stop.validity is None
+                        and not isinstance(step, Column)) else ok
+    return Column(DType(TypeId.LIST), offsets, validity, children=[child])

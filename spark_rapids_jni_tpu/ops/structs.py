@@ -1,0 +1,65 @@
+"""STRUCT-column utilities: build, unpack (Spark ``col.*``), and field
+access.
+
+The Parquet reader assembles STRUCT columns (Dremel nested assembly);
+this module makes them usable in the relational core the way Spark
+does — by star-expansion: ``unpack_struct`` replaces a STRUCT column
+with its fields (struct-level nulls ANDed into every field, the
+three-valued reading of ``null_struct.field``), after which the
+existing sort/groupby/join machinery applies directly. A null struct
+therefore sorts/groups exactly like a row whose every field is null —
+Spark's observable ordering for struct keys with null structs.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+def make_struct_column(fields: Sequence[Column],
+                       validity=None) -> Column:
+    """Host-side STRUCT builder over equal-length field columns."""
+    if not fields:
+        raise ValueError("STRUCT needs at least one field")
+    n = fields[0].size
+    for f in fields:
+        if f.size != n:
+            raise ValueError("STRUCT fields must have equal row counts")
+    return Column(DType(TypeId.STRUCT),
+                  jnp.zeros((n,), jnp.uint8), validity,
+                  children=list(fields))
+
+
+def struct_field(col: Column, idx: int) -> Column:
+    """``struct.field`` access: the field column with the struct's nulls
+    propagated (Spark: null_struct.field IS NULL)."""
+    if col.dtype.type_id != TypeId.STRUCT:
+        raise TypeError(f"struct_field needs a STRUCT column, got "
+                        f"{col.dtype}")
+    f = col.children[idx]
+    if col.validity is None:
+        return f
+    sv = col.valid_mask()
+    return Column(f.dtype, f.data, f.valid_mask() & sv,
+                  chars=f.chars, children=f.children)
+
+
+@func_range("unpack_struct")
+def unpack_struct(table: Table, col_idx: int) -> Table:
+    """Spark ``col.*`` star-expansion: replace the STRUCT column with
+    its fields in place (struct nulls ANDed into each field). Nested
+    structs expand one level; call again for deeper levels."""
+    c = table.column(col_idx)
+    if c.dtype.type_id != TypeId.STRUCT:
+        raise TypeError(f"unpack_struct needs a STRUCT column, got "
+                        f"{c.dtype}")
+    fields = [struct_field(c, i) for i in range(len(c.children))]
+    cols = (list(table.columns[:col_idx]) + fields
+            + list(table.columns[col_idx + 1:]))
+    return Table(cols)

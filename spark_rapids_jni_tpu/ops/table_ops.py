@@ -35,7 +35,12 @@ def _slice_rows(table: Table, lo: int, hi: int) -> Table:
     cols = []
     for c in table.columns:
         validity = None if c.validity is None else c.validity[lo:hi]
-        if c.dtype.type_id == TypeId.LIST:
+        if c.dtype.type_id == TypeId.STRUCT:
+            cols.append(Column(
+                c.dtype, c.data[lo:hi], validity,
+                children=[_slice_rows(Table([k]), lo, hi).column(0)
+                          for k in c.children]))
+        elif c.dtype.type_id == TypeId.LIST:
             # slice-and-rebase: cut the child to this window's element
             # range [offsets[lo], offsets[hi]) and shift the offsets so
             # they index the cut child from 0
@@ -90,6 +95,16 @@ def _concat_columns(cols: Sequence[Column]) -> Column:
         validity = None  # keep the no-null-mask fast path alive
     else:
         validity = jnp.concatenate([c.valid_mask() for c in cols])
+    if dtype.type_id == TypeId.STRUCT:
+        return Column(
+            dtype,
+            jnp.concatenate([c.data for c in cols]),
+            validity,
+            children=[
+                _concat_columns([c.children[i] for c in cols])
+                for i in range(len(cols[0].children))
+            ],
+        )
     if dtype.type_id == TypeId.LIST:
         # host-level: trim each child to its live element range (padded
         # tails would corrupt the offset re-base), shift offsets by the
@@ -106,7 +121,7 @@ def _concat_columns(cols: Sequence[Column]) -> Column:
                 f"concatenated LIST child holds {base} elements, over the "
                 "int32 Arrow offset bound (2^31-1); concatenate in batches")
         offs.append(jnp.asarray([base], jnp.int64))
-        child = _concat_columns(kids) if kids else cols[0].children[0]
+        child = _concat_columns(kids)
         return Column(
             dtype,
             jnp.concatenate(offs).astype(jnp.int32),

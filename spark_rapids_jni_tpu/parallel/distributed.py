@@ -519,7 +519,7 @@ def distributed_groupby_collect(
     Shard padding rows follow the module's phantom-row posture: they
     surface as one all-null-key group (with an empty list) that callers
     discard like local groupby padding."""
-    from spark_rapids_jni_tpu.ops.lists import CollectResult, groupby_collect
+    from spark_rapids_jni_tpu.ops.lists import groupby_collect
     from spark_rapids_jni_tpu.ops.groupby import GroupByResult
     from spark_rapids_jni_tpu.ops.table_ops import concatenate, trim_table
 

@@ -304,3 +304,37 @@ def test_distributed_groupby_collect(rng):
             zip(res2.table.column(0).to_pylist(),
                 res2.table.column(1).to_pylist()) if k is not None}
     assert got2 == {k: sorted(set(v)) for k, v in want.items()}
+
+
+def test_sequence_vs_python():
+    from spark_rapids_jni_tpu.ops.lists import sequence
+
+    a = Column.from_pylist([1, 5, 0, None, 3], t.INT64)
+    b = Column.from_pylist([5, 1, 0, 4, 1], t.INT64)
+    # wrong-direction rows RAISE like Spark
+    with pytest.raises(ValueError, match="ILLEGAL_SEQUENCE"):
+        sequence(a, b, 1)
+    ok_a = Column.from_pylist([1, 0, None], t.INT64)
+    ok_b = Column.from_pylist([5, 0, 4], t.INT64)
+    assert sequence(ok_a, ok_b, 1).to_pylist() == \
+        [[1, 2, 3, 4, 5], [0], None]
+    down_a = Column.from_pylist([5, 3], t.INT64)
+    down_b = Column.from_pylist([1, 1], t.INT64)
+    assert sequence(down_a, down_b, -2).to_pylist() == [[5, 3, 1], [3, 1]]
+    with pytest.raises(ValueError, match="non-zero"):
+        sequence(a, b, 0)
+    big = Column.from_pylist([0], t.INT64)
+    with pytest.raises(ValueError, match="max_length"):
+        sequence(big, Column.from_pylist([10**6], t.INT64), 1)
+
+
+def test_sequence_explodes():
+    from spark_rapids_jni_tpu.ops.lists import explode, sequence
+
+    a = Column.from_pylist([10, 20], t.INT64)
+    b = Column.from_pylist([12, 20], t.INT64)
+    seq = sequence(a, b)
+    tbl = Table([Column.from_pylist([1, 2], t.INT64), seq])
+    ex = explode(tbl, 1)
+    rows = _exploded_rows(ex, 2)
+    assert rows == [(1, 10), (1, 11), (1, 12), (2, 20)]

@@ -1,0 +1,73 @@
+"""STRUCT utilities: build/unpack/field access feeding the relational
+core (sort/groupby over expanded fields)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.structs import (
+    make_struct_column,
+    struct_field,
+    unpack_struct,
+)
+
+
+def _struct():
+    f1 = Column.from_pylist([3, 1, 2, 1], t.INT64)
+    f2 = Column.from_pylist(["c", "a", None, "b"], t.STRING)
+    validity = np.array([True, True, True, False])
+    return make_struct_column([f1, f2], jnp.asarray(validity))
+
+
+def test_struct_field_propagates_struct_nulls():
+    s = _struct()
+    g1 = struct_field(s, 0).to_pylist()
+    g2 = struct_field(s, 1).to_pylist()
+    assert g1 == [3, 1, 2, None]     # row 3: struct null -> field null
+    assert g2 == ["c", "a", None, None]
+
+
+def test_unpack_struct_and_sort_groupby():
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.ops.sort import sort_table
+
+    ids = Column.from_pylist([10, 20, 30, 40], t.INT64)
+    tbl = Table([ids, _struct()])
+    flat = unpack_struct(tbl, 1)
+    assert flat.num_columns == 3
+    srt = sort_table(flat, [1, 2])
+    # default nulls-first: the null struct (all fields null) leads
+    assert srt.column(0).to_pylist() == [40, 20, 30, 10]
+    g = groupby_aggregate(flat, [1], [(0, "count")]).compact()
+    got = dict(zip(g.column(0).to_pylist(), g.column(1).to_pylist()))
+    assert got == {None: 1, 1: 1, 2: 1, 3: 1}
+
+
+def test_struct_to_pylist_roundtrip():
+    s = _struct()
+    # STRUCT rows surface as field tuples; null structs as None
+    assert s.to_pylist() == [(3, "c"), (1, "a"), (2, None), None]
+
+
+def test_struct_validation():
+    with pytest.raises(ValueError, match="at least one"):
+        make_struct_column([])
+    a = Column.from_pylist([1], t.INT64)
+    b = Column.from_pylist([1, 2], t.INT64)
+    with pytest.raises(ValueError, match="equal row"):
+        make_struct_column([a, b])
+    with pytest.raises(TypeError, match="STRUCT"):
+        struct_field(a, 0)
+
+
+def test_struct_concat_and_trim():
+    from spark_rapids_jni_tpu.ops.table_ops import concatenate, trim_table
+
+    s1 = _struct()
+    t1 = Table([s1])
+    out = concatenate([t1, t1])
+    assert out.column(0).to_pylist() == s1.to_pylist() * 2
+    tr = trim_table(out, 3)
+    assert tr.column(0).to_pylist() == s1.to_pylist()[:3]
