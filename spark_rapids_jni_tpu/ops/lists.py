@@ -548,7 +548,9 @@ def sequence(start: Column, stop: Column, step: Column | int = 1,
         step_valid = jnp.ones((start.size,), jnp.bool_)
     else:
         step_data = step.data.astype(jnp.int64)
-        step_valid = step.valid_mask() & (step_data != 0)
+        if bool(jnp.any(step.valid_mask() & (step_data == 0))):
+            raise ValueError("sequence step must be non-zero")
+        step_valid = step.valid_mask()
     a = start.data.astype(jnp.int64)
     b = stop.data.astype(jnp.int64)
     ok = start.valid_mask() & stop.valid_mask() & step_valid

@@ -53,6 +53,11 @@ class Window:
                if ascending is not None else None)
         nf = ([True] * len(partition_by) + list(nulls_first)
               if nulls_first is not None else None)
+        self._order_by = list(order_by)
+        self._order_asc = (list(ascending) if ascending is not None
+                           else [True] * len(self._order_by))
+        self._order_nf = (list(nulls_first) if nulls_first is not None
+                          else [True] * len(self._order_by))
         self._order = sort_order(table, keys, ascending=asc, nulls_first=nf)
         self._sorted = gather(table, self._order)
         # inverse permutation via argsort — a sort, never a scatter
@@ -193,6 +198,99 @@ class Window:
         hi = jnp.clip(self._idx + following, self._p_start, self._p_end)
         return lo, hi
 
+    def _bounds(self, preceding, following, frame: str):
+        if frame == "rows":
+            return self._frame_bounds(preceding, following)
+        if frame == "range":
+            return self._range_frame_bounds(preceding, following)
+        raise ValueError(f"frame must be 'rows' or 'range', got {frame!r}")
+
+    def _bounded_search(self, v: jnp.ndarray, target: jnp.ndarray,
+                        lo0: jnp.ndarray, hi0: jnp.ndarray,
+                        side_left: bool) -> jnp.ndarray:
+        """Per-row binary search of ``target`` inside [lo0, hi0) over the
+        partition-sorted values ``v`` — log2(n) vectorized halving steps
+        (jnp.searchsorted has no per-row bounds)."""
+        import numpy as _np
+
+        n = self._n
+        lo_b, hi_b = lo0.astype(jnp.int64), hi0.astype(jnp.int64)
+        steps = int(_np.ceil(_np.log2(max(n, 2)))) + 1
+        for _ in range(steps):
+            active = lo_b < hi_b
+            mid = (lo_b + hi_b) >> 1
+            mv = v[jnp.clip(mid, 0, max(n - 1, 0))]
+            go_right = (mv < target) if side_left else (mv <= target)
+            lo_b = jnp.where(active & go_right, mid + 1, lo_b)
+            hi_b = jnp.where(active & ~go_right, mid, hi_b)
+        return lo_b
+
+    def _range_frame_bounds(self, preceding, following):
+        """Sorted-position [lo, hi] of each row's RANGE frame: rows of
+        the same partition whose ORDER BY value lies in
+        [v - preceding, v + following]. Requirements (raise otherwise):
+        exactly ONE numeric ORDER BY key, ascending, nulls first (the
+        defaults). Rows with a NULL order value frame over the
+        partition's null run (Spark: nulls are peers only of nulls)."""
+        if len(self._order_by) != 1:
+            raise ValueError(
+                "RANGE frames need exactly one ORDER BY key")
+        if not self._order_asc[0] or not self._order_nf[0]:
+            raise NotImplementedError(
+                "RANGE frames need an ascending, nulls-first ORDER BY "
+                "key (the defaults)")
+        if preceding < 0 or following < 0:
+            raise ValueError("RANGE bounds must be >= 0")
+        oc = self._sorted.column(self._order_by[0])
+        if oc.dtype.is_string or oc.dtype.is_decimal128 or \
+                oc.dtype.storage_dtype.kind not in ("i", "u", "f"):
+            raise TypeError(
+                f"RANGE frames need a numeric ORDER BY key, got "
+                f"{oc.dtype}")
+        if oc.dtype.is_decimal:
+            # bounds are VALUE distances: rescale to unscaled units
+            # exactly, or refuse (a silent unscaled interpretation would
+            # shrink the window by 10^scale)
+            factor = 10 ** (-oc.dtype.scale)
+            for name, b in (("preceding", preceding),
+                            ("following", following)):
+                if (b * factor) != int(b * factor):
+                    raise ValueError(
+                        f"RANGE {name}={b} is not representable at "
+                        f"{oc.dtype} scale")
+            preceding = int(preceding * factor)
+            following = int(following * factor)
+        v = oc.data
+        is_null = ~oc.valid_mask()
+        # per-partition null-run length (nulls sort first)
+        nrun = _segmented_sum_scan(
+            is_null.astype(jnp.int64)[:, None], ~self._same_p)[:, 0]
+        nc = nrun[jnp.clip(self._p_end, 0, max(self._n - 1, 0))]
+        valid_start = self._p_start + nc
+        valid_end = self._p_end + 1
+        is_nan = jnp.zeros((self._n,), jnp.bool_)
+        if oc.dtype.storage_dtype.kind == "f":
+            # NaN orders greatest (the sort posture), so the NaN run
+            # sits at the partition END; NaN rows frame over their NaN
+            # peers (NaN == NaN) and value searches exclude the run
+            is_nan = jnp.isnan(v) & ~is_null
+            nanrun = _segmented_sum_scan(
+                is_nan.astype(jnp.int64)[:, None], ~self._same_p)[:, 0]
+            nanc = nanrun[jnp.clip(self._p_end, 0,
+                                   max(self._n - 1, 0))]
+            valid_end = valid_end - nanc
+        lo = self._bounded_search(v, v - preceding, valid_start,
+                                  valid_end, side_left=True)
+        hi = self._bounded_search(v, v + following, valid_start,
+                                  valid_end, side_left=False) - 1
+        # null-order rows frame over the null run; NaN rows over theirs
+        lo = jnp.where(is_null, self._p_start, lo)
+        hi = jnp.where(is_null, self._p_start + nc - 1, hi)
+        if oc.dtype.storage_dtype.kind == "f":
+            lo = jnp.where(is_nan, valid_end, lo)
+            hi = jnp.where(is_nan, self._p_end, hi)
+        return lo, hi
+
     def _frame_diff(self, running: jnp.ndarray, lo: jnp.ndarray,
                     hi: jnp.ndarray) -> jnp.ndarray:
         """Per-frame total of a segmented running sum via prefix
@@ -210,13 +308,14 @@ class Window:
             valid.astype(jnp.int64)[:, None], ~self._same_p)[:, 0]
         return self._frame_diff(cnt, lo, hi)
 
-    def _rolling_parts(self, col_idx: int, preceding: int, following: int):
+    def _rolling_parts(self, col_idx: int, preceding: int, following: int,
+                       frame: str = "rows"):
         """Shared rolling-frame machinery: per-row frame sums and counts
         over ROWS BETWEEN preceding PRECEDING AND following FOLLOWING,
         clamped to the partition — prefix differences of the SEGMENTED
         running sum (resets each partition, so int lanes are exact and
         float error stays partition-local)."""
-        lo, hi = self._frame_bounds(preceding, following)
+        lo, hi = self._bounds(preceding, following, frame)
         c = self._sorted.column(col_idx)
         if c.dtype.is_string or c.dtype.is_decimal128:
             raise NotImplementedError(
@@ -233,14 +332,15 @@ class Window:
 
     @func_range("window_rolling_sum")
     def rolling_sum(self, col_idx: int, preceding: int,
-                    following: int = 0) -> Column:
+                    following: int = 0, frame: str = "rows") -> Column:
         """SUM over ROWS BETWEEN preceding PRECEDING AND following
         FOLLOWING (the cuDF rolling-window op). Exact for int/decimal
         lanes; float frames difference partition-local running sums
         (documented float-rounding posture)."""
         from spark_rapids_jni_tpu.ops.groupby import _sum_dtype
 
-        c, wsum, wcnt = self._rolling_parts(col_idx, preceding, following)
+        c, wsum, wcnt = self._rolling_parts(col_idx, preceding,
+                                            following, frame)
         acc_dt = _sum_dtype(c.dtype)
         return Column(acc_dt,
                       self._unsort(wsum.astype(acc_dt.jnp_dtype)),
@@ -248,20 +348,21 @@ class Window:
 
     @func_range("window_rolling_count")
     def rolling_count(self, col_idx: int, preceding: int,
-                      following: int = 0) -> Column:
+                      following: int = 0, frame: str = "rows") -> Column:
         """COUNT of non-null values in the rolling frame — needs only the
         validity mask, so every dtype (strings, DECIMAL128) is counted."""
-        lo, hi = self._frame_bounds(preceding, following)
+        lo, hi = self._bounds(preceding, following, frame)
         valid = self._sorted.column(col_idx).valid_mask()
         wcnt = self._frame_valid_count(valid, lo, hi)
         return Column(DType(TypeId.INT64), self._unsort(wcnt), None)
 
     @func_range("window_rolling_mean")
     def rolling_mean(self, col_idx: int, preceding: int,
-                     following: int = 0) -> Column:
+                     following: int = 0, frame: str = "rows") -> Column:
         """AVG over the rolling frame (FLOAT64, decimal-rescaled like the
         groupby mean contract)."""
-        c, wsum, wcnt = self._rolling_parts(col_idx, preceding, following)
+        c, wsum, wcnt = self._rolling_parts(col_idx, preceding,
+                                            following, frame)
         denom = jnp.maximum(wcnt, 1).astype(jnp.float64)
         m = wsum.astype(jnp.float64) / denom
         if c.dtype.is_decimal:
@@ -271,7 +372,8 @@ class Window:
 
     @func_range("window_rolling_var")
     def rolling_var(self, col_idx: int, preceding: int,
-                    following: int = 0, ddof: int = 1) -> Column:
+                    following: int = 0, ddof: int = 1,
+                    frame: str = "rows") -> Column:
         """VARIANCE over the ROWS frame (cuDF rolling VAR; Spark windowed
         var_samp at ddof=1, var_pop at ddof=0). Frames are centered
         around the PARTITION mean before squaring, so the
@@ -285,7 +387,7 @@ class Window:
         two-pass instead). FLOAT64 output (f32-pair emulation posture)."""
         if ddof not in (0, 1):
             raise ValueError("ddof must be 0 (population) or 1 (sample)")
-        lo, hi = self._frame_bounds(preceding, following)
+        lo, hi = self._bounds(preceding, following, frame)
         c = self._sorted.column(col_idx)
         if c.dtype.is_string or c.dtype.is_decimal128 or \
                 c.dtype.storage_dtype.kind not in ("i", "u", "f"):
@@ -319,29 +421,33 @@ class Window:
 
     @func_range("window_rolling_std")
     def rolling_std(self, col_idx: int, preceding: int,
-                    following: int = 0, ddof: int = 1) -> Column:
-        """STDDEV over the ROWS frame (sqrt of rolling_var)."""
-        v = self.rolling_var(col_idx, preceding, following, ddof)
+                    following: int = 0, ddof: int = 1,
+                    frame: str = "rows") -> Column:
+        """STDDEV over the frame (sqrt of rolling_var)."""
+        v = self.rolling_var(col_idx, preceding, following, ddof, frame)
         return Column(v.dtype, jnp.sqrt(v.data), v.validity)
 
     @func_range("window_rolling_min")
     def rolling_min(self, col_idx: int, preceding: int,
-                    following: int = 0) -> Column:
+                    following: int = 0, frame: str = "rows") -> Column:
         """MIN over the ROWS frame — sparse-table range-minimum (doubling
         levels at power-of-two strides, two overlapping block gathers per
         row), O(n log w) with zero scatters; a sliding extremum has no
         prefix-difference form the way sums do."""
-        return self._rolling_extremum(col_idx, preceding, following, "min")
+        return self._rolling_extremum(col_idx, preceding, following,
+                                      "min", frame)
 
     @func_range("window_rolling_max")
     def rolling_max(self, col_idx: int, preceding: int,
-                    following: int = 0) -> Column:
+                    following: int = 0, frame: str = "rows") -> Column:
         """MAX over the ROWS frame (see rolling_min for the design)."""
-        return self._rolling_extremum(col_idx, preceding, following, "max")
+        return self._rolling_extremum(col_idx, preceding, following,
+                                      "max", frame)
 
     def _rolling_extremum(self, col_idx: int, preceding: int,
-                          following: int, op: str) -> Column:
-        lo, hi = self._frame_bounds(preceding, following)
+                          following: int, op: str,
+                          frame: str = "rows") -> Column:
+        lo, hi = self._bounds(preceding, following, frame)
         c = self._sorted.column(col_idx)
         if c.dtype.is_string or c.dtype.is_decimal128:
             raise NotImplementedError(
@@ -352,8 +458,10 @@ class Window:
         vv = jnp.where(valid, c.data, jnp.asarray(sentinel, c.data.dtype))
         pick = jnp.minimum if op == "min" else jnp.maximum
         # levels[l][i] = extremum of vv[i : i + 2^l], enough levels to
-        # cover the widest possible frame (static bound w)
-        w = preceding + following + 1
+        # cover the widest possible frame: the row budget for ROWS
+        # frames, the whole table for RANGE frames (a value window may
+        # span arbitrarily many rows)
+        w = preceding + following + 1 if frame == "rows" else max(n, 1)
         nlev = max(1, min(w, max(n, 1)).bit_length())
         levels = [vv]
         for lev in range(nlev - 1):

@@ -360,7 +360,9 @@ def distributed_window(
     ``("nth_value", col_idx, k)``, and
     ``("rolling_<sum|count|mean|min|max>", col_idx, preceding,
     following)``, and ``("rolling_<var|std>", col_idx, preceding,
-    following[, ddof])``. Results come back sharded, aligned to
+    following[, ddof])``, and value-based RANGE frames as
+    ``("rolling_<sum|count|mean|min|max>_range", col_idx, preceding,
+    following)``. Results come back sharded, aligned to
     the shuffled rows; filter output by the returned ``row_valid``.
 
     ``row_valid`` is REQUIRED (use ``shard_table(...,
@@ -408,6 +410,11 @@ def distributed_window(
                           "rolling_min", "rolling_max"):
                 out_cols.append(getattr(w, kind)(
                     spec[1] + 1, spec[2], spec[3]))
+            elif kind in ("rolling_sum_range", "rolling_count_range",
+                          "rolling_mean_range", "rolling_min_range",
+                          "rolling_max_range"):
+                out_cols.append(getattr(w, kind[:-6])(
+                    spec[1] + 1, spec[2], spec[3], frame="range"))
             elif kind in ("rolling_var", "rolling_std"):
                 # optional trailing ddof (default 1 = sample)
                 out_cols.append(getattr(w, kind)(

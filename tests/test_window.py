@@ -327,7 +327,7 @@ def test_distributed_window_new_specs_match_local(rng):
              ("first_value", 2), ("last_value", 2), ("nth_value", 2, 2),
              ("rolling_sum", 2, 2, 1), ("rolling_min", 2, 2, 1),
              ("rolling_max", 2, 1, 0), ("rolling_var", 2, 2, 1),
-             ("rolling_std", 2, 3, 1, 0)]
+             ("rolling_std", 2, 3, 1, 0), ("rolling_sum_range", 2, 2, 2)]
     dw = distributed_window(sharded, [0], [1], specs, mesh, rv,
                             capacity=n)
     assert not np.asarray(dw.overflowed).any()
@@ -346,6 +346,8 @@ def test_distributed_window_new_specs_match_local(rng):
         ("rolling_var", 2, 2, 1): w.rolling_var(2, 2, 1).to_pylist(),
         ("rolling_std", 2, 3, 1, 0): w.rolling_std(
             2, 3, 1, 0).to_pylist(),
+        ("rolling_sum_range", 2, 2, 2): w.rolling_sum(
+            2, 2, 2, frame="range").to_pylist(),
     }
     import collections
 
@@ -439,3 +441,85 @@ def test_rolling_var_rejects_bad_inputs():
     with pytest.raises(ValueError, match="ddof"):
         Window(tbl2, partition_by=[0], order_by=[1]).rolling_var(
             2, 1, 0, ddof=2)
+
+
+def test_range_frames_vs_oracle(rng):
+    """RANGE BETWEEN p PRECEDING AND f FOLLOWING (value-based bounds)
+    vs brute force: frame = same-partition rows with order value in
+    [v-p, v+f]; null order values frame over the partition's null run."""
+    n = 240
+    part = rng.integers(0, 5, n).astype(np.int64)
+    orderv = rng.integers(0, 60, n).astype(np.int64)
+    ovalid = rng.random(n) > 0.12
+    vals = rng.integers(-40, 40, n).astype(np.int64)
+    tbl = Table([
+        Column.from_numpy(part),
+        Column.from_numpy(orderv, validity=ovalid),
+        Column.from_numpy(vals),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    for p, f in ((5, 0), (0, 5), (3, 3), (0, 0)):
+        got_sum = w.rolling_sum(2, p, f, frame="range").to_pylist()
+        got_cnt = w.rolling_count(2, p, f, frame="range").to_pylist()
+        got_mn = w.rolling_min(2, p, f, frame="range").to_pylist()
+        got_mx = w.rolling_max(2, p, f, frame="range").to_pylist()
+        for i in range(n):
+            if ovalid[i]:
+                sel = [int(vals[j]) for j in range(n)
+                       if part[j] == part[i] and ovalid[j]
+                       and orderv[i] - p <= orderv[j] <= orderv[i] + f]
+            else:
+                sel = [int(vals[j]) for j in range(n)
+                       if part[j] == part[i] and not ovalid[j]]
+            assert got_cnt[i] == len(sel), (p, f, i)
+            if sel:
+                assert got_sum[i] == sum(sel), (p, f, i)
+                assert got_mn[i] == min(sel), (p, f, i)
+                assert got_mx[i] == max(sel), (p, f, i)
+            else:
+                assert got_sum[i] is None
+
+
+def test_range_frame_validation():
+    tbl = Table([
+        Column.from_numpy(np.zeros(3, np.int64)),
+        Column.from_numpy(np.arange(3, dtype=np.int32)),
+        Column.from_numpy(np.arange(3, dtype=np.int64)),
+    ])
+    w2 = Window(tbl, partition_by=[0], order_by=[1, 2])
+    with pytest.raises(ValueError, match="exactly one"):
+        w2.rolling_sum(2, 1, 0, frame="range")
+    wd = Window(tbl, partition_by=[0], order_by=[1], ascending=[False])
+    with pytest.raises(NotImplementedError, match="ascending"):
+        wd.rolling_sum(2, 1, 0, frame="range")
+    w1 = Window(tbl, partition_by=[0], order_by=[1])
+    with pytest.raises(ValueError, match="frame"):
+        w1.rolling_sum(2, 1, 0, frame="groups")
+
+
+def test_range_frame_decimal_and_nan_postures():
+    # decimal order key: bounds rescale exactly or refuse
+    tbl = Table([
+        Column.from_numpy(np.zeros(4, np.int64)),
+        Column.from_numpy(np.array([100, 200, 300, 700], np.int64),
+                          t.decimal64(-2)),  # 1.00 2.00 3.00 7.00
+        Column.from_numpy(np.array([1, 2, 3, 4], np.int64)),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    got = w.rolling_sum(2, 1, 0, frame="range").to_pylist()
+    # window of 1.00 in VALUE terms: [1+2, 1+2+3... wait per-row:
+    # row1: [1]; row2: [1,2]; row3: [2,3]; row7: [4]
+    assert got == [1, 3, 5, 4]
+    with pytest.raises(ValueError, match="not representable"):
+        w.rolling_sum(2, 0.005, 0, frame="range")
+    # NaN order rows frame over the NaN peer run
+    tbl2 = Table([
+        Column.from_numpy(np.zeros(4, np.int64)),
+        Column.from_numpy(np.array([1.0, 2.0, np.nan, np.nan])),
+        Column.from_numpy(np.array([10, 20, 30, 40], np.int64)),
+    ])
+    w2 = Window(tbl2, partition_by=[0], order_by=[1])
+    got2 = w2.rolling_sum(2, 1, 0, frame="range").to_pylist()
+    cnt2 = w2.rolling_count(2, 1, 0, frame="range").to_pylist()
+    assert got2 == [10, 30, 70, 70]
+    assert cnt2 == [1, 2, 2, 2]
