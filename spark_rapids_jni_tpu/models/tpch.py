@@ -1235,3 +1235,191 @@ def tpch_q12_distributed(orders: Table, lineitem: Table, mesh,
     from spark_rapids_jni_tpu.ops.table_ops import trim_table
 
     return trim_table(srt, k)
+
+
+# ---------------------------------------------------------------------------
+# q4 — order priority checking (EXISTS -> left-semi join + groupby)
+# ---------------------------------------------------------------------------
+
+# q4 orders columns
+O4_ORDERKEY, O4_ORDERDATE, O4_ORDERPRIORITY = 0, 1, 2
+_Q4_QTR_START = 8582   # 1993-07-01
+_Q4_QTR_END = 8674     # 1993-10-01
+
+
+def orders_q4_table(num_rows: int, seed: int = 8) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(np.arange(1, num_rows + 1, dtype=np.int64)),
+        Column.from_numpy(
+            rng.integers(8400, 8800, num_rows).astype(np.int32),
+            t.TIMESTAMP_DAYS),
+        Column.from_pylist(
+            [_Q12_PRIORITIES[i]
+             for i in rng.integers(0, len(_Q12_PRIORITIES), num_rows)],
+            t.STRING),
+    ])
+
+
+class Q4Result(NamedTuple):
+    result: GroupByResult   # [o_orderpriority, order_count]
+    join_total: jnp.ndarray
+
+
+@func_range("tpch_q4")
+def tpch_q4(orders: Table, lineitem: Table,
+            qtr_start: int = _Q4_QTR_START,
+            qtr_end: int = _Q4_QTR_END) -> Q4Result:
+    """q4: orders in the quarter with EXISTS(lineitem late delivery),
+    counted per priority — the EXISTS lowers to a LEFT-SEMI join (the
+    round-4 join surface), then a string-key groupby."""
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+
+    od = orders.column(O4_ORDERDATE)
+    keep_o = (od.valid_mask()
+              & (od.data >= jnp.int32(qtr_start))
+              & (od.data < jnp.int32(qtr_end)))
+    probe = Table([
+        _null_where(orders.column(O4_ORDERKEY), ~keep_o),
+        orders.column(O4_ORDERPRIORITY),
+    ])
+    commit_c = lineitem.column(L12_COMMITDATE)
+    receipt_c = lineitem.column(L12_RECEIPTDATE)
+    late = (commit_c.valid_mask() & receipt_c.valid_mask()
+            & (commit_c.data < receipt_c.data))
+    build = Table([
+        _null_where(lineitem.column(L12_ORDERKEY), ~late),
+    ])
+    maps = join(probe, build, 0, 0, out_size=orders.num_rows,
+                how="left_semi")
+    j = apply_join_maps(probe, build, maps)
+    matched = maps.row_valid
+    keyed = Table([
+        _null_where(j.column(1), ~matched),
+        Column(t.INT64, jnp.where(matched, jnp.int64(1), jnp.int64(0)),
+               matched),
+    ])
+    g = groupby_aggregate(keyed, keys=[0], aggs=[(1, "sum")])
+    srt = sort_table(g.table, [0], nulls_first=[False])
+    return Q4Result(GroupByResult(srt, g.num_groups), maps.total)
+
+
+def tpch_q4_numpy(orders: Table, lineitem: Table,
+                  qtr_start: int = _Q4_QTR_START,
+                  qtr_end: int = _Q4_QTR_END) -> dict:
+    late_keys = set()
+    lkey = np.asarray(lineitem.column(L12_ORDERKEY).data).tolist()
+    commit = np.asarray(lineitem.column(L12_COMMITDATE).data).tolist()
+    receipt = np.asarray(lineitem.column(L12_RECEIPTDATE).data).tolist()
+    for i in range(lineitem.num_rows):
+        if commit[i] < receipt[i]:
+            late_keys.add(lkey[i])
+    out: dict = {}
+    okey = np.asarray(orders.column(O4_ORDERKEY).data).tolist()
+    odate = np.asarray(orders.column(O4_ORDERDATE).data).tolist()
+    prio = orders.column(O4_ORDERPRIORITY).to_pylist()
+    for i in range(orders.num_rows):
+        if not qtr_start <= odate[i] < qtr_end:
+            continue
+        if okey[i] in late_keys:
+            out[prio[i]] = out.get(prio[i], 0) + 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# q17 — small-quantity-order revenue (correlated AVG subquery ->
+# groupby mean + join + filtered exact sum)
+# ---------------------------------------------------------------------------
+
+
+class Q17Result(NamedTuple):
+    yearly_total: jnp.ndarray    # int64 unscaled decimal(-2) * 10 (sum/0.7... see ratio)
+    join_total: jnp.ndarray
+
+    def avg_yearly(self) -> float:
+        """sum(l_extendedprice)/7.0 in display units."""
+        return int(self.yearly_total) / 100.0 / 7.0
+
+
+@func_range("tpch_q17")
+def tpch_q17(part: Table, lineitem: Table,
+             brand: str = "Brand#23", container: str = "MED BOX") -> Q17Result:
+    """q17: lineitem x part filtered to one brand/container, keeping rows
+    with l_quantity < 0.2 * avg(l_quantity) OVER the part — the
+    correlated subquery lowers to a per-part groupby mean joined back
+    (two joins on partkey share the rank encoding), then an exact sum."""
+    from spark_rapids_jni_tpu.ops import strings as s
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+
+    sel_part = ((s.like(part.column(P_BRAND), brand).data != 0)
+                & (s.like(part.column(P_CONTAINER), container).data != 0)
+                & part.column(P_PARTKEY).valid_mask())
+    build = Table([
+        _null_where(part.column(P_PARTKEY), ~sel_part),
+    ])
+    n = lineitem.num_rows
+    probe = Table([lineitem.column(L19_PARTKEY)])
+    maps = join(probe, build, 0, 0, out_size=n)
+    li = jnp.clip(maps.left_index, 0, max(n - 1, 0))
+    j = apply_join_maps(probe, build, maps)
+    matched = j.column(1).valid_mask() & maps.row_valid
+
+    qty_c = lineitem.column(L19_QUANTITY)
+    price_c = lineitem.column(L19_EXTENDEDPRICE)
+    qty = qty_c.data[li]
+    price = price_c.data[li]
+    lane_ok = (qty_c.valid_mask() & price_c.valid_mask())[li] & matched
+
+    # per-part avg quantity over the SELECTED rows: groupby mean on the
+    # joined rows (keys = partkey), then gathered back via a second
+    # join... the rows are already part-grouped by the join maps, so a
+    # direct segmented mean over sorted partkeys does it in one pass
+    keyed = Table([
+        _null_where(Column(j.column(0).dtype, j.column(0).data,
+                           j.column(0).valid_mask()), ~lane_ok),
+        Column(qty_c.dtype, qty, lane_ok),
+    ])
+    g = groupby_aggregate(keyed, keys=[0], aggs=[(1, "mean")])
+    # map each row to its group's mean: join rows back on partkey
+    gt = g.table
+    m2 = join(keyed, gt, 0, 0, out_size=n)
+    li2 = jnp.clip(m2.left_index, 0, max(n - 1, 0))
+    j2 = apply_join_maps(keyed, gt, m2)
+    # j2: [l_partkey, l_quantity, g_partkey, g_mean]
+    ok2 = j2.column(2).valid_mask() & m2.row_valid
+    q2 = j2.column(1)
+    mean2 = j2.column(3)
+    # l_quantity < 0.2 * avg: quantity is decimal(-2) -> value*100;
+    # mean is FLOAT64 in VALUE units
+    pred = (q2.data.astype(jnp.float64)
+            < 0.2 * mean2.data * 100.0) & ok2 & q2.valid_mask()
+    price2 = price_c.data[li][li2]
+    price_ok = price_c.valid_mask()[li][li2]
+    total = jnp.sum(jnp.where(pred & price_ok, price2, 0))
+    return Q17Result(total, maps.total)
+
+
+def tpch_q17_numpy(part: Table, lineitem: Table,
+                   brand: str = "Brand#23",
+                   container: str = "MED BOX") -> int:
+    sel = set()
+    pk = np.asarray(part.column(P_PARTKEY).data).tolist()
+    pb = part.column(P_BRAND).to_pylist()
+    pc = part.column(P_CONTAINER).to_pylist()
+    for i in range(part.num_rows):
+        if pb[i] == brand and pc[i] == container:
+            sel.add(pk[i])
+    lkey = np.asarray(lineitem.column(L19_PARTKEY).data).tolist()
+    qty = np.asarray(lineitem.column(L19_QUANTITY).data).tolist()
+    price = np.asarray(lineitem.column(L19_EXTENDEDPRICE).data).tolist()
+    by_part: dict = {}
+    for i in range(lineitem.num_rows):
+        if lkey[i] in sel:
+            by_part.setdefault(lkey[i], []).append(i)
+    total = 0
+    for k, rows in by_part.items():
+        avg = sum(qty[i] for i in rows) / len(rows)
+        for i in rows:
+            if qty[i] < 0.2 * avg:
+                total += price[i]
+    return total

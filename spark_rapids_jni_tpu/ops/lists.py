@@ -542,27 +542,30 @@ def sequence(start: Column, stop: Column, step: Column | int = 1,
     raises like Spark's ILLEGAL_SEQUENCE_BOUNDARIES; step 0 is rejected
     up front; null operands give a null row (Spark null propagation)."""
     if isinstance(step, int):
-        if step == 0:
-            raise ValueError("sequence step must be non-zero")
         step_data = jnp.full((start.size,), step, jnp.int64)
         step_valid = jnp.ones((start.size,), jnp.bool_)
     else:
         step_data = step.data.astype(jnp.int64)
-        if bool(jnp.any(step.valid_mask() & (step_data == 0))):
-            raise ValueError("sequence step must be non-zero")
         step_valid = step.valid_mask()
     a = start.data.astype(jnp.int64)
     b = stop.data.astype(jnp.int64)
     ok = start.valid_mask() & stop.valid_mask() & step_valid
-    right_dir = jnp.where(step_data > 0, b >= a, b <= a)
+    # Spark's rule: a zero step is legal ONLY when start == stop (the
+    # single-element sequence); otherwise, and for steps moving away
+    # from stop, ILLEGAL_SEQUENCE_BOUNDARIES
+    zero_ok = (step_data == 0) & (a == b)
+    right_dir = jnp.where(step_data > 0, b >= a,
+                          jnp.where(step_data < 0, b <= a, a == b))
     if bool(jnp.any(ok & ~right_dir)):
         raise ValueError(
-            "sequence step moves away from stop (Spark "
-            "ILLEGAL_SEQUENCE_BOUNDARIES)")
+            "sequence step moves away from stop (or is zero with "
+            "start != stop) — Spark ILLEGAL_SEQUENCE_BOUNDARIES")
     safe_step = jnp.where(step_data == 0, jnp.int64(1), step_data)
-    lens = jnp.where(ok & right_dir,
-                     jnp.floor_divide(b - a, safe_step) + 1,
-                     jnp.int64(0))
+    lens = jnp.where(
+        ok & right_dir,
+        jnp.where(zero_ok, jnp.int64(1),
+                  jnp.floor_divide(b - a, safe_step) + 1),
+        jnp.int64(0))
     too_long = bool(jnp.any(lens > max_length))
     if too_long:
         raise ValueError(

@@ -249,18 +249,32 @@ class Window:
                 f"{oc.dtype}")
         if oc.dtype.is_decimal:
             # bounds are VALUE distances: rescale to unscaled units
-            # exactly, or refuse (a silent unscaled interpretation would
-            # shrink the window by 10^scale)
+            # exactly (via Fraction — float multiply would falsely
+            # reject exactly-representable bounds like 0.29 at scale
+            # -2), or refuse
+            from fractions import Fraction
+
             factor = 10 ** (-oc.dtype.scale)
+            scaled = []
             for name, b in (("preceding", preceding),
                             ("following", following)):
-                if (b * factor) != int(b * factor):
+                fb = Fraction(str(b)) * factor
+                if fb.denominator != 1:
                     raise ValueError(
                         f"RANGE {name}={b} is not representable at "
                         f"{oc.dtype} scale")
-            preceding = int(preceding * factor)
-            following = int(following * factor)
+                scaled.append(int(fb))
+            preceding, following = scaled
         v = oc.data
+        if oc.dtype.storage_dtype.kind == "u":
+            if oc.dtype.storage_dtype.itemsize == 8:
+                raise NotImplementedError(
+                    "RANGE frames on uint64 ORDER BY keys (bound "
+                    "arithmetic would wrap)")
+            v = v.astype(jnp.int64)
+        elif oc.dtype.storage_dtype.kind == "i" and \
+                oc.dtype.storage_dtype.itemsize < 8:
+            v = v.astype(jnp.int64)  # headroom for v ± bound
         is_null = ~oc.valid_mask()
         # per-partition null-run length (nulls sort first)
         nrun = _segmented_sum_scan(

@@ -321,8 +321,12 @@ def test_sequence_vs_python():
     down_a = Column.from_pylist([5, 3], t.INT64)
     down_b = Column.from_pylist([1, 1], t.INT64)
     assert sequence(down_a, down_b, -2).to_pylist() == [[5, 3, 1], [3, 1]]
-    with pytest.raises(ValueError, match="non-zero"):
-        sequence(a, b, 0)
+    # zero step: legal only when start == stop (Spark)
+    eq = Column.from_pylist([5, 7], t.INT64)
+    assert sequence(eq, eq, 0).to_pylist() == [[5], [7]]
+    with pytest.raises(ValueError, match="ILLEGAL_SEQUENCE"):
+        sequence(Column.from_pylist([1], t.INT64),
+                 Column.from_pylist([2], t.INT64), 0)
     big = Column.from_pylist([0], t.INT64)
     with pytest.raises(ValueError, match="max_length"):
         sequence(big, Column.from_pylist([10**6], t.INT64), 1)

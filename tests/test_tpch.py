@@ -442,3 +442,41 @@ def test_tpch_q12_distributed_matches_numpy():
     got = {k: [h, lo] for k, h, lo in zip(kcol, hcol, lcol)
            if k is not None}
     assert got == want
+
+
+def test_tpch_q4_vs_numpy():
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q12_table,
+        orders_q4_table,
+        tpch_q4,
+        tpch_q4_numpy,
+    )
+
+    orders = orders_q4_table(400)
+    lineitem = lineitem_q12_table(1200, 500)
+    res = tpch_q4(orders, lineitem)
+    want = tpch_q4_numpy(orders, lineitem)
+    m = int(res.result.num_groups)
+    tbl = res.result.table
+    got = {k: v for k, v in zip(tbl.column(0).to_pylist()[:m],
+                                tbl.column(1).to_pylist()[:m])
+           if k is not None}
+    assert got == want
+    assert want  # the synthetic quarter must actually select orders
+
+
+def test_tpch_q17_vs_numpy():
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q19_table,
+        part_table,
+        tpch_q17,
+        tpch_q17_numpy,
+    )
+
+    part = part_table(120)
+    lineitem = lineitem_q19_table(3000, 120)
+    res = tpch_q17(part, lineitem)
+    want = tpch_q17_numpy(part, lineitem)
+    assert int(res.yearly_total) == want
+    assert want > 0
+    assert res.avg_yearly() == want / 100.0 / 7.0

@@ -523,3 +523,32 @@ def test_range_frame_decimal_and_nan_postures():
     cnt2 = w2.rolling_count(2, 1, 0, frame="range").to_pylist()
     assert got2 == [10, 30, 70, 70]
     assert cnt2 == [1, 2, 2, 2]
+
+
+def test_range_frame_narrow_and_unsigned_keys():
+    # int32 key near the dtype edge: bound arithmetic must not wrap
+    tbl = Table([
+        Column.from_numpy(np.zeros(3, np.int64)),
+        Column.from_numpy(
+            np.array([2**31 - 3, 2**31 - 2, 2**31 - 1], np.int32)),
+        Column.from_numpy(np.array([1, 2, 4], np.int64)),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    assert w.rolling_sum(2, 1, 1, frame="range").to_pylist() == [3, 7, 6]
+    # uint32 keys near zero: v - preceding must not wrap around
+    tbl2 = Table([
+        Column.from_numpy(np.zeros(3, np.int64)),
+        Column.from_numpy(np.array([0, 1, 10], np.uint32)),
+        Column.from_numpy(np.array([5, 6, 7], np.int64)),
+    ])
+    w2 = Window(tbl2, partition_by=[0], order_by=[1])
+    assert w2.rolling_sum(2, 5, 0, frame="range").to_pylist() == \
+        [5, 11, 7]
+    # decimal bound 0.29 at scale -2 is exactly representable
+    tbl3 = Table([
+        Column.from_numpy(np.zeros(2, np.int64)),
+        Column.from_numpy(np.array([100, 129], np.int64), t.decimal64(-2)),
+        Column.from_numpy(np.array([1, 2], np.int64)),
+    ])
+    w3 = Window(tbl3, partition_by=[0], order_by=[1])
+    assert w3.rolling_sum(2, 0.29, 0, frame="range").to_pylist() == [1, 3]
