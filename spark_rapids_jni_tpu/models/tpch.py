@@ -1368,16 +1368,17 @@ def tpch_q17(part: Table, lineitem: Table,
     price_c = lineitem.column(L19_EXTENDEDPRICE)
     qty = qty_c.data[li]
     price = price_c.data[li]
-    lane_ok = (qty_c.valid_mask() & price_c.valid_mask())[li] & matched
+    # the correlated AVG(l_quantity) is over every selected row with a
+    # non-null QUANTITY — price nulls only drop rows from the final sum
+    avg_ok = qty_c.valid_mask()[li] & matched
 
     # per-part avg quantity over the SELECTED rows: groupby mean on the
     # joined rows (keys = partkey), then gathered back via a second
-    # join... the rows are already part-grouped by the join maps, so a
-    # direct segmented mean over sorted partkeys does it in one pass
+    # join (the correlated-subquery lowering)
     keyed = Table([
         _null_where(Column(j.column(0).dtype, j.column(0).data,
-                           j.column(0).valid_mask()), ~lane_ok),
-        Column(qty_c.dtype, qty, lane_ok),
+                           j.column(0).valid_mask()), ~avg_ok),
+        Column(qty_c.dtype, qty, avg_ok),
     ])
     g = groupby_aggregate(keyed, keys=[0], aggs=[(1, "mean")])
     # map each row to its group's mean: join rows back on partkey

@@ -589,3 +589,151 @@ def sequence(start: Column, stop: Column, step: Column | int = 1,
                         and stop.validity is None
                         and not isinstance(step, Column)) else ok
     return Column(DType(TypeId.LIST), offsets, validity, children=[child])
+
+
+def _list_ranges(col: Column):
+    off = col.data.astype(jnp.int32)
+    return off[:-1], off[1:]
+
+
+@func_range("array_sum")
+def array_sum(col: Column) -> Column:
+    """Per-list SUM of numeric elements (nulls skipped; empty/all-null
+    lists null — the aggregate posture)."""
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(f"array_sum needs a LIST column, got {col.dtype}")
+    child = col.children[0]
+    if child.dtype.is_string or child.dtype.is_decimal128:
+        raise TypeError("array_sum needs numeric elements")
+    valid = child.valid_mask()
+    vv = jnp.where(valid, child.data, jnp.zeros_like(child.data))
+    from spark_rapids_jni_tpu.ops.groupby import _sum_dtype
+
+    acc_dt = _sum_dtype(child.dtype)
+    acc = vv.astype(jnp.int64) if acc_dt.storage_dtype.kind in ("i", "u") \
+        else vv.astype(jnp.float64)
+    pref = jnp.concatenate(
+        [jnp.zeros((1,), acc.dtype), jnp.cumsum(acc)])
+    cpref = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64),
+         jnp.cumsum(valid.astype(jnp.int64))])
+    lo, hi = _list_ranges(col)
+    total = (pref[hi] - pref[lo]).astype(acc_dt.jnp_dtype)
+    cnt = cpref[hi] - cpref[lo]
+    return Column(acc_dt, total, col.valid_mask() & (cnt > 0))
+
+
+def _array_extremum(col: Column, op: str) -> Column:
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(f"array_{op} needs a LIST column, got {col.dtype}")
+    child = col.children[0]
+    if child.dtype.is_string or child.dtype.is_decimal128:
+        raise NotImplementedError(f"array_{op} on non-fixed-width elements")
+    child_n = int(child.size)
+    n = col.size
+    lo, hi = _list_ranges(col)
+    if child_n == 0:
+        return Column(child.dtype,
+                      jnp.zeros((n,), child.dtype.jnp_dtype),
+                      jnp.zeros((n,), jnp.bool_))
+    import numpy as _np
+
+    dt = child.dtype.storage_dtype
+    if dt.kind == "f":
+        sentinel = jnp.inf if op == "min" else -jnp.inf
+    else:
+        info = _np.iinfo(dt)
+        sentinel = info.max if op == "min" else info.min
+    vv = jnp.where(child.valid_mask(), child.data,
+                   jnp.asarray(sentinel, child.data.dtype))
+    if dt.kind == "f":
+        # Spark orders NaN greatest: array_max with any NaN is NaN,
+        # array_min skips NaNs (unless every element is NaN). Map NaN
+        # to +inf for the scan, then restore NaN where +inf won
+        # (documented ambiguity with a genuine +inf element).
+        vv = jnp.where(jnp.isnan(vv), jnp.inf, vv)
+    pick = jnp.minimum if op == "min" else jnp.maximum
+    # suffix-scan sparse table over the flat child (the rolling-extremum
+    # idiom at list granularity): levels cover the longest list
+    max_len = int(jnp.max(hi - lo)) if n else 1
+    nlev = max(1, max(max_len, 1).bit_length())
+    idx = jnp.arange(child_n, dtype=jnp.int32)
+    levels = [vv]
+    for lev in range(nlev - 1):
+        off = 1 << lev
+        levels.append(pick(
+            levels[-1],
+            levels[-1][jnp.clip(idx + off, 0, child_n - 1)]))
+    stacked = jnp.stack(levels)
+    length = jnp.maximum(hi - lo, 1)
+    k = jnp.zeros((n,), jnp.int32)
+    for lev in range(1, nlev):
+        k = k + (length >= (1 << lev)).astype(jnp.int32)
+    span = jnp.left_shift(jnp.int32(1), k)
+    c32 = lambda i: jnp.clip(i, 0, child_n - 1).astype(jnp.int32)
+    at_lo = stacked[:, c32(lo)]
+    at_hi = stacked[:, c32(hi - span)]
+    a = jnp.take_along_axis(at_lo, k[None, :], axis=0)[0]
+    b = jnp.take_along_axis(at_hi, k[None, :], axis=0)[0]
+    out = pick(a, b)
+    if dt.kind == "f":
+        out = jnp.where(jnp.isinf(out) & (out > 0), jnp.nan, out)
+    cnt = _range_any(child.valid_mask(), col.data)
+    return Column(child.dtype, out, col.valid_mask() & cnt)
+
+
+@func_range("array_min")
+def array_min(col: Column) -> Column:
+    """Per-list MIN (nulls skipped; empty/all-null lists null)."""
+    return _array_extremum(col, "min")
+
+
+@func_range("array_max")
+def array_max(col: Column) -> Column:
+    return _array_extremum(col, "max")
+
+
+@func_range("array_slice")
+def array_slice(col: Column, start: int, length: int) -> Column:
+    """Spark ``slice(arr, start, length)``: 1-based start (negative
+    counts from the end — a start beyond the head gives an EMPTY list),
+    ``length`` elements. Builds a dense compacted child via the
+    explode-style parent mapping (new offsets + one gather)."""
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(f"array_slice needs a LIST column, got {col.dtype}")
+    if start == 0:
+        raise ValueError("slice start is 1-based (non-zero)")
+    if length < 0:
+        raise ValueError("slice length must be >= 0")
+    lo, hi = _list_ranges(col)
+    lens = hi - lo
+    if start > 0:
+        s0 = lo + (start - 1)
+    else:
+        # Spark: a negative start beyond the list head yields an EMPTY
+        # slice, not a clamped one
+        cand = hi + start
+        s0 = jnp.where(cand >= lo, cand, hi)
+    s0 = jnp.minimum(s0, hi)
+    e0 = jnp.minimum(s0 + length, hi)
+    new_lens = jnp.maximum(e0 - s0, 0)
+    # rebuild offsets for a COMPACT child: gather kept elements densely
+    # (explode-style parent mapping over the kept ranges)
+    n = col.size
+    new_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64),
+         jnp.cumsum(new_lens.astype(jnp.int64))])
+    child = col.children[0]
+    child_n = int(child.size)
+    out_n = child_n  # static bound
+    k = jnp.arange(out_n, dtype=jnp.int64)
+    parent = jnp.clip(
+        jnp.searchsorted(new_off, k, side="right") - 1, 0,
+        max(n - 1, 0)).astype(jnp.int32)
+    j = k - new_off[parent]
+    live = k < new_off[-1]
+    src = jnp.clip(s0[parent] + j.astype(jnp.int32), 0,
+                   max(child_n - 1, 0))
+    new_child = _gather_any(child, src, live)
+    return Column(col.dtype, new_off.astype(jnp.int32), col.validity,
+                  children=[new_child])

@@ -293,9 +293,19 @@ class Window:
             nanc = nanrun[jnp.clip(self._p_end, 0,
                                    max(self._n - 1, 0))]
             valid_end = valid_end - nanc
-        lo = self._bounded_search(v, v - preceding, valid_start,
+        # saturating bound arithmetic: int64 keys near the dtype edge
+        # must not wrap (narrow ints were widened above; uint64 is
+        # rejected)
+        lo_t = v - preceding
+        hi_t = v + following
+        if oc.dtype.storage_dtype.kind in ("i", "u"):
+            lo_t = jnp.where((preceding > 0) & (lo_t > v),
+                             jnp.iinfo(jnp.int64).min, lo_t)
+            hi_t = jnp.where((following > 0) & (hi_t < v),
+                             jnp.iinfo(jnp.int64).max, hi_t)
+        lo = self._bounded_search(v, lo_t, valid_start,
                                   valid_end, side_left=True)
-        hi = self._bounded_search(v, v + following, valid_start,
+        hi = self._bounded_search(v, hi_t, valid_start,
                                   valid_end, side_left=False) - 1
         # null-order rows frame over the null run; NaN rows over theirs
         lo = jnp.where(is_null, self._p_start, lo)

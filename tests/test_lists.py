@@ -342,3 +342,78 @@ def test_sequence_explodes():
     ex = explode(tbl, 1)
     rows = _exploded_rows(ex, 2)
     assert rows == [(1, 10), (1, 11), (1, 12), (2, 20)]
+
+
+def test_array_sum_min_max_vs_python(rng):
+    from spark_rapids_jni_tpu.ops.lists import (
+        array_max,
+        array_min,
+        array_sum,
+    )
+
+    lists = []
+    for _ in range(200):
+        r = rng.random()
+        if r < 0.1:
+            lists.append(None)
+        else:
+            lists.append([None if rng.random() < 0.15 else
+                          int(v) for v in
+                          rng.integers(-50, 50, rng.integers(0, 7))])
+    lc = make_list_column(lists, t.INT64)
+    gs = array_sum(lc).to_pylist()
+    gm = array_min(lc).to_pylist()
+    gx = array_max(lc).to_pylist()
+    for lst, s_, m_, x_ in zip(lists, gs, gm, gx):
+        if lst is None:
+            assert s_ is None and m_ is None and x_ is None
+            continue
+        sel = [v for v in lst if v is not None]
+        if sel:
+            assert (s_, m_, x_) == (sum(sel), min(sel), max(sel)), lst
+        else:
+            assert s_ is None and m_ is None and x_ is None
+
+
+def test_array_slice_vs_python():
+    from spark_rapids_jni_tpu.ops.lists import array_slice
+
+    lists = [[1, 2, 3, 4, 5], [], None, [9], [7, 8]]
+    lc = make_list_column(lists, t.INT64)
+
+    def oracle(lst, start, length):
+        if lst is None:
+            return None
+        if start > 0:
+            i = start - 1
+        else:
+            i = len(lst) + start
+            if i < 0:
+                return []   # Spark: |start| beyond the head -> empty
+        return lst[i:i + length]
+
+    for start, length in ((2, 2), (1, 10), (-2, 2), (4, 1), (-1, 1),
+                          (-4, 2)):
+        got = array_slice(lc, start, length).to_pylist()
+        assert got == [oracle(v, start, length) for v in lists], \
+            (start, length)
+    with pytest.raises(ValueError, match="1-based"):
+        array_slice(lc, 0, 1)
+
+
+def test_array_min_max_nan_posture():
+    import math
+
+    from spark_rapids_jni_tpu.ops.lists import array_max, array_min
+
+    nan = float("nan")
+    lists = [[1.0, nan], [nan], [2.0, 3.0], []]
+    lc = make_list_column(lists, t.FLOAT64)
+    mn = array_min(lc).to_pylist()
+    mx = array_max(lc).to_pylist()
+    assert mn[0] == 1.0          # NaN skipped for min
+    assert math.isnan(mn[1])     # all-NaN -> NaN
+    assert mn[2] == 2.0 and mn[3] is None
+    assert math.isnan(mx[0])     # NaN is greatest -> max is NaN
+    assert math.isnan(mx[1])
+    assert mx[2] == 3.0

@@ -552,3 +552,16 @@ def test_range_frame_narrow_and_unsigned_keys():
     ])
     w3 = Window(tbl3, partition_by=[0], order_by=[1])
     assert w3.rolling_sum(2, 0.29, 0, frame="range").to_pylist() == [1, 3]
+
+
+def test_range_frame_int64_edge_saturates():
+    big = 2 ** 63 - 2
+    tbl = Table([
+        Column.from_numpy(np.zeros(3, np.int64)),
+        Column.from_numpy(np.array([big - 1, big, big + 1], np.int64)),
+        Column.from_numpy(np.array([1, 2, 4], np.int64)),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    # following=5 would wrap past int64 max without saturation
+    assert w.rolling_sum(2, 0, 5, frame="range").to_pylist() == [7, 6, 4]
+    assert w.rolling_sum(2, 5, 0, frame="range").to_pylist() == [1, 3, 7]
