@@ -234,16 +234,20 @@ def months_between(end: Column, start: Column,
     """Spark months_between(date1, date2): whole months plus a 31-day
     fractional remainder; exact integer when the days-of-month match or
     both are month-ends; rounded to 8 digits when ``round_off``.
-    FLOAT64 output. DATE-precision operands only: Spark counts
-    time-of-day in the 31-day fraction, so silently flooring a sub-day
-    timestamp would give wrong-vs-Spark answers — raise instead (the
-    date_add/add_months posture)."""
-    for c in (end, start):
-        if c.dtype.type_id != TypeId.TIMESTAMP_DAYS:
-            raise NotImplementedError(
-                "months_between needs TIMESTAMP_DAYS columns (sub-day "
-                "precision contributes to Spark's fraction)")
-    z1, z2 = _days_since_epoch(end), _days_since_epoch(start)
+    FLOAT64 output. Sub-day TIMESTAMP operands follow Spark's exact
+    formula: the day-of-month comparison uses the civil DATE, and the
+    fraction is (domDiff*86400 + secs1 - secs2) / (31*86400) with
+    seconds TRUNCATED from the sub-second precision (Spark's
+    MICROSECONDS.toSeconds). Mixed precisions are fine — both operands
+    reduce to (civil day, intraday seconds)."""
+    def _day_secs(c: Column):
+        z = _days_since_epoch(c)
+        if c.dtype.type_id == TypeId.TIMESTAMP_DAYS:
+            return z, jnp.zeros_like(z)
+        return z, _intraday(c, 86_400)
+
+    z1, s1 = _day_secs(end)
+    z2, s2 = _day_secs(start)
     y1, m1, d1 = civil_from_days(z1)
     y2, m2, d2 = civil_from_days(z2)
     months = ((y1 - y2) * 12 + (m1 - m2)).astype(jnp.float64)
@@ -256,7 +260,8 @@ def months_between(end: Column, start: Column,
 
     both_end = _is_month_end(y1, m1, d1, z1) & _is_month_end(y2, m2, d2, z2)
     same_dom = d1 == d2
-    frac = (d1 - d2).astype(jnp.float64) / 31.0
+    secs_diff = ((d1 - d2) * 86_400 + s1 - s2).astype(jnp.float64)
+    frac = secs_diff / (31.0 * 86_400.0)
     out = jnp.where(same_dom | both_end, months, months + frac)
     if round_off:
         out = jnp.round(out * 1e8) / 1e8

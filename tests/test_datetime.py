@@ -184,11 +184,29 @@ def test_next_day_vs_python():
             assert g == (want - epoch).days, (z, name)
 
 
-def test_months_between_rejects_subday_precision():
+def test_months_between_subday_matches_spark_formula():
+    """Sub-day operands: Spark's documented example
+    months_between('1997-02-28 10:30:00', '1996-10-30') = 3.94959677."""
+    import datetime as dtm
+
     from spark_rapids_jni_tpu.ops import datetime as d
 
-    c = Column.from_numpy(np.zeros(2, np.int64),
-                          t.DType(t.TypeId.TIMESTAMP_MICROSECONDS))
-    cd = Column.from_pylist([0, 1], t.DType(t.TypeId.TIMESTAMP_DAYS))
-    with pytest.raises(NotImplementedError, match="TIMESTAMP_DAYS"):
-        d.months_between(c, cd)
+    epoch = dtm.datetime(1970, 1, 1)
+    t1 = int((dtm.datetime(1997, 2, 28, 10, 30) - epoch)
+             .total_seconds() * 1e6)
+    t2 = int((dtm.datetime(1996, 10, 30) - epoch).total_seconds() * 1e6)
+    c1 = Column.from_pylist([t1], t.DType(t.TypeId.TIMESTAMP_MICROSECONDS))
+    c2 = Column.from_pylist([t2], t.DType(t.TypeId.TIMESTAMP_MICROSECONDS))
+    got = d.months_between(c1, c2).to_pylist()
+    assert got[0] == pytest.approx(3.94959677)
+    # mixed precision: DATE vs MICROS
+    cd = Column.from_pylist(
+        [(dtm.date(1996, 10, 30) - dtm.date(1970, 1, 1)).days],
+        t.DType(t.TypeId.TIMESTAMP_DAYS))
+    got2 = d.months_between(c1, cd).to_pylist()
+    assert got2[0] == pytest.approx(3.94959677)
+    # same day-of-month ignores time entirely (Spark rule)
+    t3 = int((dtm.datetime(1997, 1, 28, 23, 59) - epoch)
+             .total_seconds() * 1e6)
+    c3 = Column.from_pylist([t3], t.DType(t.TypeId.TIMESTAMP_MICROSECONDS))
+    assert d.months_between(c1, c3).to_pylist()[0] == 1.0

@@ -160,3 +160,76 @@ def test_nul_in_pattern_falls_back():
         compile_pattern("a\x00")
     with pytest.raises(RegexUnsupported, match="NUL"):
         compile_pattern("[\x00a]")
+
+
+def test_random_pattern_fuzz_vs_host():
+    """Grammar-driven random patterns (literals/classes/quantifiers/
+    alternation/groups/anchors) over random strings: the device DFA
+    must agree with re.ASCII (the same external oracle the host engine
+    emulates — host-vs-device agreement is pinned separately by
+    test_device_engine_matches_host_engine)."""
+    import random
+    import re
+
+    rng = random.Random(1234)
+    ALPHA = "abc"
+
+    def atom(depth):
+        r = rng.random()
+        if r < 0.35 or depth > 2:
+            return rng.choice(ALPHA)
+        if r < 0.5:
+            return "."
+        if r < 0.65:
+            body = "".join(sorted(set(
+                rng.choice(ALPHA) for _ in range(rng.randint(1, 3)))))
+            neg = "^" if rng.random() < 0.3 else ""
+            return f"[{neg}{body}]"
+        if r < 0.8:
+            return r"\d" if rng.random() < 0.5 else r"\w"
+        return "(" + alt(depth + 1) + ")"
+
+    def piece(depth):
+        a = atom(depth)
+        r = rng.random()
+        if r < 0.2:
+            return a + "*"
+        if r < 0.3:
+            return a + "+"
+        if r < 0.4:
+            return a + "?"
+        if r < 0.45:
+            lo = rng.randint(0, 2)
+            return a + f"{{{lo},{lo + rng.randint(0, 2)}}}"
+        return a
+
+    def concat(depth):
+        return "".join(piece(depth)
+                       for _ in range(rng.randint(1, 4)))
+
+    def alt(depth):
+        return "|".join(concat(depth)
+                        for _ in range(rng.randint(1, 2)))
+
+    strings = ["", "a", "b", "abc", "aab", "cabab", "abcabc", "1a",
+               "a1b2", "ccc", "ab", "ba", "aaa", "x", "a b"]
+    col = Column.from_pylist(strings, t.STRING)
+    tested = 0
+    for _ in range(120):
+        pat = alt(0)
+        if rng.random() < 0.2:
+            pat = "^" + pat
+        try:
+            compile_pattern(pat)  # compilability gate (lru-cached)
+        except RegexUnsupported:
+            continue
+        config.set_option("regex.force_engine", "device")
+        try:
+            got_dev = s.regexp_contains(col, pat).to_pylist()
+        finally:
+            config.set_option("regex.force_engine", "")
+        rx = re.compile(pat, re.ASCII)
+        want = [rx.search(v) is not None for v in strings]
+        assert got_dev == want, (pat, list(zip(strings, got_dev, want)))
+        tested += 1
+    assert tested > 60  # most generated patterns must be compilable
