@@ -81,8 +81,25 @@ class Column:
         )
 
     @property
+    def is_padded_list(self) -> bool:
+        """LIST column in the padded wire layout: data = int32 per-row
+        LENGTHS and children[0] an (n, L) element matrix with MANDATORY
+        (n, L) element validity — the 2-D validity is the layout marker
+        (no offsets-layout child carries one; child data shape alone
+        would collide with DECIMAL128's (m, 2) limb pairs)."""
+        return (
+            self.dtype.type_id == TypeId.LIST
+            and self.children is not None
+            and self.children[0].validity is not None
+            and getattr(self.children[0].validity, "ndim", 1) == 2
+        )
+
+    @property
     def size(self) -> int:
         if self.dtype.type_id == TypeId.LIST:
+            if self.is_padded_list:
+                # padded wire layout: data = per-row lengths, not offsets
+                return int(self.data.shape[0])
             return int(self.data.shape[0]) - 1
         if self.dtype.is_string and not self.is_padded_string:
             return int(self.data.shape[0]) - 1

@@ -737,3 +737,98 @@ def array_slice(col: Column, start: int, length: int) -> Column:
     new_child = _gather_any(child, src, live)
     return Column(col.dtype, new_off.astype(jnp.int32), col.validity,
                   children=[new_child])
+
+
+# ---------------------------------------------------------------------------
+# Padded wire layout for LIST columns (the padded-strings trick
+# generalized): data = int32 per-row lengths, children[0] = an element
+# column whose data is an (n, L) matrix with (n, L) element validity.
+# This is the layout the ICI shuffle ships (every lane is a dense
+# row-aligned buffer); offsets-layout lists convert at the boundary.
+# ---------------------------------------------------------------------------
+
+
+def is_padded_list(col: Column) -> bool:
+    """Delegates to the Column property (single source of truth: the
+    mandatory 2-D element validity is the layout marker)."""
+    return col.is_padded_list
+
+
+def max_list_length(col: Column) -> int:
+    """Host-side max list length (0-safe). Only valid outside jit."""
+    import numpy as np
+
+    off = np.asarray(col.data)
+    if off.shape[0] <= 1:
+        return 0
+    return int(np.max(off[1:] - off[:-1]))
+
+
+@func_range("pad_lists")
+def pad_lists(col: Column, max_len: int | None = None) -> Column:
+    """Offsets layout -> padded wire layout. ``max_len`` must bound every
+    row's length (host-computed by default; pass it statically inside
+    jit). Plain fixed-width elements only (DECIMAL128 limb pairs would
+    need a rank-3 matrix the Column invariants reject; strings-in-lists
+    are not wire-supported — explode them instead).
+
+    The (n, L) element validity is MANDATORY in this layout — it is the
+    layout marker (see Column.is_padded_list) and carries the element
+    null mask; for null-free children it costs one bool lane on the
+    wire that could in principle be derived from the lengths, a
+    documented trade-off for unambiguous layout detection."""
+    if col.dtype.type_id != TypeId.LIST:
+        raise TypeError(f"pad_lists needs a LIST column, got {col.dtype}")
+    if is_padded_list(col):
+        return col
+    child = col.children[0]
+    if not child.dtype.is_fixed_width or child.dtype.is_string:
+        raise NotImplementedError(
+            "pad_lists supports plain fixed-width elements only")
+    if max_len is None:
+        max_len = max_list_length(col)
+    L = max(int(max_len), 1)
+    off = col.data.astype(jnp.int32)
+    lens = off[1:] - off[:-1]
+    n = col.size
+    child_n = int(child.size)
+    j = jnp.arange(L, dtype=jnp.int32)[None, :]
+    src = jnp.clip(off[:-1][:, None] + j, 0, max(child_n - 1, 0))
+    in_row = j < lens[:, None]
+    if child_n:
+        mat = child.data[src]
+        evalid = child.valid_mask()[src] & in_row
+    else:
+        shape = (n, L) + child.data.shape[1:]
+        mat = jnp.zeros(shape, child.data.dtype)
+        evalid = jnp.zeros((n, L), jnp.bool_)
+    mat = jnp.where(in_row, mat, jnp.zeros_like(mat))
+    elem = Column(child.dtype, mat, evalid)
+    return Column(col.dtype, lens.astype(jnp.int32), col.validity,
+                  children=[elem])
+
+
+@func_range("unpad_lists")
+def unpad_lists(col: Column) -> Column:
+    """Padded wire layout -> offsets layout (dense compacted child via
+    the explode-style parent mapping)."""
+    if not is_padded_list(col):
+        return col
+    lens = col.data.astype(jnp.int64)
+    elem = col.children[0]
+    n, L = int(elem.data.shape[0]), int(elem.data.shape[1])
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64), jnp.cumsum(lens)])
+    cap = max(n * L, 1)
+    k = jnp.arange(cap, dtype=jnp.int64)
+    parent = jnp.clip(
+        jnp.searchsorted(offsets, k, side="right") - 1, 0,
+        max(n - 1, 0)).astype(jnp.int32)
+    j = jnp.clip(k - offsets[parent], 0, L - 1).astype(jnp.int32)
+    live = k < offsets[-1]
+    flatv = elem.data[parent, j]
+    flat_valid = elem.valid_mask()[parent, j] & live
+    flatv = jnp.where(live, flatv, jnp.zeros_like(flatv))
+    child = Column(elem.dtype, flatv, flat_valid)
+    return Column(col.dtype, offsets.astype(jnp.int32), col.validity,
+                  children=[child])

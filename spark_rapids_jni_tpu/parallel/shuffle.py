@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.types import TypeId
 from spark_rapids_jni_tpu.ops.hash import partition_hash
 from spark_rapids_jni_tpu.parallel.wire import BitPack, pack_bits, unpack_bits
 from spark_rapids_jni_tpu.utils.tracing import func_range
@@ -206,6 +207,28 @@ def shuffle_by_partition(
             out_cols.append(
                 Column(col.dtype, recv_len, recv_valid, chars=recv_mat)
             )
+            continue
+        if col.dtype.type_id == TypeId.LIST:
+            if not col.is_padded_list:
+                raise NotImplementedError(
+                    "hash_shuffle needs LIST columns in the padded wire "
+                    "layout (ops.lists.pad_lists before the shuffle)")
+            if wire_dtypes is not None and wire_dtypes[i] is not None:
+                raise ValueError(
+                    "wire narrowing does not apply to LIST columns "
+                    f"(column {i}); pass None for its wire dtype")
+            elem = col.children[0]
+            recv_len = exchange(_pack_send(col.data, order, plan))
+            recv_mat = exchange(_pack_send(elem.data, order, plan))
+            recv_ev = exchange(_pack_send(elem.valid_mask(), order, plan))
+            recv_valid = exchange(
+                _pack_send(col.valid_mask(), order, plan)) & recv_occupied
+            # unoccupied slots must read as EMPTY lists, not stale rows
+            recv_len = jnp.where(recv_occupied, recv_len, 0)
+            recv_ev = recv_ev & recv_occupied[:, None]
+            out_cols.append(Column(
+                col.dtype, recv_len, recv_valid,
+                children=[Column(elem.dtype, recv_mat, recv_ev)]))
             continue
         if not (col.dtype.is_fixed_width or col.dtype.is_decimal128):
             raise NotImplementedError(
