@@ -109,3 +109,23 @@ def get_json_object_host(col: Column, path: str) -> Column:
         jnp.asarray(out_valid.astype(bool)),
         chars=jnp.asarray(result_chars),
     )
+
+
+@func_range("json_tuple")
+def json_tuple(col: Column, *fields: str) -> list:
+    """Spark ``json_tuple(json, f1, f2, ...)``: one STRING column per
+    top-level field — each field runs the two-engine get_json_object
+    dispatcher with the ``$.field`` path. Cost is one full pass per
+    field (the k-field single-scan engine is future work — the
+    dispatcher's per-column eligibility verdict is recomputed each
+    time)."""
+    if not fields:
+        raise ValueError("json_tuple needs at least one field name")
+    out = []
+    for f in fields:
+        if not f or any(ch in f for ch in ".[]'\"$*"):
+            raise ValueError(
+                f"json_tuple field {f!r} must be a plain top-level key "
+                "(use get_json_object for nested paths)")
+        out.append(get_json_object(col, f"$.{f}"))
+    return out

@@ -129,3 +129,23 @@ def test_device_engine_width_edge():
     dev = jd.get_json_object_device(col, "$.k").to_pylist()
     host = get_json_object_host(col, "$.k").to_pylist()
     assert dev == host == ["1", None, "9"]
+
+
+def test_json_tuple_fields():
+    from spark_rapids_jni_tpu import types as t
+    from spark_rapids_jni_tpu.columnar import Column
+    from spark_rapids_jni_tpu.ops.get_json_object import json_tuple
+
+    docs = ['{"a": 1, "b": "x"}', '{"b": "y"}', None, '{"a": null}']
+    col = Column.from_pylist(docs, t.STRING)
+    a, b = json_tuple(col, "a", "b")
+    assert a.to_pylist() == ["1", None, None, None]
+    assert b.to_pylist() == ["x", "y", None, None]
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="at least one"):
+        json_tuple(col)
+    with _pt.raises(ValueError, match="plain top-level"):
+        json_tuple(col, "a.b")
+    with _pt.raises(ValueError, match="plain top-level"):
+        json_tuple(col, "*")
