@@ -12,7 +12,9 @@ python build_scripts/build-info.py
 python -m pytest tests/ -q
 
 : > bench_nightly.jsonl
-for cfg in tpch_q1 tpch_q1_planned tpch_q1_pallas tpch_q3 tpcds_q72 tpcds_q64            row_conversion parquet_q1 shuffle_wire json_extract cast_strings; do
+for cfg in tpch_q1 tpch_q1_planned tpch_q1_pallas tpch_q3 tpch_q6 tpch_q14 \
+           tpcds_q72 tpcds_q64 row_conversion parquet_q1 shuffle_wire \
+           json_extract cast_strings regexp; do
   BENCH_CONFIG=$cfg python bench.py >> bench_nightly.jsonl
 done
 cat bench_nightly.jsonl
