@@ -513,3 +513,52 @@ def test_padded_list_detection_no_decimal128_collision():
 
     with pytest.raises(NotImplementedError, match="fixed-width"):
         pad_lists(lc)
+
+
+def test_string_list_pipeline_end_to_end(rng):
+    """Integration: split -> explode -> groupby collect_set ->
+    sort_array -> array_join -> regexp_contains, against one Python
+    oracle — the round-4 string/list surface composed as a pipeline."""
+    from spark_rapids_jni_tpu.ops import strings_fns as sf
+    from spark_rapids_jni_tpu.ops import strings as s
+    from spark_rapids_jni_tpu.ops.lists import (
+        array_join,
+        explode,
+        groupby_collect,
+        sort_array,
+    )
+    from spark_rapids_jni_tpu.ops.table_ops import trim_table
+
+    words = ["apple", "pear", "fig", "kiwi", "plum"]
+    n = 120
+    keys = rng.integers(0, 6, n).tolist()
+    csvs = [",".join(words[j] for j in rng.integers(0, len(words),
+                                                    rng.integers(1, 5)))
+            for _ in range(n)]
+    tbl = Table([Column.from_pylist(keys, t.INT64),
+                 Column.from_pylist(csvs, t.STRING)])
+    # split each csv, explode to (key, word) rows
+    sp = sf.split(tbl.column(1), ",", max_pieces=8)
+    assert not bool(sp.overflowed)
+    ex = explode(Table([tbl.column(0), sp.column]), 1)
+    rows = _exploded_rows(ex, 2)
+    live_keys = [k for k, _ in rows]
+    live_words = [w for _, w in rows]
+    # collect the distinct words per key, sort, join
+    comp_tbl = Table([
+        Column.from_pylist(live_keys, t.INT64),
+        Column.from_pylist(live_words, t.STRING),
+    ])
+    coll = groupby_collect(comp_tbl, [0], 1, distinct=True)
+    trimmed = trim_table(coll.table, int(coll.num_groups))
+    joined = array_join(sort_array(trimmed.column(1)), "|")
+    has_fig = s.regexp_contains(joined, r"(^|\|)fig(\||$)").to_pylist()
+    # oracle
+    want = {}
+    for k, csv in zip(keys, csvs):
+        want.setdefault(k, set()).update(csv.split(","))
+    got_keys = trimmed.column(0).to_pylist()
+    assert sorted(got_keys) == sorted(want)  # no dropped/dup groups
+    for k, j, hf in zip(got_keys, joined.to_pylist(), has_fig):
+        assert j == "|".join(sorted(want[k])), k
+        assert hf == ("fig" in want[k]), k
