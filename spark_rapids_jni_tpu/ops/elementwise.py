@@ -78,10 +78,22 @@ def coalesce(cols: Sequence[Column]) -> Column:
 def nullif(a: Column, b: Column) -> Column:
     """Spark ``nullif(a, b)``: a, nulled where a == b (null-safe: a null
     pair does NOT null — Spark's NullIf uses EqualTo, null == null is
-    unknown, so a stays null anyway)."""
+    unknown, so a stays null anyway). Strings compare by padded bytes,
+    DECIMAL128 by limb pairs."""
     _same_dtypes([a, b], "nullif")
-    if a.dtype.is_string or a.dtype.is_decimal128:
-        raise NotImplementedError("nullif on string/DECIMAL128 columns")
+    if a.dtype.is_string:
+        from spark_rapids_jni_tpu.ops.strings import pad_to_common_width
+
+        pa, pb = pad_to_common_width([a, b])
+        eq_val = (pa.data == pb.data) & jnp.all(
+            pa.chars == pb.chars, axis=1)
+        eq = eq_val & pa.valid_mask() & pb.valid_mask()
+        return Column(pa.dtype, pa.data, pa.valid_mask() & ~eq,
+                      chars=pa.chars)
+    if a.dtype.is_decimal128:
+        eq_val = jnp.all(a.data == b.data, axis=-1)
+        eq = eq_val & a.valid_mask() & b.valid_mask()
+        return Column(a.dtype, a.data, a.valid_mask() & ~eq)
     eq = (a.data == b.data) & a.valid_mask() & b.valid_mask()
     return Column(a.dtype, a.data, a.valid_mask() & ~eq)
 

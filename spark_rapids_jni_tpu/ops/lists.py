@@ -29,6 +29,7 @@ from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops.groupby import (
@@ -239,9 +240,8 @@ def array_contains(col: Column, value) -> Column:
             f"array_contains needs a LIST column, got {col.dtype}")
     child = col.children[0]
     if child.dtype.is_decimal128:
-        raise NotImplementedError(
-            "array_contains on DECIMAL128 children")
-    if child.dtype.is_string:
+        hit = _scalar_d128_hit(child, value)
+    elif child.dtype.is_string:
         hit = _scalar_string_hit(child, value)
     else:
         hit = (child.data == value) & child.valid_mask()
@@ -325,6 +325,16 @@ def _scalar_string_hit(child: Column, value) -> jnp.ndarray:
             & p.valid_mask())
 
 
+def _scalar_d128_hit(child: Column, value) -> jnp.ndarray:
+    """bool[child_n]: DECIMAL128 elements equal to the Python-int
+    unscaled ``value`` (two's-complement limb split)."""
+    v = int(value)
+    lo = jnp.int64(np.int64(np.uint64(v & 0xFFFFFFFFFFFFFFFF)))
+    hi = jnp.int64(v >> 64)
+    return ((child.data[:, 0] == lo) & (child.data[:, 1] == hi)
+            & child.valid_mask())
+
+
 def _range_any(flags: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
     """bool[n]: ANY of ``flags`` within each [offsets[i], offsets[i+1])
     — one cumsum + prefix difference, the shared list-predicate idiom."""
@@ -384,8 +394,8 @@ def array_position(col: Column, value) -> Column:
             f"array_position needs a LIST column, got {col.dtype}")
     child = col.children[0]
     if child.dtype.is_decimal128:
-        raise NotImplementedError("array_position on DECIMAL128 children")
-    if child.dtype.is_string:
+        hit = _scalar_d128_hit(child, value)
+    elif child.dtype.is_string:
         hit = _scalar_string_hit(child, value)
     else:
         hit = (child.data == value) & child.valid_mask()

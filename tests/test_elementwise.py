@@ -94,3 +94,12 @@ def test_pmod_int64_min_exact():
     b = Column.from_pylist([3], t.INT64)
     # Java: (-2^63) % 3 == -2 -> pmod == 1
     assert e.pmod(a, b).to_pylist() == [1]
+
+
+def test_nullif_strings_and_decimal128():
+    a = Column.from_pylist(["x", "yy", None, "z"], t.STRING)
+    b = Column.from_pylist(["x", "y", None, "w"], t.STRING)
+    assert e.nullif(a, b).to_pylist() == [None, "yy", None, "z"]
+    da = Column.from_pylist([1 << 80, 5, None], t.decimal128(0))
+    db = Column.from_pylist([1 << 80, 6, None], t.decimal128(0))
+    assert e.nullif(da, db).to_pylist() == [None, 5, None]

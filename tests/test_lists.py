@@ -562,3 +562,17 @@ def test_string_list_pipeline_end_to_end(rng):
     for k, j, hf in zip(got_keys, joined.to_pylist(), has_fig):
         assert j == "|".join(sorted(want[k])), k
         assert hf == ("fig" in want[k]), k
+
+
+def test_array_contains_position_decimal128():
+    from spark_rapids_jni_tpu.ops.lists import (
+        array_contains,
+        array_position,
+    )
+
+    big = (1 << 90) + 7
+    lists = [[big, 5], [None, big], [], None, [1]]
+    lc = make_list_column(lists, t.decimal128(0))
+    assert array_contains(lc, big).to_pylist() == \
+        [True, True, False, None, False]
+    assert array_position(lc, big).to_pylist() == [1, 2, 0, None, 0]
