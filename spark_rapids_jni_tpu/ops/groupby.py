@@ -436,6 +436,30 @@ def _signed_sub_limbs16(a_mag: list, a_neg: jnp.ndarray,
     return mag, neg & ~is_zero
 
 
+def split_sum128_lanes(lo: jnp.ndarray, hi: jnp.ndarray) -> list:
+    """Four 32-bit limb lanes of a masked (lo, hi) int64 pair — int64
+    lane sums over up to 2^31 rows cannot overflow. Shared by the
+    groupby, reduction, and window exact-SUM paths."""
+    m32 = jnp.int64(0xFFFFFFFF)
+    return [lo & m32, (lo >> 32) & m32, hi & m32, hi >> 32]
+
+
+def recombine_sum128(s0, s1, s2, s3):
+    """(lo, hi, overflow) from four limb-lane sums: carry recombination
+    with the signed-128-bit overflow check (`top` must be the sign
+    extension of its own low 32 bits). The ONE implementation all three
+    exact-sum paths share — a carry-math fix lands everywhere."""
+    m32 = jnp.int64(0xFFFFFFFF)
+    c0 = s0 & m32
+    t = s1 + (s0 >> 32)
+    lo = c0 | ((t & m32) << 32)
+    u = s2 + (t >> 32)
+    top = s3 + (u >> 32)
+    hi = (u & m32) + (top << 32)
+    ovf = top != ((top << 32) >> 32)
+    return lo, hi, ovf
+
+
 def _sum_dtype(dt: DType) -> DType:
     """Spark widens SUM: integral -> INT64, decimal keeps scale (wider
     precision), floats stay floating."""
@@ -857,17 +881,9 @@ def groupby_aggregate(
         vcount = seg_col(count_lane)
         if op in ("sum128", "mean128"):
             s0, s1, s2, s3 = (seg_col(i) for i in val_lane)
-            c0 = s0 & _M32
-            t = s1 + (s0 >> 32)
-            lo = c0 | ((t & _M32) << 32)
-            u = s2 + (t >> 32)
-            top = s3 + (u >> 32)  # exact signed bits >= 96 of the total
-            hi = (u & _M32) + (top << 32)
-            # the true total fits signed 128 bits iff `top` is the sign
-            # extension of its own low 32 bits; otherwise packing would
-            # wrap two's-complement — null the group and raise the flag
-            # instead (Spark ANSI decimal overflow posture)
-            ovf_g = (top != ((top << 32) >> 32)) & (vcount > 0)
+            # shared carry recombination + Spark-ANSI overflow check
+            lo, hi, ovf = recombine_sum128(s0, s1, s2, s3)
+            ovf_g = ovf & (vcount > 0)
             if op == "mean128":
                 limbs, div_ovf = _mean128_exact(lo, hi, vcount)
                 ovf_g = ovf_g | (div_ovf & (vcount > 0))

@@ -30,22 +30,17 @@ def sum_(col: Column):
     valid = col.valid_mask()
     has_any = jnp.any(valid)
     if col.dtype.is_decimal128:
-        m32 = jnp.int64(0xFFFFFFFF)
+        from spark_rapids_jni_tpu.ops.groupby import (
+            recombine_sum128,
+            split_sum128_lanes,
+        )
+
         lo = jnp.where(valid, col.data[:, 0], jnp.int64(0))
         hi = jnp.where(valid, col.data[:, 1], jnp.int64(0))
-        s0 = jnp.sum(lo & m32)
-        s1 = jnp.sum((lo >> 32) & m32)
-        s2 = jnp.sum(hi & m32)
-        s3 = jnp.sum(hi >> 32)
-        c0 = s0 & m32
-        tq = s1 + (s0 >> 32)
-        lo_t = c0 | ((tq & m32) << 32)
-        u = s2 + (tq >> 32)
-        top = s3 + (u >> 32)
-        hi_t = (u & m32) + (top << 32)
+        lanes = [jnp.sum(l) for l in split_sum128_lanes(lo, hi)]
         # totals past signed 128 bits null the result instead of wrapping
         # (the groupby sum_overflow posture, reference: Spark ANSI)
-        ovf = top != ((top << 32) >> 32)
+        lo_t, hi_t, ovf = recombine_sum128(*lanes)
         return jnp.stack([lo_t, hi_t]), has_any & ~ovf
     vals, _ = _masked(col, 0)
     kind = col.dtype.storage_dtype.kind

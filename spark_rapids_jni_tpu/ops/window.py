@@ -354,6 +354,36 @@ class Window:
         return (c, self._frame_diff(run, lo, hi),
                 self._frame_valid_count(valid, lo, hi))
 
+    def _rolling_sum128(self, col_idx: int, preceding: int,
+                        following: int, frame: str) -> Column:
+        """Exact DECIMAL128 rolling SUM: four 32-bit limb lanes through
+        the segmented scan, frame prefix-differences per lane, carry
+        recombination with 128-bit overflow DETECTION — an overflowing
+        frame's sum is NULL, never a wrapped value (the groupby sum128
+        posture; the window API has no flag channel, documented)."""
+        from spark_rapids_jni_tpu.ops.groupby import (
+            recombine_sum128,
+            split_sum128_lanes,
+        )
+
+        lo_b, hi_b = self._bounds(preceding, following, frame)
+        c = self._sorted.column(col_idx)
+        valid = c.valid_mask()
+        vlo = jnp.where(valid, c.data[:, 0], jnp.int64(0))
+        vhi = jnp.where(valid, c.data[:, 1], jnp.int64(0))
+        # validity rides the scan as a fifth lane — one pass, not two
+        lanes = jnp.stack(
+            split_sum128_lanes(vlo, vhi)
+            + [valid.astype(jnp.int64)], axis=1)
+        runs = _segmented_sum_scan(lanes, ~self._same_p)
+        segs = [self._frame_diff(runs[:, i], lo_b, hi_b)
+                for i in range(5)]
+        lo_out, hi_out, ovf = recombine_sum128(*segs[:4])
+        wcnt = segs[4]
+        out = jnp.stack([lo_out, hi_out], axis=-1)
+        return Column(c.dtype, self._unsort(out),
+                      self._unsort((wcnt > 0) & ~ovf))
+
     @func_range("window_rolling_sum")
     def rolling_sum(self, col_idx: int, preceding: int,
                     following: int = 0, frame: str = "rows") -> Column:
@@ -363,6 +393,9 @@ class Window:
         (documented float-rounding posture)."""
         from spark_rapids_jni_tpu.ops.groupby import _sum_dtype
 
+        if self._sorted.column(col_idx).dtype.is_decimal128:
+            return self._rolling_sum128(col_idx, preceding, following,
+                                        frame)
         c, wsum, wcnt = self._rolling_parts(col_idx, preceding,
                                             following, frame)
         acc_dt = _sum_dtype(c.dtype)

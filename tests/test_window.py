@@ -565,3 +565,47 @@ def test_range_frame_int64_edge_saturates():
     # following=5 would wrap past int64 max without saturation
     assert w.rolling_sum(2, 0, 5, frame="range").to_pylist() == [7, 6, 4]
     assert w.rolling_sum(2, 5, 0, frame="range").to_pylist() == [1, 3, 7]
+
+
+def test_rolling_sum_decimal128_exact(rng):
+    """DECIMAL128 rolling SUM: limb-lane prefix differences vs a Python
+    big-int oracle, incl. values spanning both limbs and null skipping;
+    an overflowing frame is NULL, never wrapped."""
+    n = 150
+    part = rng.integers(0, 4, n).astype(np.int64)
+    order = rng.integers(0, 40, n).astype(np.int32)
+    vals = [((-1) ** i) * (int(v) << 64 | 12345)
+            for i, v in enumerate(rng.integers(0, 2**40, n))]
+    vvalid = rng.random(n) > 0.2
+    tbl = Table([
+        Column.from_numpy(part),
+        Column.from_numpy(order),
+        Column.from_pylist(
+            [v if ok else None for v, ok in zip(vals, vvalid)],
+            t.decimal128(-2)),
+    ])
+    w = Window(tbl, partition_by=[0], order_by=[1])
+    for p, f in ((3, 0), (2, 2)):
+        got = w.rolling_sum(2, p, f).to_pylist()
+        rows = sorted(range(n), key=lambda i: (part[i], order[i], i))
+        by_part = {}
+        for i in rows:
+            by_part.setdefault(part[i], []).append(i)
+        for pid, seq in by_part.items():
+            for j, i in enumerate(seq):
+                frame = seq[max(j - p, 0): j + f + 1]
+                sel = [vals[r] for r in frame if vvalid[r]]
+                if sel:
+                    assert got[i] == sum(sel), (p, f, i)
+                else:
+                    assert got[i] is None
+    # overflow: two near-max values in one frame -> NULL, not wrap
+    big = (1 << 126)
+    t2 = Table([
+        Column.from_numpy(np.zeros(2, np.int64)),
+        Column.from_numpy(np.arange(2, dtype=np.int32)),
+        Column.from_pylist([big, big], t.decimal128(0)),
+    ])
+    w2 = Window(t2, partition_by=[0], order_by=[1])
+    got2 = w2.rolling_sum(2, 1, 0).to_pylist()
+    assert got2[0] == big and got2[1] is None
