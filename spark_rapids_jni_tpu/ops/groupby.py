@@ -744,12 +744,9 @@ def groupby_aggregate(
             # long division (exact, no f64) — see the consume branch.
             lo = jnp.where(valid, c.data[:, 0], jnp.int64(0))
             hi = jnp.where(valid, c.data[:, 1], jnp.int64(0))
-            lanes128 = (
-                lane(lo & _M32, memo_key=(id(c), "s128", 0)),
-                lane((lo >> 32) & _M32, memo_key=(id(c), "s128", 1)),
-                lane(hi & _M32, memo_key=(id(c), "s128", 2)),
-                lane(hi >> 32, memo_key=(id(c), "s128", 3)),
-            )
+            lanes128 = tuple(
+                lane(l, memo_key=(id(c), "s128", k))
+                for k, l in enumerate(split_sum128_lanes(lo, hi)))
             if op == "mean":
                 # Spark avg(decimal) carries 4 extra fractional digits
                 plan.append(("mean128", c, decimal128(c.dtype.scale - 4),

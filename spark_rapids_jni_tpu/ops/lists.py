@@ -482,8 +482,8 @@ def arrays_overlap(a: Column, b: Column) -> Column:
         raise ValueError(
             f"arrays_overlap needs equal row counts, got {a.size} vs "
             f"{b.size}")
-    if ca.dtype.is_decimal128:
-        raise NotImplementedError("arrays_overlap on DECIMAL128 children")
+    # DECIMAL128 children work unchanged: limb-pair sort keys and the
+    # limb-wise equal-prev compare are the same machinery sort/groupby use
     n = a.size
     pa, pb = _parent_ids(a), _parent_ids(b)
     from spark_rapids_jni_tpu.ops.table_ops import concatenate

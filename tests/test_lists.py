@@ -576,3 +576,12 @@ def test_array_contains_position_decimal128():
     assert array_contains(lc, big).to_pylist() == \
         [True, True, False, None, False]
     assert array_position(lc, big).to_pylist() == [1, 2, 0, None, 0]
+
+
+def test_arrays_overlap_decimal128():
+    from spark_rapids_jni_tpu.ops.lists import arrays_overlap
+
+    big = (1 << 100) + 1
+    a = make_list_column([[big, 5], [1], [None, 2]], t.decimal128(0))
+    b = make_list_column([[big], [7], [3]], t.decimal128(0))
+    assert arrays_overlap(a, b).to_pylist() == [True, False, None]
