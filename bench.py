@@ -390,6 +390,102 @@ def _bench_tpch_q1_pallas(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_tpch_q12_planned(n: int, iters: int):
+    """q12 on the sort-free plan (planner-declared shipmode domain):
+    join unchanged, aggregation lowered to the bounded masked-reduction
+    pass with on-device string dictionary encoding."""
+    import jax
+
+    from spark_rapids_jni_tpu.columnar import Table
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q12_table,
+        orders_q12_table,
+        tpch_q12_planned_result,
+    )
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    n_ord = max(n // 8, 8)
+    orders = orders_q12_table(n_ord)
+    ocols = list(orders.columns)
+    ocols[1] = pad_strings(ocols[1])  # jit needs static string widths
+    orders = Table(ocols)
+    li = lineitem_q12_table(n, n_ord)
+    lcols = list(li.columns)
+    lcols[1] = pad_strings(lcols[1])
+    li = Table(lcols)
+
+    import jax.numpy as jnp
+
+    def run(o, l):
+        res = tpch_q12_planned_result(o, l)
+        return (_table_digest(res.table)
+                + jnp.sum(res.present).astype(jnp.float64)
+                + res.domain_miss)
+
+    fn = jax.jit(run)
+    per_iter = _measure(lambda: fn(orders, li), iters)
+    return n / per_iter
+
+
+def _bench_tpch_q12(n: int, iters: int):
+    """General (sort-based) q12 — the planned config's control: same
+    join, groupby on the unbounded machinery."""
+    import jax
+
+    from spark_rapids_jni_tpu.columnar import Table
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q12_table,
+        orders_q12_table,
+        tpch_q12,
+    )
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    n_ord = max(n // 8, 8)
+    orders = orders_q12_table(n_ord)
+    ocols = list(orders.columns)
+    ocols[1] = pad_strings(ocols[1])
+    orders = Table(ocols)
+    li = lineitem_q12_table(n, n_ord)
+    lcols = list(li.columns)
+    lcols[1] = pad_strings(lcols[1])
+    li = Table(lcols)
+    fn = jax.jit(lambda o, l: _table_digest(tpch_q12(o, l).result.table))
+    per_iter = _measure(lambda: fn(orders, li), iters)
+    return n / per_iter
+
+
+def _bench_tpch_q4_planned(n: int, iters: int):
+    """q4 on the sort-free plan (5-value orderpriority DDL enum)."""
+    import jax
+
+    from spark_rapids_jni_tpu.columnar import Table
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q12_table,
+        orders_q4_table,
+        tpch_q4_planned_result,
+    )
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    n_ord = max(n // 4, 8)
+    orders = orders_q4_table(n_ord)
+    ocols = list(orders.columns)
+    ocols[2] = pad_strings(ocols[2])
+    orders = Table(ocols)
+    li = lineitem_q12_table(n, n_ord)
+
+    import jax.numpy as jnp
+
+    def run(o, l):
+        res = tpch_q4_planned_result(o, l)
+        return (_table_digest(res.table)
+                + jnp.sum(res.present).astype(jnp.float64)
+                + res.domain_miss)
+
+    fn = jax.jit(run)
+    per_iter = _measure(lambda: fn(orders, li), iters)
+    return n / per_iter
+
+
 def _bench_cast_strings(n: int, iters: int):
     """BASELINE.json config #1: CastStrings float/decimal parse
     throughput. Generates n numeric strings (template pool tiled to n),
@@ -574,6 +670,11 @@ _CONFIGS = {
     "shuffle_wire": (_bench_shuffle_wire, "shuffle_wire_gb_per_s", "GB/s"),
     "json_extract": (_bench_json_extract, "json_extract_rows_per_s", "rows/s"),
     "tpch_q3": (_bench_tpch_q3, "tpch_q3_rows_per_s", "rows/s"),
+    "tpch_q12": (_bench_tpch_q12, "tpch_q12_rows_per_s", "rows/s"),
+    "tpch_q12_planned": (
+        _bench_tpch_q12_planned, "tpch_q12_planned_rows_per_s", "rows/s"),
+    "tpch_q4_planned": (
+        _bench_tpch_q4_planned, "tpch_q4_planned_rows_per_s", "rows/s"),
     "tpch_q14": (_bench_tpch_q14, "tpch_q14_rows_per_s", "rows/s"),
     "regexp": (_bench_regexp, "regexp_rows_per_s", "rows/s"),
     "cast_strings": (_bench_cast_strings, "cast_strings_rows_per_s", "rows/s"),
@@ -772,7 +873,8 @@ def sweep() -> None:
     # big-table configs whose 16M variants don't add information per size
     single_size = {"parquet_q1", "shuffle_wire", "tpcds_q72", "tpcds_q64",
                    "json_extract", "regexp", "cast_strings", "tpch_q14",
-                   "tpch_q3"}
+                   "tpch_q3", "tpch_q12", "tpch_q12_planned",
+                   "tpch_q4_planned"}
     ok, why = _probe_tpu(float(os.environ.get("BENCH_PROBE_TIMEOUT", 120)))
     if not ok:
         print(json.dumps({"sweep": "aborted", "why": why}))

@@ -214,16 +214,20 @@ def tpch_q1_planned_result(lineitem: Table):
     grouping needs no sort, no gather, no scan — one streaming masked-
     reduction pass (groupby_aggregate_bounded), and the output order is
     static (real groups lexicographic, null groups last), so the final
-    ORDER BY costs nothing. Returns the full BoundedGroupByResult so
-    jitted callers can observe ``domain_miss``; the single shared call
-    path for the checked and unchecked wrappers below."""
+    ORDER BY costs nothing. Returns the planner result so jitted callers
+    can observe ``domain_miss``; the single shared call path for the
+    checked and unchecked wrappers below. Lowered through the general
+    planner facility (ops/planner.plan_groupby) — q1 is just the first
+    client of the declared-domain plan, not a special case."""
     work = _q1_work_table(lineitem)
-    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate_bounded
+    from spark_rapids_jni_tpu.ops.planner import plan_groupby, scalar_domain
 
-    return groupby_aggregate_bounded(
+    res = plan_groupby(
         work, keys=[0, 1], aggs=_Q1_AGGS,
-        key_domains=[_Q1_RF_DOMAIN, _Q1_LS_DOMAIN],
+        domains=[scalar_domain(_Q1_RF_DOMAIN), scalar_domain(_Q1_LS_DOMAIN)],
     )
+    assert res.lowered == "bounded"  # static plan fact, not a data check
+    return res
 
 
 def tpch_q1_planned(lineitem: Table) -> Table:
@@ -440,6 +444,72 @@ def tpch_q1_distributed(lineitem: Table, mesh) -> Table:
     per_dev, num_groups = step(sharded)
     result = collect(per_dev, num_groups, mesh)
     return sort_table(result, [0, 1], nulls_first=[False, False])
+
+
+def tpch_q1_outofcore(path, *, budget_bytes: int,
+                      chunk_read_limit: int,
+                      spill_budget_bytes: int | None = None,
+                      compress_spill: bool = False):
+    """q1 over a Parquet file LARGER than the device budget: chunked
+    row-group reads -> per-chunk partial aggregates -> SpillStore'd
+    partials -> merge -> finalize. The partial->merge algebra is the
+    distributed q1's (q1_distributed_step), run over the chunk sequence
+    instead of the device mesh — same plan, different axis.
+
+    File schema: the 7 q1 lineitem columns with the 4 money columns as
+    unscaled int64 (the bench parquet_q1 layout); they are re-typed to
+    DECIMAL64(-2) on read. Returns OutOfCoreResult; ``.table`` matches
+    ``tpch_q1`` of the fully-materialized file.
+    """
+    import jax as _jax
+
+    from spark_rapids_jni_tpu.parquet.reader import ParquetChunkedReader
+    from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter, SpillStore
+    from spark_rapids_jni_tpu.runtime.outofcore import run_chunked_aggregate
+
+    money = t.decimal64(-2)
+    limiter = MemoryLimiter(budget_bytes)
+    spill = SpillStore(
+        spill_budget_bytes if spill_budget_bytes is not None
+        else budget_bytes, compress_spill=compress_spill)
+
+    def _retype(chunk: Table) -> Table:
+        cols = list(chunk.columns)
+        for i in range(4):
+            cols[i] = Column(money, cols[i].data, cols[i].validity)
+        return Table(cols)
+
+    @_jax.jit
+    def _partial(chunk: Table):
+        work = _q1_work_table(chunk)
+        budget = min(_Q1_GROUP_BUDGET, work.num_rows)
+        g = groupby_aggregate(work, keys=[0, 1], aggs=_Q1_PARTIAL_AGGS,
+                              max_groups=budget)
+        return g.table, g.num_groups, g.overflowed
+
+    def partial_fn(chunk: Table) -> Table:
+        from spark_rapids_jni_tpu.ops.table_ops import trim_table
+
+        tbl, num_groups, overflowed = _partial(_retype(chunk))
+        if bool(overflowed):
+            raise ValueError(
+                "q1 chunk exceeded the plan's group budget "
+                f"({_Q1_GROUP_BUDGET}): flag bytes outside the contract")
+        # host-side compaction between jitted regions: only real groups
+        # cross into the merge (chunk boundaries are where dynamic
+        # shapes cost nothing — the q1_distributed_step row_valid idea)
+        return trim_table(tbl, int(num_groups))
+
+    def merge_fn(partials: Table) -> Table:
+        merged = groupby_aggregate(
+            partials, keys=[0, 1],
+            aggs=[(i, "sum") for i in range(2, 10)])
+        final = _q1_finalize(merged.table)
+        return sort_table(final, [0, 1], nulls_first=[False, False])
+
+    reader = ParquetChunkedReader(path, chunk_read_limit=chunk_read_limit)
+    return run_chunked_aggregate(
+        iter(reader), partial_fn, merge_fn, limiter=limiter, spill=spill)
 
 
 # ---- TPC-H q3 (shipping priority): join + groupby + order-by ---------------
@@ -866,6 +936,56 @@ def tpch_q12_numpy(orders: Table, lineitem: Table,
         else:
             out[lmode[i]][1] += 1
     return out
+
+
+@func_range("tpch_q12_planned_result")
+def tpch_q12_planned_result(orders: Table, lineitem: Table,
+                            modes: tuple = ("MAIL", "SHIP"),
+                            year_start: int = _Q12_YEAR_START,
+                            year_end: int = _Q12_YEAR_END):
+    """q12 on the sort-free plan: the l_shipmode GROUP BY key's domain is
+    the query's own IN-list (a planner fact, like q1's DDL flag domains),
+    so the post-join aggregation lowers to the bounded masked-reduction
+    pass — the shipmode strings are dictionary-encoded on device and the
+    output keys decode to static strings at trace time. Join unchanged
+    (it is the sort-based machinery); the groupby stage carries no sort,
+    scan, or scatter (HLO-pinned in tests)."""
+    from spark_rapids_jni_tpu.ops import strings as s
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+    from spark_rapids_jni_tpu.ops.planner import plan_groupby, string_domain
+
+    mode_c = s.pad_strings(lineitem.column(L12_SHIPMODE))
+    keep = _q12_keep(lineitem, mode_c, modes, year_start, year_end)
+    probe = Table([
+        _null_where(lineitem.column(L12_ORDERKEY), ~keep),
+        mode_c,
+    ])
+    maps = join(probe, orders, 0, 0, out_size=lineitem.num_rows)
+    j = apply_join_maps(probe, orders, maps)
+    # j: [l_orderkey, l_shipmode, o_orderkey, o_orderpriority]
+    matched = j.column(2).valid_mask()
+    high, low = _q12_priority_lanes(j.column(3), matched)
+    mode_j = j.column(1)
+    keyed = Table([
+        Column(mode_j.dtype,
+               jnp.where(matched, mode_j.data, 0), matched,
+               chars=jnp.where(matched[:, None], mode_j.chars,
+                               jnp.uint8(0))),
+        high, low,
+    ])
+    return plan_groupby(keyed, keys=[0], aggs=[(1, "sum"), (2, "sum")],
+                        domains=[string_domain(modes)])
+
+
+def tpch_q12_planned(orders: Table, lineitem: Table,
+                     modes: tuple = ("MAIL", "SHIP"),
+                     year_start: int = _Q12_YEAR_START,
+                     year_end: int = _Q12_YEAR_END) -> Table:
+    """Planned q12, table only — [l_shipmode, high_line_count,
+    low_line_count], mode-sorted with the null pseudo-group last (the
+    bounded plan's static order; same ordering contract as tpch_q12)."""
+    return tpch_q12_planned_result(
+        orders, lineitem, modes, year_start, year_end).table
 
 
 # ---------------------------------------------------------------------------
@@ -1324,6 +1444,61 @@ def tpch_q4_numpy(orders: Table, lineitem: Table,
         if okey[i] in late_keys:
             out[prio[i]] = out.get(prio[i], 0) + 1
     return out
+
+
+@func_range("tpch_q4_planned_result")
+def tpch_q4_planned_result(orders: Table, lineitem: Table,
+                           qtr_start: int = _Q4_QTR_START,
+                           qtr_end: int = _Q4_QTR_END):
+    """q4 on the sort-free plan: o_orderpriority is a 5-value DDL enum
+    ('1-URGENT'..'5-LOW' — the dictionary a real planner reads from
+    column stats), so the post-semi-join COUNT(*) GROUP BY lowers to the
+    bounded masked-reduction pass with on-device dictionary encoding.
+    The EXISTS stays a LEFT-SEMI join; only the aggregation changes."""
+    from spark_rapids_jni_tpu.ops import strings as s
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+    from spark_rapids_jni_tpu.ops.planner import plan_groupby, string_domain
+
+    od = orders.column(O4_ORDERDATE)
+    keep_o = (od.valid_mask()
+              & (od.data >= jnp.int32(qtr_start))
+              & (od.data < jnp.int32(qtr_end)))
+    prio_c = s.pad_strings(orders.column(O4_ORDERPRIORITY))
+    probe = Table([
+        _null_where(orders.column(O4_ORDERKEY), ~keep_o),
+        prio_c,
+    ])
+    commit_c = lineitem.column(L12_COMMITDATE)
+    receipt_c = lineitem.column(L12_RECEIPTDATE)
+    late = (commit_c.valid_mask() & receipt_c.valid_mask()
+            & (commit_c.data < receipt_c.data))
+    build = Table([
+        _null_where(lineitem.column(L12_ORDERKEY), ~late),
+    ])
+    maps = join(probe, build, 0, 0, out_size=orders.num_rows,
+                how="left_semi")
+    j = apply_join_maps(probe, build, maps)
+    matched = maps.row_valid
+    prio_j = j.column(1)
+    keyed = Table([
+        Column(prio_j.dtype,
+               jnp.where(matched, prio_j.data, 0), matched,
+               chars=jnp.where(matched[:, None], prio_j.chars,
+                               jnp.uint8(0))),
+        Column(t.INT64, jnp.where(matched, jnp.int64(1), jnp.int64(0)),
+               matched),
+    ])
+    return plan_groupby(keyed, keys=[0], aggs=[(1, "sum")],
+                        domains=[string_domain(_Q12_PRIORITIES)])
+
+
+def tpch_q4_planned(orders: Table, lineitem: Table,
+                    qtr_start: int = _Q4_QTR_START,
+                    qtr_end: int = _Q4_QTR_END) -> Table:
+    """Planned q4, table only — [o_orderpriority, order_count] in
+    priority order, null pseudo-group last (same contract as tpch_q4)."""
+    return tpch_q4_planned_result(
+        orders, lineitem, qtr_start, qtr_end).table
 
 
 # ---------------------------------------------------------------------------

@@ -132,7 +132,15 @@ def _bits_to_f64(bits: jnp.ndarray) -> jnp.ndarray:
 
 
 def to_bytes(data: jnp.ndarray, dtype: DType) -> jnp.ndarray:
-    """(n,) fixed-width array -> (n, size) little-endian uint8 bytes."""
+    """(n,) fixed-width array -> (n, size) little-endian uint8 bytes.
+
+    DECIMAL128 input is the int64[n, 2] limb pair (lo, hi little-endian);
+    its byte image is the 16-byte little-endian two's-complement integer —
+    lo limb bytes then hi limb bytes, exactly the __int128_t layout the
+    reference's generic row path stores (row_conversion.cu:462-468)."""
+    if dtype.is_decimal128:
+        return jnp.concatenate(
+            [_i64_to_bytes(data[:, 0]), _i64_to_bytes(data[:, 1])], axis=1)
     size = dtype.size_bytes
     if size == 1:
         return jax.lax.bitcast_convert_type(data, jnp.uint8).reshape(-1, 1)
@@ -143,13 +151,37 @@ def to_bytes(data: jnp.ndarray, dtype: DType) -> jnp.ndarray:
         u = _f64_to_bits(data)
     else:
         u = data.astype(jnp.uint64)
+    return _i64_to_bytes(u)
+
+
+def _i64_to_bytes(v: jnp.ndarray) -> jnp.ndarray:
+    """(n,) 64-bit integer -> (n, 8) little-endian bytes, portable to
+    backends without 64-bit bitcast-convert (the u32-word decomposition)."""
+    if _has_bitcast64():
+        return jax.lax.bitcast_convert_type(v, jnp.uint8).reshape(-1, 8)
+    u = v.astype(jnp.uint64)
     lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
     hi = (u >> 32).astype(jnp.uint32)
     return _u32_words_to_bytes(jnp.stack([lo, hi], axis=-1))
 
 
+def _bytes_to_i64(b: jnp.ndarray) -> jnp.ndarray:
+    """(n, 8) little-endian bytes -> (n,) int64, portable (u32 words)."""
+    if _has_bitcast64():
+        return jax.lax.bitcast_convert_type(b, jnp.int64)
+    words = _bytes_to_u32_words(b)
+    u = words[:, 0].astype(jnp.uint64) | (
+        words[:, 1].astype(jnp.uint64) << 32
+    )
+    return u.astype(jnp.int64)
+
+
 def from_bytes(b: jnp.ndarray, dtype: DType) -> jnp.ndarray:
-    """(n, size) little-endian uint8 bytes -> (n,) of the storage dtype."""
+    """(n, size) little-endian uint8 bytes -> (n,) of the storage dtype
+    (int64[n, 2] limb pairs for DECIMAL128)."""
+    if dtype.is_decimal128:
+        return jnp.stack(
+            [_bytes_to_i64(b[:, :8]), _bytes_to_i64(b[:, 8:])], axis=1)
     target = dtype.jnp_dtype
     size = dtype.size_bytes
     if size == 1:

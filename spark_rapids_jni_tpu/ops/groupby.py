@@ -1326,6 +1326,33 @@ def groupby_percentile(
     return GroupByResult(Table(out_cols), num_groups, overflowed)
 
 
+def bounded_group_layout(domain_lens: Sequence[int]):
+    """Static (trace-time) layout of the bounded-groupby output.
+
+    One slot per combination of (domain value | null) per key:
+    ``m = prod(len+1)``. Returns ``(sizes, m, codes, order)`` where
+    ``codes[g, pos]`` is key ``pos``'s domain index for group ``g``
+    (``== domain_lens[pos]`` means the null slot) and ``order`` is the
+    output permutation — real-key groups first in lexicographic key
+    order, null-key groups after (the ORDER BY ... NULLS LAST every
+    consumer wants, at zero device cost). Shared by
+    ``groupby_aggregate_bounded`` and the planner's string-key decoding
+    (ops/planner.py) so the two can never disagree about slot layout.
+    """
+    sizes = [int(l) + 1 for l in domain_lens]
+    m = int(np.prod(sizes)) if sizes else 1
+    codes = np.zeros((m, len(sizes)), dtype=np.int64)
+    for pos, size in enumerate(sizes):
+        stride = int(np.prod(sizes[pos + 1:])) or 1
+        codes[:, pos] = (np.arange(m) // stride) % size
+    has_null = (codes == (np.asarray(sizes) - 1)).any(axis=1) \
+        if sizes else np.zeros((m,), bool)
+    order = np.asarray(
+        sorted(range(m), key=lambda g: (bool(has_null[g]), g)),
+        dtype=np.int64)
+    return sizes, m, codes, order
+
+
 class BoundedGroupByResult(NamedTuple):
     """Output of groupby_aggregate_bounded: one row per domain combination
     (null slots included), in a STATIC order — real-key groups first in
@@ -1376,8 +1403,8 @@ def groupby_aggregate_bounded(
     if len(key_domains) != len(keys):
         raise ValueError("one domain per key column required")
     n = table.num_rows
-    sizes = [len(d) + 1 for d in key_domains]  # +1: the null slot
-    m = int(np.prod(sizes))
+    sizes, m, slot_codes, order = bounded_group_layout(
+        [len(d) for d in key_domains])
 
     # dense gid over the domain cross product; miss detection per key
     gid = jnp.zeros((n,), jnp.int32)
@@ -1422,13 +1449,11 @@ def groupby_aggregate_bounded(
     # time; null slot -> validity False
     for pos, (k, dom) in enumerate(zip(keys, key_domains)):
         c = table.column(k)
-        size = sizes[pos]
         vals = np.zeros((m,), dtype=np.dtype(c.dtype.storage_dtype))
         kvalid = np.zeros((m,), dtype=bool)
         dom_sorted = sorted(dom)
-        stride = int(np.prod(sizes[pos + 1:])) or 1
         for g in range(m):
-            code = (g // stride) % size
+            code = slot_codes[g, pos]
             if code < len(dom_sorted):
                 vals[g] = dom_sorted[code]
                 kvalid[g] = True
@@ -1476,19 +1501,9 @@ def groupby_aggregate_bounded(
                         jnp.asarray(sentinel, c.data.dtype))
         out_cols.append(Column(c.dtype, red, vcount > 0))
 
-    # static reorder: real-key groups first (lexicographic), null-key
-    # groups after — the ORDER BY ... NULLS LAST every consumer wants,
-    # with zero device sort (the permutation is trace-time constant,
-    # derived from the null-slot layout)
-    null_flags = []
-    for i, (dom, size) in enumerate(zip(key_domains, sizes)):
-        stride = int(np.prod(sizes[i + 1:])) or 1
-        null_flags.append([((g // stride) % size) == len(dom)
-                           for g in range(m)])
-    order = sorted(
-        range(m),
-        key=lambda g: (any(nf[g] for nf in null_flags), g),
-    )
+    # static reorder from the shared layout: real-key groups first
+    # (lexicographic), null-key groups after — zero device sort (the
+    # permutation is a trace-time constant)
     perm = jnp.asarray(order, jnp.int32)
     out_cols = [
         Column(c.dtype, c.data[perm],

@@ -1,0 +1,276 @@
+"""Bounded-domain groupby planning — the facility behind the 125x q1 win.
+
+Round-4 hardware measurements (BASELINE.md) showed planner-declared key
+domains beat the general sort-based groupby by 125x at 16M rows: when every
+key column's candidate values are known at plan time, grouping lowers to
+``groupby_aggregate_bounded`` — zero sort, zero gather, zero scan, zero
+scatter; one streaming masked-reduction pass the TPU backend fuses. That
+win was hand-wired into q1 (``_Q1_RF_DOMAIN``); this module makes it a
+planner facility any query can use (VERDICT r4 item 3).
+
+Domain sources mirror what a production Spark planner sees:
+
+* ``scalar_domain`` / ``string_domain`` — DDL facts (CHAR(1) check
+  constraints, enum-like dictionaries: TPC-H fixes l_returnflag to A/N/R,
+  l_shipmode to 7 values, o_orderpriority to 5).
+* ``observed_domain`` — planning-time column statistics (host-side
+  distinct scan; the role the Parquet dictionary page / ORC column
+  statistics play in production — the readers under
+  ``spark_rapids_jni_tpu/parquet`` decode those pages).
+* ``month_domain`` + ``month_bucket`` — date columns bucketed by calendar
+  month: the bucket cardinality is tiny even when the date cardinality is
+  not, so date-bucketed rollups ride the sort-free path.
+
+``plan_groupby`` lowers to the bounded plan when every key carries a
+domain and the slot count fits the budget, else falls back to the general
+``groupby_aggregate`` — with ``domain_miss`` as the runtime escape hatch
+(out-of-domain data re-plans, it never silently drops; the
+``narrowing_overflow`` posture).
+
+Reference analogue: cuDF's groupby dispatches hash vs. sort strategies on
+key properties (vendored capability, /root/reference/build-libcudf.xml:
+34-60); this is the TPU-shaped version of that dispatch, with the planner
+supplying the cardinality facts Spark's optimizer carries.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import (
+    bounded_group_layout,
+    groupby_aggregate,
+    groupby_aggregate_bounded,
+)
+from spark_rapids_jni_tpu.ops.sort import sort_table
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+class Domain(NamedTuple):
+    """Planner-declared candidate values for one groupby key column.
+
+    ``values`` are raw storage scalars for fixed-width keys, or ``str``
+    for string keys; always kept sorted so group output order is the
+    deterministic ORDER BY ... NULLS LAST. ``source`` is provenance
+    ("ddl", "dictionary", "observed", "derived") — recorded for plan
+    explainability, never branched on.
+    """
+
+    values: tuple
+    kind: str  # "scalar" | "string"
+    source: str
+
+
+def scalar_domain(values: Sequence, source: str = "ddl") -> Domain:
+    vals = tuple(sorted(set(int(v) for v in values)))
+    if not vals:
+        raise ValueError("empty domain")
+    return Domain(vals, "scalar", source)
+
+
+def string_domain(values: Sequence[str], source: str = "ddl") -> Domain:
+    # byte-wise sort: the same collation packed_sort_keys uses, so the
+    # bounded output order matches what sort_table would have produced
+    vals = tuple(sorted(set(values), key=lambda s: s.encode()))
+    if not vals:
+        raise ValueError("empty domain")
+    return Domain(vals, "string", source)
+
+
+_OBSERVED_DEFAULT_CAP = 1024
+
+
+def observed_domain(col: Column, max_size: int = _OBSERVED_DEFAULT_CAP,
+                    source: str = "observed") -> Domain | None:
+    """Planning-time statistics: the column's distinct values, gathered
+    host-side (this runs at PLAN time over a sample/stats source, not in
+    the jitted query — production gets the same facts from Parquet
+    dictionary pages or ORC statistics without touching row data).
+    Returns None when cardinality exceeds ``max_size`` — the key is not
+    boundable and the caller stays on the general plan."""
+    if col.dtype.is_string:
+        vals = sorted({v for v in col.to_pylist() if v is not None},
+                      key=lambda s: s.encode())
+        if len(vals) > max_size:
+            return None
+        return Domain(tuple(vals), "string", source) if vals else None
+    if col.dtype.is_decimal128 or col.children is not None:
+        return None
+    data = np.asarray(col.data)
+    if col.validity is not None:
+        data = data[np.asarray(col.validity)]
+    vals = np.unique(data)
+    if vals.size > max_size or vals.size == 0:
+        return None
+    return Domain(tuple(int(v) for v in vals), "scalar", source)
+
+
+def month_code(year: int, month: int) -> int:
+    """Static month-bucket code: year*12 + (month-1)."""
+    return year * 12 + (month - 1)
+
+
+def month_bucket(col: Column) -> Column:
+    """Derived key column: the calendar-month bucket of a date column
+    (int32 ``year*12 + month-1``), jit-traceable. Date cardinality is
+    unbounded; month-bucket cardinality over any query's date range is
+    tiny, which is what puts date-bucketed rollups on the sort-free
+    plan."""
+    from spark_rapids_jni_tpu.ops import datetime as dt
+
+    y = dt.year(col)
+    mth = dt.month(col)
+    code = y.data.astype(jnp.int32) * 12 + (mth.data.astype(jnp.int32) - 1)
+    return Column(t.INT32, code, col.validity)
+
+
+def month_domain(year_lo: int, month_lo: int, year_hi: int, month_hi: int,
+                 source: str = "ddl") -> Domain:
+    """All month-bucket codes in [year_lo-month_lo, year_hi-month_hi]
+    inclusive — the domain a planner derives from a date-range predicate
+    or min/max column statistics."""
+    lo = month_code(year_lo, month_lo)
+    hi = month_code(year_hi, month_hi)
+    if hi < lo:
+        raise ValueError("month range is empty")
+    return Domain(tuple(range(lo, hi + 1)), "scalar", source)
+
+
+def encode_string_key(col: Column, domain: Domain) -> Column:
+    """Dictionary-encode a string key against its declared domain, fully
+    on device: one padded-bytes equality compare per domain value (XLA
+    fuses the d compares into a single pass over the char matrix — no
+    sort, no hash table). Code = index in the sorted domain; rows whose
+    value is outside the domain get code ``len(domain)`` which
+    ``groupby_aggregate_bounded`` flags as ``domain_miss``; null rows
+    stay null (the null slot)."""
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    if domain.kind != "string":
+        raise ValueError("encode_string_key needs a string domain")
+    col = pad_strings(col)
+    w = col.chars.shape[1] if col.chars is not None else 0
+    n = col.chars.shape[0]
+    k = len(domain.values)
+    code = jnp.full((n,), k, jnp.int32)
+    for idx, v in enumerate(domain.values):
+        b = v.encode()
+        if len(b) > w:
+            continue  # longer than every row: cannot match
+        target = np.zeros((w,), np.uint8)
+        target[: len(b)] = np.frombuffer(b, np.uint8)
+        hit = jnp.all(col.chars == jnp.asarray(target)[None, :], axis=1) \
+            if w else jnp.full((n,), len(b) == 0)
+        code = jnp.where(hit, jnp.int32(idx), code)
+    return Column(t.INT32, code, col.validity)
+
+
+class PlannedGroupBy(NamedTuple):
+    """Uniform result of ``plan_groupby`` over both lowerings.
+
+    ``table`` rows are in key order with null-key groups last. On the
+    bounded plan the shape is the static slot count m and ``present``
+    marks live groups; on the general plan the shape is the padded
+    ``max_groups`` budget and ``present`` marks the first
+    ``num_groups`` rows. ``domain_miss`` is False on the general plan
+    (nothing to miss). ``overflowed`` is the general plan's escape
+    hatch: True when the data held more groups than the budget (the
+    excess was dropped — grow the budget and retry, the
+    groupby_aggregate_auto posture); always False on the bounded plan,
+    whose slot count is checked at plan time."""
+
+    table: Table
+    present: jnp.ndarray
+    domain_miss: jnp.ndarray
+    lowered: str  # "bounded" | "general" — static plan fact
+    # bool or jnp scalar; a plain False default keeps module import free
+    # of backend initialization (import-hygiene contract)
+    overflowed: object = False
+
+
+@func_range("plan_groupby")
+def plan_groupby(
+    table: Table,
+    keys: Sequence[int],
+    aggs: Sequence[tuple[int, str]],
+    domains: Sequence[Domain | None],
+    budget: int = 4096,
+) -> PlannedGroupBy:
+    """Lower a groupby to the sort-free bounded plan when the planner can
+    bound every key, else to the general sort-based plan.
+
+    Bounded eligibility: every key has a declared ``Domain``, the slot
+    count ``prod(len(d)+1)`` fits ``budget``, and every agg is in the
+    associative single-pass set (sum/count/mean/min/max). String keys are
+    dictionary-encoded on device (``encode_string_key``) and decoded back
+    to static string columns at the output — the decode costs nothing at
+    runtime (trace-time constants from ``bounded_group_layout``).
+    """
+    if len(domains) != len(keys):
+        raise ValueError("one Domain (or None) per key required")
+    bounded_ok = (
+        all(d is not None for d in domains)
+        and all(op in ("sum", "count", "mean", "min", "max")
+                for _, op in aggs)
+        and int(np.prod([len(d.values) + 1 for d in domains])) <= budget
+        and table.num_rows > 0
+    )
+    if not bounded_ok:
+        g = groupby_aggregate(table, keys=list(keys), aggs=list(aggs),
+                              max_groups=min(budget, table.num_rows) or 1)
+        srt = sort_table(g.table, list(range(len(keys))),
+                         nulls_first=[False] * len(keys))
+        present = (jnp.arange(srt.num_rows, dtype=jnp.int32)
+                   < g.num_groups)
+        # overflowed surfaces budget-dropped groups — the caller's signal
+        # to grow and retry; never silently swallowed
+        return PlannedGroupBy(srt, present, jnp.bool_(False), "general",
+                              g.overflowed)
+
+    # bounded plan: encode string keys to dense codes, run the static
+    # masked-reduction groupby, decode codes back to strings
+    work_cols = list(table.columns)
+    key_domains: list[Sequence[int]] = []
+    string_positions: dict[int, Domain] = {}
+    for pos, (k, dom) in enumerate(zip(keys, domains)):
+        if dom.kind == "string":
+            work_cols[k] = encode_string_key(table.column(k), dom)
+            key_domains.append(tuple(range(len(dom.values))))
+            string_positions[pos] = dom
+        else:
+            key_domains.append(dom.values)
+    res = groupby_aggregate_bounded(
+        Table(work_cols), keys=list(keys), aggs=list(aggs),
+        key_domains=key_domains)
+
+    if string_positions:
+        _, m, slot_codes, order = bounded_group_layout(
+            [len(d) for d in key_domains])
+        out_cols = list(res.table.columns)
+        for pos, dom in string_positions.items():
+            # static decode, built in numpy (trace-time constants): group
+            # slot i's string is fully determined by the layout
+            w = max((len(v.encode()) for v in dom.values), default=1) or 1
+            mat = np.zeros((m, w), np.uint8)
+            lens = np.zeros((m,), np.int32)
+            valid_np = np.zeros((m,), bool)
+            for i in range(m):
+                code = slot_codes[order[i], pos]
+                if code < len(dom.values):
+                    b = dom.values[code].encode()
+                    mat[i, : len(b)] = np.frombuffer(b, np.uint8)
+                    lens[i] = len(b)
+                    valid_np[i] = True
+            out_cols[pos] = Column(
+                t.STRING, jnp.asarray(lens),
+                jnp.asarray(valid_np) & res.present,
+                chars=jnp.asarray(mat))
+        return PlannedGroupBy(Table(out_cols), res.present,
+                              res.domain_miss, "bounded")
+    return PlannedGroupBy(res.table, res.present, res.domain_miss,
+                          "bounded")

@@ -71,8 +71,11 @@ def compute_fixed_width_layout(
     column_size: list[int] = []
     at_offset = 0
     for dt in schema:
-        if not dt.is_fixed_width:
+        if not (dt.is_fixed_width or dt.is_decimal128):
             raise TypeError("Only fixed width types are currently supported")
+        # DECIMAL128 rows: 16-byte element, 16-byte alignment — the
+        # reference's generic rule (alignment == element size,
+        # row_conversion.cu:439-443) applied to __int128_t
         s = dt.size_bytes
         at_offset = _align(at_offset, s)
         column_start.append(at_offset)
@@ -197,7 +200,7 @@ def convert_from_rows(rows: RowsColumn, schema: Sequence[DType]) -> Table:
     row_conversion.cu:551-555)."""
     schema_t = tuple(schema)
     for dt in schema_t:
-        if not dt.is_fixed_width:
+        if not (dt.is_fixed_width or dt.is_decimal128):
             raise TypeError("Only fixed width types are currently supported")
     _, _, size_per_row = compute_fixed_width_layout(schema_t)
     if size_per_row != rows.row_size or rows.data.shape[0] != rows.num_rows * size_per_row:

@@ -1,0 +1,127 @@
+"""Out-of-core chunked query execution under a device-memory budget.
+
+The driver's north-star metric is TPC-DS SF1000 (BASELINE.json): at that
+scale a fact table does not fit one chip's HBM, and the reference covers
+it with cuDF's chunked Parquet reader (vendored capability,
+/root/reference/build-libcudf.xml:34-60 + BASELINE.json north star). The
+TPU-native equivalent composes pieces that already exist:
+
+* ``ParquetChunkedReader`` / ``OrcChunkedReader`` — row-group/stripe-
+  granularity chunks under an on-disk byte budget;
+* ``MemoryLimiter`` — the RMM-role accounting that turns "would OOM" into
+  a fail-loud reservation contract;
+* ``SpillStore`` — LRU device->host spill (zstd-compressed) for
+  intermediates that outlive their chunk;
+* mergeable partial aggregates — the distributed plans already reduce
+  partials after the shuffle (``q1_distributed_step``); out-of-core runs
+  the same partial->merge shape over TIME (chunk sequence) instead of
+  SPACE (device mesh).
+
+The executor here is deliberately host-driven: chunk iteration, spill
+decisions and compaction happen between jitted regions (XLA needs static
+shapes inside; chunk boundaries are where dynamic sizes are free).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, NamedTuple
+
+from spark_rapids_jni_tpu.columnar import Table
+from spark_rapids_jni_tpu.runtime.memory import (
+    MemoryLimiter,
+    SpillStore,
+    _table_nbytes,
+)
+from spark_rapids_jni_tpu.utils.log import get_logger
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+_log = get_logger(__name__)
+
+
+class OutOfCoreResult(NamedTuple):
+    table: Table
+    chunks: int           # chunks streamed
+    peak_bytes: int       # limiter high-water mark over the whole run
+    spill_stats: dict     # SpillStore counters (spilled/restored/...)
+
+
+@func_range("run_chunked_aggregate")
+def run_chunked_aggregate(
+    chunks: Iterable[Table],
+    partial_fn: Callable[[Table], Table],
+    merge_fn: Callable[[Table], Table],
+    *,
+    limiter: MemoryLimiter,
+    spill: SpillStore | None = None,
+    spill_budget_bytes: int | None = None,
+) -> OutOfCoreResult:
+    """Stream an aggregation over table chunks under a memory budget.
+
+    Contract: at no point are two chunks resident together. Each chunk is
+    reserved against ``limiter`` while its partial is computed and
+    released before the next chunk is faulted in; a chunk that alone
+    exceeds the budget raises ``MemoryLimitExceeded`` (fail loud, never
+    silently over-commit — the narrowing_overflow posture). Partials go
+    through the SpillStore: they stay on device while its budget allows
+    and LRU-spill to (compressed) host memory otherwise, so the merge
+    input never holds un-accounted device bytes either.
+
+    ``partial_fn`` maps one chunk to a small table of mergeable partial
+    rows (sums/counts, NOT averages); ``merge_fn`` maps the concatenation
+    of all partials to the final table. The partial->merge algebra is
+    identical to the distributed two-phase aggregation
+    (models/tpch.py q1_distributed_step), which is what makes the same
+    query plan work over chunks, devices, or both.
+    """
+    from spark_rapids_jni_tpu.ops.table_ops import concatenate
+
+    own_spill = spill is None
+    if own_spill:
+        spill = SpillStore(
+            spill_budget_bytes if spill_budget_bytes is not None
+            else limiter.budget)
+    handles: list[int] = []
+    nchunks = 0
+    for chunk in chunks:
+        nb = _table_nbytes(chunk)
+        limiter.reserve(nb)
+        try:
+            partial = partial_fn(chunk)
+            handles.append(spill.put(partial))
+        finally:
+            limiter.release(nb)
+        del chunk
+        nchunks += 1
+    if not handles:
+        raise ValueError("no chunks: empty input stream")
+    _log.info("out-of-core: %d chunks streamed, spill=%s",
+              nchunks, spill.stats())
+    # merge window: restoring a partial stages it back to device, so every
+    # restored partial is reserved before the next one comes up — a partial
+    # set that alone exceeds the budget raises instead of over-committing.
+    # During the concatenate both the partials and the merged table are
+    # resident (reserved together); the partials release the moment the
+    # concat result exists.
+    partials: list[Table] = []
+    partial_bytes = 0
+    for h in handles:
+        ptab = spill.get(h)
+        spill.drop(h)
+        nb_p = _table_nbytes(ptab)
+        limiter.reserve(nb_p)
+        partial_bytes += nb_p
+        partials.append(ptab)
+    if len(partials) > 1:
+        merged_in = concatenate(partials)
+        nb = _table_nbytes(merged_in)
+        limiter.reserve(nb)
+        del partials
+        limiter.release(partial_bytes)
+    else:
+        merged_in = partials[0]
+        nb = partial_bytes
+    try:
+        out = merge_fn(merged_in)
+    finally:
+        limiter.release(nb)
+    return OutOfCoreResult(out, nchunks, limiter.peak, spill.stats())

@@ -152,7 +152,11 @@ class DType:
     @property
     def size_bytes(self) -> int:
         """Fixed-width element size; also its required alignment in a packed
-        row (reference row_conversion.cu:439-443)."""
+        row (reference row_conversion.cu:439-443). DECIMAL128 is 16 — the
+        sizeof(__int128_t) the reference's generic fixed-width layout sees
+        (row_conversion.cu:462-468 via cudf::size_of)."""
+        if self.is_decimal128:
+            return 16
         return self.storage_dtype.itemsize
 
     @property

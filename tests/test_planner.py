@@ -1,0 +1,382 @@
+"""Bounded-domain groupby planner (ops/planner.py) — VERDICT r4 item 3.
+
+The 125x q1 win came from planner-declared key domains; these tests pin
+the generalized facility: domain sources (DDL, observed stats, month
+buckets), on-device string dictionary encoding, bounded-vs-general
+lowering parity against numpy oracles, the domain_miss escape hatch, and
+the sort-free HLO contract on the new planned queries (q12, q4).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.planner import (
+    Domain,
+    encode_string_key,
+    month_bucket,
+    month_code,
+    month_domain,
+    observed_domain,
+    plan_groupby,
+    scalar_domain,
+    string_domain,
+)
+
+
+def _groups(table, present=None, nkeys=1):
+    """{key tuple: agg tuple} over valid (present) group rows."""
+    cols = [c.to_pylist() for c in table.columns]
+    out = {}
+    for i in range(len(cols[0])):
+        if present is not None and not bool(np.asarray(present)[i]):
+            continue
+        key = tuple(cols[k][i] for k in range(nkeys))
+        if any(k is None for k in key):
+            continue
+        out[key] = tuple(cols[k][i] for k in range(nkeys, len(cols)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# domain sources
+# ---------------------------------------------------------------------------
+
+
+def test_scalar_domain_sorted_deduped():
+    d = scalar_domain([3, 1, 3, 2])
+    assert d.values == (1, 2, 3) and d.kind == "scalar"
+
+
+def test_string_domain_byte_order():
+    d = string_domain(["SHIP", "AIR", "MAIL"])
+    assert d.values == ("AIR", "MAIL", "SHIP")
+
+
+def test_observed_domain_scalar(rng):
+    col = Column.from_numpy(
+        rng.integers(0, 5, 200).astype(np.int32))
+    d = observed_domain(col)
+    assert d.kind == "scalar" and set(d.values) <= set(range(5))
+
+
+def test_observed_domain_respects_nulls_and_cap(rng):
+    vals = rng.integers(0, 1000, 2000).astype(np.int64)
+    col = Column.from_numpy(vals)
+    assert observed_domain(col, max_size=10) is None  # not boundable
+
+
+def test_observed_domain_strings():
+    col = Column.from_pylist(["b", "a", None, "b"], t.STRING)
+    d = observed_domain(col)
+    assert d.values == ("a", "b") and d.kind == "string"
+
+
+def test_month_domain_and_code():
+    d = month_domain(1995, 11, 1996, 2)
+    assert d.values == tuple(
+        month_code(1995, 11) + i for i in range(4))
+
+
+def test_month_bucket_matches_calendar():
+    import datetime as pydt
+
+    days = [9131, 8400, 0, 10956]  # various epochs-days
+    col = Column.from_numpy(np.asarray(days, np.int32), t.TIMESTAMP_DAYS)
+    got = np.asarray(month_bucket(col).data)
+    for i, dday in enumerate(days):
+        d = pydt.date(1970, 1, 1) + pydt.timedelta(days=dday)
+        assert got[i] == month_code(d.year, d.month)
+
+
+# ---------------------------------------------------------------------------
+# string encoding
+# ---------------------------------------------------------------------------
+
+
+def test_encode_string_key_codes_and_miss():
+    col = Column.from_pylist(
+        ["MAIL", "SHIP", "AIR", None, "MAIL"], t.STRING)
+    dom = string_domain(["MAIL", "SHIP"])
+    code = encode_string_key(col, dom)
+    # sorted domain: MAIL=0, SHIP=1; AIR (out of domain) -> k=2
+    assert np.asarray(code.data).tolist() == [0, 1, 2, 2, 0]
+    assert np.asarray(code.valid_mask()).tolist() == [
+        True, True, True, False, True]
+
+
+def test_encode_prefix_not_equal():
+    # "AIR" must not match "AIR REG" and vice versa (padded-bytes
+    # equality is exact, not prefix)
+    col = Column.from_pylist(["AIR", "AIR REG"], t.STRING)
+    dom = string_domain(["AIR REG"])
+    code = encode_string_key(col, dom)
+    assert np.asarray(code.data).tolist() == [1, 0]
+
+
+# ---------------------------------------------------------------------------
+# plan_groupby lowering parity
+# ---------------------------------------------------------------------------
+
+
+def _oracle(keys_lists, val_list):
+    out = {}
+    for i in range(len(val_list)):
+        key = tuple(k[i] for k in keys_lists)
+        if any(v is None for v in key) or val_list[i] is None:
+            if any(v is None for v in key):
+                continue
+        out.setdefault(key, 0)
+        if val_list[i] is not None:
+            out[key] += val_list[i]
+    return out
+
+
+def test_bounded_scalar_matches_general_and_oracle(rng):
+    n = 500
+    k1 = rng.integers(0, 3, n).astype(np.int8)
+    k2 = rng.integers(10, 12, n).astype(np.int32)
+    v = rng.integers(-50, 50, n).astype(np.int64)
+    kv1 = rng.random(n) > 0.1
+    tbl = Table([
+        Column.from_numpy(k1, validity=kv1),
+        Column.from_numpy(k2),
+        Column.from_numpy(v),
+    ])
+    doms = [scalar_domain([0, 1, 2]), scalar_domain([10, 11])]
+    b = plan_groupby(tbl, [0, 1], [(2, "sum")], doms)
+    assert b.lowered == "bounded" and not bool(b.domain_miss)
+    g = plan_groupby(tbl, [0, 1], [(2, "sum")], [None, None])
+    assert g.lowered == "general"
+    got_b = _groups(b.table, b.present, nkeys=2)
+    got_g = _groups(g.table, g.present, nkeys=2)
+    oracle = {}
+    for i in range(n):
+        if not kv1[i]:
+            continue
+        key = (int(k1[i]), int(k2[i]))
+        oracle[key] = (oracle.get(key, (0,))[0] + int(v[i]),)
+    assert got_b == oracle and got_g == oracle
+
+
+def test_bounded_string_key_decodes_to_strings(rng):
+    n = 300
+    modes = ["AIR", "MAIL", "SHIP", "RAIL"]
+    idx = rng.integers(0, 4, n)
+    vals = rng.integers(0, 100, n).astype(np.int64)
+    tbl = Table([
+        Column.from_pylist([modes[i] for i in idx], t.STRING),
+        Column.from_numpy(vals),
+    ])
+    res = plan_groupby(tbl, [0], [(1, "sum"), (1, "count")],
+                       [string_domain(modes)])
+    assert res.lowered == "bounded"
+    got = _groups(res.table, res.present)
+    oracle = {}
+    for i in range(n):
+        key = (modes[idx[i]],)
+        s, c = oracle.get(key, (0, 0))
+        oracle[key] = (s + int(vals[i]), c + 1)
+    assert got == oracle
+    # static output order: lexicographic keys, nulls last
+    keys = [k for k in res.table.column(0).to_pylist() if k is not None]
+    present = np.asarray(res.present)
+    live = [k for k, p in zip(res.table.column(0).to_pylist(), present)
+            if p and k is not None]
+    assert live == sorted(live)
+
+
+def test_domain_miss_flags_out_of_domain_value():
+    tbl = Table([
+        Column.from_pylist(["MAIL", "TRUCK"], t.STRING),
+        Column.from_numpy(np.asarray([1, 2], np.int64)),
+    ])
+    res = plan_groupby(tbl, [0], [(1, "sum")],
+                       [string_domain(["MAIL", "SHIP"])])
+    assert bool(res.domain_miss)
+
+
+def test_budget_overflow_falls_back_to_general():
+    tbl = Table([
+        Column.from_numpy(np.arange(100, dtype=np.int32)),
+        Column.from_numpy(np.ones(100, np.int64)),
+    ])
+    res = plan_groupby(tbl, [0], [(1, "sum")],
+                       [scalar_domain(range(100))], budget=50)
+    assert res.lowered == "general"
+    # the budget capped the general groupby: dropped groups must SIGNAL
+    # (the caller's grow-and-retry cue), never silently truncate
+    assert bool(res.overflowed)
+    got = _groups(res.table, res.present)
+    assert len(got) == 50
+    assert all(v == (1,) for v in got.values())
+
+
+def test_general_plan_under_budget_not_overflowed():
+    tbl = Table([
+        Column.from_numpy(np.asarray([1, 2, 1], np.int32)),
+        Column.from_numpy(np.asarray([5, 6, 7], np.int64)),
+    ])
+    res = plan_groupby(tbl, [0], [(1, "sum")], [None])
+    assert res.lowered == "general" and not bool(res.overflowed)
+    assert _groups(res.table, res.present) == {(1,): (12,), (2,): (6,)}
+
+
+def test_unsupported_agg_falls_back():
+    tbl = Table([
+        Column.from_numpy(np.asarray([0, 0, 1], np.int32)),
+        Column.from_numpy(np.asarray([5, 7, 9], np.int64)),
+    ])
+    res = plan_groupby(tbl, [0], [(1, "var")], [scalar_domain([0, 1])])
+    assert res.lowered == "general"
+
+
+def test_month_bucket_rollup_on_sort_free_path(rng):
+    """Date-bucketed revenue rollup: unbounded date cardinality, tiny
+    month-bucket domain — the date-bucket aggregation pattern VERDICT r4
+    item 3 names (q3 date buckets / q14 months)."""
+    n = 400
+    days = rng.integers(9131, 9131 + 120, n).astype(np.int32)  # ~4 months
+    rev = rng.integers(0, 1000, n).astype(np.int64)
+    dates = Column.from_numpy(days, t.TIMESTAMP_DAYS)
+    tbl = Table([month_bucket(dates), Column.from_numpy(rev)])
+    dom = month_domain(1995, 1, 1995, 6)
+    res = plan_groupby(tbl, [0], [(1, "sum")], [dom])
+    assert res.lowered == "bounded" and not bool(res.domain_miss)
+    got = _groups(res.table, res.present)
+    import datetime as pydt
+
+    oracle = {}
+    for i in range(n):
+        d = pydt.date(1970, 1, 1) + pydt.timedelta(days=int(days[i]))
+        key = (month_code(d.year, d.month),)
+        oracle[key] = oracle.get(key, 0) + int(rev[i])
+    assert {k: v[0] for k, v in got.items()} == oracle
+
+
+def test_bounded_string_plan_is_sort_free(rng):
+    """HLO pin (the test_tpch.py:239 contract, now for string keys):
+    encode + bounded groupby + decode lowers with zero sorts and zero
+    scatters."""
+    n = 256
+    modes = ["AIR", "MAIL", "SHIP"]
+    idx = rng.integers(0, 3, n)
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    col = pad_strings(Column.from_pylist(
+        [modes[i] for i in idx], t.STRING))
+    vals = Column.from_numpy(rng.integers(0, 9, n).astype(np.int64))
+    dom = string_domain(modes)
+
+    def digest(mode_col, val_col):
+        res = plan_groupby(Table([mode_col, val_col]), [0],
+                           [(1, "sum")], [dom])
+        acc = jnp.float64(0)
+        for c in res.table.columns:
+            acc = acc + jnp.sum(c.data).astype(jnp.float64)
+            acc = acc + jnp.sum(c.valid_mask())
+            if c.chars is not None:
+                acc = acc + jnp.sum(c.chars)
+        return acc + jnp.sum(res.present) + res.domain_miss
+
+    hlo = jax.jit(digest).lower(col, vals).compile().as_text()
+    assert not [l for l in hlo.splitlines()
+                if re.search(r"= \S+ sort\(", l)]
+    assert not [l for l in hlo.splitlines() if " scatter(" in l]
+
+
+# ---------------------------------------------------------------------------
+# planned q12 / q4 — two more queries on the sort-free path
+# ---------------------------------------------------------------------------
+
+
+def test_q12_planned_matches_oracle():
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q12_table,
+        orders_q12_table,
+        tpch_q12_numpy,
+        tpch_q12_planned_result,
+    )
+
+    li = lineitem_q12_table(800, 300)
+    orders = orders_q12_table(300)
+    res = tpch_q12_planned_result(orders, li)
+    assert res.lowered == "bounded" and not bool(res.domain_miss)
+    got = {k[0]: list(v) for k, v in
+           _groups(res.table, res.present).items()}
+    oracle = tpch_q12_numpy(orders, li)
+    assert got == oracle
+
+
+def test_q4_planned_matches_oracle():
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q12_table,
+        orders_q4_table,
+        tpch_q4_numpy,
+        tpch_q4_planned_result,
+    )
+
+    orders = orders_q4_table(400)
+    li = lineitem_q12_table(900, 400)
+    res = tpch_q4_planned_result(orders, li)
+    assert res.lowered == "bounded" and not bool(res.domain_miss)
+    got = {k[0]: v[0] for k, v in
+           _groups(res.table, res.present).items()}
+    oracle = tpch_q4_numpy(orders, li)
+    assert got == oracle
+
+
+def test_q12_planned_agg_stage_sort_free():
+    """The aggregation stage of planned q12 (post-join keyed table ->
+    grouped output) compiles with zero sorts/scatters. The join itself
+    is sort-based machinery and is outside this pin."""
+    from spark_rapids_jni_tpu.models.tpch import _Q12_MODES
+    from spark_rapids_jni_tpu.ops.planner import plan_groupby, string_domain
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    rng = np.random.default_rng(0)
+    n = 256
+    modes = ["MAIL", "SHIP"]
+    idx = rng.integers(0, 2, n)
+    keyed = Table([
+        pad_strings(Column.from_pylist(
+            [modes[i] for i in idx], t.STRING)),
+        Column.from_numpy(rng.integers(0, 2, n).astype(np.int64)),
+        Column.from_numpy(rng.integers(0, 2, n).astype(np.int64)),
+    ])
+
+    def digest(tb):
+        res = plan_groupby(tb, [0], [(1, "sum"), (2, "sum")],
+                           [string_domain(modes)])
+        acc = jnp.float64(0)
+        for c in res.table.columns:
+            acc = acc + jnp.sum(c.data).astype(jnp.float64)
+            if c.chars is not None:
+                acc = acc + jnp.sum(c.chars)
+        return acc
+
+    hlo = jax.jit(digest).lower(keyed).compile().as_text()
+    assert not [l for l in hlo.splitlines()
+                if re.search(r"= \S+ sort\(", l)]
+    assert not [l for l in hlo.splitlines() if " scatter(" in l]
+
+
+def test_q1_planned_still_lowers_bounded():
+    """q1 rewired through the planner facility keeps its contract."""
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_table,
+        tpch_q1_numpy,
+        tpch_q1_planned,
+    )
+    from tests.test_tpch import _q1_groups
+
+    li = lineitem_table(512, seed=3)
+    out = tpch_q1_planned(li)
+    oracle = tpch_q1_numpy(li)
+    got = _q1_groups(out)
+    assert got.keys() == oracle.keys()
