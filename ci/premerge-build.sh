@@ -3,8 +3,8 @@
 # native core, require a real accelerator (the reference gates on nvidia-smi,
 # ci/premerge-build.sh:21; here the gate is a visible TPU/accelerator jax
 # backend unless PREMERGE_ALLOW_CPU=1), then run the FAST test tier
-# (-m "not slow"; PREMERGE_FULL=1 opts into the full suite — the nightly
-# always runs everything).
+# (-m "not slow and not medium"; PREMERGE_FULL=1 opts into the full
+# suite — the nightly always runs everything).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -27,10 +27,11 @@ fi
 
 python build_scripts/build-info.py
 bash ci/java-build.sh   # self-gating: skips (exit 0) where no JDK exists
-# fast tier by default: the `slow` marker holds the >=45 s distributed
-# runs (the nightly runs everything); PREMERGE_FULL=1 opts back in
+# fast tier by default: `slow` holds multi-process spawns, `medium` the
+# >=14 s oracle sweeps (tier manifest in tests/conftest.py — the nightly
+# runs everything); PREMERGE_FULL=1 opts back into the full suite
 if [[ "${PREMERGE_FULL:-0}" == "1" ]]; then
   python -m pytest tests/ -x -q
 else
-  python -m pytest tests/ -x -q -m "not slow"
+  python -m pytest tests/ -x -q -m "not slow and not medium"
 fi

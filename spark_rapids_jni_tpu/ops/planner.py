@@ -213,12 +213,14 @@ def plan_groupby(
     """
     if len(domains) != len(keys):
         raise ValueError("one Domain (or None) per key required")
+    # NOTE: no row-count condition — lowering is a static plan fact
+    # (empty tables take the bounded plan too; groupby_aggregate_bounded
+    # handles n == 0 with its static slot table)
     bounded_ok = (
         all(d is not None for d in domains)
         and all(op in ("sum", "count", "mean", "min", "max")
                 for _, op in aggs)
         and int(np.prod([len(d.values) + 1 for d in domains])) <= budget
-        and table.num_rows > 0
     )
     if not bounded_ok:
         g = groupby_aggregate(table, keys=list(keys), aggs=list(aggs),

@@ -1,0 +1,557 @@
+"""Device regexp_extract / regexp_replace: capture tracking over the
+byte-DFA machinery (VERDICT r4 item 7).
+
+``regexp_contains`` needs one DFA verdict per row; EXTRACT needs the
+capture-group BOUNDARIES of the first match, which a single DFA cannot
+produce. The classic answer is a tagged automaton; the TPU-shaped answer
+here is the two-pass scheme the verdict sketched, specialized to LINEAR
+patterns (a concatenation of literals and quantified byte-classes, with
+non-nested capture groups — which covers the bulk of practical extraction
+patterns: ``(\\d+)``, ``id=(\\w+);``, ``([a-z]+)-(\\d+)``, ...):
+
+1. **Suffix feasibility (reverse DFA passes).** For each element index k,
+   a DFA for the REVERSED suffix pattern ``rev(E_m)..rev(E_k)`` runs once
+   over the reversed padded char matrix, yielding ``feas_k[i]`` = "can
+   elements k..m match starting at byte i" for ALL i in one O(n*W) scan
+   (state-table gathers, zero scatters — the regexp_contains cost model).
+2. **Greedy boundary walk (forward, one masked reduction per element).**
+   The match start is the smallest feasible i (Java's leftmost rule).
+   Element k's end is then the LARGEST (greedy; smallest for lazy ``?``)
+   t with ``t - p`` in the quantifier range, all bytes in ``[p, t)``
+   inside the class (one reverse-cummin "next non-class byte" pass), and
+   ``feas_{k+1}[t]`` — exactly Java's backtracking priority, computed
+   without backtracking because feasibility already encodes "the rest
+   can still match".
+
+Group values are substring gathers over the recorded boundaries.
+``regexp_replace`` iterates the same first-match engine from a moving
+cursor (bounded rounds, Java's empty-match advance rule) and rebuilds
+rows with a piece-table gather.
+
+Correctness scope (dispatcher-enforced): linear patterns only (no
+alternation, no nesting), ASCII-only classes/literals, and all-ASCII
+input rows (checked at runtime — ``.`` and negated classes are byte-level
+here, which equals char-level exactly on ASCII data). Everything else
+takes the host java.util.regex emulation — the two-engine posture of
+regexp_contains/get_json_object. cuDF analogue: the vendored device regex
+engine (SURVEY.md section 2.2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.ops.regex_device import (
+    MAX_DFA_STATES,
+    MAX_EXPANSION,
+    RegexUnsupported,
+    _closure,
+    _Nfa,
+)
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+_MAX_ELEMENTS = 24
+_ANY_NO_NUL = frozenset(range(1, 256))
+
+_D = frozenset(range(0x30, 0x3A))
+_W_SET = (frozenset(range(0x30, 0x3A)) | frozenset(range(0x41, 0x5B))
+          | frozenset(range(0x61, 0x7B)) | {0x5F})
+_S = frozenset(b" \t\n\x0b\f\r")
+_ASCII = frozenset(range(1, 128))
+_ASCII_NO_NL = _ASCII - {0x0A}
+
+
+class LinearElement(NamedTuple):
+    byteset: frozenset  # candidate bytes (single-byte steps)
+    lo: int             # min repetitions
+    hi: Optional[int]   # max repetitions, None = unbounded
+    lazy: bool
+
+
+class LinearPattern(NamedTuple):
+    elements: tuple            # of LinearElement
+    groups: tuple              # group g (1-based) -> (first_el, last_el+1)
+    anchored_start: bool
+    anchored_end: bool
+
+
+class _LinParser:
+    """Linear-subset parser: concatenation of quantified single-byte
+    atoms and flat capture groups. Anything outside the subset raises
+    RegexUnsupported (the dispatcher's host-fallback signal)."""
+
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+
+    def _peek(self):
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def _take(self):
+        c = self._peek()
+        if c is None:
+            raise RegexUnsupported("unexpected end of pattern")
+        self.i += 1
+        return c
+
+    def parse(self) -> LinearPattern:
+        anchored_start = anchored_end = False
+        if self._peek() == "^":
+            self._take()
+            anchored_start = True
+        elements: list[LinearElement] = []
+        groups: list[tuple[int, int]] = []
+        while self._peek() is not None:
+            c = self._peek()
+            if c == "$":
+                self._take()
+                if self._peek() is not None:
+                    raise RegexUnsupported("mid-pattern $")
+                anchored_end = True
+                break
+            if c == "|":
+                raise RegexUnsupported("alternation")
+            if c == ")":
+                raise RegexUnsupported("unbalanced )")
+            if c == "(":
+                self._take()
+                capturing = True
+                if self._peek() == "?":
+                    self._take()
+                    if self._peek() != ":":
+                        raise RegexUnsupported("(?...) construct")
+                    self._take()
+                    capturing = False
+                first = len(elements)
+                while self._peek() not in (")", None):
+                    if self._peek() in ("(",):
+                        raise RegexUnsupported("nested group")
+                    if self._peek() == "|":
+                        raise RegexUnsupported("alternation")
+                    elements.append(self._quantified_atom())
+                if self._take() != ")":
+                    raise RegexUnsupported("unbalanced (")
+                if self._peek() in ("*", "+", "?", "{"):
+                    raise RegexUnsupported("quantified group")
+                if capturing:
+                    groups.append((first, len(elements)))
+                continue
+            elements.append(self._quantified_atom())
+        if not elements:
+            raise RegexUnsupported("empty pattern")
+        if len(elements) > _MAX_ELEMENTS:
+            raise RegexUnsupported(f"more than {_MAX_ELEMENTS} elements")
+        return LinearPattern(tuple(elements), tuple(groups),
+                             anchored_start, anchored_end)
+
+    def _quantified_atom(self) -> LinearElement:
+        byteset = self._atom()
+        lo, hi = 1, 1
+        c = self._peek()
+        if c == "*":
+            self._take()
+            lo, hi = 0, None
+        elif c == "+":
+            self._take()
+            lo, hi = 1, None
+        elif c == "?":
+            self._take()
+            lo, hi = 0, 1
+        elif c == "{":
+            self._take()
+            digs = ""
+            while self._peek() and self._peek().isdigit():
+                digs += self._take()
+            if not digs:
+                raise RegexUnsupported("bad {} quantifier")
+            lo = int(digs)
+            if self._peek() == ",":
+                self._take()
+                digs2 = ""
+                while self._peek() and self._peek().isdigit():
+                    digs2 += self._take()
+                hi = int(digs2) if digs2 else None
+            else:
+                hi = lo
+            if self._take() != "}":
+                raise RegexUnsupported("bad {} quantifier")
+            if hi is not None and hi < lo:
+                raise RegexUnsupported("bad {} range")
+            if lo > MAX_EXPANSION or (hi or 0) > MAX_EXPANSION:
+                raise RegexUnsupported("quantifier too large")
+        lazy = False
+        if self._peek() == "?" and (lo, hi) != (1, 1):
+            self._take()
+            lazy = True
+        if self._peek() in ("*", "+", "?", "{") and (lo, hi) != (1, 1):
+            raise RegexUnsupported("double quantifier")
+        return LinearElement(byteset, lo, hi, lazy)
+
+    def _atom(self) -> frozenset:
+        c = self._take()
+        if c == ".":
+            return _ASCII_NO_NL
+        if c == "[":
+            return self._char_class()
+        if c == "\\":
+            return self._escape()
+        if c in "*+?{":
+            raise RegexUnsupported("dangling quantifier")
+        if ord(c) > 0x7F:
+            raise RegexUnsupported("non-ASCII literal")
+        return frozenset([ord(c)])
+
+    def _escape(self) -> frozenset:
+        c = self._take()
+        table = {"d": _D, "D": _ASCII - _D, "w": _W_SET,
+                 "W": _ASCII - _W_SET, "s": _S, "S": _ASCII - _S,
+                 "n": frozenset(b"\n"), "t": frozenset(b"\t"),
+                 "r": frozenset(b"\r")}
+        if c in table:
+            return table[c]
+        if not c.isalnum():
+            return frozenset([ord(c)])
+        raise RegexUnsupported(f"escape \\{c}")
+
+    def _char_class(self) -> frozenset:
+        negated = False
+        if self._peek() == "^":
+            self._take()
+            negated = True
+        members: set[int] = set()
+        first = True
+        while True:
+            c = self._peek()
+            if c is None:
+                raise RegexUnsupported("unterminated class")
+            if c == "]" and not first:
+                self._take()
+                break
+            first = False
+            if c == "\\":
+                self._take()
+                members |= self._escape()
+                continue
+            self._take()
+            if ord(c) > 0x7F:
+                raise RegexUnsupported("non-ASCII class member")
+            if self._peek() == "-" and self.i + 1 < len(self.p) \
+                    and self.p[self.i + 1] != "]":
+                self._take()
+                d = self._take()
+                if d == "\\" or ord(d) > 0x7F or ord(d) < ord(c):
+                    raise RegexUnsupported("complex class range")
+                members |= set(range(ord(c), ord(d) + 1))
+            else:
+                members.add(ord(c))
+        if negated:
+            return _ASCII - frozenset(members)
+        if not members:
+            raise RegexUnsupported("empty class")
+        return frozenset(members)
+
+
+def parse_linear(pattern: str) -> LinearPattern:
+    return _LinParser(pattern).parse()
+
+
+# ---------------------------------------------------------------------------
+# suffix feasibility DFAs
+# ---------------------------------------------------------------------------
+
+
+def _append_element_rev(nfa: _Nfa, cur: int, el: LinearElement) -> int:
+    """Chain one element (class semantics are order-free, so the reversed
+    element is itself) onto ``cur``; returns the new chain end."""
+    for _ in range(el.lo):
+        s = nfa.new_state()
+        nfa.add(cur, el.byteset, s)
+        cur = s
+    if el.hi is None:
+        s = nfa.new_state()
+        nfa.add(cur, None, s)
+        nfa.add(s, el.byteset, s)
+        cur = s
+    else:
+        end = nfa.new_state()
+        nfa.add(cur, None, end)
+        for _ in range(el.hi - el.lo):
+            s = nfa.new_state()
+            nfa.add(cur, el.byteset, s)
+            nfa.add(s, None, end)
+            cur = s
+        cur = end
+    return cur
+
+
+def _subset_construct(nfa: _Nfa, start: int, final: int):
+    """NFA -> DFA transition table + accept vector (the regexp_contains
+    construction, parameterized for reuse)."""
+    d0 = _closure(nfa, frozenset([start]))
+    ids = {d0: 0}
+    order = [d0]
+    trans: list[np.ndarray] = []
+    qi = 0
+    while qi < len(order):
+        cur = order[qi]
+        qi += 1
+        row = np.full(256, -1, dtype=np.int32)
+        move: dict[int, set] = {}
+        for s in cur:
+            for byteset, tgt in nfa.edges[s]:
+                if byteset is None:
+                    continue
+                for b in byteset:
+                    move.setdefault(b, set()).add(tgt)
+        cache: dict[frozenset, int] = {}
+        for b, tgts in move.items():
+            key = frozenset(tgts)
+            if key in cache:
+                row[b] = cache[key]
+                continue
+            nxt = _closure(nfa, key)
+            if nxt not in ids:
+                if len(ids) >= MAX_DFA_STATES:
+                    raise RegexUnsupported(
+                        f"DFA exceeds {MAX_DFA_STATES} states")
+                ids[nxt] = len(ids)
+                order.append(nxt)
+            row[b] = ids[nxt]
+            cache[key] = ids[nxt]
+        trans.append(row)
+    dead = len(order)
+    table = np.concatenate(trans).astype(np.int32)
+    table[table < 0] = dead
+    table = np.concatenate([table, np.full(256, dead, dtype=np.int32)])
+    accept = np.array([final in st for st in order] + [False], dtype=bool)
+    return table, accept
+
+
+class CompiledLinear(NamedTuple):
+    pattern: LinearPattern
+    # per suffix k in 0..m: (table, accept) of the reversed-suffix DFA
+    suffix_dfas: tuple
+
+
+@functools.lru_cache(maxsize=256)
+def compile_linear(pattern: str) -> CompiledLinear:
+    """Host compile: the linear pattern + one reversed-suffix DFA per
+    element boundary. LRU-cached per pattern string."""
+    lin = parse_linear(pattern)
+    m = len(lin.elements)
+    dfas = []
+    for k in range(m + 1):
+        nfa = _Nfa()
+        q0 = nfa.new_state()
+        nfa.add(q0, frozenset([0]), q0)  # reversed padding prefix
+        cur = nfa.new_state()
+        nfa.add(q0, None, cur)
+        if not lin.anchored_end:
+            # bytes AFTER the match end (reversed: consumed first)
+            nfa.add(cur, _ANY_NO_NUL, cur)
+        for el in reversed(lin.elements[k:]):
+            cur = _append_element_rev(nfa, cur, el)
+        dfas.append(_subset_construct(nfa, q0, cur))
+    return CompiledLinear(lin, tuple(dfas))
+
+
+# ---------------------------------------------------------------------------
+# device passes
+# ---------------------------------------------------------------------------
+
+
+def _feasibility(chars: jnp.ndarray, table: np.ndarray,
+                 accept: np.ndarray) -> jnp.ndarray:
+    """(n, W) padded chars -> (n, W+1) bool: feas[:, t] = the reversed
+    DFA accepts after consuming the reversed row down to byte t (i.e.
+    the suffix pattern can match starting at t)."""
+    n, w = chars.shape
+    tbl = jnp.asarray(table)
+    acc = jnp.asarray(accept)
+    rev_cols = chars[:, ::-1].T  # (W, n)
+
+    def step(state, col):
+        nxt = tbl[state * 256 + col.astype(jnp.int32)]
+        return nxt, nxt
+
+    init = jnp.zeros((n,), jnp.int32)
+    _, states = jax.lax.scan(step, init, rev_cols)  # (W, n)
+    all_states = jnp.concatenate([init[None, :], states], axis=0)
+    # position t consumed W-t reversed bytes -> state all_states[W-t]
+    return acc[all_states[::-1]].T  # (n, W+1)
+
+
+def _next_nonclass(chars: jnp.ndarray, byteset: frozenset) -> jnp.ndarray:
+    """(n, W) -> (n, W+1) int32: nxt[:, i] = smallest j >= i with
+    chars[:, j] outside the class (W if the run reaches the pad; byte 0
+    is never in a class, so runs always stop at the row end)."""
+    n, w = chars.shape
+    lut = np.zeros(256, bool)
+    lut[list(byteset)] = True
+    inclass = jnp.asarray(lut)[chars.astype(jnp.int32)]  # (n, W)
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    stop = jnp.where(inclass, jnp.int32(w), pos)  # (n, W)
+    # reverse cumulative min: nxt[i] = min(stop[i:], default W)
+    rev_min = jax.lax.cummin(stop[:, ::-1], axis=1)[:, ::-1]
+    return jnp.concatenate(
+        [rev_min, jnp.full((n, 1), w, jnp.int32)], axis=1)
+
+
+class MatchBounds(NamedTuple):
+    matched: jnp.ndarray        # bool[n]
+    starts: jnp.ndarray         # int32[n, m] element starts
+    ends: jnp.ndarray           # int32[n, m] element ends
+
+
+def _first_match(chars: jnp.ndarray, comp: CompiledLinear,
+                 feas: list[jnp.ndarray],
+                 cursor: jnp.ndarray) -> MatchBounds:
+    """Boundaries of the leftmost match starting at or after ``cursor``
+    (int32[n]), via the greedy walk. All O(n*W) masked reductions."""
+    lin = comp.pattern
+    n, w = chars.shape
+    m = len(lin.elements)
+    t_idx = jnp.arange(w + 1, dtype=jnp.int32)[None, :]
+
+    # leftmost feasible start
+    start_ok = feas[0] & (t_idx >= cursor[:, None])
+    if lin.anchored_start:
+        start_ok = start_ok & (t_idx == 0)
+    any_start = jnp.any(start_ok, axis=1)
+    s = jnp.where(
+        any_start,
+        jnp.argmax(start_ok, axis=1).astype(jnp.int32),
+        jnp.int32(w))
+
+    starts, ends = [], []
+    p = s
+    for k, el in enumerate(lin.elements):
+        nxt = _next_nonclass(chars, el.byteset)
+        run_end = jnp.take_along_axis(
+            nxt, jnp.clip(p, 0, w)[:, None], axis=1)[:, 0]
+        hi_eff = w if el.hi is None else el.hi
+        upper = jnp.minimum(p + hi_eff, run_end)
+        lower = p + el.lo
+        mask = ((t_idx >= lower[:, None]) & (t_idx <= upper[:, None])
+                & feas[k + 1])
+        if el.lazy:
+            j = jnp.min(jnp.where(mask, t_idx, w + 1), axis=1)
+        else:
+            j = jnp.max(jnp.where(mask, t_idx, -1), axis=1)
+        # feasibility guarantees a masked candidate when feas[k][p] holds;
+        # unmatched rows just carry harmless clipped positions
+        j = jnp.clip(j, 0, w).astype(jnp.int32)
+        starts.append(p)
+        ends.append(j)
+        p = j
+    return MatchBounds(any_start, jnp.stack(starts, axis=1),
+                       jnp.stack(ends, axis=1))
+
+
+@func_range("regexp_extract_device")
+def extract_device(chars: jnp.ndarray, comp: CompiledLinear,
+                   group: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(lengths int32[n], out_chars uint8[n, W]) for Spark
+    regexp_extract semantics: group'th capture of the first match, ''
+    on no-match. ``group`` 0 = the whole match."""
+    lin = comp.pattern
+    n, w = chars.shape
+    feas = [_feasibility(chars, tbl, acc) for tbl, acc in comp.suffix_dfas]
+    mb = _first_match(chars, comp, feas, jnp.zeros((n,), jnp.int32))
+    if group == 0:
+        b = mb.starts[:, 0]
+        e = mb.ends[:, -1]
+    else:
+        first_el, end_el = lin.groups[group - 1]
+        if first_el == end_el:  # empty group body: zero-width capture
+            b = e = (mb.starts[:, first_el] if first_el < len(lin.elements)
+                     else mb.ends[:, -1])
+        else:
+            b = mb.starts[:, first_el]
+            e = mb.ends[:, end_el - 1]
+    b = jnp.where(mb.matched, b, 0)
+    e = jnp.where(mb.matched, e, 0)
+    lengths = (e - b).astype(jnp.int32)
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    src = jnp.clip(b[:, None] + pos, 0, w - 1)
+    out = jnp.where(pos < lengths[:, None],
+                    jnp.take_along_axis(chars, src, axis=1),
+                    jnp.uint8(0))
+    return lengths, out
+
+
+@func_range("regexp_replace_device")
+def replace_device(chars: jnp.ndarray, lengths: jnp.ndarray,
+                   comp: CompiledLinear, replacement: bytes,
+                   max_matches: int = 8):
+    """Replace ALL matches with a literal replacement, Java semantics
+    (left-to-right non-overlapping; an empty match advances the cursor
+    by one). Returns (out_lengths, out_chars, overflowed) —
+    ``overflowed`` True for any row with matches beyond ``max_matches``
+    rounds (the dispatcher's host-recompute signal).
+    """
+    lin = comp.pattern
+    n, w = chars.shape
+    feas = [_feasibility(chars, tbl, acc) for tbl, acc in comp.suffix_dfas]
+    rep = np.frombuffer(replacement, np.uint8)
+    rl = len(rep)
+
+    # (b, e, hit) per round; non-hit rows park the span at the row end so
+    # the piece loop's keep-segment arithmetic degenerates harmlessly
+    spans: list[tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]] = []
+    cursor = jnp.zeros((n,), jnp.int32)
+    active = jnp.ones((n,), jnp.bool_)
+    for _ in range(max_matches):
+        mb = _first_match(chars, comp, feas, cursor)
+        hit = active & mb.matched & (mb.starts[:, 0] <= lengths)
+        b = jnp.where(hit, mb.starts[:, 0], lengths)
+        e = jnp.where(hit, mb.ends[:, -1], lengths)
+        spans.append((b, e, hit))
+        # Java empty-match rule: advance at least one byte
+        cursor = jnp.where(hit, jnp.maximum(e, b + 1), jnp.int32(w + 1))
+        active = hit
+    # a row overflows when another match still starts inside the row
+    # after the final cursor — the dispatcher recomputes those on host
+    t_idx = jnp.arange(w + 1, dtype=jnp.int32)[None, :]
+    more = jnp.any(feas[0] & (t_idx >= cursor[:, None])
+                   & (t_idx <= lengths[:, None]), axis=1)
+    overflowed = jnp.any(more & active)
+
+    # piece-table rebuild: per round, keep [prev_e, b) then the literal
+    # replacement; one final tail segment — all masked gathers. Bound:
+    # an EMPTY match consumes 0 bytes and inserts rl, so growth per
+    # round is rl, not rl-1.
+    w_out = w + max_matches * rl + 1
+    out = jnp.zeros((n, w_out), jnp.uint8)
+    out_pos = jnp.zeros((n,), jnp.int32)
+    opos = jnp.arange(w_out, dtype=jnp.int32)[None, :]
+    prev_e = jnp.zeros((n,), jnp.int32)
+    rep_arr = jnp.asarray(rep) if rl else jnp.zeros((1,), jnp.uint8)
+
+    def paste_input(out, out_pos, seg_start, seg_len):
+        src = jnp.clip(seg_start[:, None] + (opos - out_pos[:, None]),
+                       0, w - 1)
+        seg = jnp.take_along_axis(chars, src, axis=1)
+        sel = (opos >= out_pos[:, None]) \
+            & (opos < (out_pos + seg_len)[:, None])
+        return jnp.where(sel, seg, out), out_pos + seg_len
+
+    for b, e, hit in spans:
+        out, out_pos = paste_input(out, out_pos,
+                                   prev_e, (b - prev_e).astype(jnp.int32))
+        if rl:
+            ins = jnp.where(hit, jnp.int32(rl), jnp.int32(0))
+            rsel = (opos >= out_pos[:, None]) \
+                & (opos < (out_pos + ins)[:, None])
+            ridx = jnp.clip(opos - out_pos[:, None], 0, rl - 1)
+            out = jnp.where(rsel, rep_arr[ridx], out)
+            out_pos = out_pos + ins
+        prev_e = e
+    out, out_pos = paste_input(out, out_pos, prev_e,
+                               (lengths - prev_e).astype(jnp.int32))
+    return out_pos, out, overflowed

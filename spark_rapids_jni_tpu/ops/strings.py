@@ -713,11 +713,59 @@ def regexp_contains(col: Column, pattern: str) -> Column:
     return Column(BOOL8, flags, validity)
 
 
+def _device_capture_eligible(col: Column, pattern: str):
+    """Shared extract/replace device-path gate: the pattern parses into
+    the linear capture subset AND the column is all-ASCII with no
+    embedded NULs (byte-level ``.``/negated classes equal char-level
+    exactly on ASCII data; NULs alias the padding sentinel). Returns
+    (compiled, padded_col) or (None, None) for host fallback; respects
+    ``regex.force_engine`` like regexp_contains."""
+    from spark_rapids_jni_tpu.utils.config import get_option
+
+    force = get_option("regex.force_engine")
+    if force == "host":
+        return None, None
+    from spark_rapids_jni_tpu.ops import regex_capture_device as rc
+
+    try:
+        comp = rc.compile_linear(pattern)
+    except rc.RegexUnsupported:
+        if force == "device":
+            raise
+        return None, None
+    pc = pad_strings(col)
+    n, w = pc.chars.shape
+    if n == 0:
+        return None, None
+    nzeros = jnp.sum((pc.chars == 0).astype(jnp.int32), axis=1)
+    clean = bool(jnp.all(nzeros == (w - pc.data))
+                 & jnp.all(pc.chars < 0x80))
+    if not clean:
+        if force == "device":
+            raise ValueError(
+                "regex.force_engine=device but the column has embedded "
+                "NULs or non-ASCII bytes (outside the capture engine's "
+                "correctness scope)")
+        return None, None
+    # the boundary walk reads positions up to W inclusive: guarantee a
+    # sentinel column (same rule as run_dfa's ensure_sentinel)
+    if int(jnp.max(pc.data)) >= w:
+        pc = Column(pc.dtype, pc.data, pc.validity, chars=jnp.concatenate(
+            [pc.chars, jnp.zeros((n, 1), jnp.uint8)], axis=1))
+    return comp, pc
+
+
 @func_range("regexp_extract")
 def regexp_extract(col: Column, pattern: str, group: int = 1) -> Column:
     """Spark regexp_extract: the group'th capture of the first match,
     '' when the pattern does not match (Spark returns empty string, not
-    null). Host engine."""
+    null).
+
+    Two engines: LINEAR patterns (concatenated literals/classes with
+    flat capture groups) over ASCII data run ON DEVICE via the
+    reverse-feasibility capture engine (ops/regex_capture_device.py) —
+    scatter-free, O(elements * n * W); everything else takes the host
+    java.util.regex emulation."""
     rx = _compile_java_regex(pattern)
     if not 0 <= group <= rx.groups:
         # validate up front like regexp_replace — otherwise an invalid
@@ -725,6 +773,12 @@ def regexp_extract(col: Column, pattern: str, group: int = 1) -> Column:
         raise ValueError(
             f"regexp_extract group {group} out of range: pattern has "
             f"{rx.groups} group(s)")
+    comp, pc = _device_capture_eligible(col, pattern)
+    if comp is not None:
+        from spark_rapids_jni_tpu.ops import regex_capture_device as rc
+
+        lengths, chars = rc.extract_device(pc.chars, comp, group)
+        return Column(STRING, lengths, pc.validity, chars=chars)
 
     def ext(r, v):
         m = r.search(v)
@@ -740,8 +794,27 @@ def regexp_extract(col: Column, pattern: str, group: int = 1) -> Column:
 @func_range("regexp_replace")
 def regexp_replace(col: Column, pattern: str, replacement: str) -> Column:
     """Spark regexp_replace: every match replaced; Java $N group refs
-    (greedy multi-digit) and \\x literal escapes supported. Host engine."""
+    (greedy multi-digit) and \\x literal escapes supported.
+
+    Literal replacements of LINEAR patterns over ASCII data run ON
+    DEVICE (bounded match rounds; rows with more matches than the
+    round budget re-route the whole column to the host engine via the
+    overflow flag — the narrowing_overflow posture). Group-ref
+    replacements and non-linear patterns take the host engine."""
     rx = _compile_java_regex(pattern)
     rep = _java_replacement_to_python(replacement, rx.groups)
+    literal_rep = "$" not in replacement and "\\" not in replacement
+    if literal_rep:
+        comp, pc = _device_capture_eligible(col, pattern)
+        if comp is not None:
+            from spark_rapids_jni_tpu.ops import regex_capture_device as rc
+
+            out_len, out_chars, overflowed = rc.replace_device(
+                pc.chars, pc.data, comp, replacement.encode())
+            if not bool(overflowed):
+                return Column(STRING, out_len, pc.validity,
+                              chars=out_chars)
+            # else: some row had more matches than the round budget —
+            # fall through to the host engine for the whole column
     out = _host_regexp(col, rx, lambda r, v: r.sub(rep, v))
     return pad_strings(Column.from_pylist(out, STRING))

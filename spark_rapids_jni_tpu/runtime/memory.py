@@ -365,6 +365,14 @@ class SpillStore:
                 _log.info("unspill table %d (%d bytes)", handle, e["nbytes"])
             return e["table"]
 
+    def nbytes(self, handle: int) -> int:
+        """Logical (device) size of a stored table WITHOUT staging it —
+        lets callers reserve budget before a ``get`` faults bytes in."""
+        with self._lock:
+            if handle not in self._entries:
+                raise KeyError(f"unknown spill handle {handle}")
+            return self._entries[handle]["nbytes"]
+
     def drop(self, handle: int) -> None:
         with self._lock:
             self._entries.pop(handle, None)

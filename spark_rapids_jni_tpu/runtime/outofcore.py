@@ -104,22 +104,31 @@ def run_chunked_aggregate(
     # concat result exists.
     partials: list[Table] = []
     partial_bytes = 0
-    for h in handles:
-        ptab = spill.get(h)
-        spill.drop(h)
-        nb_p = _table_nbytes(ptab)
-        limiter.reserve(nb_p)
-        partial_bytes += nb_p
-        partials.append(ptab)
-    if len(partials) > 1:
-        merged_in = concatenate(partials)
-        nb = _table_nbytes(merged_in)
-        limiter.reserve(nb)
-        del partials
+    try:
+        for h in handles:
+            # reserve BEFORE staging: a partial set that exceeds the
+            # budget must raise before its bytes are device-resident
+            nb_p = spill.nbytes(h)
+            limiter.reserve(nb_p)
+            partial_bytes += nb_p
+            partials.append(spill.get(h))
+            spill.drop(h)
+        if len(partials) > 1:
+            merged_in = concatenate(partials)
+            nb = _table_nbytes(merged_in)
+            limiter.reserve(nb)
+            del partials
+            limiter.release(partial_bytes)
+            partial_bytes = 0
+        else:
+            merged_in = partials[0]
+            nb = partial_bytes
+            partial_bytes = 0
+    except BaseException:
+        # the limiter may be caller-injected and reused: leave no
+        # phantom usage behind a raised MemoryLimitExceeded
         limiter.release(partial_bytes)
-    else:
-        merged_in = partials[0]
-        nb = partial_bytes
+        raise
     try:
         out = merge_fn(merged_in)
     finally:

@@ -16,6 +16,80 @@ force_cpu_platform(n_virtual_devices=8)
 import numpy as np
 import pytest
 
+# ---------------------------------------------------------------------------
+# Premerge tier manifest (VERDICT r4 weak #4 / item 9): the fast tier had
+# grown to ~23 min because the heaviest oracle sweeps carried no marker.
+# Every test below measured >=14 s on the reference box (pytest
+# --durations, 2026-07-31 run; the 10 window/groupby oracle sweeps alone
+# were ~20 min). They are auto-marked `medium`: premerge deselects them
+# (-m "not slow and not medium"), the nightly still runs everything —
+# coverage moved between tiers, never deleted. Keep this list in sync
+# with new slow oracle sweeps; entries are nodeids without param ids.
+# ---------------------------------------------------------------------------
+_MEDIUM_TIER = {
+    "tests/test_cast_strings.py::test_string_to_date_vs_python_oracle",
+    "tests/test_decimal128_ops.py::test_decimal128_minmax_vs_python",
+    "tests/test_json_device.py::test_device_engine_matches_native_randomized",
+    "tests/test_lists.py::test_string_list_pipeline_end_to_end",
+    "tests/test_native_ops.py::test_get_json_object_missing_and_oob",
+    "tests/test_ops.py::test_groupby_and_q1_compile_scatter_free",
+    "tests/test_ops.py::test_groupby_covar_corr_vs_numpy",
+    "tests/test_ops.py::test_groupby_float_small_group_after_large_group",
+    "tests/test_ops.py::test_groupby_small_m_exact_fit_and_overflow",
+    "tests/test_ops.py::test_groupby_small_m_matches_default_path",
+    "tests/test_ops.py::test_groupby_sum_count_vs_numpy",
+    "tests/test_ops.py::test_groupby_var_pop_std_pop_vs_numpy",
+    "tests/test_ops.py::test_groupby_var_std_vs_numpy",
+    "tests/test_parallel.py::test_distributed_groupby_covar_corr",
+    "tests/test_parallel.py::test_tpch_q1_distributed_matches_oracle",
+    "tests/test_parallel.py::test_tpch_q1_distributed_matches_single_device",
+    "tests/test_parallel_strings.py::test_tpch_q1_distributed_string_flags",
+    "tests/test_regex_device.py::test_random_pattern_fuzz_vs_host",
+    "tests/test_strings.py::TestStringGroupBy::test_max_groups_overflow_and_auto",
+    "tests/test_strings.py::test_like_multibyte_vs_regex_oracle",
+    "tests/test_strings.py::test_like_underscore_multibyte_utf8_char_semantics",
+    "tests/test_strings.py::test_like_vs_regex_oracle",
+    "tests/test_strings_fns.py::test_split_literal_vs_python",
+    "tests/test_table_ops.py::test_except_intersect_vs_python",
+    "tests/test_tpcds.py::test_q64_base_year_anchors_dates",
+    "tests/test_tpcds.py::test_q64_matches_oracle",
+    "tests/test_tpcds.py::test_q64_sorted_by_count_desc",
+    "tests/test_tpcds.py::test_q72_distributed_matches_oracle",
+    "tests/test_tpcds.py::test_q72_matches_oracle",
+    "tests/test_tpcds.py::test_q72_year_filter_changes_result",
+    "tests/test_tpch.py::test_q1_groups_sorted_first",
+    "tests/test_tpch.py::test_q1_matches_numpy_oracle",
+    "tests/test_tpch.py::test_q1_pallas_kernel_matches_oracle_interpret",
+    "tests/test_tpch.py::test_q1_planned_checked_replans_on_domain_miss",
+    "tests/test_tpch.py::test_q1_planned_matches_oracle_and_is_sort_free",
+    "tests/test_tpch.py::test_tpch_q12_vs_numpy",
+    "tests/test_tpch.py::test_tpch_q14_vs_numpy",
+    "tests/test_tpch.py::test_tpch_q17_vs_numpy",
+    "tests/test_tpch.py::test_tpch_q19_vs_numpy",
+    "tests/test_tpch.py::test_tpch_q1_checked_rejects_out_of_contract_key_domain",
+    "tests/test_tpch.py::test_tpch_q4_vs_numpy",
+    "tests/test_window.py::test_first_last_nth_value",
+    "tests/test_window.py::test_ntile_percent_rank_cume_dist",
+    "tests/test_window.py::test_range_frames_vs_oracle",
+    "tests/test_window.py::test_rolling_frames_vs_oracle",
+    "tests/test_window.py::test_rolling_min_max_vs_oracle",
+    "tests/test_window.py::test_rolling_sum_decimal128_exact",
+    "tests/test_window.py::test_rolling_var_std_vs_oracle",
+    "tests/test_window.py::test_window_functions_vs_oracle",
+    "tests/test_window.py::test_window_string_lag_and_float_running_sum",
+    # round-5 additions measured locally over the same threshold
+    "tests/test_outofcore.py::test_q1_outofcore_matches_oracle_under_budget",
+    "tests/test_planner.py::test_q12_planned_matches_oracle",
+    "tests/test_planner.py::test_q4_planned_matches_oracle",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        base = item.nodeid.split("[")[0]
+        if base in _MEDIUM_TIER:
+            item.add_marker(pytest.mark.medium)
+
 
 @pytest.fixture
 def rng():

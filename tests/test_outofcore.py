@@ -61,8 +61,9 @@ def _q1_key_rows(table):
     return out
 
 
-@pytest.mark.slow
 def test_q1_outofcore_matches_oracle_under_budget(tmp_path):
+    # tiered `medium` via the conftest manifest (single-process oracle
+    # sweep — not `slow`, which is reserved for multi-process spawns)
     from spark_rapids_jni_tpu.models.tpch import (
         tpch_q1,
         tpch_q1_outofcore,

@@ -124,19 +124,6 @@ def test_encode_prefix_not_equal():
 # ---------------------------------------------------------------------------
 
 
-def _oracle(keys_lists, val_list):
-    out = {}
-    for i in range(len(val_list)):
-        key = tuple(k[i] for k in keys_lists)
-        if any(v is None for v in key) or val_list[i] is None:
-            if any(v is None for v in key):
-                continue
-        out.setdefault(key, 0)
-        if val_list[i] is not None:
-            out[key] += val_list[i]
-    return out
-
-
 def test_bounded_scalar_matches_general_and_oracle(rng):
     n = 500
     k1 = rng.integers(0, 3, n).astype(np.int8)
@@ -184,7 +171,6 @@ def test_bounded_string_key_decodes_to_strings(rng):
         oracle[key] = (s + int(vals[i]), c + 1)
     assert got == oracle
     # static output order: lexicographic keys, nulls last
-    keys = [k for k in res.table.column(0).to_pylist() if k is not None]
     present = np.asarray(res.present)
     live = [k for k, p in zip(res.table.column(0).to_pylist(), present)
             if p and k is not None]
@@ -380,3 +366,24 @@ def test_q1_planned_still_lowers_bounded():
     oracle = tpch_q1_numpy(li)
     got = _q1_groups(out)
     assert got.keys() == oracle.keys()
+
+
+def test_bounded_plan_on_empty_table():
+    """Lowering is a static plan fact: empty tables take the bounded
+    plan too (regression: an n>0 eligibility gate broke
+    tpch_q1_planned on empty partitions)."""
+    tbl = Table([
+        Column.from_numpy(np.zeros(0, np.int8)),
+        Column.from_numpy(np.zeros(0, np.int64)),
+    ])
+    res = plan_groupby(tbl, [0], [(1, "sum")], [scalar_domain([0, 1])])
+    assert res.lowered == "bounded"
+    assert not bool(np.asarray(res.present).any())
+
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_table,
+        tpch_q1_planned,
+    )
+
+    out = tpch_q1_planned(lineitem_table(0))
+    assert out.num_rows == 12  # the static slot table, nothing present
