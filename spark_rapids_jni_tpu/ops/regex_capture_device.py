@@ -214,8 +214,10 @@ class _LinParser:
                  "r": frozenset(b"\r")}
         if c in table:
             return table[c]
-        if not c.isalnum():
+        if not c.isalnum() and ord(c) <= 0x7F:
             return frozenset([ord(c)])
+        # alnum escapes are Java metasyntax; >0x7F would index past the
+        # 256-entry byte transition rows — both are host-engine territory
         raise RegexUnsupported(f"escape \\{c}")
 
     def _char_class(self) -> frozenset:

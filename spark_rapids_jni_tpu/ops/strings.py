@@ -806,6 +806,12 @@ def regexp_replace(col: Column, pattern: str, replacement: str) -> Column:
     literal_rep = "$" not in replacement and "\\" not in replacement
     if literal_rep:
         comp, pc = _device_capture_eligible(col, pattern)
+        if comp is not None and all(
+                el.lo == 0 for el in comp.pattern.elements):
+            # a pattern that can match empty matches at EVERY position:
+            # any row longer than the round budget is guaranteed to
+            # overflow, so the device pass would be dead work
+            comp = None
         if comp is not None:
             from spark_rapids_jni_tpu.ops import regex_capture_device as rc
 

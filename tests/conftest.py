@@ -85,10 +85,25 @@ _MEDIUM_TIER = {
 
 
 def pytest_collection_modifyitems(config, items):
+    matched = set()
+    collected_files = set()
     for item in items:
         base = item.nodeid.split("[")[0]
+        collected_files.add(base.split("::")[0])
         if base in _MEDIUM_TIER:
             item.add_marker(pytest.mark.medium)
+            matched.add(base)
+    # drift guard: a manifest entry whose FILE was collected but whose
+    # test no longer exists means a renamed/deleted heavy test would
+    # silently rejoin the premerge fast tier — fail loud instead.
+    # (Entries for files outside this collection are fine: subset runs
+    # like `pytest tests/test_ops.py` must not trip the guard.)
+    stale = [e for e in _MEDIUM_TIER
+             if e.split("::")[0] in collected_files and e not in matched]
+    if stale:
+        raise pytest.UsageError(
+            "medium-tier manifest entries match no collected test "
+            f"(renamed? update tests/conftest.py): {sorted(stale)}")
 
 
 @pytest.fixture

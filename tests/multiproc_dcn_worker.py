@@ -1,0 +1,113 @@
+"""Worker process for the DCN-across-slices prototype test.
+
+Run as: python -m tests.multiproc_dcn_worker <slice_id> <dcn_port>
+        <rows_per_slice>
+
+TWO independent process groups model two slices: each worker is its own
+jax "cluster" (no shared coordinator — that is the point: across slices
+there is no single mesh) with 4 virtual CPU devices forming the slice's
+executor mesh. The cross-slice repartition runs over the host-staged
+zstd DCN link (parallel/dcn.py); each slice then runs the UNCHANGED
+intra-slice distributed q1 over its own mesh and verifies its owned key
+partition against the full-dataset numpy oracle, printing
+DCN_SLICE_MATCH.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+_Q1_KEYS = [4, 5]  # l_returnflag, l_linestatus
+
+
+def main() -> None:
+    slice_id, port, rows_per_slice = (int(a) for a in sys.argv[1:4])
+    n_slices = 2
+
+    import numpy as np
+
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_table,
+        tpch_q1_distributed,
+        tpch_q1_numpy,
+    )
+    from spark_rapids_jni_tpu.ops.hash import partition_hash
+    from spark_rapids_jni_tpu.parallel.dcn import (
+        SliceLink,
+        exchange_across_slices,
+    )
+    from spark_rapids_jni_tpu.parallel.mesh import executor_mesh
+    from spark_rapids_jni_tpu.runtime.memory import _table_nbytes
+
+    # each slice generates ITS OWN shard (different seeds — real data
+    # locality); the oracle below rebuilds both deterministically
+    local = lineitem_table(rows_per_slice, seed=100 + slice_id)
+
+    link = (SliceLink.listen(port) if slice_id == 0
+            else SliceLink.connect(port))
+    try:
+        raw_bytes = _table_nbytes(local)
+        owned = exchange_across_slices(
+            local, _Q1_KEYS, link, slice_id, n_slices)
+    finally:
+        link.close()
+
+    # every received row must hash to THIS slice (two-level contract)
+    dest = np.asarray(partition_hash(owned, _Q1_KEYS, n_slices))
+    assert (dest == slice_id).all(), "row landed on the wrong slice"
+
+    # intra-slice distributed q1, unchanged, over this slice's own mesh
+    mesh = executor_mesh()
+    assert mesh.devices.size == 4
+    result = tpch_q1_distributed(owned, mesh)
+
+    # oracle: numpy q1 over the FULL dataset restricted to this slice's
+    # key partition
+    both = [lineitem_table(rows_per_slice, seed=100 + s)
+            for s in range(n_slices)]
+    full = Table([
+        Column(
+            c0.dtype,
+            np.concatenate([np.asarray(c0.data), np.asarray(c1.data)]),
+            None,
+        )
+        for c0, c1 in zip(both[0].columns, both[1].columns)
+    ])
+    fdest = np.asarray(partition_hash(full, _Q1_KEYS, n_slices))
+    keep = np.flatnonzero(fdest == slice_id)
+    mine_full = Table([
+        Column(c.dtype, np.asarray(c.data)[keep], None)
+        for c in full.columns
+    ])
+    oracle = tpch_q1_numpy(mine_full)
+
+    got = {}
+    cols = [c.to_pylist() for c in result.columns]
+    for i in range(result.num_rows):
+        if cols[0][i] is None or cols[1][i] is None:
+            continue
+        got[(cols[0][i], cols[1][i])] = dict(
+            sum_qty=cols[2][i], sum_base_price=cols[3][i],
+            sum_disc_price=cols[4][i], sum_charge=cols[5][i],
+            count=cols[9][i])
+    assert got.keys() == oracle.keys(), (got.keys(), oracle.keys())
+    for k, want in oracle.items():
+        for f in got[k]:
+            assert got[k][f] == want[f], (k, f, got[k][f], want[f])
+    print(f"slice {slice_id}: {local.num_rows} local rows, "
+          f"{owned.num_rows} owned after DCN exchange; raw local "
+          f"{raw_bytes} B")
+    print("DCN_SLICE_MATCH")
+
+
+if __name__ == "__main__":
+    main()

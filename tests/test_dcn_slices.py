@@ -1,0 +1,165 @@
+"""DCN-across-slices prototype (parallel/dcn.py — VERDICT r4 item 6).
+
+Unit tier: wire-format round trips (numeric/string/DECIMAL128/LIST),
+two-level partition completeness, compression effectiveness. Slow tier:
+two OS processes as two independent process groups ("slices"), a q1
+repartition spanning both over the host-staged zstd link, each slice's
+intra-slice distributed q1 verified against the full-dataset oracle.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.parallel import dcn
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _mixed_table(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(rng.integers(-9, 9, n).astype(np.int64)),
+        Column.from_numpy(rng.integers(0, 5, n).astype(np.int32),
+                          validity=rng.random(n) > 0.2),
+        Column.from_pylist(
+            [None if i % 7 == 0 else f"row-{i % 13}" for i in range(n)],
+            t.STRING),
+        Column.from_pylist(
+            [(1 << 90) + i for i in range(n)], t.decimal128(-2)),
+    ])
+
+
+def test_wire_roundtrip_mixed_types():
+    tbl = _mixed_table()
+    back = dcn.deserialize_table(dcn.serialize_table(tbl))
+    assert tbl.equals(back)
+
+
+def test_wire_roundtrip_uncompressed():
+    tbl = _mixed_table(seed=1)
+    blob = dcn.serialize_table(tbl, compress_level=0)
+    assert tbl.equals(dcn.deserialize_table(blob))
+
+
+def test_wire_roundtrip_list_column():
+    inner = Column.from_numpy(np.arange(10, dtype=np.int64))
+    import jax.numpy as jnp
+
+    lst = Column(t.DType(t.TypeId.LIST),
+                 jnp.asarray([0, 2, 2, 5, 10], jnp.int32),
+                 None, children=[inner])
+    tbl = Table([lst])
+    back = dcn.deserialize_table(dcn.serialize_table(tbl))
+    # Column.equals has no LIST form (offsets vs mask shapes); compare
+    # the materialized rows instead
+    assert back.column(0).to_pylist() == lst.to_pylist()
+
+
+def test_wire_compression_shrinks_relational_payload():
+    # sorted-ish int64 keys: the representative relational payload the
+    # design note claims zstd halves (or better) on the DCN hop
+    n = 50_000
+    tbl = Table([Column.from_numpy(
+        np.sort(np.random.default_rng(0).integers(0, 1000, n))
+        .astype(np.int64))])
+    from spark_rapids_jni_tpu.runtime.memory import _table_nbytes
+
+    raw = _table_nbytes(tbl)
+    wire = len(dcn.serialize_table(tbl, compress_level=3))
+    assert wire < raw / 2, (wire, raw)
+
+
+def test_truncated_frame_fails_loud():
+    blob = dcn.serialize_table(_mixed_table(8))
+    with pytest.raises(ValueError, match="truncated|not a DCN"):
+        dcn.deserialize_table(blob[: len(blob) // 2])
+
+
+def test_partition_for_slices_complete_and_disjoint():
+    from spark_rapids_jni_tpu.ops.hash import partition_hash
+
+    tbl = _mixed_table(300, seed=2)
+    parts = dcn.partition_for_slices(tbl, [0, 1], 2)
+    assert sum(p.num_rows for p in parts) == tbl.num_rows
+    for s, p in enumerate(parts):
+        if p.num_rows:
+            dest = np.asarray(partition_hash(p, [0, 1], 2))
+            assert (dest == s).all()
+
+
+def test_exchange_over_local_socket_pair():
+    """Both slice roles in one process (threads): every row ends on the
+    slice its key hashes to, none are lost."""
+    from spark_rapids_jni_tpu.ops.hash import partition_hash
+
+    port = _free_port()
+    tables = [_mixed_table(150, seed=s) for s in range(2)]
+    results: dict = {}
+
+    def run_slice(sid):
+        link = (dcn.SliceLink.listen(port) if sid == 0
+                else dcn.SliceLink.connect(port))
+        try:
+            results[sid] = dcn.exchange_across_slices(
+                tables[sid], [0], link, sid)
+        finally:
+            link.close()
+
+    th = [threading.Thread(target=run_slice, args=(s,)) for s in range(2)]
+    for x in th:
+        x.start()
+    for x in th:
+        x.join(timeout=120)
+    assert set(results) == {0, 1}
+    total = sum(r.num_rows for r in results.values())
+    assert total == sum(tb.num_rows for tb in tables)
+    for sid, r in results.items():
+        dest = np.asarray(partition_hash(r, [0], 2))
+        assert (dest == sid).all()
+
+
+@pytest.mark.slow
+def test_q1_repartition_spans_two_slices():
+    """Two OS processes = two independent process groups; the q1
+    repartition crosses the host-staged zstd DCN link, then each slice
+    runs the unchanged intra-slice distributed q1 over its own
+    4-device mesh and matches the full-dataset oracle."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tests.multiproc_dcn_worker",
+             str(sid), str(port), "600"],
+            cwd=repo, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for sid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for sid, (p, out) in enumerate(zip(procs, outs)):
+        tail = "\n".join(out.strip().splitlines()[-15:])
+        assert p.returncode == 0, f"slice {sid} failed:\n{tail}"
+        assert "DCN_SLICE_MATCH" in out, f"slice {sid}:\n{tail}"
