@@ -65,16 +65,25 @@ _DP_SPLIT = 16     # disc_price = A * 2^16 + B   (A < 2^15 at 1.05e9)
 
 def _q1_kernel(qty_ref, price_ref, disc_ref, tax_ref, ship_ref, rf_ref,
                ls_ref, out_ref):
-    """One grid step: (1, BLOCK) int32 column slices -> (1, SUBS*M*LANES)
-    int32 partial sums. Zero int64 ops."""
-    subs = _BLOCK // _SUB
-    qty = qty_ref[0].reshape(subs, _SUB)
-    price = price_ref[0].reshape(subs, _SUB)
-    disc = disc_ref[0].reshape(subs, _SUB)
-    tax = tax_ref[0].reshape(subs, _SUB)
-    ship = ship_ref[0].reshape(subs, _SUB)
-    rf = rf_ref[0].reshape(subs, _SUB)
-    ls = ls_ref[0].reshape(subs, _SUB)
+    """One grid step: (1, SUBS, SUB) int32 column slices -> (1, SUBS,
+    M*LANES) int32 partial sums. Zero int64 ops.
+
+    Round-5 Mosaic-conformance rewrite (the r04 kernel crashed at
+    runtime on the real chip after interpret-only development): every
+    intermediate now keeps a (sublane, lane) structure the TPU layout
+    system supports — the host pre-shapes blocks to (SUBS, SUB) =
+    (8, 256), two int32 tiles, instead of in-kernel (2048,) -> (8, 256)
+    layout-changing reshapes; reductions keep dims ((8, 1) per group
+    lane, never 1-D (8,) vectors); and the output assembles by lane
+    concatenation into EXACTLY one (8, 128) int32 tile — no flattening
+    store."""
+    qty = qty_ref[0]      # (SUBS, SUB) = (8, 256)
+    price = price_ref[0]
+    disc = disc_ref[0]
+    tax = tax_ref[0]
+    ship = ship_ref[0]
+    rf = rf_ref[0]
+    ls = ls_ref[0]
 
     keep = ship <= _Q1_CUTOFF_DAYS
     # flag codes via the declared domains (planner facts, not data sort)
@@ -104,18 +113,23 @@ def _q1_kernel(qty_ref, price_ref, disc_ref, tax_ref, ship_ref, rf_ref,
         a * w2,                         # charge high limb  (< 2^22)
         b * w2,                         # charge low limb   (< 2^23)
     ]
-    parts = []
+    subs = _BLOCK // _SUB
+    # assemble the (SUBS, M*LANES) = (8, 128) int32 output tile by
+    # broadcast-select accumulation: each (group, lane) partial is a
+    # keepdims (8, 1) sum placed at column g*LANES+li via a
+    # broadcasted_iota mask — only documented-safe Mosaic constructs
+    # (no rank changes, no 1-D vectors, no many-operand lane concat)
+    col_ids = jax.lax.broadcasted_iota(
+        jnp.int32, (subs, _M * _LANES), 1)
+    acc = jnp.zeros((subs, _M * _LANES), jnp.int32)
     for g in range(_M):
         mask = gid == g
-        for li in range(_LANES):
-            if li < len(lanes):
-                parts.append(jnp.sum(
-                    jnp.where(mask, lanes[li], 0), axis=1))
-            else:
-                parts.append(jnp.zeros((subs,), jnp.int32))
-    # (m * lanes, subs) -> (subs, m, lanes) -> flat
-    stacked = jnp.stack(parts, axis=1)  # (subs, m*lanes)
-    out_ref[:] = stacked.reshape(1, subs * _M * _LANES)
+        for li, lane in enumerate(lanes):
+            p = jnp.sum(jnp.where(mask, lane, 0),
+                        axis=1, keepdims=True)   # (SUBS, 1)
+            acc = acc + jnp.where(
+                col_ids == g * _LANES + li, p, 0)
+    out_ref[0] = acc
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -126,15 +140,19 @@ def _q1_pallas_partials(qty, price, disc, tax, ship, rf, ls,
     n = qty.shape[0]
     nb = n // _BLOCK
     subs = _BLOCK // _SUB
-    cols = [c.reshape(nb, _BLOCK) for c in
+    # blocks pre-shaped on the XLA side to the kernel's (SUBS, SUB)
+    # layout — in-kernel rank-changing reshapes are what Mosaic rejects
+    cols = [c.reshape(nb, subs, _SUB) for c in
             (qty, price, disc, tax, ship, rf, ls)]
-    spec = pl.BlockSpec((1, _BLOCK), lambda i: (i, 0))
+    spec = pl.BlockSpec((1, subs, _SUB), lambda i: (i, 0, 0))
     out = pl.pallas_call(
         _q1_kernel,
-        out_shape=jax.ShapeDtypeStruct((nb, subs * _M * _LANES), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct(
+            (nb, subs, _M * _LANES), jnp.int32),
         grid=(nb,),
         in_specs=[spec] * 7,
-        out_specs=pl.BlockSpec((1, subs * _M * _LANES), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((1, subs, _M * _LANES),
+                               lambda i: (i, 0, 0)),
         interpret=interpret,
     )(*cols)
     # tiny int64 combine outside the kernel: (nb, subs, m, lanes) -> (m, lanes)
