@@ -449,7 +449,8 @@ def tpch_q1_distributed(lineitem: Table, mesh) -> Table:
 def tpch_q1_outofcore(path, *, budget_bytes: int,
                       chunk_read_limit: int,
                       spill_budget_bytes: int | None = None,
-                      compress_spill: bool = False):
+                      compress_spill: bool = False,
+                      prefetch_depth: int = 0):
     """q1 over a Parquet file LARGER than the device budget: chunked
     row-group reads -> per-chunk partial aggregates -> SpillStore'd
     partials -> merge -> finalize. The partial->merge algebra is the
@@ -460,6 +461,11 @@ def tpch_q1_outofcore(path, *, budget_bytes: int,
     unscaled int64 (the bench parquet_q1 layout); they are re-typed to
     DECIMAL64(-2) on read. Returns OutOfCoreResult; ``.table`` matches
     ``tpch_q1`` of the fully-materialized file.
+
+    ``budget_bytes`` must cover one chunk (plus the merge window) when
+    ``prefetch_depth == 0``; with prefetch, ``prefetch_depth + 2``
+    chunks are resident at once (the read/compute overlap window) and
+    the budget must cover them.
     """
     import jax as _jax
 
@@ -509,7 +515,8 @@ def tpch_q1_outofcore(path, *, budget_bytes: int,
 
     reader = ParquetChunkedReader(path, chunk_read_limit=chunk_read_limit)
     return run_chunked_aggregate(
-        iter(reader), partial_fn, merge_fn, limiter=limiter, spill=spill)
+        iter(reader), partial_fn, merge_fn, limiter=limiter, spill=spill,
+        prefetch_depth=prefetch_depth)
 
 
 # ---- TPC-H q3 (shipping priority): join + groupby + order-by ---------------

@@ -135,3 +135,113 @@ def test_empty_stream_raises():
     with pytest.raises(ValueError, match="empty input stream"):
         run_chunked_aggregate(iter([]), lambda c: c, lambda p: p,
                               limiter=limiter)
+
+
+# ---------------------------------------------------------------------------
+# Prefetching chunk stream (the GDS-role async staging, VERDICT r4 weak
+# #6: the mmap route was synchronous single-threaded)
+# ---------------------------------------------------------------------------
+
+
+def _chunks(n=6, rows=500):
+    return [Table([Column.from_numpy(
+        np.full(rows, i, np.int64))]) for i in range(n)]
+
+
+def test_prefetch_preserves_order_and_content():
+    from spark_rapids_jni_tpu.runtime.outofcore import prefetch_chunks
+
+    got = [int(np.asarray(c.columns[0].data)[0])
+           for c in prefetch_chunks(iter(_chunks()), depth=2)]
+    assert got == list(range(6))
+
+
+def test_prefetch_runs_ahead_of_consumer():
+    import threading
+
+    from spark_rapids_jni_tpu.runtime.outofcore import prefetch_chunks
+
+    produced = []
+    second_produced = threading.Event()
+
+    def tracked():
+        for i, c in enumerate(_chunks()):
+            produced.append(i)
+            if i >= 1:
+                second_produced.set()
+            yield c
+
+    stream = prefetch_chunks(tracked(), depth=2)
+    first = next(stream)
+    # with depth 2 the producer must fetch chunk 1 (and start 2) while
+    # the consumer still holds chunk 0 — the overlap this exists for
+    assert second_produced.wait(timeout=30)
+    rest = list(stream)
+    assert len(rest) == 5
+    del first
+
+
+def test_prefetch_propagates_producer_error():
+    from spark_rapids_jni_tpu.runtime.outofcore import prefetch_chunks
+
+    def boom():
+        yield _chunks(1)[0]
+        raise RuntimeError("storage fault")
+
+    stream = prefetch_chunks(boom(), depth=1)
+    next(stream)
+    with pytest.raises(RuntimeError, match="storage fault"):
+        list(stream)
+
+
+def test_prefetch_releases_reservations_on_consumer_abort():
+    from spark_rapids_jni_tpu.runtime.outofcore import prefetch_chunks
+
+    chunks = _chunks(6)
+    per = _table_nbytes(chunks[0])
+    limiter = MemoryLimiter(per * 4)
+    stream = prefetch_chunks(iter(chunks), depth=2, limiter=limiter)
+    c0 = next(stream)
+    stream.close()  # consumer abandons mid-stream
+    # everything the producer reserved for unconsumed chunks is released;
+    # only the chunk handed to the consumer remains accounted
+    assert limiter.used == per
+    limiter.release(per)
+    del c0
+
+
+def test_run_chunked_aggregate_with_prefetch_matches(tmp_path):
+    from spark_rapids_jni_tpu.models.tpch import (
+        tpch_q1,
+        tpch_q1_outofcore,
+    )
+
+    n = 24_000
+    path, li = _write_lineitem_parquet(tmp_path, n, row_group_size=4_000)
+    budget = _table_nbytes(li)  # prefetch holds depth+1 chunks
+    res = tpch_q1_outofcore(path, budget_bytes=budget,
+                            chunk_read_limit=1, prefetch_depth=2)
+    assert res.chunks == 6
+    assert _q1_key_rows(res.table) == _q1_key_rows(tpch_q1(li))
+
+
+def test_partial_failure_with_prefetch_leaves_no_phantom_usage():
+    """partial_fn raising mid-stream must stop the producer and release
+    every in-flight prefetch reservation (a caller retrying with the
+    same limiter must not see phantom used bytes)."""
+    chunks = _chunks(8)
+    per = _table_nbytes(chunks[0])
+    limiter = MemoryLimiter(per * 16)
+
+    calls = []
+
+    def partial(c):
+        calls.append(1)
+        if len(calls) == 2:
+            raise RuntimeError("compute failed")
+        return Table([Column(t.INT64, c.columns[0].data[:1], None)])
+
+    with pytest.raises(RuntimeError, match="compute failed"):
+        run_chunked_aggregate(iter(chunks), partial, lambda p: p,
+                              limiter=limiter, prefetch_depth=2)
+    assert limiter.used == 0
