@@ -460,6 +460,20 @@ def recombine_sum128(s0, s1, s2, s3):
     return lo, hi, ovf
 
 
+def minmax_sentinel(dt: DType, op: str):
+    """The null-neutral fill for a min/max reduction over ``dt``: the
+    dtype's +inf/max for ``min``, -inf/min for ``max``. One definition
+    shared by the local bounded/general paths and the distributed merge
+    (a dtype rule fixed in one place must apply to all three)."""
+    np_dt = dt.storage_dtype
+    if np_dt.kind == "f":
+        lo, hi = -jnp.inf, jnp.inf
+    else:
+        info = np.iinfo(np_dt)
+        lo, hi = info.min, info.max
+    return hi if op == "min" else lo
+
+
 def _sum_dtype(dt: DType) -> DType:
     """Spark widens SUM: integral -> INT64, decimal keeps scale (wider
     precision), floats stay floating."""
@@ -1217,13 +1231,7 @@ def groupby_aggregate(
         if c.dtype.is_string or c.dtype.is_decimal128:
             out_cols.append(_rank_minmax(c, op, vcount))
             continue
-        np_dt = c.dtype.storage_dtype
-        if np_dt.kind == "f":
-            lo, hi = -jnp.inf, jnp.inf
-        else:
-            info = np.iinfo(np_dt)
-            lo, hi = info.min, info.max
-        sentinel = hi if op == "min" else lo
+        sentinel = minmax_sentinel(c.dtype, op)
         vv = jnp.where(valid, c.data, jnp.asarray(sentinel, c.data.dtype))
         if n:
             run = _segmented_extremum(vv, ~same, op)
@@ -1374,6 +1382,7 @@ def groupby_aggregate_bounded(
     keys: Sequence[int],
     aggs: Sequence[tuple[int, str]],
     key_domains: Sequence[Sequence[int]],
+    row_valid: Optional[jnp.ndarray] = None,
 ) -> BoundedGroupByResult:
     """Groupby with PLANNER-DECLARED key domains: zero sort, zero gather,
     zero scan, zero scatter — one streaming pass.
@@ -1393,6 +1402,11 @@ def groupby_aggregate_bounded(
     count, mean, min, max (the associative single-pass set). Rows whose
     key value is outside its domain land in no group and raise
     ``domain_miss``.
+
+    ``row_valid``: bool[n] marking rows that EXIST — False rows (e.g.
+    shard_table padding) join NO group, not even the null slot, and
+    never raise ``domain_miss`` (a padding row is not a null-key row —
+    the shard_table return_row_valid contract).
     """
     for _, op in aggs:
         if op not in ("sum", "count", "mean", "min", "max"):
@@ -1420,12 +1434,18 @@ def groupby_aggregate_bounded(
         valid = c.valid_mask()
         code = jnp.searchsorted(dom_arr, c.data).astype(jnp.int32)
         hit = (dom_arr[jnp.clip(code, 0, len(dom) - 1)] == c.data)
-        domain_miss = domain_miss | jnp.any(valid & ~hit)
+        miss_rows = valid & ~hit
+        if row_valid is not None:
+            miss_rows = miss_rows & row_valid
+        domain_miss = domain_miss | jnp.any(miss_rows)
         # null slot = len(dom); missed rows park there too but are
         # excluded from every group by the miss flag contract
         code = jnp.where(valid & hit, jnp.clip(code, 0, len(dom) - 1),
                          len(dom))
         gid = gid * (len(dom) + 1) + code
+    if row_valid is not None:
+        # non-rows (shard padding) match NO group mask, not even null
+        gid = jnp.where(row_valid, gid, jnp.int32(m))
 
     out_cols: list[Column] = []
 
@@ -1489,13 +1509,7 @@ def groupby_aggregate_bounded(
                     Column(DType(TypeId.FLOAT64), mean, vcount > 0))
             continue
         # min / max
-        np_dt = c.dtype.storage_dtype
-        if np_dt.kind == "f":
-            lo, hi = -jnp.inf, jnp.inf
-        else:
-            info = np.iinfo(np_dt)
-            lo, hi = info.min, info.max
-        sentinel = hi if op == "min" else lo
+        sentinel = minmax_sentinel(c.dtype, op)
         vv = jnp.where(valid, c.data, jnp.asarray(sentinel, c.data.dtype))
         red = per_group(vv, jnp.min if op == "min" else jnp.max,
                         jnp.asarray(sentinel, c.data.dtype))

@@ -200,6 +200,7 @@ def plan_groupby(
     aggs: Sequence[tuple[int, str]],
     domains: Sequence[Domain | None],
     budget: int = 4096,
+    row_valid: jnp.ndarray | None = None,
 ) -> PlannedGroupBy:
     """Lower a groupby to the sort-free bounded plan when the planner can
     bound every key, else to the general sort-based plan.
@@ -210,6 +211,11 @@ def plan_groupby(
     dictionary-encoded on device (``encode_string_key``) and decoded back
     to static string columns at the output — the decode costs nothing at
     runtime (trace-time constants from ``bounded_group_layout``).
+
+    ``row_valid``: bool[n] marking rows that EXIST (shard_table padding
+    contract). On the bounded plan non-rows join no slot; on the
+    general fallback their keys and values are nulled, so they fold
+    into the null-key pseudo-group every consumer already discards.
     """
     if len(domains) != len(keys):
         raise ValueError("one Domain (or None) per key required")
@@ -223,6 +229,12 @@ def plan_groupby(
         and int(np.prod([len(d.values) + 1 for d in domains])) <= budget
     )
     if not bounded_ok:
+        if row_valid is not None:
+            table = Table([
+                Column(c.dtype, c.data, c.valid_mask() & row_valid,
+                       chars=c.chars, children=c.children)
+                for c in table.columns
+            ])
         g = groupby_aggregate(table, keys=list(keys), aggs=list(aggs),
                               max_groups=min(budget, table.num_rows) or 1)
         srt = sort_table(g.table, list(range(len(keys))),
@@ -248,7 +260,7 @@ def plan_groupby(
             key_domains.append(dom.values)
     res = groupby_aggregate_bounded(
         Table(work_cols), keys=list(keys), aggs=list(aggs),
-        key_domains=key_domains)
+        key_domains=key_domains, row_valid=row_valid)
 
     if string_positions:
         _, m, slot_codes, order = bounded_group_layout(

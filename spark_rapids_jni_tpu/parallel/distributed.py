@@ -217,6 +217,122 @@ def distributed_groupby_aggregate(
         lambda sh_tbl, ks: groupby_aggregate(sh_tbl, ks, aggs))
 
 
+class DistributedBoundedGroupBy(NamedTuple):
+    """Replicated global result of the shuffle-free bounded plan: the
+    same m-slot table on every device."""
+
+    table: Table
+    present: jnp.ndarray      # bool[m] — some row anywhere hit the slot
+    domain_miss: jnp.ndarray  # scalar bool — any device saw an OOD key
+
+
+@func_range("distributed_groupby_bounded")
+def distributed_groupby_bounded(
+    table: Table,
+    keys: Sequence[int],
+    aggs: Sequence[tuple[int, str]],
+    domains: Sequence,
+    mesh: Mesh,
+    budget: int = 4096,
+    row_valid: Optional[jnp.ndarray] = None,
+) -> DistributedBoundedGroupBy:
+    """SHUFFLE-FREE distributed groupby for planner-bounded keys.
+
+    The bounded plan's output is a STATIC slot table (one row per domain
+    combination) whose sum/count/min/max aggregates are associative per
+    slot — so the cross-device merge is one collective over the m-row
+    partials (psum / pmin / pmax), never a row shuffle. Where
+    ``distributed_groupby_aggregate`` pays hash_shuffle (all_to_all of
+    whole rows over ICI) plus per-device sort machinery, this path pays
+    a per-device streaming masked-reduction pass plus an m-row
+    collective: the single-chip 125x win (BASELINE.md round-4) composes
+    with an m-vs-n bytes-on-wire win on the mesh.
+
+    ``table`` must already be sharded row-wise over ``mesh``. Output is
+    REPLICATED (every device holds the global m-slot answer) — m is
+    small by construction, and replication is what lets the next
+    pipeline stage consume it without a broadcast.
+
+    Scope: sum/count/min/max (mean decomposes to sum+count — the q1
+    partial-aggregate convention); no DECIMAL128 aggregate columns
+    (limb-pair psum has no carry propagation — use the shuffle path).
+    String KEYS are fine (on-device dictionary encode, static decode).
+    """
+    from spark_rapids_jni_tpu.ops.planner import plan_groupby
+
+    aggs = list(aggs)
+    for _, op in aggs:
+        if op not in ("sum", "count", "min", "max"):
+            raise ValueError(
+                f"distributed bounded groupby supports sum/count/min/max "
+                f"(decompose mean to sum+count), not {op!r}")
+    for col_idx, _ in aggs:
+        if table.column(col_idx).dtype.is_decimal128:
+            raise NotImplementedError(
+                "DECIMAL128 aggregates need carry-aware merges — use "
+                "distributed_groupby_aggregate")
+    # eager lowering validation (NOT an assert: an un-bounded plan
+    # psummed across devices would sum rows of DIFFERENT keys —
+    # silently wrong, so it must raise even under python -O)
+    domains = list(domains)
+    if any(d is None for d in domains):
+        raise ValueError(
+            "every key needs a declared Domain for the shuffle-free "
+            "bounded plan; use distributed_groupby_aggregate otherwise")
+    slots = int(np.prod([len(d.values) + 1 for d in domains]))
+    if slots > budget:
+        raise ValueError(
+            f"domain cross product ({slots} slots) exceeds the bounded "
+            f"budget ({budget}); use distributed_groupby_aggregate")
+    nk = len(keys)
+
+    def step(local: Table, rv):
+        res = plan_groupby(local, list(keys), aggs, domains,
+                           budget=budget, row_valid=rv)
+        assert res.lowered == "bounded"  # guaranteed by the checks above
+        present_g = jax.lax.psum(
+            res.present.astype(jnp.int32), EXEC_AXIS) > 0
+        miss_g = jax.lax.psum(
+            res.domain_miss.astype(jnp.int32), EXEC_AXIS) > 0
+        out_cols: list[Column] = []
+        for pos, c in enumerate(res.table.columns):
+            valid_g = jax.lax.psum(
+                c.valid_mask().astype(jnp.int32), EXEC_AXIS) > 0
+            if pos < nk:
+                # key data is a trace-time constant, identical on every
+                # device — only the validity needs combining
+                out_cols.append(Column(c.dtype, c.data, valid_g,
+                                       chars=c.chars))
+                continue
+            op = aggs[pos - nk][1]
+            if op in ("sum", "count"):
+                # absent slots hold the 0 neutral already
+                data = jax.lax.psum(c.data, EXEC_AXIS)
+            else:
+                from spark_rapids_jni_tpu.ops.groupby import minmax_sentinel
+
+                sentinel = minmax_sentinel(c.dtype, op)
+                guarded = jnp.where(
+                    c.valid_mask(), c.data,
+                    jnp.asarray(sentinel, c.data.dtype))
+                data = (jax.lax.pmin(guarded, EXEC_AXIS) if op == "min"
+                        else jax.lax.pmax(guarded, EXEC_AXIS))
+            out_cols.append(Column(c.dtype, data, valid_g))
+        return Table(out_cols), present_g, miss_g
+
+    if row_valid is None:
+        row_valid = jax.device_put(
+            jnp.ones((table.num_rows,), jnp.bool_),
+            NamedSharding(mesh, P(EXEC_AXIS)))
+    out_tbl, present, miss = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+        out_specs=(P(), P(), P()),
+    )(table, row_valid)
+    return DistributedBoundedGroupBy(out_tbl, present, miss)
+
+
 def _distributed_groupby(table, keys, mesh, capacity, local_groupby):
     """Shared shuffle-then-local-groupby scaffold: hash-exchange rows so
     each device owns whole key groups, run ``local_groupby(shuffled_table,

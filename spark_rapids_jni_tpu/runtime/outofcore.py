@@ -176,7 +176,8 @@ def run_chunked_aggregate(
     nchunks = 0
     # prefetch_depth > 0 overlaps the next chunk's read/decode/staging
     # with this chunk's compute; the producer thread then owns the
-    # reservation (size the budget for depth + 1 chunks)
+    # reservation (size the budget for depth + 2 resident chunks — see
+    # prefetch_chunks)
     if prefetch_depth > 0:
         stream = prefetch_chunks(chunks, prefetch_depth, limiter)
     else:

@@ -1,0 +1,175 @@
+"""Shuffle-free distributed bounded groupby (parallel/distributed.py).
+
+The bounded plan's static slot table makes the cross-device merge a
+psum/pmin/pmax over m rows instead of a row shuffle — these tests pin
+oracle equality on the 8-device CPU mesh, string-key encoding under
+shard_map, min/max sentinel handling, domain-miss propagation from a
+single shard, the replicated-output contract, and the scope guards.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.planner import scalar_domain, string_domain
+from spark_rapids_jni_tpu.parallel.distributed import (
+    distributed_groupby_bounded,
+    shard_table,
+)
+from spark_rapids_jni_tpu.parallel.mesh import executor_mesh
+
+
+def _result_rows(res, nkeys=1):
+    out = {}
+    cols = [c.to_pylist() for c in res.table.columns]
+    present = np.asarray(res.present)
+    for i in range(len(cols[0])):
+        key = tuple(cols[k][i] for k in range(nkeys))
+        if not present[i] or any(k is None for k in key):
+            continue
+        out[key] = tuple(cols[k][i] for k in range(nkeys, len(cols)))
+    return out
+
+
+def test_scalar_keys_match_oracle(rng):
+    n = 1000
+    k = rng.integers(0, 4, n).astype(np.int32)
+    v = rng.integers(-100, 100, n).astype(np.int64)
+    tbl = Table([Column.from_numpy(k), Column.from_numpy(v)])
+    mesh = executor_mesh()
+    sharded = shard_table(tbl, mesh)
+    res = distributed_groupby_bounded(
+        sharded, [0], [(1, "sum"), (1, "count"), (1, "min"), (1, "max")],
+        [scalar_domain(range(4))], mesh)
+    assert not bool(res.domain_miss)
+    got = _result_rows(res)
+    oracle = {}
+    for i in range(n):
+        key = (int(k[i]),)
+        s, c, lo, hi = oracle.get(key, (0, 0, 10**9, -10**9))
+        oracle[key] = (s + int(v[i]), c + 1, min(lo, int(v[i])),
+                       max(hi, int(v[i])))
+    assert got == oracle
+
+
+def test_string_keys_under_shard_map(rng):
+    n = 640
+    modes = ["AIR", "MAIL", "SHIP"]
+    idx = rng.integers(0, 3, n)
+    v = rng.integers(0, 50, n).astype(np.int64)
+    tbl = Table([
+        Column.from_pylist([modes[i] for i in idx], t.STRING),
+        Column.from_numpy(v),
+    ])
+    mesh = executor_mesh()
+    sharded = shard_table(tbl, mesh)
+    res = distributed_groupby_bounded(
+        sharded, [0], [(1, "sum")], [string_domain(modes)], mesh)
+    got = {k[0]: s[0] for k, s in _result_rows(res).items()}
+    oracle = {}
+    for i in range(n):
+        oracle[modes[idx[i]]] = oracle.get(modes[idx[i]], 0) + int(v[i])
+    assert got == oracle
+
+
+def test_domain_miss_propagates_from_one_shard(rng):
+    n = 64
+    k = np.zeros(n, np.int32)
+    k[-1] = 99  # out of domain, lands on the last device's shard
+    tbl = Table([Column.from_numpy(k),
+                 Column.from_numpy(np.ones(n, np.int64))])
+    mesh = executor_mesh()
+    res = distributed_groupby_bounded(
+        shard_table(tbl, mesh), [0], [(1, "sum")],
+        [scalar_domain([0, 1])], mesh)
+    assert bool(res.domain_miss)
+
+
+def test_groups_absent_everywhere_not_present(rng):
+    tbl = Table([
+        Column.from_numpy(np.zeros(16, np.int32)),
+        Column.from_numpy(np.ones(16, np.int64)),
+    ])
+    mesh = executor_mesh()
+    res = distributed_groupby_bounded(
+        shard_table(tbl, mesh), [0], [(1, "sum")],
+        [scalar_domain([0, 1, 2])], mesh)
+    got = _result_rows(res)
+    assert got == {(0,): (16,)}
+
+
+def test_mean_and_decimal128_rejected():
+    tbl = Table([
+        Column.from_numpy(np.zeros(8, np.int32)),
+        Column.from_numpy(np.ones(8, np.int64)),
+        Column.from_pylist([1 << 70] * 8, t.decimal128(-2)),
+    ])
+    mesh = executor_mesh()
+    sharded = shard_table(tbl, mesh)
+    with pytest.raises(ValueError, match="decompose mean"):
+        distributed_groupby_bounded(
+            sharded, [0], [(1, "mean")], [scalar_domain([0])], mesh)
+    with pytest.raises(NotImplementedError, match="DECIMAL128"):
+        distributed_groupby_bounded(
+            sharded, [0], [(2, "sum")], [scalar_domain([0])], mesh)
+
+
+def test_output_replicated_not_sharded(rng):
+    """The result is the same m-slot table on every device — consumable
+    by the next stage without a broadcast."""
+    n = 256
+    tbl = Table([
+        Column.from_numpy(rng.integers(0, 3, n).astype(np.int32)),
+        Column.from_numpy(rng.integers(0, 9, n).astype(np.int64)),
+    ])
+    mesh = executor_mesh()
+    res = distributed_groupby_bounded(
+        shard_table(tbl, mesh), [0], [(1, "sum")],
+        [scalar_domain(range(3))], mesh)
+    # a replicated array's global shape equals its per-device shape (m
+    # slots + null slot = 4), NOT devices * m
+    assert res.table.column(0).data.shape[0] == 4
+    assert res.present.shape[0] == 4
+
+
+def test_nondivisible_rows_no_phantom_null_group(rng):
+    """n not a multiple of the device count: shard_table padding rows
+    must NOT surface as a present null-key slot when the row_valid mask
+    is passed (regression: padding rows landed in the null slot and
+    rows_per_group counted them)."""
+    n = 1001  # 8 devices -> 7 padding rows
+    k = rng.integers(0, 3, n).astype(np.int32)
+    v = rng.integers(0, 10, n).astype(np.int64)
+    tbl = Table([Column.from_numpy(k), Column.from_numpy(v)])
+    mesh = executor_mesh()
+    sharded, rv = shard_table(tbl, mesh, return_row_valid=True)
+    res = distributed_groupby_bounded(
+        sharded, [0], [(1, "sum"), (1, "count")],
+        [scalar_domain(range(3))], mesh, row_valid=rv)
+    assert not bool(res.domain_miss)
+    # the null slot (key validity False) must not be present
+    present = np.asarray(res.present)
+    kvalid = np.asarray(res.table.column(0).valid_mask())
+    assert not (present & ~kvalid).any()
+    got = _result_rows(res)
+    oracle = {}
+    for i in range(n):
+        key = (int(k[i]),)
+        s, c = oracle.get(key, (0, 0))
+        oracle[key] = (s + int(v[i]), c + 1)
+    assert got == oracle
+
+
+def test_missing_domain_raises_eagerly():
+    tbl = Table([Column.from_numpy(np.zeros(8, np.int32)),
+                 Column.from_numpy(np.ones(8, np.int64))])
+    mesh = executor_mesh()
+    sharded = shard_table(tbl, mesh)
+    with pytest.raises(ValueError, match="declared Domain"):
+        distributed_groupby_bounded(sharded, [0], [(1, "sum")],
+                                    [None], mesh)
+    with pytest.raises(ValueError, match="exceeds the bounded budget"):
+        distributed_groupby_bounded(
+            sharded, [0], [(1, "sum")],
+            [scalar_domain(range(100))], mesh, budget=10)
