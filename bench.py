@@ -390,6 +390,32 @@ def _bench_tpch_q1_pallas(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_tpch_q3_planned(n: int, iters: int):
+    """q3 with planner-declared dense clustered PKs: both joins are
+    arithmetic + gather (zero sorts in the join phase); only the
+    high-cardinality orderkey groupby stays on the general machinery —
+    measuring exactly what the join removal buys."""
+    import jax
+
+    from spark_rapids_jni_tpu.models.tpch import (
+        customer_table,
+        lineitem_q3_table,
+        orders_table,
+        tpch_q3_planned,
+    )
+
+    n_cust = max(n // 64, 4)
+    n_ord = max(n // 8, 8)
+    c = customer_table(n_cust)
+    o = orders_table(n_ord, n_cust)
+    li = lineitem_q3_table(n, n_ord)
+    fn = jax.jit(
+        lambda a, b, d: _table_digest(tpch_q3_planned(a, b, d).result.table)
+    )
+    per_iter = _measure(lambda: fn(c, o, li), iters)
+    return n / per_iter
+
+
 def _bench_tpch_q12_planned(n: int, iters: int):
     """q12 on the sort-free plan (planner-declared shipmode domain):
     join unchanged, aggregation lowered to the bounded masked-reduction
@@ -670,6 +696,8 @@ _CONFIGS = {
     "shuffle_wire": (_bench_shuffle_wire, "shuffle_wire_gb_per_s", "GB/s"),
     "json_extract": (_bench_json_extract, "json_extract_rows_per_s", "rows/s"),
     "tpch_q3": (_bench_tpch_q3, "tpch_q3_rows_per_s", "rows/s"),
+    "tpch_q3_planned": (
+        _bench_tpch_q3_planned, "tpch_q3_planned_rows_per_s", "rows/s"),
     "tpch_q12": (_bench_tpch_q12, "tpch_q12_rows_per_s", "rows/s"),
     "tpch_q12_planned": (
         _bench_tpch_q12_planned, "tpch_q12_planned_rows_per_s", "rows/s"),
@@ -873,8 +901,8 @@ def sweep() -> None:
     # big-table configs whose 16M variants don't add information per size
     single_size = {"parquet_q1", "shuffle_wire", "tpcds_q72", "tpcds_q64",
                    "json_extract", "regexp", "cast_strings", "tpch_q14",
-                   "tpch_q3", "tpch_q12", "tpch_q12_planned",
-                   "tpch_q4_planned"}
+                   "tpch_q3", "tpch_q3_planned", "tpch_q12",
+                   "tpch_q12_planned", "tpch_q4_planned"}
     ok, why = _probe_tpu(float(os.environ.get("BENCH_PROBE_TIMEOUT", 120)))
     if not ok:
         print(json.dumps({"sweep": "aborted", "why": why}))

@@ -676,6 +676,71 @@ def tpch_q3(customer: Table, orders: Table, lineitem: Table,
     return Q3Result(GroupByResult(srt, grouped.num_groups), total, cap)
 
 
+class Q3PlannedResult(NamedTuple):
+    result: GroupByResult  # [l_orderkey, o_orderdate, o_shippriority, rev]
+    join_total: jnp.ndarray
+    # planner-contract check: any dense-PK declaration violated (caller
+    # re-plans on tpch_q3 — the domain_miss posture)
+    pk_violation: jnp.ndarray
+
+
+@func_range("tpch_q3_planned")
+def tpch_q3_planned(customer: Table, orders: Table, lineitem: Table,
+                    segment: int = 0,
+                    cutoff: int = _Q3_CUTOFF_DAYS) -> Q3PlannedResult:
+    """q3 with PLANNER-DECLARED dense clustered PKs: custkey = 1..|C|
+    clustered in customer, orderkey = 1..|O| clustered in orders (the
+    TPC-H DDL + load-order facts). Both joins collapse to arithmetic +
+    gather — the join phase compiles with ZERO sorts (HLO-pinned in
+    tests), where the general q3 pays two build-side lexsorts + probe
+    searchsorteds on the 230 ns/row machinery (BASELINE.md). The
+    orderkey groupby stays on the general (sort-based) path: its
+    cardinality is data-dependent, which is exactly the boundary of
+    what a planner can declare.
+
+    Output rows are one per LINEITEM row (PK fanout <= 1): no join
+    capacity estimate, no overflow retry — the static shape is the
+    probe's.
+    """
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    cust, ord_t, probe = _q3_inputs(customer, orders, lineitem, segment,
+                                    cutoff)
+    # join 1: each ORDER row looks up its customer (clustered custkey);
+    # ord_t rows are orders rows in load order, custkey domain 1..|C|
+    j1 = dense_pk_join(ord_t, cust, 0, 0, 1, customer.num_rows,
+                       clustered=True)
+    # j1: [o_custkey, o_orderkey, o_orderdate, o_shippriority, c_custkey]
+    matched1 = j1.matched
+    build2 = Table([
+        _null_where(j1.table.column(1), ~matched1),  # orderkey
+        j1.table.column(2),                          # orderdate
+        j1.table.column(3),                          # shippriority
+    ])
+    # join 2: each LINEITEM row looks up its order (clustered orderkey,
+    # build2 rows still in orders load order = orderkey order)
+    j2 = dense_pk_join(probe, build2, 0, 0, 1, orders.num_rows,
+                       clustered=True)
+    # j2: [l_orderkey, revenue, o_orderkey, o_orderdate, o_shippriority]
+    jt = j2.table
+    matched = j2.matched
+    keyed = Table([
+        _null_where(jt.column(0), ~matched),
+        jt.column(3),  # build columns already carry the matched mask
+        jt.column(4),
+        Column(jt.column(1).dtype, jt.column(1).data,
+               jt.column(1).valid_mask() & matched),
+    ])
+    grouped = groupby_aggregate(keyed, keys=[0, 1, 2], aggs=[(3, "sum")])
+    srt = sort_table(
+        grouped.table, [3, 1], ascending=[False, True],
+        nulls_first=[False, False],
+    )
+    return Q3PlannedResult(
+        GroupByResult(srt, grouped.num_groups), j2.total,
+        j1.pk_violation | j2.pk_violation)
+
+
 def tpch_q3_numpy(customer: Table, orders: Table, lineitem: Table,
                   segment: int = 0, cutoff: int = _Q3_CUTOFF_DAYS) -> dict:
     """Host oracle: {orderkey: (revenue, orderdate, shippriority)}."""

@@ -170,6 +170,116 @@ def encode_string_key(col: Column, domain: Domain) -> Column:
     return Column(t.INT32, code, col.validity)
 
 
+class DensePkJoinResult(NamedTuple):
+    """LEFT PK-join result: one output row per probe row (PK fanout is
+    exactly <= 1, so there is no join-maps machinery, no capacity
+    estimate, no overflow). Probe columns first, then build columns
+    (the apply_join_maps convention); unmatched probe rows carry null
+    build columns."""
+
+    table: Table
+    matched: jnp.ndarray       # bool[n] probe rows with a build match
+    total: jnp.ndarray         # scalar match count
+    # True when the declared layout lied: a clustered slot held a
+    # DIFFERENT valid key (clustered mode), or the build side held
+    # duplicate keys (sorted mode). The caller re-plans on the general
+    # join — the domain_miss posture, never a silent wrong answer.
+    pk_violation: jnp.ndarray
+
+
+@func_range("dense_pk_join")
+def dense_pk_join(
+    probe: Table,
+    build: Table,
+    probe_key: int,
+    build_key: int,
+    key_lo: int,
+    key_hi: int,
+    clustered: bool = False,
+) -> DensePkJoinResult:
+    """LEFT join against a DECLARED dense primary-key build side.
+
+    The planner fact: ``build``'s key column holds unique keys from the
+    contiguous range [key_lo, key_hi] (a TPC-H DDL fact — orderkey /
+    custkey / partkey are dense 1..N — and what a real planner reads
+    from PK constraints + min/max statistics).
+
+    * ``clustered=True``: build row i holds key ``key_lo + i`` (the
+      layout of a loaded dimension or generated key column). The join
+      is then pure arithmetic + one row gather — ZERO sorts anywhere,
+      and the general join's build-side lexsort + probe searchsorted
+      (the dominant terms of the 230 ns/row unbounded pipeline,
+      BASELINE.md) vanish. The declaration is VERIFIED, not trusted:
+      each gathered build key is compared to the probe key, and a slot
+      holding a different valid key raises ``pk_violation``.
+    * ``clustered=False``: one lexsort of the (small) build side; the
+      probe side is searchsorted + gather. Duplicate build keys raise
+      ``pk_violation`` (PK uniqueness is part of the declaration).
+
+    Build rows with NULL keys are filtered rows (the _null_where WHERE
+    idiom): probes pointing at them are unmatched, not violations.
+    """
+    from spark_rapids_jni_tpu.ops.sort import gather
+
+    n = probe.num_rows
+    nb = build.num_rows
+    pk = probe.column(probe_key)
+    bk = build.column(build_key)
+    if pk.dtype.is_string or bk.dtype.is_string:
+        raise NotImplementedError(
+            "dense PK keys are integers (dictionary-encode first)")
+    in_range = (pk.valid_mask()
+                & (pk.data >= pk.data.dtype.type(key_lo))
+                & (pk.data <= pk.data.dtype.type(key_hi)))
+    if clustered:
+        if key_hi - key_lo + 1 != nb:
+            raise ValueError(
+                f"clustered dense PK needs build rows == key range "
+                f"({nb} != {key_hi - key_lo + 1})")
+        pos = jnp.clip(pk.data - key_lo, 0, nb - 1).astype(jnp.int32)
+        bkey_at = bk.data[pos]
+        bvalid_at = bk.valid_mask()[pos]
+        matched = in_range & bvalid_at & (bkey_at == pk.data)
+        # a slot holding a DIFFERENT valid key means the layout is not
+        # clustered after all
+        pk_violation = jnp.any(in_range & bvalid_at
+                               & (bkey_at != pk.data))
+    else:
+        # null keys (filtered rows) overwritten with the dtype max so
+        # the sorted array is GLOBALLY monotone — sorting raw data with
+        # a null rank leaves the tail unsorted and breaks the binary
+        # search for large valid keys (silently dropped matches)
+        bvalid = bk.valid_mask()
+        dt_max = np.iinfo(np.dtype(bk.data.dtype)).max
+        key_clean = jnp.where(bvalid, bk.data,
+                              jnp.asarray(dt_max, bk.data.dtype))
+        perm = jnp.argsort(key_clean).astype(jnp.int32)
+        skey = key_clean[perm]
+        n_valid = jnp.sum(bvalid.astype(jnp.int32))
+        pos0 = jnp.searchsorted(skey, pk.data).astype(jnp.int32)
+        within = pos0 < n_valid
+        hit = within & (skey[jnp.clip(pos0, 0, nb - 1)] == pk.data)
+        pos = perm[jnp.clip(pos0, 0, nb - 1)]
+        matched = in_range & hit
+        dup = jnp.any((skey[1:] == skey[:-1])
+                      & (jnp.arange(1, nb) < n_valid)) if nb > 1 \
+            else jnp.bool_(False)
+        # the declaration also claims build keys live in [lo, hi]: an
+        # out-of-range valid build key is a lie, not an unmatched row
+        oor = jnp.any(bvalid & ((bk.data < bk.data.dtype.type(key_lo))
+                                | (bk.data > bk.data.dtype.type(key_hi))))
+        pk_violation = dup | oor
+
+    out_cols = list(probe.columns)
+    gathered = gather(build, pos)
+    for c in gathered.columns:
+        out_cols.append(Column(
+            c.dtype, c.data, c.valid_mask() & matched, chars=c.chars))
+    return DensePkJoinResult(
+        Table(out_cols), matched,
+        jnp.sum(matched.astype(jnp.int64)), pk_violation)
+
+
 class PlannedGroupBy(NamedTuple):
     """Uniform result of ``plan_groupby`` over both lowerings.
 

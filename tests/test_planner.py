@@ -387,3 +387,185 @@ def test_bounded_plan_on_empty_table():
 
     out = tpch_q1_planned(lineitem_table(0))
     assert out.num_rows == 12  # the static slot table, nothing present
+
+
+# ---------------------------------------------------------------------------
+# dense-PK joins (planner-declared clustered primary keys)
+# ---------------------------------------------------------------------------
+
+
+def test_dense_pk_join_clustered_matches_bruteforce(rng):
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    nb, n = 50, 300
+    bkeys = np.arange(1, nb + 1, dtype=np.int64)
+    bvals = rng.integers(0, 100, nb).astype(np.int64)
+    bvalid = rng.random(nb) > 0.2  # filtered build rows (WHERE idiom)
+    build = Table([
+        Column.from_numpy(bkeys, validity=bvalid),
+        Column.from_numpy(bvals),
+    ])
+    pkeys = rng.integers(-3, nb + 4, n).astype(np.int64)  # some OOR
+    probe = Table([Column.from_numpy(pkeys)])
+    res = dense_pk_join(probe, build, 0, 0, 1, nb, clustered=True)
+    assert not bool(res.pk_violation)
+    got_k = res.table.column(1).to_pylist()
+    got_v = res.table.column(2).to_pylist()
+    matched = np.asarray(res.matched)
+    cnt = 0
+    for i in range(n):
+        k = int(pkeys[i])
+        if 1 <= k <= nb and bvalid[k - 1]:
+            assert matched[i] and got_k[i] == k
+            assert got_v[i] == int(bvals[k - 1])
+            cnt += 1
+        else:
+            assert not matched[i]
+            assert got_k[i] is None and got_v[i] is None
+    assert int(res.total) == cnt
+
+
+def test_dense_pk_join_sorted_mode_matches(rng):
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    nb, n = 40, 200
+    bkeys = rng.permutation(np.arange(1, nb + 1)).astype(np.int64)
+    bvals = np.arange(nb, dtype=np.int64) * 10
+    build = Table([Column.from_numpy(bkeys), Column.from_numpy(bvals)])
+    pkeys = rng.integers(1, nb + 1, n).astype(np.int64)
+    probe = Table([Column.from_numpy(pkeys)])
+    res = dense_pk_join(probe, build, 0, 0, 1, nb, clustered=False)
+    assert not bool(res.pk_violation)
+    pos_of = {int(k): i for i, k in enumerate(bkeys)}
+    got_v = res.table.column(2).to_pylist()
+    for i in range(n):
+        assert got_v[i] == pos_of[int(pkeys[i])] * 10
+
+
+def test_dense_pk_join_clustered_violation_flags():
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    # slot 1 holds key 99 — the clustered declaration is a lie
+    build = Table([
+        Column.from_numpy(np.asarray([1, 99, 3], np.int64)),
+        Column.from_numpy(np.asarray([7, 8, 9], np.int64)),
+    ])
+    probe = Table([Column.from_numpy(np.asarray([2], np.int64))])
+    res = dense_pk_join(probe, build, 0, 0, 1, 3, clustered=True)
+    assert bool(res.pk_violation)
+
+
+def test_dense_pk_join_sorted_duplicate_flags():
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    build = Table([
+        Column.from_numpy(np.asarray([1, 2, 2], np.int64)),
+        Column.from_numpy(np.asarray([7, 8, 9], np.int64)),
+    ])
+    probe = Table([Column.from_numpy(np.asarray([2], np.int64))])
+    res = dense_pk_join(probe, build, 0, 0, 1, 3, clustered=False)
+    assert bool(res.pk_violation)
+
+
+def test_q3_planned_matches_general_and_oracle():
+    from spark_rapids_jni_tpu.models.tpch import (
+        customer_table,
+        lineitem_q3_table,
+        orders_table,
+        tpch_q3_numpy,
+        tpch_q3_planned,
+    )
+
+    n_cust, n_ord, n = 40, 160, 1200
+    c = customer_table(n_cust)
+    o = orders_table(n_ord, n_cust)
+    li = lineitem_q3_table(n, n_ord)
+    res = tpch_q3_planned(c, o, li)
+    assert not bool(res.pk_violation)
+    oracle = tpch_q3_numpy(c, o, li)
+    tbl = res.result.table
+    keys = tbl.column(0).to_pylist()
+    dates = tbl.column(1).to_pylist()
+    prios = tbl.column(2).to_pylist()
+    revs = tbl.column(3).to_pylist()
+    got = {}
+    for i in range(tbl.num_rows):
+        if keys[i] is None:
+            continue
+        got[keys[i]] = (revs[i], dates[i], prios[i])
+    assert got == oracle
+    # ORDER BY revenue DESC: the live prefix is non-increasing, and
+    # every null-key row strictly follows every real row
+    first_null = next((i for i in range(tbl.num_rows)
+                       if keys[i] is None), tbl.num_rows)
+    assert all(keys[i] is None for i in range(first_null, tbl.num_rows))
+    live = revs[:first_null]
+    assert all(live[i] >= live[i + 1] for i in range(len(live) - 1))
+
+
+def test_q3_planned_join_phase_sort_free():
+    """The dense-PK join phase (both joins, pre-groupby) compiles with
+    zero sorts — the general q3's two build lexsorts are gone."""
+    from spark_rapids_jni_tpu.models.tpch import (
+        _q3_inputs,
+        customer_table,
+        lineitem_q3_table,
+        orders_table,
+    )
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    n_cust, n_ord, n = 16, 64, 256
+    c = customer_table(n_cust)
+    o = orders_table(n_ord, n_cust)
+    li = lineitem_q3_table(n, n_ord)
+
+    def join_phase(cu, orr, lit):
+        cust, ord_t, probe = _q3_inputs(cu, orr, lit, 0, 9204)
+        j1 = dense_pk_join(ord_t, cust, 0, 0, 1, n_cust, clustered=True)
+        build2 = Table([
+            Column(j1.table.column(1).dtype, j1.table.column(1).data,
+                   j1.table.column(1).valid_mask() & j1.matched),
+            j1.table.column(2), j1.table.column(3),
+        ])
+        j2 = dense_pk_join(probe, build2, 0, 0, 1, n_ord, clustered=True)
+        acc = jnp.float64(0)
+        for col in j2.table.columns:
+            acc = acc + jnp.sum(col.data).astype(jnp.float64)
+            acc = acc + jnp.sum(col.valid_mask())
+        return acc + j2.total + j2.pk_violation
+
+    hlo = jax.jit(join_phase).lower(c, o, li).compile().as_text()
+    assert not [l for l in hlo.splitlines()
+                if re.search(r"= \S+ sort\(", l)]
+    assert not [l for l in hlo.splitlines() if " scatter(" in l]
+
+
+def test_dense_pk_join_sorted_mode_null_build_keys(rng):
+    """Regression: null build keys (the _null_where WHERE idiom) sorted
+    by raw data broke the binary search's monotonicity and silently
+    dropped matches for large valid keys."""
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    bkeys = np.asarray([5, 10, 1, 2], np.int64)
+    bvalid = np.asarray([True, True, False, False])
+    build = Table([
+        Column.from_numpy(bkeys, validity=bvalid),
+        Column.from_numpy(np.asarray([50, 100, 10, 20], np.int64)),
+    ])
+    probe = Table([Column.from_numpy(np.asarray([10, 5, 1], np.int64))])
+    res = dense_pk_join(probe, build, 0, 0, 1, 10, clustered=False)
+    assert not bool(res.pk_violation)
+    assert np.asarray(res.matched).tolist() == [True, True, False]
+    assert res.table.column(2).to_pylist() == [100, 50, None]
+
+
+def test_dense_pk_join_sorted_mode_out_of_range_build_key_flags():
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    build = Table([
+        Column.from_numpy(np.asarray([1, 100], np.int64)),
+        Column.from_numpy(np.asarray([7, 8], np.int64)),
+    ])
+    probe = Table([Column.from_numpy(np.asarray([1], np.int64))])
+    res = dense_pk_join(probe, build, 0, 0, 1, 40, clustered=False)
+    assert bool(res.pk_violation)  # declared range was a lie
