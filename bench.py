@@ -224,6 +224,62 @@ def _bench_tpch_q14(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_tpch_q14_planned(n: int, iters: int):
+    """q14 with the part join as a dense clustered PK lookup: the whole
+    query compiles sort-free (join = arithmetic + gather, aggregate =
+    two global masked sums)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.columnar import Table
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q14_table,
+        part_table,
+        tpch_q14_planned,
+    )
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    part = part_table(max(n // 16, 64))
+    pcols = list(part.columns)
+    pcols[1] = pad_strings(pcols[1])
+    part = Table(pcols)
+    lineitem = lineitem_q14_table(n, max(n // 16, 64))
+
+    def run(p_, l_):
+        r = tpch_q14_planned(p_, l_)
+        return (r.promo_revenue + r.total_revenue * 3
+                + r.join_total.astype(jnp.int64) * 7 + r.pk_violation)
+
+    fn = jax.jit(run)
+    per_iter = _measure(lambda: fn(part, lineitem), iters)
+    return n / per_iter
+
+
+def _bench_tpcds_q72_planned(n: int, iters: int):
+    """q72 with all three joins as dense clustered PK/grid lookups and
+    the item groupby as a dense-id count — no n-sized sorts anywhere
+    (only the num_items-row final ORDER BY sorts)."""
+    import jax
+
+    from spark_rapids_jni_tpu.models import tpcds
+
+    cs = tpcds.catalog_sales_table(n, num_items=1000)
+    dd = tpcds.date_dim_table()
+    it = tpcds.item_table(1000)
+    inv = tpcds.inventory_table(num_items=1000)
+
+    import jax.numpy as jnp
+
+    def run(a, b, c, d):
+        r = tpcds.tpcds_q72_planned(a, b, c, d)
+        return (_table_digest(r.table)
+                + jnp.sum(r.present).astype(jnp.float64) + r.pk_violation)
+
+    fn = jax.jit(run)
+    per_iter = _measure(lambda: fn(cs, dd, it, inv), iters)
+    return n / per_iter
+
+
 def _bench_regexp(n: int, iters: int):
     """Device regex engine: RLIKE over synthetic log lines (host-compiled
     byte DFA, one gather per char column). rows/s."""
@@ -704,6 +760,10 @@ _CONFIGS = {
     "tpch_q4_planned": (
         _bench_tpch_q4_planned, "tpch_q4_planned_rows_per_s", "rows/s"),
     "tpch_q14": (_bench_tpch_q14, "tpch_q14_rows_per_s", "rows/s"),
+    "tpch_q14_planned": (
+        _bench_tpch_q14_planned, "tpch_q14_planned_rows_per_s", "rows/s"),
+    "tpcds_q72_planned": (
+        _bench_tpcds_q72_planned, "tpcds_q72_planned_rows_per_s", "rows/s"),
     "regexp": (_bench_regexp, "regexp_rows_per_s", "rows/s"),
     "cast_strings": (_bench_cast_strings, "cast_strings_rows_per_s", "rows/s"),
     "tpcds_q64": (_bench_tpcds_q64, "tpcds_q64_rows_per_s", "rows/s"),
@@ -901,6 +961,7 @@ def sweep() -> None:
     # big-table configs whose 16M variants don't add information per size
     single_size = {"parquet_q1", "shuffle_wire", "tpcds_q72", "tpcds_q64",
                    "json_extract", "regexp", "cast_strings", "tpch_q14",
+                   "tpch_q14_planned", "tpcds_q72_planned",
                    "tpch_q3", "tpch_q3_planned", "tpch_q12",
                    "tpch_q12_planned", "tpch_q4_planned"}
     ok, why = _probe_tpu(float(os.environ.get("BENCH_PROBE_TIMEOUT", 120)))

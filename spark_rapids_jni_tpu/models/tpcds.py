@@ -228,6 +228,113 @@ def tpcds_q72(
     return GroupByResult(srt, grouped.num_groups)
 
 
+class Q72PlannedResult(NamedTuple):
+    table: "Table"            # [i_item_sk, i_brand_id, count], count desc
+    present: jnp.ndarray      # bool[num_items] — item had short sales
+    pk_violation: jnp.ndarray
+
+
+@func_range("tpcds_q72_planned")
+def tpcds_q72_planned(
+    catalog_sales: Table,
+    date_dim: Table,
+    item: Table,
+    inventory: Table,
+    year: int = 2000,
+) -> Q72PlannedResult:
+    """q72 with every n-sized stage on planner-declared fast paths:
+
+    * all three joins are dense clustered PK lookups (d_date_sk and
+      i_item_sk are 1..N load-ordered; the inventory grain is a dense
+      (item, week) grid, so its row index is pure arithmetic
+      ``(item-1)*num_weeks + (week-1)``) — arithmetic + gather, zero
+      sorts, probe-aligned outputs (no join-maps, no capacity);
+    * the GROUP BY item is a dense-id COUNT (``dense_id_counts``) — the
+      key IS the slot, no sort, no scatter;
+    * brands attach by one static gather against the clustered item
+      table; only the final ORDER BY count runs a sort, over num_items
+      rows instead of n.
+
+    The declarations are verified (pk_violation) — on the synthetic
+    generators they hold by construction; a real loader asserts them
+    from load order + PK constraints.
+    """
+    from spark_rapids_jni_tpu.ops.planner import (
+        dense_id_counts,
+        dense_pk_join,
+    )
+
+    num_days = date_dim.num_rows
+    num_items = item.num_rows
+    if inventory.num_rows % num_items:
+        raise ValueError(
+            "inventory is not a dense (item, week) grid — use tpcds_q72")
+    num_weeks = inventory.num_rows // num_items
+
+    # join 1: sale -> its date row (clustered d_date_sk), year filter
+    # pushed into the build key (the general plan's own idiom)
+    dd_key = _null_keys_where(
+        date_dim.column(D_DATE_SK),
+        jnp.asarray(np.int32(year)) != date_dim.column(D_YEAR).data,
+    )
+    dd = Table([dd_key, date_dim.column(D_WEEK_SEQ)])
+    j1 = dense_pk_join(catalog_sales, dd, CS_SOLD_DATE_SK, 0,
+                       1, num_days, clustered=True)
+    # j1: [cs_item, cs_date, cs_qty, cs_order, d_date_sk, d_week_seq]
+    m1 = j1.matched
+
+    # join 2: sale -> its item row (clustered i_item_sk)
+    j2 = dense_pk_join(j1.table, item, CS_ITEM_SK, I_ITEM_SK,
+                       1, num_items, clustered=True)
+    # j2: [...j1..., i_item_sk, i_brand_id, i_category_id]
+    m2 = j2.matched
+
+    # join 3: (item, week) -> the inventory grid row, purely arithmetic
+    # (a direct index gather — there is no key column to search at all;
+    # the grid contract is verified against the landed item/week below)
+    cs_item = j2.table.column(0)
+    week = j2.table.column(5)
+    grid = ((cs_item.data - 1) * num_weeks
+            + (week.data.astype(cs_item.data.dtype) - 1))
+    week_ok = (week.data >= 1) & (week.data <= num_weeks)
+    in_grid = (m1 & m2 & cs_item.valid_mask() & week.valid_mask()
+               & week_ok & (grid >= 0)
+               & (grid < inventory.num_rows))
+    pos = jnp.clip(grid, 0, inventory.num_rows - 1).astype(jnp.int32)
+    inv_item_at = inventory.column(INV_ITEM_SK).data[pos]
+    inv_week_at = inventory.column(INV_WEEK_SEQ).data[pos]
+    inv_qty_c = inventory.column(INV_QTY)
+    inv_qty_at = inv_qty_c.data[pos]
+    inv_qty_ok = inv_qty_c.valid_mask()[pos] & in_grid
+    # grid-contract verification: the landed inventory row must be the
+    # (item, week) the probe meant (a non-grid layout would alias)
+    grid_lie = jnp.any(
+        in_grid & ((inv_item_at != cs_item.data)
+                   | (inv_week_at != week.data.astype(jnp.int64))))
+
+    qty = j2.table.column(CS_QUANTITY)
+    short = (inv_qty_ok & qty.valid_mask() & (inv_qty_at < qty.data))
+    gid = jnp.where(short, cs_item.data - 1,
+                    jnp.int64(num_items)).astype(jnp.int32)
+    counts = dense_id_counts(gid, num_items)
+    present = counts > 0
+
+    # static keys + brand via one clustered gather over the item table
+    item_sk = jnp.arange(1, num_items + 1, dtype=jnp.int64)
+    brand_c = item.column(I_BRAND_ID)
+    brands = brand_c.data
+    out = Table([
+        Column(t.INT64, item_sk, present),
+        Column(brand_c.dtype, brands, brand_c.valid_mask() & present),
+        Column(t.INT64, counts, present),
+    ])
+    srt = sort_table(out, [2, 0], ascending=[False, True],
+                     nulls_first=[False, False])
+    return Q72PlannedResult(
+        srt, present,
+        j1.pk_violation | j2.pk_violation | grid_lie)
+
+
 def tpcds_q72_numpy(
     catalog_sales: Table, date_dim: Table, item: Table, inventory: Table,
     year: int = 2000,

@@ -1169,6 +1169,58 @@ def tpch_q14(part: Table, lineitem: Table,
     )
 
 
+class Q14PlannedResult(NamedTuple):
+    promo_revenue: jnp.ndarray   # int64 unscaled decimal(-4)
+    total_revenue: jnp.ndarray   # int64 unscaled decimal(-4)
+    join_total: jnp.ndarray
+    pk_violation: jnp.ndarray    # declared clustered PK was a lie
+
+    def ratio(self) -> float:
+        tot = int(self.total_revenue)
+        return 100.0 * int(self.promo_revenue) / tot if tot else 0.0
+
+
+@func_range("tpch_q14_planned")
+def tpch_q14_planned(part: Table, lineitem: Table,
+                     month_start: int = _Q14_MONTH_START,
+                     month_end: int = _Q14_MONTH_END) -> Q14PlannedResult:
+    """q14 with the part join as a planner-declared dense clustered PK
+    lookup: the WHOLE query compiles sort-free (HLO-pinned) — the join
+    is arithmetic + gather, the aggregate is two global masked sums.
+    Bonus simplification over the general plan: dense-PK output rows
+    are probe-aligned (row i IS lineitem row i), so the revenue lanes
+    need no left-map gather at all."""
+    from spark_rapids_jni_tpu.ops import strings as s
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    ship_c = lineitem.column(L14_SHIPDATE)
+    ship = ship_c.data
+    keep = (ship_c.valid_mask()
+            & (ship >= jnp.int32(month_start))
+            & (ship < jnp.int32(month_end)))
+    price = lineitem.column(L14_EXTENDEDPRICE)
+    disc = lineitem.column(L14_DISCOUNT)
+    revenue = price.data * (100 - disc.data)   # decimal(-4), exact
+    rev_ok = price.valid_mask() & disc.valid_mask() & keep
+    probe = Table([
+        _null_where(lineitem.column(L14_PARTKEY), ~keep),
+    ])
+    build = Table([part.column(P_PARTKEY),
+                   s.pad_strings(part.column(P_TYPE))])
+    j = dense_pk_join(probe, build, 0, 0, 1, part.num_rows,
+                      clustered=True)
+    # j.table: [l_partkey, p_partkey, p_type] — probe-aligned
+    matched = j.matched
+    rev_j = jnp.where(matched & rev_ok, revenue, 0)
+    promo = s.like(j.table.column(2), "PROMO%").data != 0
+    return Q14PlannedResult(
+        jnp.sum(jnp.where(promo, rev_j, 0)),
+        jnp.sum(rev_j),
+        j.total,
+        j.pk_violation,
+    )
+
+
 def tpch_q14_numpy(part: Table, lineitem: Table,
                    month_start: int = _Q14_MONTH_START,
                    month_end: int = _Q14_MONTH_END) -> tuple:

@@ -569,3 +569,101 @@ def test_dense_pk_join_sorted_mode_out_of_range_build_key_flags():
     probe = Table([Column.from_numpy(np.asarray([1], np.int64))])
     res = dense_pk_join(probe, build, 0, 0, 1, 40, clustered=False)
     assert bool(res.pk_violation)  # declared range was a lie
+
+
+def test_dense_id_counts_matches_bincount(rng):
+    from spark_rapids_jni_tpu.ops.planner import dense_id_counts
+
+    m, n = 37, 5000
+    gid = rng.integers(0, m + 1, n)  # m = "counts nowhere"
+    got = np.asarray(dense_id_counts(jnp.asarray(gid), m, block=512))
+    want = np.bincount(gid[gid < m], minlength=m)
+    assert (got == want).all()
+    assert np.asarray(
+        dense_id_counts(jnp.zeros((0,), jnp.int32), m)).sum() == 0
+
+
+def test_q14_planned_matches_oracle_and_whole_query_sort_free():
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q14_table,
+        part_table,
+        tpch_q14_numpy,
+        tpch_q14_planned,
+    )
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    n_part, n = 64, 1024
+    part = part_table(n_part)
+    pcols = list(part.columns)
+    pcols[1] = pad_strings(pcols[1])
+    part = Table(pcols)
+    li = lineitem_q14_table(n, n_part)
+    res = tpch_q14_planned(part, li)
+    assert not bool(res.pk_violation)
+    promo, total = tpch_q14_numpy(part, li)
+    assert int(res.promo_revenue) == promo
+    assert int(res.total_revenue) == total
+
+    def digest(p, l):
+        r = tpch_q14_planned(p, l)
+        return (r.promo_revenue + 3 * r.total_revenue
+                + 7 * r.join_total.astype(jnp.int64) + r.pk_violation)
+
+    hlo = jax.jit(digest).lower(part, li).compile().as_text()
+    # the ENTIRE q14 plan is sort-free: join is arithmetic+gather,
+    # aggregate is two global masked sums
+    assert not [l for l in hlo.splitlines()
+                if re.search(r"= \S+ sort\(", l)]
+    assert not [l for l in hlo.splitlines() if " scatter(" in l]
+
+
+def test_q72_planned_matches_oracle():
+    from spark_rapids_jni_tpu.models import tpcds
+
+    n = 3000
+    cs = tpcds.catalog_sales_table(n, num_items=50, num_days=400)
+    dd = tpcds.date_dim_table(400)
+    it = tpcds.item_table(50)
+    inv = tpcds.inventory_table(num_items=50, num_weeks=60)
+    res = tpcds.tpcds_q72_planned(cs, dd, it, inv)
+    assert not bool(res.pk_violation)
+    oracle = tpcds.tpcds_q72_numpy(cs, dd, it, inv)
+    tbl = res.table
+    sk = tbl.column(0).to_pylist()
+    br = tbl.column(1).to_pylist()
+    ct = tbl.column(2).to_pylist()
+    got = {}
+    for i in range(tbl.num_rows):
+        if sk[i] is None or ct[i] is None or ct[i] == 0:
+            continue
+        got[(sk[i], br[i])] = ct[i]
+    assert got == oracle
+    # ORDER BY count desc on the live head
+    live = [ct[i] for i in range(tbl.num_rows) if sk[i] is not None]
+    assert all(live[i] >= live[i + 1] for i in range(len(live) - 1))
+
+
+def test_q72_planned_no_probe_length_sorts():
+    """Every remaining sort in the planned q72 is over the num_items
+    output (the final ORDER BY), never over the n-sized probe path."""
+    from spark_rapids_jni_tpu.models import tpcds
+
+    n, items = 4096, 64
+    cs = tpcds.catalog_sales_table(n, num_items=items, num_days=200)
+    dd = tpcds.date_dim_table(200)
+    it = tpcds.item_table(items)
+    inv = tpcds.inventory_table(num_items=items, num_weeks=30)
+
+    def digest(a, b, c, d):
+        r = tpcds.tpcds_q72_planned(a, b, c, d)
+        acc = jnp.float64(0)
+        for col in r.table.columns:
+            acc = acc + jnp.sum(col.data).astype(jnp.float64)
+            acc = acc + jnp.sum(col.valid_mask())
+        return acc + jnp.sum(r.present) + r.pk_violation
+
+    hlo = jax.jit(digest).lower(cs, dd, it, inv).compile().as_text()
+    sort_lines = [l for l in hlo.splitlines()
+                  if re.search(r"= \S+ sort\(", l)]
+    assert all(str(n) not in l for l in sort_lines), sort_lines
+    assert not [l for l in hlo.splitlines() if " scatter(" in l]
