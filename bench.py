@@ -623,6 +623,22 @@ def _bench_tpcds_q64(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_tpcds_q64_planned(n: int, iters: int):
+    """q64 with the cross-year self-join ELIMINATED by the exact
+    count-product rewrite — no join materialization, no out_factor
+    blowup, no truncation mode."""
+    import jax
+
+    from spark_rapids_jni_tpu.models import tpcds
+
+    ss = tpcds.store_sales_table(n)
+    fn = jax.jit(
+        lambda a: _table_digest(tpcds.tpcds_q64_planned(a).result.table)
+    )
+    per_iter = _measure(lambda: fn(ss), iters)
+    return n / per_iter
+
+
 def _bench_tpch_q3(n: int, iters: int):
     """q3 join+groupby pipeline: n lineitem rows against n/8 orders and
     n/64 customers (TPC-H-ish fanout)."""
@@ -767,6 +783,8 @@ _CONFIGS = {
     "regexp": (_bench_regexp, "regexp_rows_per_s", "rows/s"),
     "cast_strings": (_bench_cast_strings, "cast_strings_rows_per_s", "rows/s"),
     "tpcds_q64": (_bench_tpcds_q64, "tpcds_q64_rows_per_s", "rows/s"),
+    "tpcds_q64_planned": (
+        _bench_tpcds_q64_planned, "tpcds_q64_planned_rows_per_s", "rows/s"),
     "tpch_q1_planned": (
         _bench_tpch_q1_planned, "tpch_q1_planned_rows_per_s", "rows/s"),
     "tpch_q1_pallas": (
@@ -960,6 +978,7 @@ def sweep() -> None:
                   flush=True)
     # big-table configs whose 16M variants don't add information per size
     single_size = {"parquet_q1", "shuffle_wire", "tpcds_q72", "tpcds_q64",
+                   "tpcds_q64_planned",
                    "json_extract", "regexp", "cast_strings", "tpch_q14",
                    "tpch_q14_planned", "tpcds_q72_planned",
                    "tpch_q3", "tpch_q3_planned", "tpch_q12",

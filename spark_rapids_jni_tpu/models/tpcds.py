@@ -601,6 +601,68 @@ def tpcds_q64_distributed(
     return _compact_valid_keys(result, 1, [1, 0], [False, True])
 
 
+class Q64PlannedResult(NamedTuple):
+    result: GroupByResult    # [ss_item_sk, pair_count], count desc
+    join_total: jnp.ndarray  # the pair count the general plan materializes
+
+
+@func_range("tpcds_q64_planned")
+def tpcds_q64_planned(
+    store_sales: Table,
+    year1: int = 2000,
+    year2: int = 2001,
+    num_days_per_year: int = 365,
+    base_year: int = 2000,
+) -> Q64PlannedResult:
+    """q64's cross-year self-join ELIMINATED by an exact aggregate
+    rewrite: COUNT over the (item,customer) self-join is
+    sum_{(i,c)} cnt_y1(i,c) * cnt_y2(i,c) — two conditional counts per
+    pair and a product, no join at all.
+
+    Unlike the bounded/dense plans this needs NO declared facts: the
+    rewrite is unconditionally exact (a COUNT-over-equi-self-join is a
+    sum of per-key count products — the optimizer transformation Spark
+    performs as partial aggregation pushdown). What it buys: the
+    general plan pays a build-side sort + join materialization at
+    out_factor*n rows (with truncation risk the caller must check) +
+    a groupby sort over that blown-up output; this plan pays ONE
+    groupby over n rows + one over the distinct pairs, with no
+    capacity estimate and no truncation mode at all."""
+    date = store_sales.column(SS_SOLD_DATE_SK).data
+    yr = (date - 1) // jnp.int64(num_days_per_year)
+    in_y1 = yr == (year1 - base_year)
+    in_y2 = yr == (year2 - base_year)
+    key = _pack_key(
+        store_sales.column(SS_ITEM_SK), store_sales.column(SS_CUSTOMER_SK),
+        MAX_CUSTOMERS,
+    )
+    valid = key.valid_mask() & (in_y1 | in_y2)
+    pair = Table([
+        _null_keys_where(key, ~valid),
+        Column(t.INT64, in_y1.astype(jnp.int64), valid),
+        Column(t.INT64, in_y2.astype(jnp.int64), valid),
+    ])
+    per_pair = groupby_aggregate(pair, keys=[0],
+                                 aggs=[(1, "sum"), (2, "sum")])
+    pk = per_pair.table.column(0)
+    a = per_pair.table.column(1)
+    b = per_pair.table.column(2)
+    pairs = a.data * b.data  # cnt_y1 * cnt_y2 per (item, customer)
+    pvalid = (pk.valid_mask() & a.valid_mask() & b.valid_mask()
+              & (pairs > 0))
+    item_of = Table([
+        Column(t.INT64, pk.data // jnp.int64(MAX_CUSTOMERS), pvalid),
+        Column(t.INT64, jnp.where(pvalid, pairs, 0), pvalid),
+    ])
+    grouped = groupby_aggregate(item_of, keys=[0], aggs=[(1, "sum")])
+    srt = sort_table(
+        grouped.table, [1, 0], ascending=[False, True],
+        nulls_first=[False, False],
+    )
+    total = jnp.sum(jnp.where(pvalid, pairs, 0))
+    return Q64PlannedResult(GroupByResult(srt, grouped.num_groups), total)
+
+
 def tpcds_q64_numpy(
     store_sales: Table, year1: int = 2000, year2: int = 2001,
     num_days_per_year: int = 365,

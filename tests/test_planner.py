@@ -667,3 +667,21 @@ def test_q72_planned_no_probe_length_sorts():
                   if re.search(r"= \S+ sort\(", l)]
     assert all(str(n) not in l for l in sort_lines), sort_lines
     assert not [l for l in hlo.splitlines() if " scatter(" in l]
+
+
+def test_q64_planned_join_elimination_matches_oracle(rng):
+    from spark_rapids_jni_tpu.models import tpcds
+
+    ss = tpcds.store_sales_table(4000)
+    res = tpcds.tpcds_q64_planned(ss)
+    oracle = tpcds.tpcds_q64_numpy(ss)
+    tbl = res.result.table
+    sk = tbl.column(0).to_pylist()
+    ct = tbl.column(1).to_pylist()
+    got = {sk[i]: ct[i] for i in range(tbl.num_rows)
+           if sk[i] is not None and ct[i] and ct[i] > 0}
+    assert got == oracle
+    assert int(res.join_total) == sum(oracle.values())
+    # general plan agrees too (both against the same oracle)
+    gen = tpcds.tpcds_q64(ss)
+    assert int(gen.join_total) == int(res.join_total)
