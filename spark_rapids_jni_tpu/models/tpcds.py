@@ -601,6 +601,106 @@ def tpcds_q64_distributed(
     return _compact_valid_keys(result, 1, [1, 0], [False, True])
 
 
+@func_range("tpcds_q72_planned_distributed")
+def tpcds_q72_planned_distributed(
+    catalog_sales: Table,
+    date_dim: Table,
+    item: Table,
+    inventory: Table,
+    mesh,
+    year: int = 2000,
+):
+    """Multi-executor planned q72 with ZERO shuffles: catalog_sales
+    shards row-wise, the three dimension tables replicate (the
+    broadcast-join plan — they are the small sides), every device runs
+    the dense-PK/grid lookups + dense-id COUNT on its shard, and the
+    global merge is one psum over the num_items count vector. Bytes on
+    the wire: num_items * 8 per device, vs the general distributed
+    q72's row exchange.
+
+    Returns (table, present, pk_violation) with the same schema as
+    tpcds_q72_planned; the result is REPLICATED (identical on every
+    device)."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_jni_tpu.ops.planner import (
+        dense_id_counts,
+        dense_pk_join,
+    )
+    from spark_rapids_jni_tpu.parallel.distributed import shard_table
+    from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+
+    num_days = date_dim.num_rows
+    num_items = item.num_rows
+    if inventory.num_rows % num_items:
+        raise ValueError(
+            "inventory is not a dense (item, week) grid — use tpcds_q72")
+    num_weeks = inventory.num_rows // num_items
+
+    dd_key = _null_keys_where(
+        date_dim.column(D_DATE_SK),
+        jnp.asarray(np.int32(year)) != date_dim.column(D_YEAR).data,
+    )
+    dd = Table([dd_key, date_dim.column(D_WEEK_SEQ)])
+
+    sharded, rv = shard_table(catalog_sales, mesh, return_row_valid=True)
+
+    def step(local: Table, local_rv, dd_r: Table, item_r: Table,
+             inv_r: Table):
+        j1 = dense_pk_join(local, dd_r, CS_SOLD_DATE_SK, 0,
+                           1, num_days, clustered=True)
+        j2 = dense_pk_join(j1.table, item_r, CS_ITEM_SK, I_ITEM_SK,
+                           1, num_items, clustered=True)
+        cs_item = j2.table.column(0)
+        week = j2.table.column(5)
+        grid = ((cs_item.data - 1) * num_weeks
+                + (week.data.astype(cs_item.data.dtype) - 1))
+        week_ok = (week.data >= 1) & (week.data <= num_weeks)
+        in_grid = (local_rv & j1.matched & j2.matched
+                   & cs_item.valid_mask() & week.valid_mask() & week_ok
+                   & (grid >= 0) & (grid < inv_r.num_rows))
+        pos = jnp.clip(grid, 0, inv_r.num_rows - 1).astype(jnp.int32)
+        inv_item_at = inv_r.column(INV_ITEM_SK).data[pos]
+        inv_week_at = inv_r.column(INV_WEEK_SEQ).data[pos]
+        inv_qty_c = inv_r.column(INV_QTY)
+        grid_lie = jnp.any(
+            in_grid & ((inv_item_at != cs_item.data)
+                       | (inv_week_at != week.data.astype(jnp.int64))))
+        qty = j2.table.column(CS_QUANTITY)
+        short = (in_grid & inv_qty_c.valid_mask()[pos]
+                 & qty.valid_mask()
+                 & (inv_qty_c.data[pos] < qty.data))
+        gid = jnp.where(short, cs_item.data - 1,
+                        jnp.int64(num_items)).astype(jnp.int32)
+        counts = _jax.lax.psum(
+            dense_id_counts(gid, num_items), EXEC_AXIS)
+        viol = _jax.lax.psum(
+            (j1.pk_violation | j2.pk_violation | grid_lie)
+            .astype(jnp.int32), EXEC_AXIS) > 0
+        return counts, viol
+
+    counts, viol = _jax.jit(_jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(), P(), P()),
+        out_specs=(P(), P()),
+    ))(sharded, rv, dd, item, inventory)
+
+    present = counts > 0
+    item_sk = jnp.arange(1, num_items + 1, dtype=jnp.int64)
+    brand_c = item.column(I_BRAND_ID)
+    out = Table([
+        Column(t.INT64, item_sk, present),
+        Column(brand_c.dtype, brand_c.data,
+               brand_c.valid_mask() & present),
+        Column(t.INT64, counts, present),
+    ])
+    srt = sort_table(out, [2, 0], ascending=[False, True],
+                     nulls_first=[False, False])
+    return Q72PlannedResult(srt, present, viol)
+
+
 class Q64PlannedResult(NamedTuple):
     result: GroupByResult    # [ss_item_sk, pair_count], count desc
     join_total: jnp.ndarray  # the pair count the general plan materializes

@@ -312,8 +312,11 @@ def dense_id_counts(gid: jnp.ndarray, m: int,
         oh = blk[:, None] == slots
         return acc + jnp.sum(oh, axis=0, dtype=jnp.int32), None
 
-    acc, _ = jax.lax.scan(
-        step, jnp.zeros((m,), jnp.int32), g.reshape(-1, block))
+    # init derives from the input so its varying-manner annotation
+    # matches the carry under shard_map (a plain zeros constant is
+    # 'replicated' and the scan rejects the carry type mismatch)
+    init = jnp.zeros((m,), jnp.int32) + g[0] * 0
+    acc, _ = jax.lax.scan(step, init, g.reshape(-1, block))
     return acc.astype(jnp.int64)
 
 

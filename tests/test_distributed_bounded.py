@@ -173,3 +173,34 @@ def test_missing_domain_raises_eagerly():
         distributed_groupby_bounded(
             sharded, [0], [(1, "sum")],
             [scalar_domain(range(100))], mesh, budget=10)
+
+
+def test_q72_planned_distributed_zero_shuffle_matches_oracle():
+    """Distributed planned q72: replicated dims, per-device dense-PK
+    lookups + dense-id counts, one psum — no shuffle anywhere. Oracle
+    equality on the 8-device mesh with non-divisible row counts."""
+    from spark_rapids_jni_tpu.models import tpcds
+
+    n = 3001  # not divisible by 8: exercises the row_valid padding path
+    cs = tpcds.catalog_sales_table(n, num_items=40, num_days=300)
+    dd = tpcds.date_dim_table(300)
+    it = tpcds.item_table(40)
+    inv = tpcds.inventory_table(num_items=40, num_weeks=50)
+    mesh = executor_mesh()
+    res = tpcds.tpcds_q72_planned_distributed(cs, dd, it, inv, mesh)
+    assert not bool(res.pk_violation)
+    oracle = tpcds.tpcds_q72_numpy(cs, dd, it, inv)
+    tbl = res.table
+    sk = tbl.column(0).to_pylist()
+    br = tbl.column(1).to_pylist()
+    ct = tbl.column(2).to_pylist()
+    got = {(sk[i], br[i]): ct[i] for i in range(tbl.num_rows)
+           if sk[i] is not None and ct[i] and ct[i] > 0}
+    assert got == oracle
+    # and the single-device planned plan agrees
+    single = tpcds.tpcds_q72_planned(cs, dd, it, inv)
+    s_sk = single.table.column(0).to_pylist()
+    s_ct = single.table.column(2).to_pylist()
+    s_got = {s_sk[i]: s_ct[i] for i in range(single.table.num_rows)
+             if s_sk[i] is not None and s_ct[i] and s_ct[i] > 0}
+    assert s_got == {k[0]: v for k, v in got.items()}
