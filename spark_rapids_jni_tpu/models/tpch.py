@@ -1349,6 +1349,66 @@ def tpch_q19(part: Table, lineitem: Table,
     return Q19Result(jnp.sum(revenue), maps.total)
 
 
+class Q19PlannedResult(NamedTuple):
+    revenue: jnp.ndarray     # int64 unscaled decimal(-4)
+    join_total: jnp.ndarray
+    pk_violation: jnp.ndarray
+
+
+@func_range("tpch_q19_planned")
+def tpch_q19_planned(part: Table, lineitem: Table,
+                     branches: tuple = _Q19_BRANCHES) -> Q19PlannedResult:
+    """q19 with the part join as a dense clustered PK lookup: whole
+    query sort-free, and the probe-aligned output removes every
+    left-map gather the general plan pays for the lineitem lanes
+    (qty/price/disc/shipmode/shipinstruct read directly)."""
+    from spark_rapids_jni_tpu.ops import strings as s
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    probe = Table([lineitem.column(L19_PARTKEY)])
+    build = Table([
+        part.column(P_PARTKEY),
+        s.pad_strings(part.column(P_BRAND)),
+        s.pad_strings(part.column(P_CONTAINER)),
+        part.column(P_SIZE),
+    ])
+    j = dense_pk_join(probe, build, 0, 0, 1, part.num_rows,
+                      clustered=True)
+    # j: [l_partkey, p_partkey, p_brand, p_container, p_size] — row i
+    # IS lineitem row i
+    matched = j.matched
+
+    qty_c = lineitem.column(L19_QUANTITY)
+    price_c = lineitem.column(L19_EXTENDEDPRICE)
+    disc_c = lineitem.column(L19_DISCOUNT)
+    lane_ok = (qty_c.valid_mask() & price_c.valid_mask()
+               & disc_c.valid_mask()
+               & lineitem.column(L19_SHIPMODE).valid_mask()
+               & lineitem.column(L19_SHIPINSTRUCT).valid_mask())
+    mode_c = s.pad_strings(lineitem.column(L19_SHIPMODE))
+    instr_c = s.pad_strings(lineitem.column(L19_SHIPINSTRUCT))
+
+    air = ((s.like(mode_c, "AIR").data != 0)
+           | (s.like(mode_c, "AIR REG").data != 0))
+    person = s.like(instr_c, "DELIVER IN PERSON").data != 0
+    brand_c = j.table.column(2)
+    cont_c = j.table.column(3)
+    size = j.table.column(4).data
+
+    pred = jnp.zeros((lineitem.num_rows,), jnp.bool_)
+    for brand, cont_prefix, qty_lo, size_hi in branches:
+        b = (s.like(brand_c, brand).data != 0)
+        cont = s.like(cont_c, cont_prefix + "%").data != 0
+        qlo = jnp.int64(qty_lo * 100)
+        qhi = jnp.int64((qty_lo + 10) * 100)
+        qok = (qty_c.data >= qlo) & (qty_c.data <= qhi)
+        sok = (size >= 1) & (size <= jnp.int32(size_hi))
+        pred = pred | (b & cont & qok & sok)
+    pred = pred & air & person & matched & lane_ok
+    revenue = jnp.where(pred, price_c.data * (100 - disc_c.data), 0)
+    return Q19PlannedResult(jnp.sum(revenue), j.total, j.pk_violation)
+
+
 def tpch_q19_numpy(part: Table, lineitem: Table,
                    branches: tuple = _Q19_BRANCHES) -> int:
     pinfo = {}

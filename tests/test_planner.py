@@ -685,3 +685,37 @@ def test_q64_planned_join_elimination_matches_oracle(rng):
     # general plan agrees too (both against the same oracle)
     gen = tpcds.tpcds_q64(ss)
     assert int(gen.join_total) == int(res.join_total)
+
+
+def test_q19_planned_matches_oracle_and_sort_free():
+    from spark_rapids_jni_tpu.models.tpch import (
+        lineitem_q19_table,
+        part_table,
+        tpch_q19_numpy,
+        tpch_q19_planned,
+    )
+    from spark_rapids_jni_tpu.ops.strings import pad_strings
+
+    n_part, n = 48, 900
+    part = part_table(n_part)
+    pcols = list(part.columns)
+    pcols[2] = pad_strings(pcols[2])
+    pcols[3] = pad_strings(pcols[3])
+    part = Table(pcols)
+    li = lineitem_q19_table(n, n_part)
+    lcols = list(li.columns)
+    lcols[4] = pad_strings(lcols[4])  # jit needs static string widths
+    lcols[5] = pad_strings(lcols[5])
+    li = Table(lcols)
+    res = tpch_q19_planned(part, li)
+    assert not bool(res.pk_violation)
+    assert int(res.revenue) == tpch_q19_numpy(part, li)
+
+    def digest(p, l):
+        r = tpch_q19_planned(p, l)
+        return (r.revenue + 3 * r.join_total.astype(jnp.int64)
+                + r.pk_violation)
+
+    hlo = jax.jit(digest).lower(part, li).compile().as_text()
+    assert not [l for l in hlo.splitlines()
+                if re.search(r"= \S+ sort\(", l)]
