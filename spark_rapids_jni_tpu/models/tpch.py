@@ -859,6 +859,89 @@ def tpch_q3_distributed(customer: Table, orders: Table, lineitem: Table,
     ])
 
 
+def tpch_q3_planned_distributed(customer: Table, orders: Table,
+                                lineitem: Table, mesh, segment: int = 0,
+                                cutoff: int = _Q3_CUTOFF_DAYS) -> Table:
+    """Multi-executor planned q3: the BROADCAST plan the dense-PK
+    declarations unlock. customer and orders replicate to every device
+    (they are the small sides); each device runs both clustered-PK
+    lookups on its lineitem shard — sort-free, no join exchange at all —
+    then partial-aggregates revenue by orderkey locally. The ONLY
+    exchange in the whole plan is the partial-aggregate shuffle (m
+    partial rows per device, not n), where the general distributed q3
+    pays two full row exchanges before it even reaches that point.
+    Returns the collected, sorted, compacted global result (same
+    contract as tpch_q3_distributed)."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        collect,
+        shard_table,
+    )
+    from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+    from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
+
+    cust, ord_t, probe = _q3_inputs(customer, orders, lineitem, segment,
+                                    cutoff)
+    sp, prv = shard_table(probe, mesh, return_row_valid=True)
+    n_cust, n_ord = customer.num_rows, orders.num_rows
+
+    def step(local: Table, rv, cust_r: Table, ord_r: Table):
+        j1 = dense_pk_join(ord_r, cust_r, 0, 0, 1, n_cust,
+                           clustered=True)
+        build2 = Table([
+            _null_where(j1.table.column(1), ~j1.matched),
+            j1.table.column(2), j1.table.column(3),
+        ])
+        j2 = dense_pk_join(local, build2, 0, 0, 1, n_ord,
+                           clustered=True)
+        jt = j2.table
+        matched = j2.matched & rv
+        keyed = Table([
+            _null_where(jt.column(0), ~matched),
+            jt.column(3), jt.column(4),
+            Column(jt.column(1).dtype, jt.column(1).data,
+                   jt.column(1).valid_mask() & matched),
+        ])
+        local_n = keyed.num_rows
+        partial = groupby_aggregate(keyed, keys=[0, 1, 2],
+                                    aggs=[(3, "sum")],
+                                    max_groups=local_n)
+        real = (jnp.arange(local_n, dtype=jnp.int32)
+                < partial.num_groups)
+        # a sender holds <= local_n real partial rows total, so the
+        # per-receiver lane capacity local_n can never overflow
+        sh = hash_shuffle(partial.table, [0], EXEC_AXIS,
+                          capacity=local_n, row_valid=real)
+        merged = groupby_aggregate(sh.table, keys=[0, 1, 2],
+                                   aggs=[(3, "sum")])
+        viol = (j1.pk_violation | j2.pk_violation)
+        return (merged.table, merged.num_groups.reshape(1),
+                viol.reshape(1))
+
+    out, num_groups, viol = _jax.jit(_jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(), P()),
+        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+    ))(sp, prv, cust, ord_t)
+    if bool(np.asarray(viol).any()):
+        raise ValueError(
+            "dense-PK declaration violated — re-plan with "
+            "tpch_q3_distributed")
+    result = collect(out, num_groups, mesh)
+    srt = sort_table(result, [3, 1], ascending=[False, True],
+                     nulls_first=[False, False])
+    kv = np.asarray(srt.column(0).valid_mask())
+    k = int(kv.sum())
+    return Table([
+        Column(c.dtype, c.data[:k],
+               None if c.validity is None else c.validity[:k])
+        for c in srt.columns
+    ])
+
+
 # ---------------------------------------------------------------------------
 # q12 — shipping modes and order priority (join + string-key groupby with
 # conditional counts). Reference workload family: BASELINE.json config #4's

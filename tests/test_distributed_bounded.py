@@ -204,3 +204,31 @@ def test_q72_planned_distributed_zero_shuffle_matches_oracle():
     s_got = {s_sk[i]: s_ct[i] for i in range(single.table.num_rows)
              if s_sk[i] is not None and s_ct[i] and s_ct[i] > 0}
     assert s_got == {k[0]: v for k, v in got.items()}
+
+
+def test_q3_planned_distributed_broadcast_plan_matches_oracle():
+    """Broadcast-plan distributed q3: replicated dims, per-device
+    dense-PK lookups, one partial-aggregate exchange — vs the general
+    plan's two row exchanges. Oracle equality, non-divisible rows."""
+    from spark_rapids_jni_tpu.models.tpch import (
+        customer_table,
+        lineitem_q3_table,
+        orders_table,
+        tpch_q3_numpy,
+        tpch_q3_planned_distributed,
+    )
+
+    n_cust, n_ord, n = 32, 120, 1003
+    c = customer_table(n_cust)
+    o = orders_table(n_ord, n_cust)
+    li = lineitem_q3_table(n, n_ord)
+    mesh = executor_mesh()
+    out = tpch_q3_planned_distributed(c, o, li, mesh)
+    oracle = tpch_q3_numpy(c, o, li)
+    keys = out.column(0).to_pylist()
+    dates = out.column(1).to_pylist()
+    prios = out.column(2).to_pylist()
+    revs = out.column(3).to_pylist()
+    got = {keys[i]: (revs[i], dates[i], prios[i])
+           for i in range(out.num_rows) if keys[i] is not None}
+    assert got == oracle
