@@ -81,6 +81,26 @@ _MEDIUM_TIER = {
     "tests/test_outofcore.py::test_q1_outofcore_matches_oracle_under_budget",
     "tests/test_planner.py::test_q12_planned_matches_oracle",
     "tests/test_planner.py::test_q4_planned_matches_oracle",
+    # second round-5 durations pass (>=9.5 s): 8-device shard_map
+    # compiles and oracle sweeps; bench-ledger tests stay in premerge
+    # (they protect the driver artifact and their cost is module import)
+    "tests/test_cast_strings.py::test_date_roundtrip_through_strings",
+    "tests/test_cast_strings.py::test_string_to_timestamp_vs_python_oracle",
+    "tests/test_decimal128_ops.py::test_decimal128_sum_small_m_path_matches",
+    "tests/test_distributed_bounded.py::test_domain_miss_propagates_from_one_shard",
+    "tests/test_distributed_bounded.py::test_groups_absent_everywhere_not_present",
+    "tests/test_distributed_bounded.py::test_nondivisible_rows_no_phantom_null_group",
+    "tests/test_distributed_bounded.py::test_output_replicated_not_sharded",
+    "tests/test_distributed_bounded.py::test_scalar_keys_match_oracle",
+    "tests/test_distributed_bounded.py::test_string_keys_under_shard_map",
+    "tests/test_distributed_bounded.py::test_q72_planned_distributed_zero_shuffle_matches_oracle",
+    "tests/test_distributed_bounded.py::test_q3_planned_distributed_broadcast_plan_matches_oracle",
+    "tests/test_json_device.py::test_device_engine_adversarial_structurals",
+    "tests/test_ops.py::test_groupby_first_last_vs_oracle",
+    "tests/test_outofcore.py::test_run_chunked_aggregate_with_prefetch_matches",
+    "tests/test_planner.py::test_q19_planned_matches_oracle_and_sort_free",
+    "tests/test_planner.py::test_q64_planned_join_elimination_matches_oracle",
+    "tests/test_strings.py::TestStringMinMax::test_min_max_matches_oracle",
 }
 
 
