@@ -534,10 +534,48 @@ def _ascii_case(col: Column, to_upper: bool) -> Column:
     p = pad_strings(col)
     mat = p.chars
     if bool(jnp.any(mat >= 0x80)):
-        # non-ASCII: the vectorized byte path would corrupt multi-byte
-        # UTF-8, so route through the host engine (correct, slower) —
-        # the two-engine pattern get_json_object uses
-        return _host_case(col, to_upper)
+        # non-ASCII: the Unicode device engine (per-position classify +
+        # case-LUT gather + in-place re-encode) handles every row whose
+        # characters have 1:1 length-preserving mappings; only rows with
+        # SPECIAL characters (ß→SS expansions, length-changing maps,
+        # astral chars, invalid UTF-8) take the host engine
+        from spark_rapids_jni_tpu.ops.unicode_case_device import (
+            case_map_device,
+        )
+
+        out, row_special = case_map_device(mat, to_upper)
+        spec_np = np.asarray(row_special)
+        if col.validity is not None:
+            # null rows' bytes are don't-care: never decode them
+            spec_np = spec_np & np.asarray(col.validity)
+        spec_idx = np.flatnonzero(spec_np)
+        if spec_idx.size == 0:
+            return Column(STRING, p.data, col.validity, chars=out)
+        # per-row merge: only the SPECIAL rows (expansions, length-
+        # changing maps, final sigma, invalid sequences) cross to the
+        # host — the device mapping for every other row is kept
+        lens_np = np.asarray(p.data)
+        spec_rows = np.asarray(mat[jnp.asarray(spec_idx)])
+        mapped_vals = []
+        for row_i, i in enumerate(spec_idx):
+            raw = spec_rows[row_i, : lens_np[i]].tobytes().decode()
+            mapped_vals.append(raw.upper() if to_upper else raw.lower())
+        mapped_bytes = [v.encode() for v in mapped_vals]
+        w_out = max(int(mat.shape[1]),
+                    max(len(b) for b in mapped_bytes))
+        if w_out > mat.shape[1]:
+            out = jnp.concatenate(
+                [out, jnp.zeros((out.shape[0], w_out - mat.shape[1]),
+                                jnp.uint8)], axis=1)
+        host_mat = np.zeros((spec_idx.size, w_out), np.uint8)
+        host_lens = np.zeros(spec_idx.size, np.int32)
+        for row_i, b in enumerate(mapped_bytes):
+            host_mat[row_i, : len(b)] = np.frombuffer(b, np.uint8)
+            host_lens[row_i] = len(b)
+        idx = jnp.asarray(spec_idx.astype(np.int32))
+        out = out.at[idx].set(jnp.asarray(host_mat))
+        lengths = p.data.at[idx].set(jnp.asarray(host_lens))
+        return Column(STRING, lengths, col.validity, chars=out)
     if to_upper:
         out = jnp.where((mat >= ord("a")) & (mat <= ord("z")), mat - 32, mat)
     else:
@@ -547,15 +585,17 @@ def _ascii_case(col: Column, to_upper: bool) -> Column:
 
 @func_range("string_upper")
 def upper(col: Column) -> Column:
-    """Spark upper: ASCII rides the vectorized device path; non-ASCII
-    falls back to the host Unicode engine."""
+    """Spark upper: ASCII and 1:1 length-preserving Unicode mappings ride
+    the device path; rows with special characters fall back to the host
+    Unicode engine."""
     return _ascii_case(col, True)
 
 
 @func_range("string_lower")
 def lower(col: Column) -> Column:
-    """Spark lower: ASCII rides the vectorized device path; non-ASCII
-    falls back to the host Unicode engine."""
+    """Spark lower: ASCII and 1:1 length-preserving Unicode mappings ride
+    the device path; rows with special characters fall back to the host
+    Unicode engine."""
     return _ascii_case(col, False)
 
 

@@ -1,0 +1,108 @@
+"""Device Unicode case mapping (ops/unicode_case_device.py).
+
+Oracle: Python str.upper/str.lower (the same Unicode case tables Java
+applies under Locale.ROOT). Pins: device-path correctness across the
+common scripts (no host fallback — asserted by poisoning the host
+engine), special-character rows routing host (expansions,
+length-changing maps), null handling, and a mixed-script fuzz sweep.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops import strings as s
+
+
+def _check(vals, to_upper, monkeypatch=None, expect_device=None):
+    col = Column.from_pylist(vals, t.STRING)
+    if expect_device is True and monkeypatch is not None:
+        monkeypatch.setattr(
+            s, "_host_case",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("host fallback taken unexpectedly")))
+    out = s.upper(col) if to_upper else s.lower(col)
+    got = out.to_pylist()
+    want = [None if v is None
+            else (v.upper() if to_upper else v.lower()) for v in vals]
+    assert got == want, (got, want)
+
+
+@pytest.mark.parametrize("to_upper", [True, False])
+def test_common_scripts_stay_on_device(to_upper, monkeypatch):
+    # corpora are special-free per direction (ß is special for upper;
+    # capital Σ is special for lower — the final-sigma context rule)
+    corpora = [
+        ["Café au lait", "ñoño", "ÜBER den Wolken"],
+        ["Ελληνικά Κείμενο" if to_upper else "ελληνικά κείμενο",
+         "αβγδε" if not to_upper else "αβγδε ΑΒΓΔΕ"],
+        ["Привет МИР", "жёлтый ЖЁЛТЫЙ", "Українська"],
+        ["ＡＢＣｄｅｆ", "ｆｕｌｌｗｉｄｔｈ", "１２３"],
+        ["mixed ASCII and é è ü Ö", "", None, "łódź ŁÓDŹ"],
+    ]
+    for vals in corpora:
+        _check(vals, to_upper, monkeypatch, expect_device=True)
+
+
+def test_final_sigma_rows_fall_back_and_match_python():
+    # Python's str.lower applies the SpecialCasing final-sigma rule
+    # (word-final Σ -> ς); a positionless LUT cannot, so rows with Σ
+    # are special and take the host engine — results must match the
+    # oracle exactly
+    vals = ["ΤΕΛΟΣ", "ΟΔΟΣ ΟΔΟΣ", "ΣΙΓΜΑ"]
+    col = Column.from_pylist(vals, t.STRING)
+    got = s.lower(col).to_pylist()
+    assert got == [v.lower() for v in vals]
+    assert got[0].endswith("ς")  # the context rule really fired
+
+
+def test_special_rows_fall_back_to_host():
+    # ß upper -> SS (1:2 expansion); ı upper -> I (2B -> 1B)
+    for vals, up in [(["straße"], True), (["ısı"], True),
+                     (["İstanbul"], False)]:  # İ lower -> i̇ (1:2)
+        col = Column.from_pylist(vals, t.STRING)
+        out = s.upper(col) if up else s.lower(col)
+        want = [(v.upper() if up else v.lower()) for v in vals]
+        assert out.to_pylist() == want
+
+
+def test_astral_plane_falls_back():
+    # Deseret has case pairs outside the BMP (4-byte UTF-8)
+    vals = ["\U00010400ab", "plain"]
+    col = Column.from_pylist(vals, t.STRING)
+    assert s.lower(col).to_pylist() == [v.lower() for v in vals]
+
+
+def test_ascii_only_unaffected(monkeypatch):
+    monkeypatch.setattr(
+        s, "_host_case",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("host")))
+    col = Column.from_pylist(["Hello", "WORLD", None, "mIxEd"], t.STRING)
+    assert s.upper(col).to_pylist() == ["HELLO", "WORLD", None, "MIXED"]
+    assert s.lower(col).to_pylist() == ["hello", "world", None, "mixed"]
+
+
+def test_fuzz_mixed_scripts_vs_oracle(rng):
+    alphabet = list("aZ9 éÜñ") + list("αΩж") + list("Ｆｗ") + ["ß", "ı"]
+    for trial in range(6):
+        vals = ["".join(rng.choice(alphabet,
+                                   size=rng.integers(0, 12)))
+                for _ in range(40)]
+        for to_upper in (True, False):
+            _check(vals, to_upper)
+
+
+def test_mixed_column_keeps_device_rows_and_merges_special(monkeypatch):
+    """Per-row routing: one ß row must not demote the Latin-1 rows —
+    _host_case (the whole-column path) must never run; the special row
+    still expands correctly (output width grows)."""
+    monkeypatch.setattr(
+        s, "_host_case",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("whole-column host path taken")))
+    vals = ["Café", "straße", None, "ñoño", "ÜBER"]
+    col = Column.from_pylist(vals, t.STRING)
+    got = s.upper(col).to_pylist()
+    assert got == [None if v is None else v.upper() for v in vals]
+    assert got[1] == "STRASSE"  # the 1:2 expansion really merged in
