@@ -4,13 +4,13 @@
  * packed-row byte contract (reference RowConversion.java:40-99): columns
  * size-aligned in schema order, validity bytes (bit col%8 of byte col//8)
  * after the last column, rows padded to 8 bytes, output batched under 2^31
- * bytes with 32-row-multiple batch sizes. The device (Python/JAX) codec
- * additionally packs DECIMAL128 rows: a 16-byte little-endian
- * two's-complement element aligned to 16 bytes — the generic
- * alignment-equals-size rule the reference applies to every cudf::size_of
- * type (reference row_conversion.cu:439-443,462-468). The host-buffer
- * C codec (rt_bridge) still covers 1/2/4/8-byte elements only, so
- * DECIMAL128 tables must cross as device handles, not host rows.
+ * bytes with 32-row-multiple batch sizes. All fixed-width types pack,
+ * including DECIMAL128: a 16-byte little-endian two's-complement element
+ * aligned to 16 bytes — the generic alignment-equals-size rule the
+ * reference applies to every cudf::size_of type (reference
+ * row_conversion.cu:439-443,462-468) — supported by BOTH the device
+ * (Python/JAX) codec and the host-buffer C codec, cross-validated
+ * byte-for-byte.
  *
  * The conversion runs ON DEVICE through the embedded TPU runtime
  * (libtpudf_rt -> spark_rapids_jni_tpu.ops.row_conversion), crossing JNI as

@@ -83,7 +83,11 @@ def host_from_rows(rows: np.ndarray, schema: list[DType]) -> Table:
     n = rows.shape[0]
     rows = np.ascontiguousarray(rows, dtype=np.uint8)
 
-    datas = [np.empty(n, dtype=dt.storage_dtype) for dt in schema]
+    # DECIMAL128 unpacks straight into its int64[n, 2] limb-pair storage
+    # (16 contiguous little-endian bytes per row — the same image the
+    # device codec writes)
+    datas = [np.empty((n, 2), dtype=np.int64) if dt.is_decimal128
+             else np.empty(n, dtype=dt.storage_dtype) for dt in schema]
     valids = [np.empty(n, dtype=np.uint8) for _ in schema]
     data_ptrs = (ctypes.c_void_p * len(datas))(
         *[d.ctypes.data_as(ctypes.c_void_p).value for d in datas]

@@ -16,8 +16,12 @@ Layout fixed_width_layout(std::vector<int32_t> const& sizes) {
   Layout out;
   int32_t at = 0;
   for (int32_t s : sizes) {
-    if (s != 1 && s != 2 && s != 4 && s != 8) {
-      throw std::invalid_argument("fixed-width element size must be 1/2/4/8");
+    // 16 = DECIMAL128 (__int128_t in the reference's generic layout,
+    // row_conversion.cu:462-468): little-endian limb pair, memcpy'd
+    // like every other fixed-width element; alignment = element size
+    if (s != 1 && s != 2 && s != 4 && s != 8 && s != 16) {
+      throw std::invalid_argument(
+          "fixed-width element size must be 1/2/4/8/16");
     }
     at = align_to(at, s);
     out.start.push_back(at);
