@@ -681,6 +681,42 @@ def _bench_tpcds_q64(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_tpch_q5(n: int, iters: int):
+    """q5: the six-table join grouped by nation, built entirely from
+    planner facts — five dense clustered-PK lookups + the 25-nation
+    bounded groupby; no n-sized sort anywhere."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.models.tpch import (
+        customer_q5_table,
+        lineitem_q5_table,
+        nation_table,
+        orders_table,
+        supplier_table,
+        tpch_q5,
+    )
+
+    n_cust = max(n // 64, 8)
+    n_ord = max(n // 8, 8)
+    n_supp = max(n // 128, 4)
+    c = customer_q5_table(n_cust)
+    o = orders_table(n_ord, n_cust)
+    li = lineitem_q5_table(n, n_ord, n_supp)
+    su = supplier_table(n_supp)
+    na = nation_table()
+
+    def run(a, b, d, e, f):
+        r = tpch_q5(a, b, d, e, f)
+        return (_table_digest(r.table)
+                + jnp.sum(r.present).astype(jnp.float64)
+                + r.pk_violation + r.domain_miss)
+
+    fn = jax.jit(run)
+    per_iter = _measure(lambda: fn(c, o, li, su, na), iters)
+    return n / per_iter
+
+
 def _bench_tpcds_q64_planned(n: int, iters: int):
     """q64 with the cross-year self-join ELIMINATED by the exact
     count-product rewrite — no join materialization, no out_factor
@@ -819,6 +855,7 @@ def _bench_shuffle_wire(n: int, iters: int):
 # config so failure records line up with their success history.
 _CONFIGS = {
     "tpch_q1": (_bench_tpch_q1, "tpch_q1_rows_per_s", "rows/s"),
+    "tpch_q5": (_bench_tpch_q5, "tpch_q5_rows_per_s", "rows/s"),
     "tpch_q6": (_bench_tpch_q6, "tpch_q6_rows_per_s", "rows/s"),
     "tpcds_q72": (_bench_tpcds_q72, "tpcds_q72_rows_per_s", "rows/s"),
     "row_conversion": (_bench_row_conversion, "row_conversion_gb_per_s", "GB/s"),
@@ -1042,7 +1079,7 @@ def sweep() -> None:
                    "tpcds_q64_planned",
                    "json_extract", "regexp", "cast_strings", "tpch_q14",
                    "tpch_q14_planned", "tpcds_q72_planned",
-                   "tpch_q3", "tpch_q3_planned", "tpch_q12",
+                   "tpch_q5", "tpch_q3", "tpch_q3_planned", "tpch_q12",
                    "tpch_q12_planned", "tpch_q4_planned"}
     ok, why = _probe_tpu(float(os.environ.get("BENCH_PROBE_TIMEOUT", 120)))
     if not ok:

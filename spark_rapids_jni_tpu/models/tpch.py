@@ -943,6 +943,224 @@ def tpch_q3_planned_distributed(customer: Table, orders: Table,
 
 
 # ---------------------------------------------------------------------------
+# q5 — local supplier volume: the six-table join (customer, orders,
+# lineitem, supplier, nation, region) grouped by nation. The TPU plan is
+# built ENTIRELY from planner facts: every join is a dense clustered-PK
+# lookup, the region predicate pushes into the nation build side, the
+# c_nationkey = s_nationkey condition is a post-lookup filter, and the
+# GROUP BY nation is the bounded masked-reduction over the 25-value DDL
+# domain — no sort touches an n-sized array anywhere.
+# ---------------------------------------------------------------------------
+
+_Q5_NATIONS = (
+    "ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+    "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ", "JAPAN",
+    "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU", "CHINA",
+    "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA", "UNITED KINGDOM",
+    "UNITED STATES",
+)
+_Q5_N_REGIONS = 5
+_Q5_YEAR_START = 8766   # 1994-01-01
+_Q5_YEAR_END = 9131     # 1995-01-01
+
+# nation columns
+N_NATIONKEY, N_REGIONKEY = 0, 1
+# supplier columns
+S_SUPPKEY, S_NATIONKEY = 0, 1
+# q5 customer columns
+C5_CUSTKEY, C5_NATIONKEY = 0, 1
+# q5 lineitem columns
+L5_ORDERKEY, L5_SUPPKEY, L5_EXTENDEDPRICE, L5_DISCOUNT = 0, 1, 2, 3
+
+
+def nation_table(seed: int = 0) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(np.arange(1, 26, dtype=np.int64)),
+        Column.from_numpy(
+            rng.integers(1, _Q5_N_REGIONS + 1, 25).astype(np.int64)),
+    ])
+
+
+def supplier_table(num_rows: int, seed: int = 9) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(np.arange(1, num_rows + 1, dtype=np.int64)),
+        Column.from_numpy(rng.integers(1, 26, num_rows).astype(np.int64)),
+    ])
+
+
+def customer_q5_table(num_rows: int, seed: int = 10) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(np.arange(1, num_rows + 1, dtype=np.int64)),
+        Column.from_numpy(rng.integers(1, 26, num_rows).astype(np.int64)),
+    ])
+
+
+def lineitem_q5_table(num_rows: int, num_orders: int,
+                      num_suppliers: int, seed: int = 11) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(
+            rng.integers(1, num_orders + 1, num_rows).astype(np.int64)),
+        Column.from_numpy(
+            rng.integers(1, num_suppliers + 1, num_rows).astype(np.int64)),
+        Column.from_numpy(
+            rng.integers(90_000, 10_500_000, num_rows).astype(np.int64),
+            t.decimal64(-2)),
+        Column.from_numpy(
+            rng.integers(0, 11, num_rows).astype(np.int64),
+            t.decimal64(-2)),
+    ])
+
+
+class Q5Result(NamedTuple):
+    table: Table              # [n_nationkey, revenue, n_name], rev desc
+    present: jnp.ndarray
+    pk_violation: jnp.ndarray
+    domain_miss: jnp.ndarray
+
+
+@func_range("tpch_q5")
+def tpch_q5(customer: Table, orders: Table, lineitem: Table,
+            supplier: Table, nation: Table, region_of_interest: int = 1,
+            year_start: int = _Q5_YEAR_START,
+            year_end: int = _Q5_YEAR_END) -> Q5Result:
+    """q5 as the all-planner-facts plan (module header). Row flow, one
+    output row per LINEITEM row at every stage (PK fanout <= 1):
+
+    lineitem -> supplier (suppkey lookup) -> s_nationkey
+             -> orders   (orderkey lookup; date filter pushed into the
+                          build key) -> o_custkey
+             -> customer (custkey lookup on the gathered o_custkey)
+                          -> c_nationkey
+             -> nation   (s_nationkey lookup; region filter pushed into
+                          the build key) -> survives iff in region
+    keep = all matches & c_nationkey == s_nationkey; revenue sums into
+    the 25-slot bounded nation groupby.
+    """
+    from spark_rapids_jni_tpu.ops.planner import (
+        dense_pk_join,
+        plan_groupby,
+        scalar_domain,
+    )
+
+    n_supp = supplier.num_rows
+    n_ord = orders.num_rows
+    n_cust = customer.num_rows
+
+    j_s = dense_pk_join(lineitem, supplier, L5_SUPPKEY, S_SUPPKEY,
+                        1, n_supp, clustered=True)
+    s_nation = j_s.table.column(lineitem.num_columns + 1)
+
+    od = orders.column(O_ORDERDATE)
+    date_ok = (od.valid_mask() & (od.data >= jnp.int32(year_start))
+               & (od.data < jnp.int32(year_end)))
+    ord_build = Table([
+        _null_where(orders.column(O_ORDERKEY), ~date_ok),
+        orders.column(O_CUSTKEY),
+    ])
+    j_o = dense_pk_join(lineitem, ord_build, L5_ORDERKEY, 0,
+                        1, n_ord, clustered=True)
+    o_cust = j_o.table.column(lineitem.num_columns + 1)
+
+    # dense_pk_join already folded `matched` into the gathered column's
+    # validity — the mask is ready to re-probe with
+    cust_probe = Table([o_cust])
+    j_c = dense_pk_join(cust_probe, customer, 0, C5_CUSTKEY,
+                        1, n_cust, clustered=True)
+    c_nation = j_c.table.column(2)
+
+    nat_build = Table([
+        _null_where(nation.column(N_NATIONKEY),
+                    nation.column(N_REGIONKEY).data
+                    != jnp.int64(region_of_interest)),
+    ])
+    nat_probe = Table([s_nation])
+    j_n = dense_pk_join(nat_probe, nat_build, 0, 0, 1, 25,
+                        clustered=True)
+
+    keep = (j_s.matched & j_o.matched & j_c.matched & j_n.matched
+            & (c_nation.data == s_nation.data))
+    price = lineitem.column(L5_EXTENDEDPRICE)
+    disc = lineitem.column(L5_DISCOUNT)
+    rev_ok = keep & price.valid_mask() & disc.valid_mask()
+    revenue = Column(
+        t.decimal64(-4),
+        jnp.where(rev_ok, price.data * (100 - disc.data), 0), rev_ok)
+    keyed = Table([
+        Column(s_nation.dtype,
+               jnp.where(keep, s_nation.data, 0), keep),
+        revenue,
+    ])
+    g = plan_groupby(keyed, [0], [(1, "sum")],
+                     [scalar_domain(range(1, 26))])
+    assert g.lowered == "bounded"
+    # n_name attaches statically BEFORE the tiny ORDER BY: bounded slot
+    # i (< 25) is nation key i+1 -> _Q5_NATIONS[i]; the string column
+    # then rides the 26-row sort like any other column
+    name_w = max(len(nm) for nm in _Q5_NATIONS)
+    name_mat = np.zeros((g.table.num_rows, name_w), np.uint8)
+    name_len = np.zeros(g.table.num_rows, np.int32)
+    for i, nm in enumerate(_Q5_NATIONS):
+        b = nm.encode()
+        name_mat[i, : len(b)] = np.frombuffer(b, np.uint8)
+        name_len[i] = len(b)
+    names = Column(t.STRING, jnp.asarray(name_len),
+                   g.table.column(0).valid_mask(),
+                   chars=jnp.asarray(name_mat))
+    srt = sort_table(Table(list(g.table.columns) + [names]),
+                     [1], ascending=[False], nulls_first=[False])
+    # the 26-row ORDER BY permutes the slot table; present travels as
+    # the key validity (bounded output: key valid <=> slot present)
+    present = srt.column(0).valid_mask()
+    pk_viol = (j_s.pk_violation | j_o.pk_violation | j_c.pk_violation
+               | j_n.pk_violation)
+    return Q5Result(srt, present, pk_viol, g.domain_miss)
+
+
+def tpch_q5_numpy(customer: Table, orders: Table, lineitem: Table,
+                  supplier: Table, nation: Table,
+                  region_of_interest: int = 1,
+                  year_start: int = _Q5_YEAR_START,
+                  year_end: int = _Q5_YEAR_END) -> dict:
+    """Host oracle: {n_nationkey: revenue}."""
+    s_nat = {int(k): int(v) for k, v in zip(
+        np.asarray(supplier.column(S_SUPPKEY).data),
+        np.asarray(supplier.column(S_NATIONKEY).data))}
+    c_nat = {int(k): int(v) for k, v in zip(
+        np.asarray(customer.column(C5_CUSTKEY).data),
+        np.asarray(customer.column(C5_NATIONKEY).data))}
+    in_region = {int(k) for k, r in zip(
+        np.asarray(nation.column(N_NATIONKEY).data),
+        np.asarray(nation.column(N_REGIONKEY).data))
+        if int(r) == region_of_interest}
+    o_info = {}
+    for k, c, d in zip(np.asarray(orders.column(O_ORDERKEY).data),
+                       np.asarray(orders.column(O_CUSTKEY).data),
+                       np.asarray(orders.column(O_ORDERDATE).data)):
+        if year_start <= int(d) < year_end:
+            o_info[int(k)] = int(c)
+    out: dict = {}
+    lkey = np.asarray(lineitem.column(L5_ORDERKEY).data)
+    lsupp = np.asarray(lineitem.column(L5_SUPPKEY).data)
+    price = np.asarray(lineitem.column(L5_EXTENDEDPRICE).data)
+    disc = np.asarray(lineitem.column(L5_DISCOUNT).data)
+    for i in range(lineitem.num_rows):
+        ok = int(lkey[i])
+        if ok not in o_info:
+            continue
+        sn = s_nat.get(int(lsupp[i]))
+        if sn is None or sn not in in_region:
+            continue
+        if c_nat.get(o_info[ok]) != sn:
+            continue
+        out[sn] = out.get(sn, 0) + int(price[i]) * (100 - int(disc[i]))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # q12 — shipping modes and order priority (join + string-key groupby with
 # conditional counts). Reference workload family: BASELINE.json config #4's
 # "hash-join + reader" shape; predicates are Spark CASE WHEN lowering onto

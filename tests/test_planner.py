@@ -719,3 +719,56 @@ def test_q19_planned_matches_oracle_and_sort_free():
     hlo = jax.jit(digest).lower(part, li).compile().as_text()
     assert not [l for l in hlo.splitlines()
                 if re.search(r"= \S+ sort\(", l)]
+
+
+def test_q5_six_table_plan_matches_oracle_and_sort_free():
+    from spark_rapids_jni_tpu.models.tpch import (
+        customer_q5_table,
+        lineitem_q5_table,
+        nation_table,
+        orders_table,
+        supplier_table,
+        tpch_q5,
+        tpch_q5_numpy,
+    )
+
+    n_cust, n_ord, n_supp, n = 64, 200, 32, 1500
+    c = customer_q5_table(n_cust)
+    o = orders_table(n_ord, n_cust)
+    li = lineitem_q5_table(n, n_ord, n_supp)
+    su = supplier_table(n_supp)
+    na = nation_table()
+    res = tpch_q5(c, o, li, su, na)
+    assert not bool(res.pk_violation) and not bool(res.domain_miss)
+    oracle = tpch_q5_numpy(c, o, li, su, na)
+    keys = res.table.column(0).to_pylist()
+    revs = res.table.column(1).to_pylist()
+    present = np.asarray(res.present)
+    got = {keys[i]: revs[i] for i in range(res.table.num_rows)
+           if present[i] and keys[i] is not None and revs[i]}
+    assert got == {k: v for k, v in oracle.items() if v}
+    # revenue desc on the live prefix
+    live = [revs[i] for i in range(len(keys)) if present[i] and keys[i]]
+    assert all(live[i] >= live[i + 1] for i in range(len(live) - 1))
+    # static n_name decode rides the tiny sort with its key
+    from spark_rapids_jni_tpu.models.tpch import _Q5_NATIONS
+
+    names = res.table.column(2).to_pylist()
+    for i in range(res.table.num_rows):
+        if present[i] and keys[i] is not None:
+            assert names[i] == _Q5_NATIONS[keys[i] - 1]
+
+    def digest(a, b, d, e, f):
+        r = tpch_q5(a, b, d, e, f)
+        acc = jnp.float64(0)
+        for col in r.table.columns:
+            acc = acc + jnp.sum(col.data).astype(jnp.float64)
+            acc = acc + jnp.sum(col.valid_mask())
+        return acc + r.pk_violation + r.domain_miss
+
+    hlo = jax.jit(digest).lower(c, o, li, su, na).compile().as_text()
+    sort_lines = [l for l in hlo.splitlines()
+                  if re.search(r"= \S+ sort\(", l)]
+    # only the 26-slot final ORDER BY may sort; nothing n-sized
+    assert all(str(n) not in l for l in sort_lines), sort_lines
+    assert not [l for l in hlo.splitlines() if " scatter(" in l]
