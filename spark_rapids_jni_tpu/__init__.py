@@ -10,16 +10,31 @@ variance/covariance and percentiles, exact multi-key join across all six
 join types, window functions with rolling frames, LIST operators —
 explode/collect/array algebra, concatenate/distinct/compaction,
 EXCEPT/INTERSECT, reductions, the elementwise SQL family, string
-predicates incl. a device byte-DFA regex engine, string transforms and
-split, datetime arithmetic — all incl. STRING and DECIMAL128 columns),
-pure C++ Parquet/ORC read engines, and an ICI all-to-all shuffle
-transport for multi-chip slices.
+predicates incl. a device byte-DFA regex engine with capture-tracking
+regexp_extract/replace, device Unicode case mapping, string transforms
+and split, datetime arithmetic — all incl. STRING and DECIMAL128
+columns), pure C++ Parquet/ORC read engines, out-of-core chunked
+execution under a memory budget with prefetch overlap, an ICI
+all-to-all shuffle transport for multi-chip slices, and a host-staged
+zstd DCN transport across slices.
+
+Planner layer (ops/planner.py): declared knowledge is the performance
+model — key Domains lower groupbys to the sort-free bounded
+masked-reduction pass (125x over sort-based grouping at 16M rows on
+hardware), dense clustered primary keys collapse joins to arithmetic +
+gather (whole TPC-H queries compile sort-free), dense-id counts put
+mid-cardinality groupbys on a blocked one-hot path, and exact rewrites
+(q64's count-product join elimination) remove joins outright; every
+declaration is runtime-verified (domain_miss / pk_violation) so a lie
+re-plans instead of corrupting. Distributed, the bounded plans merge
+with m-row collectives instead of row shuffles (zero-shuffle q72,
+one-exchange broadcast q3).
+
 Pallas posture: the shipped hot paths are XLA-emitted (the measured hot
 spots are layout transforms, scans, sorts, and gathers the compiler
 already fuses; scatter-heavy forms were redesigned scatter-free —
 BASELINE.md); one experimental Pallas kernel (ops/pallas_q1.py) probes
-the residual headroom and the planner-declared bounded-domain groupby is
-the measured TPU headline (125x over sort-based grouping at 16M rows).
+the residual headroom.
 
 Layer map (TPU equivalent of reference SURVEY.md section 1):
   L4' Java API parity sources  -> java/ (build-gated; no JVM in this image)
