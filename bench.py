@@ -717,6 +717,29 @@ def _bench_tpch_q5(n: int, iters: int):
     return n / per_iter
 
 
+def _bench_tpcds_q3(n: int, iters: int):
+    """TPC-DS q3 star plan: two dense clustered-PK dim lookups with
+    predicates pushed into build keys + a dense-id exact SUM brand
+    groupby — no n-sized sorts."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.models import tpcds
+
+    dd = tpcds.date_dim_table()
+    ss = tpcds.store_sales_q3_table(n, num_items=1000)
+    it = tpcds.item_q3_table(1000)
+
+    def run(a, b, c):
+        r = tpcds.tpcds_q3(a, b, c)
+        return (_table_digest(r.table)
+                + jnp.sum(r.present).astype(jnp.float64) + r.pk_violation)
+
+    fn = jax.jit(run)
+    per_iter = _measure(lambda: fn(dd, ss, it), iters)
+    return n / per_iter
+
+
 def _bench_tpcds_q64_planned(n: int, iters: int):
     """q64 with the cross-year self-join ELIMINATED by the exact
     count-product rewrite — no join materialization, no out_factor
@@ -879,6 +902,7 @@ _CONFIGS = {
         _bench_tpcds_q72_planned, "tpcds_q72_planned_rows_per_s", "rows/s"),
     "regexp": (_bench_regexp, "regexp_rows_per_s", "rows/s"),
     "cast_strings": (_bench_cast_strings, "cast_strings_rows_per_s", "rows/s"),
+    "tpcds_q3": (_bench_tpcds_q3, "tpcds_q3_rows_per_s", "rows/s"),
     "tpcds_q64": (_bench_tpcds_q64, "tpcds_q64_rows_per_s", "rows/s"),
     "tpcds_q64_planned": (
         _bench_tpcds_q64_planned, "tpcds_q64_planned_rows_per_s", "rows/s"),
@@ -1075,7 +1099,7 @@ def sweep() -> None:
                   flush=True)
     # big-table configs whose 16M variants don't add information per size
     single_size = {"parquet_q1", "outofcore_q1", "shuffle_wire",
-                   "tpcds_q72", "tpcds_q64",
+                   "tpcds_q3", "tpcds_q72", "tpcds_q64",
                    "tpcds_q64_planned",
                    "json_extract", "regexp", "cast_strings", "tpch_q14",
                    "tpch_q14_planned", "tpcds_q72_planned",

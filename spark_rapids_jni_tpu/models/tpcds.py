@@ -701,6 +701,134 @@ def tpcds_q72_planned_distributed(
     return Q72PlannedResult(srt, present, viol)
 
 
+# ---- TPC-DS q3 (brand revenue by year/month) -------------------------------
+#
+#   SELECT d_year, i_brand_id, sum(ss_ext_sales_price)
+#   FROM date_dim, store_sales, item
+#   WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+#     AND i_manufact_id = :m AND d_moy = :month
+#   GROUP BY d_year, i_brand_id ORDER BY d_year, sum desc
+
+SS3_SOLD_DATE_SK, SS3_ITEM_SK, SS3_EXT_SALES_PRICE = 0, 1, 2
+I3_ITEM_SK, I3_BRAND_ID, I3_MANUFACT_ID = 0, 1, 2
+
+
+def item_q3_table(num_items: int = 1000, seed: int = 4) -> Table:
+    """i_item_sk, i_brand_id, i_manufact_id."""
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(np.arange(1, num_items + 1, dtype=np.int64)),
+        Column.from_numpy(rng.integers(1, 100, num_items).astype(np.int64)),
+        Column.from_numpy(rng.integers(1, 50, num_items).astype(np.int64)),
+    ])
+
+
+def store_sales_q3_table(num_rows: int, num_items: int = 1000,
+                         num_days: int = 730, seed: int = 5) -> Table:
+    """ss_sold_date_sk, ss_item_sk, ss_ext_sales_price (decimal -2)."""
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(
+            rng.integers(1, num_days + 1, num_rows).astype(np.int64)),
+        Column.from_numpy(
+            rng.integers(1, num_items + 1, num_rows).astype(np.int64)),
+        Column.from_numpy(
+            rng.integers(100, 100_000, num_rows).astype(np.int64),
+            t.decimal64(-2)),
+    ])
+
+
+class Q3dsResult(NamedTuple):
+    table: "Table"            # [i_brand_id, revenue], revenue desc
+    present: jnp.ndarray
+    pk_violation: jnp.ndarray
+
+
+@func_range("tpcds_q3")
+def tpcds_q3(date_dim: Table, store_sales: Table, item: Table,
+             manufact_id: int = 7, moy: int = 11) -> Q3dsResult:
+    """TPC-DS q3 as the all-planner-facts star plan: both dim joins are
+    dense clustered-PK lookups with the predicates pushed into the
+    build-side keys (month filter into date_dim, manufacturer filter
+    into item), and the brand groupby is a dense-id exact SUM
+    (``dense_id_sums`` — brand ids are a small dense DDL domain). No
+    n-sized sort anywhere; only the brand-count final ORDER BY sorts.
+
+    The generator's date_dim spans [start_year, +2y) with d_moy derived
+    from d_date_sk; one output year keeps the query single-group-key
+    like the synthetic q72 (the d_year key generalizes via a second
+    dense-id dimension exactly like the month push-down)."""
+    from spark_rapids_jni_tpu.ops.planner import (
+        dense_id_sums,
+        dense_pk_join,
+    )
+
+    num_days = date_dim.num_rows
+    num_brands = 100  # DDL domain bound for the synthetic generator
+
+    # d_moy derives from the date grid; push the month filter into keys
+    sk = date_dim.column(D_DATE_SK).data
+    moy_of = ((sk - 1) % 365) // 31 + 1  # synthetic month-of-year
+    dd_key = _null_keys_where(
+        date_dim.column(D_DATE_SK), moy_of != jnp.int64(moy))
+    dd = Table([dd_key])
+    j1 = dense_pk_join(store_sales, dd, SS3_SOLD_DATE_SK, 0,
+                       1, num_days, clustered=True)
+
+    it_key = _null_keys_where(
+        item.column(I3_ITEM_SK),
+        item.column(I3_MANUFACT_ID).data != jnp.int64(manufact_id))
+    it = Table([it_key, item.column(I3_BRAND_ID)])
+    j2 = dense_pk_join(store_sales, it, SS3_ITEM_SK, 0,
+                       1, item.num_rows, clustered=True)
+    brand = j2.table.column(store_sales.num_columns + 1)
+
+    price = store_sales.column(SS3_EXT_SALES_PRICE)
+    keep = (j1.matched & j2.matched & brand.valid_mask()
+            & price.valid_mask())
+    gid = jnp.where(keep, brand.data - 1,
+                    jnp.int64(num_brands)).astype(jnp.int32)
+    vals = jnp.where(keep, price.data, 0)
+    sums = dense_id_sums(gid, vals, num_brands)
+    present = sums != 0
+    # a brand with exactly-zero revenue is indistinguishable from absent
+    # here; add dense_id_counts when that distinction matters
+    out = Table([
+        Column(t.INT64, jnp.arange(1, num_brands + 1, dtype=jnp.int64),
+               present),
+        Column(t.decimal64(-2), sums, present),
+    ])
+    srt = sort_table(out, [1], ascending=[False], nulls_first=[False])
+    return Q3dsResult(srt, srt.column(0).valid_mask(),
+                      j1.pk_violation | j2.pk_violation)
+
+
+def tpcds_q3_numpy(date_dim: Table, store_sales: Table, item: Table,
+                   manufact_id: int = 7, moy: int = 11) -> dict:
+    """Host oracle: {i_brand_id: revenue}."""
+    sk = np.asarray(date_dim.column(D_DATE_SK).data)
+    moy_of = ((sk - 1) % 365) // 31 + 1
+    good_days = {int(k) for k, m in zip(sk, moy_of) if m == moy}
+    brand_of = {}
+    for k, b, mf in zip(np.asarray(item.column(I3_ITEM_SK).data),
+                        np.asarray(item.column(I3_BRAND_ID).data),
+                        np.asarray(item.column(I3_MANUFACT_ID).data)):
+        if int(mf) == manufact_id:
+            brand_of[int(k)] = int(b)
+    out: dict = {}
+    for d, i, p in zip(
+            np.asarray(store_sales.column(SS3_SOLD_DATE_SK).data),
+            np.asarray(store_sales.column(SS3_ITEM_SK).data),
+            np.asarray(store_sales.column(SS3_EXT_SALES_PRICE).data)):
+        if int(d) not in good_days:
+            continue
+        b = brand_of.get(int(i))
+        if b is None:
+            continue
+        out[b] = out.get(b, 0) + int(p)
+    return out
+
+
 class Q64PlannedResult(NamedTuple):
     result: GroupByResult    # [ss_item_sk, pair_count], count desc
     join_total: jnp.ndarray  # the pair count the general plan materializes

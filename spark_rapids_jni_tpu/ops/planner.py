@@ -320,6 +320,41 @@ def dense_id_counts(gid: jnp.ndarray, m: int,
     return acc.astype(jnp.int64)
 
 
+@func_range("dense_id_sums")
+def dense_id_sums(gid: jnp.ndarray, values: jnp.ndarray, m: int,
+                  block: int = 1024) -> jnp.ndarray:
+    """SUM(values) per dense group id, exact int64, without sort or
+    scatter — the ``dense_id_counts`` scheme with a masked value
+    broadcast per block: each scan step materializes one
+    (block, m) int64 select and column-reduces it. ``gid`` entries
+    outside [0, m) contribute nowhere; ``values`` rows whose slot they
+    feed must already be zeroed for SQL null semantics (callers mask
+    with validity before the call)."""
+    n = gid.shape[0]
+    if n == 0:
+        return jnp.zeros((m,), jnp.int64)
+    block = min(block, n)
+    pad = (-n) % block
+    safe = jnp.where((gid >= 0) & (gid < m), gid,
+                     jnp.asarray(m, gid.dtype)).astype(jnp.int32)
+    v64 = values.astype(jnp.int64)
+    if pad:
+        safe = jnp.concatenate([safe, jnp.full((pad,), jnp.int32(m))])
+        v64 = jnp.concatenate([v64, jnp.zeros((pad,), jnp.int64)])
+    slots = jnp.arange(m, dtype=jnp.int32)[None, :]
+
+    def step(acc, xs):
+        blk_gid, blk_val = xs
+        sel = jnp.where(blk_gid[:, None] == slots,
+                        blk_val[:, None], jnp.int64(0))
+        return acc + jnp.sum(sel, axis=0), None
+
+    init = jnp.zeros((m,), jnp.int64) + v64[0] * 0  # vma-matching init
+    acc, _ = jax.lax.scan(
+        step, init, (safe.reshape(-1, block), v64.reshape(-1, block)))
+    return acc
+
+
 class PlannedGroupBy(NamedTuple):
     """Uniform result of ``plan_groupby`` over both lowerings.
 

@@ -772,3 +772,60 @@ def test_q5_six_table_plan_matches_oracle_and_sort_free():
     # only the 26-slot final ORDER BY may sort; nothing n-sized
     assert all(str(n) not in l for l in sort_lines), sort_lines
     assert not [l for l in hlo.splitlines() if " scatter(" in l]
+
+
+def test_dense_id_sums_matches_bincount_weights(rng):
+    from spark_rapids_jni_tpu.ops.planner import dense_id_sums
+
+    m, n = 29, 4000
+    gid = rng.integers(0, m + 2, n)  # some out of range
+    vals = rng.integers(-10**9, 10**9, n)
+    got = np.asarray(dense_id_sums(
+        jnp.asarray(gid), jnp.asarray(vals), m, block=512))
+    want = np.bincount(gid[gid < m], weights=vals[gid < m].astype(float),
+                       minlength=m).astype(np.int64)
+    assert (got == want).all()
+
+
+def test_tpcds_q3_star_plan_matches_oracle():
+    from spark_rapids_jni_tpu.models import tpcds
+
+    dd = tpcds.date_dim_table(400)
+    ss = tpcds.store_sales_q3_table(3000, num_items=80, num_days=400)
+    it = tpcds.item_q3_table(80)
+    res = tpcds.tpcds_q3(dd, ss, it)
+    assert not bool(res.pk_violation)
+    oracle = tpcds.tpcds_q3_numpy(dd, ss, it)
+    keys = res.table.column(0).to_pylist()
+    revs = res.table.column(1).to_pylist()
+    present = np.asarray(res.present)
+    got = {keys[i]: revs[i] for i in range(res.table.num_rows)
+           if present[i] and keys[i] is not None}
+    assert got == {k: v for k, v in oracle.items() if v != 0}
+    live = [revs[i] for i in range(len(keys)) if present[i]]
+    assert all(live[i] >= live[i + 1] for i in range(len(live) - 1))
+
+
+def test_tpcds_q3_no_probe_length_sorts():
+    import re as _re
+
+    from spark_rapids_jni_tpu.models import tpcds
+
+    n = 4096
+    dd = tpcds.date_dim_table(200)
+    ss = tpcds.store_sales_q3_table(n, num_items=64, num_days=200)
+    it = tpcds.item_q3_table(64)
+
+    def digest(a, b, c):
+        r = tpcds.tpcds_q3(a, b, c)
+        acc = jnp.float64(0)
+        for col in r.table.columns:
+            acc = acc + jnp.sum(col.data).astype(jnp.float64)
+            acc = acc + jnp.sum(col.valid_mask())
+        return acc + r.pk_violation
+
+    hlo = jax.jit(digest).lower(dd, ss, it).compile().as_text()
+    sort_lines = [l for l in hlo.splitlines()
+                  if _re.search(r"= \S+ sort\(", l)]
+    assert all(str(n) not in l for l in sort_lines), sort_lines
+    assert not [l for l in hlo.splitlines() if " scatter(" in l]
