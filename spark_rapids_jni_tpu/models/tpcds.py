@@ -739,41 +739,50 @@ def store_sales_q3_table(num_rows: int, num_items: int = 1000,
 
 
 class Q3dsResult(NamedTuple):
-    table: "Table"            # [i_brand_id, revenue], revenue desc
+    table: "Table"            # [d_year, i_brand_id, revenue], rev desc
     present: jnp.ndarray
     pk_violation: jnp.ndarray
+    # a kept row's brand id fell outside the declared [1, num_brands]
+    # domain — its revenue is NOT in the output; re-plan (the
+    # domain_miss posture, never a silent wrong answer)
+    brand_domain_miss: jnp.ndarray
 
 
 @func_range("tpcds_q3")
 def tpcds_q3(date_dim: Table, store_sales: Table, item: Table,
-             manufact_id: int = 7, moy: int = 11) -> Q3dsResult:
+             manufact_id: int = 7, moy: int = 11,
+             num_brands: int = 100,
+             num_days_per_year: int = 365) -> Q3dsResult:
     """TPC-DS q3 as the all-planner-facts star plan: both dim joins are
     dense clustered-PK lookups with the predicates pushed into the
     build-side keys (month filter into date_dim, manufacturer filter
-    into item), and the brand groupby is a dense-id exact SUM
-    (``dense_id_sums`` — brand ids are a small dense DDL domain). No
-    n-sized sort anywhere; only the brand-count final ORDER BY sorts.
+    into item), and the (d_year, i_brand_id) groupby is a TWO-LEVEL
+    dense-id exact SUM (``dense_id_sums`` over year*num_brands+brand —
+    both dimensions are small dense DDL domains). No n-sized sort
+    anywhere; only the group-table final ORDER BY sorts.
 
-    The generator's date_dim spans [start_year, +2y) with d_moy derived
-    from d_date_sk; one output year keeps the query single-group-key
-    like the synthetic q72 (the d_year key generalizes via a second
-    dense-id dimension exactly like the month push-down)."""
+    ``num_brands`` is the planner-declared brand domain bound; a kept
+    row whose brand falls outside it raises ``brand_domain_miss``
+    instead of silently dropping revenue."""
     from spark_rapids_jni_tpu.ops.planner import (
         dense_id_sums,
         dense_pk_join,
     )
 
     num_days = date_dim.num_rows
-    num_brands = 100  # DDL domain bound for the synthetic generator
+    num_years = (num_days + num_days_per_year - 1) // num_days_per_year
 
     # d_moy derives from the date grid; push the month filter into keys
     sk = date_dim.column(D_DATE_SK).data
-    moy_of = ((sk - 1) % 365) // 31 + 1  # synthetic month-of-year
+    moy_of = ((sk - 1) % num_days_per_year) // 31 + 1
     dd_key = _null_keys_where(
         date_dim.column(D_DATE_SK), moy_of != jnp.int64(moy))
-    dd = Table([dd_key])
+    dd = Table([dd_key, date_dim.column(D_YEAR)])
     j1 = dense_pk_join(store_sales, dd, SS3_SOLD_DATE_SK, 0,
                        1, num_days, clustered=True)
+    year = j1.table.column(store_sales.num_columns + 1)
+    base_year = date_dim.column(D_YEAR).data[0]
+    year_idx = year.data.astype(jnp.int64) - base_year
 
     it_key = _null_keys_where(
         item.column(I3_ITEM_SK),
@@ -786,29 +795,39 @@ def tpcds_q3(date_dim: Table, store_sales: Table, item: Table,
     price = store_sales.column(SS3_EXT_SALES_PRICE)
     keep = (j1.matched & j2.matched & brand.valid_mask()
             & price.valid_mask())
-    gid = jnp.where(keep, brand.data - 1,
-                    jnp.int64(num_brands)).astype(jnp.int32)
+    brand_ok = (brand.data >= 1) & (brand.data <= num_brands)
+    brand_domain_miss = jnp.any(keep & ~brand_ok)
+    year_ok = (year_idx >= 0) & (year_idx < num_years)
+    m = num_years * num_brands
+    gid = jnp.where(keep & brand_ok & year_ok,
+                    year_idx * num_brands + (brand.data - 1),
+                    jnp.int64(m)).astype(jnp.int32)
     vals = jnp.where(keep, price.data, 0)
-    sums = dense_id_sums(gid, vals, num_brands)
+    sums = dense_id_sums(gid, vals, m)
     present = sums != 0
-    # a brand with exactly-zero revenue is indistinguishable from absent
-    # here; add dense_id_counts when that distinction matters
+    # a group with exactly-zero revenue is indistinguishable from
+    # absent here; add dense_id_counts when that distinction matters
+    slot = jnp.arange(m, dtype=jnp.int64)
     out = Table([
-        Column(t.INT64, jnp.arange(1, num_brands + 1, dtype=jnp.int64),
-               present),
+        Column(t.INT64, base_year + slot // num_brands, present),
+        Column(t.INT64, 1 + slot % num_brands, present),
         Column(t.decimal64(-2), sums, present),
     ])
-    srt = sort_table(out, [1], ascending=[False], nulls_first=[False])
+    srt = sort_table(out, [2], ascending=[False], nulls_first=[False])
     return Q3dsResult(srt, srt.column(0).valid_mask(),
-                      j1.pk_violation | j2.pk_violation)
+                      j1.pk_violation | j2.pk_violation,
+                      brand_domain_miss)
 
 
 def tpcds_q3_numpy(date_dim: Table, store_sales: Table, item: Table,
-                   manufact_id: int = 7, moy: int = 11) -> dict:
-    """Host oracle: {i_brand_id: revenue}."""
+                   manufact_id: int = 7, moy: int = 11,
+                   num_days_per_year: int = 365) -> dict:
+    """Host oracle: {(d_year, i_brand_id): revenue}."""
     sk = np.asarray(date_dim.column(D_DATE_SK).data)
-    moy_of = ((sk - 1) % 365) // 31 + 1
-    good_days = {int(k) for k, m in zip(sk, moy_of) if m == moy}
+    yr = np.asarray(date_dim.column(D_YEAR).data)
+    moy_of = ((sk - 1) % num_days_per_year) // 31 + 1
+    day_year = {int(k): int(y) for k, y, m in zip(sk, yr, moy_of)
+                if m == moy}
     brand_of = {}
     for k, b, mf in zip(np.asarray(item.column(I3_ITEM_SK).data),
                         np.asarray(item.column(I3_BRAND_ID).data),
@@ -820,12 +839,13 @@ def tpcds_q3_numpy(date_dim: Table, store_sales: Table, item: Table,
             np.asarray(store_sales.column(SS3_SOLD_DATE_SK).data),
             np.asarray(store_sales.column(SS3_ITEM_SK).data),
             np.asarray(store_sales.column(SS3_EXT_SALES_PRICE).data)):
-        if int(d) not in good_days:
+        y = day_year.get(int(d))
+        if y is None:
             continue
         b = brand_of.get(int(i))
         if b is None:
             continue
-        out[b] = out.get(b, 0) + int(p)
+        out[(y, b)] = out.get((y, b), 0) + int(p)
     return out
 
 

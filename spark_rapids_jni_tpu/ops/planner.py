@@ -281,6 +281,29 @@ def dense_pk_join(
         jnp.sum(matched.astype(jnp.int64)), pk_violation)
 
 
+def _dense_prologue(gid: jnp.ndarray, m: int, block: int,
+                    values: jnp.ndarray | None):
+    """Shared scaffolding of the dense-id reductions: range-check in
+    the INPUT dtype before narrowing (an int64 gid beyond 2^31 must not
+    wrap into [0, m)), clamp the block, pad to a block multiple with
+    the discard sentinel m, and reshape for the scan. Returns
+    (gid_blocks int32[(nb, block)], value_blocks int64 | None)."""
+    n = gid.shape[0]
+    block = min(block, n)
+    pad = (-n) % block
+    safe = jnp.where((gid >= 0) & (gid < m), gid,
+                     jnp.asarray(m, gid.dtype)).astype(jnp.int32)
+    if pad:
+        safe = jnp.concatenate([safe, jnp.full((pad,), jnp.int32(m))])
+    vb = None
+    if values is not None:
+        v64 = values.astype(jnp.int64)
+        if pad:
+            v64 = jnp.concatenate([v64, jnp.zeros((pad,), jnp.int64)])
+        vb = v64.reshape(-1, block)
+    return safe.reshape(-1, block), vb
+
+
 @func_range("dense_id_counts")
 def dense_id_counts(gid: jnp.ndarray, m: int,
                     block: int = 8192) -> jnp.ndarray:
@@ -298,14 +321,7 @@ def dense_id_counts(gid: jnp.ndarray, m: int,
     n = gid.shape[0]
     if n == 0:
         return jnp.zeros((m,), jnp.int64)
-    block = min(block, n)
-    pad = (-n) % block
-    # range-check in the INPUT dtype before narrowing: an int64 gid
-    # beyond 2^31 must not wrap into [0, m) and count somewhere
-    safe = jnp.where((gid >= 0) & (gid < m), gid,
-                     jnp.asarray(m, gid.dtype)).astype(jnp.int32)
-    g = jnp.concatenate(
-        [safe, jnp.full((pad,), jnp.int32(m))]) if pad else safe
+    gb, _ = _dense_prologue(gid, m, block, None)
     slots = jnp.arange(m, dtype=jnp.int32)[None, :]
 
     def step(acc, blk):
@@ -315,8 +331,8 @@ def dense_id_counts(gid: jnp.ndarray, m: int,
     # init derives from the input so its varying-manner annotation
     # matches the carry under shard_map (a plain zeros constant is
     # 'replicated' and the scan rejects the carry type mismatch)
-    init = jnp.zeros((m,), jnp.int32) + g[0] * 0
-    acc, _ = jax.lax.scan(step, init, g.reshape(-1, block))
+    init = jnp.zeros((m,), jnp.int32) + gb[0, 0] * 0
+    acc, _ = jax.lax.scan(step, init, gb)
     return acc.astype(jnp.int64)
 
 
@@ -333,14 +349,7 @@ def dense_id_sums(gid: jnp.ndarray, values: jnp.ndarray, m: int,
     n = gid.shape[0]
     if n == 0:
         return jnp.zeros((m,), jnp.int64)
-    block = min(block, n)
-    pad = (-n) % block
-    safe = jnp.where((gid >= 0) & (gid < m), gid,
-                     jnp.asarray(m, gid.dtype)).astype(jnp.int32)
-    v64 = values.astype(jnp.int64)
-    if pad:
-        safe = jnp.concatenate([safe, jnp.full((pad,), jnp.int32(m))])
-        v64 = jnp.concatenate([v64, jnp.zeros((pad,), jnp.int64)])
+    gb, vb = _dense_prologue(gid, m, block, values)
     slots = jnp.arange(m, dtype=jnp.int32)[None, :]
 
     def step(acc, xs):
@@ -349,9 +358,8 @@ def dense_id_sums(gid: jnp.ndarray, values: jnp.ndarray, m: int,
                         blk_val[:, None], jnp.int64(0))
         return acc + jnp.sum(sel, axis=0), None
 
-    init = jnp.zeros((m,), jnp.int64) + v64[0] * 0  # vma-matching init
-    acc, _ = jax.lax.scan(
-        step, init, (safe.reshape(-1, block), v64.reshape(-1, block)))
+    init = jnp.zeros((m,), jnp.int64) + vb[0, 0] * 0  # vma-matching init
+    acc, _ = jax.lax.scan(step, init, (gb, vb))
     return acc
 
 

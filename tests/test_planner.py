@@ -790,20 +790,43 @@ def test_dense_id_sums_matches_bincount_weights(rng):
 def test_tpcds_q3_star_plan_matches_oracle():
     from spark_rapids_jni_tpu.models import tpcds
 
-    dd = tpcds.date_dim_table(400)
-    ss = tpcds.store_sales_q3_table(3000, num_items=80, num_days=400)
+    # 730 days: month 11 exists in BOTH years — pins the (d_year,
+    # brand) two-level grouping (a single-level brand key would merge
+    # the years' November revenue)
+    dd = tpcds.date_dim_table(730)
+    ss = tpcds.store_sales_q3_table(3000, num_items=80, num_days=730)
     it = tpcds.item_q3_table(80)
     res = tpcds.tpcds_q3(dd, ss, it)
     assert not bool(res.pk_violation)
+    assert not bool(res.brand_domain_miss)
     oracle = tpcds.tpcds_q3_numpy(dd, ss, it)
-    keys = res.table.column(0).to_pylist()
-    revs = res.table.column(1).to_pylist()
+    years = res.table.column(0).to_pylist()
+    keys = res.table.column(1).to_pylist()
+    revs = res.table.column(2).to_pylist()
     present = np.asarray(res.present)
-    got = {keys[i]: revs[i] for i in range(res.table.num_rows)
+    got = {(years[i], keys[i]): revs[i]
+           for i in range(res.table.num_rows)
            if present[i] and keys[i] is not None}
     assert got == {k: v for k, v in oracle.items() if v != 0}
+    assert len({y for y, _ in got}) == 2  # both years really present
     live = [revs[i] for i in range(len(keys)) if present[i]]
     assert all(live[i] >= live[i + 1] for i in range(len(live) - 1))
+
+
+def test_tpcds_q3_brand_domain_miss_flags():
+    from spark_rapids_jni_tpu.models import tpcds
+
+    dd = tpcds.date_dim_table(365)
+    ss = tpcds.store_sales_q3_table(500, num_items=20, num_days=365)
+    it = tpcds.item_q3_table(20)
+    # every item passes the manufacturer filter so kept rows certainly
+    # exist; declare a brand bound smaller than the data's: revenue
+    # would be dropped, so the miss flag must fire
+    icols = list(it.columns)
+    icols[2] = Column.from_numpy(np.full(20, 7, np.int64))
+    it = Table(icols)
+    res = tpcds.tpcds_q3(dd, ss, it, num_brands=5)
+    assert bool(res.brand_domain_miss)
 
 
 def test_tpcds_q3_no_probe_length_sorts():
