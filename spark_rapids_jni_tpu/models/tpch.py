@@ -859,6 +859,110 @@ def tpch_q3_distributed(customer: Table, orders: Table, lineitem: Table,
     ])
 
 
+def tpch_q3_outofcore(path, customer: Table, orders: Table, *,
+                      budget_bytes: int, chunk_read_limit: int,
+                      segment: int = 0, cutoff: int = _Q3_CUTOFF_DAYS,
+                      prefetch_depth: int = 0):
+    """q3 over a lineitem Parquet file larger than the device budget:
+    the JOIN side of the SF-scale story (q1 covered pure aggregation).
+    customer and orders stay resident (the small sides — the broadcast
+    plan's premise); lineitem streams in row-group chunks, each chunk
+    joins through the dense clustered-PK lookups (probe-aligned, no
+    join machinery to size) and partial-aggregates revenue by orderkey;
+    host-compacted partials merge at the end. The partial->merge
+    algebra is tpch_q3_planned_distributed's, run over TIME instead of
+    the mesh.
+
+    File schema: [l_orderkey int64, l_extendedprice int64,
+    l_discount int64, l_shipdate date32]. Returns OutOfCoreResult;
+    ``.table`` matches tpch_q3's compacted output of the materialized
+    file."""
+    import jax as _jax
+
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+    from spark_rapids_jni_tpu.parquet.reader import ParquetChunkedReader
+    from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter, SpillStore
+    from spark_rapids_jni_tpu.runtime.outofcore import run_chunked_aggregate
+
+    n_cust, n_ord = customer.num_rows, orders.num_rows
+    limiter = MemoryLimiter(budget_bytes)
+    spill = SpillStore(budget_bytes)
+
+    # the resident build side, computed once: orders |x| customer via
+    # the clustered custkey lookup, date/segment predicates pushed in
+    cust = Table([_null_where(
+        customer.column(C_CUSTKEY),
+        customer.column(C_MKTSEGMENT).data != jnp.int8(segment))])
+    okey = _null_where(
+        orders.column(O_CUSTKEY),
+        orders.column(O_ORDERDATE).data >= jnp.int32(cutoff))
+    ord_t = Table([okey, orders.column(O_ORDERKEY),
+                   orders.column(O_ORDERDATE),
+                   orders.column(O_SHIPPRIORITY)])
+    j1 = dense_pk_join(ord_t, cust, 0, 0, 1, n_cust, clustered=True)
+    if bool(j1.pk_violation):
+        raise ValueError("customer PK declaration violated")
+    build2 = Table([
+        _null_where(j1.table.column(1), ~j1.matched),
+        j1.table.column(2), j1.table.column(3),
+    ])
+
+    @_jax.jit
+    def _partial(chunk: Table):
+        lkey = _null_where(
+            chunk.column(0),
+            chunk.column(3).data <= jnp.int32(cutoff))
+        price = chunk.column(1)
+        disc = chunk.column(2)
+        revenue = Column(
+            t.decimal64(-4), price.data * (100 - disc.data),
+            price.valid_mask() & disc.valid_mask())
+        probe = Table([lkey, revenue])
+        j2 = dense_pk_join(probe, build2, 0, 0, 1, n_ord,
+                           clustered=True)
+        jt = j2.table
+        matched = j2.matched
+        keyed = Table([
+            _null_where(jt.column(0), ~matched),
+            jt.column(3), jt.column(4),
+            Column(jt.column(1).dtype, jt.column(1).data,
+                   jt.column(1).valid_mask() & matched),
+        ])
+        g = groupby_aggregate(keyed, keys=[0, 1, 2], aggs=[(3, "sum")],
+                              max_groups=keyed.num_rows)
+        return g.table, g.num_groups, j2.pk_violation
+
+    def partial_fn(chunk: Table) -> Table:
+        from spark_rapids_jni_tpu.ops.table_ops import trim_table
+
+        cols = list(chunk.columns)
+        cols[1] = Column(t.decimal64(-2), cols[1].data, cols[1].validity)
+        cols[2] = Column(t.decimal64(-2), cols[2].data, cols[2].validity)
+        tbl, num_groups, viol = _partial(Table(cols))
+        if bool(viol):
+            raise ValueError("orders PK declaration violated")
+        return trim_table(tbl, int(num_groups))
+
+    def merge_fn(partials: Table) -> Table:
+        merged = groupby_aggregate(partials, keys=[0, 1, 2],
+                                   aggs=[(3, "sum")])
+        srt = sort_table(merged.table, [3, 1],
+                         ascending=[False, True],
+                         nulls_first=[False, False])
+        kv = np.asarray(srt.column(0).valid_mask())
+        k = int(kv.sum())
+        return Table([
+            Column(c.dtype, c.data[:k],
+                   None if c.validity is None else c.validity[:k])
+            for c in srt.columns
+        ])
+
+    reader = ParquetChunkedReader(path, chunk_read_limit=chunk_read_limit)
+    return run_chunked_aggregate(
+        iter(reader), partial_fn, merge_fn, limiter=limiter, spill=spill,
+        prefetch_depth=prefetch_depth)
+
+
 def tpch_q3_planned_distributed(customer: Table, orders: Table,
                                 lineitem: Table, mesh, segment: int = 0,
                                 cutoff: int = _Q3_CUTOFF_DAYS) -> Table:

@@ -101,6 +101,7 @@ _MEDIUM_TIER = {
     "tests/test_planner.py::test_q19_planned_matches_oracle_and_sort_free",
     "tests/test_planner.py::test_q64_planned_join_elimination_matches_oracle",
     "tests/test_strings.py::TestStringMinMax::test_min_max_matches_oracle",
+    "tests/test_outofcore.py::test_q3_outofcore_join_side_matches_oracle",
 }
 
 
