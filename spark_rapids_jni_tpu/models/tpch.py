@@ -859,6 +859,109 @@ def tpch_q3_distributed(customer: Table, orders: Table, lineitem: Table,
     ])
 
 
+class Q10Result(NamedTuple):
+    result: GroupByResult   # [c_custkey, c_nationkey, revenue] rev desc
+    join_total: jnp.ndarray
+    pk_violation: jnp.ndarray
+
+
+_Q10_QTR_START = 8582   # 1993-07-01
+_Q10_QTR_END = 8674     # 1993-10-01
+
+
+@func_range("tpch_q10")
+def tpch_q10(customer: Table, orders: Table, lineitem: Table,
+             qtr_start: int = _Q10_QTR_START,
+             qtr_end: int = _Q10_QTR_END) -> Q10Result:
+    """q10 (returned-item reporting): lineitem filtered to returns,
+    joined through orders (quarter filter pushed into the build keys)
+    to the customer, grouped by customer, revenue-desc — the LIMIT 20
+    head is the caller's compact+head.
+
+    The plan mixes both machineries deliberately: the joins are dense
+    clustered-PK lookups (sort-free, probe-aligned), while the
+    customer groupby is HIGH-cardinality — outside every declared-
+    domain trick — so it rides the general sort-based groupby. This is
+    the realistic SF-scale shape: planner facts kill the join costs,
+    the one irreducible data-dependent grouping remains.
+
+    ``lineitem`` here is the q3 layout + a returnflag column appended:
+    [l_orderkey, l_extendedprice, l_discount, l_shipdate,
+    l_returnflag]."""
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    n_cust, n_ord = customer.num_rows, orders.num_rows
+    rf = lineitem.column(4)
+    returned = rf.valid_mask() & (rf.data == jnp.int8(ord("R")))
+    price = lineitem.column(L3_EXTENDEDPRICE)
+    disc = lineitem.column(L3_DISCOUNT)
+    revenue = Column(
+        t.decimal64(-4), price.data * (100 - disc.data),
+        price.valid_mask() & disc.valid_mask() & returned)
+    probe = Table([
+        _null_where(lineitem.column(L3_ORDERKEY), ~returned),
+        revenue,
+    ])
+    od = orders.column(O_ORDERDATE)
+    in_qtr = (od.valid_mask() & (od.data >= jnp.int32(qtr_start))
+              & (od.data < jnp.int32(qtr_end)))
+    ord_build = Table([
+        _null_where(orders.column(O_ORDERKEY), ~in_qtr),
+        orders.column(O_CUSTKEY),
+    ])
+    j_o = dense_pk_join(probe, ord_build, 0, 0, 1, n_ord,
+                        clustered=True)
+    o_cust = j_o.table.column(3)
+    j_c = dense_pk_join(Table([o_cust]), customer, 0, C5_CUSTKEY,
+                        1, n_cust, clustered=True)
+    c_key = j_c.table.column(1)
+    c_nat = j_c.table.column(2)
+    keep = j_o.matched & j_c.matched
+    keyed = Table([
+        _null_where(c_key, ~keep),
+        c_nat,
+        Column(revenue.dtype, revenue.data,
+               revenue.valid_mask() & keep),
+    ])
+    g = groupby_aggregate(keyed, keys=[0, 1], aggs=[(2, "sum")])
+    srt = sort_table(g.table, [2], ascending=[False],
+                     nulls_first=[False])
+    return Q10Result(
+        GroupByResult(srt, g.num_groups),
+        jnp.sum(keep.astype(jnp.int64)),
+        j_o.pk_violation | j_c.pk_violation)
+
+
+def tpch_q10_numpy(customer: Table, orders: Table, lineitem: Table,
+                   qtr_start: int = _Q10_QTR_START,
+                   qtr_end: int = _Q10_QTR_END) -> dict:
+    """Host oracle: {c_custkey: (nationkey, revenue)}."""
+    c_nat = {int(k): int(v) for k, v in zip(
+        np.asarray(customer.column(C5_CUSTKEY).data),
+        np.asarray(customer.column(C5_NATIONKEY).data))}
+    o_cust = {}
+    for k, c, d in zip(np.asarray(orders.column(O_ORDERKEY).data),
+                       np.asarray(orders.column(O_CUSTKEY).data),
+                       np.asarray(orders.column(O_ORDERDATE).data)):
+        if qtr_start <= int(d) < qtr_end:
+            o_cust[int(k)] = int(c)
+    out: dict = {}
+    lkey = np.asarray(lineitem.column(L3_ORDERKEY).data)
+    price = np.asarray(lineitem.column(L3_EXTENDEDPRICE).data)
+    disc = np.asarray(lineitem.column(L3_DISCOUNT).data)
+    rf = np.asarray(lineitem.column(4).data)
+    for i in range(lineitem.num_rows):
+        if rf[i] != ord("R"):
+            continue
+        cu = o_cust.get(int(lkey[i]))
+        if cu is None or cu not in c_nat:
+            continue
+        rev = int(price[i]) * (100 - int(disc[i]))
+        prev = out.get(cu, (c_nat[cu], 0))
+        out[cu] = (c_nat[cu], prev[1] + rev)
+    return out
+
+
 def tpch_q3_outofcore(path, customer: Table, orders: Table, *,
                       budget_bytes: int, chunk_read_limit: int,
                       segment: int = 0, cutoff: int = _Q3_CUTOFF_DAYS,

@@ -852,3 +852,33 @@ def test_tpcds_q3_no_probe_length_sorts():
                   if _re.search(r"= \S+ sort\(", l)]
     assert all(str(n) not in l for l in sort_lines), sort_lines
     assert not [l for l in hlo.splitlines() if " scatter(" in l]
+
+
+def test_q10_mixed_plan_matches_oracle(rng):
+    from spark_rapids_jni_tpu.models.tpch import (
+        customer_q5_table,
+        lineitem_q3_table,
+        orders_table,
+        tpch_q10,
+        tpch_q10_numpy,
+    )
+
+    n_cust, n_ord, n = 40, 150, 1200
+    c = customer_q5_table(n_cust)
+    o = orders_table(n_ord, n_cust)
+    li3 = lineitem_q3_table(n, n_ord)
+    flags = Column.from_numpy(
+        rng.choice(np.frombuffer(b"ANR", np.int8), n))
+    li = Table(list(li3.columns) + [flags])
+    res = tpch_q10(c, o, li)
+    assert not bool(res.pk_violation)
+    oracle = tpch_q10_numpy(c, o, li)
+    tbl = res.result.table
+    keys = tbl.column(0).to_pylist()
+    nats = tbl.column(1).to_pylist()
+    revs = tbl.column(2).to_pylist()
+    got = {keys[i]: (nats[i], revs[i]) for i in range(tbl.num_rows)
+           if keys[i] is not None}
+    assert got == oracle
+    live = [revs[i] for i in range(tbl.num_rows) if keys[i] is not None]
+    assert all(live[i] >= live[i + 1] for i in range(len(live) - 1))
