@@ -1367,6 +1367,111 @@ def tpch_q5_numpy(customer: Table, orders: Table, lineitem: Table,
     return out
 
 
+def tpch_q5_distributed(customer: Table, orders: Table, lineitem: Table,
+                        supplier: Table, nation: Table, mesh,
+                        region_of_interest: int = 1,
+                        year_start: int = _Q5_YEAR_START,
+                        year_end: int = _Q5_YEAR_END) -> Q5Result:
+    """Multi-executor q5 with ZERO shuffles: lineitem shards row-wise,
+    all four dimension tables replicate, each device runs the five
+    dense-PK lookups + the 25-slot bounded nation groupby on its shard,
+    and the global merge is one psum over the 26-slot sum vector —
+    208 bytes on the wire per device. The single-device tpch_q5 IS the
+    per-device step; only the merge differs (the bounded-slot
+    associativity that makes distributed_groupby_bounded shuffle-free).
+    Result is replicated; same schema as tpch_q5."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_jni_tpu.ops.planner import (
+        dense_pk_join,
+        plan_groupby,
+        scalar_domain,
+    )
+    from spark_rapids_jni_tpu.parallel.distributed import shard_table
+    from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+
+    n_supp, n_ord = supplier.num_rows, orders.num_rows
+    n_cust = customer.num_rows
+    sl, rv = shard_table(lineitem, mesh, return_row_valid=True)
+
+    def step(local: Table, lrv, cust_r, ord_r, supp_r, nat_r):
+        j_s = dense_pk_join(local, supp_r, L5_SUPPKEY, S_SUPPKEY,
+                            1, n_supp, clustered=True)
+        s_nation = j_s.table.column(local.num_columns + 1)
+        od = ord_r.column(O_ORDERDATE)
+        date_ok = (od.valid_mask() & (od.data >= jnp.int32(year_start))
+                   & (od.data < jnp.int32(year_end)))
+        ord_build = Table([
+            _null_where(ord_r.column(O_ORDERKEY), ~date_ok),
+            ord_r.column(O_CUSTKEY),
+        ])
+        j_o = dense_pk_join(local, ord_build, L5_ORDERKEY, 0,
+                            1, n_ord, clustered=True)
+        o_cust = j_o.table.column(local.num_columns + 1)
+        j_c = dense_pk_join(Table([o_cust]), cust_r, 0, C5_CUSTKEY,
+                            1, n_cust, clustered=True)
+        c_nation = j_c.table.column(2)
+        nat_build = Table([
+            _null_where(nat_r.column(N_NATIONKEY),
+                        nat_r.column(N_REGIONKEY).data
+                        != jnp.int64(region_of_interest)),
+        ])
+        j_n = dense_pk_join(Table([s_nation]), nat_build, 0, 0, 1, 25,
+                            clustered=True)
+        keep = (lrv & j_s.matched & j_o.matched & j_c.matched
+                & j_n.matched & (c_nation.data == s_nation.data))
+        price = local.column(L5_EXTENDEDPRICE)
+        disc = local.column(L5_DISCOUNT)
+        rev_ok = keep & price.valid_mask() & disc.valid_mask()
+        keyed = Table([
+            Column(s_nation.dtype,
+                   jnp.where(keep, s_nation.data, 0), keep),
+            Column(t.decimal64(-4),
+                   jnp.where(rev_ok, price.data * (100 - disc.data), 0),
+                   rev_ok),
+        ])
+        g = plan_groupby(keyed, [0], [(1, "sum")],
+                         [scalar_domain(range(1, 26))], row_valid=lrv)
+        # the 26-slot partials merge with ONE collective
+        sums = _jax.lax.psum(
+            jnp.where(g.table.column(1).valid_mask(),
+                      g.table.column(1).data, 0), EXEC_AXIS)
+        valid_g = _jax.lax.psum(
+            g.table.column(1).valid_mask().astype(jnp.int32),
+            EXEC_AXIS) > 0
+        viol = _jax.lax.psum(
+            (j_s.pk_violation | j_o.pk_violation | j_c.pk_violation
+             | j_n.pk_violation).astype(jnp.int32), EXEC_AXIS) > 0
+        miss = _jax.lax.psum(
+            g.domain_miss.astype(jnp.int32), EXEC_AXIS) > 0
+        return (g.table.column(0).data, sums, valid_g,
+                viol, miss)
+
+    keys, sums, valid_g, viol, miss = _jax.jit(_jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
+    ))(sl, rv, customer, orders, supplier, nation)
+
+    out = Table([
+        Column(t.INT64, keys, valid_g),
+        Column(t.decimal64(-4), sums, valid_g),
+    ])
+    name_w = max(len(nm) for nm in _Q5_NATIONS)
+    name_mat = np.zeros((out.num_rows, name_w), np.uint8)
+    name_len = np.zeros(out.num_rows, np.int32)
+    for i, nm in enumerate(_Q5_NATIONS):
+        b = nm.encode()
+        name_mat[i, : len(b)] = np.frombuffer(b, np.uint8)
+        name_len[i] = len(b)
+    names = Column(t.STRING, jnp.asarray(name_len), valid_g,
+                   chars=jnp.asarray(name_mat))
+    srt = sort_table(Table(list(out.columns) + [names]), [1],
+                     ascending=[False], nulls_first=[False])
+    return Q5Result(srt, srt.column(0).valid_mask(), viol, miss)
+
+
 # ---------------------------------------------------------------------------
 # q12 — shipping modes and order priority (join + string-key groupby with
 # conditional counts). Reference workload family: BASELINE.json config #4's

@@ -232,3 +232,41 @@ def test_q3_planned_distributed_broadcast_plan_matches_oracle():
     got = {keys[i]: (revs[i], dates[i], prios[i])
            for i in range(out.num_rows) if keys[i] is not None}
     assert got == oracle
+
+
+def test_q5_distributed_zero_shuffle_matches_single_and_oracle():
+    from spark_rapids_jni_tpu.models.tpch import (
+        customer_q5_table,
+        lineitem_q5_table,
+        nation_table,
+        orders_table,
+        supplier_table,
+        tpch_q5,
+        tpch_q5_distributed,
+        tpch_q5_numpy,
+    )
+
+    n_cust, n_ord, n_supp, n = 48, 160, 24, 1405  # non-divisible by 8
+    c = customer_q5_table(n_cust)
+    o = orders_table(n_ord, n_cust)
+    li = lineitem_q5_table(n, n_ord, n_supp)
+    su = supplier_table(n_supp)
+    na = nation_table()
+    mesh = executor_mesh()
+    res = tpch_q5_distributed(c, o, li, su, na, mesh)
+    assert not bool(res.pk_violation) and not bool(res.domain_miss)
+    oracle = tpch_q5_numpy(c, o, li, su, na)
+    keys = res.table.column(0).to_pylist()
+    revs = res.table.column(1).to_pylist()
+    present = np.asarray(res.present)
+    got = {keys[i]: revs[i] for i in range(res.table.num_rows)
+           if present[i] and keys[i] is not None and revs[i]}
+    assert got == {k: v for k, v in oracle.items() if v}
+    single = tpch_q5(c, o, li, su, na)
+    s_keys = single.table.column(0).to_pylist()
+    s_revs = single.table.column(1).to_pylist()
+    s_present = np.asarray(single.present)
+    s_got = {s_keys[i]: s_revs[i]
+             for i in range(single.table.num_rows)
+             if s_present[i] and s_keys[i] is not None and s_revs[i]}
+    assert s_got == got
