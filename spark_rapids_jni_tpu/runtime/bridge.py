@@ -47,10 +47,20 @@ def column_from_host(
     """Build a device column from little-endian host bytes. ``validity`` is
     one byte per row (0 = null), or None for all-valid."""
     dt = DType(TypeId(type_id), scale)
-    arr = np.frombuffer(data, dtype=dt.storage_dtype, count=n)
     vmask = None
     if validity is not None:
         vmask = np.frombuffer(validity, dtype=np.uint8, count=n).astype(bool)
+    if dt.is_decimal128:
+        # 16 LE bytes per row = the int64[n, 2] limb pair (lo, hi)
+        # directly — the same image column_to_host emits and the row
+        # codecs pack
+        import jax.numpy as jnp
+
+        limbs = np.frombuffer(data, dtype=np.int64,
+                              count=2 * n).reshape(n, 2)
+        return Column(dt, jnp.asarray(limbs.copy()),
+                      None if vmask is None else jnp.asarray(vmask))
+    arr = np.frombuffer(data, dtype=dt.storage_dtype, count=n)
     return Column.from_numpy(arr.copy(), dt, validity=vmask)
 
 
