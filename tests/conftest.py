@@ -102,6 +102,8 @@ _MEDIUM_TIER = {
     "tests/test_planner.py::test_q64_planned_join_elimination_matches_oracle",
     "tests/test_strings.py::TestStringMinMax::test_min_max_matches_oracle",
     "tests/test_outofcore.py::test_q3_outofcore_join_side_matches_oracle",
+    "tests/test_distributed_bounded.py::test_outofcore_times_distributed_composition",
+    "tests/test_distributed_bounded.py::test_q5_distributed_zero_shuffle_matches_single_and_oracle",
 }
 
 

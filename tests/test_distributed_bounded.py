@@ -270,3 +270,89 @@ def test_q5_distributed_zero_shuffle_matches_single_and_oracle():
              for i in range(single.table.num_rows)
              if s_present[i] and s_keys[i] is not None and s_revs[i]}
     assert s_got == got
+
+
+def test_outofcore_times_distributed_composition(tmp_path):
+    """The SF1000 execution model in miniature: a Parquet file larger
+    than the budget streams in row-group chunks, EACH chunk runs the
+    shuffle-free bounded q1 groupby across the 8-device mesh, and the
+    static slot tables merge across chunks by addition (the same
+    associativity that made the mesh merge a psum makes the chunk
+    merge a running sum) — out-of-core over TIME composed with
+    distribution over SPACE, no shuffle in either axis."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.models.tpch import lineitem_table
+    from spark_rapids_jni_tpu.ops.planner import scalar_domain
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        distributed_groupby_bounded,
+    )
+    from spark_rapids_jni_tpu.parquet.reader import ParquetChunkedReader
+    from spark_rapids_jni_tpu.runtime.memory import (
+        MemoryLimiter,
+        _table_nbytes,
+    )
+    from spark_rapids_jni_tpu.runtime.outofcore import run_chunked_aggregate
+
+    n = 48_000
+    li = lineitem_table(n)
+    pa_table = pa.table({
+        "l_quantity": pa.array(np.asarray(li.column(0).data),
+                               type=pa.int64()),
+        "l_returnflag": pa.array(np.asarray(li.column(4).data),
+                                 type=pa.int8()),
+    })
+    path = str(tmp_path / "li.parquet")
+    pq.write_table(pa_table, path, row_group_size=6_000)  # 8 chunks
+    mesh = executor_mesh()
+    dom = [scalar_domain([ord("A"), ord("N"), ord("R")])]
+
+    def partial_fn(chunk):
+        sharded, rv = shard_table(
+            Table([chunk.column(1), chunk.column(0)]), mesh,
+            return_row_valid=True)
+        res = distributed_groupby_bounded(
+            sharded, [0], [(1, "sum"), (1, "count")], dom, mesh,
+            row_valid=rv)
+        assert not bool(res.domain_miss)
+        return res.table  # replicated 4-slot table
+
+    def merge_fn(partials):
+        # k stacked 4-slot tables: per-slot running sums (associative)
+        k = partials.num_rows // 4
+        key = partials.column(0).data.reshape(k, 4)[0]
+        kv = partials.column(0).valid_mask().reshape(k, 4).any(axis=0)
+        sums = partials.column(1)
+        cnts = partials.column(2)
+        import jax.numpy as jnp
+
+        s = jnp.where(sums.valid_mask(), sums.data, 0) \
+            .reshape(k, 4).sum(axis=0)
+        c = jnp.where(cnts.valid_mask(), cnts.data, 0) \
+            .reshape(k, 4).sum(axis=0)
+        live = c > 0
+        return Table([
+            Column(partials.column(0).dtype, key, kv & live),
+            Column(sums.dtype, s, live),
+            Column(cnts.dtype, c, live),
+        ])
+
+    budget = _table_nbytes(li)  # generous vs the 2-col stream
+    res = run_chunked_aggregate(
+        iter(ParquetChunkedReader(path, chunk_read_limit=1)),
+        partial_fn, merge_fn, limiter=MemoryLimiter(budget))
+    assert res.chunks == 8
+    keys = res.table.column(0).to_pylist()
+    sums = res.table.column(1).to_pylist()
+    cnts = res.table.column(2).to_pylist()
+    got = {keys[i]: (sums[i], cnts[i]) for i in range(4)
+           if keys[i] is not None and cnts[i]}
+    qty = np.asarray(li.column(0).data)
+    rf = np.asarray(li.column(4).data)
+    oracle = {}
+    for f in (ord("A"), ord("N"), ord("R")):
+        m = rf == f
+        if m.any():
+            oracle[f] = (int(qty[m].sum()), int(m.sum()))
+    assert got == oracle
