@@ -111,6 +111,32 @@ def observed_domain(col: Column, max_size: int = _OBSERVED_DEFAULT_CAP,
     return Domain(tuple(int(v) for v in vals), "scalar", source)
 
 
+def domain_from_parquet(path, column: int,
+                        max_size: int = _OBSERVED_DEFAULT_CAP,
+                        sample_row_groups: int = 1) -> Domain | None:
+    """Planner-time domain derivation from a Parquet file: decode the
+    first ``sample_row_groups`` row groups of one column through the
+    native reader and take the observed distinct values.
+
+    This is the practical stand-in for reading the dictionary PAGE
+    directly (the native reader decodes dictionary pages internally but
+    does not yet expose their value arrays through the C ABI): a
+    planning-time sample, so the derived domain is declared with
+    ``source="observed"`` and the runtime ``domain_miss`` check remains
+    the correctness backstop — exactly the posture that makes an
+    inaccurate sample a re-plan, never a wrong answer.
+    """
+    from spark_rapids_jni_tpu.parquet.reader import (
+        read_table,
+        row_group_info,
+    )
+
+    n_groups = len(row_group_info(path))
+    groups = list(range(min(sample_row_groups, n_groups)))
+    tbl = read_table(path, columns=[column], row_groups=groups)
+    return observed_domain(tbl.column(0), max_size=max_size)
+
+
 def month_code(year: int, month: int) -> int:
     """Static month-bucket code: year*12 + (month-1)."""
     return year * 12 + (month - 1)

