@@ -330,6 +330,33 @@ def _dense_prologue(gid: jnp.ndarray, m: int, block: int,
     return safe.reshape(-1, block), vb
 
 
+def plan_groupby_auto(
+    table: Table,
+    keys: Sequence[int],
+    aggs: Sequence[tuple[int, str]],
+    domains: Sequence["Domain | None"],
+    budget: int = 4096,
+    max_budget: int | None = None,
+    row_valid: jnp.ndarray | None = None,
+) -> "PlannedGroupBy":
+    """Host wrapper completing the overflow posture: when the general
+    fallback drops groups (``overflowed``), double the budget and
+    retry until the result is complete (the groupby_aggregate_auto
+    pattern). The bounded plan never overflows (slot count checked at
+    plan time), so retries only occur on the general path."""
+    cap = max_budget if max_budget is not None else max(table.num_rows, 1)
+    b = budget
+    while True:
+        res = plan_groupby(table, keys, aggs, domains, budget=b,
+                           row_valid=row_valid)
+        if not bool(res.overflowed) or b >= cap:
+            if bool(res.overflowed):
+                raise ValueError(
+                    f"groupby exceeded max_budget={cap} distinct groups")
+            return res
+        b = min(b * 2, cap)
+
+
 @func_range("dense_id_counts")
 def dense_id_counts(gid: jnp.ndarray, m: int,
                     block: int = 8192) -> jnp.ndarray:

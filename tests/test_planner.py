@@ -925,3 +925,20 @@ def test_domain_from_parquet_drives_bounded_plan(tmp_path):
     tbl2 = read_table(path2)
     res2 = plan_groupby(tbl2, [0], [(1, "sum")], [dom2])
     assert bool(res2.domain_miss)  # the backstop fires
+
+
+def test_plan_groupby_auto_grows_until_complete(rng):
+    from spark_rapids_jni_tpu.ops.planner import plan_groupby_auto
+
+    n = 300
+    tbl = Table([
+        Column.from_numpy(np.arange(n, dtype=np.int32)),
+        Column.from_numpy(np.ones(n, np.int64)),
+    ])
+    res = plan_groupby_auto(tbl, [0], [(1, "sum")], [None], budget=16)
+    assert res.lowered == "general" and not bool(res.overflowed)
+    assert len(_groups(res.table, res.present)) == n
+
+    with pytest.raises(ValueError, match="max_budget"):
+        plan_groupby_auto(tbl, [0], [(1, "sum")], [None], budget=16,
+                          max_budget=64)
