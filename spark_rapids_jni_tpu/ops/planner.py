@@ -345,7 +345,9 @@ def plan_groupby_auto(
     pattern). The bounded plan never overflows (slot count checked at
     plan time), so retries only occur on the general path."""
     cap = max_budget if max_budget is not None else max(table.num_rows, 1)
-    b = budget
+    # clamp both ways: a sub-positive budget would loop forever (0*2 == 0)
+    # and a starting budget above the cap would silently ignore it
+    b = min(max(budget, 1), cap)
     while True:
         res = plan_groupby(table, keys, aggs, domains, budget=b,
                            row_valid=row_valid)

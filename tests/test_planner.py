@@ -942,3 +942,19 @@ def test_plan_groupby_auto_grows_until_complete(rng):
     with pytest.raises(ValueError, match="max_budget"):
         plan_groupby_auto(tbl, [0], [(1, "sum")], [None], budget=16,
                           max_budget=64)
+
+
+def test_plan_groupby_auto_budget_clamps():
+    from spark_rapids_jni_tpu.ops.planner import plan_groupby_auto
+
+    tbl = Table([
+        Column.from_numpy(np.arange(100, dtype=np.int32)),
+        Column.from_numpy(np.ones(100, np.int64)),
+    ])
+    # sub-positive budget must terminate (raise at the cap), not spin
+    res = plan_groupby_auto(tbl, [0], [(1, "sum")], [None], budget=0)
+    assert not bool(res.overflowed)
+    # a starting budget above max_budget must still honor the cap
+    with pytest.raises(ValueError, match="max_budget"):
+        plan_groupby_auto(tbl, [0], [(1, "sum")], [None],
+                          budget=4096, max_budget=64)
