@@ -1040,6 +1040,11 @@ def main() -> None:
                 platform = "tpu"
                 record["stale_s"] = round(time.time() - led.get("ts", 0), 1)
                 record["ledger_n"] = led.get("n")
+                if led.get("n") != n:
+                    # throughput is strongly size-dependent (65e6 @1M vs
+                    # 573e6 @16M q1): a different-n fallback can overstate
+                    # by ~9x, so tag it un-ignorably
+                    record["stale_n"] = led.get("n")
                 if led.get("device_kind"):
                     record["device_kind"] = led["device_kind"]
                 if led.get("source"):

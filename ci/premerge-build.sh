@@ -8,7 +8,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-cmake -S src/native -B build/native -G Ninja
+# cheap AST gate first: no new tpulint invariant findings (ci/lint.sh)
+bash ci/lint.sh
+
+# SANITIZE=1 opts the native selftest build into
+# -fsanitize=address,undefined — the native-side analogue of tpulint
+cmake -S src/native -B build/native -G Ninja ${SANITIZE:+-DSANITIZE=ON}
 ninja -C build/native
 ./build/native/tpudf_selftest
 if [[ -x build/native/tpudf_rt_selftest ]]; then

@@ -765,6 +765,7 @@ def tpcds_q3(date_dim: Table, store_sales: Table, item: Table,
     row whose brand falls outside it raises ``brand_domain_miss``
     instead of silently dropping revenue."""
     from spark_rapids_jni_tpu.ops.planner import (
+        dense_id_counts,
         dense_id_sums,
         dense_pk_join,
     )
@@ -804,9 +805,9 @@ def tpcds_q3(date_dim: Table, store_sales: Table, item: Table,
                     jnp.int64(m)).astype(jnp.int32)
     vals = jnp.where(keep, price.data, 0)
     sums = dense_id_sums(gid, vals, m)
-    present = sums != 0
-    # a group with exactly-zero revenue is indistinguishable from
-    # absent here; add dense_id_counts when that distinction matters
+    # presence is row COUNT, not sum: a group whose revenue nets to
+    # exactly zero (refunds / negative amounts) must still be emitted
+    present = dense_id_counts(gid, m) > 0
     slot = jnp.arange(m, dtype=jnp.int64)
     out = Table([
         Column(t.INT64, base_year + slot // num_brands, present),

@@ -475,7 +475,10 @@ def _digit_field(mat: jnp.ndarray, present: jnp.ndarray):
         ok = jnp.all(~sel | is_digit, axis=1)
         p = jnp.where(sel, hi[:, None] - 1 - jdx[None, :], 0)
         val = jnp.sum(
-            jnp.where(sel, digit * (10 ** p.astype(jnp.int64)), 0), axis=1
+            jnp.where(sel,
+                      digit.astype(jnp.int64) * (10 ** p.astype(jnp.int64)),
+                      0),
+            axis=1,
         )
         return val.astype(jnp.int32), ok & jnp.any(sel, axis=1)
 

@@ -278,6 +278,14 @@ def dense_pk_join(
         # search for large valid keys (silently dropped matches)
         bvalid = bk.valid_mask()
         dt_max = np.iinfo(np.dtype(bk.data.dtype)).max
+        if key_hi >= dt_max:
+            # the declared key range touches the null sentinel: a
+            # legitimate key equal to dtype max would be overwritten
+            # into the null slot and silently drop its matches
+            raise ValueError(
+                f"dense PK range [{key_lo}, {key_hi}] reaches "
+                f"iinfo({np.dtype(bk.data.dtype).name}).max, the null "
+                f"sentinel; widen the key dtype or shrink the range")
         key_clean = jnp.where(bvalid, bk.data,
                               jnp.asarray(dt_max, bk.data.dtype))
         perm = jnp.argsort(key_clean).astype(jnp.int32)

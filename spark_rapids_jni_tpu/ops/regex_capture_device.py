@@ -195,16 +195,23 @@ class _LinParser:
     def _atom(self) -> frozenset:
         c = self._take()
         if c == ".":
-            return _ASCII_NO_NL
-        if c == "[":
-            return self._char_class()
-        if c == "\\":
-            return self._escape()
-        if c in "*+?{":
+            byteset = _ASCII_NO_NL
+        elif c == "[":
+            byteset = self._char_class()
+        elif c == "\\":
+            byteset = self._escape()
+        elif c in "*+?{":
             raise RegexUnsupported("dangling quantifier")
-        if ord(c) > 0x7F:
+        elif ord(c) > 0x7F:
             raise RegexUnsupported("non-ASCII literal")
-        return frozenset([ord(c)])
+        else:
+            byteset = frozenset([ord(c)])
+        if 0 in byteset:
+            # byte 0 is the row padding byte of the padded char matrix:
+            # an atom that can match NUL would match padding and run
+            # across row boundaries — host engine territory
+            raise RegexUnsupported("NUL byte in pattern")
+        return byteset
 
     def _escape(self) -> frozenset:
         c = self._take()
@@ -214,7 +221,9 @@ class _LinParser:
                  "r": frozenset(b"\r")}
         if c in table:
             return table[c]
-        if not c.isalnum() and ord(c) <= 0x7F:
+        # ord(c) == 0 (an escaped literal NUL) is excluded with the
+        # non-ASCII range: its byteset would contain the padding byte
+        if not c.isalnum() and 0 < ord(c) <= 0x7F:
             return frozenset([ord(c)])
         # alnum escapes are Java metasyntax; >0x7F would index past the
         # 256-entry byte transition rows — both are host-engine territory
@@ -330,6 +339,8 @@ def _subset_construct(nfa: _Nfa, start: int, final: int):
     table = np.concatenate(trans).astype(np.int32)
     table[table < 0] = dead
     table = np.concatenate([table, np.full(256, dead, dtype=np.int32)])
+    # host-side DFA compile path, not device execution
+    # tpulint: disable=no-host-transfer-in-device-path
     accept = np.array([final in st for st in order] + [False], dtype=bool)
     return table, accept
 
@@ -350,7 +361,9 @@ def compile_linear(pattern: str) -> CompiledLinear:
     for k in range(m + 1):
         nfa = _Nfa()
         q0 = nfa.new_state()
-        nfa.add(q0, frozenset([0]), q0)  # reversed padding prefix
+        # reversed padding prefix: the reverse scan consumes the row's
+        # 0x00 tail first, by design  # tpulint: disable=padding-byte-invariant
+        nfa.add(q0, frozenset([0]), q0)
         cur = nfa.new_state()
         nfa.add(q0, None, cur)
         if not lin.anchored_end:

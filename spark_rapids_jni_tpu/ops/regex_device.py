@@ -56,6 +56,8 @@ class RegexUnsupported(ValueError):
 
 _SENTINEL = 0  # the padded layout's zero byte, doubles as end-of-string
 
+# deliberately contains 0x00: only ever used as `_ANY_BYTE - {_SENTINEL}`
+# or on the post-sentinel state  # tpulint: disable=padding-byte-invariant
 _ANY_BYTE = frozenset(range(256))
 _ASCII_NO_NL = frozenset(range(1, 128)) - {0x0A}
 _LEAD2 = frozenset(range(0xC2, 0xE0))
@@ -527,6 +529,8 @@ def compile_pattern(pattern: str) -> CompiledRegex:
     table[table < 0] = dead
     table = np.concatenate(
         [table, np.full(256, dead, dtype=np.int32)])
+    # host-side DFA compile path, not device execution
+    # tpulint: disable=no-host-transfer-in-device-path
     accept = np.array([final in st for st in order] + [False], dtype=bool)
     return CompiledRegex(table, accept, dead + 1)
 

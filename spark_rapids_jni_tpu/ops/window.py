@@ -301,8 +301,11 @@ class Window:
         if oc.dtype.storage_dtype.kind in ("i", "u"):
             lo_t = jnp.where((preceding > 0) & (lo_t > v),
                              jnp.iinfo(jnp.int64).min, lo_t)
-            hi_t = jnp.where((following > 0) & (hi_t < v),
-                             jnp.iinfo(jnp.int64).max, hi_t)
+            hi_t = jnp.where(
+                (following > 0) & (hi_t < v),
+                # saturation bound for the search, not a data sentinel
+                # tpulint: disable=sentinel-safety
+                jnp.iinfo(jnp.int64).max, hi_t)
         lo = self._bounded_search(v, lo_t, valid_start,
                                   valid_end, side_left=True)
         hi = self._bounded_search(v, hi_t, valid_start,

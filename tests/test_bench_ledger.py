@@ -95,6 +95,41 @@ def test_main_emits_stale_tpu_record_when_backend_down(
     assert "last-known-good" in rec["diagnostic"]
 
 
+def test_main_tags_stale_n_on_row_count_mismatch(
+        ledger, monkeypatch, capsys):
+    # throughput is size-dependent (65e6 @1M vs 573e6 @16M planned q1):
+    # a fallback record at another n must carry "stale_n" so the judge
+    # can't read it as a same-size measurement (~9x overstatement)
+    _write(ledger, [_rec(metric="tpch_q1_planned_rows_per_s", value=5.73e8,
+                         n=1 << 24)])
+    monkeypatch.setenv("BENCH_CONFIG", "tpch_q1_planned")
+    monkeypatch.setenv("BENCH_ROWS", str(1 << 20))
+    monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: (False, "down"))
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("no child")))
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["platform"] == "tpu" and rec["value"] == 5.73e8
+    assert rec["stale_n"] == 1 << 24 and rec["ledger_n"] == 1 << 24
+
+
+def test_main_no_stale_n_when_row_count_matches(
+        ledger, monkeypatch, capsys):
+    _write(ledger, [_rec(metric="tpch_q1_planned_rows_per_s", value=2.72e8)])
+    monkeypatch.setenv("BENCH_CONFIG", "tpch_q1_planned")
+    monkeypatch.setenv("BENCH_ROWS", str(1 << 22))
+    monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: (False, "down"))
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("no child")))
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "stale_s" in rec and "stale_n" not in rec
+
+
 def test_main_falls_back_to_cpu_when_ledger_empty(
         ledger, monkeypatch, capsys):
     monkeypatch.setenv("BENCH_CONFIG", "tpch_q1_planned")

@@ -467,6 +467,31 @@ def test_dense_pk_join_sorted_duplicate_flags():
     assert bool(res.pk_violation)
 
 
+def test_dense_pk_join_sorted_rejects_sentinel_key_range():
+    """Sorted mode overwrites null keys with iinfo(dtype).max; a
+    declared range reaching dtype max would let a legitimate key alias
+    the null sentinel (advisor r5 / tpulint sentinel-safety class), so
+    the declaration must be rejected up front."""
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
+
+    hi = np.iinfo(np.int64).max
+    build = Table([
+        Column.from_numpy(np.asarray([hi - 1, hi], np.int64)),
+        Column.from_numpy(np.asarray([7, 8], np.int64)),
+    ])
+    probe = Table([Column.from_numpy(np.asarray([hi], np.int64))])
+    with pytest.raises(ValueError, match="sentinel"):
+        dense_pk_join(probe, build, 0, 0, hi - 1, hi, clustered=False)
+    # a range strictly below dtype max stays accepted
+    res = dense_pk_join(
+        Table([Column.from_numpy(np.asarray([5], np.int64))]),
+        Table([Column.from_numpy(np.asarray([4, 5, 6], np.int64)),
+               Column.from_numpy(np.asarray([7, 8, 9], np.int64))]),
+        0, 0, 4, 6, clustered=False)
+    assert not bool(res.pk_violation)
+    assert res.table.column(2).to_pylist() == [8]
+
+
 def test_q3_planned_matches_general_and_oracle():
     from spark_rapids_jni_tpu.models.tpch import (
         customer_table,
@@ -807,10 +832,42 @@ def test_tpcds_q3_star_plan_matches_oracle():
     got = {(years[i], keys[i]): revs[i]
            for i in range(res.table.num_rows)
            if present[i] and keys[i] is not None}
-    assert got == {k: v for k, v in oracle.items() if v != 0}
+    # count-derived presence: EVERY group with a kept row is emitted,
+    # including any whose revenue nets to zero
+    assert got == oracle
     assert len({y for y, _ in got}) == 2  # both years really present
     live = [revs[i] for i in range(len(keys)) if present[i]]
     assert all(live[i] >= live[i + 1] for i in range(len(live) - 1))
+
+
+def test_tpcds_q3_zero_revenue_group_is_present():
+    """A group whose revenue nets to exactly zero (refund offsets the
+    sale) must still be emitted: presence is dense_id_counts > 0, not
+    sums != 0 (advisor r5 / tpulint bitmask-via-helpers class)."""
+    from spark_rapids_jni_tpu.models import tpcds
+
+    dd = tpcds.date_dim_table(365)  # year 2000; month 11 = sk 311..341
+    it = Table([
+        Column.from_numpy(np.asarray([1, 2], np.int64)),    # i_item_sk
+        Column.from_numpy(np.asarray([3, 5], np.int64)),    # i_brand_id
+        Column.from_numpy(np.asarray([7, 7], np.int64)),    # i_manufact_id
+    ])
+    ss = Table([
+        Column.from_numpy(np.asarray([311, 312, 311], np.int64)),
+        Column.from_numpy(np.asarray([1, 1, 2], np.int64)),
+        Column.from_numpy(np.asarray([500, -500, 250], np.int64),
+                          t.decimal64(-2)),
+    ])
+    res = tpcds.tpcds_q3(dd, ss, it)
+    assert not bool(res.pk_violation)
+    years = res.table.column(0).to_pylist()
+    keys = res.table.column(1).to_pylist()
+    revs = res.table.column(2).to_pylist()
+    present = np.asarray(res.present)
+    got = {(years[i], keys[i]): revs[i]
+           for i in range(res.table.num_rows) if present[i]}
+    assert got == tpcds.tpcds_q3_numpy(dd, ss, it)
+    assert got[(2000, 3)] == 0  # the refund group survives
 
 
 def test_tpcds_q3_brand_domain_miss_flags():

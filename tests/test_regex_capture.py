@@ -117,6 +117,28 @@ def test_linear_parser_rejects_out_of_subset():
             rc.parse_linear(pat)
 
 
+def test_linear_parser_rejects_nul_bytesets():
+    """Byte 0 is the row padding byte: an atom that can match NUL would
+    match padding and run across row boundaries (advisor r5 / tpulint
+    padding-byte-invariant class). Literal NUL, escaped NUL, NUL class
+    members and NUL-spanning ranges all go to the host engine."""
+    for pat in ["a\x00b", "\x00", "a\\\x00", "[\x00a]", "[\x00-\x05]+"]:
+        with pytest.raises(rc.RegexUnsupported):
+            rc.parse_linear(pat)
+
+
+def test_nul_pattern_falls_back_to_host():
+    col = Column.from_pylist(["ab", "xy"], t.STRING)
+    out = s.regexp_extract(col, "a(\x00)?b", 0)
+    assert out.to_pylist() == ["ab", ""]
+
+
+def test_force_device_raises_on_nul_pattern(force_device):
+    col = Column.from_pylist(["ab"], t.STRING)
+    with pytest.raises(rc.RegexUnsupported):
+        s.regexp_extract(col, "a(\x00)?b", 0)
+
+
 def test_extract_device_hlo_scatter_free():
     comp = rc.compile_linear(r"([a-z]+)-(\d+)")
     chars = jnp.zeros((64, 24), jnp.uint8)

@@ -1,0 +1,281 @@
+"""tpulint: the AST invariant linter (tools/tpulint).
+
+Two halves:
+
+1. **Rule regression** — each seeded-violation fixture under
+   tests/tpulint_fixtures/ must produce exactly its rule's findings
+   (and none on the clean counterparts in the same file).
+2. **Whole-tree gate** — linting spark_rapids_jni_tpu + bench.py +
+   tools with the checked-in baseline must be clean, both through the
+   library and through the real CLI (`python -m tools.tpulint`), which
+   is what ci/lint.sh runs.
+
+The linter is pure stdlib ast — no jax import, so this whole file is
+fast-tier.
+"""
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.tpulint.engine import (  # noqa: E402
+    Finding,
+    apply_baseline,
+    baseline_key,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    write_baseline,
+)
+from tools.tpulint.rules import RULES  # noqa: E402
+
+FIXTURES = REPO / "tests" / "tpulint_fixtures"
+RULE_NAMES = {r.name for r in RULES}
+
+
+def _lint_file(path: Path):
+    return lint_source(path.read_text(), path)
+
+
+def _by_rule(findings, rule):
+    assert rule in RULE_NAMES, rule
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# seeded-violation fixtures, one per rule
+# ---------------------------------------------------------------------------
+
+
+def test_rule_host_transfer_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_host_transfer_device.py"),
+                   "no-host-transfer-in-device-path")
+    texts = [f.source_line for f in got]
+    assert len(got) == 3, texts
+    assert any("np.asarray" in t for t in texts)
+    assert any(".tolist()" in t for t in texts)
+    assert any("float(" in t for t in texts)
+    # the clean jnp.asarray construction must NOT be flagged
+    assert not any("jnp.asarray" in t for t in texts)
+
+
+def test_rule_python_branch_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_python_branch.py"),
+                   "no-python-branch-on-traced")
+    texts = [f.source_line for f in got]
+    assert len(got) == 2, texts
+    assert any(t.startswith("if total") for t in texts)
+    assert any(t.startswith("while total") for t in texts)
+    # static_argnames params, .shape reads and host functions stay legal
+    assert not any("flip" in t or "shape" in t for t in texts)
+
+
+def test_rule_sentinel_safety_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_sentinel.py"),
+                   "sentinel-safety")
+    assert len(got) == 1, got
+    # the violation is in unguarded_sentinel; the guarded twin passes
+    src = (FIXTURES / "seeded_sentinel.py").read_text()
+    guarded_at = src[:src.index("def guarded_sentinel")].count("\n") + 1
+    assert got[0].line < guarded_at
+
+
+def test_rule_padding_byte_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_regex_nul_device.py"),
+                   "padding-byte-invariant")
+    texts = [f.source_line for f in got]
+    assert len(got) == 3, texts
+    assert not any("SAFE" in t for t in texts)
+
+
+def test_rule_padding_byte_needs_regex_device_filename(tmp_path):
+    # same constructions outside a regex *_device.py are out of scope
+    target = tmp_path / "not_a_regex_file.py"
+    shutil.copy(FIXTURES / "seeded_regex_nul_device.py", target)
+    assert not _by_rule(_lint_file(target), "padding-byte-invariant")
+
+
+def test_rule_dtype_width_seeded(tmp_path):
+    # the rule keys off an ops/ path segment
+    ops_dir = tmp_path / "ops"
+    ops_dir.mkdir()
+    target = ops_dir / "seeded_dtype_width.py"
+    shutil.copy(FIXTURES / "seeded_dtype_width.py", target)
+    got = _by_rule(_lint_file(target), "dtype-width-discipline")
+    assert len(got) == 1, got
+    assert "rows * stride" in got[0].source_line
+    # out of ops/: silent
+    flat = tmp_path / "seeded_dtype_width.py"
+    shutil.copy(FIXTURES / "seeded_dtype_width.py", flat)
+    assert not _by_rule(_lint_file(flat), "dtype-width-discipline")
+
+
+def test_rule_bitmask_helpers_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_bitmask.py"),
+                   "bitmask-via-helpers")
+    assert len(got) == 1, got
+    assert "sums != 0" in got[0].source_line
+    # count-derived presence (counts > 0) is the blessed form
+    assert "counts" not in got[0].source_line
+
+
+def test_every_rule_has_a_seeded_fixture():
+    """The acceptance invariant: all six rules demonstrably fire."""
+    seen = set()
+    for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_python_branch.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_sentinel.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_regex_nul_device.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_bitmask.py"):
+        seen.add(f.rule)
+    ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        d = Path(td) / "ops"
+        d.mkdir()
+        shutil.copy(ops / "seeded_dtype_width.py", d / "w.py")
+        for f in _lint_file(d / "w.py"):
+            seen.add(f.rule)
+    assert RULE_NAMES <= seen, RULE_NAMES - seen
+
+
+# ---------------------------------------------------------------------------
+# suppression: pragmas and baseline
+# ---------------------------------------------------------------------------
+
+_VIOLATION = (
+    "import numpy as np\n"
+    "import jax.numpy as jnp\n"
+    "def f(keys, valid):\n"
+    "    s = np.iinfo(np.int64).max{pragma}\n"
+    "    return jnp.where(valid, keys, s)\n"
+)
+
+
+def test_pragma_on_line_suppresses():
+    src = _VIOLATION.format(pragma="  # tpulint: disable=sentinel-safety")
+    assert not lint_source(src, "x.py")
+
+
+def test_pragma_comment_line_above_suppresses():
+    src = _VIOLATION.format(pragma="")
+    lines = src.splitlines()
+    lines.insert(3, "    # tpulint: disable=sentinel-safety")
+    assert not lint_source("\n".join(lines) + "\n", "x.py")
+
+
+def test_pragma_disable_all_and_multi_rule():
+    assert not lint_source(
+        _VIOLATION.format(pragma="  # tpulint: disable=all"), "x.py")
+    assert not lint_source(
+        _VIOLATION.format(
+            pragma="  # tpulint: disable=bitmask-via-helpers,"
+                   "sentinel-safety"), "x.py")
+
+
+def test_pragma_for_other_rule_does_not_suppress():
+    src = _VIOLATION.format(
+        pragma="  # tpulint: disable=bitmask-via-helpers")
+    got = lint_source(src, "x.py")
+    assert [f.rule for f in got] == ["sentinel-safety"]
+
+
+def test_baseline_roundtrip_and_counting(tmp_path):
+    src = _VIOLATION.format(pragma="")
+    findings = lint_source(src, tmp_path / "x.py")
+    assert len(findings) == 1
+    bl_path = tmp_path / "baseline.txt"
+    write_baseline(findings, bl_path)
+    baseline = load_baseline(bl_path)
+    new, old = apply_baseline(findings, baseline)
+    assert not new and len(old) == 1
+    # one baseline entry absorbs exactly ONE occurrence: a second
+    # identical violation is a new finding
+    doubled = findings + findings
+    new, old = apply_baseline(doubled, baseline)
+    assert len(new) == 1 and len(old) == 1
+
+
+def test_baseline_key_is_content_addressed(tmp_path):
+    f = Finding("p.py", 10, 0, "sentinel-safety", "msg",
+                "s = np.iinfo(np.int64).max")
+    g = f._replace(line=99)  # line drift must not invalidate the key
+    assert baseline_key(f) == baseline_key(g)
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    got = lint_source("def broken(:\n", tmp_path / "bad.py")
+    assert [f.rule for f in got] == ["parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# whole-tree gate (what ci/lint.sh enforces)
+# ---------------------------------------------------------------------------
+
+_TREE = ["spark_rapids_jni_tpu", "bench.py", "tools"]
+
+
+def test_package_tree_is_clean_via_library():
+    findings = lint_paths([REPO / p for p in _TREE])
+    new, _ = apply_baseline(findings, load_baseline())
+    assert not new, "\n".join(
+        f"{f.path}:{f.line}: {f.rule}: {f.source_line}" for f in new)
+
+
+def test_cli_exits_zero_on_package():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint"] + _TREE,
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "clean" in out.stdout
+
+
+def test_cli_exits_one_on_seeded_fixture():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint",
+         "tests/tpulint_fixtures/seeded_bitmask.py"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "bitmask-via-helpers" in out.stdout
+
+
+def test_cli_list_rules_names_all_six():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0
+    for name in RULE_NAMES:
+        assert name in out.stdout
+
+
+def test_cli_write_baseline_then_clean(tmp_path):
+    fixture = REPO / "tests/tpulint_fixtures/seeded_bitmask.py"
+    bl = tmp_path / "bl.txt"
+    wrote = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--write-baseline",
+         "--baseline", str(bl), str(fixture)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+    ran = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--baseline", str(bl),
+         str(fixture)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert ran.returncode == 0, ran.stdout + ran.stderr
+    assert "baselined" in ran.stdout
+
+
+def test_cli_usage_error_without_paths():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 2

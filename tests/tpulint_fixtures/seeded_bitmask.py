@@ -1,0 +1,17 @@
+"""Seeded violation for bitmask-via-helpers: presence derived from an
+ad-hoc `!= 0` on aggregated values (the tpcds_q3 bug class)."""
+
+import jax.numpy as jnp
+
+
+def presence_from_sums(gid, vals, m):
+    sums = jnp.zeros((m,), jnp.int64).at[gid].add(vals)
+    present = sums != 0                   # VIOLATION: zero-sum groups vanish
+    return sums, present
+
+
+def presence_from_counts(gid, vals, m):
+    sums = jnp.zeros((m,), jnp.int64).at[gid].add(vals)
+    counts = jnp.zeros((m,), jnp.int32).at[gid].add(1)
+    present = counts > 0                  # clean: count-derived presence
+    return sums, present

@@ -1,0 +1,14 @@
+"""Seeded violation for dtype-width-discipline (the test lints this file
+under an ops/ path segment)."""
+
+import jax.numpy as jnp
+
+
+def mixed_width_index_math(n):
+    rows = jnp.arange(n, dtype=jnp.int32)
+    stride = jnp.int64(8)
+    return rows * stride + jnp.int32(1)   # VIOLATION: int32 * int64
+
+def single_width_is_fine(n):
+    rows = jnp.arange(n, dtype=jnp.int64)
+    return rows * jnp.int64(8) + jnp.int64(1)

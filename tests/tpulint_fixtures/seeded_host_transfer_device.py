@@ -1,0 +1,27 @@
+"""Seeded violations for no-host-transfer-in-device-path (the filename's
+_device.py suffix puts every function here in device scope)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaks_asarray(x):
+    host = np.asarray(x)            # VIOLATION: device->host transfer
+    return host.sum()
+
+
+def leaks_tolist(x):
+    return x.tolist()               # VIOLATION: forces a transfer
+
+
+@jax.jit
+def leaks_concretize(x):
+    lo = float(jnp.min(x))          # VIOLATION: concretizes a tracer
+    return x - lo
+
+
+def clean_device_math(x):
+    # jnp.asarray is host->device and stays legal in device scope
+    shift = jnp.asarray(3, x.dtype)
+    return x + shift

@@ -1,0 +1,28 @@
+"""Seeded violation for no-python-branch-on-traced: a Python `if` on a
+traced value inside a @jax.jit function."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("flip",))
+def branches_on_traced(x, flip: bool = False):
+    total = jnp.sum(x)
+    if total > 0:                   # VIOLATION: traced condition
+        total = -total
+    if flip:                        # clean: static_argnames parameter
+        total = total + 1
+    if x.shape[0] > 8:              # clean: .shape is a static projection
+        total = total * 2
+    while total > 0:                # VIOLATION: traced while
+        total = total - 1
+    return total
+
+
+def host_branching_is_fine(x):
+    # not jit-decorated: Python control flow is the host planner's job
+    if x > 0:
+        return -x
+    return x

@@ -1,0 +1,9 @@
+"""Seeded violation for padding-byte-invariant (the regex..._device.py
+filename puts this file in the rule's scope)."""
+
+NUL_RANGE = frozenset(range(256))        # VIOLATION: contains byte 0
+NUL_LITERAL = frozenset([0, 10, 13])     # VIOLATION: literal 0
+NUL_BYTES = frozenset(b"a\x00b")         # VIOLATION: NUL in bytes
+
+SAFE_ASCII = frozenset(range(1, 128))    # clean: starts at 1
+SAFE_CLASS = frozenset(b" \t\n")         # clean: no NUL

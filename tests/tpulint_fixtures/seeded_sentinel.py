@@ -1,0 +1,17 @@
+"""Seeded violation for sentinel-safety: iinfo(...).max used as a data
+sentinel with no adjacent domain guard."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def unguarded_sentinel(keys, valid):
+    sentinel = np.iinfo(np.int64).max       # VIOLATION: no domain guard
+    return jnp.where(valid, keys, sentinel)
+
+
+def guarded_sentinel(keys, valid, key_hi):
+    sentinel = np.iinfo(np.int64).max
+    if key_hi >= sentinel:                  # the guard the rule wants
+        raise ValueError("key range reaches the null sentinel")
+    return jnp.where(valid, keys, sentinel)

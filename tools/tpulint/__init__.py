@@ -1,0 +1,31 @@
+"""tpulint: AST-based invariant linter for the TPU columnar stack.
+
+The reference repo enforces its invariants at compile time (C++ types,
+JNI signature checks); this pure-Python reproduction has no compiler to
+lean on, so the whole-program invariants the stack relies on — host/
+device boundary discipline, sentinel safety, the regex padding byte,
+dtype width, validity-mask derivation — are enforced here mechanically
+over the stdlib ``ast``. No third-party dependencies, files are parsed
+and never imported.
+
+Entry points:
+  * CLI:      ``python -m tools.tpulint spark_rapids_jni_tpu``
+  * pytest:   ``tests/test_tpulint.py`` (whole-package sweep + seeded
+              violation fixtures per rule)
+  * CI:       ``ci/lint.sh`` from ``ci/premerge-build.sh``
+
+Suppression: ``# tpulint: disable=<rule>[,<rule>...]`` on the offending
+line (or a comment line directly above), and ``tools/tpulint/
+baseline.txt`` for pre-existing findings (regenerate with
+``python -m tools.tpulint --write-baseline <paths>``).
+"""
+
+from tools.tpulint.engine import (  # noqa: F401
+    Finding,
+    format_finding,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    write_baseline,
+)
+from tools.tpulint.rules import RULES  # noqa: F401

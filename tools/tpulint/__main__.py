@@ -1,0 +1,71 @@
+"""CLI: ``python -m tools.tpulint [paths...]``.
+
+Exit status: 0 clean (or baselined-only), 1 new findings, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.tpulint.engine import (
+    DEFAULT_BASELINE,
+    apply_baseline,
+    format_finding,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+from tools.tpulint.rules import RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.tpulint",
+        description="AST-based invariant linter for the TPU columnar "
+                    "stack (see tools/tpulint/__init__.py)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to lint "
+                         "(e.g. spark_rapids_jni_tpu)")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline file (default: tools/tpulint/"
+                         "baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current "
+                         "findings and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule names and descriptions")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.name}: {r.description}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("tools.tpulint: error: no paths given", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(args.paths)
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(f"tpulint: wrote {len(findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    baseline = None if args.no_baseline else load_baseline(args.baseline)
+    new, old = apply_baseline(findings, baseline)
+    for f in new:
+        print(format_finding(f))
+    suffix = f" ({len(old)} baselined)" if old else ""
+    if new:
+        print(f"tpulint: {len(new)} new finding(s){suffix}")
+        return 1
+    print(f"tpulint: clean{suffix}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

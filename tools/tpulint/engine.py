@@ -1,0 +1,169 @@
+"""tpulint engine: file walking, pragma suppression, baseline.
+
+Suppression layers, innermost first:
+
+1. **Pragma** — ``# tpulint: disable=<rule>[,<rule>...]`` (or
+   ``disable=all``) on the finding's line, or on a pure-comment line
+   directly above it. Pragmas are the right tool for a reviewed,
+   deliberate violation: they sit next to the code and double as
+   documentation.
+2. **Baseline** — ``tools/tpulint/baseline.txt`` holds pre-existing
+   findings so the linter lands green while failing on NEW violations.
+   Keys are ``path|rule|stripped-source-line`` (content-addressed, so
+   unrelated line-number drift does not invalidate them); duplicate
+   keys cover multiple identical occurrences. Regenerate with
+   ``python -m tools.tpulint --write-baseline <paths>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import Counter
+from pathlib import Path
+from typing import Iterable, List, NamedTuple, Optional, Sequence, Tuple
+
+from tools.tpulint.rules import RULES, FileContext
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = Path(__file__).with_name("baseline.txt")
+
+_PRAGMA_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+class Finding(NamedTuple):
+    path: str          # posix, repo-root-relative when possible
+    line: int
+    col: int
+    rule: str
+    message: str
+    source_line: str   # stripped text of the offending line
+
+
+def format_finding(f: Finding) -> str:
+    return f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}"
+
+
+def baseline_key(f: Finding) -> str:
+    return f"{f.path}|{f.rule}|{f.source_line}"
+
+
+def _norm_path(path) -> str:
+    p = Path(path).resolve()
+    try:
+        return p.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def _pragma_rules(lines: Sequence[str], lineno: int) -> set:
+    """Rules disabled at ``lineno``: a pragma on the line itself, or on
+    a pure-comment line immediately above."""
+    rules: set = set()
+    for ln in (lineno, lineno - 1):
+        if not 1 <= ln <= len(lines):
+            continue
+        text = lines[ln - 1]
+        if ln != lineno and not text.lstrip().startswith("#"):
+            continue
+        m = _PRAGMA_RE.search(text)
+        if m:
+            rules.update(x.strip() for x in m.group(1).split(","))
+    return rules
+
+
+def lint_source(src: str, path, rules=None) -> List[Finding]:
+    """Lint one file's source text. Pragma-filtered, NOT
+    baseline-filtered (baselines apply across a whole run)."""
+    norm = _norm_path(path)
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as exc:
+        return [Finding(norm, exc.lineno or 1, exc.offset or 0,
+                        "parse-error", f"file does not parse: {exc.msg}",
+                        "")]
+    ctx = FileContext(path=norm, name=Path(path).name, src=src, tree=tree)
+    out: List[Finding] = []
+    for rule in (rules if rules is not None else RULES):
+        for rf in rule.check(ctx):
+            disabled = _pragma_rules(lines, rf.line)
+            if rule.name in disabled or "all" in disabled:
+                continue
+            src_line = (lines[rf.line - 1].strip()
+                        if 1 <= rf.line <= len(lines) else "")
+            out.append(Finding(norm, rf.line, rf.col, rule.name,
+                               rf.message, src_line))
+    out.sort(key=lambda f: (f.line, f.col, f.rule))
+    return out
+
+
+def iter_py_files(paths: Iterable) -> List[Path]:
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    return files
+
+
+def lint_paths(paths: Iterable, rules=None) -> List[Finding]:
+    out: List[Finding] = []
+    for f in iter_py_files(paths):
+        try:
+            src = f.read_text()
+        except (OSError, UnicodeDecodeError) as exc:
+            out.append(Finding(_norm_path(f), 1, 0, "parse-error",
+                               f"unreadable: {exc}", ""))
+            continue
+        out.extend(lint_source(src, f, rules=rules))
+    return out
+
+
+def load_baseline(path=DEFAULT_BASELINE) -> Counter:
+    c: Counter = Counter()
+    try:
+        text = Path(path).read_text()
+    except OSError:
+        return c
+    for line in text.splitlines():
+        line = line.rstrip()
+        if not line or line.startswith("#"):
+            continue
+        c[line] += 1
+    return c
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Optional[Counter],
+) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (new, baselined). Each baseline entry
+    absorbs one matching occurrence."""
+    if not baseline:
+        return list(findings), []
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = baseline_key(f)
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path=DEFAULT_BASELINE) -> None:
+    header = (
+        "# tpulint baseline: pre-existing findings, suppressed so the\n"
+        "# linter fails only on NEW violations. One key per occurrence,\n"
+        "# format path|rule|stripped-source-line.\n"
+        "# Regenerate: python -m tools.tpulint --write-baseline "
+        "spark_rapids_jni_tpu\n"
+    )
+    body = "".join(baseline_key(f) + "\n" for f in findings)
+    Path(path).write_text(header + body)

@@ -1,0 +1,549 @@
+"""The six tpulint rules.
+
+Each rule encodes an invariant the stack already relies on implicitly;
+the docstring of each ``check_*`` names the bug class that motivated it
+(ADVICE.md round-5 findings, BASELINE.md reconciliations). Rules are
+pure-AST heuristics: they under-approximate (no cross-module dataflow)
+and occasionally over-approximate (a reviewed-legitimate site carries a
+``# tpulint: disable=<rule>`` pragma that doubles as documentation).
+
+A rule is a ``Rule(name, description, check)`` where ``check`` maps a
+``FileContext`` to ``RawFinding``s; the engine layers pragma and
+baseline suppression on top.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Callable, List, NamedTuple
+
+
+class RawFinding(NamedTuple):
+    line: int
+    col: int
+    message: str
+
+
+class FileContext(NamedTuple):
+    path: str        # normalized posix path (repo-relative when possible)
+    name: str        # basename, used for *_device.py scope decisions
+    src: str
+    tree: ast.Module
+
+
+class Rule(NamedTuple):
+    name: str
+    description: str
+    check: Callable[[FileContext], List[RawFinding]]
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - defensive
+        return ""
+
+
+def _is_device_file(name: str) -> bool:
+    return name.endswith("_device.py")
+
+
+def _is_regex_device_file(name: str) -> bool:
+    return _is_device_file(name) and "regex" in name
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _functions(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES):
+            yield node
+
+
+def _jit_decorated(fn) -> bool:
+    """Matches @jax.jit, @_jax.jit, @jit, @partial(jax.jit, ...),
+    @functools.partial(jax.jit, static_argnames=...)."""
+    for dec in fn.decorator_list:
+        txt = _unparse(dec)
+        if "jax.jit" in txt or txt == "jit" or txt.startswith("jit("):
+            return True
+    return False
+
+
+def _static_params(fn) -> set:
+    """Parameter names pinned static via static_argnames/static_argnums:
+    they are Python values inside the trace, not tracers."""
+    names: set = set()
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for dec in fn.decorator_list:
+        for node in ast.walk(dec):
+            if not isinstance(node, ast.keyword) or node.arg not in (
+                    "static_argnames", "static_argnums"):
+                continue
+            for c in ast.walk(node.value):
+                if not isinstance(c, ast.Constant):
+                    continue
+                if isinstance(c.value, str):
+                    names.add(c.value)
+                elif isinstance(c.value, int) and 0 <= c.value < len(pos):
+                    names.add(pos[c.value])
+    return names
+
+
+# ---------------------------------------------------------------------------
+# rule 1: no-host-transfer-in-device-path
+# ---------------------------------------------------------------------------
+
+_HOST_TRANSFER_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "device_get",
+}
+_HOST_TRANSFER_METHODS = {"tolist", "item"}
+_CONCRETIZERS = {"float", "int", "bool"}
+
+
+def check_host_transfer(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: a silent device->host round trip inside a jit trace or
+    a device engine — np.asarray / jax.device_get / .tolist() force a
+    transfer (and a concretization error under jit), turning a fused
+    device pipeline into a host sync. Scope: bodies of @jax.jit
+    functions anywhere, and every function in ops/*_device.py
+    (module-level code in device files is host-side compile-path setup
+    and stays out of scope)."""
+    out: List[RawFinding] = []
+    seen: set = set()
+    for fn in _functions(ctx.tree):
+        if not (_is_device_file(ctx.name) or _jit_decorated(fn)):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            ftxt = _unparse(node.func)
+            if ftxt in _HOST_TRANSFER_CALLS:
+                out.append(RawFinding(
+                    node.lineno, node.col_offset,
+                    f"host transfer `{ftxt}(...)` in a device path "
+                    f"(jit scope or *_device.py); keep data on device "
+                    f"(jnp.asarray) or hoist to the host-side caller"))
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _HOST_TRANSFER_METHODS
+                  and not node.args and not node.keywords):
+                out.append(RawFinding(
+                    node.lineno, node.col_offset,
+                    f"`.{node.func.attr}()` forces a device->host "
+                    f"transfer in a device path; hoist it out of the "
+                    f"jit/device scope"))
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in _CONCRETIZERS and node.args):
+                atxt = _unparse(node.args[0])
+                if "jnp." in atxt or "jax.lax" in atxt:
+                    out.append(RawFinding(
+                        node.lineno, node.col_offset,
+                        f"`{node.func.id}(...)` on a traced expression "
+                        f"concretizes (device->host sync) inside a "
+                        f"device path"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 2: no-python-branch-on-traced
+# ---------------------------------------------------------------------------
+
+# attribute projections that are static Python values even on a tracer
+_STATIC_ATTRS = {
+    "shape", "dtype", "ndim", "size", "itemsize", "kind",
+    "num_rows", "num_columns", "is_string", "storage_dtype",
+}
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
+_HOST_NP_CALLS = {"jnp.iinfo", "jnp.finfo", "np.iinfo", "np.finfo",
+                  "jnp.dtype", "np.dtype"}
+
+
+def _is_traced(node: ast.AST, traced: set) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _is_traced(node.value, traced)
+    if isinstance(node, ast.Subscript):
+        return _is_traced(node.value, traced)
+    if isinstance(node, ast.Call):
+        ftxt = _unparse(node.func)
+        if ftxt in _STATIC_CALLS or ftxt in _HOST_NP_CALLS:
+            return False
+        if ftxt.startswith(("jnp.", "jax.lax.", "lax.")):
+            return True
+        return (any(_is_traced(a, traced) for a in node.args)
+                or any(_is_traced(k.value, traced)
+                       for k in node.keywords))
+    if isinstance(node, ast.BinOp):
+        return (_is_traced(node.left, traced)
+                or _is_traced(node.right, traced))
+    if isinstance(node, ast.UnaryOp):
+        return _is_traced(node.operand, traced)
+    if isinstance(node, ast.BoolOp):
+        return any(_is_traced(v, traced) for v in node.values)
+    if isinstance(node, ast.Compare):
+        return (_is_traced(node.left, traced)
+                or any(_is_traced(c, traced) for c in node.comparators))
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(_is_traced(e, traced) for e in node.elts)
+    if isinstance(node, ast.IfExp):
+        return any(_is_traced(x, traced)
+                   for x in (node.test, node.body, node.orelse))
+    return False
+
+
+def _walk_branches(stmts, traced: set, out: List[RawFinding]):
+    for stmt in stmts:
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value
+            if value is not None and _is_traced(value, traced):
+                targets = (stmt.targets
+                           if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            traced.add(n.id)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            if _is_traced(stmt.test, traced):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                out.append(RawFinding(
+                    stmt.lineno, stmt.col_offset,
+                    f"Python `{kind}` on a traced value inside jit "
+                    f"scope: the branch is resolved at trace time "
+                    f"(or raises ConcretizationTypeError); use "
+                    f"jnp.where / lax.cond"))
+            _walk_branches(stmt.body, traced, out)
+            _walk_branches(stmt.orelse, traced, out)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            _walk_branches(stmt.body, traced, out)
+            _walk_branches(stmt.orelse, traced, out)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            _walk_branches(stmt.body, traced, out)
+        elif isinstance(stmt, ast.Try):
+            for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                _walk_branches(block, traced, out)
+            for h in stmt.handlers:
+                _walk_branches(h.body, traced, out)
+        elif isinstance(stmt, _FUNC_NODES):
+            # nested def (scan bodies, kernels): closes over the traced
+            # environment, so inherit a copy plus its own parameters
+            inner = set(traced)
+            inner.update(a.arg for a in stmt.args.posonlyargs
+                         + stmt.args.args + stmt.args.kwonlyargs)
+            _walk_branches(stmt.body, inner, out)
+
+
+def check_python_branch(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: `if cond:` on a traced array inside @jax.jit either
+    burns the branch into the trace for whatever value the first call
+    saw (silently wrong on later calls) or raises at trace time. Traced
+    values are approximated as non-static parameters plus anything
+    assigned from a jnp./lax. expression; .shape/.dtype/len() reads are
+    static projections and stay branchable."""
+    out: List[RawFinding] = []
+    for fn in _functions(ctx.tree):
+        if not _jit_decorated(fn):
+            continue
+        static = _static_params(fn)
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs]
+        traced = {p for p in params if p not in static}
+        _walk_branches(fn.body, traced, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 3: sentinel-safety
+# ---------------------------------------------------------------------------
+
+def _is_sentinel_expr(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "max"
+            and isinstance(node.value, ast.Call)
+            and _unparse(node.value.func).split(".")[-1]
+            in ("iinfo", "finfo"))
+
+
+def check_sentinel_safety(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: dense_pk_join's sorted mode overwrites null keys with
+    iinfo(dtype).max so the sort is globally monotone — which silently
+    aliases a LEGITIMATE key equal to dtype max (ADVICE.md r5,
+    planner.py:281). Using iinfo/finfo(...).max as a data sentinel is
+    only safe next to a domain guard that excludes the sentinel value
+    from the data; a function that uses the sentinel and has no
+    `if ... <sentinel> ...: raise` (and no assert) is flagged."""
+    out: List[RawFinding] = []
+    for fn in _functions(ctx.tree):
+        uses: list = []
+        sentinel_names: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _any_sentinel(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        sentinel_names.add(t.id)
+            if _is_sentinel_expr(node):
+                uses.append(node)
+        if not uses:
+            continue
+
+        def refs_sentinel(expr):
+            for n in ast.walk(expr):
+                if _is_sentinel_expr(n):
+                    return True
+                if isinstance(n, ast.Name) and n.id in sentinel_names:
+                    return True
+            return False
+
+        guarded = False
+        guard_tests: list = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.If) and refs_sentinel(node.test):
+                if any(isinstance(x, ast.Raise)
+                       for s in node.body + node.orelse
+                       for x in ast.walk(s)):
+                    guarded = True
+                    guard_tests.append(node.test)
+            elif isinstance(node, ast.Assert) and refs_sentinel(node.test):
+                guarded = True
+                guard_tests.append(node.test)
+        if guarded:
+            continue
+        in_guard_test = {id(n) for t in guard_tests
+                         for n in ast.walk(t)}
+        for use in uses:
+            if id(use) in in_guard_test:
+                continue
+            out.append(RawFinding(
+                use.lineno, use.col_offset,
+                "iinfo/finfo(...).max used as a data sentinel with no "
+                "adjacent domain guard: a legitimate value equal to "
+                "dtype max silently aliases the sentinel (the "
+                "dense_pk_join bug class); raise when the declared "
+                "domain touches dtype max, or pick an out-of-domain "
+                "sentinel"))
+    return out
+
+
+def _any_sentinel(expr: ast.AST) -> bool:
+    return any(_is_sentinel_expr(n) for n in ast.walk(expr))
+
+
+# ---------------------------------------------------------------------------
+# rule 4: padding-byte-invariant
+# ---------------------------------------------------------------------------
+
+def _contains_zero(node: ast.AST) -> bool:
+    """Static over-approximation of `0 in <byteset expr>` for the
+    constructions the regex engines actually use."""
+    if isinstance(node, ast.Call):
+        ftxt = _unparse(node.func)
+        if ftxt == "range":
+            a = node.args
+            if len(a) == 1:
+                return (isinstance(a[0], ast.Constant)
+                        and isinstance(a[0].value, int)
+                        and a[0].value >= 1)
+            if len(a) >= 2:
+                return (isinstance(a[0], ast.Constant)
+                        and isinstance(a[0].value, int)
+                        and a[0].value <= 0)
+            return False
+        if ftxt in ("set", "frozenset"):
+            return bool(node.args) and _contains_zero(node.args[0])
+        return False
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return any(isinstance(e, ast.Constant) and e.value == 0
+                   for e in node.elts)
+    if isinstance(node, ast.Constant) and isinstance(node.value, bytes):
+        return 0 in node.value
+    return False
+
+
+def check_padding_byte(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: the device regex engines pad every row's char matrix
+    with 0x00 and rely on "no pattern byteset can match byte 0" so a
+    match can never run past the end of a row into padding (ADVICE.md
+    r5, regex_capture_device.py:207). Any byteset construction in a
+    regex *_device.py that statically contains byte 0 breaks that
+    invariant; deliberate sentinel machinery carries a pragma."""
+    if not _is_regex_device_file(ctx.name):
+        return []
+    out: List[RawFinding] = []
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and _unparse(node.func) in ("set", "frozenset")
+                and node.args and _contains_zero(node.args[0])):
+            out.append(RawFinding(
+                node.lineno, node.col_offset,
+                "byteset construction can contain byte 0, the row "
+                "padding byte: a pattern atom matching NUL matches "
+                "padding and crosses row boundaries; exclude 0 (start "
+                "ranges at 1) or raise RegexUnsupported"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 5: dtype-width-discipline
+# ---------------------------------------------------------------------------
+
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv, ast.Mod,
+              ast.BitAnd, ast.BitOr, ast.BitXor, ast.LShift, ast.RShift)
+_WIDTH_RE = {32: re.compile(r"\bu?int32\b"), 64: re.compile(r"\bu?int64\b")}
+
+
+def _text_width(node: ast.AST):
+    txt = _unparse(node)
+    has32 = bool(_WIDTH_RE[32].search(txt))
+    has64 = bool(_WIDTH_RE[64].search(txt))
+    if has32 and not has64:
+        return 32
+    if has64 and not has32:
+        return 64
+    return None
+
+
+def _scope_nodes(scope):
+    """Walk a scope's statements without descending into nested defs
+    (each function scope is processed on its own)."""
+    body = scope.body if hasattr(scope, "body") else []
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, _FUNC_NODES + (ast.ClassDef,)):
+                stack.append(child)
+
+
+def _name_widths(scope) -> dict:
+    """name -> 32/64 for names whose every assignment in this scope
+    pins one width (conflicting or unpinnable assignments drop the
+    name)."""
+    widths: dict = {}
+    for node in _scope_nodes(scope):
+        if not isinstance(node, ast.Assign):
+            continue
+        w = _text_width(node.value)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                if t.id in widths and widths[t.id] != w:
+                    widths[t.id] = None
+                else:
+                    widths[t.id] = w
+    return {k: v for k, v in widths.items() if v is not None}
+
+
+def _width_of(node: ast.AST, widths: dict):
+    if isinstance(node, ast.Name):
+        return widths.get(node.id)
+    return _text_width(node)
+
+
+def check_dtype_width(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: int32/int64 mixing in ops/ arithmetic promotes (or,
+    under strict dtypes, raises) at a point the author did not choose —
+    index math built at int32 against an int64 gid wraps past 2^31 rows
+    (the _dense_prologue range-check exists precisely because of this).
+    Flags a binary arithmetic op whose operands are textually pinned to
+    different widths; pick one width and cast at the boundary."""
+    if "/ops/" not in ("/" + ctx.path):
+        return []
+    out: List[RawFinding] = []
+    scopes = list(_functions(ctx.tree)) + [ctx.tree]
+    for scope in scopes:
+        widths = _name_widths(scope)
+        for node in _scope_nodes(scope):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, _ARITH_OPS)):
+                continue
+            lw = _width_of(node.left, widths)
+            rw = _width_of(node.right, widths)
+            if lw is not None and rw is not None and lw != rw:
+                out.append(RawFinding(
+                    node.lineno, node.col_offset,
+                    f"implicit int{lw}/int{rw} mix in arithmetic: the "
+                    f"promotion point is accidental and index math can "
+                    f"wrap; cast both operands to one width "
+                    f"explicitly"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 6: bitmask-via-helpers
+# ---------------------------------------------------------------------------
+
+_MASKY_NAME = re.compile(r"(^|_)(valid|validity|present|presence|mask)"
+                         r"(_|$|\d)", re.IGNORECASE)
+
+
+def _nonzero_compare(expr: ast.AST):
+    for n in ast.walk(expr):
+        if (isinstance(n, ast.Compare) and len(n.ops) == 1
+                and isinstance(n.ops[0], ast.NotEq)):
+            for side in (n.left, n.comparators[0]):
+                if isinstance(side, ast.Constant) and side.value == 0:
+                    return n
+    return None
+
+
+def check_bitmask_helpers(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: tpcds q3 derived group presence as `sums != 0`, so a
+    group whose revenue sums to exactly zero (refunds) was dropped as
+    absent (ADVICE.md r5, tpcds.py:807). A validity/presence mask must
+    come from row counts (dense_id_counts(...) > 0) or the
+    columnar/bitmask.py helpers — never from `aggregate != 0`, which
+    conflates "no rows" with "rows summing to zero"."""
+    out: List[RawFinding] = []
+    for node in ast.walk(ctx.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not any(_MASKY_NAME.search(n) for n in names):
+            continue
+        cmp_node = _nonzero_compare(value)
+        if cmp_node is not None:
+            out.append(RawFinding(
+                cmp_node.lineno, cmp_node.col_offset,
+                "validity/presence mask derived from `!= 0` on a "
+                "value: zero-valued groups vanish (the tpcds_q3 bug "
+                "class); derive presence from counts "
+                "(dense_id_counts(...) > 0) or the columnar/bitmask "
+                "helpers"))
+    return out
+
+
+RULES = [
+    Rule("no-host-transfer-in-device-path",
+         "no np.asarray / jax.device_get / .tolist() / float(traced) "
+         "inside jit scope or ops/*_device.py functions",
+         check_host_transfer),
+    Rule("no-python-branch-on-traced",
+         "no Python if/while on a traced value inside @jax.jit",
+         check_python_branch),
+    Rule("sentinel-safety",
+         "iinfo/finfo(...).max as a data sentinel requires an adjacent "
+         "domain guard",
+         check_sentinel_safety),
+    Rule("padding-byte-invariant",
+         "regex device bytesets must never contain byte 0 (the row "
+         "padding byte)",
+         check_padding_byte),
+    Rule("dtype-width-discipline",
+         "no implicit int32/int64 mixing in ops/ arithmetic",
+         check_dtype_width),
+    Rule("bitmask-via-helpers",
+         "validity masks come from counts or columnar/bitmask.py, not "
+         "ad-hoc != 0 tests",
+         check_bitmask_helpers),
+]
