@@ -1,15 +1,21 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its six invariant rules (host/device
+# tpulint (tools/tpulint) runs its seven invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
-# width, validity-mask derivation) over the package in
-# fail-on-new-findings mode. Reviewed deliberate violations carry
+# width, validity-mask derivation, fallback accounting) over the package
+# in fail-on-new-findings mode — the spark_rapids_jni_tpu glob below
+# covers the telemetry/ package alongside every other subpackage.
+# Reviewed deliberate violations carry
 # `# tpulint: disable=<rule>` pragmas; pre-existing findings live in
 # tools/tpulint/baseline.txt (regenerate with
 # `python -m tools.tpulint --write-baseline spark_rapids_jni_tpu`).
 # Any NEW finding exits 1 and fails premerge.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# the telemetry package is load-bearing for the fallback-accounting rule:
+# fail loud if a refactor moves it out from under the lint root
+test -d spark_rapids_jni_tpu/telemetry
 
 python -m tools.tpulint spark_rapids_jni_tpu bench.py tools

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_rapids_jni_tpu import telemetry
 from spark_rapids_jni_tpu import types as t
 from spark_rapids_jni_tpu.columnar import Column
 from spark_rapids_jni_tpu.ops._calendar import civil_from_days, days_from_civil
@@ -326,11 +327,17 @@ def integer_to_string(col: Column) -> Column:
     digits = np.asarray(_digit_matrix_u64(mag))
     neg = np.asarray(neg)
     valid = np.asarray(col.valid_mask())
-    return _assemble_decimal_strings(digits, neg, valid, scale=0)
+    return _assemble_decimal_strings(
+        digits, neg, valid, scale=0, op="integer_to_string")
 
 
-def _column_from_pieces(pieces: list, valid) -> Column:
-    """Host-side Arrow assembly shared by every X->string cast."""
+def _column_from_pieces(pieces: list, valid, op: str) -> Column:
+    """Host-side Arrow assembly shared by every X->string cast. Each call
+    is a device->host fallback by construction (variable-length string
+    building has no device path yet) and is recorded as such."""
+    telemetry.record_fallback(
+        op, "host-side Arrow string assembly: variable-length X->string "
+        "building has no device path", rows=len(pieces))
     offsets = np.zeros(len(pieces) + 1, dtype=np.int32)
     np.cumsum([len(p) for p in pieces], out=offsets[1:])
     chars = np.frombuffer(b"".join(pieces), dtype=np.uint8)
@@ -353,7 +360,7 @@ def boolean_to_string(col: Column) -> Column:
         (b"true" if v else b"false") if ok else b""
         for v, ok in zip(vals, valid)
     ]
-    return _column_from_pieces(pieces, valid)
+    return _column_from_pieces(pieces, valid, "boolean_to_string")
 
 
 @func_range("decimal_to_string")
@@ -376,7 +383,7 @@ def decimal_to_string(col: Column) -> Column:
 
 def _assemble_decimal_strings(
     digits: np.ndarray, neg: np.ndarray, valid: np.ndarray, scale: int,
-    trailing_zeros: int = 0,
+    trailing_zeros: int = 0, op: str = "decimal_to_string",
 ) -> Column:
     """Host assembly: digit rows -> Arrow string column. ``scale`` is the
     number of fractional digits (>= 0); ``trailing_zeros`` appends fixed
@@ -399,7 +406,7 @@ def _assemble_decimal_strings(
         if neg[i]:
             s = b"-" + s
         pieces.append(s)
-    return _column_from_pieces(pieces, valid)
+    return _column_from_pieces(pieces, valid, op)
 
 
 # ---- date casts ------------------------------------------------------------
@@ -629,7 +636,7 @@ def date_to_string(col: Column) -> Column:
         fmt(yy, mm, dd) if v else b""
         for yy, mm, dd, v in zip(y, m, d, ok)
     ]
-    return _column_from_pieces(pieces, ok)
+    return _column_from_pieces(pieces, ok, "date_to_string")
 
 
 @func_range("string_to_boolean")
@@ -711,4 +718,4 @@ def float_to_string(col: Column) -> Column:
         _java_float_repr(v, float32) if ok else b""
         for v, ok in zip(vals, valid)
     ]
-    return _column_from_pieces(pieces, valid)
+    return _column_from_pieces(pieces, valid, "float_to_string")

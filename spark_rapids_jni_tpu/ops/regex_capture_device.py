@@ -351,10 +351,24 @@ class CompiledLinear(NamedTuple):
     suffix_dfas: tuple
 
 
-@functools.lru_cache(maxsize=256)
 def compile_linear(pattern: str) -> CompiledLinear:
     """Host compile: the linear pattern + one reversed-suffix DFA per
-    element boundary. LRU-cached per pattern string."""
+    element boundary. LRU-cached per pattern string; hits/misses are
+    recorded as telemetry compile_cache events (rejected patterns raise
+    out of the cache — counted as misses)."""
+    from spark_rapids_jni_tpu import telemetry
+
+    if telemetry.enabled():
+        before = _compile_linear_cached.cache_info().hits
+        out = _compile_linear_cached(pattern)
+        hit = _compile_linear_cached.cache_info().hits > before
+        telemetry.record_compile_cache("regex_linear", hit=hit)
+        return out
+    return _compile_linear_cached(pattern)
+
+
+@functools.lru_cache(maxsize=256)
+def _compile_linear_cached(pattern: str) -> CompiledLinear:
     lin = parse_linear(pattern)
     m = len(lin.elements)
     dfas = []

@@ -455,12 +455,26 @@ def _closure(nfa: _Nfa, states: frozenset) -> frozenset:
 import functools
 
 
-@functools.lru_cache(maxsize=256)
 def compile_pattern(pattern: str) -> CompiledRegex:
     """Host compile: pattern -> byte DFA recognizing
     ``search(P) and end-of-row`` over zero-terminated padded rows.
     LRU-cached per pattern (immutable result) — repeated per-batch
-    calls skip the subset construction."""
+    calls skip the subset construction. Cache hits/misses are recorded
+    as telemetry compile_cache events (unsupported patterns raise out
+    of the cache and always re-parse — accurately counted as misses)."""
+    from spark_rapids_jni_tpu import telemetry
+
+    if telemetry.enabled():
+        before = _compile_pattern_cached.cache_info().hits
+        out = _compile_pattern_cached(pattern)
+        hit = _compile_pattern_cached.cache_info().hits > before
+        telemetry.record_compile_cache("regex_dfa", hit=hit)
+        return out
+    return _compile_pattern_cached(pattern)
+
+
+@functools.lru_cache(maxsize=256)
+def _compile_pattern_cached(pattern: str) -> CompiledRegex:
     nfa = _Nfa()
     parser = _Parser(pattern, nfa)
     frag = parser.parse()

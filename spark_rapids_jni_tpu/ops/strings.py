@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_rapids_jni_tpu import telemetry
 from spark_rapids_jni_tpu.columnar import Column
 from spark_rapids_jni_tpu.types import DType, TypeId
 from spark_rapids_jni_tpu.utils.tracing import func_range
@@ -695,7 +696,7 @@ def _host_regexp(col: Column, rx, fn):
     return [None if v is None else fn(rx, v) for v in vals]
 
 
-@func_range("regexp_contains")
+@func_range("regexp_contains", record=True)
 def regexp_contains(col: Column, pattern: str) -> Column:
     """RLIKE / regexp-find (cuDF contains_re): True when the pattern
     matches anywhere in the string.
@@ -716,14 +717,20 @@ def regexp_contains(col: Column, pattern: str) -> Column:
 
     validity = col.valid_mask() if col.validity is not None else None
     force = get_option("regex.force_engine")
-    if force != "host":
+    if force == "host":
+        telemetry.record_fallback(
+            "regexp_contains", "regex.force_engine=host pin", rows=col.size)
+    else:
         from spark_rapids_jni_tpu.ops import regex_device as rd
 
         try:
             comp = rd.compile_pattern(pattern)
-        except rd.RegexUnsupported:
+        except rd.RegexUnsupported as exc:
             if force == "device":
                 raise
+            telemetry.record_fallback(
+                "regexp_contains", f"unsupported regex atom: {exc}",
+                rows=col.size)
             comp = None
         if comp is not None:
             pc = pad_strings(col)
@@ -747,35 +754,47 @@ def regexp_contains(col: Column, pattern: str) -> Column:
                 raise ValueError(
                     "regex.force_engine=device but the column has "
                     "embedded NUL bytes (sentinel alias)")
+            telemetry.record_fallback(
+                "regexp_contains",
+                "embedded NUL bytes alias the 0x00 padding sentinel",
+                rows=col.size)
     rx = _compile_java_regex(pattern)
     out = _host_regexp(col, rx, lambda r, v: r.search(v) is not None)
     flags = jnp.asarray([bool(v) for v in out], jnp.uint8)
     return Column(BOOL8, flags, validity)
 
 
-def _device_capture_eligible(col: Column, pattern: str):
+def _device_capture_eligible(col: Column, pattern: str, op: str):
     """Shared extract/replace device-path gate: the pattern parses into
     the linear capture subset AND the column is all-ASCII with no
     embedded NULs (byte-level ``.``/negated classes equal char-level
     exactly on ASCII data; NULs alias the padding sentinel). Returns
     (compiled, padded_col) or (None, None) for host fallback; respects
-    ``regex.force_engine`` like regexp_contains."""
+    ``regex.force_engine`` like regexp_contains. Every (None, None)
+    return records a telemetry fallback under ``op`` (the dispatcher
+    the gate is deciding for)."""
     from spark_rapids_jni_tpu.utils.config import get_option
 
     force = get_option("regex.force_engine")
     if force == "host":
+        telemetry.record_fallback(
+            op, "regex.force_engine=host pin", rows=col.size)
         return None, None
     from spark_rapids_jni_tpu.ops import regex_capture_device as rc
 
     try:
         comp = rc.compile_linear(pattern)
-    except rc.RegexUnsupported:
+    except rc.RegexUnsupported as exc:
         if force == "device":
             raise
+        telemetry.record_fallback(
+            op, f"unsupported linear-capture atom: {exc}", rows=col.size)
         return None, None
     pc = pad_strings(col)
     n, w = pc.chars.shape
     if n == 0:
+        telemetry.record_fallback(
+            op, "empty column: no rows to run on device", rows=0)
         return None, None
     nzeros = jnp.sum((pc.chars == 0).astype(jnp.int32), axis=1)
     clean = bool(jnp.all(nzeros == (w - pc.data))
@@ -786,6 +805,11 @@ def _device_capture_eligible(col: Column, pattern: str):
                 "regex.force_engine=device but the column has embedded "
                 "NULs or non-ASCII bytes (outside the capture engine's "
                 "correctness scope)")
+        telemetry.record_fallback(
+            op,
+            "embedded NULs or non-ASCII bytes (sentinel alias / outside "
+            "the byte-level capture engine's correctness scope)",
+            rows=col.size)
         return None, None
     # the boundary walk reads positions up to W inclusive: guarantee a
     # sentinel column (same rule as run_dfa's ensure_sentinel)
@@ -795,7 +819,7 @@ def _device_capture_eligible(col: Column, pattern: str):
     return comp, pc
 
 
-@func_range("regexp_extract")
+@func_range("regexp_extract", record=True)
 def regexp_extract(col: Column, pattern: str, group: int = 1) -> Column:
     """Spark regexp_extract: the group'th capture of the first match,
     '' when the pattern does not match (Spark returns empty string, not
@@ -813,7 +837,7 @@ def regexp_extract(col: Column, pattern: str, group: int = 1) -> Column:
         raise ValueError(
             f"regexp_extract group {group} out of range: pattern has "
             f"{rx.groups} group(s)")
-    comp, pc = _device_capture_eligible(col, pattern)
+    comp, pc = _device_capture_eligible(col, pattern, "regexp_extract")
     if comp is not None:
         from spark_rapids_jni_tpu.ops import regex_capture_device as rc
 
@@ -831,7 +855,7 @@ def regexp_extract(col: Column, pattern: str, group: int = 1) -> Column:
     return pad_strings(Column.from_pylist(out, STRING))
 
 
-@func_range("regexp_replace")
+@func_range("regexp_replace", record=True)
 def regexp_replace(col: Column, pattern: str, replacement: str) -> Column:
     """Spark regexp_replace: every match replaced; Java $N group refs
     (greedy multi-digit) and \\x literal escapes supported.
@@ -845,12 +869,16 @@ def regexp_replace(col: Column, pattern: str, replacement: str) -> Column:
     rep = _java_replacement_to_python(replacement, rx.groups)
     literal_rep = "$" not in replacement and "\\" not in replacement
     if literal_rep:
-        comp, pc = _device_capture_eligible(col, pattern)
+        comp, pc = _device_capture_eligible(col, pattern, "regexp_replace")
         if comp is not None and all(
                 el.lo == 0 for el in comp.pattern.elements):
             # a pattern that can match empty matches at EVERY position:
             # any row longer than the round budget is guaranteed to
             # overflow, so the device pass would be dead work
+            telemetry.record_fallback(
+                "regexp_replace",
+                "empty-matching pattern: every position matches, device "
+                "round budget would always overflow", rows=col.size)
             comp = None
         if comp is not None:
             from spark_rapids_jni_tpu.ops import regex_capture_device as rc
@@ -862,5 +890,15 @@ def regexp_replace(col: Column, pattern: str, replacement: str) -> Column:
                               chars=out_chars)
             # else: some row had more matches than the round budget —
             # fall through to the host engine for the whole column
+            telemetry.record_fallback(
+                "regexp_replace",
+                "match-round budget overflow: a row exceeded the device "
+                "replace rounds; rerouting whole column to host",
+                rows=col.size)
+    else:
+        telemetry.record_fallback(
+            "regexp_replace",
+            "group-ref/escape replacement: device engine handles literal "
+            "replacements only", rows=col.size)
     out = _host_regexp(col, rx, lambda r, v: r.sub(rep, v))
     return pad_strings(Column.from_pylist(out, STRING))

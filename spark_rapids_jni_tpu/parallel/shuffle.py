@@ -33,6 +33,7 @@ from typing import NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.types import TypeId
@@ -289,3 +290,41 @@ def shuffle_by_partition(
     return ShuffleResult(
         Table(out_cols), recv_occupied, overflowed, narrowing_overflow
     )
+
+
+def report_shuffle_telemetry(result: ShuffleResult | None = None,
+                             op: str = "hash_shuffle",
+                             rows: int | None = None, *,
+                             overflowed=None,
+                             narrowing_overflow=None) -> None:
+    """Host-side fallback accounting for a CONCRETE shuffle result.
+
+    The shuffle itself runs inside shard_map/jit where telemetry calls are
+    forbidden (they would be host transfers in a traced region — the tpulint
+    no-host-transfer rule); callers that have the materialized result invoke
+    this at the jit boundary — either a full ``ShuffleResult`` or just the
+    two flag arrays for callers whose jitted step returns flags alone (the
+    shuffle_wire bench). Records a fallback event per tripped flag
+    (capacity overflow / wire narrowing overflow) and a dispatch otherwise.
+    Telemetry-off is a no-op before any flag is synced to host."""
+    from spark_rapids_jni_tpu import telemetry
+
+    if not telemetry.enabled():
+        return
+    if result is not None:
+        overflowed = result.overflowed
+        narrowing_overflow = result.narrowing_overflow
+    ovf = overflowed is not None and bool(np.asarray(overflowed).any())
+    nvf = (narrowing_overflow is not None
+           and bool(np.asarray(narrowing_overflow).any()))
+    if ovf:
+        telemetry.record_fallback(
+            op, "partition capacity overflow: a device dropped rows "
+            "(re-plan with larger capacity)", rows=rows)
+    if nvf:
+        telemetry.record_fallback(
+            op, "wire narrowing overflow: a narrowed value did not survive "
+            "the round trip (planner declared too-narrow wire type)",
+            rows=rows)
+    if not (ovf or nvf):
+        telemetry.record_dispatch(op, rows=rows)

@@ -27,6 +27,7 @@ from typing import Optional
 
 import numpy as np
 
+from spark_rapids_jni_tpu import telemetry
 from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.log import get_logger
 
@@ -288,6 +289,9 @@ class SpillStore:
         self._tick = 0
         self.spill_count = 0
         self.unspill_count = 0
+        # cumulative bytes moved across the PCIe-equivalent boundary
+        self.spilled_bytes = 0
+        self.unspilled_bytes = 0
         self._cctx = None
         self._dctx = None
         if compress_spill:
@@ -324,6 +328,11 @@ class SpillStore:
             e["table"] = None  # drop the device arrays -> XLA frees HBM
             e["state"] = "host"
             self.spill_count += 1
+            self.spilled_bytes += e["nbytes"]
+            telemetry.record_spill(
+                "spill_store",
+                "device spill budget exceeded: LRU eviction to host",
+                bytes_moved=e["nbytes"], direction="device_to_host")
             if get_option("memory.log_level") >= 1:
                 _log.info("spill table %d (%d bytes) to host", eid,
                           e["nbytes"])
@@ -361,6 +370,11 @@ class SpillStore:
             e["host_cols"] = None
             e["state"] = "device"
             self.unspill_count += 1
+            self.unspilled_bytes += e["nbytes"]
+            telemetry.record_spill(
+                "spill_store",
+                "spilled table touched: staging back to device",
+                bytes_moved=e["nbytes"], direction="host_to_device")
             if get_option("memory.log_level") >= 1:
                 _log.info("unspill table %d (%d bytes)", handle, e["nbytes"])
             return e["table"]
@@ -391,5 +405,7 @@ class SpillStore:
                 "host_stored_bytes": stored,  # compressed footprint
                 "budget_bytes": self.budget,
                 "spills": self.spill_count, "unspills": self.unspill_count,
+                "spilled_bytes": self.spilled_bytes,
+                "unspilled_bytes": self.unspilled_bytes,
                 "tables": len(self._entries),
             }

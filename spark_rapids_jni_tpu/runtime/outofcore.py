@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import Callable, Iterable, NamedTuple
 
+from spark_rapids_jni_tpu import telemetry
 from spark_rapids_jni_tpu.columnar import Table
 from spark_rapids_jni_tpu.runtime.memory import (
     MemoryLimiter,
@@ -133,7 +134,7 @@ class OutOfCoreResult(NamedTuple):
     spill_stats: dict     # SpillStore counters (spilled/restored/...)
 
 
-@func_range("run_chunked_aggregate")
+@func_range("run_chunked_aggregate", record=True)
 def run_chunked_aggregate(
     chunks: Iterable[Table],
     partial_fn: Callable[[Table], Table],
@@ -202,8 +203,18 @@ def run_chunked_aggregate(
             stream.close()
     if not handles:
         raise ValueError("no chunks: empty input stream")
+    stream_stats = spill.stats()
     _log.info("out-of-core: %d chunks streamed, spill=%s",
-              nchunks, spill.stats())
+              nchunks, stream_stats)
+    if stream_stats["spills"]:
+        # per-table byte movement is recorded by SpillStore itself; this
+        # marks the RUN as having left the all-device residency path
+        telemetry.record_fallback(
+            "run_chunked_aggregate",
+            "partials exceeded the device spill budget during chunk "
+            "streaming: LRU-spilled to host",
+            rows=nchunks, spills=stream_stats["spills"],
+            spilled_bytes=stream_stats["spilled_bytes"])
     # merge window: restoring a partial stages it back to device, so every
     # restored partial is reserved before the next one comes up — a partial
     # set that alone exceeds the budget raises instead of over-committing.

@@ -1,0 +1,46 @@
+"""Execution telemetry & fallback accounting.
+
+The reference answers "where did GPU time go" with NVTX ranges
+(``ai.rapids.cudf.nvtx.enabled``) plus RMM counters; this package is the TPU
+port's equivalent *and* closes the gap NVTX never covered: counting where
+execution actually landed. Every device→host fallback (regex NUL byteset,
+unsupported regex atom, cast-strings host assembly, out-of-core spill,
+shuffle overflow reroute) records an event with a mandatory ``reason``; the
+bench stamps a telemetry summary into every BENCH_*.json; and
+``python -m spark_rapids_jni_tpu.telemetry report run.jsonl`` renders the
+per-op device/host split with p50/p95 wall times and bytes moved.
+
+Toggles (utils/config.py): ``telemetry.enabled``
+(``SPARK_RAPIDS_TPU_TELEMETRY_ENABLED=1``) turns recording on;
+``telemetry.path`` (``SPARK_RAPIDS_TPU_TELEMETRY_PATH=run.jsonl``) adds a
+JSONL file sink on top of the in-process ring. Zero third-party deps, no jax
+import, near-zero cost when disabled (one config lookup per instrumented
+call).
+"""
+
+from spark_rapids_jni_tpu.telemetry.events import (
+    drain,
+    enabled,
+    events,
+    record_bench_stale,
+    record_compile_cache,
+    record_dispatch,
+    record_fallback,
+    record_spill,
+    summary,
+)
+from spark_rapids_jni_tpu.telemetry.registry import REGISTRY, Registry
+
+__all__ = [
+    "REGISTRY",
+    "Registry",
+    "drain",
+    "enabled",
+    "events",
+    "record_bench_stale",
+    "record_compile_cache",
+    "record_dispatch",
+    "record_fallback",
+    "record_spill",
+    "summary",
+]

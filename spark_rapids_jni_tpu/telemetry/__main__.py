@@ -1,0 +1,26 @@
+"""CLI: ``python -m spark_rapids_jni_tpu.telemetry report <run.jsonl>``."""
+
+from __future__ import annotations
+
+import sys
+
+from spark_rapids_jni_tpu.telemetry.report import report
+
+_USAGE = "usage: python -m spark_rapids_jni_tpu.telemetry report <run.jsonl>"
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2 or argv[0] != "report":
+        print(_USAGE, file=sys.stderr)
+        return 2
+    try:
+        text = report(argv[1])
+    except OSError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

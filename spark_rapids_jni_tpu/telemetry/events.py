@@ -1,0 +1,261 @@
+"""Structured JSONL event log: dispatches, fallbacks, cache hits, staleness.
+
+Every record answers the question the round-5 bench could not: *where did
+this op actually run, and why?* Four event kinds:
+
+- ``dispatch``      — an op ran on its intended engine (``engine`` says which);
+                      carries ``wall_ms`` when timed via ``trace_range(record=)``.
+- ``fallback``      — a device path handed the row set to the host engine.
+                      ``reason`` is mandatory and must be non-empty: a fallback
+                      without a reason is unaccountable and raises ValueError
+                      at the call site (enforced even when telemetry is off, so
+                      the bug surfaces in tests, not production).
+- ``compile_cache`` — hit/miss on a pattern-compile cache (regex DFA / linear).
+- ``spill``         — device→host spill under memory pressure; carries
+                      ``bytes_moved``.
+- ``bench_stale``   — bench served a last-known-good ledger value instead of a
+                      fresh measurement.
+
+Each record is stamped with ``ts`` (epoch seconds), ``platform`` (jax backend
+if jax is already imported — telemetry itself never imports jax, keeping the
+zero-dep/no-backend-init contract of tests/test_import_hygiene.py), and the
+caller-supplied ``op`` / ``rows`` / ``dtype_widths``.
+
+Sink: when ``telemetry.path`` is set, records append to that JSONL file (one
+json object per line, crash-tolerant — a torn final line is skipped by the
+reader). Always, the last 4096 records are kept in an in-process ring for the
+bench summary and tests. Emission never raises on I/O failure; dropped writes
+are counted in ``telemetry.dropped_writes``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import sys
+import threading
+import time
+from typing import Any, Deque, Dict, Iterable, List, Optional, Sequence
+
+from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import get_option
+
+__all__ = [
+    "enabled",
+    "record_dispatch",
+    "record_fallback",
+    "record_compile_cache",
+    "record_spill",
+    "record_bench_stale",
+    "events",
+    "drain",
+    "summary",
+]
+
+_RING_MAX = 4096
+_ring: Deque[Dict[str, Any]] = collections.deque(maxlen=_RING_MAX)
+_ring_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """True when the ``telemetry.enabled`` option is on."""
+    return bool(get_option("telemetry.enabled"))
+
+
+def _platform() -> str:
+    # Never import jax from here: telemetry is zero-dep and must not trigger
+    # backend init (test_import_hygiene.py). If the workload already imported
+    # jax, report its backend; otherwise "none".
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "none"
+    try:
+        return str(jax.default_backend())
+    except Exception:
+        return "unknown"
+
+
+def _emit(rec: Dict[str, Any]) -> Dict[str, Any]:
+    rec.setdefault("ts", time.time())
+    rec.setdefault("platform", _platform())
+    with _ring_lock:
+        _ring.append(rec)
+    REGISTRY.counter("events_total").inc()
+    path = get_option("telemetry.path")
+    if path:
+        try:
+            with open(path, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        except OSError:
+            # telemetry must never take the workload down with it
+            REGISTRY.counter("dropped_writes").inc()
+    return rec
+
+
+def _base(
+    kind: str,
+    op: str,
+    rows: Optional[int],
+    dtype_widths: Optional[Sequence[int]],
+    extra: Dict[str, Any],
+) -> Dict[str, Any]:
+    rec: Dict[str, Any] = {"kind": kind, "op": op}
+    if rows is not None:
+        rec["rows"] = int(rows)
+    if dtype_widths is not None:
+        rec["dtype_widths"] = [int(w) for w in dtype_widths]
+    rec.update(extra)
+    return rec
+
+
+def record_dispatch(
+    op: str,
+    *,
+    engine: str = "device",
+    rows: Optional[int] = None,
+    dtype_widths: Optional[Sequence[int]] = None,
+    wall_ms: Optional[float] = None,
+    **extra: Any,
+) -> bool:
+    """An op executed on ``engine``; optionally timed. Returns True if recorded."""
+    if not enabled():
+        return False
+    rec = _base("dispatch", op, rows, dtype_widths, extra)
+    rec["engine"] = engine
+    if wall_ms is not None:
+        rec["wall_ms"] = float(wall_ms)
+        REGISTRY.histogram(f"wall_ms.{op}").observe(float(wall_ms))
+    REGISTRY.counter(f"dispatch.{op}").inc()
+    _emit(rec)
+    return True
+
+
+def record_fallback(
+    op: str,
+    reason: str,
+    *,
+    rows: Optional[int] = None,
+    dtype_widths: Optional[Sequence[int]] = None,
+    **extra: Any,
+) -> bool:
+    """A device path handed execution to the host engine, because ``reason``."""
+    if not reason or not str(reason).strip():
+        # validated even when disabled: an unaccountable fallback is a bug
+        raise ValueError(f"record_fallback({op!r}): reason must be non-empty")
+    if not enabled():
+        return False
+    rec = _base("fallback", op, rows, dtype_widths, extra)
+    rec["reason"] = str(reason)
+    rec["engine"] = "host"
+    REGISTRY.counter(f"fallback.{op}").inc()
+    REGISTRY.counter("fallbacks_total").inc()
+    _emit(rec)
+    return True
+
+
+def record_compile_cache(op: str, *, hit: bool, **extra: Any) -> bool:
+    """A pattern-compile cache was consulted (regex DFA / linear-capture)."""
+    if not enabled():
+        return False
+    rec = _base("compile_cache", op, None, None, extra)
+    rec["hit"] = bool(hit)
+    REGISTRY.counter("compile_cache.hit" if hit else "compile_cache.miss").inc()
+    _emit(rec)
+    return True
+
+
+def record_spill(
+    op: str,
+    reason: str,
+    *,
+    bytes_moved: int = 0,
+    rows: Optional[int] = None,
+    **extra: Any,
+) -> bool:
+    """Device→host spill under memory pressure; ``reason`` mandatory."""
+    if not reason or not str(reason).strip():
+        raise ValueError(f"record_spill({op!r}): reason must be non-empty")
+    if not enabled():
+        return False
+    rec = _base("spill", op, rows, None, extra)
+    rec["reason"] = str(reason)
+    rec["bytes_moved"] = int(bytes_moved)
+    REGISTRY.counter(f"spill.{op}").inc()
+    REGISTRY.counter("spill_bytes_total").inc(max(0, int(bytes_moved)))
+    _emit(rec)
+    return True
+
+
+def record_bench_stale(
+    metric: str,
+    *,
+    stale_s: float,
+    reason: str,
+    **extra: Any,
+) -> bool:
+    """Bench served a last-known-good ledger value instead of measuring."""
+    if not reason or not str(reason).strip():
+        raise ValueError(f"record_bench_stale({metric!r}): reason must be non-empty")
+    if not enabled():
+        return False
+    rec = _base("bench_stale", metric, None, None, extra)
+    rec["reason"] = str(reason)
+    rec["stale_s"] = float(stale_s)
+    REGISTRY.counter("bench_stale_total").inc()
+    _emit(rec)
+    return True
+
+
+def events(n: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The last ``n`` (default: all buffered) records, oldest first."""
+    with _ring_lock:
+        buf = list(_ring)
+    return buf if n is None else buf[-n:]
+
+
+def drain() -> List[Dict[str, Any]]:
+    """Return and clear the in-process ring (test isolation)."""
+    with _ring_lock:
+        buf = list(_ring)
+        _ring.clear()
+    return buf
+
+
+def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any]:
+    """Aggregate counts for the bench telemetry block.
+
+    With no argument, summarizes the in-process ring; pass parsed JSONL
+    records to summarize a file written by another process (bench children).
+    """
+    recs = list(records) if records is not None else events()
+    fallbacks: Dict[str, int] = {}
+    spills: Dict[str, int] = {}
+    cache = {"hit": 0, "miss": 0}
+    stale_reads = 0
+    dispatches = 0
+    spill_bytes = 0
+    for r in recs:
+        kind = r.get("kind")
+        if kind == "fallback":
+            op = str(r.get("op", "?"))
+            fallbacks[op] = fallbacks.get(op, 0) + 1
+        elif kind == "spill":
+            op = str(r.get("op", "?"))
+            spills[op] = spills.get(op, 0) + 1
+            spill_bytes += int(r.get("bytes_moved", 0))
+        elif kind == "compile_cache":
+            cache["hit" if r.get("hit") else "miss"] += 1
+        elif kind == "bench_stale":
+            stale_reads += 1
+        elif kind == "dispatch":
+            dispatches += 1
+    return {
+        "events": len(recs),
+        "dispatches": dispatches,
+        "fallbacks": dict(sorted(fallbacks.items())),
+        "fallbacks_total": sum(fallbacks.values()),
+        "spills": dict(sorted(spills.items())),
+        "spill_bytes_total": spill_bytes,
+        "compile_cache": cache,
+        "stale_reads": stale_reads,
+    }

@@ -31,6 +31,12 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # "device" = require the DFA engine, "host" = force java.util.regex
     # emulation (testing / behavior comparison).
     "regex.force_engine": ("", str),
+    # Execution telemetry (telemetry/): record op dispatches, device->host
+    # fallbacks (with reasons), compile-cache hits, spills, bench staleness.
+    # Off by default — same posture as tracing.enabled.
+    "telemetry.enabled": (False, bool),
+    # JSONL sink for telemetry events; "" = in-process ring buffer only.
+    "telemetry.path": ("", str),
 }
 
 _overrides: dict[str, Any] = {}

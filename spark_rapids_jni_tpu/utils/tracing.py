@@ -7,12 +7,19 @@ granularity is provided with ``jax.profiler.TraceAnnotation``, which lands in
 XLA/Perfetto traces captured via ``jax.profiler.trace``. Disabled by default,
 toggled by the ``tracing.enabled`` option (env
 ``SPARK_RAPIDS_TPU_TRACING_ENABLED=1``).
+
+``record=True`` additionally times the range and records a telemetry dispatch
+event (telemetry/events.py) when ``telemetry.enabled`` is on — profiler
+annotation and execution accounting share one seam, so instrumented ops get
+both for free. Recording happens only on successful exit: a range that raised
+did not dispatch.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import time
 from typing import Callable, TypeVar
 
 from spark_rapids_jni_tpu.utils.config import get_option
@@ -21,24 +28,37 @@ F = TypeVar("F", bound=Callable)
 
 
 @contextlib.contextmanager
-def trace_range(name: str):
-    """Context manager opening a named profiler range when tracing is on."""
-    if not get_option("tracing.enabled"):
+def trace_range(name: str, record: bool = False):
+    """Context manager opening a named profiler range when tracing is on.
+
+    With ``record=True`` (and telemetry enabled), also times the body and
+    records a ``dispatch`` telemetry event carrying ``wall_ms``.
+    """
+    if record:
+        from spark_rapids_jni_tpu import telemetry
+
+        record = telemetry.enabled()
+    t0 = time.perf_counter() if record else 0.0
+    if get_option("tracing.enabled"):
+        import jax.profiler
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    else:
         yield
-        return
-    import jax.profiler
+    if record:
+        telemetry.record_dispatch(
+            name, wall_ms=(time.perf_counter() - t0) * 1e3
+        )
 
-    with jax.profiler.TraceAnnotation(name):
-        yield
 
-
-def func_range(name: str) -> Callable[[F], F]:
+def func_range(name: str, record: bool = False) -> Callable[[F], F]:
     """Decorator form — CUDF_FUNC_RANGE() parity."""
 
     def deco(fn: F) -> F:
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            with trace_range(name):
+            with trace_range(name, record=record):
                 return fn(*args, **kwargs)
 
         return wrapper  # type: ignore[return-value]

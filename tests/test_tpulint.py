@@ -125,10 +125,34 @@ def test_rule_bitmask_helpers_seeded():
     assert "counts" not in got[0].source_line
 
 
+def test_rule_fallback_recorded_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_fallback_device.py"),
+                   "fallback-must-be-recorded")
+    texts = [f.source_line for f in got]
+    assert len(got) == 2, texts
+    assert any("except RegexUnsupported:" in t for t in texts)
+    assert any('force == "host"' in t for t in texts)
+    # the recorded twins and the pure re-raise handler stay clean
+    lines = [f.line for f in got]
+    src = (FIXTURES / "seeded_fallback_device.py").read_text()
+    clean_at = src[:src.index("def recorded_swallow")].count("\n") + 1
+    assert all(ln < clean_at for ln in lines), lines
+
+
+def test_rule_fallback_recorded_needs_ops_or_device_scope(tmp_path):
+    # same constructions outside ops/ or a *_device.py file are out of
+    # scope: host-side orchestration may legitimately branch on "host"
+    target = tmp_path / "not_an_ops_file.py"
+    shutil.copy(FIXTURES / "seeded_fallback_device.py", target)
+    assert not _by_rule(_lint_file(target), "fallback-must-be-recorded")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all six rules demonstrably fire."""
+    """The acceptance invariant: all seven rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_fallback_device.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_python_branch.py"):
         seen.add(f.rule)
@@ -249,7 +273,7 @@ def test_cli_exits_one_on_seeded_fixture():
     assert "bitmask-via-helpers" in out.stdout
 
 
-def test_cli_list_rules_names_all_six():
+def test_cli_list_rules_names_all_rules():
     out = subprocess.run(
         [sys.executable, "-m", "tools.tpulint", "--list-rules"],
         capture_output=True, text=True, cwd=REPO, timeout=120)

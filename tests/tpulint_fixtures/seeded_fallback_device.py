@@ -1,0 +1,55 @@
+"""Seeded violations for fallback-must-be-recorded: device->host handoffs
+(an ``except ...Unsupported`` swallow, an explicit ``== "host"`` engine
+pin) that never call telemetry.record_fallback — the round-5 bug class
+where a perf regression was really a 100%-silent-fallback."""
+
+from spark_rapids_jni_tpu import telemetry
+
+
+class RegexUnsupported(ValueError):
+    pass
+
+
+def _device_run(pattern, col):
+    raise RegexUnsupported(pattern)
+
+
+def _host_run(pattern, col):
+    return [bool(p) for p in col]
+
+
+def silent_swallow(pattern, col):
+    try:
+        return _device_run(pattern, col)
+    except RegexUnsupported:              # VIOLATION: unrecorded fallback
+        return _host_run(pattern, col)
+
+
+def silent_host_pin(pattern, col, force=""):
+    if force == "host":                   # VIOLATION: unrecorded host pin
+        return _host_run(pattern, col)
+    return _device_run(pattern, col)
+
+
+def recorded_swallow(pattern, col):
+    try:
+        return _device_run(pattern, col)
+    except RegexUnsupported as exc:       # clean: fallback is accounted
+        telemetry.record_fallback(
+            "seeded_op", f"unsupported regex atom: {exc}", rows=len(col))
+        return _host_run(pattern, col)
+
+
+def recorded_host_pin(pattern, col, force=""):
+    if force == "host":                   # clean: pin is accounted
+        telemetry.record_fallback(
+            "seeded_op", "regex.force_engine=host pin", rows=len(col))
+        return _host_run(pattern, col)
+    return _device_run(pattern, col)
+
+
+def reraise_is_not_a_fallback(pattern, col):
+    try:
+        return _device_run(pattern, col)
+    except RegexUnsupported:              # clean: pure re-raise, no handoff
+        raise

@@ -1,4 +1,4 @@
-"""The six tpulint rules.
+"""The seven tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -523,6 +523,69 @@ def check_bitmask_helpers(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 7: fallback-must-be-recorded
+# ---------------------------------------------------------------------------
+
+def _calls_record_fallback(stmts) -> bool:
+    for s in stmts:
+        for n in ast.walk(s):
+            if (isinstance(n, ast.Call)
+                    and _unparse(n.func).endswith("record_fallback")):
+                return True
+    return False
+
+
+def check_fallback_recorded(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: the regex/cast dispatchers silently handed whole columns
+    to the host engine (ISSUE 2 motivation: round-5 could not say what ran
+    on device), so a perf regression that was really a 100%-fallback went
+    unexplained. In ops files (ops/*.py and any *_device.py), a device->host
+    handoff must be accounted: an ``except ...Unsupported`` handler, or an
+    explicit host-engine pin branch (``if <name> == "host":``), that does
+    not call ``telemetry.record_fallback(...)`` is a finding. A handler
+    whose body only re-raises is not a fallback and stays clean."""
+    if not (_is_device_file(ctx.name) or "/ops/" in ("/" + ctx.path)):
+        return []
+    out: List[RawFinding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler):
+            names = []
+            if node.type is not None:
+                for n in ast.walk(node.type):
+                    if isinstance(n, (ast.Name, ast.Attribute)):
+                        names.append(_unparse(n).split(".")[-1])
+            if not any(n.endswith("Unsupported") for n in names):
+                continue
+            if all(isinstance(s, ast.Raise) for s in node.body):
+                continue  # pure re-raise: not a fallback
+            if _calls_record_fallback(node.body):
+                continue
+            out.append(RawFinding(
+                node.lineno, node.col_offset,
+                "`except ...Unsupported` hands the column to the host "
+                "engine without telemetry.record_fallback(...): the "
+                "device/host split becomes invisible (the round-5 "
+                "silent-fallback bug class); record with a reason, or "
+                "re-raise"))
+        elif isinstance(node, ast.If):
+            test = node.test
+            if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+                    and isinstance(test.ops[0], ast.Eq)
+                    and isinstance(test.left, ast.Name)
+                    and any(isinstance(c, ast.Constant) and c.value == "host"
+                            for c in test.comparators)):
+                continue
+            if _calls_record_fallback(node.body):
+                continue
+            out.append(RawFinding(
+                node.lineno, node.col_offset,
+                "explicit host-engine branch (`== \"host\"`) without "
+                "telemetry.record_fallback(...): a forced host pin is "
+                "still a fallback the per-op accounting must see"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -546,4 +609,8 @@ RULES = [
          "validity masks come from counts or columnar/bitmask.py, not "
          "ad-hoc != 0 tests",
          check_bitmask_helpers),
+    Rule("fallback-must-be-recorded",
+         "except ...Unsupported handlers and explicit host-engine pins "
+         "in ops files must call telemetry.record_fallback(...)",
+         check_fallback_recorded),
 ]
