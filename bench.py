@@ -126,6 +126,44 @@ def _telemetry_summary(path: str | None) -> dict:
     return out
 
 
+def _dispatch_block() -> dict:
+    """The BENCH_*.json ``dispatch`` block: shape-bucketed executable-cache
+    counters for this process (compiles, hit rate, padded-waste fraction)
+    plus a first-call vs steady-state probe — 8 distinct row counts inside
+    one bucket dispatched through one op, so the first call pays the
+    (at most one) compile and every later call must be a cache hit. The
+    probe is tiny (<=1024 rows), so it cannot distort the measured
+    config's numbers; it runs after the config body."""
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    block: dict = {}
+    try:
+        import numpy as np
+
+        from spark_rapids_jni_tpu.columnar import Column
+        from spark_rapids_jni_tpu.ops import reduce as _reduce
+
+        # 8 row counts in (512, 1024] — one power-of-two bucket at the
+        # default base-16 schedule
+        times = []
+        for n in (513, 600, 700, 801, 900, 1000, 1023, 1024):
+            col = Column.from_numpy(np.arange(n, dtype=np.int64))
+            t0 = time.perf_counter()
+            total, _valid = _reduce.sum_(col)
+            float(total)
+            times.append(time.perf_counter() - t0)
+        block["probe_first_call_s"] = round(times[0], 6)
+        block["probe_steady_state_s"] = round(
+            sum(times[1:]) / len(times[1:]), 6)
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    try:
+        block.update(dispatch.stats())
+    except Exception:
+        pass
+    return block
+
+
 def _ledger_last(metric: str, n: int):
     """Most recent ledger record for ``metric`` under the current
     measurement tag — preferring an exact row-count match (throughput is
@@ -993,7 +1031,7 @@ def _child_main(config: str, n: int, iters: int) -> None:
 
         force_cpu_platform()
     value = _CONFIGS[config][0](n, iters)
-    print(json.dumps({"value": value}))
+    print(json.dumps({"value": value, "dispatch": _dispatch_block()}))
 
 
 # ---------------------------------------------------------------------------
@@ -1032,7 +1070,8 @@ def _probe_tpu(timeout_s: float) -> tuple[bool, str]:
 
 
 def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float):
-    """Run the bench in a subprocess; returns (value | None, diagnostic)."""
+    """Run the bench in a subprocess; returns (value | None, diagnostic,
+    dispatch block from the child's executable cache | None)."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CONFIG"] = config
@@ -1049,13 +1088,16 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
             env=env,
         )
     except subprocess.TimeoutExpired:
-        return None, f"{platform} bench timed out after {timeout_s:.0f}s"
+        return None, f"{platform} bench timed out after {timeout_s:.0f}s", None
     for line in reversed(out.stdout.strip().splitlines()):
         try:
-            return float(json.loads(line)["value"]), ""
+            rec = json.loads(line)
+            value = float(rec["value"])
         except (json.JSONDecodeError, KeyError, TypeError, ValueError):
             continue
-    return None, f"{platform} bench failed: {_tail(out)}"
+        disp = rec.get("dispatch") if isinstance(rec, dict) else None
+        return value, "", disp if isinstance(disp, dict) else None
+    return None, f"{platform} bench failed: {_tail(out)}", None
 
 
 def main() -> None:
@@ -1072,6 +1114,7 @@ def main() -> None:
         "measurement": _MEASUREMENT_TAG,
     }
     diagnostics: list[str] = []
+    child_disp = None
     # every run gets a telemetry file (children record through the package
     # via these env vars; the parent appends bench_stale events itself) —
     # restored afterwards so driving code / tests see their own env back
@@ -1109,7 +1152,8 @@ def main() -> None:
                 time.sleep(10)
                 ok, why = _probe_tpu(20)
             if ok:
-                value, why = _run_child(config, n, iters, "tpu", child_timeout)
+                value, why, child_disp = _run_child(
+                    config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
                     _ledger_append(
@@ -1149,7 +1193,8 @@ def main() -> None:
                     "ledger_n": led.get("n"), "requested_n": n,
                 })
         if value is None:
-            value, why = _run_child(config, n, iters, "cpu", child_timeout)
+            value, why, child_disp = _run_child(
+                config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
                 platform = "none"
@@ -1185,6 +1230,10 @@ def main() -> None:
         record["telemetry"] = _telemetry_summary(tpath)
     except Exception:  # the one-JSON-line contract beats a summary
         pass
+    # executable-cache accounting from the measured child process (the
+    # parent never imports jax, so it cannot produce these itself); an
+    # empty block records that no child delivered stats (timeout / stale)
+    record["dispatch"] = child_disp or {}
     if diagnostics:
         record["diagnostic"] = "; ".join(d for d in diagnostics if d)
     print(json.dumps(record))
@@ -1235,7 +1284,7 @@ def sweep() -> None:
             if config in single_size else sizes
         cfg_timeout = 240.0 if config == "tpch_q1_pallas" else timeout
         for n in cfg_sizes:
-            value, why = _run_child(config, n, iters, "tpu", cfg_timeout)
+            value, why, _disp = _run_child(config, n, iters, "tpu", cfg_timeout)
             line = {"config": config, "metric": metric, "n": n,
                     "value": value, "unit": unit, "device_kind": kind}
             if value is not None:

@@ -1,11 +1,12 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its seven invariant rules (host/device
+# tpulint (tools/tpulint) runs its eight invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
-# width, validity-mask derivation, fallback accounting) over the package
-# in fail-on-new-findings mode — the spark_rapids_jni_tpu glob below
-# covers the telemetry/ package alongside every other subpackage.
+# width, validity-mask derivation, fallback accounting, jit-via-dispatch)
+# over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
+# glob below covers the telemetry/ package alongside every other
+# subpackage.
 # Reviewed deliberate violations carry
 # `# tpulint: disable=<rule>` pragmas; pre-existing findings live in
 # tools/tpulint/baseline.txt (regenerate with
@@ -19,3 +20,26 @@ cd "$(dirname "$0")/.."
 test -d spark_rapids_jni_tpu/telemetry
 
 python -m tools.tpulint spark_rapids_jni_tpu bench.py tools
+
+# dispatch smoke: the jit-via-dispatch rule only proves ops ROUTE through
+# runtime/dispatch — this proves the cache actually coalesces shapes.
+# Two row counts in one bucket (513 and 1000 both pad to 1024) must
+# produce exactly ONE compile; a second compile means bucketing broke
+# and every distinct row count is back to paying full trace+compile.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops import reduce as red
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+
+for n in (513, 1000):
+    total, ok = red.sum_(Column.from_numpy(np.arange(n, dtype=np.int64)))
+    assert bool(ok) and int(total) == n * (n - 1) // 2, n
+
+compiles = REGISTRY.counter("dispatch.compile").value
+hits = REGISTRY.counter("dispatch.hit").value
+assert compiles == 1, f"expected 1 compile for one bucket, got {compiles}"
+assert hits == 1, f"expected 1 cache hit, got {hits}"
+print(f"dispatch smoke OK: 2 row counts, {compiles} compile, {hits} hit")
+EOF

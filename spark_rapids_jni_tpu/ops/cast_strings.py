@@ -281,14 +281,21 @@ def string_to_float(
 _MAX_I64_DIGITS = 20  # 19 digits + sign headroom
 
 
-@jax.jit
-def _digit_matrix_u64(mag: jnp.ndarray) -> jnp.ndarray:
-    """uint64[n] -> uint8[n, 20] decimal digits, most significant first."""
+def _digit_matrix_u64_impl(row_args, aux, rvs) -> jnp.ndarray:
+    ((mag,),) = row_args
     powers = jnp.asarray(
         [np.uint64(10) ** np.uint64(k) for k in range(_MAX_I64_DIGITS - 1, -1, -1)],
         dtype=jnp.uint64,
     )
     return ((mag[:, None] // powers[None, :]) % jnp.uint64(10)).astype(jnp.uint8)
+
+
+def _digit_matrix_u64(mag: jnp.ndarray) -> jnp.ndarray:
+    """uint64[n] -> uint8[n, 20] decimal digits, most significant first."""
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    return dispatch.rowwise("digit_matrix_u64", _digit_matrix_u64_impl,
+                            (mag,))
 
 
 def _signed_magnitude(v: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

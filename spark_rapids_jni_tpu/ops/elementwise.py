@@ -14,15 +14,23 @@ non-null; nullif(a, b) nulls where equal; greatest/least SKIP nulls
 (null only when every operand is null); unary math propagates nulls;
 pmod is null when the divisor is 0 (non-ANSI posture) or either side
 is null.
+
+Every public function here validates host-side, then routes its pure
+compute through ``runtime.dispatch`` (shape-bucketed executable cache):
+the ``_*_impl`` functions are the traced bodies. Padded tail rows
+arrive as NULL rows and are sliced off the output, so the impls never
+need the row_valid mask — elementwise ops are row-local.
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Sequence
 
 import jax.numpy as jnp
 
 from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.runtime import dispatch
 from spark_rapids_jni_tpu.types import DType, TypeId
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
@@ -42,12 +50,8 @@ def _same_dtypes(cols: Sequence[Column], op: str) -> None:
                 f"{cols[0].dtype}")
 
 
-@func_range("coalesce")
-def coalesce(cols: Sequence[Column]) -> Column:
-    """Spark ``coalesce``: per row, the first non-null operand."""
-    if not cols:
-        raise ValueError("coalesce needs at least one column")
-    _same_dtypes(cols, "coalesce")
+def _coalesce_impl(row_args, aux, rvs):
+    (cols,) = row_args
     first = cols[0]
     if first.dtype.is_string:
         from spark_rapids_jni_tpu.ops.strings import pad_to_common_width
@@ -74,13 +78,17 @@ def coalesce(cols: Sequence[Column]) -> Column:
     return Column(first.dtype, data, taken)
 
 
-@func_range("nullif")
-def nullif(a: Column, b: Column) -> Column:
-    """Spark ``nullif(a, b)``: a, nulled where a == b (null-safe: a null
-    pair does NOT null — Spark's NullIf uses EqualTo, null == null is
-    unknown, so a stays null anyway). Strings compare by padded bytes,
-    DECIMAL128 by limb pairs."""
-    _same_dtypes([a, b], "nullif")
+@func_range("coalesce")
+def coalesce(cols: Sequence[Column]) -> Column:
+    """Spark ``coalesce``: per row, the first non-null operand."""
+    if not cols:
+        raise ValueError("coalesce needs at least one column")
+    _same_dtypes(cols, "coalesce")
+    return dispatch.rowwise("coalesce", _coalesce_impl, tuple(cols))
+
+
+def _nullif_impl(row_args, aux, rvs):
+    ((a, b),) = row_args
     if a.dtype.is_string:
         from spark_rapids_jni_tpu.ops.strings import pad_to_common_width
 
@@ -98,13 +106,18 @@ def nullif(a: Column, b: Column) -> Column:
     return Column(a.dtype, a.data, a.valid_mask() & ~eq)
 
 
-def _nary_extremum(cols: Sequence[Column], op: str) -> Column:
-    if len(cols) < 2:
-        raise ValueError(f"{op} needs at least two columns")
-    _same_dtypes(cols, op)
-    for c in cols:
-        _check_numeric(c, op)
-    pick_max = op == "greatest"
+@func_range("nullif")
+def nullif(a: Column, b: Column) -> Column:
+    """Spark ``nullif(a, b)``: a, nulled where a == b (null-safe: a null
+    pair does NOT null — Spark's NullIf uses EqualTo, null == null is
+    unknown, so a stays null anyway). Strings compare by padded bytes,
+    DECIMAL128 by limb pairs."""
+    _same_dtypes([a, b], "nullif")
+    return dispatch.rowwise("nullif", _nullif_impl, (a, b))
+
+
+def _extremum_impl(row_args, aux, rvs, *, pick_max: bool):
+    (cols,) = row_args
     is_float = cols[0].dtype.storage_dtype.kind == "f"
 
     def key(x):
@@ -125,6 +138,18 @@ def _nary_extremum(cols: Sequence[Column], op: str) -> Column:
     return Column(cols[0].dtype, acc, have)
 
 
+def _nary_extremum(cols: Sequence[Column], op: str) -> Column:
+    if len(cols) < 2:
+        raise ValueError(f"{op} needs at least two columns")
+    _same_dtypes(cols, op)
+    for c in cols:
+        _check_numeric(c, op)
+    pick_max = op == "greatest"
+    return dispatch.rowwise(
+        op, partial(_extremum_impl, pick_max=pick_max), tuple(cols),
+        statics=(pick_max,))
+
+
 @func_range("greatest")
 def greatest(cols: Sequence[Column]) -> Column:
     """Spark ``greatest``: row-wise max, SKIPPING nulls (null only when
@@ -137,10 +162,15 @@ def least(cols: Sequence[Column]) -> Column:
     return _nary_extremum(cols, "least")
 
 
+def _abs_impl(row_args, aux, rvs):
+    ((col,),) = row_args
+    return Column(col.dtype, jnp.abs(col.data), col.validity)
+
+
 @func_range("abs_")
 def abs_(col: Column) -> Column:
     _check_numeric(col, "abs")
-    return Column(col.dtype, jnp.abs(col.data), col.validity)
+    return dispatch.rowwise("abs", _abs_impl, (col,))
 
 
 @func_range("ceil")
@@ -155,8 +185,8 @@ def floor(col: Column) -> Column:
     return _round_directed(col, up=False)
 
 
-def _round_directed(col: Column, up: bool) -> Column:
-    _check_numeric(col, "ceil/floor")
+def _round_directed_impl(row_args, aux, rvs, *, up: bool):
+    ((col,),) = row_args
     dt = col.dtype
     if dt.is_decimal:
         s = -dt.scale
@@ -180,20 +210,17 @@ def _round_directed(col: Column, up: bool) -> Column:
                   col.validity)
 
 
-@func_range("round_decimal")
-def round_decimal(col: Column, d: int = 0) -> Column:
-    """Spark ``round(decimal, d)`` with HALF_UP, EXACT integer
-    arithmetic: the unscaled value is divided by 10^(frac-d) with
-    away-from-zero tie rounding; the result keeps scale -d (Spark
-    narrows the scale). Non-decimal inputs are rejected — float round
-    belongs to jnp directly."""
+def _round_directed(col: Column, up: bool) -> Column:
+    _check_numeric(col, "ceil/floor")
+    return dispatch.rowwise(
+        "ceil" if up else "floor",
+        partial(_round_directed_impl, up=up), (col,), statics=(up,))
+
+
+def _round_decimal_impl(row_args, aux, rvs, *, d: int):
+    ((col,),) = row_args
     dt = col.dtype
-    if not dt.is_decimal or dt.is_decimal128:
-        raise TypeError(
-            f"round_decimal needs a DECIMAL32/64 column, got {dt}")
     frac = -dt.scale
-    if d >= frac:
-        return col  # nothing to drop
     pow10 = 10 ** (frac - d)
     v = col.data
     q = jnp.floor_divide(v, pow10)
@@ -212,16 +239,26 @@ def round_decimal(col: Column, d: int = 0) -> Column:
     return Column(out_dt, q.astype(dt.jnp_dtype), col.validity)
 
 
-@func_range("pmod")
-def pmod(a: Column, b: Column) -> Column:
-    """Spark ``pmod(a, b)``, bit-exact to its Java formula
-    ``r = a % n; if (r < 0) (r + n) % n else r`` with JAVA's
-    truncated-% (dividend sign) — for positive divisors that is the
-    usual [0, b) modulus; for negative divisors Spark's result keeps
-    the dividend-sign quirk, reproduced here rather than idealized.
-    Division by zero gives null (non-ANSI posture)."""
-    _same_dtypes([a, b], "pmod")
-    _check_numeric(a, "pmod")
+@func_range("round_decimal")
+def round_decimal(col: Column, d: int = 0) -> Column:
+    """Spark ``round(decimal, d)`` with HALF_UP, EXACT integer
+    arithmetic: the unscaled value is divided by 10^(frac-d) with
+    away-from-zero tie rounding; the result keeps scale -d (Spark
+    narrows the scale). Non-decimal inputs are rejected — float round
+    belongs to jnp directly."""
+    dt = col.dtype
+    if not dt.is_decimal or dt.is_decimal128:
+        raise TypeError(
+            f"round_decimal needs a DECIMAL32/64 column, got {dt}")
+    if d >= -dt.scale:
+        return col  # nothing to drop
+    return dispatch.rowwise(
+        "round_decimal", partial(_round_decimal_impl, d=d), (col,),
+        statics=(d,))
+
+
+def _pmod_impl(row_args, aux, rvs):
+    ((a, b),) = row_args
     zero = b.data == 0
     safe_b = jnp.where(zero, jnp.ones_like(b.data), b.data)
 
@@ -238,3 +275,16 @@ def pmod(a: Column, b: Column) -> Column:
     m = jnp.where(jt < 0, adj, jt)
     validity = a.valid_mask() & b.valid_mask() & ~zero
     return Column(a.dtype, m.astype(a.dtype.jnp_dtype), validity)
+
+
+@func_range("pmod")
+def pmod(a: Column, b: Column) -> Column:
+    """Spark ``pmod(a, b)``, bit-exact to its Java formula
+    ``r = a % n; if (r < 0) (r + n) % n else r`` with JAVA's
+    truncated-% (dividend sign) — for positive divisors that is the
+    usual [0, b) modulus; for negative divisors Spark's result keeps
+    the dividend-sign quirk, reproduced here rather than idealized.
+    Division by zero gives null (non-ANSI posture)."""
+    _same_dtypes([a, b], "pmod")
+    _check_numeric(a, "pmod")
+    return dispatch.rowwise("pmod", _pmod_impl, (a, b))

@@ -18,6 +18,7 @@ null.
 from __future__ import annotations
 
 import numbers
+from functools import partial
 from typing import NamedTuple, Sequence
 
 import jax
@@ -544,39 +545,27 @@ def _gather_group_keys(sorted_tbl: Table, keys: Sequence[int],
     return out_cols
 
 
-@func_range("groupby_aggregate")
-def groupby_aggregate(
-    table: Table,
-    keys: Sequence[int],
-    aggs: Sequence[tuple[int, str]],
-    max_groups: int | None = None,
-) -> GroupByResult:
-    """Group by `keys`; compute [(value_col, op)] aggregates.
-
-    Returns keys + one column per agg, in order, padded to ``max_groups``
-    rows (default: n, which can never overflow). A smaller ``max_groups``
-    bounds output memory for high-cardinality aggregation; if the true
-    group count exceeds it, rows of the excess groups are dropped and
-    ``overflowed`` is set so the host can grow and retry
-    (``groupby_aggregate_auto``).
-    """
-    for _, op in aggs:
-        if isinstance(op, tuple):
-            if (len(op) != 2 or op[0] not in SUPPORTED_BINARY_AGGS
-                    or not isinstance(op[1], numbers.Integral)
-                    or not 0 <= op[1] < table.num_columns):
-                raise ValueError(
-                    f"unsupported binary aggregation {op!r}; expected "
-                    f"(op, col_y) with op in {SUPPORTED_BINARY_AGGS} and "
-                    f"col_y a column index of the input table")
-        elif op not in SUPPORTED_AGGS:
-            raise ValueError(f"unsupported aggregation {op!r}")
+def _groupby_aggregate_impl(row_args, aux, rvs, *, keys, aggs,
+                            max_groups) -> GroupByResult:
+    ((table, row_valid),) = row_args
+    rv = row_valid
+    if rv is None and rvs is not None:
+        rv = rvs[0]
     n = table.num_rows
     m = n if max_groups is None else int(max_groups)
-    order = sort_order(table, keys)
+    order = sort_order(table, keys, row_valid=rv)
     sorted_tbl = gather(table, order)
 
     same = _rows_equal_prev(sorted_tbl, keys)
+    if rv is not None:
+        # phantom rows (bucketed padding tails / masked shuffle slots)
+        # sort LAST and never start a group: they merge into the final
+        # real group, where their all-null cells are neutral for every
+        # aggregate (sums add 0, counts skip, min/max see sentinels,
+        # first/last skip-null scans pass over them). The one positional
+        # exception, last_include_nulls, is kept off the bucketed path by
+        # the public wrapper (bucket_rows=False).
+        same = same | ~rv[order]
     # small-m boundary path: locate group starts with block popcounts and
     # defer (often skip entirely) the full-length group-id scan. Gated on
     # the boundary work (2*m*block rows) actually undercutting the scan.
@@ -1134,12 +1123,16 @@ def groupby_aggregate(
             col_idx2 = val_lane  # original column index stashed in plan
             nf = [True] * len(keys) + [False]
             order2 = sort_order(table, list(keys) + [col_idx2],
-                                nulls_first=nf)
+                                nulls_first=nf, row_valid=rv)
             sub = gather(
                 Table([table.column(k) for k in keys]
                       + [table.column(col_idx2)]), order2)
             kix = list(range(len(keys)))
             same_k = _rows_equal_prev(sub, kix)
+            if rv is not None:
+                # phantom rows merge into the last group here too, so
+                # gid2's group numbering stays aligned with gid's
+                same_k = same_k | ~rv[order2]
             vcol = sub.column(len(keys))
             vvalid2 = vcol.valid_mask()
             eqv = _col_values_equal_prev(vcol)
@@ -1242,6 +1235,57 @@ def groupby_aggregate(
 
     return GroupByResult(Table(out_cols), num_groups, overflowed,
                          sum128_overflow)
+
+
+@func_range("groupby_aggregate")
+def groupby_aggregate(
+    table: Table,
+    keys: Sequence[int],
+    aggs: Sequence[tuple[int, str]],
+    max_groups: int | None = None,
+    row_valid: jnp.ndarray | None = None,
+) -> GroupByResult:
+    """Group by `keys`; compute [(value_col, op)] aggregates.
+
+    Returns keys + one column per agg, in order, padded to ``max_groups``
+    rows (default: n, which can never overflow). A smaller ``max_groups``
+    bounds output memory for high-cardinality aggregation; if the true
+    group count exceeds it, rows of the excess groups are dropped and
+    ``overflowed`` is set so the host can grow and retry
+    (``groupby_aggregate_auto``).
+
+    Rows where ``row_valid`` is False are phantom rows (masked shuffle
+    slots): they contribute to no group and no aggregate.
+    """
+    for _, op in aggs:
+        if isinstance(op, tuple):
+            if (len(op) != 2 or op[0] not in SUPPORTED_BINARY_AGGS
+                    or not isinstance(op[1], numbers.Integral)
+                    or not 0 <= op[1] < table.num_columns):
+                raise ValueError(
+                    f"unsupported binary aggregation {op!r}; expected "
+                    f"(op, col_y) with op in {SUPPORTED_BINARY_AGGS} and "
+                    f"col_y a column index of the input table")
+        elif op not in SUPPORTED_AGGS:
+            raise ValueError(f"unsupported aggregation {op!r}")
+    keys_t = tuple(int(k) for k in keys)
+    aggs_t = tuple(
+        (int(c), (tuple(op) if isinstance(op, tuple) else op))
+        for c, op in aggs)
+    # last_include_nulls is POSITIONAL (the group's literal last row):
+    # a padded tail row would be that last row, so such plans run at
+    # exact shape (memoized, just not bucketed)
+    bucket = not any(op == "last_include_nulls" for _, op in aggs_t)
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    return dispatch.call(
+        "groupby_aggregate",
+        partial(_groupby_aggregate_impl, keys=keys_t, aggs=aggs_t,
+                max_groups=max_groups),
+        ((table, row_valid),),
+        statics=(keys_t, aggs_t, max_groups),
+        slice_rows=(max_groups is None),
+        bucket_rows=bucket)
 
 
 def groupby_aggregate_auto(

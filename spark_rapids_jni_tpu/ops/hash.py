@@ -18,6 +18,7 @@ normalized); decimal32/64 -> hashLong of the unscaled value.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Sequence
 
 import jax
@@ -138,6 +139,15 @@ def _column_hash(col: Column, seeds: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(col.validity, hashed, seeds)
 
 
+def _table_xxhash64_impl(row_args, aux, rvs, *, seed: int):
+    ((table,),) = row_args
+    n = table.num_rows
+    h = jnp.full((n,), np.uint64(seed), dtype=jnp.uint64)
+    for c in range(table.num_columns):
+        h = _column_hash(table.column(c), h)
+    return h.astype(jnp.int64)
+
+
 @func_range("hash_table")
 def table_xxhash64(
     table: Table,
@@ -148,12 +158,16 @@ def table_xxhash64(
     hash as seed (Spark HashExpression). Returns int64[n]. Spark-exact for
     every supported type, including DECIMAL128 (minimal two's-complement
     byte-array hash, the Decimal(precision > 18) rule)."""
-    cols = range(table.num_columns) if columns is None else columns
-    n = table.num_rows
-    h = jnp.full((n,), np.uint64(seed), dtype=jnp.uint64)
-    for c in cols:
-        h = _column_hash(table.column(c), h)
-    return h.astype(jnp.int64)
+    cols = tuple(range(table.num_columns) if columns is None else columns)
+    # dispatch only the hashed columns: an unused Arrow-layout string
+    # elsewhere in the table must not force the inline path (pad rows are
+    # null -> they pass the seed through, and the tail is sliced off)
+    sub = Table([table.column(c) for c in cols])
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    return dispatch.rowwise(
+        "table_xxhash64", partial(_table_xxhash64_impl, seed=seed),
+        (sub,), statics=(seed,))
 
 
 def partition_hash(table: Table, columns: Sequence[int], num_partitions: int) -> jnp.ndarray:

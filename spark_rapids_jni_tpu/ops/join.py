@@ -23,6 +23,7 @@ round-1 "pre-hash into one column" recipe this replaces).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple, Sequence
 
 import jax
@@ -240,6 +241,46 @@ def rank_encode_keys(
 _JOIN_TYPES = ("inner", "left", "left_semi", "left_anti", "right", "full")
 
 
+def _join_impl(row_args, aux_args, row_valids, *, lkeys, rkeys,
+               out_size, how) -> JoinMaps:
+    ((left, left_row_valid), (right, right_row_valid)) = row_args
+    if row_valids is not None:
+        # Row-dim padding happened: a caller-supplied row_valid was padded
+        # with False (phantom rows already excluded); with no caller mask
+        # the bucket mask itself marks the phantoms.
+        lrv, rrv = row_valids
+        if left_row_valid is None:
+            left_row_valid = lrv
+        if right_row_valid is None:
+            right_row_valid = rrv
+
+    lvalid = left.column(lkeys[0]).valid_mask()
+    for k in lkeys[1:]:
+        lvalid = lvalid & left.column(k).valid_mask()
+    rvalid = right.column(rkeys[0]).valid_mask()
+    for k in rkeys[1:]:
+        rvalid = rvalid & right.column(k).valid_mask()
+
+    lc = left.column(lkeys[0])
+    rc0 = right.column(rkeys[0])
+    single_integral = (
+        len(lkeys) == 1
+        and lc.dtype == rc0.dtype  # incl. decimal scale — unscaled values
+        and not lc.dtype.is_string  # only compare at identical scales
+        and not lc.dtype.is_decimal128  # limb pairs go via rank encoding
+        and lc.dtype.storage_dtype.kind in ("i", "u")
+    )
+    if single_integral:
+        # fast path: integral values are their own exact encoding
+        lkey, rkey = lc.data, rc0.data
+    else:
+        lkey, rkey = rank_encode_keys(left, right, list(lkeys), list(rkeys))
+    return _join_maps_impl(
+        lkey, lvalid, rkey, rvalid, out_size, how, left_row_valid,
+        right_row_valid,
+    )
+
+
 @func_range("join")
 def join(
     left: Table,
@@ -265,6 +306,14 @@ def join(
     ``right`` (inner + unmatched build rows with null left), ``full``
     (left + unmatched build rows with null left).
 
+    Runs through the shape-bucketed dispatch cache: each side's row count
+    is padded up to its own bucket, so nearby (n_left, n_right) pairs share
+    one executable per (out_size, how) instead of compiling per exact
+    shape. Phantom pad rows ride the existing ``*_row_valid`` contract and
+    emit nothing. The ``JoinMaps`` output is sized by ``out_size`` (a
+    static), never by the buckets, so no output slicing is needed; index
+    values in the ``~row_valid`` region are unspecified either way.
+
     SQL semantics: a NULL in ANY key column makes the row match nothing."""
     if how not in _JOIN_TYPES:
         raise ValueError(
@@ -273,31 +322,19 @@ def join(
     right_keys = [right_on] if isinstance(right_on, int) else list(right_on)
     if len(left_keys) != len(right_keys) or not left_keys:
         raise ValueError("left_on and right_on must be equal-length, non-empty")
+    lkeys_t = tuple(int(k) for k in left_keys)
+    rkeys_t = tuple(int(k) for k in right_keys)
+    out_size = int(out_size)
 
-    lvalid = left.column(left_keys[0]).valid_mask()
-    for k in left_keys[1:]:
-        lvalid = lvalid & left.column(k).valid_mask()
-    rvalid = right.column(right_keys[0]).valid_mask()
-    for k in right_keys[1:]:
-        rvalid = rvalid & right.column(k).valid_mask()
+    from spark_rapids_jni_tpu.runtime import dispatch
 
-    lc = left.column(left_keys[0])
-    rc0 = right.column(right_keys[0])
-    single_integral = (
-        len(left_keys) == 1
-        and lc.dtype == rc0.dtype  # incl. decimal scale — unscaled values
-        and not lc.dtype.is_string  # only compare at identical scales
-        and not lc.dtype.is_decimal128  # limb pairs go via rank encoding
-        and lc.dtype.storage_dtype.kind in ("i", "u")
-    )
-    if single_integral:
-        # fast path: integral values are their own exact encoding
-        lkey, rkey = lc.data, rc0.data
-    else:
-        lkey, rkey = rank_encode_keys(left, right, left_keys, right_keys)
-    return _join_maps_impl(
-        lkey, lvalid, rkey, rvalid, out_size, how, left_row_valid,
-        right_row_valid,
+    return dispatch.call(
+        "join",
+        partial(_join_impl, lkeys=lkeys_t, rkeys=rkeys_t,
+                out_size=out_size, how=how),
+        ((left, left_row_valid), (right, right_row_valid)),
+        statics=(lkeys_t, rkeys_t, out_size, how),
+        slice_rows=False,
     )
 
 

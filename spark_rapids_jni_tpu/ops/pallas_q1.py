@@ -132,6 +132,10 @@ def _q1_kernel(qty_ref, price_ref, disc_ref, tax_ref, ship_ref, rf_ref,
     out_ref[0] = acc
 
 
+# deliberate jit: inputs are already _BLOCK-quantized by the caller, so
+# row counts collapse to block multiples and the Pallas grid is
+# specialized per shape anyway.
+# tpulint: disable=jit-via-dispatch
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _q1_pallas_partials(qty, price, disc, tax, ship, rf, ls,
                         interpret: bool = False):

@@ -1,14 +1,24 @@
 """Column-level scalar reductions — cuDF ``reduce`` parity (SUM/MIN/MAX/
 MEAN/COUNT with SQL null semantics: nulls skipped; an all-null column's
 SUM/MIN/MAX/MEAN is null). Fully jittable; each op returns
-(value, valid) device scalars so callers compose without host syncs."""
+(value, valid) device scalars so callers compose without host syncs.
+
+Reductions route through ``runtime.dispatch`` with padded tail rows as
+NULL rows — every path already neutralizes nulls (sums add 0, min/max
+see sentinels, the string/decimal128 sort path ranks nulls last, counts
+skip them), so a bucketed reduction is bit-identical to the exact-shape
+one. Outputs are scalars (or (2,) limb pairs), so ``slice_rows=False``.
+"""
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.runtime import dispatch
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 
@@ -17,16 +27,20 @@ def _masked(col: Column, neutral):
     return jnp.where(valid, col.data, jnp.asarray(neutral, col.data.dtype)), valid
 
 
-@func_range("reduce_count")
-def count(col: Column) -> jnp.ndarray:
-    """Non-null count (always valid)."""
+def _count_impl(row_args, aux, rvs):
+    ((col,),) = row_args
     return jnp.sum(col.valid_mask()).astype(jnp.int64)
 
 
-@func_range("reduce_sum")
-def sum_(col: Column):
-    """(sum, valid): int/decimal accumulate in int64 (exact); floats in
-    their own dtype. DECIMAL128 sums limb-exactly (carry recombination)."""
+@func_range("reduce_count")
+def count(col: Column) -> jnp.ndarray:
+    """Non-null count (always valid)."""
+    return dispatch.rowwise("reduce_count", _count_impl, (col,),
+                            slice_rows=False)
+
+
+def _sum_impl(row_args, aux, rvs):
+    ((col,),) = row_args
     valid = col.valid_mask()
     has_any = jnp.any(valid)
     if col.dtype.is_decimal128:
@@ -52,7 +66,16 @@ def sum_(col: Column):
     return jnp.sum(vals), has_any
 
 
-def _minmax(col: Column, op: str):
+@func_range("reduce_sum")
+def sum_(col: Column):
+    """(sum, valid): int/decimal accumulate in int64 (exact); floats in
+    their own dtype. DECIMAL128 sums limb-exactly (carry recombination)."""
+    return dispatch.rowwise("reduce_sum", _sum_impl, (col,),
+                            slice_rows=False)
+
+
+def _minmax_impl(row_args, aux, rvs, *, op: str):
+    ((col,),) = row_args
     if col.dtype.is_string or col.dtype.is_decimal128:
         # order statistics via one sort: the winner is row 0 / row n-1 of
         # the nulls-last order (rank trick without the groupby machinery)
@@ -62,7 +85,6 @@ def _minmax(col: Column, op: str):
         order = sort_order(Table([col]), [0], nulls_first=[False])
         valid = col.valid_mask()
         has_any = jnp.any(valid)
-        n = col.size
         pos = jnp.where(
             jnp.asarray(op == "min"), 0,
             jnp.maximum(jnp.sum(valid).astype(jnp.int32) - 1, 0),
@@ -80,6 +102,12 @@ def _minmax(col: Column, op: str):
     return red, jnp.any(valid)
 
 
+def _minmax(col: Column, op: str):
+    return dispatch.rowwise(
+        f"reduce_{op}", partial(_minmax_impl, op=op), (col,),
+        statics=(op,), slice_rows=False)
+
+
 @func_range("reduce_min")
 def min_(col: Column):
     return _minmax(col, "min")
@@ -90,23 +118,31 @@ def max_(col: Column):
     return _minmax(col, "max")
 
 
+def _mean_impl(row_args, aux, rvs):
+    (group,) = row_args
+    (col,) = group
+    if col.dtype.is_decimal128:
+        from spark_rapids_jni_tpu.ops.groupby import _mean128_exact
+
+        total, has_any = _sum_impl(row_args, aux, rvs)  # (2,) limbs, exact
+        cnt = _count_impl(row_args, aux, rvs)
+        limbs, overflow = _mean128_exact(
+            total[0:1], total[1:2], cnt.reshape(1))
+        return limbs[0], has_any & ~overflow[0]
+    total, has_any = _sum_impl(row_args, aux, rvs)
+    denom = jnp.maximum(_count_impl(row_args, aux, rvs), 1).astype(
+        jnp.float64)
+    m = total.astype(jnp.float64) / denom
+    if col.dtype.is_decimal:
+        m = m * (10.0 ** col.dtype.scale)
+    return m, has_any
+
+
 @func_range("reduce_mean")
 def mean(col: Column):
     """(mean, valid). Floats/ints/decimal64 return FLOAT64 rescaled to the
     true value (the groupby mean contract); DECIMAL128 returns EXACT
     (2,)-limb unscaled value at 4 extra fractional digits via the same
     integer long-division path the groupby uses — no f64 anywhere."""
-    if col.dtype.is_decimal128:
-        from spark_rapids_jni_tpu.ops.groupby import _mean128_exact
-
-        total, has_any = sum_(col)  # (2,) int64 limbs, exact
-        cnt = count(col)
-        limbs, overflow = _mean128_exact(
-            total[0:1], total[1:2], cnt.reshape(1))
-        return limbs[0], has_any & ~overflow[0]
-    total, has_any = sum_(col)
-    denom = jnp.maximum(count(col), 1).astype(jnp.float64)
-    m = total.astype(jnp.float64) / denom
-    if col.dtype.is_decimal:
-        m = m * (10.0 ** col.dtype.scale)
-    return m, has_any
+    return dispatch.rowwise("reduce_mean", _mean_impl, (col,),
+                            slice_rows=False)

@@ -40,6 +40,7 @@ engine (SURVEY.md section 2.2).
 from __future__ import annotations
 
 import functools
+from functools import partial
 from typing import NamedTuple, Optional
 
 import jax
@@ -482,12 +483,8 @@ def _first_match(chars: jnp.ndarray, comp: CompiledLinear,
                        jnp.stack(ends, axis=1))
 
 
-@func_range("regexp_extract_device")
-def extract_device(chars: jnp.ndarray, comp: CompiledLinear,
-                   group: int) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """(lengths int32[n], out_chars uint8[n, W]) for Spark
-    regexp_extract semantics: group'th capture of the first match, ''
-    on no-match. ``group`` 0 = the whole match."""
+def _extract_impl(row_args, aux, rvs, *, comp: CompiledLinear, group: int):
+    ((chars,),) = row_args
     lin = comp.pattern
     n, w = chars.shape
     feas = [_feasibility(chars, tbl, acc) for tbl, acc in comp.suffix_dfas]
@@ -514,16 +511,32 @@ def extract_device(chars: jnp.ndarray, comp: CompiledLinear,
     return lengths, out
 
 
-@func_range("regexp_replace_device")
-def replace_device(chars: jnp.ndarray, lengths: jnp.ndarray,
-                   comp: CompiledLinear, replacement: bytes,
-                   max_matches: int = 8):
-    """Replace ALL matches with a literal replacement, Java semantics
-    (left-to-right non-overlapping; an empty match advances the cursor
-    by one). Returns (out_lengths, out_chars, overflowed) —
-    ``overflowed`` True for any row with matches beyond ``max_matches``
-    rounds (the dispatcher's host-recompute signal).
-    """
+@func_range("regexp_extract_device")
+def extract_device(chars: jnp.ndarray, comp: CompiledLinear,
+                   group: int, dispatch_key: str | None = None
+                   ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(lengths int32[n], out_chars uint8[n, W]) for Spark
+    regexp_extract semantics: group'th capture of the first match, ''
+    on no-match. ``group`` 0 = the whole match.
+
+    ``dispatch_key`` (the source pattern string) routes the pass through
+    the bucketed executable cache: the suffix-DFA tables are baked into
+    the trace as constants, so the pattern's identity — which ``comp``
+    itself cannot provide stably — must key the executable. None skips
+    dispatch (direct trace, for callers already inside a jit)."""
+    if dispatch_key is None:
+        return _extract_impl(((chars,),), (), None, comp=comp, group=group)
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    return dispatch.rowwise(
+        "regexp_extract",
+        partial(_extract_impl, comp=comp, group=group),
+        (chars,), statics=("extract", dispatch_key, group))
+
+
+def _replace_impl(row_args, aux, rvs, *, comp: CompiledLinear,
+                  replacement: bytes, max_matches: int):
+    ((chars, lengths),) = row_args
     lin = comp.pattern
     n, w = chars.shape
     feas = [_feasibility(chars, tbl, acc) for tbl, acc in comp.suffix_dfas]
@@ -584,3 +597,34 @@ def replace_device(chars: jnp.ndarray, lengths: jnp.ndarray,
     out, out_pos = paste_input(out, out_pos, prev_e,
                                (lengths - prev_e).astype(jnp.int32))
     return out_pos, out, overflowed
+
+
+@func_range("regexp_replace_device")
+def replace_device(chars: jnp.ndarray, lengths: jnp.ndarray,
+                   comp: CompiledLinear, replacement: bytes,
+                   max_matches: int = 8,
+                   dispatch_key: str | None = None):
+    """Replace ALL matches with a literal replacement, Java semantics
+    (left-to-right non-overlapping; an empty match advances the cursor
+    by one). Returns (out_lengths, out_chars, overflowed) —
+    ``overflowed`` True for any row with matches beyond ``max_matches``
+    rounds (the dispatcher's host-recompute signal).
+
+    ``dispatch_key`` (the source pattern string) keys the bucketed
+    executable cache, same contract as ``extract_device``. Padded tail
+    rows have zero chars/lengths: their first empty match parks the
+    cursor past the row, so they can neither overflow nor affect real
+    rows, and their output slots are sliced off."""
+    if dispatch_key is None:
+        return _replace_impl(
+            ((chars, lengths),), (), None, comp=comp,
+            replacement=replacement, max_matches=max_matches)
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    return dispatch.call(
+        "regexp_replace",
+        partial(_replace_impl, comp=comp, replacement=replacement,
+                max_matches=max_matches),
+        ((chars, lengths),),
+        statics=("replace", dispatch_key, replacement, max_matches),
+        slice_rows=True)

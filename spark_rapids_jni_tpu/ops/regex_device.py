@@ -37,6 +37,7 @@ same two-engine posture as get_json_object.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -554,6 +555,22 @@ def _compile_pattern_cached(pattern: str) -> CompiledRegex:
 # ---------------------------------------------------------------------------
 
 
+def _run_dfa_impl(row_args, aux, rvs, *, ensure_sentinel: bool):
+    ((chars,),) = row_args
+    table, accept = aux
+    n, w = chars.shape
+    if ensure_sentinel:
+        chars = jnp.concatenate(
+            [chars, jnp.zeros((n, 1), jnp.uint8)], axis=1)
+
+    def step(state, col):
+        return table[state * 256 + col.astype(jnp.int32)], None
+
+    init = jnp.zeros((n,), jnp.int32)
+    final_state, _ = jax.lax.scan(step, init, chars.T)
+    return accept[final_state]
+
+
 @func_range("regex_device_match")
 def run_dfa(chars: jnp.ndarray, compiled: CompiledRegex,
             ensure_sentinel: bool = True) -> jnp.ndarray:
@@ -564,17 +581,17 @@ def run_dfa(chars: jnp.ndarray, compiled: CompiledRegex,
     Every row must end in a 0x00 sentinel; callers that KNOW the widest
     row leaves padding slack (max length < W) pass
     ``ensure_sentinel=False`` to skip the defensive extra zero column
-    (an O(n*W) copy otherwise)."""
-    n, w = chars.shape
-    if ensure_sentinel:
-        chars = jnp.concatenate(
-            [chars, jnp.zeros((n, 1), jnp.uint8)], axis=1)
-    table = jnp.asarray(compiled.table)
-    accept = jnp.asarray(compiled.accept)
+    (an O(n*W) copy otherwise).
 
-    def step(state, col):
-        return table[state * 256 + col.astype(jnp.int32)], None
+    The DFA table/accept arrays are dispatch *aux* inputs — traced, not
+    baked — so every pattern with the same state count and row width
+    shares one bucketed executable (padded tail rows are all-zero and
+    sliced off)."""
+    from spark_rapids_jni_tpu.runtime import dispatch
 
-    init = jnp.zeros((n,), jnp.int32)
-    final_state, _ = jax.lax.scan(step, init, chars.T)
-    return accept[final_state]
+    return dispatch.rowwise(
+        "regex_run_dfa",
+        partial(_run_dfa_impl, ensure_sentinel=ensure_sentinel),
+        (chars,),
+        (jnp.asarray(compiled.table), jnp.asarray(compiled.accept)),
+        statics=(ensure_sentinel,))

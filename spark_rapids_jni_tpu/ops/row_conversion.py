@@ -133,8 +133,8 @@ def _to_rows_impl(
     return jnp.concatenate(pieces, axis=1)
 
 
-@partial(jax.jit, static_argnames=("schema",))
-def _to_rows_jit(datas, valids, schema):
+def _to_rows_dispatch(row_args, aux, rvs, *, schema):
+    ((datas, valids),) = row_args
     return _to_rows_impl(datas, valids, schema)
 
 
@@ -160,7 +160,12 @@ def convert_to_rows(
 
     datas = [c.data for c in table.columns]
     valids = [c.valid_mask() for c in table.columns]
-    rows = _to_rows_jit(datas, valids, schema)  # (n, size_per_row)
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    # padded tail rows pack to all-zero row images and are sliced off
+    rows = dispatch.rowwise(
+        "convert_to_rows", partial(_to_rows_dispatch, schema=schema),
+        (datas, valids), statics=(schema,))  # (n, size_per_row)
 
     num_rows = table.num_rows
     max_rows_per_batch = (INT32_MAX // size_per_row) // 32 * 32
@@ -173,10 +178,11 @@ def convert_to_rows(
 
 
 def _from_rows_impl(
-    flat: jnp.ndarray, schema: tuple[DType, ...]
+    rows: jnp.ndarray, schema: tuple[DType, ...]
 ) -> tuple[list[jnp.ndarray], list[jnp.ndarray]]:
+    """Jittable core over the 2-D row image uint8[n, size_per_row]."""
     column_start, column_size, size_per_row = compute_fixed_width_layout(schema)
-    rows = flat.reshape(-1, size_per_row)
+    rows = rows.reshape(-1, size_per_row)
     datas, valids = [], []
     vld_base = column_start[-1] + column_size[-1] if schema else 0
     for i, dt in enumerate(schema):
@@ -187,9 +193,9 @@ def _from_rows_impl(
     return datas, valids
 
 
-@partial(jax.jit, static_argnames=("schema",))
-def _from_rows_jit(flat, schema):
-    return _from_rows_impl(flat, schema)
+def _from_rows_dispatch(row_args, aux, rvs, *, schema):
+    ((rows,),) = row_args
+    return _from_rows_impl(rows, schema)
 
 
 @func_range("convert_from_rows")
@@ -205,7 +211,12 @@ def convert_from_rows(rows: RowsColumn, schema: Sequence[DType]) -> Table:
     _, _, size_per_row = compute_fixed_width_layout(schema_t)
     if size_per_row != rows.row_size or rows.data.shape[0] != rows.num_rows * size_per_row:
         raise ValueError("The layout of the data appears to be off")
-    datas, valids = _from_rows_jit(rows.data, schema_t)
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    rows2d = rows.data.reshape(rows.num_rows, size_per_row)
+    datas, valids = dispatch.rowwise(
+        "convert_from_rows", partial(_from_rows_dispatch, schema=schema_t),
+        (rows2d,), statics=(schema_t,))
     return Table(
         [Column(dt, d, v) for dt, d, v in zip(schema_t, datas, valids)]
     )

@@ -13,6 +13,7 @@ bitwise — documented deviation from Java's Double.compare only for -0.0).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Sequence
 
 import jax
@@ -164,27 +165,51 @@ def _pack_lex_keys(lex_keys: list[jnp.ndarray]) -> list[jnp.ndarray]:
     raise AssertionError("unreachable: total > 32 must split")
 
 
+def _sort_order_impl(row_args, aux, rvs, *, keys, ascending, nulls_first):
+    ((table, row_valid),) = row_args
+    # phantom rows (padded tails, masked shuffle slots): rank them AFTER
+    # every real row with one extra most-significant key; the sort is
+    # stable, so the leading entries are exactly the real rows' stable
+    # permutation — bit-identical to the unpadded sort after slicing.
+    rv = row_valid
+    if rv is None and rvs is not None:
+        rv = rvs[0]
+    lex_keys: list[jnp.ndarray] = []
+    # jnp.lexsort treats the LAST key as primary; build minor -> major.
+    for k, asc, nf in zip(reversed(list(keys)), reversed(list(ascending)),
+                          reversed(list(nulls_first))):
+        lex_keys.extend(_key_arrays(table.column(k), asc, nf))
+    if rv is not None:
+        lex_keys.append(jnp.where(rv, jnp.uint8(0), jnp.uint8(1)))
+    lex_keys = _pack_lex_keys(lex_keys)
+    if len(lex_keys) == 1:
+        return jnp.argsort(lex_keys[0], stable=True).astype(jnp.int32)
+    return jnp.lexsort(tuple(lex_keys)).astype(jnp.int32)
+
+
 @func_range("sort_order")
 def sort_order(
     table: Table,
     keys: Sequence[int],
     ascending: Sequence[bool] | None = None,
     nulls_first: Sequence[bool] | None = None,
+    row_valid: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Stable sort permutation (int32) ordering rows by the key columns."""
+    """Stable sort permutation (int32) ordering rows by the key columns.
+    Rows where ``row_valid`` is False sort after every real row (used by
+    callers that carry phantom rows, e.g. bounded shuffles)."""
     if ascending is None:
         ascending = [True] * len(keys)
     if nulls_first is None:
         nulls_first = [True] * len(keys)
-    lex_keys: list[jnp.ndarray] = []
-    # jnp.lexsort treats the LAST key as primary; build minor -> major.
-    for k, asc, nf in zip(reversed(list(keys)), reversed(list(ascending)),
-                          reversed(list(nulls_first))):
-        lex_keys.extend(_key_arrays(table.column(k), asc, nf))
-    lex_keys = _pack_lex_keys(lex_keys)
-    if len(lex_keys) == 1:
-        return jnp.argsort(lex_keys[0], stable=True).astype(jnp.int32)
-    return jnp.lexsort(tuple(lex_keys)).astype(jnp.int32)
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    return dispatch.call(
+        "sort_order",
+        partial(_sort_order_impl, keys=tuple(keys),
+                ascending=tuple(ascending), nulls_first=tuple(nulls_first)),
+        ((table, row_valid),),
+        statics=(tuple(keys), tuple(ascending), tuple(nulls_first)))
 
 
 def gather(table: Table, indices: jnp.ndarray) -> Table:

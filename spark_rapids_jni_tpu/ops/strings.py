@@ -841,7 +841,8 @@ def regexp_extract(col: Column, pattern: str, group: int = 1) -> Column:
     if comp is not None:
         from spark_rapids_jni_tpu.ops import regex_capture_device as rc
 
-        lengths, chars = rc.extract_device(pc.chars, comp, group)
+        lengths, chars = rc.extract_device(pc.chars, comp, group,
+                                           dispatch_key=pattern)
         return Column(STRING, lengths, pc.validity, chars=chars)
 
     def ext(r, v):
@@ -884,7 +885,8 @@ def regexp_replace(col: Column, pattern: str, replacement: str) -> Column:
             from spark_rapids_jni_tpu.ops import regex_capture_device as rc
 
             out_len, out_chars, overflowed = rc.replace_device(
-                pc.chars, pc.data, comp, replacement.encode())
+                pc.chars, pc.data, comp, replacement.encode(),
+                dispatch_key=pattern)
             if not bool(overflowed):
                 return Column(STRING, out_len, pc.validity,
                               chars=out_chars)

@@ -28,6 +28,14 @@ from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 
+def _mesh_fingerprint(mesh: Mesh) -> tuple:
+    """Hashable mesh identity for the dispatch executable cache: axis
+    layout plus the concrete device assignment — a compiled shard_map
+    program is specialized to both."""
+    return (tuple(mesh.shape.items()),
+            tuple(str(d) for d in mesh.devices.flat))
+
+
 def head_table(table: Table, k: int) -> Table:
     """First k rows (static slice) — groupby outputs put real groups first."""
     cols = []
@@ -212,9 +220,13 @@ def distributed_groupby_aggregate(
     ``table`` must already be sharded row-wise over ``mesh`` (shard_table).
     """
     aggs = list(aggs)
+    aggs_fp = tuple(
+        (int(c), tuple(op) if isinstance(op, tuple) else op)
+        for c, op in aggs)
     return _distributed_groupby(
         table, list(keys), mesh, capacity,
-        lambda sh_tbl, ks: groupby_aggregate(sh_tbl, ks, aggs))
+        lambda sh_tbl, ks: groupby_aggregate(sh_tbl, ks, aggs),
+        cache_key=("aggregate", aggs_fp))
 
 
 class DistributedBoundedGroupBy(NamedTuple):
@@ -324,19 +336,36 @@ def distributed_groupby_bounded(
         row_valid = jax.device_put(
             jnp.ones((table.num_rows,), jnp.bool_),
             NamedSharding(mesh, P(EXEC_AXIS)))
-    out_tbl, present, miss = jax.shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
-        out_specs=(P(), P(), P()),
-    )(table, row_valid)
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    out_tbl, present, miss = dispatch.sharded_call(
+        "distributed_groupby_bounded",
+        lambda: jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+            out_specs=(P(), P(), P()),
+        ),
+        (table, row_valid),
+        statics=(tuple(keys),
+                 tuple((int(c), op) for c, op in aggs),
+                 tuple((tuple(d.values), d.kind) for d in domains),
+                 int(budget), _mesh_fingerprint(mesh)),
+    )
     return DistributedBoundedGroupBy(out_tbl, present, miss)
 
 
-def _distributed_groupby(table, keys, mesh, capacity, local_groupby):
+def _distributed_groupby(table, keys, mesh, capacity, local_groupby,
+                         cache_key=None):
     """Shared shuffle-then-local-groupby scaffold: hash-exchange rows so
     each device owns whole key groups, run ``local_groupby(shuffled_table,
-    keys)`` per device, and pack the sharded GroupByResult."""
+    keys)`` per device, and pack the sharded GroupByResult.
+
+    ``cache_key`` is a hashable fingerprint of everything ``local_groupby``
+    closes over (agg list, percentile qs, ...) — the dispatch executable
+    cache keys on it, NOT on the closure's identity. ``None`` means the
+    closure is opaque: fall back to an uncached shard_map call rather than
+    risk serving a stale executable for different closure contents."""
 
     def step(local: Table):
         sh = hash_shuffle(local, keys, EXEC_AXIS, capacity=capacity)
@@ -345,12 +374,25 @@ def _distributed_groupby(table, keys, mesh, capacity, local_groupby):
                 sh.overflowed.reshape(1),
                 jnp.asarray(res.sum_overflow).reshape(1))
 
-    out_tbl, num_groups, overflowed, sum_overflow = jax.shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(P(EXEC_AXIS),),
-        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
-    )(table)
+    def build():
+        return jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(EXEC_AXIS),),
+            out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS),
+                       P(EXEC_AXIS)),
+        )
+
+    if cache_key is None:
+        out_tbl, num_groups, overflowed, sum_overflow = build()(table)
+    else:
+        from spark_rapids_jni_tpu.runtime import dispatch
+
+        out_tbl, num_groups, overflowed, sum_overflow = dispatch.sharded_call(
+            "distributed_groupby", build, (table,),
+            statics=(tuple(keys), capacity, cache_key,
+                     _mesh_fingerprint(mesh)),
+        )
     return DistributedGroupBy(out_tbl, num_groups, overflowed, sum_overflow)
 
 
@@ -371,7 +413,8 @@ def distributed_groupby_percentile(
     qs = [float(q) for q in qs]
     return _distributed_groupby(
         table, list(keys), mesh, capacity,
-        lambda sh_tbl, ks: groupby_percentile(sh_tbl, ks, value_col, qs))
+        lambda sh_tbl, ks: groupby_percentile(sh_tbl, ks, value_col, qs),
+        cache_key=("percentile", int(value_col), tuple(qs)))
 
 
 @jax.jit
@@ -541,12 +584,20 @@ def distributed_window(
         return (sh.table, Table(out_cols), sh.row_valid,
                 sh.overflowed.reshape(1))
 
-    out_tbl, results, rv, ovf = jax.shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
-        out_specs=(P(EXEC_AXIS),) * 4,
-    )(table, row_valid)
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    out_tbl, results, rv, ovf = dispatch.sharded_call(
+        "distributed_window",
+        lambda: jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+            out_specs=(P(EXEC_AXIS),) * 4,
+        ),
+        (table, row_valid),
+        statics=(tuple(pkeys), tuple(okeys), tuple(specs), capacity,
+                 _mesh_fingerprint(mesh)),
+    )
     return DistributedWindow(out_tbl, results, rv, ovf)
 
 
@@ -608,12 +659,22 @@ def distributed_join(
         left_row_valid = jnp.ones((left.num_rows,), jnp.bool_)
     if right_row_valid is None:
         right_row_valid = jnp.ones((right.num_rows,), jnp.bool_)
-    out, total, overflowed = jax.shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
-        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
-    )(left, right, left_row_valid, right_row_valid)
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    out, total, overflowed = dispatch.sharded_call(
+        "distributed_join",
+        lambda: jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS),
+                      P(EXEC_AXIS)),
+            out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+        ),
+        (left, right, left_row_valid, right_row_valid),
+        statics=(tuple(left_keys), tuple(right_keys),
+                 int(out_size_per_device), how, left_capacity,
+                 right_capacity, _mesh_fingerprint(mesh)),
+    )
     return DistributedJoin(out, total, overflowed)
 
 
@@ -654,7 +715,9 @@ def distributed_groupby_collect(
         # overflow flags are static False — collect has no max_groups)
         return GroupByResult(res.table, res.num_groups)
 
-    dist = _distributed_groupby(table, ks, mesh, capacity, local_collect)
+    dist = _distributed_groupby(
+        table, ks, mesh, capacity, local_collect,
+        cache_key=("collect", int(value_col), bool(distinct)))
     out_tbl, ngs, ovf = dist.table, dist.num_groups, dist.overflowed
     d = int(np.prod(list(mesh.shape.values())))
     counts = np.asarray(ngs).reshape(-1)

@@ -136,7 +136,14 @@ def shuffle_by_partition(
     D = jax.lax.axis_size(axis_name)
     n = table.num_rows
     if capacity is None:
-        capacity = max(1, math.ceil(n / D) * 2)
+        # Bucket-quantize the derived capacity so nearby batch sizes trace
+        # to the same (D, capacity) exchange shapes and share executables
+        # (extra slots are row_valid=False padding downstream already
+        # skips). Caller-specified capacities are honored exactly — they
+        # are part of the caller's planned output contract.
+        from spark_rapids_jni_tpu.runtime import dispatch
+
+        capacity = dispatch.quantize_capacity(max(1, math.ceil(n / D) * 2))
 
     # Sort rows by destination partition; compute each row's slot within
     # its partition run. Stable sort keeps within-partition input order.

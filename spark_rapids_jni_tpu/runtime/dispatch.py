@@ -1,0 +1,511 @@
+"""Shape-bucketed dispatch & executable cache for the device op path.
+
+The reference launches per-shape CUDA kernels, so a new batch size costs a
+kernel *launch*; under XLA a new batch size costs a *retrace and recompile*
+— orders of magnitude more. This layer closes that gap the way TPU serving
+stacks do (pad ragged batches to a small set of canonical shapes): the
+leading row dimension of every device-op input is padded up to a bucket
+from a geometric schedule, an explicit ``row_valid`` mask (the ``n_valid``
+scalar in vector form) keeps padded tail rows out of results and
+reductions, and the compiled executable is memoized under
+``(op, statics digest, leaf shapes/dtypes/shardings, backend)`` so every
+batch size inside a bucket reuses one executable.
+
+Compilation is explicit — ``jax.jit(fn).lower(args).compile()`` — rather
+than delegated to jit's internal cache, so compiles and hits are exact,
+countable events (telemetry counters ``dispatch.compile`` /
+``dispatch.hit``; ``dispatch.padded_waste_bytes`` accounts the padding
+tax). JAX's persistent compilation cache is wired from
+``SPARK_RAPIDS_TPU_DISPATCH_CACHE`` (or ``dispatch.persistent_cache_dir``)
+so steady-state runs start warm across processes.
+
+Fail-safe posture: anything this layer cannot bucket or compile — tracer
+inputs (the op is already inside a caller's trace), Arrow-layout strings,
+nested columns, zero-row batches, lowering errors — falls back to calling
+the op's implementation directly, with the reason counted. Dispatch must
+never change what an op computes, only how often XLA compiles it.
+
+Config knobs (utils/config.py): ``dispatch.enabled``,
+``dispatch.bucket_base``, ``dispatch.max_waste_frac``,
+``dispatch.persistent_cache_dir``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.telemetry.events import record_compile_cache
+from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
+from spark_rapids_jni_tpu.types import TypeId
+from spark_rapids_jni_tpu.utils.config import get_option
+
+__all__ = [
+    "Unbucketable",
+    "bucket_config",
+    "bucket_for",
+    "quantize_capacity",
+    "call",
+    "rowwise",
+    "sharded_call",
+    "stats",
+    "clear",
+]
+
+_ENV_CACHE_DIR = "SPARK_RAPIDS_TPU_DISPATCH_CACHE"
+
+_lock = threading.RLock()
+_EXEC_CACHE: dict = {}
+_persistent_initialized = False
+
+
+class Unbucketable(Exception):
+    """An input the bucketing pad cannot represent (Arrow-layout string,
+    nested column, non-array leaf, mismatched leading dimension)."""
+
+
+# ---------------------------------------------------------------------------
+# bucket schedule
+# ---------------------------------------------------------------------------
+
+
+def bucket_config() -> tuple[bool, int, float]:
+    """(enabled, bucket_base, max_waste_frac) — read per call, never baked
+    into a trace. Callers that DO consume these at trace time (the shuffle
+    capacity quantization) must carry this tuple in their dispatch key;
+    ``sharded_call`` does so automatically."""
+    return (
+        bool(get_option("dispatch.enabled")),
+        max(1, int(get_option("dispatch.bucket_base"))),
+        max(0.0, float(get_option("dispatch.max_waste_frac"))),
+    )
+
+
+def bucket_for(n: int) -> int:
+    """Smallest bucket >= n. Buckets are multiples of ``bucket_base``
+    growing geometrically by ``min(1 + max_waste_frac, 2)`` — waste_frac
+    1.0 gives power-of-two-style buckets (at most ~50% padded rows),
+    0.0 degenerates to linear base-multiple rounding."""
+    _, base, waste = bucket_config()
+    n = max(int(n), 1)
+    if n <= base:
+        return base
+    growth = min(1.0 + waste, 2.0)
+    if growth <= 1.0:
+        return ((n + base - 1) // base) * base
+    b = base
+    while b < n:
+        nxt = ((int(b * growth) + base - 1) // base) * base
+        b = max(nxt, b + base)
+    return b
+
+
+def quantize_capacity(capacity: int) -> int:
+    """Bucket-quantize a derived output capacity (e.g. the shuffle's
+    per-device slot count) so nearby batch sizes share one executable.
+    Growing a capacity is always safe — extra slots are row_valid=False
+    padding. Identity when dispatch is disabled."""
+    enabled, _, _ = bucket_config()
+    if not enabled:
+        return int(capacity)
+    return bucket_for(int(capacity))
+
+
+# ---------------------------------------------------------------------------
+# pytree pad / slice
+# ---------------------------------------------------------------------------
+
+
+def _is_array(x: Any) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+def _has_tracer(tree: Any) -> bool:
+    return any(
+        isinstance(leaf, jax.core.Tracer)
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+class _PadStats:
+    __slots__ = ("padded_bytes", "total_bytes")
+
+    def __init__(self) -> None:
+        self.padded_bytes = 0
+        self.total_bytes = 0
+
+
+def _pad_array(x: Any, n: int, B: int, acc: _PadStats) -> Any:
+    if not _is_array(x):
+        raise Unbucketable(f"non-array leaf {type(x).__name__}")
+    if x.ndim < 1 or x.shape[0] != n:
+        raise Unbucketable(
+            f"leading dim {x.shape} != row count {n}")
+    row_bytes = int(np.dtype(x.dtype).itemsize) * int(
+        math.prod(x.shape[1:]) if x.ndim > 1 else 1)
+    acc.padded_bytes += (B - n) * row_bytes
+    acc.total_bytes += B * row_bytes
+    if B == n:
+        return jnp.asarray(x)
+    pad = jnp.zeros((B - n,) + tuple(x.shape[1:]), dtype=x.dtype)
+    return jnp.concatenate([jnp.asarray(x), pad], axis=0)
+
+
+def _pad_column(col: Column, n: int, B: int, acc: _PadStats) -> Column:
+    if col.children is not None or col.dtype.type_id in (
+            TypeId.LIST, TypeId.STRUCT):
+        raise Unbucketable("nested (LIST/STRUCT) column")
+    if col.dtype.is_string and not col.is_padded_string:
+        raise Unbucketable("arrow-layout string column")
+    if col.size != n:
+        raise Unbucketable(f"column size {col.size} != row count {n}")
+    data = _pad_array(col.data, n, B, acc)
+    # padded tail rows are NULL rows: every op's null semantics already
+    # neutralize them (sums add 0, min/max see sentinels, sorts rank them
+    # by the row_valid key, counts skip them)
+    validity = jnp.concatenate(
+        [col.valid_mask(), jnp.zeros((B - n,), jnp.bool_)])
+    chars = None
+    if col.chars is not None:
+        chars = _pad_array(col.chars, n, B, acc)
+    return Column(col.dtype, data, validity, chars=chars)
+
+
+def _pad_tree(x: Any, n: int, B: int, acc: _PadStats) -> Any:
+    if x is None:
+        return None
+    if isinstance(x, Column):
+        return _pad_column(x, n, B, acc)
+    if isinstance(x, Table):
+        return Table([_pad_column(c, n, B, acc) for c in x.columns])
+    if _is_array(x):
+        return _pad_array(x, n, B, acc)
+    if isinstance(x, tuple):
+        vals = [_pad_tree(v, n, B, acc) for v in x]
+        return type(x)(*vals) if hasattr(x, "_fields") else tuple(vals)
+    if isinstance(x, list):
+        return [_pad_tree(v, n, B, acc) for v in x]
+    if isinstance(x, dict):
+        return {k: _pad_tree(v, n, B, acc) for k, v in x.items()}
+    raise Unbucketable(f"non-array leaf {type(x).__name__}")
+
+
+def _slice_column(col: Column, n: int, B: int) -> Column:
+    data = col.data
+    if _is_array(data) and data.ndim >= 1 and data.shape[0] == B:
+        data = data[:n]
+    validity = col.validity
+    if _is_array(validity) and validity.shape[0] == B:
+        validity = validity[:n]
+    chars = col.chars
+    if _is_array(chars) and chars.ndim >= 1 and chars.shape[0] == B:
+        chars = chars[:n]
+    return Column(col.dtype, data, validity, chars=chars,
+                  children=col.children)
+
+
+def _slice_tree(x: Any, n: int, B: int) -> Any:
+    if B == n or x is None:
+        return x
+    if isinstance(x, Column):
+        return _slice_column(x, n, B)
+    if isinstance(x, Table):
+        return Table([_slice_column(c, n, B) for c in x.columns])
+    if _is_array(x):
+        if x.ndim >= 1 and x.shape[0] == B:
+            return x[:n]
+        return x
+    if isinstance(x, tuple):
+        vals = [_slice_tree(v, n, B) for v in x]
+        return type(x)(*vals) if hasattr(x, "_fields") else tuple(vals)
+    if isinstance(x, list):
+        return [_slice_tree(v, n, B) for v in x]
+    if isinstance(x, dict):
+        return {k: _slice_tree(v, n, B) for k, v in x.items()}
+    return x
+
+
+def _group_rows(group: Any) -> int:
+    """The row count of one bucketing group (a pytree whose array leaves
+    all share the leading row dimension)."""
+    if isinstance(group, Table):
+        return group.num_rows
+    if isinstance(group, Column):
+        return group.size
+    for leaf in jax.tree_util.tree_leaves(group):
+        if isinstance(leaf, Column):
+            return leaf.size
+        if _is_array(leaf):
+            if leaf.ndim < 1:
+                raise Unbucketable("scalar leaf has no row dimension")
+            return int(leaf.shape[0])
+    raise Unbucketable("group has no array leaves")
+
+
+def _signature(tree: Any) -> tuple:
+    """Hashable aval digest: treedef (carries Column dtypes as aux data —
+    the reference's (typeId, scale) JNI marshaling) + per-leaf shape,
+    dtype, and sharding."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    sig = []
+    for leaf in leaves:
+        shard = getattr(leaf, "sharding", None)
+        sig.append((
+            tuple(leaf.shape) if hasattr(leaf, "shape") else (),
+            str(getattr(leaf, "dtype", type(leaf).__name__)),
+            repr(shard) if shard is not None else "",
+        ))
+    return (treedef, tuple(sig))
+
+
+# ---------------------------------------------------------------------------
+# executable cache
+# ---------------------------------------------------------------------------
+
+
+def _init_persistent_cache() -> None:
+    """Wire JAX's cross-process compilation cache (idempotent). The short
+    env var wins over the config option; thresholds are dropped to zero so
+    the small CPU-test executables persist too."""
+    global _persistent_initialized
+    with _lock:
+        if _persistent_initialized:
+            return
+        _persistent_initialized = True
+    cache_dir = os.environ.get(_ENV_CACHE_DIR) or str(
+        get_option("dispatch.persistent_cache_dir") or "")
+    if not cache_dir:
+        return
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        for opt, val in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(opt, val)
+            except Exception:
+                pass  # knob names drift across jax versions; best effort
+        # jax latches the cache as disabled at the FIRST compile in the
+        # process; imports above us always compile something, so force a
+        # re-read of the dir we just set
+        try:
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+        REGISTRY.gauge("dispatch.persistent_cache").set(1)
+    except Exception:
+        REGISTRY.counter("dispatch.persistent_cache_error").inc()
+
+
+def _inline(op: str, reason: str, fn: Callable, row_args: tuple,
+            aux_args: tuple) -> Any:
+    REGISTRY.counter("dispatch.inline").inc()
+    REGISTRY.counter(f"dispatch.inline.{reason}").inc()
+    return fn(row_args, aux_args, None)
+
+
+def call(
+    op: str,
+    fn: Callable,
+    row_args: tuple,
+    aux_args: tuple = (),
+    *,
+    statics: tuple = (),
+    slice_rows: bool = True,
+    bucket_rows: bool = True,
+) -> Any:
+    """Dispatch ``fn`` through the bucketed executable cache.
+
+    ``row_args`` is a tuple of bucketing GROUPS: each group is a pytree
+    (Columns / Tables / arrays) whose leaves share one leading row
+    dimension; each group is padded to its own bucket (a join has two
+    groups). ``aux_args`` is a pytree of arrays traced but never padded
+    (e.g. a DFA transition table — its shape still keys the cache).
+    ``statics`` must capture every non-array value ``fn`` closes over that
+    affects the trace (schemas, agg lists, config-derived flags).
+
+    ``fn(row_args, aux_args, row_valids)`` — ``row_valids`` is one
+    bool[bucket] mask per group (True = real row), or None on the inline
+    path. ``slice_rows`` trims bucket-sized leading dimensions of the
+    output back to group 0's true row count. ``bucket_rows=False`` keeps
+    exact shapes (pure executable memoization, no padding) for ops whose
+    semantics cannot absorb padded rows.
+
+    Never raises on its own behalf: every failure mode falls back to
+    ``fn(row_args, aux_args, None)`` with the reason counted under
+    ``dispatch.inline.<reason>``.
+    """
+    REGISTRY.counter("dispatch.calls").inc()
+    enabled, _, _ = bucket_config()
+    if not enabled:
+        return _inline(op, "disabled", fn, row_args, aux_args)
+    if _has_tracer((row_args, aux_args)):
+        return _inline(op, "tracer", fn, row_args, aux_args)
+    try:
+        ns = tuple(_group_rows(g) for g in row_args)
+    except Unbucketable:
+        return _inline(op, "unbucketable", fn, row_args, aux_args)
+    if any(n == 0 for n in ns):
+        return _inline(op, "empty", fn, row_args, aux_args)
+
+    buckets = tuple(bucket_for(n) for n in ns) if bucket_rows else ns
+    acc = _PadStats()
+    try:
+        padded = tuple(
+            _pad_tree(g, n, B, acc)
+            for g, n, B in zip(row_args, ns, buckets))
+    except Unbucketable:
+        return _inline(op, "unbucketable", fn, row_args, aux_args)
+    row_valids = tuple(
+        jnp.arange(B, dtype=jnp.int32) < jnp.int32(n)
+        for n, B in zip(ns, buckets))
+
+    key = (op, statics, _signature((padded, aux_args, row_valids)),
+           jax.default_backend())
+    with _lock:
+        compiled = _EXEC_CACHE.get(key)
+    if compiled is None:
+        _init_persistent_cache()
+        try:
+            compiled = jax.jit(fn).lower(
+                padded, aux_args, row_valids).compile()
+        except Exception:
+            REGISTRY.counter("dispatch.compile_error").inc()
+            return _inline(op, "compile_error", fn, row_args, aux_args)
+        with _lock:
+            _EXEC_CACHE[key] = compiled
+        REGISTRY.counter("dispatch.compile").inc()
+        REGISTRY.counter(f"dispatch.compile.{op}").inc()
+        record_compile_cache(f"dispatch:{op}", hit=False)
+    else:
+        REGISTRY.counter("dispatch.hit").inc()
+        REGISTRY.counter(f"dispatch.hit.{op}").inc()
+        record_compile_cache(f"dispatch:{op}", hit=True)
+
+    try:
+        out = compiled(padded, aux_args, row_valids)
+    except Exception:
+        # aval drift (weak types, sharding changes) — never take the op down
+        REGISTRY.counter("dispatch.exec_error").inc()
+        return _inline(op, "exec_error", fn, row_args, aux_args)
+
+    REGISTRY.counter("dispatch.padded_rows").inc(
+        sum(B - n for n, B in zip(ns, buckets)))
+    REGISTRY.counter("dispatch.padded_waste_bytes").inc(acc.padded_bytes)
+    REGISTRY.counter("dispatch.row_bytes_total").inc(acc.total_bytes)
+    if slice_rows:
+        out = _slice_tree(out, ns[0], buckets[0])
+    return out
+
+
+def rowwise(
+    op: str,
+    fn: Callable,
+    group: Any,
+    aux_args: tuple = (),
+    *,
+    statics: tuple = (),
+    slice_rows: bool = True,
+) -> Any:
+    """``call`` for the common single-row-group op."""
+    return call(op, fn, (group,), aux_args, statics=statics,
+                slice_rows=slice_rows)
+
+
+def sharded_call(
+    op: str,
+    build: Callable[[], Callable],
+    args: tuple,
+    statics: tuple = (),
+) -> Any:
+    """Executable memoization (no row bucketing) for a shard_map/jit
+    boundary: ``build()`` returns the per-call closure (a fresh
+    ``jax.shard_map(step, ...)`` wrapper is fine — identity does not key
+    the cache, ``(op, statics, signature)`` does). The bucket-schedule
+    config rides the key because shuffle capacities consume it at trace
+    time. Falls back to a direct call on any lower/compile failure."""
+    REGISTRY.counter("dispatch.calls").inc()
+    cfg = bucket_config()
+    if not cfg[0]:
+        REGISTRY.counter("dispatch.inline").inc()
+        REGISTRY.counter("dispatch.inline.disabled").inc()
+        return build()(*args)
+    if _has_tracer(args):
+        REGISTRY.counter("dispatch.inline").inc()
+        REGISTRY.counter("dispatch.inline.tracer").inc()
+        return build()(*args)
+    key = (op, ("sharded", cfg) + tuple(statics), _signature(args),
+           jax.default_backend())
+    with _lock:
+        compiled = _EXEC_CACHE.get(key)
+    if compiled is None:
+        _init_persistent_cache()
+        try:
+            compiled = jax.jit(build()).lower(*args).compile()
+        except Exception:
+            REGISTRY.counter("dispatch.compile_error").inc()
+            REGISTRY.counter("dispatch.inline").inc()
+            REGISTRY.counter("dispatch.inline.compile_error").inc()
+            return build()(*args)
+        with _lock:
+            _EXEC_CACHE[key] = compiled
+        REGISTRY.counter("dispatch.compile").inc()
+        REGISTRY.counter(f"dispatch.compile.{op}").inc()
+        record_compile_cache(f"dispatch:{op}", hit=False)
+    else:
+        REGISTRY.counter("dispatch.hit").inc()
+        REGISTRY.counter(f"dispatch.hit.{op}").inc()
+        record_compile_cache(f"dispatch:{op}", hit=True)
+    try:
+        return compiled(*args)
+    except Exception:
+        REGISTRY.counter("dispatch.exec_error").inc()
+        REGISTRY.counter("dispatch.inline").inc()
+        REGISTRY.counter("dispatch.inline.exec_error").inc()
+        return build()(*args)
+
+
+# ---------------------------------------------------------------------------
+# introspection
+# ---------------------------------------------------------------------------
+
+
+def stats() -> dict:
+    """Aggregate dispatch counters for the bench ``dispatch`` block."""
+    c = REGISTRY.counters("dispatch.")
+    compiles = c.get("dispatch.compile", 0)
+    hits = c.get("dispatch.hit", 0)
+    total_bytes = c.get("dispatch.row_bytes_total", 0)
+    waste = c.get("dispatch.padded_waste_bytes", 0)
+    return {
+        "calls": c.get("dispatch.calls", 0),
+        "compiles": compiles,
+        "hits": hits,
+        "hit_rate": hits / max(1, hits + compiles),
+        "inline": c.get("dispatch.inline", 0),
+        "padded_waste_bytes": waste,
+        "padded_waste_frac": (waste / total_bytes) if total_bytes else 0.0,
+        "executables": cache_size(),
+    }
+
+
+def cache_size() -> int:
+    with _lock:
+        return len(_EXEC_CACHE)
+
+
+def clear() -> None:
+    """Drop memoized executables (test isolation). Telemetry counters are
+    owned by the registry and are NOT reset here."""
+    with _lock:
+        _EXEC_CACHE.clear()

@@ -37,6 +37,23 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     "telemetry.enabled": (False, bool),
     # JSONL sink for telemetry events; "" = in-process ring buffer only.
     "telemetry.path": ("", str),
+    # Shape-bucketed dispatch (runtime/dispatch.py): pad the leading row
+    # dimension of device-op inputs up to a bucket so one compiled
+    # executable serves every batch size inside the bucket (the reference
+    # launches per-shape CUDA kernels; XLA instead recompiles per shape,
+    # which this layer amortizes).
+    "dispatch.enabled": (True, bool),
+    # Smallest bucket and bucket granularity (rows). Every bucket is a
+    # multiple of this.
+    "dispatch.bucket_base": (16, int),
+    # Upper bound on padding waste per bucket step: buckets grow
+    # geometrically by min(1 + max_waste_frac, 2). 1.0 = power-of-two
+    # buckets (<= 50% padded rows); 0.0 = linear base-multiple buckets.
+    "dispatch.max_waste_frac": (1.0, float),
+    # Directory for JAX's persistent (cross-process) compilation cache;
+    # "" = off. The short env var SPARK_RAPIDS_TPU_DISPATCH_CACHE is also
+    # honored (checked first by runtime/dispatch.py).
+    "dispatch.persistent_cache_dir": ("", str),
 }
 
 _overrides: dict[str, Any] = {}

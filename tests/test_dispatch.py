@@ -1,0 +1,276 @@
+"""Shape-bucketed dispatch & executable cache (runtime/dispatch, ISSUE 3).
+
+Three invariant families:
+
+1. **Bit-identity** — bucketed results must be byte-for-byte identical to
+   the unbucketed path (``dispatch.enabled = False``) at the row counts
+   where padding is most likely to leak: 1, 2^k-1, 2^k, 2^k+1 around the
+   bucket edges, including null validity tails, reductions, sort
+   permutations and groupby outputs. Values are integers (or
+   integer-valued floats), so "identical" means exact equality.
+
+2. **Executable reuse** — the acceptance micro-benchmark: >=8 distinct
+   row counts inside one bucket compile exactly ONCE (telemetry
+   ``dispatch.compile`` counter), while distinct statics / dtypes / ops
+   recompile.
+
+3. **Bucket schedule** — bucket_for / quantize_capacity arithmetic and
+   the config knobs that drive them.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops import elementwise as e
+from spark_rapids_jni_tpu.ops import reduce as red
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.hash import table_xxhash64
+from spark_rapids_jni_tpu.ops.sort import sort_order
+from spark_rapids_jni_tpu.runtime import dispatch
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+# row counts straddling the power-of-two bucket edges of the default
+# base-16 schedule: 1, 2^k-1, 2^k, 2^k+1 for the 16/32/64 buckets
+EDGE_COUNTS = (1, 15, 16, 17, 31, 32, 33, 63, 64, 65)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_dispatch():
+    """Each test sees a fresh executable cache and counter namespace and
+    leaves the dispatch config at its defaults."""
+    dispatch.clear()
+    REGISTRY.reset()
+    yield
+    for k in ("dispatch.enabled", "dispatch.bucket_base",
+              "dispatch.max_waste_frac"):
+        reset_option(k)
+    dispatch.clear()
+
+
+def _int_col(rng, n, null_tail=True):
+    vals = rng.integers(-1000, 1000, n).astype(np.int64)
+    validity = np.ones(n, bool)
+    if null_tail and n > 2:
+        # nulls at the END of the column — adjacent to where padding
+        # phantoms live, the spot a masking bug would corrupt first
+        validity[-2:] = False
+        validity[rng.integers(0, n)] = False
+    return Column.from_numpy(vals, validity=validity)
+
+
+def _both_paths(fn):
+    """Run ``fn()`` bucketed then unbucketed, return both results."""
+    bucketed = fn()
+    set_option("dispatch.enabled", False)
+    try:
+        unbucketed = fn()
+    finally:
+        set_option("dispatch.enabled", True)
+    return bucketed, unbucketed
+
+
+def _assert_cols_identical(a: Column, b: Column):
+    assert np.array_equal(np.asarray(a.valid_mask()),
+                          np.asarray(b.valid_mask()))
+    av, bv = np.asarray(a.data), np.asarray(b.data)
+    mask = np.asarray(a.valid_mask())
+    if av.ndim > 1:  # decimal128 limb pairs and the like
+        mask = mask.reshape((-1,) + (1,) * (av.ndim - 1))
+    # invalid slots hold unspecified bytes by the Column contract
+    assert np.array_equal(np.where(mask, av, 0), np.where(mask, bv, 0))
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-identity at bucket edges
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", EDGE_COUNTS)
+def test_elementwise_bit_identical_at_edges(rng, n):
+    col = _int_col(rng, n)
+    other = _int_col(rng, n)
+    for op in (lambda: e.abs_(col),
+               lambda: e.coalesce([col, other]),
+               lambda: e.nullif(col, other),
+               lambda: e.greatest([col, other])):
+        got, want = _both_paths(op)
+        _assert_cols_identical(got, want)
+
+
+@pytest.mark.parametrize("n", EDGE_COUNTS)
+def test_reductions_bit_identical_at_edges(rng, n):
+    col = _int_col(rng, n)
+    fcol = Column.from_numpy(
+        rng.integers(-50, 50, n).astype(np.float64),  # integer-exact floats
+        validity=np.asarray(col.valid_mask()))
+
+    for fn in (lambda: red.sum_(col), lambda: red.sum_(fcol),
+               lambda: red.min_(col), lambda: red.max_(col),
+               lambda: red.mean(fcol)):
+        (gv, gok), (wv, wok) = _both_paths(fn)
+        assert bool(gok) == bool(wok)
+        if bool(wok):
+            assert np.asarray(gv) == np.asarray(wv)
+    gc_, wc_ = _both_paths(lambda: red.count(col))  # count: bare scalar
+    assert int(gc_) == int(wc_)
+
+
+@pytest.mark.parametrize("n", EDGE_COUNTS)
+def test_sort_order_bit_identical_at_edges(rng, n):
+    keys = _int_col(rng, n)
+    ties = Column.from_numpy(rng.integers(0, 3, n).astype(np.int64))
+    tbl = Table([ties, keys])
+    for kwargs in ({"ascending": [True, True]},
+                   {"ascending": [False, True]},
+                   {"nulls_first": [True, True]}):
+        got, want = _both_paths(
+            lambda: sort_order(tbl, [0, 1], **kwargs))
+        # a stable sort has exactly one correct permutation: exact match
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("n", EDGE_COUNTS)
+def test_groupby_bit_identical_at_edges(rng, n):
+    keys = Column.from_numpy(rng.integers(0, 4, n).astype(np.int64))
+    vals = _int_col(rng, n)
+    tbl = Table([keys, vals])
+    aggs = [(1, "sum"), (1, "count"), (1, "min"), (1, "max")]
+
+    got, want = _both_paths(lambda: groupby_aggregate(tbl, [0], aggs))
+    assert int(got.num_groups) == int(want.num_groups)
+    m = int(want.num_groups)
+    for gc, wc in zip(got.table.columns, want.table.columns):
+        gm = np.asarray(gc.valid_mask())[:m]
+        assert np.array_equal(gm, np.asarray(wc.valid_mask())[:m])
+        assert np.array_equal(
+            np.where(gm, np.asarray(gc.data)[:m], 0),
+            np.where(gm, np.asarray(wc.data)[:m], 0))
+
+
+@pytest.mark.parametrize("n", EDGE_COUNTS)
+def test_hash_bit_identical_at_edges(rng, n):
+    tbl = Table([_int_col(rng, n), _int_col(rng, n)])
+    got, want = _both_paths(lambda: table_xxhash64(tbl, [0, 1], seed=7))
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_groupby_all_null_tail_rows(rng):
+    """Rows whose caller row_valid is False must vanish from the grouped
+    output exactly as the unbucketed path drops them."""
+    n = 33  # 2^5+1: two pad rows in the 64 bucket... no: bucket 64, 31 pads
+    keys = Column.from_numpy(rng.integers(0, 3, n).astype(np.int64))
+    vals = Column.from_numpy(rng.integers(-9, 9, n).astype(np.int64))
+    rv = np.ones(n, bool)
+    rv[-5:] = False
+    tbl = Table([keys, vals])
+    got, want = _both_paths(
+        lambda: groupby_aggregate(tbl, [0], [(1, "sum"), (1, "count")],
+                                  row_valid=np.asarray(rv)))
+    assert int(got.num_groups) == int(want.num_groups)
+    m = int(want.num_groups)
+    for gc, wc in zip(got.table.columns, want.table.columns):
+        assert np.array_equal(np.asarray(gc.data)[:m],
+                              np.asarray(wc.data)[:m])
+
+
+# ---------------------------------------------------------------------------
+# 2. executable reuse (the acceptance micro-benchmark)
+# ---------------------------------------------------------------------------
+
+
+def test_one_bucket_compiles_exactly_once(rng):
+    """>=8 distinct row counts inside one bucket -> exactly 1 compile;
+    the un-migrated path would have compiled once per row count."""
+    counts = (513, 600, 649, 700, 801, 900, 1000, 1024)  # all -> bucket 1024
+    results = []
+    for n in counts:
+        col = Column.from_numpy(np.arange(n, dtype=np.int64))
+        total, ok = red.sum_(col)
+        results.append(int(total))
+        assert bool(ok)
+    assert results == [n * (n - 1) // 2 for n in counts]
+    assert REGISTRY.counter("dispatch.compile").value == 1
+    assert REGISTRY.counter("dispatch.hit").value == len(counts) - 1
+
+
+def test_distinct_buckets_and_dtypes_compile_separately():
+    a = Column.from_numpy(np.arange(10, dtype=np.int64))
+    b = Column.from_numpy(np.arange(100, dtype=np.int64))  # other bucket
+    c = Column.from_numpy(np.arange(10, dtype=np.int32))   # other dtype
+    for col in (a, b, c):
+        red.sum_(col)
+    assert REGISTRY.counter("dispatch.compile").value == 3
+    # same shapes again: all hits
+    for col in (a, b, c):
+        red.sum_(col)
+    assert REGISTRY.counter("dispatch.compile").value == 3
+    assert REGISTRY.counter("dispatch.hit").value == 3
+
+
+def test_statics_change_recompiles(rng):
+    tbl = Table([Column.from_numpy(
+        rng.integers(0, 100, 20).astype(np.int64))])
+    sort_order(tbl, [0], ascending=[True])
+    before = REGISTRY.counter("dispatch.compile").value
+    # same shapes + op, different static (sort direction): a fresh compile
+    sort_order(tbl, [0], ascending=[False])
+    assert REGISTRY.counter("dispatch.compile").value == before + 1
+    # and re-running either direction is a pure hit
+    hits = REGISTRY.counter("dispatch.hit").value
+    sort_order(tbl, [0], ascending=[True])
+    sort_order(tbl, [0], ascending=[False])
+    assert REGISTRY.counter("dispatch.compile").value == before + 1
+    assert REGISTRY.counter("dispatch.hit").value == hits + 2
+
+
+def test_disabled_dispatch_never_compiles(rng):
+    set_option("dispatch.enabled", False)
+    col = _int_col(rng, 20)
+    red.sum_(col)
+    e.abs_(col)
+    assert REGISTRY.counter("dispatch.compile").value == 0
+    assert REGISTRY.counter("dispatch.inline.disabled").value == 2
+    assert dispatch.cache_size() == 0
+
+
+def test_padded_waste_accounted(rng):
+    col = Column.from_numpy(np.arange(17, dtype=np.int64))  # bucket 32
+    red.sum_(col)
+    stats = dispatch.stats()
+    assert stats["padded_waste_bytes"] > 0
+    assert 0.0 < stats["padded_waste_frac"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# 3. bucket schedule arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_schedule_defaults():
+    assert dispatch.bucket_for(1) == 16
+    assert dispatch.bucket_for(16) == 16
+    assert dispatch.bucket_for(17) == 32
+    assert dispatch.bucket_for(1000) == 1024
+    assert dispatch.quantize_capacity(17) == 32
+
+
+def test_bucket_schedule_waste_knob():
+    # max_waste_frac bounds the growth ratio: at 0.25 the schedule grows
+    # by at most 1.25x per step, so buckets are much denser than 2x
+    set_option("dispatch.max_waste_frac", 0.25)
+    n = 100
+    b = dispatch.bucket_for(n)
+    assert b >= n
+    assert (b - n) / n <= 0.25 + 16 / n  # base-multiple rounding slack
+    set_option("dispatch.bucket_base", 8)
+    assert dispatch.bucket_for(1) == 8
+    reset_option("dispatch.bucket_base")
+    reset_option("dispatch.max_waste_frac")
+
+
+def test_quantize_capacity_disabled_is_identity():
+    set_option("dispatch.enabled", False)
+    assert dispatch.quantize_capacity(17) == 17

@@ -147,8 +147,35 @@ def test_rule_fallback_recorded_needs_ops_or_device_scope(tmp_path):
     assert not _by_rule(_lint_file(target), "fallback-must-be-recorded")
 
 
+def test_rule_jit_via_dispatch_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_dispatch_device.py"),
+                   "jit-via-dispatch")
+    texts = [f.source_line for f in got]
+    assert len(got) == 2, texts
+    assert any(t.startswith("@jax.jit") for t in texts)
+    assert any("jax.jit(lambda" in t for t in texts)
+    # the pragma'd deliberate jit and the dispatch.rowwise twin stay clean
+    src = (FIXTURES / "seeded_dispatch_device.py").read_text()
+    clean_at = src[:src.index("def pragmaed_kernel")].count("\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_jit_via_dispatch_needs_ops_or_device_scope(tmp_path):
+    # a direct jit outside ops/ or a *_device.py file is host-side
+    # orchestration (bench drivers, runtime/dispatch itself) — out of scope
+    target = tmp_path / "not_an_ops_file.py"
+    shutil.copy(FIXTURES / "seeded_dispatch_device.py", target)
+    assert not _by_rule(_lint_file(target), "jit-via-dispatch")
+    # under an ops/ segment the same source fires regardless of basename
+    ops_dir = tmp_path / "ops"
+    ops_dir.mkdir()
+    target2 = ops_dir / "plain_name.py"
+    shutil.copy(FIXTURES / "seeded_dispatch_device.py", target2)
+    assert _by_rule(_lint_file(target2), "jit-via-dispatch")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all seven rules demonstrably fire."""
+    """The acceptance invariant: all eight rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
@@ -161,6 +188,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_regex_nul_device.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_bitmask.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_dispatch_device.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

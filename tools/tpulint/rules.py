@@ -586,6 +586,51 @@ def check_fallback_recorded(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 8: jit-via-dispatch
+# ---------------------------------------------------------------------------
+
+def check_jit_via_dispatch(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: a batch-shaped op compiled with a direct ``@jax.jit``
+    (or a bare ``jax.jit(...)`` call) re-traces and re-compiles for every
+    distinct row count, bypassing the shape-bucketed executable cache in
+    ``runtime/dispatch.py`` — exactly the per-shape compile storm the
+    dispatch layer exists to absorb, and its padded-waste / hit-rate
+    telemetry never sees the op. Scope: ops/*.py and any *_device.py
+    (host-side drivers like bench.py measure whole pipelines and stay out
+    of scope; runtime/dispatch.py itself owns the one legitimate jit).
+    A deliberate jit — e.g. a Pallas kernel wrapper whose shapes are
+    block-quantized already — carries a
+    ``# tpulint: disable=jit-via-dispatch`` pragma."""
+    if not (_is_device_file(ctx.name) or "/ops/" in ("/" + ctx.path)):
+        return []
+    out: List[RawFinding] = []
+    for fn in _functions(ctx.tree):
+        if _jit_decorated(fn):
+            # anchor on the decorator line so the pragma sits beside it
+            dec_line = min((d.lineno for d in fn.decorator_list),
+                           default=fn.lineno)
+            out.append(RawFinding(
+                dec_line, fn.col_offset,
+                f"`{fn.name}` is compiled with a direct @jax.jit: each "
+                f"distinct row count traces and compiles a fresh "
+                f"executable; route the op through "
+                f"runtime/dispatch.call/rowwise so row counts share "
+                f"bucketed executables (pragma a deliberate jit)"))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        ftxt = _unparse(node.func)
+        if ftxt == "jax.jit" or ftxt.endswith(".jax.jit") or ftxt == "jit":
+            out.append(RawFinding(
+                node.lineno, node.col_offset,
+                "bare `jax.jit(...)` in an ops file bypasses the "
+                "shape-bucketed dispatch cache; use "
+                "runtime/dispatch.call/rowwise (pragma a deliberate "
+                "jit)"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -613,4 +658,8 @@ RULES = [
          "except ...Unsupported handlers and explicit host-engine pins "
          "in ops files must call telemetry.record_fallback(...)",
          check_fallback_recorded),
+    Rule("jit-via-dispatch",
+         "batch-shaped ops in ops/ go through runtime/dispatch, not a "
+         "direct @jax.jit / jax.jit(...) that recompiles per row count",
+         check_jit_via_dispatch),
 ]
