@@ -164,6 +164,133 @@ def _dispatch_block() -> dict:
     return block
 
 
+def _pipeline_block() -> dict:
+    """The BENCH_*.json ``pipeline`` block: overlap probe of the async
+    out-of-core executor (runtime/pipeline.py). A fixed set of host-staged
+    chunks with a deliberate host-decode cost runs once serially (decode,
+    stage, compute per chunk in sequence) and once pipelined; the block
+    reports overlap efficiency (pipelined wall / serial decode+compute
+    sum — < 1.0 means decode genuinely hid behind compute), producer/
+    consumer stall fractions from the pipeline.* counters, steady-state
+    chunk latency for both paths, and the leaked-reservation byte count
+    after a fault-injected run (the no-orphaned-reservations contract,
+    must be 0). Probe-sized (a few MB, ~10 chunks): it cannot distort the
+    measured config's numbers; it runs after the config body."""
+    block: dict = {}
+    try:
+        import numpy as np
+
+        from spark_rapids_jni_tpu import telemetry
+        from spark_rapids_jni_tpu.columnar import Column, Table
+        from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+        from spark_rapids_jni_tpu.runtime import pipeline as pl
+        from spark_rapids_jni_tpu.runtime.memory import (
+            MemoryLimiter,
+            _col_to_host,
+            _table_nbytes,
+            host_table_chunk,
+        )
+
+        n_chunks, rows = 10, 1 << 15
+        decode_cost_s = 0.004  # emulated per-chunk host decode (IO+codec)
+        rng = np.random.RandomState(0)
+        host_cols = [
+            [(_col_to_host(Column.from_numpy(
+                rng.randint(0, 8, rows).astype(np.int64)))),
+             (_col_to_host(Column.from_numpy(
+                 rng.randint(0, 1000, rows).astype(np.int64))))]
+            for _ in range(n_chunks)
+        ]
+
+        def _source(i):
+            def decode():
+                time.sleep(decode_cost_s)  # stands in for storage+codec
+                return host_table_chunk(host_cols[i], rows)
+            return decode
+
+        def _compute(chunk):
+            g = groupby_aggregate(chunk, keys=[0], aggs=[(1, "sum")],
+                                  max_groups=16)
+            jax_block = g.table.columns[0].data
+            np.asarray(jax_block)  # sync: latency must include compute
+            return g
+
+        # warmup: pay the one-time jit compile outside the timed region so
+        # the serial/pipelined comparison measures steady-state chunks only
+        _compute(_source(0)().stage())
+
+        # serial reference: decode -> stage -> compute, one chunk at a time
+        decode_total = compute_total = 0.0
+        serial_lat = []
+        for i in range(n_chunks):
+            t0 = time.perf_counter()
+            hc = _source(i)()
+            t1 = time.perf_counter()
+            _compute(hc.stage())
+            t2 = time.perf_counter()
+            decode_total += t1 - t0
+            compute_total += t2 - t1
+            serial_lat.append(t2 - t0)
+
+        reg = telemetry.REGISTRY
+
+        def _ctr(name):
+            return reg.counters(name).get(name, 0)
+
+        stall0 = (_ctr("pipeline.producer_stall_us"),
+                  _ctr("pipeline.consumer_stall_us"))
+        limiter = MemoryLimiter(1 << 30)
+        t0 = time.perf_counter()
+        delivered = 0
+        for chunk in pl.pipeline_chunks(
+                [_source(i) for i in range(n_chunks)], limiter=limiter,
+                depth=2, decode_threads=2):
+            _compute(chunk)
+            limiter.release(_table_nbytes(chunk))
+            delivered += 1
+        wall = time.perf_counter() - t0
+        stall1 = (_ctr("pipeline.producer_stall_us"),
+                  _ctr("pipeline.consumer_stall_us"))
+
+        # fault injection: a mid-stream stage failure must leave zero
+        # reserved bytes behind (the acceptance contract)
+        fault_limiter = MemoryLimiter(1 << 30)
+
+        def _boom(stage, seq):
+            if stage == "transfer" and seq == n_chunks // 2:
+                raise RuntimeError("bench fault probe")
+
+        try:
+            with pl.inject_fault(_boom):
+                for chunk in pl.pipeline_chunks(
+                        [_source(i) for i in range(n_chunks)],
+                        limiter=fault_limiter, depth=2):
+                    fault_limiter.release(_table_nbytes(chunk))
+        except RuntimeError:
+            pass
+
+        denom = decode_total + compute_total
+        block.update({
+            "chunks": delivered,
+            "prefetch_depth": 2,
+            "decode_s_per_chunk": round(decode_total / n_chunks, 6),
+            "compute_s_per_chunk": round(compute_total / n_chunks, 6),
+            "serial_chunk_latency_s": round(
+                sum(serial_lat[1:]) / max(len(serial_lat) - 1, 1), 6),
+            "pipelined_chunk_latency_s": round(wall / n_chunks, 6),
+            "overlap_efficiency": round(wall / denom, 4) if denom else None,
+            "producer_stall_frac": round(
+                (stall1[0] - stall0[0]) / 1e6 / wall, 4) if wall else None,
+            "consumer_stall_frac": round(
+                (stall1[1] - stall0[1]) / 1e6 / wall, 4) if wall else None,
+            "leaked_reservation_bytes": limiter.used,
+            "post_fault_leaked_bytes": fault_limiter.used,
+        })
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    return block
+
+
 def _ledger_last(metric: str, n: int):
     """Most recent ledger record for ``metric`` under the current
     measurement tag — preferring an exact row-count match (throughput is
@@ -1031,7 +1158,8 @@ def _child_main(config: str, n: int, iters: int) -> None:
 
         force_cpu_platform()
     value = _CONFIGS[config][0](n, iters)
-    print(json.dumps({"value": value, "dispatch": _dispatch_block()}))
+    print(json.dumps({"value": value, "dispatch": _dispatch_block(),
+                      "pipeline": _pipeline_block()}))
 
 
 # ---------------------------------------------------------------------------
@@ -1071,7 +1199,8 @@ def _probe_tpu(timeout_s: float) -> tuple[bool, str]:
 
 def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float):
     """Run the bench in a subprocess; returns (value | None, diagnostic,
-    dispatch block from the child's executable cache | None)."""
+    dispatch block | None, pipeline block | None) — the blocks come from
+    the measured child process's executable cache and overlap probe."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CONFIG"] = config
@@ -1088,7 +1217,8 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
             env=env,
         )
     except subprocess.TimeoutExpired:
-        return None, f"{platform} bench timed out after {timeout_s:.0f}s", None
+        return (None, f"{platform} bench timed out after {timeout_s:.0f}s",
+                None, None)
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
@@ -1096,8 +1226,10 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         except (json.JSONDecodeError, KeyError, TypeError, ValueError):
             continue
         disp = rec.get("dispatch") if isinstance(rec, dict) else None
-        return value, "", disp if isinstance(disp, dict) else None
-    return None, f"{platform} bench failed: {_tail(out)}", None
+        pipe = rec.get("pipeline") if isinstance(rec, dict) else None
+        return (value, "", disp if isinstance(disp, dict) else None,
+                pipe if isinstance(pipe, dict) else None)
+    return None, f"{platform} bench failed: {_tail(out)}", None, None
 
 
 def main() -> None:
@@ -1115,6 +1247,7 @@ def main() -> None:
     }
     diagnostics: list[str] = []
     child_disp = None
+    child_pipe = None
     # every run gets a telemetry file (children record through the package
     # via these env vars; the parent appends bench_stale events itself) —
     # restored afterwards so driving code / tests see their own env back
@@ -1152,7 +1285,7 @@ def main() -> None:
                 time.sleep(10)
                 ok, why = _probe_tpu(20)
             if ok:
-                value, why, child_disp = _run_child(
+                value, why, child_disp, child_pipe = _run_child(
                     config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
@@ -1193,7 +1326,7 @@ def main() -> None:
                     "ledger_n": led.get("n"), "requested_n": n,
                 })
         if value is None:
-            value, why, child_disp = _run_child(
+            value, why, child_disp, child_pipe = _run_child(
                 config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
@@ -1234,6 +1367,9 @@ def main() -> None:
     # parent never imports jax, so it cannot produce these itself); an
     # empty block records that no child delivered stats (timeout / stale)
     record["dispatch"] = child_disp or {}
+    # overlap accounting for the pipelined out-of-core executor, same
+    # child-process provenance as the dispatch block
+    record["pipeline"] = child_pipe or {}
     if diagnostics:
         record["diagnostic"] = "; ".join(d for d in diagnostics if d)
     print(json.dumps(record))
@@ -1284,7 +1420,7 @@ def sweep() -> None:
             if config in single_size else sizes
         cfg_timeout = 240.0 if config == "tpch_q1_pallas" else timeout
         for n in cfg_sizes:
-            value, why, _disp = _run_child(config, n, iters, "tpu", cfg_timeout)
+            value, why, _disp, _pipe = _run_child(config, n, iters, "tpu", cfg_timeout)
             line = {"config": config, "metric": metric, "n": n,
                     "value": value, "unit": unit, "device_kind": kind}
             if value is not None:

@@ -1,9 +1,10 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its eight invariant rules (host/device
+# tpulint (tools/tpulint) runs its nine invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
-# width, validity-mask derivation, fallback accounting, jit-via-dispatch)
+# width, validity-mask derivation, fallback accounting, jit-via-dispatch,
+# pipeline-stage host-transfer)
 # over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
 # glob below covers the telemetry/ package alongside every other
 # subpackage.
@@ -42,4 +43,36 @@ hits = REGISTRY.counter("dispatch.hit").value
 assert compiles == 1, f"expected 1 compile for one bucket, got {compiles}"
 assert hits == 1, f"expected 1 cache hit, got {hits}"
 print(f"dispatch smoke OK: 2 row counts, {compiles} compile, {hits} hit")
+EOF
+
+# pipeline smoke: rule 9 only proves stage workers don't BLOCK on the
+# device — this proves the executor itself still honors its contract:
+# pipelined delivery is bit-identical to the serial reference and every
+# limiter reservation is released once the caller consumes the chunks.
+# Synthetic host-staged sources (no native decoder needed), 2 chunks.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.runtime import pipeline as pl
+from spark_rapids_jni_tpu.runtime.memory import (
+    MemoryLimiter, _col_to_host, _table_nbytes, host_table_chunk)
+
+rows = 256
+cols = [[_col_to_host(Column.from_numpy(
+    np.arange(i, i + rows, dtype=np.int64)))] for i in (0, 1000)]
+sources = [(lambda c=c: host_table_chunk(c, rows)) for c in cols]
+
+serial = [np.asarray(s().stage().columns[0].data) for s in sources]
+
+limiter = MemoryLimiter(1 << 24)
+piped = []
+for tbl in pl.pipeline_chunks(sources, limiter=limiter, depth=2):
+    piped.append(np.asarray(tbl.columns[0].data))
+    limiter.release(_table_nbytes(tbl))
+
+assert len(piped) == 2 and all(
+    (a == b).all() for a, b in zip(serial, piped)), "pipelined != serial"
+assert limiter.used == 0, f"leaked {limiter.used} reserved bytes"
+print("pipeline smoke OK: 2 chunks bit-identical, 0 leaked bytes")
 EOF

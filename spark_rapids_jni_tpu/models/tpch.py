@@ -450,7 +450,8 @@ def tpch_q1_outofcore(path, *, budget_bytes: int,
                       chunk_read_limit: int,
                       spill_budget_bytes: int | None = None,
                       compress_spill: bool = False,
-                      prefetch_depth: int = 0):
+                      prefetch_depth: int = 0,
+                      pipeline: bool | None = None):
     """q1 over a Parquet file LARGER than the device budget: chunked
     row-group reads -> per-chunk partial aggregates -> SpillStore'd
     partials -> merge -> finalize. The partial->merge algebra is the
@@ -465,7 +466,11 @@ def tpch_q1_outofcore(path, *, budget_bytes: int,
     ``budget_bytes`` must cover one chunk (plus the merge window) when
     ``prefetch_depth == 0``; with prefetch, ``prefetch_depth + 2``
     chunks are resident at once (the read/compute overlap window) and
-    the budget must cover them.
+    the budget must cover them. ``pipeline`` selects the async
+    multi-stage executor (None follows ``pipeline.enabled``): host
+    decode overlaps device compute through the reader's chunk thunks,
+    exact-bytes admission blocks instead of raising, and results stay
+    bit-identical to the serial path.
     """
     import jax as _jax
 
@@ -514,9 +519,11 @@ def tpch_q1_outofcore(path, *, budget_bytes: int,
         return sort_table(final, [0, 1], nulls_first=[False, False])
 
     reader = ParquetChunkedReader(path, chunk_read_limit=chunk_read_limit)
+    # the reader (not iter(reader)) so the pipelined executor can pick up
+    # its per-chunk decode thunks; the serial path just iterates it
     return run_chunked_aggregate(
-        iter(reader), partial_fn, merge_fn, limiter=limiter, spill=spill,
-        prefetch_depth=prefetch_depth)
+        reader, partial_fn, merge_fn, limiter=limiter, spill=spill,
+        prefetch_depth=prefetch_depth, pipeline=pipeline)
 
 
 # ---- TPC-H q3 (shipping priority): join + groupby + order-by ---------------
@@ -965,7 +972,8 @@ def tpch_q10_numpy(customer: Table, orders: Table, lineitem: Table,
 def tpch_q3_outofcore(path, customer: Table, orders: Table, *,
                       budget_bytes: int, chunk_read_limit: int,
                       segment: int = 0, cutoff: int = _Q3_CUTOFF_DAYS,
-                      prefetch_depth: int = 0):
+                      prefetch_depth: int = 0,
+                      pipeline: bool | None = None):
     """q3 over a lineitem Parquet file larger than the device budget:
     the JOIN side of the SF-scale story (q1 covered pure aggregation).
     customer and orders stay resident (the small sides — the broadcast
@@ -1062,8 +1070,8 @@ def tpch_q3_outofcore(path, customer: Table, orders: Table, *,
 
     reader = ParquetChunkedReader(path, chunk_read_limit=chunk_read_limit)
     return run_chunked_aggregate(
-        iter(reader), partial_fn, merge_fn, limiter=limiter, spill=spill,
-        prefetch_depth=prefetch_depth)
+        reader, partial_fn, merge_fn, limiter=limiter, spill=spill,
+        prefetch_depth=prefetch_depth, pipeline=pipeline)
 
 
 def tpch_q3_planned_distributed(customer: Table, orders: Table,

@@ -24,11 +24,10 @@ from __future__ import annotations
 import ctypes
 from typing import Iterator, Optional, Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu import types as t
-from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar import Table
 from spark_rapids_jni_tpu.parquet.footer import NativeError
 from spark_rapids_jni_tpu.runtime.native import load_native
 from spark_rapids_jni_tpu.utils.tracing import func_range
@@ -118,13 +117,27 @@ def read_table(
     data,
     columns: Optional[Sequence[int]] = None,
     stripes: Optional[Sequence[int]] = None,
+    stage: str = "device",
 ) -> Table:
     """Decode an ORC file into a device Table. ``data`` may be in-memory
     bytes OR a filesystem path: paths decode through a native mmap (the
     cuFile/GDS-role storage path, like the Parquet reader) — stripe-
     selective reads fault in only the selected byte ranges. None selects
-    all columns/stripes; an empty list selects none."""
+    all columns/stripes; an empty list selects none.
+
+    ``stage="host"`` stops at the host boundary and returns a
+    ``HostTableChunk`` (numpy snapshots + exact device bytes): the
+    pipelined executor decodes there so the device-budget reservation
+    precedes the host->device copy; ``stage()``-ing yields a Table
+    bit-identical to the default path."""
+    from spark_rapids_jni_tpu.runtime.memory import (
+        _col_from_host,
+        host_table_chunk,
+    )
     from spark_rapids_jni_tpu.utils.fspath import as_fs_path
+
+    if stage not in ("device", "host"):
+        raise ValueError(f"unknown stage {stage!r}")
 
     lib = load_native()
     cols, n_cols = _i32_array(columns)
@@ -142,7 +155,12 @@ def read_table(
         writer_tz = tz_raw.decode("utf-8")
         n_columns = lib.tpudf_orc_num_columns(handle)
         _check(lib, n_columns >= 0, "num_columns")
-        out = []
+        # decode every column to a HOST snapshot first (the
+        # memory._col_to_host tuple format); device staging happens at
+        # the end — or not at all for stage="host", where the pipelined
+        # executor reserves budget before staging
+        snaps = []
+        table_rows = 0
         for i in range(n_columns):
             meta = (ctypes.c_int32 * 4)()
             sizes = (ctypes.c_int64 * 2)()
@@ -150,10 +168,10 @@ def read_table(
                    "col_meta")
             kind, prec, scale, has_valid = list(meta)
             num_rows, chars_bytes = list(sizes)
+            table_rows = num_rows
             dtype = _map_dtype(kind, scale, prec)
 
             vbuf = np.empty(num_rows, dtype=np.uint8) if has_valid else None
-            validity = None
             if kind in _STRING_KINDS:
                 offsets = np.empty(num_rows + 1, dtype=np.int32)
                 chars = np.empty(max(chars_bytes, 1), dtype=np.uint8)
@@ -168,12 +186,9 @@ def read_table(
                     ) == 0,
                     "col_copy",
                 )
-                if vbuf is not None:
-                    validity = jnp.asarray(vbuf.astype(bool))
-                out.append(
-                    Column(dtype, jnp.asarray(offsets), validity,
-                           chars=jnp.asarray(chars[:chars_bytes]))
-                )
+                validity = None if vbuf is None else vbuf.astype(bool)
+                snaps.append(
+                    (dtype, offsets, validity, chars[:chars_bytes], None))
                 continue
 
             n_vals = 2 * num_rows if dtype.is_decimal128 else num_rows
@@ -188,11 +203,10 @@ def read_table(
                 ) == 0,
                 "col_copy",
             )
-            if vbuf is not None:
-                validity = jnp.asarray(vbuf.astype(bool))
+            validity = None if vbuf is None else vbuf.astype(bool)
             if dtype.is_decimal128:
                 limbs = raw[: 2 * num_rows].reshape(num_rows, 2)
-                out.append(Column(dtype, jnp.asarray(limbs), validity))
+                snaps.append((dtype, limbs, validity, None, None))
                 continue
             raw = raw[:num_rows]
             if kind == _K_FLOAT:
@@ -203,8 +217,10 @@ def read_table(
                 values = _wall_to_utc_micros(raw, vbuf, writer_tz)
             else:
                 values = raw.astype(dtype.storage_dtype, copy=False)
-            out.append(Column(dtype, jnp.asarray(values), validity))
-        return Table(out)
+            snaps.append((dtype, values, validity, None, None))
+        if stage == "host":
+            return host_table_chunk(snaps, table_rows)
+        return Table([_col_from_host(s) for s in snaps])
     finally:
         lib.tpudf_orc_close(handle)
 
@@ -235,10 +251,7 @@ class OrcChunkedReader:
     def has_next(self) -> bool:
         return self._next < len(self._infos)
 
-    def read_chunk(self) -> Table:
-        if not self.has_next():
-            raise StopIteration
-        start = self._next
+    def _chunk_end(self, start: int) -> int:
         total = 0
         end = start
         while end < len(self._infos):
@@ -246,8 +259,38 @@ class OrcChunkedReader:
             if end > start and total > self._limit:
                 break
             end += 1
+        return end
+
+    def read_chunk(self) -> Table:
+        if not self.has_next():
+            raise StopIteration
+        start = self._next
+        end = self._chunk_end(start)
         self._next = end
         return read_table(self._data, self._columns, list(range(start, end)))
+
+    def chunk_plan(self) -> list[list[int]]:
+        """Stripe index runs, one per REMAINING chunk. Pure planning:
+        does not decode or advance the iteration cursor."""
+        plans = []
+        start = self._next
+        while start < len(self._infos):
+            end = self._chunk_end(start)
+            plans.append(list(range(start, end)))
+            start = end
+        return plans
+
+    def chunk_sources(self, stage: str = "host") -> list:
+        """Zero-arg decode thunks, one per remaining chunk — the
+        pipeline's read/decode-stage contract (see
+        ``ParquetChunkedReader.chunk_sources``). ``stage="host"``
+        decodes to ``HostTableChunk`` so the device copy can wait for
+        its MemoryLimiter reservation."""
+        data, columns = self._data, self._columns
+        return [
+            (lambda sts=sts: read_table(data, columns, sts, stage=stage))
+            for sts in self.chunk_plan()
+        ]
 
     def __iter__(self) -> Iterator[Table]:
         while self.has_next():

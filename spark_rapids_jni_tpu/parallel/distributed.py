@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_jni_tpu import telemetry
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
 from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
@@ -355,6 +356,25 @@ def distributed_groupby_bounded(
     return DistributedBoundedGroupBy(out_tbl, present, miss)
 
 
+def _shuffle_retry_capacity(table: Table, mesh: Mesh,
+                            capacity: Optional[int]) -> int:
+    """Capacity for the one-shot overflow retry: double the EFFECTIVE
+    per-device slot count (the shuffle's derived default when the caller
+    passed None — mirror of shuffle_by_partition's in-trace formula) and
+    re-quantize through the dispatch bucket schedule so the retry shape
+    still shares executables with other batches that land in its bucket."""
+    import math
+
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    if capacity is None:
+        D = int(mesh.shape[EXEC_AXIS])
+        n_local = max(1, math.ceil(table.num_rows / D))
+        capacity = dispatch.quantize_capacity(
+            max(1, math.ceil(n_local / D) * 2))
+    return dispatch.quantize_capacity(max(int(capacity), 1) * 2)
+
+
 def _distributed_groupby(table, keys, mesh, capacity, local_groupby,
                          cache_key=None):
     """Shared shuffle-then-local-groupby scaffold: hash-exchange rows so
@@ -365,34 +385,53 @@ def _distributed_groupby(table, keys, mesh, capacity, local_groupby,
     closes over (agg list, percentile qs, ...) — the dispatch executable
     cache keys on it, NOT on the closure's identity. ``None`` means the
     closure is opaque: fall back to an uncached shard_map call rather than
-    risk serving a stale executable for different closure contents."""
+    risk serving a stale executable for different closure contents.
 
-    def step(local: Table):
-        sh = hash_shuffle(local, keys, EXEC_AXIS, capacity=capacity)
-        res = local_groupby(sh.table, keys)
-        return (res.table, res.num_groups.reshape(1),
-                sh.overflowed.reshape(1),
-                jnp.asarray(res.sum_overflow).reshape(1))
+    Shuffle capacity overflow recovers HERE, once, instead of at every
+    caller: ``overflowed`` is a device flag (the in-trace shuffle cannot
+    grow its static send-buffer shape), so the host boundary after the
+    call is the first place a bigger capacity can be chosen. One retry at
+    doubled quantized capacity handles the common skewed-batch case; a
+    result that STILL overflows is returned with the flag set (fail loud
+    at the caller, as before)."""
 
-    def build():
-        return jax.shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(P(EXEC_AXIS),),
-            out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS),
-                       P(EXEC_AXIS)),
-        )
+    def run(cap):
+        def step(local: Table):
+            sh = hash_shuffle(local, keys, EXEC_AXIS, capacity=cap)
+            res = local_groupby(sh.table, keys)
+            return (res.table, res.num_groups.reshape(1),
+                    sh.overflowed.reshape(1),
+                    jnp.asarray(res.sum_overflow).reshape(1))
 
-    if cache_key is None:
-        out_tbl, num_groups, overflowed, sum_overflow = build()(table)
-    else:
+        def build():
+            return jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(P(EXEC_AXIS),),
+                out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS),
+                           P(EXEC_AXIS)),
+            )
+
+        if cache_key is None:
+            return build()(table)
         from spark_rapids_jni_tpu.runtime import dispatch
 
-        out_tbl, num_groups, overflowed, sum_overflow = dispatch.sharded_call(
+        return dispatch.sharded_call(
             "distributed_groupby", build, (table,),
-            statics=(tuple(keys), capacity, cache_key,
+            statics=(tuple(keys), cap, cache_key,
                      _mesh_fingerprint(mesh)),
         )
+
+    out_tbl, num_groups, overflowed, sum_overflow = run(capacity)
+    if bool(np.asarray(overflowed).any()):
+        retry_cap = _shuffle_retry_capacity(table, mesh, capacity)
+        telemetry.record_fallback(
+            "distributed_groupby",
+            "shuffle capacity overflow: a device received more rows than "
+            "its send-buffer slots; retrying once at doubled quantized "
+            "capacity",
+            rows=table.num_rows, retry_capacity=retry_cap)
+        out_tbl, num_groups, overflowed, sum_overflow = run(retry_cap)
     return DistributedGroupBy(out_tbl, num_groups, overflowed, sum_overflow)
 
 

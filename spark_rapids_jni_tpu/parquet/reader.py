@@ -162,8 +162,12 @@ def row_group_info(data: "bytes | str | os.PathLike") -> list[tuple[int, int]]:
         cap = n
 
 
-def _read_flat_column(lib, handle: int, i: int) -> Column:
-    """One flat (non-nested) leaf: row-aligned values + optional validity."""
+def _read_flat_column_host(lib, handle: int, i: int):
+    """One flat (non-nested) leaf decoded to a HOST column snapshot
+    (the ``memory._col_to_host`` tuple format: dtype, data, validity,
+    chars, children — all numpy, row count known, zero device bytes).
+    The pipelined executor decodes through this form so the
+    MemoryLimiter reservation can precede the host->device copy."""
     meta = (ctypes.c_int32 * 7)()
     sizes = (ctypes.c_int64 * 3)()
     _check(lib, lib.tpudf_read_col_meta(handle, i, meta, sizes) == 0,
@@ -172,7 +176,6 @@ def _read_flat_column(lib, handle: int, i: int) -> Column:
     data_bytes, chars_bytes, num_rows = list(sizes)
     dtype = _map_dtype(phys, conv, scale, tlen)
 
-    validity = None
     vbuf = np.empty(num_rows, dtype=np.uint8) if has_valid else None
     if phys == _PHYS_BYTE_ARRAY:
         offsets = np.empty(num_rows + 1, dtype=np.int32)
@@ -188,10 +191,8 @@ def _read_flat_column(lib, handle: int, i: int) -> Column:
             ) == 0,
             "col_copy",
         )
-        if vbuf is not None:
-            validity = jnp.asarray(vbuf.astype(bool))
-        return Column(dtype, jnp.asarray(offsets), validity,
-                      chars=jnp.asarray(chars[:chars_bytes]))
+        validity = None if vbuf is None else vbuf.astype(bool)
+        return (dtype, offsets, validity, chars[:chars_bytes], None), num_rows
 
     raw = np.empty(max(data_bytes, 1), dtype=np.uint8)
     _check(
@@ -203,17 +204,24 @@ def _read_flat_column(lib, handle: int, i: int) -> Column:
         ) == 0,
         "col_copy",
     )
-    if vbuf is not None:
-        validity = jnp.asarray(vbuf.astype(bool))
+    validity = None if vbuf is None else vbuf.astype(bool)
     if phys == _PHYS_FLBA and dtype.is_decimal128:
         values = _flba_to_int128(raw[:data_bytes], tlen)
-        return Column(dtype, jnp.asarray(values), validity)
+        return (dtype, values, validity, None, None), num_rows
     if phys == _PHYS_FLBA:
         values = _flba_to_int64(raw[:data_bytes], tlen)
     else:
         values = raw[:data_bytes].view(_PHYS_NP[phys])
     values = values.astype(dtype.storage_dtype, copy=False)
-    return Column(dtype, jnp.asarray(values), validity)
+    return (dtype, values, validity, None, None), num_rows
+
+
+def _read_flat_column(lib, handle: int, i: int) -> Column:
+    """One flat (non-nested) leaf: row-aligned values + optional validity."""
+    from spark_rapids_jni_tpu.runtime.memory import _col_from_host
+
+    snap, _num_rows = _read_flat_column_host(lib, handle, i)
+    return _col_from_host(snap)
 
 
 def _read_leaf_data(lib, handle: int, leaf_index: int):
@@ -307,6 +315,7 @@ def read_table(
     data: "bytes | str | os.PathLike",
     columns: Optional[Sequence[int]] = None,
     row_groups: Optional[Sequence[int]] = None,
+    stage: str = "device",
 ) -> Table:
     """Decode a Parquet file into a device Table.
 
@@ -314,7 +323,15 @@ def read_table(
     through a native mmap (the cuFile/GDS-role storage path, reference
     CMakeLists.txt:200-222) — only the byte ranges of the selected row
     groups are ever faulted in, so chunked reads of large files never
-    materialize the file through Python."""
+    materialize the file through Python.
+
+    ``stage="host"`` stops at the host boundary and returns a
+    ``HostTableChunk`` (flat schemas only): the pipelined executor
+    decodes there so the device-budget reservation can be taken on exact
+    bytes BEFORE the host->device copy. ``stage()``-ing the chunk yields
+    a Table bit-identical to the default path."""
+    if stage not in ("device", "host"):
+        raise ValueError(f"unknown stage {stage!r}")
     lib = load_native()
     cols, n_cols = _i32_array(columns)
     rgs, n_rgs = _i32_array(row_groups)
@@ -342,12 +359,27 @@ def read_table(
                     "supported (rewrite as a 3-level LIST)"
                 )
         if any(not nd.is_leaf for nd in tree):
+            if stage == "host":
+                raise NotImplementedError(
+                    "host-staged decode (stage='host') supports flat "
+                    "schemas only; nested columns assemble on device"
+                )
             if columns is not None:
                 raise NotImplementedError(
                     "column selection over nested schemas is not supported "
                     "yet; read all columns"
                 )
             return _read_nested(lib, handle, tree)
+
+        if stage == "host":
+            from spark_rapids_jni_tpu.runtime.memory import host_table_chunk
+
+            snaps = []
+            num_rows = 0
+            for i in range(n_columns):
+                snap, num_rows = _read_flat_column_host(lib, handle, i)
+                snaps.append(snap)
+            return host_table_chunk(snaps, num_rows)
 
         return Table(
             [_read_flat_column(lib, handle, i) for i in range(n_columns)]
@@ -377,10 +409,7 @@ class ParquetChunkedReader:
     def has_next(self) -> bool:
         return self._next_rg < len(self._infos)
 
-    def read_chunk(self) -> Table:
-        if not self.has_next():
-            raise StopIteration
-        start = self._next_rg
+    def _chunk_end(self, start: int) -> int:
         total = 0
         end = start
         while end < len(self._infos):
@@ -388,10 +417,42 @@ class ParquetChunkedReader:
             if end > start and total > self._limit:
                 break
             end += 1
+        return end
+
+    def read_chunk(self) -> Table:
+        if not self.has_next():
+            raise StopIteration
+        start = self._next_rg
+        end = self._chunk_end(start)
         self._next_rg = end
         return read_table(
             self._data, self._columns, list(range(start, end))
         )
+
+    def chunk_plan(self) -> list[list[int]]:
+        """Row-group index runs, one per REMAINING chunk. Pure planning:
+        does not decode or advance the iteration cursor."""
+        plans = []
+        start = self._next_rg
+        while start < len(self._infos):
+            end = self._chunk_end(start)
+            plans.append(list(range(start, end)))
+            start = end
+        return plans
+
+    def chunk_sources(self, stage: str = "host") -> list:
+        """Zero-arg decode thunks, one per remaining chunk — the
+        pipeline's read/decode-stage contract. Each thunk decodes its
+        row-group run independently (safe to call from pool threads; the
+        native decode releases the GIL). The default ``stage="host"``
+        decodes to ``HostTableChunk`` so the device copy can wait for
+        its MemoryLimiter reservation; pass ``stage="device"`` for
+        schemas the host path does not cover (nested)."""
+        data, columns = self._data, self._columns
+        return [
+            (lambda rgs=rgs: read_table(data, columns, rgs, stage=stage))
+            for rgs in self.chunk_plan()
+        ]
 
     def __iter__(self) -> Iterator[Table]:
         while self.has_next():

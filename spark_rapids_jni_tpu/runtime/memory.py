@@ -22,8 +22,9 @@ the part of RMM's surface a Spark executor actually interacts with:
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import numpy as np
 
@@ -79,7 +80,9 @@ class MemoryLimiter:
         self.budget = int(budget_bytes)
         self._used = 0
         self._peak = 0
-        self._lock = threading.Lock()
+        # a Condition so reserve_blocking can sleep until release() frees
+        # budget; plain reserve/release take the same underlying lock
+        self._lock = threading.Condition()
 
     @property
     def used(self) -> int:
@@ -101,9 +104,47 @@ class MemoryLimiter:
             if get_option("memory.log_level") >= 2:
                 _log.info("reserve %d bytes (%d in use)", nbytes, self._used)
 
+    def reserve_blocking(self, nbytes: int, cancel=None,
+                         timeout: "float | None" = None) -> bool:
+        """Wait until ``nbytes`` fits inside the budget, then reserve it.
+
+        The pipeline's backpressure primitive: where ``reserve`` fails
+        loud, this form parks the producer until a consumer ``release``
+        frees room, so a tight budget degrades throughput toward serial
+        instead of raising mid-run. A request larger than the WHOLE
+        budget can never fit and raises ``MemoryLimitExceeded``
+        immediately (same contract as ``reserve``). Returns True on
+        success, False if ``cancel`` (a threading.Event) was set or
+        ``timeout`` seconds elapsed first — cancellation is polled, so
+        a cancelled producer wakes within ~50ms.
+        """
+        if nbytes > self.budget:
+            raise MemoryLimitExceeded(
+                f"reservation of {nbytes} bytes exceeds the whole budget "
+                f"({self.budget}): can never fit"
+            )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self._used + nbytes > self.budget:
+                if cancel is not None and cancel.is_set():
+                    return False
+                wait = 0.05
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    wait = min(wait, remaining)
+                self._lock.wait(wait)
+            self._used += nbytes
+            self._peak = max(self._peak, self._used)
+            if get_option("memory.log_level") >= 2:
+                _log.info("reserve %d bytes (%d in use)", nbytes, self._used)
+        return True
+
     def release(self, nbytes: int) -> None:
         with self._lock:
             self._used = max(self._used - nbytes, 0)
+            self._lock.notify_all()
             if get_option("memory.log_level") >= 2:
                 _log.info("release %d bytes (%d in use)", nbytes, self._used)
 
@@ -113,6 +154,7 @@ class MemoryLimiter:
     def __exit__(self, *exc):
         with self._lock:
             self._used = 0
+            self._lock.notify_all()
         return False
 
 
@@ -251,6 +293,34 @@ def _col_from_host(snap, dctx=None):
     )
 
 
+class HostTableChunk(NamedTuple):
+    """A host-decoded table chunk awaiting device staging.
+
+    ``cols`` holds column snapshots in the ``_col_to_host`` format
+    (dtype, data, validity, chars, children — all numpy); ``nbytes`` is
+    the exact device footprint ``stage()`` will allocate. The pipelined
+    executor decodes chunks to this form in its read/decode stage so the
+    MemoryLimiter reservation can be taken on exact bytes BEFORE the
+    host->device copy — backpressure that cannot over-commit the budget
+    on a size guess."""
+
+    cols: tuple
+    nbytes: int
+    num_rows: int
+
+    def stage(self):
+        """Host->device copy. Callers reserve ``nbytes`` first."""
+        from spark_rapids_jni_tpu.columnar import Table
+
+        return Table([_col_from_host(snap) for snap in self.cols])
+
+
+def host_table_chunk(snaps, num_rows: int) -> HostTableChunk:
+    snaps = tuple(snaps)
+    return HostTableChunk(
+        snaps, sum(_host_snap_nbytes(s) for s in snaps), int(num_rows))
+
+
 def _host_snap_nbytes(snap) -> int:
     _, data, validity, chars, children = snap
     n = (_packed_nbytes(data) + _packed_nbytes(validity)
@@ -378,6 +448,28 @@ class SpillStore:
             if get_option("memory.log_level") >= 1:
                 _log.info("unspill table %d (%d bytes)", handle, e["nbytes"])
             return e["table"]
+
+    def get_reserved(self, handle: int, limiter: MemoryLimiter):
+        """Fetch a table with its device bytes reserved against
+        ``limiter`` BEFORE the host->device copy runs.
+
+        Ordering contract: a spilled entry that would not fit the budget
+        must raise ``MemoryLimitExceeded`` before ANY device staging
+        happens — reserving after ``get`` would let the unspill allocate
+        first and account later, exactly the over-commit window the
+        limiter exists to close (and the window a prefetching pipeline
+        widens, since unspills race concurrent chunk admissions there).
+        Returns ``(table, nbytes)``; on success the CALLER owns the
+        reservation. On any failure — including the reserve itself —
+        no reservation is left behind.
+        """
+        nb = self.nbytes(handle)
+        limiter.reserve(nb)
+        try:
+            return self.get(handle), nb
+        except BaseException:
+            limiter.release(nb)
+            raise
 
     def nbytes(self, handle: int) -> int:
         """Logical (device) size of a stored table WITHOUT staging it —

@@ -34,7 +34,7 @@ from spark_rapids_jni_tpu.runtime.memory import (
     _table_nbytes,
 )
 from spark_rapids_jni_tpu.utils.log import get_logger
-from spark_rapids_jni_tpu.utils.tracing import func_range
+from spark_rapids_jni_tpu.utils.tracing import func_range, trace_range
 
 _log = get_logger(__name__)
 
@@ -144,6 +144,7 @@ def run_chunked_aggregate(
     spill: SpillStore | None = None,
     spill_budget_bytes: int | None = None,
     prefetch_depth: int = 0,
+    pipeline: bool | None = None,
 ) -> OutOfCoreResult:
     """Stream an aggregation over table chunks under a memory budget.
 
@@ -159,6 +160,18 @@ def run_chunked_aggregate(
     and LRU-spill to (compressed) host memory otherwise, so the merge
     input never holds un-accounted device bytes either.
 
+    ``pipeline`` selects the async multi-stage executor
+    (runtime/pipeline.py): None follows the ``pipeline.enabled`` option,
+    True/False force it. When pipelined, ``chunks`` may ALSO be a
+    chunked reader exposing ``chunk_sources()`` (parquet/orc) or an
+    iterable of zero-arg decode thunks; host decode then runs in a small
+    thread pool and each chunk's exact device bytes are reserved at the
+    staging boundary BEFORE its host->device copy, so backpressure
+    blocks (degrading toward serial) instead of over-committing. Results
+    are bit-identical to the serial path and chunk-order-deterministic
+    either way. ``prefetch_depth`` doubles as the pipeline queue depth
+    when > 0; otherwise ``pipeline.prefetch_depth`` applies.
+
     ``partial_fn`` maps one chunk to a small table of mergeable partial
     rows (sums/counts, NOT averages); ``merge_fn`` maps the concatenation
     of all partials to the final table. The partial->merge algebra is
@@ -167,7 +180,10 @@ def run_chunked_aggregate(
     query plan work over chunks, devices, or both.
     """
     from spark_rapids_jni_tpu.ops.table_ops import concatenate
+    from spark_rapids_jni_tpu.runtime import pipeline as pl
 
+    use_pipeline = pl.pipeline_enabled() if pipeline is None \
+        else bool(pipeline)
     own_spill = spill is None
     if own_spill:
         spill = SpillStore(
@@ -175,21 +191,35 @@ def run_chunked_aggregate(
             else limiter.budget)
     handles: list[int] = []
     nchunks = 0
-    # prefetch_depth > 0 overlaps the next chunk's read/decode/staging
-    # with this chunk's compute; the producer thread then owns the
-    # reservation (size the budget for depth + 2 resident chunks — see
-    # prefetch_chunks)
-    if prefetch_depth > 0:
+    # pipeline mode: decode in a pool, exact-bytes admission, ordered
+    # delivery; prefetch mode: single producer thread, depth+2 window;
+    # serial mode: one chunk resident at a time. In the first two the
+    # producer owns each chunk's reservation and this loop releases it.
+    if use_pipeline:
+        sources = chunks.chunk_sources() \
+            if hasattr(chunks, "chunk_sources") else chunks
+        stream = pl.pipeline_chunks(
+            sources, limiter=limiter,
+            depth=prefetch_depth if prefetch_depth > 0 else None)
+    elif prefetch_depth > 0:
         stream = prefetch_chunks(chunks, prefetch_depth, limiter)
     else:
         stream = chunks
+    producer_owns = use_pipeline or prefetch_depth > 0
     try:
         for chunk in stream:
             nb = _table_nbytes(chunk)
-            if prefetch_depth <= 0:
+            if not producer_owns:
                 limiter.reserve(nb)
             try:
-                partial = partial_fn(chunk)
+                if use_pipeline:
+                    # stage 4 of the pipeline: device compute — faults
+                    # injectable, span-traced like the producer stages
+                    pl._maybe_fault("compute", nchunks)
+                    with trace_range("pipeline.compute"):
+                        partial = partial_fn(chunk)
+                else:
+                    partial = partial_fn(chunk)
                 handles.append(spill.put(partial))
             finally:
                 limiter.release(nb)
@@ -199,7 +229,7 @@ def run_chunked_aggregate(
         # a partial_fn failure must stop the producer and release its
         # in-flight reservations (the no-phantom-usage contract) — the
         # generator's own finally does both on close
-        if prefetch_depth > 0:
+        if producer_owns:
             stream.close()
     if not handles:
         raise ValueError("no chunks: empty input stream")
@@ -227,10 +257,11 @@ def run_chunked_aggregate(
         for h in handles:
             # reserve BEFORE staging: a partial set that exceeds the
             # budget must raise before its bytes are device-resident
-            nb_p = spill.nbytes(h)
-            limiter.reserve(nb_p)
+            # (get_reserved orders the reservation ahead of the
+            # host->device copy — the pipelined-unspill contract)
+            tbl, nb_p = spill.get_reserved(h, limiter)
             partial_bytes += nb_p
-            partials.append(spill.get(h))
+            partials.append(tbl)
             spill.drop(h)
         if len(partials) > 1:
             merged_in = concatenate(partials)
@@ -249,7 +280,12 @@ def run_chunked_aggregate(
         limiter.release(partial_bytes)
         raise
     try:
-        out = merge_fn(merged_in)
+        if use_pipeline:
+            pl._maybe_fault("merge", nchunks)
+            with trace_range("pipeline.merge"):
+                out = merge_fn(merged_in)
+        else:
+            out = merge_fn(merged_in)
     finally:
         limiter.release(nb)
     return OutOfCoreResult(out, nchunks, limiter.peak, spill.stats())

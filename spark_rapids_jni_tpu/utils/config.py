@@ -54,6 +54,19 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # "" = off. The short env var SPARK_RAPIDS_TPU_DISPATCH_CACHE is also
     # honored (checked first by runtime/dispatch.py).
     "dispatch.persistent_cache_dir": ("", str),
+    # Pipelined out-of-core execution (runtime/pipeline.py): overlap host
+    # read/decode with device transfer+compute through a bounded-queue
+    # multi-stage executor. Off by default — the serial path stays the
+    # reference implementation; results are bit-identical either way.
+    "pipeline.enabled": (False, bool),
+    # How many chunks the producer stages may run ahead of the consumer.
+    # Also honored via the short env var SPARK_RAPIDS_TPU_PIPELINE_PREFETCH
+    # (checked first by runtime/pipeline.py).
+    "pipeline.prefetch_depth": (2, int),
+    # Worker threads for the host read/decode stage. Decode is mostly
+    # C-extension (numpy / native codec) work that releases the GIL, so a
+    # small pool overlaps IO with decode without oversubscribing the host.
+    "pipeline.decode_threads": (2, int),
 }
 
 _overrides: dict[str, Any] = {}

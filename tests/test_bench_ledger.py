@@ -136,12 +136,14 @@ def test_main_falls_back_to_cpu_when_ledger_empty(
     monkeypatch.delenv("BENCH_PLATFORM", raising=False)
     monkeypatch.setattr(bench, "_probe_tpu", lambda t: (False, "forced down"))
     monkeypatch.setattr(
-        bench, "_run_child", lambda c, n, i, p, t: (123.0, "", None))
+        bench, "_run_child", lambda c, n, i, p, t: (123.0, "", None, None))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["platform"] == "cpu" and rec["value"] == 123.0
-    # no child delivered dispatch stats: the block records that honestly
+    # no child delivered dispatch/pipeline stats: the blocks record that
+    # honestly
     assert rec["dispatch"] == {}
+    assert rec["pipeline"] == {}
 
 
 def test_tpu_success_appends_to_ledger(ledger, monkeypatch, capsys):
@@ -149,10 +151,12 @@ def test_tpu_success_appends_to_ledger(ledger, monkeypatch, capsys):
     monkeypatch.delenv("BENCH_PLATFORM", raising=False)
     monkeypatch.setattr(bench, "_probe_tpu", lambda t: (True, ""))
     monkeypatch.setattr(
-        bench, "_run_child", lambda c, n, i, p, t: (5.0e8, "", {"compiles": 1}))
+        bench, "_run_child",
+        lambda c, n, i, p, t: (5.0e8, "", {"compiles": 1}, {"chunks": 10}))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["platform"] == "tpu" and "stale_s" not in rec
     assert rec["dispatch"] == {"compiles": 1}
+    assert rec["pipeline"] == {"chunks": 10}
     led = bench._ledger_last("tpch_q1_planned_rows_per_s", 1 << 22)
     assert led["value"] == 5.0e8

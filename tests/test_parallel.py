@@ -480,3 +480,80 @@ def test_distributed_groupby_covar_corr(rng, mesh):
                           float(np.cov(xs, ys, ddof=1)[0, 1]), rtol=1e-5)
         assert np.isclose(got_corr[int(k)],
                           float(np.corrcoef(xs, ys)[0, 1]), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# shuffle overflow one-shot retry (host boundary, ISSUE 4 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_groupby_overflow_retries_once_with_doubled_capacity():
+    """An overflowed shuffle retries ONCE at doubled quantized capacity
+    from the host boundary — centralized here instead of at every
+    caller — and records the retry as a telemetry fallback event. The
+    mesh work is stubbed (the decision logic is pure host code), so this
+    runs on any device count."""
+    from unittest import mock
+
+    from spark_rapids_jni_tpu import telemetry
+    from spark_rapids_jni_tpu.parallel import distributed as dist
+    from spark_rapids_jni_tpu.runtime import dispatch
+    from spark_rapids_jni_tpu.utils.config import get_option, set_option
+
+    tbl = Table([Column.from_numpy(np.arange(64, dtype=np.int64))])
+
+    class _FakeMesh:
+        shape = {EXEC_AXIS: 4}
+        devices = np.empty((4,), dtype=object)
+
+    caps = []
+
+    def fake_sharded_call(name, build, args, statics=()):
+        cap = statics[1]
+        caps.append(cap)
+        return (args[0], np.array([1]),
+                np.array([cap is None or cap <= 8]), np.array([False]))
+
+    prev = get_option("telemetry.enabled")
+    set_option("telemetry.enabled", True)
+    telemetry.drain()
+    try:
+        with mock.patch.object(dispatch, "sharded_call", fake_sharded_call):
+            res = dist._distributed_groupby(
+                tbl, [0], _FakeMesh(), 8, lambda sh, ks: None,
+                cache_key=("retry-test",))
+        events = [e for e in telemetry.drain()
+                  if e.get("kind") == "fallback"
+                  and e.get("op") == "distributed_groupby"]
+    finally:
+        set_option("telemetry.enabled", prev)
+    # exactly one retry, at the doubled quantized capacity, which cleared
+    # the overflow flag
+    assert caps == [8, dispatch.quantize_capacity(16)]
+    assert not bool(np.asarray(res.overflowed).any())
+    assert len(events) == 1
+    assert events[0]["retry_capacity"] == caps[1]
+
+
+def test_shuffle_retry_capacity_derives_default_from_table():
+    """With no caller capacity the retry doubles the shuffle's DERIVED
+    default (ceil(n_local / D) * 2, quantized) — the same formula
+    shuffle_by_partition burns into the trace."""
+    import math
+
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        _shuffle_retry_capacity,
+    )
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    class _FakeMesh:
+        shape = {EXEC_AXIS: 4}
+
+    tbl = Table([Column.from_numpy(np.arange(64, dtype=np.int64))])
+    n_local = math.ceil(64 / 4)
+    derived = dispatch.quantize_capacity(max(1, math.ceil(n_local / 4) * 2))
+    assert _shuffle_retry_capacity(tbl, _FakeMesh(), None) == \
+        dispatch.quantize_capacity(derived * 2)
+    # caller-specified capacities double from the caller's number
+    assert _shuffle_retry_capacity(tbl, _FakeMesh(), 100) == \
+        dispatch.quantize_capacity(200)

@@ -174,8 +174,31 @@ def test_rule_jit_via_dispatch_needs_ops_or_device_scope(tmp_path):
     assert _by_rule(_lint_file(target2), "jit-via-dispatch")
 
 
+def test_rule_pipeline_stage_host_transfer_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_pipeline_stage.py"),
+                   "pipeline-stage-host-transfer")
+    texts = [f.source_line for f in got]
+    assert len(got) == 4, texts
+    assert any("jax.device_get" in t for t in texts)
+    assert any("np.asarray" in t for t in texts)
+    assert any("block_until_ready" in t for t in texts)
+    assert any(".item()" in t for t in texts)
+    # the host-staged twin and the pragma'd bounded probe stay clean
+    src = (FIXTURES / "seeded_pipeline_stage.py").read_text()
+    clean_at = src[:src.index("def clean_host_staged")].count("\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_pipeline_stage_needs_pipeline_filename(tmp_path):
+    # same constructions outside a pipeline module are host-side
+    # orchestration (bench drivers, notebooks) — out of scope
+    target = tmp_path / "plain_orchestration.py"
+    shutil.copy(FIXTURES / "seeded_pipeline_stage.py", target)
+    assert not _by_rule(_lint_file(target), "pipeline-stage-host-transfer")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all eight rules demonstrably fire."""
+    """The acceptance invariant: all nine rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
@@ -190,6 +213,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_bitmask.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_dispatch_device.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_pipeline_stage.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

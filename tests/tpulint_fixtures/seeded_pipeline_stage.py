@@ -1,0 +1,40 @@
+"""Seeded violations for pipeline-stage-host-transfer (the filename's
+``pipeline`` substring puts every function here in stage-worker scope).
+No jit decorators and no _device.py suffix, so rules 1/2/8 stay silent —
+each finding below belongs to rule 9 alone."""
+
+import jax
+import numpy as np
+
+
+def stalls_on_device_get(fut_table):
+    col = jax.device_get(fut_table.columns[0].data)   # VIOLATION
+    return col.nbytes
+
+
+def stalls_on_asarray(chunk):
+    host = np.asarray(chunk.columns[0].data)          # VIOLATION
+    return host.sum()
+
+
+def stalls_on_block_until_ready(chunk):
+    jax.block_until_ready(chunk.columns[0].data)      # VIOLATION
+    return chunk
+
+
+def stalls_on_item(counter):
+    return counter.item()                             # VIOLATION
+
+
+def clean_host_staged(host_chunk):
+    # the blessed shape: payloads stay HostTableChunk (already host
+    # bytes) until admission reserves their device budget, then stage()
+    nb = host_chunk.nbytes
+    return host_chunk.stage(), nb
+
+
+def clean_pragma_metadata_probe(chunk):
+    # 8-byte scalar probe read AFTER delivery, off the pool threads —
+    # the stall is bounded and reviewed
+    # tpulint: disable=pipeline-stage-host-transfer
+    return np.asarray(chunk.columns[0].data[:1])

@@ -1,4 +1,4 @@
-"""The seven tpulint rules.
+"""The nine tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -631,6 +631,62 @@ def check_jit_via_dispatch(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 9: pipeline-stage-host-transfer
+# ---------------------------------------------------------------------------
+
+_PIPELINE_BLOCKING_CALLS = _HOST_TRANSFER_CALLS | {
+    "jax.block_until_ready", "block_until_ready",
+}
+
+
+def _is_pipeline_file(name: str) -> bool:
+    return "pipeline" in name
+
+
+def check_pipeline_stage_host_transfer(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: a blocking device->host transfer inside a pipeline
+    stage worker (np.asarray / jax.device_get on a device array,
+    .tolist()/.item(), block_until_ready) parks a decode-pool thread on
+    device completion — serializing exactly the IO/compute overlap the
+    pipelined executor exists to create, invisibly (wall clock degrades
+    to serial while every stage still "works"). Host-side bytes must
+    come from the readers' host-staged decode (``stage="host"`` ->
+    ``HostTableChunk``), never from re-fetching device arrays mid-stage.
+    Scope: every function in a pipeline module (basename contains
+    ``pipeline``); a reviewed-legitimate transfer carries a
+    ``# tpulint: disable=pipeline-stage-host-transfer`` pragma stating
+    why the stall is acceptable."""
+    if not _is_pipeline_file(ctx.name):
+        return []
+    out: List[RawFinding] = []
+    seen: set = set()
+    for fn in _functions(ctx.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            ftxt = _unparse(node.func)
+            if ftxt in _PIPELINE_BLOCKING_CALLS:
+                out.append(RawFinding(
+                    node.lineno, node.col_offset,
+                    f"blocking `{ftxt}(...)` in a pipeline stage worker "
+                    f"stalls the decode pool on device work and "
+                    f"serializes the overlap; stage host bytes through "
+                    f"the readers' host-staged decode (HostTableChunk) "
+                    f"instead"))
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _HOST_TRANSFER_METHODS
+                  and not node.args and not node.keywords):
+                out.append(RawFinding(
+                    node.lineno, node.col_offset,
+                    f"`.{node.func.attr}()` in a pipeline stage worker "
+                    f"forces a device->host sync on a pool thread; keep "
+                    f"stage payloads host-staged (HostTableChunk) until "
+                    f"admission"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -662,4 +718,8 @@ RULES = [
          "batch-shaped ops in ops/ go through runtime/dispatch, not a "
          "direct @jax.jit / jax.jit(...) that recompiles per row count",
          check_jit_via_dispatch),
+    Rule("pipeline-stage-host-transfer",
+         "pipeline stage workers never block on device->host transfers; "
+         "host bytes come from the readers' host-staged decode",
+         check_pipeline_stage_host_transfer),
 ]
