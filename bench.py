@@ -291,6 +291,98 @@ def _pipeline_block() -> dict:
     return block
 
 
+def _fusion_probe_project(tbl):
+    """Module-level fusion Project callable for the donation probe (plan
+    callables are fingerprinted by qualified name; locals are rejected)."""
+    from spark_rapids_jni_tpu.columnar import Column, Table
+
+    c = tbl.column(0)
+    return Table([Column(c.dtype, c.data * 2, c.valid_mask())])
+
+
+def _fusion_block() -> dict:
+    """The BENCH_*.json ``fusion`` block: whole-stage fusion probe
+    (runtime/fusion.py). Runs q1 once as ONE fused region and once on the
+    staged op-by-op reference over the same batch, reporting steady-state
+    latency for both paths, executables compiled by each (the
+    ``dispatch.compile.fusion.*`` region counters vs the staged path's
+    per-op compiles), and the intermediate HBM bytes donation freed on a
+    caller-owned chunk (the out-of-core partial shape,
+    ``dispatch.donated_bytes``). Probe-sized (32K rows): it cannot
+    distort the measured config's numbers; it runs after the config
+    body. Like the pipeline block, it is only ever emitted by a live
+    measured child — a stale ledger record carries an empty block."""
+    block: dict = {}
+    try:
+        import numpy as np
+
+        from spark_rapids_jni_tpu.columnar import Column, Table
+        from spark_rapids_jni_tpu.models.tpch import (
+            lineitem_table,
+            tpch_q1,
+        )
+        from spark_rapids_jni_tpu.runtime import fusion
+        from spark_rapids_jni_tpu.telemetry import REGISTRY
+        from spark_rapids_jni_tpu.utils.config import (
+            reset_option,
+            set_option,
+        )
+
+        n, reps = 1 << 15, 5
+        li = lineitem_table(n)
+
+        def _compiles():
+            return sum(REGISTRY.counters("dispatch.compile.").values())
+
+        def _steady(run):
+            run()  # warm: compiles land outside the timed region
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = run()
+            np.asarray(out.column(0).data)  # sync bounds the loop
+            return (time.perf_counter() - t0) / reps
+
+        c0 = _compiles()
+        fused_s = _steady(lambda: tpch_q1(li))
+        fused_compiles = _compiles() - c0
+
+        set_option("fusion.enabled", False)
+        try:
+            c1 = _compiles()
+            staged_s = _steady(lambda: tpch_q1(li))
+            staged_compiles = _compiles() - c1
+        finally:
+            reset_option("fusion.enabled")
+
+        # donation probe: a caller-owned chunk declared dead rides
+        # donate_argnums into the fused executable
+        donated0 = fusion.stats()["donated_bytes"]
+        chunk = Table([Column.from_numpy(np.arange(n, dtype=np.int64))])
+        fusion.execute(
+            fusion.Plan("bench_donate_probe", fusion.Project(
+                fusion.Scan("chunk"), _fusion_probe_project)),
+            {"chunk": chunk}, donate_inputs=True)
+
+        st = fusion.stats()
+        block.update({
+            "probe_rows": n,
+            "fused_steady_state_s": round(fused_s, 6),
+            "staged_steady_state_s": round(staged_s, 6),
+            "fused_vs_staged": (round(staged_s / fused_s, 4)
+                                if fused_s else None),
+            "executables_fused": fused_compiles,
+            "executables_staged": staged_compiles,
+            "executables_per_query": st["executables_per_query"],
+            "regions": st["regions"],
+            "staged_regions": st["staged_regions"],
+            "nodes_fused": st["nodes_fused"],
+            "donated_bytes": st["donated_bytes"] - donated0,
+        })
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    return block
+
+
 def _ledger_last(metric: str, n: int):
     """Most recent ledger record for ``metric`` under the current
     measurement tag — preferring an exact row-count match (throughput is
@@ -1159,7 +1251,8 @@ def _child_main(config: str, n: int, iters: int) -> None:
         force_cpu_platform()
     value = _CONFIGS[config][0](n, iters)
     print(json.dumps({"value": value, "dispatch": _dispatch_block(),
-                      "pipeline": _pipeline_block()}))
+                      "pipeline": _pipeline_block(),
+                      "fusion": _fusion_block()}))
 
 
 # ---------------------------------------------------------------------------
@@ -1199,8 +1292,9 @@ def _probe_tpu(timeout_s: float) -> tuple[bool, str]:
 
 def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float):
     """Run the bench in a subprocess; returns (value | None, diagnostic,
-    dispatch block | None, pipeline block | None) — the blocks come from
-    the measured child process's executable cache and overlap probe."""
+    dispatch block | None, pipeline block | None, fusion block | None) —
+    the blocks come from the measured child process's executable cache,
+    overlap probe, and whole-stage fusion probe."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CONFIG"] = config
@@ -1218,7 +1312,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         )
     except subprocess.TimeoutExpired:
         return (None, f"{platform} bench timed out after {timeout_s:.0f}s",
-                None, None)
+                None, None, None)
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
@@ -1227,9 +1321,11 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
             continue
         disp = rec.get("dispatch") if isinstance(rec, dict) else None
         pipe = rec.get("pipeline") if isinstance(rec, dict) else None
+        fus = rec.get("fusion") if isinstance(rec, dict) else None
         return (value, "", disp if isinstance(disp, dict) else None,
-                pipe if isinstance(pipe, dict) else None)
-    return None, f"{platform} bench failed: {_tail(out)}", None, None
+                pipe if isinstance(pipe, dict) else None,
+                fus if isinstance(fus, dict) else None)
+    return None, f"{platform} bench failed: {_tail(out)}", None, None, None
 
 
 def main() -> None:
@@ -1248,6 +1344,7 @@ def main() -> None:
     diagnostics: list[str] = []
     child_disp = None
     child_pipe = None
+    child_fus = None
     # every run gets a telemetry file (children record through the package
     # via these env vars; the parent appends bench_stale events itself) —
     # restored afterwards so driving code / tests see their own env back
@@ -1285,7 +1382,7 @@ def main() -> None:
                 time.sleep(10)
                 ok, why = _probe_tpu(20)
             if ok:
-                value, why, child_disp, child_pipe = _run_child(
+                value, why, child_disp, child_pipe, child_fus = _run_child(
                     config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
@@ -1326,7 +1423,7 @@ def main() -> None:
                     "ledger_n": led.get("n"), "requested_n": n,
                 })
         if value is None:
-            value, why, child_disp, child_pipe = _run_child(
+            value, why, child_disp, child_pipe, child_fus = _run_child(
                 config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
@@ -1370,6 +1467,10 @@ def main() -> None:
     # overlap accounting for the pipelined out-of-core executor, same
     # child-process provenance as the dispatch block
     record["pipeline"] = child_pipe or {}
+    # whole-stage fusion accounting (fused vs staged latency, executables
+    # per query, donated bytes), same child-process provenance; empty when
+    # no live child ran (timeout / stale ledger record)
+    record["fusion"] = child_fus or {}
     if diagnostics:
         record["diagnostic"] = "; ".join(d for d in diagnostics if d)
     print(json.dumps(record))
@@ -1420,7 +1521,8 @@ def sweep() -> None:
             if config in single_size else sizes
         cfg_timeout = 240.0 if config == "tpch_q1_pallas" else timeout
         for n in cfg_sizes:
-            value, why, _disp, _pipe = _run_child(config, n, iters, "tpu", cfg_timeout)
+            value, why, _disp, _pipe, _fus = _run_child(
+                config, n, iters, "tpu", cfg_timeout)
             line = {"config": config, "metric": metric, "n": n,
                     "value": value, "unit": unit, "device_kind": kind}
             if value is not None:

@@ -1,10 +1,10 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its nine invariant rules (host/device
+# tpulint (tools/tpulint) runs its ten invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
-# pipeline-stage host-transfer)
+# pipeline-stage host-transfer, fusion-region host-sync)
 # over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
 # glob below covers the telemetry/ package alongside every other
 # subpackage.
@@ -75,4 +75,43 @@ assert len(piped) == 2 and all(
     (a == b).all() for a, b in zip(serial, piped)), "pipelined != serial"
 assert limiter.used == 0, f"leaked {limiter.used} reserved bytes"
 print("pipeline smoke OK: 2 chunks bit-identical, 0 leaked bytes")
+EOF
+
+# fusion smoke: rule 10 only proves fused-region callables don't SYNC to
+# the host — this proves the fuser itself still honors its contract:
+# building the q1 plan, running it fused, and diffing against the staged
+# op-by-op evaluation of the SAME plan must be bit-identical, with the
+# whole fused region costing exactly ONE compile.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+
+from spark_rapids_jni_tpu.models.tpch import lineitem_table, tpch_q1
+from spark_rapids_jni_tpu.runtime import dispatch, fusion
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+li = lineitem_table(200)
+
+fused = tpch_q1(li)
+regions = fusion.stats()
+assert regions["regions"] == 1 and regions["staged_regions"] == 0, regions
+compiles = sum(REGISTRY.counters("dispatch.compile.fusion.").values())
+assert compiles == 1, f"expected 1 fused compile, got {compiles}"
+
+set_option("fusion.enabled", False)
+dispatch.clear()
+try:
+    staged = tpch_q1(li)
+finally:
+    reset_option("fusion.enabled")
+
+for i in range(fused.num_columns):
+    fc, sc = fused.column(i), staged.column(i)
+    fv, sv = np.asarray(fc.valid_mask()), np.asarray(sc.valid_mask())
+    assert (fv == sv).all(), f"col {i} validity diverged"
+    assert (np.where(fv, np.asarray(fc.data), 0)
+            == np.where(sv, np.asarray(sc.data), 0)).all(), \
+        f"col {i} data diverged"
+print(f"fusion smoke OK: q1 fused == staged, {compiles} compile "
+      f"for the whole region")
 EOF

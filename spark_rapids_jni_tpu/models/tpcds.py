@@ -33,8 +33,8 @@ import numpy as np
 from spark_rapids_jni_tpu import types as t
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops.groupby import GroupByResult, groupby_aggregate
-from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
 from spark_rapids_jni_tpu.ops.sort import sort_table
+from spark_rapids_jni_tpu.runtime import fusion
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 # Composite-key packing bounds (data generators respect these).
@@ -162,6 +162,74 @@ def _null_keys_where(col: Column, drop: jnp.ndarray) -> Column:
 # ---- q72-style -------------------------------------------------------------
 
 
+def _q72_dd_fn(date_dim: Table, year: int) -> Table:
+    """date_dim build side with WHERE d_year = year pushed into the key
+    (wrong-year dates get null keys and never match)."""
+    dd_key = _null_keys_where(
+        date_dim.column(D_DATE_SK),
+        jnp.asarray(np.int32(year)) != date_dim.column(D_YEAR).data,
+    )
+    return Table([dd_key, date_dim.column(D_WEEK_SEQ)])
+
+
+def _q72_probe_fn(j2: Table) -> Table:
+    """sales x dates x items -> the composite (item, week) probe against
+    the inventory grain: [key, cs_item, cs_qty, i_item_sk, i_brand_id]."""
+    # j2: [cs_item, cs_date, cs_qty, cs_order, d_date_sk, d_week_seq,
+    #      i_item_sk, i_brand_id, i_category_id]
+    probe_key = _pack_key(
+        Column(t.INT64, j2.column(0).data, j2.column(0).valid_mask()),
+        Column(t.INT64, j2.column(5).data, j2.column(5).valid_mask()),
+        MAX_WEEKS,
+    )
+    return Table([probe_key] + [j2.column(i) for i in (0, 2, 6, 7)])
+
+
+def _q72_inv_fn(inventory: Table) -> Table:
+    """Inventory keyed by the packed (item, week) composite."""
+    inv_key = _pack_key(
+        inventory.column(INV_ITEM_SK), inventory.column(INV_WEEK_SEQ),
+        MAX_WEEKS,
+    )
+    return Table([inv_key, inventory.column(INV_QTY)])
+
+
+def _q72_keyed_fn(j3: Table) -> Table:
+    """WHERE inv_quantity_on_hand < cs_quantity, after the join."""
+    # j3: [key, cs_item, cs_qty, i_item_sk, i_brand, inv_key, inv_qty]
+    short = j3.column(6).data < j3.column(2).data
+    keep = j3.column(6).valid_mask() & j3.column(2).valid_mask() & short
+    return Table(
+        [
+            _null_keys_where(j3.column(3), ~keep),
+            _null_keys_where(j3.column(4), ~keep),
+            Column(t.INT64, j3.column(1).data, keep),
+        ]
+    )
+
+
+def _q72_plan(year: int, out_factor: int) -> fusion.Plan:
+    """q72 as ONE fused region: three joins + post-filter + group-count +
+    order-by (the staged path compiled each join and the groupby/sort as
+    separate executables)."""
+    cs = fusion.Scan("catalog_sales")
+    dd = fusion.Project(fusion.Scan("date_dim"), _q72_dd_fn, (year,))
+    j1 = fusion.Join(cs, dd, (CS_SOLD_DATE_SK,), (0,),
+                     fusion.rows_of("catalog_sales"), label="join1")
+    j2 = fusion.Join(j1, fusion.Scan("item"), (0,), (I_ITEM_SK,),
+                     fusion.rows_of("catalog_sales"), label="join2")
+    probe = fusion.Project(j2, _q72_probe_fn)
+    inv = fusion.Project(fusion.Scan("inventory"), _q72_inv_fn)
+    j3 = fusion.Join(probe, inv, (0,), (0,),
+                     fusion.rows_of("catalog_sales", out_factor),
+                     label="join3")
+    g = fusion.GroupBy(fusion.Project(j3, _q72_keyed_fn), (0, 1),
+                       ((2, "count"),), label="groupby")
+    # ORDER BY count desc, item asc — q72's shape
+    return fusion.Plan("tpcds_q72", fusion.Sort(
+        g, (2, 0), ascending=(False, True), nulls_first=(False, False)))
+
+
 @func_range("tpcds_q72")
 def tpcds_q72(
     catalog_sales: Table,
@@ -175,57 +243,11 @@ def tpcds_q72(
     the sale's week was below the ordered quantity (the q72 core: does the
     warehouse run short). Returns groups (i_item_sk, i_brand_id, count)
     padded; callers compact() on host."""
-    n_cs = catalog_sales.num_rows
-
-    # catalog_sales |x| date_dim, with WHERE d_year = year pushed into the
-    # build side's key (wrong-year dates get null keys and never match).
-    dd_key = _null_keys_where(
-        date_dim.column(D_DATE_SK),
-        jnp.asarray(np.int32(year)) != date_dim.column(D_YEAR).data,
-    )
-    dd = Table([dd_key, date_dim.column(D_WEEK_SEQ)])
-    m1 = join(catalog_sales, dd, CS_SOLD_DATE_SK, 0, out_size=n_cs)
-    j1 = apply_join_maps(catalog_sales, dd, m1)
-    # j1: [cs_item, cs_date, cs_qty, cs_order, d_date_sk, d_week_seq]
-
-    m2 = join(j1, item, 0, I_ITEM_SK, out_size=n_cs)
-    j2 = apply_join_maps(j1, item, m2)
-    # j2: [...j1..., i_item_sk, i_brand_id, i_category_id]
-
-    # composite (item, week) against the inventory grain
-    probe_key = _pack_key(
-        Column(t.INT64, j2.column(0).data, j2.column(0).valid_mask()),
-        Column(t.INT64, j2.column(5).data, j2.column(5).valid_mask()),
-        MAX_WEEKS,
-    )
-    probe = Table([probe_key] + [j2.column(i) for i in (0, 2, 6, 7)])
-    # probe: [key, cs_item, cs_qty, i_item_sk, i_brand_id]
-    inv_key = _pack_key(
-        inventory.column(INV_ITEM_SK), inventory.column(INV_WEEK_SEQ),
-        MAX_WEEKS,
-    )
-    inv = Table([inv_key, inventory.column(INV_QTY)])
-    m3 = join(probe, inv, 0, 0, out_size=n_cs * out_factor)
-    j3 = apply_join_maps(probe, inv, m3)
-    # j3: [key, cs_item, cs_qty, i_item_sk, i_brand, inv_key, inv_qty]
-
-    # WHERE inv_quantity_on_hand < cs_quantity, after the join
-    short = j3.column(6).data < j3.column(2).data
-    keep = j3.column(6).valid_mask() & j3.column(2).valid_mask() & short
-    keyed = Table(
-        [
-            _null_keys_where(j3.column(3), ~keep),
-            _null_keys_where(j3.column(4), ~keep),
-            Column(t.INT64, j3.column(1).data, keep),
-        ]
-    )
-    grouped = groupby_aggregate(keyed, keys=[0, 1], aggs=[(2, "count")])
-    # ORDER BY count desc, item asc — q72's shape
-    srt = sort_table(
-        grouped.table, [2, 0], ascending=[False, True],
-        nulls_first=[False, False],
-    )
-    return GroupByResult(srt, grouped.num_groups)
+    res = fusion.execute(
+        _q72_plan(year, out_factor),
+        {"catalog_sales": catalog_sales, "date_dim": date_dim,
+         "item": item, "inventory": inventory})
+    return GroupByResult(res.table, res.meta["groupby.num_groups"])
 
 
 class Q72PlannedResult(NamedTuple):
@@ -416,12 +438,14 @@ def tpcds_q72_distributed(
     from jax.sharding import PartitionSpec as P
 
     from spark_rapids_jni_tpu.parallel.distributed import (
+        _mesh_fingerprint,
         collect,
         head_table,
         shard_table,
     )
     from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
     from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
+    from spark_rapids_jni_tpu.runtime import dispatch
 
     sharded = shard_table(catalog_sales, mesh)
 
@@ -438,11 +462,16 @@ def tpcds_q72_distributed(
         return (merged.table, merged.num_groups.reshape(1),
                 partial.num_groups.reshape(1))
 
-    out, num_groups, partial_groups = _jax.jit(_jax.shard_map(
-        step, mesh=mesh,
-        in_specs=(P(EXEC_AXIS), P(), P(), P()),
-        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
-    ))(sharded, date_dim, item, inventory)
+    out, num_groups, partial_groups = dispatch.sharded_call(
+        "tpcds_q72_distributed.step",
+        lambda: _jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P(EXEC_AXIS), P(), P(), P()),
+            out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+        ),
+        (sharded, date_dim, item, inventory),
+        statics=(year, out_factor, group_budget, _mesh_fingerprint(mesh)),
+    )
     if int(np.max(np.asarray(partial_groups))) > group_budget:
         raise ValueError(
             "per-device q72 group count exceeded the shuffle budget "
@@ -453,6 +482,63 @@ def tpcds_q72_distributed(
 
 
 # ---- q64-style -------------------------------------------------------------
+
+
+def _q64_year_slice(store_sales: Table, year: int, num_days_per_year: int,
+                    base_year: int, keep_item: bool) -> Table:
+    """One side of the cross-year self-join: the packed (item, customer)
+    composite key, nulled outside ``year``."""
+    date = store_sales.column(SS_SOLD_DATE_SK).data
+    yr = (date - 1) // jnp.int64(num_days_per_year)
+    key = _pack_key(
+        store_sales.column(SS_ITEM_SK), store_sales.column(SS_CUSTOMER_SK),
+        MAX_CUSTOMERS,
+    )
+    cols = [_null_keys_where(key, yr != (year - base_year))]
+    if keep_item:
+        cols.append(store_sales.column(SS_ITEM_SK))
+    return Table(cols)
+
+
+def _q64_left_fn(store_sales: Table, year1: int, num_days_per_year: int,
+                 base_year: int) -> Table:
+    return _q64_year_slice(store_sales, year1, num_days_per_year,
+                           base_year, keep_item=True)
+
+
+def _q64_right_fn(store_sales: Table, year2: int, num_days_per_year: int,
+                  base_year: int) -> Table:
+    return _q64_year_slice(store_sales, year2, num_days_per_year,
+                           base_year, keep_item=False)
+
+
+def _q64_keyed_fn(joined: Table) -> Table:
+    # joined: [key_y1, ss_item, key_y2]; matched rows = repeat purchases
+    keep = joined.column(2).valid_mask()
+    return Table(
+        [
+            _null_keys_where(joined.column(1), ~keep),
+            Column(t.INT64, joined.column(0).data, keep),
+        ]
+    )
+
+
+def _q64_plan(year1: int, year2: int, num_days_per_year: int,
+              base_year: int, out_factor: int) -> fusion.Plan:
+    """q64's cross-year self-join as one fused region. Both Projects hang
+    off the SAME Scan node — the store_sales table binds (and buckets)
+    once, which the staged path could not express."""
+    ss = fusion.Scan("store_sales")
+    left = fusion.Project(ss, _q64_left_fn,
+                          (year1, num_days_per_year, base_year))
+    right = fusion.Project(ss, _q64_right_fn,
+                           (year2, num_days_per_year, base_year))
+    j = fusion.Join(left, right, (0,), (0,),
+                    fusion.rows_of("store_sales", out_factor), label="join")
+    g = fusion.GroupBy(fusion.Project(j, _q64_keyed_fn), (0,),
+                       ((1, "count"),), label="groupby")
+    return fusion.Plan("tpcds_q64", fusion.Sort(
+        g, (1, 0), ascending=(False, True), nulls_first=(False, False)))
 
 
 class Q64Result(NamedTuple):
@@ -477,37 +563,12 @@ def tpcds_q64(
     date_sk=1 (store_sales_table emits days 1..num_days); check
     ``join_total <= out_size`` on host — duplicate (item, customer) pairs
     multiply, so the self-join is not structurally bounded."""
-    n = store_sales.num_rows
-    date = store_sales.column(SS_SOLD_DATE_SK).data
-    yr = (date - 1) // jnp.int64(num_days_per_year)
-    in_y1 = yr == (year1 - base_year)
-    in_y2 = yr == (year2 - base_year)
-
-    key = _pack_key(
-        store_sales.column(SS_ITEM_SK), store_sales.column(SS_CUSTOMER_SK),
-        MAX_CUSTOMERS,
-    )
-    left = Table(
-        [_null_keys_where(key, ~in_y1), store_sales.column(SS_ITEM_SK)]
-    )
-    right = Table([_null_keys_where(key, ~in_y2)])
-    maps = join(left, right, 0, 0, out_size=n * out_factor)
-    joined = apply_join_maps(left, right, maps)
-    # joined: [key_y1, ss_item, key_y2]; matched rows = repeat purchases
-    keep = joined.column(2).valid_mask()
-    keyed = Table(
-        [
-            _null_keys_where(joined.column(1), ~keep),
-            Column(t.INT64, joined.column(0).data, keep),
-        ]
-    )
-    grouped = groupby_aggregate(keyed, keys=[0], aggs=[(1, "count")])
-    srt = sort_table(
-        grouped.table, [1, 0], ascending=[False, True],
-        nulls_first=[False, False],
-    )
+    res = fusion.execute(
+        _q64_plan(year1, year2, num_days_per_year, base_year, out_factor),
+        {"store_sales": store_sales})
     return Q64Result(
-        GroupByResult(srt, grouped.num_groups), maps.total, n * out_factor
+        GroupByResult(res.table, res.meta["groupby.num_groups"]),
+        res.meta["join.total"], store_sales.num_rows * out_factor,
     )
 
 
@@ -531,6 +592,7 @@ def tpcds_q64_distributed(
     from jax.sharding import PartitionSpec as P
 
     from spark_rapids_jni_tpu.parallel.distributed import (
+        _mesh_fingerprint,
         collect,
         distributed_join,
         head_table,
@@ -538,6 +600,7 @@ def tpcds_q64_distributed(
     )
     from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
     from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
+    from spark_rapids_jni_tpu.runtime import dispatch
 
     n = store_sales.num_rows
     date = np.asarray(store_sales.column(SS_SOLD_DATE_SK).data)
@@ -588,10 +651,15 @@ def tpcds_q64_distributed(
         return (merged.table, merged.num_groups.reshape(1),
                 partial.num_groups.reshape(1))
 
-    out, num_groups, partial_groups = _jax.jit(_jax.shard_map(
-        count_step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
-        out_specs=(P(EXEC_AXIS),) * 3,
-    ))(res.table)
+    out, num_groups, partial_groups = dispatch.sharded_call(
+        "tpcds_q64_distributed.count_step",
+        lambda: _jax.shard_map(
+            count_step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
+            out_specs=(P(EXEC_AXIS),) * 3,
+        ),
+        (res.table,),
+        statics=(group_budget, _mesh_fingerprint(mesh)),
+    )
     if int(np.max(np.asarray(partial_groups))) > group_budget:
         raise ValueError(
             "per-device q64 group count exceeded the shuffle budget "
@@ -628,8 +696,12 @@ def tpcds_q72_planned_distributed(
         dense_id_counts,
         dense_pk_join,
     )
-    from spark_rapids_jni_tpu.parallel.distributed import shard_table
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        _mesh_fingerprint,
+        shard_table,
+    )
     from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+    from spark_rapids_jni_tpu.runtime import dispatch
 
     num_days = date_dim.num_rows
     num_items = item.num_rows
@@ -680,12 +752,17 @@ def tpcds_q72_planned_distributed(
             .astype(jnp.int32), EXEC_AXIS) > 0
         return counts, viol
 
-    counts, viol = _jax.jit(_jax.shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(), P(), P()),
-        out_specs=(P(), P()),
-    ))(sharded, rv, dd, item, inventory)
+    counts, viol = dispatch.sharded_call(
+        "tpcds_q72_planned_distributed.step",
+        lambda: _jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(), P(), P()),
+            out_specs=(P(), P()),
+        ),
+        (sharded, rv, dd, item, inventory),
+        statics=(num_days, num_items, num_weeks, _mesh_fingerprint(mesh)),
+    )
 
     present = counts > 0
     item_sk = jnp.arange(1, num_items + 1, dtype=jnp.int64)

@@ -33,6 +33,7 @@ from spark_rapids_jni_tpu import types as t
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops.groupby import GroupByResult, groupby_aggregate
 from spark_rapids_jni_tpu.ops.sort import sort_table
+from spark_rapids_jni_tpu.runtime import fusion
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 # lineitem columns used by q1 (positions in the table below)
@@ -180,9 +181,22 @@ def _q1_work_table(lineitem: Table) -> Table:
     )
 
 
+def _q1_plan() -> fusion.Plan:
+    """q1 as ONE fusible region: filter+derive -> groupby -> sort. The
+    filtered-out pseudo-group has null keys; q1's ORDER BY puts real
+    groups first (nulls last) so the compacted head is the answer."""
+    return fusion.Plan("tpch_q1", fusion.Sort(
+        fusion.GroupBy(
+            fusion.Project(fusion.Scan("lineitem"), _q1_work_table),
+            (0, 1), tuple(_Q1_AGGS), max_groups=_Q1_GROUP_BUDGET,
+            label="groupby"),
+        (0, 1), nulls_first=(False, False)))
+
+
 @func_range("tpch_q1")
 def tpch_q1(lineitem: Table) -> Table:
-    """Single-executor q1: filter -> derived columns -> groupby -> sort.
+    """Single-executor q1: filter -> derived columns -> groupby -> sort,
+    compiled as one fused executable (runtime/fusion.py).
 
     The group budget is part of the query plan, the way Spark's planner
     carries a cardinality estimate: q1 groups by two CHAR(1) flags, <= 7
@@ -191,13 +205,7 @@ def tpch_q1(lineitem: Table) -> Table:
     groups are dropped — jitted code cannot raise on a device predicate;
     use ``tpch_q1_checked`` from host code to turn overflow into an error.
     """
-    work = _q1_work_table(lineitem)
-    grouped = groupby_aggregate(
-        work, keys=[0, 1], aggs=_Q1_AGGS, max_groups=_Q1_GROUP_BUDGET
-    )
-    # The filtered-out pseudo-group has null keys; q1's ORDER BY puts real
-    # groups first (nulls last) so the compacted head is the answer.
-    return sort_table(grouped.table, [0, 1], nulls_first=[False, False])
+    return fusion.execute(_q1_plan(), {"lineitem": lineitem}).table
 
 
 # TPC-H DDL domains for the q1 flags (the spec fixes returnflag to
@@ -219,13 +227,19 @@ def tpch_q1_planned_result(lineitem: Table):
     checked and unchecked wrappers below. Lowered through the general
     planner facility (ops/planner.plan_groupby) — q1 is just the first
     client of the declared-domain plan, not a special case."""
-    work = _q1_work_table(lineitem)
-    from spark_rapids_jni_tpu.ops.planner import plan_groupby, scalar_domain
+    from spark_rapids_jni_tpu.ops.planner import PlannedGroupBy, scalar_domain
 
-    res = plan_groupby(
-        work, keys=[0, 1], aggs=_Q1_AGGS,
-        domains=[scalar_domain(_Q1_RF_DOMAIN), scalar_domain(_Q1_LS_DOMAIN)],
-    )
+    plan = fusion.Plan("tpch_q1_planned", fusion.GroupBy(
+        fusion.Project(fusion.Scan("lineitem"), _q1_work_table),
+        (0, 1), tuple(_Q1_AGGS),
+        domains=(scalar_domain(_Q1_RF_DOMAIN),
+                 scalar_domain(_Q1_LS_DOMAIN)),
+        label="plan"))
+    out = fusion.execute(plan, {"lineitem": lineitem})
+    res = PlannedGroupBy(out.table, out.meta["plan.present"],
+                         out.meta["plan.domain_miss"],
+                         out.meta["plan.lowered"],
+                         out.meta["plan.overflowed"])
     assert res.lowered == "bounded"  # static plan fact, not a data check
     return res
 
@@ -250,17 +264,14 @@ def tpch_q1_planned_checked(lineitem: Table) -> Table:
 def tpch_q1_checked(lineitem: Table) -> Table:
     """Host-side q1 wrapper that enforces the plan's group-budget contract
     (raises instead of silently dropping groups on out-of-contract data)."""
-    work = _q1_work_table(lineitem)
-    grouped = groupby_aggregate(
-        work, keys=[0, 1], aggs=_Q1_AGGS, max_groups=_Q1_GROUP_BUDGET
-    )
-    if bool(grouped.overflowed):
+    res = fusion.execute(_q1_plan(), {"lineitem": lineitem})
+    if bool(res.meta["groupby.overflowed"]):
         raise ValueError(
             f"q1 key domain exceeded the plan's group budget "
-            f"({int(grouped.num_groups)} > {_Q1_GROUP_BUDGET}): the "
-            "returnflag/linestatus bytes are outside the TPC-H contract"
+            f"({int(res.meta['groupby.num_groups'])} > {_Q1_GROUP_BUDGET}): "
+            "the returnflag/linestatus bytes are outside the TPC-H contract"
         )
-    return sort_table(grouped.table, [0, 1], nulls_first=[False, False])
+    return res.table
 
 
 # TPC-H q6 predicate constants: shipdate in [1994-01-01, 1995-01-01) as
@@ -271,6 +282,28 @@ _Q6_DATE_HI = 9131
 _Q6_DISC_LO = 5
 _Q6_DISC_HI = 7
 _Q6_QTY_HI = 2400
+
+
+def _q6_reduce(lineitem: Table, row_valid) -> Table:
+    """q6's masked multiply-accumulate as a fusion Project (rowwise=False
+    — the 1-row output is its own space). Region-padded phantom rows have
+    null validity everywhere, so ``sel`` already excludes them and
+    ``row_valid`` needs no explicit fold."""
+    qty = lineitem.column(L_QUANTITY)
+    price = lineitem.column(L_EXTENDEDPRICE)
+    disc = lineitem.column(L_DISCOUNT)
+    ship = lineitem.column(L_SHIPDATE)
+    sel = (
+        qty.valid_mask() & price.valid_mask() & disc.valid_mask()
+        & ship.valid_mask()
+        & (ship.data >= _Q6_DATE_LO) & (ship.data < _Q6_DATE_HI)
+        & (disc.data >= _Q6_DISC_LO) & (disc.data <= _Q6_DISC_HI)
+        & (qty.data < _Q6_QTY_HI)
+    )
+    prod = jnp.where(sel, price.data * disc.data, jnp.int64(0))
+    total = jnp.sum(prod).reshape(1)
+    any_row = jnp.any(sel).reshape(1)
+    return Table([Column(t.decimal64(-4), total, any_row)])
 
 
 @func_range("tpch_q6")
@@ -286,24 +319,14 @@ def tpch_q6(lineitem: Table) -> Column:
     ~9e18, i.e. ~8.7e10 matched rows at TPC-H value ranges — far beyond
     any single-chip batch, so no 128-bit lanes are needed (unlike the
     general DECIMAL128 SUM path, which this plan deliberately avoids).
+    As a one-node fused region the whole scan+reduce is a single bucketed
+    executable instead of a chain of eager XLA calls.
 
     Returns a 1-row DECIMAL64(scale -4) column (null iff no row matched).
     """
-    qty = lineitem.column(L_QUANTITY)
-    price = lineitem.column(L_EXTENDEDPRICE)
-    disc = lineitem.column(L_DISCOUNT)
-    ship = lineitem.column(L_SHIPDATE)
-    sel = (
-        qty.valid_mask() & price.valid_mask() & disc.valid_mask()
-        & ship.valid_mask()
-        & (ship.data >= _Q6_DATE_LO) & (ship.data < _Q6_DATE_HI)
-        & (disc.data >= _Q6_DISC_LO) & (disc.data <= _Q6_DISC_HI)
-        & (qty.data < _Q6_QTY_HI)
-    )
-    prod = jnp.where(sel, price.data * disc.data, jnp.int64(0))
-    total = jnp.sum(prod).reshape(1)
-    any_row = jnp.any(sel).reshape(1)
-    return Column(t.decimal64(-4), total, any_row)
+    plan = fusion.Plan("tpch_q6", fusion.Project(
+        fusion.Scan("lineitem"), _q6_reduce, rowwise=False))
+    return fusion.execute(plan, {"lineitem": lineitem}).table.column(0)
 
 
 def tpch_q6_numpy(lineitem: Table) -> int:
@@ -389,37 +412,63 @@ def _q1_finalize(merged: Table) -> Table:
     )
 
 
+# Merge-side aggregates over the partial layout: every partial lane sums
+# associatively across the shuffle / chunk axis.
+_Q1_MERGE_AGGS = tuple((i, "sum") for i in range(2, 10))
+
+
+def _q1_partial_plan() -> fusion.Plan:
+    """Per-chunk / per-executor q1 partial: work-table projection + the
+    budget-bounded partial groupby, fused. ``min_rows_of`` reproduces the
+    staged ``min(_Q1_GROUP_BUDGET, work.num_rows)`` budget from the TRUE
+    chunk row count (never the bucket)."""
+    return fusion.Plan("tpch_q1_partial", fusion.GroupBy(
+        fusion.Project(fusion.Scan("chunk"), _q1_work_table),
+        (0, 1), tuple(_Q1_PARTIAL_AGGS),
+        max_groups=fusion.min_rows_of("chunk", _Q1_GROUP_BUDGET),
+        label="partial"))
+
+
+def _q1_merge_plan() -> fusion.Plan:
+    """Merge the stacked partials: sum-merge groupby -> finalize
+    (avgs = sum/count) -> output order, fused."""
+    return fusion.Plan("tpch_q1_merge", fusion.Sort(
+        fusion.Project(
+            fusion.GroupBy(fusion.Scan("partials"), (0, 1), _Q1_MERGE_AGGS,
+                           label="merge"),
+            _q1_finalize),
+        (0, 1), nulls_first=(False, False)))
+
+
 def q1_distributed_step(local: Table):
     """Per-executor q1 step; must run inside shard_map over EXEC_AXIS.
 
     local partial groupby -> head-truncate to the group budget -> ICI
     all-to-all shuffle by (returnflag, linestatus) -> merge groupby.
-    Afterward each executor owns a disjoint slice of the key space.
+    Afterward each executor owns a disjoint slice of the key space. Both
+    halves are the SAME fusion plans the out-of-core path runs; inside
+    the shard_map trace ``fusion.execute`` takes its staged walk (tracer
+    inputs), so the region boundary at the shuffle is explicit.
     """
     from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
     from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
 
-    work = _q1_work_table(local)
-    budget = min(_Q1_GROUP_BUDGET, work.num_rows)
+    budget = min(_Q1_GROUP_BUDGET, local.num_rows)
     # the budget-bounded partial IS the head truncation: its output is
     # padded to exactly `budget` rows, real groups first
-    partial = groupby_aggregate(work, keys=[0, 1], aggs=_Q1_PARTIAL_AGGS,
-                                max_groups=budget)
+    partial = fusion.execute(_q1_partial_plan(), {"chunk": local})
     # only the real groups cross the wire: the budget-padding rows (null
     # keys, zero aggregates) would all hash to one partition and waste the
     # null-key receiver's capacity on ~90% phantom payload
-    real = jnp.arange(budget, dtype=jnp.int32) < partial.num_groups
+    real = (jnp.arange(budget, dtype=jnp.int32)
+            < partial.meta["partial.num_groups"])
     sh = hash_shuffle(partial.table, [0, 1], EXEC_AXIS, capacity=budget,
                       row_valid=real)
     # merge with max_groups=None: m = the shuffle buffer size (every sender
     # contributed <= budget rows), which can never overflow — the receiving
     # device may own up to sender_count * budget distinct partial groups
-    merged = groupby_aggregate(
-        sh.table, keys=[0, 1], aggs=[(i, "sum") for i in range(2, 10)]
-    )
-    final = _q1_finalize(merged.table)
-    final = sort_table(final, [0, 1], nulls_first=[False, False])
-    return final, merged.num_groups.reshape(1)
+    merged = fusion.execute(_q1_merge_plan(), {"partials": sh.table})
+    return merged.table, merged.meta["merge.num_groups"].reshape(1)
 
 
 def tpch_q1_distributed(lineitem: Table, mesh) -> Table:
@@ -429,19 +478,26 @@ def tpch_q1_distributed(lineitem: Table, mesh) -> Table:
     import jax as _jax
     from jax.sharding import PartitionSpec as P
 
-    from spark_rapids_jni_tpu.parallel.distributed import collect, shard_table
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        _mesh_fingerprint,
+        collect,
+        shard_table,
+    )
     from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+    from spark_rapids_jni_tpu.runtime import dispatch
 
     sharded = shard_table(lineitem, mesh)
-    step = _jax.jit(
-        _jax.shard_map(
+    per_dev, num_groups = dispatch.sharded_call(
+        "tpch_q1_distributed.step",
+        lambda: _jax.shard_map(
             q1_distributed_step,
             mesh=mesh,
             in_specs=(P(EXEC_AXIS),),
             out_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
-        )
+        ),
+        (sharded,),
+        statics=(_mesh_fingerprint(mesh),),
     )
-    per_dev, num_groups = step(sharded)
     result = collect(per_dev, num_groups, mesh)
     return sort_table(result, [0, 1], nulls_first=[False, False])
 
@@ -471,9 +527,13 @@ def tpch_q1_outofcore(path, *, budget_bytes: int,
     decode overlaps device compute through the reader's chunk thunks,
     exact-bytes admission blocks instead of raising, and results stay
     bit-identical to the serial path.
-    """
-    import jax as _jax
 
+    Both device halves are fused regions (the q1 partial / merge plans
+    shared with the distributed step); the host-side ``trim_table``
+    compaction between them is the genuine region boundary. Chunk tables
+    are DEAD after their partial (nothing else reads them), so the
+    partial region donates them back to XLA.
+    """
     from spark_rapids_jni_tpu.parquet.reader import ParquetChunkedReader
     from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter, SpillStore
     from spark_rapids_jni_tpu.runtime.outofcore import run_chunked_aggregate
@@ -490,33 +550,24 @@ def tpch_q1_outofcore(path, *, budget_bytes: int,
             cols[i] = Column(money, cols[i].data, cols[i].validity)
         return Table(cols)
 
-    @_jax.jit
-    def _partial(chunk: Table):
-        work = _q1_work_table(chunk)
-        budget = min(_Q1_GROUP_BUDGET, work.num_rows)
-        g = groupby_aggregate(work, keys=[0, 1], aggs=_Q1_PARTIAL_AGGS,
-                              max_groups=budget)
-        return g.table, g.num_groups, g.overflowed
-
     def partial_fn(chunk: Table) -> Table:
         from spark_rapids_jni_tpu.ops.table_ops import trim_table
 
-        tbl, num_groups, overflowed = _partial(_retype(chunk))
-        if bool(overflowed):
+        res = fusion.execute(_q1_partial_plan(),
+                             {"chunk": _retype(chunk)},
+                             donate_inputs=True)
+        if bool(res.meta["partial.overflowed"]):
             raise ValueError(
                 "q1 chunk exceeded the plan's group budget "
                 f"({_Q1_GROUP_BUDGET}): flag bytes outside the contract")
-        # host-side compaction between jitted regions: only real groups
+        # host-side compaction between fused regions: only real groups
         # cross into the merge (chunk boundaries are where dynamic
         # shapes cost nothing — the q1_distributed_step row_valid idea)
-        return trim_table(tbl, int(num_groups))
+        return trim_table(res.table, int(res.meta["partial.num_groups"]))
 
     def merge_fn(partials: Table) -> Table:
-        merged = groupby_aggregate(
-            partials, keys=[0, 1],
-            aggs=[(i, "sum") for i in range(2, 10)])
-        final = _q1_finalize(merged.table)
-        return sort_table(final, [0, 1], nulls_first=[False, False])
+        # NOT donated: the SpillStore may still hold the partials buffer
+        return fusion.execute(_q1_merge_plan(), {"partials": partials}).table
 
     reader = ParquetChunkedReader(path, chunk_read_limit=chunk_read_limit)
     # the reader (not iter(reader)) so the pipelined executor can pick up
@@ -598,23 +649,27 @@ def _null_where(c: Column, drop: jnp.ndarray) -> Column:
                   chars=c.chars, children=c.children)
 
 
-def _q3_inputs(customer: Table, orders: Table, lineitem: Table,
-               segment: int, cutoff: int):
-    """Shared q3 filtered inputs for BOTH plans (single change point for
-    predicates/scales): segment-filtered customer keys, date-filtered
-    orders, and the shipdate-filtered lineitem probe with its revenue
-    lane. Returns (cust, ord_t, probe)."""
-    cust = Table([_null_where(
+def _q3_cust_fn(customer: Table, segment: int) -> Table:
+    """Segment-filtered customer keys (q3 plan Project node)."""
+    return Table([_null_where(
         customer.column(C_CUSTKEY),
         customer.column(C_MKTSEGMENT).data != jnp.int8(segment),
     )])
+
+
+def _q3_orders_fn(orders: Table, cutoff: int) -> Table:
+    """Date-filtered orders with custkey join lane (q3 plan Project)."""
     okey = _null_where(
         orders.column(O_CUSTKEY),
         orders.column(O_ORDERDATE).data >= jnp.int32(cutoff),
     )
-    ord_t = Table([okey, orders.column(O_ORDERKEY),
-                   orders.column(O_ORDERDATE),
-                   orders.column(O_SHIPPRIORITY)])
+    return Table([okey, orders.column(O_ORDERKEY),
+                  orders.column(O_ORDERDATE),
+                  orders.column(O_SHIPPRIORITY)])
+
+
+def _q3_probe_fn(lineitem: Table, cutoff: int) -> Table:
+    """Shipdate-filtered lineitem probe with its revenue lane."""
     lkey = _null_where(
         lineitem.column(L3_ORDERKEY),
         lineitem.column(L3_SHIPDATE).data <= jnp.int32(cutoff),
@@ -625,30 +680,62 @@ def _q3_inputs(customer: Table, orders: Table, lineitem: Table,
         t.decimal64(-4), price.data * (100 - disc.data),
         price.valid_mask() & disc.valid_mask(),
     )
-    probe = Table([lkey, revenue])
-    return cust, ord_t, probe
+    return Table([lkey, revenue])
 
 
-def _q3_joined(customer: Table, orders: Table, lineitem: Table,
-               segment: int, cutoff: int, out_factor: int):
-    """Single-executor q3 front: both joins. Returns
-    (joined lineitem x orders table, join maps total, out cap)."""
-    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+def _q3_inputs(customer: Table, orders: Table, lineitem: Table,
+               segment: int, cutoff: int):
+    """Shared q3 filtered inputs for BOTH plans (single change point for
+    predicates/scales): segment-filtered customer keys, date-filtered
+    orders, and the shipdate-filtered lineitem probe with its revenue
+    lane. Returns (cust, ord_t, probe). The per-table pieces are the
+    module-level fns above so the fusion plans can reference them as
+    Project nodes."""
+    return (_q3_cust_fn(customer, segment),
+            _q3_orders_fn(orders, cutoff),
+            _q3_probe_fn(lineitem, cutoff))
 
-    cust, ord_t, probe = _q3_inputs(customer, orders, lineitem, segment,
-                                    cutoff)
-    m1 = join(ord_t, cust, 0, 0, out_size=orders.num_rows)
-    oc = apply_join_maps(ord_t, cust, m1)
+
+def _q3_build_fn(oc: Table) -> Table:
+    """orders x customer join output -> second-join build side:
+    [orderkey (nulled where unmatched), orderdate, shippriority]."""
     # oc: [o_custkey, o_orderkey, o_orderdate, o_shippriority, c_custkey]
     matched = oc.column(4).valid_mask()
     oc_key = _null_where(oc.column(1), ~matched)
-    build = Table([oc_key, oc.column(2), oc.column(3)])
+    return Table([oc_key, oc.column(2), oc.column(3)])
 
-    out_cap = lineitem.num_rows * out_factor
-    m2 = join(probe, build, 0, 0, out_size=out_cap)
-    j = apply_join_maps(probe, build, m2)
+
+def _q3_keyed_fn(j: Table) -> Table:
+    """lineitem x orders join output -> groupby-keyed table
+    [l_orderkey, o_orderdate, o_shippriority, revenue], unmatched rows
+    nulled in every lane."""
     # j: [l_orderkey, revenue, o_orderkey, o_orderdate, o_shippriority]
-    return j, m2.total, out_cap
+    matched = j.column(2).valid_mask()
+    return Table([
+        _null_where(j.column(0), ~matched),
+        _null_where(j.column(3), ~matched),
+        _null_where(j.column(4), ~matched),
+        Column(j.column(1).dtype, j.column(1).data,
+               j.column(1).valid_mask() & matched),
+    ])
+
+
+def _q3_plan(segment: int, cutoff: int, out_factor: int) -> fusion.Plan:
+    """Single-executor q3 as ONE fused region: filter all three inputs,
+    orders x customer join, lineitem x orders join, groupby, order-by —
+    nine nodes, one executable (the staged path compiled five)."""
+    cust = fusion.Project(fusion.Scan("customer"), _q3_cust_fn, (segment,))
+    ord_n = fusion.Project(fusion.Scan("orders"), _q3_orders_fn, (cutoff,))
+    probe = fusion.Project(fusion.Scan("lineitem"), _q3_probe_fn, (cutoff,))
+    j1 = fusion.Join(ord_n, cust, (0,), (0,), fusion.rows_of("orders"),
+                     label="join1")
+    build = fusion.Project(j1, _q3_build_fn)
+    j2 = fusion.Join(probe, build, (0,), (0,),
+                     fusion.rows_of("lineitem", out_factor), label="join2")
+    g = fusion.GroupBy(fusion.Project(j2, _q3_keyed_fn), (0, 1, 2),
+                       ((3, "sum"),), label="groupby")
+    return fusion.Plan("tpch_q3", fusion.Sort(
+        g, (3, 1), ascending=(False, True), nulls_first=(False, False)))
 
 
 class Q3Result(NamedTuple):
@@ -665,22 +752,12 @@ def tpch_q3(customer: Table, orders: Table, lineitem: Table,
     [l_orderkey, o_orderdate, o_shippriority, revenue] padded; callers
     compact + head for the LIMIT, and check ``join_total <= out_cap`` on
     host (join_auto pattern) — exceeding it means matches were dropped."""
-    j, total, cap = _q3_joined(customer, orders, lineitem, segment,
-                               cutoff, out_factor)
-    matched = j.column(2).valid_mask()
-    keyed = Table([
-        _null_where(j.column(0), ~matched),
-        _null_where(j.column(3), ~matched),
-        _null_where(j.column(4), ~matched),
-        Column(j.column(1).dtype, j.column(1).data,
-               j.column(1).valid_mask() & matched),
-    ])
-    grouped = groupby_aggregate(keyed, keys=[0, 1, 2], aggs=[(3, "sum")])
-    srt = sort_table(
-        grouped.table, [3, 1], ascending=[False, True],
-        nulls_first=[False, False],
-    )
-    return Q3Result(GroupByResult(srt, grouped.num_groups), total, cap)
+    res = fusion.execute(
+        _q3_plan(segment, cutoff, out_factor),
+        {"customer": customer, "orders": orders, "lineitem": lineitem})
+    return Q3Result(
+        GroupByResult(res.table, res.meta["groupby.num_groups"]),
+        res.meta["join2.total"], lineitem.num_rows * out_factor)
 
 
 class Q3PlannedResult(NamedTuple):
@@ -689,6 +766,60 @@ class Q3PlannedResult(NamedTuple):
     # planner-contract check: any dense-PK declaration violated (caller
     # re-plans on tpch_q3 — the domain_miss posture)
     pk_violation: jnp.ndarray
+
+
+def _q3_build2_fn(j1t: Table) -> Table:
+    """orders-x-customer dense-PK output -> second-lookup build side.
+    dense_pk_join folds its matched mask into the gathered build column's
+    validity, so column 4's validity IS ``matched1``."""
+    # j1t: [o_custkey, o_orderkey, o_orderdate, o_shippriority, c_custkey]
+    matched1 = j1t.column(4).valid_mask()
+    return Table([
+        _null_where(j1t.column(1), ~matched1),  # orderkey
+        j1t.column(2),                          # orderdate
+        j1t.column(3),                          # shippriority
+    ])
+
+
+def _q3_planned_keyed_fn(jt: Table) -> Table:
+    """Dense-PK lineitem x orders output -> groupby-keyed table. Build
+    columns already carry the matched mask from the gather."""
+    # jt: [l_orderkey, revenue, o_orderkey, o_orderdate, o_shippriority]
+    matched = jt.column(2).valid_mask()
+    return Table([
+        _null_where(jt.column(0), ~matched),
+        jt.column(3),
+        jt.column(4),
+        Column(jt.column(1).dtype, jt.column(1).data,
+               jt.column(1).valid_mask() & matched),
+    ])
+
+
+def _q3_planned_plan(segment: int, cutoff: int) -> fusion.Plan:
+    """q3 with planner-declared dense clustered PKs, as one fused region.
+    The clustered build sides (customer, the orders-aligned lookup table)
+    ride UNBUCKETED scans: dense_pk_join's clustered layout declares
+    ``build rows == key_hi - key_lo + 1``, which padding would break."""
+    cust = fusion.Project(fusion.Scan("customer", bucket=False),
+                          _q3_cust_fn, (segment,))
+    ord_n = fusion.Project(fusion.Scan("orders", bucket=False),
+                           _q3_orders_fn, (cutoff,))
+    probe = fusion.Project(fusion.Scan("lineitem"), _q3_probe_fn, (cutoff,))
+    # join 1: each ORDER row looks up its customer (clustered custkey);
+    # ord_n rows are orders rows in load order, custkey domain 1..|C|
+    j1 = fusion.DensePkJoin(ord_n, cust, 0, 0, 1,
+                            fusion.rows_of("customer"), clustered=True,
+                            label="pk1")
+    build2 = fusion.Project(j1, _q3_build2_fn)
+    # join 2: each LINEITEM row looks up its order (clustered orderkey,
+    # build2 rows still in orders load order = orderkey order)
+    j2 = fusion.DensePkJoin(probe, build2, 0, 0, 1,
+                            fusion.rows_of("orders"), clustered=True,
+                            label="pk2")
+    g = fusion.GroupBy(fusion.Project(j2, _q3_planned_keyed_fn), (0, 1, 2),
+                       ((3, "sum"),), label="groupby")
+    return fusion.Plan("tpch_q3_planned", fusion.Sort(
+        g, (3, 1), ascending=(False, True), nulls_first=(False, False)))
 
 
 @func_range("tpch_q3_planned")
@@ -709,43 +840,13 @@ def tpch_q3_planned(customer: Table, orders: Table, lineitem: Table,
     capacity estimate, no overflow retry — the static shape is the
     probe's.
     """
-    from spark_rapids_jni_tpu.ops.planner import dense_pk_join
-
-    cust, ord_t, probe = _q3_inputs(customer, orders, lineitem, segment,
-                                    cutoff)
-    # join 1: each ORDER row looks up its customer (clustered custkey);
-    # ord_t rows are orders rows in load order, custkey domain 1..|C|
-    j1 = dense_pk_join(ord_t, cust, 0, 0, 1, customer.num_rows,
-                       clustered=True)
-    # j1: [o_custkey, o_orderkey, o_orderdate, o_shippriority, c_custkey]
-    matched1 = j1.matched
-    build2 = Table([
-        _null_where(j1.table.column(1), ~matched1),  # orderkey
-        j1.table.column(2),                          # orderdate
-        j1.table.column(3),                          # shippriority
-    ])
-    # join 2: each LINEITEM row looks up its order (clustered orderkey,
-    # build2 rows still in orders load order = orderkey order)
-    j2 = dense_pk_join(probe, build2, 0, 0, 1, orders.num_rows,
-                       clustered=True)
-    # j2: [l_orderkey, revenue, o_orderkey, o_orderdate, o_shippriority]
-    jt = j2.table
-    matched = j2.matched
-    keyed = Table([
-        _null_where(jt.column(0), ~matched),
-        jt.column(3),  # build columns already carry the matched mask
-        jt.column(4),
-        Column(jt.column(1).dtype, jt.column(1).data,
-               jt.column(1).valid_mask() & matched),
-    ])
-    grouped = groupby_aggregate(keyed, keys=[0, 1, 2], aggs=[(3, "sum")])
-    srt = sort_table(
-        grouped.table, [3, 1], ascending=[False, True],
-        nulls_first=[False, False],
-    )
+    res = fusion.execute(
+        _q3_planned_plan(segment, cutoff),
+        {"customer": customer, "orders": orders, "lineitem": lineitem})
     return Q3PlannedResult(
-        GroupByResult(srt, grouped.num_groups), j2.total,
-        j1.pk_violation | j2.pk_violation)
+        GroupByResult(res.table, res.meta["groupby.num_groups"]),
+        res.meta["pk2.total"],
+        res.meta["pk1.pk_violation"] | res.meta["pk2.pk_violation"])
 
 
 def tpch_q3_numpy(customer: Table, orders: Table, lineitem: Table,
@@ -779,6 +880,46 @@ def tpch_q3_numpy(customer: Table, orders: Table, lineitem: Table,
     return out
 
 
+def _q3_group_plan() -> fusion.Plan:
+    """Per-device q3 group step (exchange-2 output -> keyed groupby)."""
+    return fusion.Plan("tpch_q3_group", fusion.GroupBy(
+        fusion.Project(fusion.Scan("joined"), _q3_keyed_fn), (0, 1, 2),
+        ((3, "sum"),), label="groupby"))
+
+
+def _q3_group_step(j: Table):
+    """Shard-local tail of the distributed q3 (runs inside shard_map, so
+    fusion.execute takes its staged walk on the tracer input — the plan
+    still pins the node structure shared with the fused single-chip q3)."""
+    res = fusion.execute(_q3_group_plan(), {"joined": j})
+    return res.table, res.meta["groupby.num_groups"].reshape(1)
+
+
+def _q3_partial_plan(cutoff: int) -> fusion.Plan:
+    """Out-of-core q3 per-chunk region: probe projection + clustered-PK
+    lookup against the resident build2 (an exact scan — the clustered
+    layout declares build rows == declared key range, which padding would
+    break) + revenue partial groupby. ``rows_of`` specs resolve from TRUE
+    row counts: the groupby budget is the chunk's row count (the staged
+    ``max_groups=keyed.num_rows`` shape) and key_hi is |orders|."""
+    probe = fusion.Project(fusion.Scan("chunk"), _q3_probe_fn, (cutoff,))
+    j2 = fusion.DensePkJoin(probe, fusion.Scan("build2", bucket=False),
+                            0, 0, 1, fusion.rows_of("build2"),
+                            clustered=True, label="pk2")
+    return fusion.Plan("tpch_q3_partial", fusion.GroupBy(
+        fusion.Project(j2, _q3_planned_keyed_fn), (0, 1, 2), ((3, "sum"),),
+        max_groups=fusion.rows_of("chunk"), label="partial"))
+
+
+def _q3_merge_plan() -> fusion.Plan:
+    """Merge the stacked q3 partials: sum-merge + output order, fused.
+    (Final null-key compaction happens on host — dynamic shape.)"""
+    return fusion.Plan("tpch_q3_merge", fusion.Sort(
+        fusion.GroupBy(fusion.Scan("partials"), (0, 1, 2), ((3, "sum"),),
+                       label="merge"),
+        (3, 1), ascending=(False, True), nulls_first=(False, False)))
+
+
 def tpch_q3_distributed(customer: Table, orders: Table, lineitem: Table,
                         mesh, segment: int = 0,
                         cutoff: int = _Q3_CUTOFF_DAYS,
@@ -792,11 +933,13 @@ def tpch_q3_distributed(customer: Table, orders: Table, lineitem: Table,
     from jax.sharding import PartitionSpec as P
 
     from spark_rapids_jni_tpu.parallel.distributed import (
+        _mesh_fingerprint,
         collect,
         distributed_join,
         shard_table,
     )
     from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
+    from spark_rapids_jni_tpu.runtime import dispatch
 
     d = int(np.prod(list(mesh.shape.values())))
     n_ord, n_li = orders.num_rows, lineitem.num_rows
@@ -836,23 +979,15 @@ def tpch_q3_distributed(customer: Table, orders: Table, lineitem: Table,
     if np.asarray(res2.overflowed).any():
         raise ValueError("q3 exchange 2 overflowed; raise capacities")
 
-    def group_step(j: Table):
-        # j: [l_orderkey, revenue, o_orderkey, o_date, o_prio]
-        matched = j.column(2).valid_mask()
-        keyed = Table([
-            _null_where(j.column(0), ~matched),
-            _null_where(j.column(3), ~matched),
-            _null_where(j.column(4), ~matched),
-            Column(j.column(1).dtype, j.column(1).data,
-                   j.column(1).valid_mask() & matched),
-        ])
-        g = groupby_aggregate(keyed, keys=[0, 1, 2], aggs=[(3, "sum")])
-        return g.table, g.num_groups.reshape(1)
-
-    out, num_groups = _jax.jit(_jax.shard_map(
-        group_step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
-        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
-    ))(res2.table)
+    out, num_groups = dispatch.sharded_call(
+        "tpch_q3_distributed.group_step",
+        lambda: _jax.shard_map(
+            _q3_group_step, mesh=mesh, in_specs=(P(EXEC_AXIS),),
+            out_specs=(P(EXEC_AXIS), P(EXEC_AXIS)),
+        ),
+        (res2.table,),
+        statics=(_mesh_fingerprint(mesh),),
+    )
     result = collect(out, num_groups, mesh)
     srt = sort_table(result, [3, 1], ascending=[False, True],
                      nulls_first=[False, False])
@@ -987,61 +1122,30 @@ def tpch_q3_outofcore(path, customer: Table, orders: Table, *,
     File schema: [l_orderkey int64, l_extendedprice int64,
     l_discount int64, l_shipdate date32]. Returns OutOfCoreResult;
     ``.table`` matches tpch_q3's compacted output of the materialized
-    file."""
-    import jax as _jax
+    file.
 
+    The per-chunk device step is ONE fused region (probe projection +
+    clustered-PK lookup + partial groupby); the resident build2 rides
+    the region as an exact (unbucketed) scan, and the dead chunk tables
+    are donated. The host ``trim_table`` compaction and the final merge
+    plan are the region boundaries."""
     from spark_rapids_jni_tpu.ops.planner import dense_pk_join
     from spark_rapids_jni_tpu.parquet.reader import ParquetChunkedReader
     from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter, SpillStore
     from spark_rapids_jni_tpu.runtime.outofcore import run_chunked_aggregate
 
-    n_cust, n_ord = customer.num_rows, orders.num_rows
+    n_cust = customer.num_rows
     limiter = MemoryLimiter(budget_bytes)
     spill = SpillStore(budget_bytes)
 
     # the resident build side, computed once: orders |x| customer via
     # the clustered custkey lookup, date/segment predicates pushed in
-    cust = Table([_null_where(
-        customer.column(C_CUSTKEY),
-        customer.column(C_MKTSEGMENT).data != jnp.int8(segment))])
-    okey = _null_where(
-        orders.column(O_CUSTKEY),
-        orders.column(O_ORDERDATE).data >= jnp.int32(cutoff))
-    ord_t = Table([okey, orders.column(O_ORDERKEY),
-                   orders.column(O_ORDERDATE),
-                   orders.column(O_SHIPPRIORITY)])
+    cust = _q3_cust_fn(customer, segment)
+    ord_t = _q3_orders_fn(orders, cutoff)
     j1 = dense_pk_join(ord_t, cust, 0, 0, 1, n_cust, clustered=True)
     if bool(j1.pk_violation):
         raise ValueError("customer PK declaration violated")
-    build2 = Table([
-        _null_where(j1.table.column(1), ~j1.matched),
-        j1.table.column(2), j1.table.column(3),
-    ])
-
-    @_jax.jit
-    def _partial(chunk: Table):
-        lkey = _null_where(
-            chunk.column(0),
-            chunk.column(3).data <= jnp.int32(cutoff))
-        price = chunk.column(1)
-        disc = chunk.column(2)
-        revenue = Column(
-            t.decimal64(-4), price.data * (100 - disc.data),
-            price.valid_mask() & disc.valid_mask())
-        probe = Table([lkey, revenue])
-        j2 = dense_pk_join(probe, build2, 0, 0, 1, n_ord,
-                           clustered=True)
-        jt = j2.table
-        matched = j2.matched
-        keyed = Table([
-            _null_where(jt.column(0), ~matched),
-            jt.column(3), jt.column(4),
-            Column(jt.column(1).dtype, jt.column(1).data,
-                   jt.column(1).valid_mask() & matched),
-        ])
-        g = groupby_aggregate(keyed, keys=[0, 1, 2], aggs=[(3, "sum")],
-                              max_groups=keyed.num_rows)
-        return g.table, g.num_groups, j2.pk_violation
+    build2 = _q3_build2_fn(j1.table)
 
     def partial_fn(chunk: Table) -> Table:
         from spark_rapids_jni_tpu.ops.table_ops import trim_table
@@ -1049,17 +1153,16 @@ def tpch_q3_outofcore(path, customer: Table, orders: Table, *,
         cols = list(chunk.columns)
         cols[1] = Column(t.decimal64(-2), cols[1].data, cols[1].validity)
         cols[2] = Column(t.decimal64(-2), cols[2].data, cols[2].validity)
-        tbl, num_groups, viol = _partial(Table(cols))
-        if bool(viol):
+        res = fusion.execute(
+            _q3_partial_plan(cutoff),
+            {"chunk": Table(cols), "build2": build2},
+            donate_inputs=True)
+        if bool(res.meta["pk2.pk_violation"]):
             raise ValueError("orders PK declaration violated")
-        return trim_table(tbl, int(num_groups))
+        return trim_table(res.table, int(res.meta["partial.num_groups"]))
 
     def merge_fn(partials: Table) -> Table:
-        merged = groupby_aggregate(partials, keys=[0, 1, 2],
-                                   aggs=[(3, "sum")])
-        srt = sort_table(merged.table, [3, 1],
-                         ascending=[False, True],
-                         nulls_first=[False, False])
+        srt = fusion.execute(_q3_merge_plan(), {"partials": partials}).table
         kv = np.asarray(srt.column(0).valid_mask())
         k = int(kv.sum())
         return Table([
@@ -1092,11 +1195,13 @@ def tpch_q3_planned_distributed(customer: Table, orders: Table,
 
     from spark_rapids_jni_tpu.ops.planner import dense_pk_join
     from spark_rapids_jni_tpu.parallel.distributed import (
+        _mesh_fingerprint,
         collect,
         shard_table,
     )
     from spark_rapids_jni_tpu.parallel.mesh import EXEC_AXIS
     from spark_rapids_jni_tpu.parallel.shuffle import hash_shuffle
+    from spark_rapids_jni_tpu.runtime import dispatch
 
     cust, ord_t, probe = _q3_inputs(customer, orders, lineitem, segment,
                                     cutoff)
@@ -1136,11 +1241,16 @@ def tpch_q3_planned_distributed(customer: Table, orders: Table,
         return (merged.table, merged.num_groups.reshape(1),
                 viol.reshape(1))
 
-    out, num_groups, viol = _jax.jit(_jax.shard_map(
-        step, mesh=mesh,
-        in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(), P()),
-        out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
-    ))(sp, prv, cust, ord_t)
+    out, num_groups, viol = dispatch.sharded_call(
+        "tpch_q3_planned_distributed.step",
+        lambda: _jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(), P()),
+            out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+        ),
+        (sp, prv, cust, ord_t),
+        statics=(n_cust, n_ord, _mesh_fingerprint(mesh)),
+    )
     if bool(np.asarray(viol).any()):
         raise ValueError(
             "dense-PK declaration violated — re-plan with "

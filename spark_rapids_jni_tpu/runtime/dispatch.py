@@ -35,6 +35,7 @@ from __future__ import annotations
 import math
 import os
 import threading
+import warnings
 from functools import partial
 from typing import Any, Callable, Optional, Sequence
 
@@ -323,6 +324,7 @@ def call(
     statics: tuple = (),
     slice_rows: bool = True,
     bucket_rows: bool = True,
+    donate_rows: bool = False,
 ) -> Any:
     """Dispatch ``fn`` through the bucketed executable cache.
 
@@ -340,6 +342,17 @@ def call(
     output back to group 0's true row count. ``bucket_rows=False`` keeps
     exact shapes (pure executable memoization, no padding) for ops whose
     semantics cannot absorb padded rows.
+
+    ``donate_rows=True`` is the caller's declaration that every
+    ``row_args`` buffer is DEAD after this call (an intermediate table it
+    owns, a decoded chunk nothing else reads): the executable compiles
+    with ``donate_argnums`` on the row param so XLA reuses those buffers
+    for outputs instead of double-buffering. The flag keys the cache, so
+    donating and non-donating call sites never share an executable; bytes
+    handed over are counted under ``dispatch.donated_bytes``. Note that
+    when the row count already sits on a bucket boundary the "padded"
+    tree aliases the caller's arrays, so the declaration genuinely
+    invalidates them — never set this for caller-visible inputs.
 
     Never raises on its own behalf: every failure mode falls back to
     ``fn(row_args, aux_args, None)`` with the reason counted under
@@ -370,15 +383,24 @@ def call(
         jnp.arange(B, dtype=jnp.int32) < jnp.int32(n)
         for n, B in zip(ns, buckets))
 
-    key = (op, statics, _signature((padded, aux_args, row_valids)),
+    key = (op, statics, donate_rows,
+           _signature((padded, aux_args, row_valids)),
            jax.default_backend())
     with _lock:
         compiled = _EXEC_CACHE.get(key)
     if compiled is None:
         _init_persistent_cache()
         try:
-            compiled = jax.jit(fn).lower(
-                padded, aux_args, row_valids).compile()
+            jitted = (jax.jit(fn, donate_argnums=(0,)) if donate_rows
+                      else jax.jit(fn))
+            with warnings.catch_warnings():
+                # backends without donation support (CPU) warn per
+                # donated buffer at lowering; the declaration is still
+                # honored where the platform implements it
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+                compiled = jitted.lower(
+                    padded, aux_args, row_valids).compile()
         except Exception:
             REGISTRY.counter("dispatch.compile_error").inc()
             return _inline(op, "compile_error", fn, row_args, aux_args)
@@ -403,6 +425,8 @@ def call(
         sum(B - n for n, B in zip(ns, buckets)))
     REGISTRY.counter("dispatch.padded_waste_bytes").inc(acc.padded_bytes)
     REGISTRY.counter("dispatch.row_bytes_total").inc(acc.total_bytes)
+    if donate_rows:
+        REGISTRY.counter("dispatch.donated_bytes").inc(acc.total_bytes)
     if slice_rows:
         out = _slice_tree(out, ns[0], buckets[0])
     return out
@@ -495,6 +519,7 @@ def stats() -> dict:
         "inline": c.get("dispatch.inline", 0),
         "padded_waste_bytes": waste,
         "padded_waste_frac": (waste / total_bytes) if total_bytes else 0.0,
+        "donated_bytes": c.get("dispatch.donated_bytes", 0),
         "executables": cache_size(),
     }
 

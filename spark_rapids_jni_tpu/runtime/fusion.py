@@ -1,0 +1,698 @@
+"""Whole-stage fusion: compile query plans into single donated executables.
+
+The reference ships ONE fat native library so a Spark stage runs as few
+device launches as possible; Flare (PAPERS.md) shows whole-stage native
+compilation is the dominant win for Spark-shaped plans. Our models were
+still executing op-by-op: every filter/project/groupby/join/sort went
+through ``dispatch.call`` as its OWN executable, materializing each
+intermediate Table in HBM and paying per-op dispatch overhead. This module
+closes that gap: a small logical-plan IR (scan / filter / project /
+groupby / join / sort / limit nodes over ``Table``) plus a fuser that
+composes a fusible region's per-op device functions into ONE traced
+callable and dispatches it once through ``dispatch.call`` — so a fused
+region inherits shape bucketing and the executable cache, and a whole
+query compiles to one executable per bucket instead of one per op per
+bucket.
+
+Region discipline
+-----------------
+``execute`` runs ONE fusible region. Genuine host boundaries — out-of-core
+partial compaction (``trim_table`` between chunk and merge), the shuffle
+collective between distributed partial and merge, the planner
+``domain_miss`` / ``pk_violation`` re-plan check — stay in the model's
+host wrapper, which composes one plan per region (see
+``models/tpch.tpch_q1_outofcore`` for the two-region shape). Inside a
+region every op is inlined into the single trace: the per-op
+``dispatch.call`` sites detect the tracer inputs and take their inline
+path, so the op implementations themselves are byte-for-byte the staged
+ones.
+
+Bit-identity
+------------
+The region's inputs are bucket-padded ONCE at the region boundary; the
+per-group ``row_valid`` masks thread through the same user-level
+``row_valid`` parameters the staged ops already expose (``join``'s
+``left_row_valid``, ``groupby_aggregate``'s and ``plan_groupby``'s
+``row_valid``, ``sort_order``'s phantom-last ranking), so a fused region
+computes exactly what the staged path computes at the same bucket — every
+fused query is bit-identical to its op-by-op reference at any row count
+(tests/test_fusion.py pins this at 1, 2^k-1, 2^k, 2^k+1 rows with null
+tails).
+
+Donation
+--------
+``execute(..., donate_inputs=True)`` is the caller's declaration that the
+bound input tables are DEAD after the call (an intermediate table the plan
+runner owns, an out-of-core chunk nothing else reads): the fused
+executable then compiles with ``donate_argnums`` on its row param so XLA
+reuses those buffers for outputs instead of double-buffering HBM
+(``fusion.donate`` config gates this; bytes are accounted under
+``dispatch.donated_bytes``).
+
+Telemetry: ``fusion.regions`` / ``fusion.nodes_fused`` /
+``fusion.staged_regions`` counters; executables per query are the
+``dispatch.compile.fusion.<plan>`` counters (one region op name per
+plan); ``fusion.stats()`` aggregates all of it for the bench block.
+
+Config knobs (utils/config.py): ``fusion.enabled`` (off = the same plan
+runs op-by-op, the staged reference path), ``fusion.donate``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import get_option
+
+__all__ = [
+    "Scan",
+    "Filter",
+    "Project",
+    "GroupBy",
+    "Join",
+    "DensePkJoin",
+    "Sort",
+    "Limit",
+    "Plan",
+    "FusedResult",
+    "rows_of",
+    "min_rows_of",
+    "execute",
+    "stats",
+]
+
+
+# ---------------------------------------------------------------------------
+# resolvable row specs — statics that depend on TRUE input row counts
+# ---------------------------------------------------------------------------
+#
+# Capacities like a join's out_size or a partial groupby's budget are
+# STATIC plan parameters derived from the true (pre-padding) row count of
+# an input — never from the bucket, or the fused output shape would drift
+# from the staged reference. They resolve at execute() time and ride the
+# dispatch key, exactly like the statics the staged op calls carry.
+
+
+def rows_of(name: str, factor: int = 1):
+    """out_rows spec: ``factor *`` the bound table's true row count."""
+    return ("rows_of", name, int(factor))
+
+
+def min_rows_of(name: str, cap: int):
+    """max_groups spec: ``min(cap, true row count)`` — the out-of-core
+    partial's ``min(_Q1_GROUP_BUDGET, work.num_rows)`` shape."""
+    return ("min_rows_of", name, int(cap))
+
+
+def _resolve(spec, true_rows: dict) -> Optional[int]:
+    if spec is None or isinstance(spec, int):
+        return spec
+    if isinstance(spec, tuple) and len(spec) == 3:
+        kind, name, arg = spec
+        if kind == "rows_of":
+            return int(true_rows[name]) * arg
+        if kind == "min_rows_of":
+            return min(arg, int(true_rows[name]))
+    raise ValueError(f"unresolvable row spec {spec!r}")
+
+
+# ---------------------------------------------------------------------------
+# logical-plan IR
+# ---------------------------------------------------------------------------
+#
+# Nodes are plain NamedTuples forming a DAG (shared subplans are shared by
+# object identity). Node callables (Filter predicates, Project fns) must
+# be module-level functions — they are fingerprinted by qualified name for
+# the executable-cache key, with all per-query variation carried in the
+# ``params`` tuple (the same discipline dispatch ``statics`` impose).
+
+
+class Scan(NamedTuple):
+    """A named input table. ``bucket=False`` keeps the table at its exact
+    shape (an aux arg — broadcast build sides whose row count is a planner
+    fact, e.g. a clustered dense-PK build whose rows MUST equal the
+    declared key range)."""
+
+    name: str
+    bucket: bool = True
+
+
+class Filter(NamedTuple):
+    """WHERE via the masking idiom: ``pred(table, *params) -> bool[n]``;
+    rows where the predicate is False get their validity nulled in every
+    column (never compacted — static shapes)."""
+
+    child: Any
+    pred: Callable
+    params: tuple = ()
+
+
+class Project(NamedTuple):
+    """``fn(table, *params) -> Table``. ``rowwise=True`` (the default)
+    promises the output rows align 1:1 with the input rows (derived
+    columns, key masking). ``rowwise=False`` marks a shape-changing
+    compute (a full-table reduction like q6's multiply-accumulate); the fn
+    then receives the region row_valid as ``fn(table, row_valid, *params)``
+    and its output is its own row space."""
+
+    child: Any
+    fn: Callable
+    params: tuple = ()
+    rowwise: bool = True
+
+
+class GroupBy(NamedTuple):
+    """``groupby_aggregate`` (or ``plan_groupby`` when ``domains`` is
+    given). ``max_groups`` may be an int, None, or a ``min_rows_of`` spec.
+    Side outputs land in the result meta under ``<label>.*``
+    (num_groups/overflowed/sum_overflow, or present/domain_miss/lowered
+    on the planned lowering)."""
+
+    child: Any
+    keys: tuple
+    aggs: tuple
+    max_groups: Any = None
+    domains: Any = None
+    budget: int = 4096
+    label: str = "groupby"
+
+
+class Join(NamedTuple):
+    """General equi-join + ``apply_join_maps`` materialization: left
+    columns then right columns, ``out_rows`` output rows (an int or a
+    ``rows_of`` spec — resolved from TRUE row counts, never buckets).
+    Meta: ``<label>.total``."""
+
+    left: Any
+    right: Any
+    left_on: tuple
+    right_on: tuple
+    out_rows: Any
+    how: str = "inner"
+    label: str = "join"
+
+
+class DensePkJoin(NamedTuple):
+    """Planner-declared dense-PK lookup join (``ops/planner.dense_pk_join``):
+    probe-aligned output, no capacity estimate. ``key_hi`` may be a
+    ``rows_of`` spec. The build child should hang off an unbucketed Scan
+    when ``clustered=True`` (build rows must equal the declared range).
+    Meta: ``<label>.total`` / ``<label>.pk_violation``."""
+
+    probe: Any
+    build: Any
+    probe_key: int
+    build_key: int
+    key_lo: int
+    key_hi: Any
+    clustered: bool = False
+    label: str = "pk_join"
+
+
+class Sort(NamedTuple):
+    """``sort_table``; when the input still carries a region row_valid the
+    phantom rows rank strictly last (``sort_order``'s row_valid contract),
+    so the real prefix is exactly the staged sort."""
+
+    child: Any
+    keys: tuple
+    ascending: Any = None
+    nulls_first: Any = None
+
+
+class Limit(NamedTuple):
+    """Positional head: first ``min(count, true rows)`` rows."""
+
+    child: Any
+    count: int
+
+
+class Plan(NamedTuple):
+    """A named fusible region: one root node, one fused executable. The
+    name becomes the dispatch op (``fusion.<name>``), so executables per
+    query are countable (``dispatch.compile.fusion.<name>``)."""
+
+    name: str
+    root: Any
+
+
+_NODE_TYPES = (Scan, Filter, Project, GroupBy, Join, DensePkJoin, Sort,
+               Limit)
+
+
+class FusedResult(NamedTuple):
+    table: Table
+    # side outputs of labeled nodes: "<label>.<field>" -> scalar/array
+    # (plus static plan facts like "<label>.lowered")
+    meta: dict
+
+
+# ---------------------------------------------------------------------------
+# static plan analysis
+# ---------------------------------------------------------------------------
+
+
+def _children(node) -> tuple:
+    if isinstance(node, Scan):
+        return ()
+    if isinstance(node, (Filter, Project, GroupBy, Sort, Limit)):
+        return (node.child,)
+    if isinstance(node, Join):
+        return (node.left, node.right)
+    if isinstance(node, DensePkJoin):
+        return (node.probe, node.build)
+    raise TypeError(f"not a plan node: {type(node).__name__}")
+
+
+def _topo(root) -> list:
+    """Children-first topological order over the node DAG."""
+    order: list = []
+    seen: set = set()
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for c in _children(node):
+            visit(c)
+        order.append(node)
+
+    visit(root)
+    return order
+
+
+def _scan_names(nodes) -> tuple[list, list]:
+    """(bucketed, exact) scan names in first-appearance order. A name
+    must be scanned consistently (one bucket flag per table)."""
+    bucketed: list = []
+    exact: list = []
+    flags: dict = {}
+    for node in nodes:
+        if not isinstance(node, Scan):
+            continue
+        if node.name in flags:
+            if flags[node.name] != node.bucket:
+                raise ValueError(
+                    f"scan {node.name!r} used both bucketed and exact")
+            continue
+        flags[node.name] = node.bucket
+        (bucketed if node.bucket else exact).append(node.name)
+    return bucketed, exact
+
+
+def _fn_key(fn) -> tuple:
+    mod = getattr(fn, "__module__", None)
+    qual = getattr(fn, "__qualname__", None)
+    if mod is None or qual is None or "<locals>" in (qual or ""):
+        raise ValueError(
+            "plan callables must be module-level functions (their "
+            "qualified name keys the executable cache); got "
+            f"{fn!r} — carry per-query variation in params instead")
+    return (mod, qual)
+
+
+def _fingerprint(nodes, resolved: dict) -> tuple:
+    """Structural digest of the plan DAG: node kinds, static params,
+    resolved row specs, and child indices — the fused region's dispatch
+    ``statics``. Two plans collide only if they trace identically."""
+    index = {id(n): i for i, n in enumerate(nodes)}
+    out = []
+    for node in nodes:
+        kids = tuple(index[id(c)] for c in _children(node))
+        if isinstance(node, Scan):
+            entry = ("scan", node.name, node.bucket)
+        elif isinstance(node, Filter):
+            entry = ("filter", _fn_key(node.pred), node.params)
+        elif isinstance(node, Project):
+            entry = ("project", _fn_key(node.fn), node.params, node.rowwise)
+        elif isinstance(node, GroupBy):
+            doms = None
+            if node.domains is not None:
+                doms = tuple(
+                    (None if d is None else (tuple(d.values), d.kind))
+                    for d in node.domains)
+            entry = ("groupby", node.keys, node.aggs,
+                     resolved[id(node)], doms, node.budget)
+        elif isinstance(node, Join):
+            entry = ("join", node.left_on, node.right_on,
+                     resolved[id(node)], node.how)
+        elif isinstance(node, DensePkJoin):
+            entry = ("pk_join", node.probe_key, node.build_key, node.key_lo,
+                     resolved[id(node)], node.clustered)
+        elif isinstance(node, Sort):
+            entry = ("sort", node.keys,
+                     None if node.ascending is None else tuple(node.ascending),
+                     None if node.nulls_first is None
+                     else tuple(node.nulls_first))
+        elif isinstance(node, Limit):
+            entry = ("limit", resolved[id(node)])
+        else:  # pragma: no cover - _children already rejects
+            raise TypeError(type(node).__name__)
+        out.append(entry + (kids,))
+    return tuple(out)
+
+
+def _resolve_statics(nodes, true_rows: dict) -> dict:
+    """Evaluate every row-count-derived static against TRUE row counts."""
+    resolved: dict = {}
+    for node in nodes:
+        if isinstance(node, GroupBy):
+            resolved[id(node)] = _resolve(node.max_groups, true_rows)
+        elif isinstance(node, Join):
+            resolved[id(node)] = _resolve(node.out_rows, true_rows)
+        elif isinstance(node, DensePkJoin):
+            resolved[id(node)] = _resolve(node.key_hi, true_rows)
+        elif isinstance(node, Limit):
+            resolved[id(node)] = int(node.count)
+    return resolved
+
+
+def _spaces(nodes) -> dict:
+    """Static row-space analysis: node id -> scan name whose POSITIONAL
+    row space the node's output lives in (sliceable back to the true row
+    count after a padded fused run), or None for fixed/derived shapes
+    (groupby budgets, join out_size, bounded-plan slot tables)."""
+    spaces: dict = {}
+    for node in nodes:
+        if isinstance(node, Scan):
+            spaces[id(node)] = node.name if node.bucket else None
+        elif isinstance(node, Filter):
+            spaces[id(node)] = spaces[id(node.child)]
+        elif isinstance(node, Project):
+            spaces[id(node)] = (
+                spaces[id(node.child)] if node.rowwise else None)
+        elif isinstance(node, GroupBy):
+            # max_groups=None pads the output to the input row count, so
+            # it stays positionally sliceable; an explicit budget (or the
+            # bounded plan's slot count) is its own fixed shape
+            if node.max_groups is None and node.domains is None:
+                spaces[id(node)] = spaces[id(node.child)]
+            else:
+                spaces[id(node)] = None
+        elif isinstance(node, DensePkJoin):
+            spaces[id(node)] = spaces[id(node.probe)]  # probe-aligned
+        elif isinstance(node, Sort):
+            spaces[id(node)] = spaces[id(node.child)]
+        elif isinstance(node, (Join, Limit)):
+            spaces[id(node)] = None
+    return spaces
+
+
+def _side_keys(nodes) -> list:
+    """Deterministic (label, field) order of traced side outputs."""
+    keys: list = []
+    for node in nodes:
+        if isinstance(node, GroupBy):
+            if node.domains is not None:
+                keys += [f"{node.label}.present",
+                         f"{node.label}.domain_miss",
+                         f"{node.label}.overflowed"]
+            else:
+                keys += [f"{node.label}.num_groups",
+                         f"{node.label}.overflowed",
+                         f"{node.label}.sum_overflow"]
+        elif isinstance(node, Join):
+            keys.append(f"{node.label}.total")
+        elif isinstance(node, DensePkJoin):
+            keys += [f"{node.label}.total", f"{node.label}.pk_violation"]
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# evaluation — one shared walker for the fused trace AND the staged path
+# ---------------------------------------------------------------------------
+
+
+def _null_all(table: Table, keep: jnp.ndarray) -> Table:
+    return Table([
+        Column(c.dtype, c.data, c.valid_mask() & keep,
+               chars=c.chars, children=c.children)
+        for c in table.columns
+    ])
+
+
+def _head(table: Table, k: int) -> Table:
+    return Table([
+        Column(c.dtype, c.data[:k],
+               None if c.validity is None else c.validity[:k],
+               chars=None if c.chars is None else c.chars[:k])
+        for c in table.columns
+    ])
+
+
+def _eval_plan(root, tables: dict, rvs: dict, resolved: dict,
+               true_rows: dict):
+    """Evaluate the DAG. ``tables``/``rvs`` hold the (possibly padded)
+    input tables and their region row_valid masks. Returns
+    (root table, [(side key, traced value), ...]). Called with tracer
+    tables inside the fused region fn and with concrete tables on the
+    staged path — the SAME per-op calls either way."""
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
+    from spark_rapids_jni_tpu.ops.planner import dense_pk_join, plan_groupby
+    from spark_rapids_jni_tpu.ops.sort import gather, sort_order
+
+    env: dict = {}
+    side: list = []
+
+    def ev(node):
+        if id(node) in env:
+            return env[id(node)]
+        if isinstance(node, Scan):
+            out = (tables[node.name], rvs.get(node.name))
+        elif isinstance(node, Filter):
+            tbl, rv = ev(node.child)
+            out = (_null_all(tbl, node.pred(tbl, *node.params)), rv)
+        elif isinstance(node, Project):
+            tbl, rv = ev(node.child)
+            if node.rowwise:
+                out = (node.fn(tbl, *node.params), rv)
+            else:
+                out = (node.fn(tbl, rv, *node.params), None)
+        elif isinstance(node, GroupBy):
+            tbl, rv = ev(node.child)
+            if node.domains is not None:
+                res = plan_groupby(
+                    tbl, list(node.keys), list(node.aggs),
+                    list(node.domains), budget=node.budget, row_valid=rv)
+                side.extend([
+                    (f"{node.label}.present", res.present),
+                    (f"{node.label}.domain_miss", res.domain_miss),
+                    (f"{node.label}.overflowed",
+                     jnp.asarray(res.overflowed)),
+                ])
+                out = (res.table, None)
+            else:
+                g = groupby_aggregate(
+                    tbl, list(node.keys), list(node.aggs),
+                    max_groups=resolved[id(node)], row_valid=rv)
+                side.extend([
+                    (f"{node.label}.num_groups", g.num_groups),
+                    (f"{node.label}.overflowed", jnp.asarray(g.overflowed)),
+                    (f"{node.label}.sum_overflow",
+                     jnp.asarray(g.sum_overflow)),
+                ])
+                # a None budget pads to the input rows: still positional
+                rv_out = rv if resolved[id(node)] is None else None
+                out = (g.table, rv_out)
+        elif isinstance(node, Join):
+            ltbl, lrv = ev(node.left)
+            rtbl, rrv = ev(node.right)
+            maps = join(ltbl, rtbl, list(node.left_on), list(node.right_on),
+                        out_size=resolved[id(node)], how=node.how,
+                        left_row_valid=lrv, right_row_valid=rrv)
+            side.append((f"{node.label}.total", maps.total))
+            out = (apply_join_maps(ltbl, rtbl, maps), None)
+        elif isinstance(node, DensePkJoin):
+            ptbl, prv = ev(node.probe)
+            btbl, brv = ev(node.build)
+            if brv is not None:
+                # a padded build side would break the declared layout;
+                # phantom build rows are nulled out of the lookup instead
+                btbl = _null_all(btbl, brv)
+            r = dense_pk_join(ptbl, btbl, node.probe_key, node.build_key,
+                              node.key_lo, resolved[id(node)],
+                              clustered=node.clustered)
+            side.extend([
+                (f"{node.label}.total", r.total),
+                (f"{node.label}.pk_violation", r.pk_violation),
+            ])
+            out = (r.table, prv)
+        elif isinstance(node, Sort):
+            tbl, rv = ev(node.child)
+            asc = None if node.ascending is None else list(node.ascending)
+            nf = None if node.nulls_first is None else list(node.nulls_first)
+            order = sort_order(tbl, list(node.keys), asc, nf, row_valid=rv)
+            srt = gather(tbl, order)
+            if rv is None:
+                out = (srt, None)
+            else:
+                # phantoms ranked strictly last: the real prefix is the
+                # staged sort, and the mask becomes positional again
+                n = jnp.sum(rv.astype(jnp.int32))
+                out = (srt,
+                       jnp.arange(tbl.num_rows, dtype=jnp.int32) < n)
+        elif isinstance(node, Limit):
+            tbl, rv = ev(node.child)
+            out = (_head(tbl, resolved[id(node)]), None)
+        else:
+            raise TypeError(f"not a plan node: {type(node).__name__}")
+        env[id(node)] = out
+        return out
+
+    value, _ = ev(root)
+    return value, side
+
+
+def _limit_bound(nodes, resolved: dict, spaces: dict,
+                 true_rows: dict) -> None:
+    """Clamp Limit counts to the true row count of their space so the
+    fused (padded) head matches the staged (exact) head shape."""
+    for node in nodes:
+        if isinstance(node, Limit):
+            space = spaces[id(node.child)]
+            if space is not None:
+                resolved[id(node)] = min(resolved[id(node)],
+                                         int(true_rows[space]))
+
+
+def _slice_to(out, n: int):
+    """Trim a padded leading dimension back to the true row count."""
+    from spark_rapids_jni_tpu.runtime.dispatch import _slice_tree
+
+    if isinstance(out, Table):
+        rows = out.num_rows
+    elif isinstance(out, Column):
+        rows = out.size
+    else:
+        return out
+    if rows == n:
+        return out
+    return _slice_tree(out, n, rows)
+
+
+# ---------------------------------------------------------------------------
+# the fuser
+# ---------------------------------------------------------------------------
+
+
+def execute(plan: Plan, bindings: dict, *,
+            donate_inputs: bool = False) -> FusedResult:
+    """Run one fusible region.
+
+    ``bindings`` maps every Scan name to a Table. With ``fusion.enabled``
+    the whole region dispatches as ONE callable through ``dispatch.call``
+    (op name ``fusion.<plan.name>``): bucketed scans are the row groups,
+    exact scans ride as aux args, and each per-op implementation inlines
+    into the single trace. With fusion disabled — or when the bindings are
+    tracers, dispatch is disabled, or compilation fails — the exact same
+    node walk runs op-by-op (each op dispatching itself), which IS the
+    staged reference path; results are bit-identical either way.
+
+    ``donate_inputs=True`` declares every bound table dead after the call
+    (intermediates the caller owns — never user-visible inputs); see the
+    module docstring.
+    """
+    nodes = _topo(plan.root)
+    bucketed, exact = _scan_names(nodes)
+    for name in bucketed + exact:
+        if name not in bindings:
+            raise KeyError(f"plan {plan.name!r} scans unbound table "
+                           f"{name!r}")
+    true_rows = {name: bindings[name].num_rows for name in bucketed + exact}
+    resolved = _resolve_statics(nodes, true_rows)
+    spaces = _spaces(nodes)
+    _limit_bound(nodes, resolved, spaces, true_rows)
+    static_meta = {
+        f"{n.label}.lowered": _planned_lowering(n)
+        for n in nodes
+        if isinstance(n, GroupBy) and n.domains is not None
+    }
+    side_keys = _side_keys(nodes)
+
+    if not get_option("fusion.enabled"):
+        REGISTRY.counter("fusion.staged_regions").inc()
+        tables = {name: bindings[name] for name in bucketed + exact}
+        rvs = {name: None for name in tables}
+        value, side = _eval_plan(plan.root, tables, rvs, resolved,
+                                 true_rows)
+        meta = dict(side)
+        meta.update(static_meta)
+        return FusedResult(value, meta)
+
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    REGISTRY.counter("fusion.regions").inc()
+    REGISTRY.counter("fusion.nodes_fused").inc(len(nodes))
+
+    row_args = tuple(bindings[name] for name in bucketed)
+    aux_args = tuple(bindings[name] for name in exact)
+    fingerprint = _fingerprint(nodes, resolved)
+
+    def _region(row_args_, aux_args_, row_valids):
+        rvs_ = row_valids if row_valids is not None \
+            else (None,) * len(bucketed)
+        tables = dict(zip(bucketed, row_args_))
+        tables.update(zip(exact, aux_args_))
+        rvmap = dict(zip(bucketed, rvs_))
+        value, side = _eval_plan(plan.root, tables, rvmap, resolved,
+                                 true_rows)
+        return value, tuple(v for _, v in side)
+
+    donate = (bool(donate_inputs) and bool(get_option("fusion.donate"))
+              and bool(bucketed))
+    value, side_vals = dispatch.call(
+        f"fusion.{plan.name}", _region, row_args, aux_args,
+        statics=("fusion", fingerprint), slice_rows=False,
+        donate_rows=donate)
+
+    root_space = spaces[id(plan.root)]
+    if root_space is not None:
+        value = _slice_to(value, int(true_rows[root_space]))
+    meta = dict(zip(side_keys, side_vals))
+    meta.update(static_meta)
+    return FusedResult(value, meta)
+
+
+def _planned_lowering(node: GroupBy) -> str:
+    """The static ``lowered`` plan fact, mirroring ``plan_groupby``'s
+    eligibility check (it never depends on data)."""
+    bounded_ok = (
+        all(d is not None for d in node.domains)
+        and all(op in ("sum", "count", "mean", "min", "max")
+                for _, op in node.aggs)
+        and int(np.prod([len(d.values) + 1 for d in node.domains]))
+        <= node.budget
+    )
+    return "bounded" if bounded_ok else "general"
+
+
+# ---------------------------------------------------------------------------
+# introspection
+# ---------------------------------------------------------------------------
+
+
+def stats() -> dict:
+    """Aggregate fusion counters for the bench ``fusion`` block:
+    regions/nodes fused, executables per query (the
+    ``dispatch.compile.fusion.<plan>`` counters), and donated bytes."""
+    c = REGISTRY.counters("fusion.")
+    d = REGISTRY.counters("dispatch.compile.fusion.")
+    per_query = {
+        name[len("dispatch.compile.fusion."):]: count
+        for name, count in sorted(d.items())
+    }
+    return {
+        "regions": c.get("fusion.regions", 0),
+        "staged_regions": c.get("fusion.staged_regions", 0),
+        "nodes_fused": c.get("fusion.nodes_fused", 0),
+        "executables": sum(per_query.values()),
+        "executables_per_query": per_query,
+        "donated_bytes": REGISTRY.counters("dispatch.").get(
+            "dispatch.donated_bytes", 0),
+    }

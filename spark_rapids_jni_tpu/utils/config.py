@@ -67,6 +67,16 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # C-extension (numpy / native codec) work that releases the GIL, so a
     # small pool overlaps IO with decode without oversubscribing the host.
     "pipeline.decode_threads": (2, int),
+    # Whole-stage fusion (runtime/fusion.py): compile each fusible plan
+    # region into ONE executable through dispatch.call instead of one
+    # executable per op. Off -> the same plan runs op-by-op (the staged
+    # reference path); results are bit-identical either way.
+    "fusion.enabled": (True, bool),
+    # Donate region-input buffers the caller declared dead (intermediate
+    # tables between regions, out-of-core chunk tables) into the fused
+    # executable so XLA reuses them for outputs instead of
+    # double-buffering HBM. Donation never applies to caller-owned scans.
+    "fusion.donate": (True, bool),
 }
 
 _overrides: dict[str, Any] = {}

@@ -136,14 +136,16 @@ def test_main_falls_back_to_cpu_when_ledger_empty(
     monkeypatch.delenv("BENCH_PLATFORM", raising=False)
     monkeypatch.setattr(bench, "_probe_tpu", lambda t: (False, "forced down"))
     monkeypatch.setattr(
-        bench, "_run_child", lambda c, n, i, p, t: (123.0, "", None, None))
+        bench, "_run_child", lambda c, n, i, p, t: (123.0, "", None, None,
+                                                    None))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["platform"] == "cpu" and rec["value"] == 123.0
-    # no child delivered dispatch/pipeline stats: the blocks record that
-    # honestly
+    # no child delivered dispatch/pipeline/fusion stats: the blocks record
+    # that honestly
     assert rec["dispatch"] == {}
     assert rec["pipeline"] == {}
+    assert rec["fusion"] == {}
 
 
 def test_tpu_success_appends_to_ledger(ledger, monkeypatch, capsys):
@@ -152,11 +154,13 @@ def test_tpu_success_appends_to_ledger(ledger, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_probe_tpu", lambda t: (True, ""))
     monkeypatch.setattr(
         bench, "_run_child",
-        lambda c, n, i, p, t: (5.0e8, "", {"compiles": 1}, {"chunks": 10}))
+        lambda c, n, i, p, t: (5.0e8, "", {"compiles": 1}, {"chunks": 10},
+                               {"regions": 1}))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["platform"] == "tpu" and "stale_s" not in rec
     assert rec["dispatch"] == {"compiles": 1}
     assert rec["pipeline"] == {"chunks": 10}
+    assert rec["fusion"] == {"regions": 1}
     led = bench._ledger_last("tpch_q1_planned_rows_per_s", 1 << 22)
     assert led["value"] == 5.0e8

@@ -1,0 +1,334 @@
+"""Whole-stage fusion (runtime/fusion, ISSUE 5).
+
+Four invariant families:
+
+1. **Bit-identity** — a fused region must be byte-for-byte identical to
+   the staged op-by-op reference: ``fusion.enabled = False`` runs the
+   SAME plan through the same node walk with each op dispatching itself,
+   so the comparison holds the query constant and flips only the fusion
+   layer. Pinned at 1, 2^k-1, 2^k, 2^k+1 rows with null tails for
+   q1/q3/q6, the planned q3, and the planned-q1 ``domain_miss``
+   fallback.
+
+2. **Executable economy** — the acceptance claim: one compile per fused
+   REGION per bucket (``dispatch.compile.fusion.<plan>``), not one per
+   op, and strictly fewer executables than the staged path compiles for
+   the same work.
+
+3. **Donation** — ``donate_inputs=True`` accounts freed intermediate
+   bytes (``dispatch.donated_bytes``) and never changes results;
+   ``fusion.donate = False`` turns the accounting off.
+
+4. **IR discipline** — unbound scans, inconsistent bucket flags, local
+   callables, and unresolvable row specs fail loud at plan-build /
+   execute time, never inside a trace.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as t
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.runtime import dispatch, fusion
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+# row counts straddling the power-of-two bucket edges of the default
+# base-16 schedule (same family test_dispatch.py pins)
+EDGE_COUNTS = (1, 15, 16, 17, 33)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_fusion():
+    """Each test sees a fresh executable cache and counter namespace and
+    leaves the fusion/dispatch config at its defaults."""
+    dispatch.clear()
+    REGISTRY.reset()
+    yield
+    for k in ("fusion.enabled", "fusion.donate", "dispatch.enabled"):
+        reset_option(k)
+    dispatch.clear()
+
+
+def _staged(fn):
+    """Run ``fn()`` on the staged op-by-op path (same plan, fusion off)."""
+    set_option("fusion.enabled", False)
+    dispatch.clear()
+    try:
+        return fn()
+    finally:
+        reset_option("fusion.enabled")
+
+
+def _with_null_tail(tbl: Table, cols=(0,)) -> Table:
+    """Null the LAST row's validity in ``cols`` — nulls adjacent to where
+    bucket-padding phantoms live, the spot a masking bug corrupts first."""
+    out = list(tbl.columns)
+    for i in cols:
+        c = out[i]
+        v = np.asarray(c.valid_mask()).copy()
+        v[-1] = False
+        out[i] = Column(c.dtype, c.data, v, chars=c.chars)
+    return Table(out)
+
+
+def _assert_cols_identical(a: Column, b: Column, label=""):
+    av, bv = np.asarray(a.valid_mask()), np.asarray(b.valid_mask())
+    assert np.array_equal(av, bv), f"{label}: validity diverged"
+    ad = np.where(av, np.asarray(a.data), 0)
+    bd = np.where(bv, np.asarray(b.data), 0)
+    assert np.array_equal(ad, bd), f"{label}: data diverged"
+
+
+def _assert_tables_identical(a: Table, b: Table, label=""):
+    assert a.num_columns == b.num_columns
+    assert a.num_rows == b.num_rows
+    for i in range(a.num_columns):
+        _assert_cols_identical(a.column(i), b.column(i), f"{label} col {i}")
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: fused == staged at the bucket edges, null tails included
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", EDGE_COUNTS)
+def test_q1_fused_matches_staged(n):
+    li = _with_null_tail(tpch.lineitem_table(n), cols=(0, 3))
+    fused = tpch.tpch_q1(li)
+    staged = _staged(lambda: tpch.tpch_q1(li))
+    _assert_tables_identical(fused, staged, f"q1 n={n}")
+
+
+@pytest.mark.parametrize("n", EDGE_COUNTS)
+def test_q6_fused_matches_staged(n):
+    li = _with_null_tail(tpch.lineitem_table(n), cols=(2,))
+    fused = tpch.tpch_q6(li)
+    staged = _staged(lambda: tpch.tpch_q6(li))
+    _assert_cols_identical(fused, staged, f"q6 n={n}")
+    if bool(np.asarray(fused.valid_mask())[0]):
+        assert int(fused.data[0]) == tpch.tpch_q6_numpy(li)
+
+
+@pytest.mark.parametrize("n", (1, 15, 16, 17))
+def test_q3_fused_matches_staged(n):
+    cust = tpch.customer_table(max(n // 2, 1))
+    orders = tpch.orders_table(n, cust.num_rows)
+    li = _with_null_tail(
+        tpch.lineitem_q3_table(2 * n, n), cols=(1,))
+
+    fused = tpch.tpch_q3(cust, orders, li)
+    staged = _staged(lambda: tpch.tpch_q3(cust, orders, li))
+    _assert_tables_identical(fused.result.table, staged.result.table,
+                             f"q3 n={n}")
+    assert int(fused.result.num_groups) == int(staged.result.num_groups)
+    assert int(fused.join_total) == int(staged.join_total)
+    assert fused.out_cap == staged.out_cap
+
+
+@pytest.mark.parametrize("n", (1, 16, 17))
+def test_q3_planned_fused_matches_staged(n):
+    cust = tpch.customer_table(max(n // 2, 1))
+    orders = tpch.orders_table(n, cust.num_rows)
+    li = tpch.lineitem_q3_table(2 * n, n)
+
+    fused = tpch.tpch_q3_planned(cust, orders, li)
+    staged = _staged(lambda: tpch.tpch_q3_planned(cust, orders, li))
+    _assert_tables_identical(fused.result.table, staged.result.table,
+                             f"q3_planned n={n}")
+    assert int(fused.join_total) == int(staged.join_total)
+    assert bool(fused.pk_violation) == bool(staged.pk_violation)
+    assert not bool(fused.pk_violation)
+
+
+def test_q1_planned_domain_miss_replans_identically():
+    """Out-of-domain flag bytes must raise domain_miss on BOTH paths, and
+    the checked wrapper's re-plan onto the general pipeline must stay
+    bit-identical fused vs staged."""
+    li = tpch.lineitem_table(33)
+    rf = np.asarray(li.column(tpch.L_RETURNFLAG).data).copy()
+    rf[5] = ord("X")  # outside the declared 'A'/'N'/'R' domain
+    cols = list(li.columns)
+    cols[tpch.L_RETURNFLAG] = Column.from_numpy(rf, t.INT8)
+    li = Table(cols)
+
+    fused = tpch.tpch_q1_planned_result(li)
+    staged = _staged(lambda: tpch.tpch_q1_planned_result(li))
+    assert bool(fused.domain_miss) and bool(staged.domain_miss)
+    assert fused.lowered == staged.lowered == "bounded"
+
+    replanned = tpch.tpch_q1_planned_checked(li)
+    replanned_staged = _staged(lambda: tpch.tpch_q1_planned_checked(li))
+    _assert_tables_identical(replanned, replanned_staged, "q1 re-plan")
+
+
+def test_q1_in_domain_planned_has_no_miss():
+    li = tpch.lineitem_table(64)
+    res = tpch.tpch_q1_planned_result(li)
+    assert not bool(res.domain_miss)
+    _assert_tables_identical(
+        tpch.tpch_q1_planned_checked(li),
+        _staged(lambda: tpch.tpch_q1_planned_checked(li)),
+        "q1 planned")
+
+
+def test_fused_query_composes_under_jit():
+    """Inside an outer jit the bindings are tracers: dispatch's inline
+    path folds the whole region into the caller's trace, same results."""
+    li = tpch.lineitem_table(48)
+    eager = tpch.tpch_q1(li)
+    jitted = jax.jit(tpch.tpch_q1)(li)
+    _assert_tables_identical(eager, jitted, "q1 under jit")
+
+
+# ---------------------------------------------------------------------------
+# executable economy: one compile per region per bucket, not per op
+# ---------------------------------------------------------------------------
+
+
+def test_one_executable_per_region_per_bucket():
+    """Four row counts inside one bucket (17..32 pad to 32) must compile
+    the q1 region exactly ONCE — the fused region inherits dispatch's
+    shape bucketing wholesale."""
+    for n in (17, 20, 31, 32):
+        tpch.tpch_q1(tpch.lineitem_table(n))
+    st = fusion.stats()
+    assert st["regions"] == 4 and st["staged_regions"] == 0
+    assert st["executables"] == 1, st
+    assert st["executables_per_query"] == {"tpch_q1": 1}
+    assert REGISTRY.counter("dispatch.hit").value == 3
+
+
+def test_fused_compiles_fewer_executables_than_staged():
+    """The whole point: the staged q1 pays one executable per op
+    (groupby machinery, sort, gather...); the fused region pays ONE."""
+    li = tpch.lineitem_table(40)
+    tpch.tpch_q1(li)
+    fused_compiles = sum(
+        REGISTRY.counters("dispatch.compile.").values())
+    assert fused_compiles == 1
+
+    REGISTRY.reset()
+    _staged(lambda: tpch.tpch_q1(li))
+    staged_compiles = sum(
+        REGISTRY.counters("dispatch.compile.").values())
+    assert staged_compiles > fused_compiles, (
+        f"staged path compiled {staged_compiles} executables; fusion "
+        f"must beat it (got {fused_compiles})")
+
+
+def test_staged_region_counter_accounts_disabled_runs():
+    li = tpch.lineitem_table(16)
+    _staged(lambda: tpch.tpch_q1(li))
+    st = fusion.stats()
+    assert st["staged_regions"] == 1 and st["regions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+
+def _double_col(tbl: Table) -> Table:
+    c = tbl.column(0)
+    return Table([Column(c.dtype, c.data * 2, c.valid_mask())])
+
+
+def test_donated_intermediates_are_accounted():
+    """donate_inputs=True on a caller-owned intermediate accounts the
+    donated buffer bytes and leaves results identical."""
+    vals = np.arange(64, dtype=np.int64)
+    plan = fusion.Plan("donate_probe", fusion.Project(
+        fusion.Scan("t"), _double_col))
+
+    expected = vals * 2
+    res = fusion.execute(
+        plan, {"t": Table([Column.from_numpy(vals.copy())])},
+        donate_inputs=True)
+    got = np.asarray(res.table.column(0).data)
+    assert np.array_equal(got, expected)
+    assert fusion.stats()["donated_bytes"] > 0
+
+
+def test_fusion_donate_config_gates_donation():
+    set_option("fusion.donate", False)
+    vals = np.arange(64, dtype=np.int64)
+    plan = fusion.Plan("donate_probe", fusion.Project(
+        fusion.Scan("t"), _double_col))
+    fusion.execute(plan, {"t": Table([Column.from_numpy(vals)])},
+                   donate_inputs=True)
+    assert fusion.stats()["donated_bytes"] == 0
+
+
+def test_undeclared_inputs_are_never_donated():
+    vals = np.arange(64, dtype=np.int64)
+    plan = fusion.Plan("donate_probe", fusion.Project(
+        fusion.Scan("t"), _double_col))
+    fusion.execute(plan, {"t": Table([Column.from_numpy(vals)])})
+    assert fusion.stats()["donated_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# IR discipline: misuse fails loud, outside any trace
+# ---------------------------------------------------------------------------
+
+
+def _keep_evens(tbl: Table) -> jax.Array:
+    return tbl.column(0).data % 2 == 0
+
+
+def test_filter_and_limit_nodes_fused_match_staged():
+    vals = np.arange(1, 41, dtype=np.int64)
+    tbl = Table([Column.from_numpy(vals)])
+    plan = fusion.Plan("filter_limit", fusion.Limit(
+        fusion.Filter(fusion.Scan("t"), _keep_evens), 100))
+
+    fused = fusion.execute(plan, {"t": tbl}).table
+    staged = _staged(lambda: fusion.execute(plan, {"t": tbl}).table)
+    # Limit clamps to the TRUE row count, not the bucket
+    assert fused.num_rows == staged.num_rows == 40
+    _assert_tables_identical(fused, staged, "filter+limit")
+    valid = np.asarray(fused.column(0).valid_mask())
+    assert np.array_equal(valid, vals % 2 == 0)
+
+
+def test_unbound_scan_raises():
+    plan = fusion.Plan("p", fusion.Scan("missing"))
+    with pytest.raises(KeyError, match="unbound table 'missing'"):
+        fusion.execute(plan, {})
+
+
+def test_inconsistent_bucket_flags_raise():
+    a, b = fusion.Scan("t"), fusion.Scan("t", bucket=False)
+    plan = fusion.Plan("p", fusion.Join(
+        a, b, (0,), (0,), fusion.rows_of("t")))
+    with pytest.raises(ValueError, match="both bucketed and exact"):
+        fusion.execute(plan, {"t": Table([Column.from_numpy(
+            np.arange(4, dtype=np.int64))])})
+
+
+def test_local_callables_are_rejected():
+    plan = fusion.Plan("p", fusion.Project(
+        fusion.Scan("t"), lambda tbl: tbl))
+    with pytest.raises(ValueError, match="module-level"):
+        fusion.execute(plan, {"t": Table([Column.from_numpy(
+            np.arange(4, dtype=np.int64))])})
+
+
+def test_unresolvable_row_spec_raises():
+    plan = fusion.Plan("p", fusion.Join(
+        fusion.Scan("t"), fusion.Scan("t"), (0,), (0,),
+        ("bogus_spec", "t", 1)))
+    with pytest.raises(ValueError, match="unresolvable row spec"):
+        fusion.execute(plan, {"t": Table([Column.from_numpy(
+            np.arange(4, dtype=np.int64))])})
+
+
+def test_row_specs_resolve_from_true_rows():
+    assert fusion._resolve(fusion.rows_of("t", 3), {"t": 10}) == 30
+    assert fusion._resolve(fusion.min_rows_of("t", 7), {"t": 10}) == 7
+    assert fusion._resolve(fusion.min_rows_of("t", 7), {"t": 4}) == 4
+    assert fusion._resolve(None, {}) is None
+    assert fusion._resolve(12, {}) == 12

@@ -197,8 +197,32 @@ def test_rule_pipeline_stage_needs_pipeline_filename(tmp_path):
     assert not _by_rule(_lint_file(target), "pipeline-stage-host-transfer")
 
 
+def test_rule_fusion_region_host_sync_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_fusion_region.py"),
+                   "fusion-region-host-sync")
+    texts = [f.source_line for f in got]
+    assert len(got) == 4, texts
+    assert any("np.asarray" in t for t in texts)
+    assert any("jax.device_get" in t for t in texts)
+    assert any("block_until_ready" in t for t in texts)
+    assert any(".item()" in t for t in texts)
+    # metadata-derived plan building and the pragma'd boundary read stay
+    # clean
+    src = (FIXTURES / "seeded_fusion_region.py").read_text()
+    clean_at = src[:src.index("def clean_plan_build")].count("\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_fusion_region_needs_fusion_filename(tmp_path):
+    # same constructions outside a fusion module are host-side
+    # orchestration (bench drivers, result consumers) — out of scope
+    target = tmp_path / "plain_orchestration.py"
+    shutil.copy(FIXTURES / "seeded_fusion_region.py", target)
+    assert not _by_rule(_lint_file(target), "fusion-region-host-sync")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all nine rules demonstrably fire."""
+    """The acceptance invariant: all ten rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
@@ -215,6 +239,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_dispatch_device.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_pipeline_stage.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_fusion_region.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

@@ -1,0 +1,43 @@
+"""Seeded violations for fusion-region-host-sync (the filename's
+``fusion`` substring puts every function here in fused-region scope).
+No jit decorators, no _device.py suffix, and no pipeline substring, so
+rules 1/2/8/9 stay silent — each finding below belongs to rule 10
+alone."""
+
+import jax
+import numpy as np
+
+
+def region_materializes_probe(tbl):
+    # host fetch of a traced column mid-region: ConcretizationTypeError
+    # the first time the region fuses
+    host = np.asarray(tbl.columns[0].data)            # VIOLATION
+    return host.sum()
+
+
+def region_device_gets_side_key(meta):
+    return jax.device_get(meta["join.total"])         # VIOLATION
+
+
+def region_blocks_on_intermediate(joined):
+    jax.block_until_ready(joined.columns[0].data)     # VIOLATION
+    return joined
+
+
+def region_reads_group_count(num_groups):
+    return num_groups.item()                          # VIOLATION
+
+
+def clean_plan_build(bindings):
+    # the blessed shape: host values come from binding METADATA at
+    # plan-build time — .num_rows / .shape are static projections and
+    # never touch device buffers
+    rows = bindings["lineitem"].num_rows
+    return max(rows, 1)
+
+
+def clean_pragma_region_boundary(result):
+    # side-key read AFTER execute() returned, at the region boundary
+    # where the caller owns the sync — reviewed
+    # tpulint: disable=fusion-region-host-sync
+    return np.asarray(result.meta["groupby.num_groups"])

@@ -1,4 +1,4 @@
-"""The nine tpulint rules.
+"""The ten tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -687,6 +687,63 @@ def check_pipeline_stage_host_transfer(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 10: fusion-region-host-sync
+# ---------------------------------------------------------------------------
+
+_FUSION_BLOCKING_CALLS = _PIPELINE_BLOCKING_CALLS
+
+
+def _is_fusion_file(name: str) -> bool:
+    return "fusion" in name
+
+
+def check_fusion_region_host_sync(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: the whole point of runtime/fusion.py is that a fusible
+    region lowers to ONE traced executable — every node callable runs
+    inside a single dispatch.call trace. A host materialization inside
+    one of those callables (np.asarray / jax.device_get on a traced
+    table, .tolist()/.item(), block_until_ready) either raises a
+    ConcretizationTypeError the first time the region actually fuses,
+    or — worse — works on the staged path and under dispatch's inline
+    fallback, so the sync ships silently and splits the region back
+    into per-op round trips the moment someone measures the staged
+    path. Scope: every function in a fusion module (basename contains
+    ``fusion``); host-side plan construction that legitimately reads
+    binding row counts does so via .num_rows / .shape, which are static
+    and stay clean. A reviewed-legitimate transfer carries a
+    ``# tpulint: disable=fusion-region-host-sync`` pragma stating why
+    the region must break there."""
+    if not _is_fusion_file(ctx.name):
+        return []
+    out: List[RawFinding] = []
+    seen: set = set()
+    for fn in _functions(ctx.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            ftxt = _unparse(node.func)
+            if ftxt in _FUSION_BLOCKING_CALLS:
+                out.append(RawFinding(
+                    node.lineno, node.col_offset,
+                    f"host sync `{ftxt}(...)` in a fusion module: inside "
+                    f"a fused-region callable it concretizes mid-trace "
+                    f"and splits the single-executable region; resolve "
+                    f"host values from binding metadata (.num_rows / "
+                    f".shape) at plan-build time instead"))
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _HOST_TRANSFER_METHODS
+                  and not node.args and not node.keywords):
+                out.append(RawFinding(
+                    node.lineno, node.col_offset,
+                    f"`.{node.func.attr}()` in a fusion module forces a "
+                    f"device->host sync; a fused-region callable must "
+                    f"stay traceable end to end — hoist the read to the "
+                    f"region boundary (execute()'s meta outputs)"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -722,4 +779,8 @@ RULES = [
          "pipeline stage workers never block on device->host transfers; "
          "host bytes come from the readers' host-staged decode",
          check_pipeline_stage_host_transfer),
+    Rule("fusion-region-host-sync",
+         "no host materialization inside fused-region device functions; "
+         "host values resolve from binding metadata at plan-build time",
+         check_fusion_region_host_sync),
 ]
