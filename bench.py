@@ -383,6 +383,99 @@ def _fusion_block() -> dict:
     return block
 
 
+def _resilience_block() -> dict:
+    """The BENCH_*.json ``resilience`` block: cost of the unified
+    fault-handling layer (runtime/resilience.py + runtime/faults.py). A
+    small out-of-core aggregate runs three ways: resilience enabled
+    (every seam instrumented — the shipping configuration), resilience
+    disabled (the pre-resilience plain-call path), and enabled with ONE
+    transient fault injected mid-run at the outofcore.chunk seam. The
+    block reports the fault-free seam overhead (enabled vs disabled wall,
+    the ≈0 contract), the injected-fault recovery latency (faulted wall
+    minus clean wall — one chunk replay plus backoff), and the leaked
+    reservation bytes after recovery (must be 0). Probe-sized (a few MB,
+    6 chunks): it cannot distort the measured config's numbers; it runs
+    after the config body."""
+    block: dict = {}
+    try:
+        import numpy as np
+
+        from spark_rapids_jni_tpu.columnar import Column
+        from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+        from spark_rapids_jni_tpu.ops.table_ops import trim_table
+        from spark_rapids_jni_tpu.runtime import faults, resilience
+        from spark_rapids_jni_tpu.runtime.memory import (
+            MemoryLimiter,
+            _col_to_host,
+            host_table_chunk,
+        )
+        from spark_rapids_jni_tpu.runtime.outofcore import (
+            run_chunked_aggregate,
+        )
+        from spark_rapids_jni_tpu.utils.config import (
+            reset_option,
+            set_option,
+        )
+
+        n_chunks, rows = 6, 1 << 13
+        rng = np.random.RandomState(7)
+        host_cols = [
+            [_col_to_host(Column.from_numpy(
+                rng.randint(0, 8, rows).astype(np.int64))),
+             _col_to_host(Column.from_numpy(
+                 rng.randint(0, 1000, rows).astype(np.int64)))]
+            for _ in range(n_chunks)
+        ]
+
+        def _agg(tbl):
+            g = groupby_aggregate(tbl, keys=[0], aggs=[(1, "sum")],
+                                  max_groups=16)
+            return trim_table(g.table, int(g.num_groups))
+
+        def _run():
+            limiter = MemoryLimiter(1 << 30)
+            sources = [(lambda hc=hc: host_table_chunk(hc, rows))
+                       for hc in host_cols]
+            t0 = time.perf_counter()
+            run_chunked_aggregate(sources, _agg, _agg, limiter=limiter,
+                                  prefetch_depth=2, pipeline=True)
+            return time.perf_counter() - t0, limiter.used
+
+        # warmup: pay the one-time jit compile outside the timed region
+        _run()
+
+        enabled_wall = min(_run()[0] for _ in range(3))
+        set_option("resilience.enabled", False)
+        try:
+            disabled_wall = min(_run()[0] for _ in range(3))
+        finally:
+            reset_option("resilience.enabled")
+
+        script = faults.FaultScript([faults.FaultSpec(
+            "outofcore.chunk",
+            resilience.TransientDeviceError("bench fault probe"),
+            seq=n_chunks // 2)])
+        with faults.inject(script):
+            faulted_wall, leaked = _run()
+
+        block.update({
+            "chunks": n_chunks,
+            "enabled_wall_s": round(enabled_wall, 6),
+            "disabled_wall_s": round(disabled_wall, 6),
+            "seam_overhead_frac": (round(
+                enabled_wall / disabled_wall - 1.0, 4)
+                if disabled_wall else None),
+            "injected_faults": len(script.fired),
+            "faulted_wall_s": round(faulted_wall, 6),
+            "recovery_latency_s": round(
+                max(0.0, faulted_wall - enabled_wall), 6),
+            "post_fault_leaked_bytes": leaked,
+        })
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    return block
+
+
 def _ledger_last(metric: str, n: int):
     """Most recent ledger record for ``metric`` under the current
     measurement tag — preferring an exact row-count match (throughput is
@@ -1252,7 +1345,8 @@ def _child_main(config: str, n: int, iters: int) -> None:
     value = _CONFIGS[config][0](n, iters)
     print(json.dumps({"value": value, "dispatch": _dispatch_block(),
                       "pipeline": _pipeline_block(),
-                      "fusion": _fusion_block()}))
+                      "fusion": _fusion_block(),
+                      "resilience": _resilience_block()}))
 
 
 # ---------------------------------------------------------------------------

@@ -1,10 +1,11 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its ten invariant rules (host/device
+# tpulint (tools/tpulint) runs its eleven invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
-# pipeline-stage host-transfer, fusion-region host-sync)
+# pipeline-stage host-transfer, fusion-region host-sync,
+# error-must-classify)
 # over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
 # glob below covers the telemetry/ package alongside every other
 # subpackage.
@@ -114,4 +115,32 @@ for i in range(fused.num_columns):
         f"col {i} data diverged"
 print(f"fusion smoke OK: q1 fused == staged, {compiles} compile "
       f"for the whole region")
+EOF
+
+# resilience smoke: rule 11 only proves broad handlers ACCOUNT for
+# errors — this proves the resilience layer itself still honors its
+# contract: a fault injected at the memory.reserve seam is retried and
+# recovered through the one shared policy, the result is unchanged, no
+# reservation leaks, and the injection + recovery are both visible in
+# telemetry.
+JAX_PLATFORMS=cpu python - <<'EOF'
+from spark_rapids_jni_tpu.runtime import faults, resilience
+from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+
+limiter = MemoryLimiter(1 << 20)
+script = faults.FaultScript(
+    [faults.FaultSpec("memory.reserve",
+                      resilience.TransientDeviceError("injected"))])
+
+with faults.inject(script):
+    got = resilience.retrying(
+        "smoke", lambda: (limiter.reserve(1024), limiter.release(1024)),
+        seam="memory.reserve")
+
+assert script.fired == [("memory.reserve", 1024)], script.fired
+assert limiter.used == 0, f"leaked {limiter.used} reserved bytes"
+injected = REGISTRY.counter("faults.injected.memory.reserve").value
+assert injected == 1, f"expected 1 injected fault, got {injected}"
+print("resilience smoke OK: 1 injected fault, recovered, 0 leaked bytes")
 EOF

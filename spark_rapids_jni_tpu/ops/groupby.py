@@ -1299,14 +1299,29 @@ def groupby_aggregate_auto(
     ``initial_max_groups`` and multiply by ``growth`` until the result fits
     (capped at n, which always fits). Each retry recompiles for the new
     static bound — the bucketed-padding discipline, applied to output
-    cardinality."""
+    cardinality. Growth runs through the shared resilience ladder
+    (``runtime/resilience.escalate``, rung ``grow_capacity``) with the
+    capacity schedule — min(initial·growth^k, n) — preserved exactly; with
+    ``resilience.enabled=false`` the pre-resilience loop runs verbatim."""
+    from spark_rapids_jni_tpu.runtime import resilience
+
     n = table.num_rows
     m = max(1, int(initial_max_groups))
-    while True:
-        res = groupby_aggregate(table, keys, aggs, max_groups=min(m, n))
-        if m >= n or not bool(res.overflowed):
-            return res
-        m *= growth
+    if not resilience.enabled() or n < 1:
+        while True:
+            res = groupby_aggregate(table, keys, aggs, max_groups=min(m, n))
+            if m >= n or not bool(res.overflowed):
+                return res
+            m *= growth
+
+    def _attempt(cap):
+        res = groupby_aggregate(table, keys, aggs, max_groups=cap)
+        # cap == n always fits (distinct groups <= rows): never grow past it
+        return res, cap < n and bool(res.overflowed), None
+
+    return resilience.escalate(
+        "groupby_aggregate_auto", _attempt, seam="dispatch.execute",
+        initial=m, growth=growth, max_capacity=n, rows=n)
 
 
 @func_range("groupby_percentile")

@@ -382,12 +382,30 @@ def join_auto(
     guessed ``out_size``, and if ``total`` exceeded it, grow by ``growth``
     and rerun until exact. Each retry recompiles for the new static bound —
     output capacity is a planning parameter on TPU, and this wrapper is the
-    planner's feedback loop. Returns (maps, materialized table)."""
+    planner's feedback loop. Growth runs through the shared resilience
+    ladder (``runtime/resilience.escalate``): the overflowed attempt
+    reports its exact requirement (``total``), so the schedule —
+    max(total, out_size·growth) — converges on the second attempt exactly
+    as the pre-resilience loop did. Returns (maps, materialized table)."""
+    from spark_rapids_jni_tpu.runtime import resilience
+
     n = max(left.num_rows, 1)
     out_size = int(initial_out_size) if initial_out_size else n
-    while True:
-        maps = join(left, right, left_on, right_on, out_size, how=how)
+    if not resilience.enabled():
+        while True:
+            maps = join(left, right, left_on, right_on, out_size, how=how)
+            total = int(maps.total)
+            if total <= out_size:
+                return maps, apply_join_maps(left, right, maps)
+            out_size = max(total, out_size * growth)
+
+    def _attempt(cap):
+        maps = join(left, right, left_on, right_on, cap, how=how)
         total = int(maps.total)
-        if total <= out_size:
-            return maps, apply_join_maps(left, right, maps)
-        out_size = max(total, out_size * growth)
+        if total <= cap:
+            return (maps, apply_join_maps(left, right, maps)), False, None
+        return None, True, total
+
+    return resilience.escalate(
+        "join_auto", _attempt, seam="dispatch.execute",
+        initial=out_size, growth=growth, rows=n)

@@ -49,6 +49,7 @@ from spark_rapids_jni_tpu.ops.groupby import (
     groupby_aggregate_bounded,
 )
 from spark_rapids_jni_tpu.ops.sort import sort_table
+from spark_rapids_jni_tpu.runtime.resilience import FatalExecutionError
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 
@@ -338,6 +339,15 @@ def _dense_prologue(gid: jnp.ndarray, m: int, block: int,
     return safe.reshape(-1, block), vb
 
 
+class PlanBudgetExceeded(FatalExecutionError, ValueError):
+    """A groupby's distinct-group count exceeded ``max_budget``.
+
+    Classified fatal in the resilience taxonomy (the budget is a caller
+    contract, not a transient condition) while remaining the ValueError
+    this API historically raised, so existing ``except ValueError`` /
+    message-matching callers are unaffected."""
+
+
 def plan_groupby_auto(
     table: Table,
     keys: Sequence[int],
@@ -351,20 +361,38 @@ def plan_groupby_auto(
     fallback drops groups (``overflowed``), double the budget and
     retry until the result is complete (the groupby_aggregate_auto
     pattern). The bounded plan never overflows (slot count checked at
-    plan time), so retries only occur on the general path."""
+    plan time), so retries only occur on the general path. Growth runs
+    through the shared resilience ladder — budget schedule min(b·2^k,
+    cap) preserved exactly — and exhaustion raises
+    :class:`PlanBudgetExceeded` (a ``FatalExecutionError`` that is still
+    the ValueError callers match on)."""
+    from spark_rapids_jni_tpu.runtime import resilience
+
     cap = max_budget if max_budget is not None else max(table.num_rows, 1)
     # clamp both ways: a sub-positive budget would loop forever (0*2 == 0)
     # and a starting budget above the cap would silently ignore it
     b = min(max(budget, 1), cap)
-    while True:
-        res = plan_groupby(table, keys, aggs, domains, budget=b,
+    if not resilience.enabled():
+        while True:
+            res = plan_groupby(table, keys, aggs, domains, budget=b,
+                               row_valid=row_valid)
+            if not bool(res.overflowed) or b >= cap:
+                if bool(res.overflowed):
+                    raise PlanBudgetExceeded(
+                        f"groupby exceeded max_budget={cap} distinct groups")
+                return res
+            b = min(b * 2, cap)
+
+    def _attempt(budget_):
+        res = plan_groupby(table, keys, aggs, domains, budget=budget_,
                            row_valid=row_valid)
-        if not bool(res.overflowed) or b >= cap:
-            if bool(res.overflowed):
-                raise ValueError(
-                    f"groupby exceeded max_budget={cap} distinct groups")
-            return res
-        b = min(b * 2, cap)
+        return res, bool(res.overflowed), None
+
+    return resilience.escalate(
+        "plan_groupby_auto", _attempt, seam="dispatch.execute",
+        initial=b, growth=2, max_capacity=cap,
+        exhaust=lambda c, steps: PlanBudgetExceeded(
+            f"groupby exceeded max_budget={cap} distinct groups"))
 
 
 @func_range("dense_id_counts")

@@ -117,6 +117,9 @@ def _read_buffer(r: _Reader, dctx) -> np.ndarray:
     compressed, plen = r.unpack("<BQ")
     payload = r.take(plen)
     if compressed:
+        if dctx is None:
+            raise ModuleNotFoundError(
+                "zstandard is required to decode a compressed DCN frame")
         payload = dctx.decompress(payload)
     return np.frombuffer(payload, dtype=np.dtype(dts)).reshape(shape)
 
@@ -171,7 +174,10 @@ def deserialize_table(blob: bytes) -> Table:
     version, ncols, _nrows = r.unpack("<IIQ")
     if version != _VERSION:
         raise ValueError(f"DCN frame version {version} != {_VERSION}")
-    _, dctx = _zstd(1)
+    try:
+        _, dctx = _zstd(1)
+    except ModuleNotFoundError:
+        dctx = None  # uncompressed frames decode without the codec
     return Table([_read_column(r, dctx) for _ in range(ncols)])
 
 
@@ -234,11 +240,38 @@ class SliceLink:
                 time.sleep(delay_s)
 
     def send_table(self, table: Table, compress_level: int = 3) -> int:
-        blob = serialize_table(table, compress_level)
+        from spark_rapids_jni_tpu.runtime import faults, resilience
+
+        def _frame():
+            # seam + retry cover serialization only: once sendall starts,
+            # bytes on the wire make a blind replay corrupt the stream —
+            # transport-level resend belongs below this framing layer
+            faults.fire("dcn.transport", 0, direction="send",
+                        rows=table.num_rows)
+            return serialize_table(table, compress_level)
+
+        if resilience.enabled():
+            blob = resilience.retrying(
+                "dcn.send_table", _frame, seam="dcn.transport",
+                rows=table.num_rows)
+        else:
+            blob = _frame()
         self._sock.sendall(struct.pack("<Q", len(blob)) + blob)
         return len(blob)
 
     def recv_table(self) -> Table:
+        from spark_rapids_jni_tpu.runtime import faults, resilience
+
+        def _entry():
+            # fires before any read: an injected fault must not desync
+            # framing, so the retryable window closes at the first recv
+            faults.fire("dcn.transport", 0, direction="recv")
+
+        if resilience.enabled():
+            resilience.retrying("dcn.recv_table", _entry,
+                                seam="dcn.transport")
+        else:
+            _entry()
         hdr = self._recv_exact(8)
         (length,) = struct.unpack("<Q", hdr)
         return deserialize_table(self._recv_exact(length))

@@ -387,13 +387,21 @@ def _distributed_groupby(table, keys, mesh, capacity, local_groupby,
     closure is opaque: fall back to an uncached shard_map call rather than
     risk serving a stale executable for different closure contents.
 
-    Shuffle capacity overflow recovers HERE, once, instead of at every
-    caller: ``overflowed`` is a device flag (the in-trace shuffle cannot
-    grow its static send-buffer shape), so the host boundary after the
-    call is the first place a bigger capacity can be chosen. One retry at
-    doubled quantized capacity handles the common skewed-batch case; a
-    result that STILL overflows is returned with the flag set (fail loud
-    at the caller, as before)."""
+    Shuffle capacity overflow recovers HERE, instead of at every caller:
+    ``overflowed`` is a device flag (the in-trace shuffle cannot grow its
+    static send-buffer shape), so the host boundary after the call is the
+    first place a bigger capacity can be chosen. Escalation is bounded
+    geometric through the shared resilience policy — each step doubles
+    and re-quantizes through the dispatch bucket schedule, and the final
+    allowed attempt jumps to the quantized row count (a per-device
+    capacity of n rows always fits, so a recoverable skew never exhausts
+    the bound). Still overflowing there — or past
+    ``resilience.max_attempts`` — raises a classified
+    ``FatalExecutionError`` carrying rows/capacity context. With
+    ``resilience.enabled=false`` the historical behavior runs verbatim:
+    one retry at doubled quantized capacity, then the flag is returned
+    set (fail loud at the caller)."""
+    from spark_rapids_jni_tpu.runtime import faults, resilience
 
     def run(cap):
         def step(local: Table):
@@ -422,17 +430,72 @@ def _distributed_groupby(table, keys, mesh, capacity, local_groupby,
                      _mesh_fingerprint(mesh)),
         )
 
-    out_tbl, num_groups, overflowed, sum_overflow = run(capacity)
-    if bool(np.asarray(overflowed).any()):
-        retry_cap = _shuffle_retry_capacity(table, mesh, capacity)
+    pol = resilience.policy()
+    if not pol.enabled:
+        out_tbl, num_groups, overflowed, sum_overflow = run(capacity)
+        if bool(np.asarray(overflowed).any()):
+            retry_cap = _shuffle_retry_capacity(table, mesh, capacity)
+            telemetry.record_fallback(
+                "distributed_groupby",
+                "shuffle capacity overflow: a device received more rows "
+                "than its send-buffer slots; retrying once at doubled "
+                "quantized capacity",
+                rows=table.num_rows, retry_capacity=retry_cap)
+            out_tbl, num_groups, overflowed, sum_overflow = run(retry_cap)
+        return DistributedGroupBy(out_tbl, num_groups, overflowed,
+                                  sum_overflow)
+
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    max_cap = dispatch.quantize_capacity(max(table.num_rows, 1))
+    cap = capacity  # None on attempt 1: hash_shuffle derives it in-trace
+
+    def _run(c):
+        faults.fire("shuffle.transport", 0, rows=table.num_rows)
+        return run(c)
+
+    attempt = 1
+    while True:
+        out_tbl, num_groups, overflowed, sum_overflow = resilience.retrying(
+            "distributed_groupby", lambda: _run(cap),
+            seam="shuffle.transport", pol=pol, rows=table.num_rows)
+        if not bool(np.asarray(overflowed).any()):
+            if attempt > 1:
+                telemetry.record_resilience(
+                    "distributed_groupby", "recovered",
+                    seam="shuffle.transport", attempt=attempt,
+                    rung="grow_capacity", rows=table.num_rows)
+            return DistributedGroupBy(out_tbl, num_groups, overflowed,
+                                      sum_overflow)
+        at_max = cap is not None and int(cap) >= max_cap
+        if attempt >= pol.max_attempts or at_max:
+            telemetry.record_resilience(
+                "distributed_groupby", "fatal", seam="shuffle.transport",
+                attempt=attempt, rung="grow_capacity", rows=table.num_rows)
+            raise resilience.FatalExecutionError(
+                "distributed_groupby: shuffle capacity escalation "
+                "exhausted with the overflow flag still set",
+                rows=table.num_rows,
+                capacity=int(cap) if cap is not None else "derived",
+                max_capacity=max_cap, attempts=attempt)
+        # final allowed attempt jumps straight to the quantized row count
+        # (always sufficient); earlier steps double-and-quantize
+        if attempt + 1 >= pol.max_attempts:
+            retry_cap = max_cap
+        else:
+            retry_cap = min(_shuffle_retry_capacity(table, mesh, cap),
+                            max_cap)
         telemetry.record_fallback(
             "distributed_groupby",
             "shuffle capacity overflow: a device received more rows than "
-            "its send-buffer slots; retrying once at doubled quantized "
-            "capacity",
+            "its send-buffer slots; escalating quantized capacity",
             rows=table.num_rows, retry_capacity=retry_cap)
-        out_tbl, num_groups, overflowed, sum_overflow = run(retry_cap)
-    return DistributedGroupBy(out_tbl, num_groups, overflowed, sum_overflow)
+        telemetry.record_resilience(
+            "distributed_groupby", "escalate", seam="shuffle.transport",
+            attempt=attempt, rung="grow_capacity", rows=table.num_rows,
+            capacity=retry_cap)
+        cap = retry_cap
+        attempt += 1
 
 
 def distributed_groupby_percentile(
@@ -698,22 +761,34 @@ def distributed_join(
         left_row_valid = jnp.ones((left.num_rows,), jnp.bool_)
     if right_row_valid is None:
         right_row_valid = jnp.ones((right.num_rows,), jnp.bool_)
-    from spark_rapids_jni_tpu.runtime import dispatch
+    from spark_rapids_jni_tpu.runtime import dispatch, faults, resilience
 
-    out, total, overflowed = dispatch.sharded_call(
-        "distributed_join",
-        lambda: jax.shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS),
-                      P(EXEC_AXIS)),
-            out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
-        ),
-        (left, right, left_row_valid, right_row_valid),
-        statics=(tuple(left_keys), tuple(right_keys),
-                 int(out_size_per_device), how, left_capacity,
-                 right_capacity, _mesh_fingerprint(mesh)),
-    )
+    def _exchange():
+        # the exchange is the ICI-transport boundary: a transient
+        # transport fault here replays the whole (idempotent) step
+        faults.fire("shuffle.transport", 0,
+                    rows=left.num_rows + right.num_rows)
+        return dispatch.sharded_call(
+            "distributed_join",
+            lambda: jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS),
+                          P(EXEC_AXIS)),
+                out_specs=(P(EXEC_AXIS), P(EXEC_AXIS), P(EXEC_AXIS)),
+            ),
+            (left, right, left_row_valid, right_row_valid),
+            statics=(tuple(left_keys), tuple(right_keys),
+                     int(out_size_per_device), how, left_capacity,
+                     right_capacity, _mesh_fingerprint(mesh)),
+        )
+
+    if resilience.enabled():
+        out, total, overflowed = resilience.retrying(
+            "distributed_join", _exchange, seam="shuffle.transport",
+            rows=left.num_rows + right.num_rows)
+    else:
+        out, total, overflowed = _exchange()
     return DistributedJoin(out, total, overflowed)
 
 

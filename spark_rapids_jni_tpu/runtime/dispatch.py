@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.runtime import faults, resilience
 from spark_rapids_jni_tpu.telemetry.events import record_compile_cache
 from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
 from spark_rapids_jni_tpu.types import TypeId
@@ -293,14 +294,22 @@ def _init_persistent_cache() -> None:
                 ("jax_persistent_cache_min_entry_size_bytes", -1)):
             try:
                 jax.config.update(opt, val)
+            # knob names drift across jax versions; a miss only loses
+            # tuning, never correctness, and the outer handler already
+            # counts real failures
+            # tpulint: disable=error-must-classify
             except Exception:
-                pass  # knob names drift across jax versions; best effort
+                pass
         # jax latches the cache as disabled at the FIRST compile in the
         # process; imports above us always compile something, so force a
         # re-read of the dir we just set
         try:
             from jax._src import compilation_cache as _cc
             _cc.reset_cache()
+        # private-module probe, absent on some jax versions; the cache
+        # still serves compiles after this point and the outer handler
+        # counts real failures
+        # tpulint: disable=error-must-classify
         except Exception:
             pass
         REGISTRY.gauge("dispatch.persistent_cache").set(1)
@@ -390,7 +399,9 @@ def call(
         compiled = _EXEC_CACHE.get(key)
     if compiled is None:
         _init_persistent_cache()
-        try:
+
+        def _compile():
+            faults.fire("dispatch.compile", 0, op=op)
             jitted = (jax.jit(fn, donate_argnums=(0,)) if donate_rows
                       else jax.jit(fn))
             with warnings.catch_warnings():
@@ -399,9 +410,17 @@ def call(
                 # honored where the platform implements it
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable")
-                compiled = jitted.lower(
-                    padded, aux_args, row_valids).compile()
-        except Exception:
+                return jitted.lower(padded, aux_args, row_valids).compile()
+
+        # transient device faults retry under the shared policy; genuine
+        # compile errors (non-transient) give up on attempt 1 and take the
+        # host_fallback ladder rung below — dispatch still never raises
+        # on its own behalf
+        compiled, exc = resilience.retry_or_none(
+            op, _compile, seam="dispatch.compile", rung="host_fallback")
+        if compiled is None:
+            if exc is not None and not isinstance(exc, Exception):
+                raise exc  # KeyboardInterrupt etc: not dispatch's to absorb
             REGISTRY.counter("dispatch.compile_error").inc()
             return _inline(op, "compile_error", fn, row_args, aux_args)
         with _lock:
@@ -414,9 +433,15 @@ def call(
         REGISTRY.counter(f"dispatch.hit.{op}").inc()
         record_compile_cache(f"dispatch:{op}", hit=True)
 
-    try:
-        out = compiled(padded, aux_args, row_valids)
-    except Exception:
+    def _execute():
+        faults.fire("dispatch.execute", 0, op=op)
+        return compiled(padded, aux_args, row_valids)
+
+    out, exc = resilience.retry_or_none(
+        op, _execute, seam="dispatch.execute", rung="host_fallback")
+    if out is None and exc is not None:
+        if not isinstance(exc, Exception):
+            raise exc
         # aval drift (weak types, sharding changes) — never take the op down
         REGISTRY.counter("dispatch.exec_error").inc()
         return _inline(op, "exec_error", fn, row_args, aux_args)
@@ -474,9 +499,16 @@ def sharded_call(
         compiled = _EXEC_CACHE.get(key)
     if compiled is None:
         _init_persistent_cache()
-        try:
-            compiled = jax.jit(build()).lower(*args).compile()
-        except Exception:
+
+        def _compile():
+            faults.fire("dispatch.compile", 0, op=op)
+            return jax.jit(build()).lower(*args).compile()
+
+        compiled, exc = resilience.retry_or_none(
+            op, _compile, seam="dispatch.compile", rung="host_fallback")
+        if compiled is None:
+            if exc is not None and not isinstance(exc, Exception):
+                raise exc
             REGISTRY.counter("dispatch.compile_error").inc()
             REGISTRY.counter("dispatch.inline").inc()
             REGISTRY.counter("dispatch.inline.compile_error").inc()
@@ -490,13 +522,21 @@ def sharded_call(
         REGISTRY.counter("dispatch.hit").inc()
         REGISTRY.counter(f"dispatch.hit.{op}").inc()
         record_compile_cache(f"dispatch:{op}", hit=True)
-    try:
+
+    def _execute():
+        faults.fire("dispatch.execute", 0, op=op)
         return compiled(*args)
-    except Exception:
+
+    out, exc = resilience.retry_or_none(
+        op, _execute, seam="dispatch.execute", rung="host_fallback")
+    if out is None and exc is not None:
+        if not isinstance(exc, Exception):
+            raise exc
         REGISTRY.counter("dispatch.exec_error").inc()
         REGISTRY.counter("dispatch.inline").inc()
         REGISTRY.counter("dispatch.inline.exec_error").inc()
         return build()(*args)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,232 @@
+"""Global fault-injection registry: named seams across the whole runtime.
+
+Generalizes the pipeline-only ``runtime/pipeline.py:inject_fault`` hook into
+one registry every runtime boundary fires through. A *seam* is a named point
+where a real deployment can fail — device compile/execute, memory
+reservation, spill IO, chunk boundaries, network transport, fused-region
+dispatch. Production code calls ``fire(seam, seq)`` at each seam; with no
+injector installed that is one module-global ``is None`` check (the fault-free
+overhead budget is ≈0). Tests install an injector with ``inject(...)`` and
+schedule deterministic (:class:`FaultSpec`) or seeded-random
+(:class:`FaultScript`) fault scripts at any seam.
+
+Zero third-party deps and no jax import (same import-hygiene contract as
+telemetry): this module must be loadable before any backend initializes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+
+__all__ = [
+    "SEAMS",
+    "FaultSpec",
+    "FaultScript",
+    "fire",
+    "inject",
+    "active_injector",
+]
+
+# Every instrumented boundary, by layer. fire() rejects unknown seam names so
+# a typo in production code or a test script fails loudly instead of silently
+# never firing. Pipeline stages keep their legacy stage names under a
+# "pipeline." prefix so pipeline.inject_fault can stay a thin alias.
+SEAMS: Tuple[str, ...] = (
+    # dispatch (runtime/dispatch.py)
+    "dispatch.compile",
+    "dispatch.execute",
+    # memory layer (runtime/memory.py)
+    "memory.reserve",
+    "spill.spill",
+    "spill.unspill",
+    # out-of-core chunk boundaries (runtime/outofcore.py)
+    "outofcore.chunk",
+    "outofcore.merge",
+    # pipelined executor stages (runtime/pipeline.py)
+    "pipeline.decode",
+    "pipeline.staging",
+    "pipeline.transfer",
+    "pipeline.compute",
+    "pipeline.merge",
+    # distributed transport (parallel/distributed.py, parallel/dcn.py)
+    "shuffle.transport",
+    "dcn.transport",
+    # whole-stage fusion region dispatch (runtime/fusion.py)
+    "fusion.region",
+)
+
+_SEAM_SET = frozenset(SEAMS)
+
+# The installed injector: a callable (seam, seq, ctx) -> None that raises to
+# inject a fault. None (the common case) short-circuits fire() to a single
+# attribute load + comparison.
+_active: Optional[Callable[[str, int, dict], None]] = None
+_lock = threading.Lock()
+
+
+def active_injector() -> Optional[Callable[[str, int, dict], None]]:
+    """The currently installed injector, or None (introspection/tests)."""
+    return _active
+
+
+def fire(seam: str, seq: int = 0, **ctx: Any) -> None:
+    """Production seam hook: no-op unless a test installed an injector.
+
+    ``seq`` is the seam-local sequence number (chunk index, attempt number,
+    message ordinal); ``ctx`` carries whatever the seam knows (rows, nbytes,
+    op). When the injector raises, the raise is counted under
+    ``faults.injected`` / ``faults.injected.<seam>`` and propagates to the
+    seam's recovery path exactly like a real failure would.
+    """
+    hook = _active
+    if hook is None:
+        return
+    if seam not in _SEAM_SET:
+        raise ValueError(f"unknown fault seam {seam!r}; registered: {sorted(_SEAM_SET)}")
+    try:
+        hook(seam, int(seq), ctx)
+    except BaseException:
+        REGISTRY.counter("faults.injected").inc()
+        REGISTRY.counter(f"faults.injected.{seam}").inc()
+        raise
+
+
+@contextlib.contextmanager
+def inject(injector: Callable[[str, int, dict], None]) -> Iterator[None]:
+    """Install ``injector`` for the duration of the with-block.
+
+    The injector is called at every seam firing as ``injector(seam, seq,
+    ctx)``; raising injects the fault. Nested installs stack (inner wins,
+    outer restored on exit). :class:`FaultSpec` lists and
+    :class:`FaultScript` objects are callable and slot in directly.
+    """
+    global _active
+    with _lock:
+        prev = _active
+        _active = injector
+    try:
+        yield
+    finally:
+        with _lock:
+            _active = prev
+
+
+def _raise_fault(exc) -> None:
+    """``exc`` may be an exception class, a zero-arg factory, or a ready
+    instance. Classes get a standard message (the taxonomy requires one)."""
+    if isinstance(exc, BaseException):
+        raise exc
+    if isinstance(exc, type) and issubclass(exc, BaseException):
+        raise exc("injected fault")
+    raise exc()
+
+
+class FaultSpec:
+    """One deterministic scheduled fault: raise ``exc`` at a seam firing.
+
+    ``exc`` is an exception class (or zero-arg factory) or a pre-built
+    exception instance. ``seq=None`` matches any sequence number; ``times``
+    bounds how often the spec fires (default once — the transient-fault
+    shape).
+    """
+
+    def __init__(
+        self,
+        seam: str,
+        exc,
+        *,
+        seq: Optional[int] = None,
+        times: int = 1,
+    ) -> None:
+        if seam not in _SEAM_SET:
+            raise ValueError(f"unknown fault seam {seam!r}; registered: {sorted(_SEAM_SET)}")
+        self.seam = seam
+        self.exc = exc
+        self.seq = seq
+        self.times = int(times)
+        self.fired = 0
+
+    def matches(self, seam: str, seq: int) -> bool:
+        if seam != self.seam or self.fired >= self.times:
+            return False
+        return self.seq is None or int(seq) == self.seq
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"FaultSpec(seam={self.seam!r}, seq={self.seq}, "
+            f"times={self.times}, fired={self.fired})"
+        )
+
+
+class FaultScript:
+    """A schedule of faults: deterministic specs and/or seeded-random chaos.
+
+    Deterministic: pass ``specs`` (a list of :class:`FaultSpec`); each fires
+    at its matching seam/seq up to its ``times`` budget.
+
+    Seeded-random: pass ``seed`` + ``rate`` (+ optionally ``seams`` to
+    restrict); each firing of an eligible seam then injects with probability
+    ``rate``. The random decision is derived from ``(seed, seam, seq, nth)``
+    — NOT from a shared generator — so it is reproducible regardless of how
+    pipeline/producer threads interleave seam firings.
+
+    ``max_faults`` bounds total injections across the whole script (default
+    unlimited); ``fired`` records ``(seam, seq)`` history for assertions.
+    The script object is the injector: ``with faults.inject(script): ...``.
+    """
+
+    def __init__(
+        self,
+        specs: Optional[Sequence[FaultSpec]] = None,
+        *,
+        seed: Optional[int] = None,
+        rate: float = 0.0,
+        seams: Optional[Sequence[str]] = None,
+        exc=RuntimeError,
+        max_faults: Optional[int] = None,
+    ) -> None:
+        self.specs: List[FaultSpec] = list(specs or [])
+        if seams is not None:
+            unknown = set(seams) - _SEAM_SET
+            if unknown:
+                raise ValueError(f"unknown fault seams {sorted(unknown)}")
+        self.seed = seed
+        self.rate = float(rate)
+        self.seams = frozenset(seams) if seams is not None else None
+        self.exc = exc
+        self.max_faults = max_faults
+        self.fired: List[Tuple[str, int]] = []
+        self._counts: dict = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, seam: str, seq: int, ctx: dict) -> None:
+        with self._lock:
+            if self.max_faults is not None and len(self.fired) >= self.max_faults:
+                return
+            for spec in self.specs:
+                if spec.matches(seam, seq):
+                    spec.fired += 1
+                    self.fired.append((seam, seq))
+                    _raise_fault(spec.exc)
+            if self.rate > 0.0 and self.seed is not None:
+                if self.seams is not None and seam not in self.seams:
+                    return
+                # nth firing of this exact (seam, seq) — keeps retries of the
+                # same chunk from deterministically re-hitting the same fault
+                nth = self._counts.get((seam, seq), 0)
+                self._counts[(seam, seq)] = nth + 1
+                rng = random.Random(f"{self.seed}|{seam}|{int(seq)}|{nth}")
+                if rng.random() < self.rate:
+                    self.fired.append((seam, seq))
+                    _raise_fault(self.exc)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"FaultScript(specs={len(self.specs)}, seed={self.seed}, "
+            f"rate={self.rate}, fired={len(self.fired)})"
+        )

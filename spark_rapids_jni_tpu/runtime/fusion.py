@@ -66,6 +66,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.runtime import faults, resilience
+from spark_rapids_jni_tpu.telemetry.events import record_fallback
 from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
 from spark_rapids_jni_tpu.utils.config import get_option
 
@@ -615,7 +617,9 @@ def execute(plan: Plan, bindings: dict, *,
     }
     side_keys = _side_keys(nodes)
 
-    if not get_option("fusion.enabled"):
+    def _staged_eval() -> FusedResult:
+        # the staged reference path (the bit-identity oracle): the same
+        # node walk op-by-op, each op dispatching itself
         REGISTRY.counter("fusion.staged_regions").inc()
         tables = {name: bindings[name] for name in bucketed + exact}
         rvs = {name: None for name in tables}
@@ -624,6 +628,9 @@ def execute(plan: Plan, bindings: dict, *,
         meta = dict(side)
         meta.update(static_meta)
         return FusedResult(value, meta)
+
+    if not get_option("fusion.enabled"):
+        return _staged_eval()
 
     from spark_rapids_jni_tpu.runtime import dispatch
 
@@ -646,10 +653,34 @@ def execute(plan: Plan, bindings: dict, *,
 
     donate = (bool(donate_inputs) and bool(get_option("fusion.donate"))
               and bool(bucketed))
-    value, side_vals = dispatch.call(
-        f"fusion.{plan.name}", _region, row_args, aux_args,
-        statics=("fusion", fingerprint), slice_rows=False,
-        donate_rows=donate)
+
+    def _dispatch_region():
+        # the seam fires BEFORE dispatch.call touches (and possibly
+        # donates) the bound buffers, so both the retry and the staged
+        # fallback below replay against intact inputs
+        faults.fire("fusion.region", 0, plan=plan.name)
+        return dispatch.call(
+            f"fusion.{plan.name}", _region, row_args, aux_args,
+            statics=("fusion", fingerprint), slice_rows=False,
+            donate_rows=donate)
+
+    if resilience.enabled():
+        out, exc = resilience.retry_or_none(
+            f"fusion.{plan.name}", _dispatch_region,
+            seam="fusion.region", rung="staged_fallback")
+        if exc is not None:
+            if not isinstance(exc, Exception):
+                raise exc
+            # final ladder rung: run the region through the staged
+            # evaluator (bit-identical) and account for it
+            record_fallback(
+                f"fusion.{plan.name}",
+                f"fused region dispatch failed "
+                f"({type(exc).__name__}): staged evaluator fallback")
+            return _staged_eval()
+        value, side_vals = out
+    else:
+        value, side_vals = _dispatch_region()
 
     root_space = spaces[id(plan.root)]
     if root_space is not None:

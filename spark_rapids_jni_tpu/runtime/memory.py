@@ -29,6 +29,7 @@ from typing import NamedTuple, Optional
 import numpy as np
 
 from spark_rapids_jni_tpu import telemetry
+from spark_rapids_jni_tpu.runtime import faults
 from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.log import get_logger
 
@@ -93,6 +94,9 @@ class MemoryLimiter:
         return self._peak
 
     def reserve(self, nbytes: int) -> None:
+        # fault seam BEFORE the lock: an injected reservation failure must
+        # leave the accounting untouched, like a real allocator rejection
+        faults.fire("memory.reserve", nbytes, blocking=False)
         with self._lock:
             if self._used + nbytes > self.budget:
                 raise MemoryLimitExceeded(
@@ -118,6 +122,7 @@ class MemoryLimiter:
         ``timeout`` seconds elapsed first — cancellation is polled, so
         a cancelled producer wakes within ~50ms.
         """
+        faults.fire("memory.reserve", nbytes, blocking=True)
         if nbytes > self.budget:
             raise MemoryLimitExceeded(
                 f"reservation of {nbytes} bytes exceeds the whole budget "
@@ -393,6 +398,9 @@ class SpillStore:
                 )
             _, eid = min(candidates)
             e = self._entries[eid]
+            # fire before mutating the entry: an injected spill-IO failure
+            # must leave the victim resident and the store consistent
+            faults.fire("spill.spill", eid, nbytes=e["nbytes"])
             e["host_cols"] = [
                 _col_to_host(c, self._cctx) for c in e["table"].columns]
             e["table"] = None  # drop the device arrays -> XLA frees HBM
@@ -433,6 +441,9 @@ class SpillStore:
             e["tick"] = self._tick
             if e["state"] == "device":
                 return e["table"]
+            # fire before any staging: an injected unspill failure must
+            # leave the entry spilled (host copy intact, retryable)
+            faults.fire("spill.unspill", handle, nbytes=e["nbytes"])
             self._spill_lru_locked(e["nbytes"])
             cols = [
                 _col_from_host(snap, self._dctx) for snap in e["host_cols"]]

@@ -28,6 +28,7 @@ from typing import Callable, Iterable, NamedTuple
 
 from spark_rapids_jni_tpu import telemetry
 from spark_rapids_jni_tpu.columnar import Table
+from spark_rapids_jni_tpu.runtime import faults, resilience
 from spark_rapids_jni_tpu.runtime.memory import (
     MemoryLimiter,
     SpillStore,
@@ -191,46 +192,111 @@ def run_chunked_aggregate(
             else limiter.budget)
     handles: list[int] = []
     nchunks = 0
+    pol = resilience.policy()
     # pipeline mode: decode in a pool, exact-bytes admission, ordered
     # delivery; prefetch mode: single producer thread, depth+2 window;
     # serial mode: one chunk resident at a time. In the first two the
     # producer owns each chunk's reservation and this loop releases it.
+    producer_owns = use_pipeline or prefetch_depth > 0
+    sources = None
     if use_pipeline:
         sources = chunks.chunk_sources() \
             if hasattr(chunks, "chunk_sources") else chunks
-        stream = pl.pipeline_chunks(
-            sources, limiter=limiter,
-            depth=prefetch_depth if prefetch_depth > 0 else None)
-    elif prefetch_depth > 0:
-        stream = prefetch_chunks(chunks, prefetch_depth, limiter)
-    else:
-        stream = chunks
-    producer_owns = use_pipeline or prefetch_depth > 0
-    try:
-        for chunk in stream:
-            nb = _table_nbytes(chunk)
-            if not producer_owns:
-                limiter.reserve(nb)
-            try:
-                if use_pipeline:
-                    # stage 4 of the pipeline: device compute — faults
-                    # injectable, span-traced like the producer stages
-                    pl._maybe_fault("compute", nchunks)
-                    with trace_range("pipeline.compute"):
-                        partial = partial_fn(chunk)
-                else:
+        if pol.enabled:
+            # checkpoint/resume needs a re-enterable source list: chunks
+            # 0..nchunks-1 are checkpointed as spill handles (in-order
+            # delivery guarantees them complete), so after a transient
+            # mid-query fault a fresh pipeline replays sources[nchunks:]
+            # only. Materializing is cheap for decode thunks (the
+            # pipelined norm) — it holds closures, not data.
+            sources = list(sources)
+
+    def _make_stream():
+        if use_pipeline:
+            src = sources[nchunks:] if pol.enabled else sources
+            return pl.pipeline_chunks(
+                src, limiter=limiter,
+                depth=prefetch_depth if prefetch_depth > 0 else None)
+        if prefetch_depth > 0:
+            return prefetch_chunks(chunks, prefetch_depth, limiter)
+        return chunks
+
+    def _process(chunk, seq, nb):
+        """One chunk's partial: reserve (serial mode), compute, checkpoint
+        into the spill store. Self-contained so the replay_chunk ladder
+        rung can re-run it with no reservation carried between attempts."""
+        if not producer_owns:
+            limiter.reserve(nb)
+        try:
+            faults.fire("outofcore.chunk", seq, nbytes=nb)
+            if use_pipeline:
+                # stage 4 of the pipeline: device compute — faults
+                # injectable, span-traced like the producer stages
+                pl._maybe_fault("compute", seq)
+                with trace_range("pipeline.compute"):
                     partial = partial_fn(chunk)
-                handles.append(spill.put(partial))
-            finally:
+            else:
+                partial = partial_fn(chunk)
+            return spill.put(partial)
+        finally:
+            if not producer_owns:
                 limiter.release(nb)
-            del chunk
-            nchunks += 1
-    finally:
-        # a partial_fn failure must stop the producer and release its
-        # in-flight reservations (the no-phantom-usage contract) — the
-        # generator's own finally does both on close
-        if producer_owns:
-            stream.close()
+
+    run_attempt = 1
+    while True:
+        stream = _make_stream()
+        resumed = False
+        try:
+            for chunk in stream:
+                nb = _table_nbytes(chunk)
+                try:
+                    if pol.enabled:
+                        handles.append(resilience.retrying(
+                            "run_chunked_aggregate",
+                            lambda: _process(chunk, nchunks, nb),
+                            seam="outofcore.chunk", rung="replay_chunk",
+                            pol=pol, chunk=nchunks))
+                    else:
+                        handles.append(_process(chunk, nchunks, nb))
+                finally:
+                    if producer_owns:
+                        limiter.release(nb)
+                del chunk
+                nchunks += 1
+        except BaseException as exc:
+            # chunk-level checkpoint/resume: a transient fault inside the
+            # pipelined stream (decode/staging/transfer workers) tears the
+            # stream down with every reservation released; chunks
+            # 0..nchunks-1 are already checkpointed, so replay restarts a
+            # fresh pipeline at the failed chunk only.
+            if not (use_pipeline and pol.enabled
+                    and resilience.is_transient(exc)):
+                raise
+            if run_attempt >= pol.max_attempts:
+                telemetry.record_resilience(
+                    "run_chunked_aggregate", "fatal", seam="outofcore.chunk",
+                    attempt=run_attempt, rung="replay_chunk", chunk=nchunks)
+                raise resilience.FatalExecutionError(
+                    f"run_chunked_aggregate: resume retries exhausted after "
+                    f"{run_attempt} attempts at chunk {nchunks}: {exc}",
+                    chunk=nchunks, attempts=run_attempt) from exc
+            telemetry.record_resilience(
+                "run_chunked_aggregate", "retry", seam="outofcore.chunk",
+                attempt=run_attempt, rung="replay_chunk", chunk=nchunks)
+            run_attempt += 1
+            resumed = True
+        finally:
+            # a partial_fn failure must stop the producer and release its
+            # in-flight reservations (the no-phantom-usage contract) — the
+            # generator's own finally does both on close
+            if producer_owns:
+                stream.close()
+        if not resumed:
+            break
+    if run_attempt > 1:
+        telemetry.record_resilience(
+            "run_chunked_aggregate", "recovered", seam="outofcore.chunk",
+            attempt=run_attempt, rung="replay_chunk", chunk=nchunks)
     if not handles:
         raise ValueError("no chunks: empty input stream")
     stream_stats = spill.stats()
@@ -258,8 +324,17 @@ def run_chunked_aggregate(
             # reserve BEFORE staging: a partial set that exceeds the
             # budget must raise before its bytes are device-resident
             # (get_reserved orders the reservation ahead of the
-            # host->device copy — the pipelined-unspill contract)
-            tbl, nb_p = spill.get_reserved(h, limiter)
+            # host->device copy — the pipelined-unspill contract).
+            # get_reserved leaves no reservation behind on failure, so a
+            # transient unspill fault retries with zero carried state.
+            if pol.enabled:
+                tbl, nb_p = resilience.retrying(
+                    "run_chunked_aggregate",
+                    lambda: spill.get_reserved(h, limiter),
+                    seam="spill.unspill", rung="replay_chunk",
+                    pol=pol, handle=h)
+            else:
+                tbl, nb_p = spill.get_reserved(h, limiter)
             partial_bytes += nb_p
             partials.append(tbl)
             spill.drop(h)
@@ -279,13 +354,24 @@ def run_chunked_aggregate(
         # phantom usage behind a raised MemoryLimitExceeded
         limiter.release(partial_bytes)
         raise
-    try:
+    def _merge():
+        faults.fire("outofcore.merge", nchunks)
         if use_pipeline:
             pl._maybe_fault("merge", nchunks)
             with trace_range("pipeline.merge"):
-                out = merge_fn(merged_in)
+                return merge_fn(merged_in)
+        return merge_fn(merged_in)
+
+    try:
+        if pol.enabled:
+            # the merged-input reservation is held across merge retries
+            # and released exactly once below — replaying the merge
+            # neither re-reserves nor leaks
+            out = resilience.retrying(
+                "run_chunked_aggregate", _merge,
+                seam="outofcore.merge", rung="replay_chunk", pol=pol)
         else:
-            out = merge_fn(merged_in)
+            out = _merge()
     finally:
         limiter.release(nb)
     return OutOfCoreResult(out, nchunks, limiter.peak, spill.stats())

@@ -54,6 +54,7 @@ from contextlib import contextmanager
 from typing import Callable, Iterable, Iterator, Union
 
 from spark_rapids_jni_tpu import telemetry
+from spark_rapids_jni_tpu.runtime import faults
 from spark_rapids_jni_tpu.runtime.memory import (
     HostTableChunk,
     MemoryLimiter,
@@ -93,39 +94,37 @@ def configured_decode_threads() -> int:
 
 
 # ---- fault injection (tests) ------------------------------------------------
-
-_FAULT_HOOK = None
-_FAULT_LOCK = threading.Lock()
+#
+# Pipeline stages now fire through the global runtime/faults.py registry as
+# seams "pipeline.<stage>". inject_fault below is kept as a thin DEPRECATED
+# alias for existing callers; new code uses faults.inject with a FaultSpec /
+# FaultScript (or any injector callable) targeting the "pipeline.*" seams.
 
 
 @contextmanager
 def inject_fault(hook):
-    """Install a stage fault hook for the duration of the block.
+    """DEPRECATED alias over :func:`runtime.faults.inject`.
 
-    ``hook(stage, seq)`` is invoked at each stage entry with the stage
+    ``hook(stage, seq)`` is invoked at each stage entry with the bare stage
     name (one of ``STAGES``) and the chunk sequence number; it may sleep
-    (injected delay) or raise (injected failure). Raising proves the
-    error-propagation contract: the exception must surface at that
-    chunk's position with every limiter reservation released. Test-only —
-    hooks run on pipeline worker threads."""
-    global _FAULT_HOOK
-    with _FAULT_LOCK:
-        prev = _FAULT_HOOK
-        _FAULT_HOOK = hook
-    try:
+    (injected delay) or raise (injected failure). Only ``pipeline.*`` seam
+    firings reach the hook — legacy hooks never see the registry's other
+    seams. Prefer ``faults.inject`` with the ``pipeline.<stage>`` seam
+    names."""
+
+    def _adapter(seam, seq, ctx):
+        if seam.startswith("pipeline."):
+            hook(seam[len("pipeline."):], seq)
+
+    with faults.inject(_adapter):
         yield
-    finally:
-        with _FAULT_LOCK:
-            _FAULT_HOOK = prev
 
 
 def _maybe_fault(stage: str, seq: int) -> None:
-    hook = _FAULT_HOOK
-    if hook is None:
-        return
     try:
-        hook(stage, seq)
+        faults.fire("pipeline." + stage, seq)
     except BaseException:
+        # legacy counter: tests and the bench assert on it by name
         telemetry.REGISTRY.counter("pipeline.faults_injected").inc()
         raise
 

@@ -1,0 +1,366 @@
+"""Resilient execution: one error taxonomy, one retry/degradation policy.
+
+Before this layer, fault handling was per-call-site improvisation:
+``groupby_aggregate_auto`` and ``join_auto`` each hand-rolled grow-and-retry,
+the distributed shuffle had a one-shot doubled-capacity retry that gave up on
+the second overflow, and everything else let raw exceptions fly. This module
+centralizes all of it:
+
+- **Taxonomy** — every runtime seam classifies failure into
+  :class:`TransientDeviceError` / :class:`CapacityOverflow` /
+  :class:`ResourceExhausted` / :class:`TransportError` /
+  :class:`FatalExecutionError`. Transient kinds are retried; the rest
+  propagate immediately. Foreign exceptions are *classified for labeling*
+  (:func:`classify`) but never blindly retried: an unknown ``RuntimeError``
+  from deep inside XLA re-raises unchanged, so enabling resilience does not
+  change any legacy propagation behavior.
+- **Retry policy** (:func:`retrying`) — bounded attempts
+  (``resilience.max_attempts``) with optional geometric backoff
+  (``resilience.backoff_ms`` × ``resilience.backoff_multiplier``).
+  Exhaustion raises a classified :class:`FatalExecutionError` chaining the
+  final cause — never a hang, never a silent wrong result.
+- **Degradation ladder** (:func:`escalate`) — grow static capacity
+  geometrically (``resilience.growth``), quantized through the dispatch
+  bucket schedule where the caller asks; downstream rungs (shrink bucket /
+  split chunk, spill via SpillStore, host fallback with mandatory telemetry
+  reason) live at the seams that own those mechanisms
+  (dispatch ``_inline``, out-of-core chunk replay, fusion staged fallback)
+  and report through the same ``resilience.*`` telemetry events.
+
+Every retry/escalation/recovery emits :func:`telemetry.record_resilience`
+with the attempt count and ladder rung. ``resilience.enabled=false`` makes
+:func:`retrying` a plain call and every rewired call site take its verbatim
+pre-resilience code path.
+
+No jax import (import-hygiene contract): usable from telemetry-adjacent and
+host-only code.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Tuple, TypeVar
+
+from spark_rapids_jni_tpu import telemetry
+from spark_rapids_jni_tpu.utils.config import get_option
+
+__all__ = [
+    "ResilienceError",
+    "TransientDeviceError",
+    "CapacityOverflow",
+    "ResourceExhausted",
+    "TransportError",
+    "FatalExecutionError",
+    "Policy",
+    "policy",
+    "enabled",
+    "classify",
+    "is_transient",
+    "retrying",
+    "retry_or_none",
+    "escalate",
+]
+
+T = TypeVar("T")
+
+
+# --------------------------------------------------------------------------
+# taxonomy
+# --------------------------------------------------------------------------
+
+
+class ResilienceError(RuntimeError):
+    """Base of the structured error taxonomy.
+
+    ``context`` carries seam-local diagnostics (rows, capacity, seam, attempt)
+    into the message and up to the caller; ``transient`` is the class-level
+    retry eligibility the policy consults.
+    """
+
+    transient = False
+
+    def __init__(self, message: str, **context: Any) -> None:
+        if context:
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(context.items()))
+            message = f"{message} [{detail}]"
+        super().__init__(message)
+        self.context = context
+
+
+class TransientDeviceError(ResilienceError):
+    """Device-local failure expected to clear on replay (flaky compile/run)."""
+
+    transient = True
+
+
+class CapacityOverflow(TransientDeviceError):
+    """A static capacity (groups / join slots / shuffle slots) was too small.
+
+    Transient in the ladder sense: recoverable by growing the capacity, not
+    by blind replay — :func:`escalate` is the recovery, :func:`retrying`
+    alone would loop at the same capacity.
+    """
+
+
+class ResourceExhausted(ResilienceError):
+    """A memory budget was genuinely exceeded (MemoryLimiter, HBM).
+
+    Not blind-retried: a deterministic budget violation replays identically.
+    Recovery is structural — spill, shrink the chunk, or admit less work —
+    and belongs to the seam that owns the budget.
+    """
+
+    transient = False
+
+
+class TransportError(ResilienceError):
+    """Shuffle / DCN transport loss (connection reset, timeout, short read)."""
+
+    transient = True
+
+
+class FatalExecutionError(ResilienceError):
+    """Classified dead end: retries exhausted or failure is unrecoverable."""
+
+    transient = False
+
+
+# Message markers XLA/jaxlib use for genuinely transient device conditions.
+_TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "DEADLINE_EXCEEDED")
+_TRANSPORT_SEAMS = ("shuffle.transport", "dcn.transport")
+
+
+def classify(exc: BaseException, *, seam: str = "") -> type:
+    """Map an exception to its taxonomy class (for labeling and policy).
+
+    Taxonomy exceptions classify as themselves. Foreign exceptions get a
+    best-effort label: MemoryLimiter overruns -> :class:`ResourceExhausted`;
+    socket-layer errors at transport seams -> :class:`TransportError`;
+    XLA transient status markers -> :class:`TransientDeviceError`; everything
+    else -> :class:`FatalExecutionError`. Classification never converts the
+    exception object — callers that give up re-raise the *original*.
+    """
+    if isinstance(exc, ResilienceError):
+        return type(exc)
+    if isinstance(exc, MemoryError):
+        # includes runtime.memory.MemoryLimitExceeded without importing it
+        # (avoids a memory<->resilience import cycle)
+        return ResourceExhausted
+    if seam in _TRANSPORT_SEAMS and isinstance(exc, (ConnectionError, TimeoutError, OSError)):
+        return TransportError
+    msg = str(exc)
+    if any(marker in msg for marker in _TRANSIENT_MARKERS):
+        return TransientDeviceError
+    return FatalExecutionError
+
+
+def is_transient(exc: BaseException, *, seam: str = "") -> bool:
+    """Retry eligibility under the shared policy.
+
+    Only taxonomy exceptions — and foreign socket errors at transport seams,
+    where retry is a protocol concern — are eligible. A foreign exception
+    that merely *looks* transient is not retried: resilience must not change
+    legacy propagation of errors it does not own.
+    """
+    if isinstance(exc, ResilienceError):
+        return exc.transient
+    if seam in _TRANSPORT_SEAMS and isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# policy
+# --------------------------------------------------------------------------
+
+
+class Policy:
+    """A snapshot of the ``resilience.*`` options (one config read per run)."""
+
+    __slots__ = ("enabled", "max_attempts", "growth", "backoff_ms", "backoff_multiplier")
+
+    def __init__(self) -> None:
+        self.enabled = bool(get_option("resilience.enabled"))
+        self.max_attempts = max(1, int(get_option("resilience.max_attempts")))
+        self.growth = max(2, int(get_option("resilience.growth")))
+        self.backoff_ms = max(0, int(get_option("resilience.backoff_ms")))
+        self.backoff_multiplier = max(1.0, float(get_option("resilience.backoff_multiplier")))
+
+
+def policy() -> Policy:
+    return Policy()
+
+
+def enabled() -> bool:
+    return bool(get_option("resilience.enabled"))
+
+
+def _backoff(pol: Policy, attempt: int) -> None:
+    if pol.backoff_ms <= 0:
+        return
+    time.sleep(pol.backoff_ms * (pol.backoff_multiplier ** (attempt - 1)) / 1000.0)
+
+
+# --------------------------------------------------------------------------
+# retry
+# --------------------------------------------------------------------------
+
+
+def retrying(
+    op: str,
+    fn: Callable[[], T],
+    *,
+    seam: str,
+    rung: str = "same_capacity",
+    pol: Optional[Policy] = None,
+    **context: Any,
+) -> T:
+    """Run ``fn`` under the shared bounded-retry policy.
+
+    Transient failures (per :func:`is_transient`) are retried up to
+    ``resilience.max_attempts`` total attempts with the configured backoff;
+    each retry and the eventual recovery emit ``resilience.*`` telemetry with
+    the attempt count and ladder ``rung``. Non-transient failures re-raise
+    the original immediately. Exhaustion raises
+    :class:`FatalExecutionError` chaining the final transient cause, with the
+    cause's message embedded so existing match-on-message tests survive.
+
+    With ``resilience.enabled=false`` this is exactly ``fn()``.
+    """
+    pol = pol or policy()
+    if not pol.enabled:
+        return fn()
+    attempt = 1
+    while True:
+        try:
+            result = fn()
+        except BaseException as exc:
+            if not is_transient(exc, seam=seam):
+                raise
+            # "kind" is the record's reserved discriminator — the
+            # classified taxonomy name travels as error_kind
+            error_kind = classify(exc, seam=seam).__name__
+            if attempt >= pol.max_attempts:
+                telemetry.record_resilience(
+                    op, "fatal", seam=seam, attempt=attempt, rung=rung,
+                    error_kind=error_kind, **context,
+                )
+                raise FatalExecutionError(
+                    f"{op}: retries exhausted after {attempt} attempts at seam "
+                    f"{seam}: {exc}",
+                    seam=seam, attempts=attempt, **context,
+                ) from exc
+            telemetry.record_resilience(
+                op, "retry", seam=seam, attempt=attempt, rung=rung,
+                error_kind=error_kind, **context,
+            )
+            _backoff(pol, attempt)
+            attempt += 1
+            continue
+        if attempt > 1:
+            telemetry.record_resilience(
+                op, "recovered", seam=seam, attempt=attempt, rung=rung, **context,
+            )
+        return result
+
+
+def retry_or_none(
+    op: str,
+    fn: Callable[[], T],
+    *,
+    seam: str,
+    rung: str = "same_capacity",
+    pol: Optional[Policy] = None,
+    **context: Any,
+) -> Tuple[Optional[T], Optional[BaseException]]:
+    """Like :func:`retrying` but never raises: ``(result, None)`` on success,
+    ``(None, final_exc)`` on give-up.
+
+    For seams with their own downstream ladder rung (dispatch falls back to
+    the host inline path, fusion falls back to the staged evaluator): the
+    caller inspects the exception, takes its rung, and records why.
+    """
+    try:
+        return retrying(op, fn, seam=seam, rung=rung, pol=pol, **context), None
+    except BaseException as exc:  # tpulint: disable=error-must-classify — give-up is returned for the caller's ladder rung
+        return None, exc
+
+
+# --------------------------------------------------------------------------
+# capacity escalation (the grow-static-capacity ladder rung)
+# --------------------------------------------------------------------------
+
+
+def escalate(
+    op: str,
+    attempt_fn: Callable[[int], Tuple[T, bool, Optional[int]]],
+    *,
+    seam: str,
+    initial: int,
+    growth: Optional[int] = None,
+    max_capacity: Optional[int] = None,
+    quantize: Optional[Callable[[int], int]] = None,
+    pol: Optional[Policy] = None,
+    exhaust: Optional[Callable[[int, int], BaseException]] = None,
+    **context: Any,
+) -> T:
+    """Bounded geometric capacity escalation — the shared grow-and-retry.
+
+    ``attempt_fn(capacity)`` returns ``(result, needs_more, required)``:
+    ``needs_more`` says the capacity overflowed; ``required``, when the
+    attempt can name the exact need (join's total-matches count), jumps the
+    schedule there directly. Growth is geometric (``growth`` or the policy
+    default), optionally quantized (dispatch bucket schedule), clamped to
+    ``max_capacity``. Growing to a cap is intrinsically bounded, so the
+    attempt bound applies to *transient* failures at one capacity (delegated
+    to :func:`retrying`), not to growth steps.
+
+    Still-overflowing at ``max_capacity`` raises ``exhaust(capacity, steps)``
+    when given (site-specific exception contracts, e.g. the planner's
+    ValueError) or a classified :class:`FatalExecutionError`. Each growth
+    step emits an ``escalate`` event with rung ``grow_capacity``.
+    """
+    pol = pol or policy()
+    grow = int(growth) if growth is not None else pol.growth
+    cap = max(1, int(initial))
+    if max_capacity is not None:
+        cap = min(cap, max(1, int(max_capacity)))
+    step = 0
+    while True:
+        result, needs_more, required = retrying(
+            op, lambda: attempt_fn(cap), seam=seam, pol=pol,
+            capacity=cap, **context,
+        )
+        if not needs_more:
+            if step > 0:
+                telemetry.record_resilience(
+                    op, "recovered", seam=seam, attempt=step + 1,
+                    rung="grow_capacity", capacity=cap, **context,
+                )
+            return result
+        at_max = max_capacity is not None and cap >= int(max_capacity)
+        if at_max:
+            telemetry.record_resilience(
+                op, "fatal", seam=seam, attempt=step + 1, rung="grow_capacity",
+                capacity=cap, **context,
+            )
+            if exhaust is not None:
+                raise exhaust(cap, step + 1)
+            raise FatalExecutionError(
+                f"{op}: capacity escalation exhausted at {cap}",
+                seam=seam, capacity=cap, steps=step + 1, **context,
+            )
+        new_cap = cap * grow
+        if required is not None:
+            new_cap = max(int(required), new_cap)
+        if quantize is not None:
+            new_cap = int(quantize(new_cap))
+        if max_capacity is not None:
+            new_cap = min(new_cap, max(1, int(max_capacity)))
+        new_cap = max(new_cap, cap + 1)
+        step += 1
+        telemetry.record_resilience(
+            op, "escalate", seam=seam, attempt=step, rung="grow_capacity",
+            capacity=new_cap, previous_capacity=cap, **context,
+        )
+        cap = new_cap

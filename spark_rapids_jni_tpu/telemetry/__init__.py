@@ -26,6 +26,7 @@ from spark_rapids_jni_tpu.telemetry.events import (
     record_compile_cache,
     record_dispatch,
     record_fallback,
+    record_resilience,
     record_spill,
     summary,
 )
@@ -41,6 +42,7 @@ __all__ = [
     "record_compile_cache",
     "record_dispatch",
     "record_fallback",
+    "record_resilience",
     "record_spill",
     "summary",
 ]

@@ -46,6 +46,7 @@ __all__ = [
     "record_fallback",
     "record_compile_cache",
     "record_spill",
+    "record_resilience",
     "record_bench_stale",
     "events",
     "drain",
@@ -186,6 +187,46 @@ def record_spill(
     return True
 
 
+def record_resilience(
+    op: str,
+    event: str,
+    *,
+    seam: str,
+    attempt: int,
+    rung: str,
+    rows: Optional[int] = None,
+    **extra: Any,
+) -> bool:
+    """A resilience-policy decision: retry, recovery, escalation, or fatal.
+
+    ``event`` is one of ``retry`` / ``recovered`` / ``escalate`` / ``fatal``;
+    ``seam`` names the instrumented boundary (runtime/faults.py registry);
+    ``rung`` is the degradation-ladder rung taken (``same_capacity``,
+    ``grow_capacity``, ``replay_chunk``, ``staged_fallback``, ...). Like
+    fallback reasons, seam and rung are mandatory even when telemetry is off —
+    an unaccountable recovery is a bug.
+    """
+    if not seam or not str(seam).strip():
+        raise ValueError(f"record_resilience({op!r}): seam must be non-empty")
+    if not rung or not str(rung).strip():
+        raise ValueError(f"record_resilience({op!r}): rung must be non-empty")
+    if "kind" in extra or "op" in extra:
+        raise ValueError(
+            f"record_resilience({op!r}): 'kind'/'op' are reserved record "
+            "fields; pass the classified error as error_kind")
+    if not enabled():
+        return False
+    rec = _base("resilience", op, rows, None, extra)
+    rec["event"] = str(event)
+    rec["seam"] = str(seam)
+    rec["attempt"] = int(attempt)
+    rec["rung"] = str(rung)
+    REGISTRY.counter(f"resilience.{event}").inc()
+    REGISTRY.counter(f"resilience.rung.{rung}").inc()
+    _emit(rec)
+    return True
+
+
 def record_bench_stale(
     metric: str,
     *,
@@ -231,12 +272,16 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
     fallbacks: Dict[str, int] = {}
     spills: Dict[str, int] = {}
     cache = {"hit": 0, "miss": 0}
+    resilience: Dict[str, int] = {}
     stale_reads = 0
     dispatches = 0
     spill_bytes = 0
     for r in recs:
         kind = r.get("kind")
-        if kind == "fallback":
+        if kind == "resilience":
+            ev = str(r.get("event", "?"))
+            resilience[ev] = resilience.get(ev, 0) + 1
+        elif kind == "fallback":
             op = str(r.get("op", "?"))
             fallbacks[op] = fallbacks.get(op, 0) + 1
         elif kind == "spill":
@@ -257,5 +302,6 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         "spills": dict(sorted(spills.items())),
         "spill_bytes_total": spill_bytes,
         "compile_cache": cache,
+        "resilience": dict(sorted(resilience.items())),
         "stale_reads": stale_reads,
     }

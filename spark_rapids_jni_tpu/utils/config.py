@@ -77,6 +77,27 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # executable so XLA reuses them for outputs instead of
     # double-buffering HBM. Donation never applies to caller-owned scans.
     "fusion.donate": (True, bool),
+    # Resilient execution (runtime/resilience.py): the single retry /
+    # degradation policy every runtime seam routes transient failure
+    # through. Off -> each call site reproduces its pre-resilience
+    # behavior exactly (one-shot shuffle retry, unbounded grow loops,
+    # raw error propagation).
+    "resilience.enabled": (True, bool),
+    # Bounded attempts for transient-classified failures at one seam
+    # (TransientDeviceError / TransportError). Exhaustion raises a
+    # classified FatalExecutionError — never a hang, never a silent
+    # wrong result.
+    "resilience.max_attempts": (4, int),
+    # Geometric factor for capacity escalation (groupby cardinality
+    # bound, join output capacity, shuffle slot count) when the failed
+    # attempt reports no exact requirement.
+    "resilience.growth": (4, int),
+    # Base backoff between transient retries, in milliseconds; each
+    # further retry multiplies by resilience.backoff_multiplier. 0 (the
+    # default) retries immediately — device-local faults clear on
+    # replay, not on wall time.
+    "resilience.backoff_ms": (0, int),
+    "resilience.backoff_multiplier": (2.0, float),
 }
 
 _overrides: dict[str, Any] = {}

@@ -17,6 +17,7 @@ import numpy as np
 import pytest
 
 from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.runtime import faults
 from spark_rapids_jni_tpu.runtime import pipeline as pl
 from spark_rapids_jni_tpu.runtime.memory import (
     MemoryLimiter,
@@ -134,6 +135,8 @@ def test_pipeline_chunks_delivers_in_source_order():
         if stage == "decode" and seq < 2:
             time.sleep(0.05)
 
+    # deliberately exercises the deprecated legacy alias (a thin shim over
+    # runtime/faults.py) so its (stage, seq) adapter keeps working
     with pl.inject_fault(slow_early):
         got = list(pl.pipeline_chunks(sources, depth=4, decode_threads=4))
     assert len(got) == 4
@@ -169,15 +172,15 @@ def test_worker_stage_fault_propagates_and_releases(stage):
     limiter = MemoryLimiter(budget)
     computed = []
 
-    def boom(st, seq):
-        if st == stage and seq == 2:
-            raise RuntimeError(f"injected {st} fault")
+    script = faults.FaultScript([faults.FaultSpec(
+        f"pipeline.{stage}",
+        RuntimeError(f"injected {stage} fault"), seq=2)])
 
     def counting_partial(chunk):
         computed.append(1)
         return _partial_fn(chunk)
 
-    with pl.inject_fault(boom):
+    with faults.inject(script):
         with pytest.raises(RuntimeError, match=f"injected {stage} fault"):
             run_chunked_aggregate(
                 _host_sources(chunks), counting_partial, _merge_fn,
@@ -185,6 +188,7 @@ def test_worker_stage_fault_propagates_and_releases(stage):
     # within one chunk: only the two chunks BEFORE the fault computed
     assert len(computed) <= 2
     assert limiter.used == 0
+    assert script.fired == [(f"pipeline.{stage}", 2)]
     assert pl_faults_at_least(1)
 
 
@@ -200,11 +204,11 @@ def test_consumer_stage_fault_releases_reservations(stage):
     chunks = _lineitem_chunks()
     limiter = MemoryLimiter(max(_table_nbytes(c) for c in chunks) * 8)
 
-    def boom(st, seq):
-        if st == stage:
-            raise RuntimeError(f"injected {st} fault")
+    def boom(seam, seq, ctx):
+        if seam == f"pipeline.{stage}":
+            raise RuntimeError(f"injected {stage} fault")
 
-    with pl.inject_fault(boom):
+    with faults.inject(boom):
         with pytest.raises(RuntimeError, match=f"injected {stage} fault"):
             run_chunked_aggregate(
                 _host_sources(chunks), _partial_fn, _merge_fn,

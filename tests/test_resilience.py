@@ -1,0 +1,707 @@
+"""Chaos suite for the unified fault-injection & resilience layer.
+
+Covers the ISSUE-6 contracts:
+
+* taxonomy — classification and retry eligibility are conservative:
+  foreign exceptions are labeled but never blind-retried, so enabling
+  resilience changes no legacy propagation behavior;
+* one retry policy — bounded attempts, telemetry on every retry /
+  recovery / dead end, ``FatalExecutionError`` chaining the final cause;
+* one capacity ladder — ``escalate`` reproduces the legacy grow-and-retry
+  schedules bit-identically (the groupby/join/planner pins live in their
+  own test files; the schedule itself is pinned here);
+* chaos sweep — one injected fault per seam, then multi-fault schedules,
+  over the out-of-core q1-shaped probe: the run must recover to the
+  bit-identical fault-free answer with ZERO leaked reservations, or die
+  loudly with a classified error. Never a hang, never a silent wrong
+  result;
+* chunk-level checkpoint/resume — a mid-query pipeline fault replays
+  only the chunks after the last checkpoint;
+* ``resilience.enabled=false`` — verbatim pre-resilience behavior.
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import telemetry
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.runtime import faults, resilience
+from spark_rapids_jni_tpu.runtime import pipeline as pl
+from spark_rapids_jni_tpu.runtime.memory import (
+    MemoryLimiter,
+    SpillStore,
+    _col_to_host,
+    _table_nbytes,
+    host_table_chunk,
+)
+from spark_rapids_jni_tpu.runtime.outofcore import run_chunked_aggregate
+from spark_rapids_jni_tpu.runtime.resilience import (
+    CapacityOverflow,
+    FatalExecutionError,
+    ResourceExhausted,
+    TransientDeviceError,
+    TransportError,
+)
+from spark_rapids_jni_tpu.utils import config
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    telemetry.drain()
+    telemetry.REGISTRY.reset()
+    # the suite asserts on resilience.* events, which (like all telemetry)
+    # only emit when the option is on
+    config.set_option("telemetry.enabled", True)
+    yield
+    telemetry.drain()
+    telemetry.REGISTRY.reset()
+    for name in list(config._overrides):
+        config.reset_option(name)
+
+
+# ---------------------------------------------------------------------------
+# the q1-shaped out-of-core probe (same partial->merge algebra as
+# test_pipeline.py, sized for many recovery runs)
+# ---------------------------------------------------------------------------
+
+N_CHUNKS = 5
+ROWS = 300
+
+
+def _lineitem_chunks(n_chunks=N_CHUNKS, rows=ROWS):
+    from spark_rapids_jni_tpu.models.tpch import lineitem_table
+
+    li = lineitem_table(n_chunks * rows, seed=11)
+    chunks = []
+    for i in range(n_chunks):
+        a, b = i * rows, (i + 1) * rows
+        chunks.append(Table([
+            Column(c.dtype, c.data[a:b],
+                   None if c.validity is None else c.validity[a:b])
+            for c in li.columns]))
+    return chunks
+
+
+def _host_sources(chunks):
+    return [
+        (lambda hc=host_table_chunk(
+            [_col_to_host(c) for c in ch.columns], ch.num_rows): hc)
+        for ch in chunks
+    ]
+
+
+def _partial_fn(chunk):
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.ops.table_ops import trim_table
+
+    g = groupby_aggregate(
+        chunk, keys=[4, 5],
+        aggs=[(0, "sum"), (1, "sum"), (0, "count")], max_groups=16)
+    return trim_table(g.table, int(g.num_groups))
+
+
+def _merge_fn(partials):
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.ops.sort import sort_table
+    from spark_rapids_jni_tpu.ops.table_ops import trim_table
+
+    g = groupby_aggregate(
+        partials, keys=[0, 1], aggs=[(i, "sum") for i in range(2, 5)])
+    return sort_table(trim_table(g.table, int(g.num_groups)), [0, 1])
+
+
+def _tables_bit_identical(a, b):
+    if a.num_rows != b.num_rows or a.num_columns != b.num_columns:
+        return False
+    for ca, cb in zip(a.columns, b.columns):
+        if ca.dtype != cb.dtype:
+            return False
+        if not np.array_equal(np.asarray(ca.data), np.asarray(cb.data)):
+            return False
+        if not np.array_equal(np.asarray(ca.valid_mask()),
+                              np.asarray(cb.valid_mask())):
+            return False
+    return True
+
+
+def _budget(chunks):
+    return max(_table_nbytes(c) for c in chunks) * 8
+
+
+def _run_probe(chunks, limiter, **kw):
+    return run_chunked_aggregate(
+        _host_sources(chunks), _partial_fn, _merge_fn,
+        limiter=limiter, prefetch_depth=2, pipeline=True, **kw)
+
+
+@pytest.fixture(scope="module")
+def probe():
+    chunks = _lineitem_chunks()
+    serial = run_chunked_aggregate(
+        iter(chunks), _partial_fn, _merge_fn,
+        limiter=MemoryLimiter(_budget(chunks)), pipeline=False)
+    return chunks, serial.table
+
+
+# ---------------------------------------------------------------------------
+# taxonomy: classification and retry eligibility
+# ---------------------------------------------------------------------------
+
+
+def test_classify_taxonomy_is_identity():
+    for k in (TransientDeviceError, CapacityOverflow, ResourceExhausted,
+              TransportError, FatalExecutionError):
+        assert resilience.classify(k("x")) is k
+
+
+def test_classify_foreign_exceptions():
+    from spark_rapids_jni_tpu.runtime.memory import MemoryLimitExceeded
+
+    assert resilience.classify(
+        MemoryLimitExceeded("over")) is ResourceExhausted
+    assert resilience.classify(MemoryError()) is ResourceExhausted
+    assert resilience.classify(
+        ConnectionError("reset"), seam="dcn.transport") is TransportError
+    assert resilience.classify(
+        TimeoutError(), seam="shuffle.transport") is TransportError
+    # off a transport seam, a socket error is NOT transport loss
+    assert resilience.classify(ConnectionError()) is FatalExecutionError
+    assert resilience.classify(
+        RuntimeError("RESOURCE_EXHAUSTED: hbm")) is TransientDeviceError
+    assert resilience.classify(RuntimeError("?")) is FatalExecutionError
+
+
+def test_is_transient_is_conservative():
+    assert resilience.is_transient(TransientDeviceError("x"))
+    assert resilience.is_transient(CapacityOverflow("x"))
+    assert resilience.is_transient(TransportError("x"))
+    assert not resilience.is_transient(ResourceExhausted("x"))
+    assert not resilience.is_transient(FatalExecutionError("x"))
+    # foreign socket errors retry ONLY at transport seams
+    assert resilience.is_transient(
+        ConnectionError(), seam="dcn.transport")
+    assert not resilience.is_transient(ConnectionError())
+    # a foreign error that LOOKS transient is still not blind-retried
+    assert not resilience.is_transient(RuntimeError("UNAVAILABLE: x"))
+
+
+def test_taxonomy_context_lands_in_message():
+    exc = FatalExecutionError("boom", rows=10, capacity=4)
+    assert "capacity=4" in str(exc) and "rows=10" in str(exc)
+    assert exc.context == {"rows": 10, "capacity": 4}
+
+
+# ---------------------------------------------------------------------------
+# the one retry policy
+# ---------------------------------------------------------------------------
+
+
+def test_retrying_recovers_and_reports():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientDeviceError("flaky device")
+        return "ok"
+
+    assert resilience.retrying("t", flaky, seam="dispatch.execute") == "ok"
+    assert len(calls) == 3
+    s = telemetry.summary()["resilience"]
+    assert s["retry"] == 2 and s["recovered"] == 1
+
+
+def test_retrying_reraises_foreign_exception_unchanged():
+    original = ValueError("not ours")
+
+    def boom():
+        raise original
+
+    with pytest.raises(ValueError) as ei:
+        resilience.retrying("t", boom, seam="dispatch.execute")
+    assert ei.value is original  # the ORIGINAL object, not a wrapper
+    assert telemetry.summary().get("resilience", {}) == {}
+
+
+def test_retrying_exhaustion_is_classified_and_chained():
+    config.set_option("resilience.max_attempts", 3)
+
+    def always():
+        raise TransientDeviceError("never clears")
+
+    with pytest.raises(FatalExecutionError,
+                       match="retries exhausted after 3 attempts") as ei:
+        resilience.retrying("t", always, seam="outofcore.chunk")
+    assert isinstance(ei.value.__cause__, TransientDeviceError)
+    assert ei.value.context["attempts"] == 3
+    assert telemetry.summary()["resilience"]["fatal"] == 1
+
+
+def test_retrying_disabled_is_a_plain_call():
+    config.set_option("resilience.enabled", False)
+    calls = []
+
+    def once():
+        calls.append(1)
+        raise TransientDeviceError("would retry if enabled")
+
+    with pytest.raises(TransientDeviceError):
+        resilience.retrying("t", once, seam="dispatch.execute")
+    assert len(calls) == 1  # no retry, no telemetry, no wrapper
+    assert telemetry.events() == []
+
+
+def test_escalate_matches_legacy_geometric_schedule():
+    caps = []
+
+    def attempt(cap):
+        caps.append(cap)
+        return None, True, None  # overflows at every capacity
+
+    with pytest.raises(FatalExecutionError,
+                       match="capacity escalation exhausted"):
+        resilience.escalate("t", attempt, seam="dispatch.execute",
+                            initial=2, growth=4, max_capacity=100)
+    # min(m * growth**k, n): the exact legacy groupby_aggregate_auto walk
+    assert caps == [2, 8, 32, 100]
+
+
+def test_escalate_required_hint_jumps_schedule():
+    caps = []
+
+    def attempt(cap):
+        caps.append(cap)
+        return ("done", cap), cap < 77, 77
+
+    result = resilience.escalate("t", attempt, seam="dispatch.execute",
+                                 initial=4, growth=2)
+    assert result == ("done", 77)
+    assert caps == [4, 77]  # jumped straight to the named requirement
+
+
+def test_escalate_exhaust_keeps_site_exception_contract():
+    class SiteError(FatalExecutionError, ValueError):
+        pass
+
+    with pytest.raises(SiteError, match="site says no"):
+        resilience.escalate(
+            "t", lambda cap: (None, True, None), seam="dispatch.execute",
+            initial=2, max_capacity=4,
+            exhaust=lambda cap, steps: SiteError("site says no"))
+
+
+# ---------------------------------------------------------------------------
+# the fault registry
+# ---------------------------------------------------------------------------
+
+
+def test_fire_is_noop_without_injector():
+    faults.fire("dispatch.execute", 0)
+    assert faults.active_injector() is None
+
+
+def test_fire_rejects_unknown_seam():
+    with pytest.raises(ValueError, match="unknown fault seam"):
+        with faults.inject(lambda *a: None):
+            faults.fire("not.a.seam", 0)
+    with pytest.raises(ValueError, match="unknown fault seam"):
+        faults.FaultSpec("not.a.seam", RuntimeError)
+
+
+def test_injected_faults_are_counted():
+    script = faults.FaultScript(
+        [faults.FaultSpec("spill.spill", TransientDeviceError("x"))])
+    with faults.inject(script):
+        with pytest.raises(TransientDeviceError):
+            faults.fire("spill.spill", 7)
+        faults.fire("spill.spill", 8)  # times=1 budget spent
+    assert script.fired == [("spill.spill", 7)]
+    got = telemetry.REGISTRY.counters("faults.injected")
+    assert got["faults.injected"] == 1
+    assert got["faults.injected.spill.spill"] == 1
+
+
+def test_inject_nests_and_restores():
+    outer, inner = [], []
+    with faults.inject(lambda s, q, c: outer.append((s, q))):
+        with faults.inject(lambda s, q, c: inner.append((s, q))):
+            faults.fire("memory.reserve", 1)
+        faults.fire("memory.reserve", 2)
+    assert inner == [("memory.reserve", 1)]
+    assert outer == [("memory.reserve", 2)]
+    assert faults.active_injector() is None
+
+
+def _drive(script, n=30):
+    hits = []
+    with faults.inject(script):
+        for seq in range(n):
+            try:
+                faults.fire("outofcore.chunk", seq)
+            except RuntimeError:
+                hits.append(seq)
+    return hits
+
+
+def test_fault_script_seeded_random_is_deterministic():
+    mk = lambda: faults.FaultScript(seed=42, rate=0.3,
+                                    seams=["outofcore.chunk"])
+    first, second = _drive(mk()), _drive(mk())
+    assert first == second and 0 < len(first) < 30
+    assert _drive(faults.FaultScript(seed=42, rate=0.0)) == []
+    assert len(_drive(faults.FaultScript(seed=42, rate=1.0))) == 30
+
+
+def test_fault_script_max_faults_bounds_chaos():
+    script = faults.FaultScript(seed=1, rate=1.0, max_faults=3)
+    assert len(_drive(script)) == 3
+    assert len(script.fired) == 3
+
+
+def test_legacy_pipeline_alias_adapts_stage_hooks():
+    seen = []
+    with pl.inject_fault(lambda stage, seq: seen.append((stage, seq))):
+        faults.fire("pipeline.decode", 3)
+        faults.fire("memory.reserve", 9)  # non-pipeline seams filtered out
+    assert seen == [("decode", 3)]
+
+
+# ---------------------------------------------------------------------------
+# chaos sweep: one transient fault per seam over the out-of-core probe
+# ---------------------------------------------------------------------------
+
+_SWEEP = [
+    ("pipeline.decode", 2),
+    ("pipeline.staging", 2),
+    ("pipeline.transfer", 2),
+    ("pipeline.compute", 2),
+    ("outofcore.chunk", 2),
+    ("outofcore.merge", None),
+    ("memory.reserve", None),
+]
+
+
+@pytest.mark.parametrize("seam,seq", _SWEEP, ids=[s for s, _ in _SWEEP])
+def test_single_fault_recovers_bit_identical(probe, seam, seq):
+    """One transient fault at each seam: the run recovers, the answer is
+    bit-identical to the fault-free serial result, and no reservation
+    leaks."""
+    chunks, want = probe
+    limiter = MemoryLimiter(_budget(chunks))
+    script = faults.FaultScript([faults.FaultSpec(
+        seam, TransientDeviceError(f"injected at {seam}"), seq=seq)])
+    with faults.inject(script):
+        res = _run_probe(chunks, limiter)
+    assert len(script.fired) == 1 and script.fired[0][0] == seam
+    assert res.chunks == N_CHUNKS
+    assert _tables_bit_identical(res.table, want)
+    assert limiter.used == 0
+    s = telemetry.summary()["resilience"]
+    assert s["retry"] >= 1 and s["recovered"] >= 1
+
+
+def test_spill_fault_recovers_bit_identical(probe):
+    """A transient fault while LRU-spilling a partial replays the chunk;
+    the spill path itself stays consistent (the victim is untouched)."""
+    chunks, want = probe
+    limiter = MemoryLimiter(_budget(chunks))
+    spill = SpillStore(_table_nbytes(_partial_fn(chunks[0])) * 2)
+    script = faults.FaultScript([faults.FaultSpec(
+        "spill.spill", TransientDeviceError("injected spill IO"))])
+    with faults.inject(script):
+        res = _run_probe(chunks, limiter, spill=spill)
+    assert len(script.fired) == 1
+    assert _tables_bit_identical(res.table, want)
+    assert limiter.used == 0
+    assert spill.stats()["spills"] >= 1  # the budget genuinely spilled
+
+
+def test_unspill_fault_recovers_bit_identical(probe):
+    """A transient fault while restoring a SPILLED partial in the merge
+    window retries the unspill with zero carried reservation (the entry
+    stays spilled and retryable)."""
+    chunks, want = probe
+    limiter = MemoryLimiter(_budget(chunks))
+    spill = SpillStore(_table_nbytes(_partial_fn(chunks[0])) * 2)
+    script = faults.FaultScript([faults.FaultSpec(
+        "spill.unspill", TransientDeviceError("injected unspill IO"))])
+    with faults.inject(script):
+        res = _run_probe(chunks, limiter, spill=spill)
+    assert len(script.fired) == 1 and script.fired[0][0] == "spill.unspill"
+    assert _tables_bit_identical(res.table, want)
+    assert limiter.used == 0
+
+
+def test_multi_fault_schedule_recovers_bit_identical(probe):
+    """Several faults across layers in ONE query: producer stage, device
+    compute, unspill, merge — each recovered by its own rung, one answer.
+    The small spill budget makes the unspill seam genuinely reachable."""
+    chunks, want = probe
+    limiter = MemoryLimiter(_budget(chunks))
+    spill = SpillStore(_table_nbytes(_partial_fn(chunks[0])) * 2)
+    script = faults.FaultScript([
+        faults.FaultSpec("pipeline.decode",
+                         TransientDeviceError("decode blip"), seq=1),
+        faults.FaultSpec("outofcore.chunk",
+                         TransientDeviceError("compute blip"), seq=3),
+        faults.FaultSpec("spill.unspill",
+                         TransientDeviceError("unspill blip")),
+        faults.FaultSpec("outofcore.merge",
+                         TransientDeviceError("merge blip")),
+    ])
+    with faults.inject(script):
+        res = _run_probe(chunks, limiter, spill=spill)
+    assert len(script.fired) == 4, script.fired
+    assert _tables_bit_identical(res.table, want)
+    assert limiter.used == 0
+    s = telemetry.summary()["resilience"]
+    assert s["retry"] >= 4 and s["recovered"] >= 1
+
+
+def test_seeded_random_chaos_always_converges_or_dies_classified(probe):
+    """Seeded chaos at a real fault rate: every run either recovers to
+    the bit-identical answer or raises a classified FatalExecutionError.
+    Either way: zero leaked reservations, never a hang, never silent
+    corruption."""
+    chunks, want = probe
+    recovered = died = 0
+    for seed in range(6):
+        limiter = MemoryLimiter(_budget(chunks))
+        script = faults.FaultScript(
+            seed=seed, rate=0.08, exc=TransientDeviceError("chaos"),
+            seams=["pipeline.decode", "pipeline.staging",
+                   "pipeline.transfer", "outofcore.chunk",
+                   "spill.unspill", "outofcore.merge"])
+        try:
+            with faults.inject(script):
+                res = _run_probe(chunks, limiter)
+        except FatalExecutionError:
+            died += 1
+        else:
+            recovered += 1
+            assert _tables_bit_identical(res.table, want)
+        assert limiter.used == 0, f"seed {seed} leaked {limiter.used}"
+    assert recovered >= 1  # the rate is survivable for most seeds
+
+
+def test_exhaustion_raises_classified_fatal_with_context(probe):
+    chunks, _ = probe
+    limiter = MemoryLimiter(_budget(chunks))
+    script = faults.FaultScript([faults.FaultSpec(
+        "outofcore.chunk", TransientDeviceError("hard down"),
+        seq=1, times=10_000)])
+    with faults.inject(script):
+        with pytest.raises(FatalExecutionError,
+                           match="retries exhausted") as ei:
+            _run_probe(chunks, limiter)
+    assert ei.value.context["attempts"] >= 2
+    assert ei.value.context["seam"] == "outofcore.chunk"
+    assert isinstance(ei.value.__cause__, TransientDeviceError)
+    assert limiter.used == 0
+    assert telemetry.summary()["resilience"]["fatal"] >= 1
+
+
+def test_checkpoint_resume_replays_only_failed_chunks(probe):
+    """Chunk-level checkpoint/resume: a stream-tearing fault at chunk 3
+    must NOT recompute chunks 0-2 — they are already checkpointed as
+    spill handles."""
+    chunks, want = probe
+    limiter = MemoryLimiter(_budget(chunks))
+    computed = []
+
+    def counting_partial(chunk):
+        computed.append(int(np.asarray(chunk.columns[0].data)[0]))
+        return _partial_fn(chunk)
+
+    script = faults.FaultScript([faults.FaultSpec(
+        "pipeline.staging", TransientDeviceError("mid-query loss"),
+        seq=3)])
+    with faults.inject(script):
+        res = run_chunked_aggregate(
+            _host_sources(chunks), counting_partial, _merge_fn,
+            limiter=limiter, prefetch_depth=2, pipeline=True)
+    assert _tables_bit_identical(res.table, want)
+    assert limiter.used == 0
+    # every chunk's partial computed exactly once — resume restarted at
+    # the failed chunk, not from chunk 0 (which would recompute 3 extra)
+    assert len(computed) == N_CHUNKS
+
+
+def test_foreign_fault_propagates_unchanged(probe):
+    """Legacy propagation preserved: an injected RuntimeError is not in
+    the taxonomy, so resilience must re-raise it untouched."""
+    chunks, _ = probe
+    limiter = MemoryLimiter(_budget(chunks))
+    original = RuntimeError("not classified, not retried")
+    script = faults.FaultScript(
+        [faults.FaultSpec("outofcore.chunk", original, seq=1)])
+    with faults.inject(script):
+        with pytest.raises(RuntimeError) as ei:
+            _run_probe(chunks, limiter)
+    assert ei.value is original
+    assert limiter.used == 0
+
+
+def test_disabled_reproduces_pre_resilience_behavior(probe):
+    """resilience.enabled=false: no retry machinery anywhere — a
+    transient fault propagates raw exactly like the pre-PR executor, and
+    the fault-free answer is unchanged."""
+    chunks, want = probe
+    config.set_option("resilience.enabled", False)
+    limiter = MemoryLimiter(_budget(chunks))
+    res = _run_probe(chunks, limiter)
+    assert _tables_bit_identical(res.table, want)
+    assert limiter.used == 0
+    script = faults.FaultScript([faults.FaultSpec(
+        "outofcore.chunk", TransientDeviceError("raw"), seq=1)])
+    with faults.inject(script):
+        with pytest.raises(TransientDeviceError, match="raw"):
+            _run_probe(chunks, MemoryLimiter(_budget(chunks)))
+    assert [e for e in telemetry.events() if e.get("kind") == "resilience"] \
+        == []
+
+
+# ---------------------------------------------------------------------------
+# transport seams: DCN loopback link
+# ---------------------------------------------------------------------------
+
+
+def _loopback_links():
+    from spark_rapids_jni_tpu.parallel.dcn import SliceLink
+
+    a, b = socket.socketpair()
+    return SliceLink(a), SliceLink(b)
+
+
+def _small_table(n=64, seed=3):
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(rng.integers(0, 9, n).astype(np.int64)),
+        Column.from_numpy(rng.integers(-100, 100, n).astype(np.int64),
+                          validity=rng.random(n) > 0.2),
+    ])
+
+
+@pytest.mark.parametrize("exc", [
+    TransportError("link flap"),
+    ConnectionError("reset by peer"),  # foreign, transport-seam eligible
+], ids=["taxonomy", "foreign-socket"])
+def test_dcn_transport_fault_retries_before_any_bytes_move(exc):
+    """A transport fault before framing starts is retried; the frame then
+    round-trips bit-identical (the retry window closes before sendall, so
+    recovery can never corrupt the stream)."""
+    tx, rx = _loopback_links()
+    try:
+        tbl = _small_table()
+        script = faults.FaultScript(
+            [faults.FaultSpec("dcn.transport", exc)])
+        with faults.inject(script):
+            tx.send_table(tbl, compress_level=0)
+            got = rx.recv_table()
+        assert len(script.fired) == 1
+        assert _tables_bit_identical(got, tbl)
+        assert telemetry.summary()["resilience"]["recovered"] == 1
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_dcn_transport_exhaustion_is_classified():
+    config.set_option("resilience.max_attempts", 2)
+    tx, rx = _loopback_links()
+    try:
+        script = faults.FaultScript([faults.FaultSpec(
+            "dcn.transport", TransportError("link down"), times=10)])
+        with faults.inject(script):
+            with pytest.raises(FatalExecutionError,
+                               match="retries exhausted"):
+                tx.send_table(_small_table(), compress_level=0)
+        assert len(script.fired) == 2
+    finally:
+        tx.close()
+        rx.close()
+
+
+# ---------------------------------------------------------------------------
+# distributed chaos: shuffle seam over the real 8-device mesh, and the
+# full q3 two-exchange plan under a multi-fault schedule
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_distributed_groupby_shuffle_fault_recovers():
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.parallel import (
+        distributed_groupby_aggregate,
+        executor_mesh,
+        shard_table,
+    )
+    from spark_rapids_jni_tpu.parallel.distributed import collect
+
+    rng = np.random.default_rng(5)
+    n = 256
+    tbl = Table([
+        Column.from_numpy(rng.integers(0, 13, n).astype(np.int64)),
+        Column.from_numpy(rng.integers(-50, 50, n).astype(np.int64)),
+    ])
+    mesh = executor_mesh(8)
+    sharded = shard_table(tbl, mesh)
+    script = faults.FaultScript([faults.FaultSpec(
+        "shuffle.transport", TransientDeviceError("exchange blip"))])
+    with faults.inject(script):
+        dist = distributed_groupby_aggregate(
+            sharded, keys=[0], aggs=[(1, "sum"), (1, "count")],
+            mesh=mesh, capacity=n // 8)
+    assert len(script.fired) == 1
+    got = collect(dist.table, dist.num_groups, mesh)
+    local = groupby_aggregate(tbl, keys=[0],
+                              aggs=[(1, "sum"), (1, "count")])
+    k = int(local.num_groups)
+    want = {local.table.column(0).to_pylist()[i]:
+            (local.table.column(1).to_pylist()[i],
+             local.table.column(2).to_pylist()[i]) for i in range(k)}
+    have = {got.column(0).to_pylist()[i]:
+            (got.column(1).to_pylist()[i], got.column(2).to_pylist()[i])
+            for i in range(got.num_rows)}
+    have = {key: v for key, v in have.items()
+            if not (key is None and v == (None, 0))}
+    assert have == want
+    assert telemetry.summary()["resilience"]["recovered"] >= 1
+
+
+@pytest.mark.slow
+def test_q3_distributed_multi_fault_schedule_recovers():
+    from spark_rapids_jni_tpu.models.tpch import (
+        customer_table,
+        lineitem_q3_table,
+        orders_table,
+        tpch_q3_distributed,
+        tpch_q3_numpy,
+    )
+    from spark_rapids_jni_tpu.parallel import executor_mesh
+
+    c = customer_table(48)
+    o = orders_table(256, 48)
+    li = lineitem_q3_table(1024, 256)
+    mesh = executor_mesh(8)
+    script = faults.FaultScript([
+        faults.FaultSpec("shuffle.transport",
+                         TransientDeviceError("transport blip 1")),
+        faults.FaultSpec("shuffle.transport",
+                         TransientDeviceError("transport blip 2")),
+    ])
+    with faults.inject(script):
+        out = tpch_q3_distributed(c, o, li, mesh)
+    assert len(script.fired) == 2
+    want = tpch_q3_numpy(c, o, li)
+    got = {}
+    for i in range(out.num_rows):
+        got[int(np.asarray(out.column(0).data)[i])] = (
+            int(np.asarray(out.column(3).data)[i]),
+            int(np.asarray(out.column(1).data)[i]),
+            int(np.asarray(out.column(2).data)[i]),
+        )
+    assert got == want
+    assert telemetry.summary()["resilience"]["recovered"] >= 1

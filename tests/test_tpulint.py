@@ -221,8 +221,36 @@ def test_rule_fusion_region_needs_fusion_filename(tmp_path):
     assert not _by_rule(_lint_file(target), "fusion-region-host-sync")
 
 
+def test_rule_error_must_classify_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_resilience_swallow.py"),
+                   "error-must-classify")
+    texts = [f.source_line for f in got]
+    assert len(got) == 3, texts
+    assert sum("except Exception" in t for t in texts) == 2
+    assert any(t.startswith("except:") for t in texts)
+    # recorded/re-raising/logged/narrow/unwind/pragma'd twins stay clean
+    src = (FIXTURES / "seeded_resilience_swallow.py").read_text()
+    clean_at = src[:src.index("def recorded_swallow")].count("\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_error_must_classify_scope(tmp_path):
+    # same constructions outside resilience/faults/runtime/parallel scope
+    # are host-side best-effort code — out of scope
+    target = tmp_path / "plain_orchestration.py"
+    shutil.copy(FIXTURES / "seeded_resilience_swallow.py", target)
+    assert not _by_rule(_lint_file(target), "error-must-classify")
+    # under a runtime/ path segment the same source fires regardless of
+    # basename — the rule guards the whole execution path, not a filename
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    target2 = rt / "plain_name.py"
+    shutil.copy(FIXTURES / "seeded_resilience_swallow.py", target2)
+    assert _by_rule(_lint_file(target2), "error-must-classify")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all ten rules demonstrably fire."""
+    """The acceptance invariant: all eleven rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
@@ -241,6 +269,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_pipeline_stage.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_fusion_region.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_resilience_swallow.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

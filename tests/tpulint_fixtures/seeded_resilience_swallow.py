@@ -1,0 +1,84 @@
+"""Seeded violations for rule 11 (error-must-classify).
+
+The basename contains ``resilience`` so the file is in scope the same
+way runtime/ and parallel/ modules are. Violations first, then clean
+twins past the ``def clean_``/``def recorded_`` markers the per-rule
+test splits on.
+"""
+
+
+def silent_swallow(fn):
+    try:
+        return fn()
+    except Exception:  # VIOLATION: swallowed, nothing accounts for it
+        return None
+
+
+def swallow_with_unrelated_work(fn, results):
+    try:
+        results.append(fn())
+    except Exception as exc:  # VIOLATION: bookkeeping is not accounting
+        results.append(("failed", str(exc)))
+    return results
+
+
+def bare_except_swallow(fn):
+    try:
+        return fn()
+    except:  # noqa: E722  VIOLATION: bare except, silently absorbed
+        return None
+
+
+def recorded_swallow(fn, registry):
+    try:
+        return fn()
+    except Exception:  # clean: the counter makes the swallow visible
+        registry.counter("probe.swallowed").inc()
+        return None
+
+
+def recorded_fallback_swallow(fn, record_fallback):
+    try:
+        return fn()
+    except Exception as exc:  # clean: telemetry event accounts for it
+        record_fallback("probe", f"probe failed: {exc}")
+        return None
+
+
+def clean_reraise_through_taxonomy(fn, classify):
+    try:
+        return fn()
+    except Exception as exc:  # clean: re-raised, classified downstream
+        raise classify(exc)(str(exc)) from exc
+
+
+def clean_logged_swallow(fn, log):
+    try:
+        return fn()
+    except Exception:  # clean: logged — visible in operator output
+        log.warning("probe failed; continuing without it")
+        return None
+
+
+def clean_narrow_catch(fn):
+    try:
+        return fn()
+    except ValueError:  # clean: narrow catches are a deliberate contract
+        return None
+
+
+def clean_unwind_path(fn, release):
+    try:
+        return fn()
+    except BaseException:  # clean: unwind path releases and re-raises
+        release()
+        raise
+
+
+def clean_pragmad_swallow(fn):
+    try:
+        return fn()
+    # best-effort probe; a miss costs nothing downstream
+    # tpulint: disable=error-must-classify
+    except Exception:
+        return None

@@ -1,4 +1,4 @@
-"""The ten tpulint rules.
+"""The eleven tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -744,6 +744,82 @@ def check_fusion_region_host_sync(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 11: error-must-classify
+# ---------------------------------------------------------------------------
+
+# A swallow is acceptable when the handler visibly accounts for the error:
+# re-raising (through the resilience taxonomy or otherwise), recording it
+# (telemetry events / counters / logs), or routing it into the shared
+# retry/degradation policy.
+_CLASSIFY_CALL_SUFFIXES = (
+    "record_fallback", "record_resilience", "record_spill",
+    "record_compile_cache", "classify", "retrying", "escalate",
+    "retry_or_none",
+)
+_CLASSIFY_ATTR_CALLS = {"inc", "warning", "error", "exception"}
+
+
+def _is_resilient_scope_file(ctx: FileContext) -> bool:
+    path = str(ctx.path).replace("\\", "/")
+    return ("resilience" in ctx.name or "faults" in ctx.name
+            or "/runtime/" in path or "/parallel/" in path
+            or _is_device_file(ctx.name))
+
+
+def _handler_accounts(stmts) -> bool:
+    for s in stmts:
+        for n in ast.walk(s):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                ftxt = _unparse(n.func)
+                if ftxt.endswith(_CLASSIFY_CALL_SUFFIXES):
+                    return True
+                if (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in _CLASSIFY_ATTR_CALLS):
+                    return True
+    return False
+
+
+def check_error_must_classify(ctx: FileContext) -> List[RawFinding]:
+    """Bug class: a bare ``except Exception`` (or ``except:``) on the
+    device path that swallows the error silently — no re-raise, no
+    telemetry, no route into the resilience policy — converts every
+    failure mode (device OOM, transport loss, genuine bugs) into silent
+    wrong-or-missing results, exactly what the structured taxonomy in
+    ``runtime/resilience.py`` exists to prevent. Every seam must either
+    re-raise (letting ``classify``/``retrying`` own the decision) or
+    visibly account for the swallow (record_* event, counter ``.inc()``,
+    log). Scope: resilience/faults modules, ``runtime/``/``parallel/``
+    packages, and device-op files — NOT bench/tools code, whose
+    best-effort try/except-pass posture is deliberate. ``except
+    BaseException`` unwind paths are exempt (they exist to release
+    resources and re-raise or return deliberately). A reviewed-legitimate
+    swallow carries a ``# tpulint: disable=error-must-classify`` pragma
+    stating why."""
+    if not _is_resilient_scope_file(ctx):
+        return []
+    out: List[RawFinding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        # only the broad catches: bare `except:` and `except Exception`
+        # (BaseException handlers are deliberate unwind paths)
+        if node.type is not None and _unparse(node.type) != "Exception":
+            continue
+        if _handler_accounts(node.body):
+            continue
+        out.append(RawFinding(
+            node.lineno, node.col_offset,
+            "broad `except Exception` on the device path swallows the "
+            "error unclassified: re-raise through the resilience "
+            "taxonomy (runtime/resilience.classify / retrying), or "
+            "account for the swallow with a telemetry record_* event, "
+            "counter .inc(), or log"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -783,4 +859,9 @@ RULES = [
          "no host materialization inside fused-region device functions; "
          "host values resolve from binding metadata at plan-build time",
          check_fusion_region_host_sync),
+    Rule("error-must-classify",
+         "broad `except Exception` on the runtime/parallel/device path "
+         "must re-raise through the resilience taxonomy or visibly "
+         "account for the swallow (record_* event, counter, log)",
+         check_error_must_classify),
 ]
