@@ -476,6 +476,78 @@ def _resilience_block() -> dict:
     return block
 
 
+def _server_block() -> dict:
+    """The BENCH_*.json ``server`` block: closed-loop throughput of the
+    multi-query serving runtime (runtime/server.py). At each concurrency
+    level (1, 4, 16 sessions) every session submits the same warm-cache
+    q1 plan back-to-back — submit, wait, resubmit — so offered load
+    tracks service rate and the queue depth is bounded by the session
+    count. Reports queries/s, p50/p95/p99 end-to-end latency (submit to
+    result, queue wait included), and the fraction of that latency spent
+    queued ahead of admission. The scaling contract: queries/s at
+    concurrency 4 must beat concurrency 1 (shared executables, no
+    serialization through the cache); the queue-wait fraction shows
+    where added concurrency turns into waiting instead of throughput.
+    Probe-sized (4k rows, one bucket, warm cache): it measures the
+    serving layer, not the kernels."""
+    block: dict = {}
+    try:
+        import threading as _threading
+
+        from spark_rapids_jni_tpu.models import tpch
+        from spark_rapids_jni_tpu.runtime import server as _server
+
+        rows = 1 << 12
+        plan = tpch._q1_plan()
+        bindings = {"lineitem": tpch.lineitem_table(rows, seed=3)}
+        per_client = 4
+        levels = (1, 4, 16)
+        with _server.QueryServer(budget_bytes=1 << 30,
+                                 max_inflight=16) as srv:
+            # pay the one-time compile outside every timed loop
+            srv.session("warm").submit(plan, bindings).result(timeout=300)
+            for conc in levels:
+                done: list = []
+
+                def _client(i):
+                    sess = srv.session(f"bench_c{i}")
+                    for _ in range(per_client):
+                        t = sess.submit(plan, bindings)
+                        t.result(timeout=300)
+                        done.append(t)
+
+                threads = [_threading.Thread(target=_client, args=(i,))
+                           for i in range(conc)]
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                wall = time.perf_counter() - t0
+                lats = sorted(t.latency_s for t in done)
+                waits = [t.queue_wait_s for t in done]
+
+                def _pct(p):
+                    return round(
+                        lats[min(len(lats) - 1,
+                                 int(p / 100.0 * len(lats)))] * 1e3, 3)
+
+                block[f"concurrency_{conc}"] = {
+                    "queries": len(done),
+                    "queries_per_s": round(len(done) / wall, 2)
+                    if wall else None,
+                    "latency_ms_p50": _pct(50),
+                    "latency_ms_p95": _pct(95),
+                    "latency_ms_p99": _pct(99),
+                    "queue_wait_frac": round(
+                        sum(waits) / sum(lats), 4) if sum(lats) else None,
+                }
+            block["leaked_bytes"] = srv.limiter.used
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    return block
+
+
 def _ledger_last(metric: str, n: int):
     """Most recent ledger record for ``metric`` under the current
     measurement tag — preferring an exact row-count match (throughput is
@@ -1346,7 +1418,8 @@ def _child_main(config: str, n: int, iters: int) -> None:
     print(json.dumps({"value": value, "dispatch": _dispatch_block(),
                       "pipeline": _pipeline_block(),
                       "fusion": _fusion_block(),
-                      "resilience": _resilience_block()}))
+                      "resilience": _resilience_block(),
+                      "server": _server_block()}))
 
 
 # ---------------------------------------------------------------------------
@@ -1386,9 +1459,10 @@ def _probe_tpu(timeout_s: float) -> tuple[bool, str]:
 
 def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float):
     """Run the bench in a subprocess; returns (value | None, diagnostic,
-    dispatch block | None, pipeline block | None, fusion block | None) —
-    the blocks come from the measured child process's executable cache,
-    overlap probe, and whole-stage fusion probe."""
+    dispatch block | None, pipeline block | None, fusion block | None,
+    server block | None) — the blocks come from the measured child
+    process's executable cache, overlap probe, whole-stage fusion probe,
+    and serving-concurrency probe."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CONFIG"] = config
@@ -1406,7 +1480,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         )
     except subprocess.TimeoutExpired:
         return (None, f"{platform} bench timed out after {timeout_s:.0f}s",
-                None, None, None)
+                None, None, None, None)
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
@@ -1416,10 +1490,13 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         disp = rec.get("dispatch") if isinstance(rec, dict) else None
         pipe = rec.get("pipeline") if isinstance(rec, dict) else None
         fus = rec.get("fusion") if isinstance(rec, dict) else None
+        srv = rec.get("server") if isinstance(rec, dict) else None
         return (value, "", disp if isinstance(disp, dict) else None,
                 pipe if isinstance(pipe, dict) else None,
-                fus if isinstance(fus, dict) else None)
-    return None, f"{platform} bench failed: {_tail(out)}", None, None, None
+                fus if isinstance(fus, dict) else None,
+                srv if isinstance(srv, dict) else None)
+    return (None, f"{platform} bench failed: {_tail(out)}",
+            None, None, None, None)
 
 
 def main() -> None:
@@ -1439,6 +1516,7 @@ def main() -> None:
     child_disp = None
     child_pipe = None
     child_fus = None
+    child_srv = None
     # every run gets a telemetry file (children record through the package
     # via these env vars; the parent appends bench_stale events itself) —
     # restored afterwards so driving code / tests see their own env back
@@ -1476,7 +1554,8 @@ def main() -> None:
                 time.sleep(10)
                 ok, why = _probe_tpu(20)
             if ok:
-                value, why, child_disp, child_pipe, child_fus = _run_child(
+                (value, why, child_disp, child_pipe, child_fus,
+                 child_srv) = _run_child(
                     config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
@@ -1517,7 +1596,8 @@ def main() -> None:
                     "ledger_n": led.get("n"), "requested_n": n,
                 })
         if value is None:
-            value, why, child_disp, child_pipe, child_fus = _run_child(
+            (value, why, child_disp, child_pipe, child_fus,
+             child_srv) = _run_child(
                 config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
@@ -1565,6 +1645,10 @@ def main() -> None:
     # per query, donated bytes), same child-process provenance; empty when
     # no live child ran (timeout / stale ledger record)
     record["fusion"] = child_fus or {}
+    # serving-runtime concurrency probe (closed-loop queries/s + latency
+    # percentiles at 1/4/16 sessions), same child-process provenance;
+    # empty when no live child ran (timeout / stale ledger record)
+    record["server"] = child_srv or {}
     if diagnostics:
         record["diagnostic"] = "; ".join(d for d in diagnostics if d)
     print(json.dumps(record))
@@ -1615,7 +1699,7 @@ def sweep() -> None:
             if config in single_size else sizes
         cfg_timeout = 240.0 if config == "tpch_q1_pallas" else timeout
         for n in cfg_sizes:
-            value, why, _disp, _pipe, _fus = _run_child(
+            value, why, _disp, _pipe, _fus, _srv = _run_child(
                 config, n, iters, "tpu", cfg_timeout)
             line = {"config": config, "metric": metric, "n": n,
                     "value": value, "unit": unit, "device_kind": kind}

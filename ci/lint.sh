@@ -1,11 +1,11 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its eleven invariant rules (host/device
+# tpulint (tools/tpulint) runs its twelve invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
-# error-must-classify)
+# error-must-classify, server-telemetry-session-id)
 # over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
 # glob below covers the telemetry/ package alongside every other
 # subpackage.
@@ -143,4 +143,57 @@ assert limiter.used == 0, f"leaked {limiter.used} reserved bytes"
 injected = REGISTRY.counter("faults.injected.memory.reserve").value
 assert injected == 1, f"expected 1 injected fault, got {injected}"
 print("resilience smoke OK: 1 injected fault, recovered, 0 leaked bytes")
+EOF
+
+# server smoke: rule 12 only proves serving-path telemetry CARRIES a
+# session id — this proves the serving runtime itself still honors its
+# contract: a query is admitted (reservation taken), served bit-identical
+# to the serial reference, a fault injected into a second session fails
+# that query classified WITHOUT touching the first session's result, and
+# after both — clean run and fault — zero reserved bytes remain.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.runtime import faults, fusion, server
+
+plan = tpch._q1_plan()
+bindings = {"lineitem": tpch.lineitem_table(300)}
+ref = fusion.execute(plan, bindings)
+
+
+def victim_only(seam, seq, ctx):
+    if seam == "server.execute" and ctx.get("session") == "victim":
+        raise RuntimeError("injected query death")
+
+
+with server.QueryServer(budget_bytes=1 << 28, max_inflight=2) as srv:
+    ok = srv.session("steady").submit(plan, bindings)
+    res = ok.result(timeout=120)
+    assert ok.status == "served", ok.status
+    with faults.inject(victim_only):
+        doomed = srv.session("victim").submit(plan, bindings)
+        try:
+            doomed.result(timeout=120)
+            raise SystemExit("injected fault did not surface")
+        except RuntimeError:
+            pass
+    assert doomed.status == "failed", doomed.status
+    recovered = srv.session("victim").submit(plan, bindings)
+    recovered.result(timeout=120)
+    assert recovered.status == "served", recovered.status
+    for got in (res, recovered.result(timeout=1)):
+        for i in range(got.table.num_columns):
+            gc, rc = got.table.column(i), ref.table.column(i)
+            gv, rv = np.asarray(gc.valid_mask()), np.asarray(rc.valid_mask())
+            assert (gv == rv).all(), f"col {i} validity diverged"
+            assert (np.where(gv, np.asarray(gc.data), 0)
+                    == np.where(rv, np.asarray(rc.data), 0)).all(), \
+                f"col {i} data diverged"
+    leaked = srv.limiter.used
+    assert leaked == 0, f"leaked {leaked} reserved bytes"
+    stats = srv.stats()
+    assert stats["served"] == 2 and stats["failed"] == 1, stats
+print("server smoke OK: admit -> serve -> fault -> recover, "
+      "bit-identical, 0 leaked bytes")
 EOF

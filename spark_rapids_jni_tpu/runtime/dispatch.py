@@ -66,6 +66,10 @@ _ENV_CACHE_DIR = "SPARK_RAPIDS_TPU_DISPATCH_CACHE"
 
 _lock = threading.RLock()
 _EXEC_CACHE: dict = {}
+# key -> threading.Event: a first-compile currently in flight. Concurrent
+# callers of the same key park on the event and reuse the leader's
+# executable instead of compiling it N times (single-flight).
+_INFLIGHT: dict = {}
 _persistent_initialized = False
 
 
@@ -317,6 +321,41 @@ def _init_persistent_cache() -> None:
         REGISTRY.counter("dispatch.persistent_cache_error").inc()
 
 
+def _cache_lookup(key) -> tuple:
+    """Single-flight cache lookup: ``(compiled, leader_event)``.
+
+    ``compiled`` non-None means a cached executable (a hit — possibly
+    after waiting out another thread's in-flight compile of the same
+    key). ``compiled`` None means THIS caller is the compile leader for
+    ``key`` and holds ``leader_event``; it MUST finish with
+    ``_cache_store(key, compiled_or_None, leader_event)`` on every exit
+    path, or waiters park forever. A leader that fails (stores None)
+    wakes the waiters, and the first to re-loop becomes the new leader —
+    a failed compile never wedges the key.
+    """
+    while True:
+        with _lock:
+            compiled = _EXEC_CACHE.get(key)
+            if compiled is not None:
+                return compiled, None
+            ev = _INFLIGHT.get(key)
+            if ev is None:
+                ev = threading.Event()
+                _INFLIGHT[key] = ev
+                return None, ev
+        ev.wait()
+
+
+def _cache_store(key, compiled, ev: threading.Event) -> None:
+    """Publish the leader's result (or its failure) and release waiters."""
+    with _lock:
+        if compiled is not None:
+            _EXEC_CACHE[key] = compiled
+        if _INFLIGHT.get(key) is ev:
+            del _INFLIGHT[key]
+    ev.set()
+
+
 def _inline(op: str, reason: str, fn: Callable, row_args: tuple,
             aux_args: tuple) -> Any:
     REGISTRY.counter("dispatch.inline").inc()
@@ -395,8 +434,7 @@ def call(
     key = (op, statics, donate_rows,
            _signature((padded, aux_args, row_valids)),
            jax.default_backend())
-    with _lock:
-        compiled = _EXEC_CACHE.get(key)
+    compiled, lead_ev = _cache_lookup(key)
     if compiled is None:
         _init_persistent_cache()
 
@@ -416,15 +454,19 @@ def call(
         # compile errors (non-transient) give up on attempt 1 and take the
         # host_fallback ladder rung below — dispatch still never raises
         # on its own behalf
-        compiled, exc = resilience.retry_or_none(
-            op, _compile, seam="dispatch.compile", rung="host_fallback")
+        exc = None
+        try:
+            compiled, exc = resilience.retry_or_none(
+                op, _compile, seam="dispatch.compile", rung="host_fallback")
+        finally:
+            # publish (or publish the failure) on EVERY leader exit path:
+            # a waiter parked on this key must never hang
+            _cache_store(key, compiled, lead_ev)
         if compiled is None:
             if exc is not None and not isinstance(exc, Exception):
                 raise exc  # KeyboardInterrupt etc: not dispatch's to absorb
             REGISTRY.counter("dispatch.compile_error").inc()
             return _inline(op, "compile_error", fn, row_args, aux_args)
-        with _lock:
-            _EXEC_CACHE[key] = compiled
         REGISTRY.counter("dispatch.compile").inc()
         REGISTRY.counter(f"dispatch.compile.{op}").inc()
         record_compile_cache(f"dispatch:{op}", hit=False)
@@ -495,8 +537,7 @@ def sharded_call(
         return build()(*args)
     key = (op, ("sharded", cfg) + tuple(statics), _signature(args),
            jax.default_backend())
-    with _lock:
-        compiled = _EXEC_CACHE.get(key)
+    compiled, lead_ev = _cache_lookup(key)
     if compiled is None:
         _init_persistent_cache()
 
@@ -504,8 +545,12 @@ def sharded_call(
             faults.fire("dispatch.compile", 0, op=op)
             return jax.jit(build()).lower(*args).compile()
 
-        compiled, exc = resilience.retry_or_none(
-            op, _compile, seam="dispatch.compile", rung="host_fallback")
+        exc = None
+        try:
+            compiled, exc = resilience.retry_or_none(
+                op, _compile, seam="dispatch.compile", rung="host_fallback")
+        finally:
+            _cache_store(key, compiled, lead_ev)
         if compiled is None:
             if exc is not None and not isinstance(exc, Exception):
                 raise exc
@@ -513,8 +558,6 @@ def sharded_call(
             REGISTRY.counter("dispatch.inline").inc()
             REGISTRY.counter("dispatch.inline.compile_error").inc()
             return build()(*args)
-        with _lock:
-            _EXEC_CACHE[key] = compiled
         REGISTRY.counter("dispatch.compile").inc()
         REGISTRY.counter(f"dispatch.compile.{op}").inc()
         record_compile_cache(f"dispatch:{op}", hit=False)

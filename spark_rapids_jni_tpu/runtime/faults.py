@@ -58,6 +58,9 @@ SEAMS: Tuple[str, ...] = (
     "dcn.transport",
     # whole-stage fusion region dispatch (runtime/fusion.py)
     "fusion.region",
+    # multi-query serving runtime (runtime/server.py)
+    "server.admit",
+    "server.execute",
 )
 
 _SEAM_SET = frozenset(SEAMS)

@@ -85,6 +85,7 @@ __all__ = [
     "rows_of",
     "min_rows_of",
     "execute",
+    "estimate_hbm_bytes",
     "stats",
 ]
 
@@ -688,6 +689,41 @@ def execute(plan: Plan, bindings: dict, *,
     meta = dict(zip(side_keys, side_vals))
     meta.update(static_meta)
     return FusedResult(value, meta)
+
+
+def estimate_hbm_bytes(plan: Plan, bindings: dict) -> int:
+    """Plan-aware HBM footprint estimate for serving admission control.
+
+    The inputs' exact device bytes plus the materialized output of every
+    capacity-bearing node — joins at their resolved ``out_rows``, groupbys
+    at their group budget — each costed at the inputs' mean row width. An
+    estimate the admission gate reserves through the ``MemoryLimiter``,
+    not a hard bound: ``runtime/server.py`` applies the configured
+    ``server.estimate_headroom`` multiplier on top for intermediates this
+    static walk cannot see.
+    """
+    from spark_rapids_jni_tpu.runtime.memory import _table_nbytes
+
+    nodes = _topo(plan.root)
+    bucketed, exact = _scan_names(nodes)
+    for name in bucketed + exact:
+        if name not in bindings:
+            raise KeyError(f"plan {plan.name!r} scans unbound table "
+                           f"{name!r}")
+    true_rows = {name: bindings[name].num_rows for name in bucketed + exact}
+    resolved = _resolve_statics(nodes, true_rows)
+    input_bytes = sum(
+        _table_nbytes(bindings[name]) for name in bucketed + exact)
+    total_rows = max(1, sum(true_rows.values()))
+    row_width = max(1, input_bytes // total_rows)
+    out_rows = 0
+    for node in nodes:
+        if isinstance(node, (Join, DensePkJoin)):
+            out_rows += int(resolved[id(node)] or 0)
+        elif isinstance(node, GroupBy):
+            cap = resolved.get(id(node))
+            out_rows += int(cap if cap is not None else node.budget)
+    return int(input_bytes + out_rows * row_width)
 
 
 def _planned_lowering(node: GroupBy) -> str:

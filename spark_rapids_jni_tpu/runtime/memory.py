@@ -21,6 +21,7 @@ the part of RMM's surface a Spark executor actually interacts with:
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from dataclasses import dataclass
@@ -84,6 +85,10 @@ class MemoryLimiter:
         # a Condition so reserve_blocking can sleep until release() frees
         # budget; plain reserve/release take the same underlying lock
         self._lock = threading.Condition()
+        # FIFO queue of blocked reserve_blocking tickets: budget freed by a
+        # release is offered to the longest-waiting reserver first, so a
+        # small late request cannot barge past a large early one forever
+        self._waiters: "collections.deque[object]" = collections.deque()
 
     @property
     def used(self) -> int:
@@ -121,6 +126,12 @@ class MemoryLimiter:
         success, False if ``cancel`` (a threading.Event) was set or
         ``timeout`` seconds elapsed first — cancellation is polled, so
         a cancelled producer wakes within ~50ms.
+
+        Ordering contract: concurrent blocked reservers are served FIFO —
+        freed budget goes to the longest-waiting request first, and a
+        later (even smaller) request never barges past an earlier blocked
+        one. A plain ``reserve`` keeps its fail-fast semantics and does
+        not queue.
         """
         faults.fire("memory.reserve", nbytes, blocking=True)
         if nbytes > self.budget:
@@ -129,21 +140,34 @@ class MemoryLimiter:
                 f"({self.budget}): can never fit"
             )
         deadline = None if timeout is None else time.monotonic() + timeout
+        ticket = object()
         with self._lock:
-            while self._used + nbytes > self.budget:
-                if cancel is not None and cancel.is_set():
-                    return False
-                wait = 0.05
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
+            self._waiters.append(ticket)
+            try:
+                # grant only at head-of-line AND when the bytes fit: a
+                # blocked earlier ticket holds back every later one, which
+                # is exactly the no-barge property
+                while (self._waiters[0] is not ticket
+                       or self._used + nbytes > self.budget):
+                    if cancel is not None and cancel.is_set():
                         return False
-                    wait = min(wait, remaining)
-                self._lock.wait(wait)
-            self._used += nbytes
-            self._peak = max(self._peak, self._used)
-            if get_option("memory.log_level") >= 2:
-                _log.info("reserve %d bytes (%d in use)", nbytes, self._used)
+                    wait = 0.05
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return False
+                        wait = min(wait, remaining)
+                    self._lock.wait(wait)
+                self._used += nbytes
+                self._peak = max(self._peak, self._used)
+                if get_option("memory.log_level") >= 2:
+                    _log.info(
+                        "reserve %d bytes (%d in use)", nbytes, self._used)
+            finally:
+                # leaving for ANY reason (granted, cancelled, timed out)
+                # unblocks the next ticket in line
+                self._waiters.remove(ticket)
+                self._lock.notify_all()
         return True
 
     def release(self, nbytes: int) -> None:

@@ -93,6 +93,40 @@ def configured_decode_threads() -> int:
     return max(int(get_option("pipeline.decode_threads")), 1)
 
 
+# ---- shared decode pool -----------------------------------------------------
+#
+# Concurrent pipelines (and the multi-query serving runtime) would each spin
+# a private ThreadPoolExecutor, oversubscribing the host decode threads N
+# ways. The shared pool is one process-wide executor every concurrent user
+# can borrow; pipeline_chunks accepts it via ``pool=`` and never shuts a
+# borrowed pool down.
+
+_shared_pool: ThreadPoolExecutor | None = None
+_shared_pool_lock = threading.Lock()
+
+
+def shared_decode_pool() -> ThreadPoolExecutor:
+    """The process-wide host decode/staging pool, created lazily at
+    ``pipeline.decode_threads`` workers. Callers submit work but never
+    shut it down; ``reset_shared_decode_pool`` exists for test isolation."""
+    global _shared_pool
+    with _shared_pool_lock:
+        if _shared_pool is None:
+            _shared_pool = ThreadPoolExecutor(
+                max_workers=configured_decode_threads(),
+                thread_name_prefix="tpu-pipeline-decode-shared")
+        return _shared_pool
+
+
+def reset_shared_decode_pool() -> None:
+    """Shut down and drop the shared pool (test isolation / re-config)."""
+    global _shared_pool
+    with _shared_pool_lock:
+        pool, _shared_pool = _shared_pool, None
+    if pool is not None:
+        pool.shutdown(wait=True)
+
+
 # ---- fault injection (tests) ------------------------------------------------
 #
 # Pipeline stages now fire through the global runtime/faults.py registry as
@@ -143,6 +177,7 @@ def pipeline_chunks(
     limiter: MemoryLimiter | None = None,
     depth: int | None = None,
     decode_threads: int | None = None,
+    pool: ThreadPoolExecutor | None = None,
 ) -> Iterator:
     """Run chunk sources through the async pipeline; yield device Tables
     in source order.
@@ -166,6 +201,12 @@ def pipeline_chunks(
 
     On error or early close all undelivered reservations are released:
     no hangs, no orphaned reservations.
+
+    ``pool`` lends an external decode executor (e.g.
+    ``shared_decode_pool()``, so N concurrent pipelines share one set of
+    decode threads instead of oversubscribing the host N ways); a lent
+    pool is never shut down here — cleanup waits on this run's own
+    futures only.
     """
     depth = configured_prefetch_depth() if depth is None \
         else max(int(depth), 1)
@@ -254,8 +295,10 @@ def pipeline_chunks(
             reg.gauge("pipeline.chunks_in_flight").add(-1)
             raise
 
-    pool = ThreadPoolExecutor(
-        max_workers=workers, thread_name_prefix="tpu-pipeline-decode")
+    owns_pool = pool is None
+    if pool is None:
+        pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="tpu-pipeline-decode")
     submitted: list = []
     pump_exc: list = []
 
@@ -308,7 +351,8 @@ def pipeline_chunks(
     finally:
         cancel.set()
         pump.join()
-        pool.shutdown(wait=True)
+        if owns_pool:
+            pool.shutdown(wait=True)
         # drain: every submitted-but-undelivered chunk that completed
         # holds a reservation nobody will ever release — release them
         # here (the no-phantom-usage contract). Failed/cancelled workers

@@ -1,0 +1,439 @@
+"""Multi-query serving runtime: admission, fair scheduling, session budgets.
+
+Every layer below this one executes exactly one query at a time; the
+ROADMAP north star is heavy concurrent traffic on one shared device.
+Sparkle (PAPERS.md) shows Spark-shaped work on a single shared machine is
+won or lost at the admission/queueing layer; Flare shows that once kernels
+are fused the marginal cost of a query is dominated by plan reuse — which
+is exactly what the bucketed executable cache already gives concurrent
+queries at ragged row counts. This module is the layer that cashes that
+in: N sessions submit fusion plans (``runtime/fusion.py`` IR) and share
+the dispatch executable cache, one ``MemoryLimiter``, and the pipeline's
+shared decode pool.
+
+Contracts, in order of importance:
+
+* **No overcommit** — every query's HBM estimate is reserved through the
+  shared ``MemoryLimiter`` BEFORE execution starts. A query whose
+  estimate exceeds the whole budget, or whose session queue is full, is
+  rejected at submit; one that merely does not fit *right now* waits its
+  turn (the limiter's FIFO blocking reserve), bounded by
+  ``server.admission_timeout_s``.
+* **Fairness** — queued work is drained round-robin across sessions with
+  at most ``server.max_inflight`` queries executing concurrently, so one
+  heavy session cannot starve the rest: each scheduling turn takes the
+  next session's oldest query, not the globally oldest.
+* **Attribution** — end-to-end latency and queue wait land in per-session
+  histograms (``server.latency_ms.<sid>`` / ``server.queue_wait_ms.<sid>``),
+  admitted/queued/rejected/served/failed counters count per session and
+  globally, and the whole execution runs inside
+  ``telemetry.session_scope(sid)`` so fallback/spill/resilience events
+  emitted by ANY inner layer carry ``session`` attribution.
+* **No leaks** — a query that dies, however it dies, releases its
+  reservation and its in-flight slot; the failure is classified through
+  ``resilience.classify`` and recorded before the ticket resolves.
+
+Config knobs (utils/config.py, env ``SPARK_RAPIDS_TPU_SERVER_*``):
+``server.max_inflight``, ``server.hbm_budget_bytes``,
+``server.admission_timeout_s``, ``server.queue_depth``,
+``server.estimate_headroom``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Optional
+
+from spark_rapids_jni_tpu.runtime import faults, fusion, pipeline, resilience
+from spark_rapids_jni_tpu.runtime.memory import (
+    HostTableChunk,
+    MemoryLimiter,
+    _table_nbytes,
+)
+from spark_rapids_jni_tpu.telemetry.events import (
+    events as _ring_events,
+    record_server,
+    session_scope,
+)
+from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import get_option
+from spark_rapids_jni_tpu.utils.log import get_logger
+
+__all__ = ["QueryRejected", "QueryTicket", "Session", "QueryServer"]
+
+_log = get_logger("spark_rapids_jni_tpu.server")
+
+
+class QueryRejected(RuntimeError):
+    """Admission control refused the query: estimate over the whole
+    budget, session queue full, admission timeout, or server shutdown."""
+
+
+class QueryTicket:
+    """One submitted query's future. Resolves to the plan's
+    ``FusedResult`` (``result()``), a raised ``QueryRejected``, or the
+    classified execution error. ``status`` walks
+    queued -> admitted -> served | rejected | failed."""
+
+    def __init__(self, session_id: str, plan: fusion.Plan, bindings: dict,
+                 estimate: int, donate_inputs: bool):
+        self.session = session_id
+        self.plan = plan
+        self.bindings = bindings
+        self.estimate = int(estimate)
+        self.donate_inputs = bool(donate_inputs)
+        self.status = "queued"
+        self.queue_wait_s: Optional[float] = None
+        self.latency_s: Optional[float] = None
+        self._submitted_at = time.monotonic()
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"query {self.plan.name!r} (session {self.session}) not "
+                f"done within {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def _resolve(self, status: str, value: Any = None,
+                 exc: Optional[BaseException] = None) -> None:
+        self.status = status
+        self._value = value
+        self._exc = exc
+        self._done.set()
+
+
+class Session:
+    """A client handle: submits against one session id on the server."""
+
+    def __init__(self, server: "QueryServer", session_id: str):
+        self._server = server
+        self.session_id = session_id
+
+    def submit(self, plan: fusion.Plan, bindings: dict, *,
+               estimate_bytes: Optional[int] = None,
+               donate_inputs: bool = False) -> QueryTicket:
+        return self._server.submit(
+            self.session_id, plan, bindings,
+            estimate_bytes=estimate_bytes, donate_inputs=donate_inputs)
+
+    def stats(self) -> dict:
+        return self._server.session_stats(self.session_id)
+
+
+class QueryServer:
+    """The serving runtime. Construct, ``session(sid).submit(...)``,
+    ``ticket.result()``; ``close()`` (or the context manager) drains the
+    workers and rejects whatever is still queued."""
+
+    def __init__(self, *,
+                 limiter: Optional[MemoryLimiter] = None,
+                 budget_bytes: Optional[int] = None,
+                 max_inflight: Optional[int] = None,
+                 admission_timeout_s: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 estimate_headroom: Optional[float] = None):
+        if limiter is not None and budget_bytes is not None:
+            raise ValueError("pass limiter OR budget_bytes, not both")
+        self.limiter = limiter if limiter is not None else MemoryLimiter(
+            int(budget_bytes if budget_bytes is not None
+                else get_option("server.hbm_budget_bytes")))
+        self.max_inflight = max(1, int(
+            max_inflight if max_inflight is not None
+            else get_option("server.max_inflight")))
+        self.admission_timeout_s = float(
+            admission_timeout_s if admission_timeout_s is not None
+            else get_option("server.admission_timeout_s"))
+        self.queue_depth = max(1, int(
+            queue_depth if queue_depth is not None
+            else get_option("server.queue_depth")))
+        self.estimate_headroom = float(
+            estimate_headroom if estimate_headroom is not None
+            else get_option("server.estimate_headroom"))
+        # every concurrent query shares ONE host decode/staging pool
+        # (runtime/pipeline.py) instead of spinning a private executor
+        self.decode_pool = pipeline.shared_decode_pool()
+        self._cond = threading.Condition()
+        self._queues: dict[str, collections.deque] = {}
+        # round-robin ring over session ids, registration order
+        self._ring: collections.deque = collections.deque()
+        self._stop = threading.Event()
+        self._closed = False
+        self._workers = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"tpu-server-worker-{i}")
+            for i in range(self.max_inflight)
+        ]
+        for w in self._workers:
+            w.start()
+
+    # -- client surface ------------------------------------------------------
+
+    def session(self, session_id: str) -> Session:
+        if not session_id or not str(session_id).strip():
+            raise ValueError("session_id must be non-empty")
+        sid = str(session_id)
+        with self._cond:
+            if sid not in self._queues:
+                self._queues[sid] = collections.deque()
+                self._ring.append(sid)
+        return Session(self, sid)
+
+    def submit(self, session_id: str, plan: fusion.Plan, bindings: dict, *,
+               estimate_bytes: Optional[int] = None,
+               donate_inputs: bool = False) -> QueryTicket:
+        """Queue one query. Never blocks: over-the-whole-budget estimates
+        and full session queues come back as immediately-rejected tickets
+        (backpressure belongs to the client, not to unbounded memory)."""
+        sid = str(session_id)
+        self.session(sid)  # idempotent registration
+        estimate = int(estimate_bytes) if estimate_bytes is not None \
+            else self._default_estimate(plan, bindings)
+        ticket = QueryTicket(sid, plan, bindings, estimate, donate_inputs)
+        self._count("submitted", sid)
+        record_server(plan.name, "submitted", session=sid,
+                      estimate_bytes=estimate)
+        if estimate > self.limiter.budget:
+            self._reject(ticket,
+                         f"estimate {estimate} exceeds the whole HBM "
+                         f"budget ({self.limiter.budget}): can never fit")
+            return ticket
+        with self._cond:
+            if self._closed:
+                reject_why = "server closed"
+            elif len(self._queues[sid]) >= self.queue_depth:
+                reject_why = (f"session queue full "
+                              f"({self.queue_depth} deep)")
+            else:
+                reject_why = None
+                self._queues[sid].append(ticket)
+                self._cond.notify()
+        if reject_why is not None:
+            self._reject(ticket, reject_why)
+            return ticket
+        self._count("queued", sid)
+        record_server(plan.name, "queued", session=sid,
+                      estimate_bytes=estimate)
+        return ticket
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting work, drain the workers, reject the backlog."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._stop.set()
+            self._cond.notify_all()
+        for w in self._workers:
+            w.join(timeout)
+        # whatever the workers never picked up resolves as rejected
+        with self._cond:
+            backlog = [t for q in self._queues.values() for t in q]
+            for q in self._queues.values():
+                q.clear()
+        for t in backlog:
+            self._reject(t, "server shutdown")
+
+    def __enter__(self) -> "QueryServer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        c = REGISTRY.counters("server.")
+        lat = REGISTRY.histogram("server.latency_ms")
+        wait = REGISTRY.histogram("server.queue_wait_ms")
+        return {
+            "submitted": c.get("server.submitted", 0),
+            "queued": c.get("server.queued", 0),
+            "admitted": c.get("server.admitted", 0),
+            "served": c.get("server.served", 0),
+            "rejected": c.get("server.rejected", 0),
+            "failed": c.get("server.failed", 0),
+            "latency_ms_p50": lat.percentile(50),
+            "latency_ms_p95": lat.percentile(95),
+            "queue_wait_ms_p50": wait.percentile(50),
+            "queue_wait_ms_p95": wait.percentile(95),
+            "reserved_bytes": self.limiter.used,
+            "budget_bytes": self.limiter.budget,
+            "sessions": sorted(self._queues),
+        }
+
+    def session_stats(self, session_id: str) -> dict:
+        """Per-session attribution: counters, latency/queue-wait
+        percentiles, and fallback/spill accounting from the telemetry
+        ring (events stamped by ``session_scope`` during execution)."""
+        sid = str(session_id)
+        c = REGISTRY.counters("server.")
+        lat = REGISTRY.histogram(f"server.latency_ms.{sid}")
+        wait = REGISTRY.histogram(f"server.queue_wait_ms.{sid}")
+        fallbacks = 0
+        spills = 0
+        resilience_events = 0
+        for rec in _ring_events():
+            if rec.get("session") != sid:
+                continue
+            kind = rec.get("kind")
+            if kind == "fallback":
+                fallbacks += 1
+            elif kind == "spill":
+                spills += 1
+            elif kind == "resilience":
+                resilience_events += 1
+        return {
+            "session": sid,
+            "submitted": c.get(f"server.submitted.{sid}", 0),
+            "queued": c.get(f"server.queued.{sid}", 0),
+            "admitted": c.get(f"server.admitted.{sid}", 0),
+            "served": c.get(f"server.served.{sid}", 0),
+            "rejected": c.get(f"server.rejected.{sid}", 0),
+            "failed": c.get(f"server.failed.{sid}", 0),
+            "latency_ms_p50": lat.percentile(50),
+            "latency_ms_p95": lat.percentile(95),
+            "queue_wait_ms_p50": wait.percentile(50),
+            "queue_wait_ms_p95": wait.percentile(95),
+            "fallbacks": fallbacks,
+            "spills": spills,
+            "resilience_events": resilience_events,
+        }
+
+    # -- internals -----------------------------------------------------------
+
+    def _count(self, event: str, sid: str) -> None:
+        # unconditional (not gated on telemetry.enabled): admission
+        # accounting must hold whether or not anyone is watching
+        REGISTRY.counter(f"server.{event}").inc()
+        REGISTRY.counter(f"server.{event}.{sid}").inc()
+
+    def _default_estimate(self, plan: fusion.Plan, bindings: dict) -> int:
+        """Headroom x the plan-aware input+output estimate; host-staged
+        chunk bindings are costed at their exact device footprint."""
+        if any(isinstance(v, HostTableChunk) for v in bindings.values()):
+            base = sum(
+                v.nbytes if isinstance(v, HostTableChunk)
+                else _table_nbytes(v)
+                for v in bindings.values())
+        else:
+            base = fusion.estimate_hbm_bytes(plan, bindings)
+        return int(self.estimate_headroom * base)
+
+    def _reject(self, ticket: QueryTicket, reason: str) -> None:
+        self._count("rejected", ticket.session)
+        record_server(ticket.plan.name, "rejected", session=ticket.session,
+                      reason=reason, estimate_bytes=ticket.estimate)
+        _log.warning("rejected %s (session %s): %s",
+                     ticket.plan.name, ticket.session, reason)
+        ticket._resolve("rejected", exc=QueryRejected(
+            f"{ticket.plan.name} (session {ticket.session}): {reason}"))
+
+    def _next_ticket(self) -> Optional[QueryTicket]:
+        """Round-robin pop: the next session (in ring order after the
+        previously scheduled one) that has queued work gives up its
+        OLDEST query. Blocks until work arrives or the server stops."""
+        with self._cond:
+            while True:
+                for _ in range(len(self._ring)):
+                    sid = self._ring[0]
+                    self._ring.rotate(-1)
+                    q = self._queues.get(sid)
+                    if q:
+                        return q.popleft()
+                if self._stop.is_set():
+                    return None
+                self._cond.wait(0.1)
+
+    def _worker(self) -> None:
+        while True:
+            ticket = self._next_ticket()
+            if ticket is None:
+                return
+            self._serve(ticket)
+
+    def _stage_bindings(self, bindings: dict) -> dict:
+        """Stage host-decoded chunk bindings to device tables on the
+        SHARED decode pool, concurrently across tables. Runs after
+        admission: the reservation already covers these bytes."""
+        futs = {
+            name: self.decode_pool.submit(val.stage)
+            for name, val in bindings.items()
+            if isinstance(val, HostTableChunk)
+        }
+        if not futs:
+            return bindings
+        staged = dict(bindings)
+        for name, fut in futs.items():
+            staged[name] = fut.result()
+        return staged
+
+    def _serve(self, ticket: QueryTicket) -> None:
+        sid = ticket.session
+        held = 0
+        try:
+            faults.fire("server.admit", 0, session=sid,
+                        plan=ticket.plan.name)
+            ok = self.limiter.reserve_blocking(
+                ticket.estimate, cancel=self._stop,
+                timeout=self.admission_timeout_s)
+            if not ok:
+                self._reject(
+                    ticket,
+                    "server shutdown" if self._stop.is_set()
+                    else f"admission timeout "
+                         f"({self.admission_timeout_s}s) waiting for "
+                         f"{ticket.estimate} bytes")
+                return
+            held = ticket.estimate
+            ticket.status = "admitted"
+            ticket.queue_wait_s = time.monotonic() - ticket._submitted_at
+            wait_ms = ticket.queue_wait_s * 1e3
+            REGISTRY.histogram("server.queue_wait_ms").observe(wait_ms)
+            REGISTRY.histogram(
+                f"server.queue_wait_ms.{sid}").observe(wait_ms)
+            self._count("admitted", sid)
+            record_server(ticket.plan.name, "admitted", session=sid,
+                          wait_ms=wait_ms, reserved_bytes=held)
+            with session_scope(sid):
+                faults.fire("server.execute", 0, session=sid,
+                            plan=ticket.plan.name)
+                bindings = self._stage_bindings(ticket.bindings)
+                result = fusion.execute(
+                    ticket.plan, bindings,
+                    donate_inputs=ticket.donate_inputs)
+            ticket.latency_s = time.monotonic() - ticket._submitted_at
+            lat_ms = ticket.latency_s * 1e3
+            REGISTRY.histogram("server.latency_ms").observe(lat_ms)
+            REGISTRY.histogram(f"server.latency_ms.{sid}").observe(lat_ms)
+            self._count("served", sid)
+            record_server(ticket.plan.name, "served", session=sid,
+                          wall_ms=lat_ms, wait_ms=ticket.queue_wait_s * 1e3)
+            ticket._resolve("served", value=result)
+        except BaseException as exc:
+            # a dying query releases everything it holds (the finally
+            # below) and resolves CLASSIFIED — never a silent wedge
+            kind = resilience.classify(exc, seam="server.execute").__name__
+            ticket.latency_s = time.monotonic() - ticket._submitted_at
+            self._count("failed", sid)
+            record_server(ticket.plan.name, "failed", session=sid,
+                          error_kind=kind,
+                          reason=str(exc) or type(exc).__name__)
+            _log.warning("query %s (session %s) failed classified as %s",
+                         ticket.plan.name, sid, kind)
+            ticket._resolve("failed", exc=exc)
+            if not isinstance(exc, Exception):
+                raise  # KeyboardInterrupt etc: not the server's to absorb
+        finally:
+            if held:
+                self.limiter.release(held)

@@ -19,6 +19,7 @@ call).
 """
 
 from spark_rapids_jni_tpu.telemetry.events import (
+    current_session,
     drain,
     enabled,
     events,
@@ -27,7 +28,9 @@ from spark_rapids_jni_tpu.telemetry.events import (
     record_dispatch,
     record_fallback,
     record_resilience,
+    record_server,
     record_spill,
+    session_scope,
     summary,
 )
 from spark_rapids_jni_tpu.telemetry.registry import REGISTRY, Registry
@@ -35,6 +38,7 @@ from spark_rapids_jni_tpu.telemetry.registry import REGISTRY, Registry
 __all__ = [
     "REGISTRY",
     "Registry",
+    "current_session",
     "drain",
     "enabled",
     "events",
@@ -43,6 +47,8 @@ __all__ = [
     "record_dispatch",
     "record_fallback",
     "record_resilience",
+    "record_server",
     "record_spill",
+    "session_scope",
     "summary",
 ]

@@ -48,6 +48,9 @@ __all__ = [
     "record_spill",
     "record_resilience",
     "record_bench_stale",
+    "record_server",
+    "session_scope",
+    "current_session",
     "events",
     "drain",
     "summary",
@@ -56,6 +59,41 @@ __all__ = [
 _RING_MAX = 4096
 _ring: Deque[Dict[str, Any]] = collections.deque(maxlen=_RING_MAX)
 _ring_lock = threading.Lock()
+
+# Ambient session attribution (runtime/server.py): while a served query
+# executes inside session_scope(sid), every record emitted on that thread —
+# including fallbacks/spills/resilience events from layers that know nothing
+# about sessions — is stamped with ``session``.
+_session_ctx = threading.local()
+
+
+class session_scope:
+    """Attribute every telemetry record emitted on this thread to a session.
+
+    Re-entrant in the shadowing sense: nesting restores the outer session
+    on exit. Explicit ``session=`` kwargs on record_* calls win over the
+    ambient scope (``_emit`` uses ``setdefault``).
+    """
+
+    def __init__(self, session_id: str):
+        if not session_id or not str(session_id).strip():
+            raise ValueError("session_scope: session_id must be non-empty")
+        self._sid = str(session_id)
+        self._outer: Optional[str] = None
+
+    def __enter__(self) -> "session_scope":
+        self._outer = getattr(_session_ctx, "sid", None)
+        _session_ctx.sid = self._sid
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _session_ctx.sid = self._outer
+        return False
+
+
+def current_session() -> Optional[str]:
+    """The session id attributed to this thread, or None outside a scope."""
+    return getattr(_session_ctx, "sid", None)
 
 
 def enabled() -> bool:
@@ -79,6 +117,9 @@ def _platform() -> str:
 def _emit(rec: Dict[str, Any]) -> Dict[str, Any]:
     rec.setdefault("ts", time.time())
     rec.setdefault("platform", _platform())
+    sid = current_session()
+    if sid is not None:
+        rec.setdefault("session", sid)
     with _ring_lock:
         _ring.append(rec)
     REGISTRY.counter("events_total").inc()
@@ -227,6 +268,36 @@ def record_resilience(
     return True
 
 
+def record_server(
+    op: str,
+    event: str,
+    *,
+    session: str,
+    rows: Optional[int] = None,
+    **extra: Any,
+) -> bool:
+    """A serving-runtime decision for one query of one session.
+
+    ``event`` is one of ``submitted`` / ``queued`` / ``rejected`` /
+    ``admitted`` / ``served`` / ``failed``; ``session`` is mandatory and
+    must be non-empty even when telemetry is off — an unattributable
+    serving event is a bug (tpulint rule 12 enforces the static half of
+    this contract on the server path).
+    """
+    if not session or not str(session).strip():
+        raise ValueError(f"record_server({op!r}): session must be non-empty")
+    if not enabled():
+        return False
+    rec = _base("server", op, rows, None, extra)
+    rec["event"] = str(event)
+    rec["session"] = str(session)
+    # no counter side effects here: the serving runtime owns the
+    # ``server.*`` counters and counts unconditionally (admission
+    # accounting must hold even with telemetry off, like the limiter's)
+    _emit(rec)
+    return True
+
+
 def record_bench_stale(
     metric: str,
     *,
@@ -273,6 +344,7 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
     spills: Dict[str, int] = {}
     cache = {"hit": 0, "miss": 0}
     resilience: Dict[str, int] = {}
+    server: Dict[str, int] = {}
     stale_reads = 0
     dispatches = 0
     spill_bytes = 0
@@ -281,6 +353,9 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         if kind == "resilience":
             ev = str(r.get("event", "?"))
             resilience[ev] = resilience.get(ev, 0) + 1
+        elif kind == "server":
+            ev = str(r.get("event", "?"))
+            server[ev] = server.get(ev, 0) + 1
         elif kind == "fallback":
             op = str(r.get("op", "?"))
             fallbacks[op] = fallbacks.get(op, 0) + 1
@@ -303,5 +378,6 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         "spill_bytes_total": spill_bytes,
         "compile_cache": cache,
         "resilience": dict(sorted(resilience.items())),
+        "server": dict(sorted(server.items())),
         "stale_reads": stale_reads,
     }

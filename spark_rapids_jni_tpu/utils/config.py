@@ -98,6 +98,23 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # replay, not on wall time.
     "resilience.backoff_ms": (0, int),
     "resilience.backoff_multiplier": (2.0, float),
+    # Multi-query serving runtime (runtime/server.py): maximum queries
+    # executing concurrently across ALL sessions; queued work beyond this
+    # waits its round-robin turn.
+    "server.max_inflight": (4, int),
+    # Default HBM budget (bytes) for a QueryServer built without an
+    # explicit MemoryLimiter — every admitted query reserves its estimate
+    # against this before it starts.
+    "server.hbm_budget_bytes": (1 << 30, int),
+    # How long (seconds) an admitted-for-execution query may wait for its
+    # HBM reservation before it is rejected instead of held forever.
+    "server.admission_timeout_s": (30.0, float),
+    # Per-session queue depth: submissions beyond this are rejected at
+    # submit time (backpressure to the client, not unbounded memory).
+    "server.queue_depth": (64, int),
+    # Safety multiplier applied to the input-bytes HBM estimate when the
+    # caller does not supply one (intermediates cost more than inputs).
+    "server.estimate_headroom": (1.5, float),
 }
 
 _overrides: dict[str, Any] = {}

@@ -274,3 +274,47 @@ def test_bucket_schedule_waste_knob():
 def test_quantize_capacity_disabled_is_identity():
     set_option("dispatch.enabled", False)
     assert dispatch.quantize_capacity(17) == 17
+
+
+def test_concurrent_first_compile_is_single_flight():
+    """N threads racing the FIRST compile of one key: exactly one thread
+    compiles (the leader), the rest block on the in-flight marker and
+    reuse its executable. The old code let every racer compile the same
+    key (last store wins), so dispatch.compile would read N here. A
+    sleeping probe at the dispatch.compile seam holds the leader inside
+    _compile long enough that every racer is genuinely concurrent."""
+    import threading
+    import time
+
+    from spark_rapids_jni_tpu.runtime import faults
+
+    def slow_compile(seam, seq, ctx):
+        if seam == "dispatch.compile":
+            time.sleep(0.3)
+
+    n_threads = 8
+    col = Column.from_numpy(np.arange(1000, dtype=np.int64))
+    barrier = threading.Barrier(n_threads)
+    results = [None] * n_threads
+    errors = []
+
+    def racer(i):
+        barrier.wait()
+        try:
+            total, ok = red.sum_(col)
+            assert bool(ok)
+            results[i] = int(total)
+        except BaseException as exc:  # noqa: B036 - surfaced to the test
+            errors.append(exc)
+
+    with faults.inject(slow_compile):
+        threads = [threading.Thread(target=racer, args=(i,))
+                   for i in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(60)
+    assert not errors
+    assert results == [1000 * 999 // 2] * n_threads
+    assert REGISTRY.counter("dispatch.compile").value == 1
+    assert REGISTRY.counter("dispatch.hit").value == n_threads - 1

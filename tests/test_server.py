@@ -1,0 +1,390 @@
+"""Multi-query serving runtime (runtime/server, ISSUE 7).
+
+Five invariant families:
+
+1. **Bit-identity under concurrency** — N sessions submitting q1/q3/q6
+   at ragged row counts through one shared server get byte-for-byte the
+   results serial ``fusion.execute`` produces for the same plan and
+   bindings, with zero leaked ``MemoryLimiter`` reservations afterwards.
+
+2. **Warm-cache sharing** — sessions at ragged row counts inside one
+   bucket trigger exactly ONE compile per fused region (the single-flight
+   executable cache), every other query a hit.
+
+3. **Admission control** — an estimate over the whole budget (or a full
+   session queue, or an admission timeout) rejects instead of
+   overcommitting; work that merely does not fit *right now* queues and
+   the limiter peak never exceeds the budget.
+
+4. **Fairness** — round-robin across sessions: a light session's query
+   is served ahead of a heavy session's backlog, never starved behind it.
+   Plus the ``MemoryLimiter`` FIFO regression: a later smaller
+   reservation must NOT barge past an earlier blocked one (the old
+   behavior granted it instantly).
+
+5. **Fault isolation & attribution** — an injected fault in one session
+   fails that query classified, leaks nothing, and never perturbs another
+   session's results; telemetry events emitted during a served query
+   carry its ``session`` id.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.runtime import dispatch, faults, fusion, server
+from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.telemetry.events import drain as drain_events
+from spark_rapids_jni_tpu.telemetry.events import events as ring_events
+from spark_rapids_jni_tpu.utils.config import (
+    get_option,
+    reset_option,
+    set_option,
+)
+
+# ragged row counts inside ONE bucket of the default schedule
+# (512 < n <= 1024 -> bucket 1024)
+RAGGED_IN_BUCKET = (600, 700, 801, 1000)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_server():
+    """Each test sees a fresh executable cache, counter namespace, and
+    event ring, and leaves the server config at its defaults."""
+    dispatch.clear()
+    REGISTRY.reset()
+    drain_events()
+    yield
+    for k in ("server.max_inflight", "server.hbm_budget_bytes",
+              "server.admission_timeout_s", "server.queue_depth",
+              "server.estimate_headroom", "telemetry.enabled"):
+        reset_option(k)
+    dispatch.clear()
+
+
+def _q1_bindings(n, seed=0):
+    return tpch._q1_plan(), {"lineitem": tpch.lineitem_table(n, seed=seed)}
+
+
+def _q6_plan():
+    return fusion.Plan("tpch_q6", fusion.Project(
+        fusion.Scan("lineitem"), tpch._q6_reduce, rowwise=False))
+
+
+def _q3_bindings(n, seed=0):
+    n_ord = max(n // 8, 4)
+    n_cust = max(n // 64, 2)
+    plan = tpch._q3_plan(0, tpch._Q3_CUTOFF_DAYS, 2)
+    bindings = {
+        "customer": tpch.customer_table(n_cust, seed=seed),
+        "orders": tpch.orders_table(n_ord, n_cust, seed=seed + 1),
+        "lineitem": tpch.lineitem_q3_table(n, n_ord, seed=seed + 2),
+    }
+    return plan, bindings
+
+
+def _assert_tables_identical(a, b, label=""):
+    assert a.num_columns == b.num_columns, f"{label}: column count"
+    assert a.num_rows == b.num_rows, f"{label}: row count"
+    for i in range(a.num_columns):
+        ca, cb = a.column(i), b.column(i)
+        av, bv = np.asarray(ca.valid_mask()), np.asarray(cb.valid_mask())
+        assert np.array_equal(av, bv), f"{label} col {i}: validity"
+        ad = np.where(av, np.asarray(ca.data), 0)
+        bd = np.where(bv, np.asarray(cb.data), 0)
+        assert np.array_equal(ad, bd), f"{label} col {i}: data"
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-identity under concurrency
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_sessions_bit_identical_to_serial():
+    """4 sessions x {q1, q3, q6} at ragged row counts, 16 in-flight
+    slots: every result equals its serial fusion.execute reference and
+    no reservation survives the run."""
+    jobs = []  # (session, plan, bindings, reference)
+    for i, n in enumerate(RAGGED_IN_BUCKET):
+        q1p, q1b = _q1_bindings(n, seed=i)
+        q3p, q3b = _q3_bindings(max(n // 2, 64), seed=i)
+        q6p, q6b = _q6_plan(), {
+            "lineitem": tpch.lineitem_table(n + 7, seed=i + 10)}
+        for plan, bindings in ((q1p, q1b), (q3p, q3b), (q6p, q6b)):
+            ref = fusion.execute(plan, bindings)
+            jobs.append((f"sess{i}", plan, bindings, ref))
+
+    with server.QueryServer(budget_bytes=1 << 28, max_inflight=16) as srv:
+        tickets = [
+            (srv.session(sid).submit(plan, bindings), plan, ref)
+            for sid, plan, bindings, ref in jobs
+        ]
+        for ticket, plan, ref in tickets:
+            res = ticket.result(timeout=120)
+            assert ticket.status == "served"
+            _assert_tables_identical(res.table, ref.table, plan.name)
+        assert srv.limiter.used == 0, "leaked reservations"
+        assert srv.stats()["served"] == len(jobs)
+
+
+# ---------------------------------------------------------------------------
+# 2. warm-cache sharing across sessions (single-flight compile)
+# ---------------------------------------------------------------------------
+
+
+def test_sessions_in_one_bucket_share_one_executable():
+    """N sessions at ragged row counts inside one bucket: exactly ONE
+    compile per fused region, even though the first compiles race."""
+    with server.QueryServer(budget_bytes=1 << 28, max_inflight=8) as srv:
+        tickets = []
+        for i, n in enumerate(RAGGED_IN_BUCKET):
+            plan, bindings = _q1_bindings(n, seed=i)
+            tickets.append(srv.session(f"s{i}").submit(plan, bindings))
+            q6b = {"lineitem": tpch.lineitem_table(n - 3, seed=i + 20)}
+            tickets.append(srv.session(f"s{i}").submit(_q6_plan(), q6b))
+        for ticket in tickets:
+            ticket.result(timeout=120)
+    c = REGISTRY.counters("dispatch.")
+    assert c.get("dispatch.compile.fusion.tpch_q1", 0) == 1
+    assert c.get("dispatch.compile.fusion.tpch_q6", 0) == 1
+    n_queries = len(RAGGED_IN_BUCKET)
+    assert c.get("dispatch.hit.fusion.tpch_q1", 0) == n_queries - 1
+    assert c.get("dispatch.hit.fusion.tpch_q6", 0) == n_queries - 1
+
+
+# ---------------------------------------------------------------------------
+# 3. admission control
+# ---------------------------------------------------------------------------
+
+
+def test_over_budget_estimate_rejected_not_overcommitted():
+    plan, bindings = _q1_bindings(1000)
+    with server.QueryServer(budget_bytes=10_000, max_inflight=2) as srv:
+        ticket = srv.session("big").submit(plan, bindings)
+        assert ticket.status == "rejected"
+        with pytest.raises(server.QueryRejected, match="whole HBM budget"):
+            ticket.result(timeout=5)
+        assert srv.limiter.used == 0
+        assert srv.stats()["rejected"] == 1
+
+
+def test_tight_budget_queues_and_never_exceeds():
+    """Three queries against a budget that fits only one estimate at a
+    time: all serve (serialized through the limiter), and the limiter
+    peak stays inside the budget — no overcommit, ever."""
+    plan, bindings = _q1_bindings(700)
+    est = int(get_option("server.estimate_headroom")
+              * fusion.estimate_hbm_bytes(plan, bindings))
+    budget = int(est * 1.5)  # one fits, two would overcommit
+    with server.QueryServer(budget_bytes=budget, max_inflight=4) as srv:
+        tickets = [srv.session(f"s{i}").submit(plan, bindings)
+                   for i in range(3)]
+        for ticket in tickets:
+            ticket.result(timeout=120)
+            assert ticket.status == "served"
+        assert srv.limiter.peak <= budget
+        assert srv.limiter.used == 0
+
+
+def test_admission_timeout_rejects_and_releases_slot():
+    lim = MemoryLimiter(1000)
+    lim.reserve(900)  # external pressure the server cannot see past
+    plan, bindings = _q1_bindings(600)
+    with server.QueryServer(limiter=lim, max_inflight=2,
+                            admission_timeout_s=0.3) as srv:
+        ticket = srv.session("slow").submit(
+            plan, bindings, estimate_bytes=500)
+        with pytest.raises(server.QueryRejected, match="admission timeout"):
+            ticket.result(timeout=30)
+        assert ticket.status == "rejected"
+        # the slot freed: a fitting query still serves afterwards
+        ok = srv.session("slow").submit(plan, bindings, estimate_bytes=50)
+        ok.result(timeout=60)
+        assert ok.status == "served"
+    assert lim.used == 900  # external reservation untouched, nothing leaked
+    lim.release(900)
+
+
+def test_full_session_queue_rejects_at_submit():
+    plan, bindings = _q1_bindings(600)
+    lim = MemoryLimiter(1 << 28)
+    lim.reserve((1 << 28) - 1)  # wedge admission so the queue backs up
+    picked = threading.Event()
+
+    def probe(seam, seq, ctx):
+        if seam == "server.admit":
+            picked.set()
+
+    with faults.inject(probe), \
+            server.QueryServer(limiter=lim, max_inflight=1, queue_depth=2,
+                               admission_timeout_s=10.0) as srv:
+        sess = srv.session("burst")
+        tickets = [sess.submit(plan, bindings, estimate_bytes=100)]
+        assert picked.wait(10)  # the worker holds ticket 0 at admission
+        tickets += [sess.submit(plan, bindings, estimate_bytes=100)
+                    for _ in range(4)]
+        # 1 in flight (blocked at admission) + 2 queued; the rest bounce
+        rejected = [t for t in tickets if t.status == "rejected"]
+        assert len(rejected) == 2
+        for t in rejected:
+            with pytest.raises(server.QueryRejected, match="queue full"):
+                t.result(timeout=5)
+        lim.release((1 << 28) - 1)
+        for t in tickets:
+            if t not in rejected:
+                t.result(timeout=60)
+                assert t.status == "served"
+    assert lim.used == 0
+
+
+# ---------------------------------------------------------------------------
+# 4. fairness
+# ---------------------------------------------------------------------------
+
+
+def test_round_robin_light_session_not_starved():
+    """A heavy session with a 4-deep backlog and a light session with one
+    query: execution order must interleave — the light query runs right
+    after the heavy query already in flight, not after the backlog."""
+    plan, bindings = _q1_bindings(600)
+    lim = MemoryLimiter(1000)
+    lim.reserve(990)  # park the first pick at admission
+    order = []
+    picked = threading.Event()
+
+    def probe(seam, seq, ctx):
+        if seam == "server.admit":
+            picked.set()
+        elif seam == "server.execute":
+            order.append(ctx["session"])
+
+    with faults.inject(probe):
+        with server.QueryServer(limiter=lim, max_inflight=1,
+                                admission_timeout_s=30.0) as srv:
+            heavy = srv.session("heavy")
+            light = srv.session("light")
+            first = heavy.submit(plan, bindings, estimate_bytes=100)
+            assert picked.wait(10)  # the worker holds it at admission
+            backlog = [heavy.submit(plan, bindings, estimate_bytes=100)
+                       for _ in range(3)]
+            lone = light.submit(plan, bindings, estimate_bytes=100)
+            lim.release(990)
+            for t in [first, lone] + backlog:
+                t.result(timeout=60)
+    assert order[0] == "heavy"
+    assert order[1] == "light", f"light starved: {order}"
+    assert order.count("heavy") == 4 and order.count("light") == 1
+    assert lim.used == 0
+
+
+def test_limiter_fifo_no_barge():
+    """Regression (old behavior): budget 100, 80 held, thread A blocks
+    wanting 60; thread B then asks for 20 — which FITS (80+20=100), so
+    the old poll loop granted B instantly, barging past A. FIFO ordering
+    must hold B behind A until A is served."""
+    lim = MemoryLimiter(100)
+    lim.reserve(80)
+    order = []
+
+    def want(tag, n):
+        assert lim.reserve_blocking(n, timeout=10)
+        order.append(tag)
+
+    a = threading.Thread(target=want, args=("A", 60))
+    a.start()
+    time.sleep(0.2)  # A is parked before B arrives
+    b = threading.Thread(target=want, args=("B", 20))
+    b.start()
+    time.sleep(0.3)
+    # the barge window: B fits right now, but A was first — nobody may
+    # have been granted yet (old code had order == ["B"] here)
+    assert order == [], f"barge: {order}"
+    lim.release(80)
+    a.join(10)
+    b.join(10)
+    assert order == ["A", "B"]
+    assert lim.used == 80  # A's 60 + B's 20
+    lim.release(80)
+
+
+def test_limiter_fifo_timeout_unblocks_queue():
+    """A timed-out head-of-line waiter must not wedge the queue."""
+    lim = MemoryLimiter(100)
+    lim.reserve(80)
+    assert lim.reserve_blocking(60, timeout=0.2) is False
+    # the dead ticket is gone: a fitting request proceeds immediately
+    assert lim.reserve_blocking(20, timeout=5)
+    lim.release(100)
+
+
+# ---------------------------------------------------------------------------
+# 5. fault isolation & session attribution
+# ---------------------------------------------------------------------------
+
+
+def test_fault_in_one_session_leaks_nothing_and_isolates():
+    plan, bindings = _q1_bindings(700)
+    ref = fusion.execute(plan, bindings)
+
+    def victim_only(seam, seq, ctx):
+        if seam == "server.execute" and ctx.get("session") == "victim":
+            raise RuntimeError("injected query death")
+
+    with server.QueryServer(budget_bytes=1 << 28, max_inflight=4) as srv:
+        with faults.inject(victim_only):
+            doomed = srv.session("victim").submit(plan, bindings)
+            fine = srv.session("bystander").submit(plan, bindings)
+            with pytest.raises(RuntimeError, match="injected query death"):
+                doomed.result(timeout=60)
+            assert doomed.status == "failed"
+            res = fine.result(timeout=60)
+            assert fine.status == "served"
+        _assert_tables_identical(res.table, ref.table, "bystander")
+        assert srv.limiter.used == 0, "fault leaked a reservation"
+        assert srv.stats()["failed"] == 1
+        assert srv.session_stats("victim")["failed"] == 1
+        assert srv.session_stats("bystander")["failed"] == 0
+
+
+def test_served_query_events_carry_session_id():
+    """Telemetry on: a fused-region fault falls back to the staged
+    evaluator INSIDE the served query — the resulting fallback event (and
+    every server event) must carry the session id via session_scope."""
+    set_option("telemetry.enabled", True)
+    plan, bindings = _q1_bindings(600)
+    script = faults.FaultScript(
+        [faults.FaultSpec("fusion.region", RuntimeError("region boom"))])
+    with server.QueryServer(budget_bytes=1 << 28, max_inflight=2) as srv:
+        with faults.inject(script):
+            ticket = srv.session("s9").submit(plan, bindings)
+            ticket.result(timeout=60)  # staged fallback still serves
+        assert ticket.status == "served"
+        assert script.fired == [("fusion.region", 0)]
+        fallbacks = [r for r in ring_events() if r.get("kind") == "fallback"]
+        assert fallbacks and all(
+            r.get("session") == "s9" for r in fallbacks)
+        server_events = [r for r in ring_events()
+                         if r.get("kind") == "server"]
+        assert server_events and all(
+            r.get("session") == "s9" for r in server_events)
+        st = srv.session_stats("s9")
+        assert st["fallbacks"] >= 1
+        assert st["served"] == 1
+        assert st["latency_ms_p95"] >= 0.0
+
+
+def test_server_seams_registered():
+    assert "server.admit" in faults.SEAMS
+    assert "server.execute" in faults.SEAMS
+
+
+def test_server_config_defaults():
+    assert get_option("server.max_inflight") == 4
+    assert get_option("server.hbm_budget_bytes") == 1 << 30
+    assert get_option("server.admission_timeout_s") == 30.0
+    assert get_option("server.queue_depth") == 64
+    assert get_option("server.estimate_headroom") == 1.5

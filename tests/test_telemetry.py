@@ -388,3 +388,49 @@ def test_report_cli_errors(tmp_path, capsys):
     assert main(["report", str(tmp_path / "missing.jsonl")]) == 1
     assert main(["not-a-command"]) == 2
     assert main([]) == 2
+
+
+# ---------------------------------------------------------------------------
+# session attribution (multi-query serving)
+# ---------------------------------------------------------------------------
+
+
+def test_session_scope_stamps_events(enabled):
+    with telemetry.session_scope("tenant-a"):
+        assert telemetry.current_session() == "tenant-a"
+        telemetry.record_fallback("regexp", "scoped probe")
+        with telemetry.session_scope("tenant-b"):  # shadow-nests
+            telemetry.record_fallback("regexp", "inner probe")
+        telemetry.record_fallback("regexp", "outer again")
+    assert telemetry.current_session() is None
+    telemetry.record_fallback("regexp", "unscoped")
+    sids = [r.get("session") for r in telemetry.events()
+            if r["kind"] == "fallback"]
+    assert sids == ["tenant-a", "tenant-b", "tenant-a", None]
+
+
+def test_session_scope_rejects_empty_id():
+    with pytest.raises(ValueError):
+        with telemetry.session_scope(""):
+            pass
+
+
+def test_record_server_event_schema(enabled):
+    telemetry.record_server("tpch_q1", "served", session="s1",
+                            rows=100, wall_ms=1.5)
+    (rec,) = [r for r in telemetry.events() if r["kind"] == "server"]
+    assert rec["event"] == "served"
+    assert rec["session"] == "s1"
+    assert rec["rows"] == 100
+    # record_server does NOT touch counters: the serving runtime owns
+    # server.* accounting unconditionally (admission must hold with
+    # telemetry off), so a counter here would double-count
+    assert telemetry.REGISTRY.counters("server.") == {}
+    summary = telemetry.summary()
+    assert summary["server"] == {"served": 1}
+
+
+def test_record_server_session_mandatory_even_when_disabled():
+    # disabled-path validation, same contract as record_fallback's reason
+    with pytest.raises(ValueError):
+        telemetry.record_server("tpch_q1", "served", session="")

@@ -249,8 +249,30 @@ def test_rule_error_must_classify_scope(tmp_path):
     assert _by_rule(_lint_file(target2), "error-must-classify")
 
 
+def test_rule_server_session_id_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_server_telemetry.py"),
+                   "server-telemetry-session-id")
+    texts = [f.source_line for f in got]
+    assert len(got) == 3, texts
+    assert sum("record_server" in t for t in texts) == 1
+    assert sum("record_fallback" in t for t in texts) == 1
+    assert sum("record_spill" in t for t in texts) == 1
+    # kwarg / session_scope / splat / pragma'd twins stay clean
+    src = (FIXTURES / "seeded_server_telemetry.py").read_text()
+    clean_at = src[:src.index("def clean_explicit_session")].count("\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_server_session_id_scope(tmp_path):
+    # the identical source under a non-server basename is out of scope:
+    # host-side scripts emit events the ambient platform tags suffice for
+    target = tmp_path / "plain_batch_job.py"
+    shutil.copy(FIXTURES / "seeded_server_telemetry.py", target)
+    assert not _by_rule(_lint_file(target), "server-telemetry-session-id")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all eleven rules demonstrably fire."""
+    """The acceptance invariant: all twelve rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
@@ -271,6 +293,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_fusion_region.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_resilience_swallow.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_server_telemetry.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

@@ -1,0 +1,37 @@
+"""Seeded violations for rule 12 (server-telemetry-session-id).
+
+The basename contains ``server`` so the file is on the serving path.
+Violations first, then clean twins past the ``def clean_`` marker the
+per-rule test splits on. The emitters arrive as parameters — the rule
+is name-based, exactly like the real call sites it guards.
+"""
+
+
+def unattributed_server_event(record_server, op):
+    record_server(op, "served")             # VIOLATION: whose query?
+
+
+def unattributed_fallback(record_fallback, exc):
+    # VIOLATION: a fallback on the serving path nobody can attribute
+    record_fallback("server.execute", f"fell back: {exc}")
+
+
+def unattributed_spill(record_spill, nbytes):
+    record_spill("server.pipeline", nbytes)  # VIOLATION: anonymous spill
+
+
+def clean_explicit_session(record_server, op, sid):
+    record_server(op, "served", session=sid)  # clean: explicit kwarg
+
+
+def clean_inside_scope(record_fallback, session_scope, sid, exc):
+    with session_scope(sid):  # clean: the scope stamps every event
+        record_fallback("server.execute", f"fell back: {exc}")
+
+
+def clean_splat(record_server, op, kwargs):
+    record_server(op, "served", **kwargs)   # clean: splat may carry it
+
+
+def clean_pragma(record_server, op):
+    record_server(op, "probe")  # tpulint: disable=server-telemetry-session-id

@@ -1,4 +1,4 @@
-"""The eleven tpulint rules.
+"""The twelve tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -820,6 +820,66 @@ def check_error_must_classify(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 12: serving-path telemetry must carry session attribution
+# ---------------------------------------------------------------------------
+
+# the telemetry emitters whose events a multi-session operator reads
+_SESSION_RECORD_NAMES = {
+    "record_server", "record_fallback", "record_spill",
+    "record_resilience", "record_dispatch", "record_compile_cache",
+}
+
+
+def _is_server_file(name: str) -> bool:
+    return "server" in name
+
+
+def _session_scope_spans(tree: ast.Module) -> List[tuple]:
+    """(first, last) line ranges of ``with session_scope(...)`` blocks —
+    every event emitted inside one is stamped by the scope itself."""
+    spans: List[tuple] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            if "session_scope" in _unparse(item.context_expr):
+                spans.append((node.lineno, node.end_lineno or node.lineno))
+                break
+    return spans
+
+
+def check_server_session_id(ctx: FileContext) -> List[RawFinding]:
+    """ISSUE-7 bug class: the serving runtime multiplexes N sessions over
+    one process, so an un-attributed telemetry event (a fallback, a
+    spill, a served/rejected record) is unactionable — the operator
+    cannot tell WHOSE query fell back. In server-scope files every
+    telemetry ``record_*`` call must carry a ``session=`` keyword, splat
+    one through ``**kwargs``, or run inside ``with session_scope(sid):``
+    (which stamps every event emitted under it)."""
+    if not _is_server_file(ctx.name):
+        return []
+    spans = _session_scope_spans(ctx.tree)
+    out: List[RawFinding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _unparse(node.func).rsplit(".", 1)[-1]
+        if fn not in _SESSION_RECORD_NAMES:
+            continue
+        if any(kw.arg == "session" or kw.arg is None
+               for kw in node.keywords):
+            continue  # explicit kwarg, or a **splat that may carry it
+        if any(lo <= node.lineno <= hi for lo, hi in spans):
+            continue  # session_scope stamps the event
+        out.append(RawFinding(
+            node.lineno, node.col_offset,
+            f"serving-path telemetry `{fn}(...)` has no session "
+            "attribution: pass session=<sid>, or emit inside "
+            "`with session_scope(sid):` so the scope stamps it"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -864,4 +924,8 @@ RULES = [
          "must re-raise through the resilience taxonomy or visibly "
          "account for the swallow (record_* event, counter, log)",
          check_error_must_classify),
+    Rule("server-telemetry-session-id",
+         "telemetry record_* calls in server-scope files must carry "
+         "session attribution (session= kwarg or session_scope block)",
+         check_server_session_id),
 ]
