@@ -548,6 +548,161 @@ def _server_block() -> dict:
     return block
 
 
+def _degrade_block() -> dict:
+    """The BENCH_*.json ``degrade`` block: graceful degradation under
+    memory pressure (runtime/degrade.py). The same closed-loop q1
+    workload (4 sessions x 3 queries, warm cache) runs at three pressure
+    levels: the server's HBM budget scaled to 100% / 60% / 30% of the
+    concurrent working set (4x one admission's reservation), with
+    classified ``ResourceExhausted`` pressure injected at the fused/staged
+    region seam at a seeded rate rising as the budget shrinks — a CPU
+    probe cannot produce real HBM OOMs, so pressure arrives through the
+    same fault seam the resilience block uses (non-transient, exactly the
+    allocator-exhaustion shape the retry budget does NOT absorb), and the
+    budget squeeze exercises the admission/watermark side for real. Reports, per level:
+    queries/s, p50/p95 end-to-end latency, served/failed/rejected counts,
+    ladder steps taken, and per-tier degradation counts (staged /
+    outofcore / parked completions stepped to). The contract under test:
+    throughput bends (latency rises, tiers engage) but every query still
+    completes or dies classified — served + failed == offered, zero
+    leaked bytes. ``cancel_lag_ms_p50`` is the cooperative-cancellation
+    bound: queries submitted with an already-hopeless 20 ms deadline must
+    resolve within a scheduling quantum of expiry, not a query time."""
+    block: dict = {}
+    try:
+        import contextlib as _contextlib
+        import threading as _threading
+
+        from spark_rapids_jni_tpu.models import tpch
+        from spark_rapids_jni_tpu.runtime import degrade as _degrade
+        from spark_rapids_jni_tpu.runtime import faults as _faults
+        from spark_rapids_jni_tpu.runtime import resilience as _resilience
+        from spark_rapids_jni_tpu.runtime import server as _server
+        from spark_rapids_jni_tpu.telemetry import REGISTRY
+        from spark_rapids_jni_tpu.utils.config import get_option, set_option
+
+        rows = 1 << 12
+        plan = tpch._q1_plan()
+        bindings = {"lineitem": tpch.lineitem_table(rows, seed=3)}
+        conc, per_client = 4, 3
+
+        def _outofcore(staged_bindings, limiter):
+            return _degrade.row_chunked_tier(
+                staged_bindings, "lineitem", *tpch.q1_row_chunked_fns(),
+                limiter=limiter)
+
+        # working set: what ONE admission actually reserves, measured from
+        # a throwaway serve under an ample budget (also pays the compile)
+        with _server.QueryServer(budget_bytes=1 << 30,
+                                 max_inflight=conc) as srv:
+            probe = srv.session("probe").submit(plan, bindings)
+            probe.result(timeout=300)
+            ws = max(1, int(probe.estimate))
+
+        _TIER_CTRS = ("degrade.step", "degrade.tier.staged",
+                      "degrade.tier.outofcore", "degrade.tier.parked")
+        prev_tel = get_option("telemetry.enabled")
+        set_option("telemetry.enabled", True)  # degrade.* counters are gated
+        try:
+            for name, frac, rate in (("hbm_100", 1.0, 0.0),
+                                     ("hbm_60", 0.6, 0.15),
+                                     ("hbm_30", 0.3, 0.35)):
+                budget = max(ws + 1, int(conc * ws * frac))
+                before = {k: REGISTRY.counter(k).value for k in _TIER_CTRS}
+                script = _faults.FaultScript(
+                    seed=17, rate=rate, seams=("fusion.region",),
+                    exc=_resilience.ResourceExhausted) if rate else None
+                done: list = []
+                failed: list = []
+                with _server.QueryServer(budget_bytes=budget,
+                                         max_inflight=conc) as srv:
+                    srv.session("warm").submit(plan, bindings).result(
+                        timeout=300)
+
+                    def _client(i):
+                        sess = srv.session(f"deg_c{i}")
+                        for _ in range(per_client):
+                            t = sess.submit(plan, bindings,
+                                            outofcore=_outofcore)
+                            try:
+                                t.result(timeout=300)
+                                done.append(t)
+                            except Exception:
+                                failed.append(t)
+
+                    threads = [_threading.Thread(target=_client, args=(i,))
+                               for i in range(conc)]
+                    t0 = time.perf_counter()
+                    with (_faults.inject(script) if script
+                          else _contextlib.nullcontext()):
+                        for th in threads:
+                            th.start()
+                        for th in threads:
+                            th.join()
+                    wall = time.perf_counter() - t0
+                    leaked = srv.limiter.used
+                lats = sorted(t.latency_s for t in done) or [0.0]
+
+                def _pct(p):
+                    return round(
+                        lats[min(len(lats) - 1,
+                                 int(p / 100.0 * len(lats)))] * 1e3, 3)
+
+                delta = {k: REGISTRY.counter(k).value - before[k]
+                         for k in _TIER_CTRS}
+                block[name] = {
+                    "budget_frac": frac,
+                    "budget_bytes": budget,
+                    "injected_pressure_rate": rate,
+                    "queries": len(done) + len(failed),
+                    "served": len(done),
+                    "failed": len(failed),
+                    "queries_per_s": round(len(done) / wall, 2)
+                    if wall and done else None,
+                    "latency_ms_p50": _pct(50),
+                    "latency_ms_p95": _pct(95),
+                    "degrade_steps": delta["degrade.step"],
+                    "tiers": {
+                        "staged": delta["degrade.tier.staged"],
+                        "outofcore": delta["degrade.tier.outofcore"],
+                        "parked": delta["degrade.tier.parked"],
+                    },
+                    "leaked_bytes": leaked,
+                }
+        finally:
+            set_option("telemetry.enabled", prev_tel)
+
+        # cancel latency: the cooperative-cancellation bound. A chunked
+        # out-of-core run under an expiring deadline must stop at the next
+        # chunk boundary — the lag past the deadline is one chunk's work,
+        # never the remaining query time.
+        from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter
+
+        big = {"lineitem": tpch.lineitem_table(1 << 14, seed=4)}
+        limiter = MemoryLimiter(1 << 30)
+        runner = _degrade.row_chunked_tier(
+            big, "lineitem", *tpch.q1_row_chunked_fns(), limiter=limiter)
+        runner(512, None)  # pay the chunked-path compiles outside the clock
+        lags: list = []
+        for _ in range(3):
+            token = _resilience.CancelToken(50)
+            t0 = time.perf_counter()
+            try:
+                runner(512, token)
+            except _resilience.QueryCancelled:
+                pass
+            lags.append(
+                max(0.0, time.perf_counter() - t0 - 0.05) * 1e3)
+        lags.sort()
+        block["cancel_lag_ms_p50"] = round(lags[len(lags) // 2], 3)
+        block["cancel_lag_note"] = (
+            "ms past a 50ms deadline until the chunk-boundary checkpoint "
+            "stops a 32-chunk out-of-core q1; bounded by one chunk's work")
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    return block
+
+
 def _ledger_last(metric: str, n: int):
     """Most recent ledger record for ``metric`` under the current
     measurement tag — preferring an exact row-count match (throughput is
@@ -1419,7 +1574,8 @@ def _child_main(config: str, n: int, iters: int) -> None:
                       "pipeline": _pipeline_block(),
                       "fusion": _fusion_block(),
                       "resilience": _resilience_block(),
-                      "server": _server_block()}))
+                      "server": _server_block(),
+                      "degrade": _degrade_block()}))
 
 
 # ---------------------------------------------------------------------------
@@ -1460,9 +1616,10 @@ def _probe_tpu(timeout_s: float) -> tuple[bool, str]:
 def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float):
     """Run the bench in a subprocess; returns (value | None, diagnostic,
     dispatch block | None, pipeline block | None, fusion block | None,
-    server block | None) — the blocks come from the measured child
-    process's executable cache, overlap probe, whole-stage fusion probe,
-    and serving-concurrency probe."""
+    server block | None, degrade block | None) — the blocks come from the
+    measured child process's executable cache, overlap probe, whole-stage
+    fusion probe, serving-concurrency probe, and memory-pressure
+    degradation probe."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CONFIG"] = config
@@ -1480,7 +1637,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         )
     except subprocess.TimeoutExpired:
         return (None, f"{platform} bench timed out after {timeout_s:.0f}s",
-                None, None, None, None)
+                None, None, None, None, None)
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
@@ -1491,12 +1648,14 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         pipe = rec.get("pipeline") if isinstance(rec, dict) else None
         fus = rec.get("fusion") if isinstance(rec, dict) else None
         srv = rec.get("server") if isinstance(rec, dict) else None
+        deg = rec.get("degrade") if isinstance(rec, dict) else None
         return (value, "", disp if isinstance(disp, dict) else None,
                 pipe if isinstance(pipe, dict) else None,
                 fus if isinstance(fus, dict) else None,
-                srv if isinstance(srv, dict) else None)
+                srv if isinstance(srv, dict) else None,
+                deg if isinstance(deg, dict) else None)
     return (None, f"{platform} bench failed: {_tail(out)}",
-            None, None, None, None)
+            None, None, None, None, None)
 
 
 def main() -> None:
@@ -1517,6 +1676,7 @@ def main() -> None:
     child_pipe = None
     child_fus = None
     child_srv = None
+    child_deg = None
     # every run gets a telemetry file (children record through the package
     # via these env vars; the parent appends bench_stale events itself) —
     # restored afterwards so driving code / tests see their own env back
@@ -1555,7 +1715,7 @@ def main() -> None:
                 ok, why = _probe_tpu(20)
             if ok:
                 (value, why, child_disp, child_pipe, child_fus,
-                 child_srv) = _run_child(
+                 child_srv, child_deg) = _run_child(
                     config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
@@ -1597,7 +1757,7 @@ def main() -> None:
                 })
         if value is None:
             (value, why, child_disp, child_pipe, child_fus,
-             child_srv) = _run_child(
+             child_srv, child_deg) = _run_child(
                 config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
@@ -1649,6 +1809,10 @@ def main() -> None:
     # percentiles at 1/4/16 sessions), same child-process provenance;
     # empty when no live child ran (timeout / stale ledger record)
     record["server"] = child_srv or {}
+    # graceful-degradation probe (closed-loop queries/s + tier counts at
+    # 100/60/30% HBM budget, cooperative cancel lag), same child-process
+    # provenance; empty when no live child ran
+    record["degrade"] = child_deg or {}
     if diagnostics:
         record["diagnostic"] = "; ".join(d for d in diagnostics if d)
     print(json.dumps(record))
@@ -1699,7 +1863,7 @@ def sweep() -> None:
             if config in single_size else sizes
         cfg_timeout = 240.0 if config == "tpch_q1_pallas" else timeout
         for n in cfg_sizes:
-            value, why, _disp, _pipe, _fus, _srv = _run_child(
+            value, why, _disp, _pipe, _fus, _srv, _deg = _run_child(
                 config, n, iters, "tpu", cfg_timeout)
             line = {"config": config, "metric": metric, "n": n,
                     "value": value, "unit": unit, "device_kind": kind}

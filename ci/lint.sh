@@ -1,11 +1,12 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its twelve invariant rules (host/device
+# tpulint (tools/tpulint) runs its thirteen invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
-# error-must-classify, server-telemetry-session-id)
+# error-must-classify, server-telemetry-session-id,
+# reservation-release-in-finally)
 # over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
 # glob below covers the telemetry/ package alongside every other
 # subpackage.
@@ -196,4 +197,74 @@ with server.QueryServer(budget_bytes=1 << 28, max_inflight=2) as srv:
     assert stats["served"] == 2 and stats["failed"] == 1, stats
 print("server smoke OK: admit -> serve -> fault -> recover, "
       "bit-identical, 0 leaked bytes")
+EOF
+
+# degrade smoke: rule 13 only proves grants RELEASE on the unwind path —
+# this proves the degradation ladder itself still honors its contract:
+# injected pressure at the fused AND staged tiers steps a live query down
+# to out-of-core chunked execution, the answer is bit-identical to the
+# clean fused reference (valid rows; out-of-core trims the group-budget
+# padding), every step is visible in telemetry, and zero reserved bytes
+# leak from the limiter.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.runtime import degrade, faults, fusion, resilience
+from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+plan = tpch._q1_plan()
+bindings = {"lineitem": tpch.lineitem_table(300)}
+ref = fusion.execute(plan, bindings).table
+
+limiter = MemoryLimiter(1 << 26)
+runner = degrade.row_chunked_tier(
+    bindings, "lineitem", *tpch.q1_row_chunked_fns(), limiter=limiter)
+ctl = degrade.DegradationController(limiter, session="smoke")
+# distinct instances: the ladder re-raises the ORIGINAL object on
+# exhaustion, so one shared instance would read as exhaustion at step 2
+script = faults.FaultScript([
+    faults.FaultSpec("fusion.region",
+                     resilience.ResourceExhausted("injected pressure"),
+                     seq=0),   # kills fused
+    faults.FaultSpec("fusion.region",
+                     resilience.ResourceExhausted("injected pressure"),
+                     seq=1),   # kills staged
+])
+
+set_option("telemetry.enabled", True)
+set_option("degrade.chunk_rows", 128)
+try:
+    with faults.inject(script):
+        res = ctl.execute(degrade.DegradableQuery(
+            plan, bindings, outofcore=runner))
+finally:
+    reset_option("telemetry.enabled")
+    reset_option("degrade.chunk_rows")
+
+assert script.fired == [("fusion.region", 0), ("fusion.region", 1)], \
+    script.fired
+assert res.meta.get("degrade.chunk_rows") == 128, res.meta
+
+
+def valid_rows(t):
+    cols = [(np.asarray(t.column(i).valid_mask()),
+             np.asarray(t.column(i).data)) for i in range(t.num_columns)]
+    return [tuple((bool(v[r]), d[r].item() if v[r] else None)
+                  for v, d in cols)
+            for r in np.flatnonzero(cols[0][0])]
+
+
+assert valid_rows(res.table) == valid_rows(ref), \
+    "out-of-core answer diverged from the fused reference"
+steps = REGISTRY.counter("degrade.step").value
+assert steps == 2, f"expected 2 ladder steps, got {steps}"
+assert REGISTRY.counter("degrade.completed").value == 1
+assert REGISTRY.counter("degrade.tier.outofcore").value >= 1, \
+    "out-of-core rung never recorded"
+assert limiter.used == 0, f"leaked {limiter.used} reserved bytes"
+print(f"degrade smoke OK: fused -> staged -> outofcore bit-identical, "
+      f"{steps} steps, 0 leaked bytes")
 EOF

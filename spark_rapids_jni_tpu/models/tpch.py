@@ -440,6 +440,31 @@ def _q1_merge_plan() -> fusion.Plan:
         (0, 1), nulls_first=(False, False)))
 
 
+def q1_row_chunked_fns():
+    """The (partial_fn, merge_fn) pair for running q1 over IN-MEMORY row
+    chunks of a lineitem table — the algebra ``run_chunked_aggregate``
+    (and the degradation ladder's out-of-core rung, runtime/degrade.py)
+    consumes. Same plans as :func:`tpch_q1_outofcore`, minus the Parquet
+    retype: ``lineitem_table`` chunks already carry the decimal dtypes.
+    """
+    from spark_rapids_jni_tpu.ops.table_ops import trim_table
+
+    def partial_fn(chunk: Table) -> Table:
+        res = fusion.execute(_q1_partial_plan(), {"chunk": chunk},
+                             donate_inputs=True)
+        if bool(res.meta["partial.overflowed"]):
+            raise ValueError(
+                "q1 chunk exceeded the plan's group budget "
+                f"({_Q1_GROUP_BUDGET}): flag bytes outside the contract")
+        return trim_table(res.table, int(res.meta["partial.num_groups"]))
+
+    def merge_fn(partials: Table) -> Table:
+        # NOT donated: the SpillStore may still hold the partials buffer
+        return fusion.execute(_q1_merge_plan(), {"partials": partials}).table
+
+    return partial_fn, merge_fn
+
+
 def q1_distributed_step(local: Table):
     """Per-executor q1 step; must run inside shard_map over EXEC_AXIS.
 

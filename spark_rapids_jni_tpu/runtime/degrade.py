@@ -1,0 +1,309 @@
+"""Graceful degradation under memory pressure: the execution-tier ladder.
+
+The serving runtime (runtime/server.py) admits queries on an HBM estimate;
+a mis-estimated query used to either be rejected while HBM sat idle or die
+mid-flight once the retry/escalate budget (runtime/resilience.py) topped
+out. On a big shared-memory machine the scheduler must bend queries, not
+break them: the runtime already has three *bit-identical* execution tiers —
+the fused whole-stage path, the staged op-by-op oracle, and out-of-core
+chunked execution with chunk-level checkpoint/resume — and this module adds
+the controller that steps a live query down them when a classified
+``ResourceExhausted`` / ``CapacityOverflow`` escapes the retry budget:
+
+    rung 0  fused       one executable per region (the fast path)
+    rung 1  staged      op-by-op oracle — smaller peak (no whole-region
+                        intermediates resident at once), same bytes out
+    rung 2  outofcore   row-chunked partial->merge under the limiter, the
+                        chunk size HALVING on each further pressure failure
+                        (completed partials checkpoint in the SpillStore,
+                        so replay resumes — it never recomputes)
+    rung 3  parked      wait for the limiter to drain below its low
+                        watermark, then retry the most degraded tier
+
+Every step emits a ``degrade.step`` telemetry event (tier, trigger, rung)
+and fires the ``degrade.step`` fault seam, so chaos suites can script
+mid-degrade failures deterministically. Results are bit-identical at every
+tier — the ladder trades latency for survival, never correctness. A query
+that exhausts the ladder re-raises its ORIGINAL classified failure: no
+unclassified error ever leaves the controller.
+
+Deliberate stops are not failures: :class:`~.resilience.QueryCancelled`
+(deadline expiry or explicit cancel) passes straight through — a cancelled
+query must release and die, not climb down the ladder.
+
+``degrade.enabled=false`` restores the exact pre-degradation behavior:
+:meth:`DegradationController.execute` is then a plain ``fusion.execute``
+call and the first classified failure propagates verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+from spark_rapids_jni_tpu import telemetry
+from spark_rapids_jni_tpu.runtime import faults, fusion, resilience
+from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter, SpillStore
+from spark_rapids_jni_tpu.utils.config import get_option
+from spark_rapids_jni_tpu.utils.log import get_logger
+
+_log = get_logger(__name__)
+
+__all__ = [
+    "DegradableQuery",
+    "DegradationController",
+    "row_chunked_tier",
+]
+
+
+class DegradableQuery(NamedTuple):
+    """One query plus everything the ladder needs to re-execute it.
+
+    ``plan`` / ``bindings`` / ``donate_inputs`` are exactly what
+    ``fusion.execute`` takes (rungs 0 and 1 reuse them verbatim).
+    ``outofcore`` is the optional rung-2 runner — a callable
+    ``(chunk_rows, cancel_token) -> Table`` returning the bit-identical
+    final table computed chunk-wise under the budget; build one with
+    :func:`row_chunked_tier` for queries with a partial->merge
+    decomposition. Queries without it skip rung 2 (fused -> staged ->
+    parked)."""
+
+    plan: object
+    bindings: dict
+    donate_inputs: bool = False
+    outofcore: Optional[Callable[[int, object], object]] = None
+
+
+def _row_slice(table, start: int, stop: int):
+    """A row-range slice of a flat device table (the chunk source for the
+    out-of-core rung). Nested (children) columns and non-row-major string
+    payloads are not sliceable this way and raise — the caller then simply
+    has no rung-2 tier, it never gets a wrong one."""
+    from spark_rapids_jni_tpu.columnar import Column, Table
+
+    n = table.num_rows
+    cols = []
+    for c in table.columns:
+        if c.children:
+            raise ValueError(
+                "row_chunked_tier: nested (LIST/STRUCT) columns are not "
+                "row-sliceable")
+        data = c.data
+        if getattr(data, "ndim", 0) >= 1 and data.shape[0] == n:
+            data = data[start:stop]
+        validity = c.validity
+        if validity is not None:
+            validity = validity[start:stop]
+        chars = c.chars
+        if chars is not None:
+            if getattr(chars, "ndim", 0) >= 1 and chars.shape[0] == n:
+                chars = chars[start:stop]
+            else:
+                raise ValueError(
+                    "row_chunked_tier: string payload without a per-row "
+                    "leading dimension is not row-sliceable")
+        cols.append(Column(c.dtype, data, validity, chars=chars))
+    return Table(cols)
+
+
+def row_chunked_tier(
+    bindings: dict,
+    chunk_scan: str,
+    partial_fn: Callable,
+    merge_fn: Callable,
+    *,
+    limiter: MemoryLimiter,
+    spill_budget_bytes: Optional[int] = None,
+    spill_store: Optional[SpillStore] = None,
+) -> Callable[[int, object], object]:
+    """Build a rung-2 out-of-core runner from a partial->merge algebra.
+
+    ``bindings[chunk_scan]`` is the big table to stream in row chunks;
+    ``partial_fn(chunk_table) -> partial_table`` and
+    ``merge_fn(stacked_partials) -> final_table`` are the same shapes
+    ``run_chunked_aggregate`` takes (models/tpch.py q1's partial/merge
+    plans are the canonical pair). The returned callable runs the query
+    at a given ``chunk_rows`` under ``limiter`` with partials
+    checkpointed through a :class:`SpillStore` — chunk-level
+    checkpoint/resume (and the halving ladder above it) comes for free
+    from ``run_chunked_aggregate``.
+    """
+    from spark_rapids_jni_tpu.runtime.outofcore import run_chunked_aggregate
+
+    table = bindings[chunk_scan]
+
+    def run(chunk_rows: int, cancel_token=None):
+        n = int(table.num_rows)
+        rows = max(1, min(int(chunk_rows), n))
+        chunks = (_row_slice(table, s, min(s + rows, n))
+                  for s in range(0, n, rows))
+        # a caller-owned store (e.g. the serving runtime's, attached to
+        # the limiter for proactive pressure spills) is reused so the
+        # watermark reaction can see this query's checkpointed partials
+        spill = spill_store if spill_store is not None else SpillStore(
+            spill_budget_bytes if spill_budget_bytes is not None
+            else limiter.budget)
+        res = run_chunked_aggregate(
+            chunks, partial_fn, merge_fn, limiter=limiter, spill=spill,
+            cancel_token=cancel_token)
+        return res.table
+
+    return run
+
+
+def _pressure_kind(exc: BaseException) -> Optional[str]:
+    """The pressure-classified taxonomy name that makes ``exc`` a ladder
+    trigger, or None. Walks the ``__cause__`` chain so a
+    ``FatalExecutionError`` raised by an exhausted retry budget over a
+    ``CapacityOverflow`` still reads as pressure — the ladder is exactly
+    the "beyond the retry/escalate budget" recovery."""
+    seen: set = set()
+    e: Optional[BaseException] = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        kind = resilience.classify(e)
+        if kind is resilience.ResourceExhausted or issubclass(
+                kind, resilience.CapacityOverflow):
+            return kind.__name__
+        e = e.__cause__
+    return None
+
+
+class DegradationController:
+    """Steps a live query down the bit-identical tier ladder on pressure.
+
+    One controller per :class:`MemoryLimiter` (the serving runtime holds
+    one); :meth:`execute` runs a :class:`DegradableQuery` at the fused
+    tier and reacts to classified pressure failures by stepping down —
+    never sideways into global state: the staged rung forces the oracle
+    path per-call (``fusion.execute(force_staged=True)``), so concurrent
+    sessions at different rungs never perturb each other.
+    """
+
+    def __init__(self, limiter: MemoryLimiter, *, session: str = "") -> None:
+        self.limiter = limiter
+        self.session = str(session)
+
+    def execute(self, query: DegradableQuery, *, cancel_token=None,
+                label: Optional[str] = None):
+        """Run ``query``; returns a ``fusion.FusedResult``.
+
+        With ``degrade.enabled=false`` this is exactly
+        ``fusion.execute(plan, bindings, donate_inputs=...)`` — the
+        verbatim pre-degradation path. Otherwise classified
+        ``ResourceExhausted`` / ``CapacityOverflow`` failures step the
+        ladder (bounded by ``degrade.max_steps``); anything else — and
+        ``QueryCancelled`` always — re-raises immediately. Ladder
+        exhaustion re-raises the ORIGINAL classified failure.
+        """
+        op = label or f"degrade.{getattr(query.plan, 'name', 'query')}"
+        # session attribution rides as an extra field only when known —
+        # a None value would mask the ambient session_scope stamp
+        attrs = {"session": self.session} if self.session else {}
+
+        if not get_option("degrade.enabled"):
+            # the verbatim pre-degradation path, implicit staged
+            # fallback (runtime/fusion.py) included
+            return fusion.execute(
+                query.plan, query.bindings,
+                donate_inputs=query.donate_inputs,
+                cancel_token=cancel_token)
+
+        tiers = ["fused", "staged"]
+        if query.outofcore is not None:
+            tiers.append("outofcore")
+        tiers.append("parked")
+        max_steps = max(1, int(get_option("degrade.max_steps")))
+        park_timeout = float(get_option("degrade.park_timeout_s"))
+        chunk_rows = max(1, int(get_option("degrade.chunk_rows")))
+        rung = 0        # position in ``tiers``
+        steps = 0       # total downward steps taken (the telemetry ordinal)
+        original: Optional[BaseException] = None
+        trigger = "initial"
+
+        while True:
+            tier = tiers[min(rung, len(tiers) - 1)]
+            try:
+                if tier == "fused":
+                    # the controller owns the fused->staged transition
+                    # under pressure: surface those failures so the step
+                    # is visible (degrade.step) rather than silent;
+                    # non-pressure faults keep the PR-6 staged fallback
+                    result = fusion.execute(
+                        query.plan, query.bindings,
+                        donate_inputs=query.donate_inputs,
+                        surface_pressure=True,
+                        cancel_token=cancel_token)
+                elif tier == "staged":
+                    result = fusion.execute(
+                        query.plan, query.bindings,
+                        donate_inputs=query.donate_inputs,
+                        force_staged=True, cancel_token=cancel_token)
+                elif tier == "outofcore":
+                    table = query.outofcore(chunk_rows, cancel_token)
+                    result = fusion.FusedResult(
+                        table, {"degrade.chunk_rows": chunk_rows})
+                else:  # parked
+                    telemetry.record_degrade(
+                        op, "parked", tier="parked", trigger=trigger,
+                        rung=steps, **attrs)
+                    drained = self.limiter.wait_below_low(
+                        timeout=park_timeout,
+                        cancel=None if cancel_token is None
+                        else cancel_token.event)
+                    if cancel_token is not None:
+                        cancel_token.check("degrade.park")
+                    if not drained:
+                        telemetry.record_degrade(
+                            op, "exhausted", tier="parked", trigger=trigger,
+                            rung=steps, **attrs)
+                        raise original  # noqa: TRY301 — the classified cause
+                    telemetry.record_degrade(
+                        op, "resumed", tier="parked", trigger=trigger,
+                        rung=steps, **attrs)
+                    # retry the most degraded EXECUTABLE tier after drain
+                    rung = len(tiers) - 2
+                    continue
+            except resilience.QueryCancelled:
+                raise
+            except BaseException as exc:
+                if exc is original:
+                    # the parked rung re-raising ladder exhaustion
+                    raise
+                kind = _pressure_kind(exc)
+                if kind is None:
+                    raise
+                original = original or exc
+                steps += 1
+                if steps > max_steps:
+                    telemetry.record_degrade(
+                        op, "exhausted", tier=tier, trigger=kind,
+                        rung=steps, **attrs)
+                    raise original from exc
+                if tier == "outofcore" and chunk_rows > 1:
+                    # same rung, half the chunk — completed partials are
+                    # already checkpointed in the SpillStore, only the
+                    # remainder re-executes
+                    chunk_rows = max(chunk_rows // 2, 1)
+                else:
+                    rung += 1
+                next_tier = tiers[min(rung, len(tiers) - 1)]
+                trigger = kind
+                extra = dict(attrs)
+                if next_tier == "outofcore":
+                    extra["chunk_rows"] = chunk_rows
+                # seam BEFORE the step commits: chaos scripts inject
+                # mid-degrade faults here; an injected raise propagates
+                # (it is not itself degraded — one recovery at a time)
+                faults.fire("degrade.step", steps, tier=next_tier,
+                            trigger=kind, chunk_rows=chunk_rows)
+                telemetry.record_degrade(
+                    op, "step", tier=next_tier, trigger=kind, rung=steps,
+                    **extra)
+                _log.info("%s: %s -> %s after %s (step %d)", op, tier,
+                          next_tier, kind, steps)
+                continue
+            if steps > 0:
+                telemetry.record_degrade(
+                    op, "completed", tier=tier, trigger=trigger, rung=steps,
+                    **attrs)
+            return result

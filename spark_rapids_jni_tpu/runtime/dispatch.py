@@ -277,6 +277,35 @@ def _signature(tree: Any) -> tuple:
 # ---------------------------------------------------------------------------
 
 
+def _refresh_cache_index(cache_dir: str) -> None:
+    """Maintain the repo-owned ``index.json`` beside JAX's persistent
+    cache entries: which jax version wrote them and how many processes
+    have wired the directory. Written crash-safely (tmp + ``os.replace``
+    + fsync, utils/atomic_io.py); a corrupt/truncated index from an
+    earlier crash is DISCARDED with a telemetry event — warm start then
+    costs one re-count, never a crash or a poisoned cache."""
+    from spark_rapids_jni_tpu.telemetry.events import record_degrade
+    from spark_rapids_jni_tpu.utils.atomic_io import (
+        atomic_write_json,
+        load_json,
+    )
+
+    index_path = os.path.join(cache_dir, "index.json")
+    index, corrupt = load_json(index_path)
+    if corrupt is not None:
+        REGISTRY.counter("dispatch.persistent_cache_index_discarded").inc()
+        record_degrade("dispatch.persistent_cache", "state_discarded",
+                       tier="persistent", trigger="corrupt",
+                       rung=0, path=index_path, reason=corrupt)
+        index = None
+    if not isinstance(index, dict):
+        index = {}
+    index["version"] = 1
+    index["jax"] = str(jax.__version__)
+    index["wired"] = int(index.get("wired", 0)) + 1
+    atomic_write_json(index_path, index)
+
+
 def _init_persistent_cache() -> None:
     """Wire JAX's cross-process compilation cache (idempotent). The short
     env var wins over the config option; thresholds are dropped to zero so
@@ -292,6 +321,7 @@ def _init_persistent_cache() -> None:
         return
     try:
         os.makedirs(cache_dir, exist_ok=True)
+        _refresh_cache_index(cache_dir)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         for opt, val in (
                 ("jax_persistent_cache_min_compile_time_secs", 0.0),

@@ -61,6 +61,12 @@ SEAMS: Tuple[str, ...] = (
     # multi-query serving runtime (runtime/server.py)
     "server.admit",
     "server.execute",
+    # cooperative cancellation checkpoints (runtime/server.py, degrade.py)
+    "server.cancel",
+    # graceful-degradation ladder steps (runtime/degrade.py)
+    "degrade.step",
+    # watermark crossings on the memory limiter (runtime/memory.py)
+    "memory.pressure",
 )
 
 _SEAM_SET = frozenset(SEAMS)

@@ -585,7 +585,10 @@ def _slice_to(out, n: int):
 
 
 def execute(plan: Plan, bindings: dict, *,
-            donate_inputs: bool = False) -> FusedResult:
+            donate_inputs: bool = False,
+            force_staged: bool = False,
+            surface_pressure: bool = False,
+            cancel_token=None) -> FusedResult:
     """Run one fusible region.
 
     ``bindings`` maps every Scan name to a Table. With ``fusion.enabled``
@@ -600,7 +603,24 @@ def execute(plan: Plan, bindings: dict, *,
     ``donate_inputs=True`` declares every bound table dead after the call
     (intermediates the caller owns — never user-visible inputs); see the
     module docstring.
+
+    ``force_staged=True`` takes the staged reference path for THIS call
+    regardless of the global ``fusion.enabled`` option — the per-query
+    knob the degradation ladder (runtime/degrade.py) steps a live query
+    down on without flipping global state under concurrent sessions.
+    ``surface_pressure=True`` lets PRESSURE-classified failures
+    (``ResourceExhausted`` / ``CapacityOverflow``) that exhaust the retry
+    budget propagate instead of silently taking the implicit staged
+    fallback, so the degradation controller can take — and account for —
+    the fused->staged step itself. Non-pressure failures keep the
+    fallback either way.
+
+    ``cancel_token`` (a ``resilience.CancelToken``) is checked at the
+    region boundary before any compute or donation happens; cancellation
+    raises ``QueryCancelled`` with the bound inputs untouched.
     """
+    if cancel_token is not None:
+        cancel_token.check(f"fusion.{plan.name}")
     nodes = _topo(plan.root)
     bucketed, exact = _scan_names(nodes)
     for name in bucketed + exact:
@@ -620,7 +640,12 @@ def execute(plan: Plan, bindings: dict, *,
 
     def _staged_eval() -> FusedResult:
         # the staged reference path (the bit-identity oracle): the same
-        # node walk op-by-op, each op dispatching itself
+        # node walk op-by-op, each op dispatching itself. The region seam
+        # fires here too (seq=1; the fused attempt is seq=0) so chaos
+        # scripts can kill each tier independently — per-op dispatch
+        # failures below never propagate (dispatch falls back to the
+        # host inline path), so this is the staged tier's one seam
+        faults.fire("fusion.region", 1, plan=plan.name, staged=True)
         REGISTRY.counter("fusion.staged_regions").inc()
         tables = {name: bindings[name] for name in bucketed + exact}
         rvs = {name: None for name in tables}
@@ -630,7 +655,7 @@ def execute(plan: Plan, bindings: dict, *,
         meta.update(static_meta)
         return FusedResult(value, meta)
 
-    if not get_option("fusion.enabled"):
+    if force_staged or not get_option("fusion.enabled"):
         return _staged_eval()
 
     from spark_rapids_jni_tpu.runtime import dispatch
@@ -672,6 +697,15 @@ def execute(plan: Plan, bindings: dict, *,
         if exc is not None:
             if not isinstance(exc, Exception):
                 raise exc
+            if surface_pressure:
+                # the degradation controller owns tier transitions under
+                # memory pressure: let the classified failure surface so
+                # the step is taken — and accounted — at the ladder, not
+                # silently here; anything else still falls back below
+                kind = resilience.classify(exc)
+                if kind is resilience.ResourceExhausted or issubclass(
+                        kind, resilience.CapacityOverflow):
+                    raise exc
             # final ladder rung: run the region through the staged
             # evaluator (bit-identical) and account for it
             record_fallback(

@@ -74,14 +74,33 @@ class MemoryLimitExceeded(MemoryError):
 class MemoryLimiter:
     """Soft budget gate with capped-pool semantics: ``reserve`` beyond the
     budget raises (fail-fast, like a capped RMM pool) instead of letting a
-    giant batch OOM the device mid-kernel."""
+    giant batch OOM the device mid-kernel.
 
-    def __init__(self, budget_bytes: int):
+    Pressure watermarks (``memory.high_watermark`` / ``memory.low_watermark``
+    fractions of the budget, overridable per instance): a grant that lifts
+    usage across the high watermark enters the *pressure* state — the
+    ``memory.pressure`` fault seam fires, a ``degrade.pressure`` telemetry
+    event is emitted, the coldest entries of an attached :class:`SpillStore`
+    are proactively spilled, and ``reserve_blocking(..., admission=True)``
+    callers (the serving runtime's admission gate) park until usage drains
+    back below the low watermark. Non-admission reservations (pipeline
+    chunks of already-running queries) are never paused, so in-flight work
+    keeps draining toward the low watermark instead of deadlocking.
+    """
+
+    def __init__(self, budget_bytes: int, *,
+                 high_watermark: "float | None" = None,
+                 low_watermark: "float | None" = None):
         if budget_bytes <= 0:
             raise ValueError("budget must be positive")
         self.budget = int(budget_bytes)
         self._used = 0
         self._peak = 0
+        self._high_frac = None if high_watermark is None else float(high_watermark)
+        self._low_frac = None if low_watermark is None else float(low_watermark)
+        self._pressure = False
+        self._pressure_crossings = 0
+        self._spill_store: "SpillStore | None" = None
         # a Condition so reserve_blocking can sleep until release() frees
         # budget; plain reserve/release take the same underlying lock
         self._lock = threading.Condition()
@@ -98,6 +117,79 @@ class MemoryLimiter:
     def peak(self) -> int:
         return self._peak
 
+    @property
+    def pressure(self) -> bool:
+        """True between a high-watermark crossing and the drain below low."""
+        return self._pressure
+
+    @property
+    def pressure_crossings(self) -> int:
+        """How many times usage has crossed the high watermark (the seq the
+        ``memory.pressure`` fault seam fires with — lets a FaultScript
+        target the Nth crossing deterministically)."""
+        return self._pressure_crossings
+
+    def attach_spill_store(self, store: "SpillStore | None") -> None:
+        """Register the SpillStore whose coldest entries a high-watermark
+        crossing proactively spills (None detaches)."""
+        self._spill_store = store
+
+    def _high_bytes(self) -> int:
+        frac = self._high_frac
+        if frac is None:
+            frac = float(get_option("memory.high_watermark"))
+        return int(self.budget * frac)
+
+    def _low_bytes(self) -> int:
+        frac = self._low_frac
+        if frac is None:
+            frac = float(get_option("memory.low_watermark"))
+        # a misconfigured low > high would make pressure un-clearable the
+        # moment it is entered; clamp instead of wedging admission
+        return min(int(self.budget * frac), self._high_bytes())
+
+    def _note_grant_locked(self) -> bool:
+        """Called under the lock after ``_used`` grew; returns True exactly
+        when this grant crossed the high watermark (caller reacts outside
+        the lock — the pressure reaction spills and fires fault seams)."""
+        # doubly gated: on degrade.enabled (with degradation off the
+        # limiter is byte-for-byte the pre-watermark accounting — the PR-7
+        # parity contract) AND on an attached spill store — watermarks are
+        # a managed-limiter feature (the serving runtime attaches its
+        # store); a bare limiter shared with external holders would
+        # otherwise park admission on pressure nothing can ever drain
+        if (not self._pressure and self._spill_store is not None
+                and self._used >= self._high_bytes()
+                and get_option("degrade.enabled")):
+            self._pressure = True
+            self._pressure_crossings += 1
+            return True
+        return False
+
+    def _enter_pressure(self) -> None:
+        """React to a high-watermark crossing: fault seam, telemetry,
+        proactive spill of the attached store's coldest entries. Runs
+        OUTSIDE the lock; an injected ``memory.pressure`` fault propagates
+        to the reserving caller (which rolls back its grant)."""
+        faults.fire("memory.pressure", self._pressure_crossings,
+                    used=self._used, budget=self.budget,
+                    watermark=self._high_bytes())
+        freed = 0
+        store = self._spill_store
+        if store is not None:
+            # ambition: drain resident spill-store bytes by as much as the
+            # limiter is above its low watermark, coldest entries first
+            target = max(self._used - self._low_bytes(), 1)
+            freed = store.spill_coldest(target)
+        telemetry.record_degrade(
+            "memory_limiter", "pressure", tier="high", trigger="watermark",
+            rung=0, used=self._used, budget=self.budget,
+            proactive_spill_bytes=freed)
+        if get_option("memory.log_level") >= 1:
+            _log.info("memory pressure: %d/%d in use (high watermark %d), "
+                      "proactively spilled %d bytes", self._used, self.budget,
+                      self._high_bytes(), freed)
+
     def reserve(self, nbytes: int) -> None:
         # fault seam BEFORE the lock: an injected reservation failure must
         # leave the accounting untouched, like a real allocator rejection
@@ -110,11 +202,21 @@ class MemoryLimiter:
                 )
             self._used += nbytes
             self._peak = max(self._peak, self._used)
+            crossed = self._note_grant_locked()
             if get_option("memory.log_level") >= 2:
                 _log.info("reserve %d bytes (%d in use)", nbytes, self._used)
+        if crossed:
+            try:
+                self._enter_pressure()
+            except BaseException:
+                # an injected pressure fault must not leak the grant it
+                # was reacting to
+                self.release(nbytes)
+                raise
 
     def reserve_blocking(self, nbytes: int, cancel=None,
-                         timeout: "float | None" = None) -> bool:
+                         timeout: "float | None" = None,
+                         admission: bool = False) -> bool:
         """Wait until ``nbytes`` fits inside the budget, then reserve it.
 
         The pipeline's backpressure primitive: where ``reserve`` fails
@@ -132,6 +234,13 @@ class MemoryLimiter:
         later (even smaller) request never barges past an earlier blocked
         one. A plain ``reserve`` keeps its fail-fast semantics and does
         not queue.
+
+        ``admission=True`` marks this reservation as a NEW unit of work
+        (the serving runtime's admission gate): while the limiter is in
+        the pressure state, admission reservations park until usage
+        drains below the low watermark even if the bytes would fit.
+        Plain reservations (chunks of already-admitted queries) ignore
+        pressure so in-flight work keeps draining.
         """
         faults.fire("memory.reserve", nbytes, blocking=True)
         if nbytes > self.budget:
@@ -148,7 +257,8 @@ class MemoryLimiter:
                 # blocked earlier ticket holds back every later one, which
                 # is exactly the no-barge property
                 while (self._waiters[0] is not ticket
-                       or self._used + nbytes > self.budget):
+                       or self._used + nbytes > self.budget
+                       or (admission and self._pressure)):
                     if cancel is not None and cancel.is_set():
                         return False
                     wait = 0.05
@@ -160,6 +270,7 @@ class MemoryLimiter:
                     self._lock.wait(wait)
                 self._used += nbytes
                 self._peak = max(self._peak, self._used)
+                crossed = self._note_grant_locked()
                 if get_option("memory.log_level") >= 2:
                     _log.info(
                         "reserve %d bytes (%d in use)", nbytes, self._used)
@@ -168,14 +279,48 @@ class MemoryLimiter:
                 # unblocks the next ticket in line
                 self._waiters.remove(ticket)
                 self._lock.notify_all()
+        if crossed:
+            try:
+                self._enter_pressure()
+            except BaseException:
+                self.release(nbytes)
+                raise
+        return True
+
+    def wait_below_low(self, timeout: "float | None" = None,
+                       cancel=None) -> bool:
+        """Park until usage drains below the low watermark — the
+        park-and-retry ladder rung's drain wait (runtime/degrade.py).
+        Returns True once drained, False if ``cancel`` (anything with
+        ``is_set()``) fired or ``timeout`` seconds elapsed first;
+        cancellation is polled (~50ms), same as ``reserve_blocking``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self._used > self._low_bytes():
+                if cancel is not None and cancel.is_set():
+                    return False
+                wait = 0.05
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    wait = min(wait, remaining)
+                self._lock.wait(wait)
         return True
 
     def release(self, nbytes: int) -> None:
         with self._lock:
             self._used = max(self._used - nbytes, 0)
+            cleared = self._pressure and self._used <= self._low_bytes()
+            if cleared:
+                self._pressure = False
             self._lock.notify_all()
             if get_option("memory.log_level") >= 2:
                 _log.info("release %d bytes (%d in use)", nbytes, self._used)
+        if cleared:
+            telemetry.record_degrade(
+                "memory_limiter", "pressure", tier="low", trigger="watermark",
+                rung=0, used=self._used, budget=self.budget)
 
     def __enter__(self):
         return self
@@ -183,6 +328,7 @@ class MemoryLimiter:
     def __exit__(self, *exc):
         with self._lock:
             self._used = 0
+            self._pressure = False
             self._lock.notify_all()
         return False
 
@@ -408,36 +554,66 @@ class SpillStore:
         with self._lock:
             return self._device_bytes_locked()
 
+    def _coldest_device_locked(self) -> "int | None":
+        """Handle of the least-recently-used resident entry, or None."""
+        candidates = [
+            (e["tick"], eid) for eid, e in self._entries.items()
+            if e["state"] == "device"
+        ]
+        if not candidates:
+            return None
+        _, eid = min(candidates)
+        return eid
+
+    def _spill_entry_locked(self, eid: int, reason: str) -> int:
+        """Spill one resident entry to host; returns its device bytes."""
+        e = self._entries[eid]
+        # fire before mutating the entry: an injected spill-IO failure
+        # must leave the victim resident and the store consistent
+        faults.fire("spill.spill", eid, nbytes=e["nbytes"])
+        e["host_cols"] = [
+            _col_to_host(c, self._cctx) for c in e["table"].columns]
+        e["table"] = None  # drop the device arrays -> XLA frees HBM
+        e["state"] = "host"
+        self.spill_count += 1
+        self.spilled_bytes += e["nbytes"]
+        telemetry.record_spill(
+            "spill_store", reason,
+            bytes_moved=e["nbytes"], direction="device_to_host")
+        if get_option("memory.log_level") >= 1:
+            _log.info("spill table %d (%d bytes) to host", eid,
+                      e["nbytes"])
+        return e["nbytes"]
+
     def _spill_lru_locked(self, need: int) -> None:
         """Spill least-recently-used device entries until ``need`` fits."""
         while self._device_bytes_locked() + need > self.budget:
-            candidates = [
-                (e["tick"], eid) for eid, e in self._entries.items()
-                if e["state"] == "device"
-            ]
-            if not candidates:
+            eid = self._coldest_device_locked()
+            if eid is None:
                 raise MemoryLimitExceeded(
                     f"table of {need} bytes exceeds the spill budget "
                     f"({self.budget}) even with everything spilled"
                 )
-            _, eid = min(candidates)
-            e = self._entries[eid]
-            # fire before mutating the entry: an injected spill-IO failure
-            # must leave the victim resident and the store consistent
-            faults.fire("spill.spill", eid, nbytes=e["nbytes"])
-            e["host_cols"] = [
-                _col_to_host(c, self._cctx) for c in e["table"].columns]
-            e["table"] = None  # drop the device arrays -> XLA frees HBM
-            e["state"] = "host"
-            self.spill_count += 1
-            self.spilled_bytes += e["nbytes"]
-            telemetry.record_spill(
-                "spill_store",
-                "device spill budget exceeded: LRU eviction to host",
-                bytes_moved=e["nbytes"], direction="device_to_host")
-            if get_option("memory.log_level") >= 1:
-                _log.info("spill table %d (%d bytes) to host", eid,
-                          e["nbytes"])
+            self._spill_entry_locked(
+                eid, "device spill budget exceeded: LRU eviction to host")
+
+    def spill_coldest(self, nbytes: int) -> int:
+        """Proactively spill coldest-first resident entries until at least
+        ``nbytes`` device bytes are freed (or nothing is left resident).
+
+        The memory-pressure valve: a :class:`MemoryLimiter` crossing its
+        high watermark calls this on its attached store so HBM held by
+        idle inter-operator working sets drains before new admissions
+        resume. Returns the bytes actually freed."""
+        freed = 0
+        with self._lock:
+            while freed < nbytes:
+                eid = self._coldest_device_locked()
+                if eid is None:
+                    break
+                freed += self._spill_entry_locked(
+                    eid, "memory pressure: proactive spill of coldest entry")
+        return freed
 
     def put(self, table) -> int:
         """Register a device table; returns its handle. May spill others."""

@@ -146,6 +146,7 @@ def run_chunked_aggregate(
     spill_budget_bytes: int | None = None,
     prefetch_depth: int = 0,
     pipeline: bool | None = None,
+    cancel_token=None,
 ) -> OutOfCoreResult:
     """Stream an aggregation over table chunks under a memory budget.
 
@@ -179,6 +180,13 @@ def run_chunked_aggregate(
     identical to the distributed two-phase aggregation
     (models/tpch.py q1_distributed_step), which is what makes the same
     query plan work over chunks, devices, or both.
+
+    ``cancel_token`` (a ``resilience.CancelToken``) is checked at every
+    chunk boundary, before each partial restore and before the merge —
+    plus inside the pipeline decode pool when pipelined. Cancellation or
+    deadline expiry raises ``QueryCancelled`` through the same release
+    paths as any failure, leaving zero reservations behind; it is never
+    retried or resumed (deliberate stops are not transient faults).
     """
     from spark_rapids_jni_tpu.ops.table_ops import concatenate
     from spark_rapids_jni_tpu.runtime import pipeline as pl
@@ -216,7 +224,8 @@ def run_chunked_aggregate(
             src = sources[nchunks:] if pol.enabled else sources
             return pl.pipeline_chunks(
                 src, limiter=limiter,
-                depth=prefetch_depth if prefetch_depth > 0 else None)
+                depth=prefetch_depth if prefetch_depth > 0 else None,
+                cancel_token=cancel_token)
         if prefetch_depth > 0:
             return prefetch_chunks(chunks, prefetch_depth, limiter)
         return chunks
@@ -250,6 +259,12 @@ def run_chunked_aggregate(
             for chunk in stream:
                 nb = _table_nbytes(chunk)
                 try:
+                    if cancel_token is not None:
+                        # chunk-boundary checkpoint: the raise unwinds
+                        # through this try's finally (releasing the
+                        # producer-owned reservation) and the stream's
+                        # close below — zero leaked budget
+                        cancel_token.check("outofcore.chunk")
                     if pol.enabled:
                         handles.append(resilience.retrying(
                             "run_chunked_aggregate",
@@ -321,6 +336,8 @@ def run_chunked_aggregate(
     partial_bytes = 0
     try:
         for h in handles:
+            if cancel_token is not None:
+                cancel_token.check("outofcore.restore")
             # reserve BEFORE staging: a partial set that exceeds the
             # budget must raise before its bytes are device-resident
             # (get_reserved orders the reservation ahead of the
@@ -355,6 +372,8 @@ def run_chunked_aggregate(
         limiter.release(partial_bytes)
         raise
     def _merge():
+        if cancel_token is not None:
+            cancel_token.check("outofcore.merge")
         faults.fire("outofcore.merge", nchunks)
         if use_pipeline:
             pl._maybe_fault("merge", nchunks)

@@ -178,6 +178,7 @@ def pipeline_chunks(
     depth: int | None = None,
     decode_threads: int | None = None,
     pool: ThreadPoolExecutor | None = None,
+    cancel_token=None,
 ) -> Iterator:
     """Run chunk sources through the async pipeline; yield device Tables
     in source order.
@@ -207,6 +208,14 @@ def pipeline_chunks(
     decode threads instead of oversubscribing the host N ways); a lent
     pool is never shut down here — cleanup waits on this run's own
     futures only.
+
+    ``cancel_token`` (a ``resilience.CancelToken``) makes the run
+    cooperatively cancellable: the token is checked inside the decode
+    pool before each chunk decodes and at each delivery, and a blocked
+    admission wakes when the token fires. Cancellation (or deadline
+    expiry) raises ``QueryCancelled`` to the consumer through the same
+    cleanup path as any stage failure, so every undelivered reservation
+    is released in the generator's ``finally``.
     """
     depth = configured_prefetch_depth() if depth is None \
         else max(int(depth), 1)
@@ -216,6 +225,17 @@ def pipeline_chunks(
     reg = telemetry.REGISTRY
     reg.counter("pipeline.runs").inc()
     cancel = threading.Event()
+
+    class _either_cancel:
+        """Duck-typed Event for reserve_blocking: set when the pipeline's
+        internal cancel OR the caller's cancel token fired (cancelled()
+        also latches deadline expiry, so a blocked admission wakes on it)."""
+
+        @staticmethod
+        def is_set() -> bool:
+            return cancel.is_set() or (
+                cancel_token is not None and cancel_token.cancelled())
+
     out_q: "queue.Queue" = queue.Queue(maxsize=depth)
     # admission turnstile: the next sequence number allowed to reserve
     admit = threading.Condition()
@@ -232,13 +252,13 @@ def pipeline_chunks(
         t0 = time.perf_counter()
         with admit:
             while admit_seq[0] != seq:
-                if cancel.is_set():
+                if _either_cancel.is_set():
                     return False
                 admit.wait(0.05)
         ok = True
         try:
             if limiter is not None:
-                ok = limiter.reserve_blocking(nbytes, cancel=cancel)
+                ok = limiter.reserve_blocking(nbytes, cancel=_either_cancel)
         finally:
             # advance even on failure/cancel so later workers see the
             # cancel flag instead of waiting on a dead turn
@@ -255,6 +275,10 @@ def pipeline_chunks(
         passes to whoever consumes the future."""
         if cancel.is_set():
             raise _Cancelled()
+        if cancel_token is not None:
+            # the decode-pool checkpoint: a cancelled/expired query stops
+            # before decoding its next chunk, not after
+            cancel_token.check("pipeline.decode")
         _maybe_fault("decode", seq)
         t0 = time.perf_counter()
         with trace_range("pipeline.decode"):
@@ -265,6 +289,10 @@ def pipeline_chunks(
         _maybe_fault("staging", seq)
         with trace_range("pipeline.staging"):
             if not _admission(seq, nb):
+                if cancel_token is not None and cancel_token.cancelled():
+                    # surface the classified QueryCancelled, not the
+                    # internal teardown marker
+                    cancel_token.check("pipeline.staging")
                 raise _Cancelled()
         held = nb if limiter is not None else 0
         try:
@@ -315,7 +343,7 @@ def pipeline_chunks(
         try:
             seq = 0
             for src in sources:
-                if cancel.is_set():
+                if _either_cancel.is_set():
                     return
                 fut = pool.submit(_work, seq, src)
                 submitted.append(fut)
@@ -340,6 +368,10 @@ def pipeline_chunks(
                 raise payload
             if kind == "end":
                 break
+            if cancel_token is not None:
+                # delivery checkpoint: raising BEFORE result() leaves the
+                # future's reservation to the finally-drain below
+                cancel_token.check("pipeline.deliver")
             table, nb = payload.result()  # raises the worker's exception
             reg.counter("pipeline.consumer_stall_us").inc(
                 _us(time.perf_counter() - t0))

@@ -38,10 +38,12 @@ host-only code.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Optional, Tuple, TypeVar
 
 from spark_rapids_jni_tpu import telemetry
+from spark_rapids_jni_tpu.runtime import faults
 from spark_rapids_jni_tpu.utils.config import get_option
 
 __all__ = [
@@ -51,6 +53,8 @@ __all__ = [
     "ResourceExhausted",
     "TransportError",
     "FatalExecutionError",
+    "QueryCancelled",
+    "CancelToken",
     "Policy",
     "policy",
     "enabled",
@@ -123,6 +127,76 @@ class FatalExecutionError(ResilienceError):
     """Classified dead end: retries exhausted or failure is unrecoverable."""
 
     transient = False
+
+
+class QueryCancelled(ResilienceError):
+    """The query was cancelled cooperatively — deadline expiry or an
+    explicit caller cancel. Deliberate, so never retried, never degraded:
+    the recovery is releasing everything the query held (reservations,
+    queue slots) in the same ``finally`` that would have released them on
+    success."""
+
+    transient = False
+
+
+class CancelToken:
+    """Cooperative cancellation + wall-clock deadline for one query.
+
+    Checked — never preempted — at the boundaries where a query can stop
+    cleanly: fused-region dispatch, out-of-core chunk/merge boundaries, and
+    inside the pipeline decode pool. ``check(where)`` raises
+    :class:`QueryCancelled` once the token is cancelled or its deadline has
+    passed; the raise unwinds through the same ``finally`` blocks that
+    release reservations and queue slots on success, so cancellation can
+    never leak budget.
+
+    ``event`` is a plain ``threading.Event`` set on cancellation, shaped to
+    slot directly into ``MemoryLimiter.reserve_blocking(cancel=...)`` and
+    the pipeline's cancel plumbing so a *blocked* reservation wakes within
+    its poll interval instead of waiting out the budget.
+
+    Every ``check`` fires the ``server.cancel`` fault seam (seq = check
+    ordinal), so a FaultScript can inject failures at exact cancellation
+    checkpoints deterministically.
+    """
+
+    def __init__(self, deadline_ms: int = 0, *, label: str = "query") -> None:
+        self.label = str(label)
+        self.event = threading.Event()
+        self.reason: Optional[str] = None
+        self._deadline = (
+            None if not deadline_ms
+            else time.monotonic() + float(deadline_ms) / 1000.0)
+        self._deadline_ms = int(deadline_ms or 0)
+        self._checks = 0
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        """Request cancellation; idempotent (the first reason wins)."""
+        if not self.event.is_set():
+            self.reason = str(reason)
+            self.event.set()
+
+    def expired(self) -> bool:
+        return self._deadline is not None and time.monotonic() >= self._deadline
+
+    def cancelled(self) -> bool:
+        """True once cancelled or past deadline (latches deadline expiry)."""
+        if self.event.is_set():
+            return True
+        if self.expired():
+            self.cancel(f"deadline of {self._deadline_ms}ms expired")
+            return True
+        return False
+
+    def check(self, where: str = "") -> None:
+        """Raise :class:`QueryCancelled` if cancellation was requested."""
+        self._checks += 1
+        faults.fire("server.cancel", self._checks, where=where,
+                    label=self.label)
+        if self.cancelled():
+            raise QueryCancelled(
+                f"{self.label}: cancelled at {where or 'checkpoint'}",
+                reason=self.reason or "cancelled", where=where or "checkpoint")
 
 
 # Message markers XLA/jaxlib use for genuinely transient device conditions.

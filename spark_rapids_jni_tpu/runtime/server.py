@@ -32,31 +32,63 @@ Contracts, in order of importance:
 * **No leaks** — a query that dies, however it dies, releases its
   reservation and its in-flight slot; the failure is classified through
   ``resilience.classify`` and recorded before the ticket resolves.
+* **Bend, don't break** — classified pressure failures
+  (``ResourceExhausted`` / ``CapacityOverflow`` beyond the retry budget)
+  step the query down the bit-identical execution-tier ladder
+  (``runtime/degrade.py``: fused -> staged -> out-of-core -> park) instead
+  of killing it; the limiter's high watermark proactively spills the
+  server's coldest SpillStore entries and pauses NEW admissions (in-flight
+  queries keep draining) until usage falls below the low watermark.
+* **Deadlines are cooperative** — ``server.deadline_ms`` (or a per-submit
+  ``deadline_ms``) arms a ``CancelToken`` checked at region/chunk
+  boundaries and inside the pipeline decode pool; expiry (or an explicit
+  ``ticket.cancel()``) resolves the ticket ``cancelled`` with the
+  classified ``QueryCancelled``, releasing reservation and queue slot in
+  the same ``finally`` as every other exit.
+* **Admission learns** — after each served query the measured working set
+  (input + result device bytes) is blended (EMA, ``server.estimate_alpha``)
+  into a per-plan-signature estimate that replaces the static
+  ``fusion.estimate_hbm_bytes`` base for future submits, persisted
+  crash-safely beside the dispatch persistent cache
+  (``server.estimate_path``), so a fresh process admits from measured
+  truth.
 
 Config knobs (utils/config.py, env ``SPARK_RAPIDS_TPU_SERVER_*``):
 ``server.max_inflight``, ``server.hbm_budget_bytes``,
 ``server.admission_timeout_s``, ``server.queue_depth``,
-``server.estimate_headroom``.
+``server.estimate_headroom``, ``server.deadline_ms``,
+``server.estimate_alpha``, ``server.estimate_path``; the ladder's own
+knobs are ``degrade.*`` (utils/config.py).
 """
 
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
-from spark_rapids_jni_tpu.runtime import faults, fusion, pipeline, resilience
+from spark_rapids_jni_tpu.runtime import (
+    degrade,
+    faults,
+    fusion,
+    pipeline,
+    resilience,
+)
 from spark_rapids_jni_tpu.runtime.memory import (
     HostTableChunk,
     MemoryLimiter,
+    SpillStore,
     _table_nbytes,
 )
 from spark_rapids_jni_tpu.telemetry.events import (
     events as _ring_events,
+    record_degrade,
     record_server,
     session_scope,
 )
+from spark_rapids_jni_tpu.utils.atomic_io import atomic_write_json, load_json
 from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
 from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.log import get_logger
@@ -68,22 +100,55 @@ _log = get_logger("spark_rapids_jni_tpu.server")
 
 class QueryRejected(RuntimeError):
     """Admission control refused the query: estimate over the whole
-    budget, session queue full, admission timeout, or server shutdown."""
+    budget, session queue full, admission timeout, or server shutdown.
+
+    Structured context rides on the exception so clients can react
+    programmatically instead of parsing the message: ``session``,
+    ``reason``, ``queue_depth`` (entries waiting in the session's queue
+    at rejection), ``bytes_requested`` vs ``bytes_available`` (the
+    limiter's free bytes at rejection), and ``retry_after_s`` — the
+    server's backoff suggestion (``None`` means retrying can never
+    succeed, e.g. an estimate larger than the whole budget)."""
+
+    def __init__(self, message: str, *,
+                 session: str = "",
+                 reason: str = "",
+                 queue_depth: int = 0,
+                 bytes_requested: int = 0,
+                 bytes_available: int = 0,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.session = session
+        self.reason = reason
+        self.queue_depth = int(queue_depth)
+        self.bytes_requested = int(bytes_requested)
+        self.bytes_available = int(bytes_available)
+        self.retry_after_s = retry_after_s
 
 
 class QueryTicket:
     """One submitted query's future. Resolves to the plan's
-    ``FusedResult`` (``result()``), a raised ``QueryRejected``, or the
-    classified execution error. ``status`` walks
-    queued -> admitted -> served | rejected | failed."""
+    ``FusedResult`` (``result()``), a raised ``QueryRejected``, the
+    classified ``QueryCancelled`` (deadline expiry or ``cancel()``), or
+    the classified execution error. ``status`` walks
+    queued -> admitted -> served | rejected | cancelled | failed."""
 
     def __init__(self, session_id: str, plan: fusion.Plan, bindings: dict,
-                 estimate: int, donate_inputs: bool):
+                 estimate: int, donate_inputs: bool,
+                 deadline_ms: int = 0,
+                 outofcore: Optional[Callable] = None):
         self.session = session_id
         self.plan = plan
         self.bindings = bindings
         self.estimate = int(estimate)
         self.donate_inputs = bool(donate_inputs)
+        self.outofcore = outofcore
+        # the deadline clock starts at SUBMIT: queue wait counts against
+        # it, so a query stuck behind a backlog cancels instead of running
+        # pointlessly after its client gave up
+        self.deadline_ms = int(deadline_ms)
+        self.cancel_token = resilience.CancelToken(
+            self.deadline_ms, label=f"{plan.name}/{session_id}")
         self.status = "queued"
         self.queue_wait_s: Optional[float] = None
         self.latency_s: Optional[float] = None
@@ -91,6 +156,12 @@ class QueryTicket:
         self._value: Any = None
         self._exc: Optional[BaseException] = None
         self._done = threading.Event()
+
+    def cancel(self, reason: str = "client cancel") -> None:
+        """Cooperatively cancel: the query stops at its next region/chunk
+        boundary (or decode-pool checkpoint), releases everything it
+        holds, and the ticket resolves ``cancelled``."""
+        self.cancel_token.cancel(reason)
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -121,10 +192,13 @@ class Session:
 
     def submit(self, plan: fusion.Plan, bindings: dict, *,
                estimate_bytes: Optional[int] = None,
-               donate_inputs: bool = False) -> QueryTicket:
+               donate_inputs: bool = False,
+               deadline_ms: Optional[int] = None,
+               outofcore: Optional[Callable] = None) -> QueryTicket:
         return self._server.submit(
             self.session_id, plan, bindings,
-            estimate_bytes=estimate_bytes, donate_inputs=donate_inputs)
+            estimate_bytes=estimate_bytes, donate_inputs=donate_inputs,
+            deadline_ms=deadline_ms, outofcore=outofcore)
 
     def stats(self) -> dict:
         return self._server.session_stats(self.session_id)
@@ -162,6 +236,19 @@ class QueryServer:
         # every concurrent query shares ONE host decode/staging pool
         # (runtime/pipeline.py) instead of spinning a private executor
         self.decode_pool = pipeline.shared_decode_pool()
+        # the server-owned spill store backs degraded queries' partials
+        # AND is the limiter's proactive-spill target when the high
+        # watermark trips (memory.py)
+        self.spill_store = SpillStore(self.limiter.budget)
+        self.limiter.attach_spill_store(self.spill_store)
+        self.degrader = degrade.DegradationController(self.limiter)
+        # learned admission: plan signature -> EMA of measured working-set
+        # bytes, loaded from (and written through to) the crash-safe state
+        # file beside the dispatch persistent cache
+        self._learned_lock = threading.Lock()
+        self._learned: dict[str, float] = {}
+        self._estimate_path = self._resolve_estimate_path()
+        self._load_learned()
         self._cond = threading.Condition()
         self._queues: dict[str, collections.deque] = {}
         # round-robin ring over session ids, registration order
@@ -190,35 +277,54 @@ class QueryServer:
 
     def submit(self, session_id: str, plan: fusion.Plan, bindings: dict, *,
                estimate_bytes: Optional[int] = None,
-               donate_inputs: bool = False) -> QueryTicket:
+               donate_inputs: bool = False,
+               deadline_ms: Optional[int] = None,
+               outofcore: Optional[Callable] = None) -> QueryTicket:
         """Queue one query. Never blocks: over-the-whole-budget estimates
         and full session queues come back as immediately-rejected tickets
-        (backpressure belongs to the client, not to unbounded memory)."""
+        (backpressure belongs to the client, not to unbounded memory).
+
+        ``deadline_ms`` (default ``server.deadline_ms``; 0 = none) arms the
+        ticket's :class:`~.resilience.CancelToken` from SUBMIT time.
+        ``outofcore`` optionally supplies the degradation ladder's rung-2
+        runner factory, ``(bindings, limiter) -> (chunk_rows, token) ->
+        Table`` (see ``degrade.row_chunked_tier``); without it the ladder
+        for this query is fused -> staged -> parked."""
         sid = str(session_id)
         self.session(sid)  # idempotent registration
         estimate = int(estimate_bytes) if estimate_bytes is not None \
             else self._default_estimate(plan, bindings)
-        ticket = QueryTicket(sid, plan, bindings, estimate, donate_inputs)
+        ddl = int(deadline_ms if deadline_ms is not None
+                  else get_option("server.deadline_ms"))
+        ticket = QueryTicket(sid, plan, bindings, estimate, donate_inputs,
+                             deadline_ms=ddl, outofcore=outofcore)
         self._count("submitted", sid)
         record_server(plan.name, "submitted", session=sid,
                       estimate_bytes=estimate)
         if estimate > self.limiter.budget:
             self._reject(ticket,
                          f"estimate {estimate} exceeds the whole HBM "
-                         f"budget ({self.limiter.budget}): can never fit")
+                         f"budget ({self.limiter.budget}): can never fit",
+                         retry_after_s=None)
             return ticket
         with self._cond:
             if self._closed:
                 reject_why = "server closed"
+                retry_after: Optional[float] = None
             elif len(self._queues[sid]) >= self.queue_depth:
                 reject_why = (f"session queue full "
                               f"({self.queue_depth} deep)")
+                # the queue drains roughly one p50 latency per entry; a
+                # zero histogram (cold server) suggests a short poll
+                p50 = REGISTRY.histogram("server.latency_ms").percentile(50)
+                retry_after = max(0.05, float(p50 or 0.0) / 1e3)
             else:
                 reject_why = None
+                retry_after = None
                 self._queues[sid].append(ticket)
                 self._cond.notify()
         if reject_why is not None:
-            self._reject(ticket, reject_why)
+            self._reject(ticket, reject_why, retry_after_s=retry_after)
             return ticket
         self._count("queued", sid)
         record_server(plan.name, "queued", session=sid,
@@ -242,6 +348,7 @@ class QueryServer:
                 q.clear()
         for t in backlog:
             self._reject(t, "server shutdown")
+        self._save_learned()
 
     def __enter__(self) -> "QueryServer":
         return self
@@ -262,6 +369,7 @@ class QueryServer:
             "admitted": c.get("server.admitted", 0),
             "served": c.get("server.served", 0),
             "rejected": c.get("server.rejected", 0),
+            "cancelled": c.get("server.cancelled", 0),
             "failed": c.get("server.failed", 0),
             "latency_ms_p50": lat.percentile(50),
             "latency_ms_p95": lat.percentile(95),
@@ -269,6 +377,10 @@ class QueryServer:
             "queue_wait_ms_p95": wait.percentile(95),
             "reserved_bytes": self.limiter.used,
             "budget_bytes": self.limiter.budget,
+            "pressure_crossings": self.limiter.pressure_crossings,
+            "degrade_steps": REGISTRY.counters("degrade.").get(
+                "degrade.step", 0),
+            "learned_signatures": len(self._learned),
             "sessions": sorted(self._queues),
         }
 
@@ -283,6 +395,7 @@ class QueryServer:
         fallbacks = 0
         spills = 0
         resilience_events = 0
+        degrades = 0
         for rec in _ring_events():
             if rec.get("session") != sid:
                 continue
@@ -293,6 +406,8 @@ class QueryServer:
                 spills += 1
             elif kind == "resilience":
                 resilience_events += 1
+            elif kind == "degrade" and rec.get("event") == "step":
+                degrades += 1
         return {
             "session": sid,
             "submitted": c.get(f"server.submitted.{sid}", 0),
@@ -300,6 +415,7 @@ class QueryServer:
             "admitted": c.get(f"server.admitted.{sid}", 0),
             "served": c.get(f"server.served.{sid}", 0),
             "rejected": c.get(f"server.rejected.{sid}", 0),
+            "cancelled": c.get(f"server.cancelled.{sid}", 0),
             "failed": c.get(f"server.failed.{sid}", 0),
             "latency_ms_p50": lat.percentile(50),
             "latency_ms_p95": lat.percentile(95),
@@ -308,6 +424,7 @@ class QueryServer:
             "fallbacks": fallbacks,
             "spills": spills,
             "resilience_events": resilience_events,
+            "degrade_steps": degrades,
         }
 
     # -- internals -----------------------------------------------------------
@@ -318,9 +435,96 @@ class QueryServer:
         REGISTRY.counter(f"server.{event}").inc()
         REGISTRY.counter(f"server.{event}.{sid}").inc()
 
+    # -- adaptive admission --------------------------------------------------
+
+    @staticmethod
+    def _resolve_estimate_path() -> str:
+        """Where learned estimates persist: ``server.estimate_path`` if
+        set, else ``learned_estimates.json`` beside the dispatch
+        persistent cache; empty (in-memory only) when neither exists."""
+        explicit = str(get_option("server.estimate_path") or "")
+        if explicit:
+            return explicit
+        cache_dir = os.environ.get("SPARK_RAPIDS_TPU_DISPATCH_CACHE") or str(
+            get_option("dispatch.persistent_cache_dir") or "")
+        if cache_dir:
+            return os.path.join(cache_dir, "learned_estimates.json")
+        return ""
+
+    def _load_learned(self) -> None:
+        if not self._estimate_path:
+            return
+        state, corrupt = load_json(self._estimate_path)
+        if corrupt is not None:
+            # a crash mid-write can't produce this (atomic replace), but
+            # disk rot / manual edits can: discard, count, keep serving
+            REGISTRY.counter("server.estimate_state_discarded").inc()
+            record_degrade("server.learned_estimates", "state_discarded",
+                           tier="persistent", trigger="corrupt", rung=0,
+                           path=self._estimate_path, reason=corrupt)
+            return
+        if isinstance(state, dict):
+            with self._learned_lock:
+                self._learned = {
+                    str(k): float(v) for k, v in state.items()
+                    if isinstance(v, (int, float)) and float(v) > 0
+                }
+
+    def _save_learned(self) -> None:
+        if not self._estimate_path:
+            return
+        with self._learned_lock:
+            snapshot = dict(self._learned)
+        try:
+            atomic_write_json(self._estimate_path, snapshot)
+        except OSError as exc:
+            # warm-start state is an optimization; losing a write only
+            # costs the next process a cold estimate, never a query
+            REGISTRY.counter("server.estimate_state_write_error").inc()
+            _log.warning("could not persist learned estimates to %s: %s",
+                         self._estimate_path, exc)
+
+    @staticmethod
+    def _plan_signature(plan: fusion.Plan, bindings: dict) -> str:
+        """Plan name + pow2 bucket of total input rows: the granularity at
+        which measured working sets transfer between queries (matches the
+        dispatch bucketing, so same-signature queries share executables
+        AND footprints)."""
+        rows = 0
+        for v in bindings.values():
+            rows += int(getattr(v, "num_rows", 0) or 0)
+        bucket = 1 << max(rows - 1, 0).bit_length() if rows else 0
+        return f"{plan.name}@{bucket}"
+
+    def _record_actual(self, ticket: QueryTicket, bindings: dict,
+                       result) -> None:
+        """Blend this query's measured working set (input + result device
+        bytes — the floor on its true peak; headroom covers
+        intermediates) into the signature's EMA and write through."""
+        try:
+            actual = _table_nbytes(result.table)
+            for v in bindings.values():
+                actual += v.nbytes if isinstance(v, HostTableChunk) \
+                    else _table_nbytes(v)
+        except (TypeError, AttributeError):
+            return  # non-table result (nothing measurable to learn from)
+        sig = self._plan_signature(ticket.plan, ticket.bindings)
+        alpha = min(max(float(get_option("server.estimate_alpha")), 0.0), 1.0)
+        with self._learned_lock:
+            prev = self._learned.get(sig)
+            self._learned[sig] = float(actual) if prev is None \
+                else (1.0 - alpha) * prev + alpha * float(actual)
+        self._save_learned()
+
     def _default_estimate(self, plan: fusion.Plan, bindings: dict) -> int:
-        """Headroom x the plan-aware input+output estimate; host-staged
-        chunk bindings are costed at their exact device footprint."""
+        """Headroom x the measured-truth EMA for this plan signature when
+        one exists, else headroom x the static plan-aware input+output
+        estimate; host-staged chunk bindings are costed at their exact
+        device footprint."""
+        with self._learned_lock:
+            learned = self._learned.get(self._plan_signature(plan, bindings))
+        if learned is not None:
+            return int(self.estimate_headroom * learned)
         if any(isinstance(v, HostTableChunk) for v in bindings.values()):
             base = sum(
                 v.nbytes if isinstance(v, HostTableChunk)
@@ -330,14 +534,23 @@ class QueryServer:
             base = fusion.estimate_hbm_bytes(plan, bindings)
         return int(self.estimate_headroom * base)
 
-    def _reject(self, ticket: QueryTicket, reason: str) -> None:
-        self._count("rejected", ticket.session)
-        record_server(ticket.plan.name, "rejected", session=ticket.session,
-                      reason=reason, estimate_bytes=ticket.estimate)
+    def _reject(self, ticket: QueryTicket, reason: str,
+                retry_after_s: Optional[float] = None) -> None:
+        sid = ticket.session
+        with self._cond:
+            depth = len(self._queues.get(sid, ()))
+        available = max(self.limiter.budget - self.limiter.used, 0)
+        self._count("rejected", sid)
+        record_server(ticket.plan.name, "rejected", session=sid,
+                      reason=reason, estimate_bytes=ticket.estimate,
+                      queue_depth=depth, bytes_available=available)
         _log.warning("rejected %s (session %s): %s",
-                     ticket.plan.name, ticket.session, reason)
+                     ticket.plan.name, sid, reason)
         ticket._resolve("rejected", exc=QueryRejected(
-            f"{ticket.plan.name} (session {ticket.session}): {reason}"))
+            f"{ticket.plan.name} (session {sid}): {reason}",
+            session=sid, reason=reason, queue_depth=depth,
+            bytes_requested=ticket.estimate, bytes_available=available,
+            retry_after_s=retry_after_s))
 
     def _next_ticket(self) -> Optional[QueryTicket]:
         """Round-robin pop: the next session (in ring order after the
@@ -378,22 +591,59 @@ class QueryServer:
             staged[name] = fut.result()
         return staged
 
+    def _cancelled(self, ticket: QueryTicket,
+                   exc: resilience.QueryCancelled) -> None:
+        sid = ticket.session
+        reason = str(exc.context.get("reason") or "cancelled")
+        where = str(exc.context.get("where") or "checkpoint")
+        ticket.latency_s = time.monotonic() - ticket._submitted_at
+        self._count("cancelled", sid)
+        record_server(ticket.plan.name, "cancelled", session=sid,
+                      reason=reason, where=where,
+                      wall_ms=ticket.latency_s * 1e3)
+        record_degrade(f"degrade.{ticket.plan.name}", "cancelled",
+                       tier="cancelled", trigger=reason, rung=0,
+                       session=sid)
+        _log.info("query %s (session %s) cancelled: %s",
+                  ticket.plan.name, sid, reason)
+        ticket._resolve("cancelled", exc=exc)
+
     def _serve(self, ticket: QueryTicket) -> None:
         sid = ticket.session
+        token = ticket.cancel_token
+        stop = self._stop
+
+        class _admission_cancel:
+            # wake a BLOCKED admission on shutdown OR query cancellation
+            # (the limiter polls this inside reserve_blocking)
+            @staticmethod
+            def is_set() -> bool:
+                return stop.is_set() or token.cancelled()
+
         held = 0
         try:
             faults.fire("server.admit", 0, session=sid,
                         plan=ticket.plan.name)
+            if token.cancelled():
+                # expired (or explicitly cancelled) while queued: resolve
+                # without ever reserving — the budget goes to live queries
+                token.check("server.admit")
+            # admission=True: NEW work parks while the limiter is above
+            # its high watermark; in-flight queries keep draining
             ok = self.limiter.reserve_blocking(
-                ticket.estimate, cancel=self._stop,
-                timeout=self.admission_timeout_s)
+                ticket.estimate, cancel=_admission_cancel,
+                timeout=self.admission_timeout_s, admission=True)
             if not ok:
+                if token.cancelled():
+                    token.check("server.admit")
                 self._reject(
                     ticket,
                     "server shutdown" if self._stop.is_set()
                     else f"admission timeout "
                          f"({self.admission_timeout_s}s) waiting for "
-                         f"{ticket.estimate} bytes")
+                         f"{ticket.estimate} bytes",
+                    retry_after_s=None if self._stop.is_set()
+                    else self.admission_timeout_s)
                 return
             held = ticket.estimate
             ticket.status = "admitted"
@@ -408,10 +658,16 @@ class QueryServer:
             with session_scope(sid):
                 faults.fire("server.execute", 0, session=sid,
                             plan=ticket.plan.name)
+                token.check("server.execute")
                 bindings = self._stage_bindings(ticket.bindings)
-                result = fusion.execute(
-                    ticket.plan, bindings,
-                    donate_inputs=ticket.donate_inputs)
+                runner = None if ticket.outofcore is None \
+                    else ticket.outofcore(bindings, self.limiter)
+                result = self.degrader.execute(
+                    degrade.DegradableQuery(
+                        ticket.plan, bindings,
+                        donate_inputs=ticket.donate_inputs,
+                        outofcore=runner),
+                    cancel_token=token)
             ticket.latency_s = time.monotonic() - ticket._submitted_at
             lat_ms = ticket.latency_s * 1e3
             REGISTRY.histogram("server.latency_ms").observe(lat_ms)
@@ -419,7 +675,12 @@ class QueryServer:
             self._count("served", sid)
             record_server(ticket.plan.name, "served", session=sid,
                           wall_ms=lat_ms, wait_ms=ticket.queue_wait_s * 1e3)
+            self._record_actual(ticket, bindings, result)
             ticket._resolve("served", value=result)
+        except resilience.QueryCancelled as exc:
+            # a deliberate stop, not a failure: the reservation and the
+            # in-flight slot release in the SAME finally as every exit
+            self._cancelled(ticket, exc)
         except BaseException as exc:
             # a dying query releases everything it holds (the finally
             # below) and resolves CLASSIFIED — never a silent wedge

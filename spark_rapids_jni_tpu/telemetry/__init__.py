@@ -25,6 +25,7 @@ from spark_rapids_jni_tpu.telemetry.events import (
     events,
     record_bench_stale,
     record_compile_cache,
+    record_degrade,
     record_dispatch,
     record_fallback,
     record_resilience,
@@ -44,6 +45,7 @@ __all__ = [
     "events",
     "record_bench_stale",
     "record_compile_cache",
+    "record_degrade",
     "record_dispatch",
     "record_fallback",
     "record_resilience",

@@ -49,6 +49,7 @@ __all__ = [
     "record_resilience",
     "record_bench_stale",
     "record_server",
+    "record_degrade",
     "session_scope",
     "current_session",
     "events",
@@ -298,6 +299,44 @@ def record_server(
     return True
 
 
+def record_degrade(
+    op: str,
+    event: str,
+    *,
+    tier: str,
+    trigger: str,
+    rung: int,
+    rows: Optional[int] = None,
+    **extra: Any,
+) -> bool:
+    """A graceful-degradation decision for one query (runtime/degrade.py).
+
+    ``event`` is one of ``step`` / ``completed`` / ``parked`` / ``resumed``
+    / ``exhausted`` / ``pressure`` / ``cancelled`` / ``state_discarded``;
+    ``tier`` names the execution tier the ladder is moving to (``fused``,
+    ``staged``, ``outofcore``, ``parked``); ``trigger`` is what forced the
+    move (the classified error kind, ``deadline``, ``watermark``); ``rung``
+    is the 0-based ladder position. Tier and trigger are mandatory even when
+    telemetry is off — an unaccountable degradation is a bug (same contract
+    as fallback reasons).
+    """
+    if not tier or not str(tier).strip():
+        raise ValueError(f"record_degrade({op!r}): tier must be non-empty")
+    if not trigger or not str(trigger).strip():
+        raise ValueError(f"record_degrade({op!r}): trigger must be non-empty")
+    if not enabled():
+        return False
+    rec = _base("degrade", op, rows, None, extra)
+    rec["event"] = str(event)
+    rec["tier"] = str(tier)
+    rec["trigger"] = str(trigger)
+    rec["rung"] = int(rung)
+    REGISTRY.counter(f"degrade.{event}").inc()
+    REGISTRY.counter(f"degrade.tier.{tier}").inc()
+    _emit(rec)
+    return True
+
+
 def record_bench_stale(
     metric: str,
     *,
@@ -345,6 +384,8 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
     cache = {"hit": 0, "miss": 0}
     resilience: Dict[str, int] = {}
     server: Dict[str, int] = {}
+    degrade: Dict[str, int] = {}
+    degrade_tiers: Dict[str, int] = {}
     stale_reads = 0
     dispatches = 0
     spill_bytes = 0
@@ -356,6 +397,12 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         elif kind == "server":
             ev = str(r.get("event", "?"))
             server[ev] = server.get(ev, 0) + 1
+        elif kind == "degrade":
+            ev = str(r.get("event", "?"))
+            degrade[ev] = degrade.get(ev, 0) + 1
+            if ev == "step":
+                tier = str(r.get("tier", "?"))
+                degrade_tiers[tier] = degrade_tiers.get(tier, 0) + 1
         elif kind == "fallback":
             op = str(r.get("op", "?"))
             fallbacks[op] = fallbacks.get(op, 0) + 1
@@ -379,5 +426,7 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         "compile_cache": cache,
         "resilience": dict(sorted(resilience.items())),
         "server": dict(sorted(server.items())),
+        "degrade": dict(sorted(degrade.items())),
+        "degrade_tiers": dict(sorted(degrade_tiers.items())),
         "stale_reads": stale_reads,
     }

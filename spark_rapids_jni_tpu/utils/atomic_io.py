@@ -1,0 +1,80 @@
+"""Crash-safe small-state persistence: tmp-file + ``os.replace`` + fsync.
+
+Warm-start state (the serving runtime's learned admission estimates, the
+dispatch persistent-cache index) is tiny but load-bearing: a crash mid-write
+must never leave a half-file that poisons the next process. The write
+protocol here is the classic one — write the FULL payload to a same-directory
+temp file, fsync it, atomically rename over the target, then fsync the
+directory so the rename itself is durable. Readers treat any unparsable file
+as ABSENT: corruption is discarded (with a telemetry event recorded by the
+caller), never raised into query serving.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Optional, Tuple
+
+from spark_rapids_jni_tpu.utils.log import get_logger
+
+__all__ = ["atomic_write_json", "load_json"]
+
+_log = get_logger(__name__)
+
+
+def atomic_write_json(path: str, obj: Any) -> None:
+    """Durably replace ``path`` with ``obj`` serialized as JSON.
+
+    The temp file lives in the TARGET directory (``os.replace`` is only
+    atomic within one filesystem); both the file and its directory are
+    fsynced, so after return either the old complete file or the new
+    complete file is on disk — never a truncated hybrid.
+    """
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(path) + ".", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(obj, f, sort_keys=True, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # fsync the directory so the rename survives power loss; some
+        # filesystems refuse O_RDONLY dir fds — losing THIS sync only
+        # risks re-reading the previous complete file, never corruption
+        try:
+            dfd = os.open(directory, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_json(path: str) -> Tuple[Optional[Any], Optional[str]]:
+    """Read a JSON state file written by :func:`atomic_write_json`.
+
+    Returns ``(obj, None)`` on success, ``(None, None)`` when the file
+    does not exist, and ``(None, reason)`` when it exists but cannot be
+    parsed — the caller discards it (and records the telemetry event);
+    a corrupt warm-start file must cost a cold start, not a crash.
+    """
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f), None
+    except FileNotFoundError:
+        return None, None
+    except (OSError, ValueError, UnicodeDecodeError) as exc:
+        reason = f"{type(exc).__name__}: {exc}"
+        _log.warning("discarding corrupt state file %s (%s)", path, reason)
+        return None, reason

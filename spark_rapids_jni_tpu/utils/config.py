@@ -115,6 +115,41 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # Safety multiplier applied to the input-bytes HBM estimate when the
     # caller does not supply one (intermediates cost more than inputs).
     "server.estimate_headroom": (1.5, float),
+    # Per-query wall-clock deadline in milliseconds; 0 = no deadline. A
+    # query past its deadline is cancelled cooperatively (region/chunk
+    # boundaries, decode pool) and dies classified as QueryCancelled with
+    # every reservation and queue slot released.
+    "server.deadline_ms": (0, int),
+    # Graceful degradation (runtime/degrade.py): when a classified
+    # ResourceExhausted / CapacityOverflow escapes the retry/escalate
+    # budget, re-execute one rung down the bit-identical tier ladder
+    # (fused -> staged -> out-of-core halved chunks -> park-and-retry).
+    # Off -> the serving runtime is byte-for-byte the pre-degradation
+    # path: the first classified failure propagates.
+    "degrade.enabled": (True, bool),
+    # Maximum rungs a single query may step down before its original
+    # classified failure is re-raised (4 covers the whole ladder).
+    "degrade.max_steps": (4, int),
+    # Park-and-retry rung: how long (seconds) a parked query waits for
+    # the limiter to drain below the low watermark before giving up and
+    # re-raising the classified failure.
+    "degrade.park_timeout_s": (30.0, float),
+    # Out-of-core rung: rows per chunk for the first out-of-core attempt;
+    # each further pressure failure on this rung halves it (floor 1).
+    "degrade.chunk_rows": (65536, int),
+    # Memory-pressure watermarks as fractions of the limiter budget.
+    # Crossing high proactively spills the coldest SpillStore entries and
+    # pauses admission; admission resumes once usage drains below low.
+    "memory.high_watermark": (0.85, float),
+    "memory.low_watermark": (0.6, float),
+    # Adaptive admission: blend factor for folding the measured peak
+    # reservation of a plan signature into future estimates
+    # (new = (1-alpha)*old + alpha*measured). 0 disables learning.
+    "server.estimate_alpha": (0.4, float),
+    # Where learned per-signature estimates persist ("" = beside the
+    # dispatch persistent cache when that is configured, else unpersisted).
+    # Writes are crash-safe: tmp file + os.replace + fsync.
+    "server.estimate_path": ("", str),
 }
 
 _overrides: dict[str, Any] = {}

@@ -137,7 +137,7 @@ def test_main_falls_back_to_cpu_when_ledger_empty(
     monkeypatch.setattr(bench, "_probe_tpu", lambda t: (False, "forced down"))
     monkeypatch.setattr(
         bench, "_run_child", lambda c, n, i, p, t: (123.0, "", None, None,
-                                                    None, None))
+                                                    None, None, None))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["platform"] == "cpu" and rec["value"] == 123.0
@@ -155,7 +155,8 @@ def test_tpu_success_appends_to_ledger(ledger, monkeypatch, capsys):
     monkeypatch.setattr(
         bench, "_run_child",
         lambda c, n, i, p, t: (5.0e8, "", {"compiles": 1}, {"chunks": 10},
-                               {"regions": 1}, {"leaked_bytes": 0}))
+                               {"regions": 1}, {"leaked_bytes": 0},
+                               {"steps": 0}))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["platform"] == "tpu" and "stale_s" not in rec

@@ -1,0 +1,621 @@
+"""Graceful degradation under memory pressure (runtime/degrade, ISSUE 8).
+
+Invariant families:
+
+1. **The ladder preserves bit-identity** — classified pressure failures
+   step a query fused -> staged -> out-of-core (chunk halving) -> parked,
+   and whichever tier completes produces the serial ``fusion.execute``
+   answer (valid rows byte-for-byte), with ZERO leaked reservations and a
+   ``degrade.step`` event per transition.
+
+2. **Chaos sweep** — under a seeded fault script, every q1/q3/q6 query at
+   ragged row counts either completes bit-identical via SOME tier or dies
+   classified (resilience taxonomy / QueryRejected / QueryCancelled);
+   afterwards the same server serves a clean query bit-identical — chaos
+   leaves no lingering perturbation — and nothing leaks.
+
+3. **Deadlines & cancellation are cooperative and leak-free** — expiry
+   (or explicit cancel) resolves the ticket ``cancelled`` within a small
+   bound, releasing its reservation so queued work runs.
+
+4. **Watermarks** — crossing high proactively spills the attached store's
+   coldest entries, pauses NEW admission, and clears below low; with
+   ``degrade.enabled=false`` none of the machinery engages (the verbatim
+   pre-degradation path).
+
+5. **Warm-start state is crash-safe** — learned estimates round-trip
+   through tmp+``os.replace``; a corrupt file is discarded with a
+   telemetry event and a cold start, never a crash.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.runtime import (
+    degrade,
+    dispatch,
+    faults,
+    fusion,
+    resilience,
+    server,
+)
+from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter, SpillStore
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.telemetry.events import drain as drain_events
+from spark_rapids_jni_tpu.telemetry.events import events as ring_events
+from spark_rapids_jni_tpu.utils.atomic_io import atomic_write_json, load_json
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+RAGGED_IN_BUCKET = (600, 700, 801, 1000)
+
+_RESET = (
+    "server.max_inflight", "server.hbm_budget_bytes",
+    "server.admission_timeout_s", "server.queue_depth",
+    "server.estimate_headroom", "server.deadline_ms",
+    "server.estimate_alpha", "server.estimate_path",
+    "degrade.enabled", "degrade.max_steps", "degrade.park_timeout_s",
+    "degrade.chunk_rows", "memory.high_watermark", "memory.low_watermark",
+    "resilience.enabled", "resilience.max_attempts", "telemetry.enabled",
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    dispatch.clear()
+    REGISTRY.reset()
+    drain_events()
+    yield
+    for k in _RESET:
+        reset_option(k)
+    dispatch.clear()
+
+
+def _q1_bindings(n, seed=0):
+    return tpch._q1_plan(), {"lineitem": tpch.lineitem_table(n, seed=seed)}
+
+
+def _q6_plan():
+    return fusion.Plan("tpch_q6", fusion.Project(
+        fusion.Scan("lineitem"), tpch._q6_reduce, rowwise=False))
+
+
+def _q3_bindings(n, seed=0):
+    n_ord = max(n // 8, 4)
+    n_cust = max(n // 64, 2)
+    plan = tpch._q3_plan(0, tpch._Q3_CUTOFF_DAYS, 2)
+    bindings = {
+        "customer": tpch.customer_table(n_cust, seed=seed),
+        "orders": tpch.orders_table(n_ord, n_cust, seed=seed + 1),
+        "lineitem": tpch.lineitem_q3_table(n, n_ord, seed=seed + 2),
+    }
+    return plan, bindings
+
+
+def _assert_tables_identical(a, b, label=""):
+    assert a.num_columns == b.num_columns, f"{label}: column count"
+    assert a.num_rows == b.num_rows, f"{label}: row count"
+    for i in range(a.num_columns):
+        ca, cb = a.column(i), b.column(i)
+        av, bv = np.asarray(ca.valid_mask()), np.asarray(cb.valid_mask())
+        assert np.array_equal(av, bv), f"{label} col {i}: validity"
+        ad = np.where(av, np.asarray(ca.data), 0)
+        bd = np.where(bv, np.asarray(cb.data), 0)
+        assert np.array_equal(ad, bd), f"{label} col {i}: data"
+
+
+def _valid_rows(t):
+    """The table's REAL rows (row-valid = column-0 validity, the groupby
+    padding convention), masked and in table order — shape-independent:
+    the fused tier pads its groupby output to the plan's group budget
+    while the out-of-core merge is sized by its stacked partials, so
+    bit-identity across tiers is over valid rows, not padding."""
+    cols = [(np.asarray(t.column(i).valid_mask()),
+             np.asarray(t.column(i).data)) for i in range(t.num_columns)]
+    out = []
+    for r in np.flatnonzero(cols[0][0]):
+        out.append(tuple(
+            (bool(vm[r]), dm[r].item() if vm[r] else None)
+            for vm, dm in cols))
+    return out
+
+
+def _assert_same_answer(a, b, label=""):
+    """Bit-identity across tiers: full byte equality when the shapes
+    match, valid-row equality when a trimming tier changed the padding."""
+    if a.num_rows == b.num_rows:
+        _assert_tables_identical(a, b, label)
+    else:
+        assert a.num_columns == b.num_columns, f"{label}: column count"
+        assert _valid_rows(a) == _valid_rows(b), f"{label}: valid rows"
+
+
+def _degrade_events(event=None):
+    out = [r for r in ring_events() if r.get("kind") == "degrade"]
+    if event is not None:
+        out = [r for r in out if r.get("event") == event]
+    return out
+
+
+def _q1_outofcore_factory(bindings, limiter):
+    partial_fn, merge_fn = tpch.q1_row_chunked_fns()
+    return degrade.row_chunked_tier(
+        bindings, "lineitem", partial_fn, merge_fn, limiter=limiter)
+
+
+# ---------------------------------------------------------------------------
+# 1. the ladder preserves bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_degrade_seams_registered():
+    for seam in ("degrade.step", "memory.pressure", "server.cancel"):
+        assert seam in faults.SEAMS
+
+
+def test_ladder_steps_to_staged_bit_identical():
+    set_option("telemetry.enabled", True)
+    plan, bindings = _q1_bindings(600)
+    want = fusion.execute(plan, bindings).table
+    limiter = MemoryLimiter(1 << 26)
+    ctrl = degrade.DegradationController(limiter, session="lad")
+    q = degrade.DegradableQuery(plan, bindings)
+    script = faults.FaultScript([faults.FaultSpec(
+        "fusion.region", resilience.ResourceExhausted("injected"), times=1)])
+    with faults.inject(script):
+        res = ctrl.execute(q)
+    _assert_tables_identical(res.table, want, "staged tier")
+    assert limiter.used == 0
+    steps = _degrade_events("step")
+    assert [e["tier"] for e in steps] == ["staged"]
+    assert steps[0]["trigger"] == "ResourceExhausted"
+    assert steps[0]["rung"] == 1
+    assert steps[0]["session"] == "lad"
+    assert _degrade_events("completed")[0]["tier"] == "staged"
+
+
+def test_ladder_reaches_outofcore_and_halves_chunks():
+    """fused and staged both die of pressure (the region seam fires at
+    seq=0 for the fused attempt, seq=1 for the staged evaluator); the
+    out-of-core rung then halves chunk_rows on each further pressure
+    failure until the query completes — bit-identical, nothing leaked,
+    every attempt visible in degrade.step events."""
+    set_option("telemetry.enabled", True)
+    set_option("degrade.chunk_rows", 400)
+    set_option("degrade.max_steps", 8)
+    plan, bindings = _q1_bindings(600)
+    want = fusion.execute(plan, bindings).table
+    limiter = MemoryLimiter(1 << 26)
+    attempts = []
+    real = _q1_outofcore_factory(bindings, limiter)
+
+    def runner(chunk_rows, token):
+        attempts.append(chunk_rows)
+        if chunk_rows > 100:
+            raise resilience.ResourceExhausted(
+                f"chunk of {chunk_rows} rows does not fit")
+        return real(chunk_rows, token)
+
+    ctrl = degrade.DegradationController(limiter)
+    q = degrade.DegradableQuery(plan, bindings, outofcore=runner)
+    script = faults.FaultScript([
+        faults.FaultSpec("fusion.region",
+                         resilience.ResourceExhausted("hbm"), seq=0),
+        faults.FaultSpec("fusion.region",
+                         resilience.CapacityOverflow("staged oom"), seq=1),
+    ])
+    with faults.inject(script):
+        res = ctrl.execute(q)
+    _assert_same_answer(res.table, want, "outofcore tier")
+    assert limiter.used == 0
+    assert attempts == [400, 200, 100]  # halved on each pressure failure
+    steps = _degrade_events("step")
+    assert [e["tier"] for e in steps] == [
+        "staged", "outofcore", "outofcore", "outofcore"]
+    assert [e.get("chunk_rows") for e in steps] == [None, 400, 200, 100]
+    assert res.meta["degrade.chunk_rows"] == 100
+
+
+def test_ladder_exhaustion_reraises_original_classified():
+    set_option("telemetry.enabled", True)
+    set_option("degrade.max_steps", 1)
+    plan, bindings = _q1_bindings(600)
+    limiter = MemoryLimiter(1 << 26)
+    ctrl = degrade.DegradationController(limiter)
+    q = degrade.DegradableQuery(plan, bindings)
+    first = resilience.ResourceExhausted("the original failure")
+    script = faults.FaultScript([
+        faults.FaultSpec("fusion.region", first, seq=0),
+        faults.FaultSpec("fusion.region",
+                         resilience.CapacityOverflow("next"), seq=1,
+                         times=50),
+    ])
+    with faults.inject(script), pytest.raises(
+            resilience.ResourceExhausted) as ei:
+        ctrl.execute(q)
+    assert ei.value is first  # the ORIGINAL, not the last straw
+    assert limiter.used == 0
+    assert _degrade_events("exhausted")
+
+
+def test_park_rung_waits_for_drain_then_retries():
+    """No out-of-core runner: fused and staged die, the query parks until
+    the limiter drains below low, then retries staged and completes."""
+    set_option("telemetry.enabled", True)
+    set_option("degrade.park_timeout_s", 20.0)
+    plan, bindings = _q1_bindings(600)
+    want = fusion.execute(plan, bindings).table
+    limiter = MemoryLimiter(1000, high_watermark=0.8, low_watermark=0.3)
+    limiter.reserve(900)  # keeps usage above low until the helper releases
+    ctrl = degrade.DegradationController(limiter)
+    q = degrade.DegradableQuery(plan, bindings)
+    script = faults.FaultScript([
+        faults.FaultSpec("fusion.region",
+                         resilience.ResourceExhausted("hbm"), seq=0),
+        faults.FaultSpec("fusion.region",
+                         resilience.ResourceExhausted("staged oom"), seq=1),
+    ])
+    releaser = threading.Timer(0.3, limiter.release, args=(900,))
+    releaser.start()
+    try:
+        with faults.inject(script):
+            res = ctrl.execute(q)
+    finally:
+        releaser.cancel()
+        releaser.join()
+    _assert_tables_identical(res.table, want, "post-park retry")
+    assert limiter.used == 0
+    assert _degrade_events("parked")
+    assert _degrade_events("resumed")
+    assert _degrade_events("completed")
+
+
+def test_park_rung_timeout_exhausts_with_original_error():
+    set_option("telemetry.enabled", True)
+    set_option("degrade.park_timeout_s", 0.1)
+    plan, bindings = _q1_bindings(600)
+    limiter = MemoryLimiter(1000, high_watermark=0.8, low_watermark=0.3)
+    limiter.reserve(900)  # never drains
+    try:
+        ctrl = degrade.DegradationController(limiter)
+        q = degrade.DegradableQuery(plan, bindings)
+        first = resilience.ResourceExhausted("original")
+        script = faults.FaultScript([
+            faults.FaultSpec("fusion.region", first, seq=0),
+            faults.FaultSpec("fusion.region",
+                             resilience.ResourceExhausted("staged oom"),
+                             seq=1),
+        ])
+        with faults.inject(script), pytest.raises(
+                resilience.ResourceExhausted) as ei:
+            ctrl.execute(q)
+        assert ei.value is first
+        assert _degrade_events("exhausted")
+    finally:
+        limiter.release(900)
+    assert limiter.used == 0
+
+
+def test_degrade_step_seam_can_inject_mid_degrade():
+    """A fault injected AT the degrade.step seam propagates — one
+    recovery at a time, never a recursive ladder."""
+    plan, bindings = _q1_bindings(600)
+    limiter = MemoryLimiter(1 << 26)
+    ctrl = degrade.DegradationController(limiter)
+    q = degrade.DegradableQuery(plan, bindings)
+    boom = RuntimeError("mid-degrade fault")
+    script = faults.FaultScript([
+        faults.FaultSpec("fusion.region",
+                         resilience.ResourceExhausted("hbm"), times=1),
+        faults.FaultSpec("degrade.step", boom, times=1),
+    ])
+    with faults.inject(script), pytest.raises(RuntimeError) as ei:
+        ctrl.execute(q)
+    assert ei.value is boom
+    assert limiter.used == 0
+
+
+def test_disabled_is_verbatim_plain_execute():
+    """degrade.enabled=false: the controller IS fusion.execute — the
+    pre-degradation staged fallback still absorbs the fault silently,
+    and no degrade machinery runs (no events, no pressure state)."""
+    set_option("telemetry.enabled", True)
+    set_option("degrade.enabled", False)
+    plan, bindings = _q1_bindings(600)
+    want = fusion.execute(plan, bindings).table
+    limiter = MemoryLimiter(1 << 26)
+    ctrl = degrade.DegradationController(limiter)
+    q = degrade.DegradableQuery(plan, bindings)
+    # clean run: identical result, zero degrade events
+    res = ctrl.execute(q)
+    _assert_tables_identical(res.table, want, "disabled clean")
+    # the pre-degradation staged fallback absorbs a fused-region fault
+    script = faults.FaultScript([faults.FaultSpec(
+        "fusion.region", resilience.ResourceExhausted("hbm"), times=1)])
+    with faults.inject(script):
+        res2 = ctrl.execute(q)
+    _assert_tables_identical(res2.table, want, "disabled fallback")
+    assert _degrade_events() == []
+    assert limiter.used == 0
+    assert limiter.pressure_crossings == 0
+
+
+# ---------------------------------------------------------------------------
+# 2. watermarks
+# ---------------------------------------------------------------------------
+
+
+def test_high_watermark_spills_coldest_and_pauses_admission():
+    set_option("telemetry.enabled", True)
+    limiter = MemoryLimiter(100_000, high_watermark=0.5, low_watermark=0.25)
+    store = SpillStore(1 << 20)
+    limiter.attach_spill_store(store)
+    cold = tpch.lineitem_table(100, seed=1)
+    warm = tpch.lineitem_table(100, seed=2)
+    h_cold = store.put(cold)
+    h_warm = store.put(warm)
+    store.get(h_warm)  # warm's tick is now newer: cold spills first
+    pressures = []
+
+    def probe(seam, seq, ctx):
+        if seam == "memory.pressure":
+            pressures.append(dict(ctx))
+
+    with faults.inject(probe):
+        limiter.reserve(60_000)  # crosses high (50k)
+    assert limiter.pressure
+    assert limiter.pressure_crossings == 1
+    assert pressures and pressures[0]["used"] == 60_000
+    assert store.stats()["spills"] >= 1  # the proactive spill engaged
+    ev = [r for r in _degrade_events("pressure") if r["tier"] == "high"]
+    assert ev and ev[0]["trigger"] == "watermark"
+    assert ev[0]["proactive_spill_bytes"] > 0
+    # NEW admission parks while pressure holds; plain reserves do not
+    assert limiter.reserve_blocking(
+        1000, timeout=0.2, admission=True) is False
+    assert limiter.reserve_blocking(1000, timeout=0.2) is True
+    limiter.release(1000)
+    # draining below low clears pressure and admission resumes
+    limiter.release(60_000)
+    assert not limiter.pressure
+    assert limiter.reserve_blocking(
+        1000, timeout=0.2, admission=True) is True
+    limiter.release(1000)
+    assert limiter.used == 0
+    # the spilled entry restores bit-identical
+    _assert_tables_identical(store.get(h_cold), cold, "unspilled")
+
+
+def test_watermarks_inert_without_store_or_when_disabled():
+    # no store attached: the pre-degradation limiter, byte-for-byte
+    limiter = MemoryLimiter(1000, high_watermark=0.5, low_watermark=0.25)
+    limiter.reserve(900)
+    assert not limiter.pressure
+    assert limiter.pressure_crossings == 0
+    limiter.release(900)
+    # store attached but degradation disabled: still inert
+    set_option("degrade.enabled", False)
+    limiter.attach_spill_store(SpillStore(1 << 20))
+    limiter.reserve(900)
+    assert not limiter.pressure
+    assert limiter.pressure_crossings == 0
+    limiter.release(900)
+    assert limiter.used == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. deadlines & cancellation
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expiry_cancels_within_bound_and_frees_budget():
+    """One slow query holds the single worker past a queued query's
+    deadline; the queued query resolves cancelled (classified, within a
+    scheduling bound of the worker freeing) WITHOUT reserving, and the
+    server keeps serving afterwards."""
+    plan, bindings = _q1_bindings(600)
+    release_worker = threading.Event()
+
+    def probe(seam, seq, ctx):
+        if seam == "server.execute" and ctx.get("session") == "slow":
+            release_worker.wait(20)
+
+    lim = MemoryLimiter(1 << 28)
+    with faults.inject(probe), server.QueryServer(
+            limiter=lim, max_inflight=1) as srv:
+        slow = srv.session("slow").submit(plan, bindings)
+        quick = srv.session("quick").submit(plan, bindings, deadline_ms=100)
+        time.sleep(0.3)  # deadline passes while quick is still queued
+        release_worker.set()
+        with pytest.raises(resilience.QueryCancelled):
+            quick.result(timeout=30)
+        resolved_at = time.monotonic()
+        assert quick.status == "cancelled"
+        slow.result(timeout=30)
+        # the worker was freed moments ago; cancellation resolved within
+        # a scheduling bound of pickup, not after a full execution
+        assert time.monotonic() - resolved_at < 5.0
+        after = srv.session("quick").submit(plan, bindings)
+        after.result(timeout=30)
+        assert after.status == "served"
+        assert srv.stats()["cancelled"] == 1
+    assert lim.used == 0
+
+
+def test_explicit_cancel_unblocks_admission_wait():
+    """A query blocked INSIDE reserve_blocking cancels cooperatively:
+    the wait wakes within its poll interval, the ticket resolves
+    cancelled, and nothing leaks."""
+    plan, bindings = _q1_bindings(600)
+    lim = MemoryLimiter(1000)
+    lim.reserve(900)
+    with server.QueryServer(limiter=lim, max_inflight=1,
+                            admission_timeout_s=30.0) as srv:
+        t = srv.session("s").submit(plan, bindings, estimate_bytes=500)
+        time.sleep(0.2)  # let the worker park in reserve_blocking
+        t.cancel("client gave up")
+        start = time.monotonic()
+        with pytest.raises(resilience.QueryCancelled) as ei:
+            t.result(timeout=10)
+        assert time.monotonic() - start < 2.0
+        assert t.status == "cancelled"
+        assert ei.value.context.get("reason") == "client gave up"
+    assert lim.used == 900  # only the external hold remains
+    lim.release(900)
+
+
+def test_deadline_cancels_running_query_cooperatively():
+    """Deadline expiry mid-execution stops the query at its next
+    cooperative checkpoint (region or chunk boundary) and releases every
+    reservation it held."""
+    set_option("telemetry.enabled", True)
+    plan, bindings = _q1_bindings(600)
+    limiter = MemoryLimiter(1 << 26)
+    ctrl = degrade.DegradationController(limiter)
+    real = _q1_outofcore_factory(bindings, limiter)
+    token = resilience.CancelToken(150, label="mid-exec")
+
+    def runner(chunk_rows, tok):
+        time.sleep(0.3)  # outlive the deadline before chunking starts
+        return real(chunk_rows, tok)
+
+    q = degrade.DegradableQuery(plan, bindings, outofcore=runner)
+    script = faults.FaultScript([
+        faults.FaultSpec("fusion.region",
+                         resilience.ResourceExhausted("hbm"), seq=0),
+        faults.FaultSpec("fusion.region",
+                         resilience.ResourceExhausted("staged oom"), seq=1),
+    ])
+    with faults.inject(script), pytest.raises(resilience.QueryCancelled):
+        ctrl.execute(q, cancel_token=token)
+    assert limiter.used == 0
+
+
+# ---------------------------------------------------------------------------
+# 4. chaos sweep through the server
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [7, 23])
+def test_chaos_sweep_completes_or_dies_classified(seed):
+    """Seeded pressure chaos over q1/q3/q6 at ragged row counts through
+    the full server, interleaved across two sessions: every ticket is
+    either served bit-identical to its serial reference or fails with a
+    CLASSIFIED error (never silent, never unclassified); afterwards the
+    SAME server serves a clean query bit-identical, and zero
+    reservations leak."""
+    set_option("telemetry.enabled", True)
+    queries = []
+    for i, n in enumerate(RAGGED_IN_BUCKET):
+        plan, bindings = _q1_bindings(n, seed=i)
+        queries.append((plan, bindings, True))
+        queries.append((_q6_plan(),
+                        {"lineitem": tpch.lineitem_table(n, seed=i + 10)},
+                        False))
+        plan3, b3 = _q3_bindings(n, seed=i)
+        queries.append((plan3, b3, False))
+    refs = [fusion.execute(p, b).table for p, b, _ in queries]
+
+    script = faults.FaultScript(
+        seed=seed, rate=0.08, max_faults=6,
+        seams=("fusion.region", "dispatch.execute", "memory.reserve"),
+        exc=resilience.CapacityOverflow)
+    lim = MemoryLimiter(1 << 28)
+    classified = (resilience.ResilienceError, server.QueryRejected,
+                  MemoryError)
+    with server.QueryServer(limiter=lim, max_inflight=4) as srv:
+        with faults.inject(script):
+            tickets = []
+            for i, (plan, bindings, ooc) in enumerate(queries):
+                sess = srv.session("chaos-a" if i % 2 == 0 else "chaos-b")
+                tickets.append(sess.submit(
+                    plan, bindings,
+                    outofcore=_q1_outofcore_factory if ooc else None))
+            served = failed = 0
+            for i, (t, ref) in enumerate(zip(tickets, refs)):
+                try:
+                    res = t.result(timeout=180)
+                    _assert_same_answer(res.table, ref, f"chaos[{i}]")
+                    served += 1
+                except classified:
+                    failed += 1  # died classified: loud, never silent
+        assert served + failed == len(queries)
+        # chaos over: the same server still serves bit-identical
+        plan0, b0, _ = queries[0]
+        res = srv.session("after").submit(plan0, b0).result(timeout=60)
+        _assert_tables_identical(res.table, refs[0], "post-chaos")
+    assert lim.used == 0
+
+
+def test_server_degrades_query_bit_identical_with_events():
+    """End to end through the server: an injected pressure fault degrades
+    the query (visible degrade.step, stamped with the session), the
+    result is still bit-identical, and stats count the step."""
+    set_option("telemetry.enabled", True)
+    plan, bindings = _q1_bindings(600)
+    want = fusion.execute(plan, bindings).table
+    lim = MemoryLimiter(1 << 28)
+    script = faults.FaultScript([faults.FaultSpec(
+        "fusion.region", resilience.ResourceExhausted("hbm"), times=1)])
+    with faults.inject(script), server.QueryServer(
+            limiter=lim, max_inflight=2) as srv:
+        t = srv.session("d1").submit(plan, bindings)
+        res = t.result(timeout=60)
+        _assert_tables_identical(res.table, want, "server degrade")
+        steps = _degrade_events("step")
+        assert steps and steps[0]["tier"] == "staged"
+        assert steps[0]["session"] == "d1"
+        assert srv.session_stats("d1")["degrade_steps"] >= 1
+        assert srv.stats()["degrade_steps"] >= 1
+    assert lim.used == 0
+
+
+# ---------------------------------------------------------------------------
+# 5. crash-safe warm-start state
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_write_and_corrupt_discard(tmp_path):
+    path = str(tmp_path / "state.json")
+    atomic_write_json(path, {"a": 1.5})
+    obj, err = load_json(path)
+    assert obj == {"a": 1.5} and err is None
+    with open(path, "w") as f:
+        f.write('{"a": 1.')  # a torn write
+    obj, err = load_json(path)
+    assert obj is None and err
+    obj, err = load_json(str(tmp_path / "absent.json"))
+    assert obj is None and err is None
+
+
+def test_learned_estimates_persist_and_survive_corruption(tmp_path):
+    set_option("telemetry.enabled", True)
+    est_path = str(tmp_path / "learned_estimates.json")
+    set_option("server.estimate_path", est_path)
+    plan, bindings = _q1_bindings(600)
+    with server.QueryServer(budget_bytes=1 << 28, max_inflight=1) as srv:
+        srv.session("a").submit(plan, bindings).result(timeout=60)
+        learned = dict(srv._learned)
+        assert learned  # a measured working set was recorded
+    state, err = load_json(est_path)
+    assert err is None and state == pytest.approx(learned)
+    # a fresh process loads measured truth and admits from it
+    with server.QueryServer(budget_bytes=1 << 28, max_inflight=1) as srv2:
+        assert srv2._learned == pytest.approx(learned)
+        sig = srv2._plan_signature(plan, bindings)
+        est = srv2._default_estimate(plan, bindings)
+        assert est == int(srv2.estimate_headroom * learned[sig])
+    # corruption is discarded with a telemetry event, not a crash
+    with open(est_path, "w") as f:
+        f.write("{not json")
+    drain_events()
+    with server.QueryServer(budget_bytes=1 << 28, max_inflight=1) as srv3:
+        assert srv3._learned == {}
+        t = srv3.session("a").submit(plan, bindings)
+        t.result(timeout=60)
+        assert t.status == "served"
+    ev = _degrade_events("state_discarded")
+    assert ev and ev[0]["trigger"] == "corrupt"

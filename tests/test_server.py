@@ -198,9 +198,13 @@ def test_admission_timeout_rejects_and_releases_slot():
                             admission_timeout_s=0.3) as srv:
         ticket = srv.session("slow").submit(
             plan, bindings, estimate_bytes=500)
-        with pytest.raises(server.QueryRejected, match="admission timeout"):
+        with pytest.raises(server.QueryRejected, match="admission timeout") \
+                as ei:
             ticket.result(timeout=30)
         assert ticket.status == "rejected"
+        # a timed-out admission IS retryable: the hint is the window the
+        # client just waited, not "never"
+        assert ei.value.retry_after_s == pytest.approx(0.3)
         # the slot freed: a fitting query still serves afterwards
         ok = srv.session("slow").submit(plan, bindings, estimate_bytes=50)
         ok.result(timeout=60)
@@ -238,6 +242,62 @@ def test_full_session_queue_rejects_at_submit():
             if t not in rejected:
                 t.result(timeout=60)
                 assert t.status == "served"
+    assert lim.used == 0
+
+
+def test_rejection_is_structured_for_client_backoff():
+    """A QueryRejected carries everything a client needs to back off
+    sensibly: who, why, how deep the queue was, bytes requested vs
+    available, and a retry-after hint (None = retrying can NEVER
+    succeed) — and the rejected telemetry event carries the same."""
+    set_option("telemetry.enabled", True)
+    plan, bindings = _q1_bindings(600)
+    # shape 1 — never fits: estimate over the whole budget
+    with server.QueryServer(budget_bytes=10_000, max_inflight=1) as srv:
+        big = srv.session("big").submit(
+            plan, bindings, estimate_bytes=20_000)
+        with pytest.raises(server.QueryRejected) as ei:
+            big.result(timeout=5)
+        exc = ei.value
+        assert exc.session == "big"
+        assert "never fit" in exc.reason
+        assert exc.bytes_requested == 20_000
+        assert exc.bytes_available == 10_000
+        assert exc.retry_after_s is None  # structural: do not retry
+        assert exc.queue_depth == 0
+    # shape 2 — queue full: transient, retry-after is a real hint
+    lim = MemoryLimiter(1 << 20)
+    lim.reserve((1 << 20) - 1)  # wedge admission so the queue backs up
+    picked = threading.Event()
+
+    def probe(seam, seq, ctx):
+        if seam == "server.admit":
+            picked.set()
+
+    with faults.inject(probe), \
+            server.QueryServer(limiter=lim, max_inflight=1, queue_depth=1,
+                               admission_timeout_s=10.0) as srv:
+        sess = srv.session("burst")
+        first = sess.submit(plan, bindings, estimate_bytes=100)
+        assert picked.wait(10)  # the worker holds ticket 0 at admission
+        sess.submit(plan, bindings, estimate_bytes=100)  # fills the queue
+        bounced = sess.submit(plan, bindings, estimate_bytes=100)
+        assert bounced.status == "rejected"
+        with pytest.raises(server.QueryRejected) as ei:
+            bounced.result(timeout=5)
+        exc = ei.value
+        assert exc.session == "burst"
+        assert "queue full" in exc.reason
+        assert exc.queue_depth == 1
+        assert exc.bytes_requested == 100
+        assert exc.bytes_available == 1  # budget minus the wedge
+        assert exc.retry_after_s is not None and exc.retry_after_s >= 0.05
+        rej = [r for r in ring_events()
+               if r.get("kind") == "server" and r.get("event") == "rejected"]
+        assert rej and rej[-1]["queue_depth"] == 1
+        assert rej[-1]["bytes_available"] == 1
+        lim.release((1 << 20) - 1)
+        first.result(timeout=60)
     assert lim.used == 0
 
 

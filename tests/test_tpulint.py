@@ -271,8 +271,37 @@ def test_rule_server_session_id_scope(tmp_path):
     assert not _by_rule(_lint_file(target), "server-telemetry-session-id")
 
 
+def test_rule_reservation_release_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_reservation_memory.py"),
+                   "reservation-release-in-finally")
+    texts = [f.source_line for f in got]
+    assert len(got) == 2, texts
+    assert any("limiter.reserve(nbytes)" in t for t in texts)
+    assert any("reserve_blocking" in t for t in texts)
+    # finally-released, unwind-transfer, ownership-transfer, nested-worker,
+    # lock-release and pragma'd twins stay clean
+    src = (FIXTURES / "seeded_reservation_memory.py").read_text()
+    clean_at = src[:src.index("def clean_release_in_finally")].count("\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_reservation_release_scope(tmp_path):
+    # same constructions outside memory/server/degrade/outofcore basenames
+    # or runtime//parallel/ paths are host-side orchestration — out of scope
+    target = tmp_path / "plain_batch_job.py"
+    shutil.copy(FIXTURES / "seeded_reservation_memory.py", target)
+    assert not _by_rule(_lint_file(target), "reservation-release-in-finally")
+    # under a runtime/ path segment the same source fires regardless of
+    # basename — the rule guards the budget-accounting path, not a filename
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    target2 = rt / "plain_name.py"
+    shutil.copy(FIXTURES / "seeded_reservation_memory.py", target2)
+    assert _by_rule(_lint_file(target2), "reservation-release-in-finally")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all twelve rules demonstrably fire."""
+    """The acceptance invariant: all thirteen rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
@@ -295,6 +324,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_resilience_swallow.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_server_telemetry.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_reservation_memory.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

@@ -1,0 +1,80 @@
+"""Seeded violations for rule 13 (reservation-release-in-finally).
+
+The basename contains ``memory`` so the file is in scope the same way
+runtime/ and parallel/ modules are. Violations first, then clean twins
+past the ``def clean_`` marker the per-rule test splits on.
+"""
+
+
+def leaky_straightline(limiter, fn, nbytes):
+    limiter.reserve(nbytes)  # VIOLATION: fn() raising leaks the grant
+    out = fn()
+    limiter.release(nbytes)
+    return out
+
+
+def leaky_success_only_release(limiter, fn, nbytes):
+    ok = limiter.reserve_blocking(nbytes, timeout=1.0)  # VIOLATION
+    if not ok:
+        return None
+    result = fn()
+    if result is not None:
+        limiter.release(nbytes)
+    return result
+
+
+def clean_release_in_finally(limiter, fn, nbytes):
+    limiter.reserve(nbytes)
+    try:
+        return fn()
+    finally:
+        limiter.release(nbytes)
+
+
+def clean_unwind_transfers_ownership(limiter, stage, nbytes):
+    limiter.reserve(nbytes)
+    try:
+        # on success the CALLER owns the reservation (get_reserved idiom)
+        return stage(), nbytes
+    except BaseException:
+        limiter.release(nbytes)
+        raise
+
+
+def clean_ownership_transfer_no_release(limiter, nbytes):
+    # the grant leaves this function entirely: the consumer releases it
+    limiter.reserve(nbytes)
+    return nbytes
+
+
+def clean_nested_worker_released_by_parent(limiter, chunks, fn):
+    def worker(chunk):
+        limiter.reserve(chunk.nbytes)
+        return fn(chunk)
+
+    out = []
+    for chunk in chunks:
+        try:
+            out.append(worker(chunk))
+        finally:
+            limiter.release(chunk.nbytes)
+    return out
+
+
+def clean_lock_release_is_not_a_grant(limiter, lock, fn, nbytes):
+    lock.acquire()
+    limiter.reserve(nbytes)
+    try:
+        return fn()
+    finally:
+        limiter.release(nbytes)
+        lock.release()
+
+
+def clean_pragmad_leak(limiter, fn, nbytes):
+    # single-shot probe; the process exits right after
+    # tpulint: disable=reservation-release-in-finally
+    limiter.reserve(nbytes)
+    out = fn()
+    limiter.release(nbytes)
+    return out

@@ -1,4 +1,4 @@
-"""The twelve tpulint rules.
+"""The thirteen tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -880,6 +880,102 @@ def check_server_session_id(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 13: reservation-release-in-finally
+# ---------------------------------------------------------------------------
+
+_RESERVE_METHODS = {"reserve", "reserve_blocking"}
+
+
+def _is_reservation_scope_file(ctx: FileContext) -> bool:
+    path = "/" + str(ctx.path).replace("\\", "/")
+    return ("memory" in ctx.name or "server" in ctx.name
+            or "degrade" in ctx.name or "outofcore" in ctx.name
+            or "/runtime/" in path or "/parallel/" in path)
+
+
+def _top_functions(tree: ast.Module):
+    """Outermost function scopes only: a nested worker shares its
+    parent's unwind structure (the parent's finally releases what the
+    worker reserved), so the grant/release pairing is judged per
+    top-level function with every nested def folded in."""
+    out: list = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                out.append(child)
+            else:
+                visit(child)
+
+    visit(tree)
+    return out
+
+
+def check_reservation_release(ctx: FileContext) -> List[RawFinding]:
+    """ISSUE-8 bug class: a ``limiter.reserve(...)`` /
+    ``reserve_blocking(...)`` grant released only on the success path
+    leaks its bytes the first time the guarded work raises — the limiter
+    never drains, admission wedges at the high watermark, and every later
+    query parks forever (the exact failure the degradation ladder cannot
+    recover from, because the leaked usage is phantom). A function that
+    both reserves and releases on the same limiter object must put at
+    least one release in an exception-safe position: a ``finally`` block,
+    or an except handler that re-raises (the unwind-then-transfer idiom —
+    on success the caller owns the grant). A reserve with NO matching
+    release is ownership transfer and stays clean; ``.release()`` on
+    other objects (locks, semaphores) never pairs with a reserve and is
+    ignored. Scope: memory/server/degrade/outofcore basenames and the
+    ``runtime/``/``parallel/`` packages."""
+    if not _is_reservation_scope_file(ctx):
+        return []
+    out: List[RawFinding] = []
+    for fn in _top_functions(ctx.tree):
+        reserves: dict = {}
+        releases: dict = {}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            base = _unparse(node.func.value)
+            if node.func.attr in _RESERVE_METHODS:
+                reserves.setdefault(base, []).append(node)
+            elif node.func.attr == "release":
+                releases.setdefault(base, []).append(node)
+        if not reserves:
+            continue
+        # calls sitting in an exception-safe position: a finally block,
+        # or an except handler that re-raises (unwind path)
+        safe: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Try):
+                for s in node.finalbody:
+                    for n in ast.walk(s):
+                        safe.add(id(n))
+            elif isinstance(node, ast.ExceptHandler):
+                if any(isinstance(x, ast.Raise)
+                       for s in node.body for x in ast.walk(s)):
+                    for s in node.body:
+                        for n in ast.walk(s):
+                            safe.add(id(n))
+        for base, res_calls in reserves.items():
+            rels = releases.get(base, [])
+            if not rels:
+                continue  # ownership transfer: the consumer releases
+            if any(id(r) in safe for r in rels):
+                continue
+            for rc in res_calls:
+                out.append(RawFinding(
+                    rc.lineno, rc.col_offset,
+                    f"`{base}.{rc.func.attr}(...)` is released only on "
+                    f"the success path: an exception between grant and "
+                    f"release leaks the bytes and wedges admission at "
+                    f"the watermark; release in a `finally` (or an "
+                    f"except handler that re-raises, transferring "
+                    f"ownership on success)"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -928,4 +1024,9 @@ RULES = [
          "telemetry record_* calls in server-scope files must carry "
          "session attribution (session= kwarg or session_scope block)",
          check_server_session_id),
+    Rule("reservation-release-in-finally",
+         "a limiter reserve/reserve_blocking grant paired with a release "
+         "in the same function must release in a finally (or a "
+         "re-raising except handler); success-only releases leak bytes",
+         check_reservation_release),
 ]
