@@ -25,7 +25,11 @@ and fires the ``degrade.step`` fault seam, so chaos suites can script
 mid-degrade failures deterministically. Results are bit-identical at every
 tier — the ladder trades latency for survival, never correctness. A query
 that exhausts the ladder re-raises its ORIGINAL classified failure: no
-unclassified error ever leaves the controller.
+unclassified error ever leaves the controller. With ``donate_inputs=True``
+the controller also verifies the bound buffers are still live before each
+step — a genuine pressure failure that lands AFTER XLA consumed the
+donated inputs dies classified instead of replaying a lower tier against
+dead buffers.
 
 Deliberate stops are not failures: :class:`~.resilience.QueryCancelled`
 (deadline expiry or explicit cancel) passes straight through — a cancelled
@@ -73,11 +77,30 @@ class DegradableQuery(NamedTuple):
     outofcore: Optional[Callable[[int, object], object]] = None
 
 
+def _row_sliceable(table) -> bool:
+    """Can ``_row_slice`` chunk this table? Nested (children) columns and
+    string payloads without a per-row leading dimension cannot be sliced
+    by row range. :func:`row_chunked_tier` probes this EAGERLY when the
+    runner is built, so an unsliceable scan means "no rung-2 tier" at
+    ladder-construction time — never a lazy unclassified ValueError in
+    the middle of a degrade step."""
+    n = table.num_rows
+    for c in table.columns:
+        if c.children:
+            return False
+        chars = c.chars
+        if chars is not None and not (
+                getattr(chars, "ndim", 0) >= 1 and chars.shape[0] == n):
+            return False
+    return True
+
+
 def _row_slice(table, start: int, stop: int):
     """A row-range slice of a flat device table (the chunk source for the
     out-of-core rung). Nested (children) columns and non-row-major string
-    payloads are not sliceable this way and raise — the caller then simply
-    has no rung-2 tier, it never gets a wrong one."""
+    payloads are not sliceable this way and raise — ``row_chunked_tier``
+    screens them out up front with :func:`_row_sliceable`, so this raise
+    is a belt-and-suspenders guard, not a reachable path."""
     from spark_rapids_jni_tpu.columnar import Column, Table
 
     n = table.num_rows
@@ -114,7 +137,7 @@ def row_chunked_tier(
     limiter: MemoryLimiter,
     spill_budget_bytes: Optional[int] = None,
     spill_store: Optional[SpillStore] = None,
-) -> Callable[[int, object], object]:
+) -> Optional[Callable[[int, object], object]]:
     """Build a rung-2 out-of-core runner from a partial->merge algebra.
 
     ``bindings[chunk_scan]`` is the big table to stream in row chunks;
@@ -126,10 +149,24 @@ def row_chunked_tier(
     checkpointed through a :class:`SpillStore` — chunk-level
     checkpoint/resume (and the halving ladder above it) comes for free
     from ``run_chunked_aggregate``.
+
+    Returns ``None`` when the scan table is not row-sliceable (nested
+    LIST/STRUCT columns, string payloads without a per-row leading
+    dimension): the caller then has no rung-2 tier (fused -> staged ->
+    parked) — decided here, eagerly, so the ladder never discovers it as
+    an unclassified error mid-degrade.
     """
     from spark_rapids_jni_tpu.runtime.outofcore import run_chunked_aggregate
 
     table = bindings[chunk_scan]
+    if not _row_sliceable(table):
+        telemetry.record_degrade(
+            f"degrade.{chunk_scan}", "tier_unavailable", tier="outofcore",
+            trigger="not_row_sliceable", rung=2)
+        _log.info("row_chunked_tier: %r is not row-sliceable (nested or "
+                  "non-row-major string columns) — no rung-2 tier",
+                  chunk_scan)
+        return None
 
     def run(chunk_rows: int, cancel_token=None):
         n = int(table.num_rows)
@@ -148,6 +185,31 @@ def row_chunked_tier(
         return res.table
 
     return run
+
+
+def _bindings_live(bindings: dict) -> bool:
+    """Are every bound table's device buffers still alive? With
+    ``donate_inputs=True`` the fused executable donates input buffers to
+    XLA; the ``fusion.region`` seam fires before dispatch, so INJECTED
+    faults always leave the bindings intact — but a genuine failure
+    raised mid-execution can land after donation consumed them. Replaying
+    a lower tier against deleted arrays would compute garbage (or crash
+    unclassified), so the ladder checks liveness before every step and
+    dies with the original classified failure when donation already
+    happened. Arrays without ``is_deleted`` (numpy hosts) are live by
+    definition."""
+    def _col_live(c) -> bool:
+        for arr in (c.data, c.validity, c.chars):
+            deleted = getattr(arr, "is_deleted", None)
+            if deleted is not None and deleted():
+                return False
+        return all(_col_live(ch) for ch in (c.children or ()))
+
+    for v in bindings.values():
+        cols = getattr(v, "columns", None)
+        if cols is not None and not all(_col_live(c) for c in cols):
+            return False
+    return True
 
 
 def _pressure_kind(exc: BaseException) -> Optional[str]:
@@ -184,7 +246,7 @@ class DegradationController:
         self.session = str(session)
 
     def execute(self, query: DegradableQuery, *, cancel_token=None,
-                label: Optional[str] = None):
+                label: Optional[str] = None, held_bytes: int = 0):
         """Run ``query``; returns a ``fusion.FusedResult``.
 
         With ``degrade.enabled=false`` this is exactly
@@ -194,6 +256,12 @@ class DegradationController:
         ladder (bounded by ``degrade.max_steps``); anything else — and
         ``QueryCancelled`` always — re-raises immediately. Ladder
         exhaustion re-raises the ORIGINAL classified failure.
+
+        ``held_bytes`` is the caller's own outstanding limiter
+        reservation for this query (the serving runtime passes its
+        admission estimate): the parked rung subtracts it from the drain
+        threshold, so a query big enough to exceed the low watermark on
+        its own can still observe everyone else draining.
         """
         op = label or f"degrade.{getattr(query.plan, 'name', 'query')}"
         # session attribution rides as an extra field only when known —
@@ -249,7 +317,8 @@ class DegradationController:
                     drained = self.limiter.wait_below_low(
                         timeout=park_timeout,
                         cancel=None if cancel_token is None
-                        else cancel_token.event)
+                        else cancel_token.event,
+                        own_held=held_bytes)
                     if cancel_token is not None:
                         cancel_token.check("degrade.park")
                     if not drained:
@@ -273,6 +342,17 @@ class DegradationController:
                 if kind is None:
                     raise
                 original = original or exc
+                if query.donate_inputs and not _bindings_live(
+                        query.bindings):
+                    # the failed attempt already donated the inputs to
+                    # XLA: every lower tier would replay against dead
+                    # buffers — die with the classified failure instead
+                    telemetry.record_degrade(
+                        op, "exhausted", tier=tier, trigger=kind,
+                        rung=steps, donated=True, **attrs)
+                    if exc is original:
+                        raise
+                    raise original from exc
                 steps += 1
                 if steps > max_steps:
                     telemetry.record_degrade(

@@ -71,6 +71,19 @@ class MemoryLimitExceeded(MemoryError):
     pass
 
 
+class _Waiter:
+    """One blocked ``reserve_blocking`` ticket. The ``admission`` flag is
+    what lets the head-of-line check distinguish a pressure-parked
+    admission (which must NOT hold the FIFO line — the in-flight work
+    behind it is what drains the pressure) from an ordinarily blocked
+    reservation (which must)."""
+
+    __slots__ = ("admission",)
+
+    def __init__(self, admission: bool):
+        self.admission = bool(admission)
+
+
 class MemoryLimiter:
     """Soft budget gate with capped-pool semantics: ``reserve`` beyond the
     budget raises (fail-fast, like a capped RMM pool) instead of letting a
@@ -84,8 +97,10 @@ class MemoryLimiter:
     are proactively spilled, and ``reserve_blocking(..., admission=True)``
     callers (the serving runtime's admission gate) park until usage drains
     back below the low watermark. Non-admission reservations (pipeline
-    chunks of already-running queries) are never paused, so in-flight work
-    keeps draining toward the low watermark instead of deadlocking.
+    chunks of already-running queries) are never paused — a pressure-parked
+    admission ticket does not even hold the FIFO line against them — so
+    in-flight work keeps draining toward the low watermark instead of
+    deadlocking behind the very admission that is waiting for it.
     """
 
     def __init__(self, budget_bytes: int, *,
@@ -107,7 +122,7 @@ class MemoryLimiter:
         # FIFO queue of blocked reserve_blocking tickets: budget freed by a
         # release is offered to the longest-waiting reserver first, so a
         # small late request cannot barge past a large early one forever
-        self._waiters: "collections.deque[object]" = collections.deque()
+        self._waiters: "collections.deque[_Waiter]" = collections.deque()
 
     @property
     def used(self) -> int:
@@ -147,6 +162,23 @@ class MemoryLimiter:
         # a misconfigured low > high would make pressure un-clearable the
         # moment it is entered; clamp instead of wedging admission
         return min(int(self.budget * frac), self._high_bytes())
+
+    def _held_back_locked(self, ticket: "_Waiter") -> bool:
+        """Under the lock: is an EARLIER waiter legitimately holding the
+        FIFO line against ``ticket``? Pressure-parked admission tickets
+        (admission waiters while the limiter is in the pressure state) do
+        not hold the line — the non-admission reservations behind them
+        belong to in-flight queries whose releases are the only thing that
+        can drain the pressure, so blocking them would wedge the limiter
+        until the admission timeout. Parked admissions keep their queue
+        position: the moment pressure clears they are the head again and
+        ordinary no-barge FIFO resumes."""
+        for w in self._waiters:
+            if w is ticket:
+                return False
+            if not (w.admission and self._pressure):
+                return True
+        return False
 
     def _note_grant_locked(self) -> bool:
         """Called under the lock after ``_used`` grew; returns True exactly
@@ -240,7 +272,9 @@ class MemoryLimiter:
         the pressure state, admission reservations park until usage
         drains below the low watermark even if the bytes would fit.
         Plain reservations (chunks of already-admitted queries) ignore
-        pressure so in-flight work keeps draining.
+        pressure AND flow past pressure-parked admission tickets in the
+        queue — in-flight work keeps draining; the parked admission keeps
+        its FIFO position for when pressure clears.
         """
         faults.fire("memory.reserve", nbytes, blocking=True)
         if nbytes > self.budget:
@@ -249,14 +283,15 @@ class MemoryLimiter:
                 f"({self.budget}): can never fit"
             )
         deadline = None if timeout is None else time.monotonic() + timeout
-        ticket = object()
+        ticket = _Waiter(admission)
         with self._lock:
             self._waiters.append(ticket)
             try:
-                # grant only at head-of-line AND when the bytes fit: a
-                # blocked earlier ticket holds back every later one, which
-                # is exactly the no-barge property
-                while (self._waiters[0] is not ticket
+                # grant only when no earlier ticket holds the line AND the
+                # bytes fit: a blocked earlier ticket holds back every
+                # later one (the no-barge property) — except a pressure-
+                # parked admission, which in-flight reservations bypass
+                while (self._held_back_locked(ticket)
                        or self._used + nbytes > self.budget
                        or (admission and self._pressure)):
                     if cancel is not None and cancel.is_set():
@@ -288,15 +323,20 @@ class MemoryLimiter:
         return True
 
     def wait_below_low(self, timeout: "float | None" = None,
-                       cancel=None) -> bool:
+                       cancel=None, own_held: int = 0) -> bool:
         """Park until usage drains below the low watermark — the
         park-and-retry ladder rung's drain wait (runtime/degrade.py).
-        Returns True once drained, False if ``cancel`` (anything with
-        ``is_set()``) fired or ``timeout`` seconds elapsed first;
+        ``own_held`` is the caller's OWN outstanding reservation (the
+        serving runtime's admission estimate): it is subtracted from the
+        drain threshold, because a query whose own hold exceeds the low
+        watermark could otherwise never observe the drain it is waiting
+        for. Returns True once drained, False if ``cancel`` (anything
+        with ``is_set()``) fired or ``timeout`` seconds elapsed first;
         cancellation is polled (~50ms), same as ``reserve_blocking``."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        own = max(int(own_held), 0)
         with self._lock:
-            while self._used > self._low_bytes():
+            while self._used - own > self._low_bytes():
                 if cancel is not None and cancel.is_set():
                     return False
                 wait = 0.05

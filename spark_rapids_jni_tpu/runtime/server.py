@@ -51,14 +51,16 @@ Contracts, in order of importance:
   ``fusion.estimate_hbm_bytes`` base for future submits, persisted
   crash-safely beside the dispatch persistent cache
   (``server.estimate_path``), so a fresh process admits from measured
-  truth.
+  truth. Persistence is debounced off the hot path (at most one write
+  per ``server.estimate_save_interval_s``; ``close()`` flushes).
 
 Config knobs (utils/config.py, env ``SPARK_RAPIDS_TPU_SERVER_*``):
 ``server.max_inflight``, ``server.hbm_budget_bytes``,
 ``server.admission_timeout_s``, ``server.queue_depth``,
 ``server.estimate_headroom``, ``server.deadline_ms``,
-``server.estimate_alpha``, ``server.estimate_path``; the ladder's own
-knobs are ``degrade.*`` (utils/config.py).
+``server.estimate_alpha``, ``server.estimate_path``,
+``server.estimate_save_interval_s``; the ladder's own knobs are
+``degrade.*`` (utils/config.py).
 """
 
 from __future__ import annotations
@@ -247,6 +249,8 @@ class QueryServer:
         # file beside the dispatch persistent cache
         self._learned_lock = threading.Lock()
         self._learned: dict[str, float] = {}
+        self._learned_dirty = False
+        self._last_save: Optional[float] = None  # None = never saved
         self._estimate_path = self._resolve_estimate_path()
         self._load_learned()
         self._cond = threading.Condition()
@@ -474,12 +478,19 @@ class QueryServer:
         if not self._estimate_path:
             return
         with self._learned_lock:
+            if not self._learned_dirty:
+                return
             snapshot = dict(self._learned)
+            self._learned_dirty = False
+        self._last_save = time.monotonic()
         try:
             atomic_write_json(self._estimate_path, snapshot)
         except OSError as exc:
             # warm-start state is an optimization; losing a write only
-            # costs the next process a cold estimate, never a query
+            # costs the next process a cold estimate, never a query —
+            # but stay dirty so close() (or the next interval) retries
+            with self._learned_lock:
+                self._learned_dirty = True
             REGISTRY.counter("server.estimate_state_write_error").inc()
             _log.warning("could not persist learned estimates to %s: %s",
                          self._estimate_path, exc)
@@ -500,7 +511,12 @@ class QueryServer:
                        result) -> None:
         """Blend this query's measured working set (input + result device
         bytes — the floor on its true peak; headroom covers
-        intermediates) into the signature's EMA and write through."""
+        intermediates) into the signature's EMA. Persistence is
+        debounced: at most one fsynced write per
+        ``server.estimate_save_interval_s`` on the serving path (the
+        first learn saves immediately; ``close()`` flushes the rest) —
+        two synchronous fsyncs per served query is tail latency the hot
+        path does not owe a warm-start optimization."""
         try:
             actual = _table_nbytes(result.table)
             for v in bindings.values():
@@ -514,7 +530,11 @@ class QueryServer:
             prev = self._learned.get(sig)
             self._learned[sig] = float(actual) if prev is None \
                 else (1.0 - alpha) * prev + alpha * float(actual)
-        self._save_learned()
+            self._learned_dirty = True
+        interval = float(get_option("server.estimate_save_interval_s"))
+        if (interval <= 0 or self._last_save is None
+                or time.monotonic() - self._last_save >= interval):
+            self._save_learned()
 
     def _default_estimate(self, plan: fusion.Plan, bindings: dict) -> int:
         """Headroom x the measured-truth EMA for this plan signature when
@@ -662,12 +682,15 @@ class QueryServer:
                 bindings = self._stage_bindings(ticket.bindings)
                 runner = None if ticket.outofcore is None \
                     else ticket.outofcore(bindings, self.limiter)
+                # held_bytes: the parked rung must discount this query's
+                # own admission reservation from the drain threshold, or
+                # a query bigger than the low watermark parks forever
                 result = self.degrader.execute(
                     degrade.DegradableQuery(
                         ticket.plan, bindings,
                         donate_inputs=ticket.donate_inputs,
                         outofcore=runner),
-                    cancel_token=token)
+                    cancel_token=token, held_bytes=held)
             ticket.latency_s = time.monotonic() - ticket._submitted_at
             lat_ms = ticket.latency_s * 1e3
             REGISTRY.histogram("server.latency_ms").observe(lat_ms)

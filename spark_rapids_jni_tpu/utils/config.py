@@ -150,6 +150,11 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # dispatch persistent cache when that is configured, else unpersisted).
     # Writes are crash-safe: tmp file + os.replace + fsync.
     "server.estimate_path": ("", str),
+    # Minimum seconds between learned-estimate persistence writes on the
+    # serving path (the fsync pair is tail latency, not serving work);
+    # the first learn saves immediately and close() always flushes.
+    # <= 0 writes through on every served query.
+    "server.estimate_save_interval_s": (5.0, float),
 }
 
 _overrides: dict[str, Any] = {}

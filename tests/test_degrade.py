@@ -57,6 +57,7 @@ _RESET = (
     "server.admission_timeout_s", "server.queue_depth",
     "server.estimate_headroom", "server.deadline_ms",
     "server.estimate_alpha", "server.estimate_path",
+    "server.estimate_save_interval_s",
     "degrade.enabled", "degrade.max_steps", "degrade.park_timeout_s",
     "degrade.chunk_rows", "memory.high_watermark", "memory.low_watermark",
     "resilience.enabled", "resilience.max_attempts", "telemetry.enabled",
@@ -299,6 +300,102 @@ def test_park_rung_timeout_exhausts_with_original_error():
     assert limiter.used == 0
 
 
+def test_park_rung_drains_past_own_reservation():
+    """The parked rung discounts the query's OWN admission reservation:
+    a query whose estimate alone exceeds the low watermark still observes
+    everyone else's drain (here: nothing else is held, so the drain is
+    immediate) instead of burning the whole park timeout."""
+    set_option("telemetry.enabled", True)
+    set_option("degrade.park_timeout_s", 20.0)
+    plan, bindings = _q1_bindings(600)
+    want = fusion.execute(plan, bindings).table
+    limiter = MemoryLimiter(1000, high_watermark=0.8, low_watermark=0.3)
+    limiter.reserve(500)  # the query's own admission hold: 500 > low=300
+    ctrl = degrade.DegradationController(limiter)
+    q = degrade.DegradableQuery(plan, bindings)
+    script = faults.FaultScript([
+        faults.FaultSpec("fusion.region",
+                         resilience.ResourceExhausted("hbm"), seq=0),
+        faults.FaultSpec("fusion.region",
+                         resilience.ResourceExhausted("staged oom"), seq=1),
+    ])
+    t0 = time.monotonic()
+    try:
+        with faults.inject(script):
+            res = ctrl.execute(q, held_bytes=500)
+    finally:
+        limiter.release(500)
+    # the drain is observed immediately, not after park_timeout_s
+    assert time.monotonic() - t0 < 10.0
+    _assert_tables_identical(res.table, want, "own-reservation park")
+    assert _degrade_events("parked")
+    assert _degrade_events("resumed")
+    assert limiter.used == 0
+
+
+def test_donated_dead_bindings_die_classified_not_replayed():
+    """With donate_inputs=True, a pressure failure that lands AFTER the
+    donated input buffers were consumed must re-raise classified — a
+    lower tier replaying against dead buffers would compute garbage."""
+
+    class _DeadArray:
+        ndim = 1
+        shape = (4,)
+
+        @staticmethod
+        def is_deleted():
+            return True
+
+    class _DeadColumn:
+        data = _DeadArray()
+        validity = None
+        chars = None
+        children = None
+
+    class _DeadTable:
+        columns = [_DeadColumn()]
+        num_rows = 4
+
+    assert degrade._bindings_live({"t": _DeadTable()}) is False
+    set_option("telemetry.enabled", True)
+    plan, _ = _q1_bindings(600)
+    limiter = MemoryLimiter(1 << 26)
+    ctrl = degrade.DegradationController(limiter)
+    q = degrade.DegradableQuery(
+        plan, {"lineitem": _DeadTable()}, donate_inputs=True)
+    boom = resilience.ResourceExhausted("oom after donation")
+    script = faults.FaultScript([
+        faults.FaultSpec("fusion.region", boom, times=1)])
+    with faults.inject(script), pytest.raises(
+            resilience.ResourceExhausted) as ei:
+        ctrl.execute(q)
+    assert ei.value is boom  # classified, not a dead-buffer crash
+    ev = _degrade_events("exhausted")
+    assert ev and ev[0].get("donated") is True
+    assert _degrade_events("step") == []  # no tier ever replayed
+    assert limiter.used == 0
+
+
+def test_row_chunked_tier_unsliceable_scan_has_no_rung2():
+    """String/nested scans are screened out EAGERLY: the factory returns
+    None (query has no rung 2), never a lazy mid-degrade ValueError."""
+    from spark_rapids_jni_tpu import types as t
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.ops.lists import make_list_column
+
+    limiter = MemoryLimiter(1 << 20)
+    ident = lambda x: x  # noqa: E731
+    strings = Table([Column.from_pylist(["a", "bb", "ccc"], t.STRING)])
+    assert degrade.row_chunked_tier(
+        {"scan": strings}, "scan", ident, ident, limiter=limiter) is None
+    nested = Table([make_list_column([[1], [2, 3]], t.INT64)])
+    assert degrade.row_chunked_tier(
+        {"scan": nested}, "scan", ident, ident, limiter=limiter) is None
+    # a flat numeric scan still builds a runner
+    _, bindings = _q1_bindings(64)
+    assert _q1_outofcore_factory(bindings, limiter) is not None
+
+
 def test_degrade_step_seam_can_inject_mid_degrade():
     """A fault injected AT the degrade.step seam propagates — one
     recovery at a time, never a recursive ladder."""
@@ -387,6 +484,35 @@ def test_high_watermark_spills_coldest_and_pauses_admission():
     assert limiter.used == 0
     # the spilled entry restores bit-identical
     _assert_tables_identical(store.get(h_cold), cold, "unspilled")
+
+
+def test_inflight_reservation_bypasses_parked_admission():
+    """A pressure-parked admission ticket must NOT hold the FIFO line:
+    non-admission chunk reservations from in-flight queries flow past it
+    (their releases are the only thing that can drain the pressure), and
+    the parked admission keeps its position for when pressure clears."""
+    limiter = MemoryLimiter(100_000, high_watermark=0.5, low_watermark=0.25)
+    limiter.attach_spill_store(SpillStore(1 << 20))
+    limiter.reserve(60_000)  # crosses high (50k) -> pressure
+    assert limiter.pressure
+    admitted = []
+    parked = threading.Thread(
+        target=lambda: admitted.append(
+            limiter.reserve_blocking(10_000, admission=True, timeout=20)))
+    parked.start()
+    deadline = time.monotonic() + 5
+    while not limiter._waiters and time.monotonic() < deadline:
+        time.sleep(0.01)  # wait until the admission ticket is queued
+    assert limiter._waiters, "admission ticket never queued"
+    # the in-flight (non-admission) reservation is NOT stuck behind it
+    assert limiter.reserve_blocking(5_000, timeout=1.0) is True
+    limiter.release(5_000)
+    # draining below low clears pressure and the parked admission admits
+    limiter.release(60_000)
+    parked.join(timeout=10)
+    assert admitted == [True]
+    limiter.release(10_000)
+    assert limiter.used == 0
 
 
 def test_watermarks_inert_without_store_or_when_disabled():
@@ -589,6 +715,28 @@ def test_atomic_write_and_corrupt_discard(tmp_path):
     assert obj is None and err
     obj, err = load_json(str(tmp_path / "absent.json"))
     assert obj is None and err is None
+
+
+def test_learned_estimate_saves_are_debounced(tmp_path):
+    """Persistence is off the hot path: the first learn writes through,
+    later learns within the save interval only dirty the in-memory state,
+    and close() flushes whatever is pending."""
+    est_path = str(tmp_path / "learned_estimates.json")
+    set_option("server.estimate_path", est_path)
+    set_option("server.estimate_save_interval_s", 3600.0)
+    plan_a, bindings_a = _q1_bindings(600)
+    plan_b, bindings_b = _q1_bindings(1400)  # a different pow2 signature
+    with server.QueryServer(budget_bytes=1 << 28, max_inflight=1) as srv:
+        srv.session("a").submit(plan_a, bindings_a).result(timeout=60)
+        srv.session("a").submit(plan_b, bindings_b).result(timeout=60)
+        on_disk, err = load_json(est_path)
+        assert err is None
+        # first learn wrote through; the second is debounced (dirty only)
+        assert set(on_disk) == {srv._plan_signature(plan_a, bindings_a)}
+        assert len(srv._learned) == 2
+        final = dict(srv._learned)
+    on_disk, err = load_json(est_path)  # close() flushed the dirty state
+    assert err is None and on_disk == pytest.approx(final)
 
 
 def test_learned_estimates_persist_and_survive_corruption(tmp_path):
