@@ -543,6 +543,40 @@ def _server_block() -> dict:
                         sum(waits) / sum(lats), 4) if sum(lats) else None,
                 }
             block["leaked_bytes"] = srv.limiter.used
+
+            # span-derived phase breakdown + the tracing-overhead number:
+            # the same sequential workload runs twice — telemetry (spans)
+            # off, then on — so the wall delta IS what tracing costs; the
+            # instrumented pass's ring records give the per-phase wall
+            # attribution (admission / queue / decode / compute / merge).
+            from spark_rapids_jni_tpu import telemetry as _telemetry
+            from spark_rapids_jni_tpu.telemetry import spans as _spans
+            from spark_rapids_jni_tpu.utils.config import (get_option,
+                                                           set_option)
+
+            probe_n = 8
+            sess = srv.session("phase_probe")
+
+            def _seq_pass():
+                t0 = time.perf_counter()
+                for _ in range(probe_n):
+                    sess.submit(plan, bindings).result(timeout=300)
+                return time.perf_counter() - t0
+
+            prev_tel = get_option("telemetry.enabled")
+            try:
+                set_option("telemetry.enabled", False)
+                off_wall = _seq_pass()
+                set_option("telemetry.enabled", True)
+                _telemetry.drain()
+                on_wall = _seq_pass()
+                recs = _telemetry.drain()
+            finally:
+                set_option("telemetry.enabled", prev_tel)
+            block["phases"] = _spans.phase_breakdown(recs)
+            block["tracing_overhead_frac"] = (round(
+                max(0.0, on_wall / off_wall - 1.0), 4)
+                if off_wall else None)
     except Exception:  # probe failure must never cost the bench record
         pass
     return block
@@ -573,12 +607,14 @@ def _degrade_block() -> dict:
         import contextlib as _contextlib
         import threading as _threading
 
+        from spark_rapids_jni_tpu import telemetry as _telemetry
         from spark_rapids_jni_tpu.models import tpch
         from spark_rapids_jni_tpu.runtime import degrade as _degrade
         from spark_rapids_jni_tpu.runtime import faults as _faults
         from spark_rapids_jni_tpu.runtime import resilience as _resilience
         from spark_rapids_jni_tpu.runtime import server as _server
         from spark_rapids_jni_tpu.telemetry import REGISTRY
+        from spark_rapids_jni_tpu.telemetry import spans as _spans
         from spark_rapids_jni_tpu.utils.config import get_option, set_option
 
         rows = 1 << 12
@@ -618,6 +654,7 @@ def _degrade_block() -> dict:
                                          max_inflight=conc) as srv:
                     srv.session("warm").submit(plan, bindings).result(
                         timeout=300)
+                    _telemetry.drain()  # warm-up spans out of the ring
 
                     def _client(i):
                         sess = srv.session(f"deg_c{i}")
@@ -668,6 +705,9 @@ def _degrade_block() -> dict:
                         "parked": delta["degrade.tier.parked"],
                     },
                     "leaked_bytes": leaked,
+                    # where the wall went at this pressure level, from the
+                    # level's own span records (ring drained after warm-up)
+                    "phases": _spans.phase_breakdown(_telemetry.drain()),
                 }
         finally:
             set_option("telemetry.enabled", prev_tel)

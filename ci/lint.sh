@@ -1,7 +1,7 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its thirteen invariant rules (host/device
+# tpulint (tools/tpulint) runs its fourteen invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
@@ -267,4 +267,120 @@ assert REGISTRY.counter("degrade.tier.outofcore").value >= 1, \
 assert limiter.used == 0, f"leaked {limiter.used} reserved bytes"
 print(f"degrade smoke OK: fused -> staged -> outofcore bit-identical, "
       f"{steps} steps, 0 leaked bytes")
+EOF
+
+# trace smoke: rule 14 only proves spans are SCOPED — this proves the
+# tracing layer itself still honors its contract end-to-end: one q1
+# served through the QueryServer under injected pressure emits a
+# causally-parented span tree (query -> admission wait -> degrade rungs
+# -> out-of-core chunks), the tree exports as Chrome-trace JSON via the
+# CLI, the degradation step dumps a flight-recorder artifact, the answer
+# stays bit-identical to the fused reference, and zero bytes leak.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import glob
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.runtime import degrade, faults, fusion, resilience
+from spark_rapids_jni_tpu.runtime import server
+from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter
+from spark_rapids_jni_tpu.telemetry import __main__ as tele_cli
+from spark_rapids_jni_tpu.telemetry import spans
+from spark_rapids_jni_tpu.telemetry.report import load_jsonl
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+plan = tpch._q1_plan()
+bindings = {"lineitem": tpch.lineitem_table(300)}
+ref = fusion.execute(plan, bindings).table
+
+tmp = tempfile.mkdtemp(prefix="trace_smoke_")
+jsonl = os.path.join(tmp, "run.jsonl")
+chrome = os.path.join(tmp, "trace.json")
+
+# distinct instances (see degrade smoke): fused dies, staged dies, the
+# out-of-core rung finishes the query — three rungs, one span tree
+script = faults.FaultScript([
+    faults.FaultSpec("fusion.region",
+                     resilience.ResourceExhausted("injected pressure"),
+                     seq=0),
+    faults.FaultSpec("fusion.region",
+                     resilience.ResourceExhausted("injected pressure"),
+                     seq=1),
+])
+
+set_option("telemetry.enabled", True)
+set_option("telemetry.path", jsonl)
+set_option("telemetry.flight_recorder_path", tmp)
+set_option("degrade.chunk_rows", 128)
+try:
+    with server.QueryServer(limiter=MemoryLimiter(1 << 26),
+                            max_inflight=1) as srv:
+        def runner(staged_bindings, limiter):
+            return degrade.row_chunked_tier(
+                staged_bindings, "lineitem", *tpch.q1_row_chunked_fns(),
+                limiter=limiter, spill_store=srv.spill_store)
+
+        with faults.inject(script):
+            ticket = srv.submit("smoke", plan, bindings, outofcore=runner)
+            res = ticket.result(timeout=300)
+        assert ticket.status == "served", ticket.status
+    # read AFTER close(): the worker's release runs in its finally, which
+    # the ticket result does not wait for — close() drains the workers
+    leaked = srv.limiter.used
+finally:
+    reset_option("telemetry.enabled")
+    reset_option("telemetry.path")
+    reset_option("telemetry.flight_recorder_path")
+    reset_option("degrade.chunk_rows")
+
+
+def valid_rows(t):
+    cols = [(np.asarray(t.column(i).valid_mask()),
+             np.asarray(t.column(i).data)) for i in range(t.num_columns)]
+    return [tuple((bool(v[r]), d[r].item() if v[r] else None)
+                  for v, d in cols)
+            for r in np.flatnonzero(cols[0][0])]
+
+
+assert valid_rows(res.table) == valid_rows(ref), \
+    "traced out-of-core answer diverged from the fused reference"
+assert leaked == 0, f"leaked {leaked} reserved bytes"
+
+records = load_jsonl(jsonl)
+assert spans.validate(records) == [], spans.validate(records)
+span_recs = [r for r in records if r.get("kind") == "span"]
+names = [r["op"] for r in span_recs]
+for needed in ("admission.wait", "rung.fused", "rung.staged",
+               "rung.outofcore", "outofcore.chunk", "outofcore.merge"):
+    assert needed in names, f"missing span {needed!r} in {sorted(set(names))}"
+roots = [r for r in span_recs if r.get("parent") is None]
+assert len(roots) == 1 and roots[0]["op"].startswith("query."), roots
+assert roots[0]["status"] == "degraded", roots[0]
+# causal ordering: the root opens before anything nested under it, and
+# the fused rung is attempted before the ladder steps down
+t0 = {r["op"]: r["t0"] for r in span_recs}
+assert roots[0]["t0"] <= t0["admission.wait"], "root opened after admission"
+assert t0["rung.fused"] <= t0["rung.staged"] <= t0["rung.outofcore"], \
+    "degrade rungs out of order"
+
+rc = tele_cli.main(["trace", jsonl, chrome])
+assert rc == 0, f"trace export exited {rc}"
+with open(chrome, "r", encoding="utf-8") as fh:
+    trace = json.load(fh)
+events = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+assert len(events) == len(span_recs), (len(events), len(span_recs))
+
+flights = glob.glob(os.path.join(tmp, "flight-*degrade_step*.json"))
+assert flights, "no flight-recorder artifact for the degradation step"
+with open(flights[0], "r", encoding="utf-8") as fh:
+    art = json.load(fh)
+assert art["trigger"] == "degrade_step" and art["tree"]["name"].startswith(
+    "query."), art["trigger"]
+print(f"trace smoke OK: {len(span_recs)} spans, 1 causal tree, "
+      f"{len(flights)} flight record(s), chrome trace parses, "
+      f"bit-identical, 0 leaked bytes")
 EOF

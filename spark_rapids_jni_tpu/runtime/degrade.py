@@ -47,6 +47,7 @@ from typing import Callable, NamedTuple, Optional
 from spark_rapids_jni_tpu import telemetry
 from spark_rapids_jni_tpu.runtime import faults, fusion, resilience
 from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter, SpillStore
+from spark_rapids_jni_tpu.telemetry import spans
 from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.log import get_logger
 
@@ -246,7 +247,9 @@ class DegradationController:
         self.session = str(session)
 
     def execute(self, query: DegradableQuery, *, cancel_token=None,
-                label: Optional[str] = None, held_bytes: int = 0):
+                label: Optional[str] = None, held_bytes: int = 0,
+                observer: Optional[Callable[[str, int, int,
+                                             Optional[int]], None]] = None):
         """Run ``query``; returns a ``fusion.FusedResult``.
 
         With ``degrade.enabled=false`` this is exactly
@@ -262,6 +265,12 @@ class DegradationController:
         admission estimate): the parked rung subtracts it from the drain
         threshold, so a query big enough to exceed the low watermark on
         its own can still observe everyone else draining.
+
+        ``observer`` (optional) is called as ``observer(tier, rung,
+        steps, chunk_rows)`` at the start of every tier attempt —
+        including ``parked`` — independent of telemetry enablement; the
+        serving runtime uses it to keep :meth:`QueryServer.inspect`
+        current without the controller knowing about servers.
         """
         op = label or f"degrade.{getattr(query.plan, 'name', 'query')}"
         # session attribution rides as an extra field only when known —
@@ -290,48 +299,69 @@ class DegradationController:
 
         while True:
             tier = tiers[min(rung, len(tiers) - 1)]
+            if observer is not None:
+                observer(tier, rung, steps,
+                         chunk_rows if tier == "outofcore" else None)
             try:
-                if tier == "fused":
-                    # the controller owns the fused->staged transition
-                    # under pressure: surface those failures so the step
-                    # is visible (degrade.step) rather than silent;
-                    # non-pressure faults keep the PR-6 staged fallback
-                    result = fusion.execute(
-                        query.plan, query.bindings,
-                        donate_inputs=query.donate_inputs,
-                        surface_pressure=True,
-                        cancel_token=cancel_token)
-                elif tier == "staged":
-                    result = fusion.execute(
-                        query.plan, query.bindings,
-                        donate_inputs=query.donate_inputs,
-                        force_staged=True, cancel_token=cancel_token)
-                elif tier == "outofcore":
-                    table = query.outofcore(chunk_rows, cancel_token)
-                    result = fusion.FusedResult(
-                        table, {"degrade.chunk_rows": chunk_rows})
-                else:  # parked
-                    telemetry.record_degrade(
-                        op, "parked", tier="parked", trigger=trigger,
-                        rung=steps, **attrs)
-                    drained = self.limiter.wait_below_low(
-                        timeout=park_timeout,
-                        cancel=None if cancel_token is None
-                        else cancel_token.event,
-                        own_held=held_bytes)
-                    if cancel_token is not None:
-                        cancel_token.check("degrade.park")
-                    if not drained:
-                        telemetry.record_degrade(
-                            op, "exhausted", tier="parked", trigger=trigger,
-                            rung=steps, **attrs)
-                        raise original  # noqa: TRY301 — the classified cause
-                    telemetry.record_degrade(
-                        op, "resumed", tier="parked", trigger=trigger,
-                        rung=steps, **attrs)
-                    # retry the most degraded EXECUTABLE tier after drain
-                    rung = len(tiers) - 2
-                    continue
+                with spans.child(f"rung.{tier}", tier=tier, rung=rung,
+                                 step=steps) as rspan:
+                    try:
+                        if tier == "fused":
+                            # the controller owns the fused->staged
+                            # transition under pressure: surface those
+                            # failures so the step is visible
+                            # (degrade.step) rather than silent;
+                            # non-pressure faults keep the PR-6 staged
+                            # fallback
+                            result = fusion.execute(
+                                query.plan, query.bindings,
+                                donate_inputs=query.donate_inputs,
+                                surface_pressure=True,
+                                cancel_token=cancel_token)
+                        elif tier == "staged":
+                            result = fusion.execute(
+                                query.plan, query.bindings,
+                                donate_inputs=query.donate_inputs,
+                                force_staged=True,
+                                cancel_token=cancel_token)
+                        elif tier == "outofcore":
+                            table = query.outofcore(
+                                chunk_rows, cancel_token)
+                            result = fusion.FusedResult(
+                                table, {"degrade.chunk_rows": chunk_rows})
+                        else:  # parked
+                            telemetry.record_degrade(
+                                op, "parked", tier="parked",
+                                trigger=trigger, rung=steps, **attrs)
+                            drained = self.limiter.wait_below_low(
+                                timeout=park_timeout,
+                                cancel=None if cancel_token is None
+                                else cancel_token.event,
+                                own_held=held_bytes)
+                            if cancel_token is not None:
+                                cancel_token.check("degrade.park")
+                            if not drained:
+                                telemetry.record_degrade(
+                                    op, "exhausted", tier="parked",
+                                    trigger=trigger, rung=steps, **attrs)
+                                raise original  # noqa: TRY301 — the classified cause
+                            telemetry.record_degrade(
+                                op, "resumed", tier="parked",
+                                trigger=trigger, rung=steps, **attrs)
+                            # retry the most degraded EXECUTABLE tier
+                            # after drain
+                            rung = len(tiers) - 2
+                            continue
+                    except resilience.QueryCancelled:
+                        raise
+                    except BaseException as exc:
+                        # a pressure-classified failure is the ladder
+                        # working as designed, not this rung dying —
+                        # record it as "degraded" in the tree
+                        if (exc is not original
+                                and _pressure_kind(exc) is not None):
+                            rspan.set_status("degraded")
+                        raise
             except resilience.QueryCancelled:
                 raise
             except BaseException as exc:
@@ -376,6 +406,17 @@ class DegradationController:
                 # (it is not itself degraded — one recovery at a time)
                 faults.fire("degrade.step", steps, tier=next_tier,
                             trigger=kind, chunk_rows=chunk_rows)
+                # flight-record the tree as it stood when the rung
+                # stepped: the open root (if the serving runtime holds
+                # one on this thread) plus the limiter's watermark state
+                flight = spans.dump_flight_record(
+                    "degrade_step", state={
+                        "limiter": self.limiter.watermarks(),
+                        "op": op, "tier": next_tier, "trigger": kind,
+                        "steps": steps, "chunk_rows": chunk_rows,
+                    })
+                if flight:
+                    extra["flight_record"] = flight
                 telemetry.record_degrade(
                     op, "step", tier=next_tier, trigger=kind, rung=steps,
                     **extra)

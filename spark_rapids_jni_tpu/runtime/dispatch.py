@@ -45,6 +45,7 @@ import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.runtime import faults, resilience
+from spark_rapids_jni_tpu.telemetry import spans
 from spark_rapids_jni_tpu.telemetry.events import record_compile_cache
 from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
 from spark_rapids_jni_tpu.types import TypeId
@@ -472,7 +473,8 @@ def call(
             faults.fire("dispatch.compile", 0, op=op)
             jitted = (jax.jit(fn, donate_argnums=(0,)) if donate_rows
                       else jax.jit(fn))
-            with warnings.catch_warnings():
+            with spans.child("dispatch.compile", op=op), \
+                    warnings.catch_warnings():
                 # backends without donation support (CPU) warn per
                 # donated buffer at lowering; the declaration is still
                 # honored where the platform implements it
@@ -507,7 +509,10 @@ def call(
 
     def _execute():
         faults.fire("dispatch.execute", 0, op=op)
-        return compiled(padded, aux_args, row_valids)
+        # host-side only: the span closes when the dispatch RETURNS (jax
+        # is async); it never forces a device sync
+        with spans.child("dispatch.execute", op=op):
+            return compiled(padded, aux_args, row_valids)
 
     out, exc = resilience.retry_or_none(
         op, _execute, seam="dispatch.execute", rung="host_fallback")
@@ -573,7 +578,8 @@ def sharded_call(
 
         def _compile():
             faults.fire("dispatch.compile", 0, op=op)
-            return jax.jit(build()).lower(*args).compile()
+            with spans.child("dispatch.compile", op=op):
+                return jax.jit(build()).lower(*args).compile()
 
         exc = None
         try:
@@ -598,7 +604,8 @@ def sharded_call(
 
     def _execute():
         faults.fire("dispatch.execute", 0, op=op)
-        return compiled(*args)
+        with spans.child("dispatch.execute", op=op):
+            return compiled(*args)
 
     out, exc = resilience.retry_or_none(
         op, _execute, seam="dispatch.execute", rung="host_fallback")

@@ -67,6 +67,7 @@ import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.runtime import faults, resilience
+from spark_rapids_jni_tpu.telemetry import spans
 from spark_rapids_jni_tpu.telemetry.events import record_fallback
 from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
 from spark_rapids_jni_tpu.utils.config import get_option
@@ -647,10 +648,11 @@ def execute(plan: Plan, bindings: dict, *,
         # host inline path), so this is the staged tier's one seam
         faults.fire("fusion.region", 1, plan=plan.name, staged=True)
         REGISTRY.counter("fusion.staged_regions").inc()
-        tables = {name: bindings[name] for name in bucketed + exact}
-        rvs = {name: None for name in tables}
-        value, side = _eval_plan(plan.root, tables, rvs, resolved,
-                                 true_rows)
+        with spans.child(f"region.{plan.name}", mode="staged"):
+            tables = {name: bindings[name] for name in bucketed + exact}
+            rvs = {name: None for name in tables}
+            value, side = _eval_plan(plan.root, tables, rvs, resolved,
+                                     true_rows)
         meta = dict(side)
         meta.update(static_meta)
         return FusedResult(value, meta)
@@ -685,10 +687,11 @@ def execute(plan: Plan, bindings: dict, *,
         # donates) the bound buffers, so both the retry and the staged
         # fallback below replay against intact inputs
         faults.fire("fusion.region", 0, plan=plan.name)
-        return dispatch.call(
-            f"fusion.{plan.name}", _region, row_args, aux_args,
-            statics=("fusion", fingerprint), slice_rows=False,
-            donate_rows=donate)
+        with spans.child(f"region.{plan.name}", mode="fused"):
+            return dispatch.call(
+                f"fusion.{plan.name}", _region, row_args, aux_args,
+                statics=("fusion", fingerprint), slice_rows=False,
+                donate_rows=donate)
 
     if resilience.enabled():
         out, exc = resilience.retry_or_none(

@@ -31,6 +31,7 @@ import numpy as np
 
 from spark_rapids_jni_tpu import telemetry
 from spark_rapids_jni_tpu.runtime import faults
+from spark_rapids_jni_tpu.telemetry import spans
 from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.log import get_logger
 
@@ -148,6 +149,24 @@ class MemoryLimiter:
         """Register the SpillStore whose coldest entries a high-watermark
         crossing proactively spills (None detaches)."""
         self._spill_store = store
+
+    def watermarks(self) -> dict:
+        """One consistent snapshot of the limiter's watermark state —
+        live introspection (QueryServer.inspect(), flight-recorder
+        dumps). Read under the lock so used/waiters/pressure cohere."""
+        with self._lock:
+            return {
+                "used": self._used,
+                "budget": self.budget,
+                "peak": self._peak,
+                "pressure": self._pressure,
+                "pressure_crossings": self._pressure_crossings,
+                "high_bytes": self._high_bytes(),
+                "low_bytes": self._low_bytes(),
+                "waiters": len(self._waiters),
+                "admission_waiters": sum(
+                    1 for w in self._waiters if w.admission),
+            }
 
     def _high_bytes(self) -> int:
         frac = self._high_frac
@@ -611,8 +630,9 @@ class SpillStore:
         # fire before mutating the entry: an injected spill-IO failure
         # must leave the victim resident and the store consistent
         faults.fire("spill.spill", eid, nbytes=e["nbytes"])
-        e["host_cols"] = [
-            _col_to_host(c, self._cctx) for c in e["table"].columns]
+        with spans.child("spill", handle=eid, nbytes=e["nbytes"]):
+            e["host_cols"] = [
+                _col_to_host(c, self._cctx) for c in e["table"].columns]
         e["table"] = None  # drop the device arrays -> XLA frees HBM
         e["state"] = "host"
         self.spill_count += 1
@@ -684,9 +704,11 @@ class SpillStore:
             # fire before any staging: an injected unspill failure must
             # leave the entry spilled (host copy intact, retryable)
             faults.fire("spill.unspill", handle, nbytes=e["nbytes"])
-            self._spill_lru_locked(e["nbytes"])
-            cols = [
-                _col_from_host(snap, self._dctx) for snap in e["host_cols"]]
+            with spans.child("unspill", handle=handle, nbytes=e["nbytes"]):
+                self._spill_lru_locked(e["nbytes"])
+                cols = [
+                    _col_from_host(snap, self._dctx)
+                    for snap in e["host_cols"]]
             e["table"] = Table(cols)
             e["host_cols"] = None
             e["state"] = "device"

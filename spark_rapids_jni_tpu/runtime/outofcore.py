@@ -34,6 +34,7 @@ from spark_rapids_jni_tpu.runtime.memory import (
     SpillStore,
     _table_nbytes,
 )
+from spark_rapids_jni_tpu.telemetry import spans
 from spark_rapids_jni_tpu.utils.log import get_logger
 from spark_rapids_jni_tpu.utils.tracing import func_range, trace_range
 
@@ -237,16 +238,17 @@ def run_chunked_aggregate(
         if not producer_owns:
             limiter.reserve(nb)
         try:
-            faults.fire("outofcore.chunk", seq, nbytes=nb)
-            if use_pipeline:
-                # stage 4 of the pipeline: device compute — faults
-                # injectable, span-traced like the producer stages
-                pl._maybe_fault("compute", seq)
-                with trace_range("pipeline.compute"):
+            with spans.child("outofcore.chunk", seq=seq, nbytes=nb):
+                faults.fire("outofcore.chunk", seq, nbytes=nb)
+                if use_pipeline:
+                    # stage 4 of the pipeline: device compute — faults
+                    # injectable, span-traced like the producer stages
+                    pl._maybe_fault("compute", seq)
+                    with trace_range("pipeline.compute"):
+                        partial = partial_fn(chunk)
+                else:
                     partial = partial_fn(chunk)
-            else:
-                partial = partial_fn(chunk)
-            return spill.put(partial)
+                return spill.put(partial)
         finally:
             if not producer_owns:
                 limiter.release(nb)
@@ -374,12 +376,13 @@ def run_chunked_aggregate(
     def _merge():
         if cancel_token is not None:
             cancel_token.check("outofcore.merge")
-        faults.fire("outofcore.merge", nchunks)
-        if use_pipeline:
-            pl._maybe_fault("merge", nchunks)
-            with trace_range("pipeline.merge"):
-                return merge_fn(merged_in)
-        return merge_fn(merged_in)
+        with spans.child("outofcore.merge", nchunks=nchunks):
+            faults.fire("outofcore.merge", nchunks)
+            if use_pipeline:
+                pl._maybe_fault("merge", nchunks)
+                with trace_range("pipeline.merge"):
+                    return merge_fn(merged_in)
+            return merge_fn(merged_in)
 
     try:
         if pol.enabled:

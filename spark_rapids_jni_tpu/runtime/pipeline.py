@@ -55,6 +55,7 @@ from typing import Callable, Iterable, Iterator, Union
 
 from spark_rapids_jni_tpu import telemetry
 from spark_rapids_jni_tpu.runtime import faults
+from spark_rapids_jni_tpu.telemetry import spans
 from spark_rapids_jni_tpu.runtime.memory import (
     HostTableChunk,
     MemoryLimiter,
@@ -225,6 +226,10 @@ def pipeline_chunks(
     reg = telemetry.REGISTRY
     reg.counter("pipeline.runs").inc()
     cancel = threading.Event()
+    # the consumer thread's open span (e.g. the query root or an
+    # out-of-core rung): pool threads have empty span stacks, so each
+    # chunk span names it as an EXPLICIT parent to stay in the tree
+    span_parent = spans.current_span()
 
     class _either_cancel:
         """Duck-typed Event for reserve_blocking: set when the pipeline's
@@ -280,48 +285,54 @@ def pipeline_chunks(
             # before decoding its next chunk, not after
             cancel_token.check("pipeline.decode")
         _maybe_fault("decode", seq)
-        t0 = time.perf_counter()
-        with trace_range("pipeline.decode"):
-            payload = src() if callable(src) else src
-        reg.counter("pipeline.decode_us").inc(_us(time.perf_counter() - t0))
-        host_staged = isinstance(payload, HostTableChunk)
-        nb = payload.nbytes if host_staged else _table_nbytes(payload)
-        _maybe_fault("staging", seq)
-        with trace_range("pipeline.staging"):
-            if not _admission(seq, nb):
-                if cancel_token is not None and cancel_token.cancelled():
-                    # surface the classified QueryCancelled, not the
-                    # internal teardown marker
-                    cancel_token.check("pipeline.staging")
-                raise _Cancelled()
-        held = nb if limiter is not None else 0
-        try:
-            _maybe_fault("transfer", seq)
-            if host_staged:
-                t1 = time.perf_counter()
-                with trace_range("pipeline.transfer"):
-                    table = payload.stage()
-                reg.counter("pipeline.transfer_us").inc(
-                    _us(time.perf_counter() - t1))
-                # true-up: the consumer releases _table_nbytes(chunk), so
-                # the held reservation must equal it exactly (it does by
-                # construction; this guards the accounting invariant)
-                actual = _table_nbytes(table)
-                if limiter is not None and actual != held:
-                    if actual > held:
-                        limiter.reserve(actual - held)
-                    else:
-                        limiter.release(held - actual)
-                    held = actual
-                nb = actual
-            else:
-                table = payload
-            return table, nb
-        except BaseException:
-            if limiter is not None and held:
-                limiter.release(held)
-            reg.gauge("pipeline.chunks_in_flight").add(-1)
-            raise
+        # explicit parent: this runs on a pool thread whose own span
+        # stack is empty; the stage trace_ranges below nest under the
+        # chunk span through this thread's stack
+        with spans.child("pipeline.chunk", parent=span_parent, seq=seq):
+            t0 = time.perf_counter()
+            with trace_range("pipeline.decode"):
+                payload = src() if callable(src) else src
+            reg.counter("pipeline.decode_us").inc(
+                _us(time.perf_counter() - t0))
+            host_staged = isinstance(payload, HostTableChunk)
+            nb = payload.nbytes if host_staged else _table_nbytes(payload)
+            _maybe_fault("staging", seq)
+            with trace_range("pipeline.staging"):
+                if not _admission(seq, nb):
+                    if cancel_token is not None and cancel_token.cancelled():
+                        # surface the classified QueryCancelled, not the
+                        # internal teardown marker
+                        cancel_token.check("pipeline.staging")
+                    raise _Cancelled()
+            held = nb if limiter is not None else 0
+            try:
+                _maybe_fault("transfer", seq)
+                if host_staged:
+                    t1 = time.perf_counter()
+                    with trace_range("pipeline.transfer"):
+                        table = payload.stage()
+                    reg.counter("pipeline.transfer_us").inc(
+                        _us(time.perf_counter() - t1))
+                    # true-up: the consumer releases _table_nbytes(chunk),
+                    # so the held reservation must equal it exactly (it
+                    # does by construction; this guards the accounting
+                    # invariant)
+                    actual = _table_nbytes(table)
+                    if limiter is not None and actual != held:
+                        if actual > held:
+                            limiter.reserve(actual - held)
+                        else:
+                            limiter.release(held - actual)
+                        held = actual
+                    nb = actual
+                else:
+                    table = payload
+                return table, nb
+            except BaseException:
+                if limiter is not None and held:
+                    limiter.release(held)
+                reg.gauge("pipeline.chunks_in_flight").add(-1)
+                raise
 
     owns_pool = pool is None
     if pool is None:

@@ -179,6 +179,13 @@ class CancelToken:
     def expired(self) -> bool:
         return self._deadline is not None and time.monotonic() >= self._deadline
 
+    def remaining_s(self) -> Optional[float]:
+        """Seconds until the deadline (floored at 0), or None when no
+        deadline is armed — live introspection (QueryServer.inspect())."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
     def cancelled(self) -> bool:
         """True once cancelled or past deadline (latches deadline expiry)."""
         if self.event.is_set():

@@ -69,6 +69,7 @@ import collections
 import os
 import threading
 import time
+import weakref
 from typing import Any, Callable, Optional
 
 from spark_rapids_jni_tpu.runtime import (
@@ -91,13 +92,25 @@ from spark_rapids_jni_tpu.telemetry.events import (
     session_scope,
 )
 from spark_rapids_jni_tpu.utils.atomic_io import atomic_write_json, load_json
+from spark_rapids_jni_tpu.telemetry import spans
 from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
 from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.log import get_logger
 
-__all__ = ["QueryRejected", "QueryTicket", "Session", "QueryServer"]
+__all__ = ["QueryRejected", "QueryTicket", "Session", "QueryServer",
+           "live_servers"]
 
 _log = get_logger("spark_rapids_jni_tpu.server")
+
+# Open servers in this process, for live introspection: ``python -m
+# spark_rapids_jni_tpu.telemetry top`` renders inspect() of each. Weak so
+# the registry never keeps a dropped server (and its limiter) alive.
+_LIVE_SERVERS: "weakref.WeakSet[QueryServer]" = weakref.WeakSet()
+
+
+def live_servers() -> list:
+    """The not-yet-closed QueryServers of this process."""
+    return [s for s in list(_LIVE_SERVERS) if not s._closed]
 
 
 class QueryRejected(RuntimeError):
@@ -110,7 +123,10 @@ class QueryRejected(RuntimeError):
     at rejection), ``bytes_requested`` vs ``bytes_available`` (the
     limiter's free bytes at rejection), and ``retry_after_s`` — the
     server's backoff suggestion (``None`` means retrying can never
-    succeed, e.g. an estimate larger than the whole budget)."""
+    succeed, e.g. an estimate larger than the whole budget).
+    ``flight_record`` is the path of the flight-recorder artifact dumped
+    at rejection (None when the recorder is disabled or the rejection
+    happened before a span tree existed)."""
 
     def __init__(self, message: str, *,
                  session: str = "",
@@ -118,7 +134,8 @@ class QueryRejected(RuntimeError):
                  queue_depth: int = 0,
                  bytes_requested: int = 0,
                  bytes_available: int = 0,
-                 retry_after_s: Optional[float] = None):
+                 retry_after_s: Optional[float] = None,
+                 flight_record: Optional[str] = None):
         super().__init__(message)
         self.session = session
         self.reason = reason
@@ -126,6 +143,7 @@ class QueryRejected(RuntimeError):
         self.bytes_requested = int(bytes_requested)
         self.bytes_available = int(bytes_available)
         self.retry_after_s = retry_after_s
+        self.flight_record = flight_record
 
 
 class QueryTicket:
@@ -257,8 +275,14 @@ class QueryServer:
         self._queues: dict[str, collections.deque] = {}
         # round-robin ring over session ids, registration order
         self._ring: collections.deque = collections.deque()
+        # live introspection: ticket id -> {ticket, span, tier, rung, ...}
+        # maintained by _serve (register/deregister in its try/finally)
+        # and updated by the degrade observer; inspect() snapshots it
+        self._inflight: dict[int, dict] = {}
+        self._inflight_lock = threading.Lock()
         self._stop = threading.Event()
         self._closed = False
+        _LIVE_SERVERS.add(self)
         self._workers = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"tpu-server-worker-{i}")
@@ -386,6 +410,50 @@ class QueryServer:
                 "degrade.step", 0),
             "learned_signatures": len(self._learned),
             "sessions": sorted(self._queues),
+        }
+
+    def inspect(self) -> dict:
+        """Live serving introspection: every in-flight query with its
+        current span (the deepest open node of its tree), degradation
+        tier/rung, held bytes, deadline remaining and age, plus queue
+        depths and the limiter's watermark state. Pure host-side reads —
+        safe to call from any thread at any time; rendered by
+        ``python -m spark_rapids_jni_tpu.telemetry top``."""
+        with self._cond:
+            queues = {sid: len(q) for sid, q in self._queues.items()}
+        with self._inflight_lock:
+            infos = [dict(i) for i in self._inflight.values()]
+        now = time.monotonic()
+        inflight = []
+        for info in infos:
+            ticket = info["ticket"]
+            sp = info.get("span")
+            current = None
+            if isinstance(sp, spans.Span):
+                deepest = sp.deepest_open()
+                current = deepest.name if deepest is not None else None
+            inflight.append({
+                "session": info["session"],
+                "plan": info["plan"],
+                "status": ticket.status,
+                "tier": info["tier"],
+                "rung": info["rung"],
+                "steps": info["steps"],
+                "chunk_rows": info["chunk_rows"],
+                "held_bytes": info["held_bytes"],
+                "age_s": round(now - ticket._submitted_at, 3),
+                "deadline_remaining_s": ticket.cancel_token.remaining_s(),
+                "current_span": current,
+            })
+        return {
+            "inflight": sorted(inflight,
+                               key=lambda q: (q["session"], -q["age_s"])),
+            "queues": dict(sorted(queues.items())),
+            "queued": sum(queues.values()),
+            "max_inflight": self.max_inflight,
+            "limiter": self.limiter.watermarks(),
+            "spill": self.spill_store.stats(),
+            "closed": self._closed,
         }
 
     def session_stats(self, session_id: str) -> dict:
@@ -555,22 +623,25 @@ class QueryServer:
         return int(self.estimate_headroom * base)
 
     def _reject(self, ticket: QueryTicket, reason: str,
-                retry_after_s: Optional[float] = None) -> None:
+                retry_after_s: Optional[float] = None,
+                flight_record: Optional[str] = None) -> None:
         sid = ticket.session
         with self._cond:
             depth = len(self._queues.get(sid, ()))
         available = max(self.limiter.budget - self.limiter.used, 0)
         self._count("rejected", sid)
+        extra = {"flight_record": flight_record} if flight_record else {}
         record_server(ticket.plan.name, "rejected", session=sid,
                       reason=reason, estimate_bytes=ticket.estimate,
-                      queue_depth=depth, bytes_available=available)
+                      queue_depth=depth, bytes_available=available,
+                      **extra)
         _log.warning("rejected %s (session %s): %s",
                      ticket.plan.name, sid, reason)
         ticket._resolve("rejected", exc=QueryRejected(
             f"{ticket.plan.name} (session {sid}): {reason}",
             session=sid, reason=reason, queue_depth=depth,
             bytes_requested=ticket.estimate, bytes_available=available,
-            retry_after_s=retry_after_s))
+            retry_after_s=retry_after_s, flight_record=flight_record))
 
     def _next_ticket(self) -> Optional[QueryTicket]:
         """Round-robin pop: the next session (in ring order after the
@@ -612,21 +683,37 @@ class QueryServer:
         return staged
 
     def _cancelled(self, ticket: QueryTicket,
-                   exc: resilience.QueryCancelled) -> None:
+                   exc: resilience.QueryCancelled,
+                   flight_record: Optional[str] = None) -> None:
         sid = ticket.session
         reason = str(exc.context.get("reason") or "cancelled")
         where = str(exc.context.get("where") or "checkpoint")
         ticket.latency_s = time.monotonic() - ticket._submitted_at
         self._count("cancelled", sid)
+        extra = {"flight_record": flight_record} if flight_record else {}
         record_server(ticket.plan.name, "cancelled", session=sid,
                       reason=reason, where=where,
-                      wall_ms=ticket.latency_s * 1e3)
+                      wall_ms=ticket.latency_s * 1e3, **extra)
         record_degrade(f"degrade.{ticket.plan.name}", "cancelled",
                        tier="cancelled", trigger=reason, rung=0,
                        session=sid)
         _log.info("query %s (session %s) cancelled: %s",
                   ticket.plan.name, sid, reason)
         ticket._resolve("cancelled", exc=exc)
+
+    def _state_snapshot(self) -> dict:
+        """Runtime state stamped into flight-recorder dumps: limiter
+        watermarks, queue depths, in-flight count, spill-store totals."""
+        with self._cond:
+            queues = {sid: len(q) for sid, q in self._queues.items()}
+        with self._inflight_lock:
+            inflight = len(self._inflight)
+        return {
+            "limiter": self.limiter.watermarks(),
+            "queues": queues,
+            "inflight": inflight,
+            "spill": self.spill_store.stats(),
+        }
 
     def _serve(self, ticket: QueryTicket) -> None:
         sid = ticket.session
@@ -641,83 +728,151 @@ class QueryServer:
                 return stop.is_set() or token.cancelled()
 
         held = 0
+        info = {
+            "ticket": ticket, "session": sid, "plan": ticket.plan.name,
+            "tier": "fused", "rung": 0, "steps": 0, "chunk_rows": None,
+            "held_bytes": 0, "span": None,
+        }
+        with self._inflight_lock:
+            self._inflight[id(ticket)] = info
         try:
-            faults.fire("server.admit", 0, session=sid,
-                        plan=ticket.plan.name)
-            if token.cancelled():
-                # expired (or explicitly cancelled) while queued: resolve
-                # without ever reserving — the budget goes to live queries
-                token.check("server.admit")
-            # admission=True: NEW work parks while the limiter is above
-            # its high watermark; in-flight queries keep draining
-            ok = self.limiter.reserve_blocking(
-                ticket.estimate, cancel=_admission_cancel,
-                timeout=self.admission_timeout_s, admission=True)
-            if not ok:
-                if token.cancelled():
-                    token.check("server.admit")
-                self._reject(
-                    ticket,
-                    "server shutdown" if self._stop.is_set()
-                    else f"admission timeout "
-                         f"({self.admission_timeout_s}s) waiting for "
-                         f"{ticket.estimate} bytes",
-                    retry_after_s=None if self._stop.is_set()
-                    else self.admission_timeout_s)
-                return
-            held = ticket.estimate
-            ticket.status = "admitted"
-            ticket.queue_wait_s = time.monotonic() - ticket._submitted_at
-            wait_ms = ticket.queue_wait_s * 1e3
-            REGISTRY.histogram("server.queue_wait_ms").observe(wait_ms)
-            REGISTRY.histogram(
-                f"server.queue_wait_ms.{sid}").observe(wait_ms)
-            self._count("admitted", sid)
-            record_server(ticket.plan.name, "admitted", session=sid,
-                          wait_ms=wait_ms, reserved_bytes=held)
-            with session_scope(sid):
-                faults.fire("server.execute", 0, session=sid,
-                            plan=ticket.plan.name)
-                token.check("server.execute")
-                bindings = self._stage_bindings(ticket.bindings)
-                runner = None if ticket.outofcore is None \
-                    else ticket.outofcore(bindings, self.limiter)
-                # held_bytes: the parked rung must discount this query's
-                # own admission reservation from the drain threshold, or
-                # a query bigger than the low watermark parks forever
-                result = self.degrader.execute(
-                    degrade.DegradableQuery(
-                        ticket.plan, bindings,
-                        donate_inputs=ticket.donate_inputs,
-                        outofcore=runner),
-                    cancel_token=token, held_bytes=held)
-            ticket.latency_s = time.monotonic() - ticket._submitted_at
-            lat_ms = ticket.latency_s * 1e3
-            REGISTRY.histogram("server.latency_ms").observe(lat_ms)
-            REGISTRY.histogram(f"server.latency_ms.{sid}").observe(lat_ms)
-            self._count("served", sid)
-            record_server(ticket.plan.name, "served", session=sid,
-                          wall_ms=lat_ms, wait_ms=ticket.queue_wait_s * 1e3)
-            self._record_actual(ticket, bindings, result)
-            ticket._resolve("served", value=result)
-        except resilience.QueryCancelled as exc:
-            # a deliberate stop, not a failure: the reservation and the
-            # in-flight slot release in the SAME finally as every exit
-            self._cancelled(ticket, exc)
-        except BaseException as exc:
-            # a dying query releases everything it holds (the finally
-            # below) and resolves CLASSIFIED — never a silent wedge
-            kind = resilience.classify(exc, seam="server.execute").__name__
-            ticket.latency_s = time.monotonic() - ticket._submitted_at
-            self._count("failed", sid)
-            record_server(ticket.plan.name, "failed", session=sid,
-                          error_kind=kind,
-                          reason=str(exc) or type(exc).__name__)
-            _log.warning("query %s (session %s) failed classified as %s",
-                         ticket.plan.name, sid, kind)
-            ticket._resolve("failed", exc=exc)
-            if not isinstance(exc, Exception):
-                raise  # KeyboardInterrupt etc: not the server's to absorb
+            # ONE root span per query: every instrumented seam below
+            # (admission, degrade rungs, regions, pipeline chunks,
+            # spills) attaches to this tree via the thread-local stack
+            with spans.span(f"query.{ticket.plan.name}", session=sid,
+                            plan=ticket.plan.name,
+                            estimate_bytes=ticket.estimate) as qspan:
+                info["span"] = qspan
+                try:
+                    faults.fire("server.admit", 0, session=sid,
+                                plan=ticket.plan.name)
+                    if token.cancelled():
+                        # expired (or explicitly cancelled) while queued:
+                        # resolve without ever reserving — the budget
+                        # goes to live queries
+                        token.check("server.admit")
+                    # admission=True: NEW work parks while the limiter is
+                    # above its high watermark; in-flight queries keep
+                    # draining
+                    # admission runs BEFORE the execution session_scope, so
+                    # the session stamp must be explicit here
+                    with spans.child("admission.wait", session=sid,
+                                     estimate_bytes=ticket.estimate) as asp:
+                        ok = self.limiter.reserve_blocking(
+                            ticket.estimate, cancel=_admission_cancel,
+                            timeout=self.admission_timeout_s,
+                            admission=True)
+                        if not ok:
+                            asp.set_status("failed")
+                    if not ok:
+                        if token.cancelled():
+                            token.check("server.admit")
+                        qspan.set_status("failed")
+                        why = ("server shutdown" if self._stop.is_set()
+                               else f"admission timeout "
+                                    f"({self.admission_timeout_s}s) "
+                                    f"waiting for {ticket.estimate} bytes")
+                        qspan.annotate(reason=why)
+                        self._reject(
+                            ticket, why,
+                            retry_after_s=None if self._stop.is_set()
+                            else self.admission_timeout_s,
+                            flight_record=spans.dump_flight_record(
+                                "rejected", root=qspan,
+                                state=self._state_snapshot()))
+                        return
+                    held = ticket.estimate
+                    info["held_bytes"] = held
+                    ticket.status = "admitted"
+                    ticket.queue_wait_s = (
+                        time.monotonic() - ticket._submitted_at)
+                    wait_ms = ticket.queue_wait_s * 1e3
+                    REGISTRY.histogram(
+                        "server.queue_wait_ms").observe(wait_ms)
+                    REGISTRY.histogram(
+                        f"server.queue_wait_ms.{sid}").observe(wait_ms)
+                    self._count("admitted", sid)
+                    record_server(ticket.plan.name, "admitted", session=sid,
+                                  wait_ms=wait_ms, reserved_bytes=held)
+
+                    def _observe(tier: str, rung: int, steps: int,
+                                 chunk_rows: Optional[int]) -> None:
+                        # degrade-ladder progress -> inspect(); runs with
+                        # telemetry on OR off (it carries no records)
+                        info["tier"] = tier
+                        info["rung"] = rung
+                        info["steps"] = steps
+                        info["chunk_rows"] = chunk_rows
+                        if steps and qspan.status == "ok":
+                            qspan.set_status("degraded")
+
+                    with session_scope(sid):
+                        faults.fire("server.execute", 0, session=sid,
+                                    plan=ticket.plan.name)
+                        token.check("server.execute")
+                        bindings = self._stage_bindings(ticket.bindings)
+                        runner = None if ticket.outofcore is None \
+                            else ticket.outofcore(bindings, self.limiter)
+                        # held_bytes: the parked rung must discount this
+                        # query's own admission reservation from the
+                        # drain threshold, or a query bigger than the low
+                        # watermark parks forever
+                        result = self.degrader.execute(
+                            degrade.DegradableQuery(
+                                ticket.plan, bindings,
+                                donate_inputs=ticket.donate_inputs,
+                                outofcore=runner),
+                            cancel_token=token, held_bytes=held,
+                            observer=_observe)
+                    ticket.latency_s = (
+                        time.monotonic() - ticket._submitted_at)
+                    lat_ms = ticket.latency_s * 1e3
+                    REGISTRY.histogram("server.latency_ms").observe(lat_ms)
+                    REGISTRY.histogram(
+                        f"server.latency_ms.{sid}").observe(lat_ms)
+                    self._count("served", sid)
+                    record_server(ticket.plan.name, "served", session=sid,
+                                  wall_ms=lat_ms,
+                                  wait_ms=ticket.queue_wait_s * 1e3)
+                    self._record_actual(ticket, bindings, result)
+                    ticket._resolve("served", value=result)
+                except resilience.QueryCancelled as exc:
+                    # a deliberate stop, not a failure: the reservation
+                    # and the in-flight slot release in the SAME finally
+                    # as every exit
+                    qspan.set_status("cancelled")
+                    self._cancelled(
+                        ticket, exc,
+                        flight_record=spans.dump_flight_record(
+                            "cancelled", root=qspan,
+                            state=self._state_snapshot()))
+                except BaseException as exc:
+                    # a dying query releases everything it holds (the
+                    # finally below) and resolves CLASSIFIED — never a
+                    # silent wedge
+                    kind = resilience.classify(
+                        exc, seam="server.execute").__name__
+                    qspan.set_status("failed")
+                    qspan.annotate(error_kind=kind)
+                    flight = spans.dump_flight_record(
+                        "failed", root=qspan, state=self._state_snapshot())
+                    ticket.latency_s = (
+                        time.monotonic() - ticket._submitted_at)
+                    self._count("failed", sid)
+                    extra = {"flight_record": flight} if flight else {}
+                    record_server(ticket.plan.name, "failed", session=sid,
+                                  error_kind=kind,
+                                  reason=str(exc) or type(exc).__name__,
+                                  **extra)
+                    _log.warning(
+                        "query %s (session %s) failed classified as %s",
+                        ticket.plan.name, sid, kind)
+                    ticket._resolve("failed", exc=exc)
+                    if not isinstance(exc, Exception):
+                        # KeyboardInterrupt etc: not the server's to absorb
+                        raise
         finally:
+            with self._inflight_lock:
+                self._inflight.pop(id(ticket), None)
             if held:
                 self.limiter.release(held)

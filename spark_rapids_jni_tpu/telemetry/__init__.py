@@ -10,6 +10,13 @@ bench stamps a telemetry summary into every BENCH_*.json; and
 ``python -m spark_rapids_jni_tpu.telemetry report run.jsonl`` renders the
 per-op device/host split with p50/p95 wall times and bytes moved.
 
+On top of the flat stream sit hierarchical per-query span trees
+(``spans`` — one causal tree per served query), a bounded flight
+recorder with structured dump artifacts, Chrome-trace/Perfetto export
+(``python -m spark_rapids_jni_tpu.telemetry trace``), live serving
+introspection (``QueryServer.inspect()`` rendered by ``... telemetry
+top``) and Prometheus-style text exposition (``REGISTRY.exposition()``).
+
 Toggles (utils/config.py): ``telemetry.enabled``
 (``SPARK_RAPIDS_TPU_TELEMETRY_ENABLED=1``) turns recording on;
 ``telemetry.path`` (``SPARK_RAPIDS_TPU_TELEMETRY_PATH=run.jsonl``) adds a
@@ -35,14 +42,26 @@ from spark_rapids_jni_tpu.telemetry.events import (
     summary,
 )
 from spark_rapids_jni_tpu.telemetry.registry import REGISTRY, Registry
+from spark_rapids_jni_tpu.telemetry import spans
+from spark_rapids_jni_tpu.telemetry.spans import (
+    chrome_trace,
+    current_span,
+    dump_flight_record,
+    flight_records,
+    span,
+)
 
 __all__ = [
     "REGISTRY",
     "Registry",
+    "chrome_trace",
     "current_session",
+    "current_span",
     "drain",
+    "dump_flight_record",
     "enabled",
     "events",
+    "flight_records",
     "record_bench_stale",
     "record_compile_cache",
     "record_degrade",
@@ -52,5 +71,7 @@ __all__ = [
     "record_server",
     "record_spill",
     "session_scope",
+    "span",
+    "spans",
     "summary",
 ]

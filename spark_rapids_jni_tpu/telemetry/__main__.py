@@ -1,25 +1,125 @@
-"""CLI: ``python -m spark_rapids_jni_tpu.telemetry report <run.jsonl>``."""
+"""CLI for telemetry runs: ``report``, ``trace`` and ``top``.
+
+- ``report [--session <id>] [--kind <k>] <run.jsonl>`` — per-op table
+  plus event summaries, optionally narrowed to one session or one event
+  kind (dispatch | fallback | spill | server | degrade).
+- ``trace [<run.jsonl>] <out.json>`` — export the run's span records as
+  Chrome-trace / Perfetto JSON (load in ``chrome://tracing`` or
+  https://ui.perfetto.dev). With one argument the input defaults to the
+  configured ``telemetry.path``.
+- ``top [<snapshot.json>]`` — render in-flight queries: from a saved
+  ``QueryServer.inspect()`` snapshot, or live from this process.
+"""
 
 from __future__ import annotations
 
+import json
 import sys
 
-from spark_rapids_jni_tpu.telemetry.report import report
+from spark_rapids_jni_tpu.telemetry import spans, top
+from spark_rapids_jni_tpu.telemetry.report import (
+    KINDS, load_jsonl, report)
+from spark_rapids_jni_tpu.utils.config import get_option
 
-_USAGE = "usage: python -m spark_rapids_jni_tpu.telemetry report <run.jsonl>"
+_USAGE = """\
+usage: python -m spark_rapids_jni_tpu.telemetry <command> ...
+
+commands:
+  report [--session <id>] [--kind <k>] <run.jsonl>
+  trace  [<run.jsonl>] <out.json>
+  top    [<snapshot.json>]
+"""
 
 
-def main(argv: list[str]) -> int:
-    if len(argv) != 2 or argv[0] != "report":
-        print(_USAGE, file=sys.stderr)
-        return 2
+def _usage() -> int:
+    print(_USAGE, file=sys.stderr)
+    return 2
+
+
+def _report(argv: list[str]) -> int:
+    session = kind = None
+    paths: list[str] = []
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--session":
+            if i + 1 >= len(argv):
+                return _usage()
+            session = argv[i + 1]
+            i += 2
+        elif arg == "--kind":
+            if i + 1 >= len(argv):
+                return _usage()
+            kind = argv[i + 1]
+            if kind not in KINDS:
+                print(f"error: unknown kind {kind!r} "
+                      f"(expected one of {', '.join(KINDS)})",
+                      file=sys.stderr)
+                return 2
+            i += 2
+        elif arg.startswith("-"):
+            return _usage()
+        else:
+            paths.append(arg)
+            i += 1
+    if len(paths) != 1:
+        return _usage()
     try:
-        text = report(argv[1])
+        text = report(paths[0], session=session, kind=kind)
     except OSError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
     print(text)
     return 0
+
+
+def _trace(argv: list[str]) -> int:
+    if len(argv) == 1:
+        src, out = str(get_option("telemetry.path")), argv[0]
+        if not src:
+            print("error: no input given and telemetry.path is unset",
+                  file=sys.stderr)
+            return 2
+    elif len(argv) == 2:
+        src, out = argv
+    else:
+        return _usage()
+    try:
+        n = spans.write_chrome_trace(load_jsonl(src), out)
+    except OSError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    print(f"wrote {n} span events to {out}")
+    return 0
+
+
+def _top(argv: list[str]) -> int:
+    if len(argv) > 1:
+        return _usage()
+    if argv:
+        try:
+            with open(argv[0], "r", encoding="utf-8") as fh:
+                snapshots = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+    else:
+        snapshots = top.collect()
+    print(top.render_top(snapshots))
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    if not argv:
+        return _usage()
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "report":
+        return _report(rest)
+    if cmd == "trace":
+        return _trace(rest)
+    if cmd == "top":
+        return _top(rest)
+    return _usage()
 
 
 if __name__ == "__main__":

@@ -15,6 +15,9 @@ this op actually run, and why?* Four event kinds:
                       ``bytes_moved``.
 - ``bench_stale``   — bench served a last-known-good ledger value instead of a
                       fresh measurement.
+- ``span``          — one closed node of a query's causal span tree
+                      (telemetry/spans.py): id/parent/root, monotonic t0/t1,
+                      status (ok/degraded/cancelled/failed).
 
 Each record is stamped with ``ts`` (epoch seconds), ``platform`` (jax backend
 if jax is already imported — telemetry itself never imports jax, keeping the
@@ -389,8 +392,15 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
     stale_reads = 0
     dispatches = 0
     spill_bytes = 0
+    spans = 0
+    span_status: Dict[str, int] = {}
     for r in recs:
         kind = r.get("kind")
+        if kind == "span":
+            spans += 1
+            st = str(r.get("status", "?"))
+            span_status[st] = span_status.get(st, 0) + 1
+            continue
         if kind == "resilience":
             ev = str(r.get("event", "?"))
             resilience[ev] = resilience.get(ev, 0) + 1
@@ -428,5 +438,7 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         "server": dict(sorted(server.items())),
         "degrade": dict(sorted(degrade.items())),
         "degrade_tiers": dict(sorted(degrade_tiers.items())),
+        "spans": spans,
+        "span_status": dict(sorted(span_status.items())),
         "stale_reads": stale_reads,
     }

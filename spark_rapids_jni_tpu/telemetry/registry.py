@@ -28,6 +28,14 @@ __all__ = [
     "DEFAULT_BOUNDS",
 ]
 
+def _exposition_name(name: str) -> str:
+    """Map an instrument name onto the Prometheus metric charset."""
+    out = "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
 # Default histogram bounds: geometric ms-scale ladder wide enough for both
 # sub-ms device dispatches and multi-second out-of-core runs.
 DEFAULT_BOUNDS: Tuple[float, ...] = (
@@ -202,6 +210,40 @@ class Registry:
                     n: h.snapshot() for n, h in sorted(self._histograms.items())
                 },
             }
+
+    def exposition(self) -> str:
+        """Prometheus-style text exposition of every instrument, for a
+        scrape endpoint or ``curl``-style operator inspection. Names are
+        sanitized to the Prometheus charset ([a-zA-Z0-9_:]); histograms
+        render cumulative ``_bucket{le=...}`` series plus ``_sum`` /
+        ``_count``, matching the native histogram text format."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            histograms = sorted(self._histograms.items())
+        lines: List[str] = []
+        for name, c in counters:
+            metric = _exposition_name(name)
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {c.value}")
+        for name, g in gauges:
+            metric = _exposition_name(name)
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {g.value}")
+        for name, h in histograms:
+            metric = _exposition_name(name)
+            snap = h.snapshot()
+            lines.append(f"# TYPE {metric} histogram")
+            cumulative = 0
+            counts = snap["counts"]
+            for bound, count in zip(snap["bounds"], counts):
+                cumulative += count
+                lines.append(f'{metric}_bucket{{le="{bound}"}} {cumulative}')
+            cumulative += counts[-1]  # overflow bucket
+            lines.append(f'{metric}_bucket{{le="+Inf"}} {cumulative}')
+            lines.append(f"{metric}_sum {snap['sum']}")
+            lines.append(f"{metric}_count {snap['count']}")
+        return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
         """Drop all instruments (test isolation; not for production paths)."""

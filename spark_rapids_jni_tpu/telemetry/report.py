@@ -10,11 +10,42 @@ the bench ledger's crash-tolerant read posture.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, Iterable, List, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from spark_rapids_jni_tpu.telemetry.events import summary
 
-__all__ = ["load_jsonl", "aggregate", "render_table", "report"]
+__all__ = ["load_jsonl", "filter_records", "aggregate", "render_table",
+           "report"]
+
+# --kind values the CLI accepts ("span" records are the trace
+# substrate, not an event category: export those with ``trace``)
+KINDS = ("dispatch", "fallback", "spill", "server", "degrade")
+
+
+def filter_records(
+    records: Iterable[Dict[str, Any]],
+    *,
+    session: Optional[str] = None,
+    kind: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    """Narrow a record stream to one session and/or one event kind.
+
+    ``session`` matches the ambient session id every emitter stamps;
+    records with no session (emitted outside ``session_scope``) only
+    survive when no session filter is given. ``kind`` must be one of
+    :data:`KINDS` (ValueError otherwise).
+    """
+    if kind is not None and kind not in KINDS:
+        raise ValueError(
+            f"unknown kind {kind!r}: expected one of {', '.join(KINDS)}")
+    out: List[Dict[str, Any]] = []
+    for rec in records:
+        if session is not None and rec.get("session") != session:
+            continue
+        if kind is not None and rec.get("kind") != kind:
+            continue
+        out.append(rec)
+    return out
 
 
 def load_jsonl(path: str) -> List[Dict[str, Any]]:
@@ -133,9 +164,18 @@ def render_table(per_op: Dict[str, Dict[str, Any]]) -> str:
     return "\n".join([line(headers), sep] + [line(r) for r in rows])
 
 
-def report(path: str) -> str:
-    """Full report text for a JSONL run: per-op table + summary counts."""
+def report(path: str, *, session: Optional[str] = None,
+           kind: Optional[str] = None) -> str:
+    """Full report text for a JSONL run: per-op table + summary counts.
+
+    ``session``/``kind`` narrow the input through
+    :func:`filter_records` before aggregation (the CLI's ``--session``
+    and ``--kind`` flags), so every table and count below reflects the
+    filtered view.
+    """
     records = load_jsonl(path)
+    if session is not None or kind is not None:
+        records = filter_records(records, session=session, kind=kind)
     per_op = aggregate(records)
     s = summary(records)
     lines = [render_table(per_op), ""]
@@ -148,6 +188,24 @@ def report(path: str) -> str:
             stale=s["stale_reads"],
         )
     )
+    # serving-runtime sections render only when such events exist, so
+    # dispatch-only runs keep their historical output byte-for-byte
+    if s["server"]:
+        lines.append("server events:")
+        for ev, n in sorted(s["server"].items()):
+            lines.append(f"  {n:4d}x  {ev}")
+    if s["degrade"]:
+        lines.append("degrade events:")
+        for ev, n in sorted(s["degrade"].items()):
+            lines.append(f"  {n:4d}x  {ev}")
+        if s["degrade_tiers"]:
+            tiers = "  ".join(
+                f"{t}={n}" for t, n in sorted(s["degrade_tiers"].items()))
+            lines.append(f"  step tiers: {tiers}")
+    if s.get("spans"):
+        status = "  ".join(
+            f"{st}={n}" for st, n in sorted(s["span_status"].items()))
+        lines.append(f"spans: {s['spans']}  ({status})")
     reasons: Dict[str, int] = {}
     for rec in records:
         if rec.get("kind") in ("fallback", "spill"):

@@ -37,6 +37,18 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     "telemetry.enabled": (False, bool),
     # JSONL sink for telemetry events; "" = in-process ring buffer only.
     "telemetry.path": ("", str),
+    # Flight recorder (telemetry/spans.py): how many recent query span
+    # trees (completed roots + explicit dumps) the in-process ring keeps
+    # for post-mortem inspection.
+    "telemetry.flight_recorder_depth": (16, int),
+    # Directory flight-recorder artifacts (full span tree + limiter /
+    # queue state, dumped on a classified death, degrade-rung step or
+    # cancellation) are written to; "" = in-memory ring only.
+    "telemetry.flight_recorder_path": ("", str),
+    # Cap on span nodes kept per in-memory query tree (the JSONL sink is
+    # unbounded; the tree backs the flight recorder and inspect()).
+    # Past the cap, spans still emit records but stop growing the tree.
+    "telemetry.max_spans_per_tree": (2048, int),
     # Shape-bucketed dispatch (runtime/dispatch.py): pad the leading row
     # dimension of device-op inputs up to a bucket so one compiled
     # executable serves every batch size inside the bucket (the reference

@@ -8,11 +8,15 @@ XLA/Perfetto traces captured via ``jax.profiler.trace``. Disabled by default,
 toggled by the ``tracing.enabled`` option (env
 ``SPARK_RAPIDS_TPU_TRACING_ENABLED=1``).
 
-``record=True`` additionally times the range and records a telemetry dispatch
-event (telemetry/events.py) when ``telemetry.enabled`` is on — profiler
-annotation and execution accounting share one seam, so instrumented ops get
-both for free. Recording happens only on successful exit: a range that raised
-did not dispatch.
+This is the one seam instrumented ops share: the profiler annotation, the
+telemetry dispatch record and the query span tree all hang off it. When a
+query span is open on this thread (telemetry/spans.py), the range attaches a
+child span — so every ``trace_range``-wrapped stage lands in the served
+query's causal tree without its own instrumentation. ``record=True``
+additionally times the range and records a ``dispatch`` telemetry event
+carrying ``wall_ms``; a body that raises still records, with
+``status="error"`` and the exception class, so failed dispatches are visible
+in the per-op report instead of silently dropping their timing.
 """
 
 from __future__ import annotations
@@ -22,6 +26,8 @@ import functools
 import time
 from typing import Callable, TypeVar
 
+from spark_rapids_jni_tpu import telemetry
+from spark_rapids_jni_tpu.telemetry import spans
 from spark_rapids_jni_tpu.utils.config import get_option
 
 F = TypeVar("F", bound=Callable)
@@ -32,20 +38,32 @@ def trace_range(name: str, record: bool = False):
     """Context manager opening a named profiler range when tracing is on.
 
     With ``record=True`` (and telemetry enabled), also times the body and
-    records a ``dispatch`` telemetry event carrying ``wall_ms``.
+    records a ``dispatch`` telemetry event carrying ``wall_ms`` — with
+    ``status="error"`` / ``error=<exception class>`` when the body raises.
+    With telemetry enabled and a query span open on this thread, the range
+    additionally attaches a child span to the query's tree.
     """
     if record:
-        from spark_rapids_jni_tpu import telemetry
-
         record = telemetry.enabled()
     t0 = time.perf_counter() if record else 0.0
-    if get_option("tracing.enabled"):
-        import jax.profiler
+    try:
+        with spans.child(name):
+            if get_option("tracing.enabled"):
+                import jax.profiler
 
-        with jax.profiler.TraceAnnotation(name):
-            yield
-    else:
-        yield
+                with jax.profiler.TraceAnnotation(name):
+                    yield
+            else:
+                yield
+    except BaseException as exc:
+        if record:
+            telemetry.record_dispatch(
+                name,
+                wall_ms=(time.perf_counter() - t0) * 1e3,
+                status="error",
+                error=type(exc).__name__,
+            )
+        raise
     if record:
         telemetry.record_dispatch(
             name, wall_ms=(time.perf_counter() - t0) * 1e3

@@ -300,8 +300,34 @@ def test_rule_reservation_release_scope(tmp_path):
     assert _by_rule(_lint_file(target2), "reservation-release-in-finally")
 
 
+def test_rule_span_scope_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_span_scope.py"),
+                   "span-must-scope")
+    texts = [f.source_line for f in got]
+    assert len(got) == 4, texts
+    assert any("spans.span" in t for t in texts)
+    assert any("spans.child" in t for t in texts)
+    assert any("span(" in t and "handle" in t for t in texts)
+    assert any("child(" in t and "c =" in t for t in texts)
+    # with-scoped, aliased-with, unrelated-attr and pragma'd twins stay clean
+    src = (FIXTURES / "seeded_span_scope.py").read_text()
+    clean_at = src[:src.index("def clean_with_scope")].count("\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_span_scope_ignores_files_without_spans_import(tmp_path):
+    # .span()/.child() on arbitrary objects in files that never import
+    # telemetry.spans are someone else's API — out of scope
+    target = tmp_path / "other.py"
+    target.write_text(
+        "def f(tracer):\n"
+        "    probe = tracer.span('x')\n"
+        "    return tracer.child('y'), probe\n")
+    assert not _by_rule(_lint_file(target), "span-must-scope")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all thirteen rules demonstrably fire."""
+    """The acceptance invariant: all fourteen rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
@@ -326,6 +352,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_server_telemetry.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_reservation_memory.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_span_scope.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

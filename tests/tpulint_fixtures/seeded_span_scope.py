@@ -1,0 +1,61 @@
+"""Seeded violations for rule 14 (span-must-scope).
+
+Spans acquired outside a ``with`` statement leak open: they never emit,
+wedge the flight-recorder tree, and corrupt the thread-local span stack.
+Violations first, then clean twins past the ``def clean_`` marker the
+per-rule test splits on.
+"""
+
+from spark_rapids_jni_tpu.telemetry import spans
+from spark_rapids_jni_tpu.telemetry.spans import child, span
+
+
+def manual_enter_never_safe(run):
+    sp = spans.span("query.q1")  # VIOLATION: manual enter/exit leaks on raise
+    sp.__enter__()
+    out = run()
+    sp.__exit__(None, None, None)
+    return out
+
+
+def returns_unentered_span(name):
+    # VIOLATION: the caller gets a raw span with no scope guarantee
+    return spans.child(f"region.{name}", mode="fused")
+
+
+def bare_factory_assignment(run):
+    handle = span("dispatch.execute")  # VIOLATION even if with'd later
+    with handle:
+        return run()
+
+
+def bare_child_dangles():
+    c = child("pipeline.decode", seq=0)  # VIOLATION: never entered at all
+    return c.id
+
+
+def clean_with_scope(run):
+    with spans.span("query.q1"):
+        return run()
+
+
+def clean_child_with_alias(run, seq):
+    with spans.child("pipeline.chunk", seq=seq) as sp:
+        sp.annotate(seq=seq)
+        return run()
+
+
+def clean_bare_factory_in_with(run):
+    with span("dispatch.execute"), child("dispatch.compile"):
+        return run()
+
+
+def clean_other_attrs_ignored(tracer, run):
+    # .span/.child on unrelated objects are not the telemetry factories
+    probe = tracer.span("unrelated")
+    return run(probe)
+
+
+def clean_pragmad_leak():
+    # tpulint: disable=span-must-scope
+    return spans.child("pipeline.merge")

@@ -1,4 +1,4 @@
-"""The thirteen tpulint rules.
+"""The fourteen tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -976,6 +976,74 @@ def check_reservation_release(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+def check_span_scope(ctx: FileContext) -> List[RawFinding]:
+    """Span lifecycle discipline: ``spans.span(...)`` / ``spans.child(...)``
+    acquired OUTSIDE a ``with`` statement (or a decorator expression) is a
+    leak waiting to happen — an un-exited span never stamps its end time,
+    never emits, pins its subtree open in the flight recorder, and leaves
+    the thread-local stack pointing at a dead frame so every LATER span in
+    that thread parents wrong. The factories are context managers by
+    contract: the only sound acquisition is ``with spans.span(...)`` /
+    ``with spans.child(...) as s`` (or inside a decorator). Assigning the
+    result, returning it, or passing it along is flagged. The spans module
+    itself (the factories' home) is exempt."""
+    if ctx.name == "spans.py":
+        return []
+    # module aliases for telemetry.spans and bare-imported factory names
+    mod_aliases = set()
+    fn_aliases = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.endswith("telemetry") or node.module == "telemetry":
+                for a in node.names:
+                    if a.name == "spans":
+                        mod_aliases.add(a.asname or a.name)
+            elif node.module.endswith("telemetry.spans"):
+                for a in node.names:
+                    if a.name in ("span", "child"):
+                        fn_aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("telemetry.spans"):
+                    mod_aliases.add(a.asname or a.name)
+    if not mod_aliases and not fn_aliases:
+        return []
+    # calls sitting where a context manager belongs: with-items and
+    # decorators (the two scoped acquisition forms)
+    scoped: set = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                scoped.add(id(item.context_expr))
+        elif isinstance(node, _FUNC_NODES):
+            for dec in node.decorator_list:
+                for n in ast.walk(dec):
+                    scoped.add(id(n))
+    out: List[RawFinding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or id(node) in scoped:
+            continue
+        func = node.func
+        hit = None
+        if isinstance(func, ast.Attribute) and func.attr in ("span", "child"):
+            base = _unparse(func.value)
+            if (base in mod_aliases or base.endswith(".spans")
+                    or base.endswith("telemetry.spans")):
+                hit = f"{base}.{func.attr}"
+        elif isinstance(func, ast.Name) and func.id in fn_aliases:
+            hit = func.id
+        if hit is None:
+            continue
+        out.append(RawFinding(
+            node.lineno, node.col_offset,
+            f"`{hit}(...)` acquired outside a `with` statement: an "
+            f"un-exited span never records, wedges the flight-recorder "
+            f"tree open, and corrupts the thread-local span stack for "
+            f"every later span on this thread; acquire it as "
+            f"`with {hit}(...) as s:` (or in a decorator)"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -1029,4 +1097,9 @@ RULES = [
          "in the same function must release in a finally (or a "
          "re-raising except handler); success-only releases leak bytes",
          check_reservation_release),
+    Rule("span-must-scope",
+         "spans.span(...) / spans.child(...) must be acquired with a "
+         "`with` statement (or decorator): a leaked open span corrupts "
+         "the thread-local span stack and never emits",
+         check_span_scope),
 ]
