@@ -1,12 +1,12 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its fourteen invariant rules (host/device
+# tpulint (tools/tpulint) runs its fifteen invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
 # error-must-classify, server-telemetry-session-id,
-# reservation-release-in-finally)
+# reservation-release-in-finally, span-must-scope, payload-must-verify)
 # over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
 # glob below covers the telemetry/ package alongside every other
 # subpackage.
@@ -383,4 +383,72 @@ assert art["trigger"] == "degrade_step" and art["tree"]["name"].startswith(
 print(f"trace smoke OK: {len(span_recs)} spans, 1 causal tree, "
       f"{len(flights)} flight record(s), chrome trace parses, "
       f"bit-identical, 0 leaked bytes")
+EOF
+
+# integrity smoke: rule 15 only proves payload reads ROUTE through the
+# verify seam — this proves the integrity layer itself still honors its
+# contract: a sealed blob roundtrips, every corruption mode (bit-flip,
+# truncation, trailer clobber) on a spilled entry raises a classified
+# CorruptDataError instead of decoding garbage, a corrupted DCN frame is
+# refetched to a bit-identical delivery, and zero reserved bytes leak.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import socket
+
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.parallel.dcn import SliceLink
+from spark_rapids_jni_tpu.runtime import faults, resilience
+from spark_rapids_jni_tpu.runtime.integrity import seal, verify
+from spark_rapids_jni_tpu.runtime.memory import SpillStore
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+
+# seal/verify roundtrip + all three corruption modes detected
+blob = seal(b"payload bytes under test")
+assert verify(blob, seam="integrity.spill") == b"payload bytes under test"
+for mutate in (lambda b: bytes([b[0] ^ 1]) + b[1:],      # bit-flip
+               lambda b: b[:-3],                          # truncation
+               lambda b: b[:-1] + bytes([b[-1] ^ 0xFF])): # trailer clobber
+    try:
+        verify(mutate(blob), seam="integrity.spill")
+        raise SystemExit("corruption not detected")
+    except resilience.CorruptDataError:
+        pass
+
+# corrupted spill entry: detected classified, never decoded
+tbl = Table([Column.from_numpy(np.arange(64, dtype=np.int64))])
+store = SpillStore(budget_bytes=512)  # one table fits; the second evicts it
+script = faults.FaultScript(
+    corruptions=[faults.CorruptionSpec("integrity.spill", mode="flip")])
+with faults.inject(script):
+    h = store.put(tbl)
+    store.put(Table([Column.from_numpy(np.arange(64, dtype=np.int64))]))
+try:
+    store.get(h)
+    raise SystemExit("corrupted spill entry decoded")
+except resilience.CorruptDataError:
+    pass
+store.close()
+
+# corrupted wire frame: NAK -> refetch -> bit-identical delivery
+import threading
+sa, sb = socket.socketpair()
+a, b = SliceLink(sa), SliceLink(sb)
+script = faults.FaultScript(
+    corruptions=[faults.CorruptionSpec("integrity.wire", mode="flip")])
+out = {}
+def rx():
+    out["tbl"] = b.recv_table()
+t = threading.Thread(target=rx)
+with faults.inject(script):
+    t.start()
+    a.send_table(tbl, compress_level=0)
+    t.join(30)
+got = np.asarray(out["tbl"].columns[0].data)
+assert (got == np.arange(64)).all(), "refetched frame diverged"
+refetches = sum(REGISTRY.counters("integrity.refetch").values())
+assert refetches >= 1, "no refetch recorded for the corrupted frame"
+a.close(); b.close()
+print("integrity smoke OK: 3 corruption modes classified, spill "
+      "detected, wire refetch bit-identical, 0 leaked bytes")
 EOF

@@ -22,14 +22,17 @@ Type mapping (ORC kind -> DType):
 from __future__ import annotations
 
 import ctypes
+import os
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
 from spark_rapids_jni_tpu import types as t
 from spark_rapids_jni_tpu.columnar import Table
-from spark_rapids_jni_tpu.parquet.footer import NativeError
+from spark_rapids_jni_tpu.parquet.footer import MalformedFileError, NativeError
+from spark_rapids_jni_tpu.runtime import faults, integrity
 from spark_rapids_jni_tpu.runtime.native import load_native
+from spark_rapids_jni_tpu.utils.fspath import as_fs_path
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 _K_BOOLEAN, _K_BYTE, _K_SHORT, _K_INT, _K_LONG = 0, 1, 2, 3, 4
@@ -61,8 +64,94 @@ def _map_dtype(kind: int, scale: int, precision: int = 0):
 
 
 def _check(lib, ok: bool, what: str) -> None:
+    # decode failures on untrusted bytes classify as malformed input
+    # (MalformedFileError is-a NativeError, so legacy catches still work)
     if not ok:
-        raise NativeError(f"{what}: {lib.last_error()}")
+        raise integrity.reject_malformed(
+            f"orc.{what}", f"{what}: {lib.last_error()}",
+            exc_type=MalformedFileError)
+
+
+_ORC_MAGIC = b"ORC"
+
+
+def _validate_orc_envelope(data: "bytes | str | os.PathLike") -> None:
+    """Untrusted-input preflight: check the ORC file envelope — leading
+    magic, trailing postscript magic, and the one-byte postscript length
+    against the file size — BEFORE any decoder touches the bytes. Pure
+    Python (no native lib needed), so a truncated or clobbered file is
+    rejected classified even where the native engine is absent. Deep
+    structural checks (protobuf footer, stripe bounds) run inside the
+    hardened native parse behind the same classification."""
+    if not integrity.enabled():
+        return
+    path = as_fs_path(data)
+    if path is None:
+        n = len(data)
+        head, tail = bytes(data[:3]), bytes(data[-4:])
+    else:
+        try:
+            n = os.path.getsize(path)
+            with open(path, "rb") as fh:
+                head = fh.read(3)
+                fh.seek(max(0, n - 4))
+                tail = fh.read(4)
+        except OSError:
+            return  # unreadable path: let the native open report it
+    if n < 8:
+        raise integrity.reject_malformed(
+            "orc.envelope", "file too short to be ORC",
+            exc_type=MalformedFileError, size=n)
+    if head != _ORC_MAGIC:
+        raise integrity.reject_malformed(
+            "orc.envelope", "bad leading magic (not an ORC file)",
+            exc_type=MalformedFileError, size=n)
+    if tail[:3] != _ORC_MAGIC:
+        raise integrity.reject_malformed(
+            "orc.envelope",
+            "bad trailing postscript magic (truncated or clobbered file)",
+            exc_type=MalformedFileError, size=n)
+    ps_len = tail[3]
+    # the postscript (+ its length byte) must fit between head magic and EOF
+    if ps_len == 0 or ps_len + 1 > n - len(_ORC_MAGIC):
+        raise integrity.reject_malformed(
+            "orc.envelope", "postscript length field points outside the file",
+            exc_type=MalformedFileError, ps_len=ps_len, size=n)
+
+
+def _check_orc_rows(prev: "int | None", rows: int, col: int) -> None:
+    """Every column of one read must agree on the row count — a file
+    whose columns disagree would otherwise build a ragged Table that
+    downstream kernels silently broadcast or truncate."""
+    if not integrity.enabled():
+        return
+    if rows < 0:
+        raise integrity.reject_malformed(
+            "orc.column", "negative row count from decoder",
+            exc_type=MalformedFileError, column=col, rows=rows)
+    if prev is not None and rows != prev:
+        raise integrity.reject_malformed(
+            "orc.table", "columns disagree on row count",
+            exc_type=MalformedFileError, column=col,
+            rows=rows, expected=prev)
+
+
+def _check_orc_string(offsets: np.ndarray, num_rows: int,
+                      chars_bytes: int, col: int) -> None:
+    """Post-decode bounds check on one string column: offsets monotone,
+    zero-based, and ending exactly at the character payload size —
+    caught here, before a clobbered offset indexes out of bounds inside
+    a device gather where there is no fault to catch."""
+    if not integrity.enabled():
+        return
+    if chars_bytes < 0 or int(offsets[0]) != 0 \
+            or int(offsets[-1]) != chars_bytes \
+            or (num_rows > 0 and bool(np.any(np.diff(offsets) < 0))):
+        raise integrity.reject_malformed(
+            "orc.column",
+            "string offsets inconsistent with character payload",
+            exc_type=MalformedFileError, column=col,
+            rows=num_rows, chars_bytes=chars_bytes)
 
 
 _UTC_NAMES = ("", "UTC", "GMT", "Etc/UTC", "Etc/GMT")
@@ -94,8 +183,7 @@ def stripe_info(data) -> list[tuple[int, int]]:
     """[(num_rows, data_bytes)] per stripe — the chunk-planning probe.
     ``data`` may be bytes or a filesystem path (mmap; only tail pages
     fault in)."""
-    from spark_rapids_jni_tpu.utils.fspath import as_fs_path
-
+    _validate_orc_envelope(data)
     lib = load_native()
     cap = 4096
     while True:
@@ -134,11 +222,15 @@ def read_table(
         _col_from_host,
         host_table_chunk,
     )
-    from spark_rapids_jni_tpu.utils.fspath import as_fs_path
 
     if stage not in ("device", "host"):
         raise ValueError(f"unknown stage {stage!r}")
 
+    if as_fs_path(data) is None:
+        # fault-injection window: integrity.ingest corruptions land on
+        # the untrusted bytes before any validation sees them
+        data = faults.fire_corrupt("integrity.ingest", 0, data)
+    _validate_orc_envelope(data)
     lib = load_native()
     cols, n_cols = _i32_array(columns)
     sts, n_sts = _i32_array(stripes)
@@ -168,6 +260,7 @@ def read_table(
                    "col_meta")
             kind, prec, scale, has_valid = list(meta)
             num_rows, chars_bytes = list(sizes)
+            _check_orc_rows(table_rows if i else None, num_rows, i)
             table_rows = num_rows
             dtype = _map_dtype(kind, scale, prec)
 
@@ -186,6 +279,7 @@ def read_table(
                     ) == 0,
                     "col_copy",
                 )
+                _check_orc_string(offsets, num_rows, chars_bytes, i)
                 validity = None if vbuf is None else vbuf.astype(bool)
                 snaps.append(
                     (dtype, offsets, validity, chars[:chars_bytes], None))

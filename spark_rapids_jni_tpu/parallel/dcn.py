@@ -55,6 +55,11 @@ Wire format (version 1, all little-endian):
           [chars]) | [children...]
   buffer: u8 dtype_str_len | dtype_str | u8 ndim | ndim x u64 shape |
           u8 compressed | u64 payload_len | payload
+
+With ``integrity.enabled`` every framed payload additionally carries the
+runtime/integrity.py length+checksum trailer and the link runs a
+stop-and-wait ACK/NAK handshake (see :class:`SliceLink`) so a corrupt
+frame is refetched from the sender instead of decoded into garbage.
 """
 
 from __future__ import annotations
@@ -205,10 +210,26 @@ def partition_for_slices(table: Table, keys: Sequence[int],
 class SliceLink:
     """One reliable byte stream to a peer slice (TCP prototype; the
     format is transport-agnostic — see the module design note). Frames
-    are 8-byte-length-prefixed serialize_table payloads."""
+    are 8-byte-length-prefixed serialize_table payloads.
+
+    With ``integrity.enabled`` each frame additionally carries the
+    integrity layer's length+checksum trailer and the receiver answers
+    every frame with one acknowledgement byte: ACK (0x06) accepts, NAK
+    (0x15) reports a verification mismatch and asks the sender — which
+    still holds a pristine copy — to re-seal and resend (stop-and-wait
+    ARQ; the lockstep two-slice exchange is already half-duplex, so the
+    ack adds half a round trip, not a pipeline stall). Both sides bound
+    refetches by ``resilience.max_attempts``; exhaustion dies classified
+    with a flight record. Disabled, the byte stream is exactly the
+    legacy framing: no trailer, no acknowledgements."""
+
+    _ACK = b"\x06"
+    _NAK = b"\x15"
 
     def __init__(self, sock):
         self._sock = sock
+        self._send_seq = 0
+        self._recv_seq = 0
 
     @classmethod
     def listen(cls, port: int, host: str = "127.0.0.1") -> "SliceLink":
@@ -256,8 +277,34 @@ class SliceLink:
                 rows=table.num_rows)
         else:
             blob = _frame()
-        self._sock.sendall(struct.pack("<Q", len(blob)) + blob)
-        return len(blob)
+        from spark_rapids_jni_tpu.runtime import integrity
+
+        if not integrity.enabled():
+            self._sock.sendall(struct.pack("<Q", len(blob)) + blob)
+            return len(blob)
+        attempts = max(1, resilience.policy().max_attempts)
+        self._send_seq += 1
+        for attempt in range(1, attempts + 1):
+            framed = integrity.seal(blob)
+            # the corruption window sits BETWEEN seal and send — the
+            # link-corruption shape the trailer exists to catch; each
+            # resend re-seals the pristine blob, so a refetch recovers
+            framed = faults.fire_corrupt(
+                "integrity.wire", self._send_seq, framed,
+                rows=table.num_rows, attempt=attempt)
+            self._sock.sendall(struct.pack("<Q", len(framed)) + framed)
+            if self._recv_exact(1) == self._ACK:
+                return len(framed)
+        from spark_rapids_jni_tpu.telemetry import spans
+
+        flight = spans.dump_flight_record(
+            "wire_corruption", state={"attempts": attempts,
+                                      "frame": self._send_seq})
+        raise resilience.FatalExecutionError(
+            f"dcn.send_table: peer rejected frame {self._send_seq} as "
+            f"corrupt after {attempts} resends",
+            seam="dcn.transport", attempts=attempts,
+            **({"flight_record": flight} if flight else {}))
 
     def recv_table(self) -> Table:
         from spark_rapids_jni_tpu.runtime import faults, resilience
@@ -272,9 +319,56 @@ class SliceLink:
                                 seam="dcn.transport")
         else:
             _entry()
-        hdr = self._recv_exact(8)
-        (length,) = struct.unpack("<Q", hdr)
-        return deserialize_table(self._recv_exact(length))
+        from spark_rapids_jni_tpu import telemetry
+        from spark_rapids_jni_tpu.runtime import integrity
+
+        verified = integrity.enabled()
+        attempts = max(1, resilience.policy().max_attempts)
+        if verified:
+            self._recv_seq += 1
+        attempt = 1
+        while True:
+            hdr = self._recv_exact(8)
+            (length,) = struct.unpack("<Q", hdr)
+            framed = self._recv_exact(length)
+            if not verified:
+                return deserialize_table(framed)
+            try:
+                blob = integrity.verify(
+                    framed, seam="integrity.wire", op="dcn.recv_table",
+                    frame=self._recv_seq, attempt=attempt)
+            except resilience.CorruptDataError as exc:
+                # refetch: the sender still holds the pristine table, so
+                # NAK asks for a fresh frame. NAK even on the final
+                # attempt — the sender's loop shares the attempt budget,
+                # so both sides die classified instead of deadlocking on
+                # a half-acknowledged frame.
+                telemetry.REGISTRY.counter("integrity.refetch").inc()
+                telemetry.record_integrity(
+                    "dcn.recv_table", "refetch", seam="integrity.wire",
+                    nbytes=length, attempt=attempt, frame=self._recv_seq)
+                self._sock.sendall(self._NAK)
+                if attempt >= attempts:
+                    from spark_rapids_jni_tpu.telemetry import spans
+
+                    flight = spans.dump_flight_record(
+                        "wire_corruption",
+                        state={"attempts": attempts,
+                               "frame": self._recv_seq})
+                    raise resilience.FatalExecutionError(
+                        f"dcn.recv_table: frame {self._recv_seq} corrupt "
+                        f"after {attempts} refetches: {exc}",
+                        seam="dcn.transport", attempts=attempts,
+                        **({"flight_record": flight} if flight else {}),
+                    ) from exc
+                attempt += 1
+                continue
+            self._sock.sendall(self._ACK)
+            if attempt > 1:
+                telemetry.record_integrity(
+                    "dcn.recv_table", "recovered", seam="integrity.wire",
+                    nbytes=length, attempt=attempt, frame=self._recv_seq)
+            return deserialize_table(blob)
 
     def _recv_exact(self, n: int) -> bytes:
         chunks = []

@@ -18,6 +18,12 @@ with narrowing (the planner picks whichever is smaller). A value outside
 not silent truncation, exactly like the reference's hard batch bounds
 (reference row_conversion.cu:476-479).
 
+Scope note: this module is the DEVICE-side codec (value transforms that
+ride the collective). The host-side byte frames — serialization, the
+runtime/integrity.py checksum trailer, and the NAK/refetch protocol for
+corrupt frames — live in ``parallel/dcn.py``; nothing here touches raw
+wire bytes, so the integrity seam does not pass through this file.
+
 Pack layout: value j of a block occupies bits [j*bits, (j+1)*bits) of the
 little-endian uint32 word stream — FOR/bit-pack order compatible with the
 classic Parquet/ORC bitpacking definition, so the same math later backs

@@ -13,12 +13,24 @@ import ctypes
 from typing import Sequence
 
 from spark_rapids_jni_tpu.runtime import load_native
+from spark_rapids_jni_tpu.runtime.resilience import MalformedInputError
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 
 class NativeError(RuntimeError):
     """Raised when the native core reports a failure — the CudfException
     equivalent of the reference's CATCH_STD bridge."""
+
+
+class MalformedFileError(MalformedInputError, NativeError):
+    """Untrusted Parquet/ORC input failed structural validation.
+
+    Dual-parented on purpose: :class:`MalformedInputError` classifies it
+    for the serving stack (the server rejects that one query cleanly —
+    never retried, never degraded, zero leaked reservations), while the
+    :class:`NativeError` base keeps every legacy ``except NativeError``
+    caller working — hardening the readers reclassifies failures, it
+    does not change who catches them."""
 
 
 class ParquetFooter:
@@ -44,9 +56,22 @@ class ParquetFooter:
         partition byte range (negative part_length keeps all groups).
         Names should be pre-lowercased by the caller when ignore_case is
         set, as the reference documents (ParquetFooter.java:78-79)."""
-        lib = load_native()
+        from spark_rapids_jni_tpu.runtime import integrity
+
         if len(names) != len(num_children):
             raise ValueError("names and num_children must have equal length")
+        if integrity.enabled():
+            # untrusted-input preflight, before any native parse
+            if len(buffer) == 0:
+                raise integrity.reject_malformed(
+                    "parquet.footer", "empty thrift footer buffer",
+                    exc_type=MalformedFileError)
+            if part_offset < 0:
+                raise integrity.reject_malformed(
+                    "parquet.footer",
+                    "negative partition offset",
+                    exc_type=MalformedFileError, part_offset=part_offset)
+        lib = load_native()
         c_names = (ctypes.c_char_p * len(names))(
             *[n.encode() for n in names]
         )
@@ -63,7 +88,11 @@ class ParquetFooter:
             1 if ignore_case else 0,
         )
         if handle == 0:
-            raise NativeError(lib.last_error())
+            # the native thrift parser rejected the bytes: malformed
+            # input, classified for the server, NativeError for legacy
+            raise integrity.reject_malformed(
+                "parquet.footer", lib.last_error(),
+                exc_type=MalformedFileError)
         return cls(handle)
 
     def _require_open(self) -> int:

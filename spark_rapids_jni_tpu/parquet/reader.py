@@ -33,7 +33,8 @@ import numpy as np
 
 from spark_rapids_jni_tpu import types as t
 from spark_rapids_jni_tpu.columnar import Column, Table
-from spark_rapids_jni_tpu.parquet.footer import NativeError
+from spark_rapids_jni_tpu.parquet.footer import MalformedFileError, NativeError
+from spark_rapids_jni_tpu.runtime import faults, integrity
 from spark_rapids_jni_tpu.runtime.native import load_native
 from spark_rapids_jni_tpu.utils.fspath import as_fs_path
 from spark_rapids_jni_tpu.types import DType, TypeId
@@ -130,8 +131,103 @@ def _flba_to_int128(raw: np.ndarray, width: int) -> np.ndarray:
 
 
 def _check(lib, ok: bool, what: str) -> None:
+    # decode failures on untrusted bytes classify as malformed input
+    # (MalformedFileError is-a NativeError, so legacy catches still work)
     if not ok:
-        raise NativeError(f"{what}: {lib.last_error()}")
+        raise integrity.reject_malformed(
+            f"parquet.{what}", f"{what}: {lib.last_error()}",
+            exc_type=MalformedFileError)
+
+
+_PAR1 = b"PAR1"
+
+
+def _validate_parquet_envelope(data: "bytes | str | os.PathLike") -> None:
+    """Untrusted-input preflight: check the Parquet file envelope —
+    leading/trailing magic and the footer length field against the file
+    size — BEFORE any decoder touches the bytes. Pure Python (no native
+    lib needed), so a truncated or clobbered file is rejected classified
+    even where the native engine is absent. The deep structural checks
+    (thrift schema, page bounds, dictionary indices vs cardinality) run
+    inside the hardened native parse behind the same classification."""
+    if not integrity.enabled():
+        return
+    path = as_fs_path(data)
+    if path is None:
+        n = len(data)
+        head, tail = bytes(data[:4]), bytes(data[-12:])
+    else:
+        try:
+            n = os.path.getsize(path)
+            with open(path, "rb") as fh:
+                head = fh.read(4)
+                fh.seek(max(0, n - 12))
+                tail = fh.read(12)
+        except OSError:
+            return  # unreadable path: let the native open report it
+    if n < 12:
+        raise integrity.reject_malformed(
+            "parquet.envelope",
+            "file too short to be parquet",
+            exc_type=MalformedFileError, size=n)
+    if head != _PAR1:
+        raise integrity.reject_malformed(
+            "parquet.envelope",
+            "bad leading magic (not a parquet file)",
+            exc_type=MalformedFileError, size=n)
+    if tail[-4:] != _PAR1:
+        raise integrity.reject_malformed(
+            "parquet.envelope",
+            "bad trailing magic (truncated or clobbered file)",
+            exc_type=MalformedFileError, size=n)
+    import struct as _struct
+
+    (footer_len,) = _struct.unpack("<I", tail[-8:-4])
+    if footer_len == 0 or footer_len + 12 > n:
+        raise integrity.reject_malformed(
+            "parquet.envelope",
+            "footer length field points outside the file",
+            exc_type=MalformedFileError, footer_len=footer_len, size=n)
+
+
+def _validate_flat_snap(snap, num_rows: int, phys: int,
+                        data_bytes: int, chars_bytes: int) -> None:
+    """Post-decode bounds checks on one flat column: declared row count
+    vs actual payload, string offsets monotone and inside the character
+    buffer. Catches a decoder handing back internally inconsistent
+    buffers before they are staged (and before downstream gathers index
+    out of bounds on device, where there is no fault to catch)."""
+    if not integrity.enabled():
+        return
+    _dtype, values, _validity, chars, _children = snap
+    if num_rows < 0 or data_bytes < 0 or chars_bytes < 0:
+        raise integrity.reject_malformed(
+            "parquet.column", "negative size from decoder",
+            exc_type=MalformedFileError, rows=num_rows,
+            data_bytes=data_bytes, chars_bytes=chars_bytes)
+    if phys == _PHYS_BYTE_ARRAY:
+        offsets = values
+        if offsets.shape[0] != num_rows + 1:
+            raise integrity.reject_malformed(
+                "parquet.column",
+                "string offsets disagree with declared row count",
+                exc_type=MalformedFileError, rows=num_rows,
+                offsets=int(offsets.shape[0]))
+        if num_rows >= 0 and (
+                int(offsets[0]) != 0
+                or int(offsets[-1]) != int(chars.shape[0])
+                or (num_rows > 0 and bool(np.any(np.diff(offsets) < 0)))):
+            raise integrity.reject_malformed(
+                "parquet.column",
+                "string offsets inconsistent with character payload",
+                exc_type=MalformedFileError, rows=num_rows,
+                chars_bytes=int(chars.shape[0]))
+    elif phys in _PHYS_WIDTH and data_bytes != num_rows * _PHYS_WIDTH[phys]:
+        raise integrity.reject_malformed(
+            "parquet.column",
+            "column payload size disagrees with declared row count",
+            exc_type=MalformedFileError, rows=num_rows,
+            data_bytes=data_bytes, width=_PHYS_WIDTH[phys])
 
 
 def _i32_array(vals: Optional[Sequence[int]]):
@@ -146,6 +242,7 @@ def _i32_array(vals: Optional[Sequence[int]]):
 def row_group_info(data: "bytes | str | os.PathLike") -> list[tuple[int, int]]:
     """[(num_rows, byte_size)] per row group — the chunk-planning probe.
     Accepts in-memory bytes or a path (mmap; only footer pages fault in)."""
+    _validate_parquet_envelope(data)
     lib = load_native()
     cap = 4096
     while True:
@@ -192,7 +289,9 @@ def _read_flat_column_host(lib, handle: int, i: int):
             "col_copy",
         )
         validity = None if vbuf is None else vbuf.astype(bool)
-        return (dtype, offsets, validity, chars[:chars_bytes], None), num_rows
+        snap = (dtype, offsets, validity, chars[:chars_bytes], None)
+        _validate_flat_snap(snap, num_rows, phys, data_bytes, chars_bytes)
+        return snap, num_rows
 
     raw = np.empty(max(data_bytes, 1), dtype=np.uint8)
     _check(
@@ -213,7 +312,22 @@ def _read_flat_column_host(lib, handle: int, i: int):
     else:
         values = raw[:data_bytes].view(_PHYS_NP[phys])
     values = values.astype(dtype.storage_dtype, copy=False)
-    return (dtype, values, validity, None, None), num_rows
+    snap = (dtype, values, validity, None, None)
+    _validate_flat_snap(snap, num_rows, phys, data_bytes, chars_bytes)
+    return snap, num_rows
+
+
+def _check_row_agreement(prev: "int | None", rows: int, col: int) -> None:
+    """Every column of one read must agree on the row count — a file
+    whose columns disagree would otherwise build a ragged Table that
+    downstream kernels silently broadcast or truncate."""
+    if prev is None or not integrity.enabled():
+        return
+    if rows != prev:
+        raise integrity.reject_malformed(
+            "parquet.table", "columns disagree on row count",
+            exc_type=MalformedFileError, column=col,
+            rows=rows, expected=prev)
 
 
 def _read_flat_column(lib, handle: int, i: int) -> Column:
@@ -332,6 +446,13 @@ def read_table(
     a Table bit-identical to the default path."""
     if stage not in ("device", "host"):
         raise ValueError(f"unknown stage {stage!r}")
+    if as_fs_path(data) is None:
+        # chaos window for untrusted ingestion: in-memory file bytes can
+        # be corrupted by a fault script before any validation runs —
+        # the preflight + hardened decode below must classify, never
+        # crash unclassified or return garbage
+        data = faults.fire_corrupt("integrity.ingest", 0, data)
+    _validate_parquet_envelope(data)
     lib = load_native()
     cols, n_cols = _i32_array(columns)
     rgs, n_rgs = _i32_array(row_groups)
@@ -377,13 +498,22 @@ def read_table(
             snaps = []
             num_rows = 0
             for i in range(n_columns):
-                snap, num_rows = _read_flat_column_host(lib, handle, i)
+                snap, nr = _read_flat_column_host(lib, handle, i)
+                _check_row_agreement(num_rows if i else None, nr, i)
+                num_rows = nr
                 snaps.append(snap)
             return host_table_chunk(snaps, num_rows)
 
-        return Table(
-            [_read_flat_column(lib, handle, i) for i in range(n_columns)]
-        )
+        cols_out = []
+        rows_seen = None
+        for i in range(n_columns):
+            snap, nr = _read_flat_column_host(lib, handle, i)
+            _check_row_agreement(rows_seen, nr, i)
+            rows_seen = nr
+            cols_out.append(snap)
+        from spark_rapids_jni_tpu.runtime.memory import _col_from_host
+
+        return Table([_col_from_host(snap) for snap in cols_out])
     finally:
         lib.tpudf_read_close(handle)
 

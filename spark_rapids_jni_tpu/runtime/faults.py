@@ -26,8 +26,10 @@ from spark_rapids_jni_tpu.telemetry import REGISTRY
 __all__ = [
     "SEAMS",
     "FaultSpec",
+    "CorruptionSpec",
     "FaultScript",
     "fire",
+    "fire_corrupt",
     "inject",
     "active_injector",
 ]
@@ -67,6 +69,14 @@ SEAMS: Tuple[str, ...] = (
     "degrade.step",
     # watermark crossings on the memory limiter (runtime/memory.py)
     "memory.pressure",
+    # integrity verification boundaries (runtime/integrity.py call sites):
+    # payload-*corruption* seams — fired through fire_corrupt(), which
+    # mutates managed bytes in flight instead of raising, so the chaos
+    # suite can drill detection -> classified recovery end to end.
+    "integrity.spill",
+    "integrity.wire",
+    "integrity.checkpoint",
+    "integrity.ingest",
 )
 
 _SEAM_SET = frozenset(SEAMS)
@@ -103,6 +113,37 @@ def fire(seam: str, seq: int = 0, **ctx: Any) -> None:
         REGISTRY.counter("faults.injected").inc()
         REGISTRY.counter(f"faults.injected.{seam}").inc()
         raise
+
+
+def fire_corrupt(seam: str, seq: int, payload: bytes, **ctx: Any) -> bytes:
+    """Corruption seam hook: give the installed injector a chance to
+    mutate a managed payload (spill blob, wire frame, checkpoint bytes,
+    ingested file) before it is written/sent/decoded.
+
+    With no injector installed this is the same single ``is None`` check
+    as :func:`fire`. An injector participates by exposing a
+    ``corrupt_payload(seam, seq, payload, ctx) -> Optional[bytes]``
+    method (:class:`FaultScript` does, when built with ``corruptions``);
+    returning None or the payload unchanged leaves the bytes alone.
+    Mutations are counted under ``faults.corrupted`` /
+    ``faults.corrupted.<seam>`` — corruption is *injected silently* (no
+    raise); detection is the integrity layer's job, which is exactly
+    what the chaos suite is drilling.
+    """
+    hook = _active
+    if hook is None:
+        return payload
+    if seam not in _SEAM_SET:
+        raise ValueError(f"unknown fault seam {seam!r}; registered: {sorted(_SEAM_SET)}")
+    corrupt = getattr(hook, "corrupt_payload", None)
+    if corrupt is None:
+        return payload
+    mutated = corrupt(seam, int(seq), payload, ctx)
+    if mutated is None or mutated is payload:
+        return payload
+    REGISTRY.counter("faults.corrupted").inc()
+    REGISTRY.counter(f"faults.corrupted.{seam}").inc()
+    return mutated
 
 
 @contextlib.contextmanager
@@ -172,6 +213,74 @@ class FaultSpec:
         )
 
 
+class CorruptionSpec:
+    """One scheduled payload corruption at an ``integrity.*`` seam.
+
+    ``mode`` picks the mutation:
+
+    - ``"flip"`` — XOR one random bit of one random byte (link/bitrot
+      shape; length-preserving, so it also works on in-memory spill
+      snapshots where live arrays cannot shrink),
+    - ``"truncate"`` — cut the payload short (torn-write shape),
+    - ``"trailer"`` — clobber the final 16 bytes, i.e. the integrity
+      trailer itself (metadata-corruption shape).
+
+    The mutation is derived from ``(seed, seam, seq, fired)`` — never a
+    shared generator — so a corpus of corruptions is reproducible
+    case-by-case regardless of thread interleaving, and every mutation
+    is guaranteed to actually change the bytes (XOR with a nonzero
+    mask / a strictly shorter slice). ``seq=None`` matches any sequence
+    number; ``times`` bounds firings (default once).
+    """
+
+    MODES = ("flip", "truncate", "trailer")
+
+    def __init__(
+        self,
+        seam: str,
+        mode: str = "flip",
+        *,
+        seq: Optional[int] = None,
+        times: int = 1,
+        seed: int = 0,
+    ) -> None:
+        if seam not in _SEAM_SET:
+            raise ValueError(f"unknown fault seam {seam!r}; registered: {sorted(_SEAM_SET)}")
+        if mode not in self.MODES:
+            raise ValueError(f"unknown corruption mode {mode!r}; one of {self.MODES}")
+        self.seam = seam
+        self.mode = mode
+        self.seq = seq
+        self.times = int(times)
+        self.seed = int(seed)
+        self.fired = 0
+
+    def matches(self, seam: str, seq: int) -> bool:
+        if seam != self.seam or self.fired >= self.times:
+            return False
+        return self.seq is None or int(seq) == self.seq
+
+    def apply(self, payload: bytes, seq: int) -> bytes:
+        rng = random.Random(f"{self.seed}|{self.seam}|{int(seq)}|{self.fired}")
+        if not payload:
+            return payload
+        buf = bytearray(payload)
+        if self.mode == "flip":
+            buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        elif self.mode == "truncate":
+            return bytes(buf[: rng.randrange(len(buf))])
+        else:  # trailer
+            for i in range(max(0, len(buf) - 16), len(buf)):
+                buf[i] ^= rng.randrange(1, 256)
+        return bytes(buf)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"CorruptionSpec(seam={self.seam!r}, mode={self.mode!r}, "
+            f"seq={self.seq}, times={self.times}, fired={self.fired})"
+        )
+
+
 class FaultScript:
     """A schedule of faults: deterministic specs and/or seeded-random chaos.
 
@@ -184,6 +293,12 @@ class FaultScript:
     — NOT from a shared generator — so it is reproducible regardless of how
     pipeline/producer threads interleave seam firings.
 
+    Corruption: pass ``corruptions`` (a list of :class:`CorruptionSpec`);
+    each silently mutates the payload at its matching ``integrity.*``
+    seam when production code routes bytes through
+    :func:`fire_corrupt` — the detection/recovery drill for the
+    integrity layer.
+
     ``max_faults`` bounds total injections across the whole script (default
     unlimited); ``fired`` records ``(seam, seq)`` history for assertions.
     The script object is the injector: ``with faults.inject(script): ...``.
@@ -193,6 +308,7 @@ class FaultScript:
         self,
         specs: Optional[Sequence[FaultSpec]] = None,
         *,
+        corruptions: Optional[Sequence[CorruptionSpec]] = None,
         seed: Optional[int] = None,
         rate: float = 0.0,
         seams: Optional[Sequence[str]] = None,
@@ -200,6 +316,7 @@ class FaultScript:
         max_faults: Optional[int] = None,
     ) -> None:
         self.specs: List[FaultSpec] = list(specs or [])
+        self.corruptions: List[CorruptionSpec] = list(corruptions or [])
         if seams is not None:
             unknown = set(seams) - _SEAM_SET
             if unknown:
@@ -233,6 +350,20 @@ class FaultScript:
                 if rng.random() < self.rate:
                     self.fired.append((seam, seq))
                     _raise_fault(self.exc)
+
+    def corrupt_payload(self, seam: str, seq: int, payload: bytes, ctx: dict) -> Optional[bytes]:
+        """The :func:`fire_corrupt` capability: apply the first matching
+        :class:`CorruptionSpec`, or leave the payload alone."""
+        with self._lock:
+            if self.max_faults is not None and len(self.fired) >= self.max_faults:
+                return None
+            for spec in self.corruptions:
+                if spec.matches(seam, seq):
+                    mutated = spec.apply(payload, seq)
+                    spec.fired += 1
+                    self.fired.append((seam, seq))
+                    return mutated
+        return None
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return (

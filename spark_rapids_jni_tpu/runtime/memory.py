@@ -22,6 +22,8 @@ the part of RMM's surface a Spark executor actually interacts with:
 from __future__ import annotations
 
 import collections
+import os
+import pickle
 import threading
 import time
 from dataclasses import dataclass
@@ -30,7 +32,7 @@ from typing import NamedTuple, Optional
 import numpy as np
 
 from spark_rapids_jni_tpu import telemetry
-from spark_rapids_jni_tpu.runtime import faults
+from spark_rapids_jni_tpu.runtime import faults, integrity
 from spark_rapids_jni_tpu.telemetry import spans
 from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.log import get_logger
@@ -564,6 +566,48 @@ def _host_snap_nbytes(snap) -> int:
     return n
 
 
+def _unlink_quiet(path: "str | None") -> None:
+    if not path:
+        return
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def _inject_snap_corruption(snaps: list, seam: str, eid: int) -> None:
+    """Fault-script corruption window for IN-MEMORY spill snapshots:
+    route the first packed host buffer through :func:`faults.fire_corrupt`
+    so the chaos suite can plant latent corruption that unspill must
+    detect. Live numpy arrays cannot shrink, so only length-preserving
+    mutations land on raw buffers; zstd packs accept any mutation. One
+    ``is None`` check when no injector is installed."""
+    if faults.active_injector() is None:
+        return
+    for si, snap in enumerate(snaps):
+        dtype, data, validity, chars, children = snap
+        for bi, buf in enumerate((data, validity, chars)):
+            if buf is None:
+                continue
+            if isinstance(buf, tuple):  # ("zstd", dtype_str, shape, blob)
+                blob = buf[3]
+                mutated = faults.fire_corrupt(seam, eid, blob)
+                if mutated is blob:
+                    continue
+                new_buf = (buf[0], buf[1], buf[2], mutated)
+            else:
+                raw = buf.tobytes()
+                mutated = faults.fire_corrupt(seam, eid, raw)
+                if mutated is raw or len(mutated) != len(raw):
+                    continue
+                new_buf = np.frombuffer(
+                    bytearray(mutated), dtype=buf.dtype).reshape(buf.shape)
+            bufs = [data, validity, chars]
+            bufs[bi] = new_buf
+            snaps[si] = (dtype, bufs[0], bufs[1], bufs[2], children)
+            return
+
+
 class SpillStore:
     """HBM pressure valve — the role RMM's spillable pool plays for the
     Spark plugin: registered tables count against a device budget; when a
@@ -578,14 +622,33 @@ class SpillStore:
     """
 
     def __init__(self, budget_bytes: int, compress_spill: bool = False,
-                 compress_level: int = 3):
+                 compress_level: int = 3,
+                 spill_dir: "str | None" = None):
         """``compress_spill`` zstd-compresses spilled host buffers (the
         nvcomp general-codec role on the host path): logical HBM bytes
         stay the accounting unit; ``stats()['host_stored_bytes']``
-        reports the actual compressed footprint."""
+        reports the actual compressed footprint.
+
+        ``spill_dir`` (default: the ``memory.spill_dir`` option; "" =
+        off) moves spilled payloads from host memory to files in that
+        directory. Files are written crash-safe — tmp + ``os.replace``
+        + fsync + read-back verify — and carry the integrity trailer
+        when ``integrity.enabled``, so a torn write or bitrot on the
+        spill device is a classified ``CorruptDataError`` at unspill,
+        never silently wrong bytes staged back to HBM."""
         if budget_bytes <= 0:
             raise ValueError("budget must be positive")
         self.budget = int(budget_bytes)
+        if spill_dir is None:
+            spill_dir = str(get_option("memory.spill_dir")) or None
+        self._spill_dir = spill_dir
+        if self._spill_dir:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            # stores may share a directory: namespace this store's files
+            self._spill_prefix = f"spill-{os.getpid()}-{id(self):x}"
+        else:
+            self._spill_dir = None
+            self._spill_prefix = ""
         self._lock = threading.Lock()
         self._next_id = 1
         # id -> dict(state="device"|"host", table|host_cols, nbytes, tick)
@@ -630,11 +693,32 @@ class SpillStore:
         # fire before mutating the entry: an injected spill-IO failure
         # must leave the victim resident and the store consistent
         faults.fire("spill.spill", eid, nbytes=e["nbytes"])
+        seam = e.get("iseam", "integrity.spill")
         with spans.child("spill", handle=eid, nbytes=e["nbytes"]):
             e["host_cols"] = [
                 _col_to_host(c, self._cctx) for c in e["table"].columns]
+            if self._spill_dir is not None:
+                # disk tier: pickle the snapshot, seal it, write it
+                # crash-safe (tmp + os.replace + read-back verify)
+                payload = pickle.dumps(
+                    e["host_cols"], protocol=pickle.HIGHEST_PROTOCOL)
+                sealed = integrity.enabled()
+                blob = integrity.seal(payload) if sealed else payload
+                blob = faults.fire_corrupt(seam, eid, blob, nbytes=e["nbytes"])
+                path = os.path.join(
+                    self._spill_dir, f"{self._spill_prefix}-{eid}.bin")
+                integrity.write_payload_file(path, blob)
+                e["host_cols"] = None
+                e["path"] = path
+                e["sealed"] = sealed
+                e["stored_bytes"] = len(blob)
+            elif integrity.enabled():
+                # in-memory tier: checksum the packed snapshot now so
+                # unspill can prove the host copy never drifted
+                e["crc"] = integrity.snaps_checksum(e["host_cols"])
+                _inject_snap_corruption(e["host_cols"], seam, eid)
         e["table"] = None  # drop the device arrays -> XLA frees HBM
-        e["state"] = "host"
+        e["state"] = "disk" if self._spill_dir is not None else "host"
         self.spill_count += 1
         self.spilled_bytes += e["nbytes"]
         telemetry.record_spill(
@@ -675,8 +759,15 @@ class SpillStore:
                     eid, "memory pressure: proactive spill of coldest entry")
         return freed
 
-    def put(self, table) -> int:
-        """Register a device table; returns its handle. May spill others."""
+    def put(self, table, *, integrity_seam: str = "integrity.spill") -> int:
+        """Register a device table; returns its handle. May spill others.
+
+        ``integrity_seam`` tags which verification boundary this entry's
+        payload belongs to (``integrity.spill`` for plain working sets,
+        ``integrity.checkpoint`` for out-of-core partials) — it routes
+        both the corruption-injection window and the mismatch
+        classification, so a corrupt checkpoint is distinguishable from
+        a corrupt spill in telemetry and recovery."""
         nbytes = _table_nbytes(table)
         with self._lock:
             self._spill_lru_locked(nbytes)
@@ -686,6 +777,7 @@ class SpillStore:
             self._entries[eid] = {
                 "state": "device", "table": table, "host_cols": None,
                 "nbytes": nbytes, "tick": self._tick,
+                "iseam": str(integrity_seam),
             }
             return eid
 
@@ -704,13 +796,35 @@ class SpillStore:
             # fire before any staging: an injected unspill failure must
             # leave the entry spilled (host copy intact, retryable)
             faults.fire("spill.unspill", handle, nbytes=e["nbytes"])
+            seam = e.get("iseam", "integrity.spill")
             with spans.child("unspill", handle=handle, nbytes=e["nbytes"]):
+                # verify BEFORE any byte is decoded or staged: a corrupt
+                # payload raises classified CorruptDataError with the
+                # entry still spilled (file/host copy untouched, so the
+                # owning seam can replay from source or die with a
+                # flight record — never stage garbage to HBM)
+                if e["state"] == "disk":
+                    blob = integrity.read_payload_file(
+                        e["path"], seam=seam, sealed=e["sealed"],
+                        op="spill_store.get", handle=handle)
+                    snaps = pickle.loads(blob)
+                elif e.get("crc") is not None:
+                    snaps = e["host_cols"]
+                    integrity.verify_snaps(
+                        snaps, e["crc"], seam=seam,
+                        op="spill_store.get", handle=handle)
+                else:
+                    snaps = e["host_cols"]
                 self._spill_lru_locked(e["nbytes"])
                 cols = [
                     _col_from_host(snap, self._dctx)
-                    for snap in e["host_cols"]]
+                    for snap in snaps]
             e["table"] = Table(cols)
             e["host_cols"] = None
+            e["crc"] = None
+            if e["state"] == "disk":
+                _unlink_quiet(e.pop("path"))
+                e.pop("stored_bytes", None)
             e["state"] = "device"
             self.unspill_count += 1
             self.unspilled_bytes += e["nbytes"]
@@ -754,7 +868,17 @@ class SpillStore:
 
     def drop(self, handle: int) -> None:
         with self._lock:
-            self._entries.pop(handle, None)
+            e = self._entries.pop(handle, None)
+            if e is not None and e["state"] == "disk":
+                _unlink_quiet(e.get("path"))
+
+    def close(self) -> None:
+        """Release every entry and unlink this store's spill files."""
+        with self._lock:
+            for e in self._entries.values():
+                if e["state"] == "disk":
+                    _unlink_quiet(e.get("path"))
+            self._entries.clear()
 
     def stats(self) -> dict:
         with self._lock:
@@ -765,9 +889,17 @@ class SpillStore:
                 sum(_host_snap_nbytes(s) for s in e["host_cols"])
                 for e in self._entries.values() if e["state"] == "host"
             )
+            disk = sum(e["nbytes"] for e in self._entries.values()
+                       if e["state"] == "disk")
+            disk_stored = sum(
+                e.get("stored_bytes", 0)
+                for e in self._entries.values() if e["state"] == "disk")
             return {
                 "device_bytes": device, "host_bytes": host,
                 "host_stored_bytes": stored,  # compressed footprint
+                "disk_bytes": disk,  # logical HBM bytes parked on disk
+                "disk_stored_bytes": disk_stored,  # file footprint
+                "spill_dir": self._spill_dir or "",
                 "budget_bytes": self.budget,
                 "spills": self.spill_count, "unspills": self.unspill_count,
                 "spilled_bytes": self.spilled_bytes,

@@ -248,7 +248,11 @@ def run_chunked_aggregate(
                         partial = partial_fn(chunk)
                 else:
                     partial = partial_fn(chunk)
-                return spill.put(partial)
+                # checkpoint-tagged: a later verification mismatch on
+                # this entry classifies (and recovers) as a corrupt
+                # CHECKPOINT — discard + replay — not a corrupt spill
+                return spill.put(partial,
+                                 integrity_seam="integrity.checkpoint")
         finally:
             if not producer_owns:
                 limiter.release(nb)
@@ -334,10 +338,32 @@ def run_chunked_aggregate(
     # During the concatenate both the partials and the merged table are
     # resident (reserved together); the partials release the moment the
     # concat result exists.
+    def _replay_chunk(idx: int):
+        """Recovery for a corrupt checkpoint: the spilled partial failed
+        integrity verification, so resuming from it would resume from
+        garbage — recompute chunk ``idx``'s partial from its
+        materialized source instead (in-order delivery guarantees
+        ``handles[idx] <-> sources[idx]``). Returns ``(partial, nbytes)``
+        with the partial's bytes reserved — the same ownership contract
+        ``get_reserved`` hands the restore loop."""
+        src = sources[idx]
+        obj = src() if callable(src) else src
+        staged = hasattr(obj, "stage")
+        nb_c = obj.nbytes if staged else _table_nbytes(obj)
+        limiter.reserve(nb_c)
+        try:
+            chunk_tbl = obj.stage() if staged else obj
+            partial = partial_fn(chunk_tbl)
+            nb_p = _table_nbytes(partial)
+            limiter.reserve(nb_p)
+            return partial, nb_p
+        finally:
+            limiter.release(nb_c)
+
     partials: list[Table] = []
     partial_bytes = 0
     try:
-        for h in handles:
+        for idx, h in enumerate(handles):
             if cancel_token is not None:
                 cancel_token.check("outofcore.restore")
             # reserve BEFORE staging: a partial set that exceeds the
@@ -346,14 +372,31 @@ def run_chunked_aggregate(
             # host->device copy — the pipelined-unspill contract).
             # get_reserved leaves no reservation behind on failure, so a
             # transient unspill fault retries with zero carried state.
-            if pol.enabled:
-                tbl, nb_p = resilience.retrying(
-                    "run_chunked_aggregate",
-                    lambda: spill.get_reserved(h, limiter),
-                    seam="spill.unspill", rung="replay_chunk",
-                    pol=pol, handle=h)
-            else:
-                tbl, nb_p = spill.get_reserved(h, limiter)
+            try:
+                if pol.enabled:
+                    tbl, nb_p = resilience.retrying(
+                        "run_chunked_aggregate",
+                        lambda: spill.get_reserved(h, limiter),
+                        seam="spill.unspill", rung="replay_chunk",
+                        pol=pol, handle=h)
+                else:
+                    tbl, nb_p = spill.get_reserved(h, limiter)
+            except resilience.CorruptDataError:
+                # a corrupt checkpoint is deterministic (not retried
+                # above: CorruptDataError is non-transient at rest) —
+                # discard the partial and replay the chunk when the
+                # source list survives; serial/generator streams are
+                # consumed, so there the classified error propagates
+                if sources is None:
+                    raise
+                telemetry.record_integrity(
+                    "run_chunked_aggregate", "replay",
+                    seam="integrity.checkpoint", chunk=idx)
+                spill.drop(h)
+                tbl, nb_p = _replay_chunk(idx)
+                telemetry.record_integrity(
+                    "run_chunked_aggregate", "recovered",
+                    seam="integrity.checkpoint", chunk=idx)
             partial_bytes += nb_p
             partials.append(tbl)
             spill.drop(h)

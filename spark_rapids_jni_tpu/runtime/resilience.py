@@ -52,6 +52,8 @@ __all__ = [
     "CapacityOverflow",
     "ResourceExhausted",
     "TransportError",
+    "CorruptDataError",
+    "MalformedInputError",
     "FatalExecutionError",
     "QueryCancelled",
     "CancelToken",
@@ -121,6 +123,32 @@ class TransportError(ResilienceError):
     """Shuffle / DCN transport loss (connection reset, timeout, short read)."""
 
     transient = True
+
+
+class CorruptDataError(ResilienceError):
+    """A checksummed payload (spill entry, DCN wire frame, out-of-core
+    checkpoint) failed integrity verification.
+
+    Not transient in the blind-replay sense — re-reading the same bytes
+    reproduces the same mismatch deterministically. The recovery is
+    structural and seam-specific: at transport seams a fresh copy exists
+    on the peer, so :func:`is_transient` special-cases those to drive a
+    refetch; at rest the bytes are gone — the owning seam discards the
+    payload and replays from source (out-of-core checkpoints) or dies
+    classified with a flight record (spill entries with no source).
+    """
+
+    transient = False
+
+
+class MalformedInputError(ResilienceError):
+    """Untrusted input (a customer Parquet/ORC file) failed structural
+    validation — bad magic, an offset or size pointing outside the file,
+    declared counts disagreeing with actual payload. Never retried and
+    never degraded: the file is wrong, not the engine — the server
+    rejects that one query cleanly and other sessions proceed."""
+
+    transient = False
 
 
 class FatalExecutionError(ResilienceError):
@@ -243,6 +271,11 @@ def is_transient(exc: BaseException, *, seam: str = "") -> bool:
     that merely *looks* transient is not retried: resilience must not change
     legacy propagation of errors it does not own.
     """
+    if isinstance(exc, CorruptDataError):
+        # At a transport seam the peer still holds a pristine copy, so a
+        # corrupt frame is refetchable; at rest the bytes are simply gone
+        # and re-reading them reproduces the mismatch deterministically.
+        return seam in _TRANSPORT_SEAMS
     if isinstance(exc, ResilienceError):
         return exc.transient
     if seam in _TRANSPORT_SEAMS and isinstance(exc, (ConnectionError, TimeoutError)):

@@ -88,6 +88,7 @@ from spark_rapids_jni_tpu.runtime.memory import (
 from spark_rapids_jni_tpu.telemetry.events import (
     events as _ring_events,
     record_degrade,
+    record_integrity,
     record_server,
     session_scope,
 )
@@ -852,6 +853,14 @@ class QueryServer:
                     # silent wedge
                     kind = resilience.classify(
                         exc, seam="server.execute").__name__
+                    if isinstance(exc, resilience.MalformedInputError):
+                        # untrusted-input rejection: this one query dies
+                        # clean (no retry, no degradation); count it so
+                        # operators can tell hostile inputs from bugs
+                        REGISTRY.counter("integrity.malformed_rejects").inc()
+                        record_integrity(
+                            ticket.plan.name, "malformed",
+                            seam="integrity.ingest", session=sid)
                     qspan.set_status("failed")
                     qspan.annotate(error_kind=kind)
                     flight = spans.dump_flight_record(

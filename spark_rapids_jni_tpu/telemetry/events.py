@@ -53,6 +53,7 @@ __all__ = [
     "record_bench_stale",
     "record_server",
     "record_degrade",
+    "record_integrity",
     "session_scope",
     "current_session",
     "events",
@@ -340,6 +341,47 @@ def record_degrade(
     return True
 
 
+def record_integrity(
+    op: str,
+    event: str,
+    *,
+    seam: str,
+    nbytes: Optional[int] = None,
+    **extra: Any,
+) -> bool:
+    """An integrity-layer event (runtime/integrity.py and its call sites).
+
+    ``event`` is one of ``mismatch`` (a checksum trailer failed
+    verification) / ``refetch`` (a corrupt wire frame was NAK'd for
+    resend) / ``recovered`` (a refetch or checkpoint replay produced good
+    bytes) / ``replay`` (a corrupt checkpoint partial was discarded and
+    its chunk recomputed) / ``malformed`` (untrusted input rejected at
+    ingestion). ``seam`` names the verification boundary
+    (``integrity.spill`` / ``integrity.wire`` / ``integrity.checkpoint``
+    / ``integrity.ingest``) and is mandatory even when telemetry is off —
+    an unattributable corruption event is a bug, same contract as
+    fallback reasons and resilience seams.
+    """
+    if not seam or not str(seam).strip():
+        raise ValueError(f"record_integrity({op!r}): seam must be non-empty")
+    if "kind" in extra or "op" in extra:
+        raise ValueError(
+            f"record_integrity({op!r}): 'kind'/'op' are reserved record "
+            "fields; pass caller context under other names")
+    if not enabled():
+        return False
+    rec = _base("integrity", op, None, None, extra)
+    rec["event"] = str(event)
+    rec["seam"] = str(seam)
+    if nbytes is not None:
+        rec["nbytes"] = int(nbytes)
+    # no counter side effects here: integrity.verify owns the
+    # ``integrity.*`` counters and counts unconditionally (verification
+    # accounting must hold even with telemetry off, like the limiter's)
+    _emit(rec)
+    return True
+
+
 def record_bench_stale(
     metric: str,
     *,
@@ -389,6 +431,8 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
     server: Dict[str, int] = {}
     degrade: Dict[str, int] = {}
     degrade_tiers: Dict[str, int] = {}
+    integrity: Dict[str, int] = {}
+    integrity_seams: Dict[str, int] = {}
     stale_reads = 0
     dispatches = 0
     spill_bytes = 0
@@ -413,6 +457,12 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
             if ev == "step":
                 tier = str(r.get("tier", "?"))
                 degrade_tiers[tier] = degrade_tiers.get(tier, 0) + 1
+        elif kind == "integrity":
+            ev = str(r.get("event", "?"))
+            integrity[ev] = integrity.get(ev, 0) + 1
+            if ev == "mismatch":
+                seam = str(r.get("seam", "?"))
+                integrity_seams[seam] = integrity_seams.get(seam, 0) + 1
         elif kind == "fallback":
             op = str(r.get("op", "?"))
             fallbacks[op] = fallbacks.get(op, 0) + 1
@@ -438,6 +488,8 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         "server": dict(sorted(server.items())),
         "degrade": dict(sorted(degrade.items())),
         "degrade_tiers": dict(sorted(degrade_tiers.items())),
+        "integrity": dict(sorted(integrity.items())),
+        "integrity_seams": dict(sorted(integrity_seams.items())),
         "spans": spans,
         "span_status": dict(sorted(span_status.items())),
         "stale_reads": stale_reads,

@@ -19,7 +19,7 @@ __all__ = ["load_jsonl", "filter_records", "aggregate", "render_table",
 
 # --kind values the CLI accepts ("span" records are the trace
 # substrate, not an event category: export those with ``trace``)
-KINDS = ("dispatch", "fallback", "spill", "server", "degrade")
+KINDS = ("dispatch", "fallback", "spill", "server", "degrade", "integrity")
 
 
 def filter_records(
@@ -202,6 +202,14 @@ def report(path: str, *, session: Optional[str] = None,
             tiers = "  ".join(
                 f"{t}={n}" for t, n in sorted(s["degrade_tiers"].items()))
             lines.append(f"  step tiers: {tiers}")
+    if s["integrity"]:
+        lines.append("integrity events:")
+        for ev, n in sorted(s["integrity"].items()):
+            lines.append(f"  {n:4d}x  {ev}")
+        if s["integrity_seams"]:
+            seams = "  ".join(
+                f"{sm}={n}" for sm, n in sorted(s["integrity_seams"].items()))
+            lines.append(f"  mismatch seams: {seams}")
     if s.get("spans"):
         status = "  ".join(
             f"{st}={n}" for st, n in sorted(s["span_status"].items()))

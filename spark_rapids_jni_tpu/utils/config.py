@@ -167,6 +167,21 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # the first learn saves immediately and close() always flushes.
     # <= 0 writes through on every served query.
     "server.estimate_save_interval_s": (5.0, float),
+    # End-to-end data integrity (runtime/integrity.py): length+checksum
+    # trailers sealed onto spill payloads, DCN wire frames and
+    # out-of-core checkpoints, verified before any read-back byte is
+    # decoded, plus structural validation of untrusted Parquet/ORC
+    # input. Also honored via the short env var SPARK_RAPIDS_TPU_INTEGRITY
+    # (checked first by integrity.enabled()). Off restores today's
+    # byte-for-byte behavior at every seam: no trailers, no wire acks,
+    # no envelope preflight.
+    "integrity.enabled": (True, bool),
+    # Directory for disk-tier spill files (SpillStore). "" keeps spilled
+    # entries in host memory (today's behavior); a path moves spilled
+    # payloads to checksummed files written crash-safe (tmp + os.replace
+    # + fsync + read-back verify) so a crash mid-spill can never leave a
+    # torn entry a later unspill trusts.
+    "memory.spill_dir": ("", str),
 }
 
 _overrides: dict[str, Any] = {}

@@ -1,0 +1,296 @@
+"""Corruption fuzz harness for the integrity layer (ISSUE 10 satellite).
+
+220 seeded corruption cases across every managed byte boundary — the
+in-memory spill tier, the disk spill tier, the DCN wire, out-of-core
+checkpoints, and untrusted Parquet/ORC ingestion. The single invariant,
+asserted per case:
+
+    every corruption is DETECTED AND CLASSIFIED (``CorruptDataError`` /
+    ``MalformedInputError``) or the result is BIT-IDENTICAL to the
+    corruption-free run — never an unclassified crash, never garbage
+    decoded, never a leaked reservation.
+
+Every mutation derives from ``CorruptionSpec(seed=...)`` — reproducible
+case-by-case: a failure names its (family, mode, seed) triple and replays
+standalone. Ingestion cases whose mutation survives the pure-Python
+envelope preflight proceed to the native loader, which this build does
+not ship — those raise ``OSError`` (needs-native), counted as such: the
+contract "never garbage" still holds because nothing was decoded.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import telemetry
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.runtime import faults, integrity
+from spark_rapids_jni_tpu.runtime.memory import (
+    MemoryLimiter,
+    SpillStore,
+    _table_nbytes,
+)
+from spark_rapids_jni_tpu.runtime.outofcore import run_chunked_aggregate
+from spark_rapids_jni_tpu.runtime.resilience import (
+    CorruptDataError,
+    FatalExecutionError,
+    MalformedInputError,
+)
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.utils import config
+
+MODES = faults.CorruptionSpec.MODES  # ("flip", "truncate", "trailer")
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    telemetry.drain()
+    REGISTRY.reset()
+    yield
+    telemetry.drain()
+    REGISTRY.reset()
+    for name in list(config._overrides):
+        config.reset_option(name)
+
+
+def _table(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return Table([
+        Column.from_numpy(rng.integers(0, 1000, n).astype(np.int64)),
+        Column.from_numpy(rng.integers(-50, 50, n).astype(np.int64),
+                          validity=rng.random(n) > 0.15),
+    ])
+
+
+def _bit_identical(a, b):
+    if a.num_rows != b.num_rows or a.num_columns != b.num_columns:
+        return False
+    for ca, cb in zip(a.columns, b.columns):
+        if ca.dtype != cb.dtype:
+            return False
+        if not np.array_equal(np.asarray(ca.data), np.asarray(cb.data)):
+            return False
+        if not np.array_equal(np.asarray(ca.valid_mask()),
+                              np.asarray(cb.valid_mask())):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# family 1: in-memory spill tier — 60 seeded bit flips
+# (live numpy snapshots cannot shrink, so flip is the only mode that
+# lands there; truncation/trailer shapes are covered on the disk tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(60))
+def test_fuzz_spill_memory_flip(seed):
+    tbl = _table(seed=seed)
+    store = SpillStore(budget_bytes=_table_nbytes(tbl))
+    script = faults.FaultScript(corruptions=[
+        faults.CorruptionSpec("integrity.spill", mode="flip", seed=seed)])
+    try:
+        with faults.inject(script):
+            h = store.put(tbl)
+            store.put(_table(seed=seed + 1000))  # evict h to host
+        assert script.fired, f"seed {seed}: corruption window never fired"
+        try:
+            got = store.get(h)
+        except CorruptDataError:
+            assert REGISTRY.counter("integrity.mismatch").value >= 1
+        else:  # pragma: no cover - would mean a missed detection
+            assert _bit_identical(got, tbl), \
+                f"seed {seed}: undetected corruption decoded as garbage"
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# family 2: disk spill tier — 40 seeded cases over all three modes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", range(40))
+def test_fuzz_spill_disk(case, tmp_path):
+    mode = MODES[case % len(MODES)]
+    seed = 100 + case
+    tbl = _table(seed=seed)
+    store = SpillStore(budget_bytes=_table_nbytes(tbl),
+                       spill_dir=str(tmp_path))
+    script = faults.FaultScript(corruptions=[
+        faults.CorruptionSpec("integrity.spill", mode=mode, seed=seed)])
+    try:
+        with faults.inject(script):
+            h = store.put(tbl)
+            store.put(_table(seed=seed + 1000))  # evict h to disk
+        assert script.fired, f"{mode}/{seed}: corruption window never fired"
+        try:
+            got = store.get(h)
+        except CorruptDataError:
+            assert REGISTRY.counter("integrity.mismatch").value >= 1
+        else:  # pragma: no cover - would mean a missed detection
+            assert _bit_identical(got, tbl), \
+                f"{mode}/{seed}: undetected corruption decoded as garbage"
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# family 3: DCN wire — 50 seeded frame mutations; a single corruption is
+# always recovered via NAK+refetch to a bit-identical delivery
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", range(50))
+def test_fuzz_wire_mutation_recovers_bit_identical(case):
+    from spark_rapids_jni_tpu.parallel.dcn import SliceLink
+
+    mode = MODES[case % len(MODES)]
+    seed = 200 + case
+    tbl = _table(n=96, seed=seed)
+    script = faults.FaultScript(corruptions=[
+        faults.CorruptionSpec("integrity.wire", mode=mode, seed=seed)])
+    sa, sb = socket.socketpair()
+    tx, rx = SliceLink(sa), SliceLink(sb)
+    out, err = {}, {}
+
+    def _rx():
+        try:
+            out["tbl"] = rx.recv_table()
+        except BaseException as exc:  # noqa: BLE001 - surfaced below
+            err["rx"] = exc
+
+    t = threading.Thread(target=_rx)
+    try:
+        with faults.inject(script):
+            t.start()
+            tx.send_table(tbl, compress_level=0)
+            t.join(30)
+        assert not t.is_alive(), f"{mode}/{seed}: receiver hung"
+        assert not err, f"{mode}/{seed}: refetch did not recover: {err}"
+        assert script.fired, f"{mode}/{seed}: corruption window never fired"
+        assert _bit_identical(out["tbl"], tbl), \
+            f"{mode}/{seed}: refetched frame diverged"
+        assert REGISTRY.counter("integrity.refetch").value == 1
+    finally:
+        tx.close()
+        rx.close()
+
+
+# ---------------------------------------------------------------------------
+# family 4: out-of-core checkpoints — 30 seeded corruptions; the chunk is
+# replayed from source to a bit-identical result, zero leaked reservations
+# ---------------------------------------------------------------------------
+
+_CK_CHUNKS = 3
+_CK_ROWS = 64
+
+
+def _ck_chunks(seed):
+    rng = np.random.default_rng(seed)
+    return [Table([
+        Column.from_numpy(rng.integers(0, 99, _CK_ROWS).astype(np.int64)),
+    ]) for _ in range(_CK_CHUNKS)]
+
+
+def _ck_partial(chunk):
+    s = int(np.asarray(chunk.columns[0].data).sum())
+    return Table([Column.from_numpy(np.asarray([s], dtype=np.int64))])
+
+
+def _ck_merge(partials):
+    s = int(np.asarray(partials.columns[0].data).sum())
+    return Table([Column.from_numpy(np.asarray([s], dtype=np.int64))])
+
+
+@pytest.mark.parametrize("seed", range(300, 330))
+def test_fuzz_checkpoint_corruption_replays_bit_identical(seed):
+    chunks = _ck_chunks(seed)
+    want = sum(int(np.asarray(c.columns[0].data).sum()) for c in chunks)
+    limiter = MemoryLimiter(1 << 24)
+    store = SpillStore(budget_bytes=_table_nbytes(_ck_partial(chunks[0])))
+    script = faults.FaultScript(corruptions=[
+        faults.CorruptionSpec("integrity.checkpoint", mode="flip",
+                              seed=seed)])
+    try:
+        with faults.inject(script):
+            res = run_chunked_aggregate(
+                list(chunks), _ck_partial, _ck_merge,
+                limiter=limiter, spill=store, pipeline=True)
+        assert script.fired, f"seed {seed}: corruption window never fired"
+        got = int(np.asarray(res.table.columns[0].data)[0])
+        assert got == want, f"seed {seed}: replayed result diverged"
+        assert limiter.used == 0, f"seed {seed}: leaked reservation"
+        assert REGISTRY.counter(
+            "integrity.mismatch.integrity.checkpoint").value == 1
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# family 5: untrusted ingestion — 40 seeded mutations of well-formed
+# Parquet/ORC files; every case classifies (MalformedInputError), stops at
+# the absent native loader (OSError — preflight passed, nothing decoded),
+# or recovers the original bytes. Never an unclassified crash.
+# ---------------------------------------------------------------------------
+
+
+def _parquet_file():
+    from tests.parquet_util import ColumnSpec, write_parquet
+
+    return write_parquet([
+        ColumnSpec("a", 2, list(range(48))),            # INT64
+        ColumnSpec("b", 5, [i / 7 for i in range(48)]),  # DOUBLE
+    ])
+
+
+def _orc_file():
+    from tests.orc_util import ColumnSpec, write_orc
+
+    return write_orc([
+        ColumnSpec("a", 4, list(range(48))),  # LONG
+    ])
+
+
+def _fuzz_ingest(read_table, blob, mode, seed):
+    script = faults.FaultScript(corruptions=[
+        faults.CorruptionSpec("integrity.ingest", mode=mode, seed=seed)])
+    with faults.inject(script):
+        try:
+            read_table(blob)
+        except MalformedInputError:
+            assert REGISTRY.counter("integrity.malformed").value >= 1
+            return "classified"
+        except OSError:
+            # the mutation survived the envelope preflight; the decode
+            # would run inside the hardened native parse, absent here
+            return "needs-native"
+        except (CorruptDataError, FatalExecutionError):  # pragma: no cover
+            return "classified"
+    pytest.fail(  # pragma: no cover - native lib absent on this build
+        f"{mode}/{seed}: corrupted file decoded without native engine")
+
+
+@pytest.mark.parametrize("case", range(20))
+def test_fuzz_ingest_parquet(case):
+    from spark_rapids_jni_tpu.parquet.reader import read_table
+
+    outcome = _fuzz_ingest(read_table, _parquet_file(),
+                           MODES[case % len(MODES)], 400 + case)
+    assert outcome in ("classified", "needs-native")
+
+
+@pytest.mark.parametrize("case", range(20))
+def test_fuzz_ingest_orc(case):
+    from spark_rapids_jni_tpu.orc.reader import read_table
+
+    outcome = _fuzz_ingest(read_table, _orc_file(),
+                           MODES[case % len(MODES)], 500 + case)
+    assert outcome in ("classified", "needs-native")
+
+
+def test_fuzz_corpus_is_at_least_200_cases():
+    """The harness floor pinned: 60 + 40 + 50 + 30 + 40 seeded cases."""
+    assert 60 + 40 + 50 + 30 + 40 >= 200

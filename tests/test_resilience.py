@@ -21,6 +21,7 @@ Covers the ISSUE-6 contracts:
 """
 
 import socket
+import threading
 
 import numpy as np
 import pytest
@@ -598,8 +599,16 @@ def test_dcn_transport_fault_retries_before_any_bytes_move(exc):
         script = faults.FaultScript(
             [faults.FaultSpec("dcn.transport", exc)])
         with faults.inject(script):
+            # receive concurrently: with integrity on, the sender blocks
+            # until the receiver acknowledges the verified frame
+            out = {}
+            t = threading.Thread(
+                target=lambda: out.__setitem__("tbl", rx.recv_table()))
+            t.start()
             tx.send_table(tbl, compress_level=0)
-            got = rx.recv_table()
+            t.join(30)
+            assert not t.is_alive(), "receiver hung"
+            got = out["tbl"]
         assert len(script.fired) == 1
         assert _tables_bit_identical(got, tbl)
         assert telemetry.summary()["resilience"]["recovered"] == 1

@@ -326,8 +326,38 @@ def test_rule_span_scope_ignores_files_without_spans_import(tmp_path):
     assert not _by_rule(_lint_file(target), "span-must-scope")
 
 
+def test_rule_payload_verify_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_payload_memory.py"),
+                   "payload-must-verify")
+    texts = [f.source_line for f in got]
+    assert len(got) == 2, texts
+    assert any("blob = fh.read()" in t for t in texts)
+    assert any("fh.read(16)" in t for t in texts)
+    # verified-read, read-then-verify, text-mode, write-mode and pragma'd
+    # twins stay clean
+    src = (FIXTURES / "seeded_payload_memory.py").read_text()
+    clean_at = src[:src.index("def clean_verified_read")].count("\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_payload_verify_scope(tmp_path):
+    # same constructions outside the reservation scope are ordinary file
+    # IO — out of scope; integrity.py itself (the seam's home) is exempt
+    target = tmp_path / "plain_loader.py"
+    shutil.copy(FIXTURES / "seeded_payload_memory.py", target)
+    assert not _by_rule(_lint_file(target), "payload-must-verify")
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    target2 = rt / "plain_name.py"
+    shutil.copy(FIXTURES / "seeded_payload_memory.py", target2)
+    assert _by_rule(_lint_file(target2), "payload-must-verify")
+    target3 = rt / "integrity.py"
+    shutil.copy(FIXTURES / "seeded_payload_memory.py", target3)
+    assert not _by_rule(_lint_file(target3), "payload-must-verify")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all fourteen rules demonstrably fire."""
+    """The acceptance invariant: all fifteen rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
@@ -354,6 +384,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_reservation_memory.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_span_scope.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_payload_memory.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

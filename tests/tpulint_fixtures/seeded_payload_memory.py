@@ -1,0 +1,54 @@
+"""Seeded violations for rule 15 (payload-must-verify).
+
+The basename contains ``memory`` so the file is in scope the same way
+runtime/ and parallel/ modules are. Violations first, then clean twins
+past the ``def clean_`` marker the per-rule test splits on.
+"""
+
+import pickle
+
+
+def raw_unspill(path):
+    with open(path, "rb") as fh:
+        blob = fh.read()  # VIOLATION: torn write decodes into garbage
+    return pickle.loads(blob)
+
+
+def raw_probe_then_read(path):
+    fh = open(path, "rb")  # assigned handle, same bypass
+    try:
+        head = fh.read(16)  # VIOLATION
+        return head
+    finally:
+        fh.close()
+
+
+def clean_verified_read(path, integrity):
+    # the checked read path: trailer verified before any decode
+    blob = integrity.read_payload_file(
+        path, seam="integrity.spill", sealed=True)
+    return pickle.loads(blob)
+
+
+def clean_raw_read_then_verify(path, integrity):
+    # raw bytes are fine when the same scope verifies the trailer
+    with open(path, "rb") as fh:
+        blob = fh.read()
+    return integrity.verify(blob, seam="integrity.spill")
+
+
+def clean_text_mode_is_not_a_payload(path):
+    with open(path, "r") as fh:
+        return fh.read()
+
+
+def clean_binary_write_is_not_a_read(path, blob):
+    with open(path, "wb") as fh:
+        fh.write(blob)
+
+
+def clean_pragmad_raw_read(path):
+    # length probe on a file this process just wrote; no decode follows
+    with open(path, "rb") as fh:
+        # tpulint: disable=payload-must-verify
+        return len(fh.read())

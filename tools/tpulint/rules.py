@@ -1,4 +1,4 @@
-"""The fourteen tpulint rules.
+"""The fifteen tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -1044,6 +1044,89 @@ def check_span_scope(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 15: payload-must-verify
+# ---------------------------------------------------------------------------
+
+
+def check_payload_verify(ctx: FileContext) -> List[RawFinding]:
+    """ISSUE-10 bug class: a managed payload (spill file, checkpoint
+    partial, wire frame) read back with a raw binary ``fh.read()``
+    bypasses the integrity trailer — a torn write or bit-flip decodes
+    into garbage columns instead of raising a classified
+    ``CorruptDataError`` at the seam. Any top-level function in the
+    reservation-scope files (memory/server/degrade/outofcore basenames,
+    ``runtime/``/``parallel/`` packages) that opens a file in binary
+    read mode and calls ``.read()`` on the handle must also touch the
+    verify seam: a ``verify``-named callable/reference or an
+    ``integrity.read_payload_file``-style helper. The integrity module
+    itself (the seam's home, where the raw read IS the implementation)
+    is exempt."""
+    if not _is_reservation_scope_file(ctx) or "integrity" in ctx.name:
+        return []
+    out: List[RawFinding] = []
+    for fn in _top_functions(ctx.tree):
+        # a function touching the verify seam anywhere is trusted:
+        # the checked read path and the raw read may share one scope
+        # (e.g. a length probe before the verified payload read)
+        verified = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) and (
+                    "verify" in node.attr
+                    or node.attr.startswith("read_payload")):
+                verified = True
+                break
+            if isinstance(node, ast.Name) and "verify" in node.id:
+                verified = True
+                break
+        if verified:
+            continue
+        # handles bound from binary-read open(): `with open(..) as fh`
+        # or `fh = open(..)`
+        def _is_binary_read_open(call) -> bool:
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Name)
+                    and call.func.id == "open"):
+                return False
+            mode = None
+            if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+                mode = call.args[1].value
+            for kw in call.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            return isinstance(mode, str) and "b" in mode and "r" in mode
+
+        handles: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if (_is_binary_read_open(item.context_expr)
+                            and isinstance(item.optional_vars, ast.Name)):
+                        handles.add(item.optional_vars.id)
+            elif isinstance(node, ast.Assign):
+                if _is_binary_read_open(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            handles.add(tgt.id)
+        if not handles:
+            continue
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "read"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in handles):
+                out.append(RawFinding(
+                    node.lineno, node.col_offset,
+                    f"raw `{node.func.value.id}.read()` of a managed "
+                    f"payload bypasses the integrity trailer: a torn "
+                    f"write or bit-flip decodes into garbage instead of "
+                    f"raising a classified CorruptDataError; read it "
+                    f"through `integrity.read_payload_file(...)` (or "
+                    f"verify the blob with `integrity.verify(...)`)"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -1102,4 +1185,10 @@ RULES = [
          "`with` statement (or decorator): a leaked open span corrupts "
          "the thread-local span stack and never emits",
          check_span_scope),
+    Rule("payload-must-verify",
+         "binary reads of managed payloads in runtime/parallel scope "
+         "must go through the integrity verify seam; a raw fh.read() "
+         "turns torn writes into garbage columns instead of a "
+         "classified CorruptDataError",
+         check_payload_verify),
 ]
