@@ -497,6 +497,12 @@ def _server_block() -> dict:
         from spark_rapids_jni_tpu.models import tpch
         from spark_rapids_jni_tpu.runtime import server as _server
 
+        from spark_rapids_jni_tpu.utils.config import set_option as _set
+
+        # the result cache would serve these identical resubmissions
+        # straight from memory (the ``cache`` block measures that story);
+        # pin it off so this block keeps measuring the serving path itself
+        _set("cache.enabled", False)
         rows = 1 << 12
         plan = tpch._q1_plan()
         bindings = {"lineitem": tpch.lineitem_table(rows, seed=3)}
@@ -577,6 +583,126 @@ def _server_block() -> dict:
             block["tracing_overhead_frac"] = (round(
                 max(0.0, on_wall / off_wall - 1.0), 4)
                 if off_wall else None)
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    finally:
+        try:
+            from spark_rapids_jni_tpu.utils.config import reset_option
+            reset_option("cache.enabled")
+        except Exception:
+            pass
+    return block
+
+
+def _cache_block() -> dict:
+    """The BENCH_*.json ``cache`` block: the result-cache story
+    (runtime/resultcache.py) under a repetitive dashboard-style workload.
+    A working set of distinct q1/q3/q6 queries (plan x binding seed) is
+    drawn Zipf-distributed — a few hot queries dominate, a long tail
+    recurs rarely — and submitted closed-loop through one QueryServer.
+    The sequential pass classifies every submission hit-or-miss exactly
+    (counter snapshot around each call) and reports hit vs miss p50/p95
+    latency plus the achieved hit rate; a concurrency-4 pass reports
+    aggregate queries/s on the same schedule. Probe-sized: it measures
+    memoization economics (hit latency is the cache's whole value
+    proposition), not kernels."""
+    block: dict = {}
+    try:
+        import threading as _threading
+
+        import numpy as np
+
+        from spark_rapids_jni_tpu.models import tpch
+        from spark_rapids_jni_tpu.runtime import fusion as _fusion
+        from spark_rapids_jni_tpu.runtime import server as _server
+        from spark_rapids_jni_tpu.telemetry import REGISTRY as _REG
+
+        rows = 1 << 12
+        q1 = tpch._q1_plan()
+        q3 = tpch._q3_plan(segment=0, cutoff=tpch._Q3_CUTOFF_DAYS,
+                           out_factor=2)
+        q6 = _fusion.Plan("tpch_q6", _fusion.Project(
+            _fusion.Scan("lineitem"), tpch._q6_reduce, rowwise=False))
+        q3_tables = {
+            "customer": tpch.customer_table(rows // 4),
+            "orders": tpch.orders_table(rows // 2, rows // 4),
+            "lineitem": tpch.lineitem_q3_table(rows, rows // 2),
+        }
+        # the distinct-query working set: plan x binding seed
+        universe = (
+            [(q1, {"lineitem": tpch.lineitem_table(rows, seed=s)})
+             for s in (1, 2, 3)]
+            + [(q6, {"lineitem": tpch.lineitem_table(rows, seed=s)})
+               for s in (4, 5, 6)]
+            + [(q3, q3_tables)]
+        )
+        # Zipf rank-frequency over the working set, deterministic draw
+        rng = np.random.default_rng(17)
+        ranks = np.arange(1, len(universe) + 1, dtype=np.float64)
+        weights = (1.0 / ranks ** 1.2)
+        weights /= weights.sum()
+        schedule = rng.choice(len(universe), size=96, p=weights)
+
+        with _server.QueryServer(budget_bytes=1 << 30,
+                                 max_inflight=8) as srv:
+            # sequential closed loop: exact per-query hit/miss split
+            hit_lat: list = []
+            miss_lat: list = []
+            sess = srv.session("zipf")
+            t0 = time.perf_counter()
+            for qi in schedule:
+                plan, bindings = universe[int(qi)]
+                before = _REG.counter("cache.hit").value
+                t = sess.submit(plan, bindings)
+                t.result(timeout=300)
+                (hit_lat if _REG.counter("cache.hit").value > before
+                 else miss_lat).append(t.latency_s)
+            seq_wall = time.perf_counter() - t0
+
+            def _pct(lats, p):
+                if not lats:
+                    return None
+                ordered = sorted(lats)
+                return round(ordered[min(len(ordered) - 1,
+                                         int(p / 100.0 * len(ordered)))]
+                             * 1e3, 3)
+
+            block["queries"] = len(schedule)
+            block["distinct_queries"] = len(universe)
+            block["queries_per_s"] = (round(len(schedule) / seq_wall, 2)
+                                      if seq_wall else None)
+            block["hit_rate"] = round(len(hit_lat) / len(schedule), 4)
+            block["hit_latency_ms_p50"] = _pct(hit_lat, 50)
+            block["hit_latency_ms_p95"] = _pct(hit_lat, 95)
+            block["miss_latency_ms_p50"] = _pct(miss_lat, 50)
+            block["miss_latency_ms_p95"] = _pct(miss_lat, 95)
+
+            # concurrency-4 closed loop on the same schedule: aggregate
+            # throughput when hot queries collapse to cache hits
+            done: list = []
+
+            def _client(i):
+                s = srv.session(f"zipf_c{i}")
+                for qi in schedule[i::4]:
+                    plan, bindings = universe[int(qi)]
+                    t = s.submit(plan, bindings)
+                    t.result(timeout=300)
+                    done.append(t)
+
+            threads = [_threading.Thread(target=_client, args=(i,))
+                       for i in range(4)]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            conc_wall = time.perf_counter() - t0
+            block["concurrency_4_queries_per_s"] = (
+                round(len(done) / conc_wall, 2) if conc_wall else None)
+            block["stats"] = srv.result_cache.stats()
+        # after close(): resident cache charges are released, so anything
+        # left is a genuine leak
+        block["leaked_bytes"] = srv.limiter.used
     except Exception:  # probe failure must never cost the bench record
         pass
     return block
@@ -1777,6 +1903,7 @@ def _child_main(config: str, n: int, iters: int) -> None:
                       "fusion": _fusion_block(),
                       "resilience": _resilience_block(),
                       "server": _server_block(),
+                      "cache": _cache_block(),
                       "degrade": _degrade_block(),
                       "integrity": _integrity_block()}))
 
@@ -1819,9 +1946,10 @@ def _probe_tpu(timeout_s: float) -> tuple[bool, str]:
 def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float):
     """Run the bench in a subprocess; returns (value | None, diagnostic,
     dispatch block | None, pipeline block | None, fusion block | None,
-    server block | None, degrade block | None) — the blocks come from the
-    measured child process's executable cache, overlap probe, whole-stage
-    fusion probe, serving-concurrency probe, and memory-pressure
+    server block | None, cache block | None, degrade block | None,
+    integrity block | None) — the blocks come from the measured child
+    process's executable cache, overlap probe, whole-stage fusion probe,
+    serving-concurrency probe, result-cache probe, and memory-pressure
     degradation probe."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
@@ -1840,7 +1968,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         )
     except subprocess.TimeoutExpired:
         return (None, f"{platform} bench timed out after {timeout_s:.0f}s",
-                None, None, None, None, None, None)
+                None, None, None, None, None, None, None)
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
@@ -1851,16 +1979,18 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         pipe = rec.get("pipeline") if isinstance(rec, dict) else None
         fus = rec.get("fusion") if isinstance(rec, dict) else None
         srv = rec.get("server") if isinstance(rec, dict) else None
+        cache = rec.get("cache") if isinstance(rec, dict) else None
         deg = rec.get("degrade") if isinstance(rec, dict) else None
         integ = rec.get("integrity") if isinstance(rec, dict) else None
         return (value, "", disp if isinstance(disp, dict) else None,
                 pipe if isinstance(pipe, dict) else None,
                 fus if isinstance(fus, dict) else None,
                 srv if isinstance(srv, dict) else None,
+                cache if isinstance(cache, dict) else None,
                 deg if isinstance(deg, dict) else None,
                 integ if isinstance(integ, dict) else None)
     return (None, f"{platform} bench failed: {_tail(out)}",
-            None, None, None, None, None, None)
+            None, None, None, None, None, None, None)
 
 
 def main() -> None:
@@ -1881,6 +2011,7 @@ def main() -> None:
     child_pipe = None
     child_fus = None
     child_srv = None
+    child_cache = None
     child_deg = None
     child_integ = None
     # every run gets a telemetry file (children record through the package
@@ -1921,7 +2052,8 @@ def main() -> None:
                 ok, why = _probe_tpu(20)
             if ok:
                 (value, why, child_disp, child_pipe, child_fus,
-                 child_srv, child_deg, child_integ) = _run_child(
+                 child_srv, child_cache, child_deg,
+                 child_integ) = _run_child(
                     config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
@@ -1963,7 +2095,8 @@ def main() -> None:
                 })
         if value is None:
             (value, why, child_disp, child_pipe, child_fus,
-             child_srv, child_deg, child_integ) = _run_child(
+             child_srv, child_cache, child_deg,
+             child_integ) = _run_child(
                 config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
@@ -2015,6 +2148,10 @@ def main() -> None:
     # percentiles at 1/4/16 sessions), same child-process provenance;
     # empty when no live child ran (timeout / stale ledger record)
     record["server"] = child_srv or {}
+    # result & subplan cache probe (Zipf-mix closed-loop queries/s, hit
+    # rate, hit vs miss latency percentiles), same child-process
+    # provenance; empty when no live child ran (timeout / stale ledger)
+    record["cache"] = child_cache or {}
     # graceful-degradation probe (closed-loop queries/s + tier counts at
     # 100/60/30% HBM budget, cooperative cancel lag), same child-process
     # provenance; empty when no live child ran

@@ -1,12 +1,13 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its fifteen invariant rules (host/device
+# tpulint (tools/tpulint) runs its sixteen invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
 # error-must-classify, server-telemetry-session-id,
-# reservation-release-in-finally, span-must-scope, payload-must-verify)
+# reservation-release-in-finally, span-must-scope, payload-must-verify,
+# cache-key-must-fingerprint)
 # over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
 # glob below covers the telemetry/ package alongside every other
 # subpackage.
@@ -160,6 +161,9 @@ from spark_rapids_jni_tpu.runtime import faults, fusion, server
 
 plan = tpch._q1_plan()
 bindings = {"lineitem": tpch.lineitem_table(300)}
+# distinct victim bindings: identical ones would (correctly) be served
+# from the result cache and never reach the injected execution seam
+victim_bindings = {"lineitem": tpch.lineitem_table(300, seed=7)}
 ref = fusion.execute(plan, bindings)
 
 
@@ -173,7 +177,7 @@ with server.QueryServer(budget_bytes=1 << 28, max_inflight=2) as srv:
     res = ok.result(timeout=120)
     assert ok.status == "served", ok.status
     with faults.inject(victim_only):
-        doomed = srv.session("victim").submit(plan, bindings)
+        doomed = srv.session("victim").submit(plan, victim_bindings)
         try:
             doomed.result(timeout=120)
             raise SystemExit("injected fault did not surface")
@@ -191,10 +195,12 @@ with server.QueryServer(budget_bytes=1 << 28, max_inflight=2) as srv:
             assert (np.where(gv, np.asarray(gc.data), 0)
                     == np.where(rv, np.asarray(rc.data), 0)).all(), \
                 f"col {i} data diverged"
-    leaked = srv.limiter.used
-    assert leaked == 0, f"leaked {leaked} reserved bytes"
     stats = srv.stats()
     assert stats["served"] == 2 and stats["failed"] == 1, stats
+# read AFTER close(): the result cache legitimately holds charged bytes
+# for its resident entries while the server lives; close() drops them
+leaked = srv.limiter.used
+assert leaked == 0, f"leaked {leaked} reserved bytes"
 print("server smoke OK: admit -> serve -> fault -> recover, "
       "bit-identical, 0 leaked bytes")
 EOF
@@ -451,4 +457,61 @@ assert refetches >= 1, "no refetch recorded for the corrupted frame"
 a.close(); b.close()
 print("integrity smoke OK: 3 corruption modes classified, spill "
       "detected, wire refetch bit-identical, 0 leaked bytes")
+EOF
+
+# cache smoke: rule 16 only proves cache keys CARRY the input
+# fingerprint — this proves the result cache itself still honors its
+# contract: the same q1 submitted twice through the QueryServer serves
+# the second from cache (zero new compiles, zero admission wait,
+# bit-identical bytes); a cached entry corrupted at the integrity.cache
+# seam is a classified discard followed by a bit-identical recompute;
+# and after everything zero reserved bytes remain.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.runtime import faults, server
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+
+
+def bit_identical(a, b):
+    for i in range(a.num_columns):
+        ca, cb = a.column(i), b.column(i)
+        va, vb = np.asarray(ca.valid_mask()), np.asarray(cb.valid_mask())
+        assert (va == vb).all(), f"col {i} validity diverged"
+        assert (np.where(va, np.asarray(ca.data), 0)
+                == np.where(vb, np.asarray(cb.data), 0)).all(), \
+            f"col {i} data diverged"
+
+
+plan = tpch._q1_plan()
+bindings = {"lineitem": tpch.lineitem_table(300)}
+
+with server.QueryServer(budget_bytes=1 << 28, max_inflight=2) as srv:
+    first = srv.session("dash").submit(plan, bindings).result(timeout=120)
+    compiles = sum(REGISTRY.counters("dispatch.compile.").values())
+    repeat = srv.session("dash").submit(plan, bindings)
+    second = repeat.result(timeout=120)
+    assert repeat.status == "served", repeat.status
+    assert repeat.queue_wait_s == 0.0, "cache hit paid admission wait"
+    delta = sum(REGISTRY.counters("dispatch.compile.").values()) - compiles
+    assert delta == 0, f"cache hit compiled {delta} executables"
+    assert REGISTRY.counter("cache.hit").value == 1
+    bit_identical(first.table, second.table)
+
+    # corrupt the cached entry where it lives; next submission must
+    # discard it classified and recompute the same bytes from source
+    script = faults.FaultScript(
+        corruptions=[faults.CorruptionSpec("integrity.cache", mode="flip")])
+    with faults.inject(script):
+        srv.result_cache.shed(1 << 30)  # demote -> corrupts the snapshot
+    assert script.fired, "corruption window never fired"
+    third = srv.session("dash").submit(plan, bindings).result(timeout=120)
+    assert REGISTRY.counter("cache.corrupt_discard").value == 1
+    assert REGISTRY.counter("integrity.mismatch.integrity.cache").value == 1
+    bit_identical(first.table, third.table)
+leaked = srv.limiter.used
+assert leaked == 0, f"leaked {leaked} reserved bytes"
+print("cache smoke OK: repeat q1 served from cache (0 compiles, 0 wait), "
+      "corrupt entry discarded + bit-identical recompute, 0 leaked bytes")
 EOF

@@ -348,6 +348,13 @@ class DegradationController:
                             telemetry.record_degrade(
                                 op, "resumed", tier="parked",
                                 trigger=trigger, rung=steps, **attrs)
+                            # the drain threshold discounts EVICTABLE
+                            # result-cache bytes (memory.py); make that
+                            # promise real before retrying, so the
+                            # resumed attempt's reservations land on
+                            # freed budget instead of re-tripping
+                            # pressure against cold cached results
+                            self.limiter.reclaim_cache()
                             # retry the most degraded EXECUTABLE tier
                             # after drain
                             rung = len(tiers) - 2

@@ -77,6 +77,10 @@ SEAMS: Tuple[str, ...] = (
     "integrity.wire",
     "integrity.checkpoint",
     "integrity.ingest",
+    # result/subplan cache payloads (runtime/resultcache.py): cache entries
+    # ride the SpillStore tiers, so this seam corrupts a cached payload the
+    # same way integrity.spill corrupts a live query's spilled working set.
+    "integrity.cache",
 )
 
 _SEAM_SET = frozenset(SEAMS)

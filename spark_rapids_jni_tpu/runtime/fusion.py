@@ -87,6 +87,9 @@ __all__ = [
     "min_rows_of",
     "execute",
     "estimate_hbm_bytes",
+    "plan_fingerprint",
+    "scan_prefix_chains",
+    "replace_node",
     "stats",
 ]
 
@@ -726,6 +729,94 @@ def execute(plan: Plan, bindings: dict, *,
     meta = dict(zip(side_keys, side_vals))
     meta.update(static_meta)
     return FusedResult(value, meta)
+
+
+def plan_fingerprint(plan: Plan, bindings: dict) -> tuple:
+    """Canonical structural digest of a whole plan against its bound row
+    counts — the plan-signature half of the result-cache key
+    (runtime/resultcache.py). Deliberately excludes ``plan.name``: two
+    plans that trace identically produce identical results, whatever they
+    are called. Row-count-derived statics resolve (and Limit counts clamp)
+    exactly as :func:`execute` resolves them, so a cached entry can never
+    be replayed against a binding set the executable would have shaped
+    differently — everything else row-dependent is covered by the input
+    fingerprint half of the key."""
+    nodes = _topo(plan.root)
+    bucketed, exact = _scan_names(nodes)
+    for name in bucketed + exact:
+        if name not in bindings:
+            raise KeyError(f"plan {plan.name!r} scans unbound table "
+                           f"{name!r}")
+    true_rows = {name: bindings[name].num_rows for name in bucketed + exact}
+    resolved = _resolve_statics(nodes, true_rows)
+    _limit_bound(nodes, resolved, _spaces(nodes), true_rows)
+    return _fingerprint(nodes, resolved)
+
+
+def scan_prefix_chains(root) -> list:
+    """Maximal single-consumer chains of Filter / rowwise-Project nodes
+    sitting directly on a bucketed Scan — the shareable scan+filter+project
+    prefixes subplan caching keys on. Returns ``(scan, top, length)``
+    tuples where ``top`` is the highest chain node and ``length`` counts
+    the non-Scan nodes in it; ``top`` is never ``root`` itself (a whole-
+    plan prefix is the final-result cache's job). Only mask-preserving
+    nodes qualify: Filter nulls validity in place and a rowwise Project
+    stays in the scan's row space, so the materialized chain output is a
+    drop-in replacement table for any consumer."""
+    nodes = _topo(root)
+    consumers: dict = {}
+    for node in nodes:
+        for c in _children(node):
+            consumers.setdefault(id(c), []).append(node)
+    chains = []
+    for node in nodes:
+        if not (isinstance(node, Scan) and node.bucket):
+            continue
+        top, length = node, 0
+        while True:
+            nexts = consumers.get(id(top), [])
+            if len(nexts) != 1 or nexts[0] is root:
+                break
+            nxt = nexts[0]
+            if isinstance(nxt, Filter):
+                pass
+            elif isinstance(nxt, Project) and nxt.rowwise:
+                pass
+            else:
+                break
+            top, length = nxt, length + 1
+        if length > 0:
+            chains.append((node, top, length))
+    return chains
+
+
+def replace_node(root, target, replacement):
+    """Rebuild the plan DAG with ``target`` (matched by object identity)
+    swapped for ``replacement`` — the subplan-cache rewrite: a cached
+    prefix's subtree becomes a Scan bound to the materialized
+    intermediate. Shared nodes stay shared; untouched subtrees are reused
+    as-is."""
+    memo: dict = {id(target): replacement}
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        kids = _children(node)
+        new_kids = tuple(rebuild(c) for c in kids)
+        if all(nk is k for nk, k in zip(new_kids, kids)):
+            out = node
+        elif isinstance(node, (Filter, Project, GroupBy, Sort, Limit)):
+            out = node._replace(child=new_kids[0])
+        elif isinstance(node, Join):
+            out = node._replace(left=new_kids[0], right=new_kids[1])
+        elif isinstance(node, DensePkJoin):
+            out = node._replace(probe=new_kids[0], build=new_kids[1])
+        else:  # pragma: no cover - Scan has no children to rebuild
+            out = node
+        memo[id(node)] = out
+        return out
+
+    return rebuild(root)
 
 
 def estimate_hbm_bytes(plan: Plan, bindings: dict) -> int:

@@ -119,6 +119,7 @@ class MemoryLimiter:
         self._pressure = False
         self._pressure_crossings = 0
         self._spill_store: "SpillStore | None" = None
+        self._result_cache = None
         # a Condition so reserve_blocking can sleep until release() frees
         # budget; plain reserve/release take the same underlying lock
         self._lock = threading.Condition()
@@ -151,6 +152,26 @@ class MemoryLimiter:
         """Register the SpillStore whose coldest entries a high-watermark
         crossing proactively spills (None detaches)."""
         self._spill_store = store
+
+    def attach_result_cache(self, cache) -> None:
+        """Register a ResultCache (runtime/resultcache.py) whose entries a
+        high-watermark crossing sheds BEFORE any live query's working set
+        is spilled, and whose evictable resident bytes do not count as
+        "held" for drain waits (None detaches). The limiter only ever
+        reads the cache's lock-free ``evictable_bytes`` int under its own
+        lock and calls ``shed()`` outside it — the cache takes its own
+        lock then the limiter's (release), never the reverse, so the two
+        locks cannot deadlock."""
+        self._result_cache = cache
+
+    def _evictable_cache_bytes(self) -> int:
+        """Resident limiter-charged cache bytes a pressure event could
+        reclaim. Lock-free read of a plain int attribute — safe under the
+        limiter lock (see attach_result_cache)."""
+        cache = self._result_cache
+        if cache is None:
+            return 0
+        return max(int(cache.evictable_bytes), 0)
 
     def watermarks(self) -> dict:
         """One consistent snapshot of the limiter's watermark state —
@@ -228,16 +249,24 @@ class MemoryLimiter:
                     used=self._used, budget=self.budget,
                     watermark=self._high_bytes())
         freed = 0
+        shed = 0
+        target = max(self._used - self._low_bytes(), 1)
+        # eviction ordering: cached results are the FIRST thing to go —
+        # shedding a cache entry demotes it to the host/disk tier and
+        # releases its limiter charge, so live queries' working sets are
+        # only spilled for whatever pressure the cache could not absorb
+        cache = self._result_cache
+        if cache is not None:
+            shed = cache.shed(target)
         store = self._spill_store
-        if store is not None:
+        if store is not None and shed < target:
             # ambition: drain resident spill-store bytes by as much as the
             # limiter is above its low watermark, coldest entries first
-            target = max(self._used - self._low_bytes(), 1)
-            freed = store.spill_coldest(target)
+            freed = store.spill_coldest(target - shed)
         telemetry.record_degrade(
             "memory_limiter", "pressure", tier="high", trigger="watermark",
             rung=0, used=self._used, budget=self.budget,
-            proactive_spill_bytes=freed)
+            proactive_spill_bytes=freed, cache_shed_bytes=shed)
         if get_option("memory.log_level") >= 1:
             _log.info("memory pressure: %d/%d in use (high watermark %d), "
                       "proactively spilled %d bytes", self._used, self.budget,
@@ -351,13 +380,17 @@ class MemoryLimiter:
         serving runtime's admission estimate): it is subtracted from the
         drain threshold, because a query whose own hold exceeds the low
         watermark could otherwise never observe the drain it is waiting
-        for. Returns True once drained, False if ``cancel`` (anything
+        for. Evictable result-cache bytes (attach_result_cache) are also
+        subtracted: they are reclaimable on demand, so a parked query must
+        not wait out a drain the next pressure event would provide for
+        free. Returns True once drained, False if ``cancel`` (anything
         with ``is_set()``) fired or ``timeout`` seconds elapsed first;
         cancellation is polled (~50ms), same as ``reserve_blocking``."""
         deadline = None if timeout is None else time.monotonic() + timeout
         own = max(int(own_held), 0)
         with self._lock:
-            while self._used - own > self._low_bytes():
+            while (self._used - own - self._evictable_cache_bytes()
+                   > self._low_bytes()):
                 if cancel is not None and cancel.is_set():
                     return False
                 wait = 0.05
@@ -368,6 +401,23 @@ class MemoryLimiter:
                     wait = min(wait, remaining)
                 self._lock.wait(wait)
         return True
+
+    def reclaim_cache(self, nbytes: "int | None" = None) -> int:
+        """Turn the drain ``wait_below_low`` promised into real free
+        bytes: shed evictable result-cache entries (demote + release
+        charge) for up to ``nbytes`` (default: whatever stands between
+        current usage and the low watermark). Called OUTSIDE the limiter
+        lock — the parked rung (runtime/degrade.py) invokes it after a
+        drain wait returns, so a resumed query's retry reserve finds the
+        budget the evictable discount counted on."""
+        cache = self._result_cache
+        if cache is None:
+            return 0
+        target = (max(self._used - self._low_bytes(), 0)
+                  if nbytes is None else max(int(nbytes), 0))
+        if target <= 0:
+            return 0
+        return cache.shed(target)
 
     def release(self, nbytes: int) -> None:
         with self._lock:
@@ -758,6 +808,30 @@ class SpillStore:
                 freed += self._spill_entry_locked(
                     eid, "memory pressure: proactive spill of coldest entry")
         return freed
+
+    def spill(self, handle: int) -> int:
+        """Demote ONE entry to the host/disk tier (no-op if already
+        spilled). The result cache's shed path: evicting a cached result
+        from HBM must keep the integrity-sealed host copy so a later hit
+        can stage it back verified. Returns the device bytes freed."""
+        with self._lock:
+            e = self._entries.get(handle)
+            if e is None:
+                raise KeyError(f"unknown spill-store handle {handle}")
+            if e["state"] != "device":
+                return 0
+            return self._spill_entry_locked(
+                handle, "result cache shed: demote cached entry to host")
+
+    def state(self, handle: int) -> str:
+        """Residency tier of an entry ("device" | "host" | "disk") without
+        touching its LRU tick — lets the result cache reconcile limiter
+        charges after this store's own LRU spilled a cache entry."""
+        with self._lock:
+            e = self._entries.get(handle)
+            if e is None:
+                raise KeyError(f"unknown spill-store handle {handle}")
+            return e["state"]
 
     def put(self, table, *, integrity_seam: str = "integrity.spill") -> int:
         """Register a device table; returns its handle. May spill others.

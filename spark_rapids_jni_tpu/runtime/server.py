@@ -78,6 +78,7 @@ from spark_rapids_jni_tpu.runtime import (
     fusion,
     pipeline,
     resilience,
+    resultcache,
 )
 from spark_rapids_jni_tpu.runtime.memory import (
     HostTableChunk,
@@ -164,6 +165,9 @@ class QueryTicket:
         self.estimate = int(estimate)
         self.donate_inputs = bool(donate_inputs)
         self.outofcore = outofcore
+        # (signature, input fingerprint) — set by submit when the result
+        # cache is on; the serve path populates the cache under it
+        self.cache_key = None
         # the deadline clock starts at SUBMIT: queue wait counts against
         # it, so a query stuck behind a backlog cancels instead of running
         # pointlessly after its client gave up
@@ -215,11 +219,13 @@ class Session:
                estimate_bytes: Optional[int] = None,
                donate_inputs: bool = False,
                deadline_ms: Optional[int] = None,
-               outofcore: Optional[Callable] = None) -> QueryTicket:
+               outofcore: Optional[Callable] = None,
+               cache_fingerprint: Optional[str] = None) -> QueryTicket:
         return self._server.submit(
             self.session_id, plan, bindings,
             estimate_bytes=estimate_bytes, donate_inputs=donate_inputs,
-            deadline_ms=deadline_ms, outofcore=outofcore)
+            deadline_ms=deadline_ms, outofcore=outofcore,
+            cache_fingerprint=cache_fingerprint)
 
     def stats(self) -> dict:
         return self._server.session_stats(self.session_id)
@@ -263,6 +269,16 @@ class QueryServer:
         self.spill_store = SpillStore(self.limiter.budget)
         self.limiter.attach_spill_store(self.spill_store)
         self.degrader = degrade.DegradationController(self.limiter)
+        # plan-signature result & subplan cache (runtime/resultcache.py):
+        # entries ride the server's spill store under the integrity.cache
+        # seam and are byte-charged against the shared limiter; attaching
+        # makes them the FIRST thing high-watermark pressure evicts (and
+        # discounts them from parked queries' drain thresholds). All hot-
+        # path probes gate on ``cache.enabled`` — off is byte-for-byte
+        # today's serving path
+        self.result_cache = resultcache.ResultCache(
+            self.spill_store, self.limiter)
+        self.limiter.attach_result_cache(self.result_cache)
         # learned admission: plan signature -> EMA of measured working-set
         # bytes, loaded from (and written through to) the crash-safe state
         # file beside the dispatch persistent cache
@@ -308,7 +324,8 @@ class QueryServer:
                estimate_bytes: Optional[int] = None,
                donate_inputs: bool = False,
                deadline_ms: Optional[int] = None,
-               outofcore: Optional[Callable] = None) -> QueryTicket:
+               outofcore: Optional[Callable] = None,
+               cache_fingerprint: Optional[str] = None) -> QueryTicket:
         """Queue one query. Never blocks: over-the-whole-budget estimates
         and full session queues come back as immediately-rejected tickets
         (backpressure belongs to the client, not to unbounded memory).
@@ -318,7 +335,16 @@ class QueryServer:
         ``outofcore`` optionally supplies the degradation ladder's rung-2
         runner factory, ``(bindings, limiter) -> (chunk_rows, token) ->
         Table`` (see ``degrade.row_chunked_tier``); without it the ladder
-        for this query is fused -> staged -> parked."""
+        for this query is fused -> staged -> parked.
+
+        With ``cache.enabled``, a submission whose ``(plan signature,
+        input fingerprint)`` matches a cached result resolves served
+        IMMEDIATELY — no admission, no compile, no execution; the hit is
+        visible as a ``cache.hit`` span under the query's root span.
+        ``cache_fingerprint`` overrides the content digest of the
+        bindings (e.g. a :func:`resultcache.source_fingerprint` the
+        client maintains for file-backed scans) — changing it is the
+        invalidation handle."""
         sid = str(session_id)
         self.session(sid)  # idempotent registration
         estimate = int(estimate_bytes) if estimate_bytes is not None \
@@ -330,6 +356,19 @@ class QueryServer:
         self._count("submitted", sid)
         record_server(plan.name, "submitted", session=sid,
                       estimate_bytes=estimate)
+        if resultcache.enabled():
+            try:
+                ticket.cache_key = resultcache.cache_key(
+                    plan, bindings, fingerprint=cache_fingerprint)
+            except (ValueError, KeyError, TypeError):
+                # unfingerprintable plan/bindings (local callables,
+                # non-table bindings): serve normally, never cache
+                ticket.cache_key = None
+            if ticket.cache_key is not None:
+                hit = self.result_cache.get(ticket.cache_key)
+                if hit is not None:
+                    self._serve_hit(ticket, hit)
+                    return ticket
         if estimate > self.limiter.budget:
             self._reject(ticket,
                          f"estimate {estimate} exceeds the whole HBM "
@@ -377,6 +416,9 @@ class QueryServer:
                 q.clear()
         for t in backlog:
             self._reject(t, "server shutdown")
+        # drop cached entries and release their limiter charges before
+        # anyone inspects the limiter for leaks
+        self.result_cache.close()
         self._save_learned()
 
     def __enter__(self) -> "QueryServer":
@@ -411,6 +453,7 @@ class QueryServer:
                 "degrade.step", 0),
             "learned_signatures": len(self._learned),
             "sessions": sorted(self._queues),
+            "cache": self.result_cache.stats(),
         }
 
     def inspect(self) -> dict:
@@ -454,6 +497,7 @@ class QueryServer:
             "max_inflight": self.max_inflight,
             "limiter": self.limiter.watermarks(),
             "spill": self.spill_store.stats(),
+            "cache": self.result_cache.stats(),
             "closed": self._closed,
         }
 
@@ -623,6 +667,31 @@ class QueryServer:
             base = fusion.estimate_hbm_bytes(plan, bindings)
         return int(self.estimate_headroom * base)
 
+    def _serve_hit(self, ticket: QueryTicket, result) -> None:
+        """Resolve a submit-time cache hit: the cached result is returned
+        bit-identically with zero admission wait, zero compiles and zero
+        execution spans — one root span carrying a single ``cache.hit``
+        child is the query's whole trace."""
+        sid = ticket.session
+        with spans.span(f"query.{ticket.plan.name}", session=sid,
+                        plan=ticket.plan.name,
+                        estimate_bytes=ticket.estimate) as qspan:
+            qspan.annotate(cache_hit=True)
+            with spans.child("cache.hit", session=sid,
+                             key=ticket.cache_key.short):
+                pass
+        ticket.queue_wait_s = 0.0
+        ticket.latency_s = time.monotonic() - ticket._submitted_at
+        lat_ms = ticket.latency_s * 1e3
+        REGISTRY.histogram("server.latency_ms").observe(lat_ms)
+        REGISTRY.histogram(f"server.latency_ms.{sid}").observe(lat_ms)
+        REGISTRY.histogram("server.queue_wait_ms").observe(0.0)
+        REGISTRY.histogram(f"server.queue_wait_ms.{sid}").observe(0.0)
+        self._count("served", sid)
+        record_server(ticket.plan.name, "served", session=sid,
+                      wall_ms=lat_ms, wait_ms=0.0, cache_hit=True)
+        ticket._resolve("served", value=result)
+
     def _reject(self, ticket: QueryTicket, reason: str,
                 retry_after_s: Optional[float] = None,
                 flight_record: Optional[str] = None) -> None:
@@ -752,6 +821,12 @@ class QueryServer:
                         # resolve without ever reserving — the budget
                         # goes to live queries
                         token.check("server.admit")
+                    # cached results must never make a live query wait:
+                    # if this admission does not currently fit, shed
+                    # resident cache entries FIRST so the reserve below
+                    # parks only for bytes live queries actually hold
+                    if resultcache.enabled():
+                        self.result_cache.make_room(ticket.estimate)
                     # admission=True: NEW work parks while the limiter is
                     # above its high watermark; in-flight queries keep
                     # draining
@@ -814,14 +889,24 @@ class QueryServer:
                         bindings = self._stage_bindings(ticket.bindings)
                         runner = None if ticket.outofcore is None \
                             else ticket.outofcore(bindings, self.limiter)
+                        # subplan-prefix reuse: shared scan+filter+project
+                        # prefixes collapse to cached intermediates — or
+                        # materialize them once for the next plan that
+                        # shares them. A rewritten plan must not donate:
+                        # the injected binding is cache-owned
+                        run_plan, run_bindings, rewrote = \
+                            resultcache.apply_subplans(
+                                self.result_cache, ticket.plan, bindings,
+                                cancel_token=token)
                         # held_bytes: the parked rung must discount this
                         # query's own admission reservation from the
                         # drain threshold, or a query bigger than the low
                         # watermark parks forever
                         result = self.degrader.execute(
                             degrade.DegradableQuery(
-                                ticket.plan, bindings,
-                                donate_inputs=ticket.donate_inputs,
+                                run_plan, run_bindings,
+                                donate_inputs=(ticket.donate_inputs
+                                               and not rewrote),
                                 outofcore=runner),
                             cancel_token=token, held_bytes=held,
                             observer=_observe)
@@ -836,6 +921,16 @@ class QueryServer:
                                   wall_ms=lat_ms,
                                   wait_ms=ticket.queue_wait_s * 1e3)
                     self._record_actual(ticket, bindings, result)
+                    if ticket.cache_key is not None:
+                        try:
+                            self.result_cache.put(ticket.cache_key, result)
+                        except Exception as exc:
+                            # a cache-population failure must never fail
+                            # a query that already served
+                            REGISTRY.counter("cache.put_error").inc()
+                            _log.warning(
+                                "result-cache put failed for %s: %s",
+                                ticket.plan.name, exc)
                     ticket._resolve("served", value=result)
                 except resilience.QueryCancelled as exc:
                     # a deliberate stop, not a failure: the reservation

@@ -54,6 +54,7 @@ __all__ = [
     "record_server",
     "record_degrade",
     "record_integrity",
+    "record_cache",
     "session_scope",
     "current_session",
     "events",
@@ -382,6 +383,40 @@ def record_integrity(
     return True
 
 
+def record_cache(
+    op: str,
+    event: str,
+    *,
+    key: str,
+    nbytes: Optional[int] = None,
+    **extra: Any,
+) -> bool:
+    """A result/subplan-cache decision (runtime/resultcache.py).
+
+    ``event`` is one of ``hit`` / ``miss`` / ``put`` / ``evict`` /
+    ``shed`` / ``corrupt_discard`` / ``subplan_hit`` /
+    ``subplan_materialize``. ``key`` is the entry's short composite key
+    (``<signature12>@<fingerprint12>``) and is mandatory even when
+    telemetry is off — a cache record without the fingerprinted key is
+    unattributable to an entry, the same contract tpulint rule 16
+    enforces statically on cache call sites.
+    """
+    if not key or not str(key).strip():
+        raise ValueError(f"record_cache({op!r}): key must be non-empty")
+    if not enabled():
+        return False
+    rec = _base("cache", op, None, None, extra)
+    rec["event"] = str(event)
+    rec["key"] = str(key)
+    if nbytes is not None:
+        rec["nbytes"] = int(nbytes)
+    # no counter side effects here: the result cache owns the ``cache.*``
+    # counters and counts unconditionally (hit/miss accounting must hold
+    # even with telemetry off, like the server's admission counters)
+    _emit(rec)
+    return True
+
+
 def record_bench_stale(
     metric: str,
     *,
@@ -433,6 +468,7 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
     degrade_tiers: Dict[str, int] = {}
     integrity: Dict[str, int] = {}
     integrity_seams: Dict[str, int] = {}
+    result_cache: Dict[str, int] = {}
     stale_reads = 0
     dispatches = 0
     spill_bytes = 0
@@ -463,6 +499,9 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
             if ev == "mismatch":
                 seam = str(r.get("seam", "?"))
                 integrity_seams[seam] = integrity_seams.get(seam, 0) + 1
+        elif kind == "cache":
+            ev = str(r.get("event", "?"))
+            result_cache[ev] = result_cache.get(ev, 0) + 1
         elif kind == "fallback":
             op = str(r.get("op", "?"))
             fallbacks[op] = fallbacks.get(op, 0) + 1
@@ -490,6 +529,7 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         "degrade_tiers": dict(sorted(degrade_tiers.items())),
         "integrity": dict(sorted(integrity.items())),
         "integrity_seams": dict(sorted(integrity_seams.items())),
+        "result_cache": dict(sorted(result_cache.items())),
         "spans": spans,
         "span_status": dict(sorted(span_status.items())),
         "stale_reads": stale_reads,

@@ -182,6 +182,23 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # + fsync + read-back verify) so a crash mid-spill can never leave a
     # torn entry a later unspill trusts.
     "memory.spill_dir": ("", str),
+    # Plan-signature result & subplan cache (runtime/resultcache.py):
+    # memoize final query results and fused-region intermediates keyed by
+    # (plan signature, input fingerprint), stored through the SpillStore's
+    # integrity-sealed tiers. A hit in QueryServer.submit short-circuits
+    # admission, compile and execution. Off restores today's serving path
+    # byte-for-byte: no fingerprinting, no cache probes, no extra spans.
+    "cache.enabled": (True, bool),
+    # LRU capacity of the result cache in logical payload bytes (across
+    # all tiers). Resident entries are charged against the MemoryLimiter
+    # so cached results can never starve live queries; under pressure the
+    # high-watermark spiller sheds cache entries first.
+    "cache.max_bytes": (256 << 20, int),
+    # Subplan-prefix reuse: hash canonicalized scan+filter+project prefixes
+    # of submitted plans so two distinct plans sharing a prefix execute the
+    # shared region once and reuse the materialized intermediate. Gated
+    # separately because it rewrites plans before execution.
+    "cache.subplan_enabled": (True, bool),
 }
 
 _overrides: dict[str, Any] = {}

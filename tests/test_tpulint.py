@@ -356,8 +356,37 @@ def test_rule_payload_verify_scope(tmp_path):
     assert not _by_rule(_lint_file(target3), "payload-must-verify")
 
 
+def test_rule_cache_key_fingerprint_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_resultcache_key.py"),
+                   "cache-key-must-fingerprint")
+    texts = [f.source_line for f in got]
+    assert len(got) == 4, texts
+    assert any("cache.get(sig)" in t for t in texts)
+    assert any("plan_signature(plan, bindings)" in t for t in texts)
+    assert any("CacheKey(sig))" in t for t in texts)
+    assert any('CacheKey(sig, "")' in t for t in texts)
+    # derived-key, full-CacheKey, source-fingerprint, non-cache-receiver
+    # and pragma'd twins stay clean
+    src = (FIXTURES / "seeded_resultcache_key.py").read_text()
+    clean_at = src[:src.index("def clean_derived_key")].count("\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_cache_key_fingerprint_scope(tmp_path):
+    # same constructions outside cache/reservation scope are someone
+    # else's get/put contract — out of scope
+    target = tmp_path / "plain_store.py"
+    shutil.copy(FIXTURES / "seeded_resultcache_key.py", target)
+    assert not _by_rule(_lint_file(target), "cache-key-must-fingerprint")
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    target2 = rt / "plain_name.py"
+    shutil.copy(FIXTURES / "seeded_resultcache_key.py", target2)
+    assert _by_rule(_lint_file(target2), "cache-key-must-fingerprint")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all fifteen rules demonstrably fire."""
+    """The acceptance invariant: all sixteen rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
@@ -386,6 +415,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_span_scope.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_payload_memory.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_resultcache_key.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

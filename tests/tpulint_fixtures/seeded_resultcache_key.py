@@ -1,0 +1,50 @@
+"""Seeded violations for rule 16 (cache-key-must-fingerprint).
+
+The basename contains ``cache`` so the file is in scope the same way
+``runtime/resultcache.py`` is. Violations first, then clean twins past
+the ``def clean_`` marker the per-rule test splits on.
+"""
+
+
+def signature_only_name(cache, plan, bindings, plan_signature):
+    sig = plan_signature(plan, bindings)
+    return cache.get(sig)  # VIOLATION: signature-only key, stale on data change
+
+
+def raw_signature_call(cache, plan, bindings, plan_signature, result):
+    cache.put(plan_signature(plan, bindings), result)  # VIOLATION
+
+
+def fingerprintless_cachekey(cache, CacheKey, sig):
+    key = CacheKey(sig)
+    probe = cache.get(CacheKey(sig))  # VIOLATION: no fingerprint half
+    return probe, key
+
+
+def empty_fingerprint(cache, CacheKey, sig, result):
+    cache.put(CacheKey(sig, ""), result)  # VIOLATION: empty fingerprint
+
+
+def clean_derived_key(cache, resultcache, plan, bindings):
+    # the blessed derivation: both halves, content invalidates
+    key = resultcache.cache_key(plan, bindings)
+    return cache.get(key)
+
+
+def clean_full_cachekey(cache, CacheKey, sig, fingerprint, result):
+    cache.put(CacheKey(sig, fingerprint), result)
+
+
+def clean_source_fingerprint(cache, CacheKey, sig, resultcache, path, result):
+    cache.put(CacheKey(sig, fingerprint=resultcache.source_fingerprint(path)),
+              result)
+
+
+def clean_non_cache_receiver(entries, sig):
+    # a plain dict probe is not a result-cache key contract
+    return entries.get(sig, 0)
+
+
+def clean_pragmad_signature_probe(cache, sig):
+    # introspection probe on a test double; reviewed, not a serving path
+    return cache.get(sig)  # tpulint: disable=cache-key-must-fingerprint

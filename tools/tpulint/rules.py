@@ -1,4 +1,4 @@
-"""The fifteen tpulint rules.
+"""The sixteen tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -1127,6 +1127,83 @@ def check_payload_verify(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+def check_cache_key_fingerprint(ctx: FileContext) -> List[RawFinding]:
+    """ISSUE-11 bug class: a result-cache ``get``/``put`` keyed by the
+    plan signature ALONE serves yesterday's bytes the moment the bound
+    data changes — the key's second half (the input-content fingerprint)
+    is what invalidates on data change, and ``runtime/resultcache.py``
+    rejects fingerprint-less keys at runtime. This is the static half:
+    in cache-scope files (a ``cache`` basename, or the reservation-scope
+    runtime/parallel set), any ``.get(...)``/``.put(...)`` on a
+    cache-named receiver whose key argument is visibly signature-only —
+    a bare ``*sig*``-named reference, a direct ``plan_signature(...)``
+    call, or a ``CacheKey`` constructed without (or with an empty)
+    fingerprint — is flagged. Keys built through ``cache_key(...)`` or
+    carrying a fingerprint are clean; no cross-module dataflow, so a
+    laundered signature-only key still needs the runtime check."""
+    if not (_is_reservation_scope_file(ctx) or "cache" in ctx.name):
+        return []
+    out: List[RawFinding] = []
+
+    def _ident(node) -> str:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return ""
+
+    def _sig_only_name(name: str) -> bool:
+        low = name.lower()
+        return ("sig" in low and "fingerprint" not in low
+                and "fp" not in low and "key" not in low)
+
+    def _suspect_key(key) -> "str | None":
+        if isinstance(key, ast.Call):
+            callee = _ident(key.func)
+            if callee == "plan_signature":
+                return ("a raw `plan_signature(...)` digest is the "
+                        "signature half only")
+            if callee == "CacheKey":
+                fp = None
+                if len(key.args) >= 2:
+                    fp = key.args[1]
+                for kw in key.keywords:
+                    if kw.arg == "fingerprint":
+                        fp = kw.value
+                if fp is None:
+                    return "CacheKey constructed without a fingerprint"
+                if (isinstance(fp, ast.Constant)
+                        and isinstance(fp.value, str)
+                        and not fp.value.strip()):
+                    return "CacheKey fingerprint is an empty string"
+            return None
+        name = _ident(key)
+        if name and _sig_only_name(name):
+            return f"key `{name}` names only the plan signature"
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "put")
+                and node.args):
+            continue
+        recv = _unparse(node.func.value).lower()
+        if "cache" not in recv.rsplit(".", 1)[-1]:
+            continue
+        why = _suspect_key(node.args[0])
+        if why is None:
+            continue
+        out.append(RawFinding(
+            node.lineno, node.col_offset,
+            f"result-cache .{node.func.attr}(...) keyed without the "
+            f"input fingerprint ({why}): a signature-only key serves "
+            f"stale results across data changes; derive the key with "
+            f"`resultcache.cache_key(plan, bindings)` (or pass a "
+            f"`source_fingerprint`) so content invalidates it"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -1191,4 +1268,9 @@ RULES = [
          "turns torn writes into garbage columns instead of a "
          "classified CorruptDataError",
          check_payload_verify),
+    Rule("cache-key-must-fingerprint",
+         "result-cache get/put keys must carry the input-content "
+         "fingerprint half; signature-only keying serves stale results "
+         "the moment the bound data changes",
+         check_cache_key_fingerprint),
 ]
