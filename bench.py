@@ -1031,6 +1031,154 @@ def _integrity_block() -> dict:
     return block
 
 
+def _compress_block() -> dict:
+    """The BENCH_*.json ``compress`` block: what the columnar codec
+    (runtime/compress.py — per-column dictionary/RLE re-encode plus
+    bit-packed validity under the integrity seal) buys and costs at
+    each sealed seam. Ratios are measured in the seams' production
+    positions, not on cherry-picked buffers: ``spill`` is a SpillStore
+    put -> spill -> get round-trip (host and disk tiers both store
+    codec frames), ``wire`` is a serialized DCN exchange frame. The
+    q1 group keys (l_returnflag/l_linestatus) are reported separately
+    because they are the acceptance target (>=2x reduction): 3- and
+    2-value int8 columns are the dictionary encoder's best case and
+    the reason shuffle-by-group-key traffic shrinks. Encode/decode
+    micro-costs are normalized per logical MiB from the codec's own
+    telemetry counters, and the workload acceptance bound (<=5% wall)
+    reuses the integrity block's out-of-core chunked q1, compression
+    on vs off on the identical run."""
+    block: dict = {}
+    try:
+        import numpy as np
+
+        from spark_rapids_jni_tpu.models import tpch
+        from spark_rapids_jni_tpu.parallel import dcn as _dcn
+        from spark_rapids_jni_tpu.runtime import compress as _compress
+        from spark_rapids_jni_tpu.runtime import degrade as _degrade
+        from spark_rapids_jni_tpu.runtime.memory import (
+            MemoryLimiter, SpillStore)
+        from spark_rapids_jni_tpu.telemetry import REGISTRY
+        from spark_rapids_jni_tpu.utils.config import (
+            reset_option, set_option)
+
+        def _snap() -> dict:
+            return REGISTRY.counters("compress.")
+
+        def _delta(before: dict, after: dict, key: str) -> int:
+            return after.get(key, 0) - before.get(key, 0)
+
+        li = tpch.lineitem_table(1 << 14, seed=7)
+
+        # spill seam in production position: put (host tier) -> spill
+        # (disk tier) -> get, logical vs stored bytes from the codec's
+        # per-seam counters
+        b0 = _snap()
+        store = SpillStore(budget_bytes=1 << 22)
+        h = store.put(li)
+        store.spill(h)
+        back = store.get(h)
+        assert back.num_rows == li.num_rows
+        store.close()
+        a0 = _snap()
+        sp_in = _delta(b0, a0, "compress.spill.bytes_in")
+        sp_out = _delta(b0, a0, "compress.spill.bytes_out")
+        if sp_out:
+            block["spill_bytes_logical"] = sp_in
+            block["spill_bytes_stored"] = sp_out
+            block["spill_ratio"] = round(sp_in / sp_out, 2)
+
+        # wire seam: one serialized exchange frame (what send_table
+        # seals and ships), logical vs framed bytes
+        b1 = _snap()
+        blob = _dcn.serialize_table(li, compress_level=0)
+        a1 = _snap()
+        w_in = _delta(b1, a1, "compress.wire.bytes_in")
+        w_out = _delta(b1, a1, "compress.wire.bytes_out")
+        if w_out:
+            block["wire_bytes_logical"] = w_in
+            block["wire_bytes_framed"] = w_out
+            block["wire_ratio"] = round(w_in / w_out, 2)
+            block["wire_frame_bytes"] = len(blob)
+
+        # the acceptance columns: q1's group keys, dictionary's best
+        # case ('A'/'N'/'R' and 'F'/'O' int8 domains)
+        for name, idx in (("returnflag", 4), ("linestatus", 5)):
+            arr = np.asarray(li.columns[idx].data)
+            frame = _compress.encode_array(arr, seam="integrity.wire")
+            dec = _compress.decode_array(frame, seam="integrity.wire")
+            assert np.array_equal(dec, arr)
+            block[f"{name}_bytes_logical"] = int(arr.nbytes)
+            block[f"{name}_bytes_encoded"] = len(frame)
+            block[f"{name}_ratio"] = round(arr.nbytes / len(frame), 2)
+
+        # codec micro-costs per logical MiB + scheme mix, from the
+        # codec's own counters across everything encoded above
+        aN = _snap()
+        enc_us = _delta(b0, aN, "compress.encode_us")
+        enc_in = _delta(b0, aN, "compress.bytes_in")
+        dec_us = _delta(b0, aN, "compress.decode_us")
+        dec_b = _delta(b0, aN, "compress.bytes_decoded")
+        if enc_in:
+            block["encode_us_per_mib"] = round(
+                enc_us / (enc_in / (1 << 20)), 1)
+        if dec_b:
+            block["decode_us_per_mib"] = round(
+                dec_us / (dec_b / (1 << 20)), 1)
+        schemes = {
+            k[len("compress.scheme."):]: _delta(b0, aN, k)
+            for k in aN
+            if k.startswith("compress.scheme.") and _delta(b0, aN, k)
+        }
+        if schemes:
+            block["schemes"] = schemes
+        block["zstd_stage"] = _compress.zstd_available()
+
+        # workload acceptance bound: the same out-of-core chunked q1
+        # the integrity block uses (checkpoints spill through a
+        # budget-squeezed SpillStore), compression on vs off —
+        # median-of-3, identical workload, <=5% accepted
+        rows = 1 << 14
+        bindings = {"lineitem": tpch.lineitem_table(rows, seed=5)}
+        limiter = MemoryLimiter(1 << 30)
+
+        def _spill_workload():
+            st = SpillStore(budget_bytes=1 << 16)
+            runner = _degrade.row_chunked_tier(
+                bindings, "lineitem", *tpch.q1_row_chunked_fns(),
+                limiter=limiter, spill_store=st)
+            runner(1024, None)
+            st.close()
+
+        walls = {}
+        for label, en in (("on", True), ("off", False)):
+            set_option("compress.enabled", en)
+            try:
+                _spill_workload()  # warm-up out of the clock
+                samples = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    for _ in range(2):
+                        _spill_workload()
+                    samples.append(time.perf_counter() - t0)
+                walls[label] = sorted(samples)[1]
+            finally:
+                reset_option("compress.enabled")
+        if walls["off"] > 0:
+            block["outofcore_q1_overhead_pct"] = round(
+                (walls["on"] / walls["off"] - 1.0) * 100.0, 2)
+        block["note"] = (
+            "ratios are logical/stored bytes at the seam's production "
+            "position with the integrity seal outside the codec frame; "
+            "returnflag/linestatus are the q1 group keys (>=2x "
+            "acceptance target). overhead_pct: compression on vs off "
+            "on the identical out-of-core q1; acceptance <=5%. "
+            "zstd_stage false = optional zstandard absent, "
+            "dict/RLE/bit-pack carry all ratios")
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    return block
+
+
 def _ledger_last(metric: str, n: int):
     """Most recent ledger record for ``metric`` under the current
     measurement tag — preferring an exact row-count match (throughput is
@@ -1905,7 +2053,8 @@ def _child_main(config: str, n: int, iters: int) -> None:
                       "server": _server_block(),
                       "cache": _cache_block(),
                       "degrade": _degrade_block(),
-                      "integrity": _integrity_block()}))
+                      "integrity": _integrity_block(),
+                      "compress": _compress_block()}))
 
 
 # ---------------------------------------------------------------------------
@@ -1947,10 +2096,11 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
     """Run the bench in a subprocess; returns (value | None, diagnostic,
     dispatch block | None, pipeline block | None, fusion block | None,
     server block | None, cache block | None, degrade block | None,
-    integrity block | None) — the blocks come from the measured child
-    process's executable cache, overlap probe, whole-stage fusion probe,
-    serving-concurrency probe, result-cache probe, and memory-pressure
-    degradation probe."""
+    integrity block | None, compress block | None) — the blocks come
+    from the measured child process's executable cache, overlap probe,
+    whole-stage fusion probe, serving-concurrency probe, result-cache
+    probe, memory-pressure degradation probe, and the integrity /
+    columnar-codec seam probes."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CONFIG"] = config
@@ -1968,7 +2118,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         )
     except subprocess.TimeoutExpired:
         return (None, f"{platform} bench timed out after {timeout_s:.0f}s",
-                None, None, None, None, None, None, None)
+                None, None, None, None, None, None, None, None)
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
@@ -1982,15 +2132,17 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         cache = rec.get("cache") if isinstance(rec, dict) else None
         deg = rec.get("degrade") if isinstance(rec, dict) else None
         integ = rec.get("integrity") if isinstance(rec, dict) else None
+        comp = rec.get("compress") if isinstance(rec, dict) else None
         return (value, "", disp if isinstance(disp, dict) else None,
                 pipe if isinstance(pipe, dict) else None,
                 fus if isinstance(fus, dict) else None,
                 srv if isinstance(srv, dict) else None,
                 cache if isinstance(cache, dict) else None,
                 deg if isinstance(deg, dict) else None,
-                integ if isinstance(integ, dict) else None)
+                integ if isinstance(integ, dict) else None,
+                comp if isinstance(comp, dict) else None)
     return (None, f"{platform} bench failed: {_tail(out)}",
-            None, None, None, None, None, None, None)
+            None, None, None, None, None, None, None, None)
 
 
 def main() -> None:
@@ -2014,6 +2166,7 @@ def main() -> None:
     child_cache = None
     child_deg = None
     child_integ = None
+    child_comp = None
     # every run gets a telemetry file (children record through the package
     # via these env vars; the parent appends bench_stale events itself) —
     # restored afterwards so driving code / tests see their own env back
@@ -2053,7 +2206,7 @@ def main() -> None:
             if ok:
                 (value, why, child_disp, child_pipe, child_fus,
                  child_srv, child_cache, child_deg,
-                 child_integ) = _run_child(
+                 child_integ, child_comp) = _run_child(
                     config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
@@ -2093,10 +2246,21 @@ def main() -> None:
                     "stale_s": record["stale_s"],
                     "ledger_n": led.get("n"), "requested_n": n,
                 })
+                # the seam probes (dispatch .. integrity/compress) are
+                # in-process diagnostics of the CURRENT code, not TPU
+                # throughput — harvest them from a cpu child so a stale
+                # ledger record still documents today's seam behaviour
+                # instead of shipping empty blocks
+                (_pv, _pwhy, child_disp, child_pipe, child_fus,
+                 child_srv, child_cache, child_deg,
+                 child_integ, child_comp) = _run_child(
+                    config, n, iters, "cpu", child_timeout)
+                if _pv is None and _pwhy:
+                    diagnostics.append(f"probe child: {_pwhy}")
         if value is None:
             (value, why, child_disp, child_pipe, child_fus,
              child_srv, child_cache, child_deg,
-             child_integ) = _run_child(
+             child_integ, child_comp) = _run_child(
                 config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
@@ -2160,6 +2324,11 @@ def main() -> None:
     # injected-corruption recovery latency), same child-process
     # provenance; empty when no live child ran
     record["integrity"] = child_integ or {}
+    # columnar-codec probe (per-seam compression ratios, the q1
+    # group-key acceptance columns, encode/decode cost per MiB,
+    # on-vs-off out-of-core q1 wall), same child-process provenance;
+    # empty when no live child ran
+    record["compress"] = child_comp or {}
     if diagnostics:
         record["diagnostic"] = "; ".join(d for d in diagnostics if d)
     print(json.dumps(record))
@@ -2210,8 +2379,11 @@ def sweep() -> None:
             if config in single_size else sizes
         cfg_timeout = 240.0 if config == "tpch_q1_pallas" else timeout
         for n in cfg_sizes:
-            (value, why, _disp, _pipe, _fus, _srv, _deg,
-             _integ) = _run_child(config, n, iters, "tpu", cfg_timeout)
+            # blocks beyond (value, why) are per-run diagnostics the
+            # sweep line doesn't carry — star-unpack so adding one
+            # can never break the sweep again
+            value, why, *_blocks = _run_child(
+                config, n, iters, "tpu", cfg_timeout)
             line = {"config": config, "metric": metric, "n": n,
                     "value": value, "unit": unit, "device_kind": kind}
             if value is not None:

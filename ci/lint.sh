@@ -1,13 +1,13 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its sixteen invariant rules (host/device
+# tpulint (tools/tpulint) runs its seventeen invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
 # error-must-classify, server-telemetry-session-id,
 # reservation-release-in-finally, span-must-scope, payload-must-verify,
-# cache-key-must-fingerprint)
+# cache-key-must-fingerprint, compress-inside-seal)
 # over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
 # glob below covers the telemetry/ package alongside every other
 # subpackage.
@@ -514,4 +514,80 @@ leaked = srv.limiter.used
 assert leaked == 0, f"leaked {leaked} reserved bytes"
 print("cache smoke OK: repeat q1 served from cache (0 compiles, 0 wait), "
       "corrupt entry discarded + bit-identical recompute, 0 leaked bytes")
+EOF
+
+# compression smoke: rule 17 only proves sealed payloads ROUTE through
+# the codec seam — this proves the codec itself still honors its
+# contract: dictionary-friendly TPC-H lineitem columns round-trip
+# bit-identical through BOTH the spill and wire seams with a measured
+# ratio > 1 (zstd absent: dictionary/RLE/bit-pack carry it alone), and
+# a corruption injected UNDER the seal is a classified CorruptDataError
+# at read, never garbage columns.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import socket
+import threading
+
+import numpy as np
+
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.parallel.dcn import SliceLink, serialize_table
+from spark_rapids_jni_tpu.runtime import faults, resilience
+from spark_rapids_jni_tpu.runtime.memory import SpillStore
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+
+
+def bit_identical(a, b):
+    for i in range(a.num_columns):
+        ca, cb = a.columns[i], b.columns[i]
+        assert (np.asarray(ca.data) == np.asarray(cb.data)).all(), i
+        if ca.validity is not None:
+            assert (np.asarray(ca.validity)
+                    == np.asarray(cb.validity)).all(), i
+
+
+li = tpch.lineitem_table(4096)  # returnflag/linestatus: 3- and 2-value
+                                # int8 columns, the dictionary targets
+
+# spill seam: host snapshots are codec-packed, read back bit-identical
+store = SpillStore(budget_bytes=1 << 20)
+h = store.put(li)
+store.spill(h)
+st = store.stats()
+assert st["host_bytes"] > 0, st
+ratio = st["host_bytes"] / st["host_stored_bytes"]
+assert ratio > 1.0, f"spill ratio {ratio:.2f} <= 1"
+bit_identical(li, store.get(h))
+store.close()
+
+# wire seam: codec frames shrink the serialized table and decode back
+raw = serialize_table(li, compress_level=0)
+plain = sum(int(np.asarray(c.data).nbytes) for c in li.columns)
+wire_ratio = plain / len(raw)
+assert wire_ratio > 1.0, f"wire ratio {wire_ratio:.2f} <= 1"
+sa, sb = socket.socketpair()
+a, b = SliceLink(sa), SliceLink(sb)
+out = {}
+t = threading.Thread(target=lambda: out.setdefault("tbl", b.recv_table()))
+t.start()
+a.send_table(li, compress_level=0)
+t.join(30)
+bit_identical(li, out["tbl"])
+a.close(); b.close()
+
+# corruption UNDER the seal at the spill seam: classified, not garbage
+store2 = SpillStore(budget_bytes=1 << 20)
+script = faults.FaultScript(
+    corruptions=[faults.CorruptionSpec("integrity.spill", mode="flip")])
+with faults.inject(script):
+    h2 = store2.put(tpch.lineitem_table(512))
+    store2.spill(h2)
+try:
+    store2.get(h2)
+    raise SystemExit("corrupted compressed spill entry decoded")
+except resilience.CorruptDataError:
+    pass
+assert REGISTRY.counter("integrity.mismatch.integrity.spill").value >= 1
+store2.close()
+print(f"compression smoke OK: spill ratio {ratio:.2f}x, wire ratio "
+      f"{wire_ratio:.2f}x, both bit-identical, corruption classified")
 EOF

@@ -56,10 +56,24 @@ Wire format (version 1, all little-endian):
   buffer: u8 dtype_str_len | dtype_str | u8 ndim | ndim x u64 shape |
           u8 compressed | u64 payload_len | payload
 
+The ``compressed`` flag takes three values: 0 = raw bytes, 1 = legacy
+whole-buffer zstd, 2 = a self-describing runtime/compress.py codec frame
+(dictionary/RLE/bit-pack + optional zstd final stage) — the default
+whenever ``compress.enabled`` + ``compress.wire`` are on. Flag-2 decode
+re-checks the decoded dtype and shape against this buffer header (the
+post-decode check of the compress -> seal contract); with the codec off
+the stream is byte-for-byte the legacy 0/1 framing. The optional
+``zstandard`` import guard this module used to carry is hoisted into
+``runtime/compress.py`` (``zstd_codec``) and re-exported here as
+``_zstd``, so wire and codec can never disagree on availability.
+
 With ``integrity.enabled`` every framed payload additionally carries the
 runtime/integrity.py length+checksum trailer and the link runs a
 stop-and-wait ACK/NAK handshake (see :class:`SliceLink`) so a corrupt
 frame is refetched from the sender instead of decoded into garbage.
+Ordering per frame is compress -> seal on send and verify -> decompress
+-> post-decode check on receive; an ARQ resend re-seals the pristine
+compressed blob (the codec runs once per table, not per attempt).
 """
 
 from __future__ import annotations
@@ -71,29 +85,32 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.runtime import compress
 from spark_rapids_jni_tpu.types import DType, TypeId
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 _MAGIC = b"TPDC"
 _VERSION = 1
 
-
-def _zstd(level: int):
-    import zstandard as zstd
-
-    return zstd.ZstdCompressor(level=level), zstd.ZstdDecompressor()
+# the shared optional-zstandard guard, re-exported under its old name
+_zstd = compress.zstd_codec
 
 
-def _write_buffer(out: list, arr: Optional[np.ndarray], cctx) -> None:
+def _write_buffer(out: list, arr: Optional[np.ndarray], cctx,
+                  codec: bool = False) -> None:
     a = np.ascontiguousarray(arr)
     dts = a.dtype.str.encode()
     out.append(struct.pack("<B", len(dts)))
     out.append(dts)
     out.append(struct.pack("<B", a.ndim))
     out.append(struct.pack(f"<{a.ndim}Q", *a.shape))
-    payload = cctx.compress(a) if cctx is not None else a.tobytes()
-    out.append(struct.pack("<BQ", 1 if cctx is not None else 0,
-                           len(payload)))
+    if codec:
+        flag, payload = 2, compress.encode_array(a, seam="integrity.wire")
+    elif cctx is not None:
+        flag, payload = 1, cctx.compress(a)
+    else:
+        flag, payload = 0, a.tobytes()
+    out.append(struct.pack("<BQ", flag, len(payload)))
     out.append(payload)
 
 
@@ -121,6 +138,20 @@ def _read_buffer(r: _Reader, dctx) -> np.ndarray:
     shape = r.unpack(f"<{ndim}Q") if ndim else ()
     compressed, plen = r.unpack("<BQ")
     payload = r.take(plen)
+    if compressed == 2:
+        # codec frame: decode failures raise classified CorruptDataError
+        # (the seal already verified upstream — this is the corrupt-
+        # after-decompress net), then the buffer header is the
+        # post-decode length/shape oracle
+        arr = compress.decode_array(payload, seam="integrity.wire",
+                                    op="dcn.read_buffer")
+        if arr.dtype.str != dts or tuple(arr.shape) != tuple(shape):
+            raise compress.corrupt(
+                "decoded wire buffer disagrees with frame header",
+                seam="integrity.wire", op="dcn.read_buffer",
+                declared=f"{dts}{tuple(shape)}",
+                actual=f"{arr.dtype.str}{tuple(arr.shape)}")
+        return arr
     if compressed:
         if dctx is None:
             raise ModuleNotFoundError(
@@ -129,7 +160,7 @@ def _read_buffer(r: _Reader, dctx) -> np.ndarray:
     return np.frombuffer(payload, dtype=np.dtype(dts)).reshape(shape)
 
 
-def _write_column(out: list, c: Column, cctx) -> None:
+def _write_column(out: list, c: Column, cctx, codec: bool = False) -> None:
     flags = ((1 if c.validity is not None else 0)
              | (2 if c.chars is not None else 0)
              | (4 if c.children else 0))
@@ -137,13 +168,13 @@ def _write_column(out: list, c: Column, cctx) -> None:
                            c.dtype.scale or 0, flags))
     if c.children:
         out.append(struct.pack("<I", len(c.children)))
-    _write_buffer(out, np.asarray(c.data), cctx)
+    _write_buffer(out, np.asarray(c.data), cctx, codec)
     if c.validity is not None:
-        _write_buffer(out, np.asarray(c.validity), cctx)
+        _write_buffer(out, np.asarray(c.validity), cctx, codec)
     if c.chars is not None:
-        _write_buffer(out, np.asarray(c.chars), cctx)
+        _write_buffer(out, np.asarray(c.chars), cctx, codec)
     for ch in (c.children or ()):
-        _write_column(out, ch, cctx)
+        _write_column(out, ch, cctx, codec)
 
 
 def _read_column(r: _Reader, dctx) -> Column:
@@ -160,14 +191,24 @@ def _read_column(r: _Reader, dctx) -> Column:
 
 @func_range("dcn_serialize_table")
 def serialize_table(table: Table, compress_level: int = 3) -> bytes:
-    """Device table -> one self-describing compressed wire frame."""
-    cctx, _ = _zstd(compress_level) if compress_level else (None, None)
+    """Device table -> one self-describing compressed wire frame.
+
+    With ``compress.enabled`` + ``compress.wire`` every buffer rides the
+    columnar codec (flag-2 framing; ``compress_level`` is superseded by
+    ``compress.zstd_level`` inside the codec). Codec off restores the
+    legacy path exactly: whole-buffer zstd at ``compress_level`` > 0
+    (which hard-requires zstandard, as before), raw flag-0 buffers at
+    level 0."""
+    codec = compress.seam_enabled("integrity.wire")
+    cctx = None
+    if not codec and compress_level:
+        cctx, _ = _zstd(compress_level)
     out: list = [
         _MAGIC,
         struct.pack("<IIQ", _VERSION, table.num_columns, table.num_rows),
     ]
     for c in table.columns:
-        _write_column(out, c, cctx)
+        _write_column(out, c, cctx, codec)
     return b"".join(out)
 
 

@@ -20,9 +20,11 @@ not silent truncation, exactly like the reference's hard batch bounds
 
 Scope note: this module is the DEVICE-side codec (value transforms that
 ride the collective). The host-side byte frames — serialization, the
-runtime/integrity.py checksum trailer, and the NAK/refetch protocol for
-corrupt frames — live in ``parallel/dcn.py``; nothing here touches raw
-wire bytes, so the integrity seam does not pass through this file.
+``runtime/compress.py`` columnar codec (dictionary/RLE/bit-pack per
+buffer, compressed BEFORE the integrity seal), the runtime/integrity.py
+checksum trailer, and the NAK/refetch protocol for corrupt frames — all
+live in ``parallel/dcn.py``; nothing here touches raw wire bytes, so
+neither the compression nor the integrity seam passes through this file.
 
 Pack layout: value j of a block occupies bits [j*bits, (j+1)*bits) of the
 little-endian uint32 word stream — FOR/bit-pack order compatible with the

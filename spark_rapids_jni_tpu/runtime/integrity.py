@@ -34,6 +34,16 @@ that happens to embed its own crc32 — or a trailer fed back through
 ``checksum`` — never verifies by accident. Zero dependencies beyond
 the stdlib; no jax imports (this module runs on the control plane).
 
+Ordering contract with the columnar codec (``runtime/compress.py``):
+**compress → seal** on every write, **verify → decompress →
+post-decode length/shape check** on every read. The trailer always
+covers the stored (compressed) bytes — the seal is the OUTERMOST wrapper
+— so verification never spends decode work on bytes that fail the crc,
+and a corruption injected after a successful verify (a bad codec frame)
+is still a classified ``CorruptDataError`` from the codec's own header
+and per-scheme length checks. ARQ refetch at the wire seam re-seals the
+pristine compressed blob per resend; nothing is recompressed.
+
 Disabled (``integrity.enabled=false`` or ``SPARK_RAPIDS_TPU_INTEGRITY=0``)
 every seam is byte-for-byte today's behavior: no trailer, no
 verification, no wire acknowledgements.

@@ -32,7 +32,7 @@ from typing import NamedTuple, Optional
 import numpy as np
 
 from spark_rapids_jni_tpu import telemetry
-from spark_rapids_jni_tpu.runtime import faults, integrity
+from spark_rapids_jni_tpu.runtime import compress, faults, integrity
 from spark_rapids_jni_tpu.telemetry import spans
 from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.log import get_logger
@@ -520,12 +520,17 @@ def _table_nbytes(table) -> int:
     return sum(_col_nbytes(c) for c in table.columns)
 
 
-def _pack_array(arr, cctx):
-    """Optionally zstd-compress one host buffer (the nvcomp role for the
-    HOST path: spilled working sets, future DCN exchange). Returns the
-    plain array when compression is off."""
+def _pack_array(arr, cctx, codec_seam=None):
+    """Re-encode one host buffer for the spilled tiers (the nvcomp role
+    for the HOST path). ``codec_seam`` routes it through the columnar
+    codec (runtime/compress.py) as a self-describing ``("tpcc", ...)``
+    pack; otherwise ``cctx`` keeps the legacy whole-buffer zstd pack, and
+    with both off the plain array passes through — byte-for-byte the
+    pre-codec snapshot."""
     if arr is None:
         return None
+    if codec_seam is not None:
+        return compress.pack_array(arr, codec_seam)
     a = np.ascontiguousarray(arr)
     if cctx is None:
         return a
@@ -533,9 +538,14 @@ def _pack_array(arr, cctx):
     return ("zstd", a.dtype.str, a.shape, cctx.compress(a))
 
 
-def _unpack_array(obj, dctx):
+def _unpack_array(obj, dctx, seam="integrity.spill"):
     if obj is None or not isinstance(obj, tuple):
         return obj
+    if compress.is_codec_pack(obj):
+        # runs after the seam's trailer/crc verified; the codec re-checks
+        # the frame itself so a corrupt-after-decompress header is still
+        # a classified CorruptDataError, never garbage staged to HBM
+        return compress.unpack_array(obj, seam=seam, op="spill_store.unpack")
     _, dtype_str, shape, blob = obj
     return np.frombuffer(
         dctx.decompress(blob), dtype=np.dtype(dtype_str)).reshape(shape)
@@ -549,33 +559,34 @@ def _packed_nbytes(obj) -> int:
     return obj.nbytes
 
 
-def _col_to_host(c, cctx=None) -> tuple:
+def _col_to_host(c, cctx=None, codec_seam=None) -> tuple:
     """Recursive host snapshot of a column (incl. LIST/STRUCT children)."""
     return (
         c.dtype,
-        _pack_array(np.asarray(c.data), cctx),
+        _pack_array(np.asarray(c.data), cctx, codec_seam),
         None if c.validity is None
-        else _pack_array(np.asarray(c.validity), cctx),
-        None if c.chars is None else _pack_array(np.asarray(c.chars), cctx),
+        else _pack_array(np.asarray(c.validity), cctx, codec_seam),
+        None if c.chars is None
+        else _pack_array(np.asarray(c.chars), cctx, codec_seam),
         None if not c.children
-        else [_col_to_host(ch, cctx) for ch in c.children],
+        else [_col_to_host(ch, cctx, codec_seam) for ch in c.children],
     )
 
 
-def _col_from_host(snap, dctx=None):
+def _col_from_host(snap, dctx=None, seam="integrity.spill"):
     import jax.numpy as jnp
 
     from spark_rapids_jni_tpu.columnar import Column
 
     dtype, data, validity, chars, children = snap
     return Column(
-        dtype, jnp.asarray(_unpack_array(data, dctx)),
+        dtype, jnp.asarray(_unpack_array(data, dctx, seam)),
         None if validity is None
-        else jnp.asarray(_unpack_array(validity, dctx)),
+        else jnp.asarray(_unpack_array(validity, dctx, seam)),
         chars=None if chars is None
-        else jnp.asarray(_unpack_array(chars, dctx)),
+        else jnp.asarray(_unpack_array(chars, dctx, seam)),
         children=None if children is None
-        else [_col_from_host(ch, dctx) for ch in children],
+        else [_col_from_host(ch, dctx, seam) for ch in children],
     )
 
 
@@ -712,10 +723,9 @@ class SpillStore:
         self._cctx = None
         self._dctx = None
         if compress_spill:
-            import zstandard as zstd
-
-            self._cctx = zstd.ZstdCompressor(level=compress_level)
-            self._dctx = zstd.ZstdDecompressor()
+            # the shared availability guard (runtime/compress.py) — wire
+            # and spill can never disagree on whether zstandard exists
+            self._cctx, self._dctx = compress.zstd_codec(compress_level)
 
     def _device_bytes_locked(self) -> int:
         return sum(e["nbytes"] for e in self._entries.values()
@@ -744,9 +754,14 @@ class SpillStore:
         # must leave the victim resident and the store consistent
         faults.fire("spill.spill", eid, nbytes=e["nbytes"])
         seam = e.get("iseam", "integrity.spill")
+        # compress -> seal ordering: the codec re-encode happens INSIDE
+        # the snapshot (per buffer), before the crc / trailer is taken
+        # over it, so verification always covers the compressed bytes
+        codec_seam = seam if compress.seam_enabled(seam) else None
         with spans.child("spill", handle=eid, nbytes=e["nbytes"]):
             e["host_cols"] = [
-                _col_to_host(c, self._cctx) for c in e["table"].columns]
+                _col_to_host(c, self._cctx, codec_seam)
+                for c in e["table"].columns]
             if self._spill_dir is not None:
                 # disk tier: pickle the snapshot, seal it, write it
                 # crash-safe (tmp + os.replace + read-back verify)
@@ -891,7 +906,7 @@ class SpillStore:
                     snaps = e["host_cols"]
                 self._spill_lru_locked(e["nbytes"])
                 cols = [
-                    _col_from_host(snap, self._dctx)
+                    _col_from_host(snap, self._dctx, seam)
                     for snap in snaps]
             e["table"] = Table(cols)
             e["host_cols"] = None
@@ -939,6 +954,22 @@ class SpillStore:
             if handle not in self._entries:
                 raise KeyError(f"unknown spill handle {handle}")
             return self._entries[handle]["nbytes"]
+
+    def stored_nbytes(self, handle: int) -> int:
+        """RESIDENT footprint of one entry in its current tier: logical
+        HBM bytes while device-resident, the (possibly codec-compressed)
+        packed snapshot bytes on the host tier, the sealed file size on
+        the disk tier. The result cache's LRU charges this — compressed
+        entries make the same ``cache.max_bytes`` hold more results."""
+        with self._lock:
+            e = self._entries.get(handle)
+            if e is None:
+                raise KeyError(f"unknown spill handle {handle}")
+            if e["state"] == "device":
+                return e["nbytes"]
+            if e["state"] == "disk":
+                return int(e.get("stored_bytes", 0))
+            return sum(_host_snap_nbytes(s) for s in e["host_cols"])
 
     def drop(self, handle: int) -> None:
         with self._lock:

@@ -10,8 +10,11 @@ TPU-native equivalent composes pieces that already exist:
   granularity chunks under an on-disk byte budget;
 * ``MemoryLimiter`` — the RMM-role accounting that turns "would OOM" into
   a fail-loud reservation contract;
-* ``SpillStore`` — LRU device->host spill (zstd-compressed) for
-  intermediates that outlive their chunk;
+* ``SpillStore`` — LRU device->host spill for intermediates that
+  outlive their chunk; spilled snapshots and on-disk checkpoints ride
+  the ``runtime/compress.py`` columnar codec (dictionary/RLE/bit-pack,
+  compressed before the integrity seal), so checkpoint bytes shrink
+  with no changes in this module;
 * mergeable partial aggregates — the distributed plans already reduce
   partials after the shuffle (``q1_distributed_step``); out-of-core runs
   the same partial->merge shape over TIME (chunk sequence) instead of

@@ -40,7 +40,10 @@ they are the FIRST thing pressure evicts: the limiter's high-watermark
 reaction sheds cache entries (demote to host tier + release charge)
 before any live query's working set spills, and a parked query's drain
 threshold discounts evictable cache bytes (``memory.py``). Capacity is an
-LRU in logical bytes (``cache.max_bytes``).
+LRU in RESIDENT (stored) bytes (``cache.max_bytes``): entries demoted to
+the host/disk tier count at their codec-compressed footprint
+(``compress.py``), so the same budget holds more results; ``stats()``
+reports both ``bytes`` (logical) and ``stored_bytes`` (resident).
 
 Config: ``cache.enabled`` / ``cache.max_bytes`` / ``cache.subplan_enabled``
 (env ``SPARK_RAPIDS_TPU_CACHE_*``). Off restores today's serving path
@@ -285,11 +288,17 @@ class ResultCache:
         self._limiter = limiter
         self._max_bytes_override = max_bytes
         self._lock = threading.RLock()
-        # key -> {handle, nbytes, meta, charged}; insertion order IS the
-        # LRU order (move_to_end on touch)
+        # key -> {handle, nbytes, stored, meta, charged}; insertion order
+        # IS the LRU order (move_to_end on touch)
         self._entries: "collections.OrderedDict[CacheKey, dict]" = (
             collections.OrderedDict())
+        # two byte sums: _bytes is LOGICAL (uncompressed HBM-equivalent)
+        # payload across all tiers; _stored_bytes is the RESIDENT
+        # footprint (codec-compressed once an entry leaves the device
+        # tier) and is what the LRU capacity bound charges — compressed
+        # entries make the same cache.max_bytes hold more results
         self._bytes = 0
+        self._stored_bytes = 0
         # resident limiter-charged bytes a pressure event could reclaim;
         # a PLAIN int read lock-free by the limiter (under ITS lock), so
         # it must always be updated in the same critical section as the
@@ -322,10 +331,23 @@ class ResultCache:
         # accounting must hold whether or not telemetry is watching
         REGISTRY.counter(f"cache.{event}").inc()
 
+    def _refresh_stored_locked(self, entry: dict) -> None:
+        """Re-read one entry's resident footprint from the store (it
+        shrinks to the codec-compressed size when the entry is demoted
+        off the device tier, and grows back to logical on re-stage) and
+        fold the delta into the LRU accounting."""
+        try:
+            stored = self._store.stored_nbytes(entry["handle"])
+        except KeyError:
+            return  # store closed / entry dropped under us: keep last
+        self._stored_bytes += stored - entry["stored"]
+        entry["stored"] = stored
+
     def _reconcile_locked(self, entry: dict) -> None:
         """The SpillStore's OWN LRU may have demoted a charged entry
         while making room for live working sets; fold that into the
         charge so the limiter never counts bytes HBM no longer holds."""
+        self._refresh_stored_locked(entry)
         if not entry["charged"]:
             return
         try:
@@ -348,6 +370,7 @@ class ResultCache:
         self._uncharge_locked(entry)
         self._entries.pop(key, None)
         self._bytes -= entry["nbytes"]
+        self._stored_bytes -= entry["stored"]
         try:
             self._store.drop(entry["handle"])
         except KeyError:
@@ -371,6 +394,7 @@ class ResultCache:
                 self._discard_locked(key, entry, "eviction")
                 continue
             self._uncharge_locked(entry)
+            self._refresh_stored_locked(entry)
             freed += entry["nbytes"]
             record_cache("result_cache", "shed", key=key.short,
                          nbytes=entry["nbytes"])
@@ -432,8 +456,12 @@ class ResultCache:
             if nbytes > self._max_bytes():
                 self._count("too_big")
                 return False
-            # LRU capacity bound in LOGICAL bytes across all tiers
-            while (self._bytes + nbytes > self._max_bytes()
+            # LRU capacity bound charges RESIDENT (stored) bytes: demoted
+            # entries count at their codec-compressed footprint, so the
+            # same cache.max_bytes holds more results once the compress
+            # seam shrinks the spilled tier. The incoming entry starts
+            # device-resident, i.e. at its full logical size.
+            while (self._stored_bytes + nbytes > self._max_bytes()
                    and self._entries):
                 old_key, old = next(iter(self._entries.items()))
                 self._discard_locked(old_key, old, "eviction")
@@ -444,13 +472,18 @@ class ResultCache:
             if not charged:
                 # no budget for residency: keep only the sealed host copy
                 self._store.spill(handle)
-            self._entries[key] = {
-                "handle": handle, "nbytes": nbytes,
+            entry = {
+                "handle": handle, "nbytes": nbytes, "stored": nbytes,
                 "meta": _snap_meta(result.meta), "charged": charged,
             }
+            self._entries[key] = entry
             self._bytes += nbytes
+            self._stored_bytes += nbytes
             if charged:
                 self.evictable_bytes += nbytes
+            else:
+                # already demoted: account the compressed footprint now
+                self._refresh_stored_locked(entry)
         self._count("put")
         record_cache("result_cache", "put", key=key.short, nbytes=nbytes)
         return True
@@ -509,11 +542,15 @@ class ResultCache:
                     self._limiter.release(nbytes)
                 self._entries.pop(key, None)
                 self._bytes -= nbytes
+                self._stored_bytes -= entry["stored"]
                 self._count("miss")
                 return None
             if reserved:
                 entry["charged"] = True
                 self.evictable_bytes += nbytes
+            # staged back to the device tier: resident footprint is the
+            # full logical size again
+            self._refresh_stored_locked(entry)
             self._entries.move_to_end(key)
             meta = _rehydrate_meta(entry["meta"])
         self._count("hit")
@@ -542,12 +579,14 @@ class ResultCache:
         with self._lock:
             entries = len(self._entries)
             total = self._bytes
+            stored = self._stored_bytes
             resident = self.evictable_bytes
         hits = c.get("cache.hit", 0)
         misses = c.get("cache.miss", 0)
         return {
             "entries": entries,
             "bytes": total,
+            "stored_bytes": stored,
             "resident_bytes": resident,
             "max_bytes": self._max_bytes(),
             "hits": hits,

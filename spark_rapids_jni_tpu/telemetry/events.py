@@ -459,6 +459,39 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
     records to summarize a file written by another process (bench children).
     """
     recs = list(records) if records is not None else events()
+    # the columnar codec (runtime/compress.py) is counter-based — its
+    # hot path never emits per-array event records — so its section is
+    # derived from the in-process REGISTRY and only meaningful for the
+    # no-argument (same-process) view; summarizing another process's
+    # JSONL keeps the key with an empty dict
+    compress: Dict[str, Any] = {}
+    if records is None:
+        comp = REGISTRY.counters("compress.")
+        if comp:
+            bytes_in = comp.get("compress.bytes_in", 0)
+            bytes_out = comp.get("compress.bytes_out", 0)
+            compress = {
+                "bytes_in": bytes_in,
+                "bytes_out": bytes_out,
+                "ratio": round(bytes_in / bytes_out, 3)
+                if bytes_out else None,
+                "encode_us": comp.get("compress.encode_us", 0),
+                "decode_us": comp.get("compress.decode_us", 0),
+                "bytes_decoded": comp.get("compress.bytes_decoded", 0),
+                "mismatches": comp.get("compress.mismatch", 0),
+                "schemes": {
+                    k.split(".", 2)[2]: v for k, v in sorted(comp.items())
+                    if k.startswith("compress.scheme.")
+                },
+                "seams": {
+                    seam: {
+                        "bytes_in": comp.get(f"compress.{seam}.bytes_in", 0),
+                        "bytes_out": comp.get(f"compress.{seam}.bytes_out", 0),
+                    }
+                    for seam in ("spill", "wire", "checkpoint", "cache")
+                    if f"compress.{seam}.bytes_in" in comp
+                },
+            }
     fallbacks: Dict[str, int] = {}
     spills: Dict[str, int] = {}
     cache = {"hit": 0, "miss": 0}
@@ -530,6 +563,7 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         "integrity": dict(sorted(integrity.items())),
         "integrity_seams": dict(sorted(integrity_seams.items())),
         "result_cache": dict(sorted(result_cache.items())),
+        "compress": compress,
         "spans": spans,
         "span_status": dict(sorted(span_status.items())),
         "stale_reads": stale_reads,

@@ -210,6 +210,17 @@ def report(path: str, *, session: Optional[str] = None,
             seams = "  ".join(
                 f"{sm}={n}" for sm, n in sorted(s["integrity_seams"].items()))
             lines.append(f"  mismatch seams: {seams}")
+    if s.get("compress"):
+        c = s["compress"]
+        lines.append(
+            "compress: in={bi}  out={bo}  ratio={r}  schemes={sch}".format(
+                bi=_fmt_bytes(c["bytes_in"]), bo=_fmt_bytes(c["bytes_out"]),
+                r=c["ratio"] if c["ratio"] is not None else "n/a",
+                sch=" ".join(f"{k}={n}"
+                             for k, n in sorted(c["schemes"].items()))
+                or "none",
+            )
+        )
     if s.get("spans"):
         status = "  ".join(
             f"{st}={n}" for st, n in sorted(s["span_status"].items()))

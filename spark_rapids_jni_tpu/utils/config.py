@@ -199,6 +199,22 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # shared region once and reuse the materialized intermediate. Gated
     # separately because it rewrites plans before execution.
     "cache.subplan_enabled": (True, bool),
+    # Columnar compression (runtime/compress.py): dictionary/RLE re-encode
+    # + bit-packed validity + optional zstd UNDER the integrity seal on
+    # every managed byte path. Off restores byte-for-byte legacy framing
+    # at every seam: raw snapshots, flag-0/1 wire buffers, no codec frames.
+    "compress.enabled": (True, bool),
+    # Per-seam gates (all under compress.enabled): SpillStore host/disk
+    # tiers, DCN wire frames, out-of-core checkpoints, result-cache
+    # entries. Any one off restores that seam's legacy framing alone.
+    "compress.spill": (True, bool),
+    "compress.wire": (True, bool),
+    "compress.checkpoint": (True, bool),
+    "compress.cache": (True, bool),
+    # zstd final-stage level over the winning scheme payload; used only
+    # when the optional zstandard package is importable. <= 0 disables
+    # the final stage (dict/RLE/bitpack still run).
+    "compress.zstd_level": (3, int),
 }
 
 _overrides: dict[str, Any] = {}

@@ -1,9 +1,15 @@
-"""Corruption fuzz harness for the integrity layer (ISSUE 10 satellite).
+"""Corruption fuzz harness for the integrity layer (ISSUE 10 satellite,
+extended with compressed payloads in ISSUE 12).
 
-220 seeded corruption cases across every managed byte boundary — the
+260+ seeded corruption cases across every managed byte boundary — the
 in-memory spill tier, the disk spill tier, the DCN wire, out-of-core
-checkpoints, and untrusted Parquet/ORC ingestion. The single invariant,
-asserted per case:
+checkpoints, the result-cache seam, untrusted Parquet/ORC ingestion,
+and codec frames mutated AFTER a clean seal verification. With
+``compress.enabled`` defaulting on, families 1-4 already corrupt
+codec-compressed payloads (flip/truncate/trailer land on the compressed
+bytes under the seal); families 6-7 add the cache seam and the
+corrupt-after-decompress header cases the trailer cannot catch. The
+single invariant, asserted per case:
 
     every corruption is DETECTED AND CLASSIFIED (``CorruptDataError`` /
     ``MalformedInputError``) or the result is BIT-IDENTICAL to the
@@ -291,6 +297,102 @@ def test_fuzz_ingest_orc(case):
     assert outcome in ("classified", "needs-native")
 
 
+# ---------------------------------------------------------------------------
+# family 6: result-cache seam — 20 seeded corruptions of codec-compressed
+# cached snapshots; detected-and-classified or bit-identical, and the
+# spill store's accounting never leaks
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", range(20))
+def test_fuzz_cache_seam_compressed(case, tmp_path):
+    from spark_rapids_jni_tpu.runtime import compress
+
+    assert compress.seam_enabled("integrity.cache")
+    mode = MODES[case % len(MODES)]
+    seed = 600 + case
+    tbl = _table(seed=seed)
+    # disk on odd cases so all three modes land on both stored tiers
+    store = SpillStore(budget_bytes=_table_nbytes(tbl),
+                       spill_dir=str(tmp_path) if case % 2 else None)
+    script = faults.FaultScript(corruptions=[
+        faults.CorruptionSpec("integrity.cache", mode=mode, seed=seed)])
+    try:
+        with faults.inject(script):
+            h = store.put(tbl, integrity_seam="integrity.cache")
+            store.put(_table(seed=seed + 1000))  # evict h off the device
+        # codec packs store BYTES in the host tier (unlike the legacy
+        # live-ndarray snapshots), so all three modes land on both tiers
+        assert script.fired, f"{mode}/{seed}: corruption window never fired"
+        try:
+            got = store.get(h)
+        except CorruptDataError:
+            assert REGISTRY.counter(
+                "integrity.mismatch.integrity.cache").value >= 1
+        else:  # pragma: no cover - would mean a missed detection
+            assert _bit_identical(got, tbl), \
+                f"{mode}/{seed}: undetected corruption decoded as garbage"
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# family 7: corrupt-after-decompress — 21 seeded codec-frame header
+# mutations sealed AFTER the damage, so the trailer verifies clean and
+# only the codec's own header/per-scheme length checks can classify
+# ---------------------------------------------------------------------------
+
+# header region only (magic/version/scheme + dtype/ndim/shape); byte 6
+# (zstd flag) is excluded — with zstandard absent a set flag raises
+# ModuleNotFoundError (deployment error), deliberately not classified
+_HDR_POSITIONS = tuple(range(0, 6)) + tuple(range(7, 16))
+
+
+def _mutate_frame(frame, seed):
+    rng = np.random.default_rng(seed)
+    kind = seed % 3
+    if kind == 0:  # header bit flip
+        pos = _HDR_POSITIONS[int(rng.integers(0, len(_HDR_POSITIONS)))]
+        return frame[:pos] + bytes([frame[pos] ^ (1 << int(
+            rng.integers(0, 8)))]) + frame[pos + 1:]
+    if kind == 1:  # truncation (anywhere)
+        return frame[:int(rng.integers(1, len(frame)))]
+    pos = _HDR_POSITIONS[int(rng.integers(0, len(_HDR_POSITIONS)))]
+    return frame[:pos] + bytes([frame[pos] ^ 0xFF]) + frame[pos + 1:]
+
+
+@pytest.mark.parametrize("seed", range(700, 721))
+def test_fuzz_corrupt_after_decompress_header(seed):
+    from spark_rapids_jni_tpu.runtime import compress
+
+    rng = np.random.default_rng(seed)
+    arr = np.sort(rng.integers(0, 30, 2048)).astype(np.int32)
+    mutated = _mutate_frame(compress.encode_array(arr), seed)
+    sealed = integrity.seal(mutated)
+    # the seal covers the already-mutated frame: verification is clean
+    assert integrity.verify(sealed, seam="integrity.spill") == mutated
+    try:
+        got = compress.decode_array(mutated)
+    except CorruptDataError:
+        assert REGISTRY.counter("compress.mismatch").value >= 1
+        assert REGISTRY.counter("integrity.mismatch").value >= 1
+    else:
+        assert np.array_equal(got, arr), \
+            f"seed {seed}: undetected codec mutation decoded as garbage"
+
+
+def test_fuzz_corpus_runs_compressed_by_default():
+    """Families 1-4 corrupt codec-compressed payloads: the codec seams
+    default on, so flip/truncate/trailer land on compressed bytes."""
+    from spark_rapids_jni_tpu.runtime import compress
+
+    assert compress.enabled()
+    for seam in ("integrity.spill", "integrity.wire",
+                 "integrity.checkpoint", "integrity.cache"):
+        assert compress.seam_enabled(seam), seam
+
+
 def test_fuzz_corpus_is_at_least_200_cases():
-    """The harness floor pinned: 60 + 40 + 50 + 30 + 40 seeded cases."""
-    assert 60 + 40 + 50 + 30 + 40 >= 200
+    """The harness floor pinned:
+    60 + 40 + 50 + 30 + 40 + 20 + 21 seeded cases."""
+    assert 60 + 40 + 50 + 30 + 40 + 20 + 21 >= 200

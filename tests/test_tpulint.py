@@ -385,8 +385,56 @@ def test_rule_cache_key_fingerprint_scope(tmp_path):
     assert _by_rule(_lint_file(target2), "cache-key-must-fingerprint")
 
 
+def test_rule_compress_inside_seal_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_compress_memory.py"),
+                   "compress-inside-seal")
+    texts = [f.source_line for f in got]
+    assert len(got) == 3, texts
+    assert any("integrity.seal(payload)" in t for t in texts)
+    assert any("write_payload_file" in t for t in texts)
+    assert any("decode_array" in t for t in texts)
+    # verify-then-decode, decode-only and pragma'd twins stay clean
+    src = (FIXTURES / "seeded_compress_memory.py").read_text()
+    clean_at = src[:src.index("def clean_verify_then_decode")].count(
+        "\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_compress_inside_seal_scope(tmp_path):
+    # same constructions outside the reservation scope are out of scope;
+    # the codec's own home (a compress basename) is exempt
+    target = tmp_path / "plain_tool.py"
+    shutil.copy(FIXTURES / "seeded_compress_memory.py", target)
+    assert not _by_rule(_lint_file(target), "compress-inside-seal")
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    target2 = rt / "plain_name.py"
+    shutil.copy(FIXTURES / "seeded_compress_memory.py", target2)
+    assert _by_rule(_lint_file(target2), "compress-inside-seal")
+    target3 = rt / "compress.py"
+    shutil.copy(FIXTURES / "seeded_compress_memory.py", target3)
+    assert not _by_rule(_lint_file(target3), "compress-inside-seal")
+
+
+def test_rule_compress_inside_seal_codec_reference_trusted(tmp_path):
+    # a sealing module that references the codec anywhere is trusted at
+    # module granularity (dcn's send path seals a blob its serializer
+    # already compressed)
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    mod = rt / "memory_like.py"
+    mod.write_text(
+        "from spark_rapids_jni_tpu.runtime import compress\n"
+        "\n"
+        "\n"
+        "def spill(integrity, path, arr):\n"
+        "    blob = integrity.seal(compress.encode_array(arr))\n"
+        "    integrity.write_payload_file(path, blob)\n")
+    assert not _by_rule(_lint_file(mod), "compress-inside-seal")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all sixteen rules demonstrably fire."""
+    """The acceptance invariant: all seventeen rules demonstrably fire."""
     seen = set()
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
@@ -417,6 +465,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_payload_memory.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_resultcache_key.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_compress_memory.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

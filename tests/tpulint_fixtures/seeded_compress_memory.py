@@ -1,0 +1,45 @@
+"""Seeded violations for rule 17 (compress-inside-seal).
+
+The basename contains ``memory`` so the file is in scope the same way
+runtime/ and parallel/ modules are. Violations first, then clean twins
+past the ``def clean_`` marker the per-rule test splits on.
+
+NOTE: this module deliberately never references the ``compress`` codec —
+that absence IS the module-level half of the violation, so a trusted
+(codec-referencing) sealing module is demonstrated inline by the scope
+test instead of here.
+"""
+
+import pickle
+
+
+def sealed_spill_bypasses_codec(integrity, path, snaps):
+    payload = pickle.dumps(snaps)
+    blob = integrity.seal(payload)  # VIOLATION: raw payload, codec bypassed
+    integrity.write_payload_file(path, blob)  # VIOLATION: same bypass
+    return len(blob)
+
+
+def decode_before_verify(integrity, codec, frame, blob):
+    arr = codec.decode_array(frame)  # VIOLATION: decoding unverified bytes
+    payload = integrity.verify(blob, seam="integrity.spill")
+    return arr, payload
+
+
+def clean_verify_then_decode(integrity, codec, frame, blob):
+    # the contract's read order: trailer first, codec second
+    payload = integrity.verify(blob, seam="integrity.spill")
+    arr = codec.decode_array(frame)
+    return arr, payload
+
+
+def clean_decode_without_local_verify(codec, frame):
+    # the caller verified before handing the frame over; decode-only
+    # scopes are fine (ordering is judged within one function)
+    return codec.decode_array(frame)
+
+
+def clean_pragmad_seal(integrity, payload):
+    # control-plane metadata this seam never compresses
+    # tpulint: disable=compress-inside-seal
+    return integrity.seal(payload)

@@ -1204,6 +1204,113 @@ def check_cache_key_fingerprint(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 17: compress-inside-seal
+# ---------------------------------------------------------------------------
+
+_DECODE_CALL_NAMES = {"decode_array", "unpack_array"}
+_VERIFY_CALL_HINT = "verify"
+
+
+def _module_references_compress(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id == "compress":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "compress":
+            return True
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.endswith("compress"):
+                return True
+            if any((a.asname or a.name) == "compress" for a in node.names):
+                return True
+    return False
+
+
+def check_compress_inside_seal(ctx: FileContext) -> List[RawFinding]:
+    """ISSUE-12 bug class: the ordering contract is **compress -> seal**
+    on write and **verify -> decompress** on read — the integrity
+    trailer must be the OUTERMOST wrapper so the crc covers the stored
+    (compressed) bytes and no decode work is spent on bytes that fail
+    verification. Two static halves:
+
+    1. A reservation-scope module (memory/server/degrade/outofcore
+       basenames, ``runtime/``/``parallel/`` packages) that seals
+       payloads (``integrity.seal(...)`` / ``write_payload_file(...)``)
+       without referencing the ``runtime/compress.py`` codec anywhere is
+       bypassing the compression seam: its at-rest bytes are sealed raw
+       and the per-seam ``compress.*`` toggles silently do nothing
+       there. Module granularity keeps pre-compressed pass-through
+       clean (e.g. dcn's send path seals a blob its serializer already
+       compressed — the module references the codec, so it is trusted).
+    2. A function that decompresses a payload (``decode_array`` /
+       ``unpack_array`` / a ``*decompress*``-named callee) at an
+       earlier line than its own verify call (``*verify*`` /
+       ``read_payload_file``-style) is decoding unverified bytes —
+       exactly the wasted-work/garbage-decode order the contract bans.
+
+    The codec, integrity and fault-injection modules (the seams' homes)
+    are exempt."""
+    if not _is_reservation_scope_file(ctx):
+        return []
+    # exact basenames: the seams' homes, where the raw seal/decode IS
+    # the implementation (substring matching would also exempt the
+    # seeded fixture, whose name legitimately contains "compress")
+    if ctx.name in ("integrity.py", "compress.py", "faults.py"):
+        return []
+    out: List[RawFinding] = []
+    # half 1: seal without a codec reference anywhere in the module
+    if not _module_references_compress(ctx.tree):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = None
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in ("seal", "write_payload_file"):
+                    name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                if node.func.id in ("seal", "write_payload_file"):
+                    name = node.func.id
+            if name is None:
+                continue
+            out.append(RawFinding(
+                node.lineno, node.col_offset,
+                f"`{name}(...)` seals a payload in a module that never "
+                f"references the runtime/compress codec: the compress "
+                f"seam is bypassed, at-rest bytes stay raw, and the "
+                f"per-seam compress.* toggles silently do nothing here; "
+                f"route the payload through compress.pack_array/"
+                f"encode_array (or its seam gate) BEFORE sealing"))
+    # half 2: decompress at an earlier line than the same function's
+    # verify — decoding bytes nothing has verified yet
+    for fn in _top_functions(ctx.tree):
+        decode_line = None
+        verify_line = None
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = (node.func.attr if isinstance(node.func, ast.Attribute)
+                      else node.func.id if isinstance(node.func, ast.Name)
+                      else "")
+            if callee in _DECODE_CALL_NAMES or "decompress" in callee:
+                if decode_line is None or node.lineno < decode_line:
+                    decode_line = node.lineno
+            elif (_VERIFY_CALL_HINT in callee
+                  or callee.startswith("read_payload")):
+                if verify_line is None or node.lineno < verify_line:
+                    verify_line = node.lineno
+        if (decode_line is not None and verify_line is not None
+                and decode_line < verify_line):
+            out.append(RawFinding(
+                decode_line, 0,
+                f"decompress at line {decode_line} runs before this "
+                f"function's verify at line {verify_line}: the read "
+                f"contract is verify -> decompress -> post-decode check "
+                f"(the trailer covers the compressed bytes; decoding "
+                f"first spends work on — and can crash on — bytes "
+                f"verification would have rejected)"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -1273,4 +1380,10 @@ RULES = [
          "fingerprint half; signature-only keying serves stale results "
          "the moment the bound data changes",
          check_cache_key_fingerprint),
+    Rule("compress-inside-seal",
+         "sealed payloads in runtime/parallel scope must route through "
+         "the runtime/compress codec seam before integrity.seal, and "
+         "reads must verify before they decompress (the trailer covers "
+         "the compressed bytes)",
+         check_compress_inside_seal),
 ]
