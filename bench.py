@@ -1179,6 +1179,140 @@ def _compress_block() -> dict:
     return block
 
 
+def _fleet_block() -> dict:
+    """The BENCH_*.json ``fleet`` block: the fault-tolerant serving
+    fleet story (runtime/fleet.py). Two questions: what does replication
+    buy (closed-loop queries/s at 1, 2 and 4 replicas, same probe-sized
+    warm q1 the server block uses — supervisor memo and worker result
+    cache pinned OFF so every query really executes), and what does a
+    replica death cost (kill-mid-query recovery latency: a query is held
+    in flight on its replica, the replica is SIGKILLed, and the clock
+    runs from the kill to the bit-identical failed-over result — p50 and
+    max over several kills, minus the configured serve-hold so the
+    number is pure detection + re-dispatch + re-execute). Leaked bytes
+    after the chaos round must be zero."""
+    block: dict = {}
+    try:
+        import os as _os
+        import signal as _signal
+        import threading as _threading
+
+        from spark_rapids_jni_tpu.models import tpch
+        from spark_rapids_jni_tpu.runtime import fleet as _fleet
+        from spark_rapids_jni_tpu.runtime import fusion as _fusion
+        from spark_rapids_jni_tpu.runtime import resultcache as _rc
+        from spark_rapids_jni_tpu.utils.config import (
+            reset_option, set_option)
+
+        rows = 1 << 12
+        plan = tpch._q1_plan()
+        bindings = {"lineitem": tpch.lineitem_table(rows, seed=3)}
+        ref_fp = _rc.table_fingerprint(_fusion.execute(plan, bindings).table)
+        per_client = 3
+        clients = 4
+        # memo + worker result cache off: this block measures the fleet's
+        # dispatch/transport/supervision path, not cache hits
+        set_option("fleet.result_memo_entries", 0)
+        set_option("fleet.heartbeat_interval_s", 0.1)
+        set_option("fleet.restart_backoff_s", 0.1)
+        no_cache = {"SPARK_RAPIDS_TPU_CACHE_ENABLED": "0"}
+        try:
+            for n_replicas in (1, 2, 4):
+                with _fleet.QueryFleet(n_replicas,
+                                       worker_env=no_cache) as fl:
+                    if fl.wait_live(timeout=120) < n_replicas:
+                        continue
+                    # pay every replica's compile outside the clock
+                    for t in [fl.submit(f"warm{i}", plan, bindings)
+                              for i in range(n_replicas)]:
+                        t.result(timeout=300)
+                    done: list = []
+
+                    def _client(i):
+                        for _ in range(per_client):
+                            t = fl.submit(f"bench_c{i}", plan, bindings)
+                            t.result(timeout=300)
+                            done.append(t)
+
+                    threads = [_threading.Thread(target=_client, args=(i,))
+                               for i in range(clients)]
+                    t0 = time.perf_counter()
+                    for th in threads:
+                        th.start()
+                    for th in threads:
+                        th.join()
+                    wall = time.perf_counter() - t0
+                    block[f"replicas_{n_replicas}"] = {
+                        "queries": len(done),
+                        "queries_per_s": round(len(done) / wall, 2)
+                        if wall else None,
+                    }
+
+            # failover recovery: hold a query in flight on its replica
+            # (deterministic serve delay), SIGKILL that replica, and time
+            # kill -> bit-identical result on the survivor. The survivor
+            # has no hold, so recovery = detection + re-dispatch +
+            # re-execution.
+            hold_ms = 2000.0
+            recoveries = []
+            with _fleet.QueryFleet(2, worker_env=no_cache,
+                                   per_replica_env={"r0": {
+                                       _fleet._ENV_SERVE_DELAY:
+                                           str(hold_ms)}}) as fl:
+                if fl.wait_live(timeout=120) == 2:
+                    # warm BOTH replicas' executable caches off the clock
+                    # (two concurrent submits: the second places on the
+                    # replica the first already loaded)
+                    for t in [fl.submit(f"warm{i}", plan, bindings)
+                              for i in range(2)]:
+                        t.result(timeout=300)
+                    kills = 3
+                    for k in range(kills):
+                        r0 = fl._find("r0")
+                        if not r0.live_evt.wait(60):
+                            break
+                        tk = fl.submit("chaos", plan, bindings)
+                        # wait until the query lands on r0 (idle replicas
+                        # tie-break to r0) and is inside its serve hold
+                        deadline = time.monotonic() + 10
+                        while (time.monotonic() < deadline
+                               and tk.replica != "r0"):
+                            time.sleep(0.01)
+                        time.sleep(0.2)
+                        t0 = time.perf_counter()
+                        _os.kill(r0.proc.pid, _signal.SIGKILL)
+                        res = tk.result(timeout=300)
+                        if _rc.table_fingerprint(res.table) != ref_fp:
+                            block["failover_identity"] = "MISMATCH"
+                            break
+                        recoveries.append(time.perf_counter() - t0)
+                    time.sleep(0.3)  # one heartbeat for a fresh leak report
+                    block["leaked_bytes_after_chaos"] = fl.leaked_bytes()
+            if recoveries:
+                recoveries.sort()
+                block["failover_kills"] = len(recoveries)
+                block["failover_recovery_ms_p50"] = round(
+                    recoveries[len(recoveries) // 2] * 1e3, 1)
+                block["failover_recovery_ms_max"] = round(
+                    recoveries[-1] * 1e3, 1)
+                block.setdefault("failover_identity", "bit-identical")
+            block["note"] = (
+                "queries/s: closed-loop warm q1, supervisor memo and "
+                "worker result cache off (transport+supervision path, "
+                "not cache hits). failover_recovery_ms: SIGKILL of the "
+                "serving replica mid-query to bit-identical failed-over "
+                "result on the survivor (detection + re-dispatch + "
+                "re-execute; the victim's serve-hold is not part of the "
+                "clock)")
+        finally:
+            reset_option("fleet.result_memo_entries")
+            reset_option("fleet.heartbeat_interval_s")
+            reset_option("fleet.restart_backoff_s")
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    return block
+
+
 def _ledger_last(metric: str, n: int):
     """Most recent ledger record for ``metric`` under the current
     measurement tag — preferring an exact row-count match (throughput is
@@ -2054,7 +2188,8 @@ def _child_main(config: str, n: int, iters: int) -> None:
                       "cache": _cache_block(),
                       "degrade": _degrade_block(),
                       "integrity": _integrity_block(),
-                      "compress": _compress_block()}))
+                      "compress": _compress_block(),
+                      "fleet": _fleet_block()}))
 
 
 # ---------------------------------------------------------------------------
@@ -2096,11 +2231,12 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
     """Run the bench in a subprocess; returns (value | None, diagnostic,
     dispatch block | None, pipeline block | None, fusion block | None,
     server block | None, cache block | None, degrade block | None,
-    integrity block | None, compress block | None) — the blocks come
-    from the measured child process's executable cache, overlap probe,
-    whole-stage fusion probe, serving-concurrency probe, result-cache
-    probe, memory-pressure degradation probe, and the integrity /
-    columnar-codec seam probes."""
+    integrity block | None, compress block | None, fleet block | None)
+    — the blocks come from the measured child process's executable
+    cache, overlap probe, whole-stage fusion probe, serving-concurrency
+    probe, result-cache probe, memory-pressure degradation probe, the
+    integrity / columnar-codec seam probes, and the replicated-serving
+    fleet probe."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CONFIG"] = config
@@ -2118,7 +2254,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         )
     except subprocess.TimeoutExpired:
         return (None, f"{platform} bench timed out after {timeout_s:.0f}s",
-                None, None, None, None, None, None, None, None)
+                None, None, None, None, None, None, None, None, None)
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
@@ -2133,6 +2269,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         deg = rec.get("degrade") if isinstance(rec, dict) else None
         integ = rec.get("integrity") if isinstance(rec, dict) else None
         comp = rec.get("compress") if isinstance(rec, dict) else None
+        flt = rec.get("fleet") if isinstance(rec, dict) else None
         return (value, "", disp if isinstance(disp, dict) else None,
                 pipe if isinstance(pipe, dict) else None,
                 fus if isinstance(fus, dict) else None,
@@ -2140,9 +2277,10 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
                 cache if isinstance(cache, dict) else None,
                 deg if isinstance(deg, dict) else None,
                 integ if isinstance(integ, dict) else None,
-                comp if isinstance(comp, dict) else None)
+                comp if isinstance(comp, dict) else None,
+                flt if isinstance(flt, dict) else None)
     return (None, f"{platform} bench failed: {_tail(out)}",
-            None, None, None, None, None, None, None, None)
+            None, None, None, None, None, None, None, None, None)
 
 
 def main() -> None:
@@ -2167,6 +2305,7 @@ def main() -> None:
     child_deg = None
     child_integ = None
     child_comp = None
+    child_fleet = None
     # every run gets a telemetry file (children record through the package
     # via these env vars; the parent appends bench_stale events itself) —
     # restored afterwards so driving code / tests see their own env back
@@ -2206,7 +2345,7 @@ def main() -> None:
             if ok:
                 (value, why, child_disp, child_pipe, child_fus,
                  child_srv, child_cache, child_deg,
-                 child_integ, child_comp) = _run_child(
+                 child_integ, child_comp, child_fleet) = _run_child(
                     config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
@@ -2253,14 +2392,14 @@ def main() -> None:
                 # instead of shipping empty blocks
                 (_pv, _pwhy, child_disp, child_pipe, child_fus,
                  child_srv, child_cache, child_deg,
-                 child_integ, child_comp) = _run_child(
+                 child_integ, child_comp, child_fleet) = _run_child(
                     config, n, iters, "cpu", child_timeout)
                 if _pv is None and _pwhy:
                     diagnostics.append(f"probe child: {_pwhy}")
         if value is None:
             (value, why, child_disp, child_pipe, child_fus,
              child_srv, child_cache, child_deg,
-             child_integ, child_comp) = _run_child(
+             child_integ, child_comp, child_fleet) = _run_child(
                 config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
@@ -2329,6 +2468,11 @@ def main() -> None:
     # on-vs-off out-of-core q1 wall), same child-process provenance;
     # empty when no live child ran
     record["compress"] = child_comp or {}
+    # replicated-serving fleet probe (closed-loop queries/s at 1/2/4
+    # replicas, SIGKILL-mid-query failover recovery latency, post-chaos
+    # leak check), same child-process provenance; empty when no live
+    # child ran
+    record["fleet"] = child_fleet or {}
     if diagnostics:
         record["diagnostic"] = "; ".join(d for d in diagnostics if d)
     print(json.dumps(record))

@@ -1,13 +1,14 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its seventeen invariant rules (host/device
+# tpulint (tools/tpulint) runs its eighteen invariant rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
 # error-must-classify, server-telemetry-session-id,
 # reservation-release-in-finally, span-must-scope, payload-must-verify,
-# cache-key-must-fingerprint, compress-inside-seal)
+# cache-key-must-fingerprint, compress-inside-seal,
+# worker-exit-must-classify)
 # over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
 # glob below covers the telemetry/ package alongside every other
 # subpackage.
@@ -590,4 +591,65 @@ assert REGISTRY.counter("integrity.mismatch.integrity.spill").value >= 1
 store2.close()
 print(f"compression smoke OK: spill ratio {ratio:.2f}x, wire ratio "
       f"{wire_ratio:.2f}x, both bit-identical, corruption classified")
+EOF
+
+# fleet smoke: rule 18 only proves supervision code CLASSIFIES worker
+# exits — this proves the fleet itself still honors its contract: two
+# replicas boot, a query held mid-flight on its replica survives that
+# replica's SIGKILL by failing over to the survivor with a bit-identical
+# result, the death is classified (signal shape, replica tagged), the
+# victim restarts, and zero reservation bytes leak anywhere.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import signal
+import time
+
+import numpy as np
+
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.runtime import fleet, fusion, resultcache
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+plan = tpch._q1_plan()
+bindings = {"lineitem": tpch.lineitem_table(300)}
+ref_fp = resultcache.table_fingerprint(fusion.execute(plan, bindings).table)
+
+set_option("fleet.heartbeat_interval_s", 0.1)
+set_option("fleet.restart_backoff_s", 0.1)
+try:
+    with fleet.QueryFleet(2, per_replica_env={
+            "r0": {"SPARK_RAPIDS_TPU_FLEET_TEST_SERVE_DELAY_MS": "3000"}},
+            ) as f:
+        assert f.wait_live(timeout=120) == 2, "fleet never reached 2 live"
+        ticket = f.submit("smoke", plan, bindings)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and ticket.replica != "r0":
+            time.sleep(0.01)
+        assert ticket.replica == "r0", ticket.replica
+        time.sleep(0.2)  # inside r0's serve hold
+        os.kill(f._find("r0").proc.pid, signal.SIGKILL)
+        res = ticket.result(timeout=120)
+        assert ticket.status == "served", ticket.status
+        assert ticket.dispatches == 2, ticket.dispatches
+        assert ticket.replica == "r1", ticket.replica
+        got_fp = resultcache.table_fingerprint(res.table)
+        assert got_fp == ref_fp, "failed-over result diverged"
+        deaths = REGISTRY.counter("fleet.replica_deaths.r0").value
+        assert deaths == 1, f"expected 1 classified death, got {deaths}"
+        # the victim restarts (no quarantine for a single crash)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if f._find("r0").state == "live":
+                break
+            time.sleep(0.1)
+        assert f._find("r0").state == "live", f._find("r0").state
+        time.sleep(0.3)  # one heartbeat for fresh leak reports
+        leaked = f.leaked_bytes()
+        assert leaked == 0, f"leaked {leaked} reserved bytes"
+finally:
+    reset_option("fleet.heartbeat_interval_s")
+    reset_option("fleet.restart_backoff_s")
+print("fleet smoke OK: SIGKILL mid-query failed over bit-identical, "
+      "death classified, victim restarted, 0 leaked bytes")
 EOF

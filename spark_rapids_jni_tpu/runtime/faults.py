@@ -81,6 +81,15 @@ SEAMS: Tuple[str, ...] = (
     # ride the SpillStore tiers, so this seam corrupts a cached payload the
     # same way integrity.spill corrupts a live query's spilled working set.
     "integrity.cache",
+    # serving fleet (runtime/fleet.py): supervisor -> replica dispatch of a
+    # framed submit, the liveness ping loop, and worker exit-status reaping.
+    # An injected raise at fleet.dispatch is a failed send (the replica is
+    # treated as dead and the query fails over); at fleet.heartbeat it is a
+    # missed liveness deadline; at fleet.worker_exit it drills the reap path
+    # (rule 18: must route through the resilience taxonomy).
+    "fleet.dispatch",
+    "fleet.heartbeat",
+    "fleet.worker_exit",
 )
 
 _SEAM_SET = frozenset(SEAMS)

@@ -56,11 +56,13 @@ __all__ = [
     "MalformedInputError",
     "FatalExecutionError",
     "QueryCancelled",
+    "ReplicaDeadError",
     "CancelToken",
     "Policy",
     "policy",
     "enabled",
     "classify",
+    "classify_worker_exit",
     "is_transient",
     "retrying",
     "retry_or_none",
@@ -167,6 +169,24 @@ class QueryCancelled(ResilienceError):
     transient = False
 
 
+class ReplicaDeadError(ResilienceError):
+    """A serving-fleet replica subprocess died — missed its liveness
+    deadline, exited nonzero, was killed by a signal, or dropped its
+    control socket mid-frame.
+
+    Not transient in the blind-replay sense: the process is gone and
+    pinging it again reproduces the silence deterministically. The
+    recovery is structural and lives at exactly one seam —
+    ``fleet.dispatch`` — where the supervisor re-dispatches the dead
+    replica's in-flight queries to a healthy replica under the bounded
+    failover budget (:func:`is_transient` special-cases that seam the
+    same way transport seams drive a corrupt-frame refetch). Everywhere
+    else (heartbeat loop, exit reaping) it propagates classified so the
+    caller restarts or quarantines instead of retrying into a corpse."""
+
+    transient = False
+
+
 class CancelToken:
     """Cooperative cancellation + wall-clock deadline for one query.
 
@@ -237,6 +257,10 @@ class CancelToken:
 # Message markers XLA/jaxlib use for genuinely transient device conditions.
 _TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "DEADLINE_EXCEEDED")
 _TRANSPORT_SEAMS = ("shuffle.transport", "dcn.transport")
+# Fleet control-plane seams: socket-layer failures here mean the *replica*
+# is gone (the socketpair peer is a child process, not a network), so they
+# classify as ReplicaDeadError rather than TransportError.
+_FLEET_SEAMS = ("fleet.dispatch", "fleet.heartbeat", "fleet.worker_exit")
 
 
 def classify(exc: BaseException, *, seam: str = "") -> type:
@@ -257,6 +281,11 @@ def classify(exc: BaseException, *, seam: str = "") -> type:
         return ResourceExhausted
     if seam in _TRANSPORT_SEAMS and isinstance(exc, (ConnectionError, TimeoutError, OSError)):
         return TransportError
+    if seam in _FLEET_SEAMS and isinstance(exc, (EOFError, ConnectionError, TimeoutError, OSError)):
+        # Broken pipe / EOF / timeout on a replica's control socketpair:
+        # the peer is a supervised child process, so socket death *is*
+        # replica death — not a retriable transport blip.
+        return ReplicaDeadError
     msg = str(exc)
     if any(marker in msg for marker in _TRANSIENT_MARKERS):
         return TransientDeviceError
@@ -276,11 +305,60 @@ def is_transient(exc: BaseException, *, seam: str = "") -> bool:
         # corrupt frame is refetchable; at rest the bytes are simply gone
         # and re-reading them reproduces the mismatch deterministically.
         return seam in _TRANSPORT_SEAMS
+    if isinstance(exc, ReplicaDeadError):
+        # Only the dispatch seam can recover from a dead replica — by
+        # re-placing the query on a *different* replica under the bounded
+        # failover budget. Heartbeat and reap paths must not retry into
+        # the corpse.
+        return seam == "fleet.dispatch"
     if isinstance(exc, ResilienceError):
         return exc.transient
     if seam in _TRANSPORT_SEAMS and isinstance(exc, (ConnectionError, TimeoutError)):
         return True
     return False
+
+
+def classify_worker_exit(
+    returncode: Optional[int],
+    *,
+    replica: str = "",
+    seam: str = "fleet.worker_exit",
+    **context: Any,
+) -> ReplicaDeadError:
+    """Turn a reaped worker exit status into a classified taxonomy error.
+
+    Maps the three subprocess death shapes into :class:`ReplicaDeadError`
+    with cause context instead of letting a raw exit code (or a raw
+    ``OSError``/``EOFError`` from the control socket) escape unlabeled:
+
+    - negative ``returncode`` — killed by a signal (``-9`` -> ``SIGKILL``);
+    - positive ``returncode`` — exited nonzero;
+    - ``None`` — still officially running yet unresponsive (missed its
+      liveness deadline or dropped the control socket mid-frame).
+
+    Returns the exception (callers raise it, record it, or attach it to an
+    in-flight query's failover) — construction never raises.
+    """
+    if returncode is None:
+        cause = "unresponsive"
+    elif returncode < 0:
+        try:
+            import signal as _signal
+
+            cause = f"signal:{_signal.Signals(-int(returncode)).name}"
+        except (ValueError, ImportError):
+            cause = f"signal:{-int(returncode)}"
+    elif returncode != 0:
+        cause = f"exit:{int(returncode)}"
+    else:
+        cause = "exit:0"
+    ctx = dict(context)
+    if replica:
+        ctx["replica"] = replica
+    return ReplicaDeadError(
+        f"replica worker died ({cause})",
+        cause=cause, seam=seam,
+        returncode=-1 if returncode is None else int(returncode), **ctx)
 
 
 # --------------------------------------------------------------------------

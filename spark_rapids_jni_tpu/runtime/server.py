@@ -68,6 +68,11 @@ from __future__ import annotations
 import collections
 import os
 import threading
+
+try:  # POSIX advisory locks for the shared learned-estimate file
+    import fcntl
+except ImportError:  # non-POSIX: merge-on-load still runs, unlocked
+    fcntl = None  # type: ignore[assignment]
 import time
 import weakref
 from typing import Any, Callable, Optional
@@ -299,6 +304,7 @@ class QueryServer:
         self._inflight_lock = threading.Lock()
         self._stop = threading.Event()
         self._closed = False
+        self._draining = False
         _LIVE_SERVERS.add(self)
         self._workers = [
             threading.Thread(target=self._worker, daemon=True,
@@ -379,6 +385,9 @@ class QueryServer:
             if self._closed:
                 reject_why = "server closed"
                 retry_after: Optional[float] = None
+            elif self._draining:
+                reject_why = "server draining"
+                retry_after = None
             elif len(self._queues[sid]) >= self.queue_depth:
                 reject_why = (f"session queue full "
                               f"({self.queue_depth} deep)")
@@ -419,6 +428,42 @@ class QueryServer:
         # drop cached entries and release their limiter charges before
         # anyone inspects the limiter for leaks
         self.result_cache.close()
+        self._save_learned()
+
+    def drain(self, timeout: Optional[float] = 30.0) -> dict:
+        """Graceful drain: stop admitting (new submits reject with
+        "server draining"), let every queued and in-flight query finish,
+        then flush learned estimates to the shared state file. The
+        server object stays alive — the fleet supervisor drains a
+        replica before recycling it so a warm restart (shared JAX
+        persistent compile cache + merged learned estimates) loses no
+        state. Returns ``{"drained": bool, "inflight": n, "queued": n}``
+        — ``drained=False`` means the timeout expired with work still
+        running (the caller decides whether to wait more or kill)."""
+        with self._cond:
+            self._draining = True
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+        while True:
+            with self._cond:
+                queued = sum(len(q) for q in self._queues.values())
+            with self._inflight_lock:
+                inflight = len(self._inflight)
+            if queued == 0 and inflight == 0:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.01)
+        self.flush_learned()
+        record_server("server", "drained", session="_fleet",
+                      inflight=inflight, queued=queued)
+        return {"drained": queued == 0 and inflight == 0,
+                "inflight": inflight, "queued": queued}
+
+    def flush_learned(self) -> None:
+        """Force-persist learned estimates now, ignoring the debounce
+        interval (drain/recycle hook: the successor replica warm-starts
+        off this file)."""
         self._save_learned()
 
     def __enter__(self) -> "QueryServer":
@@ -568,9 +613,9 @@ class QueryServer:
             return os.path.join(cache_dir, "learned_estimates.json")
         return ""
 
-    def _load_learned(self) -> None:
-        if not self._estimate_path:
-            return
+    def _read_learned_file(self) -> Optional[dict]:
+        """Read + sanitize the shared estimate file. ``None`` = nothing
+        usable (absent, or corrupt — counted and discarded)."""
         state, corrupt = load_json(self._estimate_path)
         if corrupt is not None:
             # a crash mid-write can't produce this (atomic replace), but
@@ -579,13 +624,38 @@ class QueryServer:
             record_degrade("server.learned_estimates", "state_discarded",
                            tier="persistent", trigger="corrupt", rung=0,
                            path=self._estimate_path, reason=corrupt)
+            return None
+        if not isinstance(state, dict):
+            return None
+        return {
+            str(k): float(v) for k, v in state.items()
+            if isinstance(v, (int, float)) and float(v) > 0
+        }
+
+    @staticmethod
+    def _merge_learned(mine: dict, disk: dict) -> dict:
+        """Per-signature EMA-combine of two estimate maps: a signature
+        known to only one side transfers verbatim; one known to both
+        blends 50/50 (each side's value is already an EMA of its own
+        measurements, so the blend is a fair co-estimate, and repeated
+        merge cycles converge instead of oscillating)."""
+        merged = dict(disk)
+        for sig, mine_v in mine.items():
+            disk_v = merged.get(sig)
+            merged[sig] = float(mine_v) if disk_v is None \
+                else 0.5 * float(mine_v) + 0.5 * float(disk_v)
+        return merged
+
+    def _load_learned(self) -> None:
+        if not self._estimate_path:
             return
-        if isinstance(state, dict):
-            with self._learned_lock:
-                self._learned = {
-                    str(k): float(v) for k, v in state.items()
-                    if isinstance(v, (int, float)) and float(v) > 0
-                }
+        disk = self._read_learned_file()
+        if disk is None:
+            return
+        with self._learned_lock:
+            # merge, don't replace: N replicas share one state file, and
+            # a reload must never discard what this process has measured
+            self._learned = self._merge_learned(self._learned, disk)
 
     def _save_learned(self) -> None:
         if not self._estimate_path:
@@ -596,8 +666,21 @@ class QueryServer:
             snapshot = dict(self._learned)
             self._learned_dirty = False
         self._last_save = time.monotonic()
+        # N replica processes debounce-write this file concurrently; a
+        # bare tmp+replace is last-writer-wins and clobbers every other
+        # replica's learning. Serialize writers with an fcntl lock on a
+        # sidecar (the data file itself is replaced, so locking it would
+        # lock a dead inode) and merge-on-load inside the critical
+        # section: read what the last writer left, EMA-combine per
+        # signature, then atomically replace.
+        lock_fh = None
         try:
-            atomic_write_json(self._estimate_path, snapshot)
+            if fcntl is not None:
+                lock_fh = open(self._estimate_path + ".lock", "a")
+                fcntl.flock(lock_fh.fileno(), fcntl.LOCK_EX)
+            disk = self._read_learned_file()
+            merged = self._merge_learned(snapshot, disk or {})
+            atomic_write_json(self._estimate_path, merged)
         except OSError as exc:
             # warm-start state is an optimization; losing a write only
             # costs the next process a cold estimate, never a query —
@@ -607,6 +690,19 @@ class QueryServer:
             REGISTRY.counter("server.estimate_state_write_error").inc()
             _log.warning("could not persist learned estimates to %s: %s",
                          self._estimate_path, exc)
+        else:
+            with self._learned_lock:
+                # adopt signatures sibling replicas learned (disk-only
+                # keys) so this replica's admission warms too; our own
+                # EMAs keep their in-memory values
+                for sig, v in merged.items():
+                    self._learned.setdefault(sig, float(v))
+        finally:
+            if lock_fh is not None:
+                try:
+                    fcntl.flock(lock_fh.fileno(), fcntl.LOCK_UN)
+                finally:
+                    lock_fh.close()
 
     @staticmethod
     def _plan_signature(plan: fusion.Plan, bindings: dict) -> str:

@@ -35,6 +35,7 @@ from spark_rapids_jni_tpu.telemetry.events import (
     record_degrade,
     record_dispatch,
     record_fallback,
+    record_fleet,
     record_integrity,
     record_resilience,
     record_server,
@@ -68,6 +69,7 @@ __all__ = [
     "record_degrade",
     "record_dispatch",
     "record_fallback",
+    "record_fleet",
     "record_integrity",
     "record_resilience",
     "record_server",

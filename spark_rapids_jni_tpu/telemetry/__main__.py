@@ -8,7 +8,9 @@
   https://ui.perfetto.dev). With one argument the input defaults to the
   configured ``telemetry.path``.
 - ``top [<snapshot.json>]`` — render in-flight queries: from a saved
-  ``QueryServer.inspect()`` snapshot, or live from this process.
+  ``QueryServer.inspect()`` snapshot, or live from this process. Saved
+  or live ``QueryFleet.inspect()`` snapshots (self-identified by
+  ``"fleet": true``) render as the per-replica fleet table.
 """
 
 from __future__ import annotations
@@ -103,9 +105,22 @@ def _top(argv: list[str]) -> int:
         except (OSError, json.JSONDecodeError) as exc:
             print(f"error: {exc}", file=sys.stderr)
             return 1
-    else:
-        snapshots = top.collect()
-    print(top.render_top(snapshots))
+        # a QueryFleet.inspect() snapshot self-identifies ("fleet": True)
+        # so saved fleet state renders through the fleet view
+        items = snapshots if isinstance(snapshots, list) else [snapshots]
+        fleets = [s for s in items if isinstance(s, dict) and s.get("fleet")]
+        servers = [s for s in items if s not in fleets]
+        out = []
+        if servers or not fleets:
+            out.append(top.render_top(servers))
+        if fleets:
+            out.append("fleet:\n" + top.render_fleet(fleets))
+        print("\n\n".join(out))
+        return 0
+    print(top.render_top(top.collect()))
+    fleets = top.collect_fleet()
+    if fleets:
+        print("\nfleet:\n" + top.render_fleet(fleets))
     return 0
 
 

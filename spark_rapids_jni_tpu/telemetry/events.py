@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import collections
 import json
+import os
 import sys
 import threading
 import time
@@ -55,6 +56,7 @@ __all__ = [
     "record_degrade",
     "record_integrity",
     "record_cache",
+    "record_fleet",
     "session_scope",
     "current_session",
     "events",
@@ -120,20 +122,39 @@ def _platform() -> str:
         return "unknown"
 
 
+def _replica() -> str:
+    """The replica identity this process stamps onto every record/span
+    (fleet workers get it via SPARK_RAPIDS_TPU_TELEMETRY_REPLICA in
+    their environment); "" = unstamped single-process operation."""
+    return str(get_option("telemetry.replica") or "")
+
+
 def _emit(rec: Dict[str, Any]) -> Dict[str, Any]:
     rec.setdefault("ts", time.time())
     rec.setdefault("platform", _platform())
     sid = current_session()
     if sid is not None:
         rec.setdefault("session", sid)
+    rid = _replica()
+    if rid:
+        rec.setdefault("replica", rid)
     with _ring_lock:
         _ring.append(rec)
     REGISTRY.counter("events_total").inc()
     path = get_option("telemetry.path")
     if path:
+        # N fleet replicas share one JSONL path: each record must land as
+        # ONE O_APPEND os.write so a reader (report/trace) can never see
+        # two processes' lines torn into each other. Buffered file-object
+        # writes flush in arbitrary chunks; a single write(2) of a line
+        # that fits a pipe/page is atomic on POSIX.
+        line = (json.dumps(rec, sort_keys=True) + "\n").encode("utf-8")
         try:
-            with open(path, "a", encoding="utf-8") as fh:
-                fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
         except OSError:
             # telemetry must never take the workload down with it
             REGISTRY.counter("dropped_writes").inc()
@@ -417,6 +438,41 @@ def record_cache(
     return True
 
 
+def record_fleet(
+    op: str,
+    event: str,
+    *,
+    replica: str,
+    **extra: Any,
+) -> bool:
+    """A serving-fleet supervision event (runtime/fleet.py).
+
+    ``event`` is one of ``boot`` / ``live`` / ``dispatch`` / ``served`` /
+    ``replica_death`` / ``failover`` / ``duplicate_drop`` / ``memo_hit``
+    / ``restart`` / ``quarantine`` / ``drain`` / ``identity_mismatch``.
+    ``replica`` names the replica the event is about and is mandatory
+    even when telemetry is off — an unattributable fleet event is a bug,
+    the same contract record_server enforces for sessions (tpulint rule
+    18 enforces the classification half on worker-exit reaping sites).
+    """
+    if not replica or not str(replica).strip():
+        raise ValueError(f"record_fleet({op!r}): replica must be non-empty")
+    if "kind" in extra or "op" in extra:
+        raise ValueError(
+            f"record_fleet({op!r}): 'kind'/'op' are reserved record "
+            "fields; pass caller context under other names")
+    if not enabled():
+        return False
+    rec = _base("fleet", op, None, None, extra)
+    rec["event"] = str(event)
+    rec["replica"] = str(replica)
+    # no counter side effects here: the fleet supervisor owns the
+    # ``fleet.*`` counters and counts unconditionally (supervision
+    # accounting must hold even with telemetry off, like admission's)
+    _emit(rec)
+    return True
+
+
 def record_bench_stale(
     metric: str,
     *,
@@ -502,6 +558,8 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
     integrity: Dict[str, int] = {}
     integrity_seams: Dict[str, int] = {}
     result_cache: Dict[str, int] = {}
+    fleet: Dict[str, int] = {}
+    replicas: set = set()
     stale_reads = 0
     dispatches = 0
     spill_bytes = 0
@@ -509,6 +567,8 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
     span_status: Dict[str, int] = {}
     for r in recs:
         kind = r.get("kind")
+        if r.get("replica"):
+            replicas.add(str(r["replica"]))
         if kind == "span":
             spans += 1
             st = str(r.get("status", "?"))
@@ -535,6 +595,9 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         elif kind == "cache":
             ev = str(r.get("event", "?"))
             result_cache[ev] = result_cache.get(ev, 0) + 1
+        elif kind == "fleet":
+            ev = str(r.get("event", "?"))
+            fleet[ev] = fleet.get(ev, 0) + 1
         elif kind == "fallback":
             op = str(r.get("op", "?"))
             fallbacks[op] = fallbacks.get(op, 0) + 1
@@ -563,6 +626,8 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         "integrity": dict(sorted(integrity.items())),
         "integrity_seams": dict(sorted(integrity_seams.items())),
         "result_cache": dict(sorted(result_cache.items())),
+        "fleet": dict(sorted(fleet.items())),
+        "replicas": sorted(replicas),
         "compress": compress,
         "spans": spans,
         "span_status": dict(sorted(span_status.items())),

@@ -27,7 +27,7 @@ from __future__ import annotations
 import sys
 from typing import Any, Dict, List, Optional
 
-__all__ = ["collect", "render_top"]
+__all__ = ["collect", "collect_fleet", "render_top", "render_fleet"]
 
 
 def collect() -> List[Dict[str, Any]]:
@@ -39,6 +39,16 @@ def collect() -> List[Dict[str, Any]]:
     if mod is None:
         return []
     return [srv.inspect() for srv in mod.live_servers()]
+
+
+def collect_fleet() -> List[Dict[str, Any]]:
+    """Snapshot every open QueryFleet supervisor in this process (may be
+    []). Same ``sys.modules`` posture as :func:`collect` — no fleet
+    module loaded means no fleets."""
+    mod = sys.modules.get("spark_rapids_jni_tpu.runtime.fleet")
+    if mod is None:
+        return []
+    return [f.inspect() for f in mod.live_fleets()]
 
 
 def _fmt_bytes(n: Optional[int]) -> str:
@@ -101,6 +111,66 @@ def _render_one(snap: Dict[str, Any]) -> List[str]:
         lines.append("  ".join(c.ljust(widths[i])
                                for i, c in enumerate(r)).rstrip())
     return lines
+
+
+def _render_fleet_one(snap: Dict[str, Any]) -> List[str]:
+    lines: List[str] = []
+    counters = snap.get("counters") or {}
+    lines.append(
+        f"pending={snap.get('pending_queries', 0)}  "
+        f"memo={snap.get('memo_entries', 0)}  "
+        f"learned={snap.get('learned_signatures', 0)}  "
+        f"served={counters.get('fleet.served', 0)}  "
+        f"failovers={counters.get('fleet.failovers', 0)}  "
+        f"deaths={counters.get('fleet.replica_deaths', 0)}  "
+        f"quarantines={counters.get('fleet.quarantines', 0)}")
+    headers = ("replica", "state", "pid", "gen", "inflight", "served",
+               "crashes", "pong_age_s", "restart_in_s", "queued", "leaked")
+    rows = []
+    for r in snap.get("replicas") or []:
+        pong = r.get("last_pong_age_s")
+        restart = r.get("restart_in_s")
+        load = r.get("load") or {}
+        rows.append((
+            str(r.get("replica", "?")),
+            str(r.get("state", "?")),
+            str(r.get("pid") or "-"),
+            str(r.get("generation", "-")),
+            str(r.get("inflight", 0)),
+            str(r.get("served", 0)),
+            str(r.get("crashes", 0)),
+            "-" if pong is None else f"{pong:.2f}",
+            "-" if restart is None else f"{restart:.2f}",
+            str(load.get("queued", "-")),
+            _fmt_bytes(load.get("leaked")) if "leaked" in load else "-",
+        ))
+    if not rows:
+        lines.append("(no replicas)")
+        return lines
+    widths = [max(len(headers[i]), max(len(r[i]) for r in rows))
+              for i in range(len(headers))]
+    lines.append("  ".join(h.ljust(widths[i])
+                           for i, h in enumerate(headers)).rstrip())
+    lines.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        lines.append("  ".join(c.ljust(widths[i])
+                               for i, c in enumerate(r)).rstrip())
+    return lines
+
+
+def render_fleet(snapshots: Any) -> str:
+    """Text view of one :meth:`QueryFleet.inspect` snapshot or a list."""
+    if isinstance(snapshots, dict):
+        snapshots = [snapshots]
+    if not snapshots:
+        return "no live query fleets in this process"
+    blocks = []
+    for i, snap in enumerate(snapshots):
+        lines = _render_fleet_one(snap)
+        if len(snapshots) > 1:
+            lines.insert(0, f"fleet {i}:")
+        blocks.append("\n".join(lines))
+    return "\n\n".join(blocks)
 
 
 def render_top(snapshots: Any) -> str:

@@ -215,6 +215,40 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # when the optional zstandard package is importable. <= 0 disables
     # the final stage (dict/RLE/bitpack still run).
     "compress.zstd_level": (3, int),
+    # Serving fleet (runtime/fleet.py): number of QueryServer replica
+    # subprocesses the supervisor boots and routes over.
+    "fleet.replicas": (2, int),
+    # Supervisor -> replica liveness ping cadence, and how long a replica
+    # may go without answering before it is declared dead (classified
+    # ReplicaDeadError via the fleet.heartbeat seam).
+    "fleet.heartbeat_interval_s": (0.5, float),
+    "fleet.heartbeat_timeout_s": (5.0, float),
+    # How many times one query may be re-dispatched after replica deaths
+    # before its in-flight failure is surfaced classified to the caller.
+    "fleet.failover_budget": (2, int),
+    # Exponential restart backoff for dead replicas: first restart waits
+    # backoff_s, each consecutive crash multiplies the wait.
+    "fleet.restart_backoff_s": (0.25, float),
+    "fleet.restart_backoff_multiplier": (2.0, float),
+    # Consecutive crashes (no successfully served query in between) after
+    # which a replica's circuit breaker opens: it is quarantined and no
+    # longer restarted or routed to.
+    "fleet.quarantine_after": (3, int),
+    # Supervisor-side result memo keyed by the result-cache idempotency
+    # pair (plan signature, input fingerprint): bounds entries kept for
+    # failover dedup / bit-identity verification. 0 disables the memo.
+    "fleet.result_memo_entries": (64, int),
+    # How long a worker subprocess may take to report boot_ok before its
+    # boot counts as a crash (feeds the crash-loop circuit breaker).
+    "fleet.worker_boot_timeout_s": (60.0, float),
+    # How long a submit waits for a healthy replica (all dead/quarantined
+    # or still booting) before failing classified.
+    "fleet.dispatch_timeout_s": (30.0, float),
+    # Replica identity stamped onto every telemetry record/span emitted by
+    # this process ("" = unstamped). The fleet supervisor sets this in
+    # each worker's environment so a shared JSONL sink attributes every
+    # line, and `telemetry report`/`trace` can group by replica.
+    "telemetry.replica": ("", str),
 }
 
 _overrides: dict[str, Any] = {}

@@ -195,6 +195,54 @@ def test_taxonomy_context_lands_in_message():
 
 
 # ---------------------------------------------------------------------------
+# worker-exit classification (serving fleet supervision)
+# ---------------------------------------------------------------------------
+
+
+def test_classify_worker_exit_maps_all_death_shapes():
+    # killed by signal: negative returncode, named when the platform can
+    exc = resilience.classify_worker_exit(-9, replica="r0")
+    assert isinstance(exc, resilience.ReplicaDeadError)
+    assert "signal:SIGKILL" in str(exc) and "replica=r0" in str(exc)
+    assert exc.context["returncode"] == -9
+    # exited nonzero
+    exc = resilience.classify_worker_exit(3, replica="r1")
+    assert "exit:3" in str(exc) and exc.context["cause"] == "exit:3"
+    # officially running yet silent (missed liveness deadline)
+    exc = resilience.classify_worker_exit(None, replica="r2")
+    assert "unresponsive" in str(exc)
+    assert exc.context["returncode"] == -1
+    # caller context embeds, construction never raises (unknown signal)
+    exc = resilience.classify_worker_exit(-250, replica="r0", qid=7)
+    assert "qid=7" in str(exc) and "signal:" in str(exc)
+
+
+def test_fleet_control_socket_failures_classify_replica_dead():
+    shapes = (ConnectionError("peer closed"), EOFError(),
+              TimeoutError(), OSError(32, "broken pipe"))
+    for seam in ("fleet.dispatch", "fleet.heartbeat", "fleet.worker_exit"):
+        for raw in shapes:
+            assert resilience.classify(raw, seam=seam) \
+                is resilience.ReplicaDeadError, (seam, raw)
+    # the same raw errors OFF the fleet seams keep their old labels: the
+    # fleet mapping must not leak into transport (or seamless) call sites
+    assert resilience.classify(
+        ConnectionError(), seam="dcn.transport") is TransportError
+    assert resilience.classify(EOFError()) is FatalExecutionError
+
+
+def test_replica_dead_is_transient_only_at_dispatch():
+    exc = resilience.ReplicaDeadError("replica worker died (signal:SIGKILL)")
+    # re-placement on a DIFFERENT replica is the one structural recovery
+    assert resilience.is_transient(exc, seam="fleet.dispatch")
+    # heartbeat and reap paths must never retry into the corpse
+    assert not resilience.is_transient(exc)
+    assert not resilience.is_transient(exc, seam="fleet.heartbeat")
+    assert not resilience.is_transient(exc, seam="fleet.worker_exit")
+    assert not resilience.is_transient(exc, seam="dcn.transport")
+
+
+# ---------------------------------------------------------------------------
 # the one retry policy
 # ---------------------------------------------------------------------------
 

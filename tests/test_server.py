@@ -569,3 +569,45 @@ def test_server_config_defaults():
     assert get_option("server.admission_timeout_s") == 30.0
     assert get_option("server.queue_depth") == 64
     assert get_option("server.estimate_headroom") == 1.5
+
+
+# ---------------------------------------------------------------------------
+# shared learned-estimate state: N replica writers, one file
+# ---------------------------------------------------------------------------
+
+
+def test_learned_estimates_two_writers_merge_not_clobber(tmp_path):
+    """Two servers (the in-process stand-in for two fleet replica
+    processes) debounce-write ONE estimate file: the flock + merge-on-
+    load discipline means the second writer folds the first writer's
+    signatures in instead of clobbering them (the old tmp+replace was
+    last-writer-wins)."""
+    import json
+
+    est = tmp_path / "learned_estimates.json"
+    set_option("server.estimate_path", str(est))
+    plan1, b1 = _q1_bindings(600)
+    plan6 = _q6_plan()
+    b6 = {"lineitem": tpch.lineitem_table(600, seed=5)}
+    sig1 = server.QueryServer._plan_signature(plan1, b1)
+    sig6 = server.QueryServer._plan_signature(plan6, b6)
+    assert sig1 != sig6
+    with server.QueryServer() as a, server.QueryServer() as b:
+        # each writer learns a DIFFERENT signature, then both flush —
+        # writer b must not erase what writer a persisted
+        a.session("sa").submit(plan1, b1).result(timeout=120)
+        b.session("sb").submit(plan6, b6).result(timeout=120)
+        a.flush_learned()
+        b.flush_learned()
+        state = json.loads(est.read_text())
+        assert sig1 in state and state[sig1] > 0, state
+        assert sig6 in state and state[sig6] > 0, state
+        # flush also back-fills sibling learning into the writer: b now
+        # warm-admits a's signature without ever having served it
+        with b._learned_lock:
+            assert sig1 in b._learned
+    # a newcomer merges the whole file on load (fleet warm restart)
+    with server.QueryServer() as c:
+        with c._learned_lock:
+            assert sig1 in c._learned and sig6 in c._learned
+    reset_option("server.estimate_path")

@@ -15,6 +15,7 @@ Four layers under test:
 """
 
 import json
+import os
 
 import pytest
 
@@ -434,3 +435,75 @@ def test_record_server_session_mandatory_even_when_disabled():
     # disabled-path validation, same contract as record_fallback's reason
     with pytest.raises(ValueError):
         telemetry.record_server("tpch_q1", "served", session="")
+
+
+# ---------------------------------------------------------------------------
+# fleet events & replica attribution (runtime/fleet.py's contract)
+# ---------------------------------------------------------------------------
+
+
+def test_record_fleet_event_schema(enabled):
+    telemetry.record_fleet("fleet.supervise", "replica_death",
+                           replica="r0", error_kind="ReplicaDeadError")
+    (rec,) = [r for r in telemetry.events() if r["kind"] == "fleet"]
+    assert rec["event"] == "replica_death"
+    assert rec["replica"] == "r0"
+    assert rec["error_kind"] == "ReplicaDeadError"
+    # the supervisor owns fleet.* counters unconditionally; the recorder
+    # must not double-count (same contract as record_server)
+    assert telemetry.REGISTRY.counters("fleet.") == {}
+    assert telemetry.summary()["fleet"] == {"replica_death": 1}
+
+
+def test_record_fleet_replica_mandatory_even_when_disabled():
+    with pytest.raises(ValueError):
+        telemetry.record_fleet("fleet.supervise", "boot", replica="")
+    with pytest.raises(ValueError):
+        telemetry.record_fleet("fleet.supervise", "boot", replica="r0",
+                               kind="smuggled")
+
+
+def test_replica_option_stamps_every_record(enabled):
+    config.set_option("telemetry.replica", "r7")
+    telemetry.record_server("tpch_q1", "served", session="s1")
+    telemetry.record_spill("spill", nbytes=10, tier="host", reason="x")
+    for rec in telemetry.events():
+        assert rec["replica"] == "r7", rec
+
+
+def test_two_process_shared_sink_no_torn_lines(tmp_path):
+    """N replica processes appending to ONE JSONL path concurrently: every
+    record lands as a single O_APPEND write(2), so a reader must see
+    exactly writers x records parseable lines, each stamped with its
+    writer's replica id — never two lines torn into each other."""
+    import subprocess
+    import sys
+
+    path = tmp_path / "shared.jsonl"
+    per_writer = 400
+    code = (
+        "import sys\n"
+        "from spark_rapids_jni_tpu import telemetry\n"
+        "for i in range(%d):\n"
+        "    telemetry.record_server('tpch_q1', 'served',\n"
+        "                            session='s%%d' %% i, rows=i)\n"
+        % per_writer)
+    procs = []
+    for rid in ("r0", "r1"):
+        env = dict(os.environ)
+        env.update({
+            "SPARK_RAPIDS_TPU_TELEMETRY_ENABLED": "1",
+            "SPARK_RAPIDS_TPU_TELEMETRY_PATH": str(path),
+            "SPARK_RAPIDS_TPU_TELEMETRY_REPLICA": rid,
+        })
+        procs.append(subprocess.Popen([sys.executable, "-c", code],
+                                      env=env))
+    for p in procs:
+        assert p.wait(timeout=120) == 0
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2 * per_writer
+    by_replica = {}
+    for line in lines:
+        rec = json.loads(line)  # a torn line would fail to parse
+        by_replica[rec["replica"]] = by_replica.get(rec["replica"], 0) + 1
+    assert by_replica == {"r0": per_writer, "r1": per_writer}

@@ -433,9 +433,53 @@ def test_rule_compress_inside_seal_codec_reference_trusted(tmp_path):
     assert not _by_rule(_lint_file(mod), "compress-inside-seal")
 
 
+def test_rule_worker_exit_classified_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_fleet_worker_exit.py"),
+                   "worker-exit-must-classify")
+    texts = [f.source_line for f in got]
+    assert len(got) == 4, texts
+    assert any(".returncode" in t for t in texts)
+    assert any("proc.wait" in t for t in texts)
+    assert any("worker.poll" in t for t in texts)
+    assert any("os.waitpid" in t for t in texts)
+    # classified / recorded / raising / join-barrier / Event.wait /
+    # pragma'd twins past the clean_ marker all stay clean
+    src = (FIXTURES / "seeded_fleet_worker_exit.py").read_text()
+    clean_at = src[:src.index("def clean_classified_reap")].count("\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_worker_exit_classified_scope(tmp_path):
+    # same constructions outside the supervision scope are out of scope;
+    # a fleet-named file anywhere is in scope (the rule's home)
+    target = tmp_path / "plain_tool.py"
+    shutil.copy(FIXTURES / "seeded_fleet_worker_exit.py", target)
+    assert not _by_rule(_lint_file(target), "worker-exit-must-classify")
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    target2 = rt / "plain_name.py"
+    shutil.copy(FIXTURES / "seeded_fleet_worker_exit.py", target2)
+    assert _by_rule(_lint_file(target2), "worker-exit-must-classify")
+
+
+def test_rule_worker_exit_join_barrier_clean(tmp_path):
+    # a bare-expression proc.wait() used purely as a join barrier never
+    # consumes the status: exempt even with zero accounting around it
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    mod = rt / "fleet_like.py"
+    mod.write_text(
+        "def shutdown(replicas):\n"
+        "    for r in replicas:\n"
+        "        r.proc.wait(timeout=5.0)\n")
+    assert not _by_rule(_lint_file(mod), "worker-exit-must-classify")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all seventeen rules demonstrably fire."""
+    """The acceptance invariant: all eighteen rules demonstrably fire."""
     seen = set()
+    for f in _lint_file(FIXTURES / "seeded_fleet_worker_exit.py"):
+        seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_host_transfer_device.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_fallback_device.py"):

@@ -1,0 +1,65 @@
+"""Seeded violations for rule 18 (worker-exit-must-classify).
+
+The basename contains ``fleet`` so the file is in scope the same way
+runtime/ and parallel/ modules are. Violations first, then clean twins
+past the ``def clean_`` marker the per-rule test splits on.
+"""
+
+import os
+
+
+def raw_returncode_branch(proc):
+    if proc.returncode != 0:  # VIOLATION: raw exit code drives policy
+        return "restart"
+    return "ok"
+
+
+def consumed_wait_swallowed(proc):
+    rc = proc.wait(timeout=2.0)  # VIOLATION: status read, never mapped
+    return rc == 0
+
+
+def consumed_poll_swallowed(worker):
+    alive = worker.poll() is None  # VIOLATION: consumed, unaccounted
+    return alive
+
+
+def waitpid_swallowed(pid):
+    _, status = os.waitpid(pid, 0)  # VIOLATION: raw wait status
+    return status
+
+
+def clean_classified_reap(proc, classify_worker_exit):
+    rc = proc.wait(timeout=2.0)  # clean: shape routed through taxonomy
+    return classify_worker_exit(rc, replica="r0")
+
+
+def clean_recorded_poll(worker, record_fleet):
+    rc = worker.poll()  # clean: the read is visible in telemetry
+    record_fleet("fleet.supervise", "reap", replica="r0", returncode=rc)
+    return rc
+
+
+def clean_counted_returncode(proc, registry):
+    if proc.returncode:  # clean: counter makes the death visible
+        registry.counter("fleet.replica_deaths").inc()
+
+
+def clean_raising_read(proc, ReplicaDeadError):
+    if proc.returncode:  # clean: raised — classified downstream
+        raise ReplicaDeadError("replica worker died")
+
+
+def clean_join_barrier(proc):
+    proc.wait(timeout=5.0)  # clean: pure join, status not consumed
+    return True
+
+
+def clean_event_wait(done_evt):
+    return done_evt.wait(1.0)  # clean: Event.wait is not an exit status
+
+
+def clean_pragmad_read(proc):
+    # reviewed: boot-time liveness probe, death handled by the reaper
+    # tpulint: disable=worker-exit-must-classify
+    return proc.poll() is None

@@ -1,4 +1,4 @@
-"""The sixteen tpulint rules.
+"""The eighteen tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -1311,6 +1311,100 @@ def check_compress_inside_seal(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 18: worker-exit-must-classify
+# ---------------------------------------------------------------------------
+
+# receivers whose .wait()/.poll() plausibly return a subprocess exit
+# status (filters out the ubiquitous Event/Condition/Lock .wait())
+_PROC_RECEIVER_HINTS = ("proc", "popen", "process", "child", "worker")
+
+
+def _is_fleet_scope_file(ctx: FileContext) -> bool:
+    return _is_reservation_scope_file(ctx) or "fleet" in ctx.name
+
+
+def _proc_exit_reads(fn) -> List[ast.AST]:
+    """AST sites inside ``fn`` that CONSUME a subprocess exit status:
+    ``.returncode`` reads, ``proc.wait()``/``proc.poll()`` whose value is
+    used (a bare-expression ``proc.wait(...)`` merely synchronizes and is
+    exempt), and ``os.waitpid(...)``."""
+    discarded = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            discarded.add(id(node.value))
+    out: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr == "returncode":
+            out.append(node)
+        elif isinstance(node, ast.Call) and id(node) not in discarded:
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in ("wait", "poll"):
+                    recv = _unparse(node.func.value).lower()
+                    last = recv.rsplit(".", 1)[-1]
+                    if any(h in last for h in _PROC_RECEIVER_HINTS):
+                        out.append(node)
+                elif node.func.attr == "waitpid":
+                    out.append(node)
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id == "waitpid"):
+                out.append(node)
+    return out
+
+
+def _fn_classifies_or_accounts(fn) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Raise):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        ftxt = _unparse(node.func)
+        if "classify" in ftxt:
+            return True
+        if ftxt.endswith(_CLASSIFY_CALL_SUFFIXES + ("record_fleet",)):
+            return True
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CLASSIFY_ATTR_CALLS):
+            return True
+    return False
+
+
+def check_worker_exit_classified(ctx: FileContext) -> List[RawFinding]:
+    """ISSUE-14 bug class: supervision code that reads a worker
+    subprocess's exit status — ``proc.returncode``, a consumed
+    ``proc.wait()``/``proc.poll()``, ``os.waitpid`` — and acts on the
+    raw integer. A nonzero exit, a signal death (negative returncode)
+    and an unresponsive worker are DIFFERENT failure shapes with
+    different recovery policy (failover vs restart vs quarantine), and
+    the resilience taxonomy is where that mapping lives
+    (``resilience.classify_worker_exit`` builds the classified
+    ``ReplicaDeadError`` with cause/replica context embedded). A
+    function that consumes an exit status must route through a
+    ``classify*`` call, raise, or visibly account for the read
+    (``record_*`` event, counter ``.inc()``, log) — a silently absorbed
+    exit code turns replica death into an unexplained hang. A
+    bare-expression ``proc.wait(...)`` used purely as a join barrier is
+    exempt (the status is not consumed). Scope: supervision homes —
+    fleet-named files plus the reservation scope."""
+    if not _is_fleet_scope_file(ctx):
+        return []
+    out: List[RawFinding] = []
+    for fn in _top_functions(ctx.tree):
+        reads = _proc_exit_reads(fn)
+        if not reads or _fn_classifies_or_accounts(fn):
+            continue
+        for node in reads:
+            out.append(RawFinding(
+                node.lineno, node.col_offset,
+                f"`{_unparse(node)}` consumes a worker exit status but "
+                f"nothing in `{fn.name}` classifies or accounts for it: "
+                f"route the shape through resilience.classify_worker_exit "
+                f"(nonzero exit / signal death / unresponsive map to a "
+                f"classified ReplicaDeadError), raise, or make the read "
+                f"visible (record_* event, counter .inc(), log)"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -1386,4 +1480,10 @@ RULES = [
          "reads must verify before they decompress (the trailer covers "
          "the compressed bytes)",
          check_compress_inside_seal),
+    Rule("worker-exit-must-classify",
+         "supervision code that consumes a worker subprocess exit "
+         "status (.returncode, used .wait()/.poll(), os.waitpid) must "
+         "route the shape through resilience.classify_worker_exit / a "
+         "classify call, raise, or visibly account for the read",
+         check_worker_exit_classified),
 ]
