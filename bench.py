@@ -1313,6 +1313,145 @@ def _fleet_block() -> dict:
     return block
 
 
+def _kernels_block() -> dict:
+    """The BENCH_*.json ``kernels`` block: the maintained Pallas kernel
+    tier (ops/pallas/). For each kernel the same probe-sized workload
+    runs under ``kernels.tier=xla`` (the bit-identity oracle) and
+    ``kernels.tier=pallas``, reporting steady-state latency for both
+    tiers and whether the outputs matched byte-for-byte. The fused q1
+    accumulate leads (fused-XLA ``tpch_q1`` vs the fused Pallas kernel —
+    query-level identity is pinned by tests/test_tpch.py, so that entry
+    carries latency only). Off-TPU the pallas tier runs the interpreter
+    (``pallas_mode: "interpret"``) — those numbers document the tier
+    DECIDING correctly on a fallback backend, not kernel speed.
+    ``decisions`` is the process's full ``kernels.*`` counter ledger
+    (config body included): every tier pick and every recorded
+    fallback reason this run ever made."""
+    block: dict = {}
+    try:
+        import numpy as np
+
+        import jax
+
+        from spark_rapids_jni_tpu.columnar import Column, Table
+        from spark_rapids_jni_tpu.models.tpch import lineitem_table, tpch_q1
+        from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate_bounded
+        from spark_rapids_jni_tpu.ops.join import join
+        from spark_rapids_jni_tpu.ops.pallas_q1 import tpch_q1_pallas
+        from spark_rapids_jni_tpu.ops.row_conversion import convert_to_rows
+        from spark_rapids_jni_tpu.telemetry import REGISTRY
+        from spark_rapids_jni_tpu.utils.config import (
+            reset_option,
+            set_option,
+        )
+
+        on_tpu = jax.default_backend() == "tpu"
+        reps = 3
+        rng = np.random.default_rng(0)
+
+        def _steady(run, sync):
+            run()  # warm: trace + compile land outside the timed region
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = run()
+            sync(out)  # fetch bounds the loop (same contract as _measure)
+            return (time.perf_counter() - t0) / reps
+
+        def _tiered(run, sync, to_bytes):
+            secs, outs = {}, {}
+            for tier in ("xla", "pallas"):
+                set_option("kernels.tier", tier)
+                try:
+                    secs[tier] = _steady(run, sync)
+                    outs[tier] = to_bytes(run())
+                finally:
+                    reset_option("kernels.tier")
+            return {
+                "xla_steady_state_s": round(secs["xla"], 6),
+                "pallas_steady_state_s": round(secs["pallas"], 6),
+                "pallas_vs_xla": (round(secs["xla"] / secs["pallas"], 4)
+                                  if secs["pallas"] else None),
+                "bit_identical": outs["xla"] == outs["pallas"],
+            }
+
+        kernels: dict = {}
+
+        # q1 accumulate first: the kernel that proved the tier's headroom
+        li = lineitem_table(1 << 13)
+        q1_sync = lambda out: np.asarray(out.column(0).data)  # noqa: E731
+        q1_xla_s = _steady(lambda: tpch_q1(li), q1_sync)
+        q1_pal_s = _steady(
+            lambda: tpch_q1_pallas(li, interpret=not on_tpu), q1_sync)
+        kernels["tpch_q1.fused"] = {
+            "xla_steady_state_s": round(q1_xla_s, 6),
+            "pallas_steady_state_s": round(q1_pal_s, 6),
+            "pallas_vs_xla": (round(q1_xla_s / q1_pal_s, 4)
+                              if q1_pal_s else None),
+        }
+
+        gk = rng.integers(0, 3, 2048).astype(np.int32) * 5
+        gv = rng.integers(-(2 ** 40), 2 ** 40, 2048).astype(np.int64)
+        g8 = rng.integers(-128, 128, 2048).astype(np.int8)
+        gvalid = np.ones(2048, bool)
+        gvalid[-256:] = False
+        gtbl = Table([
+            Column.from_numpy(gk, validity=gvalid),
+            Column.from_numpy(gv),
+            Column.from_numpy(g8),
+        ])
+        gaggs = [(1, "sum"), (1, "count"), (2, "min"), (2, "max")]
+
+        def _g_bytes(res):
+            return b"".join(
+                np.asarray(c.data).tobytes() for c in res.table.columns)
+
+        kernels["groupby.bounded_accumulate"] = _tiered(
+            lambda: groupby_aggregate_bounded(
+                gtbl, [0], gaggs, key_domains=[(0, 5, 10)]),
+            lambda res: np.asarray(res.table.column(1).data),
+            _g_bytes)
+
+        jl = Table([Column.from_numpy(
+            rng.integers(0, 128, 257).astype(np.int32))])
+        jr = Table([Column.from_numpy(
+            rng.integers(0, 128, 256).astype(np.int32))])
+        kernels["join.hash_probe"] = _tiered(
+            lambda: join(jl, jr, 0, 0, 258 * 257, how="inner"),
+            lambda maps: np.asarray(maps.total),
+            lambda maps: b"".join(np.asarray(f).tobytes() for f in maps))
+
+        rvalid = np.ones(256, bool)
+        rvalid[-64:] = False
+        rtbl = Table([
+            Column.from_numpy(
+                rng.integers(-(2 ** 60), 2 ** 60, 256).astype(np.int64),
+                validity=rvalid),
+            Column.from_numpy(rng.integers(-100, 100, 256).astype(np.int8)),
+            Column.from_numpy(rng.random(256).astype(np.float64)),
+        ])
+        kernels["row_conversion.to_rows"] = _tiered(
+            lambda: convert_to_rows(rtbl),
+            lambda batches: np.asarray(batches[0].data),
+            lambda batches: b"".join(
+                np.asarray(b.data).tobytes() for b in batches))
+
+        block.update({
+            "pallas_mode": "native" if on_tpu else "interpret",
+            "kernels": kernels,
+            "decisions": dict(sorted(REGISTRY.counters("kernels").items())),
+            "note": (
+                "per-kernel steady state under kernels.tier=xla vs "
+                "=pallas over the identical probe input; bit_identical "
+                "compares raw output bytes between tiers. pallas_mode "
+                "interpret = no Mosaic backend: latency documents the "
+                "fallback contract, not kernel speed. decisions: every "
+                "kernels.* tier/fallback counter this process recorded"),
+        })
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    return block
+
+
 def _ledger_last(metric: str, n: int):
     """Most recent ledger record for ``metric`` under the current
     measurement tag — preferring an exact row-count match (throughput is
@@ -2189,7 +2328,8 @@ def _child_main(config: str, n: int, iters: int) -> None:
                       "degrade": _degrade_block(),
                       "integrity": _integrity_block(),
                       "compress": _compress_block(),
-                      "fleet": _fleet_block()}))
+                      "fleet": _fleet_block(),
+                      "kernels": _kernels_block()}))
 
 
 # ---------------------------------------------------------------------------
@@ -2231,12 +2371,12 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
     """Run the bench in a subprocess; returns (value | None, diagnostic,
     dispatch block | None, pipeline block | None, fusion block | None,
     server block | None, cache block | None, degrade block | None,
-    integrity block | None, compress block | None, fleet block | None)
-    — the blocks come from the measured child process's executable
-    cache, overlap probe, whole-stage fusion probe, serving-concurrency
-    probe, result-cache probe, memory-pressure degradation probe, the
-    integrity / columnar-codec seam probes, and the replicated-serving
-    fleet probe."""
+    integrity block | None, compress block | None, fleet block | None,
+    kernels block | None) — the blocks come from the measured child
+    process's executable cache, overlap probe, whole-stage fusion probe,
+    serving-concurrency probe, result-cache probe, memory-pressure
+    degradation probe, the integrity / columnar-codec seam probes, the
+    replicated-serving fleet probe, and the Pallas kernel-tier probe."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CONFIG"] = config
@@ -2254,7 +2394,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         )
     except subprocess.TimeoutExpired:
         return (None, f"{platform} bench timed out after {timeout_s:.0f}s",
-                None, None, None, None, None, None, None, None, None)
+                None, None, None, None, None, None, None, None, None, None)
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
@@ -2270,6 +2410,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         integ = rec.get("integrity") if isinstance(rec, dict) else None
         comp = rec.get("compress") if isinstance(rec, dict) else None
         flt = rec.get("fleet") if isinstance(rec, dict) else None
+        kern = rec.get("kernels") if isinstance(rec, dict) else None
         return (value, "", disp if isinstance(disp, dict) else None,
                 pipe if isinstance(pipe, dict) else None,
                 fus if isinstance(fus, dict) else None,
@@ -2278,9 +2419,10 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
                 deg if isinstance(deg, dict) else None,
                 integ if isinstance(integ, dict) else None,
                 comp if isinstance(comp, dict) else None,
-                flt if isinstance(flt, dict) else None)
+                flt if isinstance(flt, dict) else None,
+                kern if isinstance(kern, dict) else None)
     return (None, f"{platform} bench failed: {_tail(out)}",
-            None, None, None, None, None, None, None, None, None)
+            None, None, None, None, None, None, None, None, None, None)
 
 
 def main() -> None:
@@ -2306,6 +2448,7 @@ def main() -> None:
     child_integ = None
     child_comp = None
     child_fleet = None
+    child_kern = None
     # every run gets a telemetry file (children record through the package
     # via these env vars; the parent appends bench_stale events itself) —
     # restored afterwards so driving code / tests see their own env back
@@ -2345,7 +2488,8 @@ def main() -> None:
             if ok:
                 (value, why, child_disp, child_pipe, child_fus,
                  child_srv, child_cache, child_deg,
-                 child_integ, child_comp, child_fleet) = _run_child(
+                 child_integ, child_comp, child_fleet,
+                 child_kern) = _run_child(
                     config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
@@ -2392,14 +2536,16 @@ def main() -> None:
                 # instead of shipping empty blocks
                 (_pv, _pwhy, child_disp, child_pipe, child_fus,
                  child_srv, child_cache, child_deg,
-                 child_integ, child_comp, child_fleet) = _run_child(
+                 child_integ, child_comp, child_fleet,
+                 child_kern) = _run_child(
                     config, n, iters, "cpu", child_timeout)
                 if _pv is None and _pwhy:
                     diagnostics.append(f"probe child: {_pwhy}")
         if value is None:
             (value, why, child_disp, child_pipe, child_fus,
              child_srv, child_cache, child_deg,
-             child_integ, child_comp, child_fleet) = _run_child(
+             child_integ, child_comp, child_fleet,
+             child_kern) = _run_child(
                 config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
@@ -2473,6 +2619,11 @@ def main() -> None:
     # leak check), same child-process provenance; empty when no live
     # child ran
     record["fleet"] = child_fleet or {}
+    # Pallas kernel-tier probe (per-kernel xla vs pallas steady state,
+    # byte-identity between tiers, the full kernels.* decision/fallback
+    # counter ledger), same child-process provenance; empty when no
+    # live child ran
+    record["kernels"] = child_kern or {}
     if diagnostics:
         record["diagnostic"] = "; ".join(d for d in diagnostics if d)
     print(json.dumps(record))

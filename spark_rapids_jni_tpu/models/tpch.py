@@ -2539,3 +2539,31 @@ def tpch_q17_numpy(part: Table, lineitem: Table,
             if qty[i] < 0.2 * avg:
                 total += price[i]
     return total
+
+
+# ---------------------------------------------------------------------------
+# AOT warmup registration (runtime/server.QueryServer.warmup)
+# ---------------------------------------------------------------------------
+#
+# The learned-estimate file records plan signatures ``<plan>@<bucket>``;
+# a booting replica replays the costliest ones through these builders at
+# the signature's bucket rows so the first real query finds its
+# executables already compiled. Only single-table plans register here:
+# their signature bucket maps 1:1 onto synthetic input rows, so the
+# warmed executable IS the one live traffic will hit (a multi-table plan
+# like q3 has no unique rows-per-table split for a total-row bucket, and
+# a wrong split would warm a bucket nobody queries).
+
+def _register_warmup_builders() -> None:
+    from spark_rapids_jni_tpu.runtime.server import register_warmup_builder
+
+    register_warmup_builder(
+        "tpch_q1", lambda rows: tpch_q1(lineitem_table(rows)))
+    register_warmup_builder(
+        "tpch_q1_planned",
+        lambda rows: tpch_q1_planned(lineitem_table(rows)))
+    register_warmup_builder(
+        "tpch_q6", lambda rows: tpch_q6(lineitem_table(rows)))
+
+
+_register_warmup_builders()

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.hash import probe_sorted_lo_hi
 from spark_rapids_jni_tpu.ops.sort import gather, sort_order
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
@@ -90,8 +91,9 @@ def _join_maps_impl(
         right_key, right_valid)
 
     # Match runs per probe row (empty when the probe key is null).
-    lo = jnp.searchsorted(sorted_key, left_key, side="left")
-    hi = jnp.searchsorted(sorted_key, left_key, side="right")
+    # probe_sorted_lo_hi is the kernel-tier seam: searchsorted pair on
+    # the XLA tier, the streaming Pallas probe kernel otherwise.
+    lo, hi = probe_sorted_lo_hi(sorted_key, left_key)
     hi = jnp.minimum(hi, n_valid_right)  # the sentinel tail never matches
     lo = jnp.minimum(lo, hi)
     counts = jnp.where(left_valid, hi - lo, 0)
@@ -142,9 +144,8 @@ def _join_maps_impl(
     # appears among the valid probe keys — one more sort + binary search,
     # the mirror of the probe phase (scatter-free).
     sorted_left, n_valid_left, _ = _sorted_valid_keys(left_key, left_valid)
-    l_lo = jnp.searchsorted(sorted_left, right_key, side="left")
-    l_hi = jnp.minimum(
-        jnp.searchsorted(sorted_left, right_key, side="right"), n_valid_left)
+    l_lo, l_hi = probe_sorted_lo_hi(sorted_left, right_key)
+    l_hi = jnp.minimum(l_hi, n_valid_left)
     exists_in_left = jnp.minimum(l_lo, l_hi) < l_hi
     unmatched = ~(right_valid & exists_in_left)
     if right_row_valid is not None:

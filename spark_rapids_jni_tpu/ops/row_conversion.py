@@ -119,18 +119,44 @@ def _to_rows_impl(
     column_start, column_size, size_per_row = compute_fixed_width_layout(schema)
     n = datas[0].shape[0]
     pieces: list[jnp.ndarray] = []
+    starts: list[int] = []  # byte offset of each piece in the row image
     cursor = 0
     for i, dt in enumerate(schema):
         start, size = column_start[i], column_size[i]
-        if start > cursor:  # alignment padding before this column
-            pieces.append(jnp.zeros((n, start - cursor), dtype=jnp.uint8))
+        starts.append(start)
         pieces.append(to_bytes(datas[i], dt))
         cursor = start + size
+    starts.append(cursor)
     pieces.append(_pack_validity_bytes(jnp.stack(valids, axis=1)))
-    cursor += (len(schema) + 7) // 8
+
+    # kernel-tier seam: the XLA oracle interleaves by lane concatenation
+    # (alignment gaps / trailing row pad as explicit zero pieces); the
+    # Pallas twin assembles the same bytes by where-selects with gaps
+    # falling out of its zero-initialized tile. Tier pick is trace-time,
+    # keyed into the dispatch cache via the kernels digest.
+    from spark_rapids_jni_tpu.ops import pallas as pallas_tier
+
+    decision = pallas_tier.decide("row_conversion.to_rows")
+    if decision.use_pallas:
+        from spark_rapids_jni_tpu.ops.pallas import row_transpose as prt
+
+        reason = prt.unsupported_reason(n, size_per_row)
+        if reason is None:
+            return prt.assemble_rows(
+                pieces, starts, size_per_row,
+                interpret=decision.interpret)
+        pallas_tier.fall_back("row_conversion.to_rows", reason)
+
+    padded: list[jnp.ndarray] = []
+    cursor = 0
+    for start, piece in zip(starts, pieces):
+        if start > cursor:  # alignment padding before this piece
+            padded.append(jnp.zeros((n, start - cursor), dtype=jnp.uint8))
+        padded.append(piece)
+        cursor = start + piece.shape[1]
     if size_per_row > cursor:  # trailing pad to the 64-bit row boundary
-        pieces.append(jnp.zeros((n, size_per_row - cursor), dtype=jnp.uint8))
-    return jnp.concatenate(pieces, axis=1)
+        padded.append(jnp.zeros((n, size_per_row - cursor), dtype=jnp.uint8))
+    return jnp.concatenate(padded, axis=1)
 
 
 def _to_rows_dispatch(row_args, aux, rvs, *, schema):

@@ -1076,6 +1076,13 @@ def _worker_main(fd: int, replica: str) -> int:
     from spark_rapids_jni_tpu.runtime.server import QueryServer
 
     srv = QueryServer()
+    # AOT warmup BEFORE boot_ok (gated by server.warmup_top_n, default
+    # off): the supervisor routes no traffic here until the costliest
+    # learned plan signatures are precompiled, so a recycled replica
+    # rejoins without first-query compile stalls. warmup() never raises.
+    if int(get_option("server.warmup_top_n")) > 0:
+        from spark_rapids_jni_tpu.models import tpch  # noqa: F401  (registers warmup builders)
+        srv.warmup()
     chan.send({"t": "boot_ok", "pid": os.getpid()})
     frozen = False
     try:

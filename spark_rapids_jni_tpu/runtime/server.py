@@ -105,7 +105,7 @@ from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.log import get_logger
 
 __all__ = ["QueryRejected", "QueryTicket", "Session", "QueryServer",
-           "live_servers"]
+           "live_servers", "register_warmup_builder", "warmup_builders"]
 
 _log = get_logger("spark_rapids_jni_tpu.server")
 
@@ -118,6 +118,40 @@ _LIVE_SERVERS: "weakref.WeakSet[QueryServer]" = weakref.WeakSet()
 def live_servers() -> list:
     """The not-yet-closed QueryServers of this process."""
     return [s for s in list(_LIVE_SERVERS) if not s._closed]
+
+
+# ---------------------------------------------------------------------------
+# AOT warmup builders
+# ---------------------------------------------------------------------------
+#
+# The learned-estimate file keys plans by SIGNATURE (``<plan>@<bucket>``)
+# — exactly the granularity at which dispatch memoizes executables — so a
+# fresh replica already knows which executables its predecessors spent
+# the most HBM on. ``QueryServer.warmup`` replays the top-N signatures
+# against synthetic inputs at the signature's bucket BEFORE the replica
+# advertises boot_ok, converting first-query compile stalls into boot
+# work. A builder takes the bucket row count and runs its plan end to end
+# (filling the dispatch/fusion executable caches); models register
+# builders for the plans they own (models/tpch.py).
+
+_WARMUP_BUILDERS: dict = {}
+
+
+def register_warmup_builder(plan_name: str, builder: Callable[[int], Any],
+                            ) -> None:
+    """Register the warmup entrypoint for one plan name. ``builder(rows)``
+    must build synthetic bindings at ``rows`` input rows and execute the
+    plan through its normal path; its return value is discarded."""
+    if not plan_name or not str(plan_name).strip():
+        raise ValueError("register_warmup_builder: plan_name is required")
+    if not callable(builder):
+        raise TypeError(f"warmup builder for {plan_name!r} is not callable")
+    _WARMUP_BUILDERS[str(plan_name)] = builder
+
+
+def warmup_builders() -> dict:
+    """Snapshot of the registered warmup builders (name -> callable)."""
+    return dict(_WARMUP_BUILDERS)
 
 
 class QueryRejected(RuntimeError):
@@ -465,6 +499,49 @@ class QueryServer:
         interval (drain/recycle hook: the successor replica warm-starts
         off this file)."""
         self._save_learned()
+
+    def warmup(self, top_n: Optional[int] = None) -> dict:
+        """AOT-precompile the ``top_n`` costliest learned plan signatures
+        (by estimated working set, descending) before serving traffic.
+
+        Each signature ``<plan>@<bucket>`` replays through its registered
+        warmup builder (:func:`register_warmup_builder`) at exactly the
+        signature's bucket rows, so the executables a first query would
+        stall compiling are already in the dispatch cache — the fleet
+        replica boot hook (runtime/fleet.py) runs this before ``boot_ok``
+        when ``server.warmup_top_n`` > 0. Warmup NEVER fails boot: a
+        signature with no registered builder is skipped (counted under
+        ``server.warmup_skipped``), a builder that raises is counted
+        under ``server.warmup_failed`` and logged, and the summary dict
+        reports attempted/compiled/skipped/failed either way."""
+        if top_n is None:
+            top_n = int(get_option("server.warmup_top_n"))
+        summary = {"attempted": 0, "compiled": 0, "skipped": 0, "failed": 0}
+        if top_n <= 0:
+            return summary
+        with self._learned_lock:
+            ranked = sorted(self._learned.items(), key=lambda kv: -kv[1])
+        for sig, _est in ranked[:int(top_n)]:
+            name, _, bucket = sig.rpartition("@")
+            builder = _WARMUP_BUILDERS.get(name)
+            if builder is None or not bucket.isdigit() or int(bucket) <= 0:
+                summary["skipped"] += 1
+                REGISTRY.counter("server.warmup_skipped").inc()
+                continue
+            summary["attempted"] += 1
+            try:
+                with spans.span(f"warmup.{name}", rows=int(bucket)):
+                    builder(int(bucket))
+            except Exception as exc:
+                # a warmup miss costs the first real query a compile,
+                # never the boot — same posture as learned-state I/O
+                summary["failed"] += 1
+                REGISTRY.counter("server.warmup_failed").inc()
+                _log.warning("warmup of %s failed: %s", sig, exc)
+            else:
+                summary["compiled"] += 1
+                REGISTRY.counter("server.warmup_compiled").inc()
+        return summary
 
     def __enter__(self) -> "QueryServer":
         return self

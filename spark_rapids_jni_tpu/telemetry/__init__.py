@@ -37,6 +37,7 @@ from spark_rapids_jni_tpu.telemetry.events import (
     record_fallback,
     record_fleet,
     record_integrity,
+    record_kernel_tier,
     record_resilience,
     record_server,
     record_spill,
@@ -71,6 +72,7 @@ __all__ = [
     "record_fallback",
     "record_fleet",
     "record_integrity",
+    "record_kernel_tier",
     "record_resilience",
     "record_server",
     "record_spill",

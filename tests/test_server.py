@@ -611,3 +611,74 @@ def test_learned_estimates_two_writers_merge_not_clobber(tmp_path):
         with c._learned_lock:
             assert sig1 in c._learned and sig6 in c._learned
     reset_option("server.estimate_path")
+
+
+# ---------------------------------------------------------------------------
+# AOT warmup at boot (server.warmup_top_n)
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_precompiles_top_signatures(tmp_path):
+    """warmup() ranks the learned-estimate file by cost and precompiles
+    the top-N signatures through their registered builders (models/tpch
+    registers q1/q1_planned/q6 at import); a signature with no builder
+    skips — it can never fail the boot."""
+    import json
+
+    from spark_rapids_jni_tpu.models import tpch as _tpch  # noqa: F401
+
+    est = tmp_path / "learned_estimates.json"
+    est.write_text(json.dumps({
+        "tpch_q1@512": 9.0,       # costliest: registered builder
+        "nosuch_plan@512": 8.0,   # no builder -> skipped, not failed
+        "tpch_q6@512": 1.0,       # cheap: outside top_n=2, never touched
+    }))
+    set_option("server.estimate_path", str(est))
+    try:
+        with server.QueryServer() as srv:
+            c0 = sum(REGISTRY.counters("dispatch.compile.").values())
+            summary = srv.warmup(top_n=2)
+            assert summary == {"attempted": 1, "compiled": 1,
+                               "skipped": 1, "failed": 0}
+            # the builder really traced+compiled something
+            assert sum(REGISTRY.counters("dispatch.compile.").values()) > c0
+        assert REGISTRY.counters("server.").get(
+            "server.warmup_compiled", 0) == 1
+        assert REGISTRY.counters("server.").get(
+            "server.warmup_skipped", 0) == 1
+    finally:
+        reset_option("server.estimate_path")
+
+
+def test_warmup_off_by_default_and_failure_never_raises(tmp_path):
+    """top_n=0 (the default) is a no-op; a builder that blows up is
+    counted failed and logged, never raised — warmup cannot fail a
+    replica boot."""
+    import json
+
+    est = tmp_path / "learned_estimates.json"
+    est.write_text(json.dumps({"exploding_plan@256": 5.0}))
+    set_option("server.estimate_path", str(est))
+
+    def _boom(rows):
+        raise RuntimeError("kaboom")
+
+    server.register_warmup_builder("exploding_plan", _boom)
+    try:
+        with server.QueryServer() as srv:
+            assert srv.warmup(top_n=0) == {
+                "attempted": 0, "compiled": 0, "skipped": 0, "failed": 0}
+            summary = srv.warmup(top_n=1)
+            assert summary["failed"] == 1 and summary["compiled"] == 0
+        assert REGISTRY.counters("server.").get(
+            "server.warmup_failed", 0) == 1
+    finally:
+        server._WARMUP_BUILDERS.pop("exploding_plan", None)
+        reset_option("server.estimate_path")
+
+
+def test_warmup_builder_registration_validates():
+    with pytest.raises(ValueError):
+        server.register_warmup_builder("", lambda rows: None)
+    with pytest.raises(TypeError):
+        server.register_warmup_builder("not_callable", 42)

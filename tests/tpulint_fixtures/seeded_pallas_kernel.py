@@ -1,0 +1,40 @@
+"""Seeded violations for rule 19 (pallas-kernel-must-have-oracle).
+
+A pallas-named module that launches kernels without declaring an XLA
+bit-identity oracle via register_kernel(..., oracle="..."). The module
+DOES call register_kernel — but with an empty oracle, which is exactly
+the silent-drift shape the rule exists to reject — so both pallas_call
+sites below must fire.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def register_kernel(name, *, oracle="", doc=""):  # fixture-local stand-in
+    return name
+
+
+register_kernel("rogue.kernel", oracle="", doc="no oracle declared")
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[0] = x_ref[0] * 2
+
+
+def rogue_double(x):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def rogue_double_again(x):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.int32),
+    )(x)

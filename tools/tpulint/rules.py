@@ -1,4 +1,4 @@
-"""The eighteen tpulint rules.
+"""The nineteen tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -1405,6 +1405,60 @@ def check_worker_exit_classified(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 19: pallas-kernel-must-have-oracle
+# ---------------------------------------------------------------------------
+
+
+def _is_pallas_scope_file(ctx: FileContext) -> bool:
+    """Kernel-tier homes: any file inside a ``pallas`` package directory
+    or whose basename carries ``pallas``."""
+    return "pallas" in ctx.path.split("/")[:-1] or "pallas" in ctx.name
+
+
+def check_pallas_oracle(ctx: FileContext) -> List[RawFinding]:
+    """PR-15 bug class: a hand-written Pallas kernel with no declared
+    XLA bit-identity oracle. The kernel tier's whole contract is that
+    every kernel stays byte-for-byte checkable against the legacy XLA
+    implementation (``kernels.tier=xla``); a kernel module that launches
+    ``pl.pallas_call`` without a ``register_kernel(..., oracle=...)``
+    declaration naming its oracle (a non-empty string literal — the
+    dotted path of the XLA twin) has silently left the maintained tier:
+    nothing ties it to a reference, no tier decision is recorded for it,
+    and bit-identity tests cannot find its twin. Scope: pallas kernel
+    homes (a ``pallas`` package directory or a pallas-named file)."""
+    if not _is_pallas_scope_file(ctx):
+        return []
+    launches = [
+        node for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.Call)
+        and _unparse(node.func).split(".")[-1] == "pallas_call"
+    ]
+    if not launches:
+        return []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _unparse(node.func).split(".")[-1] != "register_kernel":
+            continue
+        for kw in node.keywords:
+            if (kw.arg == "oracle"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                    and kw.value.value.strip()):
+                return []
+    return [
+        RawFinding(
+            node.lineno, node.col_offset,
+            "pl.pallas_call in a kernel-tier module with no "
+            "register_kernel(..., oracle=\"<dotted path of the XLA "
+            "twin>\") declaration: every maintained Pallas kernel must "
+            "name its bit-identity oracle so the xla tier stays "
+            "reachable and the parity tests can find the twin")
+        for node in launches
+    ]
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -1486,4 +1540,9 @@ RULES = [
          "route the shape through resilience.classify_worker_exit / a "
          "classify call, raise, or visibly account for the read",
          check_worker_exit_classified),
+    Rule("pallas-kernel-must-have-oracle",
+         "a module launching pl.pallas_call in a pallas kernel home "
+         "must register_kernel(..., oracle=<non-empty literal>) naming "
+         "its XLA bit-identity twin",
+         check_pallas_oracle),
 ]
