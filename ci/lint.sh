@@ -1,7 +1,8 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its nineteen invariant rules (host/device
+# tpulint (tools/tpulint) runs its twenty-two invariant rules — nineteen
+# per-file AST rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
@@ -9,6 +10,9 @@
 # reservation-release-in-finally, span-must-scope, payload-must-verify,
 # cache-key-must-fingerprint, compress-inside-seal,
 # worker-exit-must-classify, pallas-kernel-must-have-oracle)
+# plus three whole-program concurrency rules built on the
+# tools/tpulint/flows.py interprocedural engine (lock-order-cycle,
+# blocking-call-under-lock, unguarded-shared-write) —
 # over the package in fail-on-new-findings mode — the spark_rapids_jni_tpu
 # glob below covers the telemetry/ package alongside every other
 # subpackage.
@@ -701,3 +705,33 @@ assert c.get("kernels.interpret", 0) >= 1, c  # CPU runs are marked
 print("kernel-tier smoke OK: pallas == xla byte-for-byte, "
       "decisions + interpret mode counted")
 EOF2
+
+# concurrency gate: rules 20-22 are whole-program (tools/tpulint/flows.py
+# builds the call graph + lock registry; concurrency.py judges it). The
+# package sweep above already fails on any new finding; this block proves
+# the ENGINE has not regressed silently — each seeded fixture must still
+# FIRE its rule (checked structurally via --format json, not by grepping
+# human output) — and re-asserts the deadlock-freedom artifact: the
+# lock-order graph over the live package stays acyclic.
+for fixture_rule in \
+    "seeded_lock_order.py lock-order-cycle" \
+    "seeded_blocking_under_lock.py blocking-call-under-lock" \
+    "seeded_unguarded_write.py unguarded-shared-write"; do
+  set -- $fixture_rule
+  out=$(python -m tools.tpulint --format json --no-baseline \
+        "tests/tpulint_fixtures/$1" || true)
+  OUT="$out" RULE="$2" FIXTURE="$1" python - <<'EOF'
+import json
+import os
+
+doc = json.loads(os.environ["OUT"])
+rules = {r["rule"] for r in doc["findings"] if r["status"] == "new"}
+want, fixture = os.environ["RULE"], os.environ["FIXTURE"]
+assert want in rules, f"{fixture} no longer fires {want}: {rules}"
+EOF
+done
+echo "concurrency fixtures OK: rules 20-22 fire"
+
+graph=$(python -m tools.tpulint --lock-graph spark_rapids_jni_tpu)
+grep -q "acyclic" <<<"$graph"
+echo "concurrency smoke OK: lock-order graph acyclic over live package"

@@ -129,8 +129,14 @@ class _FrameChannel:
         from spark_rapids_jni_tpu.runtime import integrity
 
         with self._recv_lock:
+            # _recv_lock exists ONLY to serialize whole-frame reads on
+            # this one socket: it guards no other state, so blocking in
+            # recv wedges nothing but the channel's other readers, who
+            # must wait for the frame boundary anyway.
+            # tpulint: disable=blocking-call-under-lock
             hdr = self._recv_exact(8)
             (length,) = struct.unpack("<Q", hdr)
+            # same deliberate frame read  # tpulint: disable=blocking-call-under-lock
             framed = self._recv_exact(length)
         if integrity.enabled():
             framed = integrity.verify(framed, seam="integrity.wire",
@@ -141,6 +147,9 @@ class _FrameChannel:
         chunks = []
         got = 0
         while got < n:
+            # runs under _recv_lock by design: the lock serializes frame
+            # reads on this socket and guards nothing else (see recv()).
+            # tpulint: disable=blocking-call-under-lock
             chunk = self._sock.recv(min(n - got, 1 << 20))
             if not chunk:
                 raise ConnectionError("fleet peer closed the control socket")

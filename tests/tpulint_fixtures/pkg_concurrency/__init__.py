@@ -1,0 +1,13 @@
+"""Synthetic multi-module fixture package for the whole-program
+concurrency rules (tools/tpulint/flows.py + concurrency.py).
+
+Never imported — the linter parses, it does not execute. The package
+exists so tests can prove the engine resolves *cross-module* facts:
+
+* ``ledger.py`` + ``vault.py`` — a two-lock ABBA cycle that only
+  exists across the module boundary (each file alone is order-clean);
+* ``waiters.py`` — Condition-wait under a foreign lock, next to a
+  clean nested-acquisition twin that must NOT fire;
+* ``gauges.py`` — majority guard inference on a mixed-access
+  attribute, with a bare read that must NOT fire.
+"""

@@ -1,0 +1,28 @@
+"""Majority guard inference on a mixed-access attribute: ``value`` is
+guarded at 2 of 3 sites so its bare write fires; ``peak``'s only bare
+site is a READ, which must NOT fire."""
+
+import threading
+
+
+class Gauge:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+        self.peak = 0
+
+    def set(self, v):
+        with self._lock:
+            self.value = v
+            if v > self.peak:
+                self.peak = v
+
+    def snapshot(self):
+        with self._lock:
+            return (self.value, self.peak)
+
+    def reset_fast(self):
+        self.value = 0                 # VIOLATION: bare write, majority guarded
+
+    def read_dirty(self):
+        return self.peak               # clean: bare READ is allowed

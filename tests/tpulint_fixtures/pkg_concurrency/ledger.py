@@ -1,0 +1,20 @@
+"""Half of a cross-module ABBA: Ledger._lock -> Vault._lock here, the
+reverse order in vault.py. Neither file is a violation alone."""
+
+import threading
+
+from tests.tpulint_fixtures.pkg_concurrency import vault
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.balance = 0
+
+    def transfer(self, v: vault.Vault, amount: int):
+        with self._lock:
+            v.deposit(amount)      # takes Vault._lock under Ledger._lock
+
+    def audit_total(self) -> int:
+        with self._lock:
+            return self.balance

@@ -1,0 +1,19 @@
+"""Other half of the cross-module ABBA (see ledger.py). The string
+annotation is deliberate: the engine must resolve it without an
+import (unique class basename in the corpus)."""
+
+import threading
+
+
+class Vault:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stored = 0
+
+    def deposit(self, amount: int):
+        with self._lock:
+            self.stored += amount
+
+    def sweep(self, led: "Ledger"):
+        with self._lock:
+            led.audit_total()      # takes Ledger._lock under Vault._lock

@@ -1,0 +1,24 @@
+"""Condition-wait under a foreign lock, plus the clean twin: nested
+acquisition in one consistent order (must NOT fire any rule)."""
+
+import threading
+
+
+class Turnstile:
+    def __init__(self):
+        self._gate = threading.Lock()
+        self._ready = threading.Condition()
+
+    def wedge(self):
+        with self._gate:
+            with self._ready:
+                self._ready.wait()     # VIOLATION: _gate held during wait
+
+    def clean_nested(self):
+        with self._gate:
+            with self._ready:          # clean: same order as wedge, no cycle
+                pass
+
+    def clean_wait(self):
+        with self._ready:
+            self._ready.wait(0.05)     # clean: releases the waited lock

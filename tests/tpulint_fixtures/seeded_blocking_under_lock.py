@@ -1,0 +1,30 @@
+"""Seeded violation for blocking-call-under-lock: a Condition.wait on a
+*different* object and a socket recv while a registry lock is held (the
+admission-waiter wedge shape). Condition.wait on the lock being waited
+on is clean — wait releases its own lock."""
+
+import socket
+import threading
+
+
+class Admission:
+    def __init__(self, sock: socket.socket):
+        self._lock = threading.Lock()
+        self._slots = threading.Condition()
+        self._sock = sock
+
+    def park(self):
+        with self._lock:
+            with self._slots:
+                self._slots.wait()     # VIOLATION: _lock held during wait
+
+    def pull(self):
+        with self._lock:
+            return self._sock.recv(1024)   # VIOLATION: recv under _lock
+
+    def clean_park(self):
+        with self._slots:
+            self._slots.wait(0.1)      # clean: releases the waited lock
+
+    def clean_pull(self):
+        return self._sock.recv(1024)   # clean: no lock held

@@ -1,0 +1,39 @@
+"""Seeded violation for lock-order-cycle: two locks taken in opposite
+orders by two methods of one class (the ABBA deadlock shape). The
+clean twin below takes both locks in one global order everywhere."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.balance = 0
+
+    def credit(self, n):
+        with self._alock:
+            with self._block:          # VIOLATION leg: A -> B
+                self.balance += n
+
+    def debit(self, n):
+        with self._block:
+            with self._alock:          # VIOLATION leg: B -> A (cycle)
+                self.balance -= n
+
+
+class CleanLedger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.balance = 0
+
+    def clean_credit(self, n):
+        with self._alock:
+            with self._block:          # clean: same global order as debit
+                self.balance += n
+
+    def clean_debit(self, n):
+        with self._alock:
+            with self._block:
+                self.balance -= n

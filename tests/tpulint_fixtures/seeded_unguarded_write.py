@@ -1,0 +1,40 @@
+"""Seeded violation for unguarded-shared-write: an attribute guarded by
+one lock at the majority of its access sites is written bare in another
+method. ``__init__`` writes are exempt (pre-publication), and bare
+READS never fire (lock-free counter reads are a deliberate idiom)."""
+
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def read(self):
+        with self._lock:
+            return self.count
+
+    def reset(self):
+        self.count = 0                 # VIOLATION: bare write, guarded elsewhere
+
+
+class CleanMeter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def clean_bump(self):
+        with self._lock:
+            self.count += 1
+
+    def clean_read_dirty(self):
+        return self.count              # clean: bare READ is allowed
+
+    def clean_reset(self):
+        with self._lock:
+            self.count = 0

@@ -1,22 +1,57 @@
 """CLI: ``python -m tools.tpulint [paths...]``.
 
 Exit status: 0 clean (or baselined-only), 1 new findings, 2 usage.
+``--format json`` emits a machine-readable report (rule, path, line,
+and per-record suppression status) for structural diffing in CI;
+``--lock-graph`` dumps the whole-program lock-order graph instead of
+linting, exiting 1 if the graph has a cycle.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
+from tools.tpulint.concurrency import (
+    PROGRAM_RULES,
+    format_lock_graph,
+    lock_graph_report,
+)
 from tools.tpulint.engine import (
     DEFAULT_BASELINE,
     apply_baseline,
     format_finding,
+    iter_py_files,
     lint_paths,
     load_baseline,
     write_baseline,
+    _norm_path,
 )
 from tools.tpulint.rules import RULES
+
+
+def _json_record(f, status: str) -> dict:
+    return {"rule": f.rule, "path": f.path, "line": f.line,
+            "col": f.col, "message": f.message,
+            "source_line": f.source_line, "status": status}
+
+
+def _run_lock_graph(paths, as_json: bool) -> int:
+    from tools.tpulint.flows import Program
+    sources = []
+    for f in iter_py_files(paths):
+        try:
+            sources.append((_norm_path(f), f.read_text()))
+        except (OSError, UnicodeDecodeError):
+            continue
+    prog = Program.build(sorted(sources))
+    report = lock_graph_report(prog)
+    if as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_lock_graph(report))
+    return 0 if report["acyclic"] else 1
 
 
 def main(argv=None) -> int:
@@ -37,26 +72,51 @@ def main(argv=None) -> int:
                          "findings and exit 0")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule names and descriptions")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human",
+                    help="output format (default: human)")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="dump the whole-program lock-order graph over "
+                         "the given paths and exit (1 if cyclic)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for r in RULES:
             print(f"{r.name}: {r.description}")
+        for r in PROGRAM_RULES:
+            print(f"{r.name}: {r.description} [whole-program]")
         return 0
     if not args.paths:
         ap.print_usage(sys.stderr)
         print("tools.tpulint: error: no paths given", file=sys.stderr)
         return 2
+    if args.lock_graph:
+        return _run_lock_graph(args.paths, args.format == "json")
 
-    findings = lint_paths(args.paths)
+    as_json = args.format == "json"
+    findings = lint_paths(args.paths, keep_suppressed=as_json)
+    live = [f for f in findings if not f.suppressed]
+    pragma = [f for f in findings if f.suppressed == "pragma"]
     if args.write_baseline:
-        write_baseline(findings, args.baseline)
-        print(f"tpulint: wrote {len(findings)} finding(s) to "
+        write_baseline(live, args.baseline)
+        print(f"tpulint: wrote {len(live)} finding(s) to "
               f"{args.baseline}")
         return 0
 
     baseline = None if args.no_baseline else load_baseline(args.baseline)
-    new, old = apply_baseline(findings, baseline)
+    new, old = apply_baseline(live, baseline)
+    if as_json:
+        records = ([_json_record(f, "new") for f in new]
+                   + [_json_record(f, "baselined") for f in old]
+                   + [_json_record(f, "pragma") for f in pragma])
+        records.sort(key=lambda r: (r["path"], r["line"], r["col"],
+                                    r["rule"]))
+        print(json.dumps({
+            "findings": records,
+            "counts": {"new": len(new), "baselined": len(old),
+                       "pragma": len(pragma)},
+        }, indent=2, sort_keys=True))
+        return 1 if new else 0
     for f in new:
         print(format_finding(f))
     suffix = f" ({len(old)} baselined)" if old else ""

@@ -1,0 +1,281 @@
+"""tpulint concurrency rules (20-22), built on the flows engine.
+
+These are the first *whole-program* rules: they consume the
+``tools.tpulint.flows.Program`` facts (lock registry, call graph,
+held-set dataflow) instead of a single file's AST.
+
+* **lock-order-cycle** — an A->B edge is recorded whenever lock B is
+  acquired (directly, or anywhere down a resolved call chain) while A
+  is held.  Any cycle in that graph is a potential deadlock: two
+  threads taking the locks in opposite orders can each hold one and
+  wait forever for the other.  One finding per cycle, anchored at the
+  cycle's lexicographically-smallest edge site; a pragma there
+  suppresses the whole cycle.
+* **blocking-call-under-lock** — the admission-waiter-wedge shape: a
+  call that can block indefinitely (``Condition.wait`` on a *different*
+  lock, socket ``recv``/``accept``, ``subprocess`` ``wait``/
+  ``communicate``, ``fcntl.flock``, queue ``get``/``put`` with
+  ``block=True``) executes while a registry lock is held, so every
+  other thread needing that lock is wedged behind an unbounded wait.
+  ``Condition.wait`` on the lock being waited on is exempt — wait
+  releases its own lock.
+* **unguarded-shared-write** — guard inference by majority: if one
+  lock is held at more than half of an attribute's access sites
+  (across all methods of the class, ``__init__`` excluded as
+  pre-publication), every *write* outside that lock is flagged.
+  Reads are never flagged: lock-free reads of monotonic counters are
+  a deliberate idiom in this codebase and are documented where used.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple, Optional
+
+from tools.tpulint.flows import Program
+
+
+class ProgramRawFinding(NamedTuple):
+    path: str
+    line: int
+    col: int
+    message: str
+
+
+class ProgramRule(NamedTuple):
+    name: str
+    description: str
+    check: Callable[[Program], List[ProgramRawFinding]]
+
+
+def _short(lock_id: str) -> str:
+    parts = lock_id.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else lock_id
+
+
+def _fshort(qname: str) -> str:
+    parts = qname.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else qname
+
+
+def _chain(via) -> str:
+    return " -> ".join(_fshort(q) for q in via)
+
+
+def _held_names(held) -> str:
+    return ", ".join(sorted(_short(h) for h in held))
+
+
+# ----------------------------------------------------------------------
+# rule 20: lock-order-cycle
+
+
+def check_lock_order_cycle(prog: Program) -> List[ProgramRawFinding]:
+    out: List[ProgramRawFinding] = []
+    for cyc in prog.lock_cycles():
+        edges = []
+        for i, a in enumerate(cyc):
+            e = prog.lock_edges.get((a, cyc[(i + 1) % len(cyc)]))
+            if e is not None:
+                edges.append(e)
+        if not edges:
+            continue
+        anchor = min(edges, key=lambda e: (e.path, e.line))
+        legs = "; ".join(
+            f"{_short(e.src)} -> {_short(e.dst)} at {e.path}:{e.line}"
+            + (f" (via {_chain(e.via)})" if e.via else "")
+            for e in edges)
+        out.append(ProgramRawFinding(
+            anchor.path, anchor.line, 0,
+            f"lock-order cycle: {legs}; threads taking these locks in "
+            f"opposite orders can deadlock -- pick one global order, or "
+            f"pragma this line with the reason the orders cannot "
+            f"interleave"))
+    out.sort(key=lambda f: (f.path, f.line))
+    return out
+
+
+# ----------------------------------------------------------------------
+# rule 21: blocking-call-under-lock
+
+
+def check_blocking_under_lock(prog: Program) -> List[ProgramRawFinding]:
+    out: List[ProgramRawFinding] = []
+    seen = set()
+    for q in sorted(prog.functions):
+        fi = prog.functions[q]
+        eff = fi.entry_held
+        for b in fi.blocks:
+            held = set(b.held) | eff
+            if b.kind == "condition-wait" and b.lock_id is not None:
+                held.discard(b.lock_id)
+            if not held:
+                continue
+            key = (b.path, b.line, b.kind)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(ProgramRawFinding(
+                b.path, b.line, b.col,
+                f"{b.kind} `{b.text}(...)` can block indefinitely while "
+                f"holding {_held_names(held)}; every thread needing that "
+                f"lock is wedged behind it -- move the blocking call "
+                f"outside the lock"))
+        for cs in fi.calls:
+            held = set(cs.held) | eff
+            if not held:
+                continue
+            for (kind, lock_id), (text, via) in sorted(
+                    prog.may_block.get(cs.target, {}).items(),
+                    key=lambda kv: (kv[0][0], kv[0][1] or "")):
+                h = set(held)
+                if kind == "condition-wait" and lock_id is not None:
+                    h.discard(lock_id)
+                if not h:
+                    continue
+                key = (cs.path, cs.line, kind)
+                if key in seen:
+                    continue
+                seen.add(key)
+                chain = _chain((cs.target,) + via)
+                out.append(ProgramRawFinding(
+                    cs.path, cs.line, cs.col,
+                    f"call chain {chain} reaches a {kind} "
+                    f"(`{text}(...)`) that can block indefinitely while "
+                    f"holding {_held_names(h)}; move the call outside "
+                    f"the lock or make the callee non-blocking"))
+    out.sort(key=lambda f: (f.path, f.line, f.col))
+    return out
+
+
+# ----------------------------------------------------------------------
+# rule 22: unguarded-shared-write
+
+
+def check_unguarded_shared_write(prog: Program) -> List[ProgramRawFinding]:
+    out: List[ProgramRawFinding] = []
+    for cq in sorted(prog.classes):
+        ci = prog.classes[cq]
+        lock_attr_names = set()
+        for mro_q in prog._mro(cq):
+            lock_attr_names.update(prog.classes[mro_q].lock_attrs)
+        by_attr: dict = {}
+        for fi in prog.functions.values():
+            if fi.cls is not ci:
+                continue
+            meth = fi.qname[len(cq) + 1:].split(".", 1)[0]
+            if meth == "__init__":
+                continue   # pre-publication writes need no lock
+            eff = fi.entry_held
+            for acc in fi.attr_accesses:
+                if acc.attr in lock_attr_names:
+                    continue
+                held = frozenset(acc.held) | eff
+                by_attr.setdefault(acc.attr, []).append(
+                    (acc, held, meth))
+        for attr in sorted(by_attr):
+            sites = by_attr[attr]
+            if len({m for _, _, m in sites}) < 2:
+                continue   # single-method attrs are that method's state
+            counts: dict = {}
+            for _, held, _ in sites:
+                for lid in held:
+                    counts[lid] = counts.get(lid, 0) + 1
+            guard = None
+            for lid in sorted(counts):
+                if counts[lid] * 2 > len(sites) and counts[lid] >= 2:
+                    guard = lid
+                    break
+            if guard is None:
+                continue
+            emitted = set()
+            for acc, held, meth in sites:
+                if not acc.is_write or guard in held:
+                    continue
+                if (acc.path, acc.line) in emitted:
+                    continue
+                emitted.add((acc.path, acc.line))
+                out.append(ProgramRawFinding(
+                    acc.path, acc.line, acc.col,
+                    f"`self.{attr}` is written here without "
+                    f"{_short(guard)}, but that lock guards "
+                    f"{counts[guard]} of {len(sites)} access sites of "
+                    f"`{attr}` (majority); take the lock or pragma with "
+                    f"the reason this bare write is safe"))
+    out.sort(key=lambda f: (f.path, f.line, f.col))
+    return out
+
+
+PROGRAM_RULES: List[ProgramRule] = [
+    ProgramRule(
+        "lock-order-cycle",
+        "whole-program lock-order graph contains a cycle: threads "
+        "acquiring the locks in opposite orders can deadlock",
+        check_lock_order_cycle),
+    ProgramRule(
+        "blocking-call-under-lock",
+        "a call that can block indefinitely (foreign Condition.wait, "
+        "socket recv/accept, subprocess wait/communicate, fcntl.flock, "
+        "blocking queue get/put) runs while a registry lock is held",
+        check_blocking_under_lock),
+    ProgramRule(
+        "unguarded-shared-write",
+        "an attribute guarded by one lock at the majority of its "
+        "access sites is written bare in another method of the class",
+        check_unguarded_shared_write),
+]
+
+PROGRAM_RULE_NAMES = {r.name for r in PROGRAM_RULES}
+
+
+# ----------------------------------------------------------------------
+# lock-graph artifact (``python -m tools.tpulint --lock-graph``)
+
+
+def lock_graph_report(prog: Program) -> dict:
+    """JSON-able dump of the lock registry, the order graph, and any
+    cycles -- the reviewable artifact CI asserts acyclic."""
+    cycles = prog.lock_cycles()
+    return {
+        "locks": [
+            {"id": li.lock_id, "kind": li.kind,
+             "defined": f"{li.path}:{li.line}"}
+            for li in sorted(prog.locks.values())],
+        "edges": [
+            {"src": e.src, "dst": e.dst, "at": f"{e.path}:{e.line}",
+             "via": list(e.via)}
+            for e in sorted(prog.lock_edges.values())
+            if e.src != e.dst],
+        "self_edges": [
+            {"lock": e.src, "at": f"{e.path}:{e.line}",
+             "via": list(e.via)}
+            for e in sorted(prog.lock_edges.values())
+            if e.src == e.dst],
+        "cycles": cycles,
+        "acyclic": not cycles,
+    }
+
+
+def format_lock_graph(report: dict) -> str:
+    lines = [f"lock-order graph: {len(report['locks'])} lock(s), "
+             f"{len(report['edges'])} edge(s)"]
+    lines.append("locks:")
+    for li in report["locks"]:
+        lines.append(f"  {li['id']}  ({li['kind']})  {li['defined']}")
+    lines.append("edges (src -> dst, first witness site):")
+    if not report["edges"]:
+        lines.append("  (none)")
+    for e in report["edges"]:
+        via = f"  via {' -> '.join(e['via'])}" if e["via"] else ""
+        lines.append(f"  {e['src']} -> {e['dst']}  @ {e['at']}{via}")
+    if report["self_edges"]:
+        lines.append("self edges (same class-granular lock; not "
+                     "treated as cycles):")
+        for e in report["self_edges"]:
+            lines.append(f"  {e['lock']}  @ {e['at']}")
+    if report["cycles"]:
+        lines.append("CYCLES (potential deadlocks):")
+        for cyc in report["cycles"]:
+            lines.append("  " + " -> ".join(cyc + [cyc[0]]))
+    else:
+        lines.append("cycles: none (acyclic)")
+    return "\n".join(lines)

@@ -38,6 +38,8 @@ class Finding(NamedTuple):
     rule: str
     message: str
     source_line: str   # stripped text of the offending line
+    suppressed: str = ""   # "" (live) or "pragma" (kept only when a
+                           # caller asks for suppressed findings too)
 
 
 def format_finding(f: Finding) -> str:
@@ -72,9 +74,14 @@ def _pragma_rules(lines: Sequence[str], lineno: int) -> set:
     return rules
 
 
-def lint_source(src: str, path, rules=None) -> List[Finding]:
-    """Lint one file's source text. Pragma-filtered, NOT
-    baseline-filtered (baselines apply across a whole run)."""
+def lint_source(src: str, path, rules=None,
+                keep_suppressed: bool = False) -> List[Finding]:
+    """Lint one file's source text with the per-file rules.
+    Pragma-filtered, NOT baseline-filtered (baselines apply across a
+    whole run). With ``keep_suppressed``, pragma'd findings are kept
+    with ``suppressed="pragma"`` instead of dropped (for structured
+    output). Whole-program rules run in :func:`lint_paths`, which sees
+    the full corpus."""
     norm = _norm_path(path)
     lines = src.splitlines()
     try:
@@ -88,12 +95,14 @@ def lint_source(src: str, path, rules=None) -> List[Finding]:
     for rule in (rules if rules is not None else RULES):
         for rf in rule.check(ctx):
             disabled = _pragma_rules(lines, rf.line)
-            if rule.name in disabled or "all" in disabled:
+            pragma = rule.name in disabled or "all" in disabled
+            if pragma and not keep_suppressed:
                 continue
             src_line = (lines[rf.line - 1].strip()
                         if 1 <= rf.line <= len(lines) else "")
             out.append(Finding(norm, rf.line, rf.col, rule.name,
-                               rf.message, src_line))
+                               rf.message, src_line,
+                               "pragma" if pragma else ""))
     out.sort(key=lambda f: (f.line, f.col, f.rule))
     return out
 
@@ -109,8 +118,14 @@ def iter_py_files(paths: Iterable) -> List[Path]:
     return files
 
 
-def lint_paths(paths: Iterable, rules=None) -> List[Finding]:
+def lint_paths(paths: Iterable, rules=None, program_rules=None,
+               keep_suppressed: bool = False) -> List[Finding]:
+    """Lint files/directories: the per-file rules on each file, then
+    the whole-program rules (tools/tpulint/concurrency.py) once over
+    the full corpus. Pass ``program_rules=[]`` to skip the program
+    pass, or a list to substitute it."""
     out: List[Finding] = []
+    sources: dict = {}
     for f in iter_py_files(paths):
         try:
             src = f.read_text()
@@ -118,7 +133,31 @@ def lint_paths(paths: Iterable, rules=None) -> List[Finding]:
             out.append(Finding(_norm_path(f), 1, 0, "parse-error",
                                f"unreadable: {exc}", ""))
             continue
-        out.extend(lint_source(src, f, rules=rules))
+        sources[_norm_path(f)] = src
+        out.extend(lint_source(src, f, rules=rules,
+                               keep_suppressed=keep_suppressed))
+    if program_rules is None:
+        from tools.tpulint.concurrency import PROGRAM_RULES
+        program_rules = PROGRAM_RULES
+    if program_rules and sources:
+        from tools.tpulint.flows import Program
+        prog = Program.build(sorted(sources.items()))
+        extra: List[Finding] = []
+        line_cache = {p: s.splitlines() for p, s in sources.items()}
+        for rule in program_rules:
+            for rf in rule.check(prog):
+                lines = line_cache.get(rf.path, [])
+                disabled = _pragma_rules(lines, rf.line)
+                pragma = rule.name in disabled or "all" in disabled
+                if pragma and not keep_suppressed:
+                    continue
+                src_line = (lines[rf.line - 1].strip()
+                            if 1 <= rf.line <= len(lines) else "")
+                extra.append(Finding(rf.path, rf.line, rf.col, rule.name,
+                                     rf.message, src_line,
+                                     "pragma" if pragma else ""))
+        extra.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        out.extend(extra)
     return out
 
 

@@ -1,0 +1,1049 @@
+"""tpulint flows: the whole-program analysis substrate.
+
+Where ``rules.py`` sees one file at a time, this module walks the whole
+lint corpus once and builds the interprocedural facts the concurrency
+rules (``tools/tpulint/concurrency.py``) consume:
+
+* a **module index** — every parsed file under its dotted module name,
+  with an import table (absolute and relative, plus one re-export hop
+  so ``telemetry.record_spill`` resolves through
+  ``telemetry/__init__.py``);
+* a **lock registry** — every ``threading.Lock/RLock/Condition`` bound
+  to a ``self.<attr>`` in a class, a module-level name, or a function
+  local.  ``Condition(self._lock)`` aliases canonicalize to the
+  wrapped lock, so a lock and its condition view are ONE node;
+* a **call graph** — direct intra-corpus calls resolved through
+  attribute types (``self.store = SpillStore(...)`` makes
+  ``self.store.get(...)`` resolve), parameter annotations (including
+  string annotations like ``"MemoryLimiter | None"``), module aliases,
+  and local-variable construction.  Property getters on resolved
+  receivers count as calls;
+* **held-set dataflow** — for every function, the locks lexically held
+  at each acquisition / call / attribute-access site, plus an inferred
+  *entry-held* set for private helpers: the intersection of held sets
+  over every internal call site.  This is how ``*_locked`` helpers are
+  proven to run under their class lock without annotations;
+* propagated **may-acquire** and **may-block** summaries, so an edge or
+  a blocking call several frames down is charged to the outermost
+  call site that holds a lock.
+
+Locks are **class-granular**: two instances of one class share a node.
+That conflation would manufacture false A->A deadlocks on nested
+same-class acquisitions, so self-edges are recorded but never treated
+as cycles.  Other deliberate under-approximations: only ``with``
+acquisitions are tracked (manual ``.acquire()``/``.release()`` pairs
+are not), calls through containers / ``**kwargs`` / higher-order
+values do not resolve, and entry-held inference applies only to
+private (``_``-prefixed, non-dunder) functions so a public API is
+never assumed to run under a caller's lock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Factory call texts that create a lock.  Bare names cover
+# ``from threading import Lock``-style imports.
+_LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+}
+
+# Annotation identifiers that can never name a corpus class.
+_ANN_NOISE = {
+    "Optional", "Union", "None", "Any", "List", "Dict", "Tuple", "Set",
+    "Sequence", "Iterable", "Mapping", "Callable", "int", "float",
+    "str", "bytes", "bool", "object", "list", "dict", "tuple", "set",
+}
+
+_PROC_RECEIVER_HINTS = ("proc", "popen", "process", "child", "worker")
+
+
+def _queueish(recv_last: str) -> bool:
+    return (recv_last == "q" or recv_last.endswith("_q")
+            or "queue" in recv_last
+            or recv_last in ("inbox", "outbox", "mailbox"))
+
+
+def _unparse(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _module_name(path: str) -> Tuple[str, bool]:
+    """Dotted module name for a repo-relative posix path, plus whether
+    the file is a package ``__init__``."""
+    p = path[:-3] if path.endswith(".py") else path
+    parts = [x for x in p.split("/") if x]
+    is_pkg = bool(parts) and parts[-1] == "__init__"
+    if is_pkg:
+        parts = parts[:-1]
+    return ".".join(parts), is_pkg
+
+
+def _expr_nodes(node):
+    """Yield expression nodes without descending into deferred bodies
+    (lambdas, nested defs, comprehension functions run inline so their
+    bodies ARE visited)."""
+    if isinstance(node, (ast.Lambda,) + _FUNC_NODES + (ast.ClassDef,)):
+        return
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _expr_nodes(child)
+
+
+class LockInfo(NamedTuple):
+    lock_id: str       # "mod.Class.attr" | "mod.name" | "mod.func.name"
+    kind: str          # Lock | RLock | Condition
+    path: str
+    line: int
+
+
+class AcquireSite(NamedTuple):
+    lock_id: str
+    path: str
+    line: int
+    col: int
+    held: Tuple[str, ...]     # lexically held at this acquisition
+
+
+class CallSite(NamedTuple):
+    target: str               # callee qname
+    path: str
+    line: int
+    col: int
+    held: Tuple[str, ...]
+
+
+class BlockSite(NamedTuple):
+    kind: str                 # condition-wait|socket|subprocess|flock|queue
+    text: str                 # call text, for messages
+    lock_id: Optional[str]    # the waited condition's own lock
+    path: str
+    line: int
+    col: int
+    held: Tuple[str, ...]
+
+
+class AttrAccess(NamedTuple):
+    attr: str
+    is_write: bool
+    path: str
+    line: int
+    col: int
+    held: Tuple[str, ...]
+    func: str                 # method qname
+
+
+class ModuleInfo:
+    def __init__(self, path: str, name: str, is_pkg: bool, tree):
+        self.path = path
+        self.name = name
+        self.is_pkg = is_pkg
+        self.tree = tree
+        self.imports: Dict[str, str] = {}      # local name -> dotted
+        self.classes: Dict[str, str] = {}      # name -> class qname
+        self.functions: Dict[str, str] = {}    # name -> func qname
+        self.module_locks: Dict[str, str] = {} # name -> lock_id
+        self.var_type_texts: Dict[str, str] = {}  # var -> ctor text
+        self.var_types: Dict[str, str] = {}    # var -> class qname
+
+
+class ClassInfo:
+    def __init__(self, qname: str, node: ast.ClassDef, module: ModuleInfo):
+        self.qname = qname
+        self.node = node
+        self.module = module
+        self.methods: Dict[str, str] = {}      # name -> func qname
+        self.properties: Dict[str, str] = {}   # name -> getter qname
+        self.base_texts: List[str] = [_unparse(b) for b in node.bases]
+        self.bases: List[str] = []             # resolved class qnames
+        self.lock_attrs: Dict[str, str] = {}   # attr -> lock_id
+        self.attr_type_texts: Dict[str, str] = {}  # attr -> ctor text
+        self.attr_ann_texts: Dict[str, str] = {}   # attr -> annotation
+        self.attr_types: Dict[str, str] = {}   # attr -> class qname
+
+
+class FuncInfo:
+    def __init__(self, qname, node, module, cls=None, parent=None):
+        self.qname = qname
+        self.node = node
+        self.module = module
+        self.cls: Optional[ClassInfo] = cls
+        self.parent: Optional["FuncInfo"] = parent
+        self.local_locks: Dict[str, str] = {}
+        self.var_types: Dict[str, str] = {}    # local var -> class qname
+        self.acquires: List[AcquireSite] = []
+        self.calls: List[CallSite] = []
+        self.blocks: List[BlockSite] = []
+        self.attr_accesses: List[AttrAccess] = []
+        self.entry_held: frozenset = frozenset()
+
+    @property
+    def is_private(self) -> bool:
+        last = self.qname.rsplit(".", 1)[-1]
+        return last.startswith("_") and not last.startswith("__")
+
+
+class LockEdge(NamedTuple):
+    src: str
+    dst: str
+    path: str
+    line: int
+    via: Tuple[str, ...]      # call chain, outermost first ("" = direct)
+
+
+class Program:
+    """Whole-corpus index + interprocedural concurrency facts."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.locks: Dict[str, LockInfo] = {}
+        # Derived facts (populated by _finalize):
+        self.may_acquire: Dict[str, Dict[str, Tuple[Tuple[str, ...]]]] = {}
+        self.may_block: Dict[str, Dict[tuple, tuple]] = {}
+        self.lock_edges: Dict[Tuple[str, str], LockEdge] = {}
+
+    # ------------------------------------------------------------------
+    # construction
+
+    @classmethod
+    def build(cls, files) -> "Program":
+        """Build from an iterable of ``(repo_relative_path, source)``.
+        Files that do not parse are skipped (the per-file pass already
+        reports them)."""
+        prog = cls()
+        for path, src in files:
+            try:
+                tree = ast.parse(src, filename=path)
+            except SyntaxError:
+                continue
+            name, is_pkg = _module_name(path)
+            prog.modules[name] = ModuleInfo(path, name, is_pkg, tree)
+        for mod in prog.modules.values():
+            prog._index_module(mod)
+        prog._resolve_types()
+        for fn in list(prog.functions.values()):
+            prog._walk_function(fn)
+        prog._finalize()
+        return prog
+
+    def _index_module(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    mod.imports[bound] = (alias.name if alias.asname
+                                          else alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = self._import_base(mod, node)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    mod.imports[bound] = f"{base}.{alias.name}"
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._index_class(mod, stmt)
+            elif isinstance(stmt, _FUNC_NODES):
+                self._index_function(mod, stmt)
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if not isinstance(tgt, ast.Name):
+                    continue
+                kind = self._lock_factory(stmt.value)
+                if kind:
+                    lid = f"{mod.name}.{tgt.id}"
+                    mod.module_locks[tgt.id] = lid
+                    self.locks[lid] = LockInfo(lid, kind, mod.path,
+                                               stmt.lineno)
+                elif isinstance(stmt.value, ast.Call):
+                    mod.var_type_texts[tgt.id] = _unparse(stmt.value.func)
+
+    def _import_base(self, mod: ModuleInfo, node: ast.ImportFrom):
+        if node.level == 0:
+            return node.module
+        pkg = mod.name.split(".")
+        if not mod.is_pkg:
+            pkg = pkg[:-1]
+        drop = node.level - 1
+        if drop > len(pkg):
+            return None
+        if drop:
+            pkg = pkg[:-drop]
+        if node.module:
+            pkg = pkg + [node.module]
+        return ".".join(pkg) if pkg else None
+
+    def _index_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        qname = f"{mod.name}.{node.name}"
+        ci = ClassInfo(qname, node, mod)
+        mod.classes[node.name] = qname
+        self.classes[qname] = ci
+        for item in node.body:
+            if (isinstance(item, ast.AnnAssign)
+                    and isinstance(item.target, ast.Name)):
+                ann = item.annotation
+                ci.attr_ann_texts.setdefault(item.target.id, (
+                    ann.value if isinstance(ann, ast.Constant)
+                    and isinstance(ann.value, str) else _unparse(ann)))
+                continue
+            if not isinstance(item, _FUNC_NODES):
+                continue
+            fq = f"{qname}.{item.name}"
+            ci.methods[item.name] = fq
+            for dec in item.decorator_list:
+                if _unparse(dec) == "property":
+                    ci.properties[item.name] = fq
+            self.functions[fq] = FuncInfo(fq, item, mod, cls=ci)
+            self._index_nested(self.functions[fq])
+            self._scan_self_assigns(ci, item)
+        self._resolve_condition_aliases(ci)
+
+    def _index_function(self, mod, node, parent=None) -> None:
+        if parent is None:
+            qname = f"{mod.name}.{node.name}"
+            mod.functions[node.name] = qname
+        else:
+            qname = f"{parent.qname}.{node.name}"
+        fi = FuncInfo(qname, node, mod,
+                      cls=parent.cls if parent else None, parent=parent)
+        self.functions[qname] = fi
+        self._index_nested(fi)
+
+    def _index_nested(self, fi: FuncInfo) -> None:
+        for stmt in fi.node.body:
+            self._index_nested_stmt(fi, stmt)
+
+    def _index_nested_stmt(self, fi: FuncInfo, stmt) -> None:
+        if isinstance(stmt, _FUNC_NODES):
+            self._index_function(fi.module, stmt, parent=fi)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, (ast.stmt,)):
+                self._index_nested_stmt(fi, child)
+
+    def _lock_factory(self, value) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            return _LOCK_FACTORIES.get(_unparse(value.func))
+        return None
+
+    def _scan_self_assigns(self, ci: ClassInfo, meth) -> None:
+        """Record ``self.X = <lock factory>``, ``self.X = Ctor(...)``,
+        and ``self.X = <annotated param>`` from any method body
+        (``__init__`` in practice)."""
+        param_anns: Dict[str, str] = {}
+        args = meth.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            if a.annotation is not None:
+                ann = a.annotation
+                param_anns[a.arg] = (
+                    ann.value if isinstance(ann, ast.Constant)
+                    and isinstance(ann.value, str) else _unparse(ann))
+        for node in ast.walk(meth):
+            if isinstance(node, ast.AnnAssign):
+                tgt = node.target
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    ann = node.annotation
+                    ci.attr_ann_texts.setdefault(tgt.attr, (
+                        ann.value if isinstance(ann, ast.Constant)
+                        and isinstance(ann.value, str)
+                        else _unparse(ann)))
+                continue
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            kind = self._lock_factory(node.value)
+            if kind:
+                lid = f"{ci.qname}.{tgt.attr}"
+                # Condition(self._lock) aliases are resolved after all
+                # attrs of the class are known; stash the raw node.
+                ci.lock_attrs.setdefault(tgt.attr, lid)
+                if lid not in self.locks:
+                    self.locks[lid] = LockInfo(lid, kind, ci.module.path,
+                                               node.lineno)
+            elif isinstance(node.value, ast.Call):
+                ci.attr_type_texts.setdefault(
+                    tgt.attr, _unparse(node.value.func))
+            elif (isinstance(node.value, ast.Name)
+                  and node.value.id in param_anns):
+                ci.attr_ann_texts.setdefault(
+                    tgt.attr, param_anns[node.value.id])
+
+    def _resolve_condition_aliases(self, ci: ClassInfo) -> None:
+        """``self._cond = threading.Condition(self._lock)`` makes
+        ``_cond`` and ``_lock`` the same lock node."""
+        for meth_name, fq in ci.methods.items():
+            meth = self.functions[fq].node
+            for node in ast.walk(meth):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                tgt = node.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                if self._lock_factory(node.value) != "Condition":
+                    continue
+                call = node.value
+                if not call.args:
+                    continue
+                arg = call.args[0]
+                if (isinstance(arg, ast.Attribute)
+                        and isinstance(arg.value, ast.Name)
+                        and arg.value.id == "self"
+                        and arg.attr in ci.lock_attrs
+                        and arg.attr != tgt.attr):
+                    canon = ci.lock_attrs[arg.attr]
+                    old = ci.lock_attrs.get(tgt.attr)
+                    ci.lock_attrs[tgt.attr] = canon
+                    if old and old != canon:
+                        self.locks.pop(old, None)
+
+    # ------------------------------------------------------------------
+    # resolution
+
+    def _module_by_name(self, dotted: str) -> Optional[ModuleInfo]:
+        if dotted in self.modules:
+            return self.modules[dotted]
+        hits = [m for n, m in self.modules.items()
+                if n.endswith("." + dotted)]
+        return hits[0] if len(hits) == 1 else None
+
+    def resolve_dotted(self, dotted: str, depth: int = 0):
+        """Resolve a dotted target to ("module"|"class"|"func"|"lock",
+        qname), following one re-export hop."""
+        if not dotted or depth > 4:
+            return None
+        m = self._module_by_name(dotted)
+        if m is not None:
+            return ("module", m.name)
+        head, _, last = dotted.rpartition(".")
+        m = self._module_by_name(head) if head else None
+        if m is None:
+            return None
+        if last in m.classes:
+            return ("class", m.classes[last])
+        if last in m.functions:
+            return ("func", m.functions[last])
+        if last in m.module_locks:
+            return ("lock", m.module_locks[last])
+        hop = m.imports.get(last)
+        if hop:
+            return self.resolve_dotted(hop, depth + 1)
+        return None
+
+    def resolve_symbol(self, mod: ModuleInfo, name: str):
+        if name in mod.classes:
+            return ("class", mod.classes[name])
+        if name in mod.functions:
+            return ("func", mod.functions[name])
+        if name in mod.module_locks:
+            return ("lock", mod.module_locks[name])
+        target = mod.imports.get(name)
+        if target is None:
+            return None
+        return self.resolve_dotted(target)
+
+    def _resolve_types(self) -> None:
+        for ci in self.classes.values():
+            for text in ci.base_texts:
+                sym = self._resolve_callable_text(ci.module, text)
+                if sym and sym[0] == "class":
+                    ci.bases.append(sym[1])
+            for attr, text in ci.attr_type_texts.items():
+                sym = self._resolve_callable_text(ci.module, text)
+                if sym and sym[0] == "class":
+                    ci.attr_types[attr] = sym[1]
+            for attr, text in ci.attr_ann_texts.items():
+                cq = self._class_from_ann_text(ci.module, text)
+                if cq and attr not in ci.attr_types:
+                    ci.attr_types[attr] = cq
+        for mod in self.modules.values():
+            for var, text in mod.var_type_texts.items():
+                sym = self._resolve_callable_text(mod, text)
+                if sym and sym[0] == "class":
+                    mod.var_types[var] = sym[1]
+
+    def _resolve_callable_text(self, mod: ModuleInfo, text: str):
+        if not text:
+            return None
+        if "." not in text:
+            return self.resolve_symbol(mod, text)
+        head, _, last = text.rpartition(".")
+        sym = self.resolve_symbol(mod, head) if "." not in head else None
+        if sym and sym[0] == "module":
+            m = self.modules[sym[1]]
+            if last in m.classes:
+                return ("class", m.classes[last])
+            if last in m.functions:
+                return ("func", m.functions[last])
+            hop = m.imports.get(last)
+            if hop:
+                return self.resolve_dotted(hop)
+        return self.resolve_dotted(text)
+
+    def _mro(self, class_qname: str) -> List[str]:
+        out, todo = [], [class_qname]
+        while todo:
+            q = todo.pop(0)
+            if q in out or q not in self.classes:
+                continue
+            out.append(q)
+            todo.extend(self.classes[q].bases)
+        return out
+
+    def find_method(self, class_qname: str, name: str) -> Optional[str]:
+        for q in self._mro(class_qname):
+            fq = self.classes[q].methods.get(name)
+            if fq:
+                return fq
+        return None
+
+    def find_property(self, class_qname: str, name: str) -> Optional[str]:
+        for q in self._mro(class_qname):
+            fq = self.classes[q].properties.get(name)
+            if fq:
+                return fq
+        return None
+
+    def find_lock_attr(self, class_qname: str, attr: str) -> Optional[str]:
+        for q in self._mro(class_qname):
+            lid = self.classes[q].lock_attrs.get(attr)
+            if lid:
+                return lid
+        return None
+
+    def _ann_class(self, fi: FuncInfo, ann) -> Optional[str]:
+        if ann is None:
+            return None
+        text = (ann.value if isinstance(ann, ast.Constant)
+                and isinstance(ann.value, str) else _unparse(ann))
+        return self._class_from_ann_text(fi.module, text)
+
+    def _class_from_ann_text(self, mod: ModuleInfo,
+                             text: str) -> Optional[str]:
+        for word in _iter_identifiers(text):
+            if word in _ANN_NOISE:
+                continue
+            sym = self.resolve_symbol(mod, word)
+            if sym and sym[0] == "class":
+                return sym[1]
+            hits = [q for q in self.classes
+                    if q.rsplit(".", 1)[-1] == word]
+            if len(hits) == 1:
+                return hits[0]
+        return None
+
+    def _infer_local_types(self, fi: FuncInfo) -> None:
+        args = fi.node.args
+        all_args = (args.posonlyargs + args.args + args.kwonlyargs
+                    + [a for a in (args.vararg, args.kwarg) if a])
+        for a in all_args:
+            cq = self._ann_class(fi, a.annotation)
+            if cq:
+                fi.var_types[a.arg] = cq
+        seen_conflict = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, _FUNC_NODES) and node is not fi.node:
+                continue
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name) or tgt.id in seen_conflict:
+                continue
+            cq = None
+            kind = self._lock_factory(node.value)
+            if kind:
+                lid = f"{fi.qname}.{tgt.id}"
+                fi.local_locks[tgt.id] = lid
+                self.locks.setdefault(lid, LockInfo(
+                    lid, kind, fi.module.path, node.lineno))
+                continue
+            if isinstance(node.value, ast.Call):
+                sym = self._resolve_callable_text(
+                    fi.module, _unparse(node.value.func))
+                if sym and sym[0] == "class":
+                    cq = sym[1]
+            elif (isinstance(node.value, ast.Attribute)
+                  and isinstance(node.value.value, ast.Name)
+                  and node.value.value.id == "self" and fi.cls):
+                cq = self._lookup_attr_type(fi.cls.qname, node.value.attr)
+            if cq:
+                if tgt.id in fi.var_types and fi.var_types[tgt.id] != cq:
+                    seen_conflict.add(tgt.id)
+                    fi.var_types.pop(tgt.id, None)
+                else:
+                    fi.var_types[tgt.id] = cq
+
+    def _lookup_attr_type(self, class_qname, attr) -> Optional[str]:
+        for q in self._mro(class_qname):
+            cq = self.classes[q].attr_types.get(attr)
+            if cq:
+                return cq
+        return None
+
+    def _receiver_class(self, fi: FuncInfo, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and fi.cls:
+                return fi.cls.qname
+            scope = fi
+            while scope:
+                if expr.id in scope.var_types:
+                    return scope.var_types[expr.id]
+                scope = scope.parent
+            if expr.id in fi.module.var_types:
+                return fi.module.var_types[expr.id]
+            sym = self.resolve_symbol(fi.module, expr.id)
+            if sym and sym[0] == "class":
+                return None   # a class object, not an instance
+        elif isinstance(expr, ast.Attribute):
+            if (isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self" and fi.cls):
+                return self._lookup_attr_type(fi.cls.qname, expr.attr)
+            base_cq = self._receiver_class(fi, expr.value)
+            if base_cq:
+                return self._lookup_attr_type(base_cq, expr.attr)
+        return None
+
+    def resolve_lock(self, fi: FuncInfo, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            scope = fi
+            while scope:
+                if expr.id in scope.local_locks:
+                    return scope.local_locks[expr.id]
+                scope = scope.parent
+            if expr.id in fi.module.module_locks:
+                return fi.module.module_locks[expr.id]
+            sym = self.resolve_symbol(fi.module, expr.id)
+            if sym and sym[0] == "lock":
+                return sym[1]
+        elif isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self" and fi.cls:
+                return self.find_lock_attr(fi.cls.qname, expr.attr)
+            if isinstance(base, ast.Name):
+                sym = self.resolve_symbol(fi.module, base.id)
+                if sym and sym[0] == "module":
+                    return self.modules[sym[1]].module_locks.get(expr.attr)
+            cq = self._receiver_class(fi, base)
+            if cq:
+                return self.find_lock_attr(cq, expr.attr)
+        return None
+
+    def resolve_call(self, fi: FuncInfo, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            scope = fi.parent
+            while scope:
+                nested = f"{scope.qname}.{func.id}"
+                if nested in self.functions:
+                    return nested
+                scope = scope.parent
+            nested = f"{fi.qname}.{func.id}"
+            if nested in self.functions:
+                return nested
+            sym = self.resolve_symbol(fi.module, func.id)
+            if sym and sym[0] == "func":
+                return sym[1]
+            if sym and sym[0] == "class":
+                return self.find_method(sym[1], "__init__")
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            sym = self.resolve_symbol(fi.module, base.id)
+            if sym and sym[0] == "module":
+                m = self.modules[sym[1]]
+                if func.attr in m.functions:
+                    return m.functions[func.attr]
+                if func.attr in m.classes:
+                    return self.find_method(m.classes[func.attr],
+                                            "__init__")
+                hop = m.imports.get(func.attr)
+                if hop:
+                    r = self.resolve_dotted(hop)
+                    if r and r[0] == "func":
+                        return r[1]
+                    if r and r[0] == "class":
+                        return self.find_method(r[1], "__init__")
+                return None
+            if sym and sym[0] == "class":
+                return self.find_method(sym[1], func.attr)
+        cq = self._receiver_class(fi, base)
+        if cq:
+            return self.find_method(cq, func.attr)
+        return None
+
+    # ------------------------------------------------------------------
+    # per-function walk
+
+    def _walk_function(self, fi: FuncInfo) -> None:
+        self._infer_local_types(fi)
+        self._visit_stmts(fi, fi.node.body, ())
+
+    def _visit_stmts(self, fi, stmts, held: Tuple[str, ...]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = list(held)
+                for item in stmt.items:
+                    lid = self.resolve_lock(fi, item.context_expr)
+                    if lid is not None:
+                        ce = item.context_expr
+                        fi.acquires.append(AcquireSite(
+                            lid, fi.module.path, ce.lineno, ce.col_offset,
+                            tuple(inner)))
+                        if lid not in inner:
+                            inner.append(lid)
+                    else:
+                        self._visit_expr(fi, item.context_expr,
+                                         tuple(inner))
+                self._visit_stmts(fi, stmt.body, tuple(inner))
+            elif isinstance(stmt, _FUNC_NODES + (ast.ClassDef,)):
+                continue   # nested defs analyzed as their own functions
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._visit_expr(fi, stmt.test, held)
+                self._visit_stmts(fi, stmt.body, held)
+                self._visit_stmts(fi, stmt.orelse, held)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._visit_expr(fi, stmt.iter, held)
+                self._visit_expr(fi, stmt.target, held)
+                self._visit_stmts(fi, stmt.body, held)
+                self._visit_stmts(fi, stmt.orelse, held)
+            elif isinstance(stmt, ast.Try):
+                self._visit_stmts(fi, stmt.body, held)
+                for h in stmt.handlers:
+                    self._visit_stmts(fi, h.body, held)
+                self._visit_stmts(fi, stmt.orelse, held)
+                self._visit_stmts(fi, stmt.finalbody, held)
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.expr):
+                        self._visit_expr(fi, child, held)
+
+    def _visit_expr(self, fi: FuncInfo, expr, held) -> None:
+        for node in _expr_nodes(expr):
+            if isinstance(node, ast.Call):
+                self._note_call(fi, node, held)
+            elif isinstance(node, ast.Attribute):
+                self._note_attribute(fi, node, held)
+
+    def _note_call(self, fi: FuncInfo, call: ast.Call, held) -> None:
+        blk = self._blocking_descriptor(fi, call)
+        if blk is not None:
+            kind, lock_id = blk
+            fi.blocks.append(BlockSite(
+                kind, _unparse(call.func), lock_id, fi.module.path,
+                call.lineno, call.col_offset, held))
+        target = self.resolve_call(fi, call)
+        if target is not None and target != fi.qname:
+            fi.calls.append(CallSite(target, fi.module.path, call.lineno,
+                                     call.col_offset, held))
+
+    def _note_attribute(self, fi: FuncInfo, node: ast.Attribute,
+                        held) -> None:
+        if not (isinstance(node.value, ast.Name)
+                and node.value.id == "self" and fi.cls):
+            # property getter on a resolved foreign receiver is a call
+            if isinstance(node.ctx, ast.Load):
+                cq = self._receiver_class(fi, node.value)
+                if cq:
+                    prop = self.find_property(cq, node.attr)
+                    if prop:
+                        fi.calls.append(CallSite(
+                            prop, fi.module.path, node.lineno,
+                            node.col_offset, held))
+            return
+        is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+        fi.attr_accesses.append(AttrAccess(
+            node.attr, is_write, fi.module.path, node.lineno,
+            node.col_offset, held, fi.qname))
+
+    def _blocking_descriptor(self, fi, call: ast.Call):
+        func = call.func
+        if isinstance(func, ast.Name):
+            return ("flock", None) if func.id == "flock" else None
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv_last = _unparse(func.value).rsplit(".", 1)[-1].lower()
+        if attr in ("wait", "wait_for"):
+            lid = self.resolve_lock(fi, func.value)
+            if lid is not None:
+                return ("condition-wait", lid)
+            if any(h in recv_last for h in _PROC_RECEIVER_HINTS):
+                return ("subprocess", None)
+            return None
+        if attr == "communicate":
+            return ("subprocess", None)
+        if attr in ("recv", "recvfrom", "recv_into", "accept"):
+            return ("socket", None)
+        if attr == "flock":
+            return ("flock", None)
+        if attr in ("get", "put"):
+            if not _queueish(recv_last):
+                return None
+            # Queue.get takes (block, timeout); a first positional arg
+            # that is not a bool literal means dict-style .get(key).
+            if attr == "get" and call.args and not (
+                    isinstance(call.args[0], ast.Constant)
+                    and isinstance(call.args[0].value, bool)):
+                return None
+            for kw in call.keywords:
+                if (kw.arg == "block"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False):
+                    return None
+            first = call.args[1] if attr == "put" and len(call.args) > 1 \
+                else (call.args[0] if attr == "get" and call.args else None)
+            if isinstance(first, ast.Constant) and first.value is False:
+                return None
+            return ("queue", None)
+        return None
+
+    # ------------------------------------------------------------------
+    # fixpoints
+
+    def _finalize(self) -> None:
+        callsites: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+        for fi in self.functions.values():
+            for cs in fi.calls:
+                callsites.setdefault(cs.target, []).append(
+                    (fi.qname, cs.held))
+
+        # Entry-held: a private helper's floor is the intersection of
+        # held sets over every internal call site.  Public functions
+        # and uncalled helpers get the empty set — never assume a
+        # caller's lock for an API boundary.
+        entry: Dict[str, Optional[frozenset]] = {}
+        for q, fi in self.functions.items():
+            if fi.is_private and callsites.get(q):
+                entry[q] = None            # TOP, to be narrowed
+            else:
+                entry[q] = frozenset()
+        for _ in range(50):
+            changed = False
+            for q in entry:
+                if entry[q] is not None and not self.functions[q].is_private:
+                    continue
+                sites = callsites.get(q)
+                if not sites or not self.functions[q].is_private:
+                    continue
+                acc: Optional[frozenset] = None
+                for caller, held in sites:
+                    ch = entry.get(caller, frozenset())
+                    if ch is None:
+                        continue           # caller still TOP: optimistic
+                    contrib = frozenset(held) | ch
+                    acc = contrib if acc is None else (acc & contrib)
+                if acc is None:
+                    continue
+                if entry[q] is None or entry[q] != acc:
+                    if entry[q] is None or acc < entry[q]:
+                        entry[q] = acc
+                        changed = True
+            if not changed:
+                break
+        for q, fi in self.functions.items():
+            fi.entry_held = entry[q] if entry[q] is not None else frozenset()
+
+        # may-acquire: lock -> (via chain) per function, transitively.
+        macq: Dict[str, Dict[str, Tuple[str, ...]]] = {
+            q: {a.lock_id: () for a in fi.acquires}
+            for q, fi in self.functions.items()}
+        for _ in range(50):
+            changed = False
+            for q, fi in self.functions.items():
+                for cs in fi.calls:
+                    sub = macq.get(cs.target)
+                    if not sub:
+                        continue
+                    for lid, via in sub.items():
+                        if lid not in macq[q]:
+                            macq[q][lid] = (cs.target,) + via
+                            changed = True
+            if not changed:
+                break
+        self.may_acquire = macq
+
+        # may-block: (kind, lock) -> (text, via chain) per function.
+        mblk: Dict[str, Dict[tuple, tuple]] = {}
+        for q, fi in self.functions.items():
+            mblk[q] = {}
+            for b in fi.blocks:
+                mblk[q].setdefault((b.kind, b.lock_id), (b.text, ()))
+        for _ in range(50):
+            changed = False
+            for q, fi in self.functions.items():
+                for cs in fi.calls:
+                    for key, (text, via) in mblk.get(cs.target, {}).items():
+                        if key not in mblk[q]:
+                            mblk[q][key] = (text, (cs.target,) + via)
+                            changed = True
+            if not changed:
+                break
+        self.may_block = mblk
+
+        # Lock-order edges: A -> B when B is acquired (directly or via
+        # a resolved call) while A is held.  Self-edges are kept for
+        # the graph dump but never treated as cycles (class-granular
+        # lock identity conflates instances).
+        edges: Dict[Tuple[str, str], LockEdge] = {}
+
+        def add_edge(a, b, path, line, via):
+            key = (a, b)
+            prior = edges.get(key)
+            if prior is None or (path, line) < (prior.path, prior.line):
+                edges[key] = LockEdge(a, b, path, line, via)
+
+        for q, fi in self.functions.items():
+            for acq in fi.acquires:
+                for a in set(acq.held) | fi.entry_held:
+                    if a != acq.lock_id:
+                        add_edge(a, acq.lock_id, acq.path, acq.line, ())
+            for cs in fi.calls:
+                h = set(cs.held) | fi.entry_held
+                if not h:
+                    continue
+                for lid, via in macq.get(cs.target, {}).items():
+                    for a in h:
+                        if a != lid:
+                            add_edge(a, lid, cs.path, cs.line,
+                                     (cs.target,) + via)
+        self.lock_edges = edges
+
+    # ------------------------------------------------------------------
+    # cycle detection
+
+    def lock_cycles(self) -> List[List[str]]:
+        """Elementary cycles (as node lists, first node repeated last is
+        implied) among the non-self lock-order edges, one per SCC."""
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.lock_edges:
+            if a == b:
+                continue
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        for v in adj.values():
+            v.sort()
+        sccs = _tarjan(adj)
+        cycles = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            cycles.append(_cycle_in_scc(adj, sorted(scc)))
+        cycles.sort()
+        return cycles
+
+
+def _iter_identifiers(text: str):
+    word = []
+    for ch in text + " ":
+        if ch.isalnum() or ch == "_":
+            word.append(ch)
+        else:
+            if word and not word[0].isdigit():
+                yield "".join(word)
+            word = []
+
+
+def _tarjan(adj: Dict[str, List[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: set = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recurse = False
+            succs = adj.get(node, [])
+            while pi < len(succs):
+                w = succs[pi]
+                pi += 1
+                if w not in index:
+                    work[-1] = (node, pi)
+                    work.append((w, 0))
+                    recurse = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if recurse:
+                continue
+            work[-1] = (node, pi)
+            if pi >= len(succs):
+                work.pop()
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    out.append(comp)
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def _cycle_in_scc(adj: Dict[str, List[str]], scc: List[str]) -> List[str]:
+    """A concrete elementary cycle inside a non-trivial SCC, starting
+    from its lexicographically smallest node (deterministic)."""
+    members = set(scc)
+    start = scc[0]
+    path = [start]
+    seen = {start}
+
+    def dfs(node):
+        for nxt in adj.get(node, []):
+            if nxt not in members:
+                continue
+            if nxt == start and len(path) > 1:
+                return True
+            if nxt in seen:
+                continue
+            path.append(nxt)
+            seen.add(nxt)
+            if dfs(nxt):
+                return True
+            path.pop()
+            seen.discard(nxt)
+        return False
+
+    dfs(start)
+    return path

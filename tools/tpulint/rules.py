@@ -1,11 +1,21 @@
-"""The nineteen tpulint rules.
+"""The nineteen per-file tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
-(ADVICE.md round-5 findings, BASELINE.md reconciliations). Rules are
-pure-AST heuristics: they under-approximate (no cross-module dataflow)
-and occasionally over-approximate (a reviewed-legitimate site carries a
+(ADVICE.md round-5 findings, BASELINE.md reconciliations). Rules here
+are pure-AST heuristics judging one file at a time: they
+under-approximate anything that spans modules and occasionally
+over-approximate (a reviewed-legitimate site carries a
 ``# tpulint: disable=<rule>`` pragma that doubles as documentation).
+Cross-module properties — lock ordering, blocking calls reached through
+call chains, guard inference over a class's access sites — are NOT in
+scope for these rules; they belong to the whole-program rules in
+``tools/tpulint/concurrency.py``, which run on the
+``tools/tpulint/flows.py`` engine (one parse of the entire corpus, a
+module-level call graph, a lock registry, and held-set propagation
+through ``with`` blocks and intra-package calls). That engine still
+sees no dynamic dispatch beyond annotation/constructor type inference
+and nothing outside the linted corpus.
 
 A rule is a ``Rule(name, description, check)`` where ``check`` maps a
 ``FileContext`` to ``RawFinding``s; the engine layers pragma and
