@@ -1313,6 +1313,187 @@ def _fleet_block() -> dict:
     return block
 
 
+def _cluster_block() -> dict:
+    """The BENCH_*.json ``cluster`` block: the cross-host serving mesh
+    story (runtime/cluster.py). Three questions: what does partitioned
+    serving scale like (closed-loop q1 partial fan-out/merge rounds per
+    second at 1, 2 and 4 simulated hosts, supervisor memo and worker
+    result cache pinned OFF so every shard query really executes, plus
+    the efficiency of each host count against the 1-host mesh), what
+    does locality buy (same shard served by routing the query to the
+    owning host versus shipping the shard's bytes in the bindings every
+    query — the "ship the query, not the shard" ratio), and what does a
+    HOST death cost (SIGKILL of the host owning the hot shard
+    mid-query: detection + shard re-home + re-execute to the
+    bit-identical failed-over partial, p50/max over several kills on
+    fresh meshes). Leaked bytes after the chaos round must be zero."""
+    block: dict = {}
+    try:
+        import signal as _signal
+
+        import numpy as np
+
+        from spark_rapids_jni_tpu.models import tpch
+        from spark_rapids_jni_tpu.parallel import dcn as _dcn
+        from spark_rapids_jni_tpu.ops.table_ops import (
+            concatenate as _concat, trim_table as _trim)
+        from spark_rapids_jni_tpu.runtime import cluster as _cluster
+        from spark_rapids_jni_tpu.runtime import fleet as _fleet
+        from spark_rapids_jni_tpu.runtime import fusion as _fusion
+        from spark_rapids_jni_tpu.runtime import resultcache as _rc
+        from spark_rapids_jni_tpu.utils.config import (
+            reset_option, set_option)
+
+        rows = 1 << 12
+        keys = [4, 5]  # l_returnflag, l_linestatus — the q1 group keys
+        li = tpch.lineitem_table(rows, seed=3)
+        partial = tpch._q1_partial_plan()
+
+        def _merge(results):
+            parts = [_trim(r.table,
+                           int(np.asarray(r.meta["partial.num_groups"])))
+                     for r in results]
+            res = _fusion.execute(tpch._q1_merge_plan(),
+                                  {"partials": _concat(parts)})
+            return _trim(res.table,
+                         int(np.asarray(res.meta["merge.num_groups"])))
+
+        # memo + worker result cache off: this block measures the mesh's
+        # routing/transport/merge path, not cache hits
+        set_option("fleet.result_memo_entries", 0)
+        set_option("fleet.heartbeat_interval_s", 0.1)
+        set_option("fleet.restart_backoff_s", 0.1)
+        no_cache = {"SPARK_RAPIDS_TPU_CACHE_ENABLED": "0"}
+        try:
+            iters = 3
+            for n_hosts in (1, 2, 4):
+                with _cluster.QueryCluster(n_hosts,
+                                           worker_env=no_cache) as c:
+                    if c.wait_live(timeout=120) < n_hosts:
+                        continue
+                    c.register_table("lineitem", li, keys=keys)
+                    # pay every host's compile outside the clock
+                    c.submit_merge("warm", partial, _merge,
+                                   table="lineitem",
+                                   binding="chunk").result(timeout=300)
+                    t0 = time.perf_counter()
+                    for i in range(iters):
+                        c.submit_merge(f"bench{i}", partial, _merge,
+                                       table="lineitem",
+                                       binding="chunk").result(timeout=300)
+                    wall = time.perf_counter() - t0
+                    block[f"hosts_{n_hosts}"] = {
+                        "fanouts": iters,
+                        "fanouts_per_s": round(iters / wall, 2)
+                        if wall else None,
+                    }
+            base = block.get("hosts_1", {}).get("fanouts_per_s")
+            for n_hosts in (2, 4):
+                got = block.get(f"hosts_{n_hosts}", {}).get("fanouts_per_s")
+                if base and got:
+                    block[f"scale_efficiency_hosts_{n_hosts}"] = round(
+                        got / base, 2)
+
+            # locality: the same shard served by routing the query to the
+            # owner vs shipping the shard's bytes in the bindings
+            with _cluster.QueryCluster(2, worker_env=no_cache) as c:
+                if c.wait_live(timeout=120) == 2:
+                    c.register_table("lineitem", li, keys=keys)
+                    shard0 = _dcn.partition_for_slices(li, keys, 2)[0]
+                    # warm both paths' compiles off the clock
+                    c.submit_to_shard("lwarm", partial, table="lineitem",
+                                      binding="chunk",
+                                      part=0).result(timeout=300)
+                    c.submit("swarm", partial,
+                             {"chunk": shard0}).result(timeout=300)
+                    t0 = time.perf_counter()
+                    for i in range(iters):
+                        c.submit_to_shard(f"loc{i}", partial,
+                                          table="lineitem",
+                                          binding="chunk",
+                                          part=0).result(timeout=300)
+                    local_wall = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    for i in range(iters):
+                        c.submit(f"ship{i}", partial,
+                                 {"chunk": shard0}).result(timeout=300)
+                    ship_wall = time.perf_counter() - t0
+                    if local_wall and ship_wall:
+                        block["locality"] = {
+                            "routed_qps": round(iters / local_wall, 2),
+                            "shipped_qps": round(iters / ship_wall, 2),
+                            "routed_over_shipped": round(
+                                ship_wall / local_wall, 2),
+                        }
+
+            # host-failover recovery: hold the hot shard's query on its
+            # owning host, SIGKILL that host, and time kill -> the
+            # bit-identical re-homed result on the survivor. Fresh mesh
+            # per kill: a re-homed shard would otherwise dodge the next
+            # kill (the survivor has no serve hold).
+            shard0 = _dcn.partition_for_slices(li, keys, 2)[0]
+            ref_fp = _rc.table_fingerprint(
+                _fusion.execute(partial, {"chunk": shard0}).table)
+            hold_ms = 2000.0
+            recoveries = []
+            leaked = None
+            for k in range(3):
+                with _cluster.QueryCluster(2, worker_env=no_cache,
+                                           per_replica_env={"h0": {
+                                               _fleet._ENV_SERVE_DELAY:
+                                                   str(hold_ms)}}) as c:
+                    if c.wait_live(timeout=120) < 2:
+                        continue
+                    c.register_table("lineitem", li, keys=keys)
+                    h0 = c._host("h0")
+                    tk = c.submit_to_shard("chaos", partial,
+                                           table="lineitem",
+                                           binding="chunk", part=0)
+                    deadline = time.monotonic() + 10
+                    while (time.monotonic() < deadline
+                           and tk.replica != "h0"):
+                        time.sleep(0.01)
+                    time.sleep(0.2)  # inside h0's serve hold
+                    t0 = time.perf_counter()
+                    h0.proc.send_signal(_signal.SIGKILL)
+                    res = tk.result(timeout=300)
+                    if _rc.table_fingerprint(res.table) != ref_fp:
+                        block["failover_identity"] = "MISMATCH"
+                        break
+                    recoveries.append(time.perf_counter() - t0)
+                    time.sleep(0.3)  # one heartbeat for a fresh report
+                    leaked = c.leaked_bytes()
+            if leaked is not None:
+                block["leaked_bytes_after_chaos"] = leaked
+            if recoveries:
+                recoveries.sort()
+                block["failover_kills"] = len(recoveries)
+                block["failover_recovery_ms_p50"] = round(
+                    recoveries[len(recoveries) // 2] * 1e3, 1)
+                block["failover_recovery_ms_max"] = round(
+                    recoveries[-1] * 1e3, 1)
+                block.setdefault("failover_identity", "bit-identical")
+            block["note"] = (
+                "fanouts_per_s: closed-loop q1 partial fan-out + router "
+                "merge over the registered partition map, supervisor "
+                "memo and worker result cache off. locality: same shard "
+                "served by routing the query to its owner vs shipping "
+                "the shard bytes in the bindings (routed_over_shipped "
+                "> 1 means shipping the query won). "
+                "failover_recovery_ms: SIGKILL of the host owning the "
+                "hot shard mid-query to the bit-identical re-homed "
+                "result on the survivor (detection + shard re-home + "
+                "re-execute; the victim's serve-hold is not part of "
+                "the clock)")
+        finally:
+            reset_option("fleet.result_memo_entries")
+            reset_option("fleet.heartbeat_interval_s")
+            reset_option("fleet.restart_backoff_s")
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    return block
+
+
 def _kernels_block() -> dict:
     """The BENCH_*.json ``kernels`` block: the maintained Pallas kernel
     tier (ops/pallas/). For each kernel the same probe-sized workload
@@ -2329,6 +2510,7 @@ def _child_main(config: str, n: int, iters: int) -> None:
                       "integrity": _integrity_block(),
                       "compress": _compress_block(),
                       "fleet": _fleet_block(),
+                      "cluster": _cluster_block(),
                       "kernels": _kernels_block()}))
 
 
@@ -2372,11 +2554,12 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
     dispatch block | None, pipeline block | None, fusion block | None,
     server block | None, cache block | None, degrade block | None,
     integrity block | None, compress block | None, fleet block | None,
-    kernels block | None) — the blocks come from the measured child
-    process's executable cache, overlap probe, whole-stage fusion probe,
-    serving-concurrency probe, result-cache probe, memory-pressure
-    degradation probe, the integrity / columnar-codec seam probes, the
-    replicated-serving fleet probe, and the Pallas kernel-tier probe."""
+    cluster block | None, kernels block | None) — the blocks come from
+    the measured child process's executable cache, overlap probe,
+    whole-stage fusion probe, serving-concurrency probe, result-cache
+    probe, memory-pressure degradation probe, the integrity /
+    columnar-codec seam probes, the replicated-serving fleet probe, the
+    cross-host serving-mesh probe, and the Pallas kernel-tier probe."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CONFIG"] = config
@@ -2394,7 +2577,8 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         )
     except subprocess.TimeoutExpired:
         return (None, f"{platform} bench timed out after {timeout_s:.0f}s",
-                None, None, None, None, None, None, None, None, None, None)
+                None, None, None, None, None, None, None, None, None, None,
+                None)
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
@@ -2410,6 +2594,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         integ = rec.get("integrity") if isinstance(rec, dict) else None
         comp = rec.get("compress") if isinstance(rec, dict) else None
         flt = rec.get("fleet") if isinstance(rec, dict) else None
+        clus = rec.get("cluster") if isinstance(rec, dict) else None
         kern = rec.get("kernels") if isinstance(rec, dict) else None
         return (value, "", disp if isinstance(disp, dict) else None,
                 pipe if isinstance(pipe, dict) else None,
@@ -2420,9 +2605,11 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
                 integ if isinstance(integ, dict) else None,
                 comp if isinstance(comp, dict) else None,
                 flt if isinstance(flt, dict) else None,
+                clus if isinstance(clus, dict) else None,
                 kern if isinstance(kern, dict) else None)
     return (None, f"{platform} bench failed: {_tail(out)}",
-            None, None, None, None, None, None, None, None, None, None)
+            None, None, None, None, None, None, None, None, None, None,
+            None)
 
 
 def main() -> None:
@@ -2448,6 +2635,7 @@ def main() -> None:
     child_integ = None
     child_comp = None
     child_fleet = None
+    child_clus = None
     child_kern = None
     # every run gets a telemetry file (children record through the package
     # via these env vars; the parent appends bench_stale events itself) —
@@ -2489,7 +2677,7 @@ def main() -> None:
                 (value, why, child_disp, child_pipe, child_fus,
                  child_srv, child_cache, child_deg,
                  child_integ, child_comp, child_fleet,
-                 child_kern) = _run_child(
+                 child_clus, child_kern) = _run_child(
                     config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
@@ -2537,7 +2725,7 @@ def main() -> None:
                 (_pv, _pwhy, child_disp, child_pipe, child_fus,
                  child_srv, child_cache, child_deg,
                  child_integ, child_comp, child_fleet,
-                 child_kern) = _run_child(
+                 child_clus, child_kern) = _run_child(
                     config, n, iters, "cpu", child_timeout)
                 if _pv is None and _pwhy:
                     diagnostics.append(f"probe child: {_pwhy}")
@@ -2545,7 +2733,7 @@ def main() -> None:
             (value, why, child_disp, child_pipe, child_fus,
              child_srv, child_cache, child_deg,
              child_integ, child_comp, child_fleet,
-             child_kern) = _run_child(
+             child_clus, child_kern) = _run_child(
                 config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
@@ -2619,6 +2807,12 @@ def main() -> None:
     # leak check), same child-process provenance; empty when no live
     # child ran
     record["fleet"] = child_fleet or {}
+    # cross-host serving-mesh probe (partitioned fan-out/merge rounds/s
+    # at 1/2/4 simulated hosts with scale efficiency, query-routing vs
+    # data-shipping locality ratio, hot-shard host-kill recovery
+    # latency with re-home identity + leak check), same child-process
+    # provenance; empty when no live child ran
+    record["cluster"] = child_clus or {}
     # Pallas kernel-tier probe (per-kernel xla vs pallas steady state,
     # byte-identity between tiers, the full kernels.* decision/fallback
     # counter ledger), same child-process provenance; empty when no

@@ -1,7 +1,7 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its twenty-two invariant rules — nineteen
+# tpulint (tools/tpulint) runs its twenty-three invariant rules — twenty
 # per-file AST rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
@@ -9,7 +9,8 @@
 # error-must-classify, server-telemetry-session-id,
 # reservation-release-in-finally, span-must-scope, payload-must-verify,
 # cache-key-must-fingerprint, compress-inside-seal,
-# worker-exit-must-classify, pallas-kernel-must-have-oracle)
+# worker-exit-must-classify, pallas-kernel-must-have-oracle,
+# placement-must-record)
 # plus three whole-program concurrency rules built on the
 # tools/tpulint/flows.py interprocedural engine (lock-order-cycle,
 # blocking-call-under-lock, unguarded-shared-write) —
@@ -658,6 +659,97 @@ print("fleet smoke OK: SIGKILL mid-query failed over bit-identical, "
       "death classified, victim restarted, 0 leaked bytes")
 EOF
 
+# cluster smoke: rule 23 only proves routing decisions are RECORDED —
+# this proves the mesh itself still honors its contract: two simulated
+# hosts serve a partitioned q1 bit-identical to the single-host
+# reference (ship the query to the shard, merge on the router), then
+# the host owning the hot shard is SIGKILLed mid-query and the query
+# fails over bit-identically — the shard re-homes to the survivor, the
+# host death is classified with host context, and zero bytes leak.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import signal
+import time
+
+import numpy as np
+
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.ops.table_ops import concatenate, trim_table
+from spark_rapids_jni_tpu.parallel import dcn
+from spark_rapids_jni_tpu.runtime import cluster, fusion, resultcache
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+li = tpch.lineitem_table(300)
+
+# single-host reference: the same partial -> merge algebra, one chunk
+pres = fusion.execute(tpch._q1_partial_plan(), {"chunk": li})
+ptrim = trim_table(pres.table, int(np.asarray(pres.meta["partial.num_groups"])))
+mres = fusion.execute(tpch._q1_merge_plan(), {"partials": ptrim})
+ref_fp = resultcache.table_fingerprint(
+    trim_table(mres.table, int(np.asarray(mres.meta["merge.num_groups"]))))
+
+# the shard-0 partial the chaos phase must reproduce bit-for-bit
+shard0 = dcn.partition_for_slices(li, [4, 5], 2)[0]
+shard0_fp = resultcache.table_fingerprint(
+    fusion.execute(tpch._q1_partial_plan(), {"chunk": shard0}).table)
+
+
+def merge(results):
+    parts = [trim_table(r.table, int(np.asarray(r.meta["partial.num_groups"])))
+             for r in results]
+    res = fusion.execute(tpch._q1_merge_plan(), {"partials": concatenate(parts)})
+    return trim_table(res.table, int(np.asarray(res.meta["merge.num_groups"])))
+
+
+set_option("fleet.heartbeat_interval_s", 0.1)
+set_option("fleet.restart_backoff_s", 0.1)
+try:
+    # phase 1: partitioned 2-host serve == single-host reference
+    with cluster.QueryCluster(2) as c:
+        assert c.wait_live(timeout=120) == 2, "cluster never reached 2 live"
+        info = c.register_table("lineitem", li, keys=(4, 5))
+        assert info["owners"] == ["h0", "h1"], info
+        mt = c.submit_merge("smoke", tpch._q1_partial_plan(), merge,
+                            table="lineitem", binding="chunk")
+        got_fp = resultcache.table_fingerprint(mt.result(timeout=120))
+        assert got_fp == ref_fp, "partitioned q1 diverged from single-host"
+        assert REGISTRY.counter("cluster.route_local").value >= 2
+        assert REGISTRY.counter("cluster.merges").value >= 1
+
+    # phase 2: SIGKILL the host owning the hot shard mid-query
+    with cluster.QueryCluster(2, per_replica_env={
+            "h0": {"SPARK_RAPIDS_TPU_FLEET_TEST_SERVE_DELAY_MS": "3000"}},
+            ) as c:
+        assert c.wait_live(timeout=120) == 2, "cluster never reached 2 live"
+        c.register_table("lineitem", li, keys=(4, 5))
+        t = c.submit_to_shard("smoke", tpch._q1_partial_plan(),
+                              table="lineitem", binding="chunk", part=0)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and t.replica != "h0":
+            time.sleep(0.01)
+        assert t.replica == "h0", t.replica
+        time.sleep(0.2)  # inside h0's serve hold
+        deaths0 = REGISTRY.counter("cluster.host_deaths").value
+        c._host("h0").proc.send_signal(signal.SIGKILL)
+        t.result(timeout=120)
+        assert t.status == "served", t.status
+        assert t.dispatches == 2, t.dispatches
+        assert t.replica == "h1", t.replica
+        assert t.fingerprint == shard0_fp, "failed-over shard diverged"
+        assert c._tables["lineitem"].owners[0] == "h1", "shard not re-homed"
+        assert REGISTRY.counter("cluster.host_deaths").value == deaths0 + 1
+        assert REGISTRY.counter("cluster.route_rehomed").value >= 1
+        time.sleep(0.3)  # one heartbeat for fresh leak reports
+        leaked = c.leaked_bytes()
+        assert leaked == 0, f"leaked {leaked} reserved bytes"
+finally:
+    reset_option("fleet.heartbeat_interval_s")
+    reset_option("fleet.restart_backoff_s")
+print("cluster smoke OK: 2-host partitioned q1 == single-host, hot-shard "
+      "SIGKILL failed over bit-identical via re-home, host death "
+      "classified, 0 leaked bytes")
+EOF
+
 # kernel-tier smoke: rule 19 only proves Pallas kernels DECLARE an
 # oracle — this proves the tier itself still honors its contract: the
 # same bounded groupby under kernels.tier=pallas (interpret on CPU) is
@@ -706,17 +798,19 @@ print("kernel-tier smoke OK: pallas == xla byte-for-byte, "
       "decisions + interpret mode counted")
 EOF2
 
-# concurrency gate: rules 20-22 are whole-program (tools/tpulint/flows.py
-# builds the call graph + lock registry; concurrency.py judges it). The
-# package sweep above already fails on any new finding; this block proves
-# the ENGINE has not regressed silently — each seeded fixture must still
-# FIRE its rule (checked structurally via --format json, not by grepping
-# human output) — and re-asserts the deadlock-freedom artifact: the
-# lock-order graph over the live package stays acyclic.
+# fixture gate: rules 20-22 are whole-program (tools/tpulint/flows.py
+# builds the call graph + lock registry; concurrency.py judges it) and
+# rule 23 (placement-must-record) guards the mesh's routing visibility.
+# The package sweep above already fails on any new finding; this block
+# proves the ENGINE has not regressed silently — each seeded fixture
+# must still FIRE its rule (checked structurally via --format json, not
+# by grepping human output) — and re-asserts the deadlock-freedom
+# artifact: the lock-order graph over the live package stays acyclic.
 for fixture_rule in \
     "seeded_lock_order.py lock-order-cycle" \
     "seeded_blocking_under_lock.py blocking-call-under-lock" \
-    "seeded_unguarded_write.py unguarded-shared-write"; do
+    "seeded_unguarded_write.py unguarded-shared-write" \
+    "seeded_cluster_placement.py placement-must-record"; do
   set -- $fixture_rule
   out=$(python -m tools.tpulint --format json --no-baseline \
         "tests/tpulint_fixtures/$1" || true)
@@ -730,7 +824,7 @@ want, fixture = os.environ["RULE"], os.environ["FIXTURE"]
 assert want in rules, f"{fixture} no longer fires {want}: {rules}"
 EOF
 done
-echo "concurrency fixtures OK: rules 20-22 fire"
+echo "seeded fixtures OK: rules 20-23 fire"
 
 graph=$(python -m tools.tpulint --lock-graph spark_rapids_jni_tpu)
 grep -q "acyclic" <<<"$graph"

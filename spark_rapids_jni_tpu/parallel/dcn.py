@@ -87,6 +87,7 @@ import numpy as np
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.runtime import compress
 from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 _MAGIC = b"TPDC"
@@ -248,6 +249,123 @@ def partition_for_slices(table: Table, keys: Sequence[int],
     return out
 
 
+def _bind_listener(port: int, host: Optional[str], backlog: int):
+    """Bound, listening TCP socket on the configurable DCN interface
+    (``dcn.bind_host`` when ``host`` is None — never a hardcoded
+    loopback literal in the callers)."""
+    import socket as pysock
+
+    srv = pysock.socket()
+    srv.setsockopt(pysock.SOL_SOCKET, pysock.SO_REUSEADDR, 1)
+    srv.bind((host or str(get_option("dcn.bind_host")), port))
+    srv.listen(backlog)
+    return srv
+
+
+def dial(port: int, host: Optional[str] = None, *,
+         retries: int = 100, delay_s: float = 0.1):
+    """Dial a DCN peer with bounded, classified connect retry.
+
+    The peer's listener usually races the dialer (a booting worker, a
+    slice that has not reached its exchange yet), so refusal is the
+    expected first answer: each failed attempt is classified
+    :class:`~.resilience.TransportError` (the ``dcn.transport`` seam's
+    shape for socket errors, transient -> retried under
+    ``resilience.retrying`` with the caller's attempt/backoff bounds,
+    visible as ``resilience.*`` retry events). Exhaustion surfaces the
+    classified chain — never a raw ``OSError``. Returns the connected
+    socket; ``host`` defaults to ``dcn.bind_host``."""
+    import socket as pysock
+
+    from spark_rapids_jni_tpu.runtime import resilience
+
+    peer = host or str(get_option("dcn.bind_host"))
+
+    def _attempt():
+        s = pysock.socket()
+        try:
+            s.connect((peer, port))
+            return s
+        except OSError as exc:
+            s.close()
+            raise resilience.TransportError(
+                f"dcn.dial: connect to {peer}:{port} failed: {exc}",
+                seam="dcn.transport", host=peer, port=port) from exc
+
+    if resilience.enabled():
+        pol = resilience.policy()
+        pol.max_attempts = max(1, int(retries))
+        pol.backoff_ms = max(0, int(delay_s * 1000))
+        pol.backoff_multiplier = 1.0
+        return resilience.retrying("dcn.dial", _attempt,
+                                   seam="dcn.transport", pol=pol,
+                                   host=peer, port=port)
+    for attempt in range(max(1, int(retries))):
+        try:
+            return _attempt()
+        except resilience.TransportError:
+            if attempt == max(1, int(retries)) - 1:
+                raise
+            import time
+
+            time.sleep(delay_s)
+
+
+class SliceServer:
+    """Multi-peer accept side of the DCN transport: one listener many
+    :class:`SliceLink`-style peers dial into. ``SliceLink.listen``
+    serves exactly one lockstep peer (the two-slice exchange); a mesh
+    supervisor instead keeps the listener open and accepts each host
+    worker as it dials back, so this class owns the bound socket and
+    hands out one connected socket per :meth:`accept`.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``);
+    ``host`` defaults to ``dcn.bind_host``. Frames on the accepted
+    sockets carry whatever discipline the caller wraps them in (the
+    cluster wraps each in the fleet's sealed ``_FrameChannel``; table
+    payloads inside stay ``serialize_table`` blobs, so compression and
+    the integrity trailer remain outermost)."""
+
+    def __init__(self, port: int = 0, host: Optional[str] = None,
+                 backlog: int = 16):
+        self.host = host or str(get_option("dcn.bind_host"))
+        self._sock = _bind_listener(port, self.host, backlog)
+        self.port = int(self._sock.getsockname()[1])
+        self._closed = False
+
+    def accept(self, timeout: Optional[float] = None):
+        """Block for the next peer dial-in; returns ``(sock, addr)``.
+        Raises ``TimeoutError`` on timeout and ``OSError`` once closed."""
+        self._sock.settimeout(timeout)
+        try:
+            return self._sock.accept()
+        except TimeoutError:
+            raise
+        except OSError:
+            if self._closed:
+                raise OSError("SliceServer is closed")
+            raise
+
+    def accept_link(self, timeout: Optional[float] = None) -> "SliceLink":
+        """Accept one peer and wrap it as a table-frame SliceLink."""
+        conn, _ = self.accept(timeout)
+        return SliceLink(conn)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "SliceServer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
 class SliceLink:
     """One reliable byte stream to a peer slice (TCP prototype; the
     format is transport-agnostic — see the module design note). Frames
@@ -273,33 +391,16 @@ class SliceLink:
         self._recv_seq = 0
 
     @classmethod
-    def listen(cls, port: int, host: str = "127.0.0.1") -> "SliceLink":
-        import socket as pysock
-
-        srv = pysock.socket()
-        srv.setsockopt(pysock.SOL_SOCKET, pysock.SO_REUSEADDR, 1)
-        srv.bind((host, port))
-        srv.listen(1)
+    def listen(cls, port: int, host: Optional[str] = None) -> "SliceLink":
+        srv = _bind_listener(port, host, backlog=1)
         conn, _ = srv.accept()
         srv.close()
         return cls(conn)
 
     @classmethod
-    def connect(cls, port: int, host: str = "127.0.0.1",
+    def connect(cls, port: int, host: Optional[str] = None,
                 retries: int = 100, delay_s: float = 0.1) -> "SliceLink":
-        import socket as pysock
-        import time
-
-        for attempt in range(retries):
-            try:
-                s = pysock.socket()
-                s.connect((host, port))
-                return cls(s)
-            except OSError:
-                s.close()
-                if attempt == retries - 1:
-                    raise
-                time.sleep(delay_s)
+        return cls(dial(port, host, retries=retries, delay_s=delay_s))
 
     def send_table(self, table: Table, compress_level: int = 3) -> int:
         from spark_rapids_jni_tpu.runtime import faults, resilience

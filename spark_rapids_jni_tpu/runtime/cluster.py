@@ -1,0 +1,621 @@
+"""Cross-host serving mesh: remote replicas and data-partitioned query
+routing over the sealed DCN transport.
+
+The fleet (runtime/fleet.py) made one *process* survivable; this module
+makes one *host* survivable, and moves the queries instead of the data
+while doing it:
+
+- :class:`QueryCluster` boots one :class:`~.server.QueryServer` worker
+  per simulated host as a subprocess that **dials back** over TCP
+  (``dcn.dial`` → the supervisor's :class:`~.dcn.SliceServer` gateway)
+  instead of inheriting a socketpair fd — the only transport shape that
+  survives an actual network hop. CI runs every host on localhost; the
+  control frames are the fleet's integrity-sealed ``_FrameChannel``
+  pickle frames, and every table payload inside them is a
+  ``dcn.serialize_table`` blob (columnar codec under ``compress.wire``,
+  integrity trailer outermost) — the exact wire discipline of the
+  two-slice DCN exchange.
+- Supervision is the fleet's, unmodified: heartbeat liveness, classified
+  worker exits (now stamped ``host=``), bounded failover, crash-loop
+  quarantine, the (plan signature, input fingerprint) idempotency pair,
+  and fingerprint-checked late-duplicate drops. The mesh plugs into the
+  supervision core's hooks (``_launch_worker`` / ``_attach_channel`` /
+  ``_route`` / ``_extra``) rather than forking it.
+- **Partitioned serving**: :meth:`QueryCluster.register_table` splits a
+  table by key hash (``dcn.partition_for_slices``), ships each shard to
+  its owning host once, and keeps a supervisor-side partition map plus
+  the encoded shard blobs and fingerprints. From then on
+  :meth:`submit_to_shard` ships only the *plan* — the query travels to
+  the shard, not the shard to the query — and the worker resolves the
+  binding from its registered-table store. :meth:`submit_merge` fans a
+  partial plan out across every shard and merges on the router, with
+  the merged fingerprint memoized so repeated fan-outs must agree
+  bit-for-bit.
+- **Host failover re-homes data**: when a shard's owner dies, the
+  router re-ships the retained shard blob to a healthy host, updates
+  the partition map, and re-dispatches — the registration fingerprint
+  is verified against the one taken before the bytes crossed the wire,
+  so a re-homed query is provably running against the same shard and
+  its result is checked against the same memo entry. Bit-identical
+  failover, now across hosts.
+
+Every routing decision is visible (tpulint rule 23): ``cluster.*``
+counters (``route_local`` / ``route_rehomed`` / ``fanouts`` /
+``merges`` / ``host_deaths``) and ``cluster.*`` telemetry events with
+``host=`` stamps, rendered by ``telemetry top``'s cluster view and the
+report's cluster/hosts sections.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from spark_rapids_jni_tpu.parallel import dcn
+from spark_rapids_jni_tpu.runtime import fleet as fleetmod
+from spark_rapids_jni_tpu.runtime import fusion, resilience, resultcache
+from spark_rapids_jni_tpu.runtime.fleet import (
+    FleetTicket, QueryFleet, _encode_table, _FrameChannel, _Replica)
+from spark_rapids_jni_tpu.telemetry import spans
+from spark_rapids_jni_tpu.telemetry.events import record_fleet
+from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import get_option
+from spark_rapids_jni_tpu.utils.log import get_logger
+
+__all__ = ["QueryCluster", "MergeTicket", "live_clusters", "main"]
+
+_log = get_logger("cluster")
+
+# the dial-back handshake credential: the supervisor mints one per
+# worker launch and only a dial-in presenting a currently-pending token
+# is admitted as that host's control channel
+_ENV_TOKEN = "SPARK_RAPIDS_TPU_CLUSTER_TOKEN"
+
+_LIVE_CLUSTERS: "weakref.WeakSet[QueryCluster]" = weakref.WeakSet()
+
+
+def live_clusters() -> List["QueryCluster"]:
+    """Every open cluster in this process (telemetry ``top`` view)."""
+    return [c for c in list(_LIVE_CLUSTERS) if not c._closed]
+
+
+class _ShardRows:
+    """Row-count stand-in for a worker-resident shard: the memo key and
+    cost signature both read only ``num_rows``, so the supervisor never
+    needs the shard's bytes to derive the idempotency pair."""
+
+    __slots__ = ("num_rows",)
+
+    def __init__(self, num_rows: int):
+        self.num_rows = int(num_rows)
+
+
+class _ShardSet:
+    """Supervisor-side record of one partitioned table: the partition
+    map (part -> owning host) plus, per part, the encoded shard blob
+    (retained for re-homing), its fingerprint (verified on every
+    registration — the cross-host half of the idempotency pair) and its
+    row count (memo-key stand-in)."""
+
+    __slots__ = ("name", "keys", "parts", "rows", "blobs", "fps", "owners")
+
+    def __init__(self, name: str, keys: tuple, parts: int):
+        self.name = name
+        self.keys = keys
+        self.parts = parts
+        self.rows: List[int] = []
+        self.blobs: List[bytes] = []
+        self.fps: List[str] = []
+        self.owners: List[Optional[str]] = [None] * parts
+
+
+class MergeTicket:
+    """Future for one fan-out/fan-in query: every shard's partial ticket
+    plus the router-side merge. :meth:`result` blocks for all partials
+    (in part order — the merge input order is deterministic), merges on
+    the caller's thread under a ``cluster.merge`` span, and memo-checks
+    the merged fingerprint so a repeated fan-out — including one whose
+    partials failed over to re-homed shards — must come back
+    bit-identical or die :class:`~.resilience.CorruptDataError`."""
+
+    def __init__(self, cluster: "QueryCluster", table: str, plan_name: str,
+                 tickets: List[FleetTicket], merge_fn):
+        self.table = table
+        self.plan_name = plan_name
+        self.tickets = tickets
+        self.fingerprint: Optional[str] = None
+        self._cluster = cluster
+        self._merge_fn = merge_fn
+        self._lock = threading.Lock()
+        self._resolved = False
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._resolved or all(t.done() for t in self.tickets)
+
+    def result(self, timeout: Optional[float] = None):
+        with self._lock:
+            if self._resolved:
+                if self._exc is not None:
+                    raise self._exc
+                return self._value
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            # a timeout leaves the ticket unresolved (retryable wait);
+            # any other failure — a failed partial, a merge mismatch —
+            # is permanent and resolves the ticket failed
+            partials = []
+            for t in self.tickets:
+                left = (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+                partials.append(t.result(left))
+            try:
+                value = self._cluster._merge(self, partials)
+            except BaseException as exc:
+                self._resolved, self._exc = True, exc
+                raise
+            self._resolved, self._value = True, value
+            return value
+
+
+class QueryCluster(QueryFleet):
+    """Mesh supervisor: the fleet's supervision core over dial-back TCP
+    host workers, plus the partition map and locality router.
+
+    ``hosts`` overrides ``cluster.hosts``. Construction binds the
+    gateway listener (``dcn.bind_host``, ephemeral port), launches one
+    worker per host and returns immediately; :meth:`wait_live` blocks
+    until the hosts dialed back and booted. Use as a context manager."""
+
+    _ID_PREFIX = "h"  # host workers: h0, h1, ...
+    is_cluster = True
+
+    def __init__(self, hosts: Optional[int] = None, *,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 per_replica_env: Optional[Dict[str, Dict[str, str]]] = None):
+        # gateway + handshake state first: the base ctor spawns workers
+        # through our _launch_worker, which needs both
+        self._gateway = dcn.SliceServer()
+        self._boot_lock = threading.Lock()
+        self._pending_boots: Dict[str, tuple] = {}
+        self._reg_waits: Dict[tuple, tuple] = {}
+        self._tables: Dict[str, _ShardSet] = {}
+        self._merge_memo: "collections.OrderedDict[tuple, str]" = \
+            collections.OrderedDict()
+        self._accept_stop = threading.Event()
+        super().__init__(
+            hosts if hosts is not None else int(get_option("cluster.hosts")),
+            worker_env=worker_env, per_replica_env=per_replica_env)
+        _LIVE_CLUSTERS.add(self)
+        # dials queue in the listener backlog until this thread starts,
+        # so launching before accepting loses no worker
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="cluster-gateway")
+        self._accept_thread.start()
+
+    # -- transport: dial-back workers over the DCN gateway -------------------
+
+    def _worker_environment(self, r: _Replica) -> Dict[str, str]:
+        env = super()._worker_environment(r)
+        # workers stamp host= on every record and span they emit
+        env["SPARK_RAPIDS_TPU_TELEMETRY_HOST"] = r.rid
+        return env
+
+    def _extra(self, r: _Replica) -> Dict[str, Any]:
+        return {"host": r.rid}
+
+    def _launch_worker(self, r: _Replica):
+        token = os.urandom(16).hex()
+        with self._boot_lock:
+            # a relaunch obsoletes the dead generation's credential
+            for tok in [t for t, (rr, g) in self._pending_boots.items()
+                        if rr is r and g < r.generation]:
+                del self._pending_boots[tok]
+            self._pending_boots[token] = (r, r.generation)
+        env = self._worker_environment(r)
+        env[_ENV_TOKEN] = token
+        cmd = [sys.executable, "-m", "spark_rapids_jni_tpu.runtime.cluster",
+               "--worker", "--connect",
+               f"{self._gateway.host}:{self._gateway.port}",
+               "--host", r.rid]
+        proc = subprocess.Popen(cmd, env=env)
+        # the control channel attaches asynchronously when the worker
+        # dials back with its token (the accept loop calls
+        # _attach_channel); until then the boot deadline supervises it
+        return proc, None
+
+    def _accept_loop(self) -> None:
+        while not self._accept_stop.is_set():
+            try:
+                conn, _addr = self._gateway.accept(timeout=0.2)
+            except TimeoutError:
+                continue
+            except OSError:
+                if self._accept_stop.is_set():
+                    return
+                continue
+            # handshake off the accept thread: a stalled dialer must not
+            # block other hosts' dial-ins
+            threading.Thread(target=self._admit, args=(conn,), daemon=True,
+                             name="cluster-admit").start()
+
+    def _admit(self, conn: socket.socket) -> None:
+        chan = _FrameChannel(conn)
+        try:
+            conn.settimeout(10.0)
+            hello = chan.recv()
+            conn.settimeout(None)
+        except BaseException:
+            chan.close()
+            return
+        token = str(hello.get("token", ""))
+        with self._boot_lock:
+            ent = self._pending_boots.pop(token, None)
+        if ent is None:
+            # unknown or stale credential: not one of ours (or a boot
+            # superseded by a restart) — refuse the channel, visibly
+            REGISTRY.counter("cluster.rejected_dials").inc()
+            record_fleet("cluster.gateway", "rejected_dial",
+                         replica="supervisor",
+                         peer=str(hello.get("host", "?")))
+            chan.close()
+            return
+        r, gen = ent
+        with self._lock:
+            stale = r.generation != gen
+        if stale:
+            chan.close()
+            return
+        record_fleet("cluster.gateway", "host_dialed_in", replica=r.rid,
+                     host=r.rid, generation=gen)
+        self._attach_channel(r, chan, gen)
+
+    # -- partitioned serving: register, route, fan out -----------------------
+
+    def register_table(self, name: str, table, keys,
+                       *, parts: Optional[int] = None) -> Dict[str, Any]:
+        """Partition ``table`` by the key columns ``keys`` and ship each
+        shard to its owning host (round-robin over the live set). The
+        supervisor retains each shard's encoded blob and fingerprint —
+        the re-homing reserve — and the partition map the router
+        consults. Returns ``{table, parts, rows, owners}``."""
+        if self._closed:
+            raise RuntimeError("cluster is closed")
+        name = str(name)
+        n = int(parts if parts is not None else self.n_replicas)
+        if n < 1:
+            raise ValueError("register_table needs at least one partition")
+        boot = float(get_option("fleet.worker_boot_timeout_s"))
+        if self.wait_live(1, timeout=boot) < 1:
+            raise resilience.ReplicaDeadError(
+                "cluster: no live host to place shards on", table=name,
+                seam="fleet.dispatch")
+        with spans.span("cluster.partition", table=name, parts=n):
+            shards = dcn.partition_for_slices(table, list(keys), n)
+            ss = _ShardSet(name, tuple(int(k) for k in keys), n)
+            for shard in shards:
+                ss.rows.append(int(shard.num_rows))
+                ss.blobs.append(_encode_table(shard))
+                ss.fps.append(resultcache.table_fingerprint(shard))
+        with self._lock:
+            live = [r for r in self._replicas if r.state == "live"]
+        for part in range(n):
+            r = live[part % len(live)]
+            self._register_shard(r, ss, part)
+            with self._lock:
+                ss.owners[part] = r.rid
+        with self._lock:
+            self._tables[name] = ss
+        record_fleet("cluster.partition_map", "table_registered",
+                     replica="supervisor", table=name, parts=n,
+                     rows=sum(ss.rows), owners=list(ss.owners))
+        return {"table": name, "parts": n, "rows": sum(ss.rows),
+                "owners": list(ss.owners)}
+
+    def _register_shard(self, r: _Replica, ss: _ShardSet, part: int) -> None:
+        """Ship one retained shard blob to ``r`` and block for its
+        acknowledgement; the returned fingerprint must equal the one
+        taken before the bytes crossed the wire (CorruptDataError
+        otherwise — a shard that mutated in transit must never serve)."""
+        reg = f"{ss.name}/p{part}"
+        timeout = float(get_option("cluster.register_timeout_s"))
+        with self._lock:
+            gen, chan = r.generation, r.chan
+        if chan is None or r.state != "live":
+            raise resilience.ReplicaDeadError(
+                f"cluster: host {r.rid} has no live control channel to "
+                f"register shard {reg} on", host=r.rid, table=ss.name,
+                part=part, seam="fleet.dispatch")
+        evt = threading.Event()
+        slot: Dict[str, Any] = {}
+        key = (r.rid, gen, reg)
+        with self._lock:
+            self._reg_waits[key] = (evt, slot)
+        try:
+            with spans.span("cluster.register", replica=r.rid, host=r.rid,
+                            table=ss.name, part=part):
+                try:
+                    chan.send({"t": "register", "name": reg,
+                               "table": ss.blobs[part]})
+                except BaseException as exc:
+                    raise (exc if isinstance(exc, resilience.ResilienceError)
+                           else resilience.classify(
+                               exc, seam="fleet.dispatch")(
+                               f"cluster: shard registration send to "
+                               f"{r.rid} failed: {exc}", host=r.rid,
+                               table=ss.name, part=part))
+                if not evt.wait(timeout):
+                    raise resilience.ReplicaDeadError(
+                        f"cluster: host {r.rid} did not acknowledge shard "
+                        f"{reg} within {timeout}s", host=r.rid,
+                        table=ss.name, part=part, seam="fleet.dispatch")
+            if "error_kind" in slot:
+                raise self._rebuild_error(slot, r.rid)
+            if slot.get("fingerprint") != ss.fps[part]:
+                REGISTRY.counter("fleet.identity_mismatch").inc()
+                record_fleet("cluster.register", "identity_mismatch",
+                             replica=r.rid, host=r.rid, table=ss.name,
+                             part=part)
+                raise resilience.CorruptDataError(
+                    f"cluster: shard {reg} registered on {r.rid} with "
+                    f"fingerprint {slot.get('fingerprint')!r} but left the "
+                    f"supervisor as {ss.fps[part]!r} — shard mutated in "
+                    f"transit", host=r.rid, table=ss.name, part=part)
+            REGISTRY.counter("cluster.shards_registered").inc()
+            record_fleet("cluster.register", "registered", replica=r.rid,
+                         host=r.rid, table=ss.name, part=part,
+                         rows=slot.get("rows", 0),
+                         fingerprint=ss.fps[part])
+        finally:
+            with self._lock:
+                self._reg_waits.pop(key, None)
+
+    def _on_worker_msg(self, r: _Replica, gen: int,
+                       msg: Dict[str, Any]) -> None:
+        if msg.get("t") == "registered":
+            key = (r.rid, gen, str(msg.get("name", "")))
+            with self._lock:
+                ent = self._reg_waits.get(key)
+            if ent is None:
+                return  # ack for a wait that timed out or a stale gen
+            evt, slot = ent
+            slot.update(msg)
+            evt.set()
+
+    def _host(self, rid: Optional[str]) -> Optional[_Replica]:
+        if rid is None:
+            return None
+        for r in self._replicas:
+            if r.rid == rid:
+                return r
+        return None
+
+    def _route(self, q, deadline: float) -> Optional[_Replica]:
+        """Locality routing: a shard-pinned query goes to its owning
+        host ("ship the query to the shard"); a dead owner triggers
+        re-homing — the retained blob re-ships to the cheapest live
+        host and the partition map is updated — before dispatch.
+        Unpinned queries load-balance exactly like the fleet."""
+        if q.shard is None:
+            return super()._route(q, deadline)
+        name, part = q.shard
+        with self._lock:
+            ss = self._tables.get(name)
+            owner_id = ss.owners[part] if ss is not None else None
+        if ss is None:
+            raise resilience.MalformedInputError(
+                f"cluster: query pinned to unregistered table {name!r}",
+                qid=q.qid)
+        owner = self._host(owner_id)
+        if owner is not None and owner.state == "live":
+            REGISTRY.counter("cluster.route_local").inc()
+            record_fleet("cluster.route", "local", replica=owner.rid,
+                         host=owner.rid, table=name, part=part, qid=q.qid)
+            return owner
+        r2 = self._pick_replica(deadline)
+        if r2 is None:
+            return None
+        self._register_shard(r2, ss, part)
+        with self._lock:
+            # first re-homer wins the map; a concurrent failover that
+            # also re-registered merely duplicated an idempotent install
+            if ss.owners[part] == owner_id:
+                ss.owners[part] = r2.rid
+        REGISTRY.counter("cluster.route_rehomed").inc()
+        record_fleet("cluster.route", "rehomed", replica=r2.rid,
+                     host=r2.rid, table=name, part=part, qid=q.qid,
+                     from_host=owner_id)
+        _log.warning("cluster: shard %s/p%d re-homed %s -> %s",
+                     name, part, owner_id, r2.rid)
+        return r2
+
+    def shard_for_key(self, name: str, key_table) -> int:
+        """Owning partition of one key: hash a single-row table holding
+        the key columns (in partition-key order, matching dtypes) with
+        the same ``partition_hash`` that sharded the table."""
+        from spark_rapids_jni_tpu.ops.hash import partition_hash
+
+        with self._lock:
+            ss = self._tables[str(name)]
+        ncols = len(key_table.columns)
+        if ncols != len(ss.keys):
+            raise ValueError(
+                f"cluster: table {ss.name!r} partitions on {len(ss.keys)} "
+                f"key column(s), got a {ncols}-column key table")
+        dest = np.asarray(
+            partition_hash(key_table, list(range(ncols)), ss.parts))
+        if dest.size != 1:
+            raise ValueError("shard_for_key takes exactly one key row, "
+                             f"got {dest.size}")
+        return int(dest[0])
+
+    def submit_to_shard(self, session_id: str, plan: fusion.Plan, *,
+                        table: str, binding: str,
+                        part: Optional[int] = None, key_table=None,
+                        deadline_ms: Optional[int] = None) -> FleetTicket:
+        """Route one single-shard query to the host owning the shard.
+        Only the plan crosses the wire: ``binding`` resolves on the
+        worker from its registered shard. Pass ``part`` directly or
+        ``key_table`` (one key row) to look the partition up. The memo
+        key pairs the plan signature (derived against the shard's row
+        count) with the shard's registration fingerprint, so cross-host
+        failover and duplicate drops keep their bit-identity check."""
+        with self._lock:
+            ss = self._tables.get(str(table))
+        if ss is None:
+            raise KeyError(f"cluster: table {table!r} is not registered")
+        if part is None:
+            if key_table is None:
+                raise ValueError("submit_to_shard needs part= or key_table=")
+            part = self.shard_for_key(table, key_table)
+        part = int(part)
+        if not 0 <= part < ss.parts:
+            raise IndexError(f"cluster: table {ss.name!r} has {ss.parts} "
+                             f"partitions, no p{part}")
+        binding = str(binding)
+        return self._submit(
+            str(session_id), plan, {},
+            binding_refs={binding: f"{ss.name}/p{part}"},
+            shard=(ss.name, part),
+            sig_bindings={binding: _ShardRows(ss.rows[part])},
+            deadline_ms=deadline_ms,
+            cache_fingerprint=ss.fps[part])
+
+    def submit_merge(self, session_id: str, partial_plan: fusion.Plan,
+                     merge_fn, *, table: str, binding: str,
+                     deadline_ms: Optional[int] = None) -> MergeTicket:
+        """Fan a partial plan out to every shard's host and merge on the
+        router: ``merge_fn(partial_results)`` runs on the caller's
+        thread once every partial lands (``MergeTicket.result``), its
+        input ordered by part index so the merge is deterministic."""
+        with self._lock:
+            ss = self._tables.get(str(table))
+        if ss is None:
+            raise KeyError(f"cluster: table {table!r} is not registered")
+        REGISTRY.counter("cluster.fanouts").inc()
+        record_fleet("cluster.fanout", "fanout", replica="supervisor",
+                     table=ss.name, parts=ss.parts, plan=partial_plan.name)
+        tickets = [
+            self.submit_to_shard(session_id, partial_plan, table=table,
+                                 binding=binding, part=p,
+                                 deadline_ms=deadline_ms)
+            for p in range(ss.parts)]
+        return MergeTicket(self, ss.name, partial_plan.name, tickets,
+                           merge_fn)
+
+    def _merge(self, mt: MergeTicket, partials: List[Any]):
+        fps = tuple(t.fingerprint or "" for t in mt.tickets)
+        mkey = (mt.plan_name, mt.table, fps)
+        with spans.span("cluster.merge", table=mt.table,
+                        parts=len(partials), plan=mt.plan_name):
+            merged = mt._merge_fn(partials)
+        fp = resultcache.table_fingerprint(getattr(merged, "table", merged))
+        with self._lock:
+            prev = self._merge_memo.get(mkey)
+            if prev is None:
+                self._merge_memo[mkey] = fp
+                while len(self._merge_memo) > 512:
+                    self._merge_memo.popitem(last=False)
+        if prev is not None and prev != fp:
+            REGISTRY.counter("fleet.identity_mismatch").inc()
+            record_fleet("cluster.merge", "identity_mismatch",
+                         replica="supervisor", table=mt.table,
+                         plan=mt.plan_name)
+            raise resilience.CorruptDataError(
+                f"cluster: merged result for {mt.plan_name} over "
+                f"{mt.table} differs from the memoized fingerprint for "
+                f"the same partial set — merge determinism violated",
+                table=mt.table)
+        mt.fingerprint = fp
+        REGISTRY.counter("cluster.merges").inc()
+        record_fleet("cluster.merge", "merged", replica="supervisor",
+                     table=mt.table, parts=len(partials), fingerprint=fp)
+        return merged
+
+    # -- supervision overrides ----------------------------------------------
+
+    def _on_replica_death(self, r: _Replica, gen: int,
+                          classified: BaseException) -> None:
+        before = r.crashes_total
+        super()._on_replica_death(r, gen, classified)
+        if r.crashes_total != before:
+            # the base counted a real (non-stale, unplanned) death: that
+            # is a HOST death here, with shards to re-home on demand
+            REGISTRY.counter("cluster.host_deaths").inc()
+            record_fleet("cluster.supervise", "host_death", replica=r.rid,
+                         host=r.rid,
+                         error_kind=type(classified).__name__)
+
+    def inspect(self) -> dict:
+        snap = super().inspect()
+        snap["cluster"] = True
+        with self._lock:
+            snap["tables"] = {
+                name: {"parts": ss.parts, "keys": list(ss.keys),
+                       "rows": sum(ss.rows), "owners": list(ss.owners)}
+                for name, ss in self._tables.items()}
+        c = REGISTRY.counters("cluster.")
+        snap["counters"].update(
+            {k: v for k, v in sorted(c.items()) if k.count(".") == 1})
+        return snap
+
+    def close(self, timeout: float = 30.0) -> None:
+        super().close(timeout)
+        self._accept_stop.set()
+        self._gateway.close()
+        if getattr(self, "_accept_thread", None) is not None:
+            self._accept_thread.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# worker side: dial back, authenticate, run the fleet worker loop
+# ---------------------------------------------------------------------------
+
+
+def _worker_main(connect: str, hid: str) -> int:
+    """Host-worker entrypoint: dial the supervisor's gateway (bounded
+    classified retry via ``dcn.dial``), present the launch token, then
+    hand the connected channel to the fleet's worker loop — the control
+    protocol is identical from here on."""
+    if os.environ.get(fleetmod._ENV_BOOT_CRASH):
+        return 3  # chaos hook: crash-loop at boot
+    host, _, port = connect.rpartition(":")
+    sock = dcn.dial(int(port), host or None)
+    chan = _FrameChannel(sock)
+    chan.send({"t": "hello", "host": hid,
+               "token": os.environ.get(_ENV_TOKEN, "")})
+    return fleetmod._worker_loop(chan, hid)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if "--worker" not in args:
+        print("usage: python -m spark_rapids_jni_tpu.runtime.cluster "
+              "--worker --connect <host:port> --host <hid>",
+              file=sys.stderr)
+        return 2
+    connect = hid = None
+    for i, a in enumerate(args):
+        if a == "--connect" and i + 1 < len(args):
+            connect = args[i + 1]
+        elif a == "--host" and i + 1 < len(args):
+            hid = args[i + 1]
+    if connect is None or hid is None:
+        print("cluster worker: --connect and --host are required",
+              file=sys.stderr)
+        return 2
+    return _worker_main(connect, hid)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -231,14 +231,18 @@ class FleetTicket:
 class _Query:
     """Supervisor-side record of one submitted query: the serialized
     submit payload (built once, reused verbatim on failover) plus the
-    idempotency key that makes re-dispatch safe."""
+    idempotency key that makes re-dispatch safe. ``shard`` pins a
+    partitioned query to its owning (table, part) — the mesh router
+    (runtime/cluster.py) routes those to the shard's host instead of
+    the cheapest replica."""
 
     __slots__ = ("qid", "session", "signature", "cost_sig", "key",
-                 "payload", "ticket", "deadline_ms")
+                 "payload", "ticket", "deadline_ms", "shard")
 
     def __init__(self, qid: int, session: str, signature: str,
                  cost_sig: str, key, payload: Dict[str, Any],
-                 ticket: FleetTicket, deadline_ms: int):
+                 ticket: FleetTicket, deadline_ms: int,
+                 shard=None):
         self.qid = qid
         self.session = session
         self.signature = signature
@@ -247,6 +251,7 @@ class _Query:
         self.payload = payload
         self.ticket = ticket
         self.deadline_ms = deadline_ms
+        self.shard = shard  # (table name, part index) or None
 
 
 class _Replica:
@@ -284,7 +289,18 @@ class QueryFleet:
     Construction returns immediately (workers boot in the background,
     ~seconds each under JAX); :meth:`wait_live` blocks until a quorum is
     serving. Use as a context manager — :meth:`close` shuts every
-    worker down and fails any unresolved tickets classified."""
+    worker down and fails any unresolved tickets classified.
+
+    The supervision core (heartbeat, classified deaths, bounded
+    failover, quarantine, memo/duplicate discipline) is transport- and
+    identity-agnostic: subclasses override :meth:`_launch_worker` (how
+    a worker process and its control channel come up), :meth:`_route`
+    (which replica a query lands on) and :meth:`_extra` (identity
+    context stamped into supervision events and classified errors) —
+    the cross-host mesh (runtime/cluster.py) reuses everything else."""
+
+    _ID_PREFIX = "r"  # replica id prefix ("h" for mesh host workers)
+    is_cluster = False
 
     def __init__(self, replicas: Optional[int] = None, *,
                  worker_env: Optional[Dict[str, str]] = None,
@@ -310,7 +326,7 @@ class QueryFleet:
         self._cost: Dict[str, float] = {}
         self._replicas: List[_Replica] = []
         for i in range(self.n_replicas):
-            r = _Replica(f"r{i}")
+            r = _Replica(f"{self._ID_PREFIX}{i}")
             r.env_extra = dict((per_replica_env or {}).get(r.rid, {}))
             self._replicas.append(r)
         _LIVE_FLEETS.add(self)
@@ -352,9 +368,38 @@ class QueryFleet:
         env.update(r.env_extra)
         return env
 
-    def _spawn(self, r: _Replica) -> None:
-        """Boot (or re-boot) one worker subprocess on a fresh socketpair."""
+    def _extra(self, r: _Replica) -> Dict[str, Any]:
+        """Identity context merged into supervision events and
+        classified errors (the mesh stamps ``host=`` here)."""
+        return {}
+
+    def _launch_worker(self, r: _Replica):
+        """Transport hook: create the worker process and its control
+        channel. Returns ``(proc, chan)``; ``chan`` may be None when the
+        channel attaches asynchronously (the mesh's TCP dial-back calls
+        :meth:`_attach_channel` from its accept loop instead)."""
         parent_sock, child_sock = socket.socketpair()
+        child_fd = child_sock.fileno()
+        os.set_inheritable(child_fd, True)
+        cmd = [sys.executable, "-m", "spark_rapids_jni_tpu.runtime.fleet",
+               "--worker", "--fd", str(child_fd), "--replica", r.rid]
+        proc = subprocess.Popen(cmd, pass_fds=(child_fd,),
+                                env=self._worker_environment(r))
+        child_sock.close()
+        return proc, _FrameChannel(parent_sock)
+
+    def _attach_channel(self, r: _Replica, chan: _FrameChannel,
+                        gen: int) -> None:
+        """Bind a live control channel to a replica generation and start
+        its receive loop (called from _spawn, or from the mesh accept
+        loop once the remote worker dials back)."""
+        r.chan = chan
+        threading.Thread(
+            target=self._recv_loop, args=(r, chan, gen), daemon=True,
+            name=f"fleet-recv-{r.rid}-g{gen}").start()
+
+    def _spawn(self, r: _Replica) -> None:
+        """Boot (or re-boot) one worker subprocess on a fresh channel."""
         r.generation += 1
         gen = r.generation
         r.state = "booting"
@@ -363,27 +408,20 @@ class QueryFleet:
         r.drained_evt.clear()
         r.last_pong = None
         r.load = {}
+        r.chan = None
         r.boot_deadline = (time.monotonic()
                            + float(get_option("fleet.worker_boot_timeout_s")))
-        child_fd = child_sock.fileno()
-        os.set_inheritable(child_fd, True)
-        cmd = [sys.executable, "-m", "spark_rapids_jni_tpu.runtime.fleet",
-               "--worker", "--fd", str(child_fd), "--replica", r.rid]
-        r.proc = subprocess.Popen(cmd, pass_fds=(child_fd,),
-                                  env=self._worker_environment(r))
-        child_sock.close()
-        r.chan = _FrameChannel(parent_sock)
+        r.proc, chan = self._launch_worker(r)
         REGISTRY.counter("fleet.boots").inc()
         record_fleet("fleet.spawn", "boot", replica=r.rid, pid=r.proc.pid,
-                     generation=gen)
-        threading.Thread(
-            target=self._recv_loop, args=(r, r.chan, gen), daemon=True,
-            name=f"fleet-recv-{r.rid}-g{gen}").start()
+                     generation=gen, **self._extra(r))
+        if chan is not None:
+            self._attach_channel(r, chan, gen)
 
     def _restart(self, r: _Replica) -> None:
         REGISTRY.counter("fleet.restarts").inc()
         record_fleet("fleet.restart", "restart", replica=r.rid,
-                     crashes=r.consecutive_crashes)
+                     crashes=r.consecutive_crashes, **self._extra(r))
         self._spawn(r)
 
     # -- receive path --------------------------------------------------------
@@ -404,7 +442,7 @@ class QueryFleet:
                         r.live_evt.set()
                         self._cond.notify_all()
                 record_fleet("fleet.spawn", "live", replica=r.rid,
-                             pid=msg.get("pid", 0))
+                             pid=msg.get("pid", 0), **self._extra(r))
             elif t == "pong":
                 with self._lock:
                     r.last_pong = time.monotonic()
@@ -413,7 +451,16 @@ class QueryFleet:
                 self._on_result(r, gen, msg)
             elif t == "drained":
                 r.drained_evt.set()
-            # "bye" (shutdown ack) needs no action: the exit is expected
+            elif t == "bye":
+                pass  # shutdown ack needs no action: the exit is expected
+            else:
+                # subclass protocol extension point (the mesh handles
+                # shard-registration acks here)
+                self._on_worker_msg(r, gen, msg)
+
+    def _on_worker_msg(self, r: _Replica, gen: int,
+                       msg: Dict[str, Any]) -> None:
+        """Hook for control messages beyond the base protocol."""
 
     def _reap(self, r: _Replica, gen: int, exc: BaseException) -> None:
         """Control channel closed: reap the worker's exit status and
@@ -438,7 +485,8 @@ class QueryFleet:
         except BaseException as injected:
             exc = injected
         classified = (exc if isinstance(exc, resilience.ResilienceError)
-                      else resilience.classify_worker_exit(rc, replica=r.rid))
+                      else resilience.classify_worker_exit(
+                          rc, replica=r.rid, **self._extra(r)))
         if classified is not exc and classified.__cause__ is None:
             classified.__cause__ = exc
         self._on_replica_death(r, gen, classified)
@@ -584,10 +632,12 @@ class QueryFleet:
             state={"replica": r.rid, "cause": str(classified),
                    "error_kind": type(classified).__name__,
                    "consecutive_crashes": crashes,
-                   "inflight_qids": [q.qid for q in orphans]})
+                   "inflight_qids": [q.qid for q in orphans],
+                   **self._extra(r)})
         record_fleet("fleet.supervise", "replica_death", replica=r.rid,
                      error_kind=type(classified).__name__,
                      cause=str(classified), inflight=len(orphans),
+                     **self._extra(r),
                      **({"flight_record": flight} if flight else {}))
         _log.warning("fleet: replica %s died (%s); %d in-flight to fail "
                      "over", r.rid, classified, len(orphans))
@@ -609,7 +659,7 @@ class QueryFleet:
         if r.state == "quarantined":
             REGISTRY.counter("fleet.quarantines").inc()
             record_fleet("fleet.supervise", "quarantine", replica=r.rid,
-                         crashes=crashes)
+                         crashes=crashes, **self._extra(r))
             _log.warning("fleet: replica %s quarantined after %d "
                          "consecutive crashes", r.rid, crashes)
         if orphans:
@@ -691,7 +741,7 @@ class QueryFleet:
         if exc is None or not isinstance(exc, resilience.ResilienceError):
             rc = r.proc.poll() if r.proc is not None else None
             classified = resilience.classify_worker_exit(
-                rc, replica=r.rid, seam="fleet.heartbeat")
+                rc, replica=r.rid, seam="fleet.heartbeat", **self._extra(r))
             if exc is not None and classified.__cause__ is None:
                 classified.__cause__ = exc
         else:
@@ -722,20 +772,32 @@ class QueryFleet:
             with self._cond:
                 live = [r for r in self._replicas if r.state == "live"]
                 if live:
-                    return min(live, key=lambda r: (
+                    picked = min(live, key=lambda r: (
                         self._placement_cost(r), r.rid))
+                    # every routing decision is counted (tpulint rule 23:
+                    # a placement choice must be visible in telemetry)
+                    REGISTRY.counter("fleet.placements").inc()
+                    REGISTRY.counter(
+                        f"fleet.placements.{picked.rid}").inc()
+                    return picked
                 if self._closed or time.monotonic() >= deadline:
                     return None
                 self._cond.wait(timeout=min(
                     0.05, max(0.0, deadline - time.monotonic())) or 0.01)
 
+    def _route(self, q: _Query, deadline: float) -> Optional[_Replica]:
+        """Routing hook: which replica this placement round lands on.
+        The base fleet load-balances; the mesh router overrides with
+        partition-map locality for shard-pinned queries."""
+        return self._pick_replica(deadline)
+
     def _dispatch(self, q: _Query) -> None:
-        """Place one query on the cheapest healthy replica and send its
+        """Place one query on the routed healthy replica and send its
         frame; raises classified when no replica can take it in time."""
         deadline = time.monotonic() + float(
             get_option("fleet.dispatch_timeout_s"))
         while True:
-            r = self._pick_replica(deadline)
+            r = self._route(q, deadline)
             if r is None:
                 raise resilience.ReplicaDeadError(
                     "fleet: no healthy replica to dispatch to within "
@@ -808,17 +870,37 @@ class QueryFleet:
         :class:`FleetTicket`; placement failures, replica deaths past
         the failover budget, and replica-reported failures all resolve
         the ticket classified."""
+        return self._submit(session_id, plan, bindings,
+                            deadline_ms=deadline_ms,
+                            cache_fingerprint=cache_fingerprint)
+
+    def _submit(self, session_id: str, plan: fusion.Plan, bindings: dict, *,
+                binding_refs: Optional[Dict[str, str]] = None,
+                shard=None,
+                sig_bindings: Optional[Dict[str, Any]] = None,
+                deadline_ms: Optional[int] = None,
+                cache_fingerprint: Optional[str] = None) -> FleetTicket:
+        """Shared submit core. ``binding_refs`` maps plan binding names
+        to worker-resident registered tables (the mesh's ship-the-query
+        path: the shard's bytes never ride the submit frame); ``shard``
+        pins the query to its owning (table, part) for locality routing
+        and re-homing failover; ``sig_bindings`` supplies stand-ins for
+        ref-bound tables when deriving the memo key and cost signature
+        (both read only ``num_rows``), so the idempotency pair survives
+        without the shard's bytes ever being supervisor-resident."""
         if self._closed:
             raise RuntimeError("fleet is closed")
         qid = next(self._qid)
         sid = str(session_id)
         ticket = FleetTicket(qid, sid, plan.name)
         REGISTRY.counter("fleet.submitted").inc()
+        key_bindings = (bindings if not sig_bindings
+                        else {**bindings, **sig_bindings})
         key = None
         if int(get_option("fleet.result_memo_entries")) > 0:
             try:
                 key = resultcache.cache_key(
-                    plan, bindings, fingerprint=cache_fingerprint)
+                    plan, key_bindings, fingerprint=cache_fingerprint)
             except (ValueError, KeyError, TypeError):
                 key = None  # unfingerprintable: serve, never memoize
         if key is not None:
@@ -844,6 +926,7 @@ class QueryFleet:
                                      protocol=pickle.HIGHEST_PROTOCOL),
                 "bindings": {k: _encode_table(v)
                              for k, v in bindings.items()},
+                "binding_refs": dict(binding_refs or {}),
                 "deadline_ms": deadline_ms,
                 "cache_fingerprint": cache_fingerprint,
             }
@@ -855,9 +938,9 @@ class QueryFleet:
         from spark_rapids_jni_tpu.runtime.server import QueryServer
 
         q = _Query(qid, sid, key.signature if key is not None else "",
-                   QueryServer._plan_signature(plan, bindings), key,
+                   QueryServer._plan_signature(plan, key_bindings), key,
                    payload, ticket,
-                   int(deadline_ms or 0))
+                   int(deadline_ms or 0), shard=shard)
         with self._lock:
             self._queries[qid] = q
         try:
@@ -1037,6 +1120,17 @@ def _serve_one(chan: _FrameChannel, srv, msg: Dict[str, Any],
         plan = pickle.loads(msg["plan"])
         bindings = {k: _decode_table(v)
                     for k, v in (msg.get("bindings") or {}).items()}
+        # the mesh's ship-the-query path: bindings resolved from tables
+        # registered on THIS worker (the shard lives here; only the
+        # plan crossed the wire)
+        for name, reg in (msg.get("binding_refs") or {}).items():
+            try:
+                bindings[name] = srv.registered_table(reg)
+            except KeyError:
+                raise resilience.MalformedInputError(
+                    f"fleet: submit references registered table {reg!r} "
+                    f"which is not resident on replica {replica}",
+                    replica=replica, binding=name) from None
         compiles_before = REGISTRY.counters("dispatch.").get(
             "dispatch.compile", 0)
         t0 = time.monotonic()
@@ -1073,15 +1167,44 @@ def _serve_one(chan: _FrameChannel, srv, msg: Dict[str, Any],
         pass  # supervisor gone; this worker is about to be reaped anyway
 
 
+def _register_one(chan: _FrameChannel, srv, msg: Dict[str, Any],
+                  replica: str) -> None:
+    """Install one shipped shard into this worker's registered-table
+    store and acknowledge with its fingerprint (the supervisor verifies
+    it against the fingerprint taken before the shard crossed the wire
+    — the cross-host half of the idempotency pair)."""
+    name = str(msg.get("name", ""))
+    out: Dict[str, Any] = {"t": "registered", "name": name}
+    try:
+        table = _decode_table(msg["table"])
+        out["fingerprint"] = srv.register_table(name, table)
+        out["rows"] = int(table.num_rows)
+    except BaseException as exc:
+        kind = type(exc).__name__
+        if not isinstance(exc, resilience.ResilienceError):
+            kind = resilience.classify(exc).__name__
+        out.update({"error_kind": kind, "message": str(exc)})
+    try:
+        chan.send(out)
+    except OSError:
+        pass  # supervisor gone; this worker is about to be reaped anyway
+
+
 def _worker_main(fd: int, replica: str) -> int:
     """Replica entrypoint: one in-process QueryServer behind the frame
-    channel. The main thread stays in the control loop (pings answered
-    inline, so liveness tracks control-plane responsiveness); each
-    submit serves on its own thread."""
+    channel."""
     if os.environ.get(_ENV_BOOT_CRASH):
         return 3  # chaos hook: crash-loop at boot
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM, fileno=fd)
-    chan = _FrameChannel(sock)
+    return _worker_loop(_FrameChannel(sock), replica)
+
+
+def _worker_loop(chan: _FrameChannel, replica: str) -> int:
+    """The worker control loop behind any connected frame channel (a
+    socketpair fd for the local fleet, a dialed-back TCP socket for the
+    mesh's remote hosts). The main thread stays in the control loop
+    (pings answered inline, so liveness tracks control-plane
+    responsiveness); each submit serves on its own thread."""
     from spark_rapids_jni_tpu.runtime.server import QueryServer
 
     srv = QueryServer()
@@ -1110,6 +1233,11 @@ def _worker_main(fd: int, replica: str) -> int:
                     target=_serve_one, args=(chan, srv, msg, replica),
                     daemon=True,
                     name=f"fleet-serve-{msg.get('qid')}").start()
+            elif t == "register":
+                # inline, not threaded: registration must complete (and
+                # ack) before any submit that references the shard, and
+                # the control loop's ordering guarantees exactly that
+                _register_one(chan, srv, msg, replica)
             elif t == "drain":
                 state = srv.drain(timeout=msg.get("timeout"))
                 chan.send({"t": "drained", **state})

@@ -336,6 +336,11 @@ class QueryServer:
         # and updated by the degrade observer; inspect() snapshots it
         self._inflight: dict[int, dict] = {}
         self._inflight_lock = threading.Lock()
+        # resident registered tables (the mesh's shard store): name ->
+        # (table, fingerprint). Shard-step submits bind these by name so
+        # the query ships to the data, not the data to the query.
+        self._registered: dict[str, tuple] = {}
+        self._registered_lock = threading.Lock()
         self._stop = threading.Event()
         self._closed = False
         self._draining = False
@@ -359,6 +364,34 @@ class QueryServer:
                 self._queues[sid] = collections.deque()
                 self._ring.append(sid)
         return Session(self, sid)
+
+    def register_table(self, name: str, table) -> str:
+        """Install a resident table for shard-step submits (the mesh's
+        "ship the query to the shard" surface): subsequent queries bind
+        it by name via :meth:`registered_table` so only the plan — not
+        the shard's bytes — rides each submit. Returns the table's
+        content fingerprint, the input half of the idempotency pair a
+        supervisor verifies across hosts and failovers. Re-registering
+        a name replaces it (re-homed shards after a host death)."""
+        if not name or not str(name).strip():
+            raise ValueError("registered table name must be non-empty")
+        fp = resultcache.table_fingerprint(table)
+        with self._registered_lock:
+            self._registered[str(name)] = (table, fp)
+        record_server("server", "registered", session="_cluster",
+                      table=str(name), rows=int(table.num_rows),
+                      fingerprint=fp)
+        return fp
+
+    def registered_table(self, name: str):
+        """The resident table registered under ``name`` (KeyError when
+        absent — the caller classifies)."""
+        with self._registered_lock:
+            return self._registered[str(name)][0]
+
+    def registered_fingerprint(self, name: str) -> str:
+        with self._registered_lock:
+            return self._registered[str(name)][1]
 
     def submit(self, session_id: str, plan: fusion.Plan, bindings: dict, *,
                estimate_bytes: Optional[int] = None,

@@ -105,22 +105,31 @@ def _top(argv: list[str]) -> int:
         except (OSError, json.JSONDecodeError) as exc:
             print(f"error: {exc}", file=sys.stderr)
             return 1
-        # a QueryFleet.inspect() snapshot self-identifies ("fleet": True)
-        # so saved fleet state renders through the fleet view
+        # a QueryFleet.inspect() snapshot self-identifies ("fleet": True),
+        # a QueryCluster one additionally carries "cluster": True, so
+        # saved state renders through the matching view
         items = snapshots if isinstance(snapshots, list) else [snapshots]
-        fleets = [s for s in items if isinstance(s, dict) and s.get("fleet")]
-        servers = [s for s in items if s not in fleets]
+        clusters = [s for s in items
+                    if isinstance(s, dict) and s.get("cluster")]
+        fleets = [s for s in items if isinstance(s, dict) and s.get("fleet")
+                  and s not in clusters]
+        servers = [s for s in items if s not in fleets and s not in clusters]
         out = []
-        if servers or not fleets:
+        if servers or not (fleets or clusters):
             out.append(top.render_top(servers))
         if fleets:
             out.append("fleet:\n" + top.render_fleet(fleets))
+        if clusters:
+            out.append("cluster:\n" + top.render_cluster(clusters))
         print("\n\n".join(out))
         return 0
     print(top.render_top(top.collect()))
     fleets = top.collect_fleet()
     if fleets:
         print("\nfleet:\n" + top.render_fleet(fleets))
+    clusters = top.collect_cluster()
+    if clusters:
+        print("\ncluster:\n" + top.render_cluster(clusters))
     return 0
 
 

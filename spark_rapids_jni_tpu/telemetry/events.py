@@ -130,6 +130,13 @@ def _replica() -> str:
     return str(get_option("telemetry.replica") or "")
 
 
+def _host() -> str:
+    """The mesh host identity stamped next to the replica stamp
+    (cluster workers get it via SPARK_RAPIDS_TPU_TELEMETRY_HOST in
+    their environment); "" = unstamped single-host operation."""
+    return str(get_option("telemetry.host") or "")
+
+
 def _emit(rec: Dict[str, Any]) -> Dict[str, Any]:
     rec.setdefault("ts", time.time())
     rec.setdefault("platform", _platform())
@@ -139,6 +146,9 @@ def _emit(rec: Dict[str, Any]) -> Dict[str, Any]:
     rid = _replica()
     if rid:
         rec.setdefault("replica", rid)
+    hid = _host()
+    if hid:
+        rec.setdefault("host", hid)
     with _ring_lock:
         _ring.append(rec)
     REGISTRY.counter("events_total").inc()
@@ -597,6 +607,9 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
     result_cache: Dict[str, int] = {}
     fleet: Dict[str, int] = {}
     replicas: set = set()
+    cluster: Dict[str, int] = {}
+    hosts: set = set()
+    per_host: Dict[str, int] = {}
     stale_reads = 0
     dispatches = 0
     spill_bytes = 0
@@ -606,6 +619,10 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         kind = r.get("kind")
         if r.get("replica"):
             replicas.add(str(r["replica"]))
+        if r.get("host"):
+            h = str(r["host"])
+            hosts.add(h)
+            per_host[h] = per_host.get(h, 0) + 1
         if kind == "span":
             spans += 1
             st = str(r.get("status", "?"))
@@ -635,6 +652,11 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         elif kind == "fleet":
             ev = str(r.get("event", "?"))
             fleet[ev] = fleet.get(ev, 0) + 1
+            # the mesh supervisor emits its cross-host events through
+            # record_fleet under cluster.* ops: aggregate them as their
+            # own section so the cluster view needs no second pass
+            if str(r.get("op", "")).startswith("cluster."):
+                cluster[ev] = cluster.get(ev, 0) + 1
         elif kind == "fallback":
             op = str(r.get("op", "?"))
             fallbacks[op] = fallbacks.get(op, 0) + 1
@@ -665,6 +687,9 @@ def summary(records: Optional[Iterable[Dict[str, Any]]] = None) -> Dict[str, Any
         "result_cache": dict(sorted(result_cache.items())),
         "fleet": dict(sorted(fleet.items())),
         "replicas": sorted(replicas),
+        "cluster": dict(sorted(cluster.items())),
+        "hosts": sorted(hosts),
+        "per_host": dict(sorted(per_host.items())),
         "compress": compress,
         "spans": spans,
         "span_status": dict(sorted(span_status.items())),

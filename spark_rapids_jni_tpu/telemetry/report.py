@@ -221,6 +221,16 @@ def report(path: str, *, session: Optional[str] = None,
                 or "none",
             )
         )
+    if s.get("cluster"):
+        lines.append("cluster events:")
+        for ev, n in sorted(s["cluster"].items()):
+            lines.append(f"  {n:4d}x  {ev}")
+    if s.get("hosts"):
+        # per-host aggregation of the host= stamp (cluster workers set
+        # telemetry.host; the supervisor stamps its own cluster.* events)
+        per_host = s.get("per_host") or {}
+        counts = "  ".join(f"{h}={per_host.get(h, 0)}" for h in s["hosts"])
+        lines.append(f"hosts: {counts}")
     if s.get("spans"):
         status = "  ".join(
             f"{st}={n}" for st, n in sorted(s["span_status"].items()))

@@ -27,7 +27,8 @@ from __future__ import annotations
 import sys
 from typing import Any, Dict, List, Optional
 
-__all__ = ["collect", "collect_fleet", "render_top", "render_fleet"]
+__all__ = ["collect", "collect_fleet", "collect_cluster", "render_top",
+           "render_fleet", "render_cluster"]
 
 
 def collect() -> List[Dict[str, Any]]:
@@ -44,11 +45,24 @@ def collect() -> List[Dict[str, Any]]:
 def collect_fleet() -> List[Dict[str, Any]]:
     """Snapshot every open QueryFleet supervisor in this process (may be
     []). Same ``sys.modules`` posture as :func:`collect` — no fleet
-    module loaded means no fleets."""
+    module loaded means no fleets. Mesh clusters subclass the fleet and
+    register in the same live set; they render through the cluster view
+    (:func:`collect_cluster`) instead, so they are skipped here."""
     mod = sys.modules.get("spark_rapids_jni_tpu.runtime.fleet")
     if mod is None:
         return []
-    return [f.inspect() for f in mod.live_fleets()]
+    return [f.inspect() for f in mod.live_fleets()
+            if not getattr(f, "is_cluster", False)]
+
+
+def collect_cluster() -> List[Dict[str, Any]]:
+    """Snapshot every open QueryCluster mesh supervisor in this process
+    (may be []). Same ``sys.modules`` posture — no cluster module loaded
+    means no clusters."""
+    mod = sys.modules.get("spark_rapids_jni_tpu.runtime.cluster")
+    if mod is None:
+        return []
+    return [c.inspect() for c in mod.live_clusters()]
 
 
 def _fmt_bytes(n: Optional[int]) -> str:
@@ -169,6 +183,45 @@ def render_fleet(snapshots: Any) -> str:
         lines = _render_fleet_one(snap)
         if len(snapshots) > 1:
             lines.insert(0, f"fleet {i}:")
+        blocks.append("\n".join(lines))
+    return "\n\n".join(blocks)
+
+
+def _render_cluster_one(snap: Dict[str, Any]) -> List[str]:
+    # the host table IS the replica table (one worker per simulated
+    # host), rendered by the shared fleet renderer; the cluster adds its
+    # partition map and routing counters on top
+    lines = _render_fleet_one(snap)
+    counters = snap.get("counters") or {}
+    lines.insert(1, (
+        f"routing: local={counters.get('cluster.route_local', 0)}  "
+        f"rehomed={counters.get('cluster.route_rehomed', 0)}  "
+        f"fanouts={counters.get('cluster.fanouts', 0)}  "
+        f"merges={counters.get('cluster.merges', 0)}  "
+        f"host_deaths={counters.get('cluster.host_deaths', 0)}"))
+    tables = snap.get("tables") or {}
+    for name in sorted(tables):
+        t = tables[name]
+        owners = t.get("owners") or []
+        parts = "  ".join(f"p{i}->{o or '?'}" for i, o in enumerate(owners))
+        lines.append(
+            f"table {name}: parts={t.get('parts', len(owners))} "
+            f"keys={t.get('keys')} rows={t.get('rows', '-')}  [{parts}]")
+    return lines
+
+
+def render_cluster(snapshots: Any) -> str:
+    """Text view of one :meth:`QueryCluster.inspect` snapshot or a
+    list: per-host worker table + partition map + routing counters."""
+    if isinstance(snapshots, dict):
+        snapshots = [snapshots]
+    if not snapshots:
+        return "no live query clusters in this process"
+    blocks = []
+    for i, snap in enumerate(snapshots):
+        lines = _render_cluster_one(snap)
+        if len(snapshots) > 1:
+            lines.insert(0, f"cluster {i}:")
         blocks.append("\n".join(lines))
     return "\n\n".join(blocks)
 

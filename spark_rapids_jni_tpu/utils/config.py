@@ -268,6 +268,20 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # each worker's environment so a shared JSONL sink attributes every
     # line, and `telemetry report`/`trace` can group by replica.
     "telemetry.replica": ("", str),
+    # Host identity stamped next to the replica stamp ("" = unstamped).
+    # The cluster supervisor sets this in each remote worker's
+    # environment so cross-host records/spans aggregate per host.
+    "telemetry.host": ("", str),
+    # Default interface for DCN listeners and dials (runtime/cluster
+    # gateway, SliceLink.listen/connect when no host is passed).
+    # Loopback keeps CI single-machine; a mesh deploy sets the NIC.
+    "dcn.bind_host": ("127.0.0.1", str),
+    # Cross-host serving mesh (runtime/cluster.py): number of host
+    # workers the mesh supervisor boots (localhost-simulated in CI).
+    "cluster.hosts": (2, int),
+    # How long one shard registration (ship + decode + fingerprint ack)
+    # may take before it fails classified.
+    "cluster.register_timeout_s": (60.0, float),
 }
 
 _overrides: dict[str, Any] = {}

@@ -91,7 +91,7 @@ def test_main_emits_stale_tpu_record_when_backend_down(
     monkeypatch.setattr(
         bench, "_run_child",
         lambda *a, **k: (123.0, "", None, None, None, None, None, None,
-                         None, {"spill_ratio": 2.0}, None, None))
+                         None, {"spill_ratio": 2.0}, None, None, None))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["platform"] == "tpu"
@@ -114,7 +114,7 @@ def test_main_tags_stale_n_on_row_count_mismatch(
     monkeypatch.setattr(bench, "_probe_tpu", lambda t: (False, "down"))
     monkeypatch.setattr(
         bench, "_run_child",
-        lambda *a, **k: (None, "probe child down",) + (None,) * 10)
+        lambda *a, **k: (None, "probe child down",) + (None,) * 11)
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["platform"] == "tpu" and rec["value"] == 5.73e8
@@ -130,7 +130,7 @@ def test_main_no_stale_n_when_row_count_matches(
     monkeypatch.setattr(bench, "_probe_tpu", lambda t: (False, "down"))
     monkeypatch.setattr(
         bench, "_run_child",
-        lambda *a, **k: (None, "probe child down",) + (None,) * 10)
+        lambda *a, **k: (None, "probe child down",) + (None,) * 11)
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "stale_s" in rec and "stale_n" not in rec
@@ -143,7 +143,7 @@ def test_main_falls_back_to_cpu_when_ledger_empty(
     monkeypatch.setattr(bench, "_probe_tpu", lambda t: (False, "forced down"))
     monkeypatch.setattr(
         bench, "_run_child",
-        lambda c, n, i, p, t: (123.0, "") + (None,) * 10)
+        lambda c, n, i, p, t: (123.0, "") + (None,) * 11)
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["platform"] == "cpu" and rec["value"] == 123.0
@@ -161,7 +161,7 @@ def test_tpu_success_appends_to_ledger(ledger, monkeypatch, capsys):
     monkeypatch.setattr(
         bench, "_run_child",
         lambda c, n, i, p, t: (5.0e8, "", {"compiles": 1}, {"chunks": 10},
-                               {"regions": 1}) + (None,) * 7)
+                               {"regions": 1}) + (None,) * 8)
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["platform"] == "tpu" and "stale_s" not in rec

@@ -509,8 +509,48 @@ def test_rule_pallas_oracle_scope(tmp_path):
     assert _by_rule(_lint_file(target), "pallas-kernel-must-have-oracle")
 
 
+def test_rule_placement_recorded_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_cluster_placement.py"),
+                   "placement-must-record")
+    texts = [f.source_line for f in got]
+    assert len(got) == 4, texts
+    assert any("min(replicas" in t for t in texts)
+    assert any("sorted(hosts" in t for t in texts)
+    assert any("random.choice" in t for t in texts)
+    assert any("max(live" in t for t in texts)
+    # counted / recorded / raising / arithmetic-only / pragma'd /
+    # unrelated-name twins past the clean_ marker all stay clean
+    src = (FIXTURES / "seeded_cluster_placement.py").read_text()
+    clean_at = src[:src.index("def clean_pick_replica_counted")].count(
+        "\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_placement_recorded_scope(tmp_path):
+    # the same silent selections outside a fleet/cluster-named file are
+    # out of scope — even inside runtime/ (a generic chooser is not a
+    # placement decision); cluster- and fleet-named files are in scope
+    src = (FIXTURES / "seeded_cluster_placement.py").read_text()
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    plain = rt / "compress_like.py"
+    plain.write_text(src)
+    assert not _by_rule(_lint_file(plain), "placement-must-record")
+    fleety = rt / "fleet_like.py"
+    fleety.write_text(src)
+    assert _by_rule(_lint_file(fleety), "placement-must-record")
+
+
+def test_rule_placement_recorded_shipping_code_complies():
+    # the real routers must hold their own rule: every placement site in
+    # runtime/fleet.py and runtime/cluster.py records its decision
+    for mod in ("fleet", "cluster"):
+        path = REPO / "spark_rapids_jni_tpu" / "runtime" / f"{mod}.py"
+        assert not _by_rule(_lint_file(path), "placement-must-record"), mod
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all nineteen per-file rules
+    """The acceptance invariant: all twenty per-file rules
     demonstrably fire (the three whole-program rules have their own
     coverage test below)."""
     seen = set()
@@ -549,6 +589,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_compress_memory.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_pallas_kernel.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_cluster_placement.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

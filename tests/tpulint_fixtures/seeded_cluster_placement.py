@@ -1,0 +1,60 @@
+"""Seeded violations for rule 23 (placement-must-record).
+
+The basename contains ``cluster`` so the file is in scope the same way
+runtime/fleet.py and runtime/cluster.py are. Violations first, then
+clean twins past the ``def clean_`` marker the per-rule test splits on.
+"""
+
+import random
+
+
+def pick_replica_silent(replicas, cost):
+    return min(replicas, key=cost)  # VIOLATION: unrecorded placement
+
+
+def route_query_silent(q, hosts):
+    ranked = sorted(hosts, key=lambda h: h.load)  # VIOLATION: silent pick
+    return ranked[0]
+
+
+def choose_owner_random(hosts):
+    return random.choice(hosts)  # VIOLATION: random placement, invisible
+
+
+def rehome_shard_silent(live, part):
+    target = max(live, key=lambda r: -r.inflight)  # VIOLATION
+    return (part, target)
+
+
+def clean_pick_replica_counted(replicas, cost, registry):
+    picked = min(replicas, key=cost)  # clean: counter at the decision
+    registry.counter("cluster.route_local").inc()
+    return picked
+
+
+def clean_route_recorded(q, hosts, record_fleet):
+    ranked = sorted(hosts, key=lambda h: h.load)  # clean: event emitted
+    record_fleet("cluster.route", "local", replica=ranked[0].rid,
+                 host=ranked[0].rid, qid=q.qid)
+    return ranked[0]
+
+
+def clean_choose_owner_raising(hosts):
+    if not hosts:  # clean: raises instead of placing silently
+        raise RuntimeError("no live host to place on")
+    return min(hosts, key=lambda h: h.rid)
+
+
+def clean_pick_reviewed_pragma(hosts):
+    # clean: reviewed-legitimate silent pick; the pragma documents it
+    return min(hosts, key=lambda h: h.rid)  # tpulint: disable=placement-must-record
+
+
+def clean_place_arithmetic_only(parts, live):
+    # clean: round-robin by arithmetic — no selection call to flag; the
+    # caller's registration events carry the visibility
+    return {p: live[p % len(live)] for p in range(parts)}
+
+
+def clean_unrelated_name(values):
+    return max(values)  # clean: no placement token in the name

@@ -1,4 +1,4 @@
-"""The nineteen per-file tpulint rules.
+"""The twenty per-file tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -1469,6 +1469,67 @@ def check_pallas_oracle(ctx: FileContext) -> List[RawFinding]:
     ]
 
 
+# ---------------------------------------------------------------------------
+# rule 23: placement-must-record
+# ---------------------------------------------------------------------------
+
+
+def _is_placement_scope_file(ctx: FileContext) -> bool:
+    """Routing/supervision homes: fleet- and cluster-named files (the
+    deliberately narrow scope — generic selection helpers elsewhere in
+    runtime/ are not placement decisions)."""
+    return "fleet" in ctx.name or "cluster" in ctx.name
+
+
+_PLACEMENT_NAME_TOKENS = ("pick", "route", "choose", "place", "owner",
+                          "rehome")
+_SELECTION_CALLS = {"min", "max", "sorted", "choice", "choices", "randint",
+                    "randrange", "sample", "shuffle"}
+
+
+def _placement_selections(fn) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and _unparse(node.func).split(".")[-1] in _SELECTION_CALLS):
+            out.append(node)
+    return out
+
+
+def check_placement_recorded(ctx: FileContext) -> List[RawFinding]:
+    """ISSUE-17 bug class (rule 23): an invisible routing decision. The mesh's
+    whole failure story is replayed from telemetry — which host a query
+    landed on, whether locality held or a shard re-homed, why a fan-out
+    fanned where it did. A fleet/cluster function that IS a placement
+    site (its name says so: pick/route/choose/place/owner/rehome) and
+    actually selects among candidates (``min``/``max``/``sorted``/
+    ``random.*``) but emits nothing — no ``record_*`` event, no counter
+    ``.inc()``, no raise, no log — makes the routing table
+    unreconstructable exactly when a failover goes wrong. Placement
+    decisions must be recorded at the decision site. Scope: fleet- and
+    cluster-named files; functions whose selection is pure arithmetic
+    (no selection call) are exempt."""
+    if not _is_placement_scope_file(ctx):
+        return []
+    out: List[RawFinding] = []
+    for fn in _top_functions(ctx.tree):
+        lname = fn.name.lower()
+        if not any(tok in lname for tok in _PLACEMENT_NAME_TOKENS):
+            continue
+        selections = _placement_selections(fn)
+        if not selections or _fn_classifies_or_accounts(fn):
+            continue
+        for node in selections:
+            out.append(RawFinding(
+                node.lineno, node.col_offset,
+                f"`{_unparse(node)[:60]}` selects a placement in "
+                f"`{fn.name}` but nothing records the decision: emit a "
+                f"record_* telemetry event or bump a counter (.inc()) at "
+                f"the decision site — an unrecorded routing choice makes "
+                f"cross-host failover unreconstructable from telemetry"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -1555,4 +1616,10 @@ RULES = [
          "must register_kernel(..., oracle=<non-empty literal>) naming "
          "its XLA bit-identity twin",
          check_pallas_oracle),
+    Rule("placement-must-record",
+         "a placement-named function in a fleet/cluster file that "
+         "selects among candidates (min/max/sorted/random.*) must "
+         "record the routing decision: record_* event, counter "
+         ".inc(), or raise",
+         check_placement_recorded),
 ]
