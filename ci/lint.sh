@@ -1,8 +1,8 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its twenty-three invariant rules — twenty
-# per-file AST rules (host/device
+# tpulint (tools/tpulint) runs its twenty-four invariant rules —
+# twenty-one per-file AST rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
@@ -10,7 +10,7 @@
 # reservation-release-in-finally, span-must-scope, payload-must-verify,
 # cache-key-must-fingerprint, compress-inside-seal,
 # worker-exit-must-classify, pallas-kernel-must-have-oracle,
-# placement-must-record)
+# placement-must-record, rtfilter-decision-must-record)
 # plus three whole-program concurrency rules built on the
 # tools/tpulint/flows.py interprocedural engine (lock-order-cycle,
 # blocking-call-under-lock, unguarded-shared-write) —
@@ -798,9 +798,93 @@ print("kernel-tier smoke OK: pallas == xla byte-for-byte, "
       "decisions + interpret mode counted")
 EOF2
 
+# rtfilter smoke: a selective q72-style chunked aggregate with the
+# runtime bloom filter ON must stage strictly fewer probe rows than the
+# unfiltered run, produce byte-identical output, record its decision
+# through rtfilter.decide, and leak zero memory-limiter reservations.
+JAX_PLATFORMS=cpu python - <<'EOF3'
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.table_ops import trim_table
+from spark_rapids_jni_tpu.runtime import rtfilter
+from spark_rapids_jni_tpu.runtime.memory import MemoryLimiter
+from spark_rapids_jni_tpu.runtime.outofcore import run_chunked_aggregate
+from spark_rapids_jni_tpu.types import DType, TypeId
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+N_CHUNKS, ROWS, KEYSPACE, BUILD_N = 4, 4096, 400, 40
+
+
+def chunks():
+    rng = np.random.default_rng(7)
+    for _ in range(N_CHUNKS):
+        keys = rng.integers(0, KEYSPACE, ROWS).astype(np.int64)
+        vals = rng.integers(0, 1000, ROWS).astype(np.int64)
+        yield Table([
+            Column(DType(TypeId.INT64), keys, np.ones(ROWS, bool)),
+            Column(DType(TypeId.INT64), vals, np.ones(ROWS, bool)),
+        ])
+
+
+def partial(chunk):
+    keys = np.asarray(chunk.column(0).data)
+    mask = np.isin(keys, np.arange(BUILD_N))
+    kept = Table([
+        Column(c.dtype, np.asarray(c.data)[mask],
+               np.asarray(c.valid_mask())[mask])
+        for c in chunk.columns
+    ])
+    g = groupby_aggregate(kept, keys=[0], aggs=[(1, "sum")])
+    return trim_table(g.table, int(np.asarray(g.num_groups)))
+
+
+def merge(merged_in):
+    g = groupby_aggregate(merged_in, keys=[0], aggs=[(1, "sum")])
+    return trim_table(g.table, int(np.asarray(g.num_groups)))
+
+
+def run(stream, limiter):
+    out = run_chunked_aggregate(stream, partial, merge, limiter=limiter)
+    assert limiter.used == 0, "leaked reservations"
+    return out
+
+
+lim_off = MemoryLimiter(256 << 20)
+off = run(chunks(), lim_off)
+
+set_option("rtfilter.enabled", True)
+try:
+    rtfilter.reset()
+    decision = rtfilter.decide("lint_rtfilter", "join1", BUILD_N)
+    assert decision.apply, decision
+    bf = rtfilter.build_filter(np.arange(BUILD_N, dtype=np.int64),
+                               expected_items=BUILD_N)
+    lim_on = MemoryLimiter(256 << 20)
+    on = run(rtfilter.pruned_chunks(chunks(), bf, 0,
+                                    plan_name="lint_rtfilter",
+                                    label="join1"), lim_on)
+    for a, b in zip(off.table.columns, on.table.columns):
+        assert np.asarray(a.data).tobytes() == np.asarray(b.data).tobytes(), \
+            "runtime filter changed the answer"
+    s = rtfilter.stats()
+    assert s["decisions_apply"] >= 1, s     # decision recorded
+    assert s["rows_pruned"] > 0, s          # strictly fewer rows staged
+    assert s["rows_in"] == N_CHUNKS * ROWS, s
+    assert on.peak_bytes < off.peak_bytes, (on.peak_bytes, off.peak_bytes)
+finally:
+    reset_option("rtfilter.enabled")
+    rtfilter.reset()
+print("rtfilter smoke OK: pruned run bit-identical, "
+      "decision recorded, zero leaked reservations")
+EOF3
+
 # fixture gate: rules 20-22 are whole-program (tools/tpulint/flows.py
-# builds the call graph + lock registry; concurrency.py judges it) and
-# rule 23 (placement-must-record) guards the mesh's routing visibility.
+# builds the call graph + lock registry; concurrency.py judges it),
+# rule 23 (placement-must-record) guards the mesh's routing visibility,
+# and rule 24 (rtfilter-decision-must-record) guards the runtime-filter
+# planner's decision visibility.
 # The package sweep above already fails on any new finding; this block
 # proves the ENGINE has not regressed silently — each seeded fixture
 # must still FIRE its rule (checked structurally via --format json, not
@@ -810,7 +894,8 @@ for fixture_rule in \
     "seeded_lock_order.py lock-order-cycle" \
     "seeded_blocking_under_lock.py blocking-call-under-lock" \
     "seeded_unguarded_write.py unguarded-shared-write" \
-    "seeded_cluster_placement.py placement-must-record"; do
+    "seeded_cluster_placement.py placement-must-record" \
+    "seeded_rtfilter_decision.py rtfilter-decision-must-record"; do
   set -- $fixture_rule
   out=$(python -m tools.tpulint --format json --no-baseline \
         "tests/tpulint_fixtures/$1" || true)
@@ -824,7 +909,7 @@ want, fixture = os.environ["RULE"], os.environ["FIXTURE"]
 assert want in rules, f"{fixture} no longer fires {want}: {rules}"
 EOF
 done
-echo "seeded fixtures OK: rules 20-23 fire"
+echo "seeded fixtures OK: rules 20-24 fire"
 
 graph=$(python -m tools.tpulint --lock-graph spark_rapids_jni_tpu)
 grep -q "acyclic" <<<"$graph"

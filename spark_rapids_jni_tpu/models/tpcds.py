@@ -481,6 +481,101 @@ def tpcds_q72_distributed(
     return _compact_valid_keys(result, 2, [2, 0], [False, True])
 
 
+# ---- cluster q72 (cross-host fan-out with runtime-filter pushdown) ---------
+
+
+def _q72_partial_plan(year: int, out_factor: int,
+                      rtf=None) -> fusion.Plan:
+    """Per-shard q72 partial: the full join chain + group-count over one
+    catalog_sales shard, NO final sort (the router merges and orders).
+
+    ``rtf`` is an optional ``(num_bits, num_hashes)`` pair: when set, the
+    shard's fact scan is wrapped in a ``BloomProbe`` against the packed
+    bloom bits the router shipped inline under the ``rtf_bits`` binding
+    (built from join1's date_dim build keys), so every host prunes its
+    own shard locally before the join chain runs. Null-key rows never
+    match join1 anyway, so the partial stays bit-identical with the
+    filter on or off. The geometry is part of the plan fingerprint, so
+    filtered and unfiltered partials never alias in any cache."""
+    cs = fusion.Scan("catalog_sales")
+    if rtf is not None:
+        cs = fusion.BloomProbe(
+            cs, fusion.Scan("rtf_bits", bucket=False), CS_SOLD_DATE_SK,
+            int(rtf[0]), int(rtf[1]), packed=True, label="rtf_join1")
+    dd = fusion.Project(fusion.Scan("date_dim"), _q72_dd_fn, (year,))
+    j1 = fusion.Join(cs, dd, (CS_SOLD_DATE_SK,), (0,),
+                     fusion.rows_of("catalog_sales"), label="join1")
+    j2 = fusion.Join(j1, fusion.Scan("item"), (0,), (I_ITEM_SK,),
+                     fusion.rows_of("catalog_sales"), label="join2")
+    probe = fusion.Project(j2, _q72_probe_fn)
+    inv = fusion.Project(fusion.Scan("inventory"), _q72_inv_fn)
+    j3 = fusion.Join(probe, inv, (0,), (0,),
+                     fusion.rows_of("catalog_sales", out_factor),
+                     label="join3")
+    g = fusion.GroupBy(fusion.Project(j3, _q72_keyed_fn), (0, 1),
+                       ((2, "count"),), label="partial")
+    return fusion.Plan("tpcds_q72_partial", g)
+
+
+def tpcds_q72_cluster(
+    c,
+    session_id: str,
+    date_dim: Table,
+    item: Table,
+    inventory: Table,
+    year: int = 2000,
+    out_factor: int = 2,
+    deadline_ms=None,
+    merge_timeout_s: float = 300.0,
+) -> Table:
+    """q72 over a cross-host cluster: catalog_sales is registered and
+    hash-sharded across the mesh hosts (``c.register_table``), the three
+    dimension tables broadcast inline on each submit frame, and every
+    host runs ``_q72_partial_plan`` over its resident shard. The router
+    merges the partials (concat -> regroup-sum -> compact -> order).
+
+    Runtime-filter pushdown: the router asks ``rtfilter.decide`` whether
+    join1's build side (date_dim, year-filtered) is worth a bloom
+    filter. On apply it builds the filter ONCE router-side, serializes
+    it via ``to_packed`` into the ``rtf_bits`` binding (sealed DCN
+    wire), and the per-shard plan probes it so each host prunes fact
+    rows that cannot match any in-year date before the join chain —
+    rows-scanned drops shard-locally without a second fan-out round."""
+    from spark_rapids_jni_tpu.ops.table_ops import concatenate, trim_table
+    from spark_rapids_jni_tpu.runtime import rtfilter
+
+    decision = rtfilter.decide("tpcds_q72_cluster", "join1",
+                               date_dim.num_rows)
+    bindings = {"date_dim": date_dim, "item": item, "inventory": inventory}
+    rtf = None
+    if decision.apply:
+        # Build keys = date_dim PKs with wrong-year rows nulled; the
+        # bloom set is exactly join1's match set.
+        dk = _q72_dd_fn(date_dim, year).column(0)
+        bf = rtfilter.build_filter(dk.data, dk.valid_mask(),
+                                   expected_items=date_dim.num_rows)
+        bindings["rtf_bits"] = rtfilter.packed_table(bf)
+        rtf = (bf.num_bits, bf.num_hashes)
+
+    def merge_fn(partials):
+        parts = [
+            trim_table(p.table,
+                       int(np.asarray(p.meta["partial.num_groups"])))
+            for p in partials
+        ]
+        merged = groupby_aggregate(concatenate(parts), keys=[0, 1],
+                                   aggs=[(2, "sum")])
+        out = trim_table(merged.table, int(np.asarray(merged.num_groups)))
+        return _compact_valid_keys(out, 2, [2, 0], [False, True])
+
+    mt = c.submit_merge(session_id,
+                        _q72_partial_plan(year, out_factor, rtf=rtf),
+                        merge_fn, table="catalog_sales",
+                        binding="catalog_sales", bindings=bindings,
+                        deadline_ms=deadline_ms)
+    return mt.result(timeout=merge_timeout_s)
+
+
 # ---- q64-style -------------------------------------------------------------
 
 

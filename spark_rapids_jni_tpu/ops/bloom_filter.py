@@ -31,6 +31,8 @@ import numpy as np
 
 from spark_rapids_jni_tpu.columnar.bitmask import pack_validity, unpack_validity
 from spark_rapids_jni_tpu.ops.hash import xxhash64_long
+from spark_rapids_jni_tpu.runtime.resilience import MalformedInputError
+from spark_rapids_jni_tpu.telemetry.events import REGISTRY
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 _MM3_C1 = np.uint32(0xCC9E2D51)
@@ -82,9 +84,7 @@ class BloomFilter:
     def optimal(cls, expected_items: int, fpp: float = 0.03) -> "BloomFilter":
         """Size like Spark's BloomFilter.create: m = -n ln p / (ln 2)^2,
         k = max(1, round(m/n * ln 2))."""
-        n = max(expected_items, 1)
-        m = max(int(-n * np.log(fpp) / (np.log(2) ** 2)), 64)
-        k = max(1, int(round(m / n * np.log(2))))
+        m, k = optimal_params(expected_items, fpp)
         return cls.empty(m, k)
 
     def to_packed(self) -> jnp.ndarray:
@@ -99,6 +99,16 @@ class BloomFilter:
         )
 
 
+def optimal_params(expected_items: int, fpp: float = 0.03) -> tuple[int, int]:
+    """(num_bits, num_hashes) for the Spark BloomFilter.create sizing —
+    exposed separately so the runtime-filter planner can size a filter
+    (and fold the size into fingerprints) without allocating bits."""
+    n = max(int(expected_items), 1)
+    m = max(int(-n * np.log(fpp) / (np.log(2) ** 2)), 64)
+    k = max(1, int(round(m / n * np.log(2))))
+    return m, k
+
+
 def _bit_positions(values: jnp.ndarray, num_bits: int, num_hashes: int):
     """(n, k) bit indexes — BloomFilterImpl.putLong's double hashing."""
     h1 = murmur3_hash_long(values, np.uint32(0))
@@ -109,6 +119,16 @@ def _bit_positions(values: jnp.ndarray, num_bits: int, num_hashes: int):
     return combined % jnp.int32(num_bits)
 
 
+def _put_bits(bits: jnp.ndarray, values: jnp.ndarray,
+              valid: jnp.ndarray | None, num_bits: int,
+              num_hashes: int) -> jnp.ndarray:
+    pos = _bit_positions(values.astype(jnp.int64), num_bits, num_hashes)
+    if valid is not None:
+        # route invalid rows' updates out of range; scatter mode="drop"
+        pos = jnp.where(valid[:, None], pos, num_bits)
+    return bits.at[pos.reshape(-1)].max(jnp.uint8(1), mode="drop")
+
+
 @func_range("bloom_filter_put")
 def bloom_put(
     bf: BloomFilter,
@@ -116,26 +136,69 @@ def bloom_put(
     valid: jnp.ndarray | None = None,
 ) -> BloomFilter:
     """Insert int64 values (null rows skipped). Functional update — under
-    jit XLA donates/aliases the bitset buffer."""
-    pos = _bit_positions(values.astype(jnp.int64), bf.num_bits, bf.num_hashes)
-    if valid is not None:
-        # route invalid rows' updates out of range; scatter mode="drop"
-        pos = jnp.where(valid[:, None], pos, bf.num_bits)
-    bits = bf.bits.at[pos.reshape(-1)].max(jnp.uint8(1), mode="drop")
-    return BloomFilter(bits, bf.num_hashes)
+    jit XLA donates/aliases the bitset buffer.
+
+    Routed through the bucketed dispatch cache: the value column is the
+    row group (padded rows masked out via ``row_valids``, exactly like
+    null rows), the bitset rides as an aux arg, and (num_bits,
+    num_hashes) are statics so differently-shaped filters never share an
+    executable. Under tracers (e.g. inside a fused region) dispatch
+    falls back to the inline trace — same bits either way."""
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    vld = valid if valid is not None \
+        else jnp.ones(values.shape, dtype=jnp.bool_)
+    num_bits, num_hashes = bf.num_bits, bf.num_hashes
+
+    def _fn(row_args, aux_args, row_valids):
+        (vals, v), = row_args
+        (bits,) = aux_args
+        rv = row_valids[0] if row_valids is not None else None
+        keep = v if rv is None else (v & rv)
+        return _put_bits(bits, vals, keep, num_bits, num_hashes)
+
+    bits = dispatch.call(
+        "bloom.put", _fn, ((values, vld),), (bf.bits,),
+        statics=(num_bits, num_hashes), slice_rows=False)
+    return BloomFilter(bits, num_hashes)
 
 
 @func_range("bloom_filter_might_contain")
 def bloom_might_contain(bf: BloomFilter, values: jnp.ndarray) -> jnp.ndarray:
-    """bool[n]: definitely-absent rows are False."""
-    pos = _bit_positions(values.astype(jnp.int64), bf.num_bits, bf.num_hashes)
-    return jnp.all(bf.bits[pos] == 1, axis=1)
+    """bool[n]: definitely-absent rows are False.
+
+    Dispatch-routed like :func:`bloom_put`; the bucket-padded tail rows
+    gather in-range garbage that ``slice_rows`` trims away."""
+    from spark_rapids_jni_tpu.runtime import dispatch
+
+    num_bits, num_hashes = bf.num_bits, bf.num_hashes
+
+    def _fn(row_args, aux_args, row_valids):
+        (vals,), = row_args
+        (bits,) = aux_args
+        pos = _bit_positions(vals.astype(jnp.int64), num_bits, num_hashes)
+        return jnp.all(bits[pos] == 1, axis=1)
+
+    return dispatch.call(
+        "bloom.might_contain", _fn, ((values,),), (bf.bits,),
+        statics=(num_bits, num_hashes))
 
 
 def bloom_merge(a: BloomFilter, b: BloomFilter) -> BloomFilter:
-    """Union — how Spark combines per-task filters."""
+    """Union — how Spark combines per-task filters.
+
+    Two filters only OR meaningfully when they agree on BOTH geometry
+    parameters: same num_bits AND same num_hashes (equal bit counts with
+    different hash counts place bits incompatibly, and a silent OR would
+    yield a filter that drops rows its inputs would keep). Disagreement
+    is classified :class:`MalformedInputError` — the filters are wrong,
+    not the engine — and counted under ``rtfilter.merge_mismatch``."""
     if a.num_bits != b.num_bits or a.num_hashes != b.num_hashes:
-        raise ValueError("bloom filters must have identical shape to merge")
+        REGISTRY.counter("rtfilter.merge_mismatch").inc()
+        raise MalformedInputError(
+            f"bloom merge geometry mismatch: "
+            f"(num_bits={a.num_bits}, num_hashes={a.num_hashes}) vs "
+            f"(num_bits={b.num_bits}, num_hashes={b.num_hashes})")
     return BloomFilter(jnp.maximum(a.bits, b.bits), a.num_hashes)
 
 

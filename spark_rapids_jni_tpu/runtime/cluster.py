@@ -462,6 +462,7 @@ class QueryCluster(QueryFleet):
     def submit_to_shard(self, session_id: str, plan: fusion.Plan, *,
                         table: str, binding: str,
                         part: Optional[int] = None, key_table=None,
+                        bindings: Optional[dict] = None,
                         deadline_ms: Optional[int] = None) -> FleetTicket:
         """Route one single-shard query to the host owning the shard.
         Only the plan crosses the wire: ``binding`` resolves on the
@@ -469,7 +470,13 @@ class QueryCluster(QueryFleet):
         ``key_table`` (one key row) to look the partition up. The memo
         key pairs the plan signature (derived against the shard's row
         count) with the shard's registration fingerprint, so cross-host
-        failover and duplicate drops keep their bit-identity check."""
+        failover and duplicate drops keep their bit-identity check.
+
+        ``bindings`` optionally ships additional SMALL tables inline on
+        the submit frame (sealed DCN transport) — replicated dimension
+        sides and runtime-filter ``to_packed`` bloom bits, the
+        broadcast half of a fan-out join; the registered shard stays
+        resident and never rides the wire."""
         with self._lock:
             ss = self._tables.get(str(table))
         if ss is None:
@@ -484,7 +491,7 @@ class QueryCluster(QueryFleet):
                              f"partitions, no p{part}")
         binding = str(binding)
         return self._submit(
-            str(session_id), plan, {},
+            str(session_id), plan, dict(bindings or {}),
             binding_refs={binding: f"{ss.name}/p{part}"},
             shard=(ss.name, part),
             sig_bindings={binding: _ShardRows(ss.rows[part])},
@@ -493,11 +500,14 @@ class QueryCluster(QueryFleet):
 
     def submit_merge(self, session_id: str, partial_plan: fusion.Plan,
                      merge_fn, *, table: str, binding: str,
+                     bindings: Optional[dict] = None,
                      deadline_ms: Optional[int] = None) -> MergeTicket:
         """Fan a partial plan out to every shard's host and merge on the
         router: ``merge_fn(partial_results)`` runs on the caller's
         thread once every partial lands (``MergeTicket.result``), its
-        input ordered by part index so the merge is deterministic."""
+        input ordered by part index so the merge is deterministic.
+        ``bindings`` (inline broadcast tables — dims, packed bloom
+        bits) ship with every per-shard submit."""
         with self._lock:
             ss = self._tables.get(str(table))
         if ss is None:
@@ -508,6 +518,7 @@ class QueryCluster(QueryFleet):
         tickets = [
             self.submit_to_shard(session_id, partial_plan, table=table,
                                  binding=binding, part=p,
+                                 bindings=bindings,
                                  deadline_ms=deadline_ms)
             for p in range(ss.parts)]
         return MergeTicket(self, ss.name, partial_plan.name, tickets,

@@ -92,6 +92,8 @@ __all__ = [
     "GroupBy",
     "Join",
     "DensePkJoin",
+    "BloomBuild",
+    "BloomProbe",
     "Sort",
     "Limit",
     "Plan",
@@ -99,6 +101,7 @@ __all__ = [
     "rows_of",
     "min_rows_of",
     "execute",
+    "inject_runtime_filters",
     "estimate_hbm_bytes",
     "plan_fingerprint",
     "scan_prefix_chains",
@@ -234,6 +237,45 @@ class DensePkJoin(NamedTuple):
     label: str = "pk_join"
 
 
+class BloomBuild(NamedTuple):
+    """Runtime-filter build side: materialize the child's key column into
+    a Spark-compatible bloom filter (``bloom_put_spark`` — null keys and
+    phantom rows skipped), emitted as a one-column uint8 bits table.
+    Inserted by :func:`inject_runtime_filters`, never written by hand;
+    geometry (num_bits, num_hashes) is a static chosen by the gate and
+    fingerprinted, so on/off — and differently-sized — plans never alias
+    an executable."""
+
+    child: Any
+    key: int
+    num_bits: int
+    num_hashes: int
+    label: str = "rtf"
+
+
+class BloomProbe(NamedTuple):
+    """Runtime-filter probe side: rows whose key is definitely absent
+    from the ``build`` filter get that KEY's validity nulled — exactly
+    the WHERE-before-join masking idiom, so the join downstream treats
+    them as the non-matches they are provably about to be. No row is
+    compacted and no data byte changes: results are bit-identical with
+    the probe present or absent, for probe-aligned and compacting joins
+    alike (a bloom filter has no false negatives). ``build`` is either a
+    :class:`BloomBuild` or an unbucketed Scan bound to a bits table
+    (``packed=True`` when those bits are the ``to_packed`` wire form a
+    cluster shard received). Meta: ``<label>.rows_in`` /
+    ``<label>.rows_pass`` — the observed selectivity the learned gate
+    feeds on."""
+
+    child: Any
+    build: Any
+    key: int
+    num_bits: int
+    num_hashes: int
+    packed: bool = False
+    label: str = "rtf"
+
+
 class Sort(NamedTuple):
     """``sort_table``; when the input still carries a region row_valid the
     phantom rows rank strictly last (``sort_order``'s row_valid contract),
@@ -261,8 +303,8 @@ class Plan(NamedTuple):
     root: Any
 
 
-_NODE_TYPES = (Scan, Filter, Project, GroupBy, Join, DensePkJoin, Sort,
-               Limit)
+_NODE_TYPES = (Scan, Filter, Project, GroupBy, Join, DensePkJoin,
+               BloomBuild, BloomProbe, Sort, Limit)
 
 
 class FusedResult(NamedTuple):
@@ -280,12 +322,14 @@ class FusedResult(NamedTuple):
 def _children(node) -> tuple:
     if isinstance(node, Scan):
         return ()
-    if isinstance(node, (Filter, Project, GroupBy, Sort, Limit)):
+    if isinstance(node, (Filter, Project, GroupBy, Sort, Limit, BloomBuild)):
         return (node.child,)
     if isinstance(node, Join):
         return (node.left, node.right)
     if isinstance(node, DensePkJoin):
         return (node.probe, node.build)
+    if isinstance(node, BloomProbe):
+        return (node.child, node.build)
     raise TypeError(f"not a plan node: {type(node).__name__}")
 
 
@@ -364,6 +408,11 @@ def _fingerprint(nodes, resolved: dict) -> tuple:
         elif isinstance(node, DensePkJoin):
             entry = ("pk_join", node.probe_key, node.build_key, node.key_lo,
                      resolved[id(node)], node.clustered)
+        elif isinstance(node, BloomBuild):
+            entry = ("bloom_build", node.key, node.num_bits, node.num_hashes)
+        elif isinstance(node, BloomProbe):
+            entry = ("bloom_probe", node.key, node.num_bits,
+                     node.num_hashes, node.packed)
         elif isinstance(node, Sort):
             entry = ("sort", node.keys,
                      None if node.ascending is None else tuple(node.ascending),
@@ -416,6 +465,11 @@ def _spaces(nodes) -> dict:
                 spaces[id(node)] = None
         elif isinstance(node, DensePkJoin):
             spaces[id(node)] = spaces[id(node.probe)]  # probe-aligned
+        elif isinstance(node, BloomBuild):
+            spaces[id(node)] = None  # fixed shape: num_bits bytes
+        elif isinstance(node, BloomProbe):
+            # only a key's validity changes — strictly row-preserving
+            spaces[id(node)] = spaces[id(node.child)]
         elif isinstance(node, Sort):
             spaces[id(node)] = spaces[id(node.child)]
         elif isinstance(node, (Join, Limit)):
@@ -440,6 +494,8 @@ def _side_keys(nodes) -> list:
             keys.append(f"{node.label}.total")
         elif isinstance(node, DensePkJoin):
             keys += [f"{node.label}.total", f"{node.label}.pk_violation"]
+        elif isinstance(node, BloomProbe):
+            keys += [f"{node.label}.rows_in", f"{node.label}.rows_pass"]
     return keys
 
 
@@ -472,6 +528,8 @@ def _eval_plan(root, tables: dict, rvs: dict, resolved: dict,
     (root table, [(side key, traced value), ...]). Called with tracer
     tables inside the fused region fn and with concrete tables on the
     staged path — the SAME per-op calls either way."""
+    from spark_rapids_jni_tpu import types as _t
+    from spark_rapids_jni_tpu.ops import bloom_filter as _bloom
     from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
     from spark_rapids_jni_tpu.ops.join import apply_join_maps, join
     from spark_rapids_jni_tpu.ops.planner import dense_pk_join, plan_groupby
@@ -543,6 +601,46 @@ def _eval_plan(root, tables: dict, rvs: dict, resolved: dict,
                 (f"{node.label}.pk_violation", r.pk_violation),
             ])
             out = (r.table, prv)
+        elif isinstance(node, BloomBuild):
+            tbl, rv = ev(node.child)
+            col = tbl.columns[node.key]
+            kv = col.valid_mask()
+            if rv is not None:
+                kv = kv & rv
+            bf = _bloom.BloomFilter(
+                jnp.zeros((node.num_bits,), dtype=jnp.uint8),
+                node.num_hashes)
+            bf = _bloom.bloom_put_spark(bf, col.data, kv)
+            out = (Table([Column(_t.UINT8, bf.bits)]), None)
+        elif isinstance(node, BloomProbe):
+            tbl, rv = ev(node.child)
+            btbl, _ = ev(node.build)
+            bits = btbl.columns[0].data
+            if node.packed:
+                bf = _bloom.BloomFilter.from_packed(
+                    bits, node.num_bits, node.num_hashes)
+            else:
+                bf = _bloom.BloomFilter(bits, node.num_hashes)
+            col = tbl.columns[node.key]
+            kv = col.valid_mask()
+            if rv is not None:
+                kv = kv & rv
+            hit = _bloom.bloom_might_contain_spark(bf, col.data)
+            side.extend([
+                (f"{node.label}.rows_in",
+                 jnp.sum(kv.astype(jnp.int32))),
+                (f"{node.label}.rows_pass",
+                 jnp.sum((kv & hit).astype(jnp.int32))),
+            ])
+            # null ONLY the key's validity where the filter proves the
+            # key absent from the build — data bytes and every other
+            # column untouched, so this is indistinguishable from the
+            # key having been nulled by a WHERE upstream
+            cols = list(tbl.columns)
+            cols[node.key] = Column(
+                col.dtype, col.data, col.valid_mask() & (hit | ~kv),
+                chars=col.chars, children=col.children)
+            out = (Table(cols), rv)
         elif isinstance(node, Sort):
             tbl, rv = ev(node.child)
             asc = None if node.ascending is None else list(node.ascending)
@@ -597,6 +695,123 @@ def _slice_to(out, n: int):
 
 
 # ---------------------------------------------------------------------------
+# runtime-filter planner pass
+# ---------------------------------------------------------------------------
+
+
+def _subtree_rows_estimate(node, bindings: dict) -> int:
+    """Static upper-ish bound on the distinct keys a subtree can feed a
+    bloom build: bound scan rows summed, and any interior join's resolved
+    out_rows taken as a floor (a join can expand past its scans). Used
+    only for gating and bits sizing — an overestimate just buys a larger,
+    lower-FPP filter, never a wrong result."""
+    rows = 0
+    for n in _topo(node):
+        if isinstance(n, Scan) and n.name in bindings:
+            rows += int(bindings[n.name].num_rows)
+    for n in _topo(node):
+        if isinstance(n, Join):
+            spec = n.out_rows
+            if isinstance(spec, int):
+                rows = max(rows, spec)
+            elif (isinstance(spec, tuple) and len(spec) == 3
+                    and spec[0] == "rows_of" and spec[1] in bindings):
+                rows = max(rows,
+                           int(bindings[spec[1]].num_rows) * int(spec[2]))
+    return rows
+
+
+def inject_runtime_filters(plan: Plan, bindings: dict) -> Plan:
+    """The RuntimeFilter planner pass: for each single-key inner Join
+    (either direction — the smaller side builds) and each DensePkJoin
+    (build side fixed by the layout), ask the learned gate
+    (``runtime/rtfilter.decide`` — every decision recorded with a
+    reason) whether a bloom filter pays, and when it does, insert a
+    :class:`BloomBuild` over the build child and a :class:`BloomProbe`
+    over the probe child. The probe sits INSIDE the region — below the
+    fusion boundary — so the pruned scan fuses with everything above it;
+    chunked/out-of-core paths prune per chunk on the host side instead
+    (``rtfilter.prune_chunk``), where compaction is free. Results are
+    bit-identical with the pass on or off (see :class:`BloomProbe`);
+    what changes is the dispatch fingerprint, so filtered and unfiltered
+    plans never alias an executable."""
+    from spark_rapids_jni_tpu.runtime import rtfilter
+
+    root = plan.root
+    done: set = set()
+    while True:
+        target = None
+        for node in _topo(root):
+            if isinstance(node, Join):
+                if (node.how != "inner" or len(node.left_on) != 1
+                        or len(node.right_on) != 1):
+                    continue
+                if node.label in done:
+                    continue
+                if isinstance(node.left, BloomProbe) \
+                        or isinstance(node.right, BloomProbe):
+                    done.add(node.label)
+                    continue
+                left_rows = _subtree_rows_estimate(node.left, bindings)
+                right_rows = _subtree_rows_estimate(node.right, bindings)
+                if right_rows <= left_rows:
+                    sides = ("left", node.left, node.left_on[0],
+                             node.right, node.right_on[0], right_rows)
+                else:
+                    sides = ("right", node.right, node.right_on[0],
+                             node.left, node.left_on[0], left_rows)
+                target = (node,) + sides
+                break
+            if isinstance(node, DensePkJoin):
+                if node.label in done:
+                    continue
+                if isinstance(node.probe, BloomProbe):
+                    done.add(node.label)
+                    continue
+                build_rows = _subtree_rows_estimate(node.build, bindings)
+                target = (node, "probe", node.probe, node.probe_key,
+                          node.build, node.build_key, build_rows)
+                break
+        if target is None:
+            break
+        node, side, probe_child, probe_key, build_child, build_key, \
+            build_rows = target
+        done.add(node.label)
+        decision = rtfilter.decide(plan.name, node.label, build_rows)
+        if not decision.apply:
+            continue
+        rtf_label = f"rtf_{node.label}"
+        bb = BloomBuild(build_child, build_key, decision.num_bits,
+                        decision.num_hashes, label=rtf_label)
+        bp = BloomProbe(probe_child, bb, probe_key, decision.num_bits,
+                        decision.num_hashes, label=rtf_label)
+        if isinstance(node, DensePkJoin):
+            new_node = node._replace(probe=bp)
+        elif side == "left":
+            new_node = node._replace(left=bp)
+        else:
+            new_node = node._replace(right=bp)
+        root = replace_node(root, node, new_node)
+    if root is plan.root:
+        return plan
+    return plan._replace(root=root)
+
+
+def _harvest_rtfilter(plan: Plan, nodes, meta: dict) -> None:
+    """Feed each probe's observed pass fraction back to the learned
+    gate (no-op when the region produced tracers)."""
+    probes = [n for n in nodes if isinstance(n, BloomProbe)]
+    if not probes:
+        return
+    from spark_rapids_jni_tpu.runtime import rtfilter
+
+    for n in probes:
+        rtfilter.observe(plan.name, n.label,
+                         meta.get(f"{n.label}.rows_in"),
+                         meta.get(f"{n.label}.rows_pass"))
+
+
+# ---------------------------------------------------------------------------
 # the fuser
 # ---------------------------------------------------------------------------
 
@@ -638,6 +853,8 @@ def execute(plan: Plan, bindings: dict, *,
     """
     if cancel_token is not None:
         cancel_token.check(f"fusion.{plan.name}")
+    if get_option("rtfilter.enabled"):
+        plan = inject_runtime_filters(plan, bindings)
     nodes = _topo(plan.root)
     bucketed, exact = _scan_names(nodes)
     for name in bucketed + exact:
@@ -671,7 +888,9 @@ def execute(plan: Plan, bindings: dict, *,
                                      true_rows)
         meta = dict(side)
         meta.update(static_meta)
-        return FusedResult(value, meta)
+        res = FusedResult(value, meta)
+        _harvest_rtfilter(plan, nodes, res.meta)
+        return res
 
     if force_staged or not get_option("fusion.enabled"):
         return _staged_eval()
@@ -741,6 +960,7 @@ def execute(plan: Plan, bindings: dict, *,
         value = _slice_to(value, int(true_rows[root_space]))
     meta = dict(zip(side_keys, side_vals))
     meta.update(static_meta)
+    _harvest_rtfilter(plan, nodes, meta)
     return FusedResult(value, meta)
 
 
@@ -818,12 +1038,15 @@ def replace_node(root, target, replacement):
         new_kids = tuple(rebuild(c) for c in kids)
         if all(nk is k for nk, k in zip(new_kids, kids)):
             out = node
-        elif isinstance(node, (Filter, Project, GroupBy, Sort, Limit)):
+        elif isinstance(node, (Filter, Project, GroupBy, Sort, Limit,
+                               BloomBuild)):
             out = node._replace(child=new_kids[0])
         elif isinstance(node, Join):
             out = node._replace(left=new_kids[0], right=new_kids[1])
         elif isinstance(node, DensePkJoin):
             out = node._replace(probe=new_kids[0], build=new_kids[1])
+        elif isinstance(node, BloomProbe):
+            out = node._replace(child=new_kids[0], build=new_kids[1])
         else:  # pragma: no cover - Scan has no children to rebuild
             out = node
         memo[id(node)] = out
@@ -858,13 +1081,17 @@ def estimate_hbm_bytes(plan: Plan, bindings: dict) -> int:
     total_rows = max(1, sum(true_rows.values()))
     row_width = max(1, input_bytes // total_rows)
     out_rows = 0
+    extra_bytes = 0
     for node in nodes:
         if isinstance(node, (Join, DensePkJoin)):
             out_rows += int(resolved[id(node)] or 0)
         elif isinstance(node, GroupBy):
             cap = resolved.get(id(node))
             out_rows += int(cap if cap is not None else node.budget)
-    return int(input_bytes + out_rows * row_width)
+        elif isinstance(node, BloomBuild):
+            # byte-per-bit filter plus the (n, k) position scratch
+            extra_bytes += int(node.num_bits)
+    return int(input_bytes + out_rows * row_width + extra_bytes)
 
 
 def _planned_lowering(node: GroupBy) -> str:

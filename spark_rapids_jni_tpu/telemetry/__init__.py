@@ -39,6 +39,7 @@ from spark_rapids_jni_tpu.telemetry.events import (
     record_integrity,
     record_kernel_tier,
     record_resilience,
+    record_rtfilter,
     record_server,
     record_spill,
     session_scope,
@@ -74,6 +75,7 @@ __all__ = [
     "record_integrity",
     "record_kernel_tier",
     "record_resilience",
+    "record_rtfilter",
     "record_server",
     "record_spill",
     "session_scope",

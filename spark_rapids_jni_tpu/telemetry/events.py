@@ -451,6 +451,37 @@ def record_integrity(
     return True
 
 
+def record_rtfilter(
+    op: str,
+    event: str,
+    *,
+    reason: str,
+    **extra: Any,
+) -> bool:
+    """A runtime-filter planner decision or observation
+    (runtime/rtfilter.py).
+
+    ``event`` is one of ``apply`` / ``skip`` / ``observed`` /
+    ``state_discarded`` / ``prune``; ``reason`` says WHY (``selective``,
+    ``no_history_optimistic``, ``learned_nonselective``,
+    ``build_too_large``, ``disabled``, ``corrupt``, ...) and is
+    mandatory even when telemetry is off — an unexplained filter
+    decision is a bug (tpulint rule 24 enforces the static half of this
+    contract on the rtfilter path)."""
+    if not reason or not str(reason).strip():
+        raise ValueError(f"record_rtfilter({op!r}): reason must be non-empty")
+    if not enabled():
+        return False
+    rec = _base("rtfilter", op, None, None, extra)
+    rec["event"] = str(event)
+    rec["reason"] = str(reason)
+    # no counter side effects: rtfilter owns its ``rtfilter.*`` counters
+    # and counts unconditionally (decision accounting must hold whether
+    # or not anyone is watching, like the server's admission counters)
+    _emit(rec)
+    return True
+
+
 def record_cache(
     op: str,
     event: str,

@@ -282,6 +282,34 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # How long one shard registration (ship + decode + fingerprint ack)
     # may take before it fails classified.
     "cluster.register_timeout_s": (60.0, float),
+    # Runtime bloom-join filters (runtime/rtfilter.py): master switch for
+    # the planner pass that builds a bloom filter from a selective join's
+    # build side and prunes the probe side before it stages. Off by
+    # default — results are bit-identical either way (a bloom filter only
+    # drops rows the join would drop); on buys fewer rows scanned on
+    # chunked/fan-out paths at the cost of the build.
+    "rtfilter.enabled": (False, bool),
+    # Build sides above this many rows never get a filter (the bloom
+    # bits would be large and the join is unlikely to be selective).
+    "rtfilter.max_build_rows": (1 << 16, int),
+    # Target false-positive probability handed to BloomFilter.optimal
+    # when sizing a filter's bits for the observed build cardinality.
+    "rtfilter.fpp": (0.03, float),
+    # Learned gate: once a (plan, join) signature's observed pass
+    # fraction EMA rises above this, the filter is judged non-selective
+    # and switched off for that signature (probe overhead with no
+    # pruning payoff). Signatures with no history run optimistically.
+    "rtfilter.gate_pass_frac": (0.8, float),
+    # EMA blend weight for newly observed pass fractions (same role as
+    # server.estimate_alpha for admission estimates).
+    "rtfilter.alpha": (0.4, float),
+    # Where the selectivity EMAs persist ("" = beside the learned
+    # admission estimates, i.e. learned_selectivity.json in the dispatch
+    # persistent cache dir; in-memory only when neither exists). Shares
+    # the flock+merge write discipline with the estimate file.
+    "rtfilter.path": ("", str),
+    # Debounce for selectivity-state writes, seconds.
+    "rtfilter.save_interval_s": (5.0, float),
 }
 
 _overrides: dict[str, Any] = {}

@@ -549,8 +549,47 @@ def test_rule_placement_recorded_shipping_code_complies():
         assert not _by_rule(_lint_file(path), "placement-must-record"), mod
 
 
+def test_rule_rtfilter_decision_recorded_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_rtfilter_decision.py"),
+                   "rtfilter-decision-must-record")
+    texts = [f.source_line for f in got]
+    assert len(got) == 4, texts
+    assert any("build_rows > max_rows" in t for t in texts)
+    assert any("ema <= threshold" in t for t in texts)
+    assert any("optimal_params(expected" in t for t in texts)
+    assert any("rows < 8" in t for t in texts)
+    # recorded / counted / raising / pragma'd / arithmetic-only /
+    # unrelated-name twins past the clean_ marker all stay clean
+    src = (FIXTURES / "seeded_rtfilter_decision.py").read_text()
+    clean_at = src[:src.index("def clean_decide_recorded")].count(
+        "\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_rtfilter_decision_recorded_scope(tmp_path):
+    # the same silent gates outside an rtfilter-named file are out of
+    # scope — even inside runtime/ (fusion.py's injection pass delegates
+    # its choices to rtfilter.decide, which is where the rule holds)
+    src = (FIXTURES / "seeded_rtfilter_decision.py").read_text()
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    plain = rt / "fusion_like.py"
+    plain.write_text(src)
+    assert not _by_rule(_lint_file(plain), "rtfilter-decision-must-record")
+    filtery = rt / "rtfilter_like.py"
+    filtery.write_text(src)
+    assert _by_rule(_lint_file(filtery), "rtfilter-decision-must-record")
+
+
+def test_rule_rtfilter_decision_recorded_shipping_code_complies():
+    # the real planner must hold its own rule: every gate/sizing site in
+    # runtime/rtfilter.py records its decision with a reason
+    path = REPO / "spark_rapids_jni_tpu" / "runtime" / "rtfilter.py"
+    assert not _by_rule(_lint_file(path), "rtfilter-decision-must-record")
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all twenty per-file rules
+    """The acceptance invariant: all twenty-one per-file rules
     demonstrably fire (the three whole-program rules have their own
     coverage test below)."""
     seen = set()
@@ -591,6 +630,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_pallas_kernel.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_cluster_placement.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_rtfilter_decision.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

@@ -1,0 +1,59 @@
+"""Seeded violations for rule 24 (rtfilter-decision-must-record).
+
+The basename contains ``rtfilter`` so the file is in scope the same way
+runtime/rtfilter.py is. Violations first, then clean twins past the
+``def clean_`` marker the per-rule test splits on.
+"""
+
+
+def decide_filter_silent(build_rows, max_rows):
+    if build_rows > max_rows:  # VIOLATION: silent on/off gate
+        return False
+    return True
+
+
+def gate_on_selectivity_silent(ema, threshold):
+    return ema <= threshold  # VIOLATION: unrecorded learned gate
+
+
+def size_filter_silent(expected, fpp, optimal_params):
+    return optimal_params(expected, fpp)  # VIOLATION: unrecorded sizing
+
+
+def choose_geometry_silent(rows):
+    small = rows < 8  # VIOLATION: threshold compare, invisible
+    return 64 if small else rows * 10
+
+
+def clean_decide_recorded(build_rows, max_rows, record_rtfilter):
+    apply = build_rows <= max_rows  # clean: decision event with reason
+    record_rtfilter("rtfilter.decide", "apply" if apply else "skip",
+                    reason="build_size", build_rows=build_rows)
+    return apply
+
+
+def clean_gate_counted(ema, threshold, registry):
+    ok = ema <= threshold  # clean: counter at the decision site
+    registry.counter("rtfilter.decision.skip").inc()
+    return ok
+
+
+def clean_decide_raising(build_rows):
+    if build_rows < 0:  # clean: raises instead of gating silently
+        raise ValueError("negative build-side estimate")
+    return True
+
+
+def clean_size_reviewed_pragma(expected, optimal_params):
+    # clean: reviewed-legitimate silent sizing; the pragma documents it
+    return optimal_params(expected, 0.03)  # tpulint: disable=rtfilter-decision-must-record
+
+
+def clean_size_arithmetic_only(expected):
+    # clean: pure arithmetic — no threshold compare, no sizing-seam
+    # call to flag; the caller's decision event carries the visibility
+    return int(expected) * 10
+
+
+def clean_unrelated_name(a, b):
+    return a < b  # clean: no decision token in the name

@@ -1530,6 +1530,82 @@ def check_placement_recorded(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 24: rtfilter-decision-must-record
+# ---------------------------------------------------------------------------
+
+
+def _is_rtfilter_scope_file(ctx: FileContext) -> bool:
+    """Runtime-filter planner homes: rtfilter-named files only (the
+    deliberately narrow scope — fusion.py's injection pass delegates
+    every on/off/sizing choice to ``rtfilter.decide``, which is where
+    this rule holds)."""
+    return "rtfilter" in ctx.name
+
+
+_RTFILTER_DECISION_TOKENS = ("decide", "gate", "size", "choose", "should")
+
+
+def _rtfilter_decision_sites(fn) -> List[ast.AST]:
+    """The choices that must be visible: a threshold comparison (the
+    on/off gate) or a call into the sizing seam (``optimal_params``)."""
+    out: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare):
+            out.append(node)
+        elif (isinstance(node, ast.Call)
+                and _unparse(node.func).split(".")[-1] == "optimal_params"):
+            out.append(node)
+    return out
+
+
+def _fn_records_rtfilter(fn) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and _unparse(node.func).endswith("record_rtfilter")):
+            return True
+    return False
+
+
+def check_rtfilter_decision_recorded(ctx: FileContext) -> List[RawFinding]:
+    """ISSUE-18 bug class (rule 24): an invisible runtime-filter
+    decision. The bloom pushdown is adaptive — a learned selectivity EMA
+    gates it on/off and sizes the filter — so when a query slows down
+    (filter applied to a non-selective join) or fails to speed up
+    (filter gated off on stale history), the ONLY way to reconstruct
+    what the planner chose and why is the decision record. A
+    decision-named function in an rtfilter file (decide/gate/size/
+    choose/should) that actually makes a choice — a threshold
+    comparison or a sizing call (``optimal_params``) — but emits
+    nothing (no ``record_rtfilter``/``record_*`` event, no counter
+    ``.inc()``, no raise) turns every gating bug into an unexplained
+    plan change. Every decision carries a mandatory reason
+    (``telemetry.record_rtfilter`` enforces non-empty). Functions with
+    no comparison or sizing call are exempt (pure arithmetic is not a
+    decision)."""
+    if not _is_rtfilter_scope_file(ctx):
+        return []
+    out: List[RawFinding] = []
+    for fn in _top_functions(ctx.tree):
+        lname = fn.name.lower()
+        if not any(tok in lname for tok in _RTFILTER_DECISION_TOKENS):
+            continue
+        sites = _rtfilter_decision_sites(fn)
+        if (not sites or _fn_records_rtfilter(fn)
+                or _fn_classifies_or_accounts(fn)):
+            continue
+        for node in sites:
+            out.append(RawFinding(
+                node.lineno, node.col_offset,
+                f"`{_unparse(node)[:60]}` decides a runtime-filter "
+                f"on/off/sizing in `{fn.name}` but nothing records the "
+                f"decision: emit record_rtfilter(...) with a reason (or "
+                f"a counter .inc() / raise) at the decision site — an "
+                f"unrecorded gating choice makes adaptive plan changes "
+                f"unexplainable from telemetry"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -1622,4 +1698,10 @@ RULES = [
          "record the routing decision: record_* event, counter "
          ".inc(), or raise",
          check_placement_recorded),
+    Rule("rtfilter-decision-must-record",
+         "a decision-named function in an rtfilter file that gates or "
+         "sizes a runtime filter (threshold compare / optimal_params) "
+         "must record the decision with a reason: record_rtfilter, "
+         "counter .inc(), or raise",
+         check_rtfilter_decision_recorded),
 ]
