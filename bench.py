@@ -1494,6 +1494,197 @@ def _cluster_block() -> dict:
     return block
 
 
+def _exchange_block() -> dict:
+    """The BENCH_*.json ``exchange`` block: the general-cardinality
+    distributed exchange (runtime/exchange.py). Four questions: what
+    does the device repartition path cost (closed-loop exchange_local
+    rows/s — hash, destination-sorted pack, per-destination trim — at 8
+    destinations), what does the sealed wire form buy (raw device bytes
+    over TPCZ wire bytes for every destination of one exchange shipped
+    through a sealed socketpair, plus flight rows/s), what does a
+    corrupted flight cost (injected ``exchange.wire`` flip -> NAK ->
+    ARQ refetch, the extra wall over a clean roundtrip to the
+    bit-identical table), and what does skew cost (a 90%-hot key under
+    a capped schedule riding the full ladder: capacity escalations ->
+    chunked-flight demotion -> SpillStore merge demotions, with the
+    zero-leak reservation check)."""
+    block: dict = {}
+    try:
+        import socket as _socket
+        import threading as _threading
+
+        import numpy as np
+
+        from spark_rapids_jni_tpu.columnar import Column, Table
+        from spark_rapids_jni_tpu.models import tpch
+        from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+        from spark_rapids_jni_tpu.ops.table_ops import (
+            concatenate as _concat, trim_table as _trim)
+        from spark_rapids_jni_tpu.runtime import exchange as _xch
+        from spark_rapids_jni_tpu.runtime import faults as _faults
+        from spark_rapids_jni_tpu.runtime import resultcache as _rc
+        from spark_rapids_jni_tpu.runtime.memory import (
+            MemoryLimiter, SpillStore, _table_nbytes)
+        from spark_rapids_jni_tpu.utils.config import (
+            reset_option, set_option)
+
+        rows, parts = 1 << 14, 8
+        orders = tpch.orders_table(rows, 512, seed=9)
+        keys = [tpch.O_CUSTKEY]
+
+        # device half: closed-loop repartition (pack ladder + trim)
+        _xch.exchange_local(orders, keys, parts)  # compile off the clock
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            dests = _xch.exchange_local(orders, keys, parts)
+        wall = time.perf_counter() - t0
+        if wall:
+            block["repartition_rows_per_s"] = round(iters * rows / wall)
+
+        # wire half: ship every destination over a sealed socketpair;
+        # counter deltas give the codec win (raw device bytes per wire
+        # byte) on real exchange traffic
+        def _ship(tables, script=None, seq0=0):
+            a, b = _socket.socketpair()
+            a.settimeout(60)
+            b.settimeout(60)
+            got, err = [], []
+
+            def _rx():
+                try:
+                    for i in range(len(tables)):
+                        got.append(_xch.recv_flight(b, seq0 + i))
+                except BaseException as exc:  # noqa: BLE001
+                    err.append(exc)
+
+            th = _threading.Thread(target=_rx, daemon=True)
+            ctx = _faults.inject(script) if script is not None else None
+            try:
+                if ctx is not None:
+                    ctx.__enter__()
+                th.start()
+                t0 = time.perf_counter()
+                for i, d in enumerate(tables):
+                    _xch.send_flight(a, d, seq0 + i, dest=i)
+                th.join(60)
+                wall = time.perf_counter() - t0
+            finally:
+                if ctx is not None:
+                    ctx.__exit__(None, None, None)
+                a.close()
+                b.close()
+            return got, err, wall
+
+        live = [d for d in dests if d.num_rows]
+        before = _xch.stats()
+        shipped, err, ship_wall = _ship(live)
+        after = _xch.stats()
+        raw = after["bytes_raw"] - before["bytes_raw"]
+        wire = after["bytes_wire"] - before["bytes_wire"]
+        if not err and len(shipped) == len(live):
+            block["flights"] = after["flights"] - before["flights"]
+            block["wire_bytes"] = wire
+            if wire:
+                block["raw_over_wire_bytes"] = round(raw / wire, 2)
+            if ship_wall:
+                block["flight_rows_per_s"] = round(
+                    sum(d.num_rows for d in live) / ship_wall)
+            block["flight_identity"] = (
+                "bit-identical"
+                if all(_rc.table_fingerprint(g) == _rc.table_fingerprint(d)
+                       for g, d in zip(shipped, live))
+                else "MISMATCH")
+
+        # corrupted flight: one injected exchange.wire flip -> the
+        # receiver NAKs and the refetch recovers bit-identical; the
+        # extra wall over a clean roundtrip is the recovery cost
+        probe = live[0]
+        _, _, clean_wall = _ship([probe], seq0=101)
+        script = _faults.FaultScript(corruptions=[
+            _faults.CorruptionSpec("exchange.wire", mode="flip", seed=23)])
+        got, err, dirty_wall = _ship([probe], script=script, seq0=202)
+        if not err and got and script.fired:
+            block["corruption_recovery_ms"] = round(
+                max(0.0, dirty_wall - clean_wall) * 1e3, 2)
+            block["corruption_identity"] = (
+                "bit-identical" if _rc.table_fingerprint(got[0])
+                == _rc.table_fingerprint(probe) else "MISMATCH")
+
+        # overflow half: a 90%-hot key under a capped schedule must ride
+        # escalation -> chunked flights -> SpillStore merge demotion and
+        # release every reservation
+        rng = np.random.default_rng(7)
+        skew_n = 2000
+        hot = rng.integers(1, 16, skew_n).astype(np.int64)
+        hot[rng.random(skew_n) < 0.9] = 0
+        skewed = Table([
+            Column.from_numpy(hot),
+            Column.from_numpy(np.ones(skew_n, dtype=np.int64)),
+        ])
+        set_option("exchange.max_capacity_rows", 256)
+        try:
+            before = _xch.stats()
+            flights = _xch.pack_flights(skewed, [0], 4)
+            per_dest = [[] for _ in range(4)]
+            for res in flights:
+                for p, s in enumerate(_xch.flight_slices(res)):
+                    if s.num_rows:
+                        per_dest[p].append(s)
+            hot_flights = max(per_dest, key=lambda fl: sum(
+                s.num_rows for s in fl))
+
+            def merge_step(chunk):
+                g = groupby_aggregate(chunk, [0], [(1, "sum")],
+                                      max_groups=None)
+                return _trim(g.table, int(np.asarray(g.num_groups)))
+
+            budget = sum(_table_nbytes(f) for f in hot_flights) * 4
+            limiter = MemoryLimiter(budget)
+            # a store holding ONE checkpointed partial: every further
+            # put LRU-demotes its predecessor to host
+            spill = SpillStore(max(_table_nbytes(merge_step(f))
+                                   for f in hot_flights) + 1)
+            t0 = time.perf_counter()
+            res = _xch.merge_flights(hot_flights, merge_step, merge_step,
+                                     budget_bytes=budget, limiter=limiter,
+                                     spill=spill)
+            merge_wall = time.perf_counter() - t0
+            after = _xch.stats()
+            want = merge_step(_concat(hot_flights))
+            block["skew"] = {
+                "rows": skew_n,
+                "hot_frac": 0.9,
+                "capacity_cap": 256,
+                "overflow_escalations": (after["overflow_escalations"]
+                                         - before["overflow_escalations"]),
+                "chunked_flights": len(flights),
+                "spill_demotions": (after["spill_demotions"]
+                                    - before["spill_demotions"]),
+                "hot_dest_merge_ms": round(merge_wall * 1e3, 1),
+                "merge_identity": (
+                    "bit-identical" if _rc.table_fingerprint(res.table)
+                    == _rc.table_fingerprint(want) else "MISMATCH"),
+                "leaked_bytes": int(limiter.used),
+            }
+        finally:
+            reset_option("exchange.max_capacity_rows")
+        block["note"] = (
+            "repartition_rows_per_s: closed-loop exchange_local (hash + "
+            "destination-sorted pack + per-destination trim) at 8 "
+            "destinations. raw_over_wire_bytes: device bytes per sealed "
+            "TPCZ wire byte for one exchange's flights over a "
+            "socketpair. corruption_recovery_ms: extra wall of an "
+            "injected exchange.wire flip (NAK + ARQ refetch) over a "
+            "clean flight to the bit-identical table. skew: 90%-hot key "
+            "under a 256-row capacity cap riding escalate -> chunked "
+            "flights -> SpillStore merge demotion; leaked_bytes must "
+            "be 0")
+    except Exception:  # probe failure must never cost the bench record
+        pass
+    return block
+
+
 def _rtfilter_block() -> dict:
     """The BENCH_*.json ``rtfilter`` block: runtime bloom-join filters
     (runtime/rtfilter.py + fusion's BloomProbe pushdown). A q72-style
@@ -2673,6 +2864,7 @@ def _child_main(config: str, n: int, iters: int) -> None:
                       "compress": _compress_block(),
                       "fleet": _fleet_block(),
                       "cluster": _cluster_block(),
+                      "exchange": _exchange_block(),
                       "rtfilter": _rtfilter_block(),
                       "kernels": _kernels_block()}))
 
@@ -2717,12 +2909,14 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
     dispatch block | None, pipeline block | None, fusion block | None,
     server block | None, cache block | None, degrade block | None,
     integrity block | None, compress block | None, fleet block | None,
-    cluster block | None, kernels block | None) — the blocks come from
-    the measured child process's executable cache, overlap probe,
-    whole-stage fusion probe, serving-concurrency probe, result-cache
-    probe, memory-pressure degradation probe, the integrity /
-    columnar-codec seam probes, the replicated-serving fleet probe, the
-    cross-host serving-mesh probe, and the Pallas kernel-tier probe."""
+    cluster block | None, exchange block | None, rtfilter block | None,
+    kernels block | None) — the blocks come from the measured child
+    process's executable cache, overlap probe, whole-stage fusion probe,
+    serving-concurrency probe, result-cache probe, memory-pressure
+    degradation probe, the integrity / columnar-codec seam probes, the
+    replicated-serving fleet probe, the cross-host serving-mesh probe,
+    the distributed-exchange probe, the runtime bloom-filter probe, and
+    the Pallas kernel-tier probe."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CONFIG"] = config
@@ -2741,7 +2935,7 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
     except subprocess.TimeoutExpired:
         return (None, f"{platform} bench timed out after {timeout_s:.0f}s",
                 None, None, None, None, None, None, None, None, None, None,
-                None)
+                None, None, None)
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             rec = json.loads(line)
@@ -2758,6 +2952,8 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
         comp = rec.get("compress") if isinstance(rec, dict) else None
         flt = rec.get("fleet") if isinstance(rec, dict) else None
         clus = rec.get("cluster") if isinstance(rec, dict) else None
+        exch = rec.get("exchange") if isinstance(rec, dict) else None
+        rtf = rec.get("rtfilter") if isinstance(rec, dict) else None
         kern = rec.get("kernels") if isinstance(rec, dict) else None
         return (value, "", disp if isinstance(disp, dict) else None,
                 pipe if isinstance(pipe, dict) else None,
@@ -2769,10 +2965,12 @@ def _run_child(config: str, n: int, iters: int, platform: str, timeout_s: float)
                 comp if isinstance(comp, dict) else None,
                 flt if isinstance(flt, dict) else None,
                 clus if isinstance(clus, dict) else None,
+                exch if isinstance(exch, dict) else None,
+                rtf if isinstance(rtf, dict) else None,
                 kern if isinstance(kern, dict) else None)
     return (None, f"{platform} bench failed: {_tail(out)}",
             None, None, None, None, None, None, None, None, None, None,
-            None)
+            None, None, None)
 
 
 def main() -> None:
@@ -2799,6 +2997,8 @@ def main() -> None:
     child_comp = None
     child_fleet = None
     child_clus = None
+    child_exch = None
+    child_rtf = None
     child_kern = None
     # every run gets a telemetry file (children record through the package
     # via these env vars; the parent appends bench_stale events itself) —
@@ -2840,7 +3040,8 @@ def main() -> None:
                 (value, why, child_disp, child_pipe, child_fus,
                  child_srv, child_cache, child_deg,
                  child_integ, child_comp, child_fleet,
-                 child_clus, child_kern) = _run_child(
+                 child_clus, child_exch, child_rtf,
+                 child_kern) = _run_child(
                     config, n, iters, "tpu", child_timeout)
                 platform = "tpu"
                 if value is not None:
@@ -2888,7 +3089,8 @@ def main() -> None:
                 (_pv, _pwhy, child_disp, child_pipe, child_fus,
                  child_srv, child_cache, child_deg,
                  child_integ, child_comp, child_fleet,
-                 child_clus, child_kern) = _run_child(
+                 child_clus, child_exch, child_rtf,
+                 child_kern) = _run_child(
                     config, n, iters, "cpu", child_timeout)
                 if _pv is None and _pwhy:
                     diagnostics.append(f"probe child: {_pwhy}")
@@ -2896,7 +3098,8 @@ def main() -> None:
             (value, why, child_disp, child_pipe, child_fus,
              child_srv, child_cache, child_deg,
              child_integ, child_comp, child_fleet,
-             child_clus, child_kern) = _run_child(
+             child_clus, child_exch, child_rtf,
+             child_kern) = _run_child(
                 config, n, iters, "cpu", child_timeout)
             if value is None:
                 diagnostics.append(why)
@@ -2976,6 +3179,15 @@ def main() -> None:
     # latency with re-home identity + leak check), same child-process
     # provenance; empty when no live child ran
     record["cluster"] = child_clus or {}
+    # distributed-exchange probe (local repartition rows/s, raw-over-
+    # wire byte ratio for sealed flights, injected-corruption refetch
+    # latency, skew ladder counters with the zero-leak check), same
+    # child-process provenance; empty when no live child ran
+    record["exchange"] = child_exch or {}
+    # runtime bloom-filter probe (rows-scanned reduction on a selective
+    # chain, build overhead, learned non-selective gating), same
+    # child-process provenance; empty when no live child ran
+    record["rtfilter"] = child_rtf or {}
     # Pallas kernel-tier probe (per-kernel xla vs pallas steady state,
     # byte-identity between tiers, the full kernels.* decision/fallback
     # counter ledger), same child-process provenance; empty when no

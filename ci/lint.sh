@@ -1,8 +1,8 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its twenty-four invariant rules —
-# twenty-one per-file AST rules (host/device
+# tpulint (tools/tpulint) runs its twenty-five invariant rules —
+# twenty-two per-file AST rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
@@ -10,7 +10,8 @@
 # reservation-release-in-finally, span-must-scope, payload-must-verify,
 # cache-key-must-fingerprint, compress-inside-seal,
 # worker-exit-must-classify, pallas-kernel-must-have-oracle,
-# placement-must-record, rtfilter-decision-must-record)
+# placement-must-record, rtfilter-decision-must-record,
+# exchange-overflow-must-classify)
 # plus three whole-program concurrency rules built on the
 # tools/tpulint/flows.py interprocedural engine (lock-order-cycle,
 # blocking-call-under-lock, unguarded-shared-write) —
@@ -880,11 +881,100 @@ print("rtfilter smoke OK: pruned run bit-identical, "
       "decision recorded, zero leaked reservations")
 EOF3
 
+# exchange smoke: rule 25 only proves overflow BRANCHES classify — this
+# proves the repartition itself honors its contract: every row lands on
+# exactly the destination its key hashes to (nothing dropped, nothing
+# duplicated), the Exchange plan root's wire form inverts through
+# split_wire with every routed row accounted, and a skew-forced
+# chunked-flight demotion still merges bit-identical under the spill
+# ladder with zero leaked reservations.
+JAX_PLATFORMS=cpu python - <<'EOF4'
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.hash import partition_hash
+from spark_rapids_jni_tpu.ops.table_ops import concatenate, trim_table
+from spark_rapids_jni_tpu.runtime import exchange as xch
+from spark_rapids_jni_tpu.runtime import fusion
+from spark_rapids_jni_tpu.runtime.memory import (MemoryLimiter,
+                                                 _table_nbytes)
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+
+def rowset(tbl):
+    return sorted(zip(*(np.asarray(c.data).tolist() for c in tbl.columns)))
+
+
+rng = np.random.default_rng(3)
+n, parts = 4096, 4
+tbl = Table([
+    Column.from_numpy(rng.integers(0, 97, n).astype(np.int64)),
+    Column.from_numpy(rng.integers(0, 1000, n).astype(np.int64)),
+])
+
+# partition identity: hash ownership + permutation
+dests = xch.exchange_local(tbl, [0], parts)
+assert sum(d.num_rows for d in dests) == n, "rows dropped or duplicated"
+for p, d in enumerate(dests):
+    assert (np.asarray(partition_hash(d, [0], parts)) == p).all(), \
+        f"destination {p} holds foreign rows"
+assert rowset(concatenate(dests)) == rowset(tbl), "not a permutation"
+
+# plan-root wire form: build_wire meta inverts through split_wire and
+# the transport counter accounts every routed row
+plan = fusion.Plan("lint_exchange", fusion.Exchange(
+    fusion.Scan("rows"), keys=(0,), parts=parts, label="ex"))
+fused = fusion.execute(plan, {"rows": tbl})
+rc = fused.meta["ex.row_counts"]
+assert len(rc) % parts == 0 and sum(rc) == n, rc
+regrouped = xch.split_wire(fused.table, rc, parts)
+for p, (fls, d) in enumerate(zip(regrouped, dests)):
+    assert rowset(concatenate(fls)) == rowset(d), f"split_wire dest {p}"
+assert REGISTRY.counter("exchange.rows_routed").value == n
+
+# skew ladder: one hot key under a tiny capacity cap demotes to chunked
+# flights; the receive-side merge is bit-identical and leak-free
+key = rng.integers(1, 8, 512).astype(np.int64)
+key[rng.random(512) < 0.9] = 0
+skewed = Table([Column.from_numpy(key),
+                Column.from_numpy(np.ones(512, dtype=np.int64))])
+set_option("exchange.max_capacity_rows", 64)
+try:
+    flights = xch.pack_flights(skewed, [0], parts)
+    assert len(flights) > 1, "skew did not demote to chunked flights"
+    per_dest = [[] for _ in range(parts)]
+    for res in flights:
+        for p, s in enumerate(xch.flight_slices(res)):
+            if s.num_rows:
+                per_dest[p].append(s)
+    hot = max(per_dest, key=lambda fl: sum(s.num_rows for s in fl))
+
+    def merge_step(chunk):
+        g = groupby_aggregate(chunk, [0], [(1, "sum")], max_groups=None)
+        return trim_table(g.table, int(np.asarray(g.num_groups)))
+
+    budget = sum(_table_nbytes(f) for f in hot) * 4
+    limiter = MemoryLimiter(budget)
+    out = xch.merge_flights(hot, merge_step, merge_step,
+                            budget_bytes=budget, limiter=limiter)
+    assert rowset(out.table) == rowset(merge_step(concatenate(hot))), \
+        "chunked merge changed the answer"
+    assert limiter.used == 0, "leaked reservations"
+finally:
+    reset_option("exchange.max_capacity_rows")
+print("exchange smoke OK: hash ownership exact, wire form inverts, "
+      "chunked skew merge bit-identical, zero leaked reservations")
+EOF4
+
 # fixture gate: rules 20-22 are whole-program (tools/tpulint/flows.py
 # builds the call graph + lock registry; concurrency.py judges it),
 # rule 23 (placement-must-record) guards the mesh's routing visibility,
-# and rule 24 (rtfilter-decision-must-record) guards the runtime-filter
-# planner's decision visibility.
+# rule 24 (rtfilter-decision-must-record) guards the runtime-filter
+# planner's decision visibility, and rule 25
+# (exchange-overflow-must-classify) guards the exchange/shuffle overflow
+# ladder against bare-boolean drop/cap paths.
 # The package sweep above already fails on any new finding; this block
 # proves the ENGINE has not regressed silently — each seeded fixture
 # must still FIRE its rule (checked structurally via --format json, not
@@ -895,7 +985,8 @@ for fixture_rule in \
     "seeded_blocking_under_lock.py blocking-call-under-lock" \
     "seeded_unguarded_write.py unguarded-shared-write" \
     "seeded_cluster_placement.py placement-must-record" \
-    "seeded_rtfilter_decision.py rtfilter-decision-must-record"; do
+    "seeded_rtfilter_decision.py rtfilter-decision-must-record" \
+    "seeded_exchange_overflow.py exchange-overflow-must-classify"; do
   set -- $fixture_rule
   out=$(python -m tools.tpulint --format json --no-baseline \
         "tests/tpulint_fixtures/$1" || true)
@@ -909,7 +1000,7 @@ want, fixture = os.environ["RULE"], os.environ["FIXTURE"]
 assert want in rules, f"{fixture} no longer fires {want}: {rules}"
 EOF
 done
-echo "seeded fixtures OK: rules 20-24 fire"
+echo "seeded fixtures OK: rules 20-25 fire"
 
 graph=$(python -m tools.tpulint --lock-graph spark_rapids_jni_tpu)
 grep -q "acyclic" <<<"$graph"

@@ -2558,6 +2558,102 @@ def tpch_q17_numpy(part: Table, lineitem: Table,
     return total
 
 
+# ---- TPC-H q13-shaped customer-key aggregation: the general-cardinality ----
+# distributed groupby over the exchange
+#
+#   SELECT o_custkey, count(o_orderkey) FROM orders GROUP BY o_custkey
+#
+# The inner aggregation of q13 (customer distribution): order counts per
+# customer key. Customer keys are HIGH cardinality — no slot table, no
+# domain plan, no psum merge can cover them — which is exactly the query
+# shape the bounded-slot distributed plans could not run. The distributed
+# form is partial-counts per shard -> hash-partitioned all-to-all exchange
+# by custkey (runtime/exchange.py) -> per-destination sum-merge; the merge
+# algebra is re-applicable (sum of counts), so the exchange's spill-aware
+# chunked merge composes with it unchanged.
+
+
+def q13_partial_plan() -> fusion.Plan:
+    """Per-shard q13 partial: order counts per customer key, general
+    cardinality (``max_groups=None`` pads to the shard's row count and
+    can never overflow — no static slot table)."""
+    return fusion.Plan("tpch_q13_partial", fusion.GroupBy(
+        fusion.Scan("orders"), (O_CUSTKEY,), ((O_ORDERKEY, "count"),),
+        max_groups=None, label="partial"))
+
+
+def q13_merge_plan() -> fusion.Plan:
+    """Per-destination q13 merge: sum the partial counts per customer
+    key — re-applicable (``merge(merge(a) + merge(b)) == merge(a + b)``),
+    the property the exchange's chunked spill merge relies on."""
+    return fusion.Plan("tpch_q13_merge", fusion.GroupBy(
+        fusion.Scan("partials"), (0,), ((1, "sum"),),
+        max_groups=None, label="merge"))
+
+
+def q13_exchange_plans(parts: int):
+    """The (pack_plan, merge_plan) pair for the distributed q13-shaped
+    aggregation: the pack plan roots an ``Exchange`` node over the
+    partial (keys = the custkey output column, ``valid_meta`` trims the
+    unbounded groupby's padding before any row rides the wire); the
+    merge plan scans ``partials``. Drive through
+    ``QueryCluster.submit_exchange`` — or locally via
+    :func:`tpch_q13_local`, which is the bit-identity oracle."""
+    pack = fusion.Plan("tpch_q13_pack", fusion.Exchange(
+        q13_partial_plan().root, keys=(0,), parts=int(parts),
+        valid_meta="partial.num_groups", label="exchange"))
+    return pack, q13_merge_plan()
+
+
+def tpch_q13_local(orders: Table, parts: int = 1, *,
+                   shard_keys=(O_ORDERKEY,)) -> Table:
+    """Single-host oracle for the distributed q13-shaped aggregation:
+    the SAME plans over the SAME shard split (``shard_keys`` must match
+    the cluster's ``register_table`` keys) and the same
+    source-then-flight regroup order — bit-identical to what
+    ``submit_exchange(...).result()`` returns over a live mesh."""
+    from spark_rapids_jni_tpu.ops.table_ops import _slice_rows, concatenate
+    from spark_rapids_jni_tpu.parallel import dcn
+    from spark_rapids_jni_tpu.runtime import exchange as xch
+
+    parts = int(parts)
+    pack, merge = q13_exchange_plans(parts)
+    shards = (dcn.partition_for_slices(orders, list(shard_keys), parts)
+              if parts > 1 else [orders])
+    per_dest: list = [[] for _ in range(parts)]
+    empty = None
+    for shard in shards:
+        fused = fusion.execute(pack, {"orders": shard})
+        rc = fused.meta["exchange.row_counts"]
+        empty = _slice_rows(fused.table, 0, 0)
+        for p, fls in enumerate(xch.split_wire(fused.table, rc, parts)):
+            per_dest[p].extend(fls)
+    outs = []
+    for flights in per_dest:
+        if not flights:
+            continue
+        dest_in = (flights[0] if len(flights) == 1
+                   else concatenate(flights))
+        res = fusion.execute(merge, {"partials": dest_in})
+        outs.append(_slice_rows(
+            res.table, 0, int(np.asarray(res.meta["merge.num_groups"]))))
+    if not outs:
+        res = fusion.execute(merge, {"partials": empty})
+        return _slice_rows(res.table, 0, 0)
+    return outs[0] if len(outs) == 1 else concatenate(outs)
+
+
+def tpch_q13_reference(orders: Table) -> Table:
+    """Naive single-pass reference (one global groupby): the value-level
+    check behind the oracle — same groups and counts as
+    :func:`tpch_q13_local` up to row order."""
+    from spark_rapids_jni_tpu.ops.table_ops import trim_table
+
+    g = groupby_aggregate(orders, [O_CUSTKEY], [(O_ORDERKEY, "count")],
+                          max_groups=None)
+    return trim_table(g.table, int(np.asarray(g.num_groups)))
+
+
 # ---------------------------------------------------------------------------
 # AOT warmup registration (runtime/server.QueryServer.warmup)
 # ---------------------------------------------------------------------------

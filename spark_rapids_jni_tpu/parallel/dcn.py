@@ -366,6 +366,119 @@ class SliceServer:
         return False
 
 
+_ACK = b"\x06"
+_NAK = b"\x15"
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer slice closed the DCN link")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_framed(sock, blob: bytes, seq: int, *,
+                op: str = "dcn.send_table",
+                corrupt_seam: str = "integrity.wire",
+                **ctx) -> int:
+    """Ship one length-prefixed payload under the shared seal-ordering
+    discipline — THE frame-encode helper for every per-destination send
+    loop (SliceLink table frames and the exchange's per-destination
+    flight buffers alike; there is exactly one copy of this ordering).
+
+    Integrity off: bare 8-byte length prefix, no trailer, no ack.
+    Integrity on: seal -> injected-corruption window (``corrupt_seam``,
+    the link-corruption shape the trailer exists to catch) -> send ->
+    await ACK; each NAK re-seals the PRISTINE blob and resends, bounded
+    by ``resilience.max_attempts``; exhaustion dies classified with a
+    flight record. ``ctx`` flows into the corruption seam's context."""
+    from spark_rapids_jni_tpu.runtime import faults, integrity, resilience
+
+    if not integrity.enabled():
+        sock.sendall(struct.pack("<Q", len(blob)) + blob)
+        return len(blob)
+    attempts = max(1, resilience.policy().max_attempts)
+    for attempt in range(1, attempts + 1):
+        framed = integrity.seal(blob)
+        # the corruption window sits BETWEEN seal and send — each resend
+        # re-seals the pristine blob, so a refetch recovers
+        framed = faults.fire_corrupt(corrupt_seam, seq, framed,
+                                     attempt=attempt, **ctx)
+        sock.sendall(struct.pack("<Q", len(framed)) + framed)
+        if _recv_exact(sock, 1) == _ACK:
+            return len(framed)
+    from spark_rapids_jni_tpu.telemetry import spans
+
+    flight = spans.dump_flight_record(
+        "wire_corruption", state={"attempts": attempts, "frame": seq})
+    raise resilience.FatalExecutionError(
+        f"{op}: peer rejected frame {seq} as corrupt after "
+        f"{attempts} resends",
+        seam="dcn.transport", attempts=attempts,
+        **({"flight_record": flight} if flight else {}))
+
+
+def recv_framed(sock, seq: int, *, op: str = "dcn.recv_table") -> bytes:
+    """Receive one framed payload under the shared verify-then-decode
+    discipline: length prefix, then (with integrity on) trailer
+    verification with NAK-driven refetch from the sender's pristine
+    copy — the receive half of :func:`send_framed`'s ARQ. Returns the
+    verified payload bytes; the caller decodes (``deserialize_table``
+    or the exchange's flight decode) AFTER verification, never before."""
+    from spark_rapids_jni_tpu import telemetry
+    from spark_rapids_jni_tpu.runtime import integrity, resilience
+
+    verified = integrity.enabled()
+    attempts = max(1, resilience.policy().max_attempts)
+    attempt = 1
+    while True:
+        hdr = _recv_exact(sock, 8)
+        (length,) = struct.unpack("<Q", hdr)
+        framed = _recv_exact(sock, length)
+        if not verified:
+            return framed
+        try:
+            blob = integrity.verify(
+                framed, seam="integrity.wire", op=op,
+                frame=seq, attempt=attempt)
+        except resilience.CorruptDataError as exc:
+            # refetch: the sender still holds the pristine payload, so
+            # NAK asks for a fresh frame. NAK even on the final
+            # attempt — the sender's loop shares the attempt budget,
+            # so both sides die classified instead of deadlocking on
+            # a half-acknowledged frame.
+            telemetry.REGISTRY.counter("integrity.refetch").inc()
+            telemetry.record_integrity(
+                op, "refetch", seam="integrity.wire",
+                nbytes=length, attempt=attempt, frame=seq)
+            sock.sendall(_NAK)
+            if attempt >= attempts:
+                from spark_rapids_jni_tpu.telemetry import spans
+
+                flight = spans.dump_flight_record(
+                    "wire_corruption",
+                    state={"attempts": attempts, "frame": seq})
+                raise resilience.FatalExecutionError(
+                    f"{op}: frame {seq} corrupt "
+                    f"after {attempts} refetches: {exc}",
+                    seam="dcn.transport", attempts=attempts,
+                    **({"flight_record": flight} if flight else {}),
+                ) from exc
+            attempt += 1
+            continue
+        sock.sendall(_ACK)
+        if attempt > 1:
+            telemetry.record_integrity(
+                op, "recovered", seam="integrity.wire",
+                nbytes=length, attempt=attempt, frame=seq)
+        return blob
+
+
 class SliceLink:
     """One reliable byte stream to a peer slice (TCP prototype; the
     format is transport-agnostic — see the module design note). Frames
@@ -380,10 +493,12 @@ class SliceLink:
     ack adds half a round trip, not a pipeline stall). Both sides bound
     refetches by ``resilience.max_attempts``; exhaustion dies classified
     with a flight record. Disabled, the byte stream is exactly the
-    legacy framing: no trailer, no acknowledgements."""
+    legacy framing: no trailer, no acknowledgements. The seal-ordering
+    itself lives in the module-level :func:`send_framed` /
+    :func:`recv_framed` pair this class delegates to."""
 
-    _ACK = b"\x06"
-    _NAK = b"\x15"
+    _ACK = _ACK
+    _NAK = _NAK
 
     def __init__(self, sock):
         self._sock = sock
@@ -421,32 +536,10 @@ class SliceLink:
             blob = _frame()
         from spark_rapids_jni_tpu.runtime import integrity
 
-        if not integrity.enabled():
-            self._sock.sendall(struct.pack("<Q", len(blob)) + blob)
-            return len(blob)
-        attempts = max(1, resilience.policy().max_attempts)
-        self._send_seq += 1
-        for attempt in range(1, attempts + 1):
-            framed = integrity.seal(blob)
-            # the corruption window sits BETWEEN seal and send — the
-            # link-corruption shape the trailer exists to catch; each
-            # resend re-seals the pristine blob, so a refetch recovers
-            framed = faults.fire_corrupt(
-                "integrity.wire", self._send_seq, framed,
-                rows=table.num_rows, attempt=attempt)
-            self._sock.sendall(struct.pack("<Q", len(framed)) + framed)
-            if self._recv_exact(1) == self._ACK:
-                return len(framed)
-        from spark_rapids_jni_tpu.telemetry import spans
-
-        flight = spans.dump_flight_record(
-            "wire_corruption", state={"attempts": attempts,
-                                      "frame": self._send_seq})
-        raise resilience.FatalExecutionError(
-            f"dcn.send_table: peer rejected frame {self._send_seq} as "
-            f"corrupt after {attempts} resends",
-            seam="dcn.transport", attempts=attempts,
-            **({"flight_record": flight} if flight else {}))
+        if integrity.enabled():
+            self._send_seq += 1
+        return send_framed(self._sock, blob, self._send_seq,
+                           op="dcn.send_table", rows=table.num_rows)
 
     def recv_table(self) -> Table:
         from spark_rapids_jni_tpu.runtime import faults, resilience
@@ -461,67 +554,15 @@ class SliceLink:
                                 seam="dcn.transport")
         else:
             _entry()
-        from spark_rapids_jni_tpu import telemetry
         from spark_rapids_jni_tpu.runtime import integrity
 
-        verified = integrity.enabled()
-        attempts = max(1, resilience.policy().max_attempts)
-        if verified:
+        if integrity.enabled():
             self._recv_seq += 1
-        attempt = 1
-        while True:
-            hdr = self._recv_exact(8)
-            (length,) = struct.unpack("<Q", hdr)
-            framed = self._recv_exact(length)
-            if not verified:
-                return deserialize_table(framed)
-            try:
-                blob = integrity.verify(
-                    framed, seam="integrity.wire", op="dcn.recv_table",
-                    frame=self._recv_seq, attempt=attempt)
-            except resilience.CorruptDataError as exc:
-                # refetch: the sender still holds the pristine table, so
-                # NAK asks for a fresh frame. NAK even on the final
-                # attempt — the sender's loop shares the attempt budget,
-                # so both sides die classified instead of deadlocking on
-                # a half-acknowledged frame.
-                telemetry.REGISTRY.counter("integrity.refetch").inc()
-                telemetry.record_integrity(
-                    "dcn.recv_table", "refetch", seam="integrity.wire",
-                    nbytes=length, attempt=attempt, frame=self._recv_seq)
-                self._sock.sendall(self._NAK)
-                if attempt >= attempts:
-                    from spark_rapids_jni_tpu.telemetry import spans
-
-                    flight = spans.dump_flight_record(
-                        "wire_corruption",
-                        state={"attempts": attempts,
-                               "frame": self._recv_seq})
-                    raise resilience.FatalExecutionError(
-                        f"dcn.recv_table: frame {self._recv_seq} corrupt "
-                        f"after {attempts} refetches: {exc}",
-                        seam="dcn.transport", attempts=attempts,
-                        **({"flight_record": flight} if flight else {}),
-                    ) from exc
-                attempt += 1
-                continue
-            self._sock.sendall(self._ACK)
-            if attempt > 1:
-                telemetry.record_integrity(
-                    "dcn.recv_table", "recovered", seam="integrity.wire",
-                    nbytes=length, attempt=attempt, frame=self._recv_seq)
-            return deserialize_table(blob)
+        return deserialize_table(
+            recv_framed(self._sock, self._recv_seq, op="dcn.recv_table"))
 
     def _recv_exact(self, n: int) -> bytes:
-        chunks = []
-        got = 0
-        while got < n:
-            chunk = self._sock.recv(min(n - got, 1 << 20))
-            if not chunk:
-                raise ConnectionError("peer slice closed the DCN link")
-            chunks.append(chunk)
-            got += len(chunk)
-        return b"".join(chunks)
+        return _recv_exact(self._sock, n)
 
     def close(self) -> None:
         self._sock.close()

@@ -299,39 +299,89 @@ def shuffle_by_partition(
     )
 
 
+def classify_overflow(*, op: str = "hash_shuffle",
+                      capacity: int | None = None,
+                      rows: int | None = None,
+                      partition: int | None = None,
+                      required: int | None = None,
+                      seam: str = "shuffle.transport",
+                      **context):
+    """Build the classified taxonomy error for a tripped shuffle/exchange
+    capacity-overflow flag: a :class:`~.resilience.CapacityOverflow`
+    carrying partition/capacity context, so the host boundary that syncs
+    the device flag raises something ``resilience.escalate`` (and every
+    classified handler above it) can act on — never a bare boolean."""
+    from spark_rapids_jni_tpu.runtime import resilience
+
+    where = "" if partition is None else f" (hot partition {partition})"
+    need = "" if required is None else f"; {required} slots required"
+    return resilience.CapacityOverflow(
+        f"{op}: partition capacity overflow{where}: a destination "
+        f"received more rows than its "
+        f"{capacity if capacity is not None else 'derived'} send-buffer "
+        f"slots{need}",
+        seam=seam,
+        **{k: v for k, v in dict(
+            capacity=capacity, rows=rows, partition=partition,
+            required=required, **context).items() if v is not None})
+
+
 def report_shuffle_telemetry(result: ShuffleResult | None = None,
                              op: str = "hash_shuffle",
                              rows: int | None = None, *,
                              overflowed=None,
-                             narrowing_overflow=None) -> None:
-    """Host-side fallback accounting for a CONCRETE shuffle result.
+                             narrowing_overflow=None,
+                             capacity: int | None = None,
+                             partition: int | None = None,
+                             raise_on_overflow: bool = False) -> None:
+    """Host-side overflow accounting for a CONCRETE shuffle result.
 
     The shuffle itself runs inside shard_map/jit where telemetry calls are
     forbidden (they would be host transfers in a traced region — the tpulint
     no-host-transfer rule); callers that have the materialized result invoke
     this at the jit boundary — either a full ``ShuffleResult`` or just the
     two flag arrays for callers whose jitted step returns flags alone (the
-    shuffle_wire bench). Records a fallback event per tripped flag
-    (capacity overflow / wire narrowing overflow) and a dispatch otherwise.
-    Telemetry-off is a no-op before any flag is synced to host."""
-    from spark_rapids_jni_tpu import telemetry
+    shuffle_wire bench).
 
-    if not telemetry.enabled():
-        return
+    A tripped capacity flag is classified through the resilience taxonomy
+    (:func:`classify_overflow` -> ``CapacityOverflow`` with
+    partition/capacity context): recorded as a fallback event stamped with
+    the classified kind, and RAISED when ``raise_on_overflow`` so callers
+    without their own escalation ladder fail classified instead of
+    carrying a bare boolean upward. A tripped narrowing flag classifies
+    ``MalformedInputError`` (the planner declared a too-narrow wire type —
+    a contract breach, not a capacity problem). Telemetry-off only mutes
+    the event records; classification still raises when asked."""
+    from spark_rapids_jni_tpu import telemetry
+    from spark_rapids_jni_tpu.runtime import resilience
+
     if result is not None:
         overflowed = result.overflowed
         narrowing_overflow = result.narrowing_overflow
     ovf = overflowed is not None and bool(np.asarray(overflowed).any())
     nvf = (narrowing_overflow is not None
            and bool(np.asarray(narrowing_overflow).any()))
-    if ovf:
-        telemetry.record_fallback(
-            op, "partition capacity overflow: a device dropped rows "
-            "(re-plan with larger capacity)", rows=rows)
-    if nvf:
-        telemetry.record_fallback(
-            op, "wire narrowing overflow: a narrowed value did not survive "
-            "the round trip (planner declared too-narrow wire type)",
-            rows=rows)
-    if not (ovf or nvf):
-        telemetry.record_dispatch(op, rows=rows)
+    if telemetry.enabled():
+        if ovf:
+            telemetry.record_fallback(
+                op, "partition capacity overflow: a device dropped rows "
+                "(re-plan with larger capacity)", rows=rows,
+                error_kind="CapacityOverflow",
+                **({} if capacity is None else {"capacity": capacity}))
+        if nvf:
+            telemetry.record_fallback(
+                op, "wire narrowing overflow: a narrowed value did not "
+                "survive the round trip (planner declared too-narrow wire "
+                "type)", rows=rows, error_kind="MalformedInputError")
+        if not (ovf or nvf):
+            telemetry.record_dispatch(op, rows=rows)
+    if raise_on_overflow:
+        if ovf:
+            raise classify_overflow(op=op, capacity=capacity, rows=rows,
+                                    partition=partition)
+        if nvf:
+            raise resilience.MalformedInputError(
+                f"{op}: wire narrowing overflow: a narrowed value did not "
+                "survive the round trip (planner declared a too-narrow "
+                "wire type)", seam="shuffle.transport",
+                **({} if rows is None else {"rows": rows}))

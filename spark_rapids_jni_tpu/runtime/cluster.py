@@ -71,7 +71,8 @@ from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
 from spark_rapids_jni_tpu.utils.config import get_option
 from spark_rapids_jni_tpu.utils.log import get_logger
 
-__all__ = ["QueryCluster", "MergeTicket", "live_clusters", "main"]
+__all__ = ["QueryCluster", "MergeTicket", "ExchangeTicket",
+           "live_clusters", "main"]
 
 _log = get_logger("cluster")
 
@@ -166,6 +167,122 @@ class MergeTicket:
                 raise
             self._resolved, self._value = True, value
             return value
+
+
+class ExchangeTicket:
+    """Future for one general-cardinality distributed exchange query.
+
+    Phase 1 (already in flight when this ticket exists): the pack plan —
+    an ``Exchange``-rooted plan — fanned out to every shard's host; each
+    worker runs its partial locally and returns the WIRE FORM (one
+    concatenated table of per-destination slices plus plain
+    ``row_counts`` meta). Phase 2 (:meth:`result`): the router splits
+    each source's wire table, regroups the slices by destination, and
+    per destination either ships the reassembled rows to the
+    destination's owning host to run the merge plan there (the normal
+    all-to-all path), or — when a skewed destination's flights exceed
+    the merge budget — runs the spill-aware chunked merge on the router
+    (``exchange.merge_flights``: partials demote into the SpillStore,
+    zero leaked reservations). Destination key spaces are disjoint by
+    construction, so the part-ordered concatenation of destination
+    results is the global answer; its fingerprint is memo-checked like
+    :class:`MergeTicket`'s, so a repeated exchange — including one whose
+    packs failed over — must come back bit-identical.
+
+    The merge plan must be RE-APPLICABLE (``merge(merge(a) + merge(b))
+    == merge(a + b)`` — sum/count-style merge algebra): the spill path
+    applies it per chunk and once more over the concatenated partials.
+    """
+
+    def __init__(self, cluster: "QueryCluster", session_id: str,
+                 table: str, pack_plan: fusion.Plan,
+                 merge_plan: fusion.Plan, merge_binding: str,
+                 merge_valid_meta: Optional[str],
+                 tickets: List[FleetTicket],
+                 deadline_ms: Optional[int],
+                 merge_budget_bytes: Optional[int]):
+        self.table = table
+        self.pack_plan = pack_plan
+        self.merge_plan = merge_plan
+        self.merge_binding = merge_binding
+        self.merge_valid_meta = merge_valid_meta
+        self.label = str(pack_plan.root.label)
+        self.parts = int(pack_plan.root.parts)
+        self.tickets = tickets
+        self.session_id = session_id
+        self.deadline_ms = deadline_ms
+        self.merge_budget_bytes = merge_budget_bytes
+        self.fingerprint: Optional[str] = None
+        self._cluster = cluster
+        self._lock = threading.Lock()
+        self._claimed = False
+        self._done = threading.Event()
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._done.is_set() or all(t.done() for t in self.tickets)
+
+    def _trim(self, fused: fusion.FusedResult):
+        """Slice a merge result back to its true rows (the merge plan's
+        unbounded groupby pads to its input row count)."""
+        if self.merge_valid_meta is None:
+            return fused.table
+        from spark_rapids_jni_tpu.ops.table_ops import _slice_rows
+
+        return _slice_rows(
+            fused.table, 0,
+            int(np.asarray(fused.meta[self.merge_valid_meta])))
+
+    def _run_merge_local(self, tbl):
+        """Router-side merge step (the spill path's partial AND merge
+        fn — re-applicable algebra makes them the same plan)."""
+        return self._trim(fusion.execute(
+            self.merge_plan, {self.merge_binding: tbl}))
+
+    def result(self, timeout: Optional[float] = None):
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        # one caller claims the resolution; the phase-1 waits, worker
+        # merges and spill ladder all run OUTSIDE the ticket lock (they
+        # block on sockets/queues), so concurrent callers park on the
+        # event, never on a held lock
+        with self._lock:
+            claimed = not self._claimed and not self._done.is_set()
+            if claimed:
+                self._claimed = True
+        if not claimed:
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            if not self._done.wait(left):
+                raise TimeoutError(
+                    f"exchange {self.pack_plan.name!r} (session "
+                    f"{self.session_id}) not done within {timeout}s")
+            if self._exc is not None:
+                raise self._exc
+            return self._value
+        try:
+            partials = []
+            for t in self.tickets:
+                left = (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+                partials.append(t.result(left))
+            value = self._cluster._exchange_merge(self, partials, deadline)
+        except TimeoutError:
+            # a timeout leaves the ticket unresolved (retryable wait);
+            # re-driving is idempotent through the fleet memos
+            with self._lock:
+                self._claimed = False
+            raise
+        except BaseException as exc:
+            # any other failure — a failed partial, a merge mismatch —
+            # is permanent and resolves the ticket failed
+            self._exc = exc
+            self._done.set()
+            raise
+        self._value = value
+        self._done.set()
+        return value
 
 
 class QueryCluster(QueryFleet):
@@ -551,6 +668,162 @@ class QueryCluster(QueryFleet):
         REGISTRY.counter("cluster.merges").inc()
         record_fleet("cluster.merge", "merged", replica="supervisor",
                      table=mt.table, parts=len(partials), fingerprint=fp)
+        return merged
+
+    def submit_exchange(self, session_id: str, pack_plan: fusion.Plan,
+                        merge_plan: fusion.Plan, *, table: str,
+                        binding: str, merge_binding: str,
+                        merge_valid_meta: Optional[str] = None,
+                        bindings: Optional[dict] = None,
+                        deadline_ms: Optional[int] = None,
+                        merge_budget_bytes: Optional[int] = None
+                        ) -> ExchangeTicket:
+        """General-cardinality distributed groupby/join fan-out: the
+        hash-partitioned all-to-all (``runtime/exchange.py``) over the
+        mesh, with NO static slot table anywhere.
+
+        ``pack_plan`` must be rooted at an ``Exchange`` node whose
+        ``parts`` equals the registered table's partition count: each
+        shard's host runs the child (the partial plan) locally, then
+        repartitions its output by the exchange keys into per-destination
+        wire buffers (TPCZ codec + integrity seal on every hop, like all
+        fleet frames). ``merge_plan`` scans ``merge_binding`` and runs on
+        each destination's owning host over the rows that hashed there;
+        ``merge_valid_meta`` names its true-row-count meta key (an
+        unbounded groupby's ``<label>.num_groups``). The returned
+        ticket's :meth:`~ExchangeTicket.result` finishes the all-to-all
+        and returns the part-ordered concatenation of destination
+        results — bit-identical to the single-host oracle (the same
+        plans run over ``exchange.exchange_local``)."""
+        with self._lock:
+            ss = self._tables.get(str(table))
+        if ss is None:
+            raise KeyError(f"cluster: table {table!r} is not registered")
+        root = pack_plan.root
+        if not isinstance(root, fusion.Exchange):
+            raise TypeError(
+                "submit_exchange needs a pack plan rooted at an Exchange "
+                f"node, got {type(root).__name__}")
+        if int(root.parts) != ss.parts:
+            raise ValueError(
+                f"cluster: exchange routes to {int(root.parts)} "
+                f"destinations but table {ss.name!r} has {ss.parts} "
+                f"partitions — they must match (one destination per "
+                f"shard owner)")
+        REGISTRY.counter("cluster.fanouts").inc()
+        REGISTRY.counter("cluster.exchanges").inc()
+        record_fleet("cluster.exchange", "fanout", replica="supervisor",
+                     table=ss.name, parts=ss.parts, plan=pack_plan.name)
+        tickets = [
+            self.submit_to_shard(session_id, pack_plan, table=table,
+                                 binding=binding, part=p,
+                                 bindings=bindings,
+                                 deadline_ms=deadline_ms)
+            for p in range(ss.parts)]
+        return ExchangeTicket(self, str(session_id), ss.name, pack_plan,
+                              merge_plan, str(merge_binding),
+                              merge_valid_meta, tickets, deadline_ms,
+                              merge_budget_bytes)
+
+    def _exchange_merge(self, xt: ExchangeTicket, partials: List[Any],
+                        deadline: Optional[float]):
+        """Phase 2 of the all-to-all: split every source's wire table,
+        regroup by destination, merge each destination (on its owning
+        host, or router-side through the spill ladder when its flights
+        exceed the budget), and concatenate in part order."""
+        from spark_rapids_jni_tpu.ops.table_ops import (
+            _slice_rows, concatenate)
+        from spark_rapids_jni_tpu.runtime import exchange as xch
+        from spark_rapids_jni_tpu.runtime.memory import _table_nbytes
+        from spark_rapids_jni_tpu.utils.config import get_option as _opt
+
+        label, parts = xt.label, xt.parts
+        per_dest: List[List[Any]] = [[] for _ in range(parts)]
+        for fused in partials:
+            rc = fused.meta.get(f"{label}.row_counts")
+            if rc is None:
+                raise resilience.MalformedInputError(
+                    f"cluster: exchange partial for {xt.pack_plan.name} "
+                    f"carries no {label}.row_counts meta — not an "
+                    "Exchange-rooted plan result", table=xt.table,
+                    seam="exchange.wire")
+            for p, fls in enumerate(xch.split_wire(fused.table, rc, parts)):
+                per_dest[p].extend(fls)
+        budget = int(xt.merge_budget_bytes
+                     if xt.merge_budget_bytes is not None
+                     else _opt("exchange.merge_budget_bytes"))
+        with spans.span("cluster.exchange_merge", table=xt.table,
+                        parts=parts, plan=xt.merge_plan.name):
+            # dispatch every host-merged destination first (they run
+            # concurrently on their owners), then run any router-side
+            # spill merges while the workers compute
+            pending: List[Optional[FleetTicket]] = [None] * parts
+            spill_parts: List[int] = []
+            for p, flights in enumerate(per_dest):
+                if not flights:
+                    continue
+                if (len(flights) > 1
+                        and sum(_table_nbytes(f) for f in flights) > budget):
+                    spill_parts.append(p)
+                    continue
+                dest_in = (flights[0] if len(flights) == 1
+                           else concatenate(flights))
+                pending[p] = self._submit(
+                    xt.session_id, xt.merge_plan,
+                    {xt.merge_binding: dest_in},
+                    shard=(xt.table, p), deadline_ms=xt.deadline_ms)
+            spilled: Dict[int, Any] = {}
+            for p in spill_parts:
+                # a skewed destination: too many flight bytes to reship
+                # inline — the spill-aware chunked merge absorbs them
+                # through the SpillStore on the router, zero leaks
+                REGISTRY.counter("cluster.exchange_spill_merges").inc()
+                record_fleet("cluster.exchange", "spill_merge",
+                             replica="supervisor", table=xt.table,
+                             part=p, flights=len(per_dest[p]))
+                res = xch.merge_flights(
+                    per_dest[p], xt._run_merge_local, xt._run_merge_local,
+                    budget_bytes=budget,
+                    op=f"exchange.{label}.merge")
+                spilled[p] = res.table
+            dest_results: List[Any] = []
+            for p in range(parts):
+                if p in spilled:
+                    dest_results.append(spilled[p])
+                elif pending[p] is not None:
+                    left = (None if deadline is None
+                            else max(0.0, deadline - time.monotonic()))
+                    dest_results.append(xt._trim(pending[p].result(left)))
+            if dest_results:
+                merged = (dest_results[0] if len(dest_results) == 1
+                          else concatenate(dest_results))
+            else:
+                merged = xt._run_merge_local(
+                    _slice_rows(partials[0].table, 0, 0))
+        fps = tuple(t.fingerprint or "" for t in xt.tickets)
+        mkey = ("exchange", xt.pack_plan.name, xt.merge_plan.name,
+                xt.table, fps)
+        fp = resultcache.table_fingerprint(merged)
+        with self._lock:
+            prev = self._merge_memo.get(mkey)
+            if prev is None:
+                self._merge_memo[mkey] = fp
+                while len(self._merge_memo) > 512:
+                    self._merge_memo.popitem(last=False)
+        if prev is not None and prev != fp:
+            REGISTRY.counter("fleet.identity_mismatch").inc()
+            record_fleet("cluster.exchange", "identity_mismatch",
+                         replica="supervisor", table=xt.table,
+                         plan=xt.merge_plan.name)
+            raise resilience.CorruptDataError(
+                f"cluster: exchange result for {xt.pack_plan.name} -> "
+                f"{xt.merge_plan.name} over {xt.table} differs from the "
+                "memoized fingerprint for the same partial set — "
+                "exchange determinism violated", table=xt.table)
+        xt.fingerprint = fp
+        REGISTRY.counter("cluster.exchange_merges").inc()
+        record_fleet("cluster.exchange", "merged", replica="supervisor",
+                     table=xt.table, parts=parts, fingerprint=fp)
         return merged
 
     # -- supervision overrides ----------------------------------------------

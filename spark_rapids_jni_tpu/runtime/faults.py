@@ -77,6 +77,13 @@ SEAMS: Tuple[str, ...] = (
     "integrity.wire",
     "integrity.checkpoint",
     "integrity.ingest",
+    # general-cardinality exchange (runtime/exchange.py): exchange.pack is the
+    # device-side escalating pack attempt (a raise here drills the overflow
+    # ladder), exchange.wire corrupts a sealed flight frame in transit the
+    # same way integrity.wire corrupts a sealed table frame — detection at
+    # recv_framed classifies it and the ARQ loop refetches the flight.
+    "exchange.pack",
+    "exchange.wire",
     # result/subplan cache payloads (runtime/resultcache.py): cache entries
     # ride the SpillStore tiers, so this seam corrupts a cached payload the
     # same way integrity.spill corrupts a live query's spilled working set.

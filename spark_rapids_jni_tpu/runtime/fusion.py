@@ -96,6 +96,7 @@ __all__ = [
     "BloomProbe",
     "Sort",
     "Limit",
+    "Exchange",
     "Plan",
     "FusedResult",
     "rows_of",
@@ -294,6 +295,32 @@ class Limit(NamedTuple):
     count: int
 
 
+class Exchange(NamedTuple):
+    """General-cardinality hash repartition of the child's output — the
+    distributed-exchange boundary (runtime/exchange.py). Only valid as a
+    plan ROOT: a shuffle is a genuine host boundary (see the region
+    discipline note), so the child region fuses and executes normally
+    and the exchange pack runs as its own dispatch op on the result.
+
+    ``keys`` are column indices hashed with the Spark-compatible
+    ``partition_hash``; ``parts`` is the destination count (cluster
+    hosts). ``capacity`` is the per-destination slot count (an int, a
+    ``rows_of`` spec, or None for the escalation ladder's derived
+    start). ``valid_meta`` optionally names a child meta key holding the
+    TRUE row count of the child's padded output (e.g. a partial
+    groupby's ``partial.num_groups``) so budget-padding phantom rows
+    never ride the wire. Meta: ``<label>.parts`` / ``<label>.capacity``
+    / ``<label>.flights`` / ``<label>.row_counts`` / ``<label>.rows``
+    (plain Python — they survive the fleet's result frames)."""
+
+    child: Any
+    keys: tuple
+    parts: int
+    capacity: Any = None
+    valid_meta: Optional[str] = None
+    label: str = "exchange"
+
+
 class Plan(NamedTuple):
     """A named fusible region: one root node, one fused executable. The
     name becomes the dispatch op (``fusion.<name>``), so executables per
@@ -304,7 +331,7 @@ class Plan(NamedTuple):
 
 
 _NODE_TYPES = (Scan, Filter, Project, GroupBy, Join, DensePkJoin,
-               BloomBuild, BloomProbe, Sort, Limit)
+               BloomBuild, BloomProbe, Sort, Limit, Exchange)
 
 
 class FusedResult(NamedTuple):
@@ -322,7 +349,8 @@ class FusedResult(NamedTuple):
 def _children(node) -> tuple:
     if isinstance(node, Scan):
         return ()
-    if isinstance(node, (Filter, Project, GroupBy, Sort, Limit, BloomBuild)):
+    if isinstance(node, (Filter, Project, GroupBy, Sort, Limit, BloomBuild,
+                         Exchange)):
         return (node.child,)
     if isinstance(node, Join):
         return (node.left, node.right)
@@ -420,6 +448,9 @@ def _fingerprint(nodes, resolved: dict) -> tuple:
                      else tuple(node.nulls_first))
         elif isinstance(node, Limit):
             entry = ("limit", resolved[id(node)])
+        elif isinstance(node, Exchange):
+            entry = ("exchange", node.keys, node.parts,
+                     resolved[id(node)], node.valid_meta)
         else:  # pragma: no cover - _children already rejects
             raise TypeError(type(node).__name__)
         out.append(entry + (kids,))
@@ -438,6 +469,8 @@ def _resolve_statics(nodes, true_rows: dict) -> dict:
             resolved[id(node)] = _resolve(node.key_hi, true_rows)
         elif isinstance(node, Limit):
             resolved[id(node)] = int(node.count)
+        elif isinstance(node, Exchange):
+            resolved[id(node)] = _resolve(node.capacity, true_rows)
     return resolved
 
 
@@ -472,7 +505,7 @@ def _spaces(nodes) -> dict:
             spaces[id(node)] = spaces[id(node.child)]
         elif isinstance(node, Sort):
             spaces[id(node)] = spaces[id(node.child)]
-        elif isinstance(node, (Join, Limit)):
+        elif isinstance(node, (Join, Limit, Exchange)):
             spaces[id(node)] = None
     return spaces
 
@@ -658,6 +691,11 @@ def _eval_plan(root, tables: dict, rvs: dict, resolved: dict,
         elif isinstance(node, Limit):
             tbl, rv = ev(node.child)
             out = (_head(tbl, resolved[id(node)]), None)
+        elif isinstance(node, Exchange):
+            raise TypeError(
+                "Exchange is a host boundary: it is only valid as a plan "
+                "root (execute() routes it to runtime.exchange), never "
+                "inside a fused/staged region")
         else:
             raise TypeError(f"not a plan node: {type(node).__name__}")
         env[id(node)] = out
@@ -853,6 +891,17 @@ def execute(plan: Plan, bindings: dict, *,
     """
     if cancel_token is not None:
         cancel_token.check(f"fusion.{plan.name}")
+    if isinstance(plan.root, Exchange):
+        # host boundary: partition-hash pack + wire framing happen outside
+        # any fused region — runtime.exchange runs the child plan, then
+        # packs per-destination flights on the host side of the seam
+        from spark_rapids_jni_tpu.runtime import exchange as _exchange
+        return _exchange.execute_exchange_root(
+            plan, bindings,
+            donate_inputs=donate_inputs,
+            force_staged=force_staged,
+            surface_pressure=surface_pressure,
+            cancel_token=cancel_token)
     if get_option("rtfilter.enabled"):
         plan = inject_runtime_filters(plan, bindings)
     nodes = _topo(plan.root)
@@ -1039,7 +1088,7 @@ def replace_node(root, target, replacement):
         if all(nk is k for nk, k in zip(new_kids, kids)):
             out = node
         elif isinstance(node, (Filter, Project, GroupBy, Sort, Limit,
-                               BloomBuild)):
+                               BloomBuild, Exchange)):
             out = node._replace(child=new_kids[0])
         elif isinstance(node, Join):
             out = node._replace(left=new_kids[0], right=new_kids[1])
@@ -1091,6 +1140,11 @@ def estimate_hbm_bytes(plan: Plan, bindings: dict) -> int:
         elif isinstance(node, BloomBuild):
             # byte-per-bit filter plus the (n, k) position scratch
             extra_bytes += int(node.num_bits)
+        elif isinstance(node, Exchange):
+            # destination-sorted pack materializes parts * capacity rows
+            cap = resolved.get(id(node))
+            if cap is not None:
+                out_rows += int(node.parts) * int(cap)
     return int(input_bytes + out_rows * row_width + extra_bytes)
 
 

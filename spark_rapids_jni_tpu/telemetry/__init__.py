@@ -34,6 +34,7 @@ from spark_rapids_jni_tpu.telemetry.events import (
     record_compile_cache,
     record_degrade,
     record_dispatch,
+    record_exchange,
     record_fallback,
     record_fleet,
     record_integrity,
@@ -70,6 +71,7 @@ __all__ = [
     "record_compile_cache",
     "record_degrade",
     "record_dispatch",
+    "record_exchange",
     "record_fallback",
     "record_fleet",
     "record_integrity",

@@ -551,6 +551,39 @@ def record_fleet(
     return True
 
 
+def record_exchange(
+    op: str,
+    event: str,
+    *,
+    rows: Optional[int] = None,
+    **extra: Any,
+) -> bool:
+    """A distributed-exchange lifecycle event (runtime/exchange.py).
+
+    ``event`` is one of ``pack`` / ``flight`` / ``overflow_escalate`` /
+    ``chunked_flights`` / ``spill_demote`` / ``merge`` / ``recovered``.
+    ``rows`` is the row count the event is about (routed rows for
+    ``pack``, flight rows for ``flight``, ...). Byte/flight context
+    rides in ``extra`` (``wire_bytes`` / ``raw_bytes`` / ``flights`` /
+    ``capacity`` / ``partition``). Like record_fleet, no counter side
+    effects: runtime/exchange.py owns the ``exchange.*`` counters and
+    counts unconditionally (transport accounting must hold even with
+    telemetry off).
+    """
+    if not event or not str(event).strip():
+        raise ValueError(f"record_exchange({op!r}): event must be non-empty")
+    if "kind" in extra or "op" in extra:
+        raise ValueError(
+            f"record_exchange({op!r}): 'kind'/'op' are reserved record "
+            "fields; pass caller context under other names")
+    if not enabled():
+        return False
+    rec = _base("exchange", op, rows, None, extra)
+    rec["event"] = str(event)
+    _emit(rec)
+    return True
+
+
 def record_bench_stale(
     metric: str,
     *,

@@ -282,6 +282,15 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # How long one shard registration (ship + decode + fingerprint ack)
     # may take before it fails classified.
     "cluster.register_timeout_s": (60.0, float),
+    # Distributed exchange (runtime/exchange.py): hard ceiling on the
+    # per-destination send-buffer capacity the escalation ladder may
+    # grow to before the pack demotes to multi-flight chunking (the
+    # spill-aware rung). Quantized through the dispatch bucket schedule.
+    "exchange.max_capacity_rows": (1 << 16, int),
+    # Device-byte budget for the receive-side chunked merge of exchange
+    # flights (MemoryLimiter budget handed to run_chunked_aggregate);
+    # partial results beyond it LRU-spill to compressed host memory.
+    "exchange.merge_budget_bytes": (64 << 20, int),
     # Runtime bloom-join filters (runtime/rtfilter.py): master switch for
     # the planner pass that builds a bloom filter from a selective join's
     # build side and prunes the probe side before it stages. Off by

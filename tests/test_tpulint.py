@@ -588,8 +588,48 @@ def test_rule_rtfilter_decision_recorded_shipping_code_complies():
     assert not _by_rule(_lint_file(path), "rtfilter-decision-must-record")
 
 
+def test_rule_exchange_overflow_classified_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_exchange_overflow.py"),
+                   "exchange-overflow-must-classify")
+    texts = [f.source_line for f in got]
+    assert len(got) == 3, texts
+    assert any("if overflowed:" in t for t in texts)
+    assert any("while overflowed" in t for t in texts)
+    assert any("if overflow_flag" in t for t in texts)
+    # classified / escalating / pragma'd / device-passthrough /
+    # unrelated-branch twins past the clean_ marker all stay clean
+    src = (FIXTURES / "seeded_exchange_overflow.py").read_text()
+    clean_at = src[:src.index("def clean_pack_classified")].count(
+        "\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_exchange_overflow_classified_scope(tmp_path):
+    # the same bare-boolean branches outside an exchange/shuffle-named
+    # file are out of scope — even inside runtime/ (a generic capacity
+    # check is not an exchange overflow); shuffle-named files are in
+    src = (FIXTURES / "seeded_exchange_overflow.py").read_text()
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    plain = rt / "outofcore_like.py"
+    plain.write_text(src)
+    assert not _by_rule(_lint_file(plain), "exchange-overflow-must-classify")
+    shuffley = rt / "shuffle_like.py"
+    shuffley.write_text(src)
+    assert _by_rule(_lint_file(shuffley), "exchange-overflow-must-classify")
+
+
+def test_rule_exchange_overflow_classified_shipping_code_complies():
+    # the real exchange paths must hold their own rule: every overflow
+    # branch in runtime/exchange.py and parallel/shuffle.py classifies
+    for rel in (("runtime", "exchange.py"), ("parallel", "shuffle.py")):
+        path = REPO / "spark_rapids_jni_tpu" / rel[0] / rel[1]
+        assert not _by_rule(_lint_file(path),
+                            "exchange-overflow-must-classify"), rel
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all twenty-one per-file rules
+    """The acceptance invariant: all twenty-two per-file rules
     demonstrably fire (the three whole-program rules have their own
     coverage test below)."""
     seen = set()
@@ -632,6 +672,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_cluster_placement.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_rtfilter_decision.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_exchange_overflow.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

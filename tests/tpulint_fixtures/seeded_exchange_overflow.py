@@ -1,0 +1,56 @@
+"""Seeded violations for rule 25 (exchange-overflow-must-classify).
+
+The basename contains ``exchange`` so the file is in scope the same way
+runtime/exchange.py and parallel/shuffle.py are. Violations first, then
+clean twins past the ``def clean_`` marker the per-rule test splits on.
+"""
+
+
+def pack_rows_silent(table, overflowed):
+    if overflowed:  # VIOLATION: silent row drop on overflow
+        return None
+    return table
+
+
+def retry_once_silent(pack, capacity, overflowed):
+    while overflowed and capacity < 1024:  # VIOLATION: bare one-shot retry
+        capacity *= 2
+        overflowed = pack(capacity)
+    return capacity
+
+
+def choose_capacity_silent(overflow_flag, big, small):
+    return big if overflow_flag else small  # VIOLATION: silent cap choice
+
+
+def clean_pack_classified(table, overflowed, classify_overflow):
+    if overflowed:  # clean: classified CapacityOverflow escapes
+        raise classify_overflow(op="exchange.pack", capacity=8, rows=64)
+    return table
+
+
+def clean_pack_escalates(pack, overflowed, escalate):
+    if overflowed:  # clean: the resilience ladder owns the retry
+        return escalate("exchange.pack", pack, seam="exchange.pack",
+                        initial=64)
+    return pack(64)
+
+
+def clean_pack_reviewed_pragma(table, overflowed):
+    # clean: reviewed-legitimate consumer; the pragma documents it
+    if overflowed:  # tpulint: disable=exchange-overflow-must-classify
+        return None
+    return table
+
+
+def clean_device_flag_passthrough(counts, capacity, jnp):
+    # clean: device code COMPUTES and returns the flag — the host
+    # consumer at the jit boundary owns the classification
+    overflowed = jnp.any(counts > capacity)
+    return counts, overflowed
+
+
+def clean_unrelated_branch(truncated, table):
+    if truncated:  # clean: no overflow value in the test
+        return None
+    return table

@@ -1606,6 +1606,78 @@ def check_rtfilter_decision_recorded(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 25: exchange-overflow-must-classify
+# ---------------------------------------------------------------------------
+
+
+def _is_exchange_scope_file(ctx: FileContext) -> bool:
+    """Exchange homes: the hash-partitioned repartition paths
+    (runtime/exchange.py, parallel/shuffle.py) where a capacity overflow
+    is a recoverable, classifiable event — never a silent drop."""
+    return "exchange" in ctx.name or "shuffle" in ctx.name
+
+
+def _overflow_branch_sites(fn) -> List[ast.AST]:
+    """Host-side sites that CONSUME an overflow flag: ``if``/``while``
+    tests and conditional expressions naming an overflow value. A device
+    function merely RETURNING the flag to its jit boundary is exempt —
+    that is how the flag reaches the host in the first place."""
+    out: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            if "overflow" in _unparse(node.test).lower():
+                out.append(node.test)
+    return out
+
+
+def _fn_classifies_overflow(fn) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Raise):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        last = _unparse(node.func).split(".")[-1]
+        if "classify" in last or last == "escalate":
+            return True
+    return False
+
+
+def check_exchange_overflow_classified(ctx: FileContext) -> List[RawFinding]:
+    """ISSUE-19 bug class (rule 25): a bare-boolean overflow path in an
+    exchange/shuffle file. The distributed exchange's whole overflow
+    contract is the spill-aware ladder — an overflowing pack escalates
+    through ``resilience.escalate``, demotes to chunked flights, and
+    anything that escapes is a classified ``CapacityOverflow``
+    (``shuffle.classify_overflow`` with partition/capacity context). A
+    function that branches on an overflow flag but neither classifies
+    (``classify*`` call), escalates (``resilience.escalate``), nor
+    raises has reinvented the pre-ladder one-shot retry: rows get
+    silently dropped or capacities silently capped, and the failure
+    surfaces three layers up as wrong answers instead of a
+    CapacityOverflow naming the hot partition. Device functions that
+    only COMPUTE and return the flag are exempt (the host consumer owns
+    the classification). Scope: exchange-/shuffle-named files."""
+    if not _is_exchange_scope_file(ctx):
+        return []
+    out: List[RawFinding] = []
+    for fn in _top_functions(ctx.tree):
+        sites = _overflow_branch_sites(fn)
+        if not sites or _fn_classifies_overflow(fn):
+            continue
+        for node in sites:
+            out.append(RawFinding(
+                node.lineno, node.col_offset,
+                f"`{_unparse(node)[:60]}` branches on an overflow flag "
+                f"in `{fn.name}` but nothing classifies it: route the "
+                f"overflow through shuffle.classify_overflow / "
+                f"resilience.escalate (-> CapacityOverflow with "
+                f"partition/capacity context) or raise — a bare-boolean "
+                f"overflow path silently drops rows and surfaces as "
+                f"wrong answers instead of a classified error"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -1704,4 +1776,10 @@ RULES = [
          "must record the decision with a reason: record_rtfilter, "
          "counter .inc(), or raise",
          check_rtfilter_decision_recorded),
+    Rule("exchange-overflow-must-classify",
+         "a function in an exchange/shuffle file that branches on an "
+         "overflow flag must classify it (classify_overflow / "
+         "resilience.escalate -> CapacityOverflow) or raise — never a "
+         "bare-boolean drop/cap path",
+         check_exchange_overflow_classified),
 ]
