@@ -1,8 +1,8 @@
 #!/bin/bash
 # Static-analysis gate — the Python-side stand-in for the compile-time
 # enforcement the reference gets from C++ types and JNI signature checks:
-# tpulint (tools/tpulint) runs its twenty-five invariant rules —
-# twenty-two per-file AST rules (host/device
+# tpulint (tools/tpulint) runs its twenty-six invariant rules —
+# twenty-three per-file AST rules (host/device
 # boundary, traced branches, sentinel safety, regex padding byte, dtype
 # width, validity-mask derivation, fallback accounting, jit-via-dispatch,
 # pipeline-stage host-transfer, fusion-region host-sync,
@@ -11,7 +11,7 @@
 # cache-key-must-fingerprint, compress-inside-seal,
 # worker-exit-must-classify, pallas-kernel-must-have-oracle,
 # placement-must-record, rtfilter-decision-must-record,
-# exchange-overflow-must-classify)
+# exchange-overflow-must-classify, peer-flight-must-verify-manifest)
 # plus three whole-program concurrency rules built on the
 # tools/tpulint/flows.py interprocedural engine (lock-order-cycle,
 # blocking-call-under-lock, unguarded-shared-write) —
@@ -972,9 +972,12 @@ EOF4
 # builds the call graph + lock registry; concurrency.py judges it),
 # rule 23 (placement-must-record) guards the mesh's routing visibility,
 # rule 24 (rtfilter-decision-must-record) guards the runtime-filter
-# planner's decision visibility, and rule 25
+# planner's decision visibility, rule 25
 # (exchange-overflow-must-classify) guards the exchange/shuffle overflow
-# ladder against bare-boolean drop/cap paths.
+# ladder against bare-boolean drop/cap paths, and rule 26
+# (peer-flight-must-verify-manifest) guards the direct exchange's
+# verify-then-decode seam (a peer flight must match the supervisor's
+# manifest fingerprint before any byte reaches the codec).
 # The package sweep above already fails on any new finding; this block
 # proves the ENGINE has not regressed silently — each seeded fixture
 # must still FIRE its rule (checked structurally via --format json, not
@@ -986,7 +989,8 @@ for fixture_rule in \
     "seeded_unguarded_write.py unguarded-shared-write" \
     "seeded_cluster_placement.py placement-must-record" \
     "seeded_rtfilter_decision.py rtfilter-decision-must-record" \
-    "seeded_exchange_overflow.py exchange-overflow-must-classify"; do
+    "seeded_exchange_overflow.py exchange-overflow-must-classify" \
+    "seeded_peer_flight.py peer-flight-must-verify-manifest"; do
   set -- $fixture_rule
   out=$(python -m tools.tpulint --format json --no-baseline \
         "tests/tpulint_fixtures/$1" || true)
@@ -1000,8 +1004,60 @@ want, fixture = os.environ["RULE"], os.environ["FIXTURE"]
 assert want in rules, f"{fixture} no longer fires {want}: {rules}"
 EOF
 done
-echo "seeded fixtures OK: rules 20-25 fire"
+echo "seeded fixtures OK: rules 20-26 fire"
 
 graph=$(python -m tools.tpulint --lock-graph spark_rapids_jni_tpu)
 grep -q "acyclic" <<<"$graph"
 echo "concurrency smoke OK: lock-order graph acyclic over live package"
+
+# direct-exchange smoke: rule 26 proves receive sites VERIFY; this
+# proves the direct topology actually pays off — over a live 2-host
+# mesh the same q13-shaped exchange moves strictly fewer bytes across
+# the supervisor link when the flights fly host-to-host than when they
+# route through the supervisor, bit-identical both ways. Both modes are
+# warmed first (first-run compiles drive ping/pong chatter that would
+# swamp the steady-state measurement) and the worker result memo is off
+# so both measured rounds do real work.
+JAX_PLATFORMS=cpu python - <<'EOF4'
+from spark_rapids_jni_tpu.models import tpch
+from spark_rapids_jni_tpu.runtime import cluster, resultcache
+from spark_rapids_jni_tpu.telemetry import REGISTRY
+from spark_rapids_jni_tpu.utils.config import reset_option, set_option
+
+orders = tpch.orders_table(900, 120, seed=5)
+ref = resultcache.table_fingerprint(tpch.tpch_q13_local(orders, 2))
+pack, merge = tpch.q13_exchange_plans(2)
+set_option("fleet.heartbeat_interval_s", 0.1)
+set_option("fleet.result_memo_entries", 0)
+try:
+    with cluster.QueryCluster(2) as c:
+        assert c.wait_live(timeout=120) == 2
+        c.register_table("orders", orders, keys=(tpch.O_ORDERKEY,))
+
+        def run(sid, direct):
+            xt = c.submit_exchange(
+                sid, pack, merge, table="orders", binding="orders",
+                merge_binding="partials",
+                merge_valid_meta="merge.num_groups", direct=direct)
+            fp = resultcache.table_fingerprint(xt.result(timeout=120))
+            assert fp == ref, f"{sid}: not bit-identical to the oracle"
+
+        run("w0", True)   # warm
+        run("w1", False)  # warm
+        link = REGISTRY.counter("fleet.link_bytes")
+        base = link.value
+        run("m0", True)
+        direct_bytes = link.value - base
+        base = link.value
+        run("m1", False)
+        routed_bytes = link.value - base
+        assert direct_bytes < routed_bytes, \
+            f"direct {direct_bytes} >= routed {routed_bytes}"
+        assert c.leaked_bytes() == 0, "leaked reservations"
+finally:
+    reset_option("fleet.heartbeat_interval_s")
+    reset_option("fleet.result_memo_entries")
+print(f"direct-exchange smoke OK: bit-identical both modes, "
+      f"supervisor link {direct_bytes} B direct < {routed_bytes} B "
+      f"routed ({routed_bytes / max(direct_bytes, 1):.2f}x)")
+EOF4

@@ -2605,6 +2605,21 @@ def q13_exchange_plans(parts: int):
     return pack, q13_merge_plan()
 
 
+def q13_midplan_plan(parts: int) -> fusion.Plan:
+    """The q13-shaped aggregation as ONE plan with a planner-placed
+    interior ``Exchange``: partial groupby -> exchange by custkey ->
+    sum-merge, the region -> exchange -> region shape
+    ``fusion.split_at_exchange`` breaks into exactly the hand-split
+    (pack, merge) plan pair of :func:`q13_exchange_plans`. ``parts=0``
+    defers the partition count to the learned-selectivity store
+    (``exchange.choose_parts``)."""
+    return fusion.Plan("tpch_q13_midplan", fusion.GroupBy(
+        fusion.Exchange(
+            q13_partial_plan().root, keys=(0,), parts=int(parts),
+            valid_meta="partial.num_groups", label="exchange"),
+        (0,), ((1, "sum"),), max_groups=None, label="merge"))
+
+
 def tpch_q13_local(orders: Table, parts: int = 1, *,
                    shard_keys=(O_ORDERKEY,)) -> Table:
     """Single-host oracle for the distributed q13-shaped aggregation:

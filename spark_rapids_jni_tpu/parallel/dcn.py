@@ -597,3 +597,255 @@ def exchange_across_slices(table: Table, keys: Sequence[int],
     if mine.num_rows == 0:
         return received
     return concatenate([mine, received])
+
+
+# ---------------------------------------------------------------------------
+# direct peer flights: HMAC-signed dial grants + the worker flight gateway
+# ---------------------------------------------------------------------------
+#
+# The cluster's dial-back gateway generalized: not only does every host
+# worker dial the SUPERVISOR back at boot, every worker also runs a
+# :class:`PeerFlightServer` so other hosts can dial IT with exchange
+# flights — the supervisor ships only the routing manifest
+# (per-destination flight list + fingerprints + token grants) and the
+# flight bytes move host-to-host over the same sealed ``send_framed``
+# ARQ discipline as every other DCN payload. A peer dial is only
+# accepted with a grant HMAC-signed by the supervisor (key derived from
+# the cluster's per-boot secret), so an unauthenticated peer cannot
+# inject rows into a merge; rejections are counted and recorded exactly
+# like rejected supervisor dial-ins.
+
+_GRANT_INFO = b"spark-rapids-tpu/peer-grant/v1"
+
+
+def grant_key(boot_secret: str) -> bytes:
+    """Derive the per-boot peer-grant HMAC key from the cluster's boot
+    secret (minted fresh every supervisor construction, shipped to each
+    worker in its launch environment — never over the data path)."""
+    import hashlib
+    import hmac as _hmac
+
+    return _hmac.new(boot_secret.encode("utf-8"), _GRANT_INFO,
+                     hashlib.sha256).digest()
+
+
+def sign_grant(key: bytes, *, xid: str, src: str, dest: str,
+               part: int) -> str:
+    """Sign one peer-dial grant: the supervisor authorizes exactly one
+    (exchange, source host, destination host, destination part) flight."""
+    import hashlib
+    import hmac as _hmac
+
+    msg = f"{xid}|{src}|{dest}|{int(part)}".encode("utf-8")
+    return _hmac.new(key, msg, hashlib.sha256).hexdigest()
+
+
+def verify_grant(key: bytes, grant: str, *, xid: str, src: str,
+                 dest: str, part: int) -> bool:
+    """Constant-time check of a presented grant against the per-boot
+    key; a False return means the dial is refused before any flight
+    bytes are read."""
+    import hmac as _hmac
+
+    want = sign_grant(key, xid=xid, src=src, dest=dest, part=part)
+    return _hmac.compare_digest(want, str(grant))
+
+
+def flight_fingerprint(blob: bytes) -> str:
+    """Content fingerprint of one serialized flight blob — what the
+    manifest carries and what a destination verifies before any byte is
+    decoded (the cross-host half of verify-then-decode for the direct
+    path)."""
+    import hashlib
+
+    return hashlib.sha256(blob).hexdigest()
+
+
+def send_peer_flight(addr, header: dict, blob: bytes, *,
+                     retries: Optional[int] = None,
+                     delay_s: Optional[float] = None,
+                     op: str = "exchange.peer_flight", **ctx) -> int:
+    """Dial a destination's :class:`PeerFlightServer` and ship one
+    flight: a pickled header frame (xid/src/part/grant/fingerprint),
+    then the flight blob, both under :func:`send_framed`'s seal + ARQ
+    discipline on the ``exchange.wire`` corruption seam. The dial uses
+    a SHORT bounded retry (``exchange.peer_dial_retries``): a dead peer
+    must fail fast into the routed fallback rung, not stall the
+    exchange. Raises the classified ``TransportError`` chain on dial
+    exhaustion and ``ConnectionError`` if the peer refuses the grant."""
+    import pickle
+
+    host, port = addr
+    n = int(retries if retries is not None
+            else get_option("exchange.peer_dial_retries"))
+    d = float(delay_s if delay_s is not None
+              else get_option("exchange.peer_dial_delay_s"))
+    sock = dial(int(port), host or None, retries=n, delay_s=d)
+    try:
+        send_framed(sock, pickle.dumps(header, protocol=4), 0,
+                    op="dcn.peer_hello", corrupt_seam="integrity.wire")
+        sent = send_framed(sock, blob, 1, op=op,
+                           corrupt_seam="exchange.wire", **ctx)
+        return sent
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+class PeerFlightServer:
+    """Worker-side flight gateway: one listener per cluster worker that
+    other hosts dial DIRECTLY with exchange flights, so the supervisor
+    link carries only manifests and acks.
+
+    Each accepted connection is served off-thread: header frame first
+    (grant verified against the per-boot key BEFORE any flight bytes
+    are read; a bad grant counts ``cluster.rejected_dials`` and closes
+    the socket), then the sealed flight blob, which lands in the
+    mailbox keyed ``(xid, part)`` by source host. The destination's
+    merge step collects with :meth:`wait_flights` and verifies each
+    blob against the supervisor's manifest fingerprints before it
+    decodes (tpulint rule 26). The mailbox is bounded
+    (``max_entries``): overflow evicts the oldest flight with a counter
+    so an abandoned exchange cannot pin worker memory forever."""
+
+    def __init__(self, key: bytes, *, dest: str,
+                 host: Optional[str] = None, max_entries: int = 256):
+        import threading
+
+        self._key = key
+        self._dest = str(dest)
+        self._srv = SliceServer(host=host)
+        self.host, self.port = self._srv.host, self._srv.port
+        self._max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._mail: "dict[tuple, dict]" = {}
+        self._order: "list[tuple]" = []  # (xid, part, src) arrival order
+        self._arrived = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"peer-flights-{self._dest}")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        import threading
+
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._srv.accept(timeout=0.2)
+            except TimeoutError:
+                continue
+            except OSError:
+                if self._stop.is_set():
+                    return
+                continue
+            threading.Thread(target=self._serve_peer, args=(conn,),
+                             daemon=True,
+                             name=f"peer-flight-{self._dest}").start()
+
+    def _serve_peer(self, conn) -> None:
+        """One peer dial-in: verify the grant, then receive the flight
+        into the mailbox (header and payload both framed/ARQ'd)."""
+        import pickle
+
+        from spark_rapids_jni_tpu.telemetry.events import record_fleet
+        from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
+
+        try:
+            try:
+                hdr = pickle.loads(recv_framed(conn, 0,
+                                               op="dcn.peer_hello"))
+                xid = str(hdr.get("xid", ""))
+                src = str(hdr.get("src", ""))
+                part = int(hdr.get("part", -1))
+                ok = verify_grant(self._key, str(hdr.get("grant", "")),
+                                  xid=xid, src=src, dest=self._dest,
+                                  part=part)
+                if not ok:
+                    # unauthenticated peer: refuse BEFORE any flight
+                    # bytes are read, visibly — same counter as a
+                    # rejected supervisor dial-in
+                    REGISTRY.counter("cluster.rejected_dials").inc()
+                    record_fleet("cluster.peer_gateway", "rejected_dial",
+                                 replica=self._dest, peer=src, xid=xid,
+                                 part=part)
+                    return
+                blob = recv_framed(conn, 1, op="exchange.peer_flight")
+            except Exception as exc:
+                # a half-dial (peer died mid-flight, corrupt beyond the
+                # ARQ budget): account for the swallow — the exchange's
+                # own timeout surfaces the missing flight classified
+                REGISTRY.counter("exchange.peer_recv_failures").inc()
+                record_fleet("cluster.peer_gateway", "peer_recv_failed",
+                             replica=self._dest,
+                             error_kind=type(exc).__name__)
+                return
+            REGISTRY.counter("exchange.peer_flights_recv").inc()
+            self.deliver(xid, part, src, blob)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def deliver(self, xid: str, part: int, src: str, blob: bytes) -> None:
+        """Land one flight in the mailbox (also the self-delivery path:
+        a source whose destination is itself skips the dial)."""
+        from spark_rapids_jni_tpu.telemetry.registry import REGISTRY
+
+        key = (str(xid), int(part))
+        with self._lock:
+            self._mail.setdefault(key, {})[str(src)] = blob
+            self._order.append((key[0], key[1], str(src)))
+            while len(self._order) > self._max_entries:
+                oxid, opart, osrc = self._order.pop(0)
+                box = self._mail.get((oxid, opart))
+                if box is not None and box.pop(osrc, None) is not None:
+                    REGISTRY.counter("exchange.peer_mail_evicted").inc()
+                if box is not None and not box:
+                    self._mail.pop((oxid, opart), None)
+            self._arrived.set()
+
+    def wait_flights(self, xid: str, part: int, srcs,
+                     timeout: Optional[float] = None) -> dict:
+        """Block until every source in ``srcs`` has delivered its flight
+        for ``(xid, part)``; returns ``{src: blob}``. The caller MUST
+        verify each blob against the manifest fingerprint before
+        decoding. Raises ``TimeoutError`` naming the missing sources."""
+        import time
+
+        want = {str(s) for s in srcs}
+        deadline = None if timeout is None else time.monotonic() + timeout
+        key = (str(xid), int(part))
+        while True:
+            with self._lock:
+                box = dict(self._mail.get(key) or {})
+                self._arrived.clear()
+            if want <= set(box):
+                return {s: box[s] for s in want}
+            left = (None if deadline is None
+                    else deadline - time.monotonic())
+            if left is not None and left <= 0:
+                raise TimeoutError(
+                    f"peer flights for exchange {xid!r} part {part} "
+                    f"missing from {sorted(want - set(box))} after "
+                    f"{timeout}s")
+            self._arrived.wait(0.05 if left is None else min(left, 0.05))
+
+    def discard(self, xid: str, part: Optional[int] = None) -> None:
+        """Drop mailbox state for a finished (or abandoned) exchange."""
+        with self._lock:
+            keys = [k for k in self._mail
+                    if k[0] == str(xid)
+                    and (part is None or k[1] == int(part))]
+            for k in keys:
+                self._mail.pop(k, None)
+            self._order = [o for o in self._order
+                           if (o[0], o[1]) not in set(keys)]
+
+    def close(self) -> None:
+        self._stop.set()
+        self._srv.close()
+        self._thread.join(timeout=2.0)

@@ -81,6 +81,12 @@ _log = get_logger("cluster")
 # is admitted as that host's control channel
 _ENV_TOKEN = "SPARK_RAPIDS_TPU_CLUSTER_TOKEN"
 
+# the per-boot peer secret: minted once per supervisor construction and
+# shipped to every worker's launch environment. Workers derive the
+# grant HMAC key from it (dcn.grant_key) and refuse any direct
+# host-to-host flight whose dial grant the supervisor didn't sign.
+_ENV_PEER_SECRET = "SPARK_RAPIDS_TPU_CLUSTER_PEER_SECRET"
+
 _LIVE_CLUSTERS: "weakref.WeakSet[QueryCluster]" = weakref.WeakSet()
 
 
@@ -200,7 +206,9 @@ class ExchangeTicket:
                  merge_valid_meta: Optional[str],
                  tickets: List[FleetTicket],
                  deadline_ms: Optional[int],
-                 merge_budget_bytes: Optional[int]):
+                 merge_budget_bytes: Optional[int],
+                 *, direct: bool = False, binding: str = "",
+                 bindings: Optional[dict] = None):
         self.table = table
         self.pack_plan = pack_plan
         self.merge_plan = merge_plan
@@ -213,6 +221,13 @@ class ExchangeTicket:
         self.deadline_ms = deadline_ms
         self.merge_budget_bytes = merge_budget_bytes
         self.fingerprint: Optional[str] = None
+        # direct mode: the pack fan-out is DEFERRED — phase 1 runs as
+        # xpack frames when result() drives the exchange, and the pack
+        # binding/broadcast bindings are retained for the routed
+        # fallback rung's submit_to_shard fan-out
+        self.direct = bool(direct)
+        self.binding = str(binding)
+        self.bindings = dict(bindings or {})
         self._cluster = cluster
         self._lock = threading.Lock()
         self._claimed = False
@@ -221,7 +236,9 @@ class ExchangeTicket:
         self._exc: Optional[BaseException] = None
 
     def done(self) -> bool:
-        return self._done.is_set() or all(t.done() for t in self.tickets)
+        if self._done.is_set():
+            return True
+        return bool(self.tickets) and all(t.done() for t in self.tickets)
 
     def _trim(self, fused: fusion.FusedResult):
         """Slice a merge result back to its true rows (the merge plan's
@@ -262,12 +279,7 @@ class ExchangeTicket:
                 raise self._exc
             return self._value
         try:
-            partials = []
-            for t in self.tickets:
-                left = (None if deadline is None
-                        else max(0.0, deadline - time.monotonic()))
-                partials.append(t.result(left))
-            value = self._cluster._exchange_merge(self, partials, deadline)
+            value = self._cluster._exchange_run(self, deadline)
         except TimeoutError:
             # a timeout leaves the ticket unresolved (retryable wait);
             # re-driving is idempotent through the fleet memos
@@ -306,6 +318,13 @@ class QueryCluster(QueryFleet):
         self._boot_lock = threading.Lock()
         self._pending_boots: Dict[str, tuple] = {}
         self._reg_waits: Dict[tuple, tuple] = {}
+        # direct-exchange state: the per-boot peer secret (workers sign
+        # peer dial-ins against it), each host's flight-gateway address
+        # (reported in its hello), and the pending xpack/xmerge waits
+        self._peer_secret = os.urandom(16).hex()
+        self._peer_key = dcn.grant_key(self._peer_secret)
+        self._peer_addrs: Dict[str, tuple] = {}
+        self._x_waits: Dict[tuple, tuple] = {}
         self._tables: Dict[str, _ShardSet] = {}
         self._merge_memo: "collections.OrderedDict[tuple, str]" = \
             collections.OrderedDict()
@@ -326,6 +345,9 @@ class QueryCluster(QueryFleet):
         env = super()._worker_environment(r)
         # workers stamp host= on every record and span they emit
         env["SPARK_RAPIDS_TPU_TELEMETRY_HOST"] = r.rid
+        # the grant key for direct peer flights derives from this; it
+        # rides the launch environment, never the data path
+        env[_ENV_PEER_SECRET] = self._peer_secret
         return env
 
     def _extra(self, r: _Replica) -> Dict[str, Any]:
@@ -393,8 +415,17 @@ class QueryCluster(QueryFleet):
         if stale:
             chan.close()
             return
+        peer_port = hello.get("peer_port")
+        if peer_port:
+            # the worker's direct-flight gateway: where OTHER hosts dial
+            # it with exchange flights (latest generation wins)
+            with self._lock:
+                self._peer_addrs[r.rid] = (
+                    str(hello.get("peer_host") or self._gateway.host),
+                    int(peer_port))
         record_fleet("cluster.gateway", "host_dialed_in", replica=r.rid,
-                     host=r.rid, generation=gen)
+                     host=r.rid, generation=gen,
+                     peer_port=int(peer_port or 0))
         self._attach_channel(r, chan, gen)
 
     # -- partitioned serving: register, route, fan out -----------------------
@@ -499,13 +530,25 @@ class QueryCluster(QueryFleet):
 
     def _on_worker_msg(self, r: _Replica, gen: int,
                        msg: Dict[str, Any]) -> None:
-        if msg.get("t") == "registered":
+        t = msg.get("t")
+        if t == "registered":
             key = (r.rid, gen, str(msg.get("name", "")))
             with self._lock:
                 ent = self._reg_waits.get(key)
             if ent is None:
                 return  # ack for a wait that timed out or a stale gen
             evt, slot = ent
+            slot.update(msg)
+            evt.set()
+        elif t in ("xpack_done", "xmerge_done"):
+            key = (str(msg.get("xid", "")), t, int(msg.get("part", -1)))
+            with self._lock:
+                ent = self._x_waits.get(key)
+            if ent is None:
+                return  # reply for an abandoned exchange run
+            evt, slot, rid, wgen = ent
+            if rid != r.rid or wgen != gen:
+                return  # stale generation's straggler
             slot.update(msg)
             evt.set()
 
@@ -671,34 +714,69 @@ class QueryCluster(QueryFleet):
         return merged
 
     def submit_exchange(self, session_id: str, pack_plan: fusion.Plan,
-                        merge_plan: fusion.Plan, *, table: str,
-                        binding: str, merge_binding: str,
+                        merge_plan: Optional[fusion.Plan] = None, *,
+                        table: str, binding: str,
+                        merge_binding: Optional[str] = None,
                         merge_valid_meta: Optional[str] = None,
                         bindings: Optional[dict] = None,
                         deadline_ms: Optional[int] = None,
-                        merge_budget_bytes: Optional[int] = None
+                        merge_budget_bytes: Optional[int] = None,
+                        direct: Optional[bool] = None
                         ) -> ExchangeTicket:
         """General-cardinality distributed groupby/join fan-out: the
         hash-partitioned all-to-all (``runtime/exchange.py``) over the
         mesh, with NO static slot table anywhere.
 
-        ``pack_plan`` must be rooted at an ``Exchange`` node whose
-        ``parts`` equals the registered table's partition count: each
-        shard's host runs the child (the partial plan) locally, then
-        repartitions its output by the exchange keys into per-destination
-        wire buffers (TPCZ codec + integrity seal on every hop, like all
-        fleet frames). ``merge_plan`` scans ``merge_binding`` and runs on
-        each destination's owning host over the rows that hashed there;
-        ``merge_valid_meta`` names its true-row-count meta key (an
-        unbounded groupby's ``<label>.num_groups``). The returned
-        ticket's :meth:`~ExchangeTicket.result` finishes the all-to-all
-        and returns the part-ordered concatenation of destination
-        results — bit-identical to the single-host oracle (the same
-        plans run over ``exchange.exchange_local``)."""
+        Two plan forms. The classic pair: ``pack_plan`` rooted at an
+        ``Exchange`` node whose ``parts`` equals the registered table's
+        partition count, plus a ``merge_plan`` scanning
+        ``merge_binding``. Or ONE plan with a planner-placed interior
+        ``Exchange`` (``merge_plan=None``): the supervisor derives the
+        pair with :func:`fusion.split_at_exchange` — ``parts=0`` in the
+        plan resolves to the table's partition count, and
+        ``merge_valid_meta`` defaults to the merge root's
+        ``<label>.num_groups`` when it is an unbounded groupby.
+
+        Each shard's host runs the Exchange child (the partial plan)
+        locally, then repartitions its output by the exchange keys into
+        per-destination wire buffers (TPCZ codec + integrity seal on
+        every hop, like all fleet frames); the merge plan runs on each
+        destination's owning host over the rows that hashed there.
+        ``direct`` (default ``exchange.direct_enabled``) ships the
+        flights host-to-host through each worker's peer gateway — the
+        supervisor link carries only the routing manifest and acks —
+        with the router-mediated path as the classified fallback rung.
+        The returned ticket's :meth:`~ExchangeTicket.result` finishes
+        the all-to-all and returns the part-ordered concatenation of
+        destination results — bit-identical to the single-host oracle
+        (the same plans run over ``exchange.exchange_local``), direct
+        or routed."""
         with self._lock:
             ss = self._tables.get(str(table))
         if ss is None:
             raise KeyError(f"cluster: table {table!r} is not registered")
+        if merge_plan is None:
+            # single mid-plan-Exchange form: derive the pair
+            split = fusion.split_at_exchange(pack_plan)
+            if split is None:
+                raise TypeError(
+                    "submit_exchange with merge_plan=None needs a plan "
+                    "with an interior Exchange node (see "
+                    f"fusion.split_at_exchange), got {pack_plan.name!r}")
+            pack_plan, merge_plan, merge_binding, x = split
+            if int(x.parts) == 0:
+                # auto-parts on a mesh: one destination per shard owner
+                x = x._replace(parts=ss.parts)
+                pack_plan = fusion.Plan(pack_plan.name, x)
+            mroot = merge_plan.root
+            if (merge_valid_meta is None
+                    and isinstance(mroot, fusion.GroupBy)
+                    and mroot.max_groups is None):
+                merge_valid_meta = f"{mroot.label}.num_groups"
+        if merge_binding is None:
+            raise ValueError(
+                "submit_exchange needs merge_binding= with an explicit "
+                "merge plan")
         root = pack_plan.root
         if not isinstance(root, fusion.Exchange):
             raise TypeError(
@@ -710,20 +788,31 @@ class QueryCluster(QueryFleet):
                 f"destinations but table {ss.name!r} has {ss.parts} "
                 f"partitions — they must match (one destination per "
                 f"shard owner)")
+        direct = (bool(get_option("exchange.direct_enabled"))
+                  if direct is None else bool(direct))
         REGISTRY.counter("cluster.fanouts").inc()
         REGISTRY.counter("cluster.exchanges").inc()
         record_fleet("cluster.exchange", "fanout", replica="supervisor",
-                     table=ss.name, parts=ss.parts, plan=pack_plan.name)
-        tickets = [
-            self.submit_to_shard(session_id, pack_plan, table=table,
-                                 binding=binding, part=p,
-                                 bindings=bindings,
-                                 deadline_ms=deadline_ms)
-            for p in range(ss.parts)]
+                     table=ss.name, parts=ss.parts, plan=pack_plan.name,
+                     direct=direct)
+        if direct:
+            # phase 1 is deferred: result() drives the xpack fan-out so
+            # grants/manifests bind to one exchange run (a retried wait
+            # mints a fresh xid); the routed fallback rung fans out
+            # through submit_to_shard like the classic path
+            tickets: List[FleetTicket] = []
+        else:
+            tickets = [
+                self.submit_to_shard(session_id, pack_plan, table=table,
+                                     binding=binding, part=p,
+                                     bindings=bindings,
+                                     deadline_ms=deadline_ms)
+                for p in range(ss.parts)]
         return ExchangeTicket(self, str(session_id), ss.name, pack_plan,
                               merge_plan, str(merge_binding),
                               merge_valid_meta, tickets, deadline_ms,
-                              merge_budget_bytes)
+                              merge_budget_bytes, direct=direct,
+                              binding=str(binding), bindings=bindings)
 
     def _exchange_merge(self, xt: ExchangeTicket, partials: List[Any],
                         deadline: Optional[float]):
@@ -803,6 +892,14 @@ class QueryCluster(QueryFleet):
         fps = tuple(t.fingerprint or "" for t in xt.tickets)
         mkey = ("exchange", xt.pack_plan.name, xt.merge_plan.name,
                 xt.table, fps)
+        return self._exchange_finish(xt, mkey, merged, parts, "routed")
+
+    def _exchange_finish(self, xt: ExchangeTicket, mkey: tuple, merged,
+                         parts: int, mode: str):
+        """Shared exchange epilogue: memo-check the concatenated result's
+        fingerprint — a repeated exchange over the same input set must
+        come back bit-identical whether it ran direct, routed, or fell
+        back mid-way — then count and record the merge."""
         fp = resultcache.table_fingerprint(merged)
         with self._lock:
             prev = self._merge_memo.get(mkey)
@@ -814,7 +911,7 @@ class QueryCluster(QueryFleet):
             REGISTRY.counter("fleet.identity_mismatch").inc()
             record_fleet("cluster.exchange", "identity_mismatch",
                          replica="supervisor", table=xt.table,
-                         plan=xt.merge_plan.name)
+                         plan=xt.merge_plan.name, mode=mode)
             raise resilience.CorruptDataError(
                 f"cluster: exchange result for {xt.pack_plan.name} -> "
                 f"{xt.merge_plan.name} over {xt.table} differs from the "
@@ -823,8 +920,226 @@ class QueryCluster(QueryFleet):
         xt.fingerprint = fp
         REGISTRY.counter("cluster.exchange_merges").inc()
         record_fleet("cluster.exchange", "merged", replica="supervisor",
-                     table=xt.table, parts=parts, fingerprint=fp)
+                     table=xt.table, parts=parts, fingerprint=fp,
+                     mode=mode)
         return merged
+
+    # -- direct flights: host-to-host exchange over the peer gateways --------
+
+    def _exchange_run(self, xt: ExchangeTicket, deadline: Optional[float]):
+        """Drive one claimed exchange to its value: the direct
+        host-to-host path first (for tickets submitted direct), with the
+        router-mediated path as the classified fallback rung — and the
+        only path for ``direct=False`` tickets. A fallback re-fans the
+        pack out through ``submit_to_shard`` (re-homing dead owners'
+        shards on the way), so chaos semantics and SIGKILL failover
+        carry over unchanged."""
+        if xt.direct:
+            try:
+                return self._exchange_direct(xt, deadline)
+            except TimeoutError:
+                raise  # retryable wait: the ticket unclaims
+            except BaseException as exc:
+                REGISTRY.counter("cluster.exchange_direct_fallbacks").inc()
+                record_fleet("cluster.exchange", "direct_fallback",
+                             replica="supervisor", table=xt.table,
+                             plan=xt.pack_plan.name,
+                             error_kind=type(exc).__name__)
+                _log.warning(
+                    "cluster: direct exchange %s over %s fell back to "
+                    "the routed path: %s",
+                    xt.pack_plan.name, xt.table, exc)
+        if not xt.tickets:
+            xt.tickets = [
+                self.submit_to_shard(xt.session_id, xt.pack_plan,
+                                     table=xt.table, binding=xt.binding,
+                                     part=p, bindings=xt.bindings,
+                                     deadline_ms=xt.deadline_ms)
+                for p in range(xt.parts)]
+        partials = []
+        for t in xt.tickets:
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            partials.append(t.result(left))
+        return self._exchange_merge(xt, partials, deadline)
+
+    def _x_collect(self, wait: tuple, deadline: Optional[float],
+                   cap: float, what: str) -> Dict[str, Any]:
+        """Block for one xpack/xmerge reply slot. A caller-deadline
+        expiry raises ``TimeoutError`` (retryable — the ticket
+        unclaims); a per-phase stall or an error reply raises the
+        classified ``TransportError`` that trips the routed fallback."""
+        key, evt, slot, rid = wait
+        left = (cap if deadline is None
+                else min(cap, deadline - time.monotonic()))
+        ok = evt.wait(max(0.0, left))
+        with self._lock:
+            self._x_waits.pop(key, None)
+        if not ok:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"cluster: direct exchange {what} on {rid} not done "
+                    "before the caller deadline")
+            raise resilience.TransportError(
+                f"cluster: direct exchange {what} on {rid} did not "
+                f"complete within {cap}s", host=rid,
+                seam="exchange.wire")
+        if slot.get("status") != "ok":
+            raise resilience.TransportError(
+                f"cluster: direct exchange {what} on {rid} failed: "
+                f"{slot.get('error_kind')}: {slot.get('error')}",
+                host=rid, seam="exchange.wire")
+        return slot
+
+    def _exchange_direct(self, xt: ExchangeTicket,
+                         deadline: Optional[float]):
+        """The direct all-to-all: phase 1 ships each source owner an
+        ``xpack`` frame (plan + per-destination HMAC grants); workers
+        pack locally and fly their sealed blobs host-to-host through the
+        peer gateways, reporting only fingerprints (plus any blobs whose
+        peer dial failed — the per-flight fallback rung). Phase 2 ships
+        each destination owner the manifest (source-ordered fingerprint
+        list + the supervisor-routed stragglers); workers verify every
+        blob against it before decoding, merge, and return the trimmed
+        result. The supervisor link carries manifests, acks and merge
+        results — never a healthy flight."""
+        import pickle
+
+        from spark_rapids_jni_tpu.ops.table_ops import concatenate
+
+        parts = xt.parts
+        cap = float(get_option("exchange.direct_timeout_s"))
+        owners: List[tuple] = []
+        with self._lock:
+            ss = self._tables.get(xt.table)
+            if ss is None:
+                raise KeyError(
+                    f"cluster: table {xt.table!r} is not registered")
+            for p in range(parts):
+                r = self._host(ss.owners[p])
+                if r is None or r.state != "live" or r.chan is None:
+                    raise resilience.ReplicaDeadError(
+                        f"cluster: shard {xt.table}/p{p} owner "
+                        f"{ss.owners[p]} is not live for a direct "
+                        "exchange", host=str(ss.owners[p]), part=p,
+                        seam="fleet.dispatch")
+                peer = self._peer_addrs.get(r.rid)
+                if peer is None:
+                    raise resilience.TransportError(
+                        f"cluster: host {r.rid} reported no peer flight "
+                        "gateway", host=r.rid, seam="exchange.wire")
+                owners.append((r, r.generation, r.chan, peer))
+        xid = os.urandom(8).hex()
+        plan_blob = pickle.dumps(xt.pack_plan,
+                                 protocol=pickle.HIGHEST_PROTOCOL)
+        merge_blob = pickle.dumps(xt.merge_plan,
+                                  protocol=pickle.HIGHEST_PROTOCOL)
+        enc_bindings = {k: _encode_table(v)
+                        for k, v in xt.bindings.items()}
+        record_fleet("cluster.exchange", "direct_fanout",
+                     replica="supervisor", table=xt.table, parts=parts,
+                     plan=xt.pack_plan.name, xid=xid)
+        try:
+            with spans.span("cluster.exchange_direct", table=xt.table,
+                            parts=parts, plan=xt.pack_plan.name):
+                waits = []
+                for sp in range(parts):
+                    r, gen, chan, _peer = owners[sp]
+                    dests = []
+                    for dp in range(parts):
+                        rd, _gd, _cd, peerd = owners[dp]
+                        dests.append({
+                            "part": dp, "host": rd.rid,
+                            "addr": list(peerd),
+                            "grant": dcn.sign_grant(
+                                self._peer_key, xid=xid, src=f"p{sp}",
+                                dest=rd.rid, part=dp)})
+                    key = (xid, "xpack_done", sp)
+                    evt, slot = threading.Event(), {}
+                    with self._lock:
+                        self._x_waits[key] = (evt, slot, r.rid, gen)
+                    waits.append((key, evt, slot, r.rid))
+                    chan.send({"t": "xpack", "xid": xid, "part": sp,
+                               "plan": plan_blob, "binding": xt.binding,
+                               "binding_ref": f"{xt.table}/p{sp}",
+                               "bindings": enc_bindings, "dests": dests,
+                               "timeout_s": cap})
+                packs = [self._x_collect(w, deadline, cap, "xpack")
+                         for w in waits]
+                # manifests stay SOURCE-ORDERED (sp ascending): the
+                # destination concatenates in manifest order, which is
+                # the routed path's source-major flight order — the
+                # bit-identity contract
+                manifests: List[list] = [[] for _ in range(parts)]
+                routed: List[dict] = [dict() for _ in range(parts)]
+                bytes_direct = bytes_routed = 0
+                for sp, res in enumerate(packs):
+                    sid = f"p{sp}"
+                    for dp, fpv in (res.get("fps") or {}).items():
+                        manifests[int(dp)].append([sid, str(fpv)])
+                    for dp, blob in (res.get("routed") or {}).items():
+                        routed[int(dp)][sid] = blob
+                    bytes_direct += int(res.get("bytes_direct", 0))
+                    bytes_routed += int(res.get("bytes_routed", 0))
+                # workers counted their own lanes in their own
+                # processes; re-increment here so the split is
+                # measurable from the supervisor's telemetry alone
+                REGISTRY.counter("exchange.bytes_direct").inc(bytes_direct)
+                REGISTRY.counter("exchange.bytes_routed").inc(bytes_routed)
+                budget = int(xt.merge_budget_bytes
+                             if xt.merge_budget_bytes is not None
+                             else get_option("exchange.merge_budget_bytes"))
+                mwaits = []
+                for dp in range(parts):
+                    if not manifests[dp]:
+                        continue
+                    r, gen, chan, _peer = owners[dp]
+                    key = (xid, "xmerge_done", dp)
+                    evt, slot = threading.Event(), {}
+                    with self._lock:
+                        self._x_waits[key] = (evt, slot, r.rid, gen)
+                    mwaits.append(((key, evt, slot, r.rid), dp))
+                    chan.send({"t": "xmerge", "xid": xid, "part": dp,
+                               "plan": merge_blob,
+                               "binding": xt.merge_binding,
+                               "valid_meta": xt.merge_valid_meta,
+                               "manifest": manifests[dp],
+                               "routed": routed[dp], "budget": budget,
+                               "timeout_s": cap})
+                dest_results = []
+                for w, dp in mwaits:
+                    slot = self._x_collect(w, deadline, cap, "xmerge")
+                    tbl = fleetmod._decode_table(slot["table"])
+                    if (resultcache.table_fingerprint(tbl)
+                            != slot.get("fingerprint")):
+                        REGISTRY.counter("fleet.identity_mismatch").inc()
+                        record_fleet("cluster.exchange",
+                                     "identity_mismatch",
+                                     replica="supervisor",
+                                     table=xt.table, part=dp,
+                                     mode="direct")
+                        raise resilience.CorruptDataError(
+                            f"cluster: direct merge result for part {dp} "
+                            "mutated crossing the supervisor link",
+                            table=xt.table, part=dp)
+                    dest_results.append(tbl)
+                if not dest_results:
+                    raise resilience.TransportError(
+                        "cluster: direct exchange produced no "
+                        "destination results", seam="exchange.wire")
+                merged = (dest_results[0] if len(dest_results) == 1
+                          else concatenate(dest_results))
+        finally:
+            with self._lock:
+                for k in [k for k in self._x_waits if k[0] == xid]:
+                    self._x_waits.pop(k, None)
+        REGISTRY.counter("cluster.exchanges_direct").inc()
+        # keyed by the SHARD fingerprints (the direct path has no pack
+        # tickets): a repeated direct exchange over the same registered
+        # input set must come back bit-identical
+        mkey = ("exchange-direct", xt.pack_plan.name, xt.merge_plan.name,
+                xt.table, tuple(ss.fps))
+        return self._exchange_finish(xt, mkey, merged, parts, "direct")
 
     # -- supervision overrides ----------------------------------------------
 
@@ -832,6 +1147,18 @@ class QueryCluster(QueryFleet):
                           classified: BaseException) -> None:
         before = r.crashes_total
         super()._on_replica_death(r, gen, classified)
+        # fail this generation's pending direct-exchange waits FAST: a
+        # host killed mid-flight must trip the routed fallback rung, not
+        # stall the exchange until its phase timeout
+        with self._lock:
+            dead = [v for k, v in self._x_waits.items()
+                    if v[2] == r.rid and v[3] == gen]
+        for evt, slot, _rid, _g in dead:
+            slot.setdefault("status", "error")
+            slot.setdefault("error_kind", type(classified).__name__)
+            slot.setdefault("error",
+                            f"host {r.rid} died mid-exchange")
+            evt.set()
         if r.crashes_total != before:
             # the base counted a real (non-stale, unplanned) death: that
             # is a HOST death here, with shards to re-home on demand
@@ -866,19 +1193,232 @@ class QueryCluster(QueryFleet):
 # ---------------------------------------------------------------------------
 
 
+def _handle_xpack(chan: _FrameChannel, srv, msg: Dict[str, Any],
+                  hid: str, peer) -> None:
+    """Worker-side phase 1 of a direct exchange: run the pack plan over
+    the registered shard, split its wire table per destination, and fly
+    each destination's blob host-to-host through that destination's
+    peer gateway (self-deliveries skip the dial). A failed peer dial is
+    the per-flight fallback rung: the blob rides the reply frame back to
+    the supervisor, recorded and counted — the exchange completes
+    either way. The reply carries only fingerprints, lane byte counts
+    and any routed blobs."""
+    import pickle
+
+    from spark_rapids_jni_tpu.ops.table_ops import concatenate
+    from spark_rapids_jni_tpu.runtime import exchange as xch
+
+    xid, sp = str(msg.get("xid", "")), int(msg.get("part", -1))
+    src_id = f"p{sp}"
+    try:
+        delay_ms = float(
+            os.environ.get(fleetmod._ENV_SERVE_DELAY, "0") or 0.0)
+        if delay_ms > 0:
+            time.sleep(delay_ms / 1000.0)  # chaos hold (SIGKILL tests)
+        plan = pickle.loads(msg["plan"])
+        bindings = {k: fleetmod._decode_table(v)
+                    for k, v in (msg.get("bindings") or {}).items()}
+        ref = msg.get("binding_ref")
+        if ref:
+            try:
+                bindings[str(msg.get("binding"))] = \
+                    srv.registered_table(ref)
+            except KeyError:
+                raise resilience.MalformedInputError(
+                    f"direct pack references unregistered shard "
+                    f"{ref!r}", host=hid)
+        fused = fusion.execute(plan, bindings)
+        label = str(plan.root.label)
+        parts = int(plan.root.parts)
+        rc = fused.meta[f"{label}.row_counts"]
+        per_dest = xch.split_wire(fused.table, rc, parts)
+        dests = {int(d["part"]): d for d in msg.get("dests", [])}
+        fps: Dict[int, str] = {}
+        routed: Dict[int, bytes] = {}
+        sent: List[int] = []
+        bytes_direct = bytes_routed = 0
+        for dp, flights in enumerate(per_dest):
+            if not flights:
+                continue
+            dest_in = (flights[0] if len(flights) == 1
+                       else concatenate(flights))
+            blob = xch.serialize_flight(
+                dest_in, op="exchange.direct_pack", xid=xid,
+                src=src_id, dest=dp)
+            fp = dcn.flight_fingerprint(blob)
+            fps[dp] = fp
+            d = dests[dp]
+            header = {"xid": xid, "src": src_id, "part": dp,
+                      "grant": d.get("grant", ""), "fp": fp}
+            if str(d.get("host")) == hid and peer is not None:
+                # self-flight: the destination is this host — straight
+                # into the local mailbox, no dial
+                peer.deliver(xid, dp, src_id, blob)
+                REGISTRY.counter("exchange.bytes_direct").inc(len(blob))
+                bytes_direct += len(blob)
+                sent.append(dp)
+                continue
+            try:
+                dcn.send_peer_flight(
+                    tuple(d["addr"]), header, blob,
+                    op="exchange.direct_flight", xid=xid, src=src_id)
+            except (resilience.ResilienceError, ConnectionError,
+                    OSError) as exc:
+                # peer unreachable (or it refused the grant): this
+                # flight routes via the supervisor, recorded — the
+                # classified fallback rung
+                REGISTRY.counter("exchange.peer_dial_fallbacks").inc()
+                record_fleet("cluster.peer_flight", "dial_fallback",
+                             replica=hid, host=hid, xid=xid, dest=dp,
+                             error_kind=type(exc).__name__)
+                routed[dp] = blob
+                REGISTRY.counter("exchange.bytes_routed").inc(len(blob))
+                bytes_routed += len(blob)
+                continue
+            REGISTRY.counter("exchange.bytes_direct").inc(len(blob))
+            bytes_direct += len(blob)
+            sent.append(dp)
+        chan.send({"t": "xpack_done", "xid": xid, "part": sp,
+                   "status": "ok", "fps": fps, "routed": routed,
+                   "sent": sent, "bytes_direct": bytes_direct,
+                   "bytes_routed": bytes_routed,
+                   "rows": int(fused.meta[f"{label}.rows"])})
+    except BaseException as exc:
+        err = (exc if isinstance(exc, resilience.ResilienceError)
+               else resilience.classify(exc, seam="exchange.wire")(
+                   f"direct pack failed on {hid}: {exc}", host=hid))
+        chan.send({"t": "xpack_done", "xid": xid, "part": sp,
+                   "status": "error", "error_kind": type(err).__name__,
+                   "error": str(err)})
+
+
+def _handle_xmerge(chan: _FrameChannel, srv, msg: Dict[str, Any],
+                   hid: str, peer) -> None:
+    """Worker-side phase 2 of a direct exchange: collect this
+    destination's flights from the peer mailbox (plus any
+    supervisor-routed stragglers off the frame), verify EVERY blob
+    against the manifest fingerprint before decoding (tpulint rule 26 —
+    an unverified flight must never merge), run the merge plan over the
+    manifest-ordered concatenation (or the spill-aware chunked merge
+    when the flights exceed the budget), and reply with the trimmed
+    result."""
+    import pickle
+
+    from spark_rapids_jni_tpu.ops.table_ops import (
+        _slice_rows, concatenate)
+    from spark_rapids_jni_tpu.runtime import exchange as xch
+    from spark_rapids_jni_tpu.runtime.memory import _table_nbytes
+
+    xid, dp = str(msg.get("xid", "")), int(msg.get("part", -1))
+    try:
+        try:
+            plan = pickle.loads(msg["plan"])
+            binding = str(msg.get("binding"))
+            vm = msg.get("valid_meta")
+            manifest = list(msg.get("manifest") or [])
+            routed = dict(msg.get("routed") or {})
+            timeout = float(msg.get("timeout_s") or 30.0)
+            direct_srcs = [s for s, _fp in manifest if s not in routed]
+            flights: Dict[str, bytes] = {}
+            if direct_srcs:
+                if peer is None:
+                    raise resilience.TransportError(
+                        "no peer flight gateway on this worker",
+                        host=hid, seam="exchange.wire")
+                flights = peer.wait_flights(xid, dp, direct_srcs,
+                                            timeout=timeout)
+            tables = []
+            for src_id, want_fp in manifest:
+                blob = routed.get(src_id)
+                if blob is None:
+                    blob = flights.get(src_id)
+                if blob is None or dcn.flight_fingerprint(blob) != want_fp:
+                    # a flight that does not match the supervisor's
+                    # manifest must never decode, let alone merge
+                    REGISTRY.counter("fleet.identity_mismatch").inc()
+                    record_fleet("cluster.peer_flight",
+                                 "manifest_mismatch", replica=hid,
+                                 host=hid, xid=xid, part=dp, src=src_id)
+                    raise resilience.CorruptDataError(
+                        f"direct flight {src_id} -> p{dp} of exchange "
+                        f"{xid} does not match the manifest "
+                        "fingerprint — refusing to decode", host=hid,
+                        part=dp)
+                tables.append(dcn.deserialize_table(blob))
+
+            def step(tbl):
+                res = fusion.execute(plan, {binding: tbl})
+                if vm is None:
+                    return res.table
+                return _slice_rows(
+                    res.table, 0, int(np.asarray(res.meta[vm])))
+
+            budget = int(msg.get("budget")
+                         or get_option("exchange.merge_budget_bytes"))
+            if (len(tables) > 1
+                    and sum(_table_nbytes(t) for t in tables) > budget):
+                # a skewed destination on the DIRECT path spills on its
+                # own host — the router never sees the flights at all
+                REGISTRY.counter("cluster.exchange_spill_merges").inc()
+                record_fleet("cluster.exchange", "spill_merge",
+                             replica=hid, host=hid, part=dp,
+                             flights=len(tables))
+                out = xch.merge_flights(
+                    tables, step, step, budget_bytes=budget,
+                    op="exchange.direct_merge").table
+            else:
+                dest_in = (tables[0] if len(tables) == 1
+                           else concatenate(tables))
+                out = step(dest_in)
+            chan.send({"t": "xmerge_done", "xid": xid, "part": dp,
+                       "status": "ok",
+                       "table": fleetmod._encode_table(out),
+                       "fingerprint": resultcache.table_fingerprint(out),
+                       "rows": int(out.num_rows)})
+        finally:
+            if peer is not None:
+                peer.discard(xid, dp)
+    except BaseException as exc:
+        err = (exc if isinstance(exc, resilience.ResilienceError)
+               else resilience.classify(exc, seam="exchange.wire")(
+                   f"direct merge failed on {hid}: {exc}", host=hid))
+        chan.send({"t": "xmerge_done", "xid": xid, "part": dp,
+                   "status": "error", "error_kind": type(err).__name__,
+                   "error": str(err)})
+
+
 def _worker_main(connect: str, hid: str) -> int:
     """Host-worker entrypoint: dial the supervisor's gateway (bounded
-    classified retry via ``dcn.dial``), present the launch token, then
-    hand the connected channel to the fleet's worker loop — the control
-    protocol is identical from here on."""
+    classified retry via ``dcn.dial``), present the launch token — and
+    the port of this worker's own peer flight gateway, booted from the
+    per-boot peer secret — then hand the connected channel to the
+    fleet's worker loop with the direct-exchange frame handlers
+    installed. The control protocol is the fleet's from here on."""
     if os.environ.get(fleetmod._ENV_BOOT_CRASH):
         return 3  # chaos hook: crash-loop at boot
     host, _, port = connect.rpartition(":")
+    secret = os.environ.get(_ENV_PEER_SECRET, "")
+    peer = (dcn.PeerFlightServer(dcn.grant_key(secret), dest=hid)
+            if secret else None)
     sock = dcn.dial(int(port), host or None)
     chan = _FrameChannel(sock)
-    chan.send({"t": "hello", "host": hid,
-               "token": os.environ.get(_ENV_TOKEN, "")})
-    return fleetmod._worker_loop(chan, hid)
+    hello: Dict[str, Any] = {"t": "hello", "host": hid,
+                             "token": os.environ.get(_ENV_TOKEN, "")}
+    if peer is not None:
+        hello["peer_host"] = peer.host
+        hello["peer_port"] = peer.port
+    chan.send(hello)
+    exts = {
+        "xpack": lambda ch, srv, m, rid: _handle_xpack(
+            ch, srv, m, rid, peer),
+        "xmerge": lambda ch, srv, m, rid: _handle_xmerge(
+            ch, srv, m, rid, peer),
+    }
+    try:
+        return fleetmod._worker_loop(chan, hid, extensions=exts)
+    finally:
+        if peer is not None:
+            peer.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:

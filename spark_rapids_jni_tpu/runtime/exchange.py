@@ -326,6 +326,47 @@ def split_wire(wire: Table, row_counts: Sequence[int],
     return per_dest
 
 
+def choose_parts(plan_name: str, label: str, rows: int, *,
+                 fallback: int = 1) -> int:
+    """Pick a partition count for an auto-parts (``parts=0``) Exchange
+    from the learned-selectivity store: the store's EMA for this
+    (plan, exchange label) signature is the observed fraction of the
+    region's input rows that actually enter the exchange (a partial
+    groupby's group density), so ``rows x ema / target_rows_per_part``
+    estimates how many destinations the packed output warrants. No
+    history falls back to ``fallback``. Every choice is recorded with
+    its reason (an unexplained partition count is an unexplainable plan
+    change, same contract as the rtfilter gate)."""
+    from spark_rapids_jni_tpu.runtime import rtfilter
+
+    rows = int(rows)
+    ema = rtfilter.learned_pass_frac(plan_name, f"xparts.{label}")
+    if ema is None:
+        parts, reason = int(fallback), "no_history"
+    else:
+        target = max(1, int(get_option("exchange.target_rows_per_part")))
+        est = max(1, int(rows * float(ema)))
+        parts = max(1, min(int(get_option("exchange.max_parts")),
+                           -(-est // target)))
+        reason = "learned_density"
+    REGISTRY.counter("exchange.parts_chosen").inc()
+    telemetry.record_exchange(
+        f"exchange.{label}", "parts_decision", parts=parts, rows=rows,
+        reason=reason, pass_frac_ema=ema)
+    return parts
+
+
+def resolve_auto_parts(plan_name: str, node, bindings: dict):
+    """Resolve an Exchange node's ``parts=0`` auto sentinel into a
+    concrete partition count (:func:`choose_parts` over the bound input
+    rows). Returns the node unchanged when parts is already concrete —
+    fingerprints and plan signatures only ever see resolved counts."""
+    if int(node.parts) != 0:
+        return node
+    rows = sum(int(t.num_rows) for t in bindings.values())
+    return node._replace(parts=choose_parts(plan_name, node.label, rows))
+
+
 def execute_exchange_root(plan, bindings: dict, *,
                           donate_inputs: bool = False,
                           force_staged: bool = False,
@@ -338,9 +379,9 @@ def execute_exchange_root(plan, bindings: dict, *,
     (``<label>.parts/.capacity/.flights/.row_counts/.rows``) merged over
     the child's. Called by ``fusion.execute`` itself — an Exchange root
     is the one node that is a genuine host boundary."""
-    from spark_rapids_jni_tpu.runtime import fusion
+    from spark_rapids_jni_tpu.runtime import fusion, rtfilter
 
-    root = plan.root
+    root = resolve_auto_parts(plan.name, plan.root, bindings)
     inner = fusion.execute(
         fusion.Plan(plan.name, root.child), bindings,
         donate_inputs=donate_inputs, force_staged=force_staged,
@@ -354,6 +395,10 @@ def execute_exchange_root(plan, bindings: dict, *,
         tbl = _slice_rows(
             tbl, 0, int(np.asarray(inner.meta[root.valid_meta])))
     rows = tbl.num_rows
+    # harvest the region's group density into the learned store: the
+    # signal choose_parts() sizes future auto-parts exchanges from
+    rtfilter.observe(plan.name, f"xparts.{root.label}",
+                     sum(int(t.num_rows) for t in bindings.values()), rows)
     cap = fusion._resolve(
         root.capacity, {k: v.num_rows for k, v in bindings.items()})
     op = f"exchange.{root.label}"
@@ -437,14 +482,15 @@ def merge_flights(flights: Sequence[Table],
     return res
 
 
-def send_flight(sock, table: Table, seq: int, *,
-                op: str = "exchange.send_flight", **ctx) -> int:
-    """Ship one flight over a sealed DCN socket: TPCZ-framed serialize
-    (``dcn.serialize_table`` picks the codec), then the ONE shared
-    seal-ordering helper (``dcn.send_framed``) with corruption faults
-    scoped to the ``exchange.wire`` seam — so chaos scripts can corrupt
-    shuffle traffic specifically and the ARQ refetch recovers it
-    bit-identical. Counts raw vs wire bytes for the codec-win metric."""
+def serialize_flight(table: Table, *,
+                     op: str = "exchange.serialize_flight", **ctx) -> bytes:
+    """Serialize one flight (TPCZ codec via ``dcn.serialize_table``) and
+    account for it ONCE, at first seal: ``exchange.flights`` /
+    ``bytes_raw`` / ``bytes_wire`` count unique flight payloads, so ARQ
+    refetch resends, a direct attempt that falls back to the routed
+    rung, or any other re-send of the same pristine blob never double
+    counts the wire ledger. Per-attempt transport bytes are the lane
+    counters' job (:func:`send_flight_blob`)."""
     from spark_rapids_jni_tpu.parallel import dcn
 
     blob = dcn.serialize_table(table)
@@ -454,9 +500,42 @@ def send_flight(sock, table: Table, seq: int, *,
     telemetry.record_exchange(
         op, "flight", rows=table.num_rows, wire_bytes=len(blob),
         raw_bytes=int(_table_nbytes(table)), **ctx)
+    return blob
+
+
+def send_flight_blob(sock, blob: bytes, seq: int, *,
+                     lane: str = "direct",
+                     op: str = "exchange.send_flight", **ctx) -> int:
+    """Ship one already-serialized flight blob through the ONE shared
+    seal-ordering helper (``dcn.send_framed``) with corruption faults
+    scoped to the ``exchange.wire`` seam. ``lane`` names the topology
+    the bytes actually took — ``"direct"`` (host-to-host peer dial) or
+    ``"routed"`` (via the supervisor) — splitting the transport ledger
+    (``exchange.bytes_direct`` / ``exchange.bytes_routed``) so the
+    direct path's supervisor-link win is measurable from telemetry
+    alone; ``bytes_wire`` was already counted at first seal."""
+    from spark_rapids_jni_tpu.parallel import dcn
+
+    lane = str(lane)
+    if lane not in ("direct", "routed"):
+        raise ValueError(f"exchange flight lane must be 'direct' or "
+                         f"'routed', got {lane!r}")
+    REGISTRY.counter(f"exchange.bytes_{lane}").inc(len(blob))
     return dcn.send_framed(sock, blob, seq, op=op,
-                           corrupt_seam="exchange.wire",
-                           rows=table.num_rows, **ctx)
+                           corrupt_seam="exchange.wire", lane=lane, **ctx)
+
+
+def send_flight(sock, table: Table, seq: int, *,
+                lane: str = "direct",
+                op: str = "exchange.send_flight", **ctx) -> int:
+    """Serialize-and-ship convenience: :func:`serialize_flight` (counts
+    the wire ledger once) then :func:`send_flight_blob` (counts the
+    lane). Callers that may send the same flight on more than one lane
+    (direct attempt, routed fallback) call the two halves themselves so
+    ``bytes_wire`` stays a unique-payload ledger."""
+    blob = serialize_flight(table, op=op, **ctx)
+    return send_flight_blob(sock, blob, seq, lane=lane, op=op,
+                            rows=table.num_rows, **ctx)
 
 
 def recv_flight(sock, seq: int, *, op: str = "exchange.recv_flight") -> Table:
@@ -535,6 +614,8 @@ def stats() -> dict:
         "flights": counters.get("exchange.flights", 0),
         "bytes_raw": counters.get("exchange.bytes_raw", 0),
         "bytes_wire": counters.get("exchange.bytes_wire", 0),
+        "bytes_direct": counters.get("exchange.bytes_direct", 0),
+        "bytes_routed": counters.get("exchange.bytes_routed", 0),
         "overflow_escalations":
             counters.get("exchange.overflow_escalations", 0),
         "chunked_flights": counters.get("exchange.chunked_flights", 0),

@@ -122,6 +122,7 @@ class _FrameChannel:
         blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
         if integrity.enabled():
             blob = integrity.seal(blob)
+        REGISTRY.counter("fleet.link_bytes").inc(8 + len(blob))
         with self._send_lock:
             self._sock.sendall(struct.pack("<Q", len(blob)) + blob)
 
@@ -138,6 +139,7 @@ class _FrameChannel:
             (length,) = struct.unpack("<Q", hdr)
             # same deliberate frame read  # tpulint: disable=blocking-call-under-lock
             framed = self._recv_exact(length)
+        REGISTRY.counter("fleet.link_bytes").inc(8 + length)
         if integrity.enabled():
             framed = integrity.verify(framed, seam="integrity.wire",
                                       op="fleet.recv")
@@ -1199,12 +1201,20 @@ def _worker_main(fd: int, replica: str) -> int:
     return _worker_loop(_FrameChannel(sock), replica)
 
 
-def _worker_loop(chan: _FrameChannel, replica: str) -> int:
+def _worker_loop(chan: _FrameChannel, replica: str,
+                 extensions=None) -> int:
     """The worker control loop behind any connected frame channel (a
     socketpair fd for the local fleet, a dialed-back TCP socket for the
     mesh's remote hosts). The main thread stays in the control loop
     (pings answered inline, so liveness tracks control-plane
-    responsiveness); each submit serves on its own thread."""
+    responsiveness); each submit serves on its own thread.
+
+    ``extensions`` maps extra frame types to handlers
+    ``fn(chan, srv, msg, replica)``; each runs on its own daemon thread
+    (extension frames — e.g. the cluster's direct-exchange pack/merge —
+    block on compute and peer flights, and must not stall the ping
+    loop). Unknown frame types without a handler are dropped, as
+    before."""
     from spark_rapids_jni_tpu.runtime.server import QueryServer
 
     srv = QueryServer()
@@ -1249,6 +1259,10 @@ def _worker_loop(chan: _FrameChannel, replica: str) -> int:
                 srv.close()
                 chan.send({"t": "bye"})
                 return 0
+            elif extensions is not None and t in extensions:
+                threading.Thread(
+                    target=extensions[t], args=(chan, srv, msg, replica),
+                    daemon=True, name=f"fleet-ext-{t}").start()
     finally:
         srv.close()  # idempotent: a no-op after the shutdown path ran
 

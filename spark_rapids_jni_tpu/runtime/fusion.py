@@ -297,21 +297,32 @@ class Limit(NamedTuple):
 
 class Exchange(NamedTuple):
     """General-cardinality hash repartition of the child's output — the
-    distributed-exchange boundary (runtime/exchange.py). Only valid as a
-    plan ROOT: a shuffle is a genuine host boundary (see the region
-    discipline note), so the child region fuses and executes normally
-    and the exchange pack runs as its own dispatch op on the result.
+    distributed-exchange boundary (runtime/exchange.py). A shuffle is a
+    genuine host boundary, so an Exchange never evaluates INSIDE a
+    fused/staged region; the planner instead breaks the plan at it. As
+    a plan ROOT, the child region fuses and executes normally and the
+    exchange pack runs as its own dispatch op on the result (the wire
+    form the cluster ships). Placed MID-PLAN, ``execute`` splits the
+    DAG at the (deepest-first) interior Exchange into region ->
+    exchange -> region: the pack half runs as an Exchange root, the
+    remainder re-runs per destination with the Exchange swapped for a
+    Scan bound to that destination's regrouped rows, and the
+    part-ordered concatenation is the plan's result — bit-identical to
+    the hand-split (pack plan, merge plan) pair it replaces.
 
     ``keys`` are column indices hashed with the Spark-compatible
     ``partition_hash``; ``parts`` is the destination count (cluster
-    hosts). ``capacity`` is the per-destination slot count (an int, a
-    ``rows_of`` spec, or None for the escalation ladder's derived
-    start). ``valid_meta`` optionally names a child meta key holding the
-    TRUE row count of the child's padded output (e.g. a partial
-    groupby's ``partial.num_groups``) so budget-padding phantom rows
-    never ride the wire. Meta: ``<label>.parts`` / ``<label>.capacity``
-    / ``<label>.flights`` / ``<label>.row_counts`` / ``<label>.rows``
-    (plain Python — they survive the fleet's result frames)."""
+    hosts), or 0 for "auto" — resolved at execute time from the
+    learned-selectivity store (``exchange.choose_parts``; fingerprints
+    only ever see the resolved count). ``capacity`` is the
+    per-destination slot count (an int, a ``rows_of`` spec, or None for
+    the escalation ladder's derived start). ``valid_meta`` optionally
+    names a child meta key holding the TRUE row count of the child's
+    padded output (e.g. a partial groupby's ``partial.num_groups``) so
+    budget-padding phantom rows never ride the wire. Meta:
+    ``<label>.parts`` / ``<label>.capacity`` / ``<label>.flights`` /
+    ``<label>.row_counts`` / ``<label>.rows`` (plain Python — they
+    survive the fleet's result frames)."""
 
     child: Any
     keys: tuple
@@ -854,6 +865,118 @@ def _harvest_rtfilter(plan: Plan, nodes, meta: dict) -> None:
 # ---------------------------------------------------------------------------
 
 
+def split_at_exchange(plan: Plan):
+    """Break a plan at its deepest INTERIOR ``Exchange`` node — the
+    planner-placed exchange: regions already break at genuine host
+    boundaries, and a mid-plan shuffle is one. Returns ``None`` when the
+    plan has no interior Exchange (a root Exchange is the classic pack
+    plan, handled by ``execute`` directly); otherwise
+    ``(pack_plan, merge_plan, binding, exchange_node)`` where the pack
+    plan roots the Exchange subtree and the merge plan is the remainder
+    with the Exchange swapped for a ``Scan(binding)`` — exactly the
+    hand-split plan pair shape ``QueryCluster.submit_exchange`` has
+    always driven, derived instead of hand-written. Multi-exchange
+    plans split one boundary at a time (deepest first); the remainder's
+    own interior exchanges split recursively at execute time."""
+    nodes = _topo(plan.root)
+    xs = [n for n in nodes
+          if isinstance(n, Exchange) and n is not plan.root]
+    if not xs:
+        return None
+    x = xs[0]  # _topo is children-first: the deepest boundary splits first
+    binding = f"__exchange__{x.label}"
+    pack = Plan(f"{plan.name}.pack_{x.label}", x)
+    merge = Plan(f"{plan.name}.merge_{x.label}",
+                 replace_node(plan.root, x, Scan(binding)))
+    return pack, merge, binding, x
+
+
+def _trim_region_result(res: FusedResult, root) -> Table:
+    """True-row slice of one per-destination merge-region result: an
+    unbounded groupby root pads to its input row count, and only its
+    ``<label>.num_groups`` rows are real."""
+    from spark_rapids_jni_tpu.ops.table_ops import _slice_rows
+
+    if isinstance(root, GroupBy) and root.max_groups is None:
+        # region boundary: ``res`` is an already-executed region's
+        # output, so reading its meta here cannot split a trace
+        n = int(np.asarray(  # tpulint: disable=fusion-region-host-sync
+            res.meta[f"{root.label}.num_groups"]))
+        return _slice_rows(res.table, 0, n)
+    return res.table
+
+
+def _execute_midplan_exchange(plan: Plan, bindings: dict, *,
+                              donate_inputs: bool,
+                              force_staged: bool,
+                              surface_pressure: bool,
+                              cancel_token) -> FusedResult:
+    """Execute a plan with an interior Exchange as region -> exchange ->
+    region: run the pack half (an Exchange-rooted plan — the overflow
+    ladder, valid_meta trim and wire form all apply unchanged), regroup
+    the wire table per destination, run the remainder once per non-empty
+    destination with the exchange output bound as its scan, and
+    concatenate part-ordered. Destination key spaces are disjoint by
+    construction, so the concatenation IS the plan's result —
+    bit-identical to the hand-split (pack, merge) plan pair and to the
+    ``exchange_local`` oracle over the same child output."""
+    from spark_rapids_jni_tpu.ops.table_ops import _slice_rows, concatenate
+    from spark_rapids_jni_tpu.runtime import exchange as _exchange
+
+    pack_plan, merge_plan, binding, x = split_at_exchange(plan)
+    pb, pe = _scan_names(_topo(x))
+    pack_bindings = {n: bindings[n] for n in pb + pe if n in bindings}
+    x = _exchange.resolve_auto_parts(pack_plan.name, x, pack_bindings)
+    pack_plan = Plan(pack_plan.name, x)
+    mb, me = _scan_names(_topo(merge_plan.root))
+    merge_scans = (set(mb) | set(me)) - {binding}
+    # the pack may only donate bindings the remainder never rereads
+    donate_pack = (bool(donate_inputs)
+                   and not (merge_scans & set(pack_bindings)))
+    REGISTRY.counter("fusion.midplan_exchanges").inc()
+    label, parts = x.label, int(x.parts)
+    with spans.child(f"midplan.{plan.name}", label=label, parts=parts):
+        fused = execute(pack_plan, pack_bindings,
+                        donate_inputs=donate_pack,
+                        force_staged=force_staged,
+                        surface_pressure=surface_pressure,
+                        cancel_token=cancel_token)
+        rc = fused.meta[f"{label}.row_counts"]
+        per_dest = _exchange.split_wire(fused.table, rc, parts)
+        empty = _slice_rows(fused.table, 0, 0)
+        merge_base = {n: bindings[n] for n in merge_scans
+                      if n in bindings}
+        outs: list = []
+        for flights in per_dest:
+            if not flights:
+                continue
+            dest_in = (flights[0] if len(flights) == 1
+                       else concatenate(flights))
+            res = execute(merge_plan, {**merge_base, binding: dest_in},
+                          force_staged=force_staged,
+                          surface_pressure=surface_pressure,
+                          cancel_token=cancel_token)
+            outs.append(_trim_region_result(res, merge_plan.root))
+        if outs:
+            tbl = outs[0] if len(outs) == 1 else concatenate(outs)
+        else:
+            res = execute(merge_plan, {**merge_base, binding: empty},
+                          force_staged=force_staged,
+                          surface_pressure=surface_pressure,
+                          cancel_token=cancel_token)
+            tbl = _slice_rows(res.table, 0, 0)
+    meta = {
+        f"{label}.parts": parts,
+        f"{label}.rows": int(fused.meta[f"{label}.rows"]),
+        f"{label}.dests": len(outs),
+    }
+    root = merge_plan.root
+    if isinstance(root, GroupBy) and root.max_groups is None:
+        # the concatenation is already trimmed: every row is real
+        meta[f"{root.label}.num_groups"] = int(tbl.num_rows)
+    return FusedResult(tbl, meta)
+
+
 def execute(plan: Plan, bindings: dict, *,
             donate_inputs: bool = False,
             force_staged: bool = False,
@@ -897,6 +1020,15 @@ def execute(plan: Plan, bindings: dict, *,
         # packs per-destination flights on the host side of the seam
         from spark_rapids_jni_tpu.runtime import exchange as _exchange
         return _exchange.execute_exchange_root(
+            plan, bindings,
+            donate_inputs=donate_inputs,
+            force_staged=force_staged,
+            surface_pressure=surface_pressure,
+            cancel_token=cancel_token)
+    if split_at_exchange(plan) is not None:
+        # planner-placed mid-plan exchange: break the region at the
+        # interior Exchange and run region -> exchange -> region
+        return _execute_midplan_exchange(
             plan, bindings,
             donate_inputs=donate_inputs,
             force_staged=force_staged,

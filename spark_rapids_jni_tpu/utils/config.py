@@ -291,6 +291,27 @@ _OPTIONS: dict[str, tuple[Any, type]] = {
     # flights (MemoryLimiter budget handed to run_chunked_aggregate);
     # partial results beyond it LRU-spill to compressed host memory.
     "exchange.merge_budget_bytes": (64 << 20, int),
+    # Direct host-to-host exchange flights: when on, the cluster ships
+    # only the routing manifest and sources dial destination peers
+    # directly (sealed TPCZ flights, HMAC-signed grants); the
+    # router-mediated path stays as the classified fallback rung. Off
+    # forces every flight through the supervisor (the PR-19 topology).
+    "exchange.direct_enabled": (True, bool),
+    # Bounded connect retry for one peer dial (a dead peer must fail
+    # fast into the routed fallback, not hang the exchange): attempts x
+    # delay ~= the dial budget before TransportError surfaces.
+    "exchange.peer_dial_retries": (8, int),
+    "exchange.peer_dial_delay_s": (0.05, float),
+    # How long a destination waits for all manifest-listed peer flights
+    # before the merge fails classified (and the supervisor falls back
+    # to the routed path).
+    "exchange.direct_timeout_s": (30.0, float),
+    # Planner-placed exchanges: when an interior Exchange node carries
+    # parts=0 ("auto"), the partition count comes from the learned-
+    # selectivity store (rows in x learned pass fraction / target rows
+    # per partition, clamped to max_parts); no history falls back to 1.
+    "exchange.target_rows_per_part": (4096, int),
+    "exchange.max_parts": (64, int),
     # Runtime bloom-join filters (runtime/rtfilter.py): master switch for
     # the planner pass that builds a bloom filter from a selective join's
     # build side and prunes the probe side before it stages. Off by

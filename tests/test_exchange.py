@@ -1,4 +1,4 @@
-"""General-cardinality distributed exchange (runtime/exchange, ISSUE 19).
+"""General-cardinality distributed exchange (runtime/exchange, ISSUES 19+20).
 
 Invariant families over the hash-partitioned all-to-all:
 
@@ -28,6 +28,17 @@ Invariant families over the hash-partitioned all-to-all:
    SIGKILLed mid-exchange (failover re-packs on the survivor) and with
    skewed keys under a tight merge budget (router-side spill-aware
    merge) — zero leaked bytes in every case.
+
+5. **Direct flights + planner placement (ISSUE 20)** — a plan with an
+   INTERIOR ``Exchange`` executes as region → exchange → region
+   byte-for-byte the hand-split pair (bucket edges, null tails, padded
+   strings; ``parts=0`` sized from the learned-selectivity store); the
+   direct host-to-host rung is HMAC-grant-gated, moves strictly fewer
+   supervisor-link bytes than routed, and degrades rung-by-rung
+   (unreachable peer → per-flight reroute; no gateway / SIGKILL
+   mid-flight → whole-exchange routed fallback) — always bit-identical,
+   always zero leaked reservations, ``bytes_wire`` counted once per
+   sealed flight with the ``bytes_direct``/``bytes_routed`` lane split.
 
 Host boots cost ~1-2 s each, so every cluster test keeps its mesh at
 two hosts (same discipline as test_cluster.py), the non-chaos tests
@@ -217,11 +228,112 @@ def test_exchange_plan_root_carries_wire_meta_and_split_inverts():
         xch.split_wire(fused.table, [c + 1 for c in rc[:1]] + rc[1:], 3)
 
 
-def test_exchange_is_a_plan_root_only():
-    node = fusion.Exchange(fusion.Scan("rows"), keys=(0,), parts=2)
-    plan = fusion.Plan("bad", fusion.GroupBy(node, (0,), ((1, "sum"),)))
-    with pytest.raises(TypeError, match="host boundary"):
-        fusion.execute(plan, {"rows": _mixed_table(8)})
+def _midplan(name, parts, label="ex"):
+    """ONE plan with a planner-placed interior Exchange: partial
+    groupby -> exchange by key -> sum merge (the q13 shape)."""
+    return fusion.Plan(name, fusion.GroupBy(
+        fusion.Exchange(
+            fusion.GroupBy(fusion.Scan("rows"), (0,), ((1, "sum"),),
+                           max_groups=None, label="partial"),
+            keys=(0,), parts=parts, valid_meta="partial.num_groups",
+            label=label),
+        (0,), ((1, "sum"),), max_groups=None, label="merge"))
+
+
+def _slice(tbl, n):
+    from spark_rapids_jni_tpu.ops.table_ops import _slice_rows
+
+    return _slice_rows(tbl, 0, n)
+
+
+@pytest.mark.parametrize("rows", [1, 255, 256, 257])
+def test_midplan_exchange_bit_identical_to_hand_split_pair(rows):
+    """An interior Exchange executes as region -> exchange -> region and
+    is byte-for-byte the hand-split (pack, merge) plan pair it
+    replaces — at every dispatch bucket seam, with null tails and
+    padded strings riding along."""
+    tbl = _mixed_table(rows)
+    parts = 3
+    got = fusion.execute(_midplan("edge_mid", parts), {"rows": tbl})
+    assert got.meta["ex.parts"] == parts
+    assert REGISTRY.counter("fusion.midplan_exchanges").value == 1
+    # the hand-split pair over the same input
+    pack = fusion.Plan("edge_pack", fusion.Exchange(
+        fusion.GroupBy(fusion.Scan("rows"), (0,), ((1, "sum"),),
+                       max_groups=None, label="partial"),
+        keys=(0,), parts=parts, valid_meta="partial.num_groups",
+        label="ex"))
+    merge = fusion.Plan("edge_merge", fusion.GroupBy(
+        fusion.Scan("partials"), (0,), ((1, "sum"),),
+        max_groups=None, label="merge"))
+    fused = fusion.execute(pack, {"rows": tbl})
+    outs = []
+    for fls in xch.split_wire(fused.table, fused.meta["ex.row_counts"],
+                              parts):
+        if not fls:
+            continue
+        dest_in = fls[0] if len(fls) == 1 else concatenate(fls)
+        r = fusion.execute(merge, {"partials": dest_in})
+        outs.append(_slice(r.table,
+                           int(np.asarray(r.meta["merge.num_groups"]))))
+    hand = outs[0] if len(outs) == 1 else concatenate(outs)
+    assert _fp(got.table) == _fp(hand)
+    assert got.meta["merge.num_groups"] == hand.num_rows
+    # value-level: same groups and sums as the naive global groupby
+    ref = groupby_aggregate(tbl, [0], [(1, "sum")], max_groups=None)
+    want = trim_table(ref.table, int(np.asarray(ref.num_groups)))
+    assert _rows(got.table) == _rows(want)
+
+
+@pytest.mark.parametrize("rows", [1, 255, 256, 257])
+def test_midplan_exchange_bit_identical_to_exchange_local(rows):
+    """A raw-row interior Exchange (the pack child is a Scan) merges to
+    exactly what the ``exchange_local`` oracle delivers per
+    destination."""
+    tbl = _mixed_table(rows)
+    parts = 3
+    mid = fusion.Plan("edge_raw_mid", fusion.GroupBy(
+        fusion.Exchange(fusion.Scan("rows"), keys=(0,), parts=parts,
+                        label="ex"),
+        (0,), ((1, "sum"),), max_groups=None, label="merge"))
+    got = fusion.execute(mid, {"rows": tbl})
+    merge = fusion.Plan("edge_raw_merge", fusion.GroupBy(
+        fusion.Scan("partials"), (0,), ((1, "sum"),),
+        max_groups=None, label="merge"))
+    outs = []
+    for d in xch.exchange_local(tbl, [0], parts):
+        if not d.num_rows:
+            continue
+        r = fusion.execute(merge, {"partials": d})
+        outs.append(_slice(r.table,
+                           int(np.asarray(r.meta["merge.num_groups"]))))
+    want = outs[0] if len(outs) == 1 else concatenate(outs)
+    assert _fp(got.table) == _fp(want)
+
+
+def test_midplan_exchange_auto_parts_from_learned_density():
+    """``parts=0`` defers the fan-out width to the learned-selectivity
+    store: no history falls back to 1 part; after one run the observed
+    group density sizes the next fan-out."""
+    from spark_rapids_jni_tpu.runtime import rtfilter
+
+    rtfilter.reset()
+    set_option("exchange.target_rows_per_part", 64)
+    try:
+        tbl = _mixed_table(600, nkeys=300)
+        r1 = fusion.execute(_midplan("auto_mid", 0), {"rows": tbl})
+        assert r1.meta["ex.parts"] == 1  # no history: fallback
+        r2 = fusion.execute(_midplan("auto_mid", 0), {"rows": tbl})
+        assert r2.meta["ex.parts"] > 1  # learned density sized it
+        assert _rows(r2.table) == _rows(r1.table)
+        decisions = [r for r in ring_events()
+                     if r.get("event") == "parts_decision"]
+        assert any(d.get("reason") == "no_history" for d in decisions)
+        assert any(d.get("reason") == "learned_density"
+                   for d in decisions)
+    finally:
+        reset_option("exchange.target_rows_per_part")
+        rtfilter.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -404,6 +516,48 @@ def test_exchange_wire_exhaustion_dies_classified():
     assert REGISTRY.counter("integrity.refetch").value == 2
 
 
+def test_bytes_wire_ledger_counts_each_flight_once():
+    """``exchange.bytes_wire`` is a unique-payload ledger, counted at
+    first seal: an ARQ refetch re-sends the same sealed blob without
+    re-counting it, and a routed re-send of the SAME payload moves only
+    the lane counters (``bytes_direct`` / ``bytes_routed`` split)."""
+    from spark_rapids_jni_tpu.parallel import dcn
+
+    tbl = _skewed_table(300)
+    script = faults.FaultScript(corruptions=[
+        faults.CorruptionSpec("exchange.wire", mode="flip", seed=23)])
+    got, err = _flight_roundtrip(tbl, script)  # direct lane + 1 refetch
+    assert not err
+    assert _fp(got) == _fp(tbl)
+    assert REGISTRY.counter("integrity.refetch").value == 1
+    wire = REGISTRY.counter("exchange.bytes_wire").value
+    assert REGISTRY.counter("exchange.flights").value == 1
+    assert REGISTRY.counter("exchange.bytes_direct").value == wire
+    assert REGISTRY.counter("exchange.bytes_routed").value == 0
+    # routed fallback rung: the same pristine blob rides the other lane
+    blob = dcn.serialize_table(tbl)  # codec only — not a new seal
+    a, b = socket.socketpair()
+    a.settimeout(60)
+    b.settimeout(60)
+    out = {}
+    th = threading.Thread(
+        target=lambda: out.update(tbl=xch.recv_flight(b, 9)))
+    try:
+        th.start()
+        xch.send_flight_blob(a, blob, 9, lane="routed")
+        th.join(60)
+        assert not th.is_alive()
+    finally:
+        a.close()
+        b.close()
+    assert _fp(out["tbl"]) == _fp(tbl)
+    assert REGISTRY.counter("exchange.bytes_wire").value == wire
+    assert REGISTRY.counter("exchange.flights").value == 1
+    assert REGISTRY.counter("exchange.bytes_routed").value == len(blob)
+    with pytest.raises(ValueError, match="lane"):
+        xch.send_flight_blob(None, b"", 0, lane="sideways")
+
+
 # ---------------------------------------------------------------------------
 # 4. cluster: distributed exchange == single-host oracle (+ chaos)
 # ---------------------------------------------------------------------------
@@ -442,6 +596,11 @@ def test_distributed_q13_exchange_bit_identical_to_oracle(mesh):
     assert xt.fingerprint == ref_fp
     assert REGISTRY.counter("cluster.exchanges").value == 1
     assert REGISTRY.counter("cluster.exchange_merges").value == 1
+    # direct is the default rung: the flight payloads went host-to-host
+    assert REGISTRY.counter("cluster.exchanges_direct").value == 1
+    assert REGISTRY.counter("cluster.exchange_direct_fallbacks").value == 0
+    assert REGISTRY.counter("exchange.bytes_direct").value > 0
+    assert REGISTRY.counter("exchange.bytes_routed").value == 0
     # a repeated exchange must come back bit-identical (memo-checked)
     xt2 = c.submit_exchange(
         "s1", pack, merge, table="orders", binding="orders",
@@ -463,7 +622,8 @@ def test_sigkill_host_mid_exchange_fails_over_bit_identical():
         assert info["owners"][0] == "h0"
         xt = c.submit_exchange(
             "s0", pack, merge, table="orders", binding="orders",
-            merge_binding="partials", merge_valid_meta="merge.num_groups")
+            merge_binding="partials", merge_valid_meta="merge.num_groups",
+            direct=False)  # pin the routed rung: this test is its chaos
         t0 = xt.tickets[0]
         deadline = time.monotonic() + 15
         while time.monotonic() < deadline and t0.replica != "h0":
@@ -509,7 +669,8 @@ def test_skewed_exchange_under_tight_budget_takes_spill_merge(mesh):
     xt = c.submit_exchange(
         "s2", pack, merge, table="rows", binding="rows",
         merge_binding="partials", merge_valid_meta="merge.num_groups",
-        merge_budget_bytes=budget)
+        merge_budget_bytes=budget,
+        direct=False)  # the ROUTER-side spill merge is under test here
     res = xt.result(timeout=120)
     assert _rows(res) == _rows(oracle)
     assert REGISTRY.counter("cluster.exchange_spill_merges").value >= 1
@@ -519,3 +680,202 @@ def test_skewed_exchange_under_tight_budget_takes_spill_merge(mesh):
     assert spills
     time.sleep(0.3)
     assert c.leaked_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# 5. direct host-to-host flights: grants, manifests, fallback ladder
+# ---------------------------------------------------------------------------
+
+
+def test_peer_flight_server_rejects_unsigned_dials():
+    """The peer gateway refuses a dial whose grant was not HMAC-signed
+    by THIS boot's supervisor — before a single flight byte is read —
+    and a grant for one (xid, src, dest, part) does not authorize any
+    other. The properly signed dial lands in the mailbox."""
+    from spark_rapids_jni_tpu.parallel import dcn
+
+    key = dcn.grant_key("boot-secret")
+    srv = dcn.PeerFlightServer(key, dest="h1")
+    try:
+        tbl = _mixed_table(64)
+        blob = xch.serialize_flight(tbl, op="test.peer")
+        fp = dcn.flight_fingerprint(blob)
+
+        def _dial(grant):
+            dcn.send_peer_flight(
+                (srv.host, srv.port),
+                {"xid": "x1", "src": "p0", "part": 0, "grant": grant,
+                 "fp": fp}, blob, retries=2, delay_s=0.01)
+
+        # forged grant (wrong boot secret): refused, counted, recorded
+        forged = dcn.sign_grant(dcn.grant_key("wrong-secret"),
+                                xid="x1", src="p0", dest="h1", part=0)
+        with pytest.raises((resilience.ResilienceError, OSError)):
+            _dial(forged)
+        assert REGISTRY.counter("cluster.rejected_dials").value == 1
+        rej = [r for r in ring_events()
+               if r.get("op") == "cluster.peer_gateway"
+               and r.get("event") == "rejected_dial"]
+        assert rej and rej[0]["xid"] == "x1"
+        # a real grant for a DIFFERENT destination part: also refused
+        wrong = dcn.sign_grant(key, xid="x1", src="p0", dest="h1",
+                               part=5)
+        with pytest.raises((resilience.ResilienceError, OSError)):
+            _dial(wrong)
+        assert REGISTRY.counter("cluster.rejected_dials").value == 2
+        assert srv._mail == {}  # nothing was accepted
+        # the supervisor-signed grant delivers
+        good = dcn.sign_grant(key, xid="x1", src="p0", dest="h1", part=0)
+        _dial(good)
+        flights = srv.wait_flights("x1", 0, ["p0"], timeout=30)
+        assert dcn.flight_fingerprint(flights["p0"]) == fp
+        assert REGISTRY.counter("exchange.peer_flights_recv").value == 1
+        srv.discard("x1")
+        assert srv._mail == {}
+    finally:
+        srv.close()
+
+
+def test_direct_exchange_beats_routed_on_supervisor_link_bytes(mesh):
+    """The heart of the PR: a warmed direct exchange moves strictly
+    fewer bytes over the supervisor link than the same exchange routed
+    — the flight payloads go host-to-host and the supervisor sees only
+    manifests and acks. Both modes are bit-identical to each other."""
+    orders = _orders(seed=7)
+    ref_fp = _fp(tpch.tpch_q13_local(orders, 2))
+    pack, merge = tpch.q13_exchange_plans(2)
+    c = mesh
+    c.register_table("xorders", orders, keys=(tpch.O_ORDERKEY,))
+    set_option("fleet.result_memo_entries", 0)
+    try:
+        def run(sid, direct):
+            xt = c.submit_exchange(
+                sid, pack, merge, table="xorders", binding="orders",
+                merge_binding="partials",
+                merge_valid_meta="merge.num_groups", direct=direct)
+            return _fp(xt.result(timeout=120))
+
+        # warm both modes first: first-run compiles stretch the rounds
+        # and the ping/pong chatter under them would swamp the
+        # steady-state link measurement
+        assert run("w0", True) == ref_fp
+        assert run("w1", False) == ref_fp
+        link = REGISTRY.counter("fleet.link_bytes")
+        base = link.value
+        assert run("m0", True) == ref_fp
+        direct_bytes = link.value - base
+        base = link.value
+        assert run("m1", False) == ref_fp
+        routed_bytes = link.value - base
+        assert direct_bytes < routed_bytes, (direct_bytes, routed_bytes)
+        assert REGISTRY.counter("exchange.bytes_direct").value > 0
+    finally:
+        reset_option("fleet.result_memo_entries")
+
+
+def test_midplan_single_plan_form_over_the_mesh(mesh):
+    """Planner-placed form end-to-end: ONE q13 plan with an interior
+    Exchange submits without a hand-split pair — the supervisor splits
+    it, resolves ``parts=0`` to the mesh width, and the result is
+    byte-for-byte the single-host oracle."""
+    c = mesh
+    orders = _orders(seed=13)
+    ref_fp = _fp(tpch.tpch_q13_local(orders, 2))
+    c.register_table("morders", orders, keys=(tpch.O_ORDERKEY,))
+    xt = c.submit_exchange("m2", tpch.q13_midplan_plan(0),
+                           table="morders", binding="orders")
+    assert _fp(xt.result(timeout=120)) == ref_fp
+    time.sleep(0.3)
+    assert c.leaked_bytes() == 0
+
+
+def test_peer_dial_failure_falls_back_rung_by_rung(mesh):
+    """The classified fallback ladder, bit-identical at every rung.
+    Rung 1: peers unreachable — each cross-host flight re-routes via
+    the supervisor INSIDE the direct protocol (the manifest marks it
+    routed). Rung 2: no peer gateway at all — the direct attempt
+    classifies and the WHOLE exchange drops to the routed path."""
+    c = mesh
+    orders = _orders(seed=11)
+    ref_fp = _fp(tpch.tpch_q13_local(orders, 2))
+    pack, merge = tpch.q13_exchange_plans(2)
+    c.register_table("forders", orders, keys=(tpch.O_ORDERKEY,))
+    saved = dict(c._peer_addrs)
+    assert len(saved) == 2
+
+    def run(sid):
+        xt = c.submit_exchange(
+            sid, pack, merge, table="forders", binding="orders",
+            merge_binding="partials", merge_valid_meta="merge.num_groups")
+        return _fp(xt.result(timeout=120))
+
+    try:
+        # rung 1: nothing listens at the peer addresses
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        port = dead.getsockname()[1]
+        dead.close()
+        c._peer_addrs.clear()
+        c._peer_addrs.update({k: ("127.0.0.1", port) for k in saved})
+        assert run("f0") == ref_fp
+        assert REGISTRY.counter("exchange.bytes_routed").value > 0
+        assert REGISTRY.counter("exchange.bytes_direct").value > 0
+        assert (REGISTRY.counter("cluster.exchange_direct_fallbacks")
+                .value) == 0  # the direct protocol itself completed
+        # rung 2: no peer gateways known at all
+        c._peer_addrs.clear()
+        assert run("f1") == ref_fp
+        assert (REGISTRY.counter("cluster.exchange_direct_fallbacks")
+                .value) == 1
+        fb = [r for r in ring_events()
+              if r.get("event") == "direct_fallback"]
+        assert fb
+        time.sleep(0.3)
+        assert c.leaked_bytes() == 0
+    finally:
+        c._peer_addrs.clear()
+        c._peer_addrs.update(saved)
+
+
+def test_sigkill_host_mid_direct_flight_falls_back_bit_identical():
+    """Chaos on the direct rung: h0 is SIGKILLed while holding a direct
+    pack inside its serve-delay window. The supervisor's collect fails
+    classified, the exchange drops to the routed rung on the survivor,
+    and the result is byte-for-byte the oracle — zero leaked
+    reservations."""
+    orders = _orders()
+    ref_fp = _fp(tpch.tpch_q13_local(orders, 2))
+    pack, merge = tpch.q13_exchange_plans(2)
+    with cluster.QueryCluster(2, per_replica_env={
+            "h0": {SERVE_DELAY: "1500"}}) as c:
+        assert c.wait_live(timeout=120) == 2
+        info = c.register_table("orders", orders, keys=(tpch.O_ORDERKEY,))
+        assert info["owners"][0] == "h0"
+        out = {}
+        done = threading.Event()
+
+        def _run():
+            try:
+                xt = c.submit_exchange(
+                    "s0", pack, merge, table="orders", binding="orders",
+                    merge_binding="partials",
+                    merge_valid_meta="merge.num_groups")
+                out["fp"] = _fp(xt.result(timeout=120))
+            except BaseException as exc:  # noqa: BLE001 - surfaced below
+                out["err"] = exc
+            finally:
+                done.set()
+
+        th = threading.Thread(target=_run)
+        th.start()
+        time.sleep(0.5)  # inside h0's xpack hold: the flight is pending
+        c._host("h0").proc.send_signal(signal.SIGKILL)
+        assert done.wait(120)
+        th.join(10)
+        assert out.get("err") is None, repr(out.get("err"))
+        assert out["fp"] == ref_fp
+        assert (REGISTRY.counter("cluster.exchange_direct_fallbacks")
+                .value) >= 1
+        assert REGISTRY.counter("cluster.host_deaths").value == 1
+        time.sleep(0.3)
+        assert c.leaked_bytes() == 0

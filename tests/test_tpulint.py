@@ -628,8 +628,50 @@ def test_rule_exchange_overflow_classified_shipping_code_complies():
                             "exchange-overflow-must-classify"), rel
 
 
+def test_rule_peer_flight_verifies_manifest_seeded():
+    got = _by_rule(_lint_file(FIXTURES / "seeded_peer_flight.py"),
+                   "peer-flight-must-verify-manifest")
+    texts = [f.source_line for f in got]
+    assert len(got) == 4, texts
+    assert any("wait_flights" in t for t in texts)
+    assert any("recv_peer_flight" in t for t in texts)
+    assert sum("recv_framed" in t for t in texts) == 2
+    # verified / grant-gated / raising / pragma'd / framed-layer /
+    # supervisor-link twins past the clean_ marker all stay clean
+    src = (FIXTURES / "seeded_peer_flight.py").read_text()
+    clean_at = src[:src.index("def clean_merge_verified")].count(
+        "\n") + 1
+    assert all(f.line < clean_at for f in got), [f.line for f in got]
+
+
+def test_rule_peer_flight_verifies_manifest_scope(tmp_path):
+    # the same receive sites outside an exchange/cluster/dcn/shuffle/
+    # flight-named file are out of scope; dcn-named files are in
+    src = (FIXTURES / "seeded_peer_flight.py").read_text()
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    plain = rt / "mailbox_like.py"
+    plain.write_text(src)
+    assert not _by_rule(_lint_file(plain),
+                        "peer-flight-must-verify-manifest")
+    dcnish = rt / "dcn_like.py"
+    dcnish.write_text(src)
+    assert _by_rule(_lint_file(dcnish), "peer-flight-must-verify-manifest")
+
+
+def test_rule_peer_flight_verifies_manifest_shipping_code_complies():
+    # the real direct-flight paths must hold their own rule: every peer
+    # receive site in runtime/cluster.py, runtime/exchange.py and
+    # parallel/dcn.py verifies the manifest/grant before decode
+    for rel in (("runtime", "cluster.py"), ("runtime", "exchange.py"),
+                ("parallel", "dcn.py")):
+        path = REPO / "spark_rapids_jni_tpu" / rel[0] / rel[1]
+        assert not _by_rule(_lint_file(path),
+                            "peer-flight-must-verify-manifest"), rel
+
+
 def test_every_rule_has_a_seeded_fixture():
-    """The acceptance invariant: all twenty-two per-file rules
+    """The acceptance invariant: all twenty-three per-file rules
     demonstrably fire (the three whole-program rules have their own
     coverage test below)."""
     seen = set()
@@ -674,6 +716,8 @@ def test_every_rule_has_a_seeded_fixture():
     for f in _lint_file(FIXTURES / "seeded_rtfilter_decision.py"):
         seen.add(f.rule)
     for f in _lint_file(FIXTURES / "seeded_exchange_overflow.py"):
+        seen.add(f.rule)
+    for f in _lint_file(FIXTURES / "seeded_peer_flight.py"):
         seen.add(f.rule)
     ops = Path(__file__).parent / "tpulint_fixtures"  # dtype needs ops/
     import tempfile

@@ -1,0 +1,71 @@
+"""Seeded violations for rule 26 (peer-flight-must-verify-manifest).
+
+The basename contains ``flight`` so the file is in scope the same way
+runtime/exchange.py, runtime/cluster.py and parallel/dcn.py are; the
+violations are receive-side peer-flight functions that decode before
+(or instead of) verifying. Violations first, then clean twins past the
+``def clean_`` marker the per-rule test splits on.
+"""
+
+
+def merge_unverified(peer, xid, part, srcs, decode):
+    flights = peer.wait_flights(xid, part, srcs)  # VIOLATION: straight
+    return [decode(b) for b in flights.values()]  # to the codec
+
+
+def collect_one_unverified(gateway, xid, decode):
+    blob = gateway.recv_peer_flight(xid)  # VIOLATION: no manifest check
+    return decode(blob)
+
+
+def serve_peer_blind(conn, recv_framed, mailbox):
+    hdr = recv_framed(conn, 0)  # VIOLATION x2: a peer-path recv_framed
+    blob = recv_framed(conn, 1)  # with the grant never checked
+    mailbox[hdr["src"]] = blob
+    return hdr
+
+
+def clean_merge_verified(peer, xid, part, manifest, decode,
+                         flight_fingerprint, CorruptDataError):
+    flights = peer.wait_flights(xid, part, [s for s, _ in manifest])
+    out = []
+    for src, want_fp in manifest:  # clean: verify-then-decode
+        blob = flights[src]
+        if flight_fingerprint(blob) != want_fp:
+            raise CorruptDataError(f"flight {src} mismatches manifest")
+        out.append(decode(blob))
+    return out
+
+
+def clean_serve_peer_granted(conn, recv_framed, verify_grant, key,
+                             mailbox):
+    hdr = recv_framed(conn, 0)
+    if not verify_grant(key, hdr["grant"]):  # clean: grant gates payload
+        return None
+    mailbox[hdr["src"]] = recv_framed(conn, 1)
+    return hdr
+
+
+def clean_collect_raises(gateway, xid, decode, hmac, want):
+    blob = gateway.recv_peer_flight(xid)
+    if not hmac.compare_digest(want, blob[:32]):  # clean: digest check
+        raise ValueError("peer flight failed its digest")
+    return decode(blob)
+
+
+def clean_reviewed_pragma(peer, xid, part, srcs, decode):
+    # clean: reviewed-legitimate consumer; the pragma documents it
+    flights = peer.wait_flights(xid, part, srcs)  # tpulint: disable=peer-flight-must-verify-manifest
+    return [decode(b) for b in flights.values()]
+
+
+def clean_plain_recv_flight(sock, recv_flight):
+    # clean: the framed flight's trailer is verified at the framing
+    # layer before decode — rule 15's seam, not rule 26's
+    return recv_flight(sock, 7)
+
+
+def clean_supervisor_link_recv_framed(conn, recv_framed):
+    # clean: a raw recv_framed OUTSIDE a peer-named function is the
+    # supervisor link (dial-back gateway), already authenticated
+    return recv_framed(conn, 0)

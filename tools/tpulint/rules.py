@@ -1,4 +1,4 @@
-"""The twenty per-file tpulint rules.
+"""The twenty-three per-file tpulint rules.
 
 Each rule encodes an invariant the stack already relies on implicitly;
 the docstring of each ``check_*`` names the bug class that motivated it
@@ -1678,6 +1678,88 @@ def check_exchange_overflow_classified(ctx: FileContext) -> List[RawFinding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# rule 26: peer-flight-must-verify-manifest
+# ---------------------------------------------------------------------------
+
+
+def _is_peer_flight_scope_file(ctx: FileContext) -> bool:
+    """Direct-flight homes: the exchange/cluster/dcn/shuffle layers
+    where one host receives flight bytes ANOTHER host produced and the
+    supervisor's manifest fingerprint is the only identity check
+    (flight-named files are the same surface under another name)."""
+    return ("exchange" in ctx.name or "cluster" in ctx.name
+            or "dcn" in ctx.name or "shuffle" in ctx.name
+            or "flight" in ctx.name)
+
+
+def _peer_receive_sites(fn) -> List[ast.AST]:
+    """Sites where peer-flight bytes land host-side: collecting the
+    mailbox (``wait_flights`` / ``recv_peer_flight``), or a raw
+    ``recv_framed`` inside a peer-named function (the gateway serve
+    path). Plain ``recv_flight`` is exempt: its trailer is verified at
+    the framing layer before decode (rule 15's seam)."""
+    out: List[ast.AST] = []
+    peer_fn = "peer" in fn.name.lower()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        last = _unparse(node.func).split(".")[-1]
+        if last in ("wait_flights", "recv_peer_flight"):
+            out.append(node)
+        elif last == "recv_framed" and peer_fn:
+            out.append(node)
+    return out
+
+
+def _fn_verifies_manifest(fn) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Raise):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        text = _unparse(node.func)
+        if ("verify" in text or "fingerprint" in text
+                or text.split(".")[-1] == "compare_digest"):
+            return True
+    return False
+
+
+def check_peer_flight_verifies_manifest(ctx: FileContext) -> List[RawFinding]:
+    """ISSUE-20 bug class (rule 26): decode-before-verify on the direct
+    exchange path. A peer flight arrives host-to-host — the supervisor
+    never saw the bytes, so the manifest fingerprint (and the HMAC
+    dial grant before it) is the ONLY thing standing between a merge
+    and rows some other process injected or a blob corrupted past the
+    ARQ budget. A function that collects peer flight bytes
+    (``wait_flights`` mailbox collect, ``recv_peer_flight``, or a raw
+    ``recv_framed`` in a peer-gateway serve path) but neither verifies
+    (``verify*`` / ``*fingerprint*`` / ``hmac.compare_digest`` call)
+    nor raises has broken verify-then-decode exactly where it matters
+    most: the codec decodes attacker-reachable bytes and the corruption
+    surfaces three layers up as wrong query results instead of a
+    classified ``CorruptDataError`` naming the flight. Scope:
+    exchange-/cluster-/dcn-/shuffle-/flight-named files."""
+    if not _is_peer_flight_scope_file(ctx):
+        return []
+    out: List[RawFinding] = []
+    for fn in _top_functions(ctx.tree):
+        sites = _peer_receive_sites(fn)
+        if not sites or _fn_verifies_manifest(fn):
+            continue
+        for node in sites:
+            out.append(RawFinding(
+                node.lineno, node.col_offset,
+                f"`{_unparse(node)[:60]}` receives peer flight bytes in "
+                f"`{fn.name}` but nothing verifies them against the "
+                f"manifest: check the blob fingerprint (or the dial "
+                f"grant via hmac.compare_digest) and raise before any "
+                f"decode — an unverified peer flight lets corrupt or "
+                f"injected bytes reach the codec and surface as wrong "
+                f"merge results instead of a classified CorruptDataError"))
+    return out
+
+
 RULES = [
     Rule("no-host-transfer-in-device-path",
          "no np.asarray / jax.device_get / .tolist() / float(traced) "
@@ -1782,4 +1864,11 @@ RULES = [
          "resilience.escalate -> CapacityOverflow) or raise — never a "
          "bare-boolean drop/cap path",
          check_exchange_overflow_classified),
+    Rule("peer-flight-must-verify-manifest",
+         "a function in an exchange/cluster/dcn/shuffle file that "
+         "collects peer flight bytes (wait_flights / recv_peer_flight "
+         "/ peer-path recv_framed) must verify them against the "
+         "manifest fingerprint or dial grant (verify*/fingerprint/"
+         "compare_digest) or raise — never decode-before-verify",
+         check_peer_flight_verifies_manifest),
 ]
